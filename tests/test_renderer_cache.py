@@ -1,0 +1,239 @@
+"""Tests for the renderer cache: shared local tables, global table, diffs.
+
+Scenario style mirrors the reference's renderer/cache/cache_test.go
+(behavioral assertions on table sharing and minimal diffs).
+"""
+
+import ipaddress
+
+from vpp_tpu.ir import Action, ContivRule, PodID, Protocol
+from vpp_tpu.ir.table import GLOBAL_TABLE_ID, TableType
+from vpp_tpu.renderer.api import PodConfig
+from vpp_tpu.renderer.cache import Orientation, RendererCache
+
+
+def net(s):
+    return ipaddress.ip_network(s)
+
+
+POD1 = PodID("default", "pod1")
+POD2 = PodID("default", "pod2")
+POD3 = PodID("default", "pod3")
+
+IP1 = net("10.1.1.1/32")
+IP2 = net("10.1.1.2/32")
+IP3 = net("10.1.1.3/32")
+
+
+def ingress_allow_tcp80():
+    """Typical K8s policy rendering: allow TCP:80 in, deny the rest."""
+    return [
+        ContivRule(action=Action.PERMIT, protocol=Protocol.TCP, dest_port=80),
+        ContivRule(action=Action.DENY, protocol=Protocol.TCP),
+        ContivRule(action=Action.DENY, protocol=Protocol.UDP),
+    ]
+
+
+def test_single_pod_ingress_table():
+    cache = RendererCache(Orientation.INGRESS)
+    txn = cache.new_txn()
+    txn.update(POD1, PodConfig(pod_ip=IP1, ingress=ingress_allow_tcp80(), egress=[]))
+    changes = txn.get_changes()
+    # One new local table (global stays empty / allow-all).
+    assert len(changes) == 1
+    table = changes[0].table
+    assert table.type == TableType.LOCAL
+    assert POD1 in table.pods
+    assert changes[0].previous_pods == set()
+    assert table.num_of_rules > 0
+    txn.commit()
+    assert cache.get_all_pods() == {POD1}
+    assert cache.get_isolated_pods() == {POD1}
+    assert cache.get_local_table_by_pod(POD1) is not None
+    assert cache.get_global_table().num_of_rules == 0
+
+
+def test_identical_rule_sets_share_table():
+    cache = RendererCache(Orientation.INGRESS)
+    txn = cache.new_txn()
+    txn.update(POD1, PodConfig(pod_ip=IP1, ingress=ingress_allow_tcp80(), egress=[]))
+    txn.update(POD2, PodConfig(pod_ip=IP2, ingress=ingress_allow_tcp80(), egress=[]))
+    txn.commit()
+    t1 = cache.get_local_table_by_pod(POD1)
+    t2 = cache.get_local_table_by_pod(POD2)
+    assert t1 is t2
+    assert t1.pods == {POD1, POD2}
+
+
+def test_unisolated_pod_has_no_table():
+    cache = RendererCache(Orientation.INGRESS)
+    txn = cache.new_txn()
+    txn.update(POD1, PodConfig(pod_ip=IP1, ingress=[], egress=[]))
+    txn.commit()
+    assert cache.get_all_pods() == {POD1}
+    assert cache.get_isolated_pods() == set()
+    assert cache.get_local_table_by_pod(POD1) is None
+
+
+def test_local_table_gets_default_allow_rules():
+    cache = RendererCache(Orientation.INGRESS)
+    txn = cache.new_txn()
+    txn.update(POD1, PodConfig(pod_ip=IP1, ingress=ingress_allow_tcp80(), egress=[]))
+    txn.commit()
+    table = cache.get_local_table_by_pod(POD1)
+    # deny-all TCP and UDP came from the config; the cache does not need to
+    # append permits because deny-all rules are already total.
+    protos = {(r.protocol, r.action) for r in table.rules}
+    assert (Protocol.TCP, Action.DENY) in protos
+    assert (Protocol.UDP, Action.DENY) in protos
+
+
+def test_pod_removal_releases_table():
+    cache = RendererCache(Orientation.INGRESS)
+    txn = cache.new_txn()
+    txn.update(POD1, PodConfig(pod_ip=IP1, ingress=ingress_allow_tcp80(), egress=[]))
+    txn.update(POD2, PodConfig(pod_ip=IP2, ingress=ingress_allow_tcp80(), egress=[]))
+    txn.commit()
+
+    txn2 = cache.new_txn()
+    txn2.update(POD1, PodConfig(removed=True))
+    changes = txn2.get_changes()
+    # Shared table loses POD1 but survives with POD2.
+    assert len(changes) == 1
+    assert changes[0].previous_pods == {POD1, POD2}
+    assert changes[0].table.pods == {POD2}
+    txn2.commit()
+    assert cache.get_all_pods() == {POD2}
+    assert cache.get_local_table_by_pod(POD2) is not None
+
+    txn3 = cache.new_txn()
+    txn3.update(POD2, PodConfig(removed=True))
+    changes = txn3.get_changes()
+    assert len(changes) == 1
+    assert changes[0].table.pods == set()
+    txn3.commit()
+    assert cache.get_all_pods() == set()
+    assert len(cache.local_tables.tables) == 0
+
+
+def test_no_changes_for_identical_update():
+    cache = RendererCache(Orientation.INGRESS)
+    cfg = PodConfig(pod_ip=IP1, ingress=ingress_allow_tcp80(), egress=[])
+    txn = cache.new_txn()
+    txn.update(POD1, cfg)
+    txn.commit()
+
+    txn2 = cache.new_txn()
+    txn2.update(POD1, PodConfig(pod_ip=IP1, ingress=ingress_allow_tcp80(), egress=[]))
+    assert txn2.get_changes() == []
+
+
+def test_egress_folds_into_global_table():
+    """With ingress orientation, a pod's egress restrictions land in the
+    global table (destination pinned to the pod IP)."""
+    cache = RendererCache(Orientation.INGRESS)
+    egress = [
+        ContivRule(action=Action.PERMIT, protocol=Protocol.TCP, dest_port=53),
+        ContivRule(action=Action.DENY, protocol=Protocol.TCP),
+        ContivRule(action=Action.DENY, protocol=Protocol.UDP),
+    ]
+    txn = cache.new_txn()
+    txn.update(POD1, PodConfig(pod_ip=IP1, ingress=[], egress=egress))
+    txn.commit()
+    gt = cache.get_global_table()
+    assert gt.num_of_rules > 0
+    # Every folded rule must pin dest to the pod IP; plus trailing allow-alls.
+    pinned = [r for r in gt.rules if r.dest_network == IP1]
+    assert len(pinned) == len(egress)
+    allow_all = [r for r in gt.rules if r.dest_network is None and r.src_network is None]
+    assert {r.protocol for r in allow_all} == {Protocol.TCP, Protocol.UDP}
+
+
+def test_ingress_egress_intersection_between_pods():
+    """Direction naming is from the vswitch POV (reference renderer/api.go):
+    a pod's *ingress* rules describe traffic the pod sends (src unset),
+    its *egress* rules describe traffic the pod receives (dst unset).
+
+    POD1 may send to TCP:80+8080 (ingress); POD2 may receive only TCP:80
+    (egress). Under ingress orientation POD1's local table must allow
+    sending to POD2 only on TCP:80 (the intersection), with deny-the-rest
+    pinned to POD2's IP as destination."""
+    cache = RendererCache(Orientation.INGRESS)
+    ingress1 = [
+        ContivRule(action=Action.PERMIT, protocol=Protocol.TCP, dest_port=80),
+        ContivRule(action=Action.PERMIT, protocol=Protocol.TCP, dest_port=8080),
+        ContivRule(action=Action.DENY, protocol=Protocol.TCP),
+        ContivRule(action=Action.DENY, protocol=Protocol.UDP),
+    ]
+    egress2 = [
+        ContivRule(action=Action.PERMIT, protocol=Protocol.TCP, dest_port=80),
+        ContivRule(action=Action.DENY, protocol=Protocol.TCP),
+        ContivRule(action=Action.DENY, protocol=Protocol.UDP),
+    ]
+    txn = cache.new_txn()
+    txn.update(POD1, PodConfig(pod_ip=IP1, ingress=ingress1, egress=[]))
+    txn.update(POD2, PodConfig(pod_ip=IP2, ingress=[], egress=egress2))
+    txn.commit()
+
+    t1 = cache.get_local_table_by_pod(POD1)
+    to_pod2 = [r for r in t1.rules if r.dest_network == IP2]
+    permits = {r.dest_port for r in to_pod2 if r.action == Action.PERMIT}
+    denies = [r for r in to_pod2 if r.action == Action.DENY and r.dest_port == 0]
+    assert permits == {80}
+    assert len(denies) >= 1  # deny-the-rest toward POD2
+
+
+def test_resync_then_update():
+    cache = RendererCache(Orientation.INGRESS)
+    txn = cache.new_txn()
+    txn.update(POD1, PodConfig(pod_ip=IP1, ingress=ingress_allow_tcp80(), egress=[]))
+    txn.commit()
+    dumped = [cache.get_local_table_by_pod(POD1), cache.get_global_table()]
+
+    cache2 = RendererCache(Orientation.INGRESS)
+    cache2.resync(dumped)
+    assert cache2.get_all_pods() == {POD1}
+    # Follow-up txn reconciling POD1's config produces no changes.
+    txn2 = cache2.new_txn()
+    txn2.update(POD1, PodConfig(pod_ip=IP1, ingress=ingress_allow_tcp80(), egress=[]))
+    assert txn2.get_changes() == []
+
+
+def test_icmp_permit_does_not_open_udp():
+    """Regression: a PERMIT ICMP rule must not be folded into the UDP port
+    set (which would disable UDP restrictions toward the pod)."""
+    cache = RendererCache(Orientation.INGRESS)
+    egress2 = [
+        ContivRule(action=Action.PERMIT, protocol=Protocol.ICMP),
+        ContivRule(action=Action.DENY, protocol=Protocol.TCP),
+        ContivRule(action=Action.DENY, protocol=Protocol.UDP),
+    ]
+    ingress1 = [
+        ContivRule(action=Action.PERMIT, protocol=Protocol.UDP, dest_port=53),
+        ContivRule(action=Action.DENY, protocol=Protocol.TCP),
+        ContivRule(action=Action.DENY, protocol=Protocol.UDP),
+    ]
+    txn = cache.new_txn()
+    txn.update(POD1, PodConfig(pod_ip=IP1, ingress=ingress1, egress=[]))
+    txn.update(POD2, PodConfig(pod_ip=IP2, ingress=[], egress=egress2))
+    txn.commit()
+    t1 = cache.get_local_table_by_pod(POD1)
+    # POD2 receives nothing on UDP => deny-all UDP toward POD2 must exist,
+    # and no UDP permit toward POD2 may appear.
+    to_pod2_udp = [r for r in t1.rules if r.dest_network == IP2 and r.protocol == Protocol.UDP]
+    assert any(r.action == Action.DENY and r.dest_port == 0 for r in to_pod2_udp)
+    assert not any(r.action == Action.PERMIT for r in to_pod2_udp)
+
+
+def test_table_id_counter_survives_resync():
+    cache = RendererCache(Orientation.INGRESS)
+    txn = cache.new_txn()
+    txn.update(POD1, PodConfig(pod_ip=IP1, ingress=ingress_allow_tcp80(), egress=[]))
+    txn.commit()
+    dumped = [cache.get_local_table_by_pod(POD1), cache.get_global_table()]
+    dumped_id = dumped[0].id
+
+    cache2 = RendererCache(Orientation.INGRESS)
+    cache2.resync(dumped)
+    # Newly generated IDs must not collide with dumped ones.
+    assert cache2._generate_table_id() != dumped_id

@@ -1,0 +1,97 @@
+"""Tests for the ContivRule IR and its total order.
+
+Mirrors the ordering invariants relied upon by the reference's renderer
+cache (plugins/policy/renderer/api.go Compare + utils.go CompareIPNets).
+"""
+
+import ipaddress
+
+from vpp_tpu.ir import (
+    Action,
+    ContivRule,
+    ContivRuleTable,
+    Protocol,
+    compare_ip_nets,
+    compare_ports,
+    compare_rules,
+)
+from vpp_tpu.ir.rule import rule_matches
+
+
+def net(s):
+    return ipaddress.ip_network(s)
+
+
+def test_compare_ports_any_is_highest():
+    assert compare_ports(0, 0) == 0
+    assert compare_ports(0, 80) == 1
+    assert compare_ports(80, 0) == -1
+    assert compare_ports(80, 443) == -1
+    assert compare_ports(443, 80) == 1
+
+
+def test_compare_ip_nets_subset_sorts_first():
+    # a ⊂ b => a < b
+    assert compare_ip_nets(net("10.1.1.0/24"), net("10.1.0.0/16")) == -1
+    assert compare_ip_nets(net("10.1.0.0/16"), net("10.1.1.0/24")) == 1
+    # None = 0/0 is the maximum
+    assert compare_ip_nets(net("10.1.1.0/24"), None) == -1
+    assert compare_ip_nets(None, net("10.1.1.0/24")) == 1
+    assert compare_ip_nets(None, None) == 0
+    # equal
+    assert compare_ip_nets(net("10.1.1.0/24"), net("10.1.1.0/24")) == 0
+    # disjoint but total
+    a, b = net("10.1.1.0/24"), net("10.2.2.0/24")
+    assert compare_ip_nets(a, b) == -compare_ip_nets(b, a) != 0
+    # IPv4 before IPv6
+    assert compare_ip_nets(net("10.0.0.0/8"), net("fd00::/8")) == -1
+
+
+def test_rule_total_order_specific_first():
+    specific = ContivRule(
+        action=Action.DENY,
+        src_network=net("10.1.1.3/32"),
+        protocol=Protocol.TCP,
+        dest_port=80,
+    )
+    wider = ContivRule(
+        action=Action.PERMIT,
+        src_network=net("10.1.1.0/24"),
+        protocol=Protocol.TCP,
+    )
+    widest = ContivRule(action=Action.PERMIT, protocol=Protocol.TCP)
+    assert compare_rules(specific, wider) == -1
+    assert compare_rules(wider, widest) == -1
+    assert sorted([widest, specific, wider]) == [specific, wider, widest]
+
+
+def test_rule_order_protocol_dominates():
+    tcp = ContivRule(action=Action.PERMIT, protocol=Protocol.TCP)
+    udp = ContivRule(action=Action.PERMIT, protocol=Protocol.UDP)
+    assert compare_rules(tcp, udp) == -1
+
+
+def test_table_insert_dedup_and_order():
+    t = ContivRuleTable("T1")
+    r1 = ContivRule(action=Action.PERMIT, protocol=Protocol.TCP)
+    r2 = ContivRule(action=Action.DENY, src_network=net("10.0.0.1/32"), protocol=Protocol.TCP)
+    assert t.insert_rule(r1)
+    assert t.insert_rule(r2)
+    assert not t.insert_rule(r1)  # duplicate
+    assert t.rules == [r2, r1]  # most specific first
+    assert t.num_of_rules == 2
+
+
+def test_rule_matches_oracle():
+    r = ContivRule(
+        action=Action.PERMIT,
+        src_network=net("10.1.0.0/16"),
+        protocol=Protocol.TCP,
+        dest_port=8080,
+    )
+    assert rule_matches(r, "10.1.2.3", "1.2.3.4", Protocol.TCP, 1234, 8080)
+    assert not rule_matches(r, "10.2.2.3", "1.2.3.4", Protocol.TCP, 1234, 8080)
+    assert not rule_matches(r, "10.1.2.3", "1.2.3.4", Protocol.UDP, 1234, 8080)
+    assert not rule_matches(r, "10.1.2.3", "1.2.3.4", Protocol.TCP, 1234, 80)
+    any_rule = ContivRule(action=Action.PERMIT, protocol=Protocol.ANY)
+    assert rule_matches(any_rule, "10.1.2.3", "1.2.3.4", Protocol.ICMP, 0, 0)

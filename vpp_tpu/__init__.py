@@ -1,0 +1,36 @@
+"""vpp_tpu — a TPU-native packet-processing framework.
+
+A from-scratch reimplementation of the capabilities of Contiv-VPP
+(reference: wyatuestc/vpp): Kubernetes-driven pod networking with
+NetworkPolicy enforcement (ordered 5-tuple ACL classification with
+reflective sessions), Service load-balancing (NAT44 DNAT/SNAT), IPAM,
+a multi-node overlay — with the per-packet data plane implemented as
+JAX/Pallas kernels consuming 256-packet vectors resident in HBM, and
+inter-node transport mapped onto ICI/DCN collectives where both ends
+are TPU hosts.
+
+Layering (mirrors reference SURVEY.md §1, re-designed TPU-first):
+
+- ``vpp_tpu.ir``        — canonical rule/policy/service IR
+                          (reference: plugins/policy/renderer/api.go).
+- ``vpp_tpu.renderer``  — the renderer boundary + shared renderer cache
+                          (reference: plugins/policy/renderer/cache).
+- ``vpp_tpu.ops``       — JAX/Pallas data-plane kernels: ip4 input/lookup,
+                          ACL classify, NAT44, VXLAN, sessions
+                          (reference: VPP graph nodes, external C).
+- ``vpp_tpu.pipeline``  — the fused packet pipeline + device table state
+                          (reference: VPP graph scheduler).
+- ``vpp_tpu.policy``    — policy cache/processor/configurator
+                          (reference: plugins/policy).
+- ``vpp_tpu.service``   — service processor/configurator → NAT config
+                          (reference: plugins/service).
+- ``vpp_tpu.ipam``      — node-ID arithmetic IPAM (reference: plugins/contiv/ipam).
+- ``vpp_tpu.ksr``       — K8s state reflectors (reference: plugins/ksr).
+- ``vpp_tpu.kvstore``   — etcd-style watchable KV store (reference: cn-infra kvdbsync).
+- ``vpp_tpu.agent``     — agent wiring, CNI server (reference: plugins/contiv, cmd/).
+- ``vpp_tpu.parallel``  — device-mesh sharding of tables/packet vectors,
+                          inter-node ICI overlay.
+- ``vpp_tpu.native``    — C++ host runtime (packet rings, parser).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,33 @@
+"""Canonical intermediate representation shared by the control plane and renderers."""
+
+from vpp_tpu.ir.rule import (
+    ANY_PORT,
+    Action,
+    ContivRule,
+    PodID,
+    Protocol,
+    allow_all_tcp,
+    allow_all_udp,
+    compare_ip_nets,
+    compare_ports,
+    compare_rule_lists,
+    compare_rules,
+)
+from vpp_tpu.ir.table import GLOBAL_TABLE_ID, ContivRuleTable, TableType
+
+__all__ = [
+    "ANY_PORT",
+    "Action",
+    "ContivRule",
+    "PodID",
+    "Protocol",
+    "allow_all_tcp",
+    "allow_all_udp",
+    "compare_ip_nets",
+    "compare_ports",
+    "compare_rule_lists",
+    "compare_rules",
+    "GLOBAL_TABLE_ID",
+    "ContivRuleTable",
+    "TableType",
+]
