@@ -1,0 +1,9 @@
+"""Data-plane kernels (JAX/Pallas), one module per VPP graph-node family.
+
+- ``ip4``      — ip4-input validation + TTL (reference: VPP ip4-input node)
+- ``fib``      — longest-prefix-match route lookup (reference: ip4-lookup)
+- ``acl``      — ordered 5-tuple first-match classify (reference: acl-plugin-fa)
+- ``session``  — reflective-flow hash table (reference: acl-plugin reflexive ACLs)
+- ``nat44``    — DNAT/SNAT + weighted backend LB (reference: nat44 plugin)
+- ``vxlan``    — overlay encap/decap headers (reference: vxlan plugin)
+"""

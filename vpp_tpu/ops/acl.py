@@ -1,0 +1,120 @@
+"""ACL classify: ordered 5-tuple first-match over rule tables.
+
+Reference analog: VPP's acl-plugin-fa classification (per-interface local
+ACLs + node-global ACL, first match wins). Defaults for unmatched
+traffic: deny for TCP/UDP (the renderer cache terminates tables with
+explicit allow/deny-all rules, so this rarely fires), permit for other
+protocols — the kernel-default equivalent of the reference ACL renderer
+appending explicit ICMP permits to every ACL (acl_renderer.go:378-398).
+
+Vectorization: VPP walks rules per packet with branches; here the match
+is a dense [VEC packets] x [R rules] compare (range checks on ports,
+masked compares on addresses) and first-match = argmax over the rule
+axis. Per-interface tables are row-gathers of the padded [T, R] arrays —
+every packet classifies against its own interface's table in the same
+dense op. The Pallas fast path (vpp_tpu/ops/acl_pallas.py) tiles the same
+computation through VMEM for the 10k-rule regime.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+
+from vpp_tpu.pipeline.tables import DataplaneTables
+from vpp_tpu.pipeline.vector import PacketVector
+
+
+class AclVerdict(NamedTuple):
+    permit: jnp.ndarray      # bool [P]
+    rule_idx: jnp.ndarray    # int32 [P], matched rule index (-1 = no match)
+
+
+def _first_match(
+    pkts: PacketVector,
+    src_net, src_mask, dst_net, dst_mask, proto, sport_lo, sport_hi,
+    dport_lo, dport_hi, action, nrules,
+) -> AclVerdict:
+    """Core first-match. Rule arrays are [P, R] (per-packet tables) or
+    [R] broadcastable; ``nrules`` is [P] or scalar."""
+    if src_net.ndim == 1:
+        src_net, src_mask = src_net[None, :], src_mask[None, :]
+        dst_net, dst_mask = dst_net[None, :], dst_mask[None, :]
+        proto = proto[None, :]
+        sport_lo, sport_hi = sport_lo[None, :], sport_hi[None, :]
+        dport_lo, dport_hi = dport_lo[None, :], dport_hi[None, :]
+        action = action[None, :]
+
+    src = pkts.src_ip[:, None]
+    dst = pkts.dst_ip[:, None]
+    m = (src & src_mask) == src_net
+    m &= (dst & dst_mask) == dst_net
+    m &= (proto == -1) | (proto == pkts.proto[:, None])
+    m &= (pkts.sport[:, None] >= sport_lo) & (pkts.sport[:, None] <= sport_hi)
+    m &= (pkts.dport[:, None] >= dport_lo) & (pkts.dport[:, None] <= dport_hi)
+
+    first = jnp.argmax(m, axis=1)
+    matched = jnp.take_along_axis(m, first[:, None], axis=1)[:, 0]
+    act = jnp.take_along_axis(
+        jnp.broadcast_to(action, m.shape), first[:, None], axis=1
+    )[:, 0]
+    # Defaults for unmatched traffic: an empty table allows all; a
+    # non-empty table denies unmatched TCP/UDP but *permits* other
+    # protocols (ICMP etc.) — the reference's ACL renderer always appends
+    # explicit ICMP permits to every rendered ACL (acl_renderer.go:378-398),
+    # so unmatched-ICMP-is-allowed is its effective semantic; encoding it
+    # as the kernel default keeps tables smaller. An explicit ICMP/ANY
+    # rule still matches first and can deny.
+    empty = nrules == 0
+    non_l4 = (pkts.proto != 6) & (pkts.proto != 17)
+    permit = jnp.where(matched, act == 1, empty | non_l4)
+    return AclVerdict(permit=permit, rule_idx=jnp.where(matched, first, -1))
+
+
+def acl_classify_local(tables: DataplaneTables, pkts: PacketVector) -> AclVerdict:
+    """Classify each packet against the local table of its rx interface.
+
+    Packets whose interface has no local table (-1) are permitted
+    (non-isolated pod — no policy applies).
+    """
+    tid = tables.if_local_table[pkts.rx_if]
+    has_table = tid >= 0
+    safe_tid = jnp.maximum(tid, 0)
+    verdict = _first_match(
+        pkts,
+        tables.acl_src_net[safe_tid], tables.acl_src_mask[safe_tid],
+        tables.acl_dst_net[safe_tid], tables.acl_dst_mask[safe_tid],
+        tables.acl_proto[safe_tid],
+        tables.acl_sport_lo[safe_tid], tables.acl_sport_hi[safe_tid],
+        tables.acl_dport_lo[safe_tid], tables.acl_dport_hi[safe_tid],
+        tables.acl_action[safe_tid],
+        tables.acl_nrules[safe_tid],
+    )
+    return AclVerdict(
+        permit=jnp.where(has_table, verdict.permit, True),
+        rule_idx=jnp.where(has_table, verdict.rule_idx, -1),
+    )
+
+
+def acl_classify_global(tables: DataplaneTables, pkts: PacketVector) -> AclVerdict:
+    """Classify each packet against the node-global table.
+
+    Applies only to packets arriving on interfaces marked
+    ``if_apply_global`` (node uplinks); others are permitted.
+    """
+    applies = tables.if_apply_global[pkts.rx_if] == 1
+    verdict = _first_match(
+        pkts,
+        tables.glb_src_net, tables.glb_src_mask,
+        tables.glb_dst_net, tables.glb_dst_mask,
+        tables.glb_proto,
+        tables.glb_sport_lo, tables.glb_sport_hi,
+        tables.glb_dport_lo, tables.glb_dport_hi,
+        tables.glb_action,
+        tables.glb_nrules,
+    )
+    return AclVerdict(
+        permit=jnp.where(applies, verdict.permit, True),
+        rule_idx=jnp.where(applies, verdict.rule_idx, -1),
+    )

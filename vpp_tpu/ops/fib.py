@@ -1,0 +1,45 @@
+"""ip4-lookup: vectorized longest-prefix-match over the FIB.
+
+Reference analog: VPP's mtrie-based ip4-lookup node. A TPU has no
+pointer-chasing advantage, so instead of a trie the whole (small) FIB is
+matched densely: [VEC packets] x [F routes] masked-compare, then the
+longest matching prefix wins via argmax on prefix length. Routes here are
+node-level (pod /32s, pod subnet, host subnet, per-peer-node subnets,
+default) — tens of entries, so the dense form is both simpler and faster
+than any sparse structure at this scale.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from vpp_tpu.pipeline.tables import DataplaneTables
+from vpp_tpu.pipeline.vector import Disposition, PacketVector
+
+
+class FibResult(NamedTuple):
+    matched: jnp.ndarray    # bool [P] — a route exists
+    tx_if: jnp.ndarray      # int32 [P]
+    disp: jnp.ndarray       # int32 [P] Disposition (DROP when unmatched)
+    next_hop: jnp.ndarray   # uint32 [P]
+    node_id: jnp.ndarray    # int32 [P] remote node index, -1 local
+
+
+def ip4_lookup(tables: DataplaneTables, dst_ip: jnp.ndarray) -> FibResult:
+    """LPM lookup of dst_ip [P] against the FIB slots."""
+    # [P, F] prefix match on valid slots.
+    hits = (dst_ip[:, None] & tables.fib_mask[None, :]) == tables.fib_prefix[None, :]
+    hits = hits & (tables.fib_plen[None, :] >= 0)
+    # Longest prefix wins; argmax returns the first slot among equals.
+    score = jnp.where(hits, tables.fib_plen[None, :], -1)
+    best = jnp.argmax(score, axis=1)
+    matched = jnp.take_along_axis(score, best[:, None], axis=1)[:, 0] >= 0
+    return FibResult(
+        matched=matched,
+        tx_if=jnp.where(matched, tables.fib_tx_if[best], -1),
+        disp=jnp.where(matched, tables.fib_disp[best], int(Disposition.DROP)),
+        next_hop=jnp.where(matched, tables.fib_next_hop[best], jnp.uint32(0)),
+        node_id=jnp.where(matched, tables.fib_node_id[best], -1),
+    )

@@ -1,0 +1,29 @@
+"""ip4-input: header validation + TTL handling, vectorized.
+
+Reference analog: VPP's ip4-input graph node (checks version/length/TTL/
+checksum and drops bad packets into error-drop). Parsing from raw bytes
+happens host-side (native parser); by the time packets are in a
+PacketVector the fields are already structured, so this stage validates
+semantics only.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from vpp_tpu.pipeline.vector import PacketVector
+
+
+def ip4_input(pkts: PacketVector) -> Tuple[PacketVector, jnp.ndarray]:
+    """Validate packets; returns (packets with decremented TTL, drop mask).
+
+    Drops: TTL <= 1 (would expire in forwarding), zero/invalid length.
+    Invalid slots in the frame are never "dropped" (they don't exist).
+    """
+    ttl_expired = pkts.ttl <= 1
+    bad_len = pkts.pkt_len < 20  # smaller than an IPv4 header
+    drop = (ttl_expired | bad_len) & pkts.valid
+    out = pkts._replace(ttl=jnp.where(pkts.valid & ~drop, pkts.ttl - 1, pkts.ttl))
+    return out, drop

@@ -1,0 +1,177 @@
+"""NAT44: service DNAT with weighted backend load-balancing + reverse path.
+
+Reference analog: VPP's nat44 plugin as driven by the reference's service
+configurator (plugins/service/configurator/configurator_impl.go:299-404):
+DNAT static mappings translate a service VIP (or nodeport) to one of N
+backends chosen by weight — local backends weighted 2x — and a session
+table translates return traffic back.
+
+TPU design: mappings are matched densely ([VEC] x [M]); the backend
+choice is a *consistent* weighted pick keyed on the flow hash, so every
+packet of a flow picks the same backend even before the NAT session is
+established (VPP relies on the session table for stickiness; hashing
+gives it stateless determinism — a TPU-friendly improvement). The NAT
+session table (same open-addressing design as the reflective ACL
+sessions) records the original (VIP, port) per flow for the reverse
+translation of backend→client traffic.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from vpp_tpu.ops.session import SESS_PROBES, _hash, _pack_ports, hashmap_insert
+from vpp_tpu.pipeline.tables import DataplaneTables
+from vpp_tpu.pipeline.vector import PacketVector
+
+
+def _flow_hash(pkts: PacketVector) -> jnp.ndarray:
+    """Symmetric-free 32-bit flow hash for backend selection."""
+    h = pkts.src_ip * jnp.uint32(0x01000193)
+    h ^= pkts.dst_ip * jnp.uint32(0x9E3779B1)
+    h ^= _pack_ports(pkts.sport, pkts.dport) * jnp.uint32(0x85EBCA77)
+    h ^= pkts.proto.astype(jnp.uint32)
+    h ^= h >> 16
+    h = h * jnp.uint32(0x7FEB352D)
+    h ^= h >> 15
+    return h
+
+
+def nat44_dnat(
+    tables: DataplaneTables,
+    pkts: PacketVector,
+    eligible: jnp.ndarray,
+) -> Tuple[PacketVector, jnp.ndarray]:
+    """Translate service VIP traffic to a weighted-chosen backend.
+
+    Pure translation — returns (rewritten packets, applied mask). Session
+    recording is a separate step (``nat44_record``) run *after* the ACL
+    verdict so denied packets never consume NAT session slots.
+    """
+    M = tables.nat_ext_ip.shape[0]
+    B = tables.natb_ip.shape[0]
+
+    # Match (dst_ip, dport, proto) against mappings. ext_port 0 = any port
+    # (used for plain node-IP SNAT passthrough mappings). An exact-port
+    # mapping always takes precedence over a port-0 wildcard for the same
+    # IP/proto, regardless of slot order.
+    exact = tables.nat_ext_port[None, :] == pkts.dport[:, None]
+    wildcard = tables.nat_ext_port[None, :] == 0
+    hit = (
+        (tables.nat_ext_ip[None, :] == pkts.dst_ip[:, None])
+        & (exact | wildcard)
+        & (tables.nat_proto[None, :] == pkts.proto[:, None])
+        & (tables.nat_bcnt[None, :] > 0)
+    )
+    score = jnp.where(hit, jnp.where(exact, 2, 1), 0)
+    m_idx = jnp.argmax(score, axis=1)
+    matched = (jnp.take_along_axis(score, m_idx[:, None], axis=1)[:, 0] > 0) & eligible
+
+    # Weighted consistent backend pick: w ∈ [0, total_w); first backend in
+    # the mapping's range with cumulative weight > w wins.
+    total_w = jnp.maximum(tables.nat_total_w[m_idx], 1)
+    w = (_flow_hash(pkts) % total_w.astype(jnp.uint32)).astype(jnp.int32)
+    boff = tables.nat_boff[m_idx]
+    bcnt = tables.nat_bcnt[m_idx]
+    b_range = jnp.arange(B, dtype=jnp.int32)[None, :]
+    cand = (
+        (b_range >= boff[:, None])
+        & (b_range < (boff + bcnt)[:, None])
+        & (tables.natb_cumw[None, :] > w[:, None])
+    )
+    b_idx = jnp.argmax(cand, axis=1)
+
+    new_dst = jnp.where(matched, tables.natb_ip[b_idx], pkts.dst_ip)
+    new_dport = jnp.where(matched, tables.natb_port[b_idx], pkts.dport)
+    out = pkts._replace(dst_ip=new_dst, dport=new_dport)
+    return out, matched
+
+
+def nat44_record(
+    tables: DataplaneTables,
+    pkts: PacketVector,
+    orig_dst: jnp.ndarray,
+    orig_dport: jnp.ndarray,
+    want: jnp.ndarray,
+    now: jnp.ndarray,
+) -> DataplaneTables:
+    """Record NAT sessions for translated-and-forwarded flows.
+
+    ``pkts`` are the post-translation headers; ``orig_dst``/``orig_dport``
+    the pre-translation destination (the VIP). Key = the flow as the
+    backend's reply will present it: (backend_ip, client_ip,
+    bport<<16|cport, proto); payload = the original (VIP, port).
+    """
+    key_vals = (
+        pkts.dst_ip,
+        pkts.src_ip,
+        _pack_ports(pkts.dport, pkts.sport),
+        pkts.proto,
+    )
+    h = _hash(*key_vals, tables.natsess_valid.shape[0])
+    valid, time, keys, extras, _ = hashmap_insert(
+        tables.natsess_valid,
+        tables.natsess_time,
+        (tables.natsess_a, tables.natsess_b, tables.natsess_ports, tables.natsess_proto),
+        key_vals,
+        (tables.natsess_orig_ip, tables.natsess_orig_port),
+        (orig_dst, orig_dport),
+        h,
+        want,
+        now,
+    )
+    return tables._replace(
+        natsess_a=keys[0],
+        natsess_b=keys[1],
+        natsess_ports=keys[2],
+        natsess_proto=keys[3],
+        natsess_valid=valid,
+        natsess_time=time,
+        natsess_orig_ip=extras[0],
+        natsess_orig_port=extras[1],
+    )
+
+
+def nat44_reverse(
+    tables: DataplaneTables,
+    pkts: PacketVector,
+    eligible: jnp.ndarray,
+) -> Tuple[PacketVector, jnp.ndarray]:
+    """Untranslate backend→client return traffic (src back to the VIP).
+
+    A reply packet (src=backend, dst=client) matches a NAT session keyed
+    (backend_ip, client_ip, bport<<16|cport, proto); its source is
+    rewritten to the recorded original (VIP, port).
+    """
+    n_slots = tables.natsess_valid.shape[0]
+    probes = SESS_PROBES
+    key_vals = (
+        pkts.src_ip,
+        pkts.dst_ip,
+        _pack_ports(pkts.sport, pkts.dport),
+        pkts.proto,
+    )
+    h = _hash(*key_vals, n_slots)
+    found = jnp.zeros(pkts.src_ip.shape, dtype=bool)
+    orig_ip = jnp.zeros_like(pkts.src_ip)
+    orig_port = jnp.zeros_like(pkts.sport)
+    for p in range(probes):
+        idx = (h + p) & (n_slots - 1)
+        slot_ok = tables.natsess_valid[idx] == 1
+        for arr, val in zip(
+            (tables.natsess_a, tables.natsess_b, tables.natsess_ports, tables.natsess_proto),
+            key_vals,
+        ):
+            slot_ok = slot_ok & (arr[idx] == val)
+        first_hit = slot_ok & ~found
+        orig_ip = jnp.where(first_hit, tables.natsess_orig_ip[idx], orig_ip)
+        orig_port = jnp.where(first_hit, tables.natsess_orig_port[idx], orig_port)
+        found = found | slot_ok
+    applied = found & eligible
+    out = pkts._replace(
+        src_ip=jnp.where(applied, orig_ip, pkts.src_ip),
+        sport=jnp.where(applied, orig_port, pkts.sport),
+    )
+    return out, applied

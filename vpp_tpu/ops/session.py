@@ -1,0 +1,199 @@
+"""Reflective-flow session table: an open-addressing hash map in HBM.
+
+Reference analog: VPP acl-plugin's reflexive ("reflect") ACL session
+table — when a policy permits flow A→B, the reverse flow B→A is admitted
+statefully without needing its own permit rule.
+
+Design: fixed-size power-of-two slot arrays, linear probing with a small
+static probe depth (fully unrolled under jit — no data-dependent control
+flow). Batch-parallel insert resolves same-slot collisions *within* a
+vector by a scatter-min election: the lowest packet index wins the slot,
+losers fall through to the next probe round. Aging is a host-side loop
+clearing stale ``sess_time`` entries (the reference ages sessions on a
+VPP worker interrupt, SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+
+from vpp_tpu.pipeline.tables import DataplaneTables
+from vpp_tpu.pipeline.vector import PacketVector
+
+_BIG = jnp.int32(0x7FFFFFFF)
+
+# Linear-probe depth of every hash table (lookup and insert must agree).
+SESS_PROBES = 4
+
+
+def _hash(src: jnp.ndarray, dst: jnp.ndarray, ports: jnp.ndarray, proto: jnp.ndarray,
+          n_slots: int) -> jnp.ndarray:
+    """Multiplicative xor hash of the 5-tuple into [0, n_slots)."""
+    h = src * jnp.uint32(0x9E3779B1)
+    h ^= dst * jnp.uint32(0x85EBCA77)
+    h ^= ports * jnp.uint32(0xC2B2AE3D)
+    h ^= proto.astype(jnp.uint32) * jnp.uint32(0x27D4EB2F)
+    h ^= h >> 15
+    h = h * jnp.uint32(0x2545F491)
+    h ^= h >> 13
+    return (h & jnp.uint32(n_slots - 1)).astype(jnp.int32)
+
+
+def _pack_ports(sport: jnp.ndarray, dport: jnp.ndarray) -> jnp.ndarray:
+    return (sport.astype(jnp.uint32) << 16) | dport.astype(jnp.uint32)
+
+
+def session_lookup_reverse(tables: DataplaneTables, pkts: PacketVector) -> jnp.ndarray:
+    """Is each packet the *return* traffic of an established session?
+
+    Looks up the reversed 5-tuple (dst→src, dport→sport) in the table.
+    Returns a bool mask [P].
+    """
+    n_slots = tables.sess_valid.shape[0]
+    probes = SESS_PROBES
+    key_src = pkts.dst_ip
+    key_dst = pkts.src_ip
+    key_ports = _pack_ports(pkts.dport, pkts.sport)
+    key_proto = pkts.proto
+    h = _hash(key_src, key_dst, key_ports, key_proto, n_slots)
+    hit = jnp.zeros(pkts.src_ip.shape, dtype=bool)
+    for p in range(probes):
+        idx = (h + p) & (n_slots - 1)
+        slot_match = (
+            (tables.sess_valid[idx] == 1)
+            & (tables.sess_src[idx] == key_src)
+            & (tables.sess_dst[idx] == key_dst)
+            & (tables.sess_ports[idx] == key_ports)
+            & (tables.sess_proto[idx] == key_proto)
+        )
+        hit = hit | slot_match
+    return hit
+
+
+def hashmap_insert(
+    valid: jnp.ndarray,
+    time: jnp.ndarray,
+    keys: Tuple[jnp.ndarray, ...],
+    key_vals: Tuple[jnp.ndarray, ...],
+    extras: Tuple[jnp.ndarray, ...],
+    extra_vals: Tuple[jnp.ndarray, ...],
+    h: jnp.ndarray,
+    want: jnp.ndarray,
+    now: jnp.ndarray,
+    probes: int = SESS_PROBES,
+) -> Tuple[jnp.ndarray, jnp.ndarray, Tuple[jnp.ndarray, ...], Tuple[jnp.ndarray, ...], jnp.ndarray]:
+    """Generic batch-parallel open-addressing insert (see module doc).
+
+    ``keys``/``extras`` are the table's slot arrays, ``key_vals``/
+    ``extra_vals`` the per-packet values to store; ``h`` the per-packet
+    home slot. Returns (valid, time, keys, extras, inserted_mask).
+    Matching on ``keys`` makes the insert idempotent (refreshes ``time``);
+    ``extras`` are payload columns written but not compared.
+    """
+    n_slots = valid.shape[0]
+    p_idx = jnp.arange(h.shape[0], dtype=jnp.int32)
+    keys = tuple(keys)
+    extras = tuple(extras)
+
+    def key_at(idx):
+        same = valid[idx] == 1
+        for arr, val in zip(keys, key_vals):
+            same = same & (arr[idx] == val)
+        return same
+
+    # Pass 1: existence check across the whole probe window, so a key whose
+    # entry sits at a later offset (because its home slot was taken at
+    # insert time but has since been freed) is refreshed, not duplicated.
+    exists = jnp.zeros_like(want)
+    exist_idx = jnp.zeros_like(h)
+    for p in range(probes):
+        idx = (h + p) & (n_slots - 1)
+        same = key_at(idx)
+        exist_idx = jnp.where(same & ~exists, idx, exist_idx)
+        exists = exists | same
+    refresh = want & exists
+    time = time.at[jnp.where(refresh, exist_idx, n_slots)].set(now, mode="drop")
+    pending = want & ~exists
+    inserted = refresh
+
+    # Pass 2: election-insert rounds. Among packets probing the same empty
+    # slot, the lowest packet index wins; after the write, any pending
+    # packet whose key now occupies the slot (the winner itself, or a
+    # same-key loser) is satisfied — this is what prevents two packets of
+    # one flow in the same vector from inserting twice.
+    for p in range(probes):
+        idx = (h + p) & (n_slots - 1)
+        empty = valid[idx] == 0
+        cand = pending & empty
+        claim = jnp.full((n_slots,), _BIG, dtype=jnp.int32)
+        claim = claim.at[jnp.where(cand, idx, n_slots)].min(p_idx, mode="drop")
+        winner = cand & (claim[idx] == p_idx)
+
+        widx = jnp.where(winner, idx, n_slots)  # out-of-range = dropped
+        keys = tuple(
+            arr.at[widx].set(val, mode="drop") for arr, val in zip(keys, key_vals)
+        )
+        extras = tuple(
+            arr.at[widx].set(val, mode="drop") for arr, val in zip(extras, extra_vals)
+        )
+        valid = valid.at[widx].set(1, mode="drop")
+        time = time.at[widx].set(now, mode="drop")
+        done = pending & key_at(idx)
+        inserted = inserted | done
+        pending = pending & ~done
+    return valid, time, keys, extras, inserted
+
+
+def session_insert(
+    tables: DataplaneTables,
+    pkts: PacketVector,
+    want: jnp.ndarray,
+    now: jnp.ndarray,
+) -> Tuple[DataplaneTables, jnp.ndarray]:
+    """Insert forward 5-tuples of ``want`` packets; returns (tables, inserted).
+
+    Existing identical sessions are refreshed (timestamp), not duplicated.
+    A packet that loses all probe rounds (table congestion) is simply not
+    inserted this vector — the next packet of the flow retries.
+    """
+    n_slots = tables.sess_valid.shape[0]
+    key_vals = (
+        pkts.src_ip,
+        pkts.dst_ip,
+        _pack_ports(pkts.sport, pkts.dport),
+        pkts.proto,
+    )
+    h = _hash(*key_vals, n_slots)
+    valid, time, keys, _, inserted = hashmap_insert(
+        tables.sess_valid,
+        tables.sess_time,
+        (tables.sess_src, tables.sess_dst, tables.sess_ports, tables.sess_proto),
+        key_vals,
+        (),
+        (),
+        h,
+        want,
+        now,
+    )
+    new_tables = tables._replace(
+        sess_src=keys[0],
+        sess_dst=keys[1],
+        sess_ports=keys[2],
+        sess_proto=keys[3],
+        sess_valid=valid,
+        sess_time=time,
+    )
+    return new_tables, inserted
+
+
+def session_expire(tables: DataplaneTables, now: int, max_age: int) -> DataplaneTables:
+    """Host-driven aging of both session tables (reflective ACL + NAT):
+    invalidate entries idle longer than ``max_age``."""
+    stale = (tables.sess_valid == 1) & (now - tables.sess_time > max_age)
+    nat_stale = (tables.natsess_valid == 1) & (now - tables.natsess_time > max_age)
+    return tables._replace(
+        sess_valid=jnp.where(stale, 0, tables.sess_valid),
+        natsess_valid=jnp.where(nat_stale, 0, tables.natsess_valid),
+    )

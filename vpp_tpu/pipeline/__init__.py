@@ -1,0 +1,26 @@
+"""The TPU data-plane pipeline: packet vectors, device tables, fused step.
+
+Reference analog: VPP's graph-node packet pipeline (256-packet frames
+flowing dpdk-input → ethernet-input → ip4-input → acl → nat44 →
+ip4-lookup → interface-tx; see SURVEY.md §3.5). Here each graph node is a
+vectorized JAX/Pallas stage over a struct-of-arrays packet vector, the
+whole chain is one jitted function, and tables live in HBM as a pytree
+swapped transactionally by renderer commits.
+"""
+
+from vpp_tpu.pipeline.vector import VEC, Disposition, PacketVector, make_packet_vector
+from vpp_tpu.pipeline.tables import (
+    DataplaneConfig,
+    DataplaneTables,
+    InterfaceType,
+)
+
+__all__ = [
+    "VEC",
+    "Disposition",
+    "PacketVector",
+    "make_packet_vector",
+    "DataplaneConfig",
+    "DataplaneTables",
+    "InterfaceType",
+]

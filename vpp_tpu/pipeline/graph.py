@@ -1,0 +1,134 @@
+"""The fused packet pipeline: one jitted step over a packet vector.
+
+Reference analog: the VPP graph-node chain installed by the agent
+(SURVEY.md §3.5): ip4-input → acl-plugin-fa → nat44 → ip4-lookup →
+[vxlan/remote] → interface-tx. VPP dispatches frames node-to-node through
+a scheduler; under XLA the whole chain is traced once and fused, with
+tables passed in functionally so a renderer commit is an epoch swap.
+
+Counters follow VPP's per-node/per-interface model and feed the
+statscollector (Prometheus) equivalent.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from vpp_tpu.ops.acl import acl_classify_global, acl_classify_local
+from vpp_tpu.ops.fib import ip4_lookup
+from vpp_tpu.ops.ip4 import ip4_input
+from vpp_tpu.ops.nat44 import nat44_dnat, nat44_record, nat44_reverse
+from vpp_tpu.ops.session import session_insert, session_lookup_reverse
+from vpp_tpu.pipeline.tables import DataplaneTables
+from vpp_tpu.pipeline.vector import Disposition, PacketVector
+
+
+class StepStats(NamedTuple):
+    """Per-step counters (VPP `show errors` / interface counters analog)."""
+
+    rx: jnp.ndarray            # int32 scalar: valid packets processed
+    tx: jnp.ndarray            # int32 scalar: packets forwarded
+    drop_ip4: jnp.ndarray      # int32 scalar: ip4-input drops (TTL/len)
+    drop_acl: jnp.ndarray      # int32 scalar: policy denies
+    drop_no_route: jnp.ndarray  # int32 scalar: FIB misses
+    if_rx: jnp.ndarray         # int32 [I] per-interface rx packets
+    if_tx: jnp.ndarray         # int32 [I] per-interface tx packets
+    if_rx_bytes: jnp.ndarray   # int32 [I]
+    if_tx_bytes: jnp.ndarray   # int32 [I]
+
+
+class StepResult(NamedTuple):
+    pkts: PacketVector         # header fields after rewrites (TTL, NAT)
+    disp: jnp.ndarray          # int32 [P] Disposition per packet
+    tx_if: jnp.ndarray         # int32 [P] egress interface (-1 if dropped)
+    node_id: jnp.ndarray       # int32 [P] destination node (-1 local)
+    next_hop: jnp.ndarray      # uint32 [P] peer IP for remote disposition
+    tables: DataplaneTables    # tables with updated session state
+    stats: StepStats
+
+
+def pipeline_step(
+    tables: DataplaneTables, pkts: PacketVector, now: jnp.ndarray
+) -> StepResult:
+    """Process one packet vector through the full forwarding chain.
+
+    Pure function: (tables, frame, time) → (result, new session state).
+    Jit once; call per frame.
+    """
+    n_ifaces = tables.if_type.shape[0]
+
+    # --- ip4-input ---
+    pkts, drop_ip4 = ip4_input(pkts)
+    # Traffic from an unconfigured interface slot is invalid (VPP analog:
+    # unknown sw_if_index → error-drop).
+    bad_if = tables.if_type[pkts.rx_if] == 0
+    drop_ip4 = drop_ip4 | (bad_if & pkts.valid)
+    alive = pkts.valid & ~drop_ip4
+
+    # --- reflective session bypass (return traffic of permitted flows) ---
+    # Looked up on the raw (pre-NAT) header: forward sessions are installed
+    # post-DNAT, so a backend's reply B→C reverses to the stored C→B key.
+    established = session_lookup_reverse(tables, pkts) & alive
+
+    # --- NAT44: reverse-translate return traffic, then DNAT new flows ---
+    pkts, nat_reversed = nat44_reverse(tables, pkts, alive)
+    orig_dst, orig_dport = pkts.dst_ip, pkts.dport
+    pkts, dnat_applied = nat44_dnat(tables, pkts, alive & ~nat_reversed)
+
+    # --- ACL classify (local per-interface table + node-global table) ---
+    local_v = acl_classify_local(tables, pkts)
+    glob_v = acl_classify_global(tables, pkts)
+    permit = (local_v.permit & glob_v.permit) | established
+    drop_acl = alive & ~permit
+
+    # --- ip4-lookup (on possibly NAT-rewritten dst) ---
+    fib = ip4_lookup(tables, pkts.dst_ip)
+    drop_no_route = alive & permit & ~fib.matched
+
+    forwarded = alive & permit & fib.matched & (fib.disp != int(Disposition.DROP))
+    disp = jnp.where(forwarded, fib.disp, int(Disposition.DROP)).astype(jnp.int32)
+    tx_if = jnp.where(forwarded, fib.tx_if, -1)
+
+    # --- session install for newly permitted L4 flows only (denied packets
+    # must not consume session slots) ---
+    is_l4 = (pkts.proto == 6) | (pkts.proto == 17)
+    want_sess = forwarded & ~established & is_l4
+    tables, _ = session_insert(tables, pkts, want_sess, now)
+    tables = nat44_record(
+        tables, pkts, orig_dst, orig_dport, dnat_applied & forwarded, now
+    )
+
+    # --- counters ---
+    rx_if_safe = jnp.where(alive, pkts.rx_if, n_ifaces)
+    tx_if_safe = jnp.where(forwarded, tx_if, n_ifaces)
+    zero_i = jnp.zeros((n_ifaces,), jnp.int32)
+    stats = StepStats(
+        rx=jnp.sum(alive.astype(jnp.int32)),
+        tx=jnp.sum(forwarded.astype(jnp.int32)),
+        drop_ip4=jnp.sum(drop_ip4.astype(jnp.int32)),
+        drop_acl=jnp.sum(drop_acl.astype(jnp.int32)),
+        drop_no_route=jnp.sum(drop_no_route.astype(jnp.int32)),
+        if_rx=zero_i.at[rx_if_safe].add(1, mode="drop"),
+        if_tx=zero_i.at[tx_if_safe].add(1, mode="drop"),
+        if_rx_bytes=zero_i.at[rx_if_safe].add(
+            jnp.where(alive, pkts.pkt_len, 0), mode="drop"
+        ),
+        if_tx_bytes=zero_i.at[tx_if_safe].add(
+            jnp.where(forwarded, pkts.pkt_len, 0), mode="drop"
+        ),
+    )
+    return StepResult(
+        pkts=pkts,
+        disp=disp,
+        tx_if=tx_if,
+        node_id=jnp.where(forwarded, fib.node_id, -1),
+        next_hop=jnp.where(forwarded, fib.next_hop, jnp.uint32(0)),
+        tables=tables,
+        stats=stats,
+    )
+
+
+pipeline_step_jit = jax.jit(pipeline_step, donate_argnums=())

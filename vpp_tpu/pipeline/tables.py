@@ -1,0 +1,391 @@
+"""Device-resident table state + host-side table compiler.
+
+All data-plane configuration (ACL rule tables, FIB, NAT mappings, session
+table, interface attributes) lives in one immutable pytree of device
+arrays, ``DataplaneTables``. A renderer commit builds a *new* pytree on
+the host (numpy) and swaps it in — the functional-JAX analog of VPP's
+double-buffered table swap: the jitted pipeline step simply takes the
+tables as an argument, so an epoch flip is one reference assignment and
+in-flight vectors keep their epoch's tables.
+
+Reference analogs: VPP ACL-plugin rule tables, ip4 FIB, NAT44 static
+mappings (external C, configured via vendored vpp-agent models — see
+SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import enum
+import ipaddress
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from vpp_tpu.ir.rule import ANY_PORT, Action, ContivRule, Protocol
+from vpp_tpu.pipeline.vector import Disposition, ip4
+
+
+class InterfaceType(enum.IntEnum):
+    NONE = 0
+    POD = 1      # pod-facing interface (VPP analog: TAP/veth+af_packet)
+    UPLINK = 2   # node uplink toward other nodes / cluster edge
+    HOST = 3     # host-stack interface (VPP analog: tap0 to the host)
+
+
+class DataplaneConfig(NamedTuple):
+    """Static sizing of the device tables (shapes are compile-time)."""
+
+    max_tables: int = 16       # local ACL table slots
+    max_rules: int = 128       # rules per local table (padded)
+    max_global_rules: int = 128
+    max_ifaces: int = 64
+    fib_slots: int = 128
+    sess_slots: int = 4096     # reflective-session hash slots (power of 2)
+    nat_mappings: int = 64     # DNAT static mapping slots
+    nat_backends: int = 512    # total backend slots across mappings
+
+
+class DataplaneTables(NamedTuple):
+    """The device table pytree. All arrays live in HBM; see module doc."""
+
+    # --- ACL local tables, padded [T, R] ---
+    acl_src_net: jnp.ndarray    # uint32, pre-masked network address
+    acl_src_mask: jnp.ndarray   # uint32
+    acl_dst_net: jnp.ndarray    # uint32
+    acl_dst_mask: jnp.ndarray   # uint32
+    acl_proto: jnp.ndarray      # int32 IANA proto, -1 = any, -2 = padding
+    acl_sport_lo: jnp.ndarray   # int32 (padding rows: lo=1, hi=0)
+    acl_sport_hi: jnp.ndarray   # int32
+    acl_dport_lo: jnp.ndarray   # int32
+    acl_dport_hi: jnp.ndarray   # int32
+    acl_action: jnp.ndarray     # int32: 0 deny, 1 permit, -1 padding
+    acl_nrules: jnp.ndarray     # int32 [T]
+
+    # --- global ACL table, padded [G] ---
+    glb_src_net: jnp.ndarray
+    glb_src_mask: jnp.ndarray
+    glb_dst_net: jnp.ndarray
+    glb_dst_mask: jnp.ndarray
+    glb_proto: jnp.ndarray
+    glb_sport_lo: jnp.ndarray
+    glb_sport_hi: jnp.ndarray
+    glb_dport_lo: jnp.ndarray
+    glb_dport_hi: jnp.ndarray
+    glb_action: jnp.ndarray
+    glb_nrules: jnp.ndarray     # int32 scalar
+
+    # --- interfaces [I] ---
+    if_type: jnp.ndarray        # int32 InterfaceType
+    if_local_table: jnp.ndarray  # int32 local ACL table slot, -1 = none
+    if_apply_global: jnp.ndarray  # int32 bool: global table applies here
+
+    # --- FIB [F] ---
+    fib_prefix: jnp.ndarray     # uint32 pre-masked
+    fib_mask: jnp.ndarray       # uint32
+    fib_plen: jnp.ndarray       # int32, -1 = empty slot
+    fib_tx_if: jnp.ndarray      # int32
+    fib_disp: jnp.ndarray       # int32 Disposition
+    fib_next_hop: jnp.ndarray   # uint32 (peer/VXLAN dst IP, else 0)
+    fib_node_id: jnp.ndarray    # int32 remote node index (ICI), -1 local
+
+    # --- reflective sessions (open-addressing hash) [S] ---
+    sess_src: jnp.ndarray       # uint32
+    sess_dst: jnp.ndarray       # uint32
+    sess_ports: jnp.ndarray     # uint32 (sport<<16 | dport)
+    sess_proto: jnp.ndarray     # int32
+    sess_valid: jnp.ndarray     # int32 bool
+    sess_time: jnp.ndarray      # int32 last-hit epoch (for host-side aging)
+
+    # --- NAT44 DNAT mappings [M] + backends [B] ---
+    nat_ext_ip: jnp.ndarray     # uint32 service VIP / node IP
+    nat_ext_port: jnp.ndarray   # int32
+    nat_proto: jnp.ndarray      # int32
+    nat_boff: jnp.ndarray       # int32 offset into backend arrays
+    nat_bcnt: jnp.ndarray       # int32 backend count (0 = empty slot)
+    nat_total_w: jnp.ndarray    # int32 total backend weight
+    natb_ip: jnp.ndarray        # uint32 [B]
+    natb_port: jnp.ndarray      # int32 [B]
+    natb_cumw: jnp.ndarray      # int32 [B] cumulative weight within mapping
+    nat_snat_ip: jnp.ndarray    # uint32 scalar: SNAT address (node IP)
+
+    # --- NAT44 session table (reverse translation state) [NS] ---
+    # key: (backend_ip, client_ip, bport<<16|cport, proto)
+    natsess_a: jnp.ndarray          # uint32
+    natsess_b: jnp.ndarray          # uint32
+    natsess_ports: jnp.ndarray      # uint32
+    natsess_proto: jnp.ndarray      # int32
+    natsess_valid: jnp.ndarray      # int32
+    natsess_time: jnp.ndarray       # int32
+    natsess_orig_ip: jnp.ndarray    # uint32 original dst (service VIP)
+    natsess_orig_port: jnp.ndarray  # int32 original dst port
+
+
+def _mask_of(plen: int, bits: int = 32) -> int:
+    return ((1 << bits) - 1) ^ ((1 << (bits - plen)) - 1) if plen else 0
+
+
+def pack_rules(rules: Sequence[ContivRule], max_rules: int) -> Dict[str, np.ndarray]:
+    """Compile an ordered ContivRule list into padded match arrays.
+
+    Rules must already be in evaluation order (most specific first — the
+    ContivRuleTable invariant); first match wins in the kernel. Padding
+    rows can never match (impossible port range, proto -2).
+    """
+    n = len(rules)
+    if n > max_rules:
+        raise ValueError(f"{n} rules exceed table capacity {max_rules}")
+    out = {
+        "src_net": np.zeros(max_rules, np.uint32),
+        "src_mask": np.zeros(max_rules, np.uint32),
+        "dst_net": np.zeros(max_rules, np.uint32),
+        "dst_mask": np.zeros(max_rules, np.uint32),
+        "proto": np.full(max_rules, -2, np.int32),
+        "sport_lo": np.ones(max_rules, np.int32),
+        "sport_hi": np.zeros(max_rules, np.int32),
+        "dport_lo": np.ones(max_rules, np.int32),
+        "dport_hi": np.zeros(max_rules, np.int32),
+        "action": np.full(max_rules, -1, np.int32),
+    }
+    for i, r in enumerate(rules):
+        if r.src_network is not None:
+            if r.src_network.version != 4:
+                raise NotImplementedError("IPv6 rules not yet packed")
+            plen = r.src_network.prefixlen
+            out["src_mask"][i] = _mask_of(plen)
+            out["src_net"][i] = int(r.src_network.network_address) & _mask_of(plen)
+        if r.dest_network is not None:
+            if r.dest_network.version != 4:
+                raise NotImplementedError("IPv6 rules not yet packed")
+            plen = r.dest_network.prefixlen
+            out["dst_mask"][i] = _mask_of(plen)
+            out["dst_net"][i] = int(r.dest_network.network_address) & _mask_of(plen)
+        out["proto"][i] = r.protocol.ip_proto  # -1 for ANY
+        out["sport_lo"][i] = 0 if r.src_port == ANY_PORT else r.src_port
+        out["sport_hi"][i] = 65535 if r.src_port == ANY_PORT else r.src_port
+        out["dport_lo"][i] = 0 if r.dest_port == ANY_PORT else r.dest_port
+        out["dport_hi"][i] = 65535 if r.dest_port == ANY_PORT else r.dest_port
+        out["action"][i] = int(r.action)
+    return out
+
+
+class TableBuilder:
+    """Mutable host-side (numpy) staging area for the device tables.
+
+    The TPU renderer and the node controller mutate this builder, then call
+    ``to_device()`` to produce the immutable DataplaneTables pytree for the
+    next epoch. Session state is *not* rebuilt: ``to_device`` can graft the
+    live session arrays from a previous epoch so established flows survive
+    table swaps.
+    """
+
+    def __init__(self, config: DataplaneConfig = DataplaneConfig()):
+        self.config = config
+        c = config
+        z = np.zeros
+        self.acl = {
+            k: np.tile(v, (c.max_tables, 1))
+            for k, v in pack_rules([], c.max_rules).items()
+        }
+        self.acl_nrules = z(c.max_tables, np.int32)
+        self.glb = pack_rules([], c.max_global_rules)
+        self.glb_nrules = 0
+        self.if_type = z(c.max_ifaces, np.int32)
+        self.if_local_table = np.full(c.max_ifaces, -1, np.int32)
+        self.if_apply_global = z(c.max_ifaces, np.int32)
+        self.fib_prefix = z(c.fib_slots, np.uint32)
+        self.fib_mask = z(c.fib_slots, np.uint32)
+        self.fib_plen = np.full(c.fib_slots, -1, np.int32)
+        self.fib_tx_if = z(c.fib_slots, np.int32)
+        self.fib_disp = np.full(c.fib_slots, int(Disposition.DROP), np.int32)
+        self.fib_next_hop = z(c.fib_slots, np.uint32)
+        self.fib_node_id = np.full(c.fib_slots, -1, np.int32)
+        self.nat_ext_ip = z(c.nat_mappings, np.uint32)
+        self.nat_ext_port = z(c.nat_mappings, np.int32)
+        self.nat_proto = z(c.nat_mappings, np.int32)
+        self.nat_boff = z(c.nat_mappings, np.int32)
+        self.nat_bcnt = z(c.nat_mappings, np.int32)
+        self.nat_total_w = z(c.nat_mappings, np.int32)
+        self.natb_ip = z(c.nat_backends, np.uint32)
+        self.natb_port = z(c.nat_backends, np.int32)
+        self.natb_cumw = z(c.nat_backends, np.int32)
+        self.nat_snat_ip = np.uint32(0)
+
+    # --- ACL ---
+    def set_local_table(self, slot: int, rules: Sequence[ContivRule]) -> None:
+        packed = pack_rules(rules, self.config.max_rules)
+        for k, v in packed.items():
+            self.acl[k][slot] = v
+        self.acl_nrules[slot] = len(rules)
+
+    def clear_local_table(self, slot: int) -> None:
+        self.set_local_table(slot, [])
+
+    def set_global_table(self, rules: Sequence[ContivRule]) -> None:
+        self.glb = pack_rules(rules, self.config.max_global_rules)
+        self.glb_nrules = len(rules)
+
+    # --- interfaces ---
+    def set_interface(
+        self,
+        if_index: int,
+        if_type: InterfaceType,
+        local_table: int = -1,
+        apply_global: bool = False,
+    ) -> None:
+        self.if_type[if_index] = int(if_type)
+        self.if_local_table[if_index] = local_table
+        self.if_apply_global[if_index] = int(apply_global)
+
+    # --- FIB ---
+    def add_route(
+        self,
+        prefix: str,
+        tx_if: int,
+        disposition: Disposition,
+        next_hop: int = 0,
+        node_id: int = -1,
+        slot: Optional[int] = None,
+    ) -> int:
+        net = ipaddress.ip_network(prefix)
+        if slot is None:
+            free = np.nonzero(self.fib_plen < 0)[0]
+            if len(free) == 0:
+                raise ValueError("FIB full")
+            slot = int(free[0])
+        mask = _mask_of(net.prefixlen)
+        self.fib_prefix[slot] = int(net.network_address) & mask
+        self.fib_mask[slot] = mask
+        self.fib_plen[slot] = net.prefixlen
+        self.fib_tx_if[slot] = tx_if
+        self.fib_disp[slot] = int(disposition)
+        self.fib_next_hop[slot] = next_hop
+        self.fib_node_id[slot] = node_id
+        return slot
+
+    def del_route(self, prefix: str) -> bool:
+        net = ipaddress.ip_network(prefix)
+        mask = _mask_of(net.prefixlen)
+        want = int(net.network_address) & mask
+        hit = np.nonzero(
+            (self.fib_plen == net.prefixlen) & (self.fib_prefix == want)
+        )[0]
+        if len(hit) == 0:
+            return False
+        self.fib_plen[hit[0]] = -1
+        return True
+
+    # --- NAT ---
+    def set_nat_mapping(
+        self,
+        slot: int,
+        ext_ip: int,
+        ext_port: int,
+        proto: int,
+        backends: Sequence[Tuple[int, int, int]],  # (ip, port, weight)
+        boff: int,
+    ) -> None:
+        """Install a DNAT static mapping with weighted backends at ``slot``,
+        placing backends at ``boff`` in the backend arrays."""
+        if boff + len(backends) > self.config.nat_backends:
+            raise ValueError("NAT backend arrays full")
+        cum = 0
+        for j, (bip, bport, w) in enumerate(backends):
+            cum += w
+            self.natb_ip[boff + j] = bip
+            self.natb_port[boff + j] = bport
+            self.natb_cumw[boff + j] = cum
+        self.nat_ext_ip[slot] = ext_ip
+        self.nat_ext_port[slot] = ext_port
+        self.nat_proto[slot] = proto
+        self.nat_boff[slot] = boff
+        self.nat_bcnt[slot] = len(backends)
+        self.nat_total_w[slot] = cum
+
+    def clear_nat(self) -> None:
+        self.nat_bcnt[:] = 0
+
+    # --- device upload ---
+    def to_device(self, sessions: Optional[DataplaneTables] = None) -> DataplaneTables:
+        """Produce the immutable device pytree. If ``sessions`` (a previous
+        epoch's tables) is given, its live session arrays are carried over."""
+        c = self.config
+        if sessions is not None:
+            sess = dict(
+                sess_src=sessions.sess_src,
+                sess_dst=sessions.sess_dst,
+                sess_ports=sessions.sess_ports,
+                sess_proto=sessions.sess_proto,
+                sess_valid=sessions.sess_valid,
+                sess_time=sessions.sess_time,
+                natsess_a=sessions.natsess_a,
+                natsess_b=sessions.natsess_b,
+                natsess_ports=sessions.natsess_ports,
+                natsess_proto=sessions.natsess_proto,
+                natsess_valid=sessions.natsess_valid,
+                natsess_time=sessions.natsess_time,
+                natsess_orig_ip=sessions.natsess_orig_ip,
+                natsess_orig_port=sessions.natsess_orig_port,
+            )
+        else:
+            sess = dict(
+                sess_src=jnp.zeros(c.sess_slots, jnp.uint32),
+                sess_dst=jnp.zeros(c.sess_slots, jnp.uint32),
+                sess_ports=jnp.zeros(c.sess_slots, jnp.uint32),
+                sess_proto=jnp.zeros(c.sess_slots, jnp.int32),
+                sess_valid=jnp.zeros(c.sess_slots, jnp.int32),
+                sess_time=jnp.zeros(c.sess_slots, jnp.int32),
+                natsess_a=jnp.zeros(c.sess_slots, jnp.uint32),
+                natsess_b=jnp.zeros(c.sess_slots, jnp.uint32),
+                natsess_ports=jnp.zeros(c.sess_slots, jnp.uint32),
+                natsess_proto=jnp.zeros(c.sess_slots, jnp.int32),
+                natsess_valid=jnp.zeros(c.sess_slots, jnp.int32),
+                natsess_time=jnp.zeros(c.sess_slots, jnp.int32),
+                natsess_orig_ip=jnp.zeros(c.sess_slots, jnp.uint32),
+                natsess_orig_port=jnp.zeros(c.sess_slots, jnp.int32),
+            )
+        return DataplaneTables(
+            acl_src_net=jnp.asarray(self.acl["src_net"]),
+            acl_src_mask=jnp.asarray(self.acl["src_mask"]),
+            acl_dst_net=jnp.asarray(self.acl["dst_net"]),
+            acl_dst_mask=jnp.asarray(self.acl["dst_mask"]),
+            acl_proto=jnp.asarray(self.acl["proto"]),
+            acl_sport_lo=jnp.asarray(self.acl["sport_lo"]),
+            acl_sport_hi=jnp.asarray(self.acl["sport_hi"]),
+            acl_dport_lo=jnp.asarray(self.acl["dport_lo"]),
+            acl_dport_hi=jnp.asarray(self.acl["dport_hi"]),
+            acl_action=jnp.asarray(self.acl["action"]),
+            acl_nrules=jnp.asarray(self.acl_nrules),
+            glb_src_net=jnp.asarray(self.glb["src_net"]),
+            glb_src_mask=jnp.asarray(self.glb["src_mask"]),
+            glb_dst_net=jnp.asarray(self.glb["dst_net"]),
+            glb_dst_mask=jnp.asarray(self.glb["dst_mask"]),
+            glb_proto=jnp.asarray(self.glb["proto"]),
+            glb_sport_lo=jnp.asarray(self.glb["sport_lo"]),
+            glb_sport_hi=jnp.asarray(self.glb["sport_hi"]),
+            glb_dport_lo=jnp.asarray(self.glb["dport_lo"]),
+            glb_dport_hi=jnp.asarray(self.glb["dport_hi"]),
+            glb_action=jnp.asarray(self.glb["action"]),
+            glb_nrules=jnp.asarray(np.int32(self.glb_nrules)),
+            if_type=jnp.asarray(self.if_type),
+            if_local_table=jnp.asarray(self.if_local_table),
+            if_apply_global=jnp.asarray(self.if_apply_global),
+            fib_prefix=jnp.asarray(self.fib_prefix),
+            fib_mask=jnp.asarray(self.fib_mask),
+            fib_plen=jnp.asarray(self.fib_plen),
+            fib_tx_if=jnp.asarray(self.fib_tx_if),
+            fib_disp=jnp.asarray(self.fib_disp),
+            fib_next_hop=jnp.asarray(self.fib_next_hop),
+            fib_node_id=jnp.asarray(self.fib_node_id),
+            nat_ext_ip=jnp.asarray(self.nat_ext_ip),
+            nat_ext_port=jnp.asarray(self.nat_ext_port),
+            nat_proto=jnp.asarray(self.nat_proto),
+            nat_boff=jnp.asarray(self.nat_boff),
+            nat_bcnt=jnp.asarray(self.nat_bcnt),
+            nat_total_w=jnp.asarray(self.nat_total_w),
+            natb_ip=jnp.asarray(self.natb_ip),
+            natb_port=jnp.asarray(self.natb_port),
+            natb_cumw=jnp.asarray(self.natb_cumw),
+            nat_snat_ip=jnp.asarray(self.nat_snat_ip),
+            **sess,
+        )

@@ -1,0 +1,105 @@
+"""Packet vectors: fixed-size struct-of-arrays batches of packet headers.
+
+VPP processes packets in frames of up to 256; the same frame model maps
+directly onto TPU vector lanes (256 = 2×128 lanes), so VEC=256 is the
+native batch unit here too. Header fields are SoA int32/uint32 arrays —
+TPU's natural integer width — rather than VPP's array-of-structs vlib
+buffers. Payload bytes (needed only for encap/decap and host IO) travel
+in a separate byte buffer and never enter the classify/NAT/FIB kernels.
+
+Reference analog: vlib frames + vnet buffer metadata (external VPP C).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+# Native packet-frame size (packets per vector).
+VEC = 256
+
+
+class Disposition(enum.IntEnum):
+    """Where a packet goes after the pipeline — VPP's "next node" analog."""
+
+    DROP = 0        # error-drop / policy deny
+    LOCAL = 1       # tx to a local pod/host interface
+    REMOTE = 2      # tx toward another node (ICI/DCN or VXLAN uplink)
+    HOST = 3        # punt to the host stack
+    UNKNOWN = 4     # not yet determined (pipeline-internal)
+
+
+class PacketVector(NamedTuple):
+    """A frame of packet headers in SoA layout. All arrays have shape [VEC]
+    (or [B, VEC] when batched); dtypes are fixed as noted.
+
+    ``flags`` bit 0 = packet slot valid (frames may be partially filled).
+    """
+
+    src_ip: jnp.ndarray   # uint32, IPv4 address (network-byte-order value)
+    dst_ip: jnp.ndarray   # uint32
+    proto: jnp.ndarray    # int32, IANA protocol number (6 TCP, 17 UDP, 1 ICMP)
+    sport: jnp.ndarray    # int32, L4 source port (0 for portless protos)
+    dport: jnp.ndarray    # int32
+    ttl: jnp.ndarray      # int32
+    pkt_len: jnp.ndarray  # int32, total IP length in bytes
+    rx_if: jnp.ndarray    # int32, software interface index the packet arrived on
+    flags: jnp.ndarray    # int32 bitfield; bit0 = valid
+
+    @property
+    def valid(self) -> jnp.ndarray:
+        return (self.flags & 1) == 1
+
+
+FLAG_VALID = 1
+
+
+def ip4(addr: str) -> int:
+    """Dotted-quad string → uint32 host-order integer value."""
+    a, b, c, d = (int(x) for x in addr.split("."))
+    return (a << 24) | (b << 16) | (c << 8) | d
+
+
+def ip4_str(value: int) -> str:
+    value = int(value) & 0xFFFFFFFF
+    return f"{value >> 24}.{(value >> 16) & 255}.{(value >> 8) & 255}.{value & 255}"
+
+
+def make_packet_vector(
+    packets: Optional[list] = None,
+    n: int = VEC,
+    np_mod=np,
+) -> PacketVector:
+    """Build a PacketVector from a list of dicts (host-side test/ingest path).
+
+    Each dict may carry: src, dst (dotted strings or ints), proto, sport,
+    dport, ttl, len, rx_if. Missing slots are zero-filled and marked invalid.
+    """
+    packets = packets or []
+    assert len(packets) <= n, f"{len(packets)} packets > frame size {n}"
+
+    def col(name, default, dtype=np.int32):
+        out = np.full((n,), default, dtype=dtype)
+        for i, p in enumerate(packets):
+            v = p.get(name, default)
+            if name in ("src", "dst") and isinstance(v, str):
+                v = ip4(v)
+            out[i] = v
+        return out
+
+    flags = np.zeros((n,), dtype=np.int32)
+    flags[: len(packets)] = FLAG_VALID
+    return PacketVector(
+        src_ip=jnp.asarray(col("src", 0, np.uint32)),
+        dst_ip=jnp.asarray(col("dst", 0, np.uint32)),
+        proto=jnp.asarray(col("proto", 6)),
+        sport=jnp.asarray(col("sport", 0)),
+        dport=jnp.asarray(col("dport", 0)),
+        ttl=jnp.asarray(col("ttl", 64)),
+        pkt_len=jnp.asarray(col("len", 64)),
+        rx_if=jnp.asarray(col("rx_if", 0)),
+        flags=jnp.asarray(flags),
+    )

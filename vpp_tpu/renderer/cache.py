@@ -249,6 +249,10 @@ class RendererCache:
         for table in tables:
             if table is None:
                 continue
+            # Copy: the cache must own its tables — later commits mutate pod
+            # assignments in place and must not corrupt the caller's dump
+            # (or another cache still holding the same objects).
+            table = table.copy()
             if table.type == TableType.GLOBAL:
                 global_table = table
                 continue
@@ -470,15 +474,22 @@ class RendererCacheTxn:
             if src_cfg is not None:
                 self._install_local_rules(table, dst_cfg, src_cfg)
 
-        # Explicitly allow traffic not matched by any rule.
+        # Explicitly allow traffic not matched by any rule. A rule counts as
+        # "total" for its protocol only if every match dimension is
+        # wildcarded (the reference omits the src_port check because its
+        # configurator never emits src-port rules; our IR allows them, so
+        # check it — otherwise a src-port-specific permit would suppress
+        # the allow-all append and default-deny everything else).
         if table.rules:
             all_tcp = any(
-                r.dest_port == ANY_PORT and r.src_network is None and r.dest_network is None
+                r.dest_port == ANY_PORT and r.src_port == ANY_PORT
+                and r.src_network is None and r.dest_network is None
                 and r.protocol == Protocol.TCP
                 for r in table.rules
             )
             all_udp = any(
-                r.dest_port == ANY_PORT and r.src_network is None and r.dest_network is None
+                r.dest_port == ANY_PORT and r.src_port == ANY_PORT
+                and r.src_network is None and r.dest_network is None
                 and r.protocol == Protocol.UDP
                 for r in table.rules
             )
