@@ -1,0 +1,127 @@
+"""KSR tests: models round-trip, reflector events, mark-and-sweep resync.
+
+Mirrors the reference's per-reflector tests (plugins/ksr/*_test.go) using
+the mock list-watch seam.
+"""
+
+from vpp_tpu.ksr import MockK8sListWatch, make_standard_reflectors
+from vpp_tpu.ksr import model as m
+from vpp_tpu.kvstore import Broker, KVStore
+
+
+def make_env():
+    store = KVStore()
+    broker = Broker(store, "/vnf-agent/contiv-ksr/")
+    sources = {}
+    registry = make_standard_reflectors(broker, sources)
+    return store, broker, sources, registry
+
+
+def sample_pod(name="web-1", ip="10.1.1.2"):
+    return m.Pod(
+        name=name,
+        namespace="default",
+        labels={"app": "web"},
+        ip_address=ip,
+        host_ip_address="192.168.16.1",
+        containers=[m.Container(name="c", ports=[m.ContainerPort(name="http", container_port=8080)])],
+    )
+
+
+def test_model_round_trip():
+    pod = sample_pod()
+    again = m.Pod.from_dict(pod.to_dict())
+    assert again == pod
+    assert again.containers[0].ports[0].container_port == 8080
+
+    pol = m.Policy(
+        name="allow-web",
+        namespace="default",
+        pods=m.LabelSelector(match_labels={"app": "web"}),
+        policy_type=m.POLICY_INGRESS,
+        ingress_rules=[
+            m.PolicyRule(
+                ports=[m.PolicyPort(protocol="TCP", port=8080)],
+                peers=[
+                    m.PolicyPeer(
+                        pods=m.LabelSelector(
+                            match_expressions=[m.LabelExpression(key="tier", operator=m.IN, values=["fe"])]
+                        )
+                    ),
+                    m.PolicyPeer(ip_block=m.IPBlock(cidr="10.0.0.0/8", except_cidrs=["10.1.0.0/16"])),
+                ],
+            )
+        ],
+    )
+    again = m.Policy.from_dict(pol.to_dict())
+    assert again == pol
+    assert again.ingress_rules[0].peers[1].ip_block.cidr == "10.0.0.0/8"
+
+
+def test_label_selector_semantics():
+    sel = m.LabelSelector(
+        match_labels={"app": "web"},
+        match_expressions=[
+            m.LabelExpression(key="tier", operator=m.NOT_IN, values=["db"]),
+            m.LabelExpression(key="zone", operator=m.EXISTS),
+        ],
+    )
+    assert sel.matches({"app": "web", "zone": "a"})
+    assert not sel.matches({"app": "web"})  # zone missing
+    assert not sel.matches({"app": "web", "zone": "a", "tier": "db"})
+    assert m.LabelSelector().matches({"anything": "x"})  # empty matches all
+
+
+def test_key_scheme():
+    pod = sample_pod()
+    assert pod.key() == "k8s/pod/web-1/namespace/default"
+    assert m.Node(name="n1").key() == "k8s/node/n1"
+    parsed = m.parse_key(pod.key())
+    assert parsed == {"type": "pod", "name": "web-1", "namespace": "default"}
+
+
+def test_reflector_event_flow():
+    store, broker, sources, registry = make_env()
+    registry.start_all()
+    assert registry.all_synced()
+
+    pod = sample_pod()
+    sources["pod"].add("default/web-1", pod)
+    assert broker.get(pod.key()) == pod.to_dict()
+
+    pod2 = sample_pod(ip="10.1.1.9")
+    sources["pod"].update("default/web-1", pod2)
+    assert broker.get(pod.key())["ip_address"] == "10.1.1.9"
+
+    sources["pod"].delete("default/web-1")
+    assert broker.get(pod.key()) is None
+
+    stats = registry.stats()["pod"]
+    assert (stats["adds"], stats["updates"], stats["deletes"]) == (1, 1, 1)
+
+
+def test_mark_and_sweep_resync():
+    store, broker, sources, registry = make_env()
+    # Stale item in the store from a previous life; live item in "K8s".
+    stale = sample_pod(name="gone")
+    broker.put(stale.key(), stale.to_dict())
+    live = sample_pod(name="alive")
+    sources["pod"] = MockK8sListWatch()
+    sources["pod"].add("default/alive", live)
+
+    registry2 = make_standard_reflectors(broker, sources)
+    registry2.start_all()
+    assert broker.get(stale.key()) is None          # swept
+    assert broker.get(live.key()) == live.to_dict()  # marked
+
+
+def test_events_paused_until_synced():
+    store, broker, sources, registry = make_env()
+    r = registry.reflectors["pod"]
+    r.start()
+    r.stop_data_store_updates()
+    pod = sample_pod()
+    sources["pod"].add("default/web-1", pod)
+    assert broker.get(pod.key()) is None  # write suppressed while unsynced
+    r.resync()
+    assert broker.get(pod.key()) == pod.to_dict()  # resync catches up

@@ -1,0 +1,188 @@
+"""Tests for kvstore (watch/CAS/persist), KVProxy, IPAM and node-ID allocator.
+
+Mirrors reference tests: plugins/contiv/ipam/ipam_test.go (arithmetic +
+allocation), persist_test.go (reload), kvdbproxy tests (self-echo skip).
+"""
+
+import ipaddress
+
+import pytest
+
+from vpp_tpu.agent.node_id import NodeIDAllocator
+from vpp_tpu.ipam import IPAM, IpamConfig
+from vpp_tpu.kvstore import Broker, KVProxy, KVStore, Op
+
+
+def test_kvstore_watch_and_cas():
+    s = KVStore()
+    events = []
+    cancel = s.watch("a/", events.append)
+    s.put("a/x", 1)
+    s.put("b/y", 2)  # outside prefix
+    s.delete("a/x")
+    assert [(e.op, e.key, e.value) for e in events] == [
+        (Op.PUT, "a/x", 1),
+        (Op.DELETE, "a/x", None),
+    ]
+    cancel()
+    s.put("a/z", 3)
+    assert len(events) == 2
+
+    assert s.compare_and_put("c", None, 10)
+    assert not s.compare_and_put("c", None, 11)  # exists now
+    assert s.compare_and_put("c", 10, 12)
+    assert s.get("c") == 12
+
+
+def test_kvstore_persistence(tmp_path):
+    path = str(tmp_path / "kv.json")
+    s = KVStore(persist_path=path)
+    s.put("k8s/pod/default/p1", {"ip": "10.1.1.2"})
+    s.put("ipam/p1", {"ip": 123, "pod": "p1"})
+    s.save()  # autosave is debounced; explicit save = checkpoint
+
+    s2 = KVStore(persist_path=path)
+    assert s2.get("k8s/pod/default/p1") == {"ip": "10.1.1.2"}
+    assert s2.revision == s.revision
+
+
+def test_broker_prefix_scoping():
+    s = KVStore()
+    b = Broker(s, "/vnf-agent/node1/")
+    b.put("contiv/x", 1)
+    assert s.get("/vnf-agent/node1/contiv/x") == 1
+    events = []
+    b.watch("contiv/", events.append)
+    b.put("contiv/y", 2)
+    assert events[0].key == "contiv/y"  # prefix stripped
+
+
+def test_kvproxy_skips_self_echo():
+    s = KVStore()
+    proxy = KVProxy(s)
+    events = []
+    proxy.watch("cfg/", events.append)
+    proxy.put("cfg/mine", 1)            # self write -> echo swallowed
+    s.put("cfg/other", 2)               # external write -> delivered
+    proxy.put("cfg/loud", 3, ignore_echo=False)
+    assert [e.key for e in events] == ["cfg/other", "cfg/loud"]
+
+
+def test_ipam_network_arithmetic():
+    """Reference example (ipam/doc.go): node 5 with defaults:
+    pods 10.1.5.0/24, host interconnect 172.30.5.0/24, node IP .5."""
+    ipam = IPAM(node_id=5)
+    assert str(ipam.pod_network) == "10.1.5.0/24"
+    assert str(ipam.pod_gateway_ip()) == "10.1.5.1"
+    assert str(ipam.vpp_host_network) == "172.30.5.0/24"
+    assert str(ipam.veth_vpp_end_ip()) == "172.30.5.1"
+    assert str(ipam.veth_host_end_ip()) == "172.30.5.2"
+    assert str(ipam.node_ip_address()) == "192.168.16.5"
+    assert str(ipam.vxlan_ip_address()) == "192.168.30.5"
+    assert str(ipam.other_node_pod_network(7)) == "10.1.7.0/24"
+    assert str(ipam.node_ip_address(7)) == "192.168.16.7"
+
+
+def test_ipam_allocation_cycle():
+    ipam = IPAM(node_id=1)
+    ip1 = ipam.next_pod_ip("default/p1")
+    ip2 = ipam.next_pod_ip("default/p2")
+    assert str(ip1) == "10.1.1.2"  # .1 is the gateway
+    assert str(ip2) == "10.1.1.3"
+    assert ipam.get_pod_ip("default/p1") == ip1
+    assert ipam.release_pod_ip("default/p1")
+    assert not ipam.release_pod_ip("default/p1")  # already released
+    # released IP is not immediately reused (rotation)
+    ip3 = ipam.next_pod_ip("default/p3")
+    assert str(ip3) == "10.1.1.4"
+    with pytest.raises(ValueError):
+        ipam.next_pod_ip("")
+
+
+def test_ipam_exhaustion_and_wrap():
+    cfg = IpamConfig(pod_network_prefix_len=29)  # 8 addrs: usable seq 2..6
+    # (0=network, 1=gateway, 7=broadcast are reserved)
+    ipam = IPAM(node_id=1, config=cfg)
+    ips = [ipam.next_pod_ip(f"p{i}") for i in range(5)]
+    assert len(set(ips)) == 5
+    with pytest.raises(RuntimeError):
+        ipam.next_pod_ip("overflow")
+    ipam.release_pod_ip("p0")
+    assert ipam.next_pod_ip("again") == ips[0]
+
+
+def test_ipam_persistence_reload():
+    store = KVStore()
+    broker = Broker(store, "/vnf-agent/node1/")
+    ipam = IPAM(node_id=1, broker=broker)
+    ip1 = ipam.next_pod_ip("default/p1")
+    ip2 = ipam.next_pod_ip("default/p2")
+    ipam.release_pod_ip("default/p1")
+
+    # Agent restart: new IPAM instance over the same store.
+    ipam2 = IPAM(node_id=1, broker=broker)
+    assert ipam2.get_pod_ip("default/p2") == ip2
+    assert ipam2.get_pod_ip("default/p1") is None
+    # lastAssigned was restored: allocation continues past p2.
+    ip3 = ipam2.next_pod_ip("default/p3")
+    assert int(ip3) > int(ip2)
+
+
+def test_node_id_allocator():
+    store = KVStore()
+    a1 = NodeIDAllocator(store, "node-a")
+    a2 = NodeIDAllocator(store, "node-b")
+    assert a1.get_or_allocate() == 1
+    assert a2.get_or_allocate() == 2
+    # restart of node-a reuses its claim
+    a1b = NodeIDAllocator(store, "node-a")
+    assert a1b.get_or_allocate() == 1
+
+    a1.publish_ips("192.168.16.1/24", "10.0.0.1")
+    nodes = a2.list_nodes()
+    assert nodes[1]["ip"] == "192.168.16.1/24"
+    assert nodes[1]["name"] == "node-a"
+
+    a2.release()
+    a3 = NodeIDAllocator(store, "node-c")
+    assert a3.get_or_allocate() == 2  # freed ID is reused
+
+
+def test_ipam_never_allocates_broadcast():
+    cfg = IpamConfig(pod_network_prefix_len=30)  # 4 addrs: only seq 2 usable
+    ipam = IPAM(node_id=1, config=cfg)
+    ip = ipam.next_pod_ip("p0")
+    assert int(ip) % 4 == 2  # not network(0), gateway(1), broadcast(3)
+    with pytest.raises(RuntimeError):
+        ipam.next_pod_ip("p1")
+
+
+def test_ipam_rejects_node_id_overflow():
+    # /16 subnet with /20 per-node networks leaves 4 node bits -> IDs 0..15.
+    cfg = IpamConfig(pod_subnet_cidr="10.1.0.0/16", pod_network_prefix_len=20)
+    with pytest.raises(ValueError):
+        IPAM(node_id=17, config=cfg)
+
+
+def test_kvproxy_ignore_consumed_without_watchers():
+    """An ignore entry must be consumed by the echo even when no watcher
+    matches, so it cannot swallow a later external change."""
+    s = KVStore()
+    proxy = KVProxy(s)
+    proxy.put("cfg/x", 1)  # echo consumed with no subscribers
+    events = []
+    proxy.watch("cfg/", events.append)
+    s.put("cfg/x", 2)  # external change must be delivered
+    assert [e.value for e in events] == [2]
+
+
+def test_kvproxy_two_watchers_one_skip():
+    s = KVStore()
+    proxy = KVProxy(s)
+    ev1, ev2 = [], []
+    proxy.watch("cfg/", ev1.append)
+    proxy.watch("cfg/", ev2.append)
+    proxy.put("cfg/self", 1)
+    s.put("cfg/ext", 2)
+    assert [e.key for e in ev1] == ["cfg/ext"]
+    assert [e.key for e in ev2] == ["cfg/ext"]

@@ -1,0 +1,76 @@
+"""Cluster-unique node ID allocation via kvstore compare-and-put.
+
+Each agent claims the smallest free uint8 ID by CAS-inserting its node
+name under ``allocatedIDs/<id>``; on restart it finds and reuses its
+existing claim. The allocator also publishes the node's data-plane IP and
+management IP for other nodes' node-event handlers to consume.
+
+Reference: plugins/contiv/node_id_allocator.go (getID :77,
+writeIfNotExists :178, updateEtcdEntry :133).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from vpp_tpu.kvstore.store import KVStore
+
+ID_PREFIX = "allocatedIDs/"
+MAX_ID = 255
+
+
+class NodeIDAllocator:
+    def __init__(self, store: KVStore, node_name: str):
+        self.store = store
+        self.node_name = node_name
+        self.node_id: Optional[int] = None
+
+    def get_or_allocate(self) -> int:
+        """Find this node's existing claim or CAS-claim the smallest free ID."""
+        if self.node_id is not None:
+            return self.node_id
+        # Reuse an existing claim (agent restart).
+        for key, val in self.store.list_values(ID_PREFIX).items():
+            if isinstance(val, dict) and val.get("name") == self.node_name:
+                self.node_id = int(key[len(ID_PREFIX):])
+                return self.node_id
+        # Claim the smallest free ID; retry on CAS races with other agents.
+        for attempt in range(MAX_ID):
+            taken = {
+                int(k[len(ID_PREFIX):]) for k in self.store.list_keys(ID_PREFIX)
+            }
+            candidate = next(
+                (i for i in range(1, MAX_ID + 1) if i not in taken), None
+            )
+            if candidate is None:
+                raise RuntimeError("node ID space exhausted")
+            if self.store.compare_and_put(
+                ID_PREFIX + str(candidate), None, {"name": self.node_name}
+            ):
+                self.node_id = candidate
+                return candidate
+        raise RuntimeError("node ID space exhausted")
+
+    def publish_ips(self, node_ip: str, mgmt_ip: str = "") -> None:
+        """Publish this node's data-plane and management IPs for peers."""
+        if self.node_id is None:
+            raise RuntimeError("allocate an ID before publishing IPs")
+        self.store.put(
+            ID_PREFIX + str(self.node_id),
+            {"name": self.node_name, "ip": node_ip, "mgmt_ip": mgmt_ip},
+        )
+
+    def list_nodes(self) -> Dict[int, dict]:
+        """All known nodes: id -> {name, ip?, mgmt_ip?}."""
+        out = {}
+        for key, val in self.store.list_values(ID_PREFIX).items():
+            try:
+                out[int(key[len(ID_PREFIX):])] = val
+            except ValueError:
+                continue
+        return out
+
+    def release(self) -> None:
+        if self.node_id is not None:
+            self.store.delete(ID_PREFIX + str(self.node_id))
+            self.node_id = None

@@ -1,0 +1,5 @@
+"""Distributed node-local IPAM (no central allocator)."""
+
+from vpp_tpu.ipam.ipam import IPAM, IpamConfig
+
+__all__ = ["IPAM", "IpamConfig"]

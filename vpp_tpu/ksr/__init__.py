@@ -1,0 +1,22 @@
+"""KSR — K8s State Reflector: mirrors cluster state into the kvstore.
+
+Reference: plugins/ksr (generic reflector engine + 6 reflectors over
+pod/namespace/policy/service/endpoints/node, mark-and-sweep resync,
+`k8s/<type>/<name>/namespace/<ns>` keyspace).
+"""
+
+from vpp_tpu.ksr import model
+from vpp_tpu.ksr.reflector import (
+    MockK8sListWatch,
+    Reflector,
+    ReflectorRegistry,
+    make_standard_reflectors,
+)
+
+__all__ = [
+    "model",
+    "MockK8sListWatch",
+    "Reflector",
+    "ReflectorRegistry",
+    "make_standard_reflectors",
+]

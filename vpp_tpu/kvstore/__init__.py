@@ -1,0 +1,12 @@
+"""Watchable key-value store: the control-plane data backbone.
+
+Reference analog: ETCD + cn-infra's kvdbsync (watch/resync semantics,
+per-consumer key prefixes) — SURVEY.md §5.8(a). The store is in-memory
+with optional JSON file persistence (the durable-store role ETCD plays in
+the reference: checkpoint/resume = reload + watchers replay state).
+"""
+
+from vpp_tpu.kvstore.store import Broker, KVEvent, KVStore, Op
+from vpp_tpu.kvstore.proxy import KVProxy
+
+__all__ = ["Broker", "KVEvent", "KVStore", "Op", "KVProxy"]

@@ -1,0 +1,222 @@
+"""In-memory etcd-style KV store with prefix watch, CAS and persistence.
+
+Values are JSON-serializable Python objects (the reference stores
+protobufs; our data models are dataclasses serialized via their
+``to_dict``/``from_dict``). Watch delivery is synchronous and in put()
+order — deterministic for tests, matching how the reference's unit tests
+feed synthetic datasync events (SURVEY.md §4).
+
+Reference: cn-infra db/keyval + kvdbsync (vendored), used via brokers
+with service-label prefixes (flavors/contiv/contiv_flavor.go:128-138).
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import os
+import threading
+import time as _time
+from typing import Any, Callable, Dict, Iterable, List, NamedTuple, Optional, Tuple
+
+
+class Op(enum.Enum):
+    PUT = "put"
+    DELETE = "delete"
+
+
+class KVEvent(NamedTuple):
+    op: Op
+    key: str
+    value: Any            # new value (None for DELETE)
+    prev_value: Any       # previous value (None if new key)
+    rev: int              # store revision at which the change happened
+
+
+WatchCallback = Callable[[KVEvent], None]
+
+
+class KVStore:
+    """Thread-safe watchable KV store with a global revision counter.
+
+    Watch callbacks run synchronously under the store lock (an RLock, so
+    a callback may re-enter the store from the same thread): this is what
+    guarantees revision-ordered delivery across threads. Callbacks must
+    not block on other threads that touch the store.
+    """
+
+    def __init__(self, persist_path: Optional[str] = None):
+        self._lock = threading.RLock()
+        self._last_save = 0.0
+        self._data: Dict[str, Any] = {}
+        self._rev = 0
+        self._watchers: List[Tuple[str, WatchCallback]] = []
+        self._persist_path = persist_path
+        if persist_path and os.path.exists(persist_path):
+            self.load(persist_path)
+
+    # --- basic ops ---
+    def get(self, key: str) -> Any:
+        with self._lock:
+            return self._data.get(key)
+
+    def put(self, key: str, value: Any) -> int:
+        with self._lock:
+            prev = self._data.get(key)
+            self._data[key] = value
+            self._rev += 1
+            ev = KVEvent(Op.PUT, key, value, prev, self._rev)
+            self._notify(ev)
+            self._maybe_persist()
+        return ev.rev
+
+    def delete(self, key: str) -> bool:
+        with self._lock:
+            if key not in self._data:
+                return False
+            prev = self._data.pop(key)
+            self._rev += 1
+            ev = KVEvent(Op.DELETE, key, None, prev, self._rev)
+            self._notify(ev)
+            self._maybe_persist()
+        return True
+
+    def compare_and_put(self, key: str, expected: Any, value: Any) -> bool:
+        """Atomic CAS; ``expected=None`` means "key must not exist".
+
+        Reference analog: the ETCD compare-and-put used by the node-ID
+        allocator (plugins/contiv/node_id_allocator.go:178).
+        """
+        with self._lock:
+            cur = self._data.get(key)
+            if cur != expected:
+                return False
+            prev = cur
+            self._data[key] = value
+            self._rev += 1
+            ev = KVEvent(Op.PUT, key, value, prev, self._rev)
+            self._notify(ev)
+            self._maybe_persist()
+        return True
+
+    def compare_and_delete(self, key: str, expected: Any) -> bool:
+        with self._lock:
+            if self._data.get(key) != expected:
+                return False
+            prev = self._data.pop(key)
+            self._rev += 1
+            ev = KVEvent(Op.DELETE, key, None, prev, self._rev)
+            self._notify(ev)
+            self._maybe_persist()
+        return True
+
+    def list_values(self, prefix: str) -> Dict[str, Any]:
+        with self._lock:
+            return {k: v for k, v in self._data.items() if k.startswith(prefix)}
+
+    def list_keys(self, prefix: str) -> List[str]:
+        with self._lock:
+            return sorted(k for k in self._data if k.startswith(prefix))
+
+    @property
+    def revision(self) -> int:
+        with self._lock:
+            return self._rev
+
+    # --- watch ---
+    def watch(self, prefix: str, callback: WatchCallback) -> Callable[[], None]:
+        """Subscribe to changes under a key prefix; returns unsubscribe fn."""
+        entry = (prefix, callback)
+        with self._lock:
+            self._watchers.append(entry)
+
+        def cancel() -> None:
+            with self._lock:
+                if entry in self._watchers:
+                    self._watchers.remove(entry)
+
+        return cancel
+
+    def _notify(self, ev: KVEvent) -> None:
+        # Called with the lock held; copy so callbacks may (un)subscribe.
+        for prefix, cb in list(self._watchers):
+            if ev.key.startswith(prefix):
+                cb(ev)
+
+    # --- persistence (checkpoint/resume; reference: ETCD durability) ---
+    def dump(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"rev": self._rev, "data": dict(self._data)}
+
+    def save(self, path: Optional[str] = None) -> None:
+        path = path or self._persist_path
+        if not path:
+            return
+        with self._lock:
+            snapshot = self.dump()
+            tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(snapshot, f)
+            os.replace(tmp, path)
+            self._last_save = _time.monotonic()
+
+    def load(self, path: str) -> None:
+        with open(path) as f:
+            snapshot = json.load(f)
+        with self._lock:
+            self._data = dict(snapshot["data"])
+            self._rev = int(snapshot["rev"])
+
+    # Autosave is debounced: the file is checkpoint-grade durability (the
+    # reference's durable store is external etcd); call save() explicitly
+    # for a synchronous checkpoint.
+    AUTOSAVE_MIN_INTERVAL = 0.2  # seconds
+
+    def _maybe_persist(self) -> None:
+        if self._persist_path and (
+            _time.monotonic() - self._last_save >= self.AUTOSAVE_MIN_INTERVAL
+        ):
+            self.save()
+
+
+class Broker:
+    """A prefix-scoped view of a KVStore (cn-infra broker analog).
+
+    All keys are automatically prefixed with the broker's prefix — the
+    equivalent of cn-infra's servicelabel scoping
+    (`/vnf-agent/<microservice-label>/`).
+    """
+
+    def __init__(self, store: KVStore, prefix: str):
+        self.store = store
+        self.prefix = prefix
+
+    def _k(self, key: str) -> str:
+        return self.prefix + key
+
+    def get(self, key: str) -> Any:
+        return self.store.get(self._k(key))
+
+    def put(self, key: str, value: Any) -> int:
+        return self.store.put(self._k(key), value)
+
+    def delete(self, key: str) -> bool:
+        return self.store.delete(self._k(key))
+
+    def compare_and_put(self, key: str, expected: Any, value: Any) -> bool:
+        return self.store.compare_and_put(self._k(key), expected, value)
+
+    def list_values(self, prefix: str = "") -> Dict[str, Any]:
+        full = self._k(prefix)
+        return {
+            k[len(self.prefix):]: v
+            for k, v in self.store.list_values(full).items()
+        }
+
+    def watch(self, prefix: str, callback: WatchCallback) -> Callable[[], None]:
+        full = self._k(prefix)
+
+        def strip(ev: KVEvent) -> None:
+            callback(ev._replace(key=ev.key[len(self.prefix):]))
+
+        return self.store.watch(full, strip)
