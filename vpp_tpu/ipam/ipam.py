@@ -72,10 +72,15 @@ def _apply_node_id(
 
 
 def _host_ip_in(cidr: ipaddress.IPv4Network, node_id: int) -> ipaddress.IPv4Address:
-    """CIDR base + node_id truncated to the CIDR's host bits."""
+    """CIDR base + node_id. Raises if the ID does not fit the host bits
+    (same no-silent-collision stance as _apply_node_id) or would be the
+    broadcast address."""
     host_bits = 32 - cidr.prefixlen
-    part = node_id & ((1 << host_bits) - 1)
-    return ipaddress.ip_address(int(cidr.network_address) + part)
+    if node_id >= (1 << host_bits) - 1:
+        raise ValueError(
+            f"node ID {node_id} does not fit as a host address in {cidr}"
+        )
+    return ipaddress.ip_address(int(cidr.network_address) + node_id)
 
 
 class IPAM:
@@ -210,11 +215,16 @@ class IPAM:
 
     def _load_assigned(self) -> None:
         base = int(self.pod_network.network_address)
-        count = 0
-        for _, item in self.broker.list_values(_PERSIST_PREFIX).items():
+        max_seq = self.pod_network.num_addresses - 1
+        for key, item in self.broker.list_values(_PERSIST_PREFIX).items():
             ip = int(item["ip"])
-            self._assigned[ip] = item["pod"]
             seq = ip - base
+            if not 0 < seq < max_seq:
+                # Persisted entry from a different pod network (e.g. the
+                # node came back with a new ID): stale — drop it rather
+                # than poisoning the allocator bounds.
+                self.broker.delete(key)
+                continue
+            self._assigned[ip] = item["pod"]
             if seq > self._last_assigned:
                 self._last_assigned = seq
-            count += 1

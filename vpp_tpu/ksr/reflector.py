@@ -15,6 +15,7 @@ plugins/ksr/ksr_reflector.go:41-98, markAndSweep :185-232).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
@@ -24,6 +25,8 @@ from vpp_tpu.kvstore.store import Broker
 
 # Retry backoff bounds for resync attempts, in seconds
 # (reference uses 100→1000 ms, ksr_reflector.go:35-38).
+logger = logging.getLogger(__name__)
+
 MIN_RESYNC_BACKOFF = 0.1
 MAX_RESYNC_BACKOFF = 1.0
 
@@ -106,6 +109,7 @@ class Reflector:
         self.stats = ReflectorStats()
         self._lock = threading.Lock()
         self._synced = False
+        self._paused = False
 
     # --- lifecycle ---
     def start(self) -> None:
@@ -117,10 +121,11 @@ class Reflector:
             return self._synced
 
     def stop_data_store_updates(self) -> None:
-        """Mark the store out-of-sync (e.g. store outage detected); event
-        writes pause until the next successful resync."""
+        """Deliberately pause store writes (e.g. store outage detected);
+        events are suppressed until an explicit resync() reconciles."""
         with self._lock:
             self._synced = False
+            self._paused = True
 
     # --- event handlers ---
     def _key_of(self, m: Any) -> str:
@@ -132,8 +137,15 @@ class Reflector:
             self.stats.arg_errors += 1
             return
         with self._lock:
-            if not self._synced:
-                return
+            paused = self._paused
+        if paused:
+            return
+        if not self.has_synced():
+            # A failed resync left us unsynced: retry once per incoming
+            # event; the mark-and-sweep covers this event's object too.
+            self.resync(max_attempts=1)
+            return
+        with self._lock:
             self.broker.put(self._key_of(m), m.to_dict())
             self.stats.adds += 1
 
@@ -143,8 +155,15 @@ class Reflector:
             self.stats.arg_errors += 1
             return
         with self._lock:
-            if not self._synced:
-                return
+            paused = self._paused
+        if paused:
+            return
+        if not self.has_synced():
+            # A failed resync left us unsynced: retry once per incoming
+            # event; the mark-and-sweep covers this event's object too.
+            self.resync(max_attempts=1)
+            return
+        with self._lock:
             prev = self.broker.get(self._key_of(m))
             if prev != m.to_dict():
                 self.broker.put(self._key_of(m), m.to_dict())
@@ -156,8 +175,15 @@ class Reflector:
             self.stats.arg_errors += 1
             return
         with self._lock:
-            if not self._synced:
-                return
+            paused = self._paused
+        if paused:
+            return
+        if not self.has_synced():
+            # A failed resync left us unsynced: retry once per incoming
+            # event; the mark-and-sweep covers this event's object too.
+            self.resync(max_attempts=1)
+            return
+        with self._lock:
             self.broker.delete(self._key_of(m))
             self.stats.deletes += 1
 
@@ -170,10 +196,19 @@ class Reflector:
                 self._mark_and_sweep()
                 with self._lock:
                     self._synced = True
+                    self._paused = False
                 return True
             except Exception:
+                logger.exception(
+                    "%s reflector resync attempt %d/%d failed",
+                    self.obj_type, attempt + 1, max_attempts,
+                )
                 time.sleep(backoff)
                 backoff = min(backoff * 2, MAX_RESYNC_BACKOFF)
+        logger.error(
+            "%s reflector could not resync after %d attempts; "
+            "will retry on the next watch event", self.obj_type, max_attempts,
+        )
         return False
 
     def _mark_and_sweep(self) -> None:

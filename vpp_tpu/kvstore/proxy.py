@@ -62,6 +62,12 @@ class KVProxy:
     def close(self) -> None:
         self._cancel_store_watch()
 
+    def _remove_ignore_entry(self, key: str, op: Op) -> None:
+        with self._lock:
+            entry = (key, op)
+            if entry in self._ignore:
+                self._ignore.remove(entry)
+
     # passthrough writes
     def put(self, key: str, value, ignore_echo: bool = True) -> int:
         if ignore_echo:
@@ -71,4 +77,9 @@ class KVProxy:
     def delete(self, key: str, ignore_echo: bool = True) -> bool:
         if ignore_echo:
             self.add_ignore_entry(key, Op.DELETE)
-        return self.store.delete(key)
+        deleted = self.store.delete(key)
+        if ignore_echo and not deleted:
+            # No event was emitted: reclaim the entry so it cannot swallow
+            # a later genuine external DELETE.
+            self._remove_ignore_entry(key, Op.DELETE)
+        return deleted

@@ -1,0 +1,143 @@
+"""Dataplane: the host-side handle on the device packet pipeline.
+
+Owns the staging TableBuilder, the live DataplaneTables epoch, the
+interface registry (pod ↔ interface index) and the jitted pipeline step.
+Mutators stage changes in the builder; ``swap()`` publishes a new table
+epoch atomically (carrying live session state over), the functional
+analog of VPP's config transactions hitting the running graph.
+
+Reference analogs: the vswitch side of plugins/contiv (interface
+creation per pod) + vpp-agent applying NB config to VPP.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from vpp_tpu.ir.rule import PodID
+from vpp_tpu.pipeline.graph import StepResult, pipeline_step
+from vpp_tpu.pipeline.tables import (
+    DataplaneConfig,
+    DataplaneTables,
+    InterfaceType,
+    TableBuilder,
+)
+from vpp_tpu.pipeline.vector import PacketVector
+
+
+class Dataplane:
+    def __init__(self, config: Optional[DataplaneConfig] = None):
+        self.config = config or DataplaneConfig()
+        self.builder = TableBuilder(self.config)
+        self.tables: DataplaneTables = self.builder.to_device()
+        self.epoch = 0
+        self._lock = threading.RLock()
+        self._step = jax.jit(pipeline_step)
+        self._now = 0
+
+        # interface registry
+        self.pod_if: Dict[PodID, int] = {}
+        self.if_pod: Dict[int, PodID] = {}
+        self._free_ifs = list(range(self.config.max_ifaces - 1, 0, -1))
+        # if 0 stays reserved as "unset"; uplink/host claimed explicitly
+        self.uplink_if: Optional[int] = None
+        self.host_if: Optional[int] = None
+
+        # ACL table slot registry (renderer table id -> slot)
+        self.table_slots: Dict[str, int] = {}
+        self._free_slots = list(range(self.config.max_tables - 1, -1, -1))
+
+    # --- interfaces ---
+    def add_uplink(self) -> int:
+        with self._lock:
+            if self.uplink_if is None:
+                self.uplink_if = self._free_ifs.pop()
+                self.builder.set_interface(
+                    self.uplink_if, InterfaceType.UPLINK, apply_global=True
+                )
+            return self.uplink_if
+
+    def add_host_interface(self) -> int:
+        with self._lock:
+            if self.host_if is None:
+                self.host_if = self._free_ifs.pop()
+                self.builder.set_interface(self.host_if, InterfaceType.HOST)
+            return self.host_if
+
+    def add_pod_interface(self, pod: PodID) -> int:
+        with self._lock:
+            if pod in self.pod_if:
+                return self.pod_if[pod]
+            if not self._free_ifs:
+                raise RuntimeError("interface table full")
+            idx = self._free_ifs.pop()
+            self.pod_if[pod] = idx
+            self.if_pod[idx] = pod
+            self.builder.set_interface(idx, InterfaceType.POD)
+            return idx
+
+    def del_pod_interface(self, pod: PodID) -> bool:
+        with self._lock:
+            idx = self.pod_if.pop(pod, None)
+            if idx is None:
+                return False
+            del self.if_pod[idx]
+            self.builder.set_interface(idx, InterfaceType.NONE, local_table=-1)
+            self._free_ifs.append(idx)
+            return True
+
+    # --- ACL table slots (used by the TPU renderer) ---
+    def alloc_table_slot(self, table_id: str) -> int:
+        with self._lock:
+            if table_id in self.table_slots:
+                return self.table_slots[table_id]
+            if not self._free_slots:
+                raise RuntimeError("ACL table slots exhausted")
+            slot = self._free_slots.pop()
+            self.table_slots[table_id] = slot
+            return slot
+
+    def free_table_slot(self, table_id: str) -> None:
+        with self._lock:
+            slot = self.table_slots.pop(table_id, None)
+            if slot is not None:
+                self.builder.clear_local_table(slot)
+                self._free_slots.append(slot)
+
+    def assign_pod_table(self, pod: PodID, table_id: Optional[str]) -> None:
+        """Point the pod's interface at a local ACL table (or none)."""
+        with self._lock:
+            idx = self.pod_if.get(pod)
+            if idx is None:
+                return
+            slot = self.table_slots.get(table_id, -1) if table_id else -1
+            self.builder.if_local_table[idx] = slot
+
+    # --- epoch management ---
+    def swap(self) -> int:
+        """Publish the staged configuration as a new table epoch. Live
+        session state is carried over from the running epoch."""
+        with self._lock:
+            self.tables = self.builder.to_device(sessions=self.tables)
+            self.epoch += 1
+            return self.epoch
+
+    # --- traffic ---
+    def process(self, pkts: PacketVector, now: Optional[int] = None) -> StepResult:
+        with self._lock:
+            tables = self.tables
+            if now is None:
+                self._now += 1
+                now = self._now
+        result = self._step(tables, pkts, jnp.int32(now))
+        # Session-table mutations flow back into the live epoch (config
+        # arrays are identical between result.tables and the staged ones
+        # unless a swap happens, which re-grafts the session arrays).
+        with self._lock:
+            if tables is self.tables:
+                self.tables = result.tables
+        return result

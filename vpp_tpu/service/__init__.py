@@ -1,0 +1,18 @@
+"""K8s Services → NAT44 load balancing.
+
+Reference: plugins/service — Processor merges Service+Endpoints into
+ContivService, Configurator renders NAT44 DNAT mappings with weighted
+backends (local backends weighted 2x), nodeports and the SNAT pool.
+"""
+
+from vpp_tpu.service.config import Backend, ContivService, TrafficPolicy
+from vpp_tpu.service.processor import ServiceProcessor
+from vpp_tpu.service.configurator import ServiceConfigurator
+
+__all__ = [
+    "Backend",
+    "ContivService",
+    "TrafficPolicy",
+    "ServiceProcessor",
+    "ServiceConfigurator",
+]

@@ -1,0 +1,124 @@
+"""ServiceConfigurator: ContivService set → NAT44 device configuration.
+
+Renders every tracked service into the data plane's NAT mapping/backend
+arrays and publishes one table epoch per change. Semantics follow the
+reference (plugins/service/configurator/configurator_impl.go):
+
+- one DNAT mapping per (frontend address, service port): cluster IP,
+  each external IP, and each node IP / node mgmt IP for nodeports
+  (:299-404);
+- weighted backend choice with local backends at 2x weight
+  (localEndpointWeight, :31-33);
+- "Local" external traffic policy keeps only node-local backends;
+- SNAT address for traffic leaving the cluster (:258-264).
+
+The full NAT table is rebuilt from the service map on every change:
+services are few, the rebuild is O(total backends), and it keeps the
+device arrays dense and fragmentation-free (the TPU analog of the
+reference's full-resync path against DumpNat44DNat, :213-296).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from vpp_tpu.pipeline.dataplane import Dataplane
+from vpp_tpu.pipeline.vector import ip4
+from vpp_tpu.service.config import Backend, ContivService, TrafficPolicy
+
+# Local backends get twice the share of hash space (reference
+# configurator_impl.go localEndpointWeight).
+LOCAL_BACKEND_WEIGHT = 2
+REMOTE_BACKEND_WEIGHT = 1
+
+_PROTO_NUM = {"TCP": 6, "UDP": 17}
+
+
+class ServiceConfigurator:
+    def __init__(self, dataplane: Dataplane, node_ips: Optional[List[str]] = None):
+        self.dataplane = dataplane
+        # Node frontend addresses used for nodeport mappings (node IP +
+        # mgmt IP; reference processor feeds these on node events).
+        self.node_ips: List[str] = list(node_ips or [])
+        self.services: Dict[Tuple[str, str], ContivService] = {}
+
+    # --- API (reference: configurator_api.go) ---
+    def add_service(self, svc: ContivService) -> None:
+        self.services[svc.id] = svc
+        self._rebuild()
+
+    def update_service(self, svc: ContivService) -> None:
+        self.services[svc.id] = svc
+        self._rebuild()
+
+    def delete_service(self, svc_id: Tuple[str, str]) -> None:
+        self.services.pop(svc_id, None)
+        self._rebuild()
+
+    def set_node_ips(self, node_ips: List[str]) -> None:
+        """Node add/remove: nodeport frontends change on every node
+        (reference: reconfigureNodePorts, processor_impl.go:357-373)."""
+        self.node_ips = list(node_ips)
+        self._rebuild()
+
+    def set_snat_ip(self, ip: str) -> None:
+        self.dataplane.builder.nat_snat_ip = np.uint32(ip4(ip))
+        self.dataplane.swap()
+
+    def resync(self, services: List[ContivService]) -> None:
+        self.services = {s.id: s for s in services}
+        self._rebuild()
+
+    # --- rendering ---
+    def _rebuild(self) -> None:
+        dp = self.dataplane
+        builder = dp.builder
+        builder.clear_nat()
+        slot = 0
+        boff = 0
+        cfg = dp.config
+        for svc in self.services.values():
+            for pname, spec in svc.ports.items():
+                backends = svc.backends.get(pname, [])
+                weighted = self._weighted_backends(svc, backends)
+                if not weighted:
+                    continue
+                frontends: List[Tuple[int, int]] = []
+                if svc.cluster_ip:
+                    frontends.append((ip4(svc.cluster_ip), spec.port))
+                for ext in svc.external_ips:
+                    frontends.append((ip4(ext), spec.port))
+                if spec.node_port:
+                    for nip in self.node_ips:
+                        frontends.append((ip4(nip), spec.node_port))
+
+                proto = _PROTO_NUM.get(spec.protocol.upper(), 6)
+                # All frontends of this service port share one backend range.
+                n = len(weighted)
+                if boff + n > cfg.nat_backends:
+                    raise RuntimeError("NAT backend capacity exhausted")
+                for ext_ip, ext_port in frontends:
+                    if slot >= cfg.nat_mappings:
+                        raise RuntimeError("NAT mapping capacity exhausted")
+                    builder.set_nat_mapping(
+                        slot, ext_ip, ext_port, proto, weighted, boff=boff
+                    )
+                    slot += 1
+                boff += n
+        dp.swap()
+
+    def _weighted_backends(
+        self, svc: ContivService, backends: List[Backend]
+    ) -> List[Tuple[int, int, int]]:
+        if svc.traffic_policy == TrafficPolicy.LOCAL:
+            backends = [b for b in backends if b.local]
+        return [
+            (
+                ip4(b.ip),
+                b.port,
+                LOCAL_BACKEND_WEIGHT if b.local else REMOTE_BACKEND_WEIGHT,
+            )
+            for b in backends
+        ]
