@@ -31,20 +31,28 @@ class AclVerdict(NamedTuple):
     rule_idx: jnp.ndarray    # int32 [P], matched rule index (-1 = no match)
 
 
-def _first_match(
+# Encoded no-match sentinel for cross-shard first-match combining: a
+# shard's verdict is (abs_rule_idx << 1 | deny_bit), so a min-reduction
+# over shards yields the globally-first match and its action together.
+# Plain int (not jnp): a device constant here would initialize the JAX
+# backend as an import side effect, pinning the platform before library
+# users can configure it.
+ENC_NO_MATCH = 0x7FFFFFFF
+
+
+def _match_mask(
     pkts: PacketVector,
     src_net, src_mask, dst_net, dst_mask, proto, sport_lo, sport_hi,
-    dport_lo, dport_hi, action, nrules,
-) -> AclVerdict:
-    """Core first-match. Rule arrays are [P, R] (per-packet tables) or
-    [R] broadcastable; ``nrules`` is [P] or scalar."""
+    dport_lo, dport_hi,
+) -> jnp.ndarray:
+    """Dense [P, R] rule-match mask (range checks on ports, masked
+    compares on addresses). Rule arrays are [P, R] or [R] broadcastable."""
     if src_net.ndim == 1:
         src_net, src_mask = src_net[None, :], src_mask[None, :]
         dst_net, dst_mask = dst_net[None, :], dst_mask[None, :]
         proto = proto[None, :]
         sport_lo, sport_hi = sport_lo[None, :], sport_hi[None, :]
         dport_lo, dport_hi = dport_lo[None, :], dport_hi[None, :]
-        action = action[None, :]
 
     src = pkts.src_ip[:, None]
     dst = pkts.dst_ip[:, None]
@@ -53,6 +61,50 @@ def _first_match(
     m &= (proto == -1) | (proto == pkts.proto[:, None])
     m &= (pkts.sport[:, None] >= sport_lo) & (pkts.sport[:, None] <= sport_hi)
     m &= (pkts.dport[:, None] >= dport_lo) & (pkts.dport[:, None] <= dport_hi)
+    return m
+
+
+def acl_encode_shard(
+    pkts: PacketVector,
+    src_net, src_mask, dst_net, dst_mask, proto, sport_lo, sport_hi,
+    dport_lo, dport_hi, action,
+    base_idx: jnp.ndarray,
+) -> jnp.ndarray:
+    """First-match over one rule *shard*, encoded for min-combining.
+
+    Used by the multi-chip sharded global classify
+    (vpp_tpu.parallel.cluster): each chip holds ``R/shards`` rules
+    starting at absolute index ``base_idx``; ``lax.pmin`` of the encoded
+    verdicts across the rule axis gives the cluster-wide first match.
+    """
+    m = _match_mask(
+        pkts, src_net, src_mask, dst_net, dst_mask, proto,
+        sport_lo, sport_hi, dport_lo, dport_hi,
+    )
+    if action.ndim == 1:
+        action = action[None, :]
+    first = jnp.argmax(m, axis=1)
+    matched = jnp.take_along_axis(m, first[:, None], axis=1)[:, 0]
+    act = jnp.take_along_axis(
+        jnp.broadcast_to(action, m.shape), first[:, None], axis=1
+    )[:, 0]
+    enc = ((base_idx + first.astype(jnp.int32)) << 1) | (act != 1)
+    return jnp.where(matched, enc, jnp.int32(ENC_NO_MATCH))
+
+
+def _first_match(
+    pkts: PacketVector,
+    src_net, src_mask, dst_net, dst_mask, proto, sport_lo, sport_hi,
+    dport_lo, dport_hi, action, nrules,
+) -> AclVerdict:
+    """Core first-match. Rule arrays are [P, R] (per-packet tables) or
+    [R] broadcastable; ``nrules`` is [P] or scalar."""
+    m = _match_mask(
+        pkts, src_net, src_mask, dst_net, dst_mask, proto,
+        sport_lo, sport_hi, dport_lo, dport_hi,
+    )
+    if action.ndim == 1:
+        action = action[None, :]
 
     first = jnp.argmax(m, axis=1)
     matched = jnp.take_along_axis(m, first[:, None], axis=1)[:, 0]
@@ -66,10 +118,17 @@ def _first_match(
     # so unmatched-ICMP-is-allowed is its effective semantic; encoding it
     # as the kernel default keeps tables smaller. An explicit ICMP/ANY
     # rule still matches first and can deny.
+    permit = jnp.where(matched, act == 1, acl_unmatched_default(pkts, nrules))
+    return AclVerdict(permit=permit, rule_idx=jnp.where(matched, first, -1))
+
+
+def acl_unmatched_default(pkts: PacketVector, nrules) -> jnp.ndarray:
+    """Default verdict for unmatched traffic (see module doc): empty
+    table allows all; non-empty tables deny unmatched TCP/UDP but permit
+    other protocols (the reference's implicit-ICMP-permit semantic)."""
     empty = nrules == 0
     non_l4 = (pkts.proto != 6) & (pkts.proto != 17)
-    permit = jnp.where(matched, act == 1, empty | non_l4)
-    return AclVerdict(permit=permit, rule_idx=jnp.where(matched, first, -1))
+    return empty | non_l4
 
 
 def acl_classify_local(tables: DataplaneTables, pkts: PacketVector) -> AclVerdict:

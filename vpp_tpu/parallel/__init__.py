@@ -1,0 +1,15 @@
+"""Multi-chip distribution: the cluster as a TPU device mesh.
+
+The reference scales by running one vswitch agent per cluster node
+(DaemonSet) and joining the nodes with a VXLAN full-mesh overlay
+(SURVEY.md §2.4). Here the same topology maps onto a
+``jax.sharding.Mesh``: axis ``"node"`` carries one vswitch-node per
+device (per-node tables stacked and sharded), axis ``"rule"`` shards the
+node-global ACL table across chips, and inter-node packet exchange rides
+ICI via ``all_to_all`` instead of VXLAN encapsulation.
+"""
+
+from vpp_tpu.parallel.mesh import cluster_mesh, table_specs
+from vpp_tpu.parallel.cluster import ClusterDataplane, cluster_step
+
+__all__ = ["cluster_mesh", "table_specs", "ClusterDataplane", "cluster_step"]

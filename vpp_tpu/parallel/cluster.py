@@ -1,0 +1,283 @@
+"""Multi-chip cluster step: N vswitch nodes on one device mesh.
+
+The reference joins per-node vswitches with a VXLAN full-mesh (bridge
+domain + BVI, plugins/contiv/node_events.go:184-250, host.go:211-331) and
+shards pods across nodes via node-ID IPAM. Here each mesh position along
+the ``node`` axis runs the full single-node pipeline over its own stacked
+table shard, and inter-node traffic is exchanged in one ``all_to_all``
+over ICI — the overlay *is* the interconnect, no encapsulation needed.
+The node-global ACL table is additionally sharded along the ``rule`` axis
+(tens of thousands of cluster-wide rules, the
+tests/policy/perf/gen-policy.py regime), with cluster-wide first-match
+recombined by a single ``pmin`` of encoded verdicts.
+
+A cluster step therefore is: local pipeline pass (ip4 → sessions → NAT44
+→ ACL → FIB) → pack packets with REMOTE disposition per destination node
+→ ``all_to_all`` → delivery pipeline pass at the destination (rx on the
+node's uplink, global ACL applies — same as VXLAN-decapped traffic
+hitting the reference's uplink ACL). TTL is decremented once per pass,
+matching the two vswitch hops a packet crosses in the reference.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from vpp_tpu.ops.acl import (
+    ENC_NO_MATCH,
+    AclVerdict,
+    acl_encode_shard,
+    acl_unmatched_default,
+)
+from vpp_tpu.parallel.mesh import (
+    NODE_AXIS,
+    RULE_AXIS,
+    table_shardings,
+    table_specs,
+)
+from vpp_tpu.pipeline.dataplane import Dataplane
+from vpp_tpu.pipeline.graph import StepStats, pipeline_step
+from vpp_tpu.pipeline.tables import (
+    SESSION_FIELDS,
+    DataplaneConfig,
+    DataplaneTables,
+    zero_sessions,
+)
+from vpp_tpu.pipeline.vector import (
+    FLAG_VALID,
+    Disposition,
+    PacketVector,
+    make_packet_vector,
+)
+
+
+class NodeTx(NamedTuple):
+    """One node's egress view after a pass: header fields + where each
+    packet went. ``node_id`` >= 0 marks packets handed to the fabric."""
+
+    pkts: PacketVector
+    disp: jnp.ndarray     # int32 Disposition
+    tx_if: jnp.ndarray    # int32 egress interface (-1 dropped/remote)
+    node_id: jnp.ndarray  # int32 destination node, -1 local
+
+
+class ClusterStepResult(NamedTuple):
+    local: NodeTx          # pass 1: traffic as seen at the ingress node [N, P]
+    delivered: NodeTx      # pass 2: fabric traffic at its destination [N, N*P]
+    tables: DataplaneTables  # node-stacked tables with updated sessions
+    stats: StepStats       # per-node counters (both passes summed) [N, ...]
+
+
+def sharded_global_classify(tables: DataplaneTables, pkts: PacketVector) -> AclVerdict:
+    """Global-ACL classify when the rule rows are sharded over RULE_AXIS.
+
+    Each chip first-matches its shard, then one pmin of encoded verdicts
+    (abs_idx<<1 | deny) yields the cluster-wide first match. Must run
+    inside shard_map with the ``rule`` axis bound.
+    """
+    shard_rows = tables.glb_action.shape[0]
+    base = lax.axis_index(RULE_AXIS).astype(jnp.int32) * shard_rows
+    enc = acl_encode_shard(
+        pkts,
+        tables.glb_src_net, tables.glb_src_mask,
+        tables.glb_dst_net, tables.glb_dst_mask,
+        tables.glb_proto,
+        tables.glb_sport_lo, tables.glb_sport_hi,
+        tables.glb_dport_lo, tables.glb_dport_hi,
+        tables.glb_action,
+        base,
+    )
+    enc = lax.pmin(enc, RULE_AXIS)
+    matched = enc != ENC_NO_MATCH
+    permit = jnp.where(
+        matched, (enc & 1) == 0, acl_unmatched_default(pkts, tables.glb_nrules)
+    )
+    applies = tables.if_apply_global[pkts.rx_if] == 1
+    return AclVerdict(
+        permit=jnp.where(applies, permit, True),
+        rule_idx=jnp.where(applies & matched, enc >> 1, -1),
+    )
+
+
+def _pv_spec() -> PacketVector:
+    return PacketVector(*([P(NODE_AXIS)] * len(PacketVector._fields)))
+
+
+def make_cluster_step(mesh: Mesh):
+    """Build the jitted cluster step for ``mesh``.
+
+    Signature: (tables, pkts, now, uplink_if) → ClusterStepResult, where
+    ``tables`` is node-stacked (see ClusterDataplane.swap), ``pkts`` is
+    [N, P] node-sharded, ``uplink_if`` is [N] (each node's uplink
+    interface index, rx_if for fabric-delivered traffic).
+    """
+    n_nodes = mesh.shape[NODE_AXIS]
+
+    def body(tables, pkts, now, uplink_if):
+        t = jax.tree.map(lambda a: a[0], tables)
+        p = jax.tree.map(lambda a: a[0], pkts)
+        uplink = uplink_if[0]
+
+        # Pass 1: the ingress node's full pipeline.
+        res1 = pipeline_step(t, p, now, acl_global_fn=sharded_global_classify)
+
+        # Fabric exchange: slot packets into per-destination rows, swap
+        # rows across the node axis (each row rides a distinct ICI lane —
+        # the reference's per-peer VXLAN tunnel, as one collective).
+        remote = res1.disp == int(Disposition.REMOTE)
+        dests = jnp.arange(n_nodes, dtype=jnp.int32)
+        dest_mask = remote[None, :] & (res1.node_id[None, :] == dests[:, None])
+
+        def pack(a):
+            return jnp.where(dest_mask, a[None, :], jnp.zeros((), a.dtype))
+
+        rp = res1.pkts
+        send = PacketVector(
+            src_ip=pack(rp.src_ip), dst_ip=pack(rp.dst_ip),
+            proto=pack(rp.proto), sport=pack(rp.sport), dport=pack(rp.dport),
+            ttl=pack(rp.ttl), pkt_len=pack(rp.pkt_len), rx_if=pack(rp.rx_if),
+            flags=jnp.where(dest_mask, FLAG_VALID, 0),
+        )
+        recv = jax.tree.map(
+            lambda a: lax.all_to_all(a, NODE_AXIS, 0, 0, tiled=True), send
+        )
+        flat = jax.tree.map(lambda a: a.reshape(-1), recv)
+        # Fabric traffic enters through the node's uplink: the global ACL
+        # applies, per-pod local tables do not (reference: VXLAN-decapped
+        # traffic hits the uplink's ACL before ip4-lookup).
+        flat = flat._replace(
+            rx_if=jnp.broadcast_to(uplink, flat.rx_if.shape).astype(jnp.int32)
+        )
+
+        # Pass 2: delivery at the destination node.
+        res2 = pipeline_step(
+            res1.tables, flat, now, acl_global_fn=sharded_global_classify
+        )
+
+        stats = jax.tree.map(lambda a, b: a + b, res1.stats, res2.stats)
+        out = ClusterStepResult(
+            local=NodeTx(res1.pkts, res1.disp, res1.tx_if, res1.node_id),
+            delivered=NodeTx(res2.pkts, res2.disp, res2.tx_if, res2.node_id),
+            tables=res2.tables,
+            stats=stats,
+        )
+        return jax.tree.map(lambda a: a[None], out)
+
+    tx_spec = NodeTx(
+        pkts=_pv_spec(), disp=P(NODE_AXIS), tx_if=P(NODE_AXIS), node_id=P(NODE_AXIS)
+    )
+    out_specs = ClusterStepResult(
+        local=tx_spec,
+        delivered=tx_spec,
+        tables=table_specs(),
+        stats=StepStats(*([P(NODE_AXIS)] * len(StepStats._fields))),
+    )
+    in_specs = (table_specs(), _pv_spec(), P(), P(NODE_AXIS))
+    return jax.jit(
+        jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    )
+
+
+def cluster_step(mesh: Mesh):
+    """Alias for make_cluster_step (public API name)."""
+    return make_cluster_step(mesh)
+
+
+class ClusterDataplane:
+    """Host-side handle on an N-node cluster data plane over one mesh.
+
+    Per-node configuration is staged through each node's single-node
+    ``Dataplane`` handle (``.node(i)`` — same interface/table/FIB/NAT
+    mutators the renderers drive); ``swap()`` stacks all builders and
+    publishes one node-sharded table epoch, carrying live session state
+    over exactly like the single-node epoch swap.
+    """
+
+    def __init__(self, mesh: Mesh, config: Optional[DataplaneConfig] = None):
+        self.mesh = mesh
+        self.config = config or DataplaneConfig()
+        self.n_nodes = mesh.shape[NODE_AXIS]
+        rule_shards = mesh.shape[RULE_AXIS]
+        if self.config.max_global_rules % rule_shards:
+            raise ValueError(
+                f"max_global_rules {self.config.max_global_rules} not divisible "
+                f"by rule shards {rule_shards}"
+            )
+        self.nodes: List[Dataplane] = [
+            Dataplane(self.config, materialize=False) for _ in range(self.n_nodes)
+        ]
+        self.tables: Optional[DataplaneTables] = None
+        self.epoch = 0
+        self._now = 0
+        self._lock = threading.RLock()
+        self._uplinks = None
+        self._step = make_cluster_step(mesh)
+        self._shardings = table_shardings(mesh)
+        self._node_sharding = NamedSharding(mesh, P(NODE_AXIS))
+
+    def node(self, i: int) -> Dataplane:
+        return self.nodes[i]
+
+    def swap(self) -> int:
+        """Stack every node's staged builder into one sharded table epoch.
+
+        Each node's lock is held while its builder is read, so concurrent
+        renderer mutations on other threads can't publish a torn epoch
+        (the cluster analog of Dataplane.swap holding its lock)."""
+        with self._lock:
+            per_node = []
+            for n in self.nodes:
+                with n._lock:
+                    per_node.append(
+                        {k: np.copy(v) for k, v in n.builder.host_arrays().items()}
+                    )
+            host = {
+                k: np.stack([arrs[k] for arrs in per_node]) for k in per_node[0]
+            }
+            if self.tables is not None:
+                sess = {f: getattr(self.tables, f) for f in SESSION_FIELDS}
+            else:
+                sess = zero_sessions(self.config, leading=(self.n_nodes,))
+            tables = DataplaneTables(**host, **sess)
+            self.tables = jax.device_put(tables, self._shardings)
+            self._uplinks = jax.device_put(
+                np.array(
+                    [
+                        n.uplink_if if n.uplink_if is not None else 0
+                        for n in self.nodes
+                    ],
+                    np.int32,
+                ),
+                self._node_sharding,
+            )
+            self.epoch += 1
+            return self.epoch
+
+    def make_frames(self, per_node_packets: Sequence[list], n: int = 256) -> PacketVector:
+        """Stack per-node packet lists into one [N, P] sharded vector."""
+        assert len(per_node_packets) == self.n_nodes
+        vecs = [make_packet_vector(pkts, n=n) for pkts in per_node_packets]
+        stacked = jax.tree.map(lambda *a: jnp.stack(a), *vecs)
+        return jax.device_put(stacked, self._node_sharding)
+
+    def step(self, pkts: PacketVector, now: Optional[int] = None) -> ClusterStepResult:
+        with self._lock:
+            if self.tables is None:
+                self.swap()
+            if now is None:
+                self._now += 1
+                now = self._now
+            tables, uplinks = self.tables, self._uplinks
+        result = self._step(tables, pkts, jnp.int32(now), uplinks)
+        with self._lock:
+            if tables is self.tables:
+                self.tables = result.tables
+        return result

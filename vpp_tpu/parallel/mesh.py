@@ -1,0 +1,64 @@
+"""Mesh construction + sharding specs for the cluster data plane.
+
+Axes:
+  ``node`` — one cluster node (vswitch agent) per mesh position; the
+             analog of the reference's per-node DaemonSet replica
+             (k8s/contiv-vpp.yaml:150). Per-node tables are stacked on a
+             leading axis and sharded here.
+  ``rule`` — shards the rows of the node-global ACL table, so a
+             cluster-scale rule set (tests/policy/perf/gen-policy.py
+             regime) classifies in parallel across chips; first-match is
+             recombined with a min-reduction (ops/acl.acl_encode_shard).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from vpp_tpu.pipeline.tables import DataplaneTables
+
+NODE_AXIS = "node"
+RULE_AXIS = "rule"
+
+# Global-ACL row arrays are sharded over the rule axis as well as stacked
+# over nodes; everything else is only stacked per node.
+_RULE_SHARDED_FIELDS = frozenset(
+    f for f in DataplaneTables._fields if f.startswith("glb_") and f != "glb_nrules"
+)
+
+
+def cluster_mesh(
+    n_nodes: int,
+    rule_shards: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the (node, rule) mesh from the first n_nodes*rule_shards devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    need = n_nodes * rule_shards
+    if len(devices) < need:
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    grid = np.asarray(devices[:need]).reshape(n_nodes, rule_shards)
+    return Mesh(grid, (NODE_AXIS, RULE_AXIS))
+
+
+def table_specs() -> DataplaneTables:
+    """PartitionSpec pytree for node-stacked DataplaneTables."""
+    return DataplaneTables(
+        **{
+            f: P(NODE_AXIS, RULE_AXIS) if f in _RULE_SHARDED_FIELDS else P(NODE_AXIS)
+            for f in DataplaneTables._fields
+        }
+    )
+
+
+def table_shardings(mesh: Mesh) -> DataplaneTables:
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        table_specs(),
+        is_leaf=lambda x: isinstance(x, P),
+    )

@@ -30,10 +30,17 @@ from vpp_tpu.pipeline.vector import PacketVector
 
 
 class Dataplane:
-    def __init__(self, config: Optional[DataplaneConfig] = None):
+    def __init__(
+        self, config: Optional[DataplaneConfig] = None, materialize: bool = True
+    ):
+        """``materialize=False`` skips the initial device upload — used by
+        ClusterDataplane, which stages through per-node builders but
+        publishes node-stacked tables itself (parallel/cluster.py)."""
         self.config = config or DataplaneConfig()
         self.builder = TableBuilder(self.config)
-        self.tables: DataplaneTables = self.builder.to_device()
+        self.tables: Optional[DataplaneTables] = (
+            self.builder.to_device() if materialize else None
+        )
         self.epoch = 0
         self._lock = threading.RLock()
         self._step = jax.jit(pipeline_step)
@@ -122,6 +129,11 @@ class Dataplane:
         """Publish the staged configuration as a new table epoch. Live
         session state is carried over from the running epoch."""
         with self._lock:
+            if self.tables is None:
+                raise RuntimeError(
+                    "this Dataplane is a staging handle managed by a "
+                    "ClusterDataplane; publish epochs via cluster.swap()"
+                )
             self.tables = self.builder.to_device(sessions=self.tables)
             self.epoch += 1
             return self.epoch
@@ -129,6 +141,11 @@ class Dataplane:
     # --- traffic ---
     def process(self, pkts: PacketVector, now: Optional[int] = None) -> StepResult:
         with self._lock:
+            if self.tables is None:
+                raise RuntimeError(
+                    "this Dataplane is a staging handle managed by a "
+                    "ClusterDataplane; process frames via cluster.step()"
+                )
             tables = self.tables
             if now is None:
                 self._now += 1

@@ -51,12 +51,17 @@ class StepResult(NamedTuple):
 
 
 def pipeline_step(
-    tables: DataplaneTables, pkts: PacketVector, now: jnp.ndarray
+    tables: DataplaneTables,
+    pkts: PacketVector,
+    now: jnp.ndarray,
+    acl_global_fn=acl_classify_global,
 ) -> StepResult:
     """Process one packet vector through the full forwarding chain.
 
     Pure function: (tables, frame, time) → (result, new session state).
-    Jit once; call per frame.
+    Jit once; call per frame. ``acl_global_fn`` lets the multi-chip
+    cluster step substitute a rule-sharded global classify
+    (vpp_tpu.parallel.cluster) without altering the chain.
     """
     n_ifaces = tables.if_type.shape[0]
 
@@ -80,7 +85,7 @@ def pipeline_step(
 
     # --- ACL classify (local per-interface table + node-global table) ---
     local_v = acl_classify_local(tables, pkts)
-    glob_v = acl_classify_global(tables, pkts)
+    glob_v = acl_global_fn(tables, pkts)
     permit = (local_v.permit & glob_v.permit) | established
     drop_acl = alive & ~permit
 

@@ -125,6 +125,26 @@ def _mask_of(plen: int, bits: int = 32) -> int:
     return ((1 << bits) - 1) ^ ((1 << (bits - plen)) - 1) if plen else 0
 
 
+# Session-state fields of DataplaneTables (reflective ACL + NAT session
+# tables) with their dtypes — the single source for zero-initialization
+# and for epoch-swap carry-over.
+SESSION_FIELDS: Dict[str, type] = {
+    "sess_src": np.uint32, "sess_dst": np.uint32, "sess_ports": np.uint32,
+    "sess_proto": np.int32, "sess_valid": np.int32, "sess_time": np.int32,
+    "natsess_a": np.uint32, "natsess_b": np.uint32, "natsess_ports": np.uint32,
+    "natsess_proto": np.int32, "natsess_valid": np.int32,
+    "natsess_time": np.int32, "natsess_orig_ip": np.uint32,
+    "natsess_orig_port": np.int32,
+}
+
+
+def zero_sessions(config: DataplaneConfig, leading: Tuple[int, ...] = ()) -> Dict[str, np.ndarray]:
+    """Fresh (empty) session-state arrays, optionally with leading axes
+    (the cluster data plane stacks per-node session tables)."""
+    shape = leading + (config.sess_slots,)
+    return {k: np.zeros(shape, dt) for k, dt in SESSION_FIELDS.items()}
+
+
 def pack_rules(rules: Sequence[ContivRule], max_rules: int) -> Dict[str, np.ndarray]:
     """Compile an ordered ContivRule list into padded match arrays.
 
@@ -306,86 +326,64 @@ class TableBuilder:
         self.nat_bcnt[:] = 0
 
     # --- device upload ---
+    def host_arrays(self) -> Dict[str, np.ndarray]:
+        """The staged configuration as numpy arrays keyed by
+        DataplaneTables field name (everything except session state).
+        Used directly by to_device() and, node-stacked, by the cluster
+        data plane (vpp_tpu.parallel.cluster)."""
+        return dict(
+            acl_src_net=self.acl["src_net"],
+            acl_src_mask=self.acl["src_mask"],
+            acl_dst_net=self.acl["dst_net"],
+            acl_dst_mask=self.acl["dst_mask"],
+            acl_proto=self.acl["proto"],
+            acl_sport_lo=self.acl["sport_lo"],
+            acl_sport_hi=self.acl["sport_hi"],
+            acl_dport_lo=self.acl["dport_lo"],
+            acl_dport_hi=self.acl["dport_hi"],
+            acl_action=self.acl["action"],
+            acl_nrules=self.acl_nrules,
+            glb_src_net=self.glb["src_net"],
+            glb_src_mask=self.glb["src_mask"],
+            glb_dst_net=self.glb["dst_net"],
+            glb_dst_mask=self.glb["dst_mask"],
+            glb_proto=self.glb["proto"],
+            glb_sport_lo=self.glb["sport_lo"],
+            glb_sport_hi=self.glb["sport_hi"],
+            glb_dport_lo=self.glb["dport_lo"],
+            glb_dport_hi=self.glb["dport_hi"],
+            glb_action=self.glb["action"],
+            glb_nrules=np.int32(self.glb_nrules),
+            if_type=self.if_type,
+            if_local_table=self.if_local_table,
+            if_apply_global=self.if_apply_global,
+            fib_prefix=self.fib_prefix,
+            fib_mask=self.fib_mask,
+            fib_plen=self.fib_plen,
+            fib_tx_if=self.fib_tx_if,
+            fib_disp=self.fib_disp,
+            fib_next_hop=self.fib_next_hop,
+            fib_node_id=self.fib_node_id,
+            nat_ext_ip=self.nat_ext_ip,
+            nat_ext_port=self.nat_ext_port,
+            nat_proto=self.nat_proto,
+            nat_boff=self.nat_boff,
+            nat_bcnt=self.nat_bcnt,
+            nat_total_w=self.nat_total_w,
+            natb_ip=self.natb_ip,
+            natb_port=self.natb_port,
+            natb_cumw=self.natb_cumw,
+            nat_snat_ip=self.nat_snat_ip,
+        )
+
     def to_device(self, sessions: Optional[DataplaneTables] = None) -> DataplaneTables:
         """Produce the immutable device pytree. If ``sessions`` (a previous
         epoch's tables) is given, its live session arrays are carried over."""
-        c = self.config
         if sessions is not None:
-            sess = dict(
-                sess_src=sessions.sess_src,
-                sess_dst=sessions.sess_dst,
-                sess_ports=sessions.sess_ports,
-                sess_proto=sessions.sess_proto,
-                sess_valid=sessions.sess_valid,
-                sess_time=sessions.sess_time,
-                natsess_a=sessions.natsess_a,
-                natsess_b=sessions.natsess_b,
-                natsess_ports=sessions.natsess_ports,
-                natsess_proto=sessions.natsess_proto,
-                natsess_valid=sessions.natsess_valid,
-                natsess_time=sessions.natsess_time,
-                natsess_orig_ip=sessions.natsess_orig_ip,
-                natsess_orig_port=sessions.natsess_orig_port,
-            )
+            sess = {f: getattr(sessions, f) for f in SESSION_FIELDS}
         else:
-            sess = dict(
-                sess_src=jnp.zeros(c.sess_slots, jnp.uint32),
-                sess_dst=jnp.zeros(c.sess_slots, jnp.uint32),
-                sess_ports=jnp.zeros(c.sess_slots, jnp.uint32),
-                sess_proto=jnp.zeros(c.sess_slots, jnp.int32),
-                sess_valid=jnp.zeros(c.sess_slots, jnp.int32),
-                sess_time=jnp.zeros(c.sess_slots, jnp.int32),
-                natsess_a=jnp.zeros(c.sess_slots, jnp.uint32),
-                natsess_b=jnp.zeros(c.sess_slots, jnp.uint32),
-                natsess_ports=jnp.zeros(c.sess_slots, jnp.uint32),
-                natsess_proto=jnp.zeros(c.sess_slots, jnp.int32),
-                natsess_valid=jnp.zeros(c.sess_slots, jnp.int32),
-                natsess_time=jnp.zeros(c.sess_slots, jnp.int32),
-                natsess_orig_ip=jnp.zeros(c.sess_slots, jnp.uint32),
-                natsess_orig_port=jnp.zeros(c.sess_slots, jnp.int32),
-            )
-        return DataplaneTables(
-            acl_src_net=jnp.asarray(self.acl["src_net"]),
-            acl_src_mask=jnp.asarray(self.acl["src_mask"]),
-            acl_dst_net=jnp.asarray(self.acl["dst_net"]),
-            acl_dst_mask=jnp.asarray(self.acl["dst_mask"]),
-            acl_proto=jnp.asarray(self.acl["proto"]),
-            acl_sport_lo=jnp.asarray(self.acl["sport_lo"]),
-            acl_sport_hi=jnp.asarray(self.acl["sport_hi"]),
-            acl_dport_lo=jnp.asarray(self.acl["dport_lo"]),
-            acl_dport_hi=jnp.asarray(self.acl["dport_hi"]),
-            acl_action=jnp.asarray(self.acl["action"]),
-            acl_nrules=jnp.asarray(self.acl_nrules),
-            glb_src_net=jnp.asarray(self.glb["src_net"]),
-            glb_src_mask=jnp.asarray(self.glb["src_mask"]),
-            glb_dst_net=jnp.asarray(self.glb["dst_net"]),
-            glb_dst_mask=jnp.asarray(self.glb["dst_mask"]),
-            glb_proto=jnp.asarray(self.glb["proto"]),
-            glb_sport_lo=jnp.asarray(self.glb["sport_lo"]),
-            glb_sport_hi=jnp.asarray(self.glb["sport_hi"]),
-            glb_dport_lo=jnp.asarray(self.glb["dport_lo"]),
-            glb_dport_hi=jnp.asarray(self.glb["dport_hi"]),
-            glb_action=jnp.asarray(self.glb["action"]),
-            glb_nrules=jnp.asarray(np.int32(self.glb_nrules)),
-            if_type=jnp.asarray(self.if_type),
-            if_local_table=jnp.asarray(self.if_local_table),
-            if_apply_global=jnp.asarray(self.if_apply_global),
-            fib_prefix=jnp.asarray(self.fib_prefix),
-            fib_mask=jnp.asarray(self.fib_mask),
-            fib_plen=jnp.asarray(self.fib_plen),
-            fib_tx_if=jnp.asarray(self.fib_tx_if),
-            fib_disp=jnp.asarray(self.fib_disp),
-            fib_next_hop=jnp.asarray(self.fib_next_hop),
-            fib_node_id=jnp.asarray(self.fib_node_id),
-            nat_ext_ip=jnp.asarray(self.nat_ext_ip),
-            nat_ext_port=jnp.asarray(self.nat_ext_port),
-            nat_proto=jnp.asarray(self.nat_proto),
-            nat_boff=jnp.asarray(self.nat_boff),
-            nat_bcnt=jnp.asarray(self.nat_bcnt),
-            nat_total_w=jnp.asarray(self.nat_total_w),
-            natb_ip=jnp.asarray(self.natb_ip),
-            natb_port=jnp.asarray(self.natb_port),
-            natb_cumw=jnp.asarray(self.natb_cumw),
-            nat_snat_ip=jnp.asarray(self.nat_snat_ip),
-            **sess,
-        )
+            sess = {
+                k: jnp.asarray(v) for k, v in zero_sessions(self.config).items()
+            }
+        host = {k: jnp.asarray(v) for k, v in self.host_arrays().items()}
+        return DataplaneTables(**host, **sess)
