@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""North-star benchmark: ACL-path classification at 10k rules.
+
+Reproduces BASELINE.md config #2/#5 — the reference's policy-perf regime
+(tests/policy/perf/gen-policy.py: 1000 CIDR blocks x excepts x 20 ports)
+— through the FULL fused pipeline (ip4-input → reflective sessions →
+NAT44 → 10k-rule global ACL classify → ip4-lookup), measured in Mpps on
+one chip against the driver-set 40 Mpps north star (BASELINE.json).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def build_rules(n_rules: int):
+    """Policy rule set shaped like tests/policy/perf/gen-policy.py:
+    CIDR-block x port permits with interleaved deny excepts, then a
+    terminal deny-all (the renderer-cache table form)."""
+    import ipaddress
+
+    from vpp_tpu.ir.rule import Action, ContivRule, Protocol
+
+    rules = []
+    i = 0
+    while len(rules) < n_rules - 1:
+        block = i % 1000
+        port = 8000 + (i // 1000) % 20
+        net = ipaddress.ip_network(
+            f"172.{16 + block // 256}.{block % 256}.0/24"
+        )
+        action = Action.DENY if i % 6 == 5 else Action.PERMIT
+        rules.append(
+            ContivRule(
+                action=action,
+                src_network=net,
+                protocol=Protocol.TCP,
+                dest_port=port,
+            )
+        )
+        i += 1
+    rules.append(ContivRule(action=Action.DENY))
+    return rules
+
+
+def build_dataplane(n_rules: int, n_backends: int):
+    from vpp_tpu.ir.rule import Action, ContivRule
+    from vpp_tpu.pipeline.dataplane import Dataplane
+    from vpp_tpu.pipeline.tables import DataplaneConfig
+    from vpp_tpu.pipeline.vector import Disposition, ip4
+
+    config = DataplaneConfig(
+        max_tables=2,
+        max_rules=16,
+        max_global_rules=n_rules,
+        max_ifaces=16,
+        fib_slots=64,
+        sess_slots=1 << 15,
+        nat_mappings=4,
+        nat_backends=max(n_backends, 1),
+    )
+    dp = Dataplane(config)
+    uplink = dp.add_uplink()
+    server_if = dp.add_pod_interface(("default", "server"))
+    dp.builder.add_route("10.1.1.0/24", server_if, Disposition.LOCAL)
+    dp.builder.add_route("0.0.0.0/0", uplink, Disposition.REMOTE, node_id=1)
+    dp.builder.set_global_table(build_rules(n_rules))
+    # NAT44 VIP with weighted backends (BASELINE config #3 shape).
+    dp.builder.set_nat_mapping(
+        0,
+        ext_ip=ip4("10.96.0.10"),
+        ext_port=80,
+        proto=6,
+        backends=[(ip4("10.1.1.2") + i, 80, 1 + (i % 2)) for i in range(n_backends)],
+        boff=0,
+    )
+    dp.swap()
+    return dp, uplink
+
+
+def build_traffic(n_pkts: int, uplink: int, seed: int = 7):
+    """Uplink traffic: TCP flows from the rule-space CIDR blocks toward
+    the local pod subnet + a slice of VIP (NAT) traffic."""
+    import jax.numpy as jnp
+
+    from vpp_tpu.pipeline.vector import FLAG_VALID, PacketVector, ip4
+
+    rng = np.random.default_rng(seed)
+    block = rng.integers(0, 1000, n_pkts)
+    src = (
+        (172 << 24)
+        | ((16 + block // 256) << 16)
+        | ((block % 256) << 8)
+        | rng.integers(1, 255, n_pkts)
+    ).astype(np.uint32)
+    dst = (ip4("10.1.1.0") + rng.integers(2, 250, n_pkts)).astype(np.uint32)
+    # ~1/8 of traffic targets the service VIP (exercises DNAT + session).
+    vip_mask = rng.random(n_pkts) < 0.125
+    dst = np.where(vip_mask, np.uint32(ip4("10.96.0.10")), dst)
+    dport = np.where(
+        vip_mask, 80, 8000 + rng.integers(0, 20, n_pkts)
+    ).astype(np.int32)
+    return PacketVector(
+        src_ip=jnp.asarray(src),
+        dst_ip=jnp.asarray(dst),
+        proto=jnp.full((n_pkts,), 6, jnp.int32),
+        sport=jnp.asarray(rng.integers(1024, 65535, n_pkts).astype(np.int32)),
+        dport=jnp.asarray(dport),
+        ttl=jnp.full((n_pkts,), 64, jnp.int32),
+        pkt_len=jnp.full((n_pkts,), 512, jnp.int32),
+        rx_if=jnp.full((n_pkts,), uplink, jnp.int32),
+        flags=jnp.full((n_pkts,), FLAG_VALID, jnp.int32),
+    )
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rules", type=int, default=10240)
+    ap.add_argument("--packets", type=int, default=8192,
+                    help="packets per pipeline step (throughput run)")
+    ap.add_argument("--backends", type=int, default=100)
+    ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--warmup", type=int, default=5)
+    ap.add_argument("--latency-frame", type=int, default=256,
+                    help="frame size for the added-latency measurement")
+    ap.add_argument("--cpu", action="store_true", help="force CPU (debug)")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+
+    from vpp_tpu.pipeline.graph import pipeline_step
+
+    dp, uplink = build_dataplane(args.rules, args.backends)
+    step = jax.jit(pipeline_step, donate_argnums=(0,))
+
+    # --- throughput: K chained steps, sessions threaded through ---
+    pkts = build_traffic(args.packets, uplink)
+    tables = dp.tables
+    for i in range(args.warmup):
+        res = step(tables, pkts, jnp.int32(i + 1))
+        tables = res.tables
+    jax.block_until_ready(tables)
+
+    t0 = time.perf_counter()
+    for i in range(args.iters):
+        res = step(tables, pkts, jnp.int32(100 + i))
+        tables = res.tables
+    jax.block_until_ready(res)
+    dt = time.perf_counter() - t0
+    mpps = args.packets * args.iters / dt / 1e6
+
+    # --- added latency: single small-frame step, p50/p99 ---
+    frame = build_traffic(args.latency_frame, uplink, seed=11)
+    lat = []
+    for i in range(args.warmup):
+        out = step(tables, frame, jnp.int32(i))
+        jax.block_until_ready(out.disp)
+        tables = out.tables
+    for i in range(200):
+        t0 = time.perf_counter()
+        out = step(tables, frame, jnp.int32(1000 + i))
+        jax.block_until_ready(out.disp)
+        lat.append(time.perf_counter() - t0)
+        tables = out.tables
+    lat_us = np.array(lat) * 1e6
+
+    baseline_mpps = 40.0  # BASELINE.json north star, TPU v5e
+    print(
+        json.dumps(
+            {
+                "metric": "acl_nat_pipeline_mpps_10k_rules",
+                "value": round(mpps, 3),
+                "unit": "Mpps",
+                "vs_baseline": round(mpps / baseline_mpps, 4),
+                "details": {
+                    "rules": args.rules,
+                    "packets_per_step": args.packets,
+                    "nat_backends": args.backends,
+                    "frame_latency_p50_us": round(float(np.percentile(lat_us, 50)), 1),
+                    "frame_latency_p99_us": round(float(np.percentile(lat_us, 99)), 1),
+                    "latency_frame": args.latency_frame,
+                    "backend": jax.default_backend(),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -7,9 +7,8 @@ and the rule-sharded global table recombination.
 """
 
 import numpy as np
-import pytest
 
-from vpp_tpu.ipam import IPAM, IpamConfig
+from vpp_tpu.ipam import IPAM
 import ipaddress
 
 from vpp_tpu.ir.rule import Action, ContivRule, Protocol
@@ -42,7 +41,7 @@ def build_cluster(n_nodes=4, rule_shards=2, global_rules=()):
         for other in range(n_nodes):
             if other == nid:
                 continue
-            other_net = IPAM(other + 1).pod_network
+            other_net = ipam.other_node_pod_network(other + 1)
             node.builder.add_route(
                 str(other_net), uplink, Disposition.REMOTE, node_id=other
             )
@@ -84,8 +83,8 @@ def test_cross_node_forwarding():
 
 def test_global_acl_filters_fabric_traffic_sharded():
     # Rules land in different shards (rule_shards=2 splits 32 rows at 16):
-    # a deny for dport 23 early, a permit-all later; plus default deny for
-    # unmatched TCP via a trailing deny rule in shard 2.
+    # a deny for dport 23 in shard 1, a permit for dport 80 in shard 2;
+    # unmatched TCP is denied by the kernel default (acl_unmatched_default).
     rules = [
         ContivRule(Action.DENY, None, None, Protocol.TCP, 0, 23),
         ContivRule(Action.PERMIT, None, None, Protocol.TCP, 0, 80),

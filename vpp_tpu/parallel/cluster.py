@@ -65,7 +65,7 @@ class NodeTx(NamedTuple):
 
     pkts: PacketVector
     disp: jnp.ndarray     # int32 Disposition
-    tx_if: jnp.ndarray    # int32 egress interface (-1 dropped/remote)
+    tx_if: jnp.ndarray    # int32 egress interface (uplink for REMOTE, -1 dropped)
     node_id: jnp.ndarray  # int32 destination node, -1 local
 
 
@@ -239,6 +239,17 @@ class ClusterDataplane:
                     per_node.append(
                         {k: np.copy(v) for k, v in n.builder.host_arrays().items()}
                     )
+            # Misconfiguration guard: any node that fabric routes point at
+            # must have an uplink, or its inbound traffic would arrive on
+            # the reserved interface 0 and be silently dropped as bad-if.
+            for i, arrs in enumerate(per_node):
+                targets = arrs["fib_node_id"][arrs["fib_plen"] >= 0]
+                for t in np.unique(targets[targets >= 0]):
+                    if self.nodes[int(t)].uplink_if is None:
+                        raise ValueError(
+                            f"node {i} routes to node {int(t)}, which has "
+                            "no uplink interface (call add_uplink())"
+                        )
             host = {
                 k: np.stack([arrs[k] for arrs in per_node]) for k in per_node[0]
             }
