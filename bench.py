@@ -122,7 +122,7 @@ def build_traffic(n_pkts: int, uplink: int, seed: int = 7):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rules", type=int, default=10240)
-    ap.add_argument("--packets", type=int, default=8192,
+    ap.add_argument("--packets", type=int, default=65536,
                     help="packets per pipeline step (throughput run)")
     ap.add_argument("--backends", type=int, default=100)
     ap.add_argument("--iters", type=int, default=50)
@@ -139,10 +139,11 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    from vpp_tpu.pipeline.graph import pipeline_step
+    from vpp_tpu.pipeline.graph import pipeline_step, pipeline_step_mxu
 
     dp, uplink = build_dataplane(args.rules, args.backends)
-    step = jax.jit(pipeline_step, donate_argnums=(0,))
+    step_fn = pipeline_step_mxu if dp._use_mxu else pipeline_step
+    step = jax.jit(step_fn, donate_argnums=(0,))
 
     # --- throughput: K chained steps, sessions threaded through ---
     pkts = build_traffic(args.packets, uplink)

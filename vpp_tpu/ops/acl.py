@@ -156,13 +156,34 @@ def acl_classify_local(tables: DataplaneTables, pkts: PacketVector) -> AclVerdic
     )
 
 
+def assemble_global_verdict(
+    tables: DataplaneTables,
+    pkts: PacketVector,
+    matched: jnp.ndarray,
+    permit_if_matched: jnp.ndarray,
+    rule_idx: jnp.ndarray,
+) -> AclVerdict:
+    """Fold a raw global-table match into the final verdict: unmatched
+    traffic takes the kernel default, and the table only applies to
+    interfaces marked ``if_apply_global`` (node uplinks). Shared by the
+    dense, MXU and rule-sharded global classifiers so their semantics
+    stay in lockstep."""
+    permit = jnp.where(
+        matched, permit_if_matched, acl_unmatched_default(pkts, tables.glb_nrules)
+    )
+    applies = tables.if_apply_global[pkts.rx_if] == 1
+    return AclVerdict(
+        permit=jnp.where(applies, permit, True),
+        rule_idx=jnp.where(applies & matched, rule_idx, -1),
+    )
+
+
 def acl_classify_global(tables: DataplaneTables, pkts: PacketVector) -> AclVerdict:
     """Classify each packet against the node-global table.
 
     Applies only to packets arriving on interfaces marked
     ``if_apply_global`` (node uplinks); others are permitted.
     """
-    applies = tables.if_apply_global[pkts.rx_if] == 1
     verdict = _first_match(
         pkts,
         tables.glb_src_net, tables.glb_src_mask,
@@ -173,7 +194,7 @@ def acl_classify_global(tables: DataplaneTables, pkts: PacketVector) -> AclVerdi
         tables.glb_action,
         tables.glb_nrules,
     )
-    return AclVerdict(
-        permit=jnp.where(applies, verdict.permit, True),
-        rule_idx=jnp.where(applies, verdict.rule_idx, -1),
+    matched = verdict.rule_idx >= 0
+    return assemble_global_verdict(
+        tables, pkts, matched, verdict.permit, verdict.rule_idx
     )

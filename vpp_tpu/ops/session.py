@@ -22,7 +22,11 @@ import jax.numpy as jnp
 from vpp_tpu.pipeline.tables import DataplaneTables
 from vpp_tpu.pipeline.vector import PacketVector
 
-_BIG = jnp.int32(0x7FFFFFFF)
+# Plain int, not jnp: a module-level device scalar would (a) initialize
+# the JAX backend at import and (b) be captured as an embedded device
+# constant in every jitted program using it, which forces a drastically
+# slower dispatch path (~100x) through the axon TPU tunnel.
+_BIG = 0x7FFFFFFF
 
 # Linear-probe depth of every hash table (lookup and insert must agree).
 SESS_PROBES = 4

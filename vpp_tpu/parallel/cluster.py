@@ -35,7 +35,7 @@ from vpp_tpu.ops.acl import (
     ENC_NO_MATCH,
     AclVerdict,
     acl_encode_shard,
-    acl_unmatched_default,
+    assemble_global_verdict,
 )
 from vpp_tpu.parallel.mesh import (
     NODE_AXIS,
@@ -97,13 +97,8 @@ def sharded_global_classify(tables: DataplaneTables, pkts: PacketVector) -> AclV
     )
     enc = lax.pmin(enc, RULE_AXIS)
     matched = enc != ENC_NO_MATCH
-    permit = jnp.where(
-        matched, (enc & 1) == 0, acl_unmatched_default(pkts, tables.glb_nrules)
-    )
-    applies = tables.if_apply_global[pkts.rx_if] == 1
-    return AclVerdict(
-        permit=jnp.where(applies, permit, True),
-        rule_idx=jnp.where(applies & matched, enc >> 1, -1),
+    return assemble_global_verdict(
+        tables, pkts, matched, (enc & 1) == 0, enc >> 1
     )
 
 
@@ -206,11 +201,16 @@ class ClusterDataplane:
         self.config = config or DataplaneConfig()
         self.n_nodes = mesh.shape[NODE_AXIS]
         rule_shards = mesh.shape[RULE_AXIS]
-        if self.config.max_global_rules % rule_shards:
-            raise ValueError(
-                f"max_global_rules {self.config.max_global_rules} not divisible "
-                f"by rule shards {rule_shards}"
-            )
+        from vpp_tpu.ops.acl_mxu import mxu_rule_capacity
+
+        for name, dim in (
+            ("max_global_rules", self.config.max_global_rules),
+            ("MXU rule capacity", mxu_rule_capacity(self.config.max_global_rules)),
+        ):
+            if dim % rule_shards:
+                raise ValueError(
+                    f"{name} {dim} not divisible by rule shards {rule_shards}"
+                )
         self.nodes: List[Dataplane] = [
             Dataplane(self.config, materialize=False) for _ in range(self.n_nodes)
         ]

@@ -26,9 +26,13 @@ NODE_AXIS = "node"
 RULE_AXIS = "rule"
 
 # Global-ACL row arrays are sharded over the rule axis as well as stacked
-# over nodes; everything else is only stacked per node.
+# over nodes; everything else is only stacked per node. The bit-plane
+# arrays (ops/acl_mxu) shard their *rule* dimension, which for the coeff
+# matrix is axis 2 of the node-stacked array.
 _RULE_SHARDED_FIELDS = frozenset(
-    f for f in DataplaneTables._fields if f.startswith("glb_") and f != "glb_nrules"
+    f
+    for f in DataplaneTables._fields
+    if f.startswith("glb_") and f not in ("glb_nrules", "glb_mxu_coeff")
 )
 
 
@@ -48,12 +52,12 @@ def cluster_mesh(
 
 def table_specs() -> DataplaneTables:
     """PartitionSpec pytree for node-stacked DataplaneTables."""
-    return DataplaneTables(
-        **{
-            f: P(NODE_AXIS, RULE_AXIS) if f in _RULE_SHARDED_FIELDS else P(NODE_AXIS)
-            for f in DataplaneTables._fields
-        }
-    )
+    specs = {
+        f: P(NODE_AXIS, RULE_AXIS) if f in _RULE_SHARDED_FIELDS else P(NODE_AXIS)
+        for f in DataplaneTables._fields
+    }
+    specs["glb_mxu_coeff"] = P(NODE_AXIS, None, RULE_AXIS)
+    return DataplaneTables(**specs)
 
 
 def table_shardings(mesh: Mesh) -> DataplaneTables:

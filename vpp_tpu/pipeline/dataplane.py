@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from vpp_tpu.ir.rule import PodID
-from vpp_tpu.pipeline.graph import StepResult, pipeline_step
+from vpp_tpu.pipeline.graph import StepResult, pipeline_step, pipeline_step_mxu
 from vpp_tpu.pipeline.tables import (
     DataplaneConfig,
     DataplaneTables,
@@ -44,6 +44,11 @@ class Dataplane:
         self.epoch = 0
         self._lock = threading.RLock()
         self._step = jax.jit(pipeline_step)
+        self._step_mxu = jax.jit(pipeline_step_mxu)
+        # Flipped at swap(): large exact-port global tables classify on
+        # the MXU bit-plane kernel; small or range-rule tables stay dense.
+        self._use_mxu = False
+        self.mxu_threshold = 512
         self._now = 0
 
         # interface registry
@@ -135,6 +140,10 @@ class Dataplane:
                     "ClusterDataplane; publish epochs via cluster.swap()"
                 )
             self.tables = self.builder.to_device(sessions=self.tables)
+            self._use_mxu = (
+                self.builder.glb_mxu.ok
+                and self.builder.glb_nrules >= self.mxu_threshold
+            )
             self.epoch += 1
             return self.epoch
 
@@ -147,10 +156,11 @@ class Dataplane:
                     "ClusterDataplane; process frames via cluster.step()"
                 )
             tables = self.tables
+            step = self._step_mxu if self._use_mxu else self._step
             if now is None:
                 self._now += 1
                 now = self._now
-        result = self._step(tables, pkts, jnp.int32(now))
+        result = step(tables, pkts, jnp.int32(now))
         # Session-table mutations flow back into the live epoch (config
         # arrays are identical between result.tables and the staged ones
         # unless a swap happens, which re-grafts the session arrays).

@@ -136,4 +136,14 @@ def pipeline_step(
     )
 
 
+def pipeline_step_mxu(
+    tables: DataplaneTables, pkts: PacketVector, now: jnp.ndarray
+) -> StepResult:
+    """pipeline_step with the global ACL on the MXU bit-plane kernel
+    (vpp_tpu.ops.acl_mxu) — the fast path for large exact-port tables."""
+    from vpp_tpu.ops.acl_mxu import acl_classify_global_mxu
+
+    return pipeline_step(tables, pkts, now, acl_global_fn=acl_classify_global_mxu)
+
+
 pipeline_step_jit = jax.jit(pipeline_step, donate_argnums=())

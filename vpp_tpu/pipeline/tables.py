@@ -74,6 +74,10 @@ class DataplaneTables(NamedTuple):
     glb_dport_hi: jnp.ndarray
     glb_action: jnp.ndarray
     glb_nrules: jnp.ndarray     # int32 scalar
+    # Bit-plane form of the global table for the MXU classify kernel
+    # (vpp_tpu.ops.acl_mxu); float32 {-1,0,1} coeffs, cast to bf16 at use.
+    glb_mxu_coeff: jnp.ndarray  # float32 [PLANES, R']
+    glb_mxu_k: jnp.ndarray      # float32 [R']
 
     # --- interfaces [I] ---
     if_type: jnp.ndarray        # int32 InterfaceType
@@ -210,6 +214,9 @@ class TableBuilder:
         self.acl_nrules = z(c.max_tables, np.int32)
         self.glb = pack_rules([], c.max_global_rules)
         self.glb_nrules = 0
+        from vpp_tpu.ops.acl_mxu import empty_bitplanes
+
+        self.glb_mxu = empty_bitplanes(c.max_global_rules)
         self.if_type = z(c.max_ifaces, np.int32)
         self.if_local_table = np.full(c.max_ifaces, -1, np.int32)
         self.if_apply_global = z(c.max_ifaces, np.int32)
@@ -242,8 +249,11 @@ class TableBuilder:
         self.set_local_table(slot, [])
 
     def set_global_table(self, rules: Sequence[ContivRule]) -> None:
+        from vpp_tpu.ops.acl_mxu import compile_bitplanes
+
         self.glb = pack_rules(rules, self.config.max_global_rules)
         self.glb_nrules = len(rules)
+        self.glb_mxu = compile_bitplanes(self.glb, self.config.max_global_rules)
 
     # --- interfaces ---
     def set_interface(
@@ -354,6 +364,8 @@ class TableBuilder:
             glb_dport_hi=self.glb["dport_hi"],
             glb_action=self.glb["action"],
             glb_nrules=np.int32(self.glb_nrules),
+            glb_mxu_coeff=self.glb_mxu.coeff,
+            glb_mxu_k=self.glb_mxu.k,
             if_type=self.if_type,
             if_local_table=self.if_local_table,
             if_apply_global=self.if_apply_global,
