@@ -25,7 +25,12 @@ from vpp_tpu.ops.acl_mxu import (
 )
 from vpp_tpu.pipeline.dataplane import Dataplane
 from vpp_tpu.pipeline.tables import DataplaneConfig, pack_rules
-from vpp_tpu.pipeline.vector import Disposition, PacketVector, ip4
+from vpp_tpu.pipeline.vector import (
+    Disposition,
+    PacketVector,
+    ip4,
+    make_packet_vector,
+)
 
 
 def random_rules(rng, n, with_ranges=False):
@@ -147,8 +152,17 @@ def test_range_rules_fall_back():
     table = compile_bitplanes(packed, 8)
     assert not table.ok
     # Fail closed: the range rule can never match in the MXU planes even
-    # if a caller ignores ok=False (k >= 1 keeps its mismatch positive).
+    # if a caller ignores ok=False — its coefficient column is zeroed and
+    # k pinned to 1, so mismatch ≡ 1 for every possible packet.
     assert table.k[0] >= 1.0
+    assert (table.coeff[:, 0] == 0.0).all()
+    # Direct check: a proto-7 packet (one bit off TCP) must NOT match —
+    # this was the spurious-match case before coeff zeroing.
+    pkts = make_packet_vector([dict(src="1.2.3.4", dst="5.6.7.8", proto=7,
+                                    sport=1, dport=150)])
+    bits = packet_bit_planes(pkts)
+    mism = bits.astype(jnp.float32) @ table.coeff + table.k
+    assert float(mism[0, 0]) >= 1.0
 
 
 def test_dataplane_flips_to_mxu_path():

@@ -133,9 +133,11 @@ def compile_bitplanes(packed: dict, max_rules: int) -> MxuTable:
             np.where(exact, lo, 0).astype(np.uint32),
             np.where(exact, 0xFFFF, 0).astype(np.uint32),
         )
-    # Fail closed: a range-port rule can never match in the MXU planes
-    # (k>=1 keeps its mismatch count positive), so a caller that ignores
+    # Fail closed: a range-port rule can never match in the MXU planes —
+    # zero its coefficient column AND pin k=1 so the mismatch count is a
+    # constant 1 regardless of packet bits. A caller that ignores
     # ok=False misses the rule rather than wildcarding its ports.
+    coeff[:, :n] = np.where(bad_rows[None, :], 0.0, coeff[:, :n])
     k[:n] = np.where(bad_rows, 1.0, k[:n])
     return MxuTable(coeff=coeff, k=k, ok=not bad_rows.any())
 

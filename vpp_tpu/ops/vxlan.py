@@ -1,0 +1,182 @@
+"""VXLAN encap/decap for cluster-edge traffic.
+
+Reference analog: VPP's vxlan plugin, driven by the contiv agent's
+node-events handler — a VXLAN full-mesh between nodes over bridge
+domain 10 with a BVI (reference plugins/contiv/node_events.go:184-250,
+plugins/contiv/host.go:211-331). On TPU, node↔node traffic between TPU
+hosts rides ICI/DCN collectives (vpp_tpu.parallel.cluster); VXLAN
+remains the fabric for the *cluster edge* — peers that are not TPU
+hosts — exactly as SURVEY.md §5.8 prescribes.
+
+Design: headers are SoA vectors (pipeline/vector.py), so an encapped
+packet is a *pair* of vectors (outer, inner) rather than a byte blob.
+The encap kernel computes the outer IPv4/UDP header fields on-device
+(source-port flow entropy per RFC 7348 §5.1 — a hash of the inner
+5-tuple — so ECMP in the underlay spreads flows); the decap kernel
+validates outer fields + VNI and re-admits the inner vector. Byte-level
+serialization for a real NIC lives in ``encode_frame``/``decode_frame``
+(host-side, numpy) and is exercised by the native IO ring.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from vpp_tpu.pipeline.vector import FLAG_VALID, PacketVector
+
+VXLAN_PORT = 4789
+# Default VNI: the reference puts the pod overlay in bridge domain 10
+# (vxlan tunnels created by node_events.go join BD "vxlanBD").
+DEFAULT_VNI = 10
+# Outer overhead on the wire: IPv4 (20) + UDP (8) + VXLAN (8).
+ENCAP_OVERHEAD = 36
+# VPP sets the outer TTL of vxlan-encapped packets to 254.
+OUTER_TTL = 254
+
+
+class DecapResult(NamedTuple):
+    inner: PacketVector   # inner headers, valid only where ok
+    ok: jnp.ndarray       # bool [P]: outer was well-formed VXLAN for vni
+
+
+def _flow_entropy_sport(pkts: PacketVector) -> jnp.ndarray:
+    """RFC 7348 §5.1 source-port entropy: hash the inner 5-tuple into the
+    dynamic port range so underlay ECMP spreads flows but each flow is
+    stable (no reordering)."""
+    h = pkts.src_ip ^ (pkts.dst_ip * jnp.uint32(0x9E3779B1))
+    h = h ^ (
+        (pkts.sport.astype(jnp.uint32) << 16)
+        | pkts.dport.astype(jnp.uint32)
+    )
+    h = h * jnp.uint32(0x85EBCA77) ^ pkts.proto.astype(jnp.uint32)
+    h = h ^ (h >> 15)
+    return (49152 + (h % jnp.uint32(16384))).astype(jnp.int32)
+
+
+def vxlan_encap(
+    inner: PacketVector,
+    encap_mask: jnp.ndarray,
+    local_vtep: jnp.ndarray,
+    remote_vtep: jnp.ndarray,
+) -> PacketVector:
+    """Build the outer IPv4/UDP header vector for packets in ``encap_mask``.
+
+    ``remote_vtep`` is per-packet (uint32 [P]) — the FIB's next_hop for
+    REMOTE dispositions (pipeline StepResult.next_hop). Packets outside
+    the mask come back with flags=0 (invalid outer). The inner vector is
+    untouched — an encapped packet is the (outer, inner) pair.
+    """
+    valid = inner.valid & encap_mask
+    flags = jnp.where(valid, FLAG_VALID, 0).astype(jnp.int32)
+    zero = jnp.zeros_like(inner.src_ip)
+    return PacketVector(
+        src_ip=jnp.where(valid, local_vtep, zero).astype(jnp.uint32),
+        dst_ip=jnp.where(valid, remote_vtep, zero).astype(jnp.uint32),
+        proto=jnp.where(valid, 17, 0).astype(jnp.int32),
+        sport=jnp.where(valid, _flow_entropy_sport(inner), 0).astype(jnp.int32),
+        dport=jnp.where(valid, VXLAN_PORT, 0).astype(jnp.int32),
+        ttl=jnp.where(valid, OUTER_TTL, 0).astype(jnp.int32),
+        pkt_len=jnp.where(valid, inner.pkt_len + ENCAP_OVERHEAD, 0).astype(
+            jnp.int32
+        ),
+        rx_if=inner.rx_if,
+        flags=flags,
+    )
+
+
+def vxlan_decap(
+    outer: PacketVector,
+    inner: PacketVector,
+    vni: jnp.ndarray,
+    expected_vni: int = DEFAULT_VNI,
+    local_vtep: jnp.ndarray = None,
+) -> DecapResult:
+    """Validate outer headers + VNI; re-admit inner packets where ok.
+
+    Mirrors VPP's vxlan-input checks: UDP proto, VXLAN dst port, VNI
+    match, and (when ``local_vtep`` is given) outer dst addressed to us.
+    The re-admitted inner vector keeps the outer's rx interface — the
+    uplink — as its rx_if, like a decapped packet re-entering the graph
+    on the tunnel interface.
+    """
+    ok = (
+        outer.valid
+        & (outer.proto == 17)
+        & (outer.dport == VXLAN_PORT)
+        & (vni == expected_vni)
+    )
+    if local_vtep is not None:
+        ok = ok & (outer.dst_ip == local_vtep)
+    flags = jnp.where(ok & inner.valid, FLAG_VALID, 0).astype(jnp.int32)
+    return DecapResult(
+        inner=inner._replace(rx_if=outer.rx_if, flags=flags),
+        ok=ok,
+    )
+
+
+# --- byte-level wire codec (host side, for the NIC/native-ring edge) ---
+
+_IP_HDR = struct.Struct("!BBHHHBBHII")   # version/ihl, tos, len, id, frag, ttl, proto, csum, src, dst
+_UDP_HDR = struct.Struct("!HHHH")
+_VXLAN_HDR = struct.Struct("!II")        # flags(8)|rsvd(24), vni(24)|rsvd(8)
+
+
+def _ip_checksum(hdr: bytes) -> int:
+    s = 0
+    for i in range(0, len(hdr), 2):
+        s += (hdr[i] << 8) | hdr[i + 1]
+    while s >> 16:
+        s = (s & 0xFFFF) + (s >> 16)
+    return (~s) & 0xFFFF
+
+
+def _ip4_bytes(src: int, dst: int, proto: int, ttl: int, payload_len: int) -> bytes:
+    hdr = _IP_HDR.pack(
+        0x45, 0, 20 + payload_len, 0, 0, ttl, proto, 0, src & 0xFFFFFFFF, dst & 0xFFFFFFFF
+    )
+    csum = _ip_checksum(hdr)
+    return hdr[:10] + struct.pack("!H", csum) + hdr[12:]
+
+
+def encode_frame(outer: dict, inner: dict, vni: int = DEFAULT_VNI,
+                 inner_payload: bytes = b"") -> bytes:
+    """Serialize one encapped packet to wire bytes:
+    outer IPv4 | UDP | VXLAN | inner IPv4 | inner L4 stub | payload."""
+    inner_l4 = _UDP_HDR.pack(
+        inner.get("sport", 0), inner.get("dport", 0), 8 + len(inner_payload), 0
+    )
+    inner_ip = _ip4_bytes(
+        inner["src"], inner["dst"], inner.get("proto", 17),
+        inner.get("ttl", 64), len(inner_l4) + len(inner_payload),
+    )
+    vxlan = _VXLAN_HDR.pack(0x08 << 24, (vni & 0xFFFFFF) << 8)
+    inner_bytes = inner_ip + inner_l4 + inner_payload
+    udp_len = 8 + len(vxlan) + len(inner_bytes)
+    udp = _UDP_HDR.pack(outer.get("sport", 49152), VXLAN_PORT, udp_len, 0)
+    outer_ip = _ip4_bytes(
+        outer["src"], outer["dst"], 17, outer.get("ttl", OUTER_TTL), udp_len
+    )
+    return outer_ip + udp + vxlan + inner_bytes
+
+
+def decode_frame(wire: bytes) -> Tuple[dict, dict, int, bytes]:
+    """Parse wire bytes back into (outer, inner, vni, payload)."""
+    o = _IP_HDR.unpack_from(wire, 0)
+    outer = {"src": o[8], "dst": o[9], "proto": o[6], "ttl": o[5]}
+    sport, dport, _ulen, _ = _UDP_HDR.unpack_from(wire, 20)
+    outer["sport"], outer["dport"] = sport, dport
+    if dport != VXLAN_PORT:
+        raise ValueError(f"not VXLAN: UDP dport {dport}")
+    vflags, vvni = _VXLAN_HDR.unpack_from(wire, 28)
+    if not (vflags >> 24) & 0x08:
+        raise ValueError("VXLAN I-flag not set")
+    vni = (vvni >> 8) & 0xFFFFFF
+    i = _IP_HDR.unpack_from(wire, 36)
+    inner = {"src": i[8], "dst": i[9], "proto": i[6], "ttl": i[5], "len": i[2]}
+    isport, idport, _, _ = _UDP_HDR.unpack_from(wire, 56)
+    inner["sport"], inner["dport"] = isport, idport
+    return outer, inner, vni, wire[64:]

@@ -214,6 +214,10 @@ class ClusterDataplane:
         self.nodes: List[Dataplane] = [
             Dataplane(self.config, materialize=False) for _ in range(self.n_nodes)
         ]
+        for n in self.nodes:
+            # Cluster nodes always classify via the dense rule-sharded
+            # kernel; skip the MXU bit-plane compile + coeff upload.
+            n.builder.mxu_enabled = False
         self.tables: Optional[DataplaneTables] = None
         self.epoch = 0
         self._now = 0

@@ -45,6 +45,7 @@ class Dataplane:
         self._lock = threading.RLock()
         self._step = jax.jit(pipeline_step)
         self._step_mxu = jax.jit(pipeline_step_mxu)
+        self._encap = None  # jitted vxlan_encap, built on first use
         # Flipped at swap(): large exact-port global tables classify on
         # the MXU bit-plane kernel; small or range-rule tables stay dense.
         self._use_mxu = False
@@ -141,11 +142,37 @@ class Dataplane:
                 )
             self.tables = self.builder.to_device(sessions=self.tables)
             self._use_mxu = (
-                self.builder.glb_mxu.ok
+                self.builder.mxu_enabled
+                and self.builder.glb_mxu.ok
                 and self.builder.glb_nrules >= self.mxu_threshold
             )
             self.epoch += 1
             return self.epoch
+
+    # --- VXLAN edge (cluster-boundary peers; TPU↔TPU rides ICI instead) ---
+    def set_vtep(self, vtep_ip: int) -> None:
+        """Set this node's VXLAN tunnel endpoint address (the reference's
+        per-node vxlanCIDR IP, plugins/contiv/ipam computeVxlanIPAddress)."""
+        with self._lock:
+            self._vtep = jnp.uint32(vtep_ip)
+
+    def encap_remote(self, result: StepResult) -> PacketVector:
+        """Outer-header vector for REMOTE-disposed packets of a step —
+        the vxlan-encap graph node for traffic leaving the cluster edge."""
+        from vpp_tpu.ops.vxlan import vxlan_encap
+
+        vtep = getattr(self, "_vtep", None)
+        if vtep is None:
+            raise RuntimeError("set_vtep() before encap_remote()")
+        if self._encap is None:
+            self._encap = jax.jit(vxlan_encap)
+        # All REMOTE-disposed traffic encaps here: in a standalone node the
+        # VXLAN mesh is the only inter-node fabric (ICI handoff is the
+        # ClusterDataplane's job, which gates on disp the same way).
+        from vpp_tpu.pipeline.vector import Disposition
+
+        mask = result.disp == int(Disposition.REMOTE)
+        return self._encap(result.pkts, mask, vtep, result.next_hop)
 
     # --- traffic ---
     def process(self, pkts: PacketVector, now: Optional[int] = None) -> StepResult:

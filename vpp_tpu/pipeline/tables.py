@@ -205,6 +205,7 @@ class TableBuilder:
 
     def __init__(self, config: DataplaneConfig = DataplaneConfig()):
         self.config = config
+        self.mxu_enabled = True  # cleared for cluster-node builders
         c = config
         z = np.zeros
         self.acl = {
@@ -249,11 +250,18 @@ class TableBuilder:
         self.set_local_table(slot, [])
 
     def set_global_table(self, rules: Sequence[ContivRule]) -> None:
-        from vpp_tpu.ops.acl_mxu import compile_bitplanes
+        from vpp_tpu.ops.acl_mxu import compile_bitplanes, empty_bitplanes
 
         self.glb = pack_rules(rules, self.config.max_global_rules)
         self.glb_nrules = len(rules)
-        self.glb_mxu = compile_bitplanes(self.glb, self.config.max_global_rules)
+        # Bit-plane compilation only pays off where the MXU classify can
+        # actually run: a ClusterDataplane node always classifies via the
+        # dense rule-sharded kernel, so its builders skip the compile (and
+        # the per-epoch device upload of the [PLANES, R] coeff matrix).
+        if self.mxu_enabled:
+            self.glb_mxu = compile_bitplanes(self.glb, self.config.max_global_rules)
+        else:
+            self.glb_mxu = empty_bitplanes(self.config.max_global_rules)
 
     # --- interfaces ---
     def set_interface(
