@@ -1,0 +1,212 @@
+"""CNI subsystem tests: server Add/Delete semantics, persistence resync,
+the unix-socket transport, and the shim's CNI-spec translation.
+
+Reference model: plugins/contiv/remote_cni_server_test.go (server logic
+against a tracked backend) + cmd/contiv-cni/contiv_cni_test.go.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from vpp_tpu.cni import (
+    CNIReply,
+    CNIRequest,
+    ContainerIndex,
+    RemoteCNIServer,
+    ResultCode,
+)
+from vpp_tpu.cni import shim
+from vpp_tpu.cni.transport import CNITransportServer, cni_call
+from vpp_tpu.ipam.ipam import IPAM
+from vpp_tpu.kvstore.store import Broker, KVStore
+from vpp_tpu.pipeline.dataplane import Dataplane
+from vpp_tpu.pipeline.tables import DataplaneConfig
+from vpp_tpu.pipeline.vector import Disposition, ip4, make_packet_vector
+
+
+def make_server(store=None):
+    dp = Dataplane(DataplaneConfig(sess_slots=256))
+    dp.add_uplink()
+    broker = Broker(store, "agent1/") if store is not None else None
+    ipam = IPAM(node_id=1, broker=broker)
+    index = ContainerIndex(broker)
+    srv = RemoteCNIServer(dp, ipam, index)
+    srv.set_ready()
+    return srv, dp, ipam
+
+
+def add_req(cid, name, ns="default"):
+    return CNIRequest(
+        container_id=cid,
+        netns=f"/proc/ns/{cid}",
+        extra_args={"K8S_POD_NAME": name, "K8S_POD_NAMESPACE": ns},
+    )
+
+
+def test_add_wires_pod_and_traffic_flows():
+    srv, dp, ipam = make_server()
+    r1 = srv.add(add_req("c1", "client"))
+    r2 = srv.add(add_req("c2", "server"))
+    assert r1.result == ResultCode.OK and r2.result == ResultCode.OK
+    ip1 = r1.interfaces[0].ip_addresses[0].address.split("/")[0]
+    ip2 = r2.interfaces[0].ip_addresses[0].address.split("/")[0]
+    assert ip1 != ip2
+    assert r1.routes[0].dst == "0.0.0.0/0"
+    assert r1.interfaces[0].ip_addresses[0].gateway == str(ipam.pod_gateway_ip())
+
+    # semantic check: pod1 → pod2 traffic is actually forwarded
+    if1 = dp.pod_if[("default", "client")]
+    if2 = dp.pod_if[("default", "server")]
+    res = dp.process(make_packet_vector(
+        [dict(src=ip1, dst=ip2, proto=6, sport=1234, dport=80, rx_if=if1)]
+    ))
+    assert int(res.disp[0]) == int(Disposition.LOCAL)
+    assert int(res.tx_if[0]) == if2
+
+
+def test_add_not_ready_returns_try_again():
+    srv, dp, _ = make_server()
+    srv._ready = False
+    r = srv.add(add_req("c1", "p1"))
+    assert r.result == ResultCode.TRY_AGAIN
+
+
+def test_add_is_idempotent():
+    srv, dp, _ = make_server()
+    r1 = srv.add(add_req("c1", "p1"))
+    r2 = srv.add(add_req("c1", "p1"))
+    assert r2.result == ResultCode.OK
+    assert r1.interfaces[0].ip_addresses == r2.interfaces[0].ip_addresses
+    assert len(dp.pod_if) == 1
+
+
+def test_delete_releases_everything():
+    srv, dp, ipam = make_server()
+    r = srv.add(add_req("c1", "p1"))
+    ip = r.interfaces[0].ip_addresses[0].address.split("/")[0]
+    assert srv.delete(CNIRequest(container_id="c1")).result == ResultCode.OK
+    assert ("default", "p1") not in dp.pod_if
+    assert ipam.assigned_count() == 0
+    # packet to the released IP no longer routes locally
+    res = dp.process(make_packet_vector(
+        [dict(src="10.1.1.9", dst=ip, proto=6, sport=1, dport=2, rx_if=1)]
+    ))
+    assert int(res.disp[0]) != int(Disposition.LOCAL)
+    # second delete is a no-op success (CNI DEL idempotency)
+    assert srv.delete(CNIRequest(container_id="c1")).result == ResultCode.OK
+
+
+def test_pod_change_notifications_fire():
+    events = []
+    srv, dp, _ = make_server()
+    srv.on_pod_change = lambda: events.append(1)
+    srv.add(add_req("c1", "p1"))
+    srv.delete(CNIRequest(container_id="c1"))
+    assert len(events) == 2
+
+
+def test_restart_resync_rewires_pods():
+    store = KVStore()
+    srv, dp, _ = make_server(store)
+    r = srv.add(add_req("c1", "p1"))
+    ip = r.interfaces[0].ip_addresses[0].address.split("/")[0]
+
+    # "restart": fresh dataplane + server over the same store
+    srv2, dp2, ipam2 = make_server(store)
+    n = srv2.resync()
+    assert n == 1
+    assert ("default", "p1") in dp2.pod_if
+    # IPAM must remember the assignment across restart (persisted broker)
+    assert ipam2.assigned_count() == 1
+    if_idx = dp2.pod_if[("default", "p1")]
+    res = dp2.process(make_packet_vector(
+        [dict(src="10.9.9.9", dst=ip, proto=6, sport=1, dport=2,
+              rx_if=dp2.uplink_if)]
+    ))
+    assert int(res.disp[0]) == int(Disposition.LOCAL)
+    assert int(res.tx_if[0]) == if_idx
+    # the restarted server can still answer the original container
+    r2 = srv2.add(add_req("c1", "p1"))
+    assert r2.interfaces[0].ip_addresses[0].address.startswith(ip)
+
+
+def test_transport_roundtrip(tmp_path):
+    srv, dp, _ = make_server()
+    sock = str(tmp_path / "cni.sock")
+    ts = CNITransportServer(sock, srv.dispatch)
+    ts.start()
+    try:
+        reply = cni_call(sock, "Add", add_req("c9", "podx").to_dict())
+        assert reply["result"] == 0
+        assert reply["interfaces"][0]["ip_addresses"][0]["address"].endswith("/32")
+        reply = cni_call(sock, "Bogus", {"container_id": "c9"})
+        assert reply["result"] == 1
+    finally:
+        ts.close()
+
+
+def test_shim_add_del_flow(tmp_path):
+    srv, dp, _ = make_server()
+    sock = str(tmp_path / "cni.sock")
+    ts = CNITransportServer(sock, srv.dispatch)
+    ts.start()
+    try:
+        env = {
+            "CNI_COMMAND": "ADD",
+            "CNI_CONTAINERID": "c42",
+            "CNI_NETNS": "/proc/42/ns/net",
+            "CNI_IFNAME": "eth0",
+            "CNI_ARGS": "IgnoreUnknown=1;K8S_POD_NAME=web;K8S_POD_NAMESPACE=prod",
+        }
+        conf = json.dumps({"cniVersion": "0.3.1", "grpcServer": sock}).encode()
+        out, code = shim.run(env, conf)
+        assert code == 0
+        result = json.loads(out)
+        assert result["cniVersion"] == "0.3.1"
+        assert result["ips"][0]["address"].endswith("/32")
+        assert result["ips"][0]["version"] == "4"
+        assert result["interfaces"][0]["name"] == "eth0"
+        assert ("prod", "web") in dp.pod_if
+
+        env["CNI_COMMAND"] = "DEL"
+        out, code = shim.run(env, conf)
+        assert code == 0 and out == ""
+        assert ("prod", "web") not in dp.pod_if
+    finally:
+        ts.close()
+
+
+def test_shim_version_and_errors(tmp_path):
+    out, code = shim.run({"CNI_COMMAND": "VERSION"}, b"")
+    assert code == 0
+    assert "0.3.1" in json.loads(out)["supportedVersions"]
+
+    out, code = shim.run({"CNI_COMMAND": "ADD"}, b"")
+    assert code == 1
+    assert json.loads(out)["code"] == shim.ERR_INVALID_ENV
+
+    # agent unreachable → ERR_IO
+    env = {"CNI_COMMAND": "ADD", "CNI_CONTAINERID": "c1"}
+    conf = json.dumps({"grpcServer": str(tmp_path / "nope.sock")}).encode()
+    out, code = shim.run(env, conf)
+    assert code == 1
+    assert json.loads(out)["code"] == shim.ERR_IO
+
+
+def test_shim_try_again_when_agent_not_ready(tmp_path):
+    srv, dp, _ = make_server()
+    srv._ready = False
+    sock = str(tmp_path / "cni.sock")
+    ts = CNITransportServer(sock, srv.dispatch)
+    ts.start()
+    try:
+        env = {"CNI_COMMAND": "ADD", "CNI_CONTAINERID": "c1"}
+        conf = json.dumps({"grpcServer": sock}).encode()
+        out, code = shim.run(env, conf)
+        assert code == 1
+        assert json.loads(out)["code"] == shim.ERR_TRY_AGAIN
+    finally:
+        ts.close()

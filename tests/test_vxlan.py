@@ -148,8 +148,34 @@ def test_wire_codec_roundtrip():
 def test_wire_codec_rejects_non_vxlan():
     outer = {"src": VTEP_A, "dst": VTEP_B}
     inner = {"src": 1, "dst": 2, "proto": 6, "sport": 1, "dport": 2}
-    wire = bytearray(encode_frame(outer, inner))
+    good = encode_frame(outer, inner)
+
+    wire = bytearray(good)
     wire[22] = 0x01  # corrupt UDP dst port
     wire[23] = 0x02
+    with pytest.raises(ValueError):
+        decode_frame(bytes(wire))
+
+    # non-UDP outer (e.g. GRE) must be rejected even if payload bytes
+    # happen to look like port 4789
+    wire = bytearray(good)
+    wire[9] = 47  # outer proto = GRE
+    with pytest.raises(ValueError):
+        decode_frame(bytes(wire))
+
+    # outer with IP options (IHL > 5) shifts offsets — rejected
+    wire = bytearray(good)
+    wire[0] = 0x46
+    with pytest.raises(ValueError):
+        decode_frame(bytes(wire))
+
+    # truncated frame raises ValueError, not struct.error
+    with pytest.raises(ValueError):
+        decode_frame(good[:40])
+
+    # non-IPv4 inner ethertype rejected
+    wire = bytearray(good)
+    wire[48] = 0x86  # ethertype -> 0x86DD (IPv6)
+    wire[49] = 0xDD
     with pytest.raises(ValueError):
         decode_frame(bytes(wire))

@@ -1,0 +1,161 @@
+"""RemoteCNIServer: the agent-side Add/Delete endpoint that wires pods.
+
+Reference analog: remoteCNIserver (plugins/contiv/remote_cni_server.go:
+274-283 Add/Delete, :895 configureContainerConnectivity): allocate a pod
+IP from IPAM, create the pod's dataplane interface, install the /32
+route + gateway, persist the container config (skipping the kvstore echo
+via the proxy, :1390-1420), and answer with the CNI result. Requests
+arriving before the base vswitch config is ready get TRY_AGAIN (the
+reference blocks on vswitchCond, :129-130 — we answer non-blocking so
+the shim can retry, same effect for kubelet's retry loop).
+
+Restart resync: `resync()` reloads the persisted container index and
+re-wires every interface/route — the reference's resync-from-ETCD path.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, List, Optional
+
+from vpp_tpu.cni.containeridx import ContainerConfig, ContainerIndex
+from vpp_tpu.cni.model import (
+    CNIInterface,
+    CNIIpAddress,
+    CNIReply,
+    CNIRequest,
+    CNIRoute,
+    ResultCode,
+)
+from vpp_tpu.ipam.ipam import IPAM
+from vpp_tpu.pipeline.dataplane import Dataplane
+from vpp_tpu.pipeline.vector import Disposition
+
+log = logging.getLogger("vpp_tpu.cni")
+
+
+class RemoteCNIServer:
+    def __init__(
+        self,
+        dataplane: Dataplane,
+        ipam: IPAM,
+        index: Optional[ContainerIndex] = None,
+        on_pod_change: Optional[Callable[[], None]] = None,
+    ):
+        self.dp = dataplane
+        self.ipam = ipam
+        self.index = index or ContainerIndex()
+        self._ready = False
+        self._lock = threading.RLock()
+        # Fired after a pod is wired/unwired and the epoch swapped —
+        # the policy/service plugins' cue to re-render (the reference's
+        # async ETCD-watch path, SURVEY.md §3.2).
+        self.on_pod_change = on_pod_change
+
+    # --- lifecycle ---
+    def set_ready(self) -> None:
+        """Base vswitch connectivity configured; start serving Adds."""
+        with self._lock:
+            self._ready = True
+
+    def resync(self) -> int:
+        """Re-wire all persisted containers after an agent restart."""
+        with self._lock:
+            n = 0
+            for cfg in self.index.load_persisted():
+                pod = (cfg.pod_namespace, cfg.pod_name)
+                if_idx = self.dp.add_pod_interface(pod)
+                self.dp.builder.add_route(
+                    f"{cfg.ip}/32", if_idx, Disposition.LOCAL
+                )
+                n += 1
+            if n:
+                self.dp.swap()
+            return n
+
+    # --- CNI protocol ---
+    def add(self, req: CNIRequest) -> CNIReply:
+        with self._lock:
+            if not self._ready:
+                return CNIReply(
+                    result=ResultCode.TRY_AGAIN,
+                    error="vswitch base config not ready",
+                )
+            existing = self.index.lookup(req.container_id)
+            if existing is not None:
+                # idempotent re-Add (kubelet retries): answer as success
+                return self._reply_for(existing)
+            try:
+                pod_id = f"{req.pod_namespace}/{req.pod_name}"
+                ip = self.ipam.next_pod_ip(pod_id)
+                pod = (req.pod_namespace, req.pod_name)
+                if_idx = self.dp.add_pod_interface(pod)
+                self.dp.builder.add_route(
+                    f"{ip}/32", if_idx, Disposition.LOCAL
+                )
+                self.dp.swap()
+                cfg = ContainerConfig(
+                    container_id=req.container_id,
+                    pod_name=req.pod_name,
+                    pod_namespace=req.pod_namespace,
+                    if_index=if_idx,
+                    if_name=req.if_name,
+                    ip=str(ip),
+                    netns=req.netns,
+                )
+                self.index.register(cfg)
+            except Exception as e:  # IPAM full, interface table full, ...
+                log.exception("CNI Add failed for %s", req.container_id)
+                return CNIReply(result=ResultCode.ERROR, error=str(e))
+        self._notify()
+        return self._reply_for(cfg)
+
+    def delete(self, req: CNIRequest) -> CNIReply:
+        with self._lock:
+            cfg = self.index.unregister(req.container_id)
+            if cfg is None:
+                # unknown container: CNI DEL must be idempotent
+                return CNIReply(result=ResultCode.OK)
+            pod = (cfg.pod_namespace, cfg.pod_name)
+            self.dp.builder.del_route(f"{cfg.ip}/32")
+            self.dp.del_pod_interface(pod)
+            self.ipam.release_pod_ip(f"{cfg.pod_namespace}/{cfg.pod_name}")
+            self.dp.swap()
+        self._notify()
+        return CNIReply(result=ResultCode.OK)
+
+    # --- helpers ---
+    def _notify(self) -> None:
+        if self.on_pod_change is not None:
+            try:
+                self.on_pod_change()
+            except Exception:
+                log.exception("on_pod_change callback failed")
+
+    def _reply_for(self, cfg: ContainerConfig) -> CNIReply:
+        gw = str(self.ipam.pod_gateway_ip())
+        return CNIReply(
+            result=ResultCode.OK,
+            interfaces=[
+                CNIInterface(
+                    name=cfg.if_name,
+                    sandbox=cfg.netns,
+                    ip_addresses=[
+                        CNIIpAddress(address=f"{cfg.ip}/32", gateway=gw)
+                    ],
+                )
+            ],
+            routes=[CNIRoute(dst="0.0.0.0/0", gw=gw)],
+        )
+
+    def dispatch(self, method: str, params: dict) -> dict:
+        """Transport-level entry: method name + request dict → reply dict."""
+        req = CNIRequest.from_dict(params)
+        if method == "Add":
+            return self.add(req).to_dict()
+        if method == "Delete":
+            return self.delete(req).to_dict()
+        return CNIReply(
+            result=ResultCode.ERROR, error=f"unknown method {method!r}"
+        ).to_dict()

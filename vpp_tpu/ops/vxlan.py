@@ -32,8 +32,10 @@ VXLAN_PORT = 4789
 # Default VNI: the reference puts the pod overlay in bridge domain 10
 # (vxlan tunnels created by node_events.go join BD "vxlanBD").
 DEFAULT_VNI = 10
-# Outer overhead on the wire: IPv4 (20) + UDP (8) + VXLAN (8).
-ENCAP_OVERHEAD = 36
+# Outer overhead on the wire: IPv4 (20) + UDP (8) + VXLAN (8) + inner
+# Ethernet (14) — VXLAN tunnels L2 frames, so the inner MAC header is
+# part of the encapped payload (VPP counts the same 50 bytes).
+ENCAP_OVERHEAD = 50
 # VPP sets the outer TTL of vxlan-encapped packets to 254.
 OUTER_TTL = 254
 
@@ -119,10 +121,22 @@ def vxlan_decap(
 
 
 # --- byte-level wire codec (host side, for the NIC/native-ring edge) ---
+# RFC 7348 framing: outer IPv4 | outer UDP | VXLAN | inner Ethernet |
+# inner IPv4 | inner L4. The inner Ethernet header is mandatory on the
+# wire (VXLAN tunnels L2 frames); we synthesize locally-administered
+# MACs derived from the inner IPs unless the caller provides real ones.
 
 _IP_HDR = struct.Struct("!BBHHHBBHII")   # version/ihl, tos, len, id, frag, ttl, proto, csum, src, dst
 _UDP_HDR = struct.Struct("!HHHH")
 _VXLAN_HDR = struct.Struct("!II")        # flags(8)|rsvd(24), vni(24)|rsvd(8)
+_ETH_HDR = struct.Struct("!6s6sH")       # dst mac, src mac, ethertype
+_ETH_IPV4 = 0x0800
+
+
+def _synth_mac(ip: int) -> bytes:
+    """Locally-administered MAC from an IPv4 address (0x02 | ip bytes),
+    the same trick the reference uses for pod-side MACs."""
+    return bytes([0x02, 0x00]) + struct.pack("!I", ip & 0xFFFFFFFF)
 
 
 def _ip_checksum(hdr: bytes) -> int:
@@ -143,9 +157,11 @@ def _ip4_bytes(src: int, dst: int, proto: int, ttl: int, payload_len: int) -> by
 
 
 def encode_frame(outer: dict, inner: dict, vni: int = DEFAULT_VNI,
-                 inner_payload: bytes = b"") -> bytes:
-    """Serialize one encapped packet to wire bytes:
-    outer IPv4 | UDP | VXLAN | inner IPv4 | inner L4 stub | payload."""
+                 inner_payload: bytes = b"",
+                 inner_src_mac: bytes = None,
+                 inner_dst_mac: bytes = None) -> bytes:
+    """Serialize one encapped packet to RFC 7348 wire bytes:
+    outer IPv4 | UDP | VXLAN | inner Ethernet | inner IPv4 | inner L4."""
     inner_l4 = _UDP_HDR.pack(
         inner.get("sport", 0), inner.get("dport", 0), 8 + len(inner_payload), 0
     )
@@ -153,8 +169,13 @@ def encode_frame(outer: dict, inner: dict, vni: int = DEFAULT_VNI,
         inner["src"], inner["dst"], inner.get("proto", 17),
         inner.get("ttl", 64), len(inner_l4) + len(inner_payload),
     )
+    eth = _ETH_HDR.pack(
+        inner_dst_mac or _synth_mac(inner["dst"]),
+        inner_src_mac or _synth_mac(inner["src"]),
+        _ETH_IPV4,
+    )
     vxlan = _VXLAN_HDR.pack(0x08 << 24, (vni & 0xFFFFFF) << 8)
-    inner_bytes = inner_ip + inner_l4 + inner_payload
+    inner_bytes = eth + inner_ip + inner_l4 + inner_payload
     udp_len = 8 + len(vxlan) + len(inner_bytes)
     udp = _UDP_HDR.pack(outer.get("sport", 49152), VXLAN_PORT, udp_len, 0)
     outer_ip = _ip4_bytes(
@@ -163,20 +184,47 @@ def encode_frame(outer: dict, inner: dict, vni: int = DEFAULT_VNI,
     return outer_ip + udp + vxlan + inner_bytes
 
 
+# fixed offsets given options-free outer IPv4 (we validate IHL==5)
+_OFF_UDP = 20
+_OFF_VXLAN = 28
+_OFF_ETH = 36
+_OFF_INNER_IP = 50
+_OFF_INNER_L4 = 70
+_MIN_LEN = 78
+
+
 def decode_frame(wire: bytes) -> Tuple[dict, dict, int, bytes]:
-    """Parse wire bytes back into (outer, inner, vni, payload)."""
+    """Parse RFC 7348 wire bytes back into (outer, inner, vni, payload).
+
+    Raises ValueError on anything that is not a well-formed VXLAN-in-
+    IPv4/UDP frame — the same checks the on-device decap kernel applies
+    (proto 17, dst port 4789, I-flag) plus wire-only ones (version/IHL,
+    length, inner ethertype).
+    """
+    if len(wire) < _MIN_LEN:
+        raise ValueError(f"frame too short for VXLAN: {len(wire)} bytes")
     o = _IP_HDR.unpack_from(wire, 0)
+    if o[0] != 0x45:
+        raise ValueError(f"outer not options-free IPv4 (ver/ihl 0x{o[0]:02x})")
     outer = {"src": o[8], "dst": o[9], "proto": o[6], "ttl": o[5]}
-    sport, dport, _ulen, _ = _UDP_HDR.unpack_from(wire, 20)
+    if outer["proto"] != 17:
+        raise ValueError(f"outer proto {outer['proto']} is not UDP")
+    sport, dport, _ulen, _ = _UDP_HDR.unpack_from(wire, _OFF_UDP)
     outer["sport"], outer["dport"] = sport, dport
     if dport != VXLAN_PORT:
         raise ValueError(f"not VXLAN: UDP dport {dport}")
-    vflags, vvni = _VXLAN_HDR.unpack_from(wire, 28)
+    vflags, vvni = _VXLAN_HDR.unpack_from(wire, _OFF_VXLAN)
     if not (vflags >> 24) & 0x08:
         raise ValueError("VXLAN I-flag not set")
     vni = (vvni >> 8) & 0xFFFFFF
-    i = _IP_HDR.unpack_from(wire, 36)
-    inner = {"src": i[8], "dst": i[9], "proto": i[6], "ttl": i[5], "len": i[2]}
-    isport, idport, _, _ = _UDP_HDR.unpack_from(wire, 56)
+    dst_mac, src_mac, ethertype = _ETH_HDR.unpack_from(wire, _OFF_ETH)
+    if ethertype != _ETH_IPV4:
+        raise ValueError(f"inner ethertype 0x{ethertype:04x} not IPv4")
+    i = _IP_HDR.unpack_from(wire, _OFF_INNER_IP)
+    if i[0] != 0x45:
+        raise ValueError(f"inner not options-free IPv4 (ver/ihl 0x{i[0]:02x})")
+    inner = {"src": i[8], "dst": i[9], "proto": i[6], "ttl": i[5], "len": i[2],
+             "src_mac": src_mac, "dst_mac": dst_mac}
+    isport, idport, _, _ = _UDP_HDR.unpack_from(wire, _OFF_INNER_L4)
     inner["sport"], inner["dport"] = isport, idport
-    return outer, inner, vni, wire[64:]
+    return outer, inner, vni, wire[_MIN_LEN:]

@@ -216,7 +216,7 @@ class ClusterDataplane:
         ]
         for n in self.nodes:
             # Cluster nodes always classify via the dense rule-sharded
-            # kernel; skip the MXU bit-plane compile + coeff upload.
+            # kernel; skip the host-side MXU bit-plane compile.
             n.builder.mxu_enabled = False
         self.tables: Optional[DataplaneTables] = None
         self.epoch = 0

@@ -26,7 +26,8 @@ from vpp_tpu.pipeline.tables import (
     InterfaceType,
     TableBuilder,
 )
-from vpp_tpu.pipeline.vector import PacketVector
+from vpp_tpu.ops.vxlan import vxlan_encap
+from vpp_tpu.pipeline.vector import Disposition, PacketVector
 
 
 class Dataplane:
@@ -159,8 +160,6 @@ class Dataplane:
     def encap_remote(self, result: StepResult) -> PacketVector:
         """Outer-header vector for REMOTE-disposed packets of a step —
         the vxlan-encap graph node for traffic leaving the cluster edge."""
-        from vpp_tpu.ops.vxlan import vxlan_encap
-
         vtep = getattr(self, "_vtep", None)
         if vtep is None:
             raise RuntimeError("set_vtep() before encap_remote()")
@@ -169,8 +168,6 @@ class Dataplane:
         # All REMOTE-disposed traffic encaps here: in a standalone node the
         # VXLAN mesh is the only inter-node fabric (ICI handoff is the
         # ClusterDataplane's job, which gates on disp the same way).
-        from vpp_tpu.pipeline.vector import Disposition
-
         mask = result.disp == int(Disposition.REMOTE)
         return self._encap(result.pkts, mask, vtep, result.next_hop)
 

@@ -256,8 +256,10 @@ class TableBuilder:
         self.glb_nrules = len(rules)
         # Bit-plane compilation only pays off where the MXU classify can
         # actually run: a ClusterDataplane node always classifies via the
-        # dense rule-sharded kernel, so its builders skip the compile (and
-        # the per-epoch device upload of the [PLANES, R] coeff matrix).
+        # dense rule-sharded kernel, so its builders skip the host-side
+        # compile. (The zero coeff matrix is still part of the pytree —
+        # shapes must stay epoch-invariant for jit — so the device upload
+        # itself is not avoided, only the O(PLANES·R) host work.)
         if self.mxu_enabled:
             self.glb_mxu = compile_bitplanes(self.glb, self.config.max_global_rules)
         else:
