@@ -6,13 +6,10 @@ against a tracked backend) + cmd/contiv-cni/contiv_cni_test.go.
 """
 
 import json
-import os
 
-import numpy as np
 import pytest
 
 from vpp_tpu.cni import (
-    CNIReply,
     CNIRequest,
     ContainerIndex,
     RemoteCNIServer,
@@ -97,6 +94,37 @@ def test_delete_releases_everything():
     assert int(res.disp[0]) != int(Disposition.LOCAL)
     # second delete is a no-op success (CNI DEL idempotency)
     assert srv.delete(CNIRequest(container_id="c1")).result == ResultCode.OK
+
+
+def test_sandbox_recreation_survives_stale_delete():
+    """ADD with a new container ID for an existing pod replaces the old
+    sandbox; kubelet's late DEL of the old ID must not cut connectivity."""
+    srv, dp, ipam = make_server()
+    srv.add(add_req("c-old", "p1"))
+    r2 = srv.add(add_req("c-new", "p1"))
+    assert r2.result == ResultCode.OK
+    assert ipam.assigned_count() == 1  # old IP released
+    ip2 = r2.interfaces[0].ip_addresses[0].address.split("/")[0]
+
+    # stale DEL of the old sandbox: harmless no-op
+    assert srv.delete(CNIRequest(container_id="c-old")).result == ResultCode.OK
+    assert ("default", "p1") in dp.pod_if
+    if_idx = dp.pod_if[("default", "p1")]
+    res = dp.process(make_packet_vector(
+        [dict(src="10.9.9.9", dst=ip2, proto=6, sport=1, dport=2,
+              rx_if=dp.uplink_if)]
+    ))
+    assert int(res.disp[0]) == int(Disposition.LOCAL)
+    assert int(res.tx_if[0]) == if_idx
+
+
+def test_failed_add_releases_ip():
+    srv, dp, ipam = make_server()
+    # exhaust the interface table so add_pod_interface raises
+    dp._free_ifs = []
+    r = srv.add(add_req("c1", "p1"))
+    assert r.result == ResultCode.ERROR
+    assert ipam.assigned_count() == 0, "partial Add must not leak the IP"
 
 
 def test_pod_change_notifications_fire():

@@ -1,0 +1,211 @@
+"""VPPTCP renderer + session-rule engine tests.
+
+Reference model: renderer/vpptcp/vpptcp_renderer_test.go — render pod
+policies, then assert *connection* allow/deny semantics against the
+installed session-rule tables. LOCAL scope filters a namespace's
+outbound connects (ingress orientation: rules sit where traffic enters
+the vswitch from the app); GLOBAL scope filters inbound accepts from
+outside the node. Batched minimal deltas + resync reconciliation.
+"""
+
+import ipaddress
+
+import numpy as np
+
+from vpp_tpu.hoststack import (
+    ConnDirection,
+    RuleAction,
+    RuleScope,
+    SessionRule,
+    SessionRuleEngine,
+)
+from vpp_tpu.hoststack.session_rules import GLOBAL_NS
+from vpp_tpu.ir import Action, ContivRule, Protocol
+from vpp_tpu.renderer.vpptcp import VpptcpRenderer
+from vpp_tpu.pipeline.vector import ip4
+
+NS = {("default", "client"): 1, ("default", "server"): 2, ("prod", "web"): 3}
+
+POD_CLIENT = ("default", "client")
+POD_SERVER = ("default", "server")
+CLIENT_IP = ipaddress.ip_network("10.1.1.2/32")
+SERVER_IP = ipaddress.ip_network("10.1.1.3/32")
+
+
+def make_renderer():
+    engine = SessionRuleEngine(capacity=512)
+    return VpptcpRenderer(engine, lambda pod: NS.get(pod, -1)), engine
+
+
+def out_conn(ns, proto, lcl_ip, lcl_port, rmt_ip, rmt_port):
+    return (ns, proto, ip4(lcl_ip), lcl_port, ip4(rmt_ip), rmt_port)
+
+
+def in_conn(proto, lcl_ip, lcl_port, rmt_ip, rmt_port):
+    return (proto, ip4(lcl_ip), lcl_port, ip4(rmt_ip), rmt_port)
+
+
+def test_engine_specificity_first_match():
+    eng = SessionRuleEngine(capacity=64)
+    eng.apply(add=[
+        SessionRule(scope=int(RuleScope.LOCAL), appns_index=1,
+                    transport_proto=6, lcl_net=0, lcl_plen=0,
+                    rmt_net=ip4("10.0.0.0"), rmt_plen=8,
+                    lcl_port=0, rmt_port=80,
+                    action=int(RuleAction.ALLOW)),
+        SessionRule(scope=int(RuleScope.LOCAL), appns_index=1,
+                    transport_proto=6, lcl_net=0, lcl_plen=0,
+                    rmt_net=0, rmt_plen=0, lcl_port=0, rmt_port=0,
+                    action=int(RuleAction.DENY)),
+    ])
+    got = eng.check_connect([
+        out_conn(1, 6, "10.1.1.2", 9999, "10.2.3.4", 80),   # specific allow
+        out_conn(1, 6, "10.1.1.2", 9999, "10.2.3.4", 22),   # deny-all
+        out_conn(2, 6, "10.1.1.2", 9999, "10.2.3.4", 22),   # other ns: allow
+        out_conn(1, 17, "10.1.1.2", 9999, "10.2.3.4", 22),  # UDP: no rule
+    ])
+    assert got.tolist() == [True, False, True, True]
+
+
+def test_engine_direction_scoping():
+    """LOCAL rules never see accepts; GLOBAL rules never see connects."""
+    eng = SessionRuleEngine(capacity=64)
+    eng.apply(add=[
+        # ns 7 may not connect anywhere
+        SessionRule(scope=int(RuleScope.LOCAL), appns_index=7,
+                    transport_proto=6, lcl_net=0, lcl_plen=0,
+                    rmt_net=0, rmt_plen=0, lcl_port=0, rmt_port=0,
+                    action=int(RuleAction.DENY)),
+        # node accepts only to 10.1.1.3:80
+        SessionRule(scope=int(RuleScope.GLOBAL), appns_index=GLOBAL_NS,
+                    transport_proto=6, lcl_net=ip4("10.1.1.3"), lcl_plen=32,
+                    rmt_net=0, rmt_plen=0, lcl_port=80, rmt_port=0,
+                    action=int(RuleAction.ALLOW)),
+        SessionRule(scope=int(RuleScope.GLOBAL), appns_index=GLOBAL_NS,
+                    transport_proto=6, lcl_net=0, lcl_plen=0,
+                    rmt_net=0, rmt_plen=0, lcl_port=0, rmt_port=0,
+                    action=int(RuleAction.DENY)),
+    ])
+    got_out = eng.check_connect([
+        out_conn(7, 6, "1.1.1.1", 9, "2.2.2.2", 80),  # ns7 denied
+        out_conn(8, 6, "1.1.1.1", 9, "2.2.2.2", 80),  # ns8: global doesn't apply
+    ])
+    assert got_out.tolist() == [False, True]
+    got_in = eng.check_accept([
+        in_conn(6, "10.1.1.3", 80, "9.9.9.9", 555),   # allowed accept
+        in_conn(6, "10.1.1.3", 22, "9.9.9.9", 555),   # denied port
+        in_conn(6, "10.1.1.4", 80, "9.9.9.9", 555),   # denied target
+    ])
+    assert got_in.tolist() == [True, False, False]
+
+
+def test_renderer_policy_to_session_rules():
+    r, eng = make_renderer()
+    # server accepts TCP/80 only from client (ingress orientation: the
+    # pod's egress list describes traffic it RECEIVES)
+    txn = r.new_txn()
+    txn.render(POD_SERVER, SERVER_IP, ingress=[], egress=[
+        ContivRule(action=Action.PERMIT, src_network=CLIENT_IP,
+                   protocol=Protocol.TCP, dest_port=80),
+        ContivRule(action=Action.DENY),
+    ])
+    txn.render(POD_CLIENT, CLIENT_IP, ingress=[], egress=[])
+    txn.commit()
+    assert eng.num_rules > 0
+
+    # client's outbound connects (LOCAL scope, client's namespace)
+    client_ns = NS[POD_CLIENT]
+    got = eng.check_connect([
+        out_conn(client_ns, 6, "10.1.1.2", 9999, "10.1.1.3", 80),  # → server:80 ok
+        out_conn(client_ns, 6, "10.1.1.2", 9999, "10.1.1.3", 22),  # → server:22 denied
+        out_conn(client_ns, 6, "10.1.1.2", 9999, "8.8.8.8", 443),  # elsewhere ok
+    ])
+    assert got.tolist() == [True, False, True]
+
+    # inbound accepts from outside the node (GLOBAL scope)
+    got_in = eng.check_accept([
+        in_conn(6, "10.1.1.3", 80, "10.1.1.2", 5555),  # client → server:80 ok
+        in_conn(6, "10.1.1.3", 80, "10.9.9.9", 5555),  # stranger denied
+        in_conn(6, "10.1.1.3", 22, "10.1.1.2", 5555),  # wrong port denied
+    ])
+    assert got_in.tolist() == [True, False, False]
+
+
+def test_renderer_batched_delta_updates():
+    r, eng = make_renderer()
+    txn = r.new_txn()
+    txn.render(POD_SERVER, SERVER_IP, ingress=[], egress=[
+        ContivRule(action=Action.PERMIT, src_network=CLIENT_IP,
+                   protocol=Protocol.TCP, dest_port=80),
+        ContivRule(action=Action.DENY),
+    ])
+    txn.commit()
+    before = set(eng.dump())
+
+    # a policy on another pod adds new rules (the ingress fold pins the
+    # new pod's restrictions into every sender's table) but must only
+    # ADD at the wire level — existing rules stay installed untouched
+    applied = []
+    orig_apply = eng.apply
+    eng.apply = lambda add=(), delete=(): (
+        applied.append((set(add), set(delete))), orig_apply(add, delete)
+    )[1]
+    txn2 = r.new_txn()
+    txn2.render(("prod", "web"), ipaddress.ip_network("10.1.1.9/32"),
+                ingress=[], egress=[
+        ContivRule(action=Action.PERMIT, protocol=Protocol.TCP, dest_port=443),
+        ContivRule(action=Action.DENY),
+    ])
+    txn2.commit()
+    eng.apply = orig_apply
+    after = set(eng.dump())
+    assert before <= after, "existing rules must survive an unrelated update"
+    assert len(applied) == 1, "one batched apply per commit"
+    add, delete = applied[0]
+    assert not delete, "unrelated update must not delete installed rules"
+    assert add == after - before, "wire delta is exactly the new rules"
+    assert any(x.appns_index == NS[("prod", "web")] for x in after)
+
+    # removing the server pod deletes exactly its namespace's rules
+    txn3 = r.new_txn()
+    txn3.render(POD_SERVER, SERVER_IP, ingress=[], egress=[], removed=True)
+    txn3.commit()
+    final = set(eng.dump())
+    assert not any(x.appns_index == NS[POD_SERVER] for x in final)
+    assert any(x.appns_index == NS[("prod", "web")] for x in final)
+
+
+def test_renderer_resync_reconciles_stale_rules():
+    r, eng = make_renderer()
+    # stale rule left over from "before restart"
+    stale = SessionRule(scope=int(RuleScope.LOCAL), appns_index=42,
+                        transport_proto=6, lcl_net=0, lcl_plen=0,
+                        rmt_net=0, rmt_plen=0, lcl_port=0, rmt_port=0,
+                        action=int(RuleAction.DENY), tag="stale")
+    eng.apply(add=[stale])
+
+    txn = r.new_txn(resync=True)
+    txn.render(POD_SERVER, SERVER_IP, ingress=[], egress=[
+        ContivRule(action=Action.PERMIT, src_network=CLIENT_IP,
+                   protocol=Protocol.TCP, dest_port=80),
+        ContivRule(action=Action.DENY),
+    ])
+    txn.commit()
+    dump = eng.dump()
+    assert stale not in dump
+    assert any(x.appns_index == NS[POD_SERVER] for x in dump)
+
+
+def test_icmp_rules_skipped_any_proto_expands():
+    r, eng = make_renderer()
+    txn = r.new_txn()
+    txn.render(POD_SERVER, SERVER_IP, ingress=[], egress=[
+        ContivRule(action=Action.PERMIT, src_network=CLIENT_IP,
+                   protocol=Protocol.ANY),
+        ContivRule(action=Action.PERMIT, protocol=Protocol.ICMP),
+        ContivRule(action=Action.DENY),
+    ])
+    txn.commit()
+    protos = {x.transport_proto for x in eng.dump()
+              if x.appns_index == NS[POD_SERVER]}
+    assert protos == {6, 17}  # ANY → TCP+UDP; ICMP skipped at session layer

@@ -15,6 +15,7 @@ re-wires every interface/route — the reference's resync-from-ETCD path.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import threading
 from typing import Callable, List, Optional
@@ -69,6 +70,14 @@ class RemoteCNIServer:
                 self.dp.builder.add_route(
                     f"{cfg.ip}/32", if_idx, Disposition.LOCAL
                 )
+                if if_idx != cfg.if_index:
+                    # The fresh dataplane's slot allocator need not hand
+                    # back the pre-restart index; re-register so the
+                    # persisted config and the ifindex→pod axis (metric
+                    # labels) track the live interface.
+                    self.index.register(
+                        dataclasses.replace(cfg, if_index=if_idx)
+                    )
                 n += 1
             if n:
                 self.dp.swap()
@@ -86,8 +95,22 @@ class RemoteCNIServer:
             if existing is not None:
                 # idempotent re-Add (kubelet retries): answer as success
                 return self._reply_for(existing)
+            # Sandbox recreation: a new container ID for a pod we already
+            # wired. Tear the old container down first so the stale DEL
+            # kubelet sends later is a harmless no-op — otherwise old and
+            # new would share one interface and the late DEL would cut
+            # the live pod's connectivity.
+            stale = self.index.lookup_pod(req.pod_namespace, req.pod_name)
+            if stale is not None:
+                self.index.unregister(stale.container_id)
+                self.dp.builder.del_route(f"{stale.ip}/32")
+                self.dp.del_pod_interface((stale.pod_namespace, stale.pod_name))
+                self.ipam.release_pod_ip(
+                    f"{stale.pod_namespace}/{stale.pod_name}"
+                )
+            pod_id = f"{req.pod_namespace}/{req.pod_name}"
+            ip = None
             try:
-                pod_id = f"{req.pod_namespace}/{req.pod_name}"
                 ip = self.ipam.next_pod_ip(pod_id)
                 pod = (req.pod_namespace, req.pod_name)
                 if_idx = self.dp.add_pod_interface(pod)
@@ -107,6 +130,10 @@ class RemoteCNIServer:
                 self.index.register(cfg)
             except Exception as e:  # IPAM full, interface table full, ...
                 log.exception("CNI Add failed for %s", req.container_id)
+                if ip is not None:
+                    # half-configured: release the (persisted) allocation
+                    # or every kubelet retry leaks another pod IP
+                    self.ipam.release_pod_ip(pod_id)
                 return CNIReply(result=ResultCode.ERROR, error=str(e))
         self._notify()
         return self._reply_for(cfg)

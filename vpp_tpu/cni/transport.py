@@ -10,6 +10,7 @@ shim dependency-free.
 from __future__ import annotations
 
 import json
+import os
 import socket
 import socketserver
 import threading
@@ -42,6 +43,12 @@ class CNITransportServer:
             allow_reuse_address = True
 
         self.dispatch = dispatch
+        # SO_REUSEADDR is a no-op for AF_UNIX: a stale socket file from an
+        # unclean exit would make bind() fail forever. Unlink it first.
+        try:
+            os.unlink(socket_path)
+        except FileNotFoundError:
+            pass
         self._server = Server(socket_path, Handler)
         self._thread: Optional[threading.Thread] = None
 
@@ -68,4 +75,9 @@ def cni_call(socket_path: str, method: str, params: dict, timeout: float = 30.0)
             if not chunk:
                 break
             buf += chunk
-    return json.loads(buf)
+    try:
+        return json.loads(buf)
+    except ValueError as e:
+        # Connection dropped mid-reply: surface as the transport error it
+        # is, so the shim's OSError path emits a retryable CNI error.
+        raise ConnectionError(f"incomplete reply from agent: {e}") from e
