@@ -1,0 +1,174 @@
+"""Statscollector + Prometheus exposition tests.
+
+Reference model: plugins/statscollector/plugin_statscollector_test.go
+(mockPrometheus + mockContiv injection → assert gauge values and pod
+labels) and the KSR gauge surface (ksr_statscollector.go).
+"""
+
+import urllib.request
+
+import numpy as np
+
+from vpp_tpu.cni import ContainerIndex, RemoteCNIServer, ResultCode
+from vpp_tpu.cni.model import CNIRequest
+from vpp_tpu.ipam.ipam import IPAM
+from vpp_tpu.ksr.reflector import ReflectorRegistry, Reflector, MockK8sListWatch
+from vpp_tpu.kvstore.store import Broker, KVStore
+from vpp_tpu.pipeline.dataplane import Dataplane
+from vpp_tpu.pipeline.tables import DataplaneConfig
+from vpp_tpu.pipeline.vector import Disposition, make_packet_vector
+from vpp_tpu.stats import Gauge, MetricsRegistry, StatsCollector, StatsHTTPServer
+from vpp_tpu.stats.collector import STATS_PATH, register_ksr_gauges
+
+
+def wired_node():
+    dp = Dataplane(DataplaneConfig(sess_slots=256))
+    dp.add_uplink()
+    dp.add_host_interface()
+    ipam = IPAM(node_id=1)
+    index = ContainerIndex()
+    srv = RemoteCNIServer(dp, ipam, index)
+    srv.set_ready()
+    r1 = srv.add(CNIRequest(container_id="c1", extra_args={
+        "K8S_POD_NAME": "web", "K8S_POD_NAMESPACE": "prod"}))
+    r2 = srv.add(CNIRequest(container_id="c2", extra_args={
+        "K8S_POD_NAME": "db", "K8S_POD_NAMESPACE": "prod"}))
+    ip1 = r1.interfaces[0].ip_addresses[0].address.split("/")[0]
+    ip2 = r2.interfaces[0].ip_addresses[0].address.split("/")[0]
+    return dp, index, srv, ip1, ip2
+
+
+def test_collector_pod_labels_and_counts():
+    dp, index, srv, ip1, ip2 = wired_node()
+    coll = StatsCollector(dp, index)
+    if1 = dp.pod_if[("prod", "web")]
+    res = dp.process(make_packet_vector(
+        [dict(src=ip1, dst=ip2, proto=6, sport=1000 + i, dport=80,
+              len=100, rx_if=if1) for i in range(5)]
+    ))
+    assert int(res.stats.tx) == 5
+    coll.update(res.stats)
+    coll.publish()
+
+    g_in = coll.if_gauges["vpp_tpu_if_in_packets"]
+    g_out = coll.if_gauges["vpp_tpu_if_out_packets"]
+    g_bytes = coll.if_gauges["vpp_tpu_if_in_bytes"]
+    web = dict(podName="web", podNamespace="prod", interfaceName="eth0")
+    db = dict(podName="db", podNamespace="prod", interfaceName="eth0")
+    assert g_in.get(**web) == 5
+    assert g_bytes.get(**web) == 500
+    assert g_out.get(**db) == 5
+    assert coll.node_gauges["vpp_tpu_node_rx_packets"].get() == 5
+    assert coll.node_gauges["vpp_tpu_node_tx_packets"].get() == 5
+    # accumulation across frames
+    res2 = dp.process(make_packet_vector(
+        [dict(src=ip1, dst=ip2, proto=6, sport=2000, dport=80,
+              len=100, rx_if=if1)]
+    ))
+    coll.update(res2.stats)
+    coll.publish()
+    assert g_in.get(**web) == 6
+
+
+def test_collector_drop_attribution():
+    dp, index, srv, ip1, ip2 = wired_node()
+    coll = StatsCollector(dp, index)
+    if1 = dp.pod_if[("prod", "web")]
+    res = dp.process(make_packet_vector(
+        [dict(src=ip1, dst="203.0.113.9", proto=6, sport=1, dport=2,
+              rx_if=if1)]  # no route
+    ))
+    coll.update(res.stats)
+    coll.publish()
+    web = dict(podName="web", podNamespace="prod", interfaceName="eth0")
+    assert coll.if_gauges["vpp_tpu_if_drop_packets"].get(**web) == 1
+    assert coll.node_gauges["vpp_tpu_node_drop_no_route"].get() == 1
+
+
+def test_deleted_pod_gauges_removed():
+    dp, index, srv, ip1, ip2 = wired_node()
+    coll = StatsCollector(dp, index)
+    if1 = dp.pod_if[("prod", "web")]
+    res = dp.process(make_packet_vector(
+        [dict(src=ip1, dst=ip2, proto=6, sport=1, dport=80, rx_if=if1)]
+    ))
+    coll.update(res.stats)
+    coll.publish()
+    web = dict(podName="web", podNamespace="prod", interfaceName="eth0")
+    assert coll.if_gauges["vpp_tpu_if_in_packets"].get(**web) == 1
+
+    srv.delete(CNIRequest(container_id="c1"))
+    coll.publish()
+    assert coll.if_gauges["vpp_tpu_if_in_packets"].get(**web) == 0
+
+
+def test_http_exposition_roundtrip():
+    dp, index, srv, ip1, ip2 = wired_node()
+    coll = StatsCollector(dp, index)
+    if1 = dp.pod_if[("prod", "web")]
+    res = dp.process(make_packet_vector(
+        [dict(src=ip1, dst=ip2, proto=6, sport=1, dport=80, rx_if=if1)]
+    ))
+    coll.update(res.stats)
+    coll.publish()
+    server = StatsHTTPServer(coll.registry, port=0)
+    server.start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}{STATS_PATH}", timeout=10
+        ).read().decode()
+        assert 'vpp_tpu_if_in_packets{interfaceName="eth0",podName="web",podNamespace="prod"} 1' in body
+        assert "# TYPE vpp_tpu_node_rx_packets gauge" in body
+        # unknown path → 404
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/nope", timeout=10
+            )
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        server.close()
+
+
+def test_ksr_gauges():
+    store = KVStore()
+    watch = MockK8sListWatch()
+    registry = ReflectorRegistry()
+
+    class Obj:
+        def __init__(self, name):
+            self.name = name
+
+        def key(self):
+            return f"k8s/pod/{self.name}"
+
+        def to_dict(self):
+            return {"name": self.name}
+
+    refl = Reflector(
+        obj_type="pod",
+        broker=Broker(store, "ksr/"),
+        list_watch=watch,
+        converter=lambda o: Obj(o["name"]),
+    )
+    registry.add(refl)
+    refl.start()
+    watch.add("p1", {"name": "p1"})
+    watch.add("p2", {"name": "p2"})
+    watch.delete("p1")
+
+    mreg = MetricsRegistry()
+    gauges = register_ksr_gauges(mreg, registry)
+    gauges["_publish"]()
+    assert gauges["adds"].get(reflector="pod") == 2
+    assert gauges["deletes"].get(reflector="pod") == 1
+    body = mreg.render("/metrics")
+    assert 'vpp_tpu_ksr_adds{reflector="pod"} 2' in body
+
+
+def test_gauge_render_escaping():
+    g = Gauge("x", "help")
+    g.set(1, name='we"ird\\pod')
+    lines = g.render()
+    assert 'x{name="we\\"ird\\\\pod"} 1' in lines

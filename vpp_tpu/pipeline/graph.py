@@ -34,10 +34,12 @@ class StepStats(NamedTuple):
     drop_ip4: jnp.ndarray      # int32 scalar: ip4-input drops (TTL/len)
     drop_acl: jnp.ndarray      # int32 scalar: policy denies
     drop_no_route: jnp.ndarray  # int32 scalar: FIB misses
+    punt: jnp.ndarray          # int32 scalar: packets punted to host stack
     if_rx: jnp.ndarray         # int32 [I] per-interface rx packets
     if_tx: jnp.ndarray         # int32 [I] per-interface tx packets
     if_rx_bytes: jnp.ndarray   # int32 [I]
     if_tx_bytes: jnp.ndarray   # int32 [I]
+    if_drops: jnp.ndarray      # int32 [I] drops attributed to the rx if
 
 
 class StepResult(NamedTuple):
@@ -107,8 +109,12 @@ def pipeline_step(
     )
 
     # --- counters ---
+    dropped = (pkts.valid & (drop_ip4 | drop_acl | drop_no_route)) | (
+        alive & permit & fib.matched & (fib.disp == int(Disposition.DROP))
+    )
     rx_if_safe = jnp.where(alive, pkts.rx_if, n_ifaces)
     tx_if_safe = jnp.where(forwarded, tx_if, n_ifaces)
+    drop_if_safe = jnp.where(dropped, pkts.rx_if, n_ifaces)
     zero_i = jnp.zeros((n_ifaces,), jnp.int32)
     stats = StepStats(
         rx=jnp.sum(alive.astype(jnp.int32)),
@@ -116,6 +122,9 @@ def pipeline_step(
         drop_ip4=jnp.sum(drop_ip4.astype(jnp.int32)),
         drop_acl=jnp.sum(drop_acl.astype(jnp.int32)),
         drop_no_route=jnp.sum(drop_no_route.astype(jnp.int32)),
+        punt=jnp.sum(
+            (forwarded & (disp == int(Disposition.HOST))).astype(jnp.int32)
+        ),
         if_rx=zero_i.at[rx_if_safe].add(1, mode="drop"),
         if_tx=zero_i.at[tx_if_safe].add(1, mode="drop"),
         if_rx_bytes=zero_i.at[rx_if_safe].add(
@@ -124,6 +133,7 @@ def pipeline_step(
         if_tx_bytes=zero_i.at[tx_if_safe].add(
             jnp.where(forwarded, pkts.pkt_len, 0), mode="drop"
         ),
+        if_drops=zero_i.at[drop_if_safe].add(1, mode="drop"),
     )
     return StepResult(
         pkts=pkts,

@@ -1,0 +1,11 @@
+"""Observability: stats collection + Prometheus exposition.
+
+Reference analogs: plugins/statscollector (pod-labelled per-interface
+gauges at :9999/stats, plugin_impl_statscollector.go:20-90) and the KSR
+per-reflector gauges (plugins/ksr/ksr_statscollector.go:68-160).
+"""
+
+from vpp_tpu.stats.collector import StatsCollector
+from vpp_tpu.stats.prometheus import Gauge, MetricsRegistry, StatsHTTPServer
+
+__all__ = ["Gauge", "MetricsRegistry", "StatsCollector", "StatsHTTPServer"]

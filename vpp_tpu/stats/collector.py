@@ -1,0 +1,177 @@
+"""StatsCollector: pipeline counters → pod-labelled Prometheus gauges.
+
+Reference analog: plugins/statscollector — consumes interface stats,
+maps ifname→pod via contiv.API (here: the CNI ContainerIndex's
+ifindex→pod axis), and exposes 12 gauges under /stats
+(plugin_impl_statscollector.go:20-90, metric names :28-41). Interfaces
+without a pod (uplink, host) are labelled by interface role instead, and
+gauges for deleted pods are dropped like the reference's unregister path.
+
+Six per-interface gauges (in/out packets, in/out bytes, drops, punts*)
+plus six node-level ones (rx/tx totals, drop causes, active sessions).
+*punts are node-level in the pipeline (disposition HOST), surfaced on
+the host interface's row.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from vpp_tpu.cni.containeridx import ContainerIndex
+from vpp_tpu.pipeline.dataplane import Dataplane
+from vpp_tpu.pipeline.graph import StepStats
+from vpp_tpu.stats.prometheus import Gauge, MetricsRegistry
+
+STATS_PATH = "/stats"
+
+PER_IF_GAUGES = (
+    ("vpp_tpu_if_in_packets", "packets received on the interface"),
+    ("vpp_tpu_if_out_packets", "packets transmitted on the interface"),
+    ("vpp_tpu_if_in_bytes", "bytes received on the interface"),
+    ("vpp_tpu_if_out_bytes", "bytes transmitted on the interface"),
+    ("vpp_tpu_if_drop_packets", "packets dropped that arrived on the interface"),
+    ("vpp_tpu_if_punt_packets", "packets punted to the host stack"),
+)
+
+NODE_GAUGES = (
+    ("vpp_tpu_node_rx_packets", "total valid packets processed"),
+    ("vpp_tpu_node_tx_packets", "total packets forwarded"),
+    ("vpp_tpu_node_drop_ip4", "ip4-input drops (TTL/length/bad interface)"),
+    ("vpp_tpu_node_drop_acl", "policy (ACL) denies"),
+    ("vpp_tpu_node_drop_no_route", "FIB lookup misses"),
+    ("vpp_tpu_node_sessions_active", "live reflective-session entries"),
+)
+
+
+class StatsCollector:
+    def __init__(
+        self,
+        dataplane: Dataplane,
+        index: Optional[ContainerIndex] = None,
+        registry: Optional[MetricsRegistry] = None,
+    ):
+        self.dp = dataplane
+        self.index = index
+        self.registry = registry or MetricsRegistry()
+        self._lock = threading.Lock()
+        n_if = dataplane.config.max_ifaces
+        self._acc: Dict[str, np.ndarray] = {
+            "if_rx": np.zeros(n_if, np.int64),
+            "if_tx": np.zeros(n_if, np.int64),
+            "if_rx_bytes": np.zeros(n_if, np.int64),
+            "if_tx_bytes": np.zeros(n_if, np.int64),
+            "if_drops": np.zeros(n_if, np.int64),
+        }
+        self._totals: Dict[str, int] = {
+            k: 0 for k in ("rx", "tx", "drop_ip4", "drop_acl",
+                           "drop_no_route", "punt")
+        }
+        self.if_gauges = {
+            name: self.registry.register(STATS_PATH, Gauge(name, help_))
+            for name, help_ in PER_IF_GAUGES
+        }
+        self.node_gauges = {
+            name: self.registry.register(STATS_PATH, Gauge(name, help_))
+            for name, help_ in NODE_GAUGES
+        }
+        self._known_labels: Dict[int, Dict[str, str]] = {}
+
+    # --- ingestion (called after each processed frame) ---
+    def update(self, stats: StepStats) -> None:
+        with self._lock:
+            for k in self._acc:
+                self._acc[k] += np.asarray(getattr(stats, k), np.int64)
+            for k in self._totals:
+                self._totals[k] += int(getattr(stats, k))
+
+    # --- label resolution ---
+    def _labels_for(self, if_idx: int) -> Optional[Dict[str, str]]:
+        if self.index is not None:
+            cfg = self.index.lookup_if(if_idx)
+            if cfg is not None:
+                return {
+                    "podName": cfg.pod_name,
+                    "podNamespace": cfg.pod_namespace,
+                    "interfaceName": cfg.if_name,
+                }
+        pod = self.dp.if_pod.get(if_idx)
+        if pod is not None:
+            return {
+                "podName": pod[1], "podNamespace": pod[0],
+                "interfaceName": f"if{if_idx}",
+            }
+        if if_idx == self.dp.uplink_if:
+            return {"podName": "", "podNamespace": "",
+                    "interfaceName": "uplink"}
+        if if_idx == self.dp.host_if:
+            return {"podName": "", "podNamespace": "", "interfaceName": "host"}
+        return None
+
+    # --- publication (periodic, or before scrape) ---
+    def publish(self) -> None:
+        with self._lock:
+            acc = {k: v.copy() for k, v in self._acc.items()}
+            totals = dict(self._totals)
+        live: Dict[int, Dict[str, str]] = {}
+        for if_idx in range(acc["if_rx"].shape[0]):
+            labels = self._labels_for(if_idx)
+            if labels is None:
+                continue
+            live[if_idx] = labels
+            self.if_gauges["vpp_tpu_if_in_packets"].set(
+                int(acc["if_rx"][if_idx]), **labels)
+            self.if_gauges["vpp_tpu_if_out_packets"].set(
+                int(acc["if_tx"][if_idx]), **labels)
+            self.if_gauges["vpp_tpu_if_in_bytes"].set(
+                int(acc["if_rx_bytes"][if_idx]), **labels)
+            self.if_gauges["vpp_tpu_if_out_bytes"].set(
+                int(acc["if_tx_bytes"][if_idx]), **labels)
+            self.if_gauges["vpp_tpu_if_drop_packets"].set(
+                int(acc["if_drops"][if_idx]), **labels)
+            if if_idx == self.dp.host_if:
+                self.if_gauges["vpp_tpu_if_punt_packets"].set(
+                    totals["punt"], **labels)
+        # drop gauges of interfaces whose pod went away
+        for if_idx, labels in self._known_labels.items():
+            if if_idx not in live or live[if_idx] != labels:
+                for g in self.if_gauges.values():
+                    g.remove(**labels)
+        self._known_labels = live
+
+        self.node_gauges["vpp_tpu_node_rx_packets"].set(totals["rx"])
+        self.node_gauges["vpp_tpu_node_tx_packets"].set(totals["tx"])
+        self.node_gauges["vpp_tpu_node_drop_ip4"].set(totals["drop_ip4"])
+        self.node_gauges["vpp_tpu_node_drop_acl"].set(totals["drop_acl"])
+        self.node_gauges["vpp_tpu_node_drop_no_route"].set(totals["drop_no_route"])
+        if self.dp.tables is not None:
+            self.node_gauges["vpp_tpu_node_sessions_active"].set(
+                int(np.asarray(self.dp.tables.sess_valid).sum())
+            )
+
+
+def register_ksr_gauges(registry: MetricsRegistry, ksr_registry,
+                        path: str = "/metrics") -> Dict[str, Gauge]:
+    """KSR per-reflector gauges (ksr_statscollector.go:109-160): one gauge
+    per counter, labelled by reflector name. Call publish_ksr_gauges()
+    to refresh from the live reflector stats."""
+    gauges = {
+        name: registry.register(
+            path, Gauge(f"vpp_tpu_ksr_{name}", f"KSR reflector {name} count")
+        )
+        for name in (
+            "adds", "updates", "deletes", "resyncs",
+            "add_errors", "upd_errors", "del_errors", "arg_errors",
+        )
+    }
+
+    def publish():
+        for refl_name, stats in ksr_registry.stats().items():
+            for counter, value in stats.items():
+                if counter in gauges:
+                    gauges[counter].set(value, reflector=refl_name)
+
+    gauges["_publish"] = publish  # type: ignore
+    return gauges

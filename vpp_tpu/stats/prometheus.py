@@ -1,0 +1,133 @@
+"""Minimal Prometheus text-format exposition over stdlib HTTP.
+
+Reference analog: cn-infra's prometheus plugin serving the
+statscollector registry at :9999 (docs/Prometheus.md:1-26). No external
+client library: gauges render to text format 0.0.4 directly.
+"""
+
+from __future__ import annotations
+
+import http.server
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+LabelSet = Tuple[Tuple[str, str], ...]
+
+
+def _labels_key(labels: Dict[str, str]) -> LabelSet:
+    return tuple(sorted(labels.items()))
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+class Gauge:
+    """One metric family; holds a value per label set."""
+
+    def __init__(self, name: str, help_text: str = ""):
+        self.name = name
+        self.help = help_text
+        self._values: Dict[LabelSet, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, **labels: str) -> None:
+        with self._lock:
+            self._values[_labels_key(labels)] = value
+
+    def add(self, delta: float, **labels: str) -> None:
+        with self._lock:
+            k = _labels_key(labels)
+            self._values[k] = self._values.get(k, 0.0) + delta
+
+    def get(self, **labels: str) -> float:
+        with self._lock:
+            return self._values.get(_labels_key(labels), 0.0)
+
+    def remove(self, **labels: str) -> None:
+        with self._lock:
+            self._values.pop(_labels_key(labels), None)
+
+    def render(self) -> List[str]:
+        out = []
+        if self.help:
+            out.append(f"# HELP {self.name} {self.help}")
+        out.append(f"# TYPE {self.name} gauge")
+        with self._lock:
+            items = sorted(self._values.items())
+        for labels, value in items:
+            if labels:
+                lbl = ",".join(f'{k}="{_escape(v)}"' for k, v in labels)
+                out.append(f"{self.name}{{{lbl}}} {value:g}")
+            else:
+                out.append(f"{self.name} {value:g}")
+        return out
+
+
+class MetricsRegistry:
+    """Named path-scoped registries (the cn-infra ':9999/<path>' model)."""
+
+    def __init__(self):
+        self._gauges: Dict[str, List[Gauge]] = {}
+        self._lock = threading.Lock()
+
+    def register(self, path: str, gauge: Gauge) -> Gauge:
+        with self._lock:
+            self._gauges.setdefault(path, []).append(gauge)
+        return gauge
+
+    def paths(self) -> List[str]:
+        with self._lock:
+            return list(self._gauges)
+
+    def render(self, path: str) -> Optional[str]:
+        with self._lock:
+            gauges = list(self._gauges.get(path, ()))
+        if not gauges and path not in self.paths():
+            return None
+        lines: List[str] = []
+        for g in gauges:
+            lines.extend(g.render())
+        return "\n".join(lines) + "\n"
+
+
+class StatsHTTPServer:
+    """Serves every registry path ('/stats', '/metrics', ...) on one port."""
+
+    def __init__(self, registry: MetricsRegistry, port: int = 9999,
+                 host: str = "127.0.0.1"):
+        self.registry = registry
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = outer.registry.render(self.path)
+                if body is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                data = body.encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *args):  # quiet
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="stats-http"
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
