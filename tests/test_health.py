@@ -1,0 +1,210 @@
+"""Statuscheck + STN daemon/watchdog tests.
+
+Reference model: cn-infra statuscheck semantics (worst-of aggregation,
+probe transitions) and cmd/contiv-stn behavior (steal/release/info,
+restart persistence, watchdog reverting NICs after consecutive health
+failures — main.go:486-537).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from vpp_tpu.health import (
+    FakeNetlink,
+    PluginState,
+    STNDaemon,
+    StatusCheck,
+)
+from vpp_tpu.health.statuscheck import HealthHTTPServer
+from vpp_tpu.health.stn import Watchdog
+
+
+def test_statuscheck_aggregation_and_watchers():
+    sc = StatusCheck()
+    report_a = sc.register("ipam")
+    report_b = sc.register("policy")
+    assert sc.agent_state() == PluginState.INIT
+
+    transitions = []
+    sc.watch_state(lambda p, s: transitions.append((p, s)))
+
+    report_a(PluginState.OK)
+    report_b(PluginState.OK)
+    assert sc.agent_state() == PluginState.OK
+    report_b(PluginState.ERROR, "etcd down")
+    assert sc.agent_state() == PluginState.ERROR
+    assert sc.liveness()["alive"] is False
+    assert ("policy", PluginState.ERROR) in transitions
+    # repeated same-state report doesn't re-fire watchers
+    n = len(transitions)
+    report_b(PluginState.ERROR, "still down")
+    assert len(transitions) == n
+
+    report_b(PluginState.OK)
+    assert sc.liveness()["ready"] is True
+
+
+def test_statuscheck_probes():
+    sc = StatusCheck()
+    healthy = {"v": True}
+    sc.register_probe("datastore", lambda: healthy["v"])
+    sc.run_probes()
+    assert sc.agent_state() == PluginState.OK
+    healthy["v"] = False
+    sc.run_probes()
+    assert sc.agent_state() == PluginState.ERROR
+    st = sc.plugin_status()["datastore"]
+    assert st["state"] == "ERROR" and st["error"]
+
+    sc.register_probe("broken", lambda: 1 / 0)
+    sc.run_probes()
+    assert "probe raised" in sc.plugin_status()["broken"]["error"]
+
+
+def test_health_http_endpoints():
+    sc = StatusCheck()
+    rep = sc.register("core")
+    server = HealthHTTPServer(sc, port=0)
+    server.start()
+    try:
+        url = f"http://127.0.0.1:{server.port}"
+        # INIT: alive but not ready
+        body = json.loads(urllib.request.urlopen(f"{url}/liveness", timeout=10).read())
+        assert body["alive"] is True
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{url}/readiness", timeout=10)
+        assert e.value.code == 503
+
+        rep(PluginState.OK)
+        body = json.loads(urllib.request.urlopen(f"{url}/readiness", timeout=10).read())
+        assert body["ready"] is True
+
+        rep(PluginState.ERROR, "dead")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{url}/liveness", timeout=10)
+        assert e.value.code == 503
+    finally:
+        server.close()
+
+
+def nic_fixture():
+    nl = FakeNetlink()
+    nl.add_interface(
+        "eth1", pci="0000:00:08.0", driver="mlx5_core",
+        ips=["192.168.1.10/24"],
+        routes=[{"dst": "0.0.0.0/0", "gw": "192.168.1.1"}],
+    )
+    return nl
+
+
+def test_stn_steal_release_roundtrip(tmp_path):
+    nl = nic_fixture()
+    d = STNDaemon(nl, persist_path=str(tmp_path / "stn.json"))
+    info = d.steal("eth1")
+    assert info.ip_addresses == ["192.168.1.10/24"]
+    assert nl.state["eth1"]["bound"] is False
+    assert nl.state["eth1"]["ips"] == []
+    # idempotent steal returns recorded info
+    assert d.steal("eth1") == info
+    assert d.stolen_interface_info("eth1") == info
+
+    assert d.release("eth1") is True
+    assert nl.state["eth1"]["bound"] is True
+    assert nl.state["eth1"]["ips"] == ["192.168.1.10/24"]
+    assert nl.state["eth1"]["routes"] == [{"dst": "0.0.0.0/0", "gw": "192.168.1.1"}]
+    assert d.release("eth1") is False  # already released
+
+
+def test_stn_restart_persistence(tmp_path):
+    nl = nic_fixture()
+    path = str(tmp_path / "stn.json")
+    d = STNDaemon(nl, persist_path=path)
+    d.steal("eth1")
+
+    # daemon restart: new instance over same backend + persist file
+    d2 = STNDaemon(nl, persist_path=path)
+    info = d2.stolen_interface_info("eth1")
+    assert info is not None and info.ip_addresses == ["192.168.1.10/24"]
+    assert d2.release("eth1") is True
+    assert nl.state["eth1"]["ips"] == ["192.168.1.10/24"]
+
+
+def test_watchdog_reverts_after_grace_and_rearms():
+    nl = nic_fixture()
+    d = STNDaemon(nl)
+    d.steal("eth1")
+    healthy = {"v": True}
+    wd = Watchdog(d, probe=lambda: healthy["v"], grace_failures=3)
+
+    wd.tick()
+    assert d.stolen_interface_info("eth1") is not None
+
+    healthy["v"] = False
+    wd.tick(); wd.tick()
+    assert d.stolen_interface_info("eth1") is not None, "within grace"
+    wd.tick()
+    assert d.stolen_interface_info("eth1") is None, "reverted after grace"
+    assert nl.state["eth1"]["bound"] is True
+
+    # agent recovers and steals again; watchdog must re-arm
+    healthy["v"] = True
+    wd.tick()
+    d.steal("eth1")
+    healthy["v"] = False
+    for _ in range(3):
+        wd.tick()
+    assert d.stolen_interface_info("eth1") is None
+
+
+def test_watchdog_retries_failed_reverts():
+    """A rebind failure must not kill the watchdog; the NIC stays
+    tracked and the revert retries on later ticks."""
+    nl = nic_fixture()
+    d = STNDaemon(nl)
+    d.steal("eth1")
+    boom = {"v": True}
+    orig_rebind = nl.rebind
+
+    def flaky_rebind(iface):
+        if boom["v"]:
+            raise OSError("sysfs transient error")
+        orig_rebind(iface)
+
+    nl.rebind = flaky_rebind
+    wd = Watchdog(d, probe=lambda: False, grace_failures=1)
+    wd.tick()
+    assert d.stolen_interface_info("eth1") is not None, "still tracked"
+    assert wd.reverted is False
+    boom["v"] = False
+    wd.tick()
+    assert d.stolen_interface_info("eth1") is None
+    assert nl.state["eth1"]["bound"] is True
+
+
+def test_release_failure_keeps_nic_tracked():
+    nl = nic_fixture()
+    d = STNDaemon(nl)
+    d.steal("eth1")
+    orig = nl.rebind
+    nl.rebind = lambda iface: (_ for _ in ()).throw(OSError("busy"))
+    with pytest.raises(OSError):
+        d.release("eth1")
+    assert d.stolen_interface_info("eth1") is not None
+    nl.rebind = orig
+    assert d.release("eth1") is True
+
+
+def test_watchdog_probe_exception_counts_as_failure():
+    nl = nic_fixture()
+    d = STNDaemon(nl)
+    d.steal("eth1")
+
+    def probe():
+        raise ConnectionError("agent down")
+
+    wd = Watchdog(d, probe=probe, grace_failures=2)
+    wd.tick(); wd.tick()
+    assert d.stolen_interface_info("eth1") is None

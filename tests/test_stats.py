@@ -159,12 +159,53 @@ def test_ksr_gauges():
     watch.delete("p1")
 
     mreg = MetricsRegistry()
-    gauges = register_ksr_gauges(mreg, registry)
-    gauges["_publish"]()
+    gauges, publish_ksr = register_ksr_gauges(mreg, registry)
+    publish_ksr()
     assert gauges["adds"].get(reflector="pod") == 2
     assert gauges["deletes"].get(reflector="pod") == 1
     body = mreg.render("/metrics")
     assert 'vpp_tpu_ksr_adds{reflector="pod"} 2' in body
+
+
+def test_reused_interface_slot_starts_at_zero():
+    dp, index, srv, ip1, ip2 = wired_node()
+    coll = StatsCollector(dp, index)
+    if1 = dp.pod_if[("prod", "web")]
+    res = dp.process(make_packet_vector(
+        [dict(src=ip1, dst=ip2, proto=6, sport=1, dport=80, rx_if=if1)]
+    ))
+    coll.update(res.stats)
+    srv.delete(CNIRequest(container_id="c1"))
+    # new pod reuses the freed slot (LIFO allocator)
+    srv.add(CNIRequest(container_id="c3", extra_args={
+        "K8S_POD_NAME": "api", "K8S_POD_NAMESPACE": "prod"}))
+    assert dp.pod_if[("prod", "api")] == if1
+    coll.publish()
+    api = dict(podName="api", podNamespace="prod", interfaceName="eth0")
+    assert coll.if_gauges["vpp_tpu_if_in_packets"].get(**api) == 0
+
+
+def test_gauge_large_values_exact():
+    g = Gauge("big")
+    g.set(12345678)
+    assert "big 12345678" in g.render()
+    g2 = Gauge("frac")
+    g2.set(0.25)
+    assert "frac 0.25" in g2.render()
+
+
+def test_http_path_with_query_string():
+    reg = MetricsRegistry()
+    reg.register(STATS_PATH, Gauge("x")).set(1)
+    server = StatsHTTPServer(reg, port=0)
+    server.start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}{STATS_PATH}?ts=123", timeout=10
+        ).read().decode()
+        assert "x 1" in body
+    finally:
+        server.close()
 
 
 def test_gauge_render_escaping():

@@ -64,6 +64,10 @@ class Dataplane:
         # ACL table slot registry (renderer table id -> slot)
         self.table_slots: Dict[str, int] = {}
         self._free_slots = list(range(self.config.max_tables - 1, -1, -1))
+        # observers notified when a pod interface slot is freed (the
+        # statscollector zeroes its accumulators so a later pod reusing
+        # the slot doesn't inherit counters)
+        self.on_if_freed = []
 
     # --- interfaces ---
     def add_uplink(self) -> int:
@@ -102,7 +106,10 @@ class Dataplane:
             del self.if_pod[idx]
             self.builder.set_interface(idx, InterfaceType.NONE, local_table=-1)
             self._free_ifs.append(idx)
-            return True
+            observers = list(self.on_if_freed)
+        for cb in observers:
+            cb(idx)
+        return True
 
     # --- ACL table slots (used by the TPU renderer) ---
     def alloc_table_slot(self, table_id: str) -> int:

@@ -78,6 +78,15 @@ class StatsCollector:
             for name, help_ in NODE_GAUGES
         }
         self._known_labels: Dict[int, Dict[str, str]] = {}
+        self._publish_lock = threading.Lock()
+        # zero accumulators when an interface slot is freed, so a later
+        # pod reusing the slot doesn't inherit the old pod's counters
+        dataplane.on_if_freed.append(self.reset_interface)
+
+    def reset_interface(self, if_idx: int) -> None:
+        with self._lock:
+            for arr in self._acc.values():
+                arr[if_idx] = 0
 
     # --- ingestion (called after each processed frame) ---
     def update(self, stats: StepStats) -> None:
@@ -110,8 +119,12 @@ class StatsCollector:
             return {"podName": "", "podNamespace": "", "interfaceName": "host"}
         return None
 
-    # --- publication (periodic, or before scrape) ---
+    # --- publication (periodic, or before scrape; serialized) ---
     def publish(self) -> None:
+        with self._publish_lock:
+            self._publish_locked()
+
+    def _publish_locked(self) -> None:
         with self._lock:
             acc = {k: v.copy() for k, v in self._acc.items()}
             totals = dict(self._totals)
@@ -152,11 +165,12 @@ class StatsCollector:
             )
 
 
-def register_ksr_gauges(registry: MetricsRegistry, ksr_registry,
-                        path: str = "/metrics") -> Dict[str, Gauge]:
+def register_ksr_gauges(
+    registry: MetricsRegistry, ksr_registry, path: str = "/metrics"
+) -> Tuple[Dict[str, Gauge], callable]:
     """KSR per-reflector gauges (ksr_statscollector.go:109-160): one gauge
-    per counter, labelled by reflector name. Call publish_ksr_gauges()
-    to refresh from the live reflector stats."""
+    per counter, labelled by reflector name. Returns (gauges, publish);
+    call publish() to refresh from the live reflector stats."""
     gauges = {
         name: registry.register(
             path, Gauge(f"vpp_tpu_ksr_{name}", f"KSR reflector {name} count")
@@ -173,5 +187,4 @@ def register_ksr_gauges(registry: MetricsRegistry, ksr_registry,
                 if counter in gauges:
                     gauges[counter].set(value, reflector=refl_name)
 
-    gauges["_publish"] = publish  # type: ignore
-    return gauges
+    return gauges, publish

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import http.server
 import threading
+import urllib.parse
 from typing import Callable, Dict, List, Optional, Tuple
 
 LabelSet = Tuple[Tuple[str, str], ...]
@@ -56,11 +57,14 @@ class Gauge:
         with self._lock:
             items = sorted(self._values.items())
         for labels, value in items:
+            # exact formatting: ':g' would round counters >1e6 (byte
+            # counters get there in ~1000 packets)
+            sval = str(int(value)) if float(value).is_integer() else repr(float(value))
             if labels:
                 lbl = ",".join(f'{k}="{_escape(v)}"' for k, v in labels)
-                out.append(f"{self.name}{{{lbl}}} {value:g}")
+                out.append(f"{self.name}{{{lbl}}} {sval}")
             else:
-                out.append(f"{self.name} {value:g}")
+                out.append(f"{self.name} {sval}")
         return out
 
 
@@ -101,7 +105,8 @@ class StatsHTTPServer:
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):
-                body = outer.registry.render(self.path)
+                path = urllib.parse.urlsplit(self.path).path
+                body = outer.registry.render(path)
                 if body is None:
                     self.send_response(404)
                     self.end_headers()
