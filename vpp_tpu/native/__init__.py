@@ -1,0 +1,11 @@
+"""Native (C++) runtime components.
+
+The per-packet IO path between the NIC-facing process and the agent is
+native, like the reference's govpp shared-memory transport + VPP vlib
+frames (SURVEY.md §2.3) — Python only maps committed frames as numpy
+views and hands them to the jitted pipeline.
+"""
+
+from vpp_tpu.native.ring import FrameRing, RING_COLUMNS, build_library
+
+__all__ = ["FrameRing", "RING_COLUMNS", "build_library"]
