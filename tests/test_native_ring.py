@@ -107,20 +107,23 @@ def test_threaded_producer_consumer():
     N = 500
     seen = []
 
+    import time
+    deadline = time.monotonic() + 60
+
     def producer():
         i = 0
-        while i < N:
+        while i < N and time.monotonic() < deadline:
             if ring.push(make_cols(i % 256), n_packets=1, epoch=i):
                 i += 1
 
     def consumer():
-        while len(seen) < N:
+        while len(seen) < N and time.monotonic() < deadline:
             got = ring.pop()
             if got is not None:
                 seen.append(got[2])
 
-    t1 = threading.Thread(target=producer)
-    t2 = threading.Thread(target=consumer)
+    t1 = threading.Thread(target=producer, daemon=True)
+    t2 = threading.Thread(target=consumer, daemon=True)
     t1.start(); t2.start()
     t1.join(timeout=60); t2.join(timeout=60)
     assert seen == list(range(N)), "every frame exactly once, in order"

@@ -1,0 +1,374 @@
+"""ContivAgent: the vswitch-node process, all plugins wired.
+
+Reference analogs: flavors/contiv FlavorContiv.Inject
+(contiv_flavor.go:102-191 — the DI graph of ~20 plugins) and
+cmd/contiv-agent/main.go:28-49 (event loop + SIGTERM graceful close).
+
+Startup order mirrors the reference's Init/AfterInit phases (SURVEY.md
+§3.1): data store → node ID → IPAM → dataplane + renderers → policy/
+service plugins → CNI server → watchers subscribed → first resync →
+ready. The kvstore watch bridge is the cn-infra kvdbsync analog: KSR
+writes `k8s/<type>/...` keys; the bridge deserializes model objects and
+fans them out to the policy cache and service processor.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+from typing import Optional
+
+from vpp_tpu.agent import node_id as node_id_mod
+from vpp_tpu.agent.node_id import NodeIDAllocator
+from vpp_tpu.cni.containeridx import ContainerIndex
+from vpp_tpu.cni.server import RemoteCNIServer
+from vpp_tpu.cni.transport import CNITransportServer
+from vpp_tpu.cmd.config import AgentConfig
+from vpp_tpu.health.statuscheck import HealthHTTPServer, PluginState, StatusCheck
+from vpp_tpu.health.stn import STNDaemon
+from vpp_tpu.hoststack.session_rules import SessionRuleEngine
+from vpp_tpu.ipam.ipam import IPAM
+from vpp_tpu.ir.rule import PodID
+from vpp_tpu.ksr import model as m
+from vpp_tpu.kvstore.proxy import KVProxy
+from vpp_tpu.kvstore.store import Broker, KVEvent, KVStore, Op
+from vpp_tpu.pipeline.dataplane import Dataplane
+from vpp_tpu.pipeline.vector import Disposition
+from vpp_tpu.policy import PolicyCache, PolicyConfigurator, PolicyProcessor
+from vpp_tpu.renderer.tpu import TpuRenderer
+from vpp_tpu.renderer.vpptcp import VpptcpRenderer
+from vpp_tpu.service import ServiceConfigurator, ServiceProcessor
+from vpp_tpu.stats.collector import StatsCollector
+from vpp_tpu.stats.prometheus import StatsHTTPServer
+
+log = logging.getLogger("vpp_tpu.agent")
+
+# KSR publishes under this store prefix (the reference's
+# /vnf-agent/contiv-ksr/ microservice-label prefix,
+# flavors/contiv/contiv_flavor.go:129-138).
+KSR_PREFIX = "ksr/"
+
+
+def _ksr_key(ev_key: str) -> str:
+    """Strip the KSR store prefix off a watched key for parse_key()."""
+    return ev_key[len(KSR_PREFIX):] if ev_key.startswith(KSR_PREFIX) else ev_key
+
+
+class ContivAgent:
+    def __init__(self, config: Optional[AgentConfig] = None,
+                 store: Optional[KVStore] = None):
+        """``store`` injection lets tests (and multi-agent simulations)
+        share one in-memory store; production passes None and gets a
+        persisted local store (the ETCD-client analog)."""
+        self.config = config or AgentConfig()
+        c = self.config
+
+        # --- data store + proxy (cn-infra kvdbsync analog) ---
+        self.store = store or KVStore(persist_path=c.persist_path)
+        self.proxy = KVProxy(self.store)
+        self._watch_cancels = []
+
+        # --- statuscheck ---
+        self.statuscheck = StatusCheck()
+        self._report_core = self.statuscheck.register("core")
+        self._report_policy = self.statuscheck.register("policy")
+        self._report_service = self.statuscheck.register("service")
+
+        # --- node identity + IPAM ---
+        self.node_allocator = NodeIDAllocator(self.store, c.node_name)
+        self.node_id = self.node_allocator.get_or_allocate()
+        broker = Broker(self.store, f"agent/{c.node_name}/")
+        self.ipam = IPAM(self.node_id, c.ipam, broker=broker)
+
+        # --- data plane + renderers ---
+        self.dataplane = Dataplane(c.dataplane)
+        self.uplink_if = self.dataplane.add_uplink()
+        self.host_if = self.dataplane.add_host_interface()
+        self.dataplane.set_vtep(int(self.ipam.vxlan_ip_address()))
+        self.tpu_renderer = TpuRenderer(self.dataplane)
+        self.session_engine = SessionRuleEngine()
+        self.vpptcp_renderer = VpptcpRenderer(
+            self.session_engine, self._pod_ns_index
+        )
+
+        # --- policy plugin (cache → processor → configurator) ---
+        self.policy_cache = PolicyCache()
+        self.policy_configurator = PolicyConfigurator(self.policy_cache)
+        self.policy_configurator.register_renderer(self.tpu_renderer)
+        self.policy_configurator.register_renderer(self.vpptcp_renderer)
+        self.policy_processor = PolicyProcessor(
+            self.policy_cache, self.policy_configurator
+        )
+
+        # --- service plugin ---
+        self.service_configurator = ServiceConfigurator(
+            self.dataplane,
+            node_ips=[str(self.ipam.node_ip_address())],
+        )
+        self.service_processor = ServiceProcessor(
+            self.service_configurator, node_name=c.node_name
+        )
+
+        # --- CNI ---
+        self.container_index = ContainerIndex(broker)
+        self.cni_server = RemoteCNIServer(
+            self.dataplane, self.ipam, self.container_index,
+            on_pod_change=self._on_local_pod_change,
+        )
+        self.cni_transport: Optional[CNITransportServer] = None
+
+        # --- observability ---
+        self.stats = StatsCollector(self.dataplane, self.container_index)
+        self.stats_http: Optional[StatsHTTPServer] = None
+        self.health_http: Optional[HealthHTTPServer] = None
+
+        # --- STN bootstrap (contiv-init analog) ---
+        self.stn: Optional[STNDaemon] = None
+
+        # peers with installed routes: node_id -> peer vtep ip
+        self._peer_routes = {}
+        self._closed = threading.Event()
+
+    # --- contiv.API analogs ---
+    def _pod_ns_index(self, pod: PodID) -> int:
+        """GetNsIndex analog: a pod's app-namespace index is its
+        dataplane interface index (unique per pod on this node)."""
+        return self.dataplane.pod_if.get(pod, -1)
+
+    def _on_local_pod_change(self) -> None:
+        """A pod was wired/unwired by CNI: re-render policies (the
+        reference reacts to the ETCD echo; we shortcut in-process)."""
+        self.policy_processor.resync()
+
+    # --- lifecycle ---
+    def start(self, netlink_backend=None) -> None:
+        c = self.config
+        # STN bootstrap (contiv-init main.go:66-119): steal the
+        # configured NIC before bringing up the data plane's uplink path
+        if c.stn_interface and netlink_backend is not None:
+            self.stn = STNDaemon(
+                netlink_backend, persist_path=c.stn_persist_path
+            )
+            self.stn.steal(c.stn_interface)
+        # resync persisted pods before serving (restart path)
+        n = self.cni_server.resync()
+        if n:
+            log.info("resynced %d persisted pods", n)
+        self._subscribe_watchers()
+        # first resync: replay existing KSR state from the store through
+        # the same handlers — the watch bridge only sees future events,
+        # but KSR typically reflected pods/policies/services before this
+        # agent (re)started (the reference's startup resync, SURVEY §3.1)
+        self._resync_from_store()
+        # node events: learn peers that registered before we started
+        # (node_events.go resync), then publish our own IPs for them
+        for node_id, info in self.node_allocator.list_nodes().items():
+            self._apply_node(node_id, info)
+        self.node_allocator.publish_ips(
+            str(self.ipam.node_ip_address()),
+        )
+        self.cni_server.set_ready()
+        if c.serve_http:
+            self.cni_transport = CNITransportServer(
+                c.cni_socket, self.cni_server.dispatch
+            )
+            self.cni_transport.start()
+            self.stats_http = StatsHTTPServer(
+                self.stats.registry, port=c.stats_port, host=c.http_host
+            )
+            self.stats_http.start()
+            self.health_http = HealthHTTPServer(
+                self.statuscheck, port=c.health_port, host=c.http_host
+            )
+            self.health_http.start()
+        self._report_core(PluginState.OK)
+        self._report_policy(PluginState.OK)
+        self._report_service(PluginState.OK)
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        for cancel in self._watch_cancels:
+            cancel()
+        for srv in (self.cni_transport, self.stats_http, self.health_http):
+            if srv is not None:
+                srv.close()
+        self.proxy.close()
+        if self.stn is not None:
+            self.stn.revert_all()
+        if self.store.persist_path:
+            self.store.save()
+
+    # --- the kvdbsync watch bridge ---
+    def _subscribe_watchers(self) -> None:
+        sub = self.proxy.watch
+        self._watch_cancels = [
+            sub(KSR_PREFIX + m.key_prefix(m.Pod.TYPE), self._on_pod_event),
+            sub(KSR_PREFIX + m.key_prefix(m.Policy.TYPE), self._on_policy_event),
+            sub(KSR_PREFIX + m.key_prefix(m.Namespace.TYPE), self._on_namespace_event),
+            sub(KSR_PREFIX + m.key_prefix(m.Service.TYPE), self._on_service_event),
+            sub(KSR_PREFIX + m.key_prefix(m.Endpoints.TYPE), self._on_endpoints_event),
+            sub(node_id_mod.ID_PREFIX, self._on_node_event),
+        ]
+
+    def _resync_from_store(self) -> None:
+        handlers = {
+            m.Pod.TYPE: self._on_pod_event,
+            m.Namespace.TYPE: self._on_namespace_event,
+            m.Policy.TYPE: self._on_policy_event,
+            m.Service.TYPE: self._on_service_event,
+            m.Endpoints.TYPE: self._on_endpoints_event,
+        }
+        for obj_type, handler in handlers.items():
+            prefix = KSR_PREFIX + m.key_prefix(obj_type)
+            for key, value in self.store.list_values(prefix).items():
+                handler(KVEvent(op=Op.PUT, key=key, value=value,
+                                prev_value=None, rev=0))
+
+    # --- node events (plugins/contiv/node_events.go:34,184-252) ---
+    def _on_node_event(self, ev: KVEvent) -> None:
+        try:
+            node_id = int(ev.key[len(node_id_mod.ID_PREFIX):])
+        except ValueError:
+            return
+        if node_id == self.node_id:
+            return
+        if ev.op == Op.PUT:
+            self._apply_node(node_id, ev.value or {})
+        else:
+            self._remove_node(node_id)
+
+    def _apply_node(self, node_id: int, info: dict) -> None:
+        """Install routes to another node's pod + vpp/host subnets over
+        the uplink, vxlan-encapped toward its VTEP."""
+        if node_id == self.node_id or not isinstance(info, dict):
+            return
+        peer_vtep = int(self.ipam.vxlan_ip_address(node_id))
+        if self._peer_routes.get(node_id) == peer_vtep:
+            return  # already installed (IP update without vtep change)
+        with_hop = dict(
+            tx_if=self.uplink_if,
+            disposition=Disposition.REMOTE,
+            next_hop=peer_vtep,
+            node_id=node_id,
+        )
+        self.dataplane.builder.add_route(
+            str(self.ipam.other_node_pod_network(node_id)), **with_hop
+        )
+        self.dataplane.builder.add_route(
+            str(self.ipam.other_node_vpp_host_network(node_id)), **with_hop
+        )
+        self.dataplane.swap()
+        self._peer_routes[node_id] = peer_vtep
+        log.info("node %d added: routes via vtep %s", node_id, peer_vtep)
+
+    def _remove_node(self, node_id: int) -> None:
+        if self._peer_routes.pop(node_id, None) is None:
+            return
+        self.dataplane.builder.del_route(
+            str(self.ipam.other_node_pod_network(node_id))
+        )
+        self.dataplane.builder.del_route(
+            str(self.ipam.other_node_vpp_host_network(node_id))
+        )
+        self.dataplane.swap()
+        log.info("node %d removed", node_id)
+
+    def _on_pod_event(self, ev: KVEvent) -> None:
+        try:
+            if ev.op == Op.PUT:
+                self.policy_cache.update_pod(m.Pod.from_dict(ev.value))
+            else:
+                k = m.parse_key(_ksr_key(ev.key))
+                self.policy_cache.delete_pod(
+                    PodID(k.get("namespace", "default"), k["name"])
+                )
+        except Exception:
+            log.exception("pod event failed: %s", ev.key)
+            self._report_policy(PluginState.ERROR, f"pod event {ev.key}")
+
+    def _on_policy_event(self, ev: KVEvent) -> None:
+        try:
+            if ev.op == Op.PUT:
+                self.policy_cache.update_policy(m.Policy.from_dict(ev.value))
+            else:
+                k = m.parse_key(_ksr_key(ev.key))
+                self.policy_cache.delete_policy(
+                    k.get("namespace", "default"), k["name"]
+                )
+        except Exception:
+            log.exception("policy event failed: %s", ev.key)
+            self._report_policy(PluginState.ERROR, f"policy event {ev.key}")
+
+    def _on_namespace_event(self, ev: KVEvent) -> None:
+        try:
+            if ev.op == Op.PUT:
+                self.policy_cache.update_namespace(
+                    m.Namespace.from_dict(ev.value)
+                )
+            else:
+                k = m.parse_key(_ksr_key(ev.key))
+                self.policy_cache.delete_namespace(k["name"])
+        except Exception:
+            log.exception("namespace event failed: %s", ev.key)
+
+    def _on_service_event(self, ev: KVEvent) -> None:
+        try:
+            if ev.op == Op.PUT:
+                self.service_processor.update_service(
+                    m.Service.from_dict(ev.value)
+                )
+            else:
+                k = m.parse_key(_ksr_key(ev.key))
+                self.service_processor.delete_service(
+                    k.get("namespace", "default"), k["name"]
+                )
+        except Exception:
+            log.exception("service event failed: %s", ev.key)
+            self._report_service(PluginState.ERROR, f"service event {ev.key}")
+
+    def _on_endpoints_event(self, ev: KVEvent) -> None:
+        try:
+            if ev.op == Op.PUT:
+                self.service_processor.update_endpoints(
+                    m.Endpoints.from_dict(ev.value)
+                )
+            else:
+                k = m.parse_key(_ksr_key(ev.key))
+                self.service_processor.delete_endpoints(
+                    k.get("namespace", "default"), k["name"]
+                )
+        except Exception:
+            log.exception("endpoints event failed: %s", ev.key)
+            self._report_service(PluginState.ERROR, f"endpoints event {ev.key}")
+
+
+def main(argv=None) -> int:
+    """contiv-agent main: config flag, event loop, SIGTERM close."""
+    import argparse
+
+    from vpp_tpu.cmd.config import load_config
+
+    parser = argparse.ArgumentParser(prog="vpp-tpu-agent")
+    parser.add_argument("--config", default=None, help="agent YAML config")
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    agent = ContivAgent(load_config(args.config))
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    agent.start()
+    log.info("agent up: node %s id %d", agent.config.node_name, agent.node_id)
+    stop.wait()
+    log.info("shutting down")
+    agent.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

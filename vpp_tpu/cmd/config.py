@@ -1,0 +1,59 @@
+"""Agent configuration: the contiv.yaml analog.
+
+Reference: the contiv plugin Config struct + per-plugin YAML config
+flags (plugin_impl_contiv.go:87-118, 361-378) injected via ConfigMap
+(k8s/contiv-vpp.yaml:19-70). One YAML file configures the whole agent;
+every field has a sane default so an empty file boots a dev node.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from vpp_tpu.ipam.ipam import IpamConfig
+from vpp_tpu.pipeline.tables import DataplaneConfig
+
+
+@dataclasses.dataclass
+class AgentConfig:
+    node_name: str = "node-1"
+    # data store
+    persist_path: Optional[str] = None       # kvstore snapshot file
+    # CNI
+    cni_socket: str = "/run/vpp-tpu/cni.sock"
+    # observability / health
+    stats_port: int = 9999
+    health_port: int = 9191
+    http_host: str = "127.0.0.1"
+    serve_http: bool = True                  # False in unit tests
+    # STN bootstrap
+    stn_interface: str = ""                  # "" = no NIC stealing
+    stn_persist_path: Optional[str] = None
+    # device tables sizing
+    dataplane: DataplaneConfig = dataclasses.field(default_factory=DataplaneConfig)
+    # IPAM subnets
+    ipam: IpamConfig = dataclasses.field(default_factory=IpamConfig)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "AgentConfig":
+        d = dict(d or {})
+        if "dataplane" in d:
+            d["dataplane"] = DataplaneConfig(**d["dataplane"])
+        if "ipam" in d:
+            d["ipam"] = IpamConfig(**d["ipam"])
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown config keys: {sorted(unknown)}")
+        return cls(**d)
+
+
+def load_config(path: Optional[str]) -> AgentConfig:
+    if not path:
+        return AgentConfig()
+    import yaml
+
+    with open(path) as f:
+        data = yaml.safe_load(f)
+    return AgentConfig.from_dict(data)

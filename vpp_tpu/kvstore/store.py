@@ -55,6 +55,10 @@ class KVStore:
         if persist_path and os.path.exists(persist_path):
             self.load(persist_path)
 
+    @property
+    def persist_path(self) -> Optional[str]:
+        return self._persist_path
+
     # --- basic ops ---
     def get(self, key: str) -> Any:
         with self._lock:

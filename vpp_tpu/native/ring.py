@@ -56,10 +56,15 @@ def build_library(force: bool = False) -> str:
         # per-process tmp name: concurrent builds from separate processes
         # must not clobber each other's output mid-write
         tmp = f"{_LIB}.tmp.{os.getpid()}.so"
-        subprocess.run(
+        proc = subprocess.run(
             ["g++", "-std=c++17", "-O2", "-shared", "-fPIC", "-o", tmp, _SRC],
-            check=True, capture_output=True,
+            capture_output=True, text=True,
         )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"frame-ring build failed (g++ rc={proc.returncode}):\n"
+                f"{proc.stderr}"
+            )
         os.replace(tmp, _LIB)
         return _LIB
 
@@ -199,8 +204,10 @@ class FrameRing:
             ctypes.byref(n), ctypes.byref(epoch),
         )
         self.lib.fr_consume_release(self._base)
+        # flat is a fresh local array; views of it are already safe to
+        # hand out without a second copy
         cols = {
-            name: flat[i].view(dtype).copy()
+            name: flat[i].view(dtype)
             for i, (name, dtype) in enumerate(RING_COLUMNS)
         }
         return cols, int(n.value), int(epoch.value)
