@@ -151,6 +151,10 @@ class ContivAgent:
                 netlink_backend, persist_path=c.stn_persist_path
             )
             self.stn.steal(c.stn_interface)
+        # publish the base vswitch config (uplink/host interfaces staged
+        # in __init__) before anything can send through those interfaces
+        # — configureVswitchConnectivity's final txn in the reference
+        self.dataplane.swap()
         # resync persisted pods before serving (restart path)
         n = self.cni_server.resync()
         if n:
@@ -313,6 +317,7 @@ class ContivAgent:
                 self.policy_cache.delete_namespace(k["name"])
         except Exception:
             log.exception("namespace event failed: %s", ev.key)
+            self._report_policy(PluginState.ERROR, f"namespace event {ev.key}")
 
     def _on_service_event(self, ev: KVEvent) -> None:
         try:

@@ -38,10 +38,23 @@ class AgentConfig:
     @classmethod
     def from_dict(cls, d: dict) -> "AgentConfig":
         d = dict(d or {})
-        if "dataplane" in d:
-            d["dataplane"] = DataplaneConfig(**d["dataplane"])
-        if "ipam" in d:
-            d["ipam"] = IpamConfig(**d["ipam"])
+
+        def build_section(name: str, section_cls, fields) -> None:
+            if name not in d:
+                return
+            section = dict(d[name] or {})
+            unknown = set(section) - fields
+            if unknown:
+                raise ValueError(
+                    f"unknown config keys in '{name}': {sorted(unknown)}"
+                )
+            d[name] = section_cls(**section)
+
+        build_section("dataplane", DataplaneConfig, set(DataplaneConfig._fields))
+        build_section(
+            "ipam", IpamConfig,
+            {f.name for f in dataclasses.fields(IpamConfig)},
+        )
         known = {f.name for f in dataclasses.fields(cls)}
         unknown = set(d) - known
         if unknown:

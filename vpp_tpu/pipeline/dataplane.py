@@ -64,6 +64,9 @@ class Dataplane:
         # ACL table slot registry (renderer table id -> slot)
         self.table_slots: Dict[str, int] = {}
         self._free_slots = list(range(self.config.max_tables - 1, -1, -1))
+        # optional PacketTracer (vpp_tpu.trace); when set, every
+        # processed frame is offered to it (captures only while armed)
+        self.tracer = None
         # observers notified when a pod interface slot is freed (the
         # statscollector zeroes its accumulators so a later pod reusing
         # the slot doesn't inherit counters)
@@ -198,4 +201,7 @@ class Dataplane:
         with self._lock:
             if tables is self.tables:
                 self.tables = result.tables
+            tracer = self.tracer
+        if tracer is not None:
+            tracer.record(result)
         return result

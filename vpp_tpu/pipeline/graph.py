@@ -42,6 +42,22 @@ class StepStats(NamedTuple):
     if_drops: jnp.ndarray      # int32 [I] drops attributed to the rx if
 
 
+# Per-packet drop attribution (error-drop counter analog).
+DROP_NONE = 0
+DROP_IP4 = 1        # ip4-input: TTL/length/bad interface
+DROP_ACL = 2        # policy deny
+DROP_NO_ROUTE = 3   # FIB miss
+DROP_FIB = 4        # matched a drop route
+
+DROP_CAUSE_NAMES = {
+    DROP_NONE: "none",
+    DROP_IP4: "ip4-input",
+    DROP_ACL: "acl-deny",
+    DROP_NO_ROUTE: "no-route",
+    DROP_FIB: "fib-drop",
+}
+
+
 class StepResult(NamedTuple):
     pkts: PacketVector         # header fields after rewrites (TTL, NAT)
     disp: jnp.ndarray          # int32 [P] Disposition per packet
@@ -50,6 +66,9 @@ class StepResult(NamedTuple):
     next_hop: jnp.ndarray      # uint32 [P] peer IP for remote disposition
     tables: DataplaneTables    # tables with updated session state
     stats: StepStats
+    drop_cause: jnp.ndarray    # int32 [P] DROP_* attribution (0 = none)
+    established: jnp.ndarray   # bool [P] admitted via reflective session
+    dnat_applied: jnp.ndarray  # bool [P] DNAT rewrote the destination
 
 
 def pipeline_step(
@@ -109,9 +128,10 @@ def pipeline_step(
     )
 
     # --- counters ---
-    dropped = (pkts.valid & (drop_ip4 | drop_acl | drop_no_route)) | (
-        alive & permit & fib.matched & (fib.disp == int(Disposition.DROP))
+    fib_dropped = alive & permit & fib.matched & (
+        fib.disp == int(Disposition.DROP)
     )
+    dropped = (pkts.valid & (drop_ip4 | drop_acl | drop_no_route)) | fib_dropped
     rx_if_safe = jnp.where(alive, pkts.rx_if, n_ifaces)
     tx_if_safe = jnp.where(forwarded, tx_if, n_ifaces)
     drop_if_safe = jnp.where(dropped, pkts.rx_if, n_ifaces)
@@ -135,6 +155,12 @@ def pipeline_step(
         ),
         if_drops=zero_i.at[drop_if_safe].add(1, mode="drop"),
     )
+    drop_cause = (
+        jnp.where(pkts.valid & drop_ip4, DROP_IP4, 0)
+        + jnp.where(drop_acl, DROP_ACL, 0)
+        + jnp.where(drop_no_route, DROP_NO_ROUTE, 0)
+        + jnp.where(fib_dropped, DROP_FIB, 0)
+    ).astype(jnp.int32)
     return StepResult(
         pkts=pkts,
         disp=disp,
@@ -143,6 +169,9 @@ def pipeline_step(
         next_hop=jnp.where(forwarded, fib.next_hop, jnp.uint32(0)),
         tables=tables,
         stats=stats,
+        drop_cause=drop_cause,
+        established=established,
+        dnat_applied=dnat_applied,
     )
 
 

@@ -1,0 +1,17 @@
+"""Tracing & profiling.
+
+Reference analogs: the VPP packet tracer (`trace add <node> N` + `show
+trace`, docs/VPP_PACKET_TRACING_K8S.md:20-50) and per-graph-node cycle
+accounting (`show run` clocks/vector, :28-50).
+"""
+
+from vpp_tpu.trace.tracer import PacketTracer, TraceEntry
+from vpp_tpu.trace.cycles import StageTiming, profile_stages, format_show_run
+
+__all__ = [
+    "PacketTracer",
+    "StageTiming",
+    "TraceEntry",
+    "format_show_run",
+    "profile_stages",
+]

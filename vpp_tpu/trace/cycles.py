@@ -1,0 +1,98 @@
+"""Per-stage cycle accounting: the `show run` analog.
+
+Reference: VPP's `show run` prints per-graph-node calls, vectors and
+clocks/vector (docs/VPP_PACKET_TRACING_K8S.md:28-50). Under XLA the
+production pipeline is ONE fused computation, so per-stage costs are
+measured diagnostically: each stage is jitted and timed in isolation
+over the same frame. The sum exceeds the fused step's time (fusion is
+the point) — the per-stage numbers locate the expensive node, the fused
+number is the real cost. For hardware-level truth use
+``jax.profiler.trace`` (xplane) around ``Dataplane.process``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from vpp_tpu.ops.acl import acl_classify_global, acl_classify_local
+from vpp_tpu.ops.fib import ip4_lookup
+from vpp_tpu.ops.ip4 import ip4_input
+from vpp_tpu.ops.nat44 import nat44_dnat, nat44_reverse
+from vpp_tpu.ops.session import session_lookup_reverse
+from vpp_tpu.pipeline.graph import pipeline_step
+from vpp_tpu.pipeline.tables import DataplaneTables
+from vpp_tpu.pipeline.vector import PacketVector
+
+
+@dataclasses.dataclass
+class StageTiming:
+    node: str
+    calls: int
+    vectors: int          # packets per call
+    seconds_per_call: float
+
+    @property
+    def ns_per_packet(self) -> float:
+        if self.vectors == 0:
+            return 0.0
+        return self.seconds_per_call / self.vectors * 1e9
+
+
+def _time(fn: Callable, iters: int) -> float:
+    out = fn()
+    jax.block_until_ready(out)  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def profile_stages(
+    tables: DataplaneTables,
+    pkts: PacketVector,
+    now=None,
+    iters: int = 20,
+) -> List[StageTiming]:
+    """Time each pipeline stage in isolation + the fused step."""
+    now = jnp.int32(1) if now is None else now
+    n = int(pkts.src_ip.shape[0])
+    alive = pkts.valid
+
+    stages: Dict[str, Callable] = {
+        "ip4-input": jax.jit(lambda: ip4_input(pkts)),
+        "session-lookup": jax.jit(lambda: session_lookup_reverse(tables, pkts)),
+        "nat44-reverse": jax.jit(lambda: nat44_reverse(tables, pkts, alive)),
+        "nat44-dnat": jax.jit(lambda: nat44_dnat(tables, pkts, alive)),
+        "acl-classify-local": jax.jit(lambda: acl_classify_local(tables, pkts)),
+        "acl-classify-global": jax.jit(lambda: acl_classify_global(tables, pkts)),
+        "ip4-lookup": jax.jit(lambda: ip4_lookup(tables, pkts.dst_ip)),
+        "FUSED pipeline-step": jax.jit(lambda: pipeline_step(tables, pkts, now)),
+    }
+    out = []
+    for name, fn in stages.items():
+        sec = _time(fn, iters)
+        out.append(StageTiming(
+            node=name, calls=iters, vectors=n, seconds_per_call=sec,
+        ))
+    return out
+
+
+def format_show_run(timings: List[StageTiming]) -> str:
+    """`show run`-style table."""
+    header = (
+        f"{'Node':<24}{'Calls':>8}{'Vectors':>10}"
+        f"{'us/call':>12}{'ns/packet':>12}"
+    )
+    lines = [header, "-" * len(header)]
+    for t in timings:
+        lines.append(
+            f"{t.node:<24}{t.calls:>8}{t.vectors:>10}"
+            f"{t.seconds_per_call * 1e6:>12.2f}{t.ns_per_packet:>12.2f}"
+        )
+    return "\n".join(lines)

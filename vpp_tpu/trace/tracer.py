@@ -1,0 +1,167 @@
+"""PacketTracer: a bounded ring of sampled per-packet pipeline paths.
+
+Reference analog: VPP's packet tracer — `trace add dpdk-input 50`
+captures the next 50 packets with their node-by-node path; `show trace`
+prints them (docs/VPP_PACKET_TRACING_K8S.md:20-50). Here the "path" is
+reconstructed from the fused step's per-packet outputs (drop cause,
+session/DNAT flags, disposition), so arming the tracer costs nothing on
+the device: tracing reads back arrays the step already produced.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import deque
+from typing import Deque, List, Optional
+
+import numpy as np
+
+from vpp_tpu.pipeline.graph import (
+    DROP_CAUSE_NAMES,
+    DROP_NONE,
+    StepResult,
+)
+from vpp_tpu.pipeline.vector import Disposition, ip4_str
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceEntry:
+    frame_seq: int
+    slot: int              # packet lane within the frame
+    src: str
+    dst: str
+    proto: int
+    sport: int
+    dport: int
+    rx_if: int
+    path: tuple            # node names the packet visited
+    disposition: str
+    tx_if: int
+    drop_cause: str
+
+    def format(self) -> str:
+        l4 = f"{self.sport}->{self.dport}" if self.proto in (6, 17) else ""
+        lines = [
+            f"Packet (frame {self.frame_seq}, slot {self.slot}): "
+            f"proto {self.proto} {self.src} -> {self.dst} {l4}".rstrip(),
+        ]
+        for node in self.path:
+            lines.append(f"  {node}")
+        return "\n".join(lines)
+
+
+class PacketTracer:
+    """Arm with ``add(count)``; feed every processed frame to
+    ``record``; read back with ``entries()`` / ``format_trace()``."""
+
+    def __init__(self, max_entries: int = 512):
+        self.max_entries = max_entries
+        self._buf: Deque[TraceEntry] = deque(maxlen=max_entries)
+        self._armed = 0
+        self._frame_seq = 0
+        self._lock = threading.Lock()
+
+    def add(self, count: int = 50) -> None:
+        """Capture the next ``count`` valid packets (VPP `trace add`)."""
+        with self._lock:
+            self._armed = min(count, self.max_entries)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+            self._armed = 0
+
+    @property
+    def armed(self) -> int:
+        return self._armed
+
+    def record(self, result: StepResult) -> int:
+        """Sample packets from a processed frame while armed. Returns
+        how many packets were captured from this frame."""
+        with self._lock:
+            if self._armed <= 0:
+                self._frame_seq += 1
+                return 0
+            seq = self._frame_seq
+            self._frame_seq += 1
+        pkts = result.pkts
+        valid = np.asarray(pkts.valid)
+        idxs = np.nonzero(valid)[0]
+        if idxs.size == 0:
+            return 0
+        disp = np.asarray(result.disp)
+        tx_if = np.asarray(result.tx_if)
+        node_id = np.asarray(result.node_id)
+        cause = np.asarray(result.drop_cause)
+        established = np.asarray(result.established)
+        dnat = np.asarray(result.dnat_applied)
+        src = np.asarray(pkts.src_ip)
+        dst = np.asarray(pkts.dst_ip)
+        proto = np.asarray(pkts.proto)
+        sport = np.asarray(pkts.sport)
+        dport = np.asarray(pkts.dport)
+        rx_if = np.asarray(pkts.rx_if)
+
+        captured = 0
+        with self._lock:
+            for i in idxs:
+                if self._armed <= 0:
+                    break
+                i = int(i)
+                path: List[str] = ["ip4-input"]
+                c = int(cause[i])
+                d = int(disp[i])
+                if c == 1:  # DROP_IP4
+                    path.append("error-drop (ip4-input)")
+                else:
+                    if established[i]:
+                        path.append("session-lookup (established)")
+                    if dnat[i]:
+                        path.append("nat44-dnat")
+                    path.append("acl-classify")
+                    if c == 2:
+                        path.append("error-drop (acl-deny)")
+                    else:
+                        path.append("ip4-lookup")
+                        if c == 3:
+                            path.append("error-drop (no-route)")
+                        elif c == 4:
+                            path.append("error-drop (fib-drop)")
+                        elif d == int(Disposition.REMOTE):
+                            path.append("vxlan/ici-encap")
+                            path.append("interface-output (uplink)")
+                        elif d == int(Disposition.HOST):
+                            path.append("host-punt")
+                        else:
+                            path.append(
+                                f"interface-output (if {int(tx_if[i])})"
+                            )
+                self._buf.append(TraceEntry(
+                    frame_seq=seq,
+                    slot=i,
+                    src=ip4_str(int(src[i])),
+                    dst=ip4_str(int(dst[i])),
+                    proto=int(proto[i]),
+                    sport=int(sport[i]),
+                    dport=int(dport[i]),
+                    rx_if=int(rx_if[i]),
+                    path=tuple(path),
+                    disposition=Disposition(d).name,
+                    tx_if=int(tx_if[i]),
+                    drop_cause=DROP_CAUSE_NAMES.get(c, str(c)),
+                ))
+                self._armed -= 1
+                captured += 1
+        return captured
+
+    def entries(self) -> List[TraceEntry]:
+        with self._lock:
+            return list(self._buf)
+
+    def format_trace(self) -> str:
+        """`show trace` analog."""
+        entries = self.entries()
+        if not entries:
+            return "No packets in trace buffer"
+        return "\n------\n".join(e.format() for e in entries)
