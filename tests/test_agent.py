@@ -275,6 +275,25 @@ def test_config_yaml_roundtrip(tmp_path):
         load_config(str(bad))
 
 
+def test_maintenance_tick_ages_sessions_and_publishes():
+    store, ksr, agent = boot()
+    ip1 = add_pod(agent, "c1", "p1")
+    ip2 = add_pod(agent, "c2", "p2")
+    disp, res = send(agent, ("default", "p1"), ip1, ip2, 80)
+    assert disp == Disposition.LOCAL
+    import numpy as np
+    assert int(np.asarray(agent.dataplane.tables.sess_valid).sum()) == 1
+    agent.stats.update(res.stats)
+
+    agent.session_max_age = 0  # everything idle > 0 frames expires
+    agent.dataplane._now += 5
+    agent.maintenance_tick()
+    assert int(np.asarray(agent.dataplane.tables.sess_valid).sum()) == 0
+    assert agent.stats.node_gauges["vpp_tpu_node_rx_packets"].get() == 1
+    assert agent.statuscheck.liveness()["ready"] is True
+    agent.close()
+
+
 def test_close_is_idempotent_and_stops_watches():
     store, ksr, agent = boot()
     agent.close()

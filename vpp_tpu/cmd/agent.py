@@ -129,6 +129,10 @@ class ContivAgent:
         # peers with installed routes: node_id -> peer vtep ip
         self._peer_routes = {}
         self._closed = threading.Event()
+        self._maint_thread: Optional[threading.Thread] = None
+        # sessions idle longer than this many processed frames expire
+        # (the VPP session/NAT timer analog, driven by the host loop)
+        self.session_max_age = 1 << 16
 
     # --- contiv.API analogs ---
     def _pod_ns_index(self, pod: PodID) -> int:
@@ -189,6 +193,33 @@ class ContivAgent:
         self._report_core(PluginState.OK)
         self._report_policy(PluginState.OK)
         self._report_service(PluginState.OK)
+        if c.serve_http:
+            self._maint_thread = threading.Thread(
+                target=self._maintenance_loop, daemon=True,
+                name="agent-maintenance",
+            )
+            self._maint_thread.start()
+
+    def maintenance_tick(self) -> None:
+        """One round of periodic upkeep: age sessions, publish stats,
+        poll health probes. Called by the background loop; callable
+        directly in tests."""
+        try:
+            self.dataplane.expire_sessions(self.session_max_age)
+        except Exception:
+            log.exception("session expiry failed")
+        try:
+            self.stats.publish()
+        except Exception:
+            log.exception("stats publish failed")
+        try:
+            self.statuscheck.run_probes()
+        except Exception:
+            log.exception("probe round failed")
+
+    def _maintenance_loop(self, interval: float = 5.0) -> None:
+        while not self._closed.wait(interval):
+            self.maintenance_tick()
 
     def close(self) -> None:
         if self._closed.is_set():

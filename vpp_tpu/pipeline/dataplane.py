@@ -181,6 +181,24 @@ class Dataplane:
         mask = result.disp == int(Disposition.REMOTE)
         return self._encap(result.pkts, mask, vtep, result.next_hop)
 
+    # --- session aging (host loop; reference: VPP session/NAT timers) ---
+    def expire_sessions(self, max_age: int) -> int:
+        """Invalidate reflective + NAT sessions idle for more than
+        ``max_age`` frames. Returns the number of sessions expired."""
+        from vpp_tpu.ops.session import session_expire
+
+        with self._lock:
+            if self.tables is None:
+                return 0
+            before = self.tables
+            after = session_expire(before, self._now, max_age)
+            self.tables = after
+        expired = int(
+            jnp.sum(before.sess_valid - after.sess_valid)
+            + jnp.sum(before.natsess_valid - after.natsess_valid)
+        )
+        return expired
+
     # --- traffic ---
     def process(self, pkts: PacketVector, now: Optional[int] = None) -> StepResult:
         with self._lock:
