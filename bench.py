@@ -119,6 +119,131 @@ def build_traffic(n_pkts: int, uplink: int, seed: int = 7):
     )
 
 
+def build_fwd_dataplane():
+    """BASELINE config #1: pod-to-pod ip4-lookup only (no policy/NAT)."""
+    from vpp_tpu.pipeline.dataplane import Dataplane
+    from vpp_tpu.pipeline.tables import DataplaneConfig
+    from vpp_tpu.pipeline.vector import Disposition
+
+    config = DataplaneConfig(
+        max_tables=2, max_rules=16, max_global_rules=16, max_ifaces=64,
+        fib_slots=64, sess_slots=1 << 12, nat_mappings=1, nat_backends=1,
+    )
+    dp = Dataplane(config)
+    for i in range(32):
+        idx = dp.add_pod_interface(("default", f"p{i}"))
+        dp.builder.add_route(f"10.1.1.{i + 2}/32", idx, Disposition.LOCAL)
+    dp.swap()
+    return dp
+
+
+def build_pod_traffic(n_pkts: int, seed: int = 3):
+    import jax.numpy as jnp
+
+    from vpp_tpu.pipeline.vector import FLAG_VALID, PacketVector, ip4
+
+    rng = np.random.default_rng(seed)
+    src = (ip4("10.1.1.0") + rng.integers(2, 34, n_pkts)).astype(np.uint32)
+    dst = (ip4("10.1.1.0") + rng.integers(2, 34, n_pkts)).astype(np.uint32)
+    return PacketVector(
+        src_ip=jnp.asarray(src),
+        dst_ip=jnp.asarray(dst),
+        proto=jnp.full((n_pkts,), 17, jnp.int32),
+        sport=jnp.asarray(rng.integers(1024, 65535, n_pkts).astype(np.int32)),
+        dport=jnp.full((n_pkts,), 5201, jnp.int32),
+        ttl=jnp.full((n_pkts,), 64, jnp.int32),
+        pkt_len=jnp.full((n_pkts,), 1400, jnp.int32),
+        rx_if=jnp.asarray(rng.integers(1, 33, n_pkts).astype(np.int32)),
+        flags=jnp.full((n_pkts,), FLAG_VALID, jnp.int32),
+    )
+
+
+def measure_mpps(step, tables, pkts, iters, warmup, now0=1):
+    import jax
+    import jax.numpy as jnp
+
+    n = int(pkts.src_ip.shape[0])
+    for i in range(warmup):
+        res = step(tables, pkts, jnp.int32(now0 + i))
+        tables = res.tables
+    jax.block_until_ready(tables)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        res = step(tables, pkts, jnp.int32(now0 + warmup + i))
+        tables = res.tables
+    jax.block_until_ready(res)
+    return n * iters / (time.perf_counter() - t0) / 1e6, res.tables
+
+
+def sub_benches(args):
+    """BASELINE configs #1/#3/#4 as secondary metrics."""
+    import jax
+    import jax.numpy as jnp
+
+    from vpp_tpu.pipeline.graph import pipeline_step
+    from vpp_tpu.pipeline.vector import ip4
+
+    out = {}
+    step = jax.jit(pipeline_step, donate_argnums=(0,))
+
+    # #1 pod-to-pod forwarding (iperf analog)
+    dp = build_fwd_dataplane()
+    mpps, _ = measure_mpps(
+        step, dp.tables, build_pod_traffic(args.packets), args.iters, args.warmup
+    )
+    out["pod_to_pod_fwd_mpps"] = round(mpps, 1)
+
+    # #3 NAT44 100-backend LB: all traffic through the VIP
+    dp, uplink = build_dataplane(16, args.backends)
+    pkts = build_traffic(args.packets, uplink, seed=5)
+    pkts = pkts._replace(
+        dst_ip=jnp.full_like(pkts.dst_ip, ip4("10.96.0.10")),
+        dport=jnp.full_like(pkts.dport, 80),
+    )
+    mpps, _ = measure_mpps(step, dp.tables, pkts, args.iters, args.warmup)
+    out["nat44_vip_lb_mpps"] = round(mpps, 1)
+
+    # #4 VXLAN overlay: remote-disposed traffic + encap kernel
+    from vpp_tpu.ops.vxlan import vxlan_encap
+    from vpp_tpu.pipeline.vector import Disposition
+
+    dp, uplink = build_dataplane(16, 1)
+    dp.builder.add_route(
+        "10.2.0.0/16", uplink, Disposition.REMOTE,
+        next_hop=ip4("192.168.16.2"), node_id=2,
+    )
+    dp.swap()
+    pkts = build_traffic(args.packets, uplink, seed=9)
+    pkts = pkts._replace(
+        dst_ip=(ip4("10.2.0.0") + np.random.default_rng(4).integers(
+            2, 1 << 15, args.packets)).astype(np.uint32)
+    )
+    vtep = jnp.uint32(ip4("192.168.16.1"))
+    encap = jax.jit(vxlan_encap)
+
+    # Two jits, like the deployment shape (Dataplane.process +
+    # encap_remote). Note: fusing encap INTO the step jit measured ~140x
+    # slower on v5e (XLA scheduling pathology) — keep them separate.
+    tables = dp.tables
+    n = int(pkts.src_ip.shape[0])
+    for i in range(args.warmup):
+        res = step(tables, pkts, jnp.int32(1 + i))
+        outer = encap(res.pkts, res.disp == int(Disposition.REMOTE),
+                      vtep, res.next_hop)
+        tables = res.tables
+    jax.block_until_ready(outer)
+    t0 = time.perf_counter()
+    for i in range(args.iters):
+        res = step(tables, pkts, jnp.int32(100 + i))
+        outer = encap(res.pkts, res.disp == int(Disposition.REMOTE),
+                      vtep, res.next_hop)
+        tables = res.tables
+    jax.block_until_ready(outer)
+    mpps = n * args.iters / (time.perf_counter() - t0) / 1e6
+    out["vxlan_overlay_encap_mpps"] = round(mpps, 1)
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rules", type=int, default=10240)
@@ -130,6 +255,8 @@ def main():
     ap.add_argument("--latency-frame", type=int, default=256,
                     help="frame size for the added-latency measurement")
     ap.add_argument("--cpu", action="store_true", help="force CPU (debug)")
+    ap.add_argument("--no-subbench", action="store_true",
+                    help="skip the secondary BASELINE configs (#1/#3/#4)")
     args = ap.parse_args()
 
     if args.cpu:
@@ -176,6 +303,19 @@ def main():
         tables = out.tables
     lat_us = np.array(lat) * 1e6
 
+    # steady-state (pipelined) per-frame latency: dispatch K frames
+    # back-to-back without host sync — the per-frame cost once dispatch
+    # overlaps execution, the deployment regime of a streaming data plane
+    K = 64
+    t0 = time.perf_counter()
+    for i in range(K):
+        out = step(tables, frame, jnp.int32(2000 + i))
+        tables = out.tables
+    jax.block_until_ready(out.disp)
+    pipelined_us = (time.perf_counter() - t0) / K * 1e6
+
+    subs = {} if args.no_subbench else sub_benches(args)
+
     baseline_mpps = 40.0  # BASELINE.json north star, TPU v5e
     print(
         json.dumps(
@@ -190,8 +330,13 @@ def main():
                     "nat_backends": args.backends,
                     "frame_latency_p50_us": round(float(np.percentile(lat_us, 50)), 1),
                     "frame_latency_p99_us": round(float(np.percentile(lat_us, 99)), 1),
+                    "frame_latency_pipelined_us": round(pipelined_us, 1),
+                    "per_packet_added_latency_us": round(
+                        pipelined_us / args.latency_frame, 3
+                    ),
                     "latency_frame": args.latency_frame,
                     "backend": jax.default_backend(),
+                    **subs,
                 },
             }
         )
