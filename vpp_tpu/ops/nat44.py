@@ -154,21 +154,22 @@ def nat44_reverse(
         pkts.proto,
     )
     h = _hash(*key_vals, n_slots)
-    found = jnp.zeros(pkts.src_ip.shape, dtype=bool)
-    orig_ip = jnp.zeros_like(pkts.src_ip)
-    orig_port = jnp.zeros_like(pkts.sport)
-    for p in range(probes):
-        idx = (h + p) & (n_slots - 1)
-        slot_ok = tables.natsess_valid[idx] == 1
-        for arr, val in zip(
-            (tables.natsess_a, tables.natsess_b, tables.natsess_ports, tables.natsess_proto),
-            key_vals,
-        ):
-            slot_ok = slot_ok & (arr[idx] == val)
-        first_hit = slot_ok & ~found
-        orig_ip = jnp.where(first_hit, tables.natsess_orig_ip[idx], orig_ip)
-        orig_port = jnp.where(first_hit, tables.natsess_orig_port[idx], orig_port)
-        found = found | slot_ok
+    # Vectorized probe window: one [P, probes] gather per array, then a
+    # first-hit argmax — replaces `probes` sequential dependent gathers.
+    idx = (h[:, None] + jnp.arange(probes, dtype=jnp.int32)[None, :]) & (
+        n_slots - 1
+    )
+    slot_ok = tables.natsess_valid[idx] == 1
+    for arr, val in zip(
+        (tables.natsess_a, tables.natsess_b, tables.natsess_ports, tables.natsess_proto),
+        key_vals,
+    ):
+        slot_ok = slot_ok & (arr[idx] == val[:, None])
+    found = jnp.any(slot_ok, axis=1)
+    first = jnp.argmax(slot_ok, axis=1)
+    hit_idx = jnp.take_along_axis(idx, first[:, None], axis=1)[:, 0]
+    orig_ip = jnp.where(found, tables.natsess_orig_ip[hit_idx], 0)
+    orig_port = jnp.where(found, tables.natsess_orig_port[hit_idx], 0)
     applied = found & eligible
     out = pkts._replace(
         src_ip=jnp.where(applied, orig_ip, pkts.src_ip),

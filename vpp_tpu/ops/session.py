@@ -62,18 +62,20 @@ def session_lookup_reverse(tables: DataplaneTables, pkts: PacketVector) -> jnp.n
     key_ports = _pack_ports(pkts.dport, pkts.sport)
     key_proto = pkts.proto
     h = _hash(key_src, key_dst, key_ports, key_proto, n_slots)
-    hit = jnp.zeros(pkts.src_ip.shape, dtype=bool)
-    for p in range(probes):
-        idx = (h + p) & (n_slots - 1)
-        slot_match = (
-            (tables.sess_valid[idx] == 1)
-            & (tables.sess_src[idx] == key_src)
-            & (tables.sess_dst[idx] == key_dst)
-            & (tables.sess_ports[idx] == key_ports)
-            & (tables.sess_proto[idx] == key_proto)
-        )
-        hit = hit | slot_match
-    return hit
+    # One [P, probes] gather per array instead of `probes` sequential
+    # gathers — no cross-probe dependency, so the TPU vectorizes the
+    # whole probe window at once.
+    idx = (h[:, None] + jnp.arange(probes, dtype=jnp.int32)[None, :]) & (
+        n_slots - 1
+    )
+    slot_match = (
+        (tables.sess_valid[idx] == 1)
+        & (tables.sess_src[idx] == key_src[:, None])
+        & (tables.sess_dst[idx] == key_dst[:, None])
+        & (tables.sess_ports[idx] == key_ports[:, None])
+        & (tables.sess_proto[idx] == key_proto[:, None])
+    )
+    return jnp.any(slot_match, axis=1)
 
 
 def hashmap_insert(

@@ -43,12 +43,12 @@ class StageTiming:
         return self.seconds_per_call / self.vectors * 1e9
 
 
-def _time(fn: Callable, iters: int) -> float:
-    out = fn()
+def _time(fn: Callable, args, iters: int) -> float:
+    out = fn(*args)
     jax.block_until_ready(out)  # compile + warm
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = fn()
+        out = fn(*args)
     jax.block_until_ready(out)
     return (time.perf_counter() - t0) / iters
 
@@ -59,24 +59,31 @@ def profile_stages(
     now=None,
     iters: int = 20,
 ) -> List[StageTiming]:
-    """Time each pipeline stage in isolation + the fused step."""
+    """Time each pipeline stage in isolation + the fused step.
+
+    Stages take the tables/frame as real jit arguments (capturing device
+    arrays in a closure embeds them as constants, which inflates per-call
+    dispatch enormously). Absolute numbers still include one host→device
+    dispatch each — compare rows, and trust the FUSED row as the real
+    per-frame cost.
+    """
     now = jnp.int32(1) if now is None else now
     n = int(pkts.src_ip.shape[0])
     alive = pkts.valid
 
-    stages: Dict[str, Callable] = {
-        "ip4-input": jax.jit(lambda: ip4_input(pkts)),
-        "session-lookup": jax.jit(lambda: session_lookup_reverse(tables, pkts)),
-        "nat44-reverse": jax.jit(lambda: nat44_reverse(tables, pkts, alive)),
-        "nat44-dnat": jax.jit(lambda: nat44_dnat(tables, pkts, alive)),
-        "acl-classify-local": jax.jit(lambda: acl_classify_local(tables, pkts)),
-        "acl-classify-global": jax.jit(lambda: acl_classify_global(tables, pkts)),
-        "ip4-lookup": jax.jit(lambda: ip4_lookup(tables, pkts.dst_ip)),
-        "FUSED pipeline-step": jax.jit(lambda: pipeline_step(tables, pkts, now)),
+    stages = {
+        "ip4-input": (jax.jit(ip4_input), (pkts,)),
+        "session-lookup": (jax.jit(session_lookup_reverse), (tables, pkts)),
+        "nat44-reverse": (jax.jit(nat44_reverse), (tables, pkts, alive)),
+        "nat44-dnat": (jax.jit(nat44_dnat), (tables, pkts, alive)),
+        "acl-classify-local": (jax.jit(acl_classify_local), (tables, pkts)),
+        "acl-classify-global": (jax.jit(acl_classify_global), (tables, pkts)),
+        "ip4-lookup": (jax.jit(ip4_lookup), (tables, pkts.dst_ip)),
+        "FUSED pipeline-step": (jax.jit(pipeline_step), (tables, pkts, now)),
     }
     out = []
-    for name, fn in stages.items():
-        sec = _time(fn, iters)
+    for name, (fn, args) in stages.items():
+        sec = _time(fn, args, iters)
         out.append(StageTiming(
             node=name, calls=iters, vectors=n, seconds_per_call=sec,
         ))
