@@ -1,0 +1,75 @@
+"""Debug CLI tests (vppctl `show ...` analog)."""
+
+import ipaddress
+
+from vpp_tpu.cli import DebugCLI
+from vpp_tpu.ir import Action, ContivRule, Protocol
+from vpp_tpu.pipeline.dataplane import Dataplane
+from vpp_tpu.pipeline.tables import DataplaneConfig
+from vpp_tpu.pipeline.vector import Disposition, ip4, make_packet_vector
+from vpp_tpu.trace import PacketTracer
+
+
+def make_env():
+    dp = Dataplane(DataplaneConfig(sess_slots=256))
+    uplink = dp.add_uplink()
+    a = dp.add_pod_interface(("default", "web"))
+    dp.builder.add_route("10.1.1.2/32", a, Disposition.LOCAL)
+    dp.builder.add_route("10.2.0.0/16", uplink, Disposition.REMOTE,
+                         next_hop=ip4("192.168.16.2"), node_id=2)
+    slot = dp.alloc_table_slot("T1")
+    dp.builder.set_local_table(slot, [
+        ContivRule(action=Action.PERMIT,
+                   dest_network=ipaddress.ip_network("10.1.1.2/32"),
+                   protocol=Protocol.TCP, dest_port=80),
+        ContivRule(action=Action.DENY),
+    ])
+    dp.assign_pod_table(("default", "web"), "T1")
+    dp.builder.set_nat_mapping(
+        0, ext_ip=ip4("10.96.0.9"), ext_port=80, proto=6,
+        backends=[(ip4("10.1.1.2"), 8080, 2), (ip4("10.1.1.3"), 8080, 1)],
+        boff=0,
+    )
+    dp.swap()
+    return dp, a, uplink
+
+
+def test_show_interface_and_fib():
+    dp, a, uplink = make_env()
+    cli = DebugCLI(dp)
+    out = cli.run("show interface")
+    assert "default/web" in out and "uplink" in out
+    out = cli.run("show fib")
+    assert "10.1.1.2/32" in out
+    assert "10.2.0.0/16" in out and "node 2" in out and "192.168.16.2" in out
+
+
+def test_show_acl_and_nat():
+    dp, a, uplink = make_env()
+    cli = DebugCLI(dp)
+    out = cli.run("show acl")
+    assert "local table T1" in out
+    assert "permit tcp" in out and ":80" in out
+    assert "deny tcp" in out  # ContivRule default protocol is TCP
+    out = cli.run("show nat44")
+    assert "10.96.0.9:80" in out
+    assert "weight 2" in out and "weight 1" in out
+
+
+def test_show_session_and_trace_and_unknown():
+    dp, a, uplink = make_env()
+    tracer = PacketTracer()
+    dp.tracer = tracer
+    tracer.add(5)
+    dp.process(make_packet_vector([
+        dict(src="10.9.9.9", dst="10.1.1.2", proto=6, sport=1234, dport=80,
+             rx_if=uplink)
+    ]))
+    cli = DebugCLI(dp, tracer=tracer)
+    out = cli.run("show session")
+    assert "1 established sessions" in out
+    assert "10.9.9.9" in out
+    out = cli.run("show trace")
+    assert "10.9.9.9 -> 10.1.1.2" in out
+    assert "unknown command" in cli.run("bogus thing")
+    assert "show nat44" in cli.run("help")

@@ -1,0 +1,196 @@
+"""Debug CLI: the vppctl analog.
+
+Reference: VPP's `vppctl` show commands (`show interface`, `show acl`,
+`show session`, `show nat44`, `show ip fib`, `show trace`, `show run`,
+`show errors`) used throughout docs/VPP_PACKET_TRACING_K8S.md. Operates
+on a live Dataplane (and optionally its tracer/stats); every command
+returns a string so it serves both the interactive REPL and tests.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from vpp_tpu.pipeline.dataplane import Dataplane
+from vpp_tpu.pipeline.tables import InterfaceType
+from vpp_tpu.pipeline.vector import Disposition, ip4_str
+
+
+class DebugCLI:
+    def __init__(self, dataplane: Dataplane, tracer=None, stats=None):
+        self.dp = dataplane
+        self.tracer = tracer
+        self.stats = stats
+
+    # --- dispatch ---
+    def run(self, line: str) -> str:
+        parts = line.strip().split()
+        if not parts:
+            return ""
+        handlers = {
+            ("show", "interface"): self.show_interface,
+            ("show", "acl"): self.show_acl,
+            ("show", "session"): self.show_session,
+            ("show", "nat44"): self.show_nat44,
+            ("show", "fib"): self.show_fib,
+            ("show", "trace"): self.show_trace,
+            ("show", "errors"): self.show_errors,
+            ("help",): self.help,
+        }
+        for sig, fn in handlers.items():
+            if tuple(parts[: len(sig)]) == sig:
+                return fn()
+        return f"unknown command: {line.strip()!r} (try 'help')"
+
+    def help(self) -> str:
+        return (
+            "commands: show interface | show acl | show session | "
+            "show nat44 | show fib | show trace | show errors"
+        )
+
+    # --- commands ---
+    def show_interface(self) -> str:
+        dp = self.dp
+        t = np.asarray(dp.builder.if_type)
+        lines = [f"{'idx':>4} {'type':<8} {'acl-table':>9}  pod"]
+        for i in np.nonzero(t != 0)[0]:
+            i = int(i)
+            pod = dp.if_pod.get(i)
+            name = f"{pod[0]}/{pod[1]}" if pod else (
+                "uplink" if i == dp.uplink_if else
+                "host" if i == dp.host_if else ""
+            )
+            slot = int(dp.builder.if_local_table[i])
+            lines.append(
+                f"{i:>4} {InterfaceType(int(t[i])).name.lower():<8} "
+                f"{slot if slot >= 0 else '-':>9}  {name}"
+            )
+        return "\n".join(lines)
+
+    def show_acl(self) -> str:
+        dp = self.dp
+        lines = []
+        for table_id, slot in sorted(dp.table_slots.items()):
+            n = int(dp.builder.acl_nrules[slot])
+            lines.append(f"local table {table_id} (slot {slot}, {n} rules):")
+            lines.extend(self._rules(dp.builder.acl, slot, n))
+        n = int(dp.builder.glb_nrules)
+        lines.append(f"global table ({n} rules):")
+        lines.extend(self._rules(dp.builder.glb, None, n))
+        return "\n".join(lines)
+
+    def _rules(self, packed, slot: Optional[int], n: int) -> List[str]:
+        def col(name, i):
+            a = packed[name]
+            return a[slot][i] if slot is not None else a[i]
+
+        out = []
+        for i in range(n):
+            act = "permit" if int(col("action", i)) == 1 else "deny"
+            proto = int(col("proto", i))
+            pstr = {6: "tcp", 17: "udp", 1: "icmp", -1: "any"}.get(proto, str(proto))
+            src = f"{ip4_str(int(col('src_net', i)))}/{bin(int(col('src_mask', i))).count('1')}"
+            dst = f"{ip4_str(int(col('dst_net', i)))}/{bin(int(col('dst_mask', i))).count('1')}"
+            def port_range(lo, hi):
+                if (lo, hi) == (0, 65535):
+                    return "any"
+                return str(lo) if lo == hi else f"{lo}-{hi}"
+
+            sport = port_range(int(col("sport_lo", i)), int(col("sport_hi", i)))
+            dport = port_range(int(col("dport_lo", i)), int(col("dport_hi", i)))
+            out.append(f"  [{i}] {act} {pstr} {src}:{sport} -> {dst}:{dport}")
+        return out
+
+    def show_session(self) -> str:
+        t = self.dp.tables
+        if t is None:
+            return "no live tables"
+        valid = np.asarray(t.sess_valid)
+        idxs = np.nonzero(valid)[0]
+        lines = [f"{len(idxs)} established sessions "
+                 f"({valid.shape[0]} slots)"]
+        src = np.asarray(t.sess_src); dst = np.asarray(t.sess_dst)
+        ports = np.asarray(t.sess_ports); proto = np.asarray(t.sess_proto)
+        age = np.asarray(t.sess_time)
+        for i in idxs[:64]:
+            i = int(i)
+            lines.append(
+                f"  {ip4_str(int(src[i]))}:{int(ports[i]) >> 16} -> "
+                f"{ip4_str(int(dst[i]))}:{int(ports[i]) & 0xFFFF} "
+                f"proto {int(proto[i])} last-hit {int(age[i])}"
+            )
+        if len(idxs) > 64:
+            lines.append(f"  ... {len(idxs) - 64} more")
+        return "\n".join(lines)
+
+    def show_nat44(self) -> str:
+        dp = self.dp
+        b = dp.builder
+        lines = ["static mappings:"]
+        for s in np.nonzero(np.asarray(b.nat_bcnt) > 0)[0]:
+            s = int(s)
+            boff, bcnt = int(b.nat_boff[s]), int(b.nat_bcnt[s])
+            lines.append(
+                f"  {ip4_str(int(b.nat_ext_ip[s]))}:{int(b.nat_ext_port[s])} "
+                f"proto {int(b.nat_proto[s])} -> {bcnt} backends:"
+            )
+            prev = 0
+            for j in range(boff, boff + bcnt):
+                w = int(b.natb_cumw[j]) - prev
+                prev = int(b.natb_cumw[j])
+                lines.append(
+                    f"    {ip4_str(int(b.natb_ip[j]))}:{int(b.natb_port[j])} "
+                    f"weight {w}"
+                )
+        t = dp.tables
+        if t is not None:
+            n = int(np.asarray(t.natsess_valid).sum())
+            lines.append(f"nat sessions: {n}")
+        return "\n".join(lines)
+
+    def show_fib(self) -> str:
+        b = self.dp.builder
+        plen = np.asarray(b.fib_plen)
+        lines = []
+        for i in np.nonzero(plen >= 0)[0]:
+            i = int(i)
+            disp = Disposition(int(b.fib_disp[i])).name.lower()
+            extra = ""
+            if int(b.fib_node_id[i]) >= 0:
+                extra = f" node {int(b.fib_node_id[i])}"
+            if int(b.fib_next_hop[i]):
+                extra += f" via {ip4_str(int(b.fib_next_hop[i]))}"
+            lines.append(
+                f"  {ip4_str(int(b.fib_prefix[i]))}/{int(plen[i])} "
+                f"-> if {int(b.fib_tx_if[i])} [{disp}]{extra}"
+            )
+        return "\n".join(sorted(lines)) or "empty FIB"
+
+    def show_trace(self) -> str:
+        if self.tracer is None:
+            return "no tracer attached"
+        return self.tracer.format_trace()
+
+    def show_errors(self) -> str:
+        if self.stats is None:
+            return "no statscollector attached"
+        totals = self.stats.totals_snapshot()
+        lines = [f"{'counter':<16} {'count':>12}"]
+        for k in ("rx", "tx", "drop_ip4", "drop_acl", "drop_no_route", "punt"):
+            lines.append(f"{k:<16} {totals[k]:>12}")
+        return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    """Interactive REPL against a running agent is future work (needs an
+    RPC surface); today the CLI wraps an in-process Dataplane."""
+    import sys
+
+    print("vpp_tpu debug CLI — in-process use only; see DebugCLI.", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -96,6 +96,11 @@ class StatsCollector:
             for k in self._totals:
                 self._totals[k] += int(getattr(stats, k))
 
+    def totals_snapshot(self) -> Dict[str, int]:
+        """Consistent copy of the node-level counters (CLI/debug use)."""
+        with self._lock:
+            return dict(self._totals)
+
     # --- label resolution ---
     def _labels_for(self, if_idx: int) -> Optional[Dict[str, str]]:
         if self.index is not None:
