@@ -289,26 +289,28 @@ class ContivAgent:
             next_hop=peer_vtep,
             node_id=node_id,
         )
-        self.dataplane.builder.add_route(
-            str(self.ipam.other_node_pod_network(node_id)), **with_hop
-        )
-        self.dataplane.builder.add_route(
-            str(self.ipam.other_node_vpp_host_network(node_id)), **with_hop
-        )
-        self.dataplane.swap()
+        with self.dataplane.commit_lock:
+            self.dataplane.builder.add_route(
+                str(self.ipam.other_node_pod_network(node_id)), **with_hop
+            )
+            self.dataplane.builder.add_route(
+                str(self.ipam.other_node_vpp_host_network(node_id)), **with_hop
+            )
+            self.dataplane.swap()
         self._peer_routes[node_id] = peer_vtep
         log.info("node %d added: routes via vtep %s", node_id, peer_vtep)
 
     def _remove_node(self, node_id: int) -> None:
         if self._peer_routes.pop(node_id, None) is None:
             return
-        self.dataplane.builder.del_route(
-            str(self.ipam.other_node_pod_network(node_id))
-        )
-        self.dataplane.builder.del_route(
-            str(self.ipam.other_node_vpp_host_network(node_id))
-        )
-        self.dataplane.swap()
+        with self.dataplane.commit_lock:
+            self.dataplane.builder.del_route(
+                str(self.ipam.other_node_pod_network(node_id))
+            )
+            self.dataplane.builder.del_route(
+                str(self.ipam.other_node_vpp_host_network(node_id))
+            )
+            self.dataplane.swap()
         log.info("node %d removed", node_id)
 
     def _on_pod_event(self, ev: KVEvent) -> None:

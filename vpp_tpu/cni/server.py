@@ -62,7 +62,7 @@ class RemoteCNIServer:
 
     def resync(self) -> int:
         """Re-wire all persisted containers after an agent restart."""
-        with self._lock:
+        with self._lock, self.dp.commit_lock:
             n = 0
             for cfg in self.index.load_persisted():
                 pod = (cfg.pod_namespace, cfg.pod_name)
@@ -100,24 +100,27 @@ class RemoteCNIServer:
             # kubelet sends later is a harmless no-op — otherwise old and
             # new would share one interface and the late DEL would cut
             # the live pod's connectivity.
-            stale = self.index.lookup_pod(req.pod_namespace, req.pod_name)
-            if stale is not None:
-                self.index.unregister(stale.container_id)
-                self.dp.builder.del_route(f"{stale.ip}/32")
-                self.dp.del_pod_interface((stale.pod_namespace, stale.pod_name))
-                self.ipam.release_pod_ip(
-                    f"{stale.pod_namespace}/{stale.pod_name}"
-                )
             pod_id = f"{req.pod_namespace}/{req.pod_name}"
             ip = None
             try:
-                ip = self.ipam.next_pod_ip(pod_id)
-                pod = (req.pod_namespace, req.pod_name)
-                if_idx = self.dp.add_pod_interface(pod)
-                self.dp.builder.add_route(
-                    f"{ip}/32", if_idx, Disposition.LOCAL
-                )
-                self.dp.swap()
+                with self.dp.commit_lock:
+                    stale = self.index.lookup_pod(
+                        req.pod_namespace, req.pod_name
+                    )
+                    if stale is not None:
+                        self.index.unregister(stale.container_id)
+                        self.dp.builder.del_route(f"{stale.ip}/32")
+                        self.dp.del_pod_interface(
+                            (stale.pod_namespace, stale.pod_name)
+                        )
+                        self.ipam.release_pod_ip(pod_id)
+                    ip = self.ipam.next_pod_ip(pod_id)
+                    pod = (req.pod_namespace, req.pod_name)
+                    if_idx = self.dp.add_pod_interface(pod)
+                    self.dp.builder.add_route(
+                        f"{ip}/32", if_idx, Disposition.LOCAL
+                    )
+                    self.dp.swap()
                 cfg = ContainerConfig(
                     container_id=req.container_id,
                     pod_name=req.pod_name,
@@ -145,10 +148,11 @@ class RemoteCNIServer:
                 # unknown container: CNI DEL must be idempotent
                 return CNIReply(result=ResultCode.OK)
             pod = (cfg.pod_namespace, cfg.pod_name)
-            self.dp.builder.del_route(f"{cfg.ip}/32")
-            self.dp.del_pod_interface(pod)
-            self.ipam.release_pod_ip(f"{cfg.pod_namespace}/{cfg.pod_name}")
-            self.dp.swap()
+            with self.dp.commit_lock:
+                self.dp.builder.del_route(f"{cfg.ip}/32")
+                self.dp.del_pod_interface(pod)
+                self.ipam.release_pod_ip(f"{cfg.pod_namespace}/{cfg.pod_name}")
+                self.dp.swap()
         self._notify()
         return CNIReply(result=ResultCode.OK)
 

@@ -211,6 +211,7 @@ class ClusterDataplane:
                 raise ValueError(
                     f"{name} {dim} not divisible by rule shards {rule_shards}"
                 )
+        self._lock = threading.RLock()
         self.nodes: List[Dataplane] = [
             Dataplane(self.config, materialize=False) for _ in range(self.n_nodes)
         ]
@@ -218,10 +219,16 @@ class ClusterDataplane:
             # Cluster nodes always classify via the dense rule-sharded
             # kernel; skip the host-side MXU bit-plane compile.
             n.builder.mxu_enabled = False
+            # Renderer/CNI commits on a node handle publish the whole
+            # cluster epoch (the node's swap delegates here). All node
+            # commits serialize on the CLUSTER lock — a single lock, so
+            # concurrent per-node writers can't deadlock on each other
+            # and a swap never reads a half-applied peer builder.
+            n._swap_delegate = self.swap
+            n.commit_lock = self._lock
         self.tables: Optional[DataplaneTables] = None
         self.epoch = 0
         self._now = 0
-        self._lock = threading.RLock()
         self._uplinks = None
         self._step = make_cluster_step(mesh)
         self._shardings = table_shardings(mesh)

@@ -44,6 +44,14 @@ class Dataplane:
         )
         self.epoch = 0
         self._lock = threading.RLock()
+        # Guards a whole stage-mutate-then-swap commit sequence. For a
+        # standalone dataplane it's the same lock; a ClusterDataplane
+        # repoints every node handle at ITS lock so one node's commit
+        # can't publish another node's half-applied staging (cluster
+        # swap reads all builders). Writers (renderer commit, CNI server,
+        # node events, service configurator) hold this across builder
+        # mutations + swap().
+        self.commit_lock = self._lock
         self._step = jax.jit(pipeline_step)
         self._step_mxu = jax.jit(pipeline_step_mxu)
         self._encap = None  # jitted vxlan_encap, built on first use
@@ -144,12 +152,20 @@ class Dataplane:
     # --- epoch management ---
     def swap(self) -> int:
         """Publish the staged configuration as a new table epoch. Live
-        session state is carried over from the running epoch."""
+        session state is carried over from the running epoch.
+
+        On a cluster-node staging handle the swap delegates to the owning
+        ClusterDataplane (set via ``_swap_delegate``), so renderers and
+        the CNI server drive cluster nodes unchanged."""
+        delegate = getattr(self, "_swap_delegate", None)
+        if delegate is not None:
+            return delegate()
         with self._lock:
             if self.tables is None:
                 raise RuntimeError(
-                    "this Dataplane is a staging handle managed by a "
-                    "ClusterDataplane; publish epochs via cluster.swap()"
+                    "this Dataplane has no live tables and no swap "
+                    "delegate (materialize=False without a managing "
+                    "ClusterDataplane)"
                 )
             self.tables = self.builder.to_device(sessions=self.tables)
             self._use_mxu = (

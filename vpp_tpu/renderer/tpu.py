@@ -72,6 +72,10 @@ class TpuRendererTxn(RendererTxn):
 
     def commit(self) -> None:
         dp = self.renderer.dataplane
+        with dp.commit_lock:
+            self._commit_locked(dp)
+
+    def _commit_locked(self, dp: Dataplane) -> None:
         changes = self.cache_txn.get_changes()
         for change in changes:
             table = change.table

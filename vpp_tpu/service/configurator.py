@@ -64,8 +64,9 @@ class ServiceConfigurator:
         self._rebuild()
 
     def set_snat_ip(self, ip: str) -> None:
-        self.dataplane.builder.nat_snat_ip = np.uint32(ip4(ip))
-        self.dataplane.swap()
+        with self.dataplane.commit_lock:
+            self.dataplane.builder.nat_snat_ip = np.uint32(ip4(ip))
+            self.dataplane.swap()
 
     def resync(self, services: List[ContivService]) -> None:
         self.services = {s.id: s for s in services}
@@ -73,6 +74,10 @@ class ServiceConfigurator:
 
     # --- rendering ---
     def _rebuild(self) -> None:
+        with self.dataplane.commit_lock:
+            self._rebuild_locked()
+
+    def _rebuild_locked(self) -> None:
         dp = self.dataplane
         builder = dp.builder
         builder.clear_nat()
