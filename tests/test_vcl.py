@@ -1,0 +1,129 @@
+"""VCL socket-shim tests: real loopback connections filtered by session
+rules (the ld_preload/iperf suite analog, over localhost instead of
+pods)."""
+
+import threading
+
+import pytest
+
+from vpp_tpu.hoststack import RuleAction, RuleScope, SessionRule, SessionRuleEngine
+from vpp_tpu.hoststack.session_rules import GLOBAL_NS
+from vpp_tpu.hoststack.vcl import HostStackApp, PolicyDenied
+from vpp_tpu.pipeline.vector import ip4
+
+LOOP = ip4("127.0.0.1")
+
+
+def deny_connect_rule(ns, rmt_port=0):
+    return SessionRule(
+        scope=int(RuleScope.LOCAL), appns_index=ns, transport_proto=6,
+        lcl_net=0, lcl_plen=0, rmt_net=LOOP, rmt_plen=32,
+        lcl_port=0, rmt_port=rmt_port, action=int(RuleAction.DENY),
+    )
+
+
+def echo_server(app):
+    srv = app.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen()
+    port = srv.getsockname()[1]
+
+    def serve():
+        try:
+            conn, _ = srv.accept()
+            conn.send(conn.recv(64))
+            conn.close()
+        except OSError:
+            pass
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    return srv, port, t
+
+
+def test_allowed_connect_end_to_end():
+    engine = SessionRuleEngine(capacity=64)
+    server_app = HostStackApp(engine, appns_index=2)
+    client_app = HostStackApp(engine, appns_index=1)
+    srv, port, t = echo_server(server_app)
+    with client_app.socket() as c:
+        c.settimeout(10)
+        c.connect(("127.0.0.1", port))
+        c.send(b"ping")
+        assert c.recv(64) == b"ping"
+    srv.close()
+
+
+def test_denied_connect_never_reaches_server():
+    engine = SessionRuleEngine(capacity=64)
+    client_app = HostStackApp(engine, appns_index=1)
+    engine.apply(add=[deny_connect_rule(ns=1)])
+    with client_app.socket() as c:
+        with pytest.raises(PolicyDenied):
+            c.connect(("127.0.0.1", 1))
+    # other namespaces unaffected
+    other = HostStackApp(engine, appns_index=9)
+    srv, port, t = echo_server(other)
+    with other.socket() as c:
+        c.settimeout(10)
+        c.connect(("127.0.0.1", port))
+    srv.close()
+
+
+def test_denied_accept_closes_peer_and_keeps_listening():
+    engine = SessionRuleEngine(capacity=64)
+    server_app = HostStackApp(engine, appns_index=2)
+    srv = server_app.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen()
+    port = srv.getsockname()[1]
+
+    # GLOBAL rules filter accepts: deny peers with src port == their
+    # bound port unknown; instead deny everything, then allow nothing →
+    # accept() should close the first conn; we then allow and retry.
+    engine.apply(add=[SessionRule(
+        scope=int(RuleScope.GLOBAL), appns_index=GLOBAL_NS,
+        transport_proto=6, lcl_net=LOOP, lcl_plen=32,
+        rmt_net=0, rmt_plen=0, lcl_port=port, rmt_port=0,
+        action=int(RuleAction.DENY),
+    )])
+
+    results = []
+
+    def serve():
+        srv.sock.settimeout(30)
+        try:
+            conn, peer = srv.accept()
+            results.append(("accepted", peer))
+            conn.close()
+        except OSError as e:
+            results.append(("err", e))
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+
+    import socket as s
+
+    # first client: denied at accept → its connection gets closed
+    c1 = s.socket()
+    c1.settimeout(10)
+    c1.connect(("127.0.0.1", port))
+    # the server should close it (recv returns b"" on clean close/reset)
+    c1.settimeout(10)
+    try:
+        got = c1.recv(16)
+        assert got == b""
+    except ConnectionError:
+        pass
+    c1.close()
+    assert not results, "denied peer must not be accepted"
+
+    # permit: flip the rule and the next client is accepted
+    engine.flush()
+    c2 = s.socket()
+    c2.settimeout(10)
+    c2.connect(("127.0.0.1", port))
+    t.join(timeout=30)
+    assert results and results[0][0] == "accepted"
+    c2.close()
+    srv.close()

@@ -1,0 +1,125 @@
+"""VCL: the host-stack socket shim.
+
+Reference analog: VPP's VCL + ldpreload (tests/ld_preload*, the
+contiv-cri shim injecting LD_PRELOAD env so app sockets ride VPP's TCP
+stack and are filtered by session rules). Here the accelerated stack's
+*policy surface* is reproduced: an app namespace opens sockets through
+``HostStackApp``, and every connect()/accept() is checked against the
+node's SessionRuleEngine before the OS proceeds — deny means the
+connection never happens (connect raises, accept closes), exactly the
+session-layer filtering the VPPTCP renderer programs.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Optional, Tuple
+
+from vpp_tpu.hoststack.session_rules import SessionRuleEngine
+
+
+class PolicyDenied(ConnectionRefusedError):
+    """Raised when a session rule denies the connection."""
+
+
+def _ip_int(addr: str) -> int:
+    return struct.unpack("!I", socket.inet_aton(addr))[0]
+
+
+class FilteredSocket:
+    """A TCP/UDP socket whose session-layer operations are filtered.
+
+    Wraps a real OS socket (tests exercise actual connections over
+    loopback); the filtering decision is the part that mirrors VPP —
+    where VPP consults its session rule tables inside the host stack,
+    we consult the SessionRuleEngine at the same call sites.
+    """
+
+    def __init__(self, app: "HostStackApp", proto: int = 6,
+                 sock: Optional[socket.socket] = None):
+        self.app = app
+        self.proto = proto
+        kind = socket.SOCK_STREAM if proto == 6 else socket.SOCK_DGRAM
+        self.sock = sock or socket.socket(socket.AF_INET, kind)
+
+    # --- session-layer entry points ---
+    def connect(self, address: Tuple[str, int]) -> None:
+        rmt_ip, rmt_port = address
+        lcl_ip, lcl_port = self._local()
+        allowed = self.app.engine.check_connect([
+            (self.app.appns_index, self.proto, _ip_int(lcl_ip), lcl_port,
+             _ip_int(rmt_ip), rmt_port)
+        ])[0]
+        if not allowed:
+            raise PolicyDenied(
+                f"session rule denies connect to {rmt_ip}:{rmt_port} "
+                f"(ns {self.app.appns_index})"
+            )
+        self.sock.connect(address)
+
+    def bind(self, address: Tuple[str, int]) -> None:
+        self.sock.bind(address)
+
+    def listen(self, backlog: int = 16) -> None:
+        self.sock.listen(backlog)
+
+    def accept(self) -> Tuple["FilteredSocket", Tuple[str, int]]:
+        """Accept the next ALLOWED connection; denied peers are closed
+        (the VPP session layer resets filtered sessions) and the accept
+        keeps waiting."""
+        while True:
+            conn, peer = self.sock.accept()
+            lcl_ip, lcl_port = conn.getsockname()[:2]
+            allowed = self.app.engine.check_accept([
+                (self.proto, _ip_int(lcl_ip), lcl_port,
+                 _ip_int(peer[0]), peer[1])
+            ])[0]
+            if allowed:
+                return FilteredSocket(self.app, self.proto, conn), peer
+            conn.close()
+
+    # --- passthrough ---
+    def _local(self) -> Tuple[str, int]:
+        try:
+            name = self.sock.getsockname()
+            return name[0], name[1]
+        except OSError:
+            return ("0.0.0.0", 0)
+
+    def getsockname(self):
+        return self.sock.getsockname()
+
+    def send(self, data: bytes) -> int:
+        return self.sock.send(data)
+
+    def recv(self, n: int) -> bytes:
+        return self.sock.recv(n)
+
+    def settimeout(self, t) -> None:
+        self.sock.settimeout(t)
+
+    def close(self) -> None:
+        self.sock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class HostStackApp:
+    """One application namespace on the accelerated host stack.
+
+    The reference derives the app namespace from the pod (contiv.API
+    GetNsIndex); here the CNI layer supplies the same index (the pod's
+    dataplane interface index, ContivAgent._pod_ns_index).
+    """
+
+    def __init__(self, engine: SessionRuleEngine, appns_index: int):
+        self.engine = engine
+        self.appns_index = appns_index
+
+    def socket(self, proto: int = 6) -> FilteredSocket:
+        return FilteredSocket(self, proto)
