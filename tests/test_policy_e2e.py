@@ -68,6 +68,44 @@ def db_policy():
     )
 
 
+def test_parallel_renderer_commits():
+    """configurator_impl.go:211-233 analog: with parallel_commits, both
+    renderers land their tables and verdicts match the serial path."""
+    from vpp_tpu.hoststack import SessionRuleEngine
+    from vpp_tpu.renderer.vpptcp import VpptcpRenderer
+    from vpp_tpu.policy import PolicyCache, PolicyConfigurator, PolicyProcessor
+
+    dp = Dataplane()
+    dp.add_uplink()
+    cache = PolicyCache()
+    configurator = PolicyConfigurator(cache, parallel_commits=True)
+    engine = SessionRuleEngine(capacity=256)
+    pod_ifs = {}
+    configurator.register_renderer(TpuRenderer(dp))
+    configurator.register_renderer(
+        VpptcpRenderer(engine, lambda p: pod_ifs.get(p, -1))
+    )
+    processor = PolicyProcessor(cache, configurator)
+
+    cache.update_namespace(m.Namespace(name="default", labels={}))
+    for pid in (WEB1, DB):
+        idx = dp.add_pod_interface(pid)
+        pod_ifs[pid] = idx
+        dp.builder.add_route(f"{IPS[pid]}/32", idx, Disposition.LOCAL)
+        cache.update_pod(m.Pod(name=pid.name, namespace=pid.namespace,
+                               labels=LABELS[pid], ip_address=IPS[pid]))
+    dp.swap()
+    cache.update_policy(db_policy())
+
+    # both renderers committed: device tables deny, session rules exist
+    pkts = make_packet_vector([
+        {"src": IPS[WEB1], "dst": IPS[DB], "proto": 6, "sport": 1,
+         "dport": 9999, "rx_if": pod_ifs[WEB1]}
+    ])
+    assert int(dp.process(pkts).disp[0]) == int(Disposition.DROP)
+    assert engine.num_rules > 0
+
+
 def test_no_policy_everything_allowed():
     env = Env()
     assert env.send(CLIENT, DB, 5432) == Disposition.LOCAL

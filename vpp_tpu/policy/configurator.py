@@ -49,9 +49,15 @@ def subtract_subnet(subnet: IPNetwork, excluded: IPNetwork) -> List[IPNetwork]:
 
 
 class PolicyConfigurator:
-    def __init__(self, cache: PolicyCache):
+    def __init__(self, cache: PolicyCache, parallel_commits: bool = False):
+        """``parallel_commits``: commit independent renderers from worker
+        threads (reference: the optional parallel renderer commit,
+        configurator_impl.go:211-233, flag plugin_impl_policy.go:161).
+        Renderers are independent southbound targets, so their commits
+        may overlap; errors propagate after all complete."""
         self.cache = cache
         self.renderers: List[PolicyRendererAPI] = []
+        self.parallel_commits = parallel_commits
         self._pod_ips: Dict[PodID, IPNetwork] = {}
 
     def register_renderer(self, renderer: PolicyRendererAPI) -> None:
@@ -112,8 +118,19 @@ class PolicyConfiguratorTxn:
             for rtxn in renderer_txns:
                 rtxn.render(pod, pod_ip, list(ingress), list(egress), removed)
 
-        for rtxn in renderer_txns:
-            rtxn.commit()
+        if cfg.parallel_commits and len(renderer_txns) > 1:
+            import concurrent.futures
+
+            with concurrent.futures.ThreadPoolExecutor(
+                max_workers=len(renderer_txns),
+                thread_name_prefix="renderer-commit",
+            ) as pool:
+                futures = [pool.submit(r.commit) for r in renderer_txns]
+                for f in futures:
+                    f.result()  # re-raise the first renderer error
+        else:
+            for rtxn in renderer_txns:
+                rtxn.commit()
 
     # --- rule generation (reference: generateRules, :248-479) ---
     def _generate_rules(
