@@ -1,0 +1,309 @@
+"""SNAT differential tests: pod→external egress leaves with the node IP,
+replies translate back, counters account the translations.
+
+Reference analog: the service configurator's SNAT pool for traffic
+leaving the cluster (plugins/service/configurator/configurator_impl.go
+:258-264) applied by VPP's nat44 in2out/out2in nodes.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from vpp_tpu.pipeline.graph import pipeline_step
+from vpp_tpu.pipeline.tables import DataplaneConfig, InterfaceType, TableBuilder
+from vpp_tpu.pipeline.vector import (
+    Disposition,
+    ip4,
+    ip4_str,
+    make_packet_vector,
+)
+
+IF_POD, IF_UPLINK = 0, 1
+POD_IP = "10.1.1.2"
+NODE_IP = "192.168.16.1"
+EXT_IP = "93.184.216.34"
+
+
+def snat_builder():
+    b = TableBuilder(DataplaneConfig())
+    b.set_interface(IF_POD, InterfaceType.POD)
+    b.set_interface(IF_UPLINK, InterfaceType.UPLINK)
+    b.add_route(f"{POD_IP}/32", IF_POD, Disposition.LOCAL)
+    # Cluster-egress default route: SNAT applies.
+    b.add_route("0.0.0.0/0", IF_UPLINK, Disposition.REMOTE,
+                next_hop=ip4("192.168.16.100"), snat=True)
+    b.nat_snat_ip = np.uint32(ip4(NODE_IP))
+    return b
+
+
+def test_snat_egress_and_reply_roundtrip():
+    t = snat_builder().to_device()
+    # pod → external: source must leave as the node IP.
+    out = pipeline_step(t, make_packet_vector(
+        [{"src": POD_IP, "dst": EXT_IP, "proto": 6,
+          "sport": 44321, "dport": 443, "rx_if": IF_POD}]
+    ), jnp.int32(1))
+    assert int(out.disp[0]) == Disposition.REMOTE
+    assert ip4_str(out.pkts.src_ip[0]) == NODE_IP
+    alloc_port = int(out.pkts.sport[0])
+    assert 1024 <= alloc_port < 65536
+    assert bool(out.snat_applied[0])
+    assert int(out.stats.snat) == 1
+    assert int(out.stats.dnat) == 0
+
+    # reply external → node IP:alloc — must un-SNAT to the pod and be
+    # delivered on the pod interface without any pod-side permit rule
+    # (reflective session admits it).
+    rep = pipeline_step(out.tables, make_packet_vector(
+        [{"src": EXT_IP, "dst": NODE_IP, "proto": 6,
+          "sport": 443, "dport": alloc_port, "rx_if": IF_UPLINK}]
+    ), jnp.int32(2))
+    assert int(rep.disp[0]) == Disposition.LOCAL
+    assert int(rep.tx_if[0]) == IF_POD
+    assert ip4_str(rep.pkts.dst_ip[0]) == POD_IP
+    assert int(rep.pkts.dport[0]) == 44321
+    assert int(rep.stats.nat_reversed) == 1
+
+
+def test_snat_port_is_flow_consistent():
+    t = snat_builder().to_device()
+    pkts = make_packet_vector(
+        [{"src": POD_IP, "dst": EXT_IP, "proto": 6,
+          "sport": 50000, "dport": 443, "rx_if": IF_POD}] * 3
+        + [{"src": POD_IP, "dst": EXT_IP, "proto": 6,
+            "sport": 50001, "dport": 443, "rx_if": IF_POD}]
+    )
+    out = pipeline_step(t, pkts, jnp.int32(1))
+    ports = [int(out.pkts.sport[i]) for i in range(4)]
+    assert ports[0] == ports[1] == ports[2]  # same flow → same port
+    # a different flow must actually be translated (not passthrough)
+    assert 1024 <= ports[3] < 65536
+    assert ports[3] != 50001
+
+
+def test_snat_skips_local_and_non_marked_routes():
+    b = snat_builder()
+    b.add_route("10.2.0.0/16", IF_UPLINK, Disposition.REMOTE, node_id=2)
+    t = b.to_device()
+    pkts = make_packet_vector(
+        [  # pod → other-node pod subnet: fabric route, NOT snat-marked
+            {"src": POD_IP, "dst": "10.2.0.9", "proto": 6,
+             "sport": 1000, "dport": 80, "rx_if": IF_POD},
+        ]
+    )
+    out = pipeline_step(t, pkts, jnp.int32(1))
+    assert int(out.disp[0]) == Disposition.REMOTE
+    assert ip4_str(out.pkts.src_ip[0]) == POD_IP
+    assert int(out.stats.snat) == 0
+
+
+def test_nodeport_dnat_plus_snat_combined():
+    """External client → nodeIP:nodeport, backend behind an SNAT-marked
+    route: forward carries DNAT+SNAT, the reply undoes both."""
+    b = snat_builder()
+    # nodeport mapping on the node IP toward a backend reached over the
+    # default (snat-marked) route — the remote-backend nodeport case.
+    backend = "93.99.0.5"
+    b.set_nat_mapping(
+        0, ext_ip=ip4(NODE_IP), ext_port=30080, proto=6,
+        backends=[(ip4(backend), 8080, 1)], boff=0,
+    )
+    t = b.to_device()
+    client = "198.51.100.7"
+    out = pipeline_step(t, make_packet_vector(
+        [{"src": client, "dst": NODE_IP, "proto": 6,
+          "sport": 7777, "dport": 30080, "rx_if": IF_UPLINK}]
+    ), jnp.int32(1))
+    assert bool(out.dnat_applied[0]) and bool(out.snat_applied[0])
+    assert ip4_str(out.pkts.dst_ip[0]) == backend
+    assert int(out.pkts.dport[0]) == 8080
+    assert ip4_str(out.pkts.src_ip[0]) == NODE_IP
+    alloc = int(out.pkts.sport[0])
+    assert int(out.stats.dnat) == 1 and int(out.stats.snat) == 1
+
+    # backend reply → must become (nodeIP:30080 → client:7777)
+    rep = pipeline_step(out.tables, make_packet_vector(
+        [{"src": backend, "dst": NODE_IP, "proto": 6,
+          "sport": 8080, "dport": alloc, "rx_if": IF_UPLINK}]
+    ), jnp.int32(2))
+    assert ip4_str(rep.pkts.src_ip[0]) == NODE_IP
+    assert int(rep.pkts.sport[0]) == 30080
+    assert ip4_str(rep.pkts.dst_ip[0]) == client
+    assert int(rep.pkts.dport[0]) == 7777
+    assert int(rep.disp[0]) == Disposition.REMOTE  # back out the uplink
+    assert int(rep.stats.nat_reversed) == 1
+
+
+def test_nodeport_remote_backend_self_snat():
+    """Nodeport mapping marked self-snat: DNAT to a backend behind a
+    NON-snat fabric route still gets SNAT'd so the reply returns here
+    (the round-1 asymmetry: replies used to bypass the ingress node)."""
+    b = snat_builder()
+    backend = "10.2.0.5"  # on peer node 2, plain fabric route
+    b.add_route("10.2.0.0/16", IF_UPLINK, Disposition.REMOTE, node_id=2)
+    b.set_nat_mapping(
+        0, ext_ip=ip4(NODE_IP), ext_port=30080, proto=6,
+        backends=[(ip4(backend), 8080, 1)], boff=0, self_snat=True,
+    )
+    t = b.to_device()
+    client = "198.51.100.7"
+    out = pipeline_step(t, make_packet_vector(
+        [{"src": client, "dst": NODE_IP, "proto": 6,
+          "sport": 7777, "dport": 30080, "rx_if": IF_UPLINK}]
+    ), jnp.int32(1))
+    assert bool(out.dnat_applied[0]) and bool(out.snat_applied[0])
+    assert ip4_str(out.pkts.src_ip[0]) == NODE_IP  # SNAT despite fabric route
+    assert int(out.node_id[0]) == 2
+    alloc = int(out.pkts.sport[0])
+
+    rep = pipeline_step(out.tables, make_packet_vector(
+        [{"src": backend, "dst": NODE_IP, "proto": 6,
+          "sport": 8080, "dport": alloc, "rx_if": IF_UPLINK}]
+    ), jnp.int32(2))
+    assert ip4_str(rep.pkts.src_ip[0]) == NODE_IP
+    assert int(rep.pkts.sport[0]) == 30080
+    assert ip4_str(rep.pkts.dst_ip[0]) == client
+    assert int(rep.pkts.dport[0]) == 7777
+
+
+def test_icmp_snat_and_unsupported_proto_drop():
+    t = snat_builder().to_device()
+    out = pipeline_step(t, make_packet_vector(
+        [  # icmp echo: src-only SNAT, id (sport/dport) untouched
+            {"src": POD_IP, "dst": EXT_IP, "proto": 1,
+             "sport": 321, "dport": 321, "rx_if": IF_POD},
+            # GRE: not NAT-able → fail closed on the SNAT route
+            {"src": POD_IP, "dst": EXT_IP, "proto": 47,
+             "sport": 0, "dport": 0, "rx_if": IF_POD},
+        ]
+    ), jnp.int32(1))
+    assert ip4_str(out.pkts.src_ip[0]) == NODE_IP
+    assert int(out.pkts.sport[0]) == 321  # echo id preserved
+    assert int(out.disp[0]) == Disposition.REMOTE
+    assert int(out.disp[1]) == Disposition.DROP
+    assert int(out.stats.drop_nat) == 1
+
+    # echo reply round-trips back to the pod
+    rep = pipeline_step(out.tables, make_packet_vector(
+        [{"src": EXT_IP, "dst": NODE_IP, "proto": 1,
+          "sport": 321, "dport": 321, "rx_if": IF_UPLINK}]
+    ), jnp.int32(2))
+    assert int(rep.disp[0]) == Disposition.LOCAL
+    assert ip4_str(rep.pkts.dst_ip[0]) == POD_IP
+
+
+def test_snat_port_collision_fails_closed():
+    """Force a reply-key collision: two flows whose SNAT'd reply
+    5-tuples are identical must not both own the NAT session — the
+    second flow drops and is counted, never misdelivered."""
+    import numpy as np
+
+    from vpp_tpu.ops.nat44 import _flow_hash
+    from vpp_tpu.pipeline.vector import FLAG_VALID, PacketVector
+
+    b = snat_builder()
+    t = b.to_device()
+    # find two (sport) values from different pods that hash to the same
+    # allocated port toward the same external endpoint
+    import jax.numpy as jnpp
+
+    pod2 = "10.1.1.3"
+    b2 = snat_builder()
+    b2.add_route(f"{pod2}/32", IF_POD, Disposition.LOCAL)
+    t = b2.to_device()
+
+    def alloc_port_of(src, sport):
+        pv = make_packet_vector(
+            [{"src": src, "dst": EXT_IP, "proto": 6,
+              "sport": sport, "dport": 443, "rx_if": IF_POD}]
+        )
+        return 1024 + int(np.asarray(_flow_hash(pv)[0])) % 64512
+
+    base = alloc_port_of(POD_IP, 40000)
+    match = None
+    for sp in range(40000, 60000):
+        if alloc_port_of(pod2, sp) == base:
+            match = sp
+            break
+    assert match is not None, "no colliding sport found in range"
+
+    out1 = pipeline_step(t, make_packet_vector(
+        [{"src": POD_IP, "dst": EXT_IP, "proto": 6,
+          "sport": 40000, "dport": 443, "rx_if": IF_POD}]
+    ), jnp.int32(1))
+    assert int(out1.stats.snat) == 1
+    out2 = pipeline_step(out1.tables, make_packet_vector(
+        [{"src": pod2, "dst": EXT_IP, "proto": 6,
+          "sport": match, "dport": 443, "rx_if": IF_POD}]
+    ), jnp.int32(2))
+    assert int(out2.disp[0]) == Disposition.DROP
+    assert int(out2.stats.drop_nat) == 1
+
+    # the original flow's reply still translates to the right pod
+    rep = pipeline_step(out2.tables, make_packet_vector(
+        [{"src": EXT_IP, "dst": NODE_IP, "proto": 6,
+          "sport": 443, "dport": base, "rx_if": IF_UPLINK}]
+    ), jnp.int32(3))
+    assert ip4_str(rep.pkts.dst_ip[0]) == POD_IP
+    assert int(rep.pkts.dport[0]) == 40000
+
+
+def test_snat_port_collision_intra_batch_fails_closed():
+    """Two colliding flows in the SAME packet vector: exactly one owns
+    the NAT session; the other drops (never silently misdelivered)."""
+    import numpy as np
+
+    from vpp_tpu.ops.nat44 import _flow_hash
+
+    pod2 = "10.1.1.3"
+    b = snat_builder()
+    b.add_route(f"{pod2}/32", IF_POD, Disposition.LOCAL)
+    t = b.to_device()
+
+    def alloc_port_of(src, sport):
+        pv = make_packet_vector(
+            [{"src": src, "dst": EXT_IP, "proto": 6,
+              "sport": sport, "dport": 443, "rx_if": IF_POD}]
+        )
+        return 1024 + int(np.asarray(_flow_hash(pv)[0])) % 64512
+
+    base = alloc_port_of(POD_IP, 40000)
+    match = next(
+        (sp for sp in range(40000, 60000) if alloc_port_of(pod2, sp) == base),
+        None,
+    )
+    assert match is not None, "no colliding sport found in range"
+
+    out = pipeline_step(t, make_packet_vector(
+        [{"src": POD_IP, "dst": EXT_IP, "proto": 6,
+          "sport": 40000, "dport": 443, "rx_if": IF_POD},
+         {"src": pod2, "dst": EXT_IP, "proto": 6,
+          "sport": match, "dport": 443, "rx_if": IF_POD}]
+    ), jnp.int32(1))
+    disps = [int(out.disp[i]) for i in range(2)]
+    assert sorted(disps) == [int(Disposition.DROP), int(Disposition.REMOTE)]
+    assert int(out.stats.drop_nat) == 1
+    winner = disps.index(int(Disposition.REMOTE))
+    winner_pod = POD_IP if winner == 0 else pod2
+    winner_sport = 40000 if winner == 0 else match
+
+    # the reply translates to the winner, never the loser
+    rep = pipeline_step(out.tables, make_packet_vector(
+        [{"src": EXT_IP, "dst": NODE_IP, "proto": 6,
+          "sport": 443, "dport": base, "rx_if": IF_UPLINK}]
+    ), jnp.int32(2))
+    assert ip4_str(rep.pkts.dst_ip[0]) == winner_pod
+    assert int(rep.pkts.dport[0]) == winner_sport
+
+
+def test_snat_counters_account_translations():
+    t = snat_builder().to_device()
+    n = 32
+    pkts = make_packet_vector(
+        [{"src": POD_IP, "dst": EXT_IP, "proto": 6,
+          "sport": 40000 + i, "dport": 443, "rx_if": IF_POD}
+         for i in range(n)]
+    )
+    out = pipeline_step(t, pkts, jnp.int32(1))
+    assert int(out.stats.snat) == n
+    assert int(np.sum(np.asarray(out.tables.natsess_valid))) > 0

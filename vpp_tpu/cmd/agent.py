@@ -86,6 +86,30 @@ class ContivAgent:
         self.uplink_if = self.dataplane.add_uplink()
         self.host_if = self.dataplane.add_host_interface()
         self.dataplane.set_vtep(int(self.ipam.vxlan_ip_address()))
+        # Cluster-egress: default route out the uplink, source-NAT'd to
+        # the node IP so external replies return through this node
+        # (reference: service configurator SNAT pool for traffic leaving
+        # the cluster, configurator_impl.go:258-264). Staged here,
+        # published by start()'s base-config swap.
+        from vpp_tpu.pipeline.vector import ip4
+
+        self.dataplane.builder.add_route(
+            "0.0.0.0/0", self.uplink_if, Disposition.REMOTE, snat=True
+        )
+        # Cluster-internal subnets must never leak out the SNAT egress:
+        # a drop route for the whole pod/host supernets that per-peer
+        # routes (longest prefix) override — traffic to a removed node
+        # drops instead of escaping NAT'd (reference: only pod-external
+        # traffic hits the SNAT pool).
+        self.dataplane.builder.add_route(
+            str(self.ipam.pod_subnet), -1, Disposition.DROP
+        )
+        self.dataplane.builder.add_route(
+            str(self.ipam.vpp_host_subnet), -1, Disposition.DROP
+        )
+        self.dataplane.builder.set_snat_ip(
+            ip4(str(self.ipam.node_ip_address()))
+        )
         self.tpu_renderer = TpuRenderer(self.dataplane)
         self.session_engine = SessionRuleEngine()
         self.vpptcp_renderer = VpptcpRenderer(

@@ -25,6 +25,7 @@ class FibResult(NamedTuple):
     disp: jnp.ndarray       # int32 [P] Disposition (DROP when unmatched)
     next_hop: jnp.ndarray   # uint32 [P]
     node_id: jnp.ndarray    # int32 [P] remote node index, -1 local
+    snat: jnp.ndarray       # bool [P] route is marked for source-NAT
 
 
 def ip4_lookup(tables: DataplaneTables, dst_ip: jnp.ndarray) -> FibResult:
@@ -42,4 +43,5 @@ def ip4_lookup(tables: DataplaneTables, dst_ip: jnp.ndarray) -> FibResult:
         disp=jnp.where(matched, tables.fib_disp[best], int(Disposition.DROP)),
         next_hop=jnp.where(matched, tables.fib_next_hop[best], jnp.uint32(0)),
         node_id=jnp.where(matched, tables.fib_node_id[best], -1),
+        snat=matched & (tables.fib_snat[best] == 1),
     )

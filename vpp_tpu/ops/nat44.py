@@ -43,12 +43,15 @@ def nat44_dnat(
     tables: DataplaneTables,
     pkts: PacketVector,
     eligible: jnp.ndarray,
-) -> Tuple[PacketVector, jnp.ndarray]:
+) -> Tuple[PacketVector, jnp.ndarray, jnp.ndarray]:
     """Translate service VIP traffic to a weighted-chosen backend.
 
-    Pure translation — returns (rewritten packets, applied mask). Session
-    recording is a separate step (``nat44_record``) run *after* the ACL
-    verdict so denied packets never consume NAT session slots.
+    Pure translation — returns (rewritten packets, applied mask,
+    self_snat mask: the matched mapping also requires SNAT — the
+    nodeport case, where the backend's reply must return through this
+    node for un-DNAT, reference TwoNodeNAT semantics). Session recording
+    is a separate step (``nat44_record``) run *after* the ACL verdict so
+    denied packets never consume NAT session slots.
     """
     M = tables.nat_ext_ip.shape[0]
     B = tables.natb_ip.shape[0]
@@ -86,7 +89,41 @@ def nat44_dnat(
     new_dst = jnp.where(matched, tables.natb_ip[b_idx], pkts.dst_ip)
     new_dport = jnp.where(matched, tables.natb_port[b_idx], pkts.dport)
     out = pkts._replace(dst_ip=new_dst, dport=new_dport)
-    return out, matched
+    self_snat = matched & (tables.nat_self_snat[m_idx] == 1)
+    return out, matched, self_snat
+
+
+def nat44_snat(
+    tables: DataplaneTables,
+    pkts: PacketVector,
+    want: jnp.ndarray,
+) -> Tuple[PacketVector, jnp.ndarray]:
+    """Source-NAT cluster-egress flows to the node's SNAT address.
+
+    Reference analog: the service configurator's SNAT pool for traffic
+    leaving the cluster (configurator_impl.go:258-264). VPP allocates
+    ports from a pool; here the port is *derived* from the flow hash
+    (1024 + h % 64512) so every packet of a flow picks the same external
+    port statelessly — the NAT session (``nat44_record``) still records
+    the flow so replies can be un-SNAT'd, and a hash collision between
+    two flows to the same external endpoint is detected at insert time
+    (same reply key, different payload) and surfaced as a counter by the
+    caller.
+    """
+    applied = want & (tables.nat_snat_ip != 0)
+    sport = (
+        1024 + (_flow_hash(pkts) % jnp.uint32(64512)).astype(jnp.int32)
+    )
+    # ICMP (echo id modeled in sport/dport by the parser) keeps its id —
+    # only the source address is translated; VPP translates icmp ids,
+    # accepted simplification (collisions between two pods pinging the
+    # same target with the same id fail closed via the conflict path).
+    rewrite_port = applied & ((pkts.proto == 6) | (pkts.proto == 17))
+    out = pkts._replace(
+        src_ip=jnp.where(applied, tables.nat_snat_ip, pkts.src_ip),
+        sport=jnp.where(rewrite_port, sport, pkts.sport),
+    )
+    return out, applied
 
 
 def nat44_record(
@@ -94,15 +131,26 @@ def nat44_record(
     pkts: PacketVector,
     orig_dst: jnp.ndarray,
     orig_dport: jnp.ndarray,
+    orig_src: jnp.ndarray,
+    orig_sport: jnp.ndarray,
+    kind: jnp.ndarray,
     want: jnp.ndarray,
     now: jnp.ndarray,
-) -> DataplaneTables:
+) -> Tuple[DataplaneTables, jnp.ndarray]:
     """Record NAT sessions for translated-and-forwarded flows.
 
-    ``pkts`` are the post-translation headers; ``orig_dst``/``orig_dport``
-    the pre-translation destination (the VIP). Key = the flow as the
-    backend's reply will present it: (backend_ip, client_ip,
-    bport<<16|cport, proto); payload = the original (VIP, port).
+    ``pkts`` are the post-translation headers; ``orig_*`` the
+    pre-translation endpoints. Key = the flow as the reply will present
+    it: (reply_src=our dst, reply_dst=our src, dport<<16|sport, proto);
+    payload = the original destination (VIP, for un-DNAT of the reply
+    source), the original source (pod IP, for un-SNAT of the reply
+    destination) and the ``kind`` bitmask saying which rewrites apply
+    (1=DNAT, 2=SNAT — a node-port flow to a remote backend carries both).
+
+    Returns (tables, conflict): ``conflict`` marks packets whose reply
+    key is already owned by a *different* flow (hash-derived SNAT port
+    collision) — the caller fails closed (drops + counts) so replies are
+    never misdelivered to the wrong pod.
     """
     key_vals = (
         pkts.dst_ip,
@@ -111,13 +159,14 @@ def nat44_record(
         pkts.proto,
     )
     h = _hash(*key_vals, tables.natsess_valid.shape[0])
-    valid, time, keys, extras, _ = hashmap_insert(
+    valid, time, keys, extras, _, conflict = hashmap_insert(
         tables.natsess_valid,
         tables.natsess_time,
         (tables.natsess_a, tables.natsess_b, tables.natsess_ports, tables.natsess_proto),
         key_vals,
-        (tables.natsess_orig_ip, tables.natsess_orig_port),
-        (orig_dst, orig_dport),
+        (tables.natsess_orig_ip, tables.natsess_orig_port,
+         tables.natsess_src_ip, tables.natsess_sport, tables.natsess_kind),
+        (orig_dst, orig_dport, orig_src, orig_sport, kind),
         h,
         want,
         now,
@@ -131,7 +180,10 @@ def nat44_record(
         natsess_time=time,
         natsess_orig_ip=extras[0],
         natsess_orig_port=extras[1],
-    )
+        natsess_src_ip=extras[2],
+        natsess_sport=extras[3],
+        natsess_kind=extras[4],
+    ), conflict
 
 
 def nat44_reverse(
@@ -139,11 +191,14 @@ def nat44_reverse(
     pkts: PacketVector,
     eligible: jnp.ndarray,
 ) -> Tuple[PacketVector, jnp.ndarray]:
-    """Untranslate backend→client return traffic (src back to the VIP).
+    """Untranslate NAT'd return traffic.
 
-    A reply packet (src=backend, dst=client) matches a NAT session keyed
-    (backend_ip, client_ip, bport<<16|cport, proto); its source is
-    rewritten to the recorded original (VIP, port).
+    A reply packet matches a NAT session keyed on its own header
+    (src, dst, sport<<16|dport, proto). The recorded ``kind`` bitmask
+    says which rewrites to undo: bit 1 (DNAT'd forward) rewrites the
+    reply *source* back to the original destination (the service VIP);
+    bit 2 (SNAT'd forward) rewrites the reply *destination* back to the
+    original source (the pod IP/port behind the node's SNAT address).
     """
     n_slots = tables.natsess_valid.shape[0]
     probes = SESS_PROBES
@@ -168,11 +223,14 @@ def nat44_reverse(
     found = jnp.any(slot_ok, axis=1)
     first = jnp.argmax(slot_ok, axis=1)
     hit_idx = jnp.take_along_axis(idx, first[:, None], axis=1)[:, 0]
-    orig_ip = jnp.where(found, tables.natsess_orig_ip[hit_idx], 0)
-    orig_port = jnp.where(found, tables.natsess_orig_port[hit_idx], 0)
     applied = found & eligible
+    kind = jnp.where(applied, tables.natsess_kind[hit_idx], 0)
+    undo_dnat = (kind & 1) != 0
+    undo_snat = (kind & 2) != 0
     out = pkts._replace(
-        src_ip=jnp.where(applied, orig_ip, pkts.src_ip),
-        sport=jnp.where(applied, orig_port, pkts.sport),
+        src_ip=jnp.where(undo_dnat, tables.natsess_orig_ip[hit_idx], pkts.src_ip),
+        sport=jnp.where(undo_dnat, tables.natsess_orig_port[hit_idx], pkts.sport),
+        dst_ip=jnp.where(undo_snat, tables.natsess_src_ip[hit_idx], pkts.dst_ip),
+        dport=jnp.where(undo_snat, tables.natsess_sport[hit_idx], pkts.dport),
     )
     return out, applied

@@ -94,9 +94,15 @@ def hashmap_insert(
 
     ``keys``/``extras`` are the table's slot arrays, ``key_vals``/
     ``extra_vals`` the per-packet values to store; ``h`` the per-packet
-    home slot. Returns (valid, time, keys, extras, inserted_mask).
-    Matching on ``keys`` makes the insert idempotent (refreshes ``time``);
-    ``extras`` are payload columns written but not compared.
+    home slot. Returns (valid, time, keys, extras, inserted_mask,
+    conflict_mask). Matching on ``keys`` makes the insert idempotent
+    (refreshes ``time``); ``extras`` are payload columns written but not
+    compared for matching — but if an existing entry has the same key
+    with *different* payload, the insert is a **conflict** (e.g. two
+    SNAT'd flows whose hash-derived ports collide on the same reply
+    5-tuple): the entry is left untouched (no time refresh — the
+    original flow owns the slot) and the packet is flagged so the caller
+    can fail closed.
     """
     n_slots = valid.shape[0]
     p_idx = jnp.arange(h.shape[0], dtype=jnp.int32)
@@ -106,6 +112,12 @@ def hashmap_insert(
     def key_at(idx):
         same = valid[idx] == 1
         for arr, val in zip(keys, key_vals):
+            same = same & (arr[idx] == val)
+        return same
+
+    def payload_at(idx):
+        same = jnp.ones(idx.shape, bool)
+        for arr, val in zip(extras, extra_vals):
             same = same & (arr[idx] == val)
         return same
 
@@ -119,7 +131,9 @@ def hashmap_insert(
         same = key_at(idx)
         exist_idx = jnp.where(same & ~exists, idx, exist_idx)
         exists = exists | same
-    refresh = want & exists
+    same_payload = payload_at(exist_idx)
+    conflict = want & exists & ~same_payload
+    refresh = want & exists & same_payload
     time = time.at[jnp.where(refresh, exist_idx, n_slots)].set(now, mode="drop")
     pending = want & ~exists
     inserted = refresh
@@ -146,10 +160,16 @@ def hashmap_insert(
         )
         valid = valid.at[widx].set(1, mode="drop")
         time = time.at[widx].set(now, mode="drop")
-        done = pending & key_at(idx)
+        # A pending packet whose key now occupies the slot is satisfied
+        # only if the stored payload is its own; otherwise a *different*
+        # flow in this same vector won the key (intra-batch reply-key
+        # collision) — flag it so the caller fails closed.
+        done_key = pending & key_at(idx)
+        done = done_key & payload_at(idx)
+        conflict = conflict | (done_key & ~payload_at(idx))
         inserted = inserted | done
-        pending = pending & ~done
-    return valid, time, keys, extras, inserted
+        pending = pending & ~done_key
+    return valid, time, keys, extras, inserted, conflict
 
 
 def session_insert(
@@ -172,7 +192,7 @@ def session_insert(
         pkts.proto,
     )
     h = _hash(*key_vals, n_slots)
-    valid, time, keys, _, inserted = hashmap_insert(
+    valid, time, keys, _, inserted, _ = hashmap_insert(
         tables.sess_valid,
         tables.sess_time,
         (tables.sess_src, tables.sess_dst, tables.sess_ports, tables.sess_proto),

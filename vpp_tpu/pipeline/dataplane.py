@@ -191,10 +191,11 @@ class Dataplane:
             raise RuntimeError("set_vtep() before encap_remote()")
         if self._encap is None:
             self._encap = jax.jit(vxlan_encap)
-        # All REMOTE-disposed traffic encaps here: in a standalone node the
-        # VXLAN mesh is the only inter-node fabric (ICI handoff is the
-        # ClusterDataplane's job, which gates on disp the same way).
-        mask = result.disp == int(Disposition.REMOTE)
+        # Encap only REMOTE traffic with a VTEP next_hop (fabric peers
+        # and edge peers with an explicit tunnel endpoint): routes with
+        # next_hop 0 — e.g. the SNAT'd default route — leave as plain IP
+        # out the uplink; encapping them would emit VXLAN toward dst 0.
+        mask = (result.disp == int(Disposition.REMOTE)) & (result.next_hop != 0)
         return self._encap(result.pkts, mask, vtep, result.next_hop)
 
     # --- session aging (host loop; reference: VPP session/NAT timers) ---

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 from vpp_tpu.ops.acl import acl_classify_global, acl_classify_local
 from vpp_tpu.ops.fib import ip4_lookup
 from vpp_tpu.ops.ip4 import ip4_input
-from vpp_tpu.ops.nat44 import nat44_dnat, nat44_record, nat44_reverse
+from vpp_tpu.ops.nat44 import nat44_dnat, nat44_record, nat44_reverse, nat44_snat
 from vpp_tpu.ops.session import session_insert, session_lookup_reverse
 from vpp_tpu.pipeline.tables import DataplaneTables
 from vpp_tpu.pipeline.vector import Disposition, PacketVector
@@ -35,6 +35,12 @@ class StepStats(NamedTuple):
     drop_acl: jnp.ndarray      # int32 scalar: policy denies
     drop_no_route: jnp.ndarray  # int32 scalar: FIB misses
     punt: jnp.ndarray          # int32 scalar: packets punted to host stack
+    dnat: jnp.ndarray          # int32 scalar: DNAT translations applied
+    snat: jnp.ndarray          # int32 scalar: SNAT translations applied
+    nat_reversed: jnp.ndarray  # int32 scalar: reply-path un-NAT hits
+    drop_nat: jnp.ndarray      # int32 scalar: NAT fail-closed drops
+                               # (SNAT port collision / un-NATable proto
+                               # on an SNAT egress route)
     if_rx: jnp.ndarray         # int32 [I] per-interface rx packets
     if_tx: jnp.ndarray         # int32 [I] per-interface tx packets
     if_rx_bytes: jnp.ndarray   # int32 [I]
@@ -48,6 +54,7 @@ DROP_IP4 = 1        # ip4-input: TTL/length/bad interface
 DROP_ACL = 2        # policy deny
 DROP_NO_ROUTE = 3   # FIB miss
 DROP_FIB = 4        # matched a drop route
+DROP_NAT = 5        # NAT fail-closed (port collision / un-NATable proto)
 
 DROP_CAUSE_NAMES = {
     DROP_NONE: "none",
@@ -55,6 +62,7 @@ DROP_CAUSE_NAMES = {
     DROP_ACL: "acl-deny",
     DROP_NO_ROUTE: "no-route",
     DROP_FIB: "fib-drop",
+    DROP_NAT: "nat-drop",
 }
 
 
@@ -69,6 +77,7 @@ class StepResult(NamedTuple):
     drop_cause: jnp.ndarray    # int32 [P] DROP_* attribution (0 = none)
     established: jnp.ndarray   # bool [P] admitted via reflective session
     dnat_applied: jnp.ndarray  # bool [P] DNAT rewrote the destination
+    snat_applied: jnp.ndarray  # bool [P] SNAT rewrote the source
 
 
 def pipeline_step(
@@ -102,7 +111,9 @@ def pipeline_step(
     # --- NAT44: reverse-translate return traffic, then DNAT new flows ---
     pkts, nat_reversed = nat44_reverse(tables, pkts, alive)
     orig_dst, orig_dport = pkts.dst_ip, pkts.dport
-    pkts, dnat_applied = nat44_dnat(tables, pkts, alive & ~nat_reversed)
+    pkts, dnat_applied, dnat_self_snat = nat44_dnat(
+        tables, pkts, alive & ~nat_reversed
+    )
 
     # --- ACL classify (local per-interface table + node-global table) ---
     local_v = acl_classify_local(tables, pkts)
@@ -118,20 +129,53 @@ def pipeline_step(
     disp = jnp.where(forwarded, fib.disp, int(Disposition.DROP)).astype(jnp.int32)
     tx_if = jnp.where(forwarded, fib.tx_if, -1)
 
-    # --- session install for newly permitted L4 flows only (denied packets
-    # must not consume session slots) ---
+    # --- SNAT for cluster-egress flows (routes marked snat) and for
+    # self-snat DNAT mappings (nodeports: the backend's reply must return
+    # through this node for un-DNAT even when the backend is remote).
+    # New outbound flows only: reply traffic (un-NAT'd above, or admitted
+    # via a reflective session) must keep its translated/original source.
+    # Reference: configurator_impl.go:258-264 SNAT pool.
     is_l4 = (pkts.proto == 6) | (pkts.proto == 17)
-    want_sess = forwarded & ~established & is_l4
-    tables, _ = session_insert(tables, pkts, want_sess, now)
-    tables = nat44_record(
-        tables, pkts, orig_dst, orig_dport, dnat_applied & forwarded, now
+    nat_capable = is_l4 | (pkts.proto == 1)  # icmp: src-only translation
+    fresh = ~nat_reversed & ~established
+    orig_src, orig_sport = pkts.src_ip, pkts.sport
+    want_snat = forwarded & fresh & nat_capable & (fib.snat | dnat_self_snat)
+    pkts, snat_applied = nat44_snat(tables, pkts, want_snat)
+    # A protocol NAT can't translate, leaving via an SNAT route, would
+    # leak the pod's private source address — fail closed.
+    nat_unsupported = (
+        forwarded & fresh & ~nat_capable & fib.snat
+        & (tables.nat_snat_ip != 0)
     )
+
+    # --- session install for newly permitted flows only (denied packets
+    # must not consume session slots); keys are post-NAT so replies match ---
+    want_sess = forwarded & ~established & nat_capable & ~nat_unsupported
+    tables, _ = session_insert(tables, pkts, want_sess, now)
+    nat_kind = (
+        jnp.where(dnat_applied, 1, 0) + jnp.where(snat_applied, 2, 0)
+    ).astype(jnp.int32)
+    tables, nat_conflict = nat44_record(
+        tables, pkts, orig_dst, orig_dport, orig_src, orig_sport, nat_kind,
+        (dnat_applied | snat_applied) & forwarded, now,
+    )
+    # Fail closed on reply-key collisions (two SNAT'd flows hashed onto
+    # the same external port): misdelivering replies to the wrong pod is
+    # worse than dropping the colliding flow — drops are counted.
+    dropped_nat = nat_conflict | nat_unsupported
+    forwarded = forwarded & ~dropped_nat
+    disp = jnp.where(dropped_nat, int(Disposition.DROP), disp).astype(jnp.int32)
+    tx_if = jnp.where(dropped_nat, -1, tx_if)
 
     # --- counters ---
     fib_dropped = alive & permit & fib.matched & (
         fib.disp == int(Disposition.DROP)
     )
-    dropped = (pkts.valid & (drop_ip4 | drop_acl | drop_no_route)) | fib_dropped
+    dropped = (
+        (pkts.valid & (drop_ip4 | drop_acl | drop_no_route))
+        | fib_dropped
+        | dropped_nat
+    )
     rx_if_safe = jnp.where(alive, pkts.rx_if, n_ifaces)
     tx_if_safe = jnp.where(forwarded, tx_if, n_ifaces)
     drop_if_safe = jnp.where(dropped, pkts.rx_if, n_ifaces)
@@ -145,6 +189,10 @@ def pipeline_step(
         punt=jnp.sum(
             (forwarded & (disp == int(Disposition.HOST))).astype(jnp.int32)
         ),
+        dnat=jnp.sum((dnat_applied & forwarded).astype(jnp.int32)),
+        snat=jnp.sum((snat_applied & forwarded).astype(jnp.int32)),
+        nat_reversed=jnp.sum((nat_reversed & forwarded).astype(jnp.int32)),
+        drop_nat=jnp.sum(dropped_nat.astype(jnp.int32)),
         if_rx=zero_i.at[rx_if_safe].add(1, mode="drop"),
         if_tx=zero_i.at[tx_if_safe].add(1, mode="drop"),
         if_rx_bytes=zero_i.at[rx_if_safe].add(
@@ -160,6 +208,7 @@ def pipeline_step(
         + jnp.where(drop_acl, DROP_ACL, 0)
         + jnp.where(drop_no_route, DROP_NO_ROUTE, 0)
         + jnp.where(fib_dropped, DROP_FIB, 0)
+        + jnp.where(dropped_nat, DROP_NAT, 0)
     ).astype(jnp.int32)
     return StepResult(
         pkts=pkts,
@@ -172,6 +221,7 @@ def pipeline_step(
         drop_cause=drop_cause,
         established=established,
         dnat_applied=dnat_applied,
+        snat_applied=snat_applied,
     )
 
 

@@ -92,6 +92,9 @@ class DataplaneTables(NamedTuple):
     fib_disp: jnp.ndarray       # int32 Disposition
     fib_next_hop: jnp.ndarray   # uint32 (peer/VXLAN dst IP, else 0)
     fib_node_id: jnp.ndarray    # int32 remote node index (ICI), -1 local
+    fib_snat: jnp.ndarray       # int32 bool: cluster-egress route — SNAT
+                                # applies (reference: configurator_impl.go
+                                # :258-264 SNAT pool for external traffic)
 
     # --- reflective sessions (open-addressing hash) [S] ---
     sess_src: jnp.ndarray       # uint32
@@ -108,13 +111,17 @@ class DataplaneTables(NamedTuple):
     nat_boff: jnp.ndarray       # int32 offset into backend arrays
     nat_bcnt: jnp.ndarray       # int32 backend count (0 = empty slot)
     nat_total_w: jnp.ndarray    # int32 total backend weight
+    nat_self_snat: jnp.ndarray  # int32 bool [M]: DNAT'd flows of this
+                                # mapping are also SNAT'd (nodeport case:
+                                # the reply must return via this node)
     natb_ip: jnp.ndarray        # uint32 [B]
     natb_port: jnp.ndarray      # int32 [B]
     natb_cumw: jnp.ndarray      # int32 [B] cumulative weight within mapping
     nat_snat_ip: jnp.ndarray    # uint32 scalar: SNAT address (node IP)
 
     # --- NAT44 session table (reverse translation state) [NS] ---
-    # key: (backend_ip, client_ip, bport<<16|cport, proto)
+    # key: the flow as the *reply* will present it,
+    # (reply_src_ip, reply_dst_ip, reply_sport<<16|reply_dport, proto)
     natsess_a: jnp.ndarray          # uint32
     natsess_b: jnp.ndarray          # uint32
     natsess_ports: jnp.ndarray      # uint32
@@ -123,6 +130,9 @@ class DataplaneTables(NamedTuple):
     natsess_time: jnp.ndarray       # int32
     natsess_orig_ip: jnp.ndarray    # uint32 original dst (service VIP)
     natsess_orig_port: jnp.ndarray  # int32 original dst port
+    natsess_src_ip: jnp.ndarray     # uint32 original src (pre-SNAT pod IP)
+    natsess_sport: jnp.ndarray      # int32 original src port
+    natsess_kind: jnp.ndarray       # int32 bitmask: 1=DNAT'd, 2=SNAT'd
 
 
 def _mask_of(plen: int, bits: int = 32) -> int:
@@ -138,7 +148,8 @@ SESSION_FIELDS: Dict[str, type] = {
     "natsess_a": np.uint32, "natsess_b": np.uint32, "natsess_ports": np.uint32,
     "natsess_proto": np.int32, "natsess_valid": np.int32,
     "natsess_time": np.int32, "natsess_orig_ip": np.uint32,
-    "natsess_orig_port": np.int32,
+    "natsess_orig_port": np.int32, "natsess_src_ip": np.uint32,
+    "natsess_sport": np.int32, "natsess_kind": np.int32,
 }
 
 
@@ -228,12 +239,14 @@ class TableBuilder:
         self.fib_disp = np.full(c.fib_slots, int(Disposition.DROP), np.int32)
         self.fib_next_hop = z(c.fib_slots, np.uint32)
         self.fib_node_id = np.full(c.fib_slots, -1, np.int32)
+        self.fib_snat = z(c.fib_slots, np.int32)
         self.nat_ext_ip = z(c.nat_mappings, np.uint32)
         self.nat_ext_port = z(c.nat_mappings, np.int32)
         self.nat_proto = z(c.nat_mappings, np.int32)
         self.nat_boff = z(c.nat_mappings, np.int32)
         self.nat_bcnt = z(c.nat_mappings, np.int32)
         self.nat_total_w = z(c.nat_mappings, np.int32)
+        self.nat_self_snat = z(c.nat_mappings, np.int32)
         self.natb_ip = z(c.nat_backends, np.uint32)
         self.natb_port = z(c.nat_backends, np.int32)
         self.natb_cumw = z(c.nat_backends, np.int32)
@@ -286,6 +299,7 @@ class TableBuilder:
         next_hop: int = 0,
         node_id: int = -1,
         slot: Optional[int] = None,
+        snat: bool = False,
     ) -> int:
         net = ipaddress.ip_network(prefix)
         if slot is None:
@@ -301,6 +315,7 @@ class TableBuilder:
         self.fib_disp[slot] = int(disposition)
         self.fib_next_hop[slot] = next_hop
         self.fib_node_id[slot] = node_id
+        self.fib_snat[slot] = int(snat)
         return slot
 
     def del_route(self, prefix: str) -> bool:
@@ -324,6 +339,7 @@ class TableBuilder:
         proto: int,
         backends: Sequence[Tuple[int, int, int]],  # (ip, port, weight)
         boff: int,
+        self_snat: bool = False,
     ) -> None:
         """Install a DNAT static mapping with weighted backends at ``slot``,
         placing backends at ``boff`` in the backend arrays."""
@@ -341,9 +357,16 @@ class TableBuilder:
         self.nat_boff[slot] = boff
         self.nat_bcnt[slot] = len(backends)
         self.nat_total_w[slot] = cum
+        self.nat_self_snat[slot] = int(self_snat)
 
     def clear_nat(self) -> None:
         self.nat_bcnt[:] = 0
+
+    def set_snat_ip(self, ip: int) -> None:
+        """Set the node's SNAT address (0 disables SNAT). The single
+        mutation point for ``nat_snat_ip`` — agent bootstrap and the
+        service configurator both route through here."""
+        self.nat_snat_ip = np.uint32(ip)
 
     # --- device upload ---
     def host_arrays(self) -> Dict[str, np.ndarray]:
@@ -386,12 +409,14 @@ class TableBuilder:
             fib_disp=self.fib_disp,
             fib_next_hop=self.fib_next_hop,
             fib_node_id=self.fib_node_id,
+            fib_snat=self.fib_snat,
             nat_ext_ip=self.nat_ext_ip,
             nat_ext_port=self.nat_ext_port,
             nat_proto=self.nat_proto,
             nat_boff=self.nat_boff,
             nat_bcnt=self.nat_bcnt,
             nat_total_w=self.nat_total_w,
+            nat_self_snat=self.nat_self_snat,
             natb_ip=self.natb_ip,
             natb_port=self.natb_port,
             natb_cumw=self.natb_cumw,

@@ -65,7 +65,7 @@ class ServiceConfigurator:
 
     def set_snat_ip(self, ip: str) -> None:
         with self.dataplane.commit_lock:
-            self.dataplane.builder.nat_snat_ip = np.uint32(ip4(ip))
+            self.dataplane.builder.set_snat_ip(ip4(ip))
             self.dataplane.swap()
 
     def resync(self, services: List[ContivService]) -> None:
@@ -90,25 +90,31 @@ class ServiceConfigurator:
                 weighted = self._weighted_backends(svc, backends)
                 if not weighted:
                     continue
-                frontends: List[Tuple[int, int]] = []
+                # (frontend ip, frontend port, self_snat): nodeport
+                # frontends are marked self-snat so flows DNAT'd to a
+                # remote backend also get source-NAT'd — the backend's
+                # reply must return through this node for un-DNAT
+                # (reference nodeport/TwoNodeNAT semantics).
+                frontends: List[Tuple[int, int, bool]] = []
                 if svc.cluster_ip:
-                    frontends.append((ip4(svc.cluster_ip), spec.port))
+                    frontends.append((ip4(svc.cluster_ip), spec.port, False))
                 for ext in svc.external_ips:
-                    frontends.append((ip4(ext), spec.port))
+                    frontends.append((ip4(ext), spec.port, False))
                 if spec.node_port:
                     for nip in self.node_ips:
-                        frontends.append((ip4(nip), spec.node_port))
+                        frontends.append((ip4(nip), spec.node_port, True))
 
                 proto = _PROTO_NUM.get(spec.protocol.upper(), 6)
                 # All frontends of this service port share one backend range.
                 n = len(weighted)
                 if boff + n > cfg.nat_backends:
                     raise RuntimeError("NAT backend capacity exhausted")
-                for ext_ip, ext_port in frontends:
+                for ext_ip, ext_port, self_snat in frontends:
                     if slot >= cfg.nat_mappings:
                         raise RuntimeError("NAT mapping capacity exhausted")
                     builder.set_nat_mapping(
-                        slot, ext_ip, ext_port, proto, weighted, boff=boff
+                        slot, ext_ip, ext_port, proto, weighted, boff=boff,
+                        self_snat=self_snat,
                     )
                     slot += 1
                 boff += n
