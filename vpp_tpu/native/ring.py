@@ -1,4 +1,4 @@
-"""ctypes bindings for the native frame ring (native/frame_ring.cpp).
+"""ctypes bindings for the native frame ring (vpp_tpu/native/frame_ring.cpp).
 
 The ring lives in caller-provided shared memory
 (multiprocessing.shared_memory for cross-process, a plain bytearray for
@@ -34,9 +34,18 @@ RING_COLUMNS: Tuple[Tuple[str, type], ...] = (
     ("flags", np.int32),
 )
 
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-_SRC = os.path.join(_REPO_ROOT, "native", "frame_ring.cpp")
-_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+# Source ships inside the package so installed wheels can build it
+# (cache goes to a writable build dir beside the source, or TMPDIR when
+# the package directory is read-only, e.g. a system site-packages).
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_PKG_DIR, "frame_ring.cpp")
+_BUILD_DIR = (
+    os.path.join(_PKG_DIR, "build")
+    if os.access(_PKG_DIR, os.W_OK)
+    else os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), f"vpp_tpu_native_{os.getuid()}"
+    )
+)
 _LIB = os.path.join(_BUILD_DIR, "libframering.so")
 
 _build_lock = threading.Lock()
