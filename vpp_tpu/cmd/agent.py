@@ -59,13 +59,19 @@ class ContivAgent:
     def __init__(self, config: Optional[AgentConfig] = None,
                  store: Optional[KVStore] = None):
         """``store`` injection lets tests (and multi-agent simulations)
-        share one in-memory store; production passes None and gets a
-        persisted local store (the ETCD-client analog)."""
+        share one in-memory store; production passes None and gets the
+        configured backend — a RemoteKVStore against the cluster's
+        KVServer when ``store_url`` is set (the deployed-etcd analog),
+        else a persisted local store."""
         self.config = config or AgentConfig()
         c = self.config
 
         # --- data store + proxy (cn-infra kvdbsync analog) ---
-        self.store = store or KVStore(persist_path=c.persist_path)
+        if store is None:
+            from vpp_tpu.kvstore.client import connect_store
+
+            store = connect_store(c.store_url, persist_path=c.persist_path)
+        self.store = store
         self.proxy = KVProxy(self.store)
         self._watch_cancels = []
 

@@ -18,8 +18,10 @@ from vpp_tpu.pipeline.tables import DataplaneConfig
 @dataclasses.dataclass
 class AgentConfig:
     node_name: str = "node-1"
-    # data store
-    persist_path: Optional[str] = None       # kvstore snapshot file
+    # data store: "" = in-process store (dev/tests); "tcp://host:port" =
+    # shared KVServer (the deployed-etcd analog, k8s/contiv-vpp.yaml:72-114)
+    store_url: str = ""
+    persist_path: Optional[str] = None       # in-process store snapshot file
     # CNI
     cni_socket: str = "/run/vpp-tpu/cni.sock"
     # observability / health

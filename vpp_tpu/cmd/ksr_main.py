@@ -33,11 +33,16 @@ class KsrAgent:
         store: Optional[KVStore] = None,
         sources: Optional[Dict[str, K8sListWatch]] = None,
         persist_path: Optional[str] = None,
+        store_url: str = "",
         stats_port: int = 9998,
         health_port: int = 9192,
         serve_http: bool = True,
     ):
-        self.store = store or KVStore(persist_path=persist_path)
+        if store is None:
+            from vpp_tpu.kvstore.client import connect_store
+
+            store = connect_store(store_url, persist_path=persist_path)
+        self.store = store
         self.broker = Broker(self.store, "ksr/")
         self.sources = sources if sources is not None else {}
         self.registry: ReflectorRegistry = make_standard_reflectors(
@@ -84,9 +89,24 @@ def main(argv=None) -> int:
 
     parser = argparse.ArgumentParser(prog="vpp-tpu-ksr")
     parser.add_argument("--persist", default=None, help="store snapshot path")
+    parser.add_argument(
+        "--store-url", default="",
+        help="shared store, e.g. tcp://kvstore:12379 ('' = in-process)",
+    )
+    parser.add_argument(
+        "--kubeconfig", default=None,
+        help="reflect a real K8s API server (default: no sources)",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
-    agent = KsrAgent(persist_path=args.persist)
+    sources = None
+    if args.kubeconfig:
+        from vpp_tpu.ksr.k8s_client import make_k8s_sources
+
+        sources = make_k8s_sources(kubeconfig=args.kubeconfig)
+    agent = KsrAgent(
+        persist_path=args.persist, store_url=args.store_url, sources=sources
+    )
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: stop.set())

@@ -1,0 +1,51 @@
+"""vpp-tpu-kvstore: the cluster-shared data store daemon.
+
+Deployment analog of the reference's etcd DaemonSet
+(/root/reference/k8s/contiv-vpp.yaml:72-114): a single served KVStore
+that every KSR and agent process connects to via
+``tcp://host:port`` store URLs, with file-snapshot durability standing
+in for etcd's WAL.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+
+from vpp_tpu.kvstore.server import KVServer
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="vpp-tpu kvstore server")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=12379)
+    parser.add_argument("--persist", default=None,
+                        help="snapshot file for durability")
+    parser.add_argument("--log-level", default="INFO")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=args.log_level,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    server = KVServer(host=args.host, port=args.port,
+                      persist_path=args.persist)
+
+    # Serve from a worker thread: calling shutdown() from the thread
+    # running serve_forever() deadlocks, and a signal handler runs on
+    # the main thread — so the main thread must only wait.
+    import threading
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    server.start()
+    stop.wait()
+    server.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

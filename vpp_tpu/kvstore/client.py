@@ -1,0 +1,335 @@
+"""RemoteKVStore: KVStore-interface client for a KVServer.
+
+Drop-in replacement for ``KVStore`` (duck-typed: ``Broker``, ``KVProxy``,
+the node-ID allocator, IPAM persistence and the agent watch bridge all
+work unchanged), backed by a TCP connection to ``kvstore.server.KVServer``
+— the deployed-etcd analog (reference: etcd DaemonSet
+/root/reference/k8s/contiv-vpp.yaml:72-114, consumed through cn-infra
+kvdbsync clones flavors/contiv/contiv_flavor.go:128-138).
+
+Threading model:
+  * caller threads send requests and block on per-request events;
+  * one reader thread demultiplexes responses (by id) and watch pushes;
+  * one dispatcher thread delivers watch events in arrival (= revision)
+    order. Callbacks may freely call back into the store: their requests
+    are answered by the reader thread, which never runs callbacks.
+
+Reconnect: on connection loss the client reconnects with capped backoff
+and re-registers every watch snapshot-atomically. Each watch's optional
+``on_resync(snapshot, rev)`` hook is invoked with the fresh snapshot so
+consumers can mark-and-sweep state that changed during the outage — the
+reference KSR's reconnect behavior (plugins/ksr/ksr_reflector.go:185-232).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import queue
+import socket
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from vpp_tpu.kvstore.server import decode_event
+from vpp_tpu.kvstore.store import WatchCallback
+
+log = logging.getLogger("kvclient")
+
+ResyncCallback = Callable[[Dict[str, Any], int], None]
+
+_STOP = object()
+
+
+class _Watch:
+    __slots__ = ("wid", "prefix", "callback", "on_resync", "active")
+
+    def __init__(self, wid: int, prefix: str, callback: WatchCallback,
+                 on_resync: Optional[ResyncCallback]):
+        self.wid = wid
+        self.prefix = prefix
+        self.callback = callback
+        self.on_resync = on_resync
+        self.active = True
+
+
+class RemoteKVStore:
+    def __init__(self, host: str, port: int,
+                 request_timeout: float = 10.0,
+                 reconnect_timeout: float = 30.0,
+                 reconnect_backoff: Tuple[float, float] = (0.1, 2.0)):
+        self.host = host
+        self.port = port
+        self.request_timeout = request_timeout
+        self.reconnect_timeout = reconnect_timeout
+        self.reconnect_backoff = reconnect_backoff
+
+        self._ids = itertools.count(1)
+        self._wids = itertools.count(1)
+        self._lock = threading.Lock()          # connection + pending state
+        self._sock: Optional[socket.socket] = None
+        self._pending: Dict[int, "queue.Queue[Any]"] = {}
+        self._watches: Dict[int, _Watch] = {}
+        self._closed = False
+
+        self._events: "queue.Queue[Any]" = queue.Queue()
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True, name="kv-dispatch"
+        )
+        self._dispatcher.start()
+        self._reader: Optional[threading.Thread] = None
+        self._connect(deadline=time.monotonic() + reconnect_timeout)
+
+    # --- connection management ---
+    def _connect(self, deadline: float) -> None:
+        backoff, cap = self.reconnect_backoff
+        while True:
+            if self._closed:
+                raise ConnectionError("client closed")
+            try:
+                sock = socket.create_connection(
+                    (self.host, self.port), timeout=self.request_timeout
+                )
+                sock.settimeout(None)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                break
+            except OSError as exc:
+                if time.monotonic() >= deadline:
+                    raise ConnectionError(
+                        f"kvserver {self.host}:{self.port} unreachable: {exc}"
+                    ) from exc
+                time.sleep(min(backoff, cap))
+                backoff *= 2
+        with self._lock:
+            self._sock = sock
+            self._reader = threading.Thread(
+                target=self._read_loop, args=(sock,), daemon=True,
+                name="kv-reader",
+            )
+            self._reader.start()
+        self._reregister_watches()
+
+    def _read_loop(self, sock: socket.socket) -> None:
+        buf = b""
+        try:
+            while True:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if not line.strip():
+                        continue
+                    msg = json.loads(line)
+                    if "watch_id" in msg and "event" in msg:
+                        self._events.put(msg)
+                    else:
+                        q = self._pending.pop(msg.get("id"), None)
+                        if q is not None:
+                            q.put(msg)
+        except OSError:
+            pass
+        finally:
+            self._on_disconnect(sock)
+
+    def _on_disconnect(self, sock: socket.socket) -> None:
+        with self._lock:
+            if self._sock is not sock:
+                return  # stale reader from a previous connection
+            self._sock = None
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for q in pending:
+            q.put({"ok": False, "error": "connection lost", "_conn": True})
+        if self._closed:
+            return
+        log.warning("kvserver connection lost; reconnecting")
+        threading.Thread(
+            target=self._reconnect_loop, daemon=True, name="kv-reconnect"
+        ).start()
+
+    def _reconnect_loop(self) -> None:
+        try:
+            self._connect(deadline=time.monotonic() + self.reconnect_timeout)
+            log.info("kvserver reconnected")
+        except ConnectionError as exc:
+            log.error("kvserver reconnect failed: %s", exc)
+
+    def _reregister_watches(self) -> None:
+        with self._lock:
+            watches = [w for w in self._watches.values() if w.active]
+        for w in watches:
+            try:
+                res = self._request(
+                    "watch", prefix=w.prefix, watch_id=w.wid
+                )
+            except ConnectionError:
+                return  # next reconnect will retry
+            if w.on_resync is not None:
+                self._events.put(("resync", w, res["snapshot"], res["rev"]))
+
+    # --- request plumbing ---
+    def _request(self, op: str, **kw: Any) -> Any:
+        rid = next(self._ids)
+        msg = {"id": rid, "op": op, **kw}
+        data = json.dumps(msg, separators=(",", ":")).encode() + b"\n"
+        deadline = time.monotonic() + self.request_timeout
+        while True:
+            with self._lock:
+                sock = self._sock
+                if sock is not None:
+                    q: "queue.Queue[Any]" = queue.Queue()
+                    self._pending[rid] = q
+            if sock is None:
+                if self._closed or time.monotonic() >= deadline:
+                    raise ConnectionError("kvserver not connected")
+                time.sleep(0.05)
+                continue
+            try:
+                sock.sendall(data)
+            except OSError:
+                self._pending.pop(rid, None)
+                time.sleep(0.05)
+                continue
+            try:
+                resp = q.get(timeout=max(0.0, deadline - time.monotonic()))
+            except queue.Empty:
+                self._pending.pop(rid, None)
+                raise TimeoutError(f"kvserver request {op!r} timed out")
+            if resp.get("_conn"):
+                # Connection died mid-request. Mutating ops may or may not
+                # have applied; surface that instead of blindly retrying.
+                raise ConnectionError("connection lost during request")
+            if not resp.get("ok"):
+                raise RuntimeError(f"kvserver error: {resp.get('error')}")
+            return resp.get("result")
+
+    # --- watch event dispatch (single thread, arrival order) ---
+    def _dispatch_loop(self) -> None:
+        while True:
+            item = self._events.get()
+            if item is _STOP:
+                return
+            try:
+                if isinstance(item, tuple) and item[0] == "resync":
+                    _, w, snapshot, rev = item
+                    if w.active and w.on_resync is not None:
+                        w.on_resync(snapshot, rev)
+                    continue
+                w = self._watches.get(item["watch_id"])
+                if w is not None and w.active:
+                    w.callback(decode_event(item["event"]))
+            except Exception:  # noqa: BLE001 — keep dispatching
+                log.exception("watch callback raised")
+
+    # --- KVStore interface ---
+    @property
+    def persist_path(self) -> Optional[str]:
+        return None  # durability lives server-side
+
+    def get(self, key: str) -> Any:
+        return self._request("get", key=key)
+
+    def put(self, key: str, value: Any) -> int:
+        return self._request("put", key=key, value=value)
+
+    def delete(self, key: str) -> bool:
+        return self._request("delete", key=key)
+
+    def compare_and_put(self, key: str, expected: Any, value: Any) -> bool:
+        return self._request("cas", key=key, expected=expected, value=value)
+
+    def compare_and_delete(self, key: str, expected: Any) -> bool:
+        return self._request("cad", key=key, expected=expected)
+
+    def list_values(self, prefix: str = "") -> Dict[str, Any]:
+        return self._request("list", prefix=prefix)
+
+    def list_keys(self, prefix: str = "") -> List[str]:
+        return self._request("list_keys", prefix=prefix)
+
+    @property
+    def revision(self) -> int:
+        return self._request("rev")
+
+    def save(self, path: Optional[str] = None) -> None:
+        self._request("save")
+
+    def ping(self) -> bool:
+        return self._request("ping") == "pong"
+
+    def watch(self, prefix: str, callback: WatchCallback,
+              on_resync: Optional[ResyncCallback] = None
+              ) -> Callable[[], None]:
+        wid = next(self._wids)
+        w = _Watch(wid, prefix, callback, on_resync)
+        with self._lock:
+            self._watches[wid] = w
+        self._request("watch", prefix=prefix, watch_id=wid)
+
+        def cancel() -> None:
+            w.active = False
+            with self._lock:
+                self._watches.pop(wid, None)
+            try:
+                self._request("unwatch", watch_id=wid)
+            except (ConnectionError, TimeoutError, RuntimeError):
+                pass  # server side is cleaned up on disconnect anyway
+
+        return cancel
+
+    def watch_with_snapshot(
+        self, prefix: str, callback: WatchCallback
+    ) -> Tuple[Dict[str, Any], int, Callable[[], None]]:
+        wid = next(self._wids)
+        w = _Watch(wid, prefix, callback, None)
+        with self._lock:
+            self._watches[wid] = w
+        res = self._request("watch", prefix=prefix, watch_id=wid)
+
+        def cancel() -> None:
+            w.active = False
+            with self._lock:
+                self._watches.pop(wid, None)
+            try:
+                self._request("unwatch", watch_id=wid)
+            except (ConnectionError, TimeoutError, RuntimeError):
+                pass
+
+        return res["snapshot"], res["rev"], cancel
+
+    def close(self) -> None:
+        self._closed = True
+        with self._lock:
+            sock = self._sock
+            self._sock = None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._events.put(_STOP)
+
+
+def connect_store(url: Optional[str],
+                  persist_path: Optional[str] = None,
+                  **kw: Any):
+    """Build the configured store backend.
+
+    ``url`` forms:
+      * ``None`` / ``""``      -> in-process KVStore (dev / unit tests)
+      * ``"tcp://host:port"``  -> RemoteKVStore against a KVServer
+    """
+    if not url:
+        from vpp_tpu.kvstore.store import KVStore
+
+        return KVStore(persist_path=persist_path)
+    if url.startswith("tcp://"):
+        hostport = url[len("tcp://"):]
+        host, _, port = hostport.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(f"bad store url: {url!r}")
+        return RemoteKVStore(host, int(port), **kw)
+    raise ValueError(f"unsupported store url scheme: {url!r}")

@@ -1,0 +1,219 @@
+"""KVServer: serve a KVStore over TCP so state spans processes and nodes.
+
+This is the deployment analog of the reference's etcd DaemonSet
+(/root/reference/k8s/contiv-vpp.yaml:72-114): one served store per
+cluster, with every agent/KSR process connecting through
+``vpp_tpu.kvstore.client.RemoteKVStore``. The wire protocol is
+newline-delimited JSON frames:
+
+  request   {"id": N, "op": "...", ...}        -> {"id": N, "ok": true, "result": ...}
+  watch push                                     {"watch_id": W, "event": {...}}
+
+Watch registration is snapshot-atomic (``KVStore.watch_with_snapshot``):
+the client receives the current state under the prefix plus the store
+revision, then a gapless event stream — the etcd revisioned list+watch
+contract the reference's kvdbsync resync logic depends on
+(flavors/contiv/contiv_flavor.go:128-138).
+
+Store watch callbacks run under the store lock, so events are only
+*enqueued* there; a per-connection writer thread drains the queue to the
+socket. A slow or dead client therefore never blocks writers.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import socket
+import socketserver
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from vpp_tpu.kvstore.store import KVEvent, KVStore, Op
+
+log = logging.getLogger("kvserver")
+
+_SENTINEL = object()
+
+
+def encode_event(ev: KVEvent) -> Dict[str, Any]:
+    return {
+        "op": ev.op.value,
+        "key": ev.key,
+        "value": ev.value,
+        "prev_value": ev.prev_value,
+        "rev": ev.rev,
+    }
+
+
+def decode_event(d: Dict[str, Any]) -> KVEvent:
+    return KVEvent(
+        Op(d["op"]), d["key"], d.get("value"), d.get("prev_value"), d["rev"]
+    )
+
+
+class _Conn(socketserver.BaseRequestHandler):
+    """One client connection: request loop + watch push queue."""
+
+    def setup(self) -> None:
+        self.request.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.server.live_conns.add(self.request)  # type: ignore[attr-defined]
+        self._out: "queue.Queue[Any]" = queue.Queue()
+        self._watch_cancels: Dict[int, Callable[[], None]] = {}
+        self._writer = threading.Thread(target=self._drain, daemon=True)
+        self._writer.start()
+
+    def _drain(self) -> None:
+        while True:
+            item = self._out.get()
+            if item is _SENTINEL:
+                return
+            try:
+                self.request.sendall(
+                    json.dumps(item, separators=(",", ":")).encode() + b"\n"
+                )
+            except OSError:
+                return
+
+    def _send(self, obj: Dict[str, Any]) -> None:
+        self._out.put(obj)
+
+    def handle(self) -> None:
+        store: KVStore = self.server.store  # type: ignore[attr-defined]
+        buf = b""
+        while True:
+            try:
+                chunk = self.request.recv(65536)
+            except OSError:
+                return
+            if not chunk:
+                return
+            buf += chunk
+            while b"\n" in buf:
+                line, buf = buf.split(b"\n", 1)
+                if not line.strip():
+                    continue
+                try:
+                    req = json.loads(line)
+                except json.JSONDecodeError:
+                    self._send({"id": None, "ok": False, "error": "bad json"})
+                    continue
+                self._handle_req(store, req)
+
+    def _handle_req(self, store: KVStore, req: Dict[str, Any]) -> None:
+        rid = req.get("id")
+        op = req.get("op")
+        try:
+            if op == "get":
+                res = store.get(req["key"])
+            elif op == "put":
+                res = store.put(req["key"], req.get("value"))
+            elif op == "delete":
+                res = store.delete(req["key"])
+            elif op == "cas":
+                res = store.compare_and_put(
+                    req["key"], req.get("expected"), req.get("value")
+                )
+            elif op == "cad":
+                res = store.compare_and_delete(req["key"], req.get("expected"))
+            elif op == "list":
+                res = store.list_values(req.get("prefix", ""))
+            elif op == "list_keys":
+                res = store.list_keys(req.get("prefix", ""))
+            elif op == "rev":
+                res = store.revision
+            elif op == "save":
+                store.save()
+                res = True
+            elif op == "watch":
+                wid = int(req["watch_id"])
+                # Re-registration of a live wid (client retry racing a
+                # reconnect) must not leak the old store watch or the
+                # client would see every event twice.
+                stale = self._watch_cancels.pop(wid, None)
+                if stale:
+                    stale()
+
+                def push(ev: KVEvent, _wid: int = wid) -> None:
+                    # Runs under the store lock: enqueue only.
+                    self._send({"watch_id": _wid, "event": encode_event(ev)})
+
+                snapshot, rev, cancel = store.watch_with_snapshot(
+                    req.get("prefix", ""), push
+                )
+                self._watch_cancels[wid] = cancel
+                res = {"snapshot": snapshot, "rev": rev}
+            elif op == "unwatch":
+                cancel = self._watch_cancels.pop(int(req["watch_id"]), None)
+                if cancel:
+                    cancel()
+                res = True
+            elif op == "ping":
+                res = "pong"
+            else:
+                raise ValueError(f"unknown op: {op!r}")
+        except Exception as exc:  # noqa: BLE001 — protocol boundary
+            self._send({"id": rid, "ok": False, "error": str(exc)})
+            return
+        self._send({"id": rid, "ok": True, "result": res})
+
+    def finish(self) -> None:
+        self.server.live_conns.discard(self.request)  # type: ignore[attr-defined]
+        for cancel in self._watch_cancels.values():
+            cancel()
+        self._watch_cancels.clear()
+        self._out.put(_SENTINEL)
+
+
+class KVServer:
+    """Threaded TCP front-end for a KVStore (etcd-deployment analog)."""
+
+    def __init__(self, store: Optional[KVStore] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 persist_path: Optional[str] = None):
+        self.store = store or KVStore(persist_path=persist_path)
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), _Conn)
+        self._server.store = self.store  # type: ignore[attr-defined]
+        self._server.live_conns = set()  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> tuple:
+        return self._server.server_address
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> "KVServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="kvserver-accept",
+        )
+        self._thread.start()
+        log.info("kvserver listening on %s:%d", *self._server.server_address)
+        return self
+
+    def serve_forever(self) -> None:
+        log.info("kvserver listening on %s:%d", *self._server.server_address)
+        self._server.serve_forever()
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        # Established connections outlive shutdown() in socketserver; a
+        # "stopped" server must actually disconnect its clients so their
+        # reconnect/resync logic engages.
+        for conn in list(self._server.live_conns):  # type: ignore[attr-defined]
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        if self.store.persist_path:
+            self.store.save()

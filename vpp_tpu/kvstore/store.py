@@ -141,6 +141,25 @@ class KVStore:
 
         return cancel
 
+    def watch_with_snapshot(
+        self, prefix: str, callback: WatchCallback
+    ) -> Tuple[Dict[str, Any], int, Callable[[], None]]:
+        """Atomically snapshot ``prefix`` and subscribe to later changes.
+
+        Returns ``(snapshot, rev, cancel)``. No event with rev <= the
+        returned rev will be delivered, and every change after it will —
+        the list+watch handoff the reference gets from etcd's revisioned
+        Watch (plugins/ksr/ksr_reflector.go:185-232 relies on the same
+        contract for mark-and-sweep resync).
+        """
+        with self._lock:
+            snapshot = {
+                k: v for k, v in self._data.items() if k.startswith(prefix)
+            }
+            rev = self._rev
+            cancel = self.watch(prefix, callback)
+        return snapshot, rev, cancel
+
     def _notify(self, ev: KVEvent) -> None:
         # Called with the lock held; copy so callbacks may (un)subscribe.
         for prefix, cb in list(self._watchers):
