@@ -241,6 +241,14 @@ class FakeK8sApiServer:
     def end_stream(self, path):
         self.watch_queues.setdefault(path, queue.Queue()).put(None)
 
+    def expire_stream(self, path):
+        """Simulate 410 Gone: watch continuity lost, client must re-list."""
+        q = self.watch_queues.setdefault(path, queue.Queue())
+        q.put({"type": "ERROR", "object": {
+            "kind": "Status", "code": 410,
+            "message": "too old resource version"}})
+        q.put(None)
+
     def close(self):
         self.server.shutdown()
         self.server.server_close()
@@ -311,8 +319,10 @@ class TestListWatch:
             fake_k8s.end_stream(POD_PATH)
 
     def test_reconnect_relists_and_diffs(self, fake_k8s):
-        """Stream loss -> re-list; objects that vanished during the outage
-        must be synthesized as deletes (informer semantics)."""
+        """410 Gone -> re-list; objects that vanished during the outage
+        must be synthesized as deletes (informer semantics). A clean
+        stream end (server watch timeout) must NOT re-list — continuity
+        holds via resourceVersion."""
         store = KVStore()
         broker = Broker(store, "ksr/")
         fake_k8s.set_objects(POD_PATH, {"p": POD_JSON})
@@ -329,7 +339,8 @@ class TestListWatch:
                 "spec": {}, "status": {"podIP": "10.1.9.9"},
             }
             fake_k8s.set_objects(POD_PATH, {"q": other})
-            fake_k8s.end_stream(POD_PATH)
+            lists_before = fake_k8s.list_calls.get(POD_PATH, 0)
+            fake_k8s.expire_stream(POD_PATH)
             wait_for(lambda: store.get(pod_key) is None,
                      msg="synthesized delete after re-list")
             wait_for(
@@ -337,6 +348,17 @@ class TestListWatch:
                 is not None,
                 msg="synthesized add after re-list",
             )
+            assert fake_k8s.list_calls[POD_PATH] > lists_before
+
+            # clean end: re-watch only, no re-list
+            wait_for(
+                lambda: POD_PATH in fake_k8s.watch_queues,
+                msg="watch re-established",
+            )
+            lists_before = fake_k8s.list_calls[POD_PATH]
+            fake_k8s.end_stream(POD_PATH)
+            time.sleep(0.4)
+            assert fake_k8s.list_calls[POD_PATH] == lists_before
         finally:
             lw.stop()
             fake_k8s.end_stream(POD_PATH)

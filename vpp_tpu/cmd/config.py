@@ -16,6 +16,18 @@ from vpp_tpu.pipeline.tables import DataplaneConfig
 
 
 @dataclasses.dataclass
+class IOConfig:
+    """Packet-IO front-end (the VPP-process analog): the agent owns the
+    shared-memory frame rings + pump; the vpp-tpu-io daemon attaches by
+    shm name and owns the NIC/TAP endpoints."""
+
+    enabled: bool = False
+    shm_name: str = ""                       # "" = in-process rings (dev)
+    n_slots: int = 64
+    snap: int = 2048                         # payload bytes kept per packet
+
+
+@dataclasses.dataclass
 class AgentConfig:
     node_name: str = "node-1"
     # data store: "" = in-process store (dev/tests); "tcp://host:port" =
@@ -36,6 +48,8 @@ class AgentConfig:
     dataplane: DataplaneConfig = dataclasses.field(default_factory=DataplaneConfig)
     # IPAM subnets
     ipam: IpamConfig = dataclasses.field(default_factory=IpamConfig)
+    # packet IO
+    io: IOConfig = dataclasses.field(default_factory=IOConfig)
 
     @classmethod
     def from_dict(cls, d: dict) -> "AgentConfig":
@@ -56,6 +70,10 @@ class AgentConfig:
         build_section(
             "ipam", IpamConfig,
             {f.name for f in dataclasses.fields(IpamConfig)},
+        )
+        build_section(
+            "io", IOConfig,
+            {f.name for f in dataclasses.fields(IOConfig)},
         )
         known = {f.name for f in dataclasses.fields(cls)}
         unknown = set(d) - known

@@ -1,0 +1,27 @@
+"""Packet-IO front-end: transports, IO daemon, and the dataplane pump.
+
+The piece the reference gets from VPP's input/output graph nodes plus
+its DPDK/AF_PACKET/TAP drivers (contiv-vswitch.conf:8-11, graph nodes in
+docs/VPP_PACKET_TRACING_K8S.md:28-50): real packets in from the wire,
+through the native codec into shared-memory frame rings, across the
+jitted TPU pipeline, and back out rewritten.
+
+  wire -> Transport.recv -> PacketCodec.parse -> rx IORing
+       -> DataplanePump -> Dataplane.process (TPU) -> tx IORing
+       -> PacketCodec.rewrite (+ VXLAN encap) -> Transport.send -> wire
+"""
+
+from vpp_tpu.io.rings import IORing, IORingPair
+from vpp_tpu.io.transport import (
+    AfPacketTransport,
+    SocketPairTransport,
+    TapTransport,
+    Transport,
+)
+from vpp_tpu.io.daemon import IODaemon
+from vpp_tpu.io.pump import DataplanePump
+
+__all__ = [
+    "IORing", "IORingPair", "Transport", "AfPacketTransport",
+    "TapTransport", "SocketPairTransport", "IODaemon", "DataplanePump",
+]

@@ -1,0 +1,204 @@
+"""IO daemon: pumps packets between transports and the frame rings.
+
+The process that plays VPP's input/output nodes (af-packet-input →
+ethernet-input on rx; ip4-rewrite → interface-output on tx): an rx
+thread select()s across all transports, batch-parses raw frames through
+the native codec into the rx ring; a tx thread drains the tx ring,
+applies native header rewrite (NAT results, TTL, checksums), VXLAN-
+encapsulates remote-bound packets toward their peer VTEP, and transmits
+per disposition. Ethernet addressing uses learned (ip → MAC) mappings
+from rx traffic with broadcast fallback — the ARP analog for the
+directly-attached pod links the reference configures static ARP for
+(plugins/contiv/pod.go:375-452).
+"""
+
+from __future__ import annotations
+
+import logging
+import select
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from vpp_tpu.io.rings import IORingPair, VEC
+from vpp_tpu.io.transport import BROADCAST_MAC, Transport
+from vpp_tpu.native.pktio import FLAG_NON_IP4, FLAG_VALID, PacketCodec
+from vpp_tpu.pipeline.vector import Disposition
+
+log = logging.getLogger("io_daemon")
+
+
+class IODaemon:
+    def __init__(
+        self,
+        rings: IORingPair,
+        transports: Dict[int, Transport],
+        uplink_if: int,
+        host_if: Optional[int] = None,
+        vtep_ip: int = 0,
+        vni: int = 10,
+        poll_s: float = 0.0002,
+    ):
+        self.rings = rings
+        self.transports = dict(transports)
+        self.uplink_if = uplink_if
+        self.host_if = host_if
+        self.vtep_ip = vtep_ip
+        self.vni = vni
+        self.poll_s = poll_s
+        self.codec = PacketCodec(snap=rings.rx.snap)
+        self._scratch = np.zeros((VEC, rings.rx.snap), np.uint8)
+        self.mac_of: Dict[int, bytes] = {}
+        self.stats = {
+            "rx_frames": 0, "rx_pkts": 0, "rx_ring_full": 0,
+            "tx_frames": 0, "tx_pkts": 0, "tx_drops": 0, "tx_punts": 0,
+            "vxlan_encap": 0, "vxlan_decap": 0,
+        }
+        self._stop = threading.Event()
+        self._threads = []
+
+    # --- lifecycle ---
+    def start(self) -> "IODaemon":
+        for fn, name in ((self._rx_loop, "io-rx"), (self._tx_loop, "io-tx")):
+            t = threading.Thread(target=fn, daemon=True, name=name)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self, join_timeout: Optional[float] = None) -> bool:
+        """Stop rx/tx threads; unbounded join by default — callers free
+        the ring buffers next, so returning with a live thread would be
+        a use-after-free into shared memory."""
+        self._stop.set()
+        ok = True
+        for t in self._threads:
+            t.join(timeout=join_timeout)
+            ok = ok and not t.is_alive()
+        return ok
+
+    # --- rx: wire -> ring ---
+    def _rx_loop(self) -> None:
+        while not self._stop.is_set():
+            fds = {t.fileno(): (if_idx, t)
+                   for if_idx, t in self.transports.items()}
+            try:
+                ready, _, _ = select.select(list(fds), [], [], 0.05)
+            except OSError:
+                continue
+            for fd in ready:
+                if_idx, transport = fds[fd]
+                frames = transport.recv_frames(VEC)
+                if frames:
+                    self._ingest(if_idx, frames)
+
+    def _ingest(self, if_idx: int, frames: list) -> None:
+        if if_idx == self.uplink_if:
+            # VXLAN datagrams from peer nodes carry the inner frame
+            unwrapped = []
+            for f in frames:
+                off = self.codec.decap_offset(f)
+                if off:
+                    self.stats["vxlan_decap"] += 1
+                    unwrapped.append(f[off:])
+                else:
+                    unwrapped.append(f)
+            frames = unwrapped
+        for start in range(0, len(frames), VEC):
+            chunk = frames[start:start + VEC]
+            cols, n = self.codec.parse(chunk, if_idx, self._scratch)
+            self._learn_macs(chunk, cols, n)
+            if self.rings.rx.push(cols, n, payload=self._scratch):
+                self.stats["rx_frames"] += 1
+                self.stats["rx_pkts"] += n
+            else:
+                self.stats["rx_ring_full"] += 1
+
+    def _learn_macs(self, frames: list, cols: Dict[str, np.ndarray],
+                    n: int) -> None:
+        flags = cols["flags"]
+        src = cols["src_ip"]
+        for i in range(n):
+            if flags[i] & FLAG_NON_IP4:
+                continue
+            self.mac_of[int(src[i])] = bytes(frames[i][6:12])
+
+    # --- tx: ring -> wire ---
+    def _tx_loop(self) -> None:
+        rings = self.rings
+        while not self._stop.is_set():
+            frame = rings.tx.peek()
+            if frame is None:
+                time.sleep(self.poll_s)
+                continue
+            try:
+                self._transmit(frame)
+            except Exception:
+                log.exception("tx frame failed")
+            rings.tx.release()
+            self.stats["tx_frames"] += 1
+
+    def _transmit(self, frame) -> None:
+        cols, n, payload = frame.cols, frame.n, frame.payload
+        # native rewrite: NAT/TTL results patched into the raw bytes with
+        # checksum fixes (no-op for untouched packets)
+        self.codec.rewrite(cols, payload, n)
+        flags = cols["flags"]
+        disp = cols["disp"]
+        tx_if = cols["rx_if"]     # tx direction: egress interface index
+        dst_ip = cols["dst_ip"]
+        next_hop = cols["next_hop"]
+        pkt_len = cols["pkt_len"]
+        uplink = self.transports.get(self.uplink_if)
+        for i in range(n):
+            if not flags[i] & FLAG_VALID:
+                continue
+            d = int(disp[i])
+            wire_len = min(int(pkt_len[i]) + 14, payload.shape[1])
+            raw = payload[i, :wire_len]
+            if d == int(Disposition.DROP):
+                self.stats["tx_drops"] += 1
+            elif d == int(Disposition.LOCAL):
+                t = self.transports.get(int(tx_if[i]))
+                if t is None:
+                    self.stats["tx_drops"] += 1
+                    continue
+                self._set_eth(raw, t.mac, int(dst_ip[i]))
+                t.send_frame(raw.tobytes())
+                self.stats["tx_pkts"] += 1
+            elif d == int(Disposition.REMOTE):
+                if uplink is None:
+                    self.stats["tx_drops"] += 1
+                    continue
+                nh = int(next_hop[i])
+                if nh:
+                    wire = self.codec.encap(
+                        payload[i], wire_len, self.vtep_ip, nh,
+                        49152 + (int(dst_ip[i]) & 0x3FFF), self.vni,
+                        uplink.mac, self.mac_of.get(nh, BROADCAST_MAC),
+                    )
+                    uplink.send_frame(wire)
+                    self.stats["vxlan_encap"] += 1
+                else:
+                    self._set_eth(raw, uplink.mac, int(dst_ip[i]))
+                    uplink.send_frame(raw.tobytes())
+                self.stats["tx_pkts"] += 1
+            elif d == int(Disposition.HOST):
+                t = (self.transports.get(self.host_if)
+                     if self.host_if is not None else None)
+                if t is None:
+                    self.stats["tx_drops"] += 1
+                    continue
+                t.send_frame(raw.tobytes())
+                self.stats["tx_punts"] += 1
+            else:
+                self.stats["tx_drops"] += 1
+
+    def _set_eth(self, raw: np.ndarray, src_mac: bytes, dst_ip: int) -> None:
+        if len(raw) < 14:
+            return
+        raw[0:6] = np.frombuffer(
+            self.mac_of.get(dst_ip, BROADCAST_MAC), np.uint8
+        )
+        raw[6:12] = np.frombuffer(src_mac, np.uint8)

@@ -1,0 +1,178 @@
+"""Frame rings with payload blocks: header columns + raw packet bytes.
+
+The SPSC frame ring (native/frame_ring.cpp) carries the 12 SoA header
+columns; full packet bytes travel in a payload block — a [n_slots, VEC,
+snap] uint8 region indexed by the same slot number, synchronized by the
+ring's head/tail (the slot's payload is owned by whoever owns the slot).
+This mirrors VPP's split between vlib frame vectors and buffer memory.
+
+Both sides can live in one process (bytearray buffers, tests/dev) or in
+two (multiprocessing.shared_memory, the production daemon split).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from vpp_tpu.native.ring import RING_COLUMNS, FrameRing
+
+VEC = 256
+DEFAULT_SNAP = 2048
+DEFAULT_SLOTS = 64
+
+
+class Frame(NamedTuple):
+    cols: Dict[str, np.ndarray]   # 12 ring columns, [VEC]
+    n: int                        # valid packet count
+    epoch: int
+    payload: np.ndarray           # uint8 [VEC, snap] view for this slot
+
+
+class IORing:
+    """A FrameRing plus its payload block (one direction)."""
+
+    def __init__(self, ring_buf, payload_buf, n_slots: int = DEFAULT_SLOTS,
+                 snap: int = DEFAULT_SNAP, create: bool = True):
+        self.ring = FrameRing(ring_buf, n_slots=n_slots, create=create)
+        n_slots = self.ring.n_slots
+        self.snap = snap
+        need = n_slots * VEC * snap
+        mv = memoryview(payload_buf)
+        if len(mv) < need:
+            raise ValueError(f"payload buffer too small: {len(mv)} < {need}")
+        self.payload = np.frombuffer(mv, np.uint8, count=need).reshape(
+            n_slots, VEC, snap
+        )
+        lib = self.ring.lib
+        self._hdr_size = int(lib.fr_header_size())
+        self._slot_size = int(lib.fr_slot_size())
+
+    @classmethod
+    def required_sizes(cls, n_slots: int = DEFAULT_SLOTS,
+                       snap: int = DEFAULT_SNAP) -> Tuple[int, int]:
+        return FrameRing.required_size(n_slots), n_slots * VEC * snap
+
+    def _slot_index(self, off: int) -> int:
+        return (off - self._hdr_size) // self._slot_size
+
+    # --- producer ---
+    def push(self, cols: Dict[str, np.ndarray], n: int,
+             payload: Optional[np.ndarray] = None, epoch: int = 0) -> bool:
+        """Write one frame (+payload rows) — False if full."""
+        lib, base = self.ring.lib, self.ring._base
+        off = lib.fr_produce_reserve(base)
+        if off < 0:
+            return False
+        idx = self._slot_index(off)
+        if payload is not None:
+            self.payload[idx, :n] = payload[:n]
+        hdr = np.frombuffer(self.ring._mv, np.uint32, count=2, offset=off)
+        hdr[0] = n
+        hdr[1] = epoch
+        for name, slot_col in self.ring._slot_views(off).items():
+            if name in cols:
+                slot_col[:] = cols[name]
+            else:
+                slot_col[:] = 0
+        lib.fr_produce_commit(base)
+        return True
+
+    # --- consumer ---
+    def peek(self) -> Optional[Frame]:
+        """Zero-copy views of the oldest frame (cols + payload), or None.
+        Valid until release()."""
+        lib, base = self.ring.lib, self.ring._base
+        off = lib.fr_consume_peek(base)
+        if off < 0:
+            return None
+        idx = self._slot_index(off)
+        hdr = np.frombuffer(self.ring._mv, np.uint32, count=2, offset=off)
+        return Frame(
+            self.ring._slot_views(off), int(hdr[0]), int(hdr[1]),
+            self.payload[idx],
+        )
+
+    def release(self) -> None:
+        self.ring.release()
+
+    def pending(self) -> int:
+        return self.ring.pending()
+
+
+class IORingPair:
+    """rx + tx rings over in-process buffers or named shared memory."""
+
+    def __init__(self, n_slots: int = DEFAULT_SLOTS, snap: int = DEFAULT_SNAP,
+                 shm_name: Optional[str] = None, create: bool = True):
+        ring_sz, pay_sz = IORing.required_sizes(n_slots, snap)
+        self._shm = None
+        self._views: list = []
+        if shm_name is None:
+            bufs = [bytearray(ring_sz), bytearray(pay_sz),
+                    bytearray(ring_sz), bytearray(pay_sz)]
+        else:
+            from multiprocessing import shared_memory
+
+            total = 2 * (ring_sz + pay_sz)
+            if create:
+                try:
+                    self._shm = shared_memory.SharedMemory(
+                        name=shm_name, create=True, size=total
+                    )
+                except FileExistsError:
+                    # A crashed previous agent (kill -9 / OOM) leaves the
+                    # segment behind; the restart must reclaim it, not
+                    # fail to boot until an operator clears /dev/shm.
+                    stale = shared_memory.SharedMemory(name=shm_name)
+                    stale.close()
+                    stale.unlink()
+                    self._shm = shared_memory.SharedMemory(
+                        name=shm_name, create=True, size=total
+                    )
+            else:
+                self._shm = shared_memory.SharedMemory(name=shm_name)
+            mv = self._shm.buf
+            o = 0
+            bufs = []
+            for sz in (ring_sz, pay_sz, ring_sz, pay_sz):
+                view = mv[o:o + sz]
+                self._views.append(view)
+                bufs.append(view)
+                o += sz
+        self.rx = IORing(bufs[0], bufs[1], n_slots, snap, create=create)
+        self.tx = IORing(bufs[2], bufs[3], n_slots, snap, create=create)
+
+    def close(self, unlink: bool = False) -> None:
+        # Numpy arrays + memoryview slices into the shm buffer must all
+        # be dropped before SharedMemory.close() (it refuses while
+        # exported pointers exist); anything still pinned is reclaimed at
+        # process exit, so failures here must not mask real errors.
+        import gc
+
+        for ring in (self.rx, self.tx):
+            if ring is not None:
+                ring.payload = None
+                ring.ring._arr = None
+                ring.ring._mv = None
+                ring.ring._base = None
+        self.rx = self.tx = None
+        gc.collect()
+        if self._shm is not None:
+            for v in self._views:
+                try:
+                    v.release()
+                except BufferError:
+                    pass
+            self._views.clear()
+            try:
+                self._shm.close()
+            except BufferError:
+                pass
+            if unlink:
+                try:
+                    self._shm.unlink()
+                except FileNotFoundError:
+                    pass
+            self._shm = None

@@ -36,6 +36,19 @@ log = logging.getLogger("k8s_client")
 
 SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
+# temp files holding materialized kubeconfig data (may include TLS client
+# keys) — scrubbed at process exit
+_materialized_paths: list = []
+
+
+def _cleanup_materialized() -> None:
+    for path in _materialized_paths:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    _materialized_paths.clear()
+
 
 # --------------------------------------------------------------------------
 # configuration / auth
@@ -51,12 +64,19 @@ class K8sApiConfig:
 
     @staticmethod
     def _materialize(b64: str, suffix: str) -> str:
-        """Write inline base64 kubeconfig data to a temp file for requests."""
+        """Write inline base64 kubeconfig data to a temp file for
+        requests. Files (0600 by NamedTemporaryFile default) are removed
+        at process exit — client private keys must not outlive us."""
         f = tempfile.NamedTemporaryFile(
             mode="wb", suffix=suffix, delete=False, prefix="vpp-tpu-k8s-"
         )
         with f:
             f.write(base64.b64decode(b64))
+        if not _materialized_paths:
+            import atexit
+
+            atexit.register(_cleanup_materialized)
+        _materialized_paths.append(f.name)
         return f.name
 
     @classmethod
@@ -394,18 +414,24 @@ class KubernetesListWatch(K8sListWatch):
         self._handlers: List[Tuple[Callable, Callable, Callable]] = []
         self._cache: Dict[str, Any] = {}
         self._rv = "0"
-        self._lock = threading.Lock()
+        # One RLock serializes every cache mutation WITH its fetch and
+        # dispatch: a reflector-driven list() racing the watch thread's
+        # re-list could otherwise swap the cache backwards (stale fetch
+        # wins) and emit reversed diffs. RLock because a dispatched
+        # handler may synchronously call list() back (reflector resync).
+        self._lock = threading.RLock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     # --- K8sListWatch interface ---
     def list(self) -> List[Any]:
-        raw = self.api.get_list(self.resource.path)
-        items = [self.resource.convert(o) for o in raw.get("items") or []]
         with self._lock:
+            raw = self.api.get_list(self.resource.path)
+            items = [self.resource.convert(o)
+                     for o in raw.get("items") or []]
             self._rv = (raw.get("metadata") or {}).get("resourceVersion", "0")
             self._cache = {m.key(): m for m in items}
-        return items
+            return items
 
     def subscribe(self, on_add, on_update, on_delete) -> None:
         self._handlers.append((on_add, on_update, on_delete))
@@ -428,39 +454,49 @@ class KubernetesListWatch(K8sListWatch):
                 log.exception("%s handler raised", self.resource.obj_type)
 
     def _relist_and_diff(self) -> None:
-        raw = self.api.get_list(self.resource.path)
-        items = {m.key(): m
-                 for m in (self.resource.convert(o)
-                           for o in raw.get("items") or [])}
         with self._lock:
+            raw = self.api.get_list(self.resource.path)
+            items = {m.key(): m
+                     for m in (self.resource.convert(o)
+                               for o in raw.get("items") or [])}
             old = self._cache
             self._cache = items
-            self._rv = (raw.get("metadata") or {}).get("resourceVersion", "0")
-        for key, m in items.items():
-            prev = old.get(key)
-            if prev is None:
-                self._dispatch(0, m)
-            elif prev.to_dict() != m.to_dict():
-                self._dispatch(1, prev, m)
-        for key, prev in old.items():
-            if key not in items:
-                self._dispatch(2, prev)
+            self._rv = (raw.get("metadata") or {}).get(
+                "resourceVersion", "0")
+            for key, m in items.items():
+                prev = old.get(key)
+                if prev is None:
+                    self._dispatch(0, m)
+                elif prev.to_dict() != m.to_dict():
+                    self._dispatch(1, prev, m)
+            for key, prev in old.items():
+                if key not in items:
+                    self._dispatch(2, prev)
 
     def _watch_loop(self) -> None:
         backoff, cap = self.RECONNECT_BACKOFF
+        needs_list = True
         while not self._stop.is_set():
             try:
-                self._relist_and_diff()
+                if needs_list:
+                    self._relist_and_diff()
+                    needs_list = False
                 with self._lock:
                     rv = self._rv
                 for ev in self.api.watch(self.resource.path, rv):
                     if self._stop.is_set():
                         return
                     self._handle_event(ev)
-                backoff = self.RECONNECT_BACKOFF[0]  # clean stream end
+                # Clean stream end (server timeoutSeconds elapsed): the
+                # tracked resourceVersion is current — re-watch from it.
+                # A full re-list here would re-GET the whole collection
+                # every ~5 minutes for zero information; listing is only
+                # for errors/410 where continuity is actually lost.
+                backoff = self.RECONNECT_BACKOFF[0]
             except Exception as exc:  # noqa: BLE001 — reconnect on anything
                 if self._stop.is_set():
                     return
+                needs_list = True
                 log.warning("%s watch lost (%s); re-listing in %.1fs",
                             self.resource.obj_type, exc, backoff)
                 self._stop.wait(backoff)
@@ -486,18 +522,18 @@ class KubernetesListWatch(K8sListWatch):
                 self._cache[m.key()] = m
             elif etype == "DELETED":
                 self._cache.pop(m.key(), None)
-        if etype == "ADDED":
-            # A re-delivered ADDED for a known object is an update
-            if prev is None:
-                self._dispatch(0, m)
-            elif prev.to_dict() != m.to_dict():
+            if etype == "ADDED":
+                # A re-delivered ADDED for a known object is an update
+                if prev is None:
+                    self._dispatch(0, m)
+                elif prev.to_dict() != m.to_dict():
+                    self._dispatch(1, prev, m)
+            elif etype == "MODIFIED":
                 self._dispatch(1, prev, m)
-        elif etype == "MODIFIED":
-            self._dispatch(1, prev, m)
-        elif etype == "DELETED":
-            self._dispatch(2, m)
-        else:
-            log.warning("unknown watch event type %r", etype)
+            elif etype == "DELETED":
+                self._dispatch(2, m)
+            else:
+                log.warning("unknown watch event type %r", etype)
 
 
 def make_k8s_sources(

@@ -22,9 +22,11 @@
 namespace {
 
 constexpr uint32_t kMagic = 0x54505652;  // "RVPT"
-constexpr uint32_t kVersion = 1;
+constexpr uint32_t kVersion = 2;
 constexpr uint32_t kVec = 256;           // packets per frame (PacketVector VEC)
-constexpr uint32_t kColumns = 9;         // PacketVector fields, 4 bytes each
+// PacketVector's nine fields plus three IO columns (disp, next_hop,
+// meta) used on the tx direction, 4 bytes each.
+constexpr uint32_t kColumns = 12;
 constexpr uint32_t kCacheLine = 64;
 
 struct RingHeader {
@@ -82,16 +84,24 @@ int fr_create(void* mem, uint64_t size, uint32_t n_slots) {
   r->head.store(0, std::memory_order_relaxed);
   r->tail.store(0, std::memory_order_relaxed);
   r->version = kVersion;
-  std::atomic_thread_fence(std::memory_order_release);
-  r->magic = kMagic;
+  reinterpret_cast<std::atomic<uint32_t>*>(&r->magic)
+      ->store(kMagic, std::memory_order_release);
   return 0;
 }
 
-// Attach to an existing ring; validates magic/version.
+// Attach to an existing ring; validates magic/version/slot layout.
 int fr_attach(void* mem) {
   RingHeader* r = as_ring(mem);
-  if (r->magic != kMagic) return -1;
+  // Pair with fr_create's release fence: only after an acquire fence may
+  // we trust n_slots/slot_size written before magic became visible
+  // (a cross-process attach racing creation on a weakly-ordered CPU
+  // could otherwise see magic with stale geometry).
+  if (reinterpret_cast<std::atomic<uint32_t>*>(&r->magic)
+          ->load(std::memory_order_acquire) != kMagic)
+    return -1;
   if (r->version != kVersion) return -2;
+  // Reject rings built by a binary with a different slot layout.
+  if (r->slot_size != slot_size_aligned()) return -3;
   return 0;
 }
 
@@ -151,7 +161,7 @@ uint64_t fr_pending(void* mem) {
 
 // ---- batch copy helpers (amortize ctypes call overhead) ----
 
-// Copy a full frame (9 columns × kVec int32) into the slot at `offset`
+// Copy a full frame (kColumns × kVec int32) into the slot at `offset`
 // and set n_packets. Caller still must fr_produce_commit.
 void fr_write_frame(void* mem, int64_t offset, const int32_t* columns,
                     uint32_t n_packets, uint32_t epoch) {

@@ -1,0 +1,253 @@
+// Native packet codec: wire frames <-> the ring's SoA columns.
+//
+// The front-end half of the data plane the reference gets from VPP's C
+// graph input/output nodes (dpdk-input / af-packet-input -> ethernet-input
+// -> ip4-input parse; interface-output serialize, see
+// /root/reference/docs/VPP_PACKET_TRACING_K8S.md:28-50). Batch functions
+// so the Python side makes one ctypes call per 256-packet frame:
+//
+//   pio_parse    raw ethernet frames -> 12 SoA columns + payload copies
+//   pio_rewrite  patch L3/L4 headers in stored frames from (possibly
+//                NAT-rewritten) columns, with incremental checksums
+//   pio_encap    wrap a stored frame in outer Ethernet+IPv4+UDP+VXLAN
+//
+// Checksum discipline: IPv4 header checksum recomputed from scratch;
+// TCP/UDP checksums updated incrementally per RFC 1624 (HC' = ~(~HC +
+// ~m + m')) over the rewritten words, so payload bytes never need to be
+// touched. UDP checksum 0 (disabled) is preserved as 0.
+//
+// Build: g++ -O2 -shared -fPIC -o libpktio.so pkt_io.cpp
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr uint32_t kVec = 256;
+constexpr uint32_t kColumns = 12;
+
+// Column indices (must match vpp_tpu/native/ring.py RING_COLUMNS).
+enum Col {
+  kSrcIp = 0, kDstIp, kProto, kSport, kDport, kTtl, kPktLen, kRxIf,
+  kFlags, kDisp, kNextHop, kMeta,
+};
+
+// flags bits (bit0 mirrors PacketVector FLAG_VALID)
+constexpr int32_t kFlagValid = 1;
+constexpr int32_t kFlagNonIp4 = 2;   // not IPv4: punt/bypass, never classify
+
+constexpr uint32_t kEthHdr = 14;
+constexpr uint16_t kEthIp4 = 0x0800;
+
+inline uint16_t rd16(const uint8_t* p) {
+  return static_cast<uint16_t>(p[0]) << 8 | p[1];
+}
+inline uint32_t rd32(const uint8_t* p) {
+  return static_cast<uint32_t>(p[0]) << 24 | static_cast<uint32_t>(p[1]) << 16 |
+         static_cast<uint32_t>(p[2]) << 8 | p[3];
+}
+inline void wr16(uint8_t* p, uint16_t v) {
+  p[0] = v >> 8;
+  p[1] = v & 0xff;
+}
+inline void wr32(uint8_t* p, uint32_t v) {
+  p[0] = v >> 24;
+  p[1] = (v >> 16) & 0xff;
+  p[2] = (v >> 8) & 0xff;
+  p[3] = v & 0xff;
+}
+
+// One's-complement sum over a byte range (big-endian 16-bit words).
+uint32_t csum_add(uint32_t sum, const uint8_t* p, uint32_t len) {
+  while (len > 1) {
+    sum += rd16(p);
+    p += 2;
+    len -= 2;
+  }
+  if (len) sum += static_cast<uint32_t>(p[0]) << 8;
+  return sum;
+}
+
+uint16_t csum_fold(uint32_t sum) {
+  while (sum >> 16) sum = (sum & 0xffff) + (sum >> 16);
+  return static_cast<uint16_t>(~sum & 0xffff);
+}
+
+// RFC 1624 incremental update: checksum at `ck` (big-endian in the
+// packet) adjusted for a 16-bit word changing old->neu.
+void csum_update16(uint8_t* ck, uint16_t old, uint16_t neu) {
+  uint16_t hc = rd16(ck);
+  uint32_t sum = static_cast<uint32_t>(static_cast<uint16_t>(~hc)) +
+                 static_cast<uint16_t>(~old) + neu;
+  while (sum >> 16) sum = (sum & 0xffff) + (sum >> 16);
+  wr16(ck, static_cast<uint16_t>(~sum & 0xffff));
+}
+
+void csum_update32(uint8_t* ck, uint32_t old, uint32_t neu) {
+  csum_update16(ck, old >> 16, neu >> 16);
+  csum_update16(ck, old & 0xffff, neu & 0xffff);
+}
+
+inline int32_t* col(int32_t* cols, int c) { return cols + c * kVec; }
+
+}  // namespace
+
+extern "C" {
+
+uint32_t pio_vec() { return kVec; }
+uint32_t pio_columns() { return kColumns; }
+
+// Parse up to kVec raw ethernet frames into SoA columns and copy each
+// frame into payload[i*snap .. ]. bufs: concatenated frames; offsets/
+// lens: per-frame location. Returns number of slots filled.
+//
+// Non-IPv4 frames (ARP, IPv6, LLDP...) get kFlagNonIp4 and no L3/L4
+// fields: the IO daemon punts them to the host path un-classified (the
+// reference's VPP punts unmatched ethertypes similarly).
+uint32_t pio_parse(const uint8_t* bufs, const uint64_t* offsets,
+                   const uint32_t* lens, uint32_t n, int32_t rx_if,
+                   int32_t* cols, uint8_t* payload, uint32_t snap) {
+  if (n > kVec) n = kVec;
+  std::memset(cols, 0, sizeof(int32_t) * kVec * kColumns);
+  for (uint32_t i = 0; i < n; i++) {
+    const uint8_t* f = bufs + offsets[i];
+    uint32_t len = lens[i];
+    uint32_t copy = len < snap ? len : snap;
+    std::memcpy(payload + static_cast<uint64_t>(i) * snap, f, copy);
+    col(cols, kRxIf)[i] = rx_if;
+    // pkt_len convention is L3 length (wire length = pkt_len + 14);
+    // keep it for non-IPv4 frames too so the tx side reconstructs the
+    // right wire length for punts.
+    col(cols, kPktLen)[i] =
+        static_cast<int32_t>(len >= kEthHdr ? len - kEthHdr : 0);
+    col(cols, kFlags)[i] = kFlagValid;
+    if (len < kEthHdr + 20 || rd16(f + 12) != kEthIp4) {
+      col(cols, kFlags)[i] |= kFlagNonIp4;
+      continue;
+    }
+    const uint8_t* ip = f + kEthHdr;
+    uint32_t ihl = (ip[0] & 0x0f) * 4u;
+    if ((ip[0] >> 4) != 4 || ihl < 20 || len < kEthHdr + ihl) {
+      col(cols, kFlags)[i] |= kFlagNonIp4;
+      continue;
+    }
+    col(cols, kSrcIp)[i] = static_cast<int32_t>(rd32(ip + 12));
+    col(cols, kDstIp)[i] = static_cast<int32_t>(rd32(ip + 16));
+    col(cols, kProto)[i] = ip[9];
+    col(cols, kTtl)[i] = ip[8];
+    col(cols, kPktLen)[i] = rd16(ip + 2);
+    uint8_t proto = ip[9];
+    const uint8_t* l4 = ip + ihl;
+    if ((proto == 6 || proto == 17) && len >= kEthHdr + ihl + 4) {
+      col(cols, kSport)[i] = rd16(l4);
+      col(cols, kDport)[i] = rd16(l4 + 2);
+    }
+  }
+  return n;
+}
+
+// Patch stored frames from (possibly rewritten) columns: IP src/dst,
+// TTL, L4 ports; fix IPv4 + L4 checksums. Only valid IPv4 slots touched.
+void pio_rewrite(const int32_t* cols_c, uint8_t* payload, uint32_t n,
+                 uint32_t snap) {
+  int32_t* cols = const_cast<int32_t*>(cols_c);
+  if (n > kVec) n = kVec;
+  for (uint32_t i = 0; i < n; i++) {
+    int32_t flags = col(cols, kFlags)[i];
+    if (!(flags & kFlagValid) || (flags & kFlagNonIp4)) continue;
+    uint8_t* f = payload + static_cast<uint64_t>(i) * snap;
+    uint8_t* ip = f + kEthHdr;
+    uint32_t ihl = (ip[0] & 0x0f) * 4u;
+    uint8_t proto = ip[9];
+    uint8_t* l4 = ip + ihl;
+
+    uint32_t old_src = rd32(ip + 12), old_dst = rd32(ip + 16);
+    uint32_t new_src = static_cast<uint32_t>(col(cols, kSrcIp)[i]);
+    uint32_t new_dst = static_cast<uint32_t>(col(cols, kDstIp)[i]);
+    uint8_t new_ttl = static_cast<uint8_t>(col(cols, kTtl)[i]);
+
+    // L4 checksum location (TCP: +16, UDP: +6); UDP 0 = disabled stays 0
+    uint8_t* l4ck = nullptr;
+    if (proto == 6) l4ck = l4 + 16;
+    else if (proto == 17 && rd16(l4 + 6) != 0) l4ck = l4 + 6;
+
+    if (new_src != old_src) {
+      wr32(ip + 12, new_src);
+      if (l4ck) csum_update32(l4ck, old_src, new_src);
+    }
+    if (new_dst != old_dst) {
+      wr32(ip + 16, new_dst);
+      if (l4ck) csum_update32(l4ck, old_dst, new_dst);
+    }
+    if (proto == 6 || proto == 17) {
+      uint16_t old_sp = rd16(l4), old_dp = rd16(l4 + 2);
+      uint16_t new_sp = static_cast<uint16_t>(col(cols, kSport)[i]);
+      uint16_t new_dp = static_cast<uint16_t>(col(cols, kDport)[i]);
+      if (new_sp != old_sp) {
+        wr16(l4, new_sp);
+        if (l4ck) csum_update16(l4ck, old_sp, new_sp);
+      }
+      if (new_dp != old_dp) {
+        wr16(l4 + 2, new_dp);
+        if (l4ck) csum_update16(l4ck, old_dp, new_dp);
+      }
+    }
+    ip[8] = new_ttl;
+    // IPv4 header checksum: recompute from scratch (cheap, 20-60B)
+    wr16(ip + 10, 0);
+    wr16(ip + 10, csum_fold(csum_add(0, ip, ihl)));
+  }
+}
+
+// VXLAN-encapsulate one stored frame into out (must hold 50 + frame_len
+// bytes): outer Ethernet + IPv4 + UDP + VXLAN, inner = frame as-is.
+// Returns total outer length. Outer MACs are caller-provided.
+// Reference wire format: RFC 7348 (matches ops/vxlan.py encode_frame).
+uint32_t pio_encap(const uint8_t* frame, uint32_t frame_len, uint32_t src_ip,
+                   uint32_t dst_ip, uint16_t src_port, uint32_t vni,
+                   const uint8_t* src_mac, const uint8_t* dst_mac,
+                   uint8_t* out) {
+  uint8_t* p = out;
+  std::memcpy(p, dst_mac, 6);
+  std::memcpy(p + 6, src_mac, 6);
+  wr16(p + 12, kEthIp4);
+  p += kEthHdr;
+  uint32_t udp_len = 8 + 8 + frame_len;       // UDP + VXLAN + inner
+  uint32_t ip_len = 20 + udp_len;
+  p[0] = 0x45; p[1] = 0;
+  wr16(p + 2, static_cast<uint16_t>(ip_len));
+  wr16(p + 4, 0);                              // id
+  wr16(p + 6, 0x4000);                         // DF
+  p[8] = 64;                                   // ttl
+  p[9] = 17;                                   // udp
+  wr16(p + 10, 0);
+  wr32(p + 12, src_ip);
+  wr32(p + 16, dst_ip);
+  wr16(p + 10, csum_fold(csum_add(0, p, 20)));
+  p += 20;
+  wr16(p, src_port);
+  wr16(p + 2, 4789);                           // VXLAN dst port
+  wr16(p + 4, static_cast<uint16_t>(udp_len));
+  wr16(p + 6, 0);                              // UDP csum optional for v4
+  p += 8;
+  p[0] = 0x08; p[1] = 0; p[2] = 0; p[3] = 0;   // flags: VNI present
+  wr32(p + 4, vni << 8);
+  p += 8;
+  std::memcpy(p, frame, frame_len);
+  return kEthHdr + ip_len;
+}
+
+// Decapsulate: returns offset of the inner frame within `frame` (the
+// payload of a VXLAN UDP datagram), or 0 if not VXLAN-to-our-port.
+uint32_t pio_decap_offset(const uint8_t* frame, uint32_t frame_len) {
+  if (frame_len < kEthHdr + 20 + 8 + 8 + kEthHdr) return 0;
+  if (rd16(frame + 12) != kEthIp4) return 0;
+  const uint8_t* ip = frame + kEthHdr;
+  uint32_t ihl = (ip[0] & 0x0f) * 4u;
+  if (ip[9] != 17) return 0;
+  const uint8_t* udp = ip + ihl;
+  if (rd16(udp + 2) != 4789) return 0;
+  return kEthHdr + ihl + 8 + 8;
+}
+
+}  // extern "C"

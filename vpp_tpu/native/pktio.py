@@ -1,0 +1,145 @@
+"""ctypes bindings for the native packet codec (pkt_io.cpp).
+
+Batch wire-format work — ethernet/IPv4/L4 parse into the ring's SoA
+columns, header rewrite with incremental checksums, VXLAN encap/decap —
+one ctypes call per 256-packet frame. This is the native input/output
+node layer of the data plane (reference: VPP's af-packet-input /
+ethernet-input / ip4-rewrite / interface-output C graph nodes).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from vpp_tpu.native.ring import RING_COLUMNS, build_native
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_PKG_DIR, "pkt_io.cpp")
+_BUILD_DIR = (
+    os.path.join(_PKG_DIR, "build")
+    if os.access(_PKG_DIR, os.W_OK)
+    else os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), f"vpp_tpu_native_{os.getuid()}"
+    )
+)
+_LIB = os.path.join(_BUILD_DIR, "libpktio.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+VEC = 256
+N_COLUMNS = len(RING_COLUMNS)
+
+FLAG_VALID = 1
+FLAG_NON_IP4 = 2
+
+_COL_INDEX = {name: i for i, (name, _) in enumerate(RING_COLUMNS)}
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        lib = ctypes.CDLL(build_native(_SRC, _LIB))
+        lib.pio_vec.restype = ctypes.c_uint32
+        lib.pio_columns.restype = ctypes.c_uint32
+        lib.pio_parse.restype = ctypes.c_uint32
+        lib.pio_parse.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_uint32, ctypes.c_int32, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_uint32,
+        ]
+        lib.pio_rewrite.restype = None
+        lib.pio_rewrite.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32,
+            ctypes.c_uint32,
+        ]
+        lib.pio_encap.restype = ctypes.c_uint32
+        lib.pio_encap.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
+            ctypes.c_uint32, ctypes.c_uint16, ctypes.c_uint32,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        lib.pio_decap_offset.restype = ctypes.c_uint32
+        lib.pio_decap_offset.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+        assert int(lib.pio_vec()) == VEC
+        assert int(lib.pio_columns()) == N_COLUMNS
+        _lib = lib
+        return lib
+
+
+class PacketCodec:
+    """Frame-batch codec over a flat [N_COLUMNS, VEC] int32 scratch."""
+
+    def __init__(self, snap: int = 2048):
+        self.lib = _load()
+        self.snap = snap
+
+    def parse(
+        self, frames: list, rx_if: int,
+        payload: np.ndarray,
+    ) -> Tuple[Dict[str, np.ndarray], int]:
+        """Parse raw ethernet frames (list of bytes) into SoA columns,
+        copying each frame into ``payload`` (uint8 [VEC, snap])."""
+        n = min(len(frames), VEC)
+        buf = b"".join(frames[:n])
+        bufs = np.frombuffer(buf, np.uint8)
+        lens = np.array([len(f) for f in frames[:n]], np.uint32)
+        offsets = np.zeros(n, np.uint64)
+        if n > 1:
+            offsets[1:] = np.cumsum(lens[:-1], dtype=np.uint64)
+        flat = np.zeros((N_COLUMNS, VEC), np.int32)
+        assert payload.shape == (VEC, self.snap) and payload.dtype == np.uint8
+        self.lib.pio_parse(
+            bufs.ctypes.data_as(ctypes.c_void_p),
+            offsets.ctypes.data_as(ctypes.c_void_p),
+            lens.ctypes.data_as(ctypes.c_void_p),
+            n, rx_if,
+            flat.ctypes.data_as(ctypes.c_void_p),
+            payload.ctypes.data_as(ctypes.c_void_p),
+            self.snap,
+        )
+        cols = {
+            name: flat[i].view(dtype)
+            for i, (name, dtype) in enumerate(RING_COLUMNS)
+        }
+        return cols, n
+
+    def rewrite(self, cols: Dict[str, np.ndarray], payload: np.ndarray,
+                n: int) -> None:
+        """Patch stored frames in ``payload`` from (rewritten) columns,
+        fixing IPv4 + L4 checksums in place."""
+        flat = np.zeros((N_COLUMNS, VEC), np.int32)
+        for name, arr in cols.items():
+            flat[_COL_INDEX[name]] = np.asarray(arr).view(np.int32)
+        self.lib.pio_rewrite(
+            flat.ctypes.data_as(ctypes.c_void_p),
+            payload.ctypes.data_as(ctypes.c_void_p),
+            n, self.snap,
+        )
+
+    def encap(self, frame: np.ndarray, frame_len: int, src_ip: int,
+              dst_ip: int, src_port: int, vni: int,
+              src_mac: bytes, dst_mac: bytes) -> bytes:
+        out = np.zeros(50 + frame_len, np.uint8)
+        total = self.lib.pio_encap(
+            frame.ctypes.data_as(ctypes.c_void_p), frame_len,
+            src_ip & 0xFFFFFFFF, dst_ip & 0xFFFFFFFF, src_port & 0xFFFF,
+            vni,
+            (ctypes.c_char * 6).from_buffer_copy(src_mac),
+            (ctypes.c_char * 6).from_buffer_copy(dst_mac),
+            out.ctypes.data_as(ctypes.c_void_p),
+        )
+        return out[:total].tobytes()
+
+    def decap_offset(self, frame: bytes) -> int:
+        arr = np.frombuffer(frame, np.uint8)
+        return int(self.lib.pio_decap_offset(
+            arr.ctypes.data_as(ctypes.c_void_p), len(arr)
+        ))

@@ -21,8 +21,10 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-# must match PacketVector field order (pipeline/vector.py)
-RING_COLUMNS: Tuple[Tuple[str, type], ...] = (
+# First nine must match PacketVector field order (pipeline/vector.py);
+# the last three are IO-direction columns (tx disposition, VXLAN peer,
+# spare metadata) consumed by the IO daemon, not the pipeline.
+PV_COLUMNS: Tuple[Tuple[str, type], ...] = (
     ("src_ip", np.uint32),
     ("dst_ip", np.uint32),
     ("proto", np.int32),
@@ -32,6 +34,11 @@ RING_COLUMNS: Tuple[Tuple[str, type], ...] = (
     ("pkt_len", np.int32),
     ("rx_if", np.int32),
     ("flags", np.int32),
+)
+RING_COLUMNS: Tuple[Tuple[str, type], ...] = PV_COLUMNS + (
+    ("disp", np.int32),
+    ("next_hop", np.uint32),
+    ("meta", np.int32),
 )
 
 # Source ships inside the package so installed wheels can build it
@@ -52,30 +59,35 @@ _build_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 
 
-def build_library(force: bool = False) -> str:
-    """Compile the ring library if missing/stale; returns the .so path."""
+def build_native(src: str, lib: str, force: bool = False) -> str:
+    """Compile one native source if missing/stale; returns the .so path."""
     with _build_lock:
         if (
             not force
-            and os.path.exists(_LIB)
-            and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)
+            and os.path.exists(lib)
+            and os.path.getmtime(lib) >= os.path.getmtime(src)
         ):
-            return _LIB
-        os.makedirs(_BUILD_DIR, exist_ok=True)
+            return lib
+        os.makedirs(os.path.dirname(lib), exist_ok=True)
         # per-process tmp name: concurrent builds from separate processes
         # must not clobber each other's output mid-write
-        tmp = f"{_LIB}.tmp.{os.getpid()}.so"
+        tmp = f"{lib}.tmp.{os.getpid()}.so"
         proc = subprocess.run(
-            ["g++", "-std=c++17", "-O2", "-shared", "-fPIC", "-o", tmp, _SRC],
+            ["g++", "-std=c++17", "-O2", "-shared", "-fPIC", "-o", tmp, src],
             capture_output=True, text=True,
         )
         if proc.returncode != 0:
             raise RuntimeError(
-                f"frame-ring build failed (g++ rc={proc.returncode}):\n"
-                f"{proc.stderr}"
+                f"native build of {os.path.basename(src)} failed "
+                f"(g++ rc={proc.returncode}):\n{proc.stderr}"
             )
-        os.replace(tmp, _LIB)
-        return _LIB
+        os.replace(tmp, lib)
+        return lib
+
+
+def build_library(force: bool = False) -> str:
+    """Compile the ring library if missing/stale; returns the .so path."""
+    return build_native(_SRC, _LIB, force)
 
 
 def _load() -> ctypes.CDLL:
@@ -186,7 +198,13 @@ class FrameRing:
         hdr[0] = n_packets
         hdr[1] = epoch
         for name, slot_col in self._slot_views(off).items():
-            slot_col[:] = columns[name]
+            # IO-direction columns (disp/next_hop/meta) may be omitted by
+            # rx-side producers; zero-fill so the consumer sees no stale
+            # data from a previous lap of the ring.
+            if name in columns:
+                slot_col[:] = columns[name]
+            else:
+                slot_col[:] = 0
         self.lib.fr_produce_commit(self._base)
         return True
 
@@ -230,9 +248,12 @@ class FrameRing:
         return int(self.lib.fr_pending(self._base))
 
     def to_packet_vector(self, cols: Dict[str, np.ndarray]):
-        """Lift ring columns into a PacketVector for the pipeline step."""
+        """Lift ring columns into a PacketVector for the pipeline step.
+        The three IO-only columns (disp/next_hop/meta) are dropped."""
         import jax.numpy as jnp
 
         from vpp_tpu.pipeline.vector import PacketVector
 
-        return PacketVector(**{k: jnp.asarray(v) for k, v in cols.items()})
+        return PacketVector(
+            **{k: jnp.asarray(cols[k]) for k, _ in PV_COLUMNS}
+        )
