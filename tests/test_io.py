@@ -239,6 +239,52 @@ class TestWireToWire:
         assert harness.pump.stats["frames"] >= 4
 
 
+class TestCodecSafety:
+    """Adversarial wire input must never leak slot memory or over-read."""
+
+    def test_lying_ip_length_marks_trunc_and_never_transmits(self):
+        from vpp_tpu.native.pktio import FLAG_TRUNC, PacketCodec
+
+        codec = PacketCodec()
+        payload = np.full((256, 2048), 0xAB, np.uint8)  # poisoned slot
+        frame = bytearray(make_frame(CLIENT_IP, SERVER_IP, proto=17,
+                                     dport=80))
+        # claim 1500 bytes in the IPv4 total-length field of a ~74B frame
+        frame[16:18] = (1500).to_bytes(2, "big")
+        cols, n = codec.parse([bytes(frame)], 0, payload)
+        assert cols["flags"][0] & FLAG_TRUNC
+        # pkt_len clamped to captured bytes: nothing can read into the
+        # poisoned residue
+        assert int(cols["pkt_len"][0]) <= len(frame) - 14
+
+    def test_oversnap_frame_marked_trunc(self):
+        from vpp_tpu.native.pktio import FLAG_TRUNC, PacketCodec
+
+        codec = PacketCodec(snap=256)
+        payload = np.zeros((256, 256), np.uint8)
+        big = make_frame(CLIENT_IP, SERVER_IP, proto=17, dport=80,
+                         payload=b"z" * 900)
+        cols, n = codec.parse([big], 0, payload)
+        assert cols["flags"][0] & FLAG_TRUNC
+
+    def test_crafted_ihl_decap_no_overread(self):
+        from vpp_tpu.native.pktio import PacketCodec
+
+        codec = PacketCodec()
+        # 64-byte frame claiming IHL=15 (60-byte IP header), proto UDP:
+        # the UDP header would sit past the end of the buffer
+        frame = bytearray(64)
+        frame[12:14] = b"\x08\x00"
+        frame[14] = 0x4F          # v4, ihl=15
+        frame[14 + 9] = 17        # udp
+        assert codec.decap_offset(bytes(frame)) == 0
+        # IHL<20 and non-v4 likewise rejected
+        frame[14] = 0x43
+        assert codec.decap_offset(bytes(frame)) == 0
+        frame[14] = 0x65
+        assert codec.decap_offset(bytes(frame)) == 0
+
+
 def _can_netadmin() -> bool:
     import subprocess
 

@@ -164,9 +164,10 @@ class ContivAgent:
         self._peer_routes = {}
         self._closed = threading.Event()
         self._maint_thread: Optional[threading.Thread] = None
-        # sessions idle longer than this many processed frames expire
-        # (the VPP session/NAT timer analog, driven by the host loop)
-        self.session_max_age = 1 << 16
+        # session idle timeout in clock ticks; None = the dataplane
+        # config's sess_max_age (wall-clock based — the VPP session/NAT
+        # timer analog; lookups also enforce it in-kernel)
+        self.session_max_age = None
 
     # --- contiv.API analogs ---
     def _pod_ns_index(self, pod: PodID) -> int:

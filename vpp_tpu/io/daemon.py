@@ -24,7 +24,12 @@ import numpy as np
 
 from vpp_tpu.io.rings import IORingPair, VEC
 from vpp_tpu.io.transport import BROADCAST_MAC, Transport
-from vpp_tpu.native.pktio import FLAG_NON_IP4, FLAG_VALID, PacketCodec
+from vpp_tpu.native.pktio import (
+    FLAG_NON_IP4,
+    FLAG_TRUNC,
+    FLAG_VALID,
+    PacketCodec,
+)
 from vpp_tpu.pipeline.vector import Disposition
 
 log = logging.getLogger("io_daemon")
@@ -54,7 +59,7 @@ class IODaemon:
         self.stats = {
             "rx_frames": 0, "rx_pkts": 0, "rx_ring_full": 0,
             "tx_frames": 0, "tx_pkts": 0, "tx_drops": 0, "tx_punts": 0,
-            "vxlan_encap": 0, "vxlan_decap": 0,
+            "trunc_drops": 0, "vxlan_encap": 0, "vxlan_decap": 0,
         }
         self._stop = threading.Event()
         self._threads = []
@@ -153,6 +158,12 @@ class IODaemon:
         uplink = self.transports.get(self.uplink_if)
         for i in range(n):
             if not flags[i] & FLAG_VALID:
+                continue
+            if flags[i] & FLAG_TRUNC:
+                # captured < claimed bytes: transmitting would pad with
+                # residual slot data (cross-flow leak) or emit a frame
+                # whose IP length lies — drop and make it visible
+                self.stats["trunc_drops"] += 1
                 continue
             d = int(disp[i])
             wire_len = min(int(pkt_len[i]) + 14, payload.shape[1])

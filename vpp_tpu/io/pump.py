@@ -22,7 +22,7 @@ import jax
 import numpy as np
 
 from vpp_tpu.io.rings import IORingPair
-from vpp_tpu.native.pktio import FLAG_NON_IP4, FLAG_VALID
+from vpp_tpu.native.pktio import FLAG_NON_IP4, FLAG_TRUNC, FLAG_VALID
 from vpp_tpu.pipeline.vector import Disposition, PacketVector
 
 log = logging.getLogger("pump")
@@ -74,9 +74,11 @@ class DataplanePump:
         cols = frame.cols
         flags = np.asarray(cols["flags"])
         non_ip = (flags & FLAG_NON_IP4) != 0
-        # non-IPv4 slots are invalid for the pipeline (their L3/L4
-        # columns are zero); they are punted after the step instead
-        pv_flags = np.where(non_ip, 0, flags).astype(np.int32)
+        trunc = (flags & FLAG_TRUNC) != 0
+        # non-IPv4 and truncated slots are invalid for the pipeline
+        # (bogus/partial headers); non-IP is punted after the step,
+        # truncated is dropped by the daemon via its flag
+        pv_flags = np.where(non_ip | trunc, 0, flags).astype(np.int32)
         pv = PacketVector(
             src_ip=np.asarray(cols["src_ip"]).copy(),
             dst_ip=np.asarray(cols["dst_ip"]).copy(),

@@ -61,22 +61,13 @@ class IORing:
     def push(self, cols: Dict[str, np.ndarray], n: int,
              payload: Optional[np.ndarray] = None, epoch: int = 0) -> bool:
         """Write one frame (+payload rows) — False if full."""
-        lib, base = self.ring.lib, self.ring._base
-        off = lib.fr_produce_reserve(base)
+        off = self.ring.reserve()
         if off < 0:
             return False
-        idx = self._slot_index(off)
         if payload is not None:
-            self.payload[idx, :n] = payload[:n]
-        hdr = np.frombuffer(self.ring._mv, np.uint32, count=2, offset=off)
-        hdr[0] = n
-        hdr[1] = epoch
-        for name, slot_col in self.ring._slot_views(off).items():
-            if name in cols:
-                slot_col[:] = cols[name]
-            else:
-                slot_col[:] = 0
-        lib.fr_produce_commit(base)
+            self.payload[self._slot_index(off), :n] = payload[:n]
+        self.ring.write_slot(off, cols, n, epoch)
+        self.ring.commit()
         return True
 
     # --- consumer ---

@@ -35,6 +35,9 @@ enum Col {
 // flags bits (bit0 mirrors PacketVector FLAG_VALID)
 constexpr int32_t kFlagValid = 1;
 constexpr int32_t kFlagNonIp4 = 2;   // not IPv4: punt/bypass, never classify
+constexpr int32_t kFlagTrunc = 4;    // captured bytes < claimed length:
+                                     // must be dropped, never transmitted
+                                     // (stale slot bytes would leak)
 
 constexpr uint32_t kEthHdr = 14;
 constexpr uint16_t kEthIp4 = 0x0800;
@@ -117,10 +120,11 @@ uint32_t pio_parse(const uint8_t* bufs, const uint64_t* offsets,
     col(cols, kRxIf)[i] = rx_if;
     // pkt_len convention is L3 length (wire length = pkt_len + 14);
     // keep it for non-IPv4 frames too so the tx side reconstructs the
-    // right wire length for punts.
+    // right wire length for punts. Clamped to the captured bytes.
     col(cols, kPktLen)[i] =
-        static_cast<int32_t>(len >= kEthHdr ? len - kEthHdr : 0);
+        static_cast<int32_t>(copy >= kEthHdr ? copy - kEthHdr : 0);
     col(cols, kFlags)[i] = kFlagValid;
+    if (len > snap) col(cols, kFlags)[i] |= kFlagTrunc;
     if (len < kEthHdr + 20 || rd16(f + 12) != kEthIp4) {
       col(cols, kFlags)[i] |= kFlagNonIp4;
       continue;
@@ -135,7 +139,17 @@ uint32_t pio_parse(const uint8_t* bufs, const uint64_t* offsets,
     col(cols, kDstIp)[i] = static_cast<int32_t>(rd32(ip + 16));
     col(cols, kProto)[i] = ip[9];
     col(cols, kTtl)[i] = ip[8];
-    col(cols, kPktLen)[i] = rd16(ip + 2);
+    // pkt_len is CLAMPED to what was actually captured: a header
+    // claiming more than the wire delivered (or a frame longer than
+    // snap) must never cause tx of residual bytes from a previous
+    // packet in the reused slot — that would leak cross-flow data.
+    uint32_t tot_len = rd16(ip + 2);
+    uint32_t captured_l3 = copy - kEthHdr;
+    if (tot_len > captured_l3 || len > snap) {
+      col(cols, kFlags)[i] |= kFlagTrunc;
+      tot_len = tot_len > captured_l3 ? captured_l3 : tot_len;
+    }
+    col(cols, kPktLen)[i] = static_cast<int32_t>(tot_len);
     uint8_t proto = ip[9];
     const uint8_t* l4 = ip + ihl;
     if ((proto == 6 || proto == 17) && len >= kEthHdr + ihl + 4) {
@@ -240,10 +254,15 @@ uint32_t pio_encap(const uint8_t* frame, uint32_t frame_len, uint32_t src_ip,
 // Decapsulate: returns offset of the inner frame within `frame` (the
 // payload of a VXLAN UDP datagram), or 0 if not VXLAN-to-our-port.
 uint32_t pio_decap_offset(const uint8_t* frame, uint32_t frame_len) {
-  if (frame_len < kEthHdr + 20 + 8 + 8 + kEthHdr) return 0;
+  if (frame_len < kEthHdr + 20) return 0;
   if (rd16(frame + 12) != kEthIp4) return 0;
   const uint8_t* ip = frame + kEthHdr;
+  if ((ip[0] >> 4) != 4) return 0;
   uint32_t ihl = (ip[0] & 0x0f) * 4u;
+  if (ihl < 20) return 0;
+  // Bounds must use the ACTUAL header length (IHL up to 60): a crafted
+  // IHL with a 20-byte-based check would read past the buffer.
+  if (frame_len < kEthHdr + ihl + 8 + 8 + kEthHdr) return 0;
   if (ip[9] != 17) return 0;
   const uint8_t* udp = ip + ihl;
   if (rd16(udp + 2) != 4789) return 0;

@@ -37,6 +37,7 @@ N_COLUMNS = len(RING_COLUMNS)
 
 FLAG_VALID = 1
 FLAG_NON_IP4 = 2
+FLAG_TRUNC = 4   # captured < claimed length: drop, never transmit
 
 _COL_INDEX = {name: i for i, (name, _) in enumerate(RING_COLUMNS)}
 

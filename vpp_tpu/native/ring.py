@@ -186,14 +186,15 @@ class FrameRing:
         return cols
 
     # --- producer ---
-    def push(self, columns: Dict[str, np.ndarray], n_packets: int,
-             epoch: int = 0) -> bool:
-        """Write one frame; False if the ring is full. ``columns`` maps
-        PacketVector field names to [VEC] arrays of the right dtype.
-        Columns are written straight into the slot (one copy total)."""
-        off = self.lib.fr_produce_reserve(self._base)
-        if off < 0:
-            return False
+    def reserve(self) -> int:
+        """Reserve the next slot; returns its byte offset or -1 (full).
+        Write via write_slot() then commit()."""
+        return int(self.lib.fr_produce_reserve(self._base))
+
+    def write_slot(self, off: int, columns: Dict[str, np.ndarray],
+                   n_packets: int, epoch: int = 0) -> None:
+        """Fill a reserved slot: header words + all columns (the single
+        copy of the slot-write protocol; IORing reuses it)."""
         hdr = np.frombuffer(self._mv, np.uint32, count=2, offset=off)
         hdr[0] = n_packets
         hdr[1] = epoch
@@ -205,7 +206,20 @@ class FrameRing:
                 slot_col[:] = columns[name]
             else:
                 slot_col[:] = 0
+
+    def commit(self) -> None:
         self.lib.fr_produce_commit(self._base)
+
+    def push(self, columns: Dict[str, np.ndarray], n_packets: int,
+             epoch: int = 0) -> bool:
+        """Write one frame; False if the ring is full. ``columns`` maps
+        PacketVector field names to [VEC] arrays of the right dtype.
+        Columns are written straight into the slot (one copy total)."""
+        off = self.reserve()
+        if off < 0:
+            return False
+        self.write_slot(off, columns, n_packets, epoch)
+        self.commit()
         return True
 
     # --- consumer ---

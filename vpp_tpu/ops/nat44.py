@@ -136,7 +136,7 @@ def nat44_record(
     kind: jnp.ndarray,
     want: jnp.ndarray,
     now: jnp.ndarray,
-) -> Tuple[DataplaneTables, jnp.ndarray]:
+) -> Tuple[DataplaneTables, jnp.ndarray, jnp.ndarray]:
     """Record NAT sessions for translated-and-forwarded flows.
 
     ``pkts`` are the post-translation headers; ``orig_*`` the
@@ -147,10 +147,12 @@ def nat44_record(
     destination) and the ``kind`` bitmask saying which rewrites apply
     (1=DNAT, 2=SNAT — a node-port flow to a remote backend carries both).
 
-    Returns (tables, conflict): ``conflict`` marks packets whose reply
-    key is already owned by a *different* flow (hash-derived SNAT port
-    collision) — the caller fails closed (drops + counts) so replies are
-    never misdelivered to the wrong pod.
+    Returns (tables, conflict, failed): ``conflict`` marks packets whose
+    reply key is already owned by a *different* flow (hash-derived SNAT
+    port collision) — the caller fails closed (drops + counts) so
+    replies are never misdelivered to the wrong pod. ``failed`` marks
+    probe-window congestion (no slot found; surfaced as a counter).
+    Expired entries are evicted in place (``tables.sess_max_age``).
     """
     key_vals = (
         pkts.dst_ip,
@@ -159,7 +161,7 @@ def nat44_record(
         pkts.proto,
     )
     h = _hash(*key_vals, tables.natsess_valid.shape[0])
-    valid, time, keys, extras, _, conflict = hashmap_insert(
+    valid, time, keys, extras, _, conflict, failed = hashmap_insert(
         tables.natsess_valid,
         tables.natsess_time,
         (tables.natsess_a, tables.natsess_b, tables.natsess_ports, tables.natsess_proto),
@@ -170,6 +172,7 @@ def nat44_record(
         h,
         want,
         now,
+        max_age=tables.sess_max_age,
     )
     return tables._replace(
         natsess_a=keys[0],
@@ -183,15 +186,20 @@ def nat44_record(
         natsess_src_ip=extras[2],
         natsess_sport=extras[3],
         natsess_kind=extras[4],
-    ), conflict
+    ), conflict, failed
 
 
 def nat44_reverse(
     tables: DataplaneTables,
     pkts: PacketVector,
     eligible: jnp.ndarray,
-) -> Tuple[PacketVector, jnp.ndarray]:
+    now=None,
+) -> Tuple[PacketVector, jnp.ndarray, jnp.ndarray]:
     """Untranslate NAT'd return traffic.
+
+    Returns (pkts, applied, hit_idx): ``hit_idx`` is the matched slot
+    (undefined where not applied) so the caller can refresh the
+    session's timestamp via ``nat44_touch``.
 
     A reply packet matches a NAT session keyed on its own header
     (src, dst, sport<<16|dport, proto). The recorded ``kind`` bitmask
@@ -215,6 +223,11 @@ def nat44_reverse(
         n_slots - 1
     )
     slot_ok = tables.natsess_valid[idx] == 1
+    if now is not None:
+        # expired NAT state must not translate new traffic
+        slot_ok = slot_ok & (
+            now - tables.natsess_time[idx] <= tables.sess_max_age
+        )
     for arr, val in zip(
         (tables.natsess_a, tables.natsess_b, tables.natsess_ports, tables.natsess_proto),
         key_vals,
@@ -233,4 +246,16 @@ def nat44_reverse(
         dst_ip=jnp.where(undo_snat, tables.natsess_src_ip[hit_idx], pkts.dst_ip),
         dport=jnp.where(undo_snat, tables.natsess_sport[hit_idx], pkts.dport),
     )
-    return out, applied
+    return out, applied, hit_idx
+
+
+def nat44_touch(
+    tables: DataplaneTables, hit_idx: jnp.ndarray, mask: jnp.ndarray, now
+) -> DataplaneTables:
+    """Refresh natsess_time for sessions hit by reply traffic — an
+    active NAT'd flow must not expire while its replies still flow."""
+    n_slots = tables.natsess_valid.shape[0]
+    widx = jnp.where(mask, hit_idx, n_slots)
+    return tables._replace(
+        natsess_time=tables.natsess_time.at[widx].set(now, mode="drop")
+    )

@@ -22,6 +22,7 @@ matching the two vswitch hops a packet crosses in the reference.
 from __future__ import annotations
 
 import threading
+import time as _time
 from typing import List, NamedTuple, Optional, Sequence
 
 import numpy as np
@@ -228,6 +229,8 @@ class ClusterDataplane:
             n.commit_lock = self._lock
         self.tables: Optional[DataplaneTables] = None
         self.epoch = 0
+        # wall-clock session time base (matches Dataplane semantics)
+        self._t0 = _time.monotonic()
         self._now = 0
         self._uplinks = None
         self._step = make_cluster_step(mesh)
@@ -295,7 +298,11 @@ class ClusterDataplane:
             if self.tables is None:
                 self.swap()
             if now is None:
-                self._now += 1
+                ticks = int(
+                    (_time.monotonic() - self._t0)
+                    * Dataplane.TICKS_PER_SEC
+                )
+                self._now = max(self._now, ticks)
                 now = self._now
             tables, uplinks = self.tables, self._uplinks
         result = self._step(tables, pkts, jnp.int32(now), uplinks)

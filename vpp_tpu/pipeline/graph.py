@@ -20,8 +20,18 @@ import jax.numpy as jnp
 from vpp_tpu.ops.acl import acl_classify_global, acl_classify_local
 from vpp_tpu.ops.fib import ip4_lookup
 from vpp_tpu.ops.ip4 import ip4_input
-from vpp_tpu.ops.nat44 import nat44_dnat, nat44_record, nat44_reverse, nat44_snat
-from vpp_tpu.ops.session import session_insert, session_lookup_reverse
+from vpp_tpu.ops.nat44 import (
+    nat44_dnat,
+    nat44_record,
+    nat44_reverse,
+    nat44_snat,
+    nat44_touch,
+)
+from vpp_tpu.ops.session import (
+    session_insert,
+    session_lookup_reverse_idx,
+    session_touch,
+)
 from vpp_tpu.pipeline.tables import DataplaneTables
 from vpp_tpu.pipeline.vector import Disposition, PacketVector
 
@@ -41,6 +51,12 @@ class StepStats(NamedTuple):
     drop_nat: jnp.ndarray      # int32 scalar: NAT fail-closed drops
                                # (SNAT port collision / un-NATable proto
                                # on an SNAT egress route)
+    sess_insert_fail: jnp.ndarray     # int32 scalar: reflective-session
+                                      # probe-window congestion (no slot)
+    natsess_insert_fail: jnp.ndarray  # int32 scalar: NAT-session insert
+                                      # congestion
+    sess_occupancy: jnp.ndarray       # int32 scalar: live reflective slots
+    natsess_occupancy: jnp.ndarray    # int32 scalar: live NAT slots
     if_rx: jnp.ndarray         # int32 [I] per-interface rx packets
     if_tx: jnp.ndarray         # int32 [I] per-interface tx packets
     if_rx_bytes: jnp.ndarray   # int32 [I]
@@ -106,10 +122,15 @@ def pipeline_step(
     # --- reflective session bypass (return traffic of permitted flows) ---
     # Looked up on the raw (pre-NAT) header: forward sessions are installed
     # post-DNAT, so a backend's reply B→C reverses to the stored C→B key.
-    established = session_lookup_reverse(tables, pkts) & alive
+    # Expired entries (idle > sess_max_age ticks) don't match, and hits
+    # refresh the timestamp — active flows never expire mid-flow.
+    established, sess_hit_idx = session_lookup_reverse_idx(tables, pkts, now)
+    established = established & alive
+    tables = session_touch(tables, sess_hit_idx, established, now)
 
     # --- NAT44: reverse-translate return traffic, then DNAT new flows ---
-    pkts, nat_reversed = nat44_reverse(tables, pkts, alive)
+    pkts, nat_reversed, nat_hit_idx = nat44_reverse(tables, pkts, alive, now)
+    tables = nat44_touch(tables, nat_hit_idx, nat_reversed, now)
     orig_dst, orig_dport = pkts.dst_ip, pkts.dport
     pkts, dnat_applied, dnat_self_snat = nat44_dnat(
         tables, pkts, alive & ~nat_reversed
@@ -151,11 +172,11 @@ def pipeline_step(
     # --- session install for newly permitted flows only (denied packets
     # must not consume session slots); keys are post-NAT so replies match ---
     want_sess = forwarded & ~established & nat_capable & ~nat_unsupported
-    tables, _ = session_insert(tables, pkts, want_sess, now)
+    tables, _, sess_fail = session_insert(tables, pkts, want_sess, now)
     nat_kind = (
         jnp.where(dnat_applied, 1, 0) + jnp.where(snat_applied, 2, 0)
     ).astype(jnp.int32)
-    tables, nat_conflict = nat44_record(
+    tables, nat_conflict, natsess_fail = nat44_record(
         tables, pkts, orig_dst, orig_dport, orig_src, orig_sport, nat_kind,
         (dnat_applied | snat_applied) & forwarded, now,
     )
@@ -193,6 +214,19 @@ def pipeline_step(
         snat=jnp.sum((snat_applied & forwarded).astype(jnp.int32)),
         nat_reversed=jnp.sum((nat_reversed & forwarded).astype(jnp.int32)),
         drop_nat=jnp.sum(dropped_nat.astype(jnp.int32)),
+        sess_insert_fail=jnp.sum(sess_fail.astype(jnp.int32)),
+        natsess_insert_fail=jnp.sum(natsess_fail.astype(jnp.int32)),
+        # live = valid and not idle-expired (what lookups actually see)
+        sess_occupancy=jnp.sum(
+            ((tables.sess_valid == 1)
+             & (now - tables.sess_time <= tables.sess_max_age)
+             ).astype(jnp.int32)
+        ),
+        natsess_occupancy=jnp.sum(
+            ((tables.natsess_valid == 1)
+             & (now - tables.natsess_time <= tables.sess_max_age)
+             ).astype(jnp.int32)
+        ),
         if_rx=zero_i.at[rx_if_safe].add(1, mode="drop"),
         if_tx=zero_i.at[tx_if_safe].add(1, mode="drop"),
         if_rx_bytes=zero_i.at[rx_if_safe].add(

@@ -42,6 +42,12 @@ class DataplaneConfig(NamedTuple):
     max_ifaces: int = 64
     fib_slots: int = 128
     sess_slots: int = 4096     # reflective-session hash slots (power of 2)
+    # Session/NAT idle timeout in clock ticks (Dataplane.TICKS_PER_SEC =
+    # 10/s, so 3000 = 300 s — VPP's default TCP established timeout
+    # order). Enforced in-kernel: lookups ignore expired entries and
+    # inserts reclaim their slots, so timeout precision doesn't depend
+    # on the host aging loop's cadence.
+    sess_max_age: int = 3000
     nat_mappings: int = 64     # DNAT static mapping slots
     nat_backends: int = 512    # total backend slots across mappings
 
@@ -102,7 +108,8 @@ class DataplaneTables(NamedTuple):
     sess_ports: jnp.ndarray     # uint32 (sport<<16 | dport)
     sess_proto: jnp.ndarray     # int32
     sess_valid: jnp.ndarray     # int32 bool
-    sess_time: jnp.ndarray      # int32 last-hit epoch (for host-side aging)
+    sess_time: jnp.ndarray      # int32 last-hit tick (aging)
+    sess_max_age: jnp.ndarray   # int32 scalar: idle timeout in ticks
 
     # --- NAT44 DNAT mappings [M] + backends [B] ---
     nat_ext_ip: jnp.ndarray     # uint32 service VIP / node IP
@@ -410,6 +417,7 @@ class TableBuilder:
             fib_next_hop=self.fib_next_hop,
             fib_node_id=self.fib_node_id,
             fib_snat=self.fib_snat,
+            sess_max_age=np.int32(self.config.sess_max_age),
             nat_ext_ip=self.nat_ext_ip,
             nat_ext_port=self.nat_ext_port,
             nat_proto=self.nat_proto,

@@ -43,6 +43,13 @@ NODE_GAUGES = (
     ("vpp_tpu_node_drop_acl", "policy (ACL) denies"),
     ("vpp_tpu_node_drop_no_route", "FIB lookup misses"),
     ("vpp_tpu_node_sessions_active", "live reflective-session entries"),
+    ("vpp_tpu_node_drop_nat", "NAT fail-closed drops"),
+    ("vpp_tpu_node_sess_insert_fail",
+     "reflective-session inserts that found no free probe slot"),
+    ("vpp_tpu_node_natsess_insert_fail",
+     "NAT-session inserts that found no free probe slot"),
+    ("vpp_tpu_node_sess_occupancy", "live (unexpired) reflective slots"),
+    ("vpp_tpu_node_natsess_occupancy", "live (unexpired) NAT-session slots"),
 )
 
 
@@ -67,7 +74,12 @@ class StatsCollector:
         }
         self._totals: Dict[str, int] = {
             k: 0 for k in ("rx", "tx", "drop_ip4", "drop_acl",
-                           "drop_no_route", "punt")
+                           "drop_no_route", "punt", "drop_nat",
+                           "sess_insert_fail", "natsess_insert_fail")
+        }
+        # gauges, not counters: last-step snapshots
+        self._last: Dict[str, int] = {
+            "sess_occupancy": 0, "natsess_occupancy": 0,
         }
         self.if_gauges = {
             name: self.registry.register(STATS_PATH, Gauge(name, help_))
@@ -95,6 +107,8 @@ class StatsCollector:
                 self._acc[k] += np.asarray(getattr(stats, k), np.int64)
             for k in self._totals:
                 self._totals[k] += int(getattr(stats, k))
+            for k in self._last:
+                self._last[k] = int(getattr(stats, k))
 
     def totals_snapshot(self) -> Dict[str, int]:
         """Consistent copy of the node-level counters (CLI/debug use)."""
@@ -164,6 +178,17 @@ class StatsCollector:
         self.node_gauges["vpp_tpu_node_drop_ip4"].set(totals["drop_ip4"])
         self.node_gauges["vpp_tpu_node_drop_acl"].set(totals["drop_acl"])
         self.node_gauges["vpp_tpu_node_drop_no_route"].set(totals["drop_no_route"])
+        self.node_gauges["vpp_tpu_node_drop_nat"].set(totals["drop_nat"])
+        self.node_gauges["vpp_tpu_node_sess_insert_fail"].set(
+            totals["sess_insert_fail"])
+        self.node_gauges["vpp_tpu_node_natsess_insert_fail"].set(
+            totals["natsess_insert_fail"])
+        with self._lock:
+            last = dict(self._last)
+        self.node_gauges["vpp_tpu_node_sess_occupancy"].set(
+            last["sess_occupancy"])
+        self.node_gauges["vpp_tpu_node_natsess_occupancy"].set(
+            last["natsess_occupancy"])
         if self.dp.tables is not None:
             self.node_gauges["vpp_tpu_node_sessions_active"].set(
                 int(np.asarray(self.dp.tables.sess_valid).sum())
