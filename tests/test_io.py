@@ -277,12 +277,42 @@ class TestCodecSafety:
         frame[12:14] = b"\x08\x00"
         frame[14] = 0x4F          # v4, ihl=15
         frame[14 + 9] = 17        # udp
-        assert codec.decap_offset(bytes(frame)) == 0
+        assert codec.decap_offset(bytes(frame), 10) == 0
         # IHL<20 and non-v4 likewise rejected
         frame[14] = 0x43
-        assert codec.decap_offset(bytes(frame)) == 0
+        assert codec.decap_offset(bytes(frame), 10) == 0
         frame[14] = 0x65
-        assert codec.decap_offset(bytes(frame)) == 0
+        assert codec.decap_offset(bytes(frame), 10) == 0
+
+    def test_decap_requires_flag_and_vni_match(self):
+        from vpp_tpu.native.pktio import PacketCodec
+
+        codec = PacketCodec()
+        inner = make_frame(CLIENT_IP, SERVER_IP, proto=17, dport=80)
+        arr = np.frombuffer(inner, np.uint8)
+        wire = bytearray(codec.encap(
+            arr, len(arr), 0x0A000001, 0x0A000002, 49152, 10,
+            b"\x02" * 6, b"\x04" * 6,
+        ))
+        off = codec.decap_offset(bytes(wire), 10)
+        assert off and bytes(wire[off:off + len(inner)]) == inner
+        # wrong segment: a frame from VNI 11 must not be injected
+        assert codec.decap_offset(bytes(wire), 11) == 0
+        # I-flag clear (no VNI present): reject even if port matches
+        ihl = (wire[14] & 0x0F) * 4
+        wire[14 + ihl + 8] = 0x00
+        assert codec.decap_offset(bytes(wire), 10) == 0
+
+    def test_runt_frame_marked_trunc(self):
+        from vpp_tpu.native.pktio import FLAG_TRUNC, PacketCodec
+
+        codec = PacketCodec()
+        payload = np.full((256, 2048), 0xAB, np.uint8)  # poisoned slots
+        cols, n = codec.parse([b"\x02\x04\x06"], 0, payload)
+        assert n == 1
+        # a 3-byte runt must never reach tx: wire_len would include
+        # residual bytes from the slot's previous occupant
+        assert cols["flags"][0] & FLAG_TRUNC
 
 
 def _can_netadmin() -> bool:

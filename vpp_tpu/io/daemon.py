@@ -103,7 +103,7 @@ class IODaemon:
             # VXLAN datagrams from peer nodes carry the inner frame
             unwrapped = []
             for f in frames:
-                off = self.codec.decap_offset(f)
+                off = self.codec.decap_offset(f, self.vni)
                 if off:
                     self.stats["vxlan_decap"] += 1
                     unwrapped.append(f[off:])

@@ -68,6 +68,7 @@ class RemoteKVStore:
         self._ids = itertools.count(1)
         self._wids = itertools.count(1)
         self._lock = threading.Lock()          # connection + pending state
+        self._send_lock = threading.Lock()     # serializes socket writes
         self._sock: Optional[socket.socket] = None
         self._pending: Dict[int, "queue.Queue[Any]"] = {}
         self._watches: Dict[int, _Watch] = {}
@@ -188,7 +189,12 @@ class RemoteKVStore:
                 time.sleep(0.05)
                 continue
             try:
-                sock.sendall(data)
+                # sendall can be split across multiple send() syscalls;
+                # without this lock two caller threads (maintenance loop,
+                # watch dispatcher, CNI handlers) could interleave partial
+                # writes and corrupt the newline-delimited stream.
+                with self._send_lock:
+                    sock.sendall(data)
             except OSError:
                 self._pending.pop(rid, None)
                 time.sleep(0.05)

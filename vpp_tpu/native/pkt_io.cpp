@@ -125,6 +125,10 @@ uint32_t pio_parse(const uint8_t* bufs, const uint64_t* offsets,
         static_cast<int32_t>(copy >= kEthHdr ? copy - kEthHdr : 0);
     col(cols, kFlags)[i] = kFlagValid;
     if (len > snap) col(cols, kFlags)[i] |= kFlagTrunc;
+    // Runts shorter than an Ethernet header have no meaningful wire
+    // length; without kFlagTrunc the punt path would transmit up to 14
+    // bytes including residual data from the slot's previous occupant.
+    if (copy < kEthHdr) col(cols, kFlags)[i] |= kFlagTrunc;
     if (len < kEthHdr + 20 || rd16(f + 12) != kEthIp4) {
       col(cols, kFlags)[i] |= kFlagNonIp4;
       continue;
@@ -252,8 +256,13 @@ uint32_t pio_encap(const uint8_t* frame, uint32_t frame_len, uint32_t src_ip,
 }
 
 // Decapsulate: returns offset of the inner frame within `frame` (the
-// payload of a VXLAN UDP datagram), or 0 if not VXLAN-to-our-port.
-uint32_t pio_decap_offset(const uint8_t* frame, uint32_t frame_len) {
+// payload of a VXLAN UDP datagram), or 0 if not VXLAN-to-our-port, not
+// a VNI-present VXLAN header, or from a different overlay segment than
+// `vni` (the reference maps tunnels by VNI; accepting any UDP/4789
+// frame would inject foreign-segment or crafted traffic as inner
+// frames).
+uint32_t pio_decap_offset(const uint8_t* frame, uint32_t frame_len,
+                          uint32_t vni) {
   if (frame_len < kEthHdr + 20) return 0;
   if (rd16(frame + 12) != kEthIp4) return 0;
   const uint8_t* ip = frame + kEthHdr;
@@ -266,6 +275,9 @@ uint32_t pio_decap_offset(const uint8_t* frame, uint32_t frame_len) {
   if (ip[9] != 17) return 0;
   const uint8_t* udp = ip + ihl;
   if (rd16(udp + 2) != 4789) return 0;
+  const uint8_t* vx = udp + 8;
+  if (vx[0] != 0x08) return 0;                 // I flag: VNI present
+  if ((rd32(vx + 4) >> 8) != vni) return 0;    // segment match
   return kEthHdr + ihl + 8 + 8;
 }
 

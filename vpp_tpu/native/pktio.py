@@ -16,7 +16,7 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-from vpp_tpu.native.ring import RING_COLUMNS, build_native
+from vpp_tpu.native.ring import RING_COLUMNS, load_native
 
 _PKG_DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_PKG_DIR, "pkt_io.cpp")
@@ -47,7 +47,7 @@ def _load() -> ctypes.CDLL:
     with _lock:
         if _lib is not None:
             return _lib
-        lib = ctypes.CDLL(build_native(_SRC, _LIB))
+        lib = load_native(_SRC, _LIB)
         lib.pio_vec.restype = ctypes.c_uint32
         lib.pio_columns.restype = ctypes.c_uint32
         lib.pio_parse.restype = ctypes.c_uint32
@@ -68,7 +68,9 @@ def _load() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ]
         lib.pio_decap_offset.restype = ctypes.c_uint32
-        lib.pio_decap_offset.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+        lib.pio_decap_offset.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
+        ]
         assert int(lib.pio_vec()) == VEC
         assert int(lib.pio_columns()) == N_COLUMNS
         _lib = lib
@@ -139,8 +141,10 @@ class PacketCodec:
         )
         return out[:total].tobytes()
 
-    def decap_offset(self, frame: bytes) -> int:
+    def decap_offset(self, frame: bytes, vni: int) -> int:
+        """Offset of the inner frame if this is a VXLAN datagram for
+        segment ``vni`` (I-flag set, VNI match), else 0."""
         arr = np.frombuffer(frame, np.uint8)
         return int(self.lib.pio_decap_offset(
-            arr.ctypes.data_as(ctypes.c_void_p), len(arr)
+            arr.ctypes.data_as(ctypes.c_void_p), len(arr), vni & 0xFFFFFF
         ))

@@ -90,6 +90,18 @@ def build_library(force: bool = False) -> str:
     return build_native(_SRC, _LIB, force)
 
 
+def load_native(src: str, lib_path: str) -> ctypes.CDLL:
+    """Build-if-stale then dlopen, with a rebuild fallback: a cached .so
+    from another arch/libc (copied build dir, container image change)
+    passes the mtime check but fails to load — force a recompile from
+    source instead of surfacing the dlopen error."""
+    path = build_native(src, lib_path)
+    try:
+        return ctypes.CDLL(path)
+    except OSError:
+        return ctypes.CDLL(build_native(src, lib_path, force=True))
+
+
 def _load() -> ctypes.CDLL:
     global _lib
     if _lib is not None:
@@ -97,8 +109,7 @@ def _load() -> ctypes.CDLL:
     # build_library no-ops when the cached .so is fresh, and rebuilds on
     # source changes — loading a stale binary would silently run old
     # slot-layout semantics against peers built from the new source
-    path = build_library()
-    lib = ctypes.CDLL(path)
+    lib = load_native(_SRC, _LIB)
     lib.fr_required_size.restype = ctypes.c_uint64
     lib.fr_required_size.argtypes = [ctypes.c_uint32]
     for fn in ("fr_slot_size", "fr_vec", "fr_columns", "fr_header_size",
