@@ -112,10 +112,12 @@ class IoHarness:
         dp.swap()
 
         # compile the pipeline step before any wire traffic so recv
-        # timeouts measure the data path, not the first jit trace
+        # timeouts measure the data path, not the first jit trace (the
+        # pump's hot path is the packed single-transfer step)
         from vpp_tpu.pipeline.vector import make_packet_vector
 
         self.dp.process(make_packet_vector([]))
+        self.dp.process_packed(np.zeros((9, 256), np.int32))
 
         self.rings = IORingPair(n_slots=8)
         self.transports = {}
@@ -232,11 +234,82 @@ class TestWireToWire:
 
     def test_stats_account_traffic(self, harness):
         s = harness.daemon.stats
+        # counters are incremented by the daemon tx thread AFTER
+        # send_frame; the previous test's recv() can beat that by a few
+        # instructions, so give the counters a moment to settle
+        deadline = time.monotonic() + 3
+        while time.monotonic() < deadline and s["tx_pkts"] < 3:
+            time.sleep(0.01)
         assert s["rx_frames"] >= 4
         assert s["tx_pkts"] >= 3
         assert s["vxlan_encap"] >= 1
         assert s["vxlan_decap"] >= 1
         assert harness.pump.stats["frames"] >= 4
+
+
+class TestPipelinedPump:
+    """The pump keeps frames in flight and coalesces under backlog
+    (VERDICT r2 Next #2) — results must still come out per-frame, in
+    order, with the right per-packet verdicts."""
+
+    def test_backlog_coalesced_in_order(self):
+        from vpp_tpu.io.rings import IORingPair
+        from vpp_tpu.native.pktio import PacketCodec
+        from vpp_tpu.pipeline.vector import VEC
+
+        dp = Dataplane(DataplaneConfig())
+        a = dp.add_pod_interface(("default", "a"))
+        b = dp.add_pod_interface(("default", "b"))
+        dp.builder.add_route(f"{CLIENT_IP}/32", a, Disposition.LOCAL)
+        dp.builder.add_route(f"{SERVER_IP}/32", b, Disposition.LOCAL)
+        dp.swap()
+        codec = PacketCodec()
+        rings = IORingPair(n_slots=32)
+        scratch = np.zeros((VEC, rings.rx.snap), np.uint8)
+
+        # fill the rx ring with 16 frames BEFORE starting the pump: the
+        # first dispatch must coalesce several of them into one batch
+        n_frames, per = 16, 8
+        for k in range(n_frames):
+            frames = [
+                make_frame(CLIENT_IP, SERVER_IP, proto=17, sport=20000 + k,
+                           dport=1000 + k * per + j)
+                for j in range(per)
+            ]
+            cols, n = codec.parse(frames, a, scratch)
+            assert rings.rx.push(cols, n, payload=scratch)
+        pump = DataplanePump(dp, rings, max_batch=2048).start()
+        try:
+            got = []
+            deadline = time.monotonic() + 120
+            while len(got) < n_frames and time.monotonic() < deadline:
+                f = rings.tx.peek()
+                if f is None:
+                    time.sleep(0.005)
+                    continue
+                got.append((f.cols["sport"][:f.n].copy(),
+                            f.cols["dport"][:f.n].copy(),
+                            f.cols["rx_if"][:f.n].copy(),
+                            f.n))
+                rings.tx.release()
+            assert len(got) == n_frames
+            for k, (sports, dports, tx_ifs, n) in enumerate(got):
+                assert n == per
+                # order preserved: frame k carries sport 20000+k
+                assert (sports == 20000 + k).all()
+                assert list(dports) == [1000 + k * per + j
+                                        for j in range(per)]
+                assert (tx_ifs == b).all()
+            assert pump.stats["frames"] == n_frames
+            assert pump.stats["pkts"] == n_frames * per
+            # backlog must have produced at least one multi-frame batch
+            assert pump.stats["max_coalesce"] > 1
+            assert pump.stats["batches"] < n_frames
+            lat = pump.latency_us()
+            assert lat["n"] == pump.stats["batches"] and lat["p99"] > 0
+        finally:
+            pump.stop()
+            rings.close()
 
 
 class TestCodecSafety:

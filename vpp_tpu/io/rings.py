@@ -60,12 +60,21 @@ class IORing:
     # --- producer ---
     def push(self, cols: Dict[str, np.ndarray], n: int,
              payload: Optional[np.ndarray] = None, epoch: int = 0) -> bool:
-        """Write one frame (+payload rows) — False if full."""
+        """Write one frame (+payload rows) — False if full.
+
+        Payload rows are copied only up to the frame's max wire length
+        (pkt_len + ethernet header), not the full snap width: consumers
+        never read past wire_len per packet, and copying snap bytes per
+        row (512 KB/frame at snap 2048) would bottleneck the host path
+        on memcpy for small-packet traffic."""
         off = self.ring.reserve()
         if off < 0:
             return False
         if payload is not None:
-            self.payload[self._slot_index(off), :n] = payload[:n]
+            w = self.snap
+            if n and "pkt_len" in cols:
+                w = min(self.snap, int(np.max(cols["pkt_len"][:n])) + 14)
+            self.payload[self._slot_index(off), :n, :w] = payload[:n, :w]
         self.ring.write_slot(off, cols, n, epoch)
         self.ring.commit()
         return True
@@ -76,6 +85,22 @@ class IORing:
         Valid until release()."""
         lib, base = self.ring.lib, self.ring._base
         off = lib.fr_consume_peek(base)
+        if off < 0:
+            return None
+        idx = self._slot_index(off)
+        hdr = np.frombuffer(self.ring._mv, np.uint32, count=2, offset=off)
+        return Frame(
+            self.ring._slot_views(off), int(hdr[0]), int(hdr[1]),
+            self.payload[idx],
+        )
+
+    def peek_nth(self, k: int) -> Optional[Frame]:
+        """Zero-copy views of the k-th oldest pending frame (k=0 ==
+        peek()), or None if fewer than k+1 frames are committed. The
+        slot stays ring-owned until k+1 release() calls happen, so the
+        views are stable while the frame is in flight on the device."""
+        lib, base = self.ring.lib, self.ring._base
+        off = lib.fr_consume_peek_nth(base, k)
         if off < 0:
             return None
         idx = self._slot_index(off)

@@ -137,6 +137,21 @@ int64_t fr_consume_peek(void* mem) {
   return static_cast<int64_t>(slot_ptr(r, tail) - reinterpret_cast<uint8_t*>(r));
 }
 
+// Peek the k-th oldest unconsumed slot (k=0 == fr_consume_peek).
+// Returns byte offset or -1 if fewer than k+1 frames are pending. Lets
+// the consumer keep several frames in flight (dispatched to the device)
+// while their slots stay owned by the ring — released in order once the
+// results are written out. The producer cannot touch these slots until
+// tail advances, so the views stay stable without a payload copy.
+int64_t fr_consume_peek_nth(void* mem, uint32_t k) {
+  RingHeader* r = as_ring(mem);
+  uint64_t tail = r->tail.load(std::memory_order_relaxed);
+  uint64_t head = r->head.load(std::memory_order_acquire);
+  if (tail + k >= head) return -1;
+  return static_cast<int64_t>(slot_ptr(r, tail + k) -
+                              reinterpret_cast<uint8_t*>(r));
+}
+
 // Release the slot returned by the last successful peek. Returns 0, or
 // -1 if there is nothing to release (a mismatched release would
 // otherwise advance tail past head and wedge the ring permanently).

@@ -126,6 +126,8 @@ def _load() -> ctypes.CDLL:
     lib.fr_produce_commit.argtypes = [ctypes.c_void_p]
     lib.fr_consume_peek.restype = ctypes.c_int64
     lib.fr_consume_peek.argtypes = [ctypes.c_void_p]
+    lib.fr_consume_peek_nth.restype = ctypes.c_int64
+    lib.fr_consume_peek_nth.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
     lib.fr_consume_release.restype = ctypes.c_int
     lib.fr_consume_release.argtypes = [ctypes.c_void_p]
     lib.fr_n_slots.restype = ctypes.c_uint32
