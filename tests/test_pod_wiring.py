@@ -1,0 +1,226 @@
+"""Dynamic pod attach e2e: CNI Add wires a REAL netns pod (VERDICT r2
+Next #1).
+
+A CNI Add must leave a working kernel path: veth pair created, container
+side configured inside the pod's netns (IP, routes, static gateway ARP),
+host side attached to the IO daemon through its control socket — and a
+UDP datagram sent by one netns pod must cross Transport → codec → ring →
+device pipeline → ring → Transport into the other netns pod. After a
+deny policy lands, the same traffic must die in the data plane.
+
+Reference analog: plugins/contiv/pod.go:262-452 (pod connectivity
+builders), remote_cni_server.go:895-1250 (configureContainerConnectivity)
+and the robot suite's pod↔pod UDP case
+(tests/robot/suites/one_node_two_pods.robot).
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from vpp_tpu.cni.model import CNIRequest, ResultCode
+from vpp_tpu.cni.server import RemoteCNIServer
+from vpp_tpu.cni.wiring import VethPodWirer, host_ifname
+from vpp_tpu.io.control import IOControlClient, IOControlServer
+from vpp_tpu.io.daemon import IODaemon
+from vpp_tpu.io.pump import DataplanePump
+from vpp_tpu.io.rings import IORingPair
+from vpp_tpu.ipam.ipam import IPAM
+from vpp_tpu.ir.rule import Action, ContivRule, Protocol
+from vpp_tpu.pipeline.dataplane import Dataplane
+from vpp_tpu.pipeline.tables import DataplaneConfig
+
+
+def _can_netns() -> bool:
+    try:
+        r = subprocess.run(["ip", "netns", "add", "vpptselfns"],
+                           capture_output=True, timeout=10)
+        if r.returncode == 0:
+            subprocess.run(["ip", "netns", "del", "vpptselfns"],
+                           capture_output=True, timeout=10)
+            return True
+        return False
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+pytestmark = pytest.mark.skipif(
+    not _can_netns(), reason="needs CAP_NET_ADMIN (netns/veth)"
+)
+
+NS_A, NS_B = "vppt-poda", "vppt-podb"
+CID_A = "aaaa1111bbbb2222cccc"
+CID_B = "dddd3333eeee4444ffff"
+
+
+def _netns_path(name: str) -> str:
+    return f"/var/run/netns/{name}"
+
+
+def _cleanup():
+    for ns in (NS_A, NS_B):
+        subprocess.run(["ip", "netns", "del", ns], capture_output=True)
+    for cid in (CID_A, CID_B):
+        subprocess.run(["ip", "link", "del", host_ifname(cid)],
+                       capture_output=True)
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    """Dataplane + CNI server w/ wirer + in-process IO daemon with a
+    real control socket, plus two empty named netns "pods"."""
+    _cleanup()
+    for ns in (NS_A, NS_B):
+        subprocess.run(["ip", "netns", "add", ns], check=True, timeout=10)
+
+    dp = Dataplane(DataplaneConfig())
+    uplink = dp.add_uplink()
+    # no NetworkPolicy installed yet -> default allow (the classifier
+    # fails closed with an empty global table)
+    dp.builder.set_global_table(
+        [ContivRule(action=Action.PERMIT, protocol=Protocol.ANY)]
+    )
+    dp.swap()
+    dp.process_packed(np.zeros((9, 256), np.int32))  # pre-compile
+
+    rings = IORingPair(n_slots=32)
+    daemon = IODaemon(rings, {}, uplink_if=uplink).start()
+    ctl_sock = str(tmp_path / "io-ctl.sock")
+    control = IOControlServer(daemon, ctl_sock).start()
+    pump = DataplanePump(dp, rings).start()
+
+    ipam = IPAM(node_id=1)
+    wirer = VethPodWirer(IOControlClient(ctl_sock),
+                         gateway_ip=str(ipam.pod_gateway_ip()))
+    server = RemoteCNIServer(dp, ipam, wirer=wirer)
+    server.set_ready()
+    try:
+        yield {"dp": dp, "server": server, "daemon": daemon,
+               "ipam": ipam}
+    finally:
+        pump.stop()
+        control.close()
+        daemon.stop()
+        for t in daemon.transports.values():
+            t.close()
+        rings.close()
+        _cleanup()
+
+
+def _add_pod(server, cid: str, ns: str, name: str):
+    reply = server.add(CNIRequest(
+        container_id=cid, netns=_netns_path(ns), if_name="eth0",
+        extra_args={"K8S_POD_NAME": name, "K8S_POD_NAMESPACE": "default"},
+    ))
+    assert reply.result == ResultCode.OK, reply.error
+    addr = reply.interfaces[0].ip_addresses[0].address
+    return addr.split("/")[0]
+
+
+def _udp_recv_proc(ns: str, port: int):
+    return subprocess.Popen(
+        ["ip", "netns", "exec", ns, sys.executable, "-c",
+         "import socket,sys\n"
+         "s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)\n"
+         f"s.bind(('0.0.0.0', {port}))\n"
+         "s.settimeout(30)\n"
+         "data, peer = s.recvfrom(4096)\n"
+         "print(data.decode() + '|' + peer[0], flush=True)\n"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+
+
+def _udp_send(ns: str, dst: str, port: int, msg: str, times: int = 20):
+    # retried sends: first packets race the receiver bind + daemon select
+    subprocess.run(
+        ["ip", "netns", "exec", ns, sys.executable, "-c",
+         "import socket, time\n"
+         "s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)\n"
+         f"for _ in range({times}):\n"
+         f"    s.sendto({msg!r}.encode(), ('{dst}', {port}))\n"
+         "    time.sleep(0.1)\n"],
+        check=True, timeout=60, capture_output=True,
+    )
+
+
+class TestPodWiring:
+    def test_add_wires_real_interfaces_and_udp_flows(self, stack):
+        server, dp = stack["server"], stack["dp"]
+        ip_a = _add_pod(server, CID_A, NS_A, "pod-a")
+        ip_b = _add_pod(server, CID_B, NS_B, "pod-b")
+
+        # kernel artifacts exist: host-side veths, container eth0 w/ IP
+        assert subprocess.run(
+            ["ip", "link", "show", host_ifname(CID_A)],
+            capture_output=True).returncode == 0
+        out = subprocess.run(
+            ["ip", "-n", NS_B, "-o", "addr", "show", "eth0"],
+            capture_output=True, text=True).stdout
+        assert ip_b in out
+
+        # daemon got both attachments
+        assert set(stack["daemon"].transports) >= {
+            dp.pod_if[("default", "pod-a")],
+            dp.pod_if[("default", "pod-b")],
+        }
+
+        # pod A -> pod B UDP through the device pipeline
+        recv = _udp_recv_proc(NS_B, 5354)
+        time.sleep(0.5)
+        _udp_send(NS_A, ip_b, 5354, "hello-through-tpu")
+        out, err = recv.communicate(timeout=40)
+        assert "hello-through-tpu" in out, (out, err)
+        assert ip_a in out  # source IP preserved through the pipeline
+
+        # deny UDP:5355 toward pod B (NetworkPolicy analog), keep the
+        # rest: traffic must now die in the classifier
+        slot = dp.alloc_table_slot("deny-b")
+        with dp.commit_lock:
+            dp.builder.set_local_table(slot, [
+                ContivRule(action=Action.DENY,
+                           dest_network=ipaddress.ip_network(f"{ip_b}/32"),
+                           protocol=Protocol.UDP, dest_port=5355),
+                ContivRule(action=Action.PERMIT),
+            ])
+            dp.assign_pod_table(("default", "pod-a"), "deny-b")
+            dp.swap()
+        recv2 = _udp_recv_proc(NS_B, 5355)
+        time.sleep(0.5)
+        drops_before = stack["daemon"].stats["tx_drops"]
+        _udp_send(NS_A, ip_b, 5355, "must-not-arrive", times=5)
+        time.sleep(1.0)
+        assert stack["daemon"].stats["tx_drops"] > drops_before
+        recv2.kill()
+        out2, _ = recv2.communicate(timeout=10)
+        assert "must-not-arrive" not in (out2 or "")
+
+        # CNI Delete tears the kernel path down
+        reply = server.delete(CNIRequest(container_id=CID_A))
+        assert reply.result == ResultCode.OK
+        assert subprocess.run(
+            ["ip", "link", "show", host_ifname(CID_A)],
+            capture_output=True).returncode != 0
+        assert dp.pod_if.get(("default", "pod-a")) is None
+
+    def test_failed_wire_rolls_back(self, stack):
+        server = stack["server"]
+        ipam = stack["ipam"]
+        before = ipam.assigned_count()
+        # nonexistent netns: the wire step must fail and roll back the
+        # dataplane + IPAM state
+        reply = server.add(CNIRequest(
+            container_id="feedfacefeedface", netns="/var/run/netns/nope",
+            if_name="eth0",
+            extra_args={"K8S_POD_NAME": "ghost"},
+        ))
+        assert reply.result == ResultCode.ERROR
+        assert ipam.assigned_count() == before
+        assert stack["dp"].pod_if.get(("default", "ghost")) is None
+        # and the retry path stays clean (no stale index/interface)
+        assert server.index.lookup("feedfacefeedface") is None

@@ -25,6 +25,25 @@ class IOConfig:
     shm_name: str = ""                       # "" = in-process rings (dev)
     n_slots: int = 64
     snap: int = 2048                         # payload bytes kept per packet
+    # IO-daemon control socket: when set, the CNI server wires pods with
+    # real veth pairs and attaches them to the daemon at runtime
+    # (io/control.py; reference remote_cni_server.go:895-1250)
+    control_socket: str = ""
+    # pump tuning (io/pump.py): coalesced device batch cap, in-flight
+    # batches, concurrent result fetchers
+    max_batch: int = 2048
+    depth: int = 8
+    workers: int = 4
+    # node uplink (vpp-tpu-init bootstrap; reference contiv-init
+    # vppcfg.go:74-559): kernel NIC the IO daemon binds as the uplink
+    uplink_interface: str = ""
+    uplink_ip: str = ""                      # static CIDR; "" = none/DHCP
+    uplink_dhcp: bool = False
+    proxy_arp: bool = False
+    vni: int = 10
+    # handshake file the agent writes once rings exist so vpp-tpu-init
+    # can start the IO daemon with matching geometry ("" = don't write)
+    plan_path: str = ""
 
 
 @dataclasses.dataclass
@@ -44,6 +63,10 @@ class AgentConfig:
     # STN bootstrap
     stn_interface: str = ""                  # "" = no NIC stealing
     stn_persist_path: Optional[str] = None
+    # commit the independent renderers (TPU ACL + VPPTCP session) from
+    # worker threads (reference's optional parallel renderer commit,
+    # configurator_impl.go:211-233 / plugin_impl_policy.go:161)
+    parallel_renderer_commits: bool = False
     # device tables sizing
     dataplane: DataplaneConfig = dataclasses.field(default_factory=DataplaneConfig)
     # IPAM subnets

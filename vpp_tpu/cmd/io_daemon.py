@@ -17,18 +17,13 @@ from __future__ import annotations
 import argparse
 import logging
 import signal
-import socket
 import sys
 import threading
 
+from vpp_tpu.io.control import IOControlServer
 from vpp_tpu.io.daemon import IODaemon
 from vpp_tpu.io.rings import IORingPair
-from vpp_tpu.io.transport import (
-    AfPacketTransport,
-    SocketPairTransport,
-    TapTransport,
-    Transport,
-)
+from vpp_tpu.io.transport import make_transport
 
 log = logging.getLogger("io_daemon")
 
@@ -36,18 +31,6 @@ log = logging.getLogger("io_daemon")
 def parse_if_spec(spec: str) -> tuple:
     idx, kind, arg = spec.split(":", 2)
     return int(idx), kind, arg
-
-
-def make_transport(kind: str, arg: str) -> Transport:
-    if kind == "afpacket":
-        return AfPacketTransport(arg)
-    if kind == "tap":
-        return TapTransport(arg)
-    if kind == "fd":
-        return SocketPairTransport(
-            socket.socket(fileno=int(arg)), name=f"fd{arg}"
-        )
-    raise ValueError(f"unknown transport kind {kind!r}")
 
 
 def main(argv=None) -> int:
@@ -64,6 +47,9 @@ def main(argv=None) -> int:
     parser.add_argument("--vtep", type=int, default=0,
                         help="this node's VTEP IPv4 as uint32")
     parser.add_argument("--vni", type=int, default=10)
+    parser.add_argument("--control", default=None, metavar="SOCK",
+                        help="unix socket for runtime attach/detach "
+                             "(the agent's CNI server drives this)")
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args(argv)
     logging.basicConfig(level=args.log_level)
@@ -79,13 +65,19 @@ def main(argv=None) -> int:
         rings, transports, uplink_if=args.uplink, host_if=args.host_if,
         vtep_ip=args.vtep, vni=args.vni,
     ).start()
+    control = None
+    if args.control:
+        control = IOControlServer(daemon, args.control).start()
+        log.info("control socket at %s", args.control)
 
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: stop.set())
     stop.wait()
+    if control is not None:
+        control.close()
     daemon.stop()
-    for t in transports.values():
+    for t in daemon.transports.values():
         t.close()
     rings.close()
     return 0

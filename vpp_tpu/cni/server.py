@@ -43,6 +43,7 @@ class RemoteCNIServer:
         ipam: IPAM,
         index: Optional[ContainerIndex] = None,
         on_pod_change: Optional[Callable[[], None]] = None,
+        wirer=None,
     ):
         self.dp = dataplane
         self.ipam = ipam
@@ -53,6 +54,10 @@ class RemoteCNIServer:
         # the policy/service plugins' cue to re-render (the reference's
         # async ETCD-watch path, SURVEY.md §3.2).
         self.on_pod_change = on_pod_change
+        # Optional VethPodWirer (cni/wiring.py): creates the kernel veth
+        # path and attaches it to the IO daemon. None = config-only mode
+        # (unit tests, cluster simulations without CAP_NET_ADMIN).
+        self.wirer = wirer
 
     # --- lifecycle ---
     def set_ready(self) -> None:
@@ -64,6 +69,7 @@ class RemoteCNIServer:
         """Re-wire all persisted containers after an agent restart."""
         with self._lock, self.dp.commit_lock:
             n = 0
+            rewire = []
             for cfg in self.index.load_persisted():
                 pod = (cfg.pod_namespace, cfg.pod_name)
                 if_idx = self.dp.add_pod_interface(pod)
@@ -75,12 +81,44 @@ class RemoteCNIServer:
                     # back the pre-restart index; re-register so the
                     # persisted config and the ifindex→pod axis (metric
                     # labels) track the live interface.
-                    self.index.register(
-                        dataclasses.replace(cfg, if_index=if_idx)
-                    )
+                    cfg = dataclasses.replace(cfg, if_index=if_idx)
+                    self.index.register(cfg)
+                rewire.append(cfg)
                 n += 1
             if n:
                 self.dp.swap()
+            if self.wirer is not None:
+                # re-attach surviving veth pairs to the (possibly also
+                # restarted) IO daemon; attach is idempotent. A pod
+                # whose veth vanished (node reboot) gets re-created —
+                # kubelet will eventually re-Add anyway, but traffic
+                # for still-running containers must not wait for it.
+                from vpp_tpu.cni.wiring import host_ifname
+
+                from vpp_tpu.net import linux
+
+                for cfg in rewire:
+                    try:
+                        host_if = host_ifname(cfg.container_id)
+                        if linux.link_exists(host_if):
+                            self.wirer.re_attach(
+                                container_id=cfg.container_id,
+                                netns=cfg.netns,
+                                if_name=cfg.if_name,
+                                if_index=cfg.if_index,
+                                pod_ip=cfg.ip,
+                            )
+                        elif cfg.netns:
+                            self.wirer.wire(
+                                container_id=cfg.container_id,
+                                netns=cfg.netns,
+                                if_name=cfg.if_name,
+                                if_index=cfg.if_index,
+                                pod_ip=cfg.ip,
+                            )
+                    except Exception:  # noqa: BLE001 — per-pod isolation
+                        log.exception("resync re-wire failed for %s",
+                                      cfg.container_id)
             return n
 
     # --- CNI protocol ---
@@ -102,6 +140,8 @@ class RemoteCNIServer:
             # the live pod's connectivity.
             pod_id = f"{req.pod_namespace}/{req.pod_name}"
             ip = None
+            if_idx = None
+            pod = (req.pod_namespace, req.pod_name)
             try:
                 with self.dp.commit_lock:
                     stale = self.index.lookup_pod(
@@ -114,13 +154,29 @@ class RemoteCNIServer:
                             (stale.pod_namespace, stale.pod_name)
                         )
                         self.ipam.release_pod_ip(pod_id)
+                        if self.wirer is not None:
+                            self.wirer.unwire(
+                                container_id=stale.container_id,
+                                netns=stale.netns,
+                                if_index=stale.if_index,
+                            )
                     ip = self.ipam.next_pod_ip(pod_id)
-                    pod = (req.pod_namespace, req.pod_name)
                     if_idx = self.dp.add_pod_interface(pod)
                     self.dp.builder.add_route(
                         f"{ip}/32", if_idx, Disposition.LOCAL
                     )
                     self.dp.swap()
+                # kernel path: veth pair + netns config + daemon attach
+                # (the reference's configurePodInterface step,
+                # remote_cni_server.go:1039; rolls itself back on error)
+                if self.wirer is not None and req.netns:
+                    self.wirer.wire(
+                        container_id=req.container_id,
+                        netns=req.netns,
+                        if_name=req.if_name,
+                        if_index=if_idx,
+                        pod_ip=str(ip),
+                    )
                 cfg = ContainerConfig(
                     container_id=req.container_id,
                     pod_name=req.pod_name,
@@ -133,10 +189,24 @@ class RemoteCNIServer:
                 self.index.register(cfg)
             except Exception as e:  # IPAM full, interface table full, ...
                 log.exception("CNI Add failed for %s", req.container_id)
-                if ip is not None:
-                    # half-configured: release the (persisted) allocation
-                    # or every kubelet retry leaks another pod IP
-                    self.ipam.release_pod_ip(pod_id)
+                with self.dp.commit_lock:
+                    if if_idx is not None:
+                        # unwind the dataplane config so a kubelet retry
+                        # starts from a clean slate
+                        self.dp.builder.del_route(f"{ip}/32")
+                        self.dp.del_pod_interface(pod)
+                        self.dp.swap()
+                    if ip is not None:
+                        # half-configured: release the (persisted)
+                        # allocation or every retry leaks another pod IP
+                        self.ipam.release_pod_ip(pod_id)
+                # IO daemon not (yet) reachable on its control socket —
+                # a boot-order transient (vpp-tpu-init starts it after
+                # the agent): tell kubelet to retry, not that the pod
+                # can never be wired
+                if isinstance(e, (FileNotFoundError, ConnectionError)):
+                    return CNIReply(result=ResultCode.TRY_AGAIN,
+                                    error=str(e))
                 return CNIReply(result=ResultCode.ERROR, error=str(e))
         self._notify()
         return self._reply_for(cfg)
@@ -153,6 +223,11 @@ class RemoteCNIServer:
                 self.dp.del_pod_interface(pod)
                 self.ipam.release_pod_ip(f"{cfg.pod_namespace}/{cfg.pod_name}")
                 self.dp.swap()
+            if self.wirer is not None:
+                self.wirer.unwire(
+                    container_id=cfg.container_id, netns=cfg.netns,
+                    if_index=cfg.if_index,
+                )
         self._notify()
         return CNIReply(result=ResultCode.OK)
 

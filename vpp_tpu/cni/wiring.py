@@ -1,0 +1,122 @@
+"""VethPodWirer: give a CNI Add a real kernel interface path.
+
+The seam VERDICT r2 called "between framework and CNI plugin": the r2
+CNI server allocated an interface *index* and routes, but no kernel
+interface ever existed and the IO daemon couldn't learn about it. This
+wirer is the reference's configurePodInterface semantics
+(plugins/contiv/pod.go:262-360, remote_cni_server.go:1039-1250) built
+for the IO-daemon split:
+
+  * create a veth pair; the host side stays in the agent's netns and is
+    attached to the IO daemon as an AF_PACKET endpoint via the control
+    channel (io/control.py) — the "plug the TAP into the vswitch" step;
+  * the container side moves into the pod's netns, renamed to the CNI
+    if_name, configured with the pod /32, link-scope + default routes
+    through the virtual gateway, and a static ARP for the gateway MAC
+    (pod.go:375-452's static ARP entries);
+  * the pod's (ip → MAC) is pushed to the daemon so first packets
+    toward the pod never broadcast-flood;
+  * unwire detaches + deletes the pair (deleting the host side tears
+    down both ends), releasing the bind-mounted netns name if one was
+    created.
+
+Wire/unwire are transactional from the CNI server's point of view: any
+failure mid-wire rolls back what was created before re-raising.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from vpp_tpu.net import linux
+
+log = logging.getLogger("vpp_tpu.cni.wiring")
+
+# gateway MAC the data plane answers from: locally-administered, stable
+# (the pod's static ARP entry points here; the daemon rewrites source
+# MACs on tx anyway)
+GATEWAY_MAC = b"\x02\xfe\x00\x00\x00\x01"
+
+
+def host_ifname(container_id: str) -> str:
+    """Deterministic host-side veth name, kernel-limit safe (<=15)."""
+    return "vpp" + container_id.replace("-", "")[:11]
+
+
+class VethPodWirer:
+    """Creates/destroys the kernel path for one pod interface."""
+
+    def __init__(self, io_ctl, gateway_ip: str,
+                 gateway_mac: bytes = GATEWAY_MAC):
+        self.io_ctl = io_ctl
+        self.gateway_ip = gateway_ip
+        self.gateway_mac = gateway_mac
+
+    def wire(self, *, container_id: str, netns: str, if_name: str,
+             if_index: int, pod_ip: str) -> bytes:
+        """Create + attach the pod link; returns the container MAC."""
+        host_if = host_ifname(container_id)
+        peer = "p" + host_if[:14]
+        ns_name = None
+        try:
+            ns_name = linux.ensure_named_netns(netns)
+            if linux.link_exists(host_if):
+                # stale pair from a crashed wire (or kubelet retry after
+                # partial failure): recreate cleanly
+                linux.delete_link(host_if)
+            linux.create_veth(host_if, peer)
+            linux.move_to_netns(peer, ns_name)
+            pod_mac = linux.setup_pod_interface(
+                ns_name, peer, if_name, f"{pod_ip}/32",
+                self.gateway_ip, self.gateway_mac,
+            )
+            linux.ip_cmd("link", "set", host_if, "up")
+            self.io_ctl.attach(if_index, "afpacket", host_if)
+            from vpp_tpu.pipeline.vector import ip4
+
+            self.io_ctl.set_mac(int(ip4(pod_ip)), pod_mac)
+            return pod_mac
+        except Exception:
+            log.exception("pod wire failed for %s; rolling back",
+                          container_id)
+            try:
+                self.io_ctl.detach(if_index)
+            except Exception:  # noqa: BLE001 — best-effort rollback
+                pass
+            linux.delete_link(host_if)
+            if ns_name is not None:
+                linux.release_named_netns(netns)
+            raise
+
+    def re_attach(self, *, container_id: str, netns: str, if_name: str,
+                  if_index: int, pod_ip: str) -> None:
+        """Agent/daemon restart path: the veth pair survived, so only
+        re-plug the host side into the (possibly fresh) IO daemon and
+        re-push the pod's static MAC — a restarted daemon starts with an
+        empty (ip → MAC) table and would broadcast-flood toward silent
+        pods otherwise."""
+        from vpp_tpu.pipeline.vector import ip4
+
+        self.io_ctl.attach(if_index, "afpacket", host_ifname(container_id))
+        try:
+            if netns:
+                ns_name = linux.ensure_named_netns(netns)
+                pod_mac = linux.get_mac(if_name, netns=ns_name)
+                self.io_ctl.set_mac(int(ip4(pod_ip)), pod_mac)
+        except Exception:  # noqa: BLE001 — MAC push is best-effort here;
+            # rx learning recovers it on the pod's first transmission
+            log.warning("static MAC re-push failed for %s", container_id)
+
+    def unwire(self, *, container_id: str, netns: str,
+               if_index: int) -> None:
+        """Tear down the pod link (idempotent — CNI DEL semantics)."""
+        try:
+            self.io_ctl.detach(if_index)
+        except Exception:  # noqa: BLE001 — daemon may be restarting
+            log.warning("detach if %d failed during unwire", if_index)
+        linux.delete_link(host_ifname(container_id))
+        if netns:
+            try:
+                linux.release_named_netns(netns)
+            except Exception:  # noqa: BLE001
+                pass

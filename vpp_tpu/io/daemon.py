@@ -64,6 +64,36 @@ class IODaemon:
         self._stop = threading.Event()
         self._threads = []
 
+    # --- runtime interface management (driven by IOControlServer; the
+    # reference analog is the CNI server creating pod TAP/veth
+    # interfaces in the running vswitch, remote_cni_server.go:895-1250) ---
+    def attach(self, if_idx: int, kind: str, arg: str) -> None:
+        """Create a transport and plug it in as interface ``if_idx``.
+        Replaces (and closes) any previous transport on that index —
+        attach is idempotent for agent resync."""
+        from vpp_tpu.io.transport import make_transport
+
+        new = make_transport(kind, arg)
+        old = self.transports.get(if_idx)
+        self.transports[if_idx] = new  # dict assignment: GIL-atomic
+        if old is not None:
+            old.close()
+        log.info("attached if %d: %s(%s)", if_idx, kind, arg)
+
+    def detach(self, if_idx: int) -> bool:
+        t = self.transports.pop(if_idx, None)
+        if t is None:
+            return False
+        t.close()
+        log.info("detached if %d (%s)", if_idx, t.name)
+        return True
+
+    def set_static_mac(self, ip: int, mac: bytes) -> None:
+        """Static (ip → MAC) entry — the reference's configured static
+        ARP for pod links (pod.go:375-452); rx learning keeps it fresh
+        but the first packet toward a silent pod no longer floods."""
+        self.mac_of[int(ip)] = bytes(mac)
+
     # --- lifecycle ---
     def start(self) -> "IODaemon":
         for fn, name in ((self._rx_loop, "io-rx"), (self._tx_loop, "io-tx")):
@@ -86,17 +116,29 @@ class IODaemon:
     # --- rx: wire -> ring ---
     def _rx_loop(self) -> None:
         while not self._stop.is_set():
-            fds = {t.fileno(): (if_idx, t)
-                   for if_idx, t in self.transports.items()}
+            # The control thread mutates transports at runtime
+            # (attach/detach); a transport closed between the snapshot
+            # and the select/recv surfaces as ValueError (fileno -1) or
+            # OSError — both are routine during a CNI Delete and must
+            # never kill the rx thread (that would silently stop ALL
+            # packet reception on the node).
             try:
+                fds = {t.fileno(): (if_idx, t)
+                       for if_idx, t in list(self.transports.items())
+                       if t.fileno() >= 0}
+                if not fds:
+                    time.sleep(0.05)
+                    continue
                 ready, _, _ = select.select(list(fds), [], [], 0.05)
-            except OSError:
+                for fd in ready:
+                    if_idx, transport = fds[fd]
+                    frames = transport.recv_frames(VEC)
+                    if frames:
+                        self._ingest(if_idx, frames)
+            except (OSError, ValueError):
                 continue
-            for fd in ready:
-                if_idx, transport = fds[fd]
-                frames = transport.recv_frames(VEC)
-                if frames:
-                    self._ingest(if_idx, frames)
+            except Exception:
+                log.exception("rx iteration failed; continuing")
 
     def _ingest(self, if_idx: int, frames: list) -> None:
         if if_idx == self.uplink_if:

@@ -134,6 +134,20 @@ class TapTransport(Transport):
         os.close(self.fd)
 
 
+def make_transport(kind: str, arg: str) -> Transport:
+    """Transport factory used by the daemon CLI and the control channel
+    (attach): afpacket:IFNAME | tap:NAME | fd:N."""
+    if kind == "afpacket":
+        return AfPacketTransport(arg)
+    if kind == "tap":
+        return TapTransport(arg)
+    if kind == "fd":
+        return SocketPairTransport(
+            socket.socket(fileno=int(arg)), name=f"fd{arg}"
+        )
+    raise ValueError(f"unknown transport kind {kind!r}")
+
+
 class SocketPairTransport(Transport):
     """Frame transport over a SOCK_DGRAM socketpair (tests / dev).
 

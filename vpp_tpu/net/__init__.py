@@ -1,0 +1,14 @@
+"""Host networking helpers: veth/netns plumbing and netlink-style ops."""
+
+from vpp_tpu.net.linux import (  # noqa: F401
+    IpCmdError,
+    create_veth,
+    delete_link,
+    ensure_named_netns,
+    get_mac,
+    ip_cmd,
+    link_exists,
+    move_to_netns,
+    release_named_netns,
+    setup_pod_interface,
+)
