@@ -250,6 +250,42 @@ def test_node_events_install_and_remove_peer_routes():
     a.close(); b.close()
 
 
+def test_node_crash_lease_expiry_removes_peer_routes():
+    """A node that dies WITHOUT cleanup (kill -9, partition) must lose
+    its routes on peers once its liveness lease expires — the etcd-lease
+    liveness mechanism (VERDICT r2 Next #8). Clean release() is covered
+    above; this is the crash path: no delete is ever issued."""
+    store = KVStore()
+    a = ContivAgent(AgentConfig(node_name="n1", serve_http=False), store=store)
+    a.start()
+    b = ContivAgent(AgentConfig(node_name="n2", serve_http=False), store=store)
+    b.node_allocator.liveness_ttl_s = 0.3
+    b.start()
+
+    ip_a = add_pod(a, "c1", "p1")
+    dst_b = str(b.ipam.pod_gateway_ip() + 5)
+    disp, _ = send(a, ("default", "p1"), ip_a, dst_b, 80)
+    assert disp == Disposition.REMOTE
+
+    # B "crashes": stop its maintenance loop (keepalives) without any
+    # cleanup; its allocatedIDs claim stays (ID reuse on restart), but
+    # the liveness key must expire
+    b._closed.set()
+    import time
+
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        store.sweep_leases()
+        disp, _ = send(a, ("default", "p1"), ip_a, dst_b, 80)
+        if disp == Disposition.DROP:
+            break
+        time.sleep(0.1)
+    assert disp == Disposition.DROP
+    # the ID claim survives (restarting node-b reuses its ID)
+    assert store.get("allocatedIDs/" + str(b.node_id)) is not None
+    a.close(); b.close()
+
+
 def test_config_yaml_roundtrip(tmp_path):
     cfg_file = tmp_path / "contiv.yaml"
     cfg_file.write_text(textwrap.dedent("""

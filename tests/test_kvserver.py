@@ -253,3 +253,90 @@ class TestCrossProcess:
             assert parent.get("ids/7") == "child"
         finally:
             parent.close()
+
+
+class TestLeases:
+    """Lease/TTL keys: node-liveness semantics (VERDICT r2 Next #8;
+    etcd-lease analog). Keys die with their lease; keepalive holds them."""
+
+    def test_lease_expiry_deletes_key_and_notifies_watchers(self, client):
+        events = queue.Queue()
+        client.watch("live/", events.put)
+        lease = client.lease_grant(0.6)
+        client.put("live/7", {"ip": "10.0.0.7"}, lease=lease)
+        ev = events.get(timeout=5)
+        assert ev.op == Op.PUT and ev.key == "live/7"
+        # no keepalive: the server-side sweeper must delete it
+        ev = events.get(timeout=5)
+        assert ev.op == Op.DELETE and ev.key == "live/7"
+        assert client.get("live/7") is None
+
+    def test_keepalive_holds_key_alive(self, client):
+        lease = client.lease_grant(0.8)
+        client.put("live/8", {"ip": "10.0.0.8"}, lease=lease)
+        for _ in range(4):
+            time.sleep(0.4)
+            assert client.lease_keepalive(lease)
+            assert client.get("live/8") is not None
+        client.lease_revoke(lease)
+        wait_for(lambda: client.get("live/8") is None,
+                 msg="revoke deletes key")
+
+    def test_put_with_unknown_lease_rejected(self, client):
+        with pytest.raises(RuntimeError):
+            client.put("live/9", {}, lease=424242)
+
+    def test_leases_do_not_survive_restart(self, tmp_path):
+        path = str(tmp_path / "snap.json")
+        store = KVStore(persist_path=path)
+        lease = store.lease_grant(60.0)
+        store.put("live/1", {"ip": "10.0.0.1"}, lease=lease)
+        store.put("cfg/a", 1)
+        store.save()
+        store2 = KVStore(persist_path=path)
+        # durable data survives; lease-attached liveness starts expired
+        assert store2.get("cfg/a") == 1
+        assert store2.get("live/1") is None
+
+
+class TestCrashSafety:
+    """kill -9 the kvserver mid-write; restart; state intact
+    (VERDICT r2 Next #8)."""
+
+    def test_kill9_mid_write_leaves_loadable_snapshot(self, tmp_path):
+        path = str(tmp_path / "state.json")
+        code = (
+            "import sys\n"
+            "sys.path.insert(0, %r)\n"
+            "from vpp_tpu.cmd.kvserver import main\n"
+            "main(['--host', '127.0.0.1', '--port', '0',\n"
+            "      '--persist', %r, '--port-file', %r])\n"
+        ) % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+             path, path + ".port")
+        env = dict(os.environ)
+        env.pop("PYTHONPATH", None)
+        proc = subprocess.Popen([sys.executable, "-c", code], env=env)
+        try:
+            wait_for(lambda: os.path.exists(path + ".port"), timeout=15,
+                     msg="server port file")
+            port = int(open(path + ".port").read())
+            cli = RemoteKVStore("127.0.0.1", port, request_timeout=5.0)
+            # hammer puts so a save is overwhelmingly likely in flight
+            # when the SIGKILL lands (autosave debounce is 0.2 s)
+            for i in range(400):
+                cli.put(f"k/{i:04d}", {"i": i, "pad": "x" * 200})
+            proc.kill()
+            proc.wait(timeout=10)
+            cli.close()
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        # restart: the snapshot must parse and contain a consistent
+        # prefix of the writes (atomic rename: old-or-new, never torn)
+        store = KVStore(persist_path=path)
+        keys = store.list_keys("k/")
+        assert keys, "no state survived the crash"
+        for k in keys:
+            v = store.get(k)
+            assert v["pad"] == "x" * 200
+            assert f"k/{v['i']:04d}" == k

@@ -16,14 +16,24 @@ from typing import Dict, Optional
 from vpp_tpu.kvstore.store import KVStore
 
 ID_PREFIX = "allocatedIDs/"
+# lease-attached liveness keys: present while the node's agent keeps
+# its lease alive; expiry (crash, partition) deletes the key and every
+# peer's watch removes the routes toward that node. The ID claim itself
+# stays persistent so a restarting node reuses its ID (the reference
+# keeps allocations in etcd; liveness is the etcd-lease analog).
+LIVENESS_PREFIX = "nodeliveness/"
 MAX_ID = 255
 
 
 class NodeIDAllocator:
-    def __init__(self, store: KVStore, node_name: str):
+    def __init__(self, store: KVStore, node_name: str,
+                 liveness_ttl_s: float = 15.0):
         self.store = store
         self.node_name = node_name
         self.node_id: Optional[int] = None
+        self.liveness_ttl_s = liveness_ttl_s
+        self._lease: Optional[int] = None
+        self._liveness_info: Optional[dict] = None
 
     def get_or_allocate(self) -> int:
         """Find this node's existing claim or CAS-claim the smallest free ID."""
@@ -60,6 +70,47 @@ class NodeIDAllocator:
             {"name": self.node_name, "ip": node_ip, "mgmt_ip": mgmt_ip},
         )
 
+    def publish_liveness(self, node_ip: str, mgmt_ip: str = "") -> int:
+        """Publish a lease-attached liveness key; keep it alive with
+        liveness_keepalive() from the agent maintenance loop. Expiry
+        (crash/partition) auto-deletes the key — peers' node watches see
+        the DELETE and tear down routes to this node."""
+        if self.node_id is None:
+            raise RuntimeError("allocate an ID before publishing liveness")
+        self._lease = self.store.lease_grant(self.liveness_ttl_s)
+        self._liveness_info = {
+            "name": self.node_name, "ip": node_ip, "mgmt_ip": mgmt_ip,
+        }
+        self.store.put(
+            LIVENESS_PREFIX + str(self.node_id), self._liveness_info,
+            lease=self._lease,
+        )
+        return self._lease
+
+    def liveness_keepalive(self) -> bool:
+        """Refresh the liveness lease; re-grants + re-publishes if the
+        lease was lost (kvserver restart, long partition)."""
+        if self._lease is None or self._liveness_info is None:
+            return False
+        if self.store.lease_keepalive(self._lease):
+            return True
+        self._lease = self.store.lease_grant(self.liveness_ttl_s)
+        self.store.put(
+            LIVENESS_PREFIX + str(self.node_id), self._liveness_info,
+            lease=self._lease,
+        )
+        return True
+
+    def list_live_nodes(self) -> Dict[int, dict]:
+        """Nodes with a current liveness key: id -> {name, ip, mgmt_ip}."""
+        out = {}
+        for key, val in self.store.list_values(LIVENESS_PREFIX).items():
+            try:
+                out[int(key[len(LIVENESS_PREFIX):])] = val
+            except ValueError:
+                continue
+        return out
+
     def list_nodes(self) -> Dict[int, dict]:
         """All known nodes: id -> {name, ip?, mgmt_ip?}."""
         out = {}
@@ -71,6 +122,12 @@ class NodeIDAllocator:
         return out
 
     def release(self) -> None:
+        if self._lease is not None:
+            try:
+                self.store.lease_revoke(self._lease)
+            except Exception:  # noqa: BLE001 — store may be gone
+                pass
+            self._lease = None
         if self.node_id is not None:
             self.store.delete(ID_PREFIX + str(self.node_id))
             self.node_id = None

@@ -23,6 +23,9 @@ def main(argv=None) -> int:
     parser.add_argument("--port", type=int, default=12379)
     parser.add_argument("--persist", default=None,
                         help="snapshot file for durability")
+    parser.add_argument("--port-file", default=None,
+                        help="write the bound port here once listening "
+                             "(--port 0 support: tests, supervisors)")
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args(argv)
 
@@ -32,6 +35,13 @@ def main(argv=None) -> int:
     )
     server = KVServer(host=args.host, port=args.port,
                       persist_path=args.persist)
+    if args.port_file:
+        tmp = args.port_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(server.port))
+        import os
+
+        os.replace(tmp, args.port_file)
 
     # Serve from a worker thread: calling shutdown() from the thread
     # running serve_forever() deadlocks, and a signal handler runs on

@@ -238,8 +238,20 @@ class RemoteKVStore:
     def get(self, key: str) -> Any:
         return self._request("get", key=key)
 
-    def put(self, key: str, value: Any) -> int:
-        return self._request("put", key=key, value=value)
+    def put(self, key: str, value: Any, lease: Optional[int] = None) -> int:
+        if lease is None:
+            return self._request("put", key=key, value=value)
+        return self._request("put", key=key, value=value, lease=lease)
+
+    # --- leases (node liveness; etcd lease analog) ---
+    def lease_grant(self, ttl_s: float) -> int:
+        return self._request("lease_grant", ttl=ttl_s)
+
+    def lease_keepalive(self, lease: int) -> bool:
+        return bool(self._request("lease_keepalive", lease=lease))
+
+    def lease_revoke(self, lease: int) -> int:
+        return self._request("lease_revoke", lease=lease)
 
     def delete(self, key: str) -> bool:
         return self._request("delete", key=key)

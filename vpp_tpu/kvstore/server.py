@@ -108,7 +108,8 @@ class _Conn(socketserver.BaseRequestHandler):
             if op == "get":
                 res = store.get(req["key"])
             elif op == "put":
-                res = store.put(req["key"], req.get("value"))
+                res = store.put(req["key"], req.get("value"),
+                                lease=req.get("lease"))
             elif op == "delete":
                 res = store.delete(req["key"])
             elif op == "cas":
@@ -149,6 +150,12 @@ class _Conn(socketserver.BaseRequestHandler):
                 if cancel:
                     cancel()
                 res = True
+            elif op == "lease_grant":
+                res = store.lease_grant(float(req["ttl"]))
+            elif op == "lease_keepalive":
+                res = store.lease_keepalive(int(req["lease"]))
+            elif op == "lease_revoke":
+                res = store.lease_revoke(int(req["lease"]))
             elif op == "ping":
                 res = "pong"
             else:
@@ -182,6 +189,23 @@ class KVServer:
         self._server.store = self.store  # type: ignore[attr-defined]
         self._server.live_conns = set()  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
+        self._sweep_stop = threading.Event()
+        self._sweeper = threading.Thread(
+            target=self._sweep_loop, daemon=True, name="kvserver-leases"
+        )
+
+    # lease sweep cadence: fine-grained enough that a node-liveness TTL
+    # of a few seconds expires promptly (etcd's lease granularity is 1 s)
+    LEASE_SWEEP_INTERVAL = 0.5
+
+    def _sweep_loop(self) -> None:
+        while not self._sweep_stop.wait(self.LEASE_SWEEP_INTERVAL):
+            try:
+                n = self.store.sweep_leases()
+                if n:
+                    log.info("lease sweep expired %d keys", n)
+            except Exception:  # noqa: BLE001 — keep sweeping
+                log.exception("lease sweep failed")
 
     @property
     def address(self) -> tuple:
@@ -197,14 +221,17 @@ class KVServer:
             name="kvserver-accept",
         )
         self._thread.start()
+        self._sweeper.start()
         log.info("kvserver listening on %s:%d", *self._server.server_address)
         return self
 
     def serve_forever(self) -> None:
         log.info("kvserver listening on %s:%d", *self._server.server_address)
+        self._sweeper.start()
         self._server.serve_forever()
 
     def close(self) -> None:
+        self._sweep_stop.set()
         self._server.shutdown()
         self._server.server_close()
         # Established connections outlive shutdown() in socketserver; a

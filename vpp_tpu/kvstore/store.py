@@ -51,6 +51,13 @@ class KVStore:
         self._data: Dict[str, Any] = {}
         self._rev = 0
         self._watchers: List[Tuple[str, WatchCallback]] = []
+        # leases (etcd-style): lease id -> (deadline, ttl); keys attached
+        # to a lease die with it — the node-liveness mechanism
+        # (reference: etcd leases; node death must expire its routes)
+        self._leases: Dict[int, Tuple[float, float]] = {}
+        self._lease_keys: Dict[int, set] = {}
+        self._lease_of: Dict[str, int] = {}
+        self._next_lease = 1
         self._persist_path = persist_path
         if persist_path and os.path.exists(persist_path):
             self.load(persist_path)
@@ -64,10 +71,13 @@ class KVStore:
         with self._lock:
             return self._data.get(key)
 
-    def put(self, key: str, value: Any) -> int:
+    def put(self, key: str, value: Any, lease: Optional[int] = None) -> int:
         with self._lock:
+            if lease is not None and lease not in self._leases:
+                raise ValueError(f"unknown lease {lease}")
             prev = self._data.get(key)
             self._data[key] = value
+            self._attach_lease(key, lease)
             self._rev += 1
             ev = KVEvent(Op.PUT, key, value, prev, self._rev)
             self._notify(ev)
@@ -79,6 +89,7 @@ class KVStore:
             if key not in self._data:
                 return False
             prev = self._data.pop(key)
+            self._attach_lease(key, None)
             self._rev += 1
             ev = KVEvent(Op.DELETE, key, None, prev, self._rev)
             self._notify(ev)
@@ -166,12 +177,83 @@ class KVStore:
             if ev.key.startswith(prefix):
                 cb(ev)
 
+    # --- leases (node-liveness TTL keys; etcd lease analog) ---
+    def _attach_lease(self, key: str, lease: Optional[int]) -> None:
+        old = self._lease_of.pop(key, None)
+        if old is not None:
+            self._lease_keys.get(old, set()).discard(key)
+        if lease is not None:
+            self._lease_of[key] = lease
+            self._lease_keys.setdefault(lease, set()).add(key)
+
+    def lease_grant(self, ttl_s: float) -> int:
+        """Grant a lease; keys put with it are deleted (with DELETE
+        events) unless lease_keepalive arrives within ttl_s."""
+        if ttl_s <= 0:
+            raise ValueError("ttl must be positive")
+        with self._lock:
+            lid = self._next_lease
+            self._next_lease += 1
+            self._leases[lid] = (_time.monotonic() + ttl_s, ttl_s)
+            self._lease_keys[lid] = set()
+            return lid
+
+    def lease_keepalive(self, lease: int) -> bool:
+        with self._lock:
+            ent = self._leases.get(lease)
+            if ent is None:
+                return False
+            _, ttl = ent
+            self._leases[lease] = (_time.monotonic() + ttl, ttl)
+            return True
+
+    def lease_revoke(self, lease: int) -> int:
+        """Drop a lease and delete its keys. Returns keys deleted."""
+        with self._lock:
+            return self._expire_lease(lease)
+
+    def _expire_lease(self, lease: int) -> int:
+        if lease not in self._leases:
+            return 0
+        del self._leases[lease]
+        keys = self._lease_keys.pop(lease, set())
+        n = 0
+        for key in sorted(keys):
+            self._lease_of.pop(key, None)
+            if key in self._data:
+                prev = self._data.pop(key)
+                self._rev += 1
+                self._notify(KVEvent(Op.DELETE, key, None, prev, self._rev))
+                n += 1
+        if n:
+            self._maybe_persist()
+        return n
+
+    def sweep_leases(self, now: Optional[float] = None) -> int:
+        """Expire overdue leases; returns the number of keys deleted.
+        KVServer runs this on a timer; in-process deployments call it
+        from their maintenance loop."""
+        now = _time.monotonic() if now is None else now
+        with self._lock:
+            overdue = [lid for lid, (dl, _) in self._leases.items()
+                       if dl <= now]
+            return sum(self._expire_lease(lid) for lid in overdue)
+
     # --- persistence (checkpoint/resume; reference: ETCD durability) ---
     def dump(self) -> Dict[str, Any]:
         with self._lock:
-            return {"rev": self._rev, "data": dict(self._data)}
+            return {
+                "rev": self._rev,
+                "data": dict(self._data),
+                "lease_of": dict(self._lease_of),
+            }
 
     def save(self, path: Optional[str] = None) -> None:
+        """Crash-safe checkpoint: write-to-temp, fsync the file, atomic
+        rename, fsync the directory. A kill -9 mid-save leaves either
+        the old snapshot or the new one, never a torn file — and the
+        rename itself survives a host crash (the directory entry is on
+        disk before save() returns)."""
         path = path or self._persist_path
         if not path:
             return
@@ -180,7 +262,15 @@ class KVStore:
             tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
             with open(tmp, "w") as f:
                 json.dump(snapshot, f)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, path)
+            dirfd = os.open(os.path.dirname(os.path.abspath(path)),
+                            os.O_RDONLY)
+            try:
+                os.fsync(dirfd)
+            finally:
+                os.close(dirfd)
             self._last_save = _time.monotonic()
 
     def load(self, path: str) -> None:
@@ -189,6 +279,14 @@ class KVStore:
         with self._lock:
             self._data = dict(snapshot["data"])
             self._rev = int(snapshot["rev"])
+            # leases do not survive a restart: their holders must
+            # keepalive against the new process, so any persisted
+            # lease-attached key (node liveness entries) starts expired
+            for key in snapshot.get("lease_of", {}):
+                self._data.pop(key, None)
+            self._lease_of.clear()
+            self._leases.clear()
+            self._lease_keys.clear()
 
     # Autosave is debounced: the file is checkpoint-grade durability (the
     # reference's durable store is external etcd); call save() explicitly
