@@ -208,3 +208,76 @@ def test_watchdog_probe_exception_counts_as_failure():
     wd = Watchdog(d, probe=probe, grace_failures=2)
     wd.tick(); wd.tick()
     assert d.stolen_interface_info("eth1") is None
+
+
+def _can_netadmin_stn() -> bool:
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            ["ip", "link", "add", "vpptstnck0", "type", "veth",
+             "peer", "name", "vpptstnck1"],
+            capture_output=True, timeout=10,
+        )
+        if r.returncode == 0:
+            subprocess.run(["ip", "link", "del", "vpptstnck0"],
+                           capture_output=True, timeout=10)
+            return True
+        return False
+    except Exception:
+        return False
+
+
+@pytest.mark.skipif(not _can_netadmin_stn(),
+                    reason="needs CAP_NET_ADMIN (veth)")
+def test_stn_real_kernel_steal_crash_autorevert(tmp_path):
+    """VERDICT r2 Next #6: steal → crash → auto-revert against a REAL
+    kernel interface. A veth leg gets an address + route, the LinuxNetlink
+    backend steals it (kernel addressing flushed), the watchdog sees the
+    'agent' die and must restore the exact addresses and routes."""
+    import subprocess
+
+    from vpp_tpu.health.stn_netlink import LinuxNetlink
+
+    def sh(*a):
+        return subprocess.run(["ip", *a], capture_output=True, text=True)
+
+    sh("link", "del", "vpptstn0")
+    assert sh("link", "add", "vpptstn0", "type", "veth",
+              "peer", "name", "vpptstn1").returncode == 0
+    try:
+        sh("link", "set", "vpptstn0", "up")
+        sh("link", "set", "vpptstn1", "up")
+        sh("addr", "add", "10.77.0.2/24", "dev", "vpptstn0")
+        sh("route", "add", "10.78.0.0/24", "via", "10.77.0.1",
+           "dev", "vpptstn0", "onlink")
+
+        backend = LinuxNetlink()
+        daemon = STNDaemon(backend,
+                           persist_path=str(tmp_path / "stn.json"))
+        info = daemon.steal("vpptstn0")
+        assert "10.77.0.2/24" in info.ip_addresses
+        assert any(r["dst"] == "10.78.0.0/24" and r["gw"] == "10.77.0.1"
+                   for r in info.routes)
+        # kernel addressing is gone (the data plane owns the wire now)
+        assert "10.77.0.2" not in sh("-o", "addr", "show",
+                                     "dev", "vpptstn0").stdout
+
+        # the agent "crashes": health probe dead → watchdog reverts
+        dog = Watchdog(daemon, probe=lambda: False, grace_failures=2)
+        dog.tick()
+        dog.tick()
+        out = sh("-o", "addr", "show", "dev", "vpptstn0").stdout
+        assert "10.77.0.2/24" in out
+        routes = sh("route", "show", "10.78.0.0/24").stdout
+        assert "10.77.0.1" in routes and "vpptstn0" in routes
+        assert daemon.stolen_interface_info("vpptstn0") is None
+
+        # recovered agent can steal again
+        info2 = daemon.steal("vpptstn0")
+        assert "10.77.0.2/24" in info2.ip_addresses
+        daemon.release("vpptstn0")
+        assert "10.77.0.2" in sh("-o", "addr", "show",
+                                 "dev", "vpptstn0").stdout
+    finally:
+        sh("link", "del", "vpptstn0")
