@@ -11,6 +11,7 @@ always from a consistent epoch, session state never corrupted).
 import threading
 
 import numpy as np
+import pytest
 
 from vpp_tpu.cmd import AgentConfig, ContivAgent
 from vpp_tpu.cni.model import CNIRequest
@@ -22,9 +23,18 @@ N_THREADS = 4
 N_OPS = 12
 
 
-def test_concurrent_cni_and_traffic_and_policy():
-    agent = ContivAgent(AgentConfig(node_name="n1", serve_http=False),
-                        store=KVStore())
+@pytest.mark.parametrize("parallel_commits", [False, True],
+                         ids=["serial-renderers", "parallel-renderers"])
+def test_concurrent_cni_and_traffic_and_policy(parallel_commits):
+    """parallel_commits=True additionally exercises the reference's
+    optional concurrent renderer commit (configurator_impl.go:211-233)
+    under the same storm: both renderers committing from worker threads
+    while CNI and traffic race them."""
+    agent = ContivAgent(
+        AgentConfig(node_name="n1", serve_http=False,
+                    parallel_renderer_commits=parallel_commits),
+        store=KVStore(),
+    )
     agent.start()
     errors = []
     barrier = threading.Barrier(N_THREADS + 2)
