@@ -12,8 +12,8 @@ is a dense [VEC packets] x [R rules] compare (range checks on ports,
 masked compares on addresses) and first-match = argmax over the rule
 axis. Per-interface tables are row-gathers of the padded [T, R] arrays —
 every packet classifies against its own interface's table in the same
-dense op. The Pallas fast path (vpp_tpu/ops/acl_pallas.py) tiles the same
-computation through VMEM for the 10k-rule regime.
+dense op. The MXU fast path (vpp_tpu/ops/acl_mxu.py) reformulates the
+same first-match as a bf16 bit-plane matmul for the 10k-rule regime.
 """
 
 from __future__ import annotations

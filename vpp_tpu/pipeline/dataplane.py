@@ -197,7 +197,7 @@ class Dataplane:
             if idx is None:
                 return
             slot = self.table_slots.get(table_id, -1) if table_id else -1
-            self.builder.if_local_table[idx] = slot
+            self.builder.set_if_local_table(idx, slot)
 
     # --- epoch management ---
     def swap(self) -> int:
