@@ -38,14 +38,41 @@ def _emit_error(exc: BaseException) -> None:
     )
 
 
+def _subprocess_probe(timeout_s: float = 60.0) -> bool:
+    """Probe TPU backend health in a THROWAWAY subprocess first.
+
+    A wedged axon tunnel (a SIGTERM'd process mid-claim) makes backend
+    init HANG rather than fail — in-process that would hang this whole
+    bench and the driver would record nothing. A subprocess can be
+    killed safely (it holds no grant yet)."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); print('ok')"],
+            capture_output=True, timeout=timeout_s, text=True,
+        )
+        return proc.returncode == 0 and "ok" in proc.stdout
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
 def _probe_backend(retries: int, delay: float):
     """Initialize the JAX backend, retrying transient axon/tunnel init
     failures (round-1 bench died on 'Unable to initialize backend axon'
-    before measuring anything)."""
+    before measuring anything; round-3 saw init HANG on a wedged
+    tunnel — hence the subprocess pre-probe)."""
+    retries = max(1, retries)
+    for attempt in range(retries):
+        if _subprocess_probe():
+            break
+        if attempt + 1 >= retries:
+            raise RuntimeError("TPU backend unreachable (subprocess probe)")
+        time.sleep(delay)
     import jax
 
     last: BaseException | None = None
-    retries = max(1, retries)
     for attempt in range(retries):
         try:
             return jax.default_backend()
@@ -510,16 +537,29 @@ def _run():
 
         jax.config.update("jax_platforms", "cpu")
     try:
-        _probe_backend(args.retries, args.retry_delay)
+        if not args.cpu:
+            # --cpu must never touch (or wait on) the TPU backend — it
+            # exists exactly for when that backend is unreachable
+            _probe_backend(args.retries, args.retry_delay)
     except RuntimeError:
         if not args.cpu:
             # The failed axon init poisons this process's backend state;
             # fall back to CPU in a FRESH process (where jax.config can
-            # still force the platform before first backend touch).
-            os.execv(
+            # still force the platform before first backend touch). A
+            # WEDGED tunnel hangs even CPU-platform init through the
+            # eagerly-registering axon plugin, so drop it from
+            # PYTHONPATH for the fallback process.
+            env = dict(os.environ)
+            env["PYTHONPATH"] = ":".join(
+                p for p in env.get("PYTHONPATH", "").split(":")
+                if p and "axon" not in p
+            )
+            env["JAX_PLATFORMS"] = "cpu"
+            os.execve(
                 sys.executable,
                 [sys.executable, os.path.abspath(__file__), "--cpu"]
                 + [a for a in sys.argv[1:] if a != "--cpu"],
+                env,
             )
         raise
     import jax

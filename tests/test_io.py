@@ -572,3 +572,69 @@ class TestCrossProcessDaemon:
                 inside.close()
                 outside.close()
             rings.close(unlink=True)
+
+
+class TestBatchSyscalls:
+    """recvmmsg/sendmmsg native batch path (pio_recv_batch/send_batch)."""
+
+    def test_recv_batch_reports_true_length_for_oversized(self):
+        """MSG_TRUNC: a frame longer than snap must report its REAL wire
+        length so the parser sets FLAG_TRUNC — otherwise the punt path
+        would transmit a silently truncated frame."""
+        from vpp_tpu.native.pktio import FLAG_TRUNC, PacketCodec
+
+        codec = PacketCodec(snap=256)
+        a, b = SocketPairTransport.pair("trunc")
+        try:
+            big = make_frame(CLIENT_IP, SERVER_IP, proto=17, dport=80,
+                             payload=b"z" * 900)
+            small = make_frame(CLIENT_IP, SERVER_IP, proto=17, dport=80)
+            b.sock.send(big)
+            b.sock.send(small)
+            time.sleep(0.05)
+            scratch = np.zeros((256, 256), np.uint8)
+            lens = np.zeros(256, np.uint32)
+            n = codec.recv_batch(a.batch_fd, scratch, lens)
+            assert n == 2
+            assert int(lens[0]) == len(big)      # true length, not snap
+            assert int(lens[1]) == len(small)
+            cols, n = codec.parse_inplace(scratch, lens, n, 0)
+            assert cols["flags"][0] & FLAG_TRUNC
+            assert not (cols["flags"][1] & FLAG_TRUNC)
+        finally:
+            a.close()
+            b.close()
+
+    def test_recv_batch_distinguishes_dead_fd_from_idle(self):
+        from vpp_tpu.native.pktio import PacketCodec
+
+        codec = PacketCodec(snap=256)
+        a, b = SocketPairTransport.pair("dead")
+        scratch = np.zeros((8, 256), np.uint8)
+        lens = np.zeros(8, np.uint32)
+        fd = a.batch_fd
+        assert codec.recv_batch(fd, scratch, lens) == 0   # idle
+        a.close()
+        b.close()
+        assert codec.recv_batch(fd, scratch, lens) == -1  # dead
+
+    def test_send_batch_roundtrip(self):
+        from vpp_tpu.native.pktio import PacketCodec
+
+        codec = PacketCodec(snap=512)
+        a, b = SocketPairTransport.pair("sb")
+        try:
+            payload = np.zeros((4, 512), np.uint8)
+            frames = [make_frame(CLIENT_IP, SERVER_IP, sport=5000 + i,
+                                 dport=80) for i in range(4)]
+            for i, f in enumerate(frames):
+                payload[i, :len(f)] = np.frombuffer(f, np.uint8)
+            rows = np.arange(4, dtype=np.uint32)
+            lens = np.asarray([len(f) for f in frames], np.uint32)
+            sent = codec.send_batch(a.batch_fd, payload, rows, lens, 4)
+            assert sent == 4
+            got = [b.sock.recv(65535) for _ in range(4)]
+            assert got == frames
+        finally:
+            a.close()
+            b.close()

@@ -274,10 +274,47 @@ class TestTwoNodeTwoPods:
         assert "cross-node-hello" in out, (out, err)
         assert ip_a in out
 
-        # NetworkPolicy via the store (KSR key scheme): pod-b accepts
-        # only TCP/9 -> the UDP flow must die in node B's classifier
+        # ClusterIP service leg (robot suite's service case): a VIP on
+        # UDP/5300 backed by pod-b on the OTHER node. Pod A sends to
+        # the VIP; node A's NAT44 DNATs to pod-b and the flow rides the
+        # VXLAN fabric to node B.
         cli = RemoteKVStore("127.0.0.1", cluster["kv_port"])
         try:
+            svc = m.Service(
+                name="svc-b", namespace="default",
+                cluster_ip="10.96.0.50",
+                ports=[m.ServicePort(name="u", protocol="UDP", port=5300,
+                                     target_port=6013)],
+                selector={"app": "b"},
+            )
+            eps = m.Endpoints(
+                name="svc-b", namespace="default",
+                subsets=[m.EndpointSubset(
+                    addresses=[m.EndpointAddress(
+                        ip=ip_b, node_name="node-b",
+                        target_pod="default/pod-b")],
+                    ports=[m.EndpointPort(name="u", port=6013,
+                                          protocol="UDP")],
+                )],
+            )
+            cli.put(KSR_PREFIX + svc.key(), svc.to_dict())
+            cli.put(KSR_PREFIX + eps.key(), eps.to_dict())
+            deadline = time.monotonic() + 60
+            got_vip = False
+            while time.monotonic() < deadline and not got_vip:
+                recv_svc = _udp_recv(PODS["b"], 6013, timeout_s=8)
+                time.sleep(0.3)
+                try:
+                    _udp_spray(PODS["a"], "10.96.0.50", 5300,
+                               "via-the-vip", times=16)
+                except subprocess.CalledProcessError:
+                    pass
+                out_svc, _ = recv_svc.communicate(timeout=30)
+                got_vip = "via-the-vip" in (out_svc or "")
+            assert got_vip, "ClusterIP DNAT across nodes never delivered"
+
+            # NetworkPolicy via the store (KSR key scheme): pod-b accepts
+            # only TCP/9 -> the UDP flows must die in node B's classifier
             pod_a = m.Pod(name="pod-a", namespace="default",
                           labels={"app": "a"}, ip_address=ip_a)
             pod_b = m.Pod(name="pod-b", namespace="default",

@@ -18,7 +18,7 @@ import logging
 import select
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -55,6 +55,7 @@ class IODaemon:
         self.poll_s = poll_s
         self.codec = PacketCodec(snap=rings.rx.snap)
         self._scratch = np.zeros((VEC, rings.rx.snap), np.uint8)
+        self._rx_lens = np.zeros(VEC, np.uint32)
         self.mac_of: Dict[int, bytes] = {}
         self.stats = {
             "rx_frames": 0, "rx_pkts": 0, "rx_ring_full": 0,
@@ -132,9 +133,19 @@ class IODaemon:
                 ready, _, _ = select.select(list(fds), [], [], 0.05)
                 for fd in ready:
                     if_idx, transport = fds[fd]
-                    frames = transport.recv_frames(VEC)
-                    if frames:
-                        self._ingest(if_idx, frames)
+                    bfd = transport.batch_fd
+                    if bfd is not None:
+                        # native fast path: recvmmsg straight into the
+                        # payload scratch rows, zero bytes objects
+                        n = self.codec.recv_batch(
+                            bfd, self._scratch, self._rx_lens
+                        )
+                        if n > 0:
+                            self._ingest_scratch(if_idx, n)
+                    else:
+                        frames = transport.recv_frames(VEC)
+                        if frames:
+                            self._ingest(if_idx, frames)
             except (OSError, ValueError):
                 continue
             except Exception:
@@ -162,6 +173,27 @@ class IODaemon:
             else:
                 self.stats["rx_ring_full"] += 1
 
+    def _ingest_scratch(self, if_idx: int, n: int) -> None:
+        """Batch-received frames already sit in scratch rows: decap
+        VXLAN on the uplink (in-row shift), parse in place, push."""
+        lens = self._rx_lens
+        if if_idx == self.uplink_if:
+            for i in range(n):
+                row = self._scratch[i]
+                off = self.codec.decap_offset(row[:lens[i]], self.vni)
+                if off:
+                    self.stats["vxlan_decap"] += 1
+                    inner = int(lens[i]) - off
+                    row[:inner] = row[off:lens[i]]
+                    lens[i] = inner
+        cols, n = self.codec.parse_inplace(self._scratch, lens, n, if_idx)
+        self._learn_macs_scratch(cols, n)
+        if self.rings.rx.push(cols, n, payload=self._scratch):
+            self.stats["rx_frames"] += 1
+            self.stats["rx_pkts"] += n
+        else:
+            self.stats["rx_ring_full"] += 1
+
     def _learn_macs(self, frames: list, cols: Dict[str, np.ndarray],
                     n: int) -> None:
         flags = cols["flags"]
@@ -170,6 +202,15 @@ class IODaemon:
             if flags[i] & FLAG_NON_IP4:
                 continue
             self.mac_of[int(src[i])] = bytes(frames[i][6:12])
+
+    def _learn_macs_scratch(self, cols: Dict[str, np.ndarray],
+                            n: int) -> None:
+        flags = cols["flags"]
+        src = cols["src_ip"]
+        for i in range(n):
+            if flags[i] & FLAG_NON_IP4:
+                continue
+            self.mac_of[int(src[i])] = bytes(self._scratch[i, 6:12])
 
     # --- tx: ring -> wire ---
     def _tx_loop(self) -> None:
@@ -198,6 +239,17 @@ class IODaemon:
         next_hop = cols["next_hop"]
         pkt_len = cols["pkt_len"]
         uplink = self.transports.get(self.uplink_if)
+        # per-egress-interface batches: the header patching stays a
+        # (cheap) Python loop, the send syscalls are amortized through
+        # sendmmsg (native/pkt_io.cpp pio_send_batch) — one syscall per
+        # 64 frames instead of one per packet
+        batches: Dict[int, Tuple[list, list]] = {}
+
+        def enqueue(iface: int, row: int, wire_len: int) -> None:
+            rows, lens = batches.setdefault(iface, ([], []))
+            rows.append(row)
+            lens.append(wire_len)
+
         for i in range(n):
             if not flags[i] & FLAG_VALID:
                 continue
@@ -213,13 +265,13 @@ class IODaemon:
             if d == int(Disposition.DROP):
                 self.stats["tx_drops"] += 1
             elif d == int(Disposition.LOCAL):
-                t = self.transports.get(int(tx_if[i]))
+                iface = int(tx_if[i])
+                t = self.transports.get(iface)
                 if t is None:
                     self.stats["tx_drops"] += 1
                     continue
                 self._set_eth(raw, t.mac, int(dst_ip[i]))
-                t.send_frame(raw.tobytes())
-                self.stats["tx_pkts"] += 1
+                enqueue(iface, i, wire_len)
             elif d == int(Disposition.REMOTE):
                 if uplink is None:
                     self.stats["tx_drops"] += 1
@@ -233,20 +285,38 @@ class IODaemon:
                     )
                     uplink.send_frame(wire)
                     self.stats["vxlan_encap"] += 1
+                    self.stats["tx_pkts"] += 1
                 else:
                     self._set_eth(raw, uplink.mac, int(dst_ip[i]))
-                    uplink.send_frame(raw.tobytes())
-                self.stats["tx_pkts"] += 1
+                    enqueue(self.uplink_if, i, wire_len)
             elif d == int(Disposition.HOST):
-                t = (self.transports.get(self.host_if)
-                     if self.host_if is not None else None)
-                if t is None:
+                if self.host_if is None or \
+                        self.host_if not in self.transports:
                     self.stats["tx_drops"] += 1
                     continue
-                t.send_frame(raw.tobytes())
-                self.stats["tx_punts"] += 1
+                enqueue(self.host_if, i, wire_len)
             else:
                 self.stats["tx_drops"] += 1
+
+        for iface, (rows, lens) in batches.items():
+            t = self.transports.get(iface)
+            if t is None:
+                self.stats["tx_drops"] += len(rows)
+                continue
+            punt = iface == self.host_if
+            bfd = t.batch_fd
+            if bfd is not None:
+                sent = self.codec.send_batch(
+                    bfd, payload, np.asarray(rows, np.uint32),
+                    np.asarray(lens, np.uint32), len(rows),
+                )
+            else:
+                sent = 0
+                for row, ln in zip(rows, lens):
+                    t.send_frame(payload[row, :ln].tobytes())
+                    sent += 1
+            self.stats["tx_punts" if punt else "tx_pkts"] += sent
+            self.stats["tx_drops"] += len(rows) - sent
 
     def _set_eth(self, raw: np.ndarray, src_mac: bytes, dst_ip: int) -> None:
         if len(raw) < 14:

@@ -34,6 +34,13 @@ class Transport:
     name: str = ""
     mac: bytes = b"\x02\x00\x00\x00\x00\x00"
 
+    @property
+    def batch_fd(self) -> Optional[int]:
+        """Socket fd usable with sendmmsg/recvmmsg (the native batch
+        path, native/pkt_io.cpp), or None — TAP is a char device whose
+        fd the mmsg syscalls reject, so it keeps the per-frame path."""
+        return None
+
     def fileno(self) -> int:
         raise NotImplementedError
 
@@ -79,6 +86,10 @@ class AfPacketTransport(Transport):
             struct.pack("256s", ifname.encode()[:15]),
         )
         self.mac = info[18:24]
+
+    @property
+    def batch_fd(self):
+        return self.sock.fileno()
 
     def fileno(self) -> int:
         return self.sock.fileno()
@@ -172,6 +183,10 @@ class SocketPairTransport(Transport):
             except OSError:
                 pass
         return cls(a, f"{name}-in"), cls(b, f"{name}-out")
+
+    @property
+    def batch_fd(self):
+        return self.sock.fileno()
 
     def fileno(self) -> int:
         return self.sock.fileno()

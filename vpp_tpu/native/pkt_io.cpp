@@ -21,6 +21,10 @@
 #include <cstdint>
 #include <cstring>
 
+#include <cerrno>
+#include <sys/socket.h>
+#include <sys/uio.h>
+
 namespace {
 
 constexpr uint32_t kVec = 256;
@@ -93,6 +97,57 @@ void csum_update32(uint8_t* ck, uint32_t old, uint32_t neu) {
 
 inline int32_t* col(int32_t* cols, int c) { return cols + c * kVec; }
 
+// Field extraction for one frame at slot i (shared by the copying and
+// in-place parse entry points). `f` points at the frame bytes, `len`
+// is the wire length, `copy` the bytes actually available (<= snap).
+void parse_fields(const uint8_t* f, uint32_t len, uint32_t copy,
+                  uint32_t snap, uint32_t i, int32_t rx_if,
+                  int32_t* cols) {
+  col(cols, kRxIf)[i] = rx_if;
+  // pkt_len convention is L3 length (wire length = pkt_len + 14);
+  // keep it for non-IPv4 frames too so the tx side reconstructs the
+  // right wire length for punts. Clamped to the captured bytes.
+  col(cols, kPktLen)[i] =
+      static_cast<int32_t>(copy >= kEthHdr ? copy - kEthHdr : 0);
+  col(cols, kFlags)[i] = kFlagValid;
+  if (len > snap) col(cols, kFlags)[i] |= kFlagTrunc;
+  // Runts shorter than an Ethernet header have no meaningful wire
+  // length; without kFlagTrunc the punt path would transmit up to 14
+  // bytes including residual data from the slot's previous occupant.
+  if (copy < kEthHdr) col(cols, kFlags)[i] |= kFlagTrunc;
+  if (len < kEthHdr + 20 || rd16(f + 12) != kEthIp4) {
+    col(cols, kFlags)[i] |= kFlagNonIp4;
+    return;
+  }
+  const uint8_t* ip = f + kEthHdr;
+  uint32_t ihl = (ip[0] & 0x0f) * 4u;
+  if ((ip[0] >> 4) != 4 || ihl < 20 || len < kEthHdr + ihl) {
+    col(cols, kFlags)[i] |= kFlagNonIp4;
+    return;
+  }
+  col(cols, kSrcIp)[i] = static_cast<int32_t>(rd32(ip + 12));
+  col(cols, kDstIp)[i] = static_cast<int32_t>(rd32(ip + 16));
+  col(cols, kProto)[i] = ip[9];
+  col(cols, kTtl)[i] = ip[8];
+  // pkt_len is CLAMPED to what was actually captured: a header
+  // claiming more than the wire delivered (or a frame longer than
+  // snap) must never cause tx of residual bytes from a previous
+  // packet in the reused slot — that would leak cross-flow data.
+  uint32_t tot_len = rd16(ip + 2);
+  uint32_t captured_l3 = copy - kEthHdr;
+  if (tot_len > captured_l3 || len > snap) {
+    col(cols, kFlags)[i] |= kFlagTrunc;
+    tot_len = tot_len > captured_l3 ? captured_l3 : tot_len;
+  }
+  col(cols, kPktLen)[i] = static_cast<int32_t>(tot_len);
+  uint8_t proto = ip[9];
+  const uint8_t* l4 = ip + ihl;
+  if ((proto == 6 || proto == 17) && len >= kEthHdr + ihl + 4) {
+    col(cols, kSport)[i] = rd16(l4);
+    col(cols, kDport)[i] = rd16(l4 + 2);
+  }
+}
+
 }  // namespace
 
 extern "C" {
@@ -117,49 +172,7 @@ uint32_t pio_parse(const uint8_t* bufs, const uint64_t* offsets,
     uint32_t len = lens[i];
     uint32_t copy = len < snap ? len : snap;
     std::memcpy(payload + static_cast<uint64_t>(i) * snap, f, copy);
-    col(cols, kRxIf)[i] = rx_if;
-    // pkt_len convention is L3 length (wire length = pkt_len + 14);
-    // keep it for non-IPv4 frames too so the tx side reconstructs the
-    // right wire length for punts. Clamped to the captured bytes.
-    col(cols, kPktLen)[i] =
-        static_cast<int32_t>(copy >= kEthHdr ? copy - kEthHdr : 0);
-    col(cols, kFlags)[i] = kFlagValid;
-    if (len > snap) col(cols, kFlags)[i] |= kFlagTrunc;
-    // Runts shorter than an Ethernet header have no meaningful wire
-    // length; without kFlagTrunc the punt path would transmit up to 14
-    // bytes including residual data from the slot's previous occupant.
-    if (copy < kEthHdr) col(cols, kFlags)[i] |= kFlagTrunc;
-    if (len < kEthHdr + 20 || rd16(f + 12) != kEthIp4) {
-      col(cols, kFlags)[i] |= kFlagNonIp4;
-      continue;
-    }
-    const uint8_t* ip = f + kEthHdr;
-    uint32_t ihl = (ip[0] & 0x0f) * 4u;
-    if ((ip[0] >> 4) != 4 || ihl < 20 || len < kEthHdr + ihl) {
-      col(cols, kFlags)[i] |= kFlagNonIp4;
-      continue;
-    }
-    col(cols, kSrcIp)[i] = static_cast<int32_t>(rd32(ip + 12));
-    col(cols, kDstIp)[i] = static_cast<int32_t>(rd32(ip + 16));
-    col(cols, kProto)[i] = ip[9];
-    col(cols, kTtl)[i] = ip[8];
-    // pkt_len is CLAMPED to what was actually captured: a header
-    // claiming more than the wire delivered (or a frame longer than
-    // snap) must never cause tx of residual bytes from a previous
-    // packet in the reused slot — that would leak cross-flow data.
-    uint32_t tot_len = rd16(ip + 2);
-    uint32_t captured_l3 = copy - kEthHdr;
-    if (tot_len > captured_l3 || len > snap) {
-      col(cols, kFlags)[i] |= kFlagTrunc;
-      tot_len = tot_len > captured_l3 ? captured_l3 : tot_len;
-    }
-    col(cols, kPktLen)[i] = static_cast<int32_t>(tot_len);
-    uint8_t proto = ip[9];
-    const uint8_t* l4 = ip + ihl;
-    if ((proto == 6 || proto == 17) && len >= kEthHdr + ihl + 4) {
-      col(cols, kSport)[i] = rd16(l4);
-      col(cols, kDport)[i] = rd16(l4 + 2);
-    }
+    parse_fields(f, len, copy, snap, i, rx_if, cols);
   }
   return n;
 }
@@ -279,6 +292,92 @@ uint32_t pio_decap_offset(const uint8_t* frame, uint32_t frame_len,
   if (vx[0] != 0x08) return 0;                 // I flag: VNI present
   if ((rd32(vx + 4) >> 8) != vni) return 0;    // segment match
   return kEthHdr + ihl + 8 + 8;
+}
+
+// ---- batch socket IO (the syscall-amortization layer; reference: VPP
+// moves packets in 256-frame vectors precisely so per-packet costs
+// amortize — a Python send() per packet re-introduces them) ----
+
+constexpr uint32_t kMmsgChunk = 64;
+
+// Transmit n frames over one socket fd with sendmmsg. rows[i] selects
+// the payload slot row, lens[i] the wire length. Returns frames sent
+// (short count on EAGAIN/tx-queue-full; caller counts the rest as
+// drops, same policy as the per-frame path).
+int32_t pio_send_batch(int32_t fd, const uint8_t* payload, uint32_t snap,
+                       const uint32_t* rows, const uint32_t* lens,
+                       uint32_t n) {
+  mmsghdr msgs[kMmsgChunk];
+  iovec iov[kMmsgChunk];
+  uint32_t sent = 0;
+  while (sent < n) {
+    uint32_t k = n - sent < kMmsgChunk ? n - sent : kMmsgChunk;
+    std::memset(msgs, 0, sizeof(mmsghdr) * k);
+    for (uint32_t i = 0; i < k; i++) {
+      uint32_t row = rows[sent + i];
+      iov[i].iov_base =
+          const_cast<uint8_t*>(payload + static_cast<uint64_t>(row) * snap);
+      iov[i].iov_len = lens[sent + i];
+      msgs[i].msg_hdr.msg_iov = &iov[i];
+      msgs[i].msg_hdr.msg_iovlen = 1;
+    }
+    int rc = sendmmsg(fd, msgs, k, MSG_DONTWAIT);
+    if (rc <= 0) break;
+    sent += static_cast<uint32_t>(rc);
+    if (static_cast<uint32_t>(rc) < k) break;  // tx queue filled mid-batch
+  }
+  return static_cast<int32_t>(sent);
+}
+
+// Receive up to max_frames datagrams/frames into payload rows [0..) in
+// one recvmmsg; lens[i] gets each frame's TRUE wire byte count
+// (MSG_TRUNC: a frame longer than snap reports its real length, so the
+// parser sets kFlagTrunc and the tx path can never emit a silently
+// truncated frame — the copying path's trunc_drops guarantee).
+// Non-blocking; returns the count, 0 when nothing pending, -1 on a
+// hard socket error with nothing received (dead/detached fd).
+int32_t pio_recv_batch(int32_t fd, uint8_t* payload, uint32_t snap,
+                       uint32_t* lens, uint32_t max_frames) {
+  mmsghdr msgs[kMmsgChunk];
+  iovec iov[kMmsgChunk];
+  uint32_t got = 0;
+  while (got < max_frames) {
+    uint32_t k = max_frames - got < kMmsgChunk ? max_frames - got
+                                               : kMmsgChunk;
+    std::memset(msgs, 0, sizeof(mmsghdr) * k);
+    for (uint32_t i = 0; i < k; i++) {
+      iov[i].iov_base = payload + static_cast<uint64_t>(got + i) * snap;
+      iov[i].iov_len = snap;
+      msgs[i].msg_hdr.msg_iov = &iov[i];
+      msgs[i].msg_hdr.msg_iovlen = 1;
+    }
+    int rc = recvmmsg(fd, msgs, k, MSG_DONTWAIT | MSG_TRUNC, nullptr);
+    if (rc < 0) {
+      if (got) return static_cast<int32_t>(got);
+      return (errno == EAGAIN || errno == EWOULDBLOCK) ? 0 : -1;
+    }
+    for (int i = 0; i < rc; i++) lens[got + i] = msgs[i].msg_len;
+    got += static_cast<uint32_t>(rc);
+    if (static_cast<uint32_t>(rc) < k) break;  // drained
+  }
+  return static_cast<int32_t>(got);
+}
+
+// Parse frames already resident in the payload block (recv_batch wrote
+// them there): same field extraction as pio_parse but zero copies —
+// each row IS the stored frame.
+uint32_t pio_parse_inplace(const uint8_t* payload, uint32_t snap,
+                           const uint32_t* lens, uint32_t n,
+                           int32_t rx_if, int32_t* cols) {
+  if (n > kVec) n = kVec;
+  std::memset(cols, 0, sizeof(int32_t) * kVec * kColumns);
+  for (uint32_t i = 0; i < n; i++) {
+    const uint8_t* f = payload + static_cast<uint64_t>(i) * snap;
+    uint32_t len = lens[i];
+    uint32_t copy = len < snap ? len : snap;
+    parse_fields(f, len, copy, snap, i, rx_if, cols);
+  }
+  return n;
 }
 
 }  // extern "C"
