@@ -433,6 +433,50 @@ class TableBuilder:
         self.nat_snat_ip = np.uint32(ip)
         self._mark("nat")
 
+    # staging-state array attributes (everything a mutator can touch,
+    # besides the dict-of-arrays acl/glb and the scalars handled
+    # explicitly in state_snapshot/state_restore)
+    _STATE_ARRAYS = (
+        "acl_nrules", "if_type", "if_local_table", "if_apply_global",
+        "fib_prefix", "fib_mask", "fib_plen", "fib_tx_if", "fib_disp",
+        "fib_next_hop", "fib_node_id", "fib_snat",
+        "nat_ext_ip", "nat_ext_port", "nat_proto", "nat_boff", "nat_bcnt",
+        "nat_total_w", "nat_self_snat", "natb_ip", "natb_port",
+        "natb_cumw",
+    )
+
+    def state_snapshot(self) -> dict:
+        """Copy of the whole staged (host) configuration — cheap numpy
+        copies, no device state. Pair with state_restore for
+        transactional rollback (pipeline/txn.py)."""
+        return {
+            "arrays": {k: getattr(self, k).copy()
+                       for k in self._STATE_ARRAYS},
+            "acl": {k: v.copy() for k, v in self.acl.items()},
+            "glb": {k: v.copy() for k, v in self.glb.items()},
+            "glb_nrules": self.glb_nrules,
+            "glb_mxu": self.glb_mxu,       # replaced wholesale, never
+            "nat_snat_ip": self.nat_snat_ip,  # mutated in place
+            "dirty": set(self._dirty),
+        }
+
+    def state_restore(self, snap: dict) -> None:
+        """Restore a state_snapshot (in-place array writes so existing
+        references — e.g. cluster builders — stay valid)."""
+        for k, v in snap["arrays"].items():
+            getattr(self, k)[...] = v
+        for k, v in snap["acl"].items():
+            self.acl[k][...] = v
+        for k, v in snap["glb"].items():
+            self.glb[k][...] = v
+        self.glb_nrules = snap["glb_nrules"]
+        self.glb_mxu = snap["glb_mxu"]
+        self.nat_snat_ip = snap["nat_snat_ip"]
+        # union, not replace: groups the rolled-back ops touched stay
+        # dirty — a redundant re-upload of identical data is harmless,
+        # a stale device cache is not
+        self._dirty |= set(snap["dirty"])
+
     # --- device upload ---
     def host_arrays(self) -> Dict[str, np.ndarray]:
         """The staged configuration as numpy arrays keyed by
