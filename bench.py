@@ -355,11 +355,24 @@ def sub_benches(args):
     return out
 
 
+def wire_udp(i: int) -> bytes:
+    """One test UDP frame 10.1.1.2 → 10.1.1.3 (shared by the ring bench
+    and the daemon-bench sender subprocess)."""
+    import ipaddress
+    import struct
+
+    src = ipaddress.ip_address("10.1.1.2").packed
+    dst = ipaddress.ip_address("10.1.1.3").packed
+    eth = b"\x02\x00\x00\x00\x00\x02\x02\x00\x00\x00\x00\x01\x08\x00"
+    l4 = struct.pack("!HHHH", 40000 + (i % 1024), 80, 16, 0) + b"y" * 8
+    hdr = struct.pack("!BBHHHBBH4s4s", 0x45, 0, 20 + len(l4), i & 0xFFFF,
+                      0x4000, 64, 17, 0, src, dst)
+    return eth + hdr + l4
+
+
 def io_ring_bench(args, frame_pkts: int = 256,
                   sat_s: float = 5.0, paced_s: float = 5.0) -> dict:
     import collections
-    import ipaddress
-    import struct
     import threading
 
     import jax as _jax
@@ -371,15 +384,6 @@ def io_ring_bench(args, frame_pkts: int = 256,
 
     dp = build_fwd_dataplane()
     client_if = dp.pod_if[("default", "p0")]
-
-    def wire_udp(i: int) -> bytes:
-        src = ipaddress.ip_address("10.1.1.2").packed
-        dst = ipaddress.ip_address("10.1.1.3").packed
-        eth = b"\x02\x00\x00\x00\x00\x02\x02\x00\x00\x00\x00\x01\x08\x00"
-        l4 = struct.pack("!HHHH", 40000 + (i % 1024), 80, 16, 0) + b"y" * 8
-        hdr = struct.pack("!BBHHHBBH4s4s", 0x45, 0, 20 + len(l4), i & 0xFFFF,
-                          0x4000, 64, 17, 0, src, dst)
-        return eth + hdr + l4
 
     frames = [wire_udp(i) for i in range(frame_pkts)]
     # deep ring + large coalesce + parallel fetchers: over the axon
@@ -504,6 +508,174 @@ def io_ring_bench(args, frame_pkts: int = 256,
         rings.close()
 
 
+def io_daemon_bench(args, duration_s: float = 5.0) -> dict:
+    """Real-packet throughput through the FULL node data path: kernel
+    veth → AF_PACKET → IO daemon (recvmmsg batch rx) → rx ring →
+    pipelined pump → device pipeline → tx ring → daemon (sendmmsg batch
+    tx) → AF_PACKET → kernel veth. The reference's whole purpose is
+    moving real packets (SURVEY §3.5); this is the number a deployed
+    node actually sees. Skipped (empty dict) without CAP_NET_ADMIN."""
+    import subprocess
+
+    import jax as _jax
+
+    def sh(*a):
+        return subprocess.run(["ip", *a], capture_output=True, timeout=15)
+
+    # capability check + fixture
+    created = []
+    for pair in (("vppbnA0", "vppbnA1"), ("vppbnB0", "vppbnB1")):
+        sh("link", "del", pair[0])
+        if sh("link", "add", pair[0], "type", "veth", "peer", "name",
+              pair[1]).returncode != 0:
+            for leg in created:  # don't leak a half-built fixture
+                sh("link", "del", leg)
+            return {}
+        created.append(pair[0])
+        for leg in pair:
+            sh("link", "set", leg, "up")
+
+    from vpp_tpu.io.daemon import IODaemon
+    from vpp_tpu.io.pump import DataplanePump
+    from vpp_tpu.io.rings import IORingPair
+    from vpp_tpu.io.transport import AfPacketTransport
+    from vpp_tpu.native.pktio import PacketCodec
+    from vpp_tpu.pipeline.dataplane import Dataplane
+    from vpp_tpu.pipeline.tables import DataplaneConfig
+    from vpp_tpu.pipeline.vector import VEC, Disposition
+
+    dp = Dataplane(DataplaneConfig())
+    if_a = dp.add_pod_interface(("default", "a"))
+    if_b = dp.add_pod_interface(("default", "b"))
+    dp.builder.add_route("10.1.1.3/32", if_b, Disposition.LOCAL)
+    dp.swap()
+    for bucket in (VEC, 16384):
+        _jax.block_until_ready(
+            dp.process_packed(np.zeros((9, bucket), np.int32))
+        )
+
+    rings = IORingPair(n_slots=256, snap=512)
+    daemon = pump = None
+    try:
+        daemon = IODaemon(
+            rings,
+            {if_a: AfPacketTransport("vppbnA0"),
+             if_b: AfPacketTransport("vppbnB0")},
+            uplink_if=0,
+        ).start()
+        pump = DataplanePump(dp, rings, max_batch=16384, workers=8).start()
+
+        # sender/receiver as SUBPROCESSES: in-process Python threads
+        # would fight the daemon+pump threads for the GIL and the
+        # receiver would undercount by dropping at its own socket —
+        # separate interpreters measure the daemon, not the harness.
+        # (They import only the native codec + transports, no jax.)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = (
+            os.path.dirname(os.path.abspath(__file__))
+            + os.pathsep + env.get("PYTHONPATH", "")
+        )
+        sender_code = (
+            "import time\n"
+            "import numpy as np\n"
+            "from bench import wire_udp\n"
+            "from vpp_tpu.io.transport import AfPacketTransport\n"
+            "from vpp_tpu.native.pktio import PacketCodec\n"
+            "VEC = 256\n"
+            "codec = PacketCodec(snap=512)\n"
+            "t = AfPacketTransport('vppbnA1')\n"
+            "payload = np.zeros((VEC, 512), np.uint8)\n"
+            "lens = np.zeros(VEC, np.uint32)\n"
+            "for i in range(VEC):\n"
+            "    f = wire_udp(i)\n"
+            "    payload[i, :len(f)] = np.frombuffer(f, np.uint8)\n"
+            "    lens[i] = len(f)\n"
+            "rows = np.arange(VEC, dtype=np.uint32)\n"
+            # the sender times its own loop: interpreter/numpy startup
+            # and frame building must not dilute the send window
+            "t0 = time.perf_counter()\n"
+            f"deadline = t0 + {duration_s}\n"
+            "sent = 0\n"
+            "while time.perf_counter() < deadline:\n"
+            "    k = codec.send_batch(t.batch_fd, payload, rows, lens, VEC)\n"
+            "    sent += k\n"
+            "    if k < VEC:\n"
+            "        time.sleep(0.0005)\n"
+            "print(sent, time.perf_counter() - t0)\n"
+        )
+        recv_code = (
+            "import socket, time\n"
+            "import numpy as np\n"
+            "from vpp_tpu.io.transport import AfPacketTransport\n"
+            "from vpp_tpu.native.pktio import PacketCodec\n"
+            "codec = PacketCodec(snap=512)\n"
+            "t = AfPacketTransport('vppbnB1')\n"
+            "SO_RCVBUFFORCE = 33\n"
+            "t.sock.setsockopt(socket.SOL_SOCKET, SO_RCVBUFFORCE,\n"
+            "                  256 << 20)\n"  # past rmem_max (CAP_NET_ADMIN)
+            "print('READY', flush=True)\n"
+            "scratch = np.zeros((256, 512), np.uint8)\n"
+            "lens = np.zeros(256, np.uint32)\n"
+            f"deadline = time.perf_counter() + {duration_s + 10.0}\n"
+            "got, idle_since = 0, None\n"
+            "while time.perf_counter() < deadline:\n"
+            "    n = codec.recv_batch(t.batch_fd, scratch, lens)\n"
+            "    if n > 0:\n"
+            "        got += n\n"
+            "        idle_since = None\n"
+            "    else:\n"
+            "        now = time.perf_counter()\n"
+            "        if idle_since is None:\n"
+            "            idle_since = now\n"
+            f"        elif got and now - idle_since > 1.5:\n"
+            "            break\n"  # sender done, queue drained
+            "        time.sleep(0.0002)\n"
+            "print(got)\n"
+        )
+        recv_proc = subprocess.Popen(
+            [sys.executable, "-c", recv_code], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        # wait for the receiver's socket to exist before offering load —
+        # frames forwarded to vppbnB1 before the bind are unaccountable
+        ready = recv_proc.stdout.readline()
+        if "READY" not in ready:
+            _, r_err = recv_proc.communicate(timeout=30)
+            raise RuntimeError(f"receiver failed to start: {r_err[-300:]}")
+        send_proc = subprocess.Popen(
+            [sys.executable, "-c", sender_code], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        s_out, s_err = send_proc.communicate(timeout=duration_s + 60)
+        r_out, r_err = recv_proc.communicate(timeout=duration_s + 60)
+        # a dead endpoint must surface as an ERROR, not as a plausible
+        # 0.0 Mpps datum
+        if send_proc.returncode != 0 or not s_out.strip():
+            raise RuntimeError(f"sender failed: {s_err[-300:]}")
+        if recv_proc.returncode != 0 or not r_out.strip():
+            raise RuntimeError(f"receiver failed: {r_err[-300:]}")
+        offered_s, window_s = s_out.split()
+        offered = int(offered_s)
+        send_window = float(window_s)
+        got = int(r_out.strip())
+        # rate over the offered window (the receiver's post-drain of its
+        # kernel queue belongs to that window's traffic)
+        return {
+            "io_daemon_veth_mpps": round(got / send_window / 1e6, 4),
+            "io_daemon_offered_mpps": round(offered / send_window / 1e6, 4),
+        }
+    finally:
+        if pump is not None:
+            pump.stop()
+        if daemon is not None:
+            daemon.stop()
+            for t in daemon.transports.values():
+                t.close()
+        rings.close()
+        for leg in ("vppbnA0", "vppbnB0"):
+            sh("link", "del", leg)
+
+
 def main():
     try:
         _run()
@@ -614,6 +786,11 @@ def _run():
     pipelined_us = (time.perf_counter() - t0) / K * 1e6
 
     subs = {} if args.no_subbench else sub_benches(args)
+    if not args.no_subbench:
+        try:
+            subs.update(io_daemon_bench(args))
+        except Exception as e:  # noqa: BLE001 — optional, env-dependent
+            subs["io_daemon_bench_error"] = f"{type(e).__name__}: {e}"
     subs.update(commit_bench(args))
     # the honest experienced figure: ring-to-ring wire-path latency at
     # a paced (non-saturating) offered load, NOT pipelined-throughput/N
