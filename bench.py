@@ -535,28 +535,30 @@ def io_daemon_bench(args, duration_s: float = 5.0) -> dict:
         for leg in pair:
             sh("link", "set", leg, "up")
 
-    from vpp_tpu.io.daemon import IODaemon
-    from vpp_tpu.io.pump import DataplanePump
-    from vpp_tpu.io.rings import IORingPair
-    from vpp_tpu.io.transport import AfPacketTransport
-    from vpp_tpu.native.pktio import PacketCodec
-    from vpp_tpu.pipeline.dataplane import Dataplane
-    from vpp_tpu.pipeline.tables import DataplaneConfig
-    from vpp_tpu.pipeline.vector import VEC, Disposition
-
-    dp = Dataplane(DataplaneConfig())
-    if_a = dp.add_pod_interface(("default", "a"))
-    if_b = dp.add_pod_interface(("default", "b"))
-    dp.builder.add_route("10.1.1.3/32", if_b, Disposition.LOCAL)
-    dp.swap()
-    for bucket in (VEC, 16384):
-        _jax.block_until_ready(
-            dp.process_packed(np.zeros((9, bucket), np.int32))
-        )
-
-    rings = IORingPair(n_slots=256, snap=512)
-    daemon = pump = None
+    # everything from here runs under the cleanup block: a failing
+    # import/compile/ring setup (busy TPU is a realistic one) must not
+    # leak the veth pairs onto the host
+    rings = daemon = pump = None
     try:
+        from vpp_tpu.io.daemon import IODaemon
+        from vpp_tpu.io.pump import DataplanePump
+        from vpp_tpu.io.rings import IORingPair
+        from vpp_tpu.io.transport import AfPacketTransport
+        from vpp_tpu.pipeline.dataplane import Dataplane
+        from vpp_tpu.pipeline.tables import DataplaneConfig
+        from vpp_tpu.pipeline.vector import VEC, Disposition
+
+        dp = Dataplane(DataplaneConfig())
+        if_a = dp.add_pod_interface(("default", "a"))
+        if_b = dp.add_pod_interface(("default", "b"))
+        dp.builder.add_route("10.1.1.3/32", if_b, Disposition.LOCAL)
+        dp.swap()
+        for bucket in (VEC, 16384):
+            _jax.block_until_ready(
+                dp.process_packed(np.zeros((9, bucket), np.int32))
+            )
+
+        rings = IORingPair(n_slots=256, snap=512)
         daemon = IODaemon(
             rings,
             {if_a: AfPacketTransport("vppbnA0"),
@@ -671,7 +673,8 @@ def io_daemon_bench(args, duration_s: float = 5.0) -> dict:
             daemon.stop()
             for t in daemon.transports.values():
                 t.close()
-        rings.close()
+        if rings is not None:
+            rings.close()
         for leg in ("vppbnA0", "vppbnB0"):
             sh("link", "del", leg)
 

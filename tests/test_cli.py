@@ -73,3 +73,57 @@ def test_show_session_and_trace_and_unknown():
     assert "10.9.9.9 -> 10.1.1.2" in out
     assert "unknown command" in cli.run("bogus thing")
     assert "show nat44" in cli.run("help")
+
+
+def test_show_io_with_pump_and_daemon():
+    """show io surfaces pump + daemon counters through the control
+    socket (the vector-rates analog for the host IO path)."""
+    import tempfile
+
+    import numpy as np
+
+    from vpp_tpu.cli import DebugCLI
+    from vpp_tpu.io.control import IOControlClient, IOControlServer
+    from vpp_tpu.io.daemon import IODaemon
+    from vpp_tpu.io.pump import DataplanePump
+    from vpp_tpu.io.rings import IORingPair
+    from vpp_tpu.native.pktio import PacketCodec
+    from vpp_tpu.pipeline.dataplane import Dataplane
+    from vpp_tpu.pipeline.tables import DataplaneConfig
+    from vpp_tpu.pipeline.vector import VEC, Disposition
+
+    dp = Dataplane(DataplaneConfig())
+    a = dp.add_pod_interface(("default", "a"))
+    b = dp.add_pod_interface(("default", "b"))
+    dp.builder.add_route("10.1.1.3/32", b, Disposition.LOCAL)
+    dp.swap()
+    rings = IORingPair(n_slots=8)
+    daemon = IODaemon(rings, {}, uplink_if=0).start()
+    sock = tempfile.mktemp(suffix=".sock")
+    control = IOControlServer(daemon, sock).start()
+    pump = DataplanePump(dp, rings).start()
+    try:
+        # push one frame through so counters are non-trivial
+        from wire import make_frame
+
+        codec = PacketCodec()
+        frame = make_frame("10.1.1.2", "10.1.1.3", proto=17, dport=53)
+        scratch = np.zeros((VEC, rings.rx.snap), np.uint8)
+        cols, n = codec.parse([frame], a, scratch)
+        rings.rx.push(cols, n, payload=scratch)
+        deadline = __import__("time").monotonic() + 60
+        while pump.stats["frames"] < 1 and \
+                __import__("time").monotonic() < deadline:
+            __import__("time").sleep(0.05)
+
+        cli = DebugCLI(dp, pump=pump, io_ctl=IOControlClient(sock))
+        out = cli.run("show io")
+        assert "pump: 1 frames" in out
+        assert "io-daemon: rx" in out
+        assert "batch latency" in out
+        assert "interfaces" in out
+    finally:
+        pump.stop()
+        control.close()
+        daemon.stop()
+        rings.close()

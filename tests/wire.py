@@ -1,0 +1,50 @@
+"""Shared wire-format helpers for IO tests: hand-rolled ethernet/IPv4/L4
+frames with correct checksums (single source — the codec's accepted
+wire format must only ever be updated in one place)."""
+
+from __future__ import annotations
+
+import ipaddress
+import struct
+
+
+def ip_checksum_ok(ip_hdr: bytes) -> bool:
+    s = sum(struct.unpack(f"!{len(ip_hdr) // 2}H", ip_hdr))
+    while s >> 16:
+        s = (s & 0xFFFF) + (s >> 16)
+    return s == 0xFFFF
+
+
+def make_frame(src: str, dst: str, proto: int = 17, sport: int = 40000,
+               dport: int = 80, payload: bytes = b"x" * 32,
+               ttl: int = 64) -> bytes:
+    """Ethernet + IPv4 + L4 frame with valid IP and L4 checksums."""
+    eth = b"\x02\x00\x00\x00\x00\x02" + b"\x02\x00\x00\x00\x00\x01" \
+        + b"\x08\x00"
+    if proto == 17:
+        l4 = struct.pack("!HHHH", sport, dport, 8 + len(payload), 0) + payload
+    elif proto == 6:
+        l4 = struct.pack("!HHIIBBHHH", sport, dport, 1, 0, 5 << 4, 0x02,
+                         8192, 0, 0) + payload
+    else:
+        l4 = payload
+    ip_len = 20 + len(l4)
+    src_b = ipaddress.ip_address(src).packed
+    dst_b = ipaddress.ip_address(dst).packed
+    hdr = struct.pack("!BBHHHBBH4s4s", 0x45, 0, ip_len, 1, 0x4000, ttl,
+                      proto, 0, src_b, dst_b)
+    s = sum(struct.unpack("!10H", hdr))
+    while s >> 16:
+        s = (s & 0xFFFF) + (s >> 16)
+    hdr = hdr[:10] + struct.pack("!H", ~s & 0xFFFF) + hdr[12:]
+    # L4 checksum (TCP +16 / UDP +6) over pseudo-header
+    if proto in (6, 17):
+        pseudo = src_b + dst_b + struct.pack("!BBH", 0, proto, len(l4))
+        data = pseudo + l4 + (b"\x00" if len(l4) % 2 else b"")
+        s = sum(struct.unpack(f"!{len(data) // 2}H", data))
+        while s >> 16:
+            s = (s & 0xFFFF) + (s >> 16)
+        ck = (~s & 0xFFFF) or 0xFFFF
+        off = 16 if proto == 6 else 6
+        l4 = l4[:off] + struct.pack("!H", ck) + l4[off + 2:]
+    return eth + hdr + l4

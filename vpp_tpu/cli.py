@@ -19,10 +19,15 @@ from vpp_tpu.pipeline.vector import Disposition, ip4_str
 
 
 class DebugCLI:
-    def __init__(self, dataplane: Dataplane, tracer=None, stats=None):
+    def __init__(self, dataplane: Dataplane, tracer=None, stats=None,
+                 pump=None, io_ctl=None):
         self.dp = dataplane
         self.tracer = tracer
         self.stats = stats
+        # optional IO front-end handles: the agent-side pump and the
+        # control-socket client into the (separate) IO daemon process
+        self.pump = pump
+        self.io_ctl = io_ctl
 
     # --- dispatch ---
     def run(self, line: str) -> str:
@@ -37,6 +42,7 @@ class DebugCLI:
             ("show", "fib"): self.show_fib,
             ("show", "trace"): self.show_trace,
             ("show", "errors"): self.show_errors,
+            ("show", "io"): self.show_io,
             ("help",): self.help,
         }
         for sig, fn in handlers.items():
@@ -47,7 +53,7 @@ class DebugCLI:
     def help(self) -> str:
         return (
             "commands: show interface | show acl | show session | "
-            "show nat44 | show fib | show trace | show errors"
+            "show nat44 | show fib | show trace | show errors | show io"
         )
 
     # --- commands ---
@@ -167,6 +173,53 @@ class DebugCLI:
                 f"-> if {int(b.fib_tx_if[i])} [{disp}]{extra}"
             )
         return "\n".join(sorted(lines)) or "empty FIB"
+
+    def show_io(self) -> str:
+        """Pump + IO-daemon counters (the `show interface rx-placement`
+        / vector-rates analog for the host IO path)."""
+        lines = []
+        if self.pump is not None:
+            s = self.pump.stats
+            lat = self.pump.latency_us()
+            lines.append(
+                f"pump: {s['frames']} frames, {s['pkts']} pkts, "
+                f"{s['batches']} batches (max coalesce {s['max_coalesce']}"
+                f"), tx-ring-full {s['tx_ring_full']}, "
+                f"errors {s['batch_errors']}"
+            )
+            lines.append(
+                f"pump batch latency: p50 {lat['p50']:.0f}us "
+                f"p99 {lat['p99']:.0f}us over {lat['n']} batches"
+            )
+        if self.io_ctl is not None:
+            # the whole block is guarded: the daemon is another process
+            # over a socket, so besides being down it may be a different
+            # build whose stats dict lacks keys — degrade, never crash
+            # the debug CLI
+            try:
+                d = self.io_ctl.stats()
+                ifs = self.io_ctl.list_interfaces()
+                lines.append(
+                    "io-daemon: rx {rx_frames}f/{rx_pkts}p "
+                    "(ring-full {rx_ring_full}), tx {tx_frames}f/"
+                    "{tx_pkts}p, drops {tx_drops}, punts {tx_punts}, "
+                    "trunc {trunc_drops}, vxlan {vxlan_encap}e/"
+                    "{vxlan_decap}d".format(
+                        **{k: d.get(k, "?") for k in (
+                            "rx_frames", "rx_pkts", "rx_ring_full",
+                            "tx_frames", "tx_pkts", "tx_drops",
+                            "tx_punts", "trunc_drops", "vxlan_encap",
+                            "vxlan_decap")}
+                    )
+                )
+                lines.append(
+                    "io-daemon interfaces: "
+                    + (", ".join(f"{i}:{n}" for i, n in sorted(ifs.items()))
+                       or "(none)")
+                )
+            except Exception as e:  # noqa: BLE001 — daemon may be down
+                lines.append(f"io-daemon: unreachable ({e})")
+        return "\n".join(lines) if lines else "no IO front-end attached"
 
     def show_trace(self) -> str:
         if self.tracer is None:
