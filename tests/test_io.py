@@ -25,7 +25,7 @@ from wire import ip_checksum_ok, make_frame
 
 from vpp_tpu.io import DataplanePump, IODaemon, IORingPair, SocketPairTransport
 from vpp_tpu.ir.rule import Action, ContivRule, Protocol
-from vpp_tpu.pipeline.dataplane import Dataplane
+from vpp_tpu.pipeline.dataplane import Dataplane, packed_input_zeros
 from vpp_tpu.pipeline.tables import DataplaneConfig
 from vpp_tpu.pipeline.vector import Disposition, ip4
 
@@ -77,7 +77,7 @@ class IoHarness:
         from vpp_tpu.pipeline.vector import make_packet_vector
 
         self.dp.process(make_packet_vector([]))
-        self.dp.process_packed(np.zeros((9, 256), np.int32))
+        self.dp.process_packed(packed_input_zeros(256))
 
         self.rings = IORingPair(n_slots=8)
         self.transports = {}
@@ -164,6 +164,31 @@ class TestWireToWire:
         assert inner[14 + 16:14 + 20] == \
             ipaddress.ip_address(REMOTE_POD).packed
         assert inner[22] == 63
+
+    def test_armed_tracer_captures_pump_traffic(self, harness):
+        """The pump's tracing slow path (dispatch via the unpacked step
+        so the tracer sees a full StepResult) must still forward the
+        frame AND capture a trace entry — regression for the packed
+        [5,B] boundary breaking the slow branch's column decode."""
+        from vpp_tpu.trace.tracer import PacketTracer
+
+        tracer = PacketTracer()
+        harness.dp.tracer = tracer
+        tracer.add(4)
+        try:
+            frame = make_frame(CLIENT_IP, SERVER_IP, proto=17, dport=80)
+            harness.send("client", frame)
+            out = harness.recv("server")
+            assert out[14 + 16:14 + 20] == \
+                ipaddress.ip_address(SERVER_IP).packed
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and not tracer.entries():
+                time.sleep(0.01)
+            entries = tracer.entries()
+            assert entries, "armed tracer captured nothing from the pump"
+            assert any(e.dst == SERVER_IP for e in entries)
+        finally:
+            harness.dp.tracer = None
 
     def test_non_ip_frame_punted_to_host(self, harness):
         arp = b"\xff" * 6 + b"\x02\x00\x00\x00\x00\x01" + b"\x08\x06" \

@@ -33,7 +33,7 @@ from vpp_tpu.io.pump import DataplanePump
 from vpp_tpu.io.rings import IORingPair
 from vpp_tpu.ipam.ipam import IPAM
 from vpp_tpu.ir.rule import Action, ContivRule, Protocol
-from vpp_tpu.pipeline.dataplane import Dataplane
+from vpp_tpu.pipeline.dataplane import Dataplane, packed_input_zeros
 from vpp_tpu.pipeline.tables import DataplaneConfig
 
 
@@ -87,7 +87,7 @@ def stack(tmp_path):
         [ContivRule(action=Action.PERMIT, protocol=Protocol.ANY)]
     )
     dp.swap()
-    dp.process_packed(np.zeros((9, 256), np.int32))  # pre-compile
+    dp.process_packed(packed_input_zeros(256))  # pre-compile
 
     rings = IORingPair(n_slots=32)
     daemon = IODaemon(rings, {}, uplink_if=uplink).start()

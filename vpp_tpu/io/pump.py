@@ -43,8 +43,12 @@ import numpy as np
 
 from vpp_tpu.io.rings import VEC, IORingPair
 from vpp_tpu.native.pktio import FLAG_NON_IP4, FLAG_TRUNC
-from vpp_tpu.native.ring import PV_COLUMNS
-from vpp_tpu.pipeline.dataplane import PACKED_OUT_ROWS
+from vpp_tpu.pipeline.dataplane import (
+    PACKED_IN_ROWS,
+    pack_packet_columns,
+    unpack_packet_input,
+    unpack_packet_result,
+)
 from vpp_tpu.pipeline.vector import Disposition, PacketVector
 
 log = logging.getLogger("pump")
@@ -73,8 +77,12 @@ class DataplanePump:
         }
         # dispatch→tx latency of recent batches, seconds (experienced
         # added latency of the device leg; ring-wait not included — the
-        # bench measures full ring-to-ring with its own timestamps)
+        # bench measures full ring-to-ring with its own timestamps).
+        # _lat_lock guards append vs snapshot: iterating a deque while
+        # the tx writer appends raises RuntimeError (reachable from the
+        # CLI's `show io` → latency_us()).
         self.batch_lat = collections.deque(maxlen=lat_window)
+        self._lat_lock = threading.Lock()
         self._inflight: "queue.Queue" = queue.Queue(maxsize=depth)
         self._done: dict = {}               # seq -> completed batch
         self._done_cv = threading.Condition()
@@ -160,34 +168,31 @@ class DataplanePump:
         # max_batch — the step's device cost is dominated by fixed
         # overhead, so padding is cheaper than extra compiles
         bucket = VEC if total <= VEC else self.max_batch
-        # one [9, bucket] int32 block: a single host→device transfer
-        # (uint32 columns travel bitcast; unpacked device-side)
-        flat = np.zeros((9, bucket), np.int32)
+        # one [5, bucket] int32 bit-packed block: a single host→device
+        # transfer of 20 B/packet (dataplane.pack_packet_columns layout)
+        flat = np.zeros((PACKED_IN_ROWS, bucket), np.int32)
+        fu = flat.view(np.uint32)
         off = 0
         for f in frames:
-            n = f.n
-            for i, (name, _) in enumerate(PV_COLUMNS):
-                flat[i, off:off + n] = f.cols[name][:n].view(np.int32)
-            off += n
-        flags = flat[8]
+            pack_packet_columns(fu, f.cols, f.n, off)
+            off += f.n
+        flags = fu[4] & 0xFF
         non_ip = (flags & FLAG_NON_IP4) != 0
         # non-IPv4 and truncated slots are invalid for the pipeline
         # (bogus/partial headers); non-IP is punted after the step,
         # truncated is dropped by the daemon via its flag. Padding slots
         # beyond `off` stay flags=0 == invalid.
         bad = (flags & (FLAG_NON_IP4 | FLAG_TRUNC)) != 0
-        flat[8] = np.where(bad, 0, flags)
+        fu[4] = np.where(bad, fu[4] & ~np.uint32(0xFF), fu[4])
         tracer = self.dp.tracer
         slow = tracer is not None and getattr(tracer, "_armed", 0) > 0
         t0 = time.perf_counter()
         if slow:
             # tracing: run the unpacked step so the tracer captures a
             # full StepResult (multi-transfer — fine while debugging)
-            cols = {
-                name: flat[i].view(dtype)
-                for i, (name, dtype) in enumerate(PV_COLUMNS)
-            }
-            payload = self.dp.process(PacketVector(**cols))
+            payload = self.dp.process(
+                PacketVector(**unpack_packet_input(flat))
+            )
         else:
             payload = self.dp.process_packed(flat)  # async dispatch
         item = (self._seq, payload, frames, non_ip, t0, slow)
@@ -244,16 +249,11 @@ class DataplanePump:
                         "next_hop": np.asarray(next_hop),
                     }
                 else:
-                    # ONE [10, B] fetch; np.array: device_get may hand
+                    # ONE [5, B] fetch; np.array: device_get may hand
                     # back a read-only zero-copy view (CPU backend) and
-                    # the writer mutates rows
+                    # the decode + writer mutate rows
                     out = np.array(jax.device_get(payload))
-                    batch = {
-                        name: out[i]
-                        for i, name in enumerate(PACKED_OUT_ROWS)
-                    }
-                    for name in ("src_ip", "dst_ip", "next_hop"):
-                        batch[name] = batch[name].view(np.uint32)
+                    batch = unpack_packet_result(out)
             except Exception:
                 log.exception("pump fetch failed (batch %d)", seq)
                 batch = None
@@ -305,6 +305,12 @@ class DataplanePump:
                     out_cols[name] = col
                 out_cols["flags"] = f.cols["flags"]  # valid+non-ip4
                 out_cols["meta"] = f.cols["meta"]
+                # pipeline-invariant fields don't travel back over the
+                # packed boundary; the rx slot is their source of truth
+                # (the tracing path still returns them — don't clobber)
+                for inv in ("proto", "pkt_len"):
+                    if inv not in out_cols:
+                        out_cols[inv] = f.cols[inv]
                 if self.rings.tx.push(out_cols, n, payload=f.payload,
                                       epoch=epoch):
                     self.stats["frames"] += 1
@@ -312,7 +318,8 @@ class DataplanePump:
                 else:
                     self.stats["tx_ring_full"] += 1
                 off += n
-            self.batch_lat.append(time.perf_counter() - t0)
+            with self._lat_lock:
+                self.batch_lat.append(time.perf_counter() - t0)
         with self._held_lock:
             for _ in frames:
                 self.rings.rx.release()
@@ -321,9 +328,11 @@ class DataplanePump:
     # --- observability ---
     def latency_us(self) -> dict:
         """p50/p99 dispatch→tx batch latency over the recent window."""
-        if not self.batch_lat:
+        with self._lat_lock:
+            snap = list(self.batch_lat)
+        if not snap:
             return {"p50": 0.0, "p99": 0.0, "n": 0}
-        arr = np.asarray(self.batch_lat) * 1e6
+        arr = np.asarray(snap) * 1e6
         return {
             "p50": float(np.percentile(arr, 50)),
             "p99": float(np.percentile(arr, 99)),

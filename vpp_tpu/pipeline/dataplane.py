@@ -14,6 +14,8 @@ from __future__ import annotations
 
 import threading
 import time as _time
+
+import numpy as np
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -32,46 +34,137 @@ from vpp_tpu.pipeline.vector import Disposition, PacketVector
 
 
 def _packed_call(step):
-    """Wrap a pipeline step with a packed IO boundary: ONE [9, B] int32
-    input (PacketVector columns bitcast + stacked) and ONE [10, B] int32
-    output (rewritten header columns + disp + tx_if + next_hop).
+    """Wrap a pipeline step with a bit-packed IO boundary: ONE [5, B]
+    int32 input and ONE [5, B] int32 output.
 
     Over a remote device transport (the axon tunnel) every host↔device
     transfer is a round trip; the unpacked path costs ~13 of them per
     frame (9 column uploads + 4 result fetches), which is what buried
-    the r2 wire path at 0.001 Mpps. Packed: exactly one upload and one
-    fetch per batch."""
+    the r2 wire path at 0.001 Mpps. r3 packed that into one [9,B] up /
+    one [10,B] down transfer; this layout additionally bit-packs the
+    sub-32-bit header fields so the boundary is 20 B/packet each way
+    instead of 36/40 — on a bandwidth-limited transport (the tunnel
+    measures single-digit MB/s on bad days; PCIe DMA on real hardware)
+    bytes-per-packet IS the wire-path throughput ceiling.
+
+    Input rows (uint32 bit layout):
+      0: src_ip            1: dst_ip
+      2: sport<<16 | dport
+      3: pkt_len<<16 | proto<<8 | ttl
+      4: rx_if<<8 | flags
+    Output rows:
+      0: src_ip            1: dst_ip
+      2: sport<<16 | dport
+      3: disp<<24 | ttl<<16 | tx_if        (tx_if 0xFFFF == none/-1)
+      4: next_hop
+    proto and pkt_len are invariant through the pipeline (NAT rewrites
+    addresses/ports, never protocol or length), so the tx side reuses
+    the rx ring columns for them — they don't travel back.
+    """
 
     def run(tables, flat, now):
         from jax import lax
 
-        def u32(row):
-            return lax.bitcast_convert_type(row, jnp.uint32)
+        f = lax.bitcast_convert_type(flat, jnp.uint32)
 
-        def i32(arr):
-            return lax.bitcast_convert_type(arr, jnp.int32)
+        def i32(x):
+            return x.astype(jnp.int32)
 
         pv = PacketVector(
-            src_ip=u32(flat[0]), dst_ip=u32(flat[1]), proto=flat[2],
-            sport=flat[3], dport=flat[4], ttl=flat[5], pkt_len=flat[6],
-            rx_if=flat[7], flags=flat[8],
+            src_ip=f[0],
+            dst_ip=f[1],
+            proto=i32((f[3] >> 8) & 0xFF),
+            sport=i32(f[2] >> 16),
+            dport=i32(f[2] & 0xFFFF),
+            ttl=i32(f[3] & 0xFF),
+            pkt_len=i32(f[3] >> 16),
+            rx_if=i32(f[4] >> 8),
+            flags=i32(f[4] & 0xFF),
         )
         res = step(tables, pv, now)
+
+        def u32(x):
+            return x.astype(jnp.uint32)
+
         out = jnp.stack([
-            i32(res.pkts.src_ip), i32(res.pkts.dst_ip), res.pkts.proto,
-            res.pkts.sport, res.pkts.dport, res.pkts.ttl,
-            res.pkts.pkt_len, res.disp, res.tx_if, i32(res.next_hop),
+            res.pkts.src_ip,
+            res.pkts.dst_ip,
+            (u32(res.pkts.sport) << 16) | (u32(res.pkts.dport) & 0xFFFF),
+            (u32(res.disp) << 24)
+            | ((u32(res.pkts.ttl) & 0xFF) << 16)
+            | (u32(res.tx_if) & 0xFFFF),
+            res.next_hop,
         ])
-        return res.tables, out
+        return res.tables, lax.bitcast_convert_type(out, jnp.int32)
 
     return run
 
 
-# row order of the packed result (matches _packed_call's jnp.stack)
-PACKED_OUT_ROWS = (
-    "src_ip", "dst_ip", "proto", "sport", "dport", "ttl", "pkt_len",
-    "disp", "tx_if", "next_hop",
-)
+# packed-boundary shape: [PACKED_IN_ROWS, B] in, [PACKED_OUT_ROWS_N, B] out
+PACKED_IN_ROWS = 5
+PACKED_OUT_ROWS_N = 5
+
+
+def packed_input_zeros(n: int):
+    """An all-invalid packed input batch (flags=0) — the pre-compile /
+    warm-up argument for ``process_packed``."""
+    return np.zeros((PACKED_IN_ROWS, n), np.int32)
+
+
+def pack_packet_columns(fu, cols, n: int, off: int = 0) -> None:
+    """Pack ring columns (native/ring.py PV_COLUMNS views) into a packed
+    input batch. ``fu`` is the uint32 view of a [5, B] int32 batch;
+    writes packets [off, off+n)."""
+    def u(name):
+        return cols[name][:n].view(np.uint32)
+
+    fu[0, off:off + n] = u("src_ip")
+    fu[1, off:off + n] = u("dst_ip")
+    fu[2, off:off + n] = (u("sport") << 16) | (u("dport") & 0xFFFF)
+    fu[3, off:off + n] = (
+        ((u("pkt_len") & 0xFFFF) << 16) | ((u("proto") & 0xFF) << 8)
+        | (u("ttl") & 0xFF)
+    )
+    fu[4, off:off + n] = (u("rx_if") << 8) | (u("flags") & 0xFF)
+
+
+def unpack_packet_input(flat) -> dict:
+    """Host-side inverse of ``pack_packet_columns``: decode a [5, B]
+    packed input batch back into named PacketVector column arrays (the
+    pump's tracing path runs the unpacked step from these)."""
+    fu = flat.view(np.uint32)
+    return {
+        "src_ip": fu[0],
+        "dst_ip": fu[1],
+        "proto": ((fu[3] >> 8) & 0xFF).astype(np.int32),
+        "sport": (fu[2] >> 16).astype(np.int32),
+        "dport": (fu[2] & 0xFFFF).astype(np.int32),
+        "ttl": (fu[3] & 0xFF).astype(np.int32),
+        "pkt_len": (fu[3] >> 16).astype(np.int32),
+        "rx_if": (fu[4] >> 8).astype(np.int32),
+        "flags": (fu[4] & 0xFF).astype(np.int32),
+    }
+
+
+def unpack_packet_result(out) -> dict:
+    """Decode a fetched [5, B] packed result into named host arrays.
+    ``out`` must be a writable int32 array (np.array of the device_get).
+    tx_if 0xFFFF decodes to -1 (no egress interface)."""
+    assert out.shape[0] == PACKED_OUT_ROWS_N, out.shape
+    ou = out.view(np.uint32)
+    row3 = ou[3]
+    tx_if = (row3 & 0xFFFF).astype(np.int32)
+    tx_if[tx_if == 0xFFFF] = -1
+    return {
+        "src_ip": ou[0],
+        "dst_ip": ou[1],
+        "sport": (ou[2] >> 16).astype(np.int32),
+        "dport": (ou[2] & 0xFFFF).astype(np.int32),
+        "ttl": ((row3 >> 16) & 0xFF).astype(np.int32),
+        "disp": (row3 >> 24).astype(np.int32),
+        "tx_if": tx_if,
+        "next_hop": ou[4],
+    }
 
 
 class Dataplane:
@@ -312,10 +405,12 @@ class Dataplane:
 
     def process_packed(self, flat, now: Optional[int] = None):
         """Single-transfer variant of process() for the pump's hot path:
-        ``flat`` is a host [9, B] int32 array (PacketVector columns,
-        uint32 fields bitcast); returns the DEVICE [10, B] int32 result
-        (PACKED_OUT_ROWS) without forcing a host sync — the caller
-        device_gets it when ready. One upload, one fetch per batch."""
+        ``flat`` is a host [5, B] int32 bit-packed batch (see
+        ``_packed_call`` for the row layout; build with
+        ``pack_packet_columns`` / ``packed_input_zeros``); returns the
+        DEVICE [5, B] int32 packed result without forcing a host sync —
+        the caller device_gets it when ready. One upload, one fetch per
+        batch, 20 bytes per packet each way."""
         with self._lock:
             if self.tables is None:
                 raise RuntimeError(
