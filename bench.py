@@ -395,11 +395,6 @@ def io_ring_bench(args, frame_pkts: int = 256,
     codec = PacketCodec(snap=rings.rx.snap)
     scratch = np.zeros((VEC, rings.rx.snap), np.uint8)
 
-    # compile both pump bucket shapes before measuring
-    for bucket in (VEC, max_batch):
-        _jax.block_until_ready(
-            dp.process_packed(packed_input_zeros(bucket))
-        )
 
     # transport bandwidth floor: the packed boundary is 20 B/packet
     # each way, so host↔device bandwidth IS the wire-path ceiling on a
@@ -422,7 +417,13 @@ def io_ring_bench(args, frame_pkts: int = 256,
     ceiling_mpps = min(up_mbps, down_mbps) / bytes_per_pkt
 
     pump = DataplanePump(dp, rings, max_batch=max_batch,
-                         workers=workers).start()
+                         workers=workers)
+    # compile every dispatch bucket rung before measuring
+    for bucket in pump.bucket_sizes():
+        _jax.block_until_ready(
+            dp.process_packed(packed_input_zeros(bucket))
+        )
+    pump.start()
 
     # warm-up barrier: push one frame through the full ring→device→ring
     # path and wait for it to drain, so the measured phases never pay
@@ -548,6 +549,182 @@ def io_ring_bench(args, frame_pkts: int = 256,
         rings.close()
 
 
+def hoststack_bench(args, duration_s: float = 2.5) -> dict:
+    """RPS/CPS under policy — the reference's wrk perf harness analog
+    (tests/policy/perf/RPS.sh, CPS.sh: 50 connections, keep-alive vs
+    Connection: close) over the VCL session-filtered host stack.
+
+    A server app namespace answers a minimal request/response protocol
+    on loopback; a client namespace drives it with the session-rule
+    engine packed to a gen-policy.py-shaped 1000-rule set. Session
+    rules filter connection SETUP (VPP session-layer semantics), so RPS
+    measures the steady state while CPS pays an admission check per
+    wave — client connects ride connect_batch (one engine batch per
+    wave), server accepts are admission-checked in waves too. Also
+    reports the engine's raw batched admission capacity, the device
+    ceiling on CPS."""
+    import socket as socket_mod
+    import threading
+
+    from vpp_tpu.hoststack.session_rules import (
+        GLOBAL_NS,
+        RuleAction,
+        RuleScope,
+        SessionRule,
+        SessionRuleEngine,
+    )
+    from vpp_tpu.hoststack.vcl import HostStackApp, _ip_int
+
+    LOOP = _ip_int("127.0.0.1")
+    engine = SessionRuleEngine(capacity=2048)
+
+    # gen-policy-shaped filler: 1000 CIDR x port rules (5:1 permit:deny)
+    filler = []
+    for i in range(996):
+        net = ((10 << 24) | ((i // 250) << 16) | ((i % 250) << 8))
+        filler.append(SessionRule(
+            scope=int(RuleScope.LOCAL), appns_index=1, transport_proto=6,
+            lcl_net=0, lcl_plen=0, rmt_net=net, rmt_plen=24,
+            lcl_port=0, rmt_port=8000 + i % 20,
+            action=int(RuleAction.DENY if i % 6 == 5 else RuleAction.ALLOW),
+        ))
+    engine.apply(add=filler)
+
+    srv_sock = socket_mod.socket()
+    srv_sock.bind(("127.0.0.1", 0))
+    srv_sock.listen(256)
+    port = srv_sock.getsockname()[1]
+
+    # specific admits over default-deny in BOTH scopes, so the connect
+    # check (LOCAL) and the accept check (GLOBAL) each decide something
+    # real — the engine default-allows unmatched connections, so the
+    # deny-alls are what make the allows load-bearing
+    engine.apply(add=[
+        SessionRule(scope=int(RuleScope.LOCAL), appns_index=1,
+                    transport_proto=6, lcl_net=0, lcl_plen=0,
+                    rmt_net=LOOP, rmt_plen=32, lcl_port=0, rmt_port=port,
+                    action=int(RuleAction.ALLOW)),
+        SessionRule(scope=int(RuleScope.LOCAL), appns_index=1,
+                    transport_proto=6, lcl_net=0, lcl_plen=0,
+                    rmt_net=0, rmt_plen=0, lcl_port=0, rmt_port=0,
+                    action=int(RuleAction.DENY)),
+        SessionRule(scope=int(RuleScope.GLOBAL), appns_index=-1,
+                    transport_proto=6, lcl_net=LOOP, lcl_plen=32,
+                    rmt_net=0, rmt_plen=0, lcl_port=port, rmt_port=0,
+                    action=int(RuleAction.ALLOW)),
+        SessionRule(scope=int(RuleScope.GLOBAL), appns_index=-1,
+                    transport_proto=6, lcl_net=0, lcl_plen=0,
+                    rmt_net=0, rmt_plen=0, lcl_port=0, rmt_port=0,
+                    action=int(RuleAction.DENY)),
+    ])
+
+    client = HostStackApp(engine, appns_index=1)
+    stop = threading.Event()
+
+    def serve_conn(conn):
+        try:
+            while True:
+                req = conn.recv(64)
+                if not req:
+                    return
+                conn.sendall(b"HTTP/1.1 200 OK\r\n\r\nok")
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def acceptor():
+        """Wave admission: drain pending OS accepts, one engine batch
+        per wave (VPP filters inbound sessions in its session tables;
+        waves are the batched form). Wait briefly for the FIRST
+        connection only, then drain non-blocking — a wave must never
+        stall on a timeout waiting for a member that isn't coming (that
+        stall becomes the measured CPS)."""
+        while not stop.is_set():
+            wave = []
+            try:
+                srv_sock.settimeout(0.01)
+                wave.append(srv_sock.accept())
+                srv_sock.setblocking(False)
+                while len(wave) < 64:
+                    try:
+                        wave.append(srv_sock.accept())
+                    except (BlockingIOError, OSError):
+                        break
+            except (TimeoutError, socket_mod.timeout):
+                pass
+            except OSError:
+                return
+            if not wave:
+                continue
+            verdicts = engine.check_accept([
+                (6, LOOP, port, _ip_int(p[0]), p[1]) for _, p in wave
+            ])
+            for ok, (conn, _) in zip(verdicts, wave):
+                if ok:
+                    threading.Thread(target=serve_conn, args=(conn,),
+                                     daemon=True).start()
+                else:
+                    conn.close()
+
+    acc = threading.Thread(target=acceptor, daemon=True)
+    acc.start()
+    out = {"hoststack_rules": engine.num_rules}
+    try:
+        # --- RPS: 50 persistent session-admitted connections ---
+        conns = [c for c in client.connect_batch(
+            [("127.0.0.1", port)] * 50) if c is not None]
+        if len(conns) != 50:
+            raise RuntimeError(f"admission failed: {len(conns)}/50")
+        for c in conns:
+            c.settimeout(10)
+        reqs = 0
+        deadline = time.perf_counter() + duration_s
+        t0 = time.perf_counter()
+        while time.perf_counter() < deadline:
+            c = conns[reqs % 50]
+            c.send(b"GET / HTTP/1.1\r\n\r\n")
+            if not c.recv(64):
+                raise RuntimeError("server closed mid-RPS")
+            reqs += 1
+        out["hoststack_rps"] = round(reqs / (time.perf_counter() - t0), 1)
+        for c in conns:
+            c.close()
+
+        # --- CPS: connect+request+close, 32-wide admission waves ---
+        done = 0
+        deadline = time.perf_counter() + duration_s
+        t0 = time.perf_counter()
+        while time.perf_counter() < deadline:
+            wave = [c for c in client.connect_batch(
+                [("127.0.0.1", port)] * 32) if c is not None]
+            for c in wave:
+                c.settimeout(10)
+                c.send(b"GET / HTTP/1.1\r\nConnection: close\r\n\r\n")
+                if c.recv(64):
+                    done += 1
+                c.close()
+        out["hoststack_cps"] = round(done / (time.perf_counter() - t0), 1)
+
+        # --- raw admission capacity: 4096-conn batched checks ---
+        rng = np.random.default_rng(5)
+        batch = [(1, 6, 0, 0, int(x), 8000 + int(x) % 20)
+                 for x in rng.integers(10 << 24, (10 << 24) + (1 << 20),
+                                       4096)]
+        engine.check_connect(batch)  # compile/warm
+        t0 = time.perf_counter()
+        iters = 20
+        for _ in range(iters):
+            engine.check_connect(batch)
+        out["session_admission_ksps"] = round(
+            4096 * iters / (time.perf_counter() - t0) / 1e3, 1
+        )
+        return out
+    finally:
+        stop.set()
+        srv_sock.close()
+
+
 def io_daemon_bench(args, duration_s: float = 5.0) -> dict:
     """Real-packet throughput through the FULL node data path: kernel
     veth → AF_PACKET → IO daemon (recvmmsg batch rx) → rx ring →
@@ -593,10 +770,6 @@ def io_daemon_bench(args, duration_s: float = 5.0) -> dict:
         if_b = dp.add_pod_interface(("default", "b"))
         dp.builder.add_route("10.1.1.3/32", if_b, Disposition.LOCAL)
         dp.swap()
-        for bucket in (VEC, 16384):
-            _jax.block_until_ready(
-                dp.process_packed(packed_input_zeros(bucket))
-            )
 
         rings = IORingPair(n_slots=256, snap=512)
         daemon = IODaemon(
@@ -605,7 +778,12 @@ def io_daemon_bench(args, duration_s: float = 5.0) -> dict:
              if_b: AfPacketTransport("vppbnB0")},
             uplink_if=0,
         ).start()
-        pump = DataplanePump(dp, rings, max_batch=16384, workers=8).start()
+        pump = DataplanePump(dp, rings, max_batch=16384, workers=8)
+        for bucket in pump.bucket_sizes():
+            _jax.block_until_ready(
+                dp.process_packed(packed_input_zeros(bucket))
+            )
+        pump.start()
 
         # warm-up barrier: one real packet through veth → daemon →
         # device → daemon before the measured window, so the window
@@ -870,6 +1048,10 @@ def _run():
             subs.update(io_daemon_bench(args))
         except Exception as e:  # noqa: BLE001 — optional, env-dependent
             subs["io_daemon_bench_error"] = f"{type(e).__name__}: {e}"
+        try:
+            subs.update(hoststack_bench(args))
+        except Exception as e:  # noqa: BLE001 — optional, env-dependent
+            subs["hoststack_bench_error"] = f"{type(e).__name__}: {e}"
     subs.update(commit_bench(args))
     # the honest experienced figure: ring-to-ring wire-path latency at
     # a paced (non-saturating) offered load, NOT pipelined-throughput/N

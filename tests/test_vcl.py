@@ -127,3 +127,40 @@ def test_denied_accept_closes_peer_and_keeps_listening():
     assert results and results[0][0] == "accepted"
     c2.close()
     srv.close()
+
+
+def test_connect_batch_mixed_verdicts():
+    """One engine batch admits a whole wave of connects; denied
+    addresses come back as None without touching the server."""
+    engine = SessionRuleEngine(capacity=64)
+    server_app = HostStackApp(engine, appns_index=2)
+    client_app = HostStackApp(engine, appns_index=1)
+
+    srv = server_app.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen()
+    port = srv.getsockname()[1]
+    # deny a port nobody listens on; the live port stays allowed
+    engine.apply(add=[deny_connect_rule(ns=1, rmt_port=port + 1)])
+
+    served = []
+
+    def serve():
+        try:
+            while True:
+                conn, _ = srv.accept()
+                served.append(conn)
+        except OSError:
+            pass
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+
+    wave = [("127.0.0.1", port), ("127.0.0.1", port + 1),
+            ("127.0.0.1", port), ("127.0.0.1", port + 1)]
+    socks = client_app.connect_batch(wave)
+    assert [s is not None for s in socks] == [True, False, True, False]
+    for s in socks:
+        if s is not None:
+            s.close()
+    srv.close()

@@ -70,6 +70,19 @@ class DataplanePump:
         self.rings = rings
         self.poll_s = poll_s
         self.max_batch = max(VEC, int(max_batch))
+        # geometric bucket ladder VEC, 4·VEC, 16·VEC, … up to max_batch:
+        # a partial backlog pads to the next bucket, not straight to
+        # max_batch — padding is wasted boundary bytes (a 10-frame
+        # backlog padded to 16384 uploads 6× the useful data), and on a
+        # transfer-limited transport that waste IS lost throughput.
+        # Cost: one extra jit compile per rung (precompile via
+        # ``bucket_sizes()``).
+        self.buckets = []
+        b = VEC
+        while b < self.max_batch:
+            self.buckets.append(b)
+            b *= 4
+        self.buckets.append(self.max_batch)
         self.workers = max(1, int(workers))
         self.stats = {
             "frames": 0, "pkts": 0, "batches": 0, "tx_ring_full": 0,
@@ -94,6 +107,11 @@ class DataplanePump:
         self._held = 0
         self._stop = threading.Event()
         self._threads: list = []
+
+    def bucket_sizes(self) -> list:
+        """The dispatch bucket ladder — precompile ``process_packed``
+        at each of these batch sizes before offering traffic."""
+        return list(self.buckets)
 
     # --- lifecycle ---
     def start(self) -> "DataplanePump":
@@ -163,11 +181,11 @@ class DataplanePump:
 
     def _dispatch(self, frames: list) -> None:
         total = sum(f.n for f in frames)
-        # two jit shapes only (a compile costs 20-40 s on TPU): a single
-        # frame dispatches at VEC for latency; any backlog pads to
-        # max_batch — the step's device cost is dominated by fixed
-        # overhead, so padding is cheaper than extra compiles
-        bucket = VEC if total <= VEC else self.max_batch
+        # pad to the smallest ladder bucket that fits (a compile costs
+        # 20-40 s on TPU, so the ladder is geometric, not per-size): a
+        # single frame dispatches at VEC for latency; larger backlogs
+        # climb the rungs instead of jumping straight to max_batch
+        bucket = next(b for b in self.buckets if b >= total)
         # one [5, bucket] int32 bit-packed block: a single host→device
         # transfer of 20 B/packet (dataplane.pack_packet_columns layout)
         flat = np.zeros((PACKED_IN_ROWS, bucket), np.int32)
