@@ -1,0 +1,26 @@
+# CI analog of the reference's Makefile (Makefile:44-70: per-package
+# unit tests, -race variants, lint) for a no-external-deps environment.
+
+PY ?= python
+
+.PHONY: test test-race lint verify bench all
+
+all: lint test
+
+test:
+	$(PY) -m pytest tests/ -q
+
+# Race-amplified run: tests/conftest.py lowers the interpreter's thread
+# switch interval to force frequent preemption at the concurrency seams
+# (the Go -race analog available to pure Python — races surface as
+# corrupted state/assertions in the stress tests rather than reports).
+test-race:
+	VPP_TPU_RACE=1 $(PY) -m pytest tests/test_concurrency.py tests/test_io.py \
+		tests/test_native_ring.py tests/test_kvserver.py -q
+
+lint:
+	$(PY) tools/lint.py
+
+# Driver-facing headline benchmark (real TPU; one JSON line).
+bench:
+	$(PY) bench.py

@@ -20,3 +20,11 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# `make test-race`: amplify thread interleavings by forcing preemption
+# every few microseconds (default 5 ms) — the Go `-race` analog for the
+# concurrency stress tests; races surface as corrupted ring/table state.
+if os.environ.get("VPP_TPU_RACE"):
+    import sys
+
+    sys.setswitchinterval(5e-6)

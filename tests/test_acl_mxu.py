@@ -28,7 +28,6 @@ from vpp_tpu.pipeline.tables import DataplaneConfig, pack_rules
 from vpp_tpu.pipeline.vector import (
     Disposition,
     PacketVector,
-    ip4,
     make_packet_vector,
 )
 

@@ -7,7 +7,6 @@ against a tracked backend) + cmd/contiv-cni/contiv_cni_test.go.
 
 import json
 
-import pytest
 
 from vpp_tpu.cni import (
     CNIRequest,
@@ -21,7 +20,7 @@ from vpp_tpu.ipam.ipam import IPAM
 from vpp_tpu.kvstore.store import Broker, KVStore
 from vpp_tpu.pipeline.dataplane import Dataplane
 from vpp_tpu.pipeline.tables import DataplaneConfig
-from vpp_tpu.pipeline.vector import Disposition, ip4, make_packet_vector
+from vpp_tpu.pipeline.vector import Disposition, make_packet_vector
 
 
 def make_server(store=None):

@@ -114,7 +114,6 @@ def test_concurrent_swaps_and_processing_consistent_epochs():
     """Packets processed during continuous table swaps must always see a
     complete epoch: with rule sets {permit-all} and {deny-all} flipping,
     a frame's verdicts must be all-permit or all-deny, never mixed."""
-    import ipaddress
 
     from vpp_tpu.ir import Action, ContivRule
     from vpp_tpu.pipeline.dataplane import Dataplane

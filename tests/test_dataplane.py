@@ -11,7 +11,6 @@ import random
 
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from vpp_tpu.ir import Action, ContivRule, Protocol
 from vpp_tpu.ops.acl import acl_classify_local

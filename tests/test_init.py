@@ -6,7 +6,6 @@ processes."""
 from __future__ import annotations
 
 import json
-import os
 import threading
 import time
 

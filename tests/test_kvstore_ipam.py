@@ -4,7 +4,6 @@ Mirrors reference tests: plugins/contiv/ipam/ipam_test.go (arithmetic +
 allocation), persist_test.go (reload), kvdbproxy tests (self-echo skip).
 """
 
-import ipaddress
 
 import pytest
 

@@ -21,7 +21,6 @@ import subprocess
 import sys
 import time
 
-import numpy as np
 import pytest
 
 from vpp_tpu.cni.model import CNIRequest, ResultCode

@@ -7,7 +7,6 @@ tables → jitted verdicts) and compared against a direct pure-Python
 oracle evaluating K8s NetworkPolicy semantics.
 """
 
-import ipaddress
 import random
 
 import pytest

@@ -5,7 +5,6 @@ This is the TPU analog of the reference's acl_renderer_test.go driven
 through mock/aclengine: assertions are *connectivity semantics*.
 """
 
-import jax.numpy as jnp
 
 from vpp_tpu.ir.rule import PodID
 from vpp_tpu.ksr import model as m

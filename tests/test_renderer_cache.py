@@ -7,7 +7,7 @@ Scenario style mirrors the reference's renderer/cache/cache_test.go
 import ipaddress
 
 from vpp_tpu.ir import Action, ContivRule, PodID, Protocol
-from vpp_tpu.ir.table import GLOBAL_TABLE_ID, TableType
+from vpp_tpu.ir.table import TableType
 from vpp_tpu.renderer.api import PodConfig
 from vpp_tpu.renderer.cache import Orientation, RendererCache
 

@@ -6,14 +6,13 @@ TCP pod↔pod, pod↔host, cross-node connectivity and policy cases, run
 here as in-process scenarios against real agents over a shared store.
 """
 
-import numpy as np
 
 from vpp_tpu.cmd import AgentConfig, ContivAgent
 from vpp_tpu.cmd.ksr_main import KsrAgent
 from vpp_tpu.cni.model import CNIRequest
 from vpp_tpu.ksr import model as m
 from vpp_tpu.kvstore.store import KVStore
-from vpp_tpu.pipeline.vector import Disposition, ip4, make_packet_vector
+from vpp_tpu.pipeline.vector import Disposition, make_packet_vector
 
 
 def boot(node_name="node-a", store=None):

@@ -12,9 +12,7 @@ Reference analog: VPP session/NAT timers + acl-plugin session counters
 from __future__ import annotations
 
 import numpy as np
-import pytest
 
-import jax.numpy as jnp
 
 from vpp_tpu.ir.rule import Action, ContivRule, Protocol
 from vpp_tpu.pipeline.dataplane import Dataplane

@@ -199,13 +199,11 @@ def test_snat_port_collision_fails_closed():
     import numpy as np
 
     from vpp_tpu.ops.nat44 import _flow_hash
-    from vpp_tpu.pipeline.vector import FLAG_VALID, PacketVector
 
     b = snat_builder()
     t = b.to_device()
     # find two (sport) values from different pods that hash to the same
     # allocated port toward the same external endpoint
-    import jax.numpy as jnpp
 
     pod2 = "10.1.1.3"
     b2 = snat_builder()

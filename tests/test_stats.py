@@ -7,16 +7,15 @@ labels) and the KSR gauge surface (ksr_statscollector.go).
 
 import urllib.request
 
-import numpy as np
 
-from vpp_tpu.cni import ContainerIndex, RemoteCNIServer, ResultCode
+from vpp_tpu.cni import ContainerIndex, RemoteCNIServer
 from vpp_tpu.cni.model import CNIRequest
 from vpp_tpu.ipam.ipam import IPAM
 from vpp_tpu.ksr.reflector import ReflectorRegistry, Reflector, MockK8sListWatch
 from vpp_tpu.kvstore.store import Broker, KVStore
 from vpp_tpu.pipeline.dataplane import Dataplane
 from vpp_tpu.pipeline.tables import DataplaneConfig
-from vpp_tpu.pipeline.vector import Disposition, make_packet_vector
+from vpp_tpu.pipeline.vector import make_packet_vector
 from vpp_tpu.stats import Gauge, MetricsRegistry, StatsCollector, StatsHTTPServer
 from vpp_tpu.stats.collector import STATS_PATH, register_ksr_gauges
 

@@ -32,7 +32,7 @@ import pytest
 
 from vpp_tpu.cni.transport import cni_call
 from vpp_tpu.cni.wiring import host_ifname
-from vpp_tpu.cmd.config import AgentConfig, IOConfig
+from vpp_tpu.cmd.config import AgentConfig
 from vpp_tpu.cmd.init_main import InitSupervisor
 from vpp_tpu.ksr import model as m
 from vpp_tpu.kvstore.client import RemoteKVStore

@@ -6,7 +6,6 @@ from __future__ import annotations
 
 import ipaddress
 
-import numpy as np
 import pytest
 
 from vpp_tpu.ir.rule import Action, ContivRule, Protocol

@@ -10,10 +10,8 @@ outside the node. Batched minimal deltas + resync reconciliation.
 
 import ipaddress
 
-import numpy as np
 
 from vpp_tpu.hoststack import (
-    ConnDirection,
     RuleAction,
     RuleScope,
     SessionRule,

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Minimal in-tree linter (the `go fmt`/`golint` analog of the
+reference's CI — README.md:36-40, docker/development Dockerfile.metalinter
+— rebuilt for a no-external-deps environment).
+
+Checks, per file:
+  * the file parses (SyntaxError == fail)
+  * unused imports (module scope; names re-exported via __all__ or
+    marked `# noqa: unused` are exempt)
+  * `except:` bare except clauses
+  * tabs in indentation and trailing whitespace
+  * mutable default arguments (def f(x=[]) / {} / set())
+
+Exit code 1 if anything fires. Run via `make lint`.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+ROOTS = ("vpp_tpu", "tests", "bench.py", "__graft_entry__.py", "tools")
+
+
+class ImportCollector(ast.NodeVisitor):
+    def __init__(self):
+        self.imports: dict = {}   # name -> (lineno, stmt text)
+        self.used: set = set()
+        self.exported: set = set()
+
+    def visit_Import(self, node):
+        for a in node.names:
+            name = (a.asname or a.name).split(".")[0]
+            self.imports[name] = node.lineno
+
+    def visit_ImportFrom(self, node):
+        if node.module == "__future__":
+            return
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.imports[a.asname or a.name] = node.lineno
+
+    def visit_Name(self, node):
+        self.used.add(node.id)
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+
+    def visit_Assign(self, node):
+        # __all__ = [...] re-exports
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id == "__all__":
+                try:
+                    self.exported |= set(ast.literal_eval(node.value))
+                except ValueError:
+                    pass
+        self.generic_visit(node)
+
+
+def lint_file(path: Path) -> list:
+    problems = []
+    src = path.read_text()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
+
+    lines = src.splitlines()
+    noqa = {i + 1 for i, ln in enumerate(lines) if "# noqa" in ln}
+
+    for i, ln in enumerate(lines, 1):
+        if ln.rstrip() != ln and ln.strip():
+            problems.append(f"{path}:{i}: trailing whitespace")
+        if ln.startswith("\t"):
+            problems.append(f"{path}:{i}: tab indentation")
+
+    col = ImportCollector()
+    col.visit(tree)
+    # exemptions: used as a Name anywhere, re-exported via __all__,
+    # `# noqa` on the import line, or a leading-underscore alias
+    for name, lineno in col.imports.items():
+        if name in col.used or name in col.exported or lineno in noqa:
+            continue
+        if name.startswith("_"):
+            continue
+        problems.append(f"{path}:{lineno}: unused import '{name}'")
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            if node.lineno not in noqa:
+                problems.append(f"{path}:{node.lineno}: bare 'except:'")
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in node.args.defaults + node.args.kw_defaults:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    problems.append(
+                        f"{path}:{node.lineno}: mutable default argument "
+                        f"in '{node.name}'"
+                    )
+        if isinstance(node, ast.Compare):
+            for cmp_op, val in zip(node.ops, node.comparators):
+                if isinstance(cmp_op, (ast.Eq, ast.NotEq)) and \
+                        isinstance(val, ast.Constant) and \
+                        any(val.value is c for c in (True, False, None)):
+                    if node.lineno not in noqa:
+                        problems.append(
+                            f"{path}:{node.lineno}: comparison to "
+                            f"{val.value!r} — use 'is'/'is not'/truthiness"
+                        )
+    return problems
+
+
+def main() -> int:
+    repo = Path(__file__).resolve().parent.parent
+    files = []
+    for root in ROOTS:
+        p = repo / root
+        if p.is_file():
+            files.append(p)
+        else:
+            files.extend(sorted(p.rglob("*.py")))
+    all_problems = []
+    for f in files:
+        if "__pycache__" in str(f):
+            continue
+        all_problems.extend(lint_file(f))
+    for p in all_problems:
+        print(p)
+    print(f"lint: {len(files)} files, {len(all_problems)} problems")
+    return 1 if all_problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

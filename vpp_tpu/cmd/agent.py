@@ -20,8 +20,6 @@ import threading
 import time
 from typing import Optional
 
-import jax
-
 from vpp_tpu.agent import node_id as node_id_mod
 from vpp_tpu.agent.node_id import NodeIDAllocator
 from vpp_tpu.cni.containeridx import ContainerIndex
@@ -232,20 +230,12 @@ class ContivAgent:
                 max_batch=c.io.max_batch, depth=c.io.depth,
                 workers=c.io.workers,
             )
-            # warm every dispatch bucket rung before serving: a rung's
-            # first jit compile costs 20-40 s on TPU, and lazily paying
-            # that inside the dispatch thread would stall the rx rings
-            # (and drop live traffic) the first time a backlog of each
-            # size shows up
-            from vpp_tpu.pipeline.dataplane import packed_input_zeros
-
+            # warm every dispatch bucket rung before serving — a lazy
+            # mid-traffic rung compile would stall the rx rings
             t0 = time.monotonic()
-            for bucket in self.io_pump.bucket_sizes():
-                jax.block_until_ready(
-                    self.dataplane.process_packed(packed_input_zeros(bucket))
-                )
+            rungs = self.io_pump.warm()
             log.info("pump dispatch rungs %s warmed in %.1fs",
-                     self.io_pump.bucket_sizes(), time.monotonic() - t0)
+                     rungs, time.monotonic() - t0)
             self.io_pump.start()
             if c.io.plan_path:
                 self._write_io_plan()

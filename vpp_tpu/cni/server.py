@@ -18,7 +18,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import threading
-from typing import Callable, List, Optional
+from typing import Callable, Optional
 
 from vpp_tpu.cni.containeridx import ContainerConfig, ContainerIndex
 from vpp_tpu.cni.model import (

@@ -132,7 +132,10 @@ class HostStackApp:
         a time, tests/policy/perf/RPS.sh).
 
         Returns a list parallel to ``addresses``: a connected
-        FilteredSocket where allowed, None where policy denied."""
+        FilteredSocket where allowed, None where policy denied. An
+        OS-level connect failure is NOT a policy verdict: it closes the
+        whole wave and re-raises, mirroring the single-connect path's
+        PolicyDenied-vs-OSError separation."""
         socks = [FilteredSocket(self, proto) for _ in addresses]
         conns = []
         for s, (ip, port) in zip(socks, addresses):
@@ -141,15 +144,19 @@ class HostStackApp:
                           lcl_port, _ip_int(ip), port))
         allowed = self.engine.check_connect(conns)
         out = []
-        for ok, s, addr in zip(allowed, socks, addresses):
-            if ok:
-                try:
+        try:
+            for ok, s, addr in zip(allowed, socks, addresses):
+                if ok:
                     s.sock.connect(addr)
                     out.append(s)
-                except OSError:
+                else:
                     s.close()
                     out.append(None)
-            else:
+        except OSError:
+            for s in out:
+                if s is not None:
+                    s.close()
+            for s in socks[len(out):]:
                 s.close()
-                out.append(None)
+            raise
         return out

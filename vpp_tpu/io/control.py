@@ -23,9 +23,7 @@ shared.
 
 from __future__ import annotations
 
-import json
 import logging
-import socket
 from typing import Optional
 
 from vpp_tpu.cni.transport import CNITransportServer, cni_call

@@ -113,6 +113,21 @@ class DataplanePump:
         at each of these batch sizes before offering traffic."""
         return list(self.buckets)
 
+    def warm(self) -> list:
+        """Compile every dispatch bucket rung (blocking). Call before
+        ``start()``/before offering traffic: a rung's first jit compile
+        costs 20-40 s on TPU, and paying it lazily inside the dispatch
+        thread stalls the rx rings and drops live traffic."""
+        import jax
+
+        from vpp_tpu.pipeline.dataplane import packed_input_zeros
+
+        for bucket in self.buckets:
+            jax.block_until_ready(
+                self.dp.process_packed(packed_input_zeros(bucket))
+            )
+        return list(self.buckets)
+
     # --- lifecycle ---
     def start(self) -> "DataplanePump":
         names = [(self._dispatch_loop, "dp-pump-dispatch"),

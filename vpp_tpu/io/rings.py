@@ -16,7 +16,7 @@ from typing import Dict, NamedTuple, Optional, Tuple
 
 import numpy as np
 
-from vpp_tpu.native.ring import RING_COLUMNS, FrameRing
+from vpp_tpu.native.ring import FrameRing
 
 VEC = 256
 DEFAULT_SNAP = 2048

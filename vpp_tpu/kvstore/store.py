@@ -17,7 +17,7 @@ import json
 import os
 import threading
 import time as _time
-from typing import Any, Callable, Dict, Iterable, List, NamedTuple, Optional, Tuple
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
 
 
 class Op(enum.Enum):

@@ -18,7 +18,7 @@ same first-match as a bf16 bit-plane matmul for the 10k-rule regime.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple
 
 import jax.numpy as jnp
 

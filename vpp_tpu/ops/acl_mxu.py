@@ -30,7 +30,7 @@ exact-port, so the 10k-rule north-star regime is MXU-served).
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Tuple
+from typing import NamedTuple
 
 import numpy as np
 

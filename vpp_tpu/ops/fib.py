@@ -16,7 +16,7 @@ from typing import NamedTuple
 import jax.numpy as jnp
 
 from vpp_tpu.pipeline.tables import DataplaneTables
-from vpp_tpu.pipeline.vector import Disposition, PacketVector
+from vpp_tpu.pipeline.vector import Disposition
 
 
 class FibResult(NamedTuple):

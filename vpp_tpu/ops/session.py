@@ -15,7 +15,7 @@ VPP worker interrupt, SURVEY.md §5).
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import Tuple
 
 import jax.numpy as jnp
 

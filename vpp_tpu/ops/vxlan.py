@@ -24,7 +24,6 @@ import struct
 from typing import NamedTuple, Tuple
 
 import jax.numpy as jnp
-import numpy as np
 
 from vpp_tpu.pipeline.vector import FLAG_VALID, PacketVector
 

@@ -16,7 +16,7 @@ import threading
 import time as _time
 
 import numpy as np
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp

@@ -12,7 +12,7 @@ statscollector (Prometheus) equivalent.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp

@@ -18,13 +18,13 @@ from __future__ import annotations
 import enum
 import ipaddress
 import logging
-from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+from typing import Dict, NamedTuple, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
 
-from vpp_tpu.ir.rule import ANY_PORT, Action, ContivRule, Protocol
-from vpp_tpu.pipeline.vector import Disposition, ip4
+from vpp_tpu.ir.rule import ANY_PORT, ContivRule
+from vpp_tpu.pipeline.vector import Disposition
 
 log = logging.getLogger("vpp_tpu.tables")
 

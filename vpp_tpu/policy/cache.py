@@ -11,7 +11,7 @@ Reference: plugins/policy/cache ({cache_api,data_change,data_resync}.go
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional, Set
+from typing import Dict, List, Optional
 
 from vpp_tpu.ir.rule import PodID
 from vpp_tpu.ksr import model as m

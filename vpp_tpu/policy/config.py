@@ -11,7 +11,6 @@ Reference: plugins/policy/configurator/configurator_api.go:41-160.
 from __future__ import annotations
 
 import enum
-import ipaddress
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 

@@ -14,7 +14,6 @@ Reference: plugins/policy/configurator/configurator_impl.go.
 
 from __future__ import annotations
 
-import ipaddress
 from typing import Dict, List, Optional, Tuple
 
 from vpp_tpu.ir.rule import (
@@ -28,7 +27,7 @@ from vpp_tpu.ir.rule import (
     one_host_subnet,
 )
 from vpp_tpu.policy.cache import PolicyCache
-from vpp_tpu.policy.config import ContivPolicy, Match, MatchType, PolicyType, Protocol
+from vpp_tpu.policy.config import ContivPolicy, MatchType, PolicyType
 from vpp_tpu.renderer.api import PolicyRendererAPI
 
 

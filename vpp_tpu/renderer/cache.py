@@ -20,7 +20,6 @@ in Python (sorted lists + dict indexes instead of Go slices/maps).
 from __future__ import annotations
 
 import enum
-import ipaddress
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 

@@ -23,7 +23,7 @@ from __future__ import annotations
 from typing import List, Optional
 
 from vpp_tpu.ir.rule import ContivRule, IPNetwork, PodID
-from vpp_tpu.ir.table import GLOBAL_TABLE_ID, TableType
+from vpp_tpu.ir.table import TableType
 from vpp_tpu.pipeline.dataplane import Dataplane
 from vpp_tpu.renderer.api import PodConfig, PolicyRendererAPI, RendererTxn
 from vpp_tpu.renderer.cache import Orientation, RendererCache

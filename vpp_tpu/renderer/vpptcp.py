@@ -19,7 +19,7 @@ and ``src_*`` the remote initiator.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional, Set
+from typing import Callable, List, Optional, Set
 
 from vpp_tpu.hoststack.session_rules import (
     GLOBAL_NS,

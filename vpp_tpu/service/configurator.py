@@ -22,7 +22,6 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
-import numpy as np
 
 from vpp_tpu.pipeline.dataplane import Dataplane
 from vpp_tpu.pipeline.vector import ip4

@@ -10,7 +10,7 @@ from __future__ import annotations
 import http.server
 import threading
 import urllib.parse
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 LabelSet = Tuple[Tuple[str, str], ...]
 

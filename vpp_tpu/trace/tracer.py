@@ -13,15 +13,11 @@ from __future__ import annotations
 import dataclasses
 import threading
 from collections import deque
-from typing import Deque, List, Optional
+from typing import Deque, List
 
 import numpy as np
 
-from vpp_tpu.pipeline.graph import (
-    DROP_CAUSE_NAMES,
-    DROP_NONE,
-    StepResult,
-)
+from vpp_tpu.pipeline.graph import DROP_CAUSE_NAMES, StepResult
 from vpp_tpu.pipeline.vector import Disposition, ip4_str
 
 
