@@ -163,6 +163,9 @@ class TestReconnect:
             resyncs = queue.Queue()
             c.watch("ksr/", events.put,
                     on_resync=lambda snap, rev: resyncs.put((snap, rev)))
+            # registration itself delivers the first (empty) snapshot
+            snap0, _ = resyncs.get(timeout=5)
+            assert snap0 == {}
             store.put("ksr/a", 1)
             assert events.get(timeout=5).key == "ksr/a"
 
@@ -340,3 +343,185 @@ class TestCrashSafety:
             v = store.get(k)
             assert v["pad"] == "x" * 200
             assert f"k/{v['i']:04d}" == k
+
+
+class TestReplication:
+    """Warm-standby HA: follower replication, read-only posture,
+    promotion on primary loss, client endpoint failover
+    (kvstore/replica.py; the reference leans on a single-replica etcd
+    Deployment, k8s/contiv-vpp.yaml:72-114)."""
+
+    def test_follower_replicates_and_rejects_writes(self):
+        from vpp_tpu.kvstore.replica import Replicator
+
+        primary = KVServer(host="127.0.0.1", port=0).start()
+        primary.store.put("ksr/pod/a", {"ip": "10.1.1.2"})
+        fstore = KVStore()
+        follower = KVServer(store=fstore, host="127.0.0.1", port=0)
+        follower.read_only = True
+        follower.start()
+        repl = None
+        try:
+            repl = Replicator(fstore, "127.0.0.1", primary.port,
+                              promote_after=2.0).start()
+            # initial snapshot applied before start() returned
+            assert fstore.get("ksr/pod/a") == {"ip": "10.1.1.2"}
+            # live stream: put + delete flow through
+            primary.store.put("ksr/pod/b", 2)
+            wait_for(lambda: fstore.get("ksr/pod/b") == 2, msg="repl put")
+            primary.store.delete("ksr/pod/a")
+            wait_for(lambda: fstore.get("ksr/pod/a") is None,
+                     msg="repl delete")
+            # reads served, writes refused while following
+            c = RemoteKVStore("127.0.0.1", follower.port,
+                              request_timeout=5.0)
+            try:
+                assert c.get("ksr/pod/b") == 2
+                with pytest.raises(RuntimeError, match="not primary"):
+                    c.put("ksr/pod/c", 3)
+            finally:
+                c.close()
+        finally:
+            if repl is not None:
+                repl.stop()
+            follower.close()
+            primary.close()
+
+    def test_promotion_and_client_failover(self):
+        from vpp_tpu.kvstore.replica import Replicator
+
+        primary = KVServer(host="127.0.0.1", port=0).start()
+        primary.store.put("agent/node/1", "up")
+        fstore = KVStore()
+        follower = KVServer(store=fstore, host="127.0.0.1", port=0)
+        follower.read_only = True
+        follower.start()
+        repl = Replicator(fstore, "127.0.0.1", primary.port,
+                          promote_after=1.0,
+                          on_promote=lambda: setattr(
+                              follower, "read_only", False))
+        repl.start()
+        # an agent configured with both endpoints
+        c = connect_store(
+            f"tcp://127.0.0.1:{primary.port},127.0.0.1:{follower.port}",
+            request_timeout=5.0, reconnect_timeout=15.0,
+            reconnect_backoff=(0.05, 0.2),
+        )
+        try:
+            assert c.get("agent/node/1") == "up"
+            events = queue.Queue()
+            c.watch("agent/", events.put)
+
+            primary.close()  # the outage
+            wait_for(lambda: repl.promoted.is_set(), timeout=15.0,
+                     msg="follower promotion")
+            assert not follower.read_only
+            # client fails over to the standby; state intact; writes
+            # resume; the re-registered watch sees them
+            wait_for(lambda: c.get("agent/node/1") == "up", timeout=15.0,
+                     msg="failover read")
+            c.put("agent/node/2", "up")
+            assert fstore.get("agent/node/2") == "up"
+            ev = events.get(timeout=5)
+            while ev.key != "agent/node/2":
+                ev = events.get(timeout=5)
+        finally:
+            c.close()
+            repl.stop()
+            follower.close()
+
+    def test_promotion_grace_leases_liveness_keys(self):
+        """Leases don't replicate; at promotion, keys under the grace
+        prefixes get a fresh short lease so a dead node's liveness key
+        expires instead of pinning its routes forever."""
+        from vpp_tpu.kvstore.replica import Replicator
+
+        primary = KVServer(host="127.0.0.1", port=0).start()
+        lease = primary.store.lease_grant(30.0)
+        primary.store.put("nodeliveness/3", {"ip": "10.3.0.1"},
+                          lease=lease)
+        fstore = KVStore()
+        follower = KVServer(store=fstore, host="127.0.0.1", port=0)
+        follower.read_only = True
+        follower.start()
+        repl = Replicator(fstore, "127.0.0.1", primary.port,
+                          promote_after=0.5,
+                          grace_prefixes=("nodeliveness/",),
+                          grace_ttl_s=0.5)
+        repl.start()
+        try:
+            assert fstore.get("nodeliveness/3") == {"ip": "10.3.0.1"}
+            primary.close()
+            wait_for(lambda: repl.promoted.is_set(), timeout=15.0,
+                     msg="promotion")
+            # the dead node never keeps its grace lease alive; the
+            # follower's own sweeper (running via KVServer) expires it
+            wait_for(lambda: fstore.get("nodeliveness/3") is None,
+                     timeout=10.0, msg="grace lease expiry")
+        finally:
+            repl.stop()
+            follower.close()
+
+    def test_write_rotates_off_readonly_follower(self):
+        """A client connected to a live-but-read-only follower must not
+        be stranded: 'not primary' rejections advance the endpoint
+        rotation until a writable server answers (the transient-primary-
+        blip case: clients failed over before the standby promoted)."""
+        primary = KVServer(host="127.0.0.1", port=0).start()
+        fstore = KVStore()
+        follower = KVServer(store=fstore, host="127.0.0.1", port=0)
+        follower.read_only = True
+        follower.start()
+        try:
+            # follower listed FIRST: the client connects there
+            c = connect_store(
+                f"tcp://127.0.0.1:{follower.port},"
+                f"127.0.0.1:{primary.port}",
+                request_timeout=10.0, reconnect_timeout=10.0,
+                reconnect_backoff=(0.05, 0.2),
+            )
+            try:
+                assert (c.host, c.port) == ("127.0.0.1", follower.port)
+                c.put("a", 1)  # rotates to the writable primary
+                assert primary.store.get("a") == 1
+                assert (c.host, c.port) == ("127.0.0.1", primary.port)
+            finally:
+                c.close()
+        finally:
+            follower.close()
+            primary.close()
+
+    def test_follower_with_primary_down_at_start_promotes(self):
+        """Correlated failure: the standby restarts while the primary is
+        already down. It must promote from its persisted replica rather
+        than crash-loop (Replicator.start swallows the initial
+        ConnectionError and promotes)."""
+        from vpp_tpu.kvstore.replica import Replicator
+
+        dead = KVServer(host="127.0.0.1", port=0).start()
+        dead_port = dead.port
+        dead.close()  # nothing listens here any more
+
+        fstore = KVStore()
+        fstore.put("agent/persisted", "state")  # the surviving replica
+        follower = KVServer(store=fstore, host="127.0.0.1", port=0)
+        follower.read_only = True
+        follower.start()
+        try:
+            repl = Replicator(
+                fstore, "127.0.0.1", dead_port, promote_after=1.0,
+                on_promote=lambda: setattr(follower, "read_only", False),
+            ).start()
+            try:
+                wait_for(lambda: repl.promoted.is_set(), timeout=15.0,
+                         msg="promotion with primary down at start")
+                c = RemoteKVStore("127.0.0.1", follower.port)
+                try:
+                    assert c.get("agent/persisted") == "state"
+                    c.put("agent/new", 1)
+                finally:
+                    c.close()
+            finally:
+                repl.stop()
+        finally:
+            follower.close()

@@ -26,6 +26,14 @@ def main(argv=None) -> int:
     parser.add_argument("--port-file", default=None,
                         help="write the bound port here once listening "
                              "(--port 0 support: tests, supervisors)")
+    parser.add_argument("--follow", default=None, metavar="HOST:PORT",
+                        help="run as a warm-standby follower of this "
+                             "primary kvserver: replicate continuously, "
+                             "serve reads only, self-promote when the "
+                             "primary stays unreachable (kvstore HA)")
+    parser.add_argument("--promote-after", type=float, default=10.0,
+                        help="seconds of primary unreachability before a "
+                             "follower promotes itself to primary")
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args(argv)
 
@@ -35,6 +43,19 @@ def main(argv=None) -> int:
     )
     server = KVServer(host=args.host, port=args.port,
                       persist_path=args.persist)
+    replicator = None
+    if args.follow:
+        from vpp_tpu.agent.node_id import LIVENESS_PREFIX
+        from vpp_tpu.kvstore.replica import Replicator
+
+        fhost, _, fport = args.follow.rpartition(":")
+        server.read_only = True
+        replicator = Replicator(
+            server.store, fhost, int(fport),
+            promote_after=args.promote_after,
+            on_promote=lambda: setattr(server, "read_only", False),
+            grace_prefixes=(LIVENESS_PREFIX,),
+        ).start()
     if args.port_file:
         tmp = args.port_file + ".tmp"
         with open(tmp, "w") as f:
@@ -53,6 +74,8 @@ def main(argv=None) -> int:
         signal.signal(sig, lambda *_: stop.set())
     server.start()
     stop.wait()
+    if replicator is not None:
+        replicator.stop()
     server.close()
     return 0
 

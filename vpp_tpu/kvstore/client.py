@@ -58,9 +58,22 @@ class RemoteKVStore:
     def __init__(self, host: str, port: int,
                  request_timeout: float = 10.0,
                  reconnect_timeout: float = 30.0,
-                 reconnect_backoff: Tuple[float, float] = (0.1, 2.0)):
+                 reconnect_backoff: Tuple[float, float] = (0.1, 2.0),
+                 fallbacks: Optional[List[Tuple[str, int]]] = None,
+                 on_reconnect_failed: Optional[Callable[[], None]] = None):
+        """``fallbacks``: additional (host, port) endpoints tried in
+        rotation when the current one is unreachable — the HA client
+        side of a primary + standby kvserver pair (the reference simply
+        points every agent at the etcd Service VIP; here failover is
+        client-side). ``on_reconnect_failed`` fires when a reconnect
+        gives up after ``reconnect_timeout`` across ALL endpoints (the
+        replicator uses it as its promotion trigger)."""
         self.host = host
         self.port = port
+        self.endpoints: List[Tuple[str, int]] = (
+            [(host, port)] + list(fallbacks or [])
+        )
+        self.on_reconnect_failed = on_reconnect_failed
         self.request_timeout = request_timeout
         self.reconnect_timeout = reconnect_timeout
         self.reconnect_backoff = reconnect_backoff
@@ -72,6 +85,13 @@ class RemoteKVStore:
         self._sock: Optional[socket.socket] = None
         self._pending: Dict[int, "queue.Queue[Any]"] = {}
         self._watches: Dict[int, _Watch] = {}
+        # request-id -> _Watch for in-flight watch registrations whose
+        # snapshot must be delivered via on_resync. The READER thread
+        # enqueues the resync when it sees the response — before it can
+        # read any subsequent event — so snapshot-then-events ordering
+        # is guaranteed (caller-side enqueueing raced the event stream).
+        self._resync_rids: Dict[int, _Watch] = {}
+        self._rotate_start = 0
         self._closed = False
 
         self._events: "queue.Queue[Any]" = queue.Queue()
@@ -85,23 +105,38 @@ class RemoteKVStore:
     # --- connection management ---
     def _connect(self, deadline: float) -> None:
         backoff, cap = self.reconnect_backoff
+        attempt = 0
+        n = len(self.endpoints)
         while True:
             if self._closed:
                 raise ConnectionError("client closed")
+            # rotate through endpoints starting at _rotate_start: each
+            # backoff round tries the next candidate, so a dead primary
+            # fails over to a standby within one round. _rotate_start
+            # persists across reconnects — a "not primary" rejection
+            # advances it (see _request) so the rotation can move off a
+            # live-but-read-only follower, and lands back on index 0
+            # (the preferred primary) one step later.
+            idx = (self._rotate_start + attempt) % n
+            host, port = self.endpoints[idx]
+            attempt += 1
             try:
                 sock = socket.create_connection(
-                    (self.host, self.port), timeout=self.request_timeout
+                    (host, port), timeout=self.request_timeout
                 )
                 sock.settimeout(None)
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self.host, self.port = host, port
+                self._rotate_start = idx
                 break
             except OSError as exc:
                 if time.monotonic() >= deadline:
                     raise ConnectionError(
-                        f"kvserver {self.host}:{self.port} unreachable: {exc}"
+                        f"kvserver unreachable on {self.endpoints}: {exc}"
                     ) from exc
-                time.sleep(min(backoff, cap))
-                backoff *= 2
+                if attempt % n == 0:
+                    time.sleep(min(backoff, cap))
+                    backoff *= 2
         with self._lock:
             self._sock = sock
             self._reader = threading.Thread(
@@ -127,7 +162,14 @@ class RemoteKVStore:
                     if "watch_id" in msg and "event" in msg:
                         self._events.put(msg)
                     else:
-                        q = self._pending.pop(msg.get("id"), None)
+                        rid = msg.get("id")
+                        w = self._resync_rids.pop(rid, None)
+                        if w is not None and msg.get("ok"):
+                            res = msg["result"]
+                            self._events.put(
+                                ("resync", w, res["snapshot"], res["rev"])
+                            )
+                        q = self._pending.pop(rid, None)
                         if q is not None:
                             q.put(msg)
         except OSError:
@@ -154,26 +196,42 @@ class RemoteKVStore:
     def _reconnect_loop(self) -> None:
         try:
             self._connect(deadline=time.monotonic() + self.reconnect_timeout)
-            log.info("kvserver reconnected")
+            log.info("kvserver reconnected (%s:%d)", self.host, self.port)
         except ConnectionError as exc:
             log.error("kvserver reconnect failed: %s", exc)
+            cb = self.on_reconnect_failed
+            if cb is not None and not self._closed:
+                try:
+                    cb()
+                except Exception:  # noqa: BLE001 — observer must not kill us
+                    log.exception("on_reconnect_failed callback failed")
 
     def _reregister_watches(self) -> None:
         with self._lock:
             watches = [w for w in self._watches.values() if w.active]
         for w in watches:
             try:
-                res = self._request(
-                    "watch", prefix=w.prefix, watch_id=w.wid
-                )
+                self._watch_request(w)
             except ConnectionError:
                 return  # next reconnect will retry
-            if w.on_resync is not None:
-                self._events.put(("resync", w, res["snapshot"], res["rev"]))
+
+    def _watch_request(self, w: _Watch) -> Any:
+        """Send a watch registration whose snapshot (if the consumer
+        wants it) is enqueued by the READER thread, ordered strictly
+        before any event of the new watch stream."""
+        rid = next(self._ids)
+        if w.on_resync is not None:
+            self._resync_rids[rid] = w
+        try:
+            return self._request("watch", _rid=rid,
+                                 prefix=w.prefix, watch_id=w.wid)
+        finally:
+            # normally consumed by the reader; clean up on failure paths
+            self._resync_rids.pop(rid, None)
 
     # --- request plumbing ---
-    def _request(self, op: str, **kw: Any) -> Any:
-        rid = next(self._ids)
+    def _request(self, op: str, _rid: Optional[int] = None, **kw: Any) -> Any:
+        rid = next(self._ids) if _rid is None else _rid
         msg = {"id": rid, "op": op, **kw}
         data = json.dumps(msg, separators=(",", ":")).encode() + b"\n"
         deadline = time.monotonic() + self.request_timeout
@@ -209,8 +267,35 @@ class RemoteKVStore:
                 # have applied; surface that instead of blindly retrying.
                 raise ConnectionError("connection lost during request")
             if not resp.get("ok"):
-                raise RuntimeError(f"kvserver error: {resp.get('error')}")
+                err = str(resp.get("error"))
+                if "not primary" in err and \
+                        len(self.endpoints) > 1 and \
+                        time.monotonic() < deadline:
+                    # connected to a read-only follower (e.g. the
+                    # primary blipped and we failed over before the
+                    # standby promoted). The op did NOT apply, so it is
+                    # safe to rotate endpoints and retry: advance the
+                    # rotation cursor — the next reconnect starts one
+                    # past this follower, which wraps back to the
+                    # preferred primary — and force the reconnect by
+                    # dropping the socket.
+                    self._rotate_endpoint()
+                    time.sleep(0.05)
+                    continue
+                raise RuntimeError(f"kvserver error: {err}")
             return resp.get("result")
+
+    def _rotate_endpoint(self) -> None:
+        with self._lock:
+            self._rotate_start = (
+                (self._rotate_start + 1) % len(self.endpoints)
+            )
+            sock = self._sock
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass  # already dying; the reader's disconnect handles it
 
     # --- watch event dispatch (single thread, arrival order) ---
     def _dispatch_loop(self) -> None:
@@ -281,11 +366,15 @@ class RemoteKVStore:
     def watch(self, prefix: str, callback: WatchCallback,
               on_resync: Optional[ResyncCallback] = None
               ) -> Callable[[], None]:
+        """``on_resync(snapshot, rev)`` fires on EVERY snapshot-atomic
+        registration — the initial one included, then each reconnect —
+        so a consumer can mark-and-sweep from the same code path
+        whether it is starting fresh or recovering from an outage."""
         wid = next(self._wids)
         w = _Watch(wid, prefix, callback, on_resync)
         with self._lock:
             self._watches[wid] = w
-        self._request("watch", prefix=prefix, watch_id=wid)
+        self._watch_request(w)
 
         def cancel() -> None:
             w.active = False
@@ -337,17 +426,23 @@ def connect_store(url: Optional[str],
     """Build the configured store backend.
 
     ``url`` forms:
-      * ``None`` / ``""``      -> in-process KVStore (dev / unit tests)
-      * ``"tcp://host:port"``  -> RemoteKVStore against a KVServer
+      * ``None`` / ``""``                 -> in-process KVStore (dev/tests)
+      * ``"tcp://host:port"``             -> RemoteKVStore against a KVServer
+      * ``"tcp://h1:p1,h2:p2[,...]"``     -> HA pair/list: first endpoint
+        preferred, the rest are failover candidates (primary + standby
+        kvservers; see kvstore/replica.py)
     """
     if not url:
         from vpp_tpu.kvstore.store import KVStore
 
         return KVStore(persist_path=persist_path)
     if url.startswith("tcp://"):
-        hostport = url[len("tcp://"):]
-        host, _, port = hostport.rpartition(":")
-        if not host or not port.isdigit():
-            raise ValueError(f"bad store url: {url!r}")
-        return RemoteKVStore(host, int(port), **kw)
+        endpoints = []
+        for hostport in url[len("tcp://"):].split(","):
+            host, _, port = hostport.strip().rpartition(":")
+            if not host or not port.isdigit():
+                raise ValueError(f"bad store url: {url!r}")
+            endpoints.append((host, int(port)))
+        (host, port), fallbacks = endpoints[0], endpoints[1:]
+        return RemoteKVStore(host, port, fallbacks=fallbacks, **kw)
     raise ValueError(f"unsupported store url scheme: {url!r}")

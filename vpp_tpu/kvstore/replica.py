@@ -1,0 +1,143 @@
+"""Warm-standby replication for the cluster kvstore.
+
+The reference deploys etcd as a single-replica Deployment and leans on
+Kubernetes to reschedule it (/root/reference/k8s/contiv-vpp.yaml:72-114)
+— state survives via the host-path data dir, but the store is down until
+the pod returns. This module gives the custom KVServer a hotter story:
+
+  * a **follower** kvserver runs with ``Replicator`` attached: it
+    list+watches EVERYTHING on the primary (the same snapshot-atomic
+    contract the agents use) and applies the stream to its local store,
+    staying a live, consistent, queryable copy;
+  * while following, the server is **read-only** — writes answer
+    "not primary" so a partitioned client can't fork history;
+  * if the primary stays unreachable past ``promote_after`` seconds,
+    the follower **promotes**: replication stops, the server turns
+    writable, and clients configured with both endpoints
+    (``tcp://primary:p,standby:p`` — see client.connect_store) fail
+    over and resume.
+
+Lease state is intentionally NOT replicated: lease-backed keys (node
+liveness) arrive as plain keys. After a promotion every agent's
+keepalive loop finds its lease unknown, re-grants against the new
+primary, and re-puts its liveness key — the same self-healing path as
+an etcd compaction of lease state.
+
+Split-brain note: promotion is one-way and local. If the old primary
+returns it is NOT demoted automatically; run it as a follower of the
+promoted standby (operator/orchestrator action, documented in
+docs/DEPLOYMENT.md). This is the deliberate simplicity trade: the
+reference accepts a single-replica etcd, we accept manual fail-back.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from vpp_tpu.kvstore.client import RemoteKVStore
+from vpp_tpu.kvstore.store import KVEvent, KVStore, Op
+
+log = logging.getLogger("kvreplica")
+
+
+class Replicator:
+    def __init__(self, store: KVStore, primary_host: str, primary_port: int,
+                 promote_after: float = 10.0,
+                 on_promote: Optional[Callable[[], None]] = None,
+                 grace_prefixes: tuple = (),
+                 grace_ttl_s: float = 30.0):
+        """``grace_prefixes``: key prefixes whose entries were
+        lease-attached on the primary (leases don't replicate — the
+        keys arrive plain). At promotion each such key gets a fresh
+        ``grace_ttl_s`` lease: live owners re-grant and re-publish on
+        their next keepalive (their old lease id is unknown here), dead
+        owners' keys expire after the grace instead of lingering
+        forever."""
+        self.store = store
+        self.primary = (primary_host, primary_port)
+        self.promote_after = promote_after
+        self.on_promote = on_promote
+        self.grace_prefixes = tuple(grace_prefixes)
+        self.grace_ttl_s = grace_ttl_s
+        self.promoted = threading.Event()
+        self.synced = threading.Event()      # first snapshot applied
+        self._client: Optional[RemoteKVStore] = None
+        self._lock = threading.Lock()
+
+    # --- lifecycle ---
+    def start(self) -> "Replicator":
+        """Connect to the primary and begin streaming. Blocks until the
+        initial snapshot is applied (a follower that serves before its
+        first sync would hand out empty state).
+
+        A primary already unreachable at startup — the correlated-
+        failure case: standby restarted during the primary's outage —
+        promotes after ``promote_after`` instead of raising: with a
+        persisted local replica this process may be the only surviving
+        copy of the cluster state, and crash-looping here would keep
+        the kvstore down until an operator stepped in."""
+        try:
+            self._client = RemoteKVStore(
+                *self.primary,
+                reconnect_timeout=self.promote_after,
+                on_reconnect_failed=self._promote,
+            )
+            self._client.watch("", self._apply_event,
+                               on_resync=self._apply_snapshot)
+        except ConnectionError:
+            self._promote()
+            return self
+        if not self.synced.wait(timeout=30):
+            raise TimeoutError("initial sync from primary did not complete")
+        log.info("following primary %s:%d (%d keys)",
+                 *self.primary, len(self.store.list_keys("")))
+        return self
+
+    def stop(self) -> None:
+        c = self._client
+        self._client = None
+        if c is not None:
+            c.close()
+
+    # --- replication ---
+    def _apply_snapshot(self, snapshot: Dict[str, Any], rev: int) -> None:
+        """Mark-and-sweep the local store to the primary's snapshot
+        (first sync + every reconnect: deletions during an outage must
+        not survive here)."""
+        with self._lock:
+            for key, value in snapshot.items():
+                if self.store.get(key) != value:
+                    self.store.put(key, value)
+            for key in self.store.list_keys(""):
+                if key not in snapshot:
+                    self.store.delete(key)
+        log.info("resynced from primary: %d keys @ rev %d",
+                 len(snapshot), rev)
+        self.synced.set()
+
+    def _apply_event(self, ev: KVEvent) -> None:
+        with self._lock:
+            if ev.op is Op.PUT:
+                self.store.put(ev.key, ev.value)
+            elif ev.op is Op.DELETE:
+                self.store.delete(ev.key)
+
+    # --- failover ---
+    def _promote(self) -> None:
+        if self.promoted.is_set():
+            return
+        self.promoted.set()
+        log.warning(
+            "primary %s:%d unreachable for %.0fs — promoting to primary",
+            *self.primary, self.promote_after,
+        )
+        self.stop()
+        for prefix in self.grace_prefixes:
+            for key, value in self.store.list_values(prefix).items():
+                lease = self.store.lease_grant(self.grace_ttl_s)
+                self.store.put(key, value, lease=lease)
+        cb = self.on_promote
+        if cb is not None:
+            cb()

@@ -101,10 +101,20 @@ class _Conn(socketserver.BaseRequestHandler):
                     continue
                 self._handle_req(store, req)
 
+    WRITE_OPS = frozenset(
+        {"put", "delete", "cas", "cad",
+         "lease_grant", "lease_keepalive", "lease_revoke"}
+    )
+
     def _handle_req(self, store: KVStore, req: Dict[str, Any]) -> None:
         rid = req.get("id")
         op = req.get("op")
         try:
+            if op in self.WRITE_OPS and \
+                    self.server.read_only:  # type: ignore[attr-defined]
+                raise PermissionError(
+                    "not primary: this kvserver is a read-only follower"
+                )
             if op == "get":
                 res = store.get(req["key"])
             elif op == "put":
@@ -188,6 +198,7 @@ class KVServer:
         self._server = _Server((host, port), _Conn)
         self._server.store = self.store  # type: ignore[attr-defined]
         self._server.live_conns = set()  # type: ignore[attr-defined]
+        self._server.read_only = False  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
         self._sweep_stop = threading.Event()
         self._sweeper = threading.Thread(
@@ -206,6 +217,14 @@ class KVServer:
                     log.info("lease sweep expired %d keys", n)
             except Exception:  # noqa: BLE001 — keep sweeping
                 log.exception("lease sweep failed")
+
+    @property
+    def read_only(self) -> bool:
+        return self._server.read_only  # type: ignore[attr-defined]
+
+    @read_only.setter
+    def read_only(self, value: bool) -> None:
+        self._server.read_only = bool(value)  # type: ignore[attr-defined]
 
     @property
     def address(self) -> tuple:
