@@ -525,3 +525,47 @@ class TestReplication:
                 repl.stop()
         finally:
             follower.close()
+
+    def test_silent_primary_death_promotes_via_heartbeat(self):
+        """Power loss / partition sends no FIN: the replication socket
+        just blocks. The heartbeat must still promote. Simulated with
+        SIGSTOP on a real kvserver subprocess — the TCP connection
+        stays ESTABLISHED but nothing answers."""
+        import signal
+        import tempfile
+
+        from vpp_tpu.kvstore.replica import Replicator
+
+        with tempfile.TemporaryDirectory() as tmp:
+            port_file = os.path.join(tmp, "port")
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "vpp_tpu.cmd.kvserver",
+                 "--host", "127.0.0.1", "--port", "0",
+                 "--port-file", port_file],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+            try:
+                wait_for(lambda: os.path.exists(port_file), timeout=20.0,
+                         msg="primary start")
+                pport = int(open(port_file).read())
+                seed = RemoteKVStore("127.0.0.1", pport)
+                seed.put("k", 1)
+                seed.close()
+
+                fstore = KVStore()
+                repl = Replicator(fstore, "127.0.0.1", pport,
+                                  promote_after=1.5)
+                repl.start()
+                try:
+                    assert fstore.get("k") == 1
+                    os.kill(proc.pid, signal.SIGSTOP)  # silent death
+                    wait_for(lambda: repl.promoted.is_set(), timeout=30.0,
+                             msg="heartbeat promotion on silent death")
+                finally:
+                    repl.stop()
+            finally:
+                try:
+                    os.kill(proc.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                proc.wait(timeout=10)

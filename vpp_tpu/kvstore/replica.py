@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Any, Callable, Dict, Optional
 
 from vpp_tpu.kvstore.client import RemoteKVStore
@@ -64,6 +65,7 @@ class Replicator:
         self.promoted = threading.Event()
         self.synced = threading.Event()      # first snapshot applied
         self._client: Optional[RemoteKVStore] = None
+        self._heartbeat_thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
 
     # --- lifecycle ---
@@ -81,19 +83,63 @@ class Replicator:
         try:
             self._client = RemoteKVStore(
                 *self.primary,
+                request_timeout=max(2.0, min(10.0, self.promote_after)),
                 reconnect_timeout=self.promote_after,
                 on_reconnect_failed=self._promote,
             )
-            self._client.watch("", self._apply_event,
-                               on_resync=self._apply_snapshot)
         except ConnectionError:
+            # ONLY the initial connect promotes directly: it already
+            # waited promote_after across the reconnect deadline. A
+            # failure after a successful connect must NOT short-circuit
+            # the promote window (a primary mid-restart would fork).
             self._promote()
             return self
-        if not self.synced.wait(timeout=30):
-            raise TimeoutError("initial sync from primary did not complete")
+        try:
+            self._client.watch("", self._apply_event,
+                               on_resync=self._apply_snapshot)
+        except (ConnectionError, TimeoutError, RuntimeError):
+            # connection dropped right after connecting: the client's
+            # reconnect loop re-registers the watch or, after
+            # promote_after of failures, fires on_reconnect_failed
+            log.warning("watch registration interrupted; relying on "
+                        "reconnect/promote machinery")
+        self._heartbeat_thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True, name="kv-replica-hb"
+        )
+        self._heartbeat_thread.start()
+        deadline = time.monotonic() + max(30.0, self.promote_after * 3)
+        while not self.synced.wait(timeout=0.2):
+            if self.promoted.is_set():
+                return self
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    "initial sync from primary did not complete"
+                )
         log.info("following primary %s:%d (%d keys)",
                  *self.primary, len(self.store.list_keys("")))
         return self
+
+    def _heartbeat_loop(self) -> None:
+        """Detect SILENT primary death (power loss, partition — no FIN,
+        so the replication socket just blocks forever): ping the
+        primary on its own request path; promote once promote_after
+        passes without a successful round trip. TCP disconnects are
+        still caught faster by on_reconnect_failed."""
+        last_ok = time.monotonic()
+        interval = max(0.2, self.promote_after / 4.0)
+        while not self.promoted.is_set():
+            c = self._client
+            if c is None:
+                return  # stopped
+            try:
+                c.ping()
+                last_ok = time.monotonic()
+            except Exception:  # noqa: BLE001 — any failure counts
+                if time.monotonic() - last_ok > self.promote_after:
+                    self._promote()
+                    return
+            if self.promoted.wait(timeout=interval):
+                return
 
     def stop(self) -> None:
         c = self._client

@@ -24,6 +24,7 @@
 #include <cerrno>
 #include <sys/socket.h>
 #include <sys/uio.h>
+#include <unistd.h>
 
 namespace {
 
@@ -378,6 +379,199 @@ uint32_t pio_parse_inplace(const uint8_t* payload, uint32_t snap,
     parse_fields(f, len, copy, snap, i, rx_if, cols);
   }
   return n;
+}
+
+// ---- (ip -> MAC) neighbor table, caller-owned arrays (the daemon's
+// static-ARP + rx-learning store; reference: configured static ARP
+// entries per pod link, plugins/contiv/pod.go:375-452). Open-addressed
+// hash, capacity a power of two, insert-only — overwrites refresh, a
+// full probe run evicts the home slot, occupancy never clears, so
+// probe chains stay intact without tombstones.
+//
+// Concurrency: the rx thread learns, the tx thread looks up and the
+// control thread installs static entries, all GIL-free (ctypes calls
+// release the GIL). Per-slot seqlock discipline: state 0 = empty
+// (ends a probe chain), 1 = write in progress (chain continues, entry
+// unreadable), 2 = valid. Writers store 1, write ip+mac, then
+// store-release 2; readers load-acquire state, copy, and re-check
+// state+ip — a torn 6-byte MAC copy can never be returned (the reader
+// falls back to a miss, i.e. broadcast: safe, not misdelivered). ----
+
+constexpr uint32_t kMacProbe = 16;
+
+static inline uint32_t mac_hash(uint32_t ip) { return ip * 0x9e3779b1u; }
+
+void pio_mac_put(uint32_t* ips, uint8_t* macs, uint8_t* state,
+                 uint32_t cap, uint32_t ip, const uint8_t* mac) {
+  uint32_t mask = cap - 1;
+  uint32_t h = mac_hash(ip) & mask;
+  uint32_t slot = h;
+  for (uint32_t probe = 0; probe < kMacProbe; probe++) {
+    uint32_t s = (h + probe) & mask;
+    uint8_t st = __atomic_load_n(&state[s], __ATOMIC_ACQUIRE);
+    if (st == 0 || ips[s] == ip) {
+      slot = s;
+      break;
+    }
+  }
+  // SEQ_CST: the invalidation must not be reordered (by compiler or
+  // CPU) after the ip/mac writes it guards
+  __atomic_store_n(&state[slot], 1, __ATOMIC_SEQ_CST);  // mark writing
+  __atomic_store_n(&ips[slot], ip, __ATOMIC_RELEASE);
+  std::memcpy(macs + static_cast<uint64_t>(slot) * 6u, mac, 6);
+  __atomic_store_n(&state[slot], 2, __ATOMIC_RELEASE);  // publish
+}
+
+int32_t pio_mac_get(const uint32_t* ips, const uint8_t* macs,
+                    const uint8_t* state, uint32_t cap, uint32_t ip,
+                    uint8_t* out) {
+  uint32_t mask = cap - 1;
+  uint32_t h = mac_hash(ip) & mask;
+  for (uint32_t probe = 0; probe < kMacProbe; probe++) {
+    uint32_t s = (h + probe) & mask;
+    uint8_t st = __atomic_load_n(&state[s], __ATOMIC_ACQUIRE);
+    if (st == 0) return 0;              // chain end
+    if (st != 2) continue;              // mid-write: unreadable, probe on
+    if (__atomic_load_n(&ips[s], __ATOMIC_ACQUIRE) != ip) continue;
+    std::memcpy(out, macs + static_cast<uint64_t>(s) * 6u, 6);
+    // validate: a concurrent rewrite of this slot during the copy
+    // makes the result unusable — report a miss (broadcast fallback)
+    __atomic_thread_fence(__ATOMIC_ACQUIRE);
+    if (__atomic_load_n(&state[s], __ATOMIC_ACQUIRE) == 2 &&
+        __atomic_load_n(&ips[s], __ATOMIC_ACQUIRE) == ip) {
+      return 1;
+    }
+    return 0;
+  }
+  return 0;
+}
+
+// Learn (src_ip -> source MAC) for every valid IPv4 packet of a parsed
+// frame in one pass — replaces a per-packet Python loop that capped
+// the rx path at ~1 Mpps. flags/src are the frame's column arrays.
+void pio_mac_learn(uint32_t* ips, uint8_t* macs, uint8_t* state,
+                   uint32_t cap, const int32_t* flags, const int32_t* src,
+                   const uint8_t* payload, uint32_t snap, uint32_t n) {
+  if (n > kVec) n = kVec;
+  for (uint32_t i = 0; i < n; i++) {
+    if (!(flags[i] & kFlagValid) || (flags[i] & kFlagNonIp4)) continue;
+    pio_mac_put(ips, macs, state, cap, static_cast<uint32_t>(src[i]),
+                payload + static_cast<uint64_t>(i) * snap + 6);
+  }
+}
+
+// ---- tx dispatch: one native pass over a tx frame (the
+// interface-output node; reference: VPP's l2/ip4-rewrite +
+// interface-output run per vector in C, never per packet in a slow
+// layer). Validity/trunc policy, disposition switch, Ethernet
+// addressing from the neighbor table, per-egress-interface batching,
+// sendmmsg (sockets) or write() (TAP char devices). REMOTE packets
+// with a VXLAN next-hop are returned to the caller for encap.
+//
+// counters: [0]=tx_pkts [1]=tx_drops [2]=tx_punts [3]=trunc_drops
+//           [4]=n_remote (rows listed in remote_rows)
+void pio_tx_dispatch(const int32_t* cols, uint8_t* payload, uint32_t snap,
+                     uint32_t n, const int32_t* if_indices,
+                     const int32_t* if_fds, const uint8_t* if_sock,
+                     const uint8_t* if_macs, uint32_t n_if,
+                     int32_t uplink_if, int32_t host_if,
+                     const uint32_t* mac_ips, const uint8_t* mac_macs,
+                     const uint8_t* mac_state, uint32_t mac_cap,
+                     uint32_t* remote_rows, uint32_t* counters) {
+  const int32_t* flags = cols + kFlags * kVec;
+  const int32_t* disp = cols + kDisp * kVec;
+  // tx direction: the rx_if column carries the EGRESS interface
+  const int32_t* tx_if = cols + kRxIf * kVec;
+  const int32_t* dst_ip = cols + kDstIp * kVec;
+  const int32_t* next_hop = cols + kNextHop * kVec;
+  const int32_t* pkt_len = cols + kPktLen * kVec;
+  if (n > kVec) n = kVec;
+
+  int16_t assign[kVec];
+  uint32_t wlen[kVec];
+
+  for (uint32_t i = 0; i < n; i++) {
+    assign[i] = -1;
+    int32_t f = flags[i];
+    if (!(f & kFlagValid)) continue;
+    if (f & kFlagTrunc) {
+      // captured < claimed bytes: transmitting would pad with residual
+      // slot data (cross-flow leak) — drop and make it visible
+      counters[3]++;
+      continue;
+    }
+    uint32_t wire = static_cast<uint32_t>(pkt_len[i]) + kEthHdr;
+    if (wire > snap) wire = snap;
+    int32_t d = disp[i];
+    int32_t target = -1;
+    bool set_mac = true;
+    if (d == 0) {  // DROP
+      counters[1]++;
+      continue;
+    } else if (d == 1) {  // LOCAL
+      target = tx_if[i];
+    } else if (d == 2) {  // REMOTE
+      if (next_hop[i] != 0) {
+        remote_rows[counters[4]++] = i;  // caller VXLAN-encapsulates
+        continue;
+      }
+      target = uplink_if;
+    } else if (d == 3) {  // HOST punt: original Ethernet kept intact
+      target = host_if;
+      set_mac = false;
+    } else {
+      counters[1]++;
+      continue;
+    }
+    int slot = -1;
+    for (uint32_t s = 0; s < n_if; s++) {
+      if (if_indices[s] == target) {
+        slot = static_cast<int>(s);
+        break;
+      }
+    }
+    if (slot < 0 || wire < kEthHdr) {
+      counters[1]++;
+      continue;
+    }
+    if (set_mac) {
+      uint8_t* raw = payload + static_cast<uint64_t>(i) * snap;
+      if (!pio_mac_get(mac_ips, mac_macs, mac_state, mac_cap,
+                       static_cast<uint32_t>(dst_ip[i]), raw)) {
+        std::memset(raw, 0xff, 6);  // broadcast fallback
+      }
+      std::memcpy(raw + 6, if_macs + static_cast<uint64_t>(slot) * 6u, 6);
+    }
+    assign[i] = static_cast<int16_t>(slot);
+    wlen[i] = wire;
+  }
+
+  for (uint32_t s = 0; s < n_if; s++) {
+    uint32_t rows[kVec], lens[kVec], k = 0;
+    for (uint32_t i = 0; i < n; i++) {
+      if (assign[i] == static_cast<int16_t>(s)) {
+        rows[k] = i;
+        lens[k] = wlen[i];
+        k++;
+      }
+    }
+    if (!k) continue;
+    int32_t sent = 0;
+    if (if_sock[s]) {
+      sent = pio_send_batch(if_fds[s], payload, snap, rows, lens, k);
+    } else {
+      for (uint32_t j = 0; j < k; j++) {  // TAP: one write per frame
+        ssize_t rc = write(if_fds[s],
+                           payload + static_cast<uint64_t>(rows[j]) * snap,
+                           lens[j]);
+        if (rc < 0) break;
+        sent++;
+      }
+    }
+    bool punt = if_indices[s] == host_if;
+    counters[punt ? 2 : 0] += static_cast<uint32_t>(sent);
+    counters[1] += k - static_cast<uint32_t>(sent);
+  }
 }
 
 }  // extern "C"

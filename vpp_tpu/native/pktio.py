@@ -42,6 +42,18 @@ FLAG_TRUNC = 4   # captured < claimed length: drop, never transmit
 _COL_INDEX = {name: i for i, (name, _) in enumerate(RING_COLUMNS)}
 
 
+def flatten_cols(cols) -> np.ndarray:
+    """Column dict → the contiguous [N_COLUMNS, VEC] int32 block the
+    native calls consume. Passes a pre-flattened block through, so hot
+    paths flatten ONCE and hand the same buffer to rewrite + dispatch."""
+    if isinstance(cols, np.ndarray):
+        return cols
+    flat = np.zeros((N_COLUMNS, VEC), np.int32)
+    for name, arr in cols.items():
+        flat[_COL_INDEX[name]] = np.asarray(arr).view(np.int32)
+    return flat
+
+
 def _load() -> ctypes.CDLL:
     global _lib
     with _lock:
@@ -86,10 +98,88 @@ def _load() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p,
             ctypes.c_uint32, ctypes.c_int32, ctypes.c_void_p,
         ]
+        lib.pio_mac_put.restype = None
+        lib.pio_mac_put.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_uint32, ctypes.c_uint32, ctypes.c_void_p,
+        ]
+        lib.pio_mac_get.restype = ctypes.c_int32
+        lib.pio_mac_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_uint32, ctypes.c_uint32, ctypes.c_void_p,
+        ]
+        lib.pio_mac_learn.restype = None
+        lib.pio_mac_learn.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_uint32, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
+        ]
+        lib.pio_tx_dispatch.restype = None
+        lib.pio_tx_dispatch.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32,
+            ctypes.c_uint32, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32,
+            ctypes.c_void_p, ctypes.c_void_p,
+        ]
         assert int(lib.pio_vec()) == VEC
         assert int(lib.pio_columns()) == N_COLUMNS
         _lib = lib
         return lib
+
+
+class MacTable:
+    """Native (ip → MAC) neighbor table: static entries from the control
+    plane (the reference's configured per-pod static ARPs,
+    plugins/contiv/pod.go:375-452) plus rx learning, stored in numpy
+    arrays the C helpers operate on — lookup AND learning run inside
+    the per-frame native calls, never per packet in Python."""
+
+    def __init__(self, capacity: int = 4096):
+        assert capacity & (capacity - 1) == 0, "capacity must be 2^k"
+        self.capacity = capacity
+        self.ips = np.zeros(capacity, np.uint32)
+        self.macs = np.zeros((capacity, 6), np.uint8)
+        self.state = np.zeros(capacity, np.uint8)
+        self._lib = _load()
+
+    def put(self, ip: int, mac: bytes) -> None:
+        self._lib.pio_mac_put(
+            self.ips.ctypes.data_as(ctypes.c_void_p),
+            self.macs.ctypes.data_as(ctypes.c_void_p),
+            self.state.ctypes.data_as(ctypes.c_void_p),
+            self.capacity, ip & 0xFFFFFFFF,
+            (ctypes.c_char * 6).from_buffer_copy(mac),
+        )
+
+    def get(self, ip: int) -> Optional[bytes]:
+        out = np.zeros(6, np.uint8)
+        found = self._lib.pio_mac_get(
+            self.ips.ctypes.data_as(ctypes.c_void_p),
+            self.macs.ctypes.data_as(ctypes.c_void_p),
+            self.state.ctypes.data_as(ctypes.c_void_p),
+            self.capacity, ip & 0xFFFFFFFF,
+            out.ctypes.data_as(ctypes.c_void_p),
+        )
+        return out.tobytes() if found else None
+
+    def learn(self, cols: Dict[str, np.ndarray], payload: np.ndarray,
+              n: int) -> None:
+        """Learn (src_ip → source MAC) for a parsed frame in one native
+        pass over its flags/src_ip columns + payload source MACs."""
+        flags = np.ascontiguousarray(cols["flags"], np.int32)
+        src = np.ascontiguousarray(cols["src_ip"]).view(np.int32)
+        self._lib.pio_mac_learn(
+            self.ips.ctypes.data_as(ctypes.c_void_p),
+            self.macs.ctypes.data_as(ctypes.c_void_p),
+            self.state.ctypes.data_as(ctypes.c_void_p),
+            self.capacity,
+            flags.ctypes.data_as(ctypes.c_void_p),
+            src.ctypes.data_as(ctypes.c_void_p),
+            payload.ctypes.data_as(ctypes.c_void_p),
+            payload.shape[1], n,
+        )
 
 
 class PacketCodec:
@@ -129,13 +219,11 @@ class PacketCodec:
         }
         return cols, n
 
-    def rewrite(self, cols: Dict[str, np.ndarray], payload: np.ndarray,
-                n: int) -> None:
-        """Patch stored frames in ``payload`` from (rewritten) columns,
-        fixing IPv4 + L4 checksums in place."""
-        flat = np.zeros((N_COLUMNS, VEC), np.int32)
-        for name, arr in cols.items():
-            flat[_COL_INDEX[name]] = np.asarray(arr).view(np.int32)
+    def rewrite(self, cols, payload: np.ndarray, n: int) -> None:
+        """Patch stored frames in ``payload`` from (rewritten) columns
+        (dict or pre-flattened block), fixing IPv4 + L4 checksums in
+        place."""
+        flat = flatten_cols(cols)
         self.lib.pio_rewrite(
             flat.ctypes.data_as(ctypes.c_void_p),
             payload.ctypes.data_as(ctypes.c_void_p),
@@ -197,6 +285,42 @@ class PacketCodec:
             for i, (name, dtype) in enumerate(RING_COLUMNS)
         }
         return cols, n
+
+    def tx_dispatch(self, cols, payload: np.ndarray,
+                    n: int, if_indices: np.ndarray, if_fds: np.ndarray,
+                    if_sock: np.ndarray, if_macs: np.ndarray,
+                    uplink_if: int, host_if: int,
+                    mac: "MacTable") -> Tuple[np.ndarray, np.ndarray]:
+        """One native pass over a tx frame: validity/trunc policy,
+        disposition switch, Ethernet addressing from the neighbor
+        table, per-egress batching, sendmmsg/write transmission.
+
+        Returns (counters, remote_rows): counters = uint32
+        [tx_pkts, tx_drops, tx_punts, trunc_drops, n_remote];
+        remote_rows[:n_remote] are rows the caller must VXLAN-
+        encapsulate (REMOTE disposition with a peer next-hop).
+        ``cols`` may be a dict or a pre-flattened block (flatten_cols —
+        the daemon flattens once for rewrite + dispatch)."""
+        flat = flatten_cols(cols)
+        remote = np.zeros(VEC, np.uint32)
+        counters = np.zeros(5, np.uint32)
+        self.lib.pio_tx_dispatch(
+            flat.ctypes.data_as(ctypes.c_void_p),
+            payload.ctypes.data_as(ctypes.c_void_p),
+            payload.shape[1], n,
+            if_indices.ctypes.data_as(ctypes.c_void_p),
+            if_fds.ctypes.data_as(ctypes.c_void_p),
+            if_sock.ctypes.data_as(ctypes.c_void_p),
+            if_macs.ctypes.data_as(ctypes.c_void_p),
+            len(if_indices), uplink_if, host_if,
+            mac.ips.ctypes.data_as(ctypes.c_void_p),
+            mac.macs.ctypes.data_as(ctypes.c_void_p),
+            mac.state.ctypes.data_as(ctypes.c_void_p),
+            mac.capacity,
+            remote.ctypes.data_as(ctypes.c_void_p),
+            counters.ctypes.data_as(ctypes.c_void_p),
+        )
+        return counters, remote
 
     def decap_offset(self, frame: bytes, vni: int) -> int:
         """Offset of the inner frame if this is a VXLAN datagram for
