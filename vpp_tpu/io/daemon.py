@@ -178,14 +178,9 @@ class IODaemon:
         VXLAN on the uplink (in-row shift), parse in place, push."""
         lens = self._rx_lens
         if if_idx == self.uplink_if:
-            for i in range(n):
-                row = self._scratch[i]
-                off = self.codec.decap_offset(row[:lens[i]], self.vni)
-                if off:
-                    self.stats["vxlan_decap"] += 1
-                    inner = int(lens[i]) - off
-                    row[:inner] = row[off:lens[i]]
-                    lens[i] = inner
+            self.stats["vxlan_decap"] += self.codec.decap_batch(
+                self._scratch, lens, n, self.vni
+            )
         cols, n = self.codec.parse_inplace(self._scratch, lens, n, if_idx)
         self.mac.learn(cols, self._scratch, n)
         if self.rings.rx.push(cols, n, payload=self._scratch):

@@ -66,6 +66,7 @@ class Replicator:
         self.synced = threading.Event()      # first snapshot applied
         self._client: Optional[RemoteKVStore] = None
         self._heartbeat_thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()    # operator stop ≠ promotion
         self._lock = threading.Lock()
 
     # --- lifecycle ---
@@ -135,6 +136,8 @@ class Replicator:
                 c.ping()
                 last_ok = time.monotonic()
             except Exception:  # noqa: BLE001 — any failure counts
+                if self._stopped.is_set():
+                    return  # operator stop, not a dead primary
                 if time.monotonic() - last_ok > self.promote_after:
                     self._promote()
                     return
@@ -142,6 +145,9 @@ class Replicator:
                 return
 
     def stop(self) -> None:
+        # an operator stop must never look like a dead primary to the
+        # heartbeat (the close makes its next ping raise)
+        self._stopped.set()
         c = self._client
         self._client = None
         if c is not None:
@@ -172,7 +178,7 @@ class Replicator:
 
     # --- failover ---
     def _promote(self) -> None:
-        if self.promoted.is_set():
+        if self.promoted.is_set() or self._stopped.is_set():
             return
         self.promoted.set()
         log.warning(

@@ -385,63 +385,80 @@ uint32_t pio_parse_inplace(const uint8_t* payload, uint32_t snap,
 // static-ARP + rx-learning store; reference: configured static ARP
 // entries per pod link, plugins/contiv/pod.go:375-452). Open-addressed
 // hash, capacity a power of two, insert-only — overwrites refresh, a
-// full probe run evicts the home slot, occupancy never clears, so
-// probe chains stay intact without tombstones.
+// full probe run evicts an UNPINNED slot in the run, occupancy never
+// clears, so probe chains stay intact without tombstones. Static
+// control-plane entries are pinned: rx learning can refresh their MAC
+// but never evict them for an unrelated IP (a silent pod's entry must
+// survive table pressure or its no-flood guarantee is gone).
 //
 // Concurrency: the rx thread learns, the tx thread looks up and the
 // control thread installs static entries, all GIL-free (ctypes calls
-// release the GIL). Per-slot seqlock discipline: state 0 = empty
-// (ends a probe chain), 1 = write in progress (chain continues, entry
-// unreadable), 2 = valid. Writers store 1, write ip+mac, then
-// store-release 2; readers load-acquire state, copy, and re-check
-// state+ip — a torn 6-byte MAC copy can never be returned (the reader
-// falls back to a miss, i.e. broadcast: safe, not misdelivered). ----
+// release the GIL). Per-slot u32 SEQUENCE word: 0 = never written
+// (ends a probe chain), odd = write in progress, even>0 = valid
+// version. Writers take the slot with a CAS to odd (mutual exclusion —
+// concurrent writers retry the probe), write ip+mac, publish seq+2.
+// Readers snapshot the sequence, copy, and re-check sequence equality:
+// any complete rewrite during the copy changed the version (no ABA),
+// so a torn 6-byte MAC can never be returned — the reader degrades to
+// a miss (broadcast), never misdelivery. ----
 
 constexpr uint32_t kMacProbe = 16;
 
 static inline uint32_t mac_hash(uint32_t ip) { return ip * 0x9e3779b1u; }
 
-void pio_mac_put(uint32_t* ips, uint8_t* macs, uint8_t* state,
-                 uint32_t cap, uint32_t ip, const uint8_t* mac) {
+void pio_mac_put(uint32_t* ips, uint8_t* macs, uint32_t* seq,
+                 uint8_t* pin, uint32_t cap, uint32_t ip,
+                 const uint8_t* mac, uint32_t pin_flag) {
   uint32_t mask = cap - 1;
   uint32_t h = mac_hash(ip) & mask;
-  uint32_t slot = h;
-  for (uint32_t probe = 0; probe < kMacProbe; probe++) {
-    uint32_t s = (h + probe) & mask;
-    uint8_t st = __atomic_load_n(&state[s], __ATOMIC_ACQUIRE);
-    if (st == 0 || ips[s] == ip) {
-      slot = s;
-      break;
+  for (uint32_t attempt = 0; attempt < 4; attempt++) {
+    // pick a slot: empty, same-ip refresh, or (last resort) the first
+    // unpinned slot of the probe run
+    int32_t slot = -1, victim = -1;
+    for (uint32_t probe = 0; probe < kMacProbe; probe++) {
+      uint32_t s = (h + probe) & mask;
+      uint32_t sq = __atomic_load_n(&seq[s], __ATOMIC_ACQUIRE);
+      if (sq == 0 || __atomic_load_n(&ips[s], __ATOMIC_ACQUIRE) == ip) {
+        slot = static_cast<int32_t>(s);
+        break;
+      }
+      if (victim < 0 && !pin[s]) victim = static_cast<int32_t>(s);
     }
+    if (slot < 0) slot = victim;
+    if (slot < 0) return;  // whole probe run pinned: drop the learn
+    uint32_t s = static_cast<uint32_t>(slot);
+    uint32_t sq = __atomic_load_n(&seq[s], __ATOMIC_ACQUIRE);
+    if (sq & 1) continue;  // another writer mid-flight: re-probe
+    // claim the slot (writer mutual exclusion)
+    if (!__atomic_compare_exchange_n(&seq[s], &sq, sq + 1, false,
+                                     __ATOMIC_ACQ_REL, __ATOMIC_ACQUIRE)) {
+      continue;  // lost the race: re-probe
+    }
+    __atomic_store_n(&ips[s], ip, __ATOMIC_RELEASE);
+    std::memcpy(macs + static_cast<uint64_t>(s) * 6u, mac, 6);
+    if (pin_flag) pin[s] = 1;
+    __atomic_store_n(&seq[s], sq + 2, __ATOMIC_RELEASE);  // publish
+    return;
   }
-  // SEQ_CST: the invalidation must not be reordered (by compiler or
-  // CPU) after the ip/mac writes it guards
-  __atomic_store_n(&state[slot], 1, __ATOMIC_SEQ_CST);  // mark writing
-  __atomic_store_n(&ips[slot], ip, __ATOMIC_RELEASE);
-  std::memcpy(macs + static_cast<uint64_t>(slot) * 6u, mac, 6);
-  __atomic_store_n(&state[slot], 2, __ATOMIC_RELEASE);  // publish
 }
 
 int32_t pio_mac_get(const uint32_t* ips, const uint8_t* macs,
-                    const uint8_t* state, uint32_t cap, uint32_t ip,
+                    const uint32_t* seq, uint32_t cap, uint32_t ip,
                     uint8_t* out) {
   uint32_t mask = cap - 1;
   uint32_t h = mac_hash(ip) & mask;
   for (uint32_t probe = 0; probe < kMacProbe; probe++) {
     uint32_t s = (h + probe) & mask;
-    uint8_t st = __atomic_load_n(&state[s], __ATOMIC_ACQUIRE);
-    if (st == 0) return 0;              // chain end
-    if (st != 2) continue;              // mid-write: unreadable, probe on
+    uint32_t s1 = __atomic_load_n(&seq[s], __ATOMIC_ACQUIRE);
+    if (s1 == 0) return 0;              // chain end
+    if (s1 & 1) continue;               // mid-write: probe on
     if (__atomic_load_n(&ips[s], __ATOMIC_ACQUIRE) != ip) continue;
     std::memcpy(out, macs + static_cast<uint64_t>(s) * 6u, 6);
-    // validate: a concurrent rewrite of this slot during the copy
-    // makes the result unusable — report a miss (broadcast fallback)
     __atomic_thread_fence(__ATOMIC_ACQUIRE);
-    if (__atomic_load_n(&state[s], __ATOMIC_ACQUIRE) == 2 &&
-        __atomic_load_n(&ips[s], __ATOMIC_ACQUIRE) == ip) {
-      return 1;
-    }
-    return 0;
+    // sequence unchanged == no rewrite overlapped the copy (a full
+    // rewrite bumps the version by 2, so ABA cannot slip through)
+    if (__atomic_load_n(&seq[s], __ATOMIC_ACQUIRE) == s1) return 1;
+    return 0;                            // torn: miss (broadcast)
   }
   return 0;
 }
@@ -449,15 +466,39 @@ int32_t pio_mac_get(const uint32_t* ips, const uint8_t* macs,
 // Learn (src_ip -> source MAC) for every valid IPv4 packet of a parsed
 // frame in one pass — replaces a per-packet Python loop that capped
 // the rx path at ~1 Mpps. flags/src are the frame's column arrays.
-void pio_mac_learn(uint32_t* ips, uint8_t* macs, uint8_t* state,
-                   uint32_t cap, const int32_t* flags, const int32_t* src,
-                   const uint8_t* payload, uint32_t snap, uint32_t n) {
+void pio_mac_learn(uint32_t* ips, uint8_t* macs, uint32_t* seq,
+                   uint8_t* pin, uint32_t cap, const int32_t* flags,
+                   const int32_t* src, const uint8_t* payload,
+                   uint32_t snap, uint32_t n) {
   if (n > kVec) n = kVec;
   for (uint32_t i = 0; i < n; i++) {
     if (!(flags[i] & kFlagValid) || (flags[i] & kFlagNonIp4)) continue;
-    pio_mac_put(ips, macs, state, cap, static_cast<uint32_t>(src[i]),
-                payload + static_cast<uint64_t>(i) * snap + 6);
+    pio_mac_put(ips, macs, seq, pin, cap, static_cast<uint32_t>(src[i]),
+                payload + static_cast<uint64_t>(i) * snap + 6, 0);
   }
+}
+
+// Batch VXLAN decap for frames resident in payload rows (the uplink rx
+// path: every inter-node packet arrives encapsulated, and a per-packet
+// ctypes decap call capped that path at well under 1 Mpps): for each
+// row whose bytes are a VXLAN datagram of segment `vni`, shift the
+// inner frame to the row start and shrink lens[i]. Returns the number
+// of rows decapped.
+uint32_t pio_decap_batch(uint8_t* payload, uint32_t snap, uint32_t* lens,
+                         uint32_t n, uint32_t vni) {
+  if (n > kVec) n = kVec;
+  uint32_t decapped = 0;
+  for (uint32_t i = 0; i < n; i++) {
+    uint8_t* row = payload + static_cast<uint64_t>(i) * snap;
+    uint32_t len = lens[i] < snap ? lens[i] : snap;
+    uint32_t off = pio_decap_offset(row, len, vni);
+    if (!off) continue;
+    uint32_t inner = len - off;
+    std::memmove(row, row + off, inner);
+    lens[i] = inner;
+    decapped++;
+  }
+  return decapped;
 }
 
 // ---- tx dispatch: one native pass over a tx frame (the
@@ -476,7 +517,7 @@ void pio_tx_dispatch(const int32_t* cols, uint8_t* payload, uint32_t snap,
                      const uint8_t* if_macs, uint32_t n_if,
                      int32_t uplink_if, int32_t host_if,
                      const uint32_t* mac_ips, const uint8_t* mac_macs,
-                     const uint8_t* mac_state, uint32_t mac_cap,
+                     const uint32_t* mac_seq, uint32_t mac_cap,
                      uint32_t* remote_rows, uint32_t* counters) {
   const int32_t* flags = cols + kFlags * kVec;
   const int32_t* disp = cols + kDisp * kVec;
@@ -536,7 +577,7 @@ void pio_tx_dispatch(const int32_t* cols, uint8_t* payload, uint32_t snap,
     }
     if (set_mac) {
       uint8_t* raw = payload + static_cast<uint64_t>(i) * snap;
-      if (!pio_mac_get(mac_ips, mac_macs, mac_state, mac_cap,
+      if (!pio_mac_get(mac_ips, mac_macs, mac_seq, mac_cap,
                        static_cast<uint32_t>(dst_ip[i]), raw)) {
         std::memset(raw, 0xff, 6);  // broadcast fallback
       }

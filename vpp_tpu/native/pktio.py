@@ -98,10 +98,16 @@ def _load() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p,
             ctypes.c_uint32, ctypes.c_int32, ctypes.c_void_p,
         ]
+        lib.pio_decap_batch.restype = ctypes.c_uint32
+        lib.pio_decap_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p,
+            ctypes.c_uint32, ctypes.c_uint32,
+        ]
         lib.pio_mac_put.restype = None
         lib.pio_mac_put.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-            ctypes.c_uint32, ctypes.c_uint32, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
+            ctypes.c_void_p, ctypes.c_uint32,
         ]
         lib.pio_mac_get.restype = ctypes.c_int32
         lib.pio_mac_get.argtypes = [
@@ -111,8 +117,9 @@ def _load() -> ctypes.CDLL:
         lib.pio_mac_learn.restype = None
         lib.pio_mac_learn.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-            ctypes.c_uint32, ctypes.c_void_p, ctypes.c_void_p,
-            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32,
+            ctypes.c_uint32,
         ]
         lib.pio_tx_dispatch.restype = None
         lib.pio_tx_dispatch.argtypes = [
@@ -141,16 +148,24 @@ class MacTable:
         self.capacity = capacity
         self.ips = np.zeros(capacity, np.uint32)
         self.macs = np.zeros((capacity, 6), np.uint8)
-        self.state = np.zeros(capacity, np.uint8)
+        # per-slot seqlock word (0 empty, odd writing, even>0 valid)
+        self.seq = np.zeros(capacity, np.uint32)
+        # pinned = static control-plane entry: rx learning may refresh
+        # its MAC but never evict it for an unrelated IP
+        self.pin = np.zeros(capacity, np.uint8)
         self._lib = _load()
 
-    def put(self, ip: int, mac: bytes) -> None:
+    def put(self, ip: int, mac: bytes, pin: bool = True) -> None:
+        """Install an entry; ``pin`` (default, the control-plane path)
+        protects it from learning-pressure eviction."""
         self._lib.pio_mac_put(
             self.ips.ctypes.data_as(ctypes.c_void_p),
             self.macs.ctypes.data_as(ctypes.c_void_p),
-            self.state.ctypes.data_as(ctypes.c_void_p),
+            self.seq.ctypes.data_as(ctypes.c_void_p),
+            self.pin.ctypes.data_as(ctypes.c_void_p),
             self.capacity, ip & 0xFFFFFFFF,
             (ctypes.c_char * 6).from_buffer_copy(mac),
+            1 if pin else 0,
         )
 
     def get(self, ip: int) -> Optional[bytes]:
@@ -158,7 +173,7 @@ class MacTable:
         found = self._lib.pio_mac_get(
             self.ips.ctypes.data_as(ctypes.c_void_p),
             self.macs.ctypes.data_as(ctypes.c_void_p),
-            self.state.ctypes.data_as(ctypes.c_void_p),
+            self.seq.ctypes.data_as(ctypes.c_void_p),
             self.capacity, ip & 0xFFFFFFFF,
             out.ctypes.data_as(ctypes.c_void_p),
         )
@@ -173,7 +188,8 @@ class MacTable:
         self._lib.pio_mac_learn(
             self.ips.ctypes.data_as(ctypes.c_void_p),
             self.macs.ctypes.data_as(ctypes.c_void_p),
-            self.state.ctypes.data_as(ctypes.c_void_p),
+            self.seq.ctypes.data_as(ctypes.c_void_p),
+            self.pin.ctypes.data_as(ctypes.c_void_p),
             self.capacity,
             flags.ctypes.data_as(ctypes.c_void_p),
             src.ctypes.data_as(ctypes.c_void_p),
@@ -315,12 +331,23 @@ class PacketCodec:
             len(if_indices), uplink_if, host_if,
             mac.ips.ctypes.data_as(ctypes.c_void_p),
             mac.macs.ctypes.data_as(ctypes.c_void_p),
-            mac.state.ctypes.data_as(ctypes.c_void_p),
+            mac.seq.ctypes.data_as(ctypes.c_void_p),
             mac.capacity,
             remote.ctypes.data_as(ctypes.c_void_p),
             counters.ctypes.data_as(ctypes.c_void_p),
         )
         return counters, remote
+
+    def decap_batch(self, scratch: np.ndarray, lens: np.ndarray,
+                    n: int, vni: int) -> int:
+        """Decap every VXLAN row of segment ``vni`` in place (inner
+        frame shifted to row start, lens shrunk) in ONE native pass —
+        the uplink rx path, where a per-packet ctypes decap call was
+        the throughput cap. Returns rows decapped."""
+        return int(self.lib.pio_decap_batch(
+            scratch.ctypes.data_as(ctypes.c_void_p), scratch.shape[1],
+            lens.ctypes.data_as(ctypes.c_void_p), n, vni & 0xFFFFFF,
+        ))
 
     def decap_offset(self, frame: bytes, vni: int) -> int:
         """Offset of the inner frame if this is a VXLAN datagram for
