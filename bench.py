@@ -956,9 +956,13 @@ def _run():
     ap.add_argument("--cpu", action="store_true", help="force CPU (debug)")
     ap.add_argument("--no-subbench", action="store_true",
                     help="skip the secondary BASELINE configs (#1/#3/#4)")
-    ap.add_argument("--retries", type=int, default=3,
+    # generous probe window: the axon tunnel wedges for long stretches
+    # (hours observed) and recovers on its own; a premature CPU
+    # fallback records a meaningless headline for the round, so spend
+    # up to ~15 min looking for the chip before giving up on it
+    ap.add_argument("--retries", type=int, default=12,
                     help="TPU backend init attempts before CPU fallback")
-    ap.add_argument("--retry-delay", type=float, default=10.0)
+    ap.add_argument("--retry-delay", type=float, default=15.0)
     args = ap.parse_args()
 
     if args.cpu:

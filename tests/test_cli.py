@@ -127,3 +127,38 @@ def test_show_io_with_pump_and_daemon():
         control.close()
         daemon.stop()
         rings.close()
+
+
+def test_show_neighbors_lists_static_and_learned():
+    """show neighbors renders the daemon's (ip → MAC) table over the
+    control socket — the `show ip arp` analog; static entries carry S."""
+    import tempfile
+
+    from vpp_tpu.cli import DebugCLI
+    from vpp_tpu.io.control import IOControlClient, IOControlServer
+    from vpp_tpu.io.daemon import IODaemon
+    from vpp_tpu.io.rings import IORingPair
+    from vpp_tpu.pipeline.dataplane import Dataplane
+    from vpp_tpu.pipeline.tables import DataplaneConfig
+    from vpp_tpu.pipeline.vector import ip4
+
+    dp = Dataplane(DataplaneConfig())
+    rings = IORingPair(n_slots=8)
+    daemon = IODaemon(rings, {}, uplink_if=0)
+    sock = tempfile.mktemp(suffix=".sock")
+    control = IOControlServer(daemon, sock).start()
+    try:
+        client = IOControlClient(sock)
+        client.set_mac(ip4("10.1.1.7"), bytes.fromhex("02aabbccddee"))
+        daemon.mac.put(ip4("10.1.1.8"), bytes.fromhex("020102030405"),
+                       pin=False)  # "learned"
+        cli = DebugCLI(dp, io_ctl=client)
+        out = cli.run("show neighbors")
+        assert "10.1.1.7" in out and "02:aa:bb:cc:dd:ee" in out
+        line7 = next(ln for ln in out.splitlines() if "10.1.1.7" in ln)
+        line8 = next(ln for ln in out.splitlines() if "10.1.1.8" in ln)
+        assert line7.rstrip().endswith("S")
+        assert not line8.rstrip().endswith("S")
+    finally:
+        control.close()
+        rings.close()

@@ -43,6 +43,7 @@ class DebugCLI:
             ("show", "trace"): self.show_trace,
             ("show", "errors"): self.show_errors,
             ("show", "io"): self.show_io,
+            ("show", "neighbors"): self.show_neighbors,
             ("help",): self.help,
         }
         for sig, fn in handlers.items():
@@ -53,7 +54,8 @@ class DebugCLI:
     def help(self) -> str:
         return (
             "commands: show interface | show acl | show session | "
-            "show nat44 | show fib | show trace | show errors | show io"
+            "show nat44 | show fib | show trace | show errors | "
+            "show io | show neighbors"
         )
 
     # --- commands ---
@@ -220,6 +222,24 @@ class DebugCLI:
             except Exception as e:  # noqa: BLE001 — daemon may be down
                 lines.append(f"io-daemon: unreachable ({e})")
         return "\n".join(lines) if lines else "no IO front-end attached"
+
+    def show_neighbors(self) -> str:
+        """The IO daemon's (ip → MAC) neighbor table — the `show ip
+        arp` analog (static entries from the control plane are marked
+        S, rx-learned entries are unmarked)."""
+        if self.io_ctl is None:
+            return "no IO front-end attached"
+        try:
+            entries = self.io_ctl.neighbors()
+        except Exception as e:  # noqa: BLE001 — daemon may be down
+            return f"io-daemon: unreachable ({e})"
+        from vpp_tpu.pipeline.vector import ip4_str
+
+        lines = [f"{'ip':<16} {'mac':<18} flags"]
+        for ip, mac, pin in sorted(entries):
+            mac_s = ":".join(f"{b:02x}" for b in mac)
+            lines.append(f"{ip4_str(ip):<16} {mac_s:<18} {'S' if pin else ''}")
+        return "\n".join(lines)
 
     def show_trace(self) -> str:
         if self.tracer is None:

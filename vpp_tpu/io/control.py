@@ -15,6 +15,7 @@ binary API (plugins/contiv/remote_cni_server.go:895-1250):
                                  flood fallback for known pods
   stats    {}                    daemon counters
   list     {}                    current interface table
+  neighbors {}                   (ip → MAC) table dump (show ip arp)
 
 One request per connection, newline-delimited JSON — same wire shape as
 the CNI shim transport (cni/transport.py), so the protocol layer is
@@ -63,6 +64,14 @@ class IOControlServer:
                 return {"result": 0}
             if method == "stats":
                 return {"result": 0, "stats": dict(self.daemon.stats)}
+            if method == "neighbors":
+                return {
+                    "result": 0,
+                    "neighbors": [
+                        {"ip": ip, "mac": mac.hex(), "pin": pin}
+                        for ip, mac, pin in self.daemon.mac.entries()
+                    ],
+                }
             if method == "list":
                 return {
                     "result": 0,
@@ -104,6 +113,14 @@ class IOControlClient:
 
     def stats(self) -> dict:
         return self._call("stats")["stats"]
+
+    def neighbors(self) -> list:
+        """The daemon's (ip → MAC) neighbor table: list of
+        (ip, mac_bytes, pinned) — `show ip arp` analog data."""
+        return [
+            (int(e["ip"]), bytes.fromhex(e["mac"]), bool(e["pin"]))
+            for e in self._call("neighbors")["neighbors"]
+        ]
 
     def list_interfaces(self) -> dict:
         return {int(k): v

@@ -93,7 +93,10 @@ class IODaemon:
         """Static (ip → MAC) entry — the reference's configured static
         ARP for pod links (pod.go:375-452); rx learning keeps it fresh
         but the first packet toward a silent pod no longer floods."""
-        self.mac.put(int(ip), bytes(mac))
+        if not self.mac.put(int(ip), bytes(mac)):
+            # surfaced as an RPC error through the control socket: a
+            # silently missing static means permanent broadcast flood
+            raise RuntimeError("neighbor table rejected static entry")
 
     # --- lifecycle ---
     def start(self) -> "IODaemon":

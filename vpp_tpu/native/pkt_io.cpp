@@ -406,26 +406,47 @@ constexpr uint32_t kMacProbe = 16;
 
 static inline uint32_t mac_hash(uint32_t ip) { return ip * 0x9e3779b1u; }
 
-void pio_mac_put(uint32_t* ips, uint8_t* macs, uint32_t* seq,
-                 uint8_t* pin, uint32_t cap, uint32_t ip,
-                 const uint8_t* mac, uint32_t pin_flag) {
+// Returns 1 when the entry was installed, 0 when dropped (probe run
+// fully pinned for an UNPINNED learn, or pathological CAS contention).
+// A pinned (control-plane) put never drops for pin pressure: statics
+// outrank learned entries AND each other's slots — the caller surfaces
+// a 0 as an RPC error instead of silently not installing.
+int32_t pio_mac_put(uint32_t* ips, uint8_t* macs, uint32_t* seq,
+                    uint8_t* pin, uint32_t cap, uint32_t ip,
+                    const uint8_t* mac, uint32_t pin_flag) {
   uint32_t mask = cap - 1;
   uint32_t h = mac_hash(ip) & mask;
-  for (uint32_t attempt = 0; attempt < 4; attempt++) {
+  enum { kEmpty, kRefresh, kVictim, kPinnedVictim };
+  for (uint32_t attempt = 0; attempt < 64; attempt++) {
     // pick a slot: empty, same-ip refresh, or (last resort) the first
-    // unpinned slot of the probe run
+    // unpinned slot of the probe run; a pinned put may evict a pinned
+    // victim when everything is pinned
     int32_t slot = -1, victim = -1;
+    int kind = kEmpty;
     for (uint32_t probe = 0; probe < kMacProbe; probe++) {
       uint32_t s = (h + probe) & mask;
       uint32_t sq = __atomic_load_n(&seq[s], __ATOMIC_ACQUIRE);
-      if (sq == 0 || __atomic_load_n(&ips[s], __ATOMIC_ACQUIRE) == ip) {
+      if (sq == 0) {
         slot = static_cast<int32_t>(s);
+        kind = kEmpty;
+        break;
+      }
+      if (__atomic_load_n(&ips[s], __ATOMIC_ACQUIRE) == ip) {
+        slot = static_cast<int32_t>(s);
+        kind = kRefresh;
         break;
       }
       if (victim < 0 && !pin[s]) victim = static_cast<int32_t>(s);
     }
-    if (slot < 0) slot = victim;
-    if (slot < 0) return;  // whole probe run pinned: drop the learn
+    if (slot < 0 && victim >= 0) {
+      slot = victim;
+      kind = kVictim;
+    }
+    if (slot < 0) {
+      if (!pin_flag) return 0;  // whole run pinned: drop the learn
+      slot = static_cast<int32_t>(h);  // static outranks static: home
+      kind = kPinnedVictim;
+    }
     uint32_t s = static_cast<uint32_t>(slot);
     uint32_t sq = __atomic_load_n(&seq[s], __ATOMIC_ACQUIRE);
     if (sq & 1) continue;  // another writer mid-flight: re-probe
@@ -434,12 +455,36 @@ void pio_mac_put(uint32_t* ips, uint8_t* macs, uint32_t* seq,
                                      __ATOMIC_ACQ_REL, __ATOMIC_ACQUIRE)) {
       continue;  // lost the race: re-probe
     }
+    // re-validate the selection criteria UNDER the claim: between
+    // selection and the CAS another writer may have completed a full
+    // cycle (the CAS only proves seq didn't change since our re-read),
+    // e.g. a pinned static landing in "our" empty slot — overwriting
+    // it here would evict the very entry pinning protects
+    bool ok = true;
+    if (kind == kEmpty) {
+      ok = (sq == 0);
+    } else if (kind == kRefresh) {
+      ok = (__atomic_load_n(&ips[s], __ATOMIC_ACQUIRE) == ip);
+    } else if (kind == kVictim) {
+      ok = !pin[s];
+    }  // kPinnedVictim: unconditional — control plane wins
+    if (!ok) {
+      __atomic_store_n(&seq[s], sq, __ATOMIC_RELEASE);  // release claim
+      continue;  // re-probe with fresh state
+    }
     __atomic_store_n(&ips[s], ip, __ATOMIC_RELEASE);
     std::memcpy(macs + static_cast<uint64_t>(s) * 6u, mac, 6);
-    if (pin_flag) pin[s] = 1;
+    if (pin_flag) {
+      pin[s] = 1;
+    } else if (kind == kEmpty || kind == kVictim) {
+      // a learned entry occupying a slot must not inherit a stale pin
+      // (slot may have held a static for a since-deleted pod)
+      pin[s] = 0;
+    }
     __atomic_store_n(&seq[s], sq + 2, __ATOMIC_RELEASE);  // publish
-    return;
+    return 1;
   }
+  return 0;  // pathological contention: caller decides (learns drop)
 }
 
 int32_t pio_mac_get(const uint32_t* ips, const uint8_t* macs,

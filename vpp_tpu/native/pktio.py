@@ -103,7 +103,7 @@ def _load() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p,
             ctypes.c_uint32, ctypes.c_uint32,
         ]
-        lib.pio_mac_put.restype = None
+        lib.pio_mac_put.restype = ctypes.c_int32
         lib.pio_mac_put.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
@@ -155,10 +155,13 @@ class MacTable:
         self.pin = np.zeros(capacity, np.uint8)
         self._lib = _load()
 
-    def put(self, ip: int, mac: bytes, pin: bool = True) -> None:
+    def put(self, ip: int, mac: bytes, pin: bool = True) -> bool:
         """Install an entry; ``pin`` (default, the control-plane path)
-        protects it from learning-pressure eviction."""
-        self._lib.pio_mac_put(
+        protects it from learning-pressure eviction. Returns False when
+        the entry could NOT be installed (unpinned put into a fully
+        pinned probe run, or pathological contention) — control-plane
+        callers must surface that, never swallow it."""
+        return bool(self._lib.pio_mac_put(
             self.ips.ctypes.data_as(ctypes.c_void_p),
             self.macs.ctypes.data_as(ctypes.c_void_p),
             self.seq.ctypes.data_as(ctypes.c_void_p),
@@ -166,7 +169,7 @@ class MacTable:
             self.capacity, ip & 0xFFFFFFFF,
             (ctypes.c_char * 6).from_buffer_copy(mac),
             1 if pin else 0,
-        )
+        ))
 
     def get(self, ip: int) -> Optional[bytes]:
         out = np.zeros(6, np.uint8)
@@ -178,6 +181,16 @@ class MacTable:
             out.ctypes.data_as(ctypes.c_void_p),
         )
         return out.tobytes() if found else None
+
+    def entries(self) -> list:
+        """Snapshot of valid entries: [(ip, mac_bytes, pinned), ...]
+        (debug/CLI path — races with writers are benign here, a torn
+        row just shows a transient value in `show neighbors`)."""
+        valid = (self.seq > 0) & (self.seq % 2 == 0)
+        return [
+            (int(self.ips[i]), self.macs[i].tobytes(), bool(self.pin[i]))
+            for i in np.nonzero(valid)[0]
+        ]
 
     def learn(self, cols: Dict[str, np.ndarray], payload: np.ndarray,
               n: int) -> None:
