@@ -546,6 +546,68 @@ uint32_t pio_decap_batch(uint8_t* payload, uint32_t snap, uint32_t* lens,
   return decapped;
 }
 
+// Batch VXLAN encap + transmit for REMOTE-disposed rows (the
+// vxlan-encap -> interface-output chain; completes the native tx path —
+// pio_tx_dispatch hands these rows back by index, and a per-packet
+// Python encap+send would cap inter-node traffic the way the local
+// path used to be capped). Each inner frame is wrapped into its
+// scratch row (outer Ethernet+IPv4+UDP+VXLAN via pio_encap, dst MAC
+// from the neighbor table, flow-entropy source port), then the batch
+// goes out in sendmmsg chunks (or write() for a TAP uplink).
+// Returns frames sent.
+int32_t pio_encap_tx_batch(const int32_t* cols, const uint8_t* payload,
+                           uint32_t snap, const uint32_t* rows, uint32_t n,
+                           uint32_t vtep_ip, uint32_t vni,
+                           const uint8_t* src_mac,
+                           const uint32_t* mac_ips, const uint8_t* mac_macs,
+                           const uint32_t* mac_seq, uint32_t mac_cap,
+                           int32_t fd, uint32_t fd_is_sock,
+                           uint8_t* scratch, uint32_t scratch_stride) {
+  const int32_t* pkt_len = cols + kPktLen * kVec;
+  const int32_t* next_hop = cols + kNextHop * kVec;
+  const int32_t* dst_ip = cols + kDstIp * kVec;
+  if (n > kVec) n = kVec;
+  uint32_t out_rows[kVec], out_lens[kVec], k = 0;
+  uint8_t bcast[6];
+  std::memset(bcast, 0xff, 6);
+  for (uint32_t j = 0; j < n; j++) {
+    uint32_t row = rows[j];
+    if (row >= kVec) continue;
+    uint32_t wire = static_cast<uint32_t>(pkt_len[row]) + kEthHdr;
+    if (wire > snap) wire = snap;
+    if (wire + 50 > scratch_stride) continue;  // no headroom: skip
+    uint32_t nh = static_cast<uint32_t>(next_hop[row]);
+    uint8_t dst_mac[6];
+    if (!pio_mac_get(mac_ips, mac_macs, mac_seq, mac_cap, nh, dst_mac)) {
+      std::memcpy(dst_mac, bcast, 6);
+    }
+    uint32_t total = pio_encap(
+        payload + static_cast<uint64_t>(row) * snap, wire, vtep_ip, nh,
+        static_cast<uint16_t>(
+            49152 + (static_cast<uint32_t>(dst_ip[row]) & 0x3FFF)),
+        vni, src_mac, dst_mac,
+        scratch + static_cast<uint64_t>(k) * scratch_stride);
+    if (!total) continue;
+    out_rows[k] = k;
+    out_lens[k] = total;
+    k++;
+  }
+  if (!k) return 0;
+  if (fd_is_sock) {
+    return pio_send_batch(fd, scratch, scratch_stride, out_rows, out_lens,
+                          k);
+  }
+  int32_t sent = 0;
+  for (uint32_t j = 0; j < k; j++) {
+    ssize_t rc = write(fd, scratch + static_cast<uint64_t>(j) *
+                               scratch_stride,
+                       out_lens[j]);
+    if (rc < 0) break;
+    sent++;
+  }
+  return sent;
+}
+
 // ---- tx dispatch: one native pass over a tx frame (the
 // interface-output node; reference: VPP's l2/ip4-rewrite +
 // interface-output run per vector in C, never per packet in a slow

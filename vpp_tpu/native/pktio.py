@@ -103,6 +103,15 @@ def _load() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p,
             ctypes.c_uint32, ctypes.c_uint32,
         ]
+        lib.pio_encap_tx_batch.restype = ctypes.c_int32
+        lib.pio_encap_tx_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32,
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
+            ctypes.c_uint32, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32,
+            ctypes.c_int32, ctypes.c_uint32, ctypes.c_void_p,
+            ctypes.c_uint32,
+        ]
         lib.pio_mac_put.restype = ctypes.c_int32
         lib.pio_mac_put.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
@@ -314,6 +323,32 @@ class PacketCodec:
             for i, (name, dtype) in enumerate(RING_COLUMNS)
         }
         return cols, n
+
+    def encap_tx_batch(self, cols, payload: np.ndarray, rows: np.ndarray,
+                       n: int, vtep_ip: int, vni: int, src_mac: bytes,
+                       mac: "MacTable", fd: int, fd_is_sock: bool,
+                       scratch: np.ndarray) -> int:
+        """VXLAN-encap the selected payload rows into ``scratch`` rows
+        and transmit them toward the uplink in one native pass (pkt_len,
+        next_hop and dst_ip come straight from the flat column block;
+        outer headers + neighbor-table VTEP MAC + sendmmsg). Returns
+        frames sent."""
+        if n == 0:
+            return 0
+        flat = flatten_cols(cols)
+        return int(self.lib.pio_encap_tx_batch(
+            flat.ctypes.data_as(ctypes.c_void_p),
+            payload.ctypes.data_as(ctypes.c_void_p), payload.shape[1],
+            np.ascontiguousarray(rows[:n], np.uint32).ctypes.data_as(
+                ctypes.c_void_p),
+            n, vtep_ip & 0xFFFFFFFF, vni & 0xFFFFFF,
+            (ctypes.c_char * 6).from_buffer_copy(src_mac),
+            mac.ips.ctypes.data_as(ctypes.c_void_p),
+            mac.macs.ctypes.data_as(ctypes.c_void_p),
+            mac.seq.ctypes.data_as(ctypes.c_void_p),
+            mac.capacity, fd, 1 if fd_is_sock else 0,
+            scratch.ctypes.data_as(ctypes.c_void_p), scratch.shape[1],
+        ))
 
     def tx_dispatch(self, cols, payload: np.ndarray,
                     n: int, if_indices: np.ndarray, if_fds: np.ndarray,
