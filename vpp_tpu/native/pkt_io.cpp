@@ -98,6 +98,33 @@ void csum_update32(uint8_t* ck, uint32_t old, uint32_t neu) {
 
 inline int32_t* col(int32_t* cols, int c) { return cols + c * kVec; }
 
+// Per-frame write() transmission for char-device (TAP) fds — sendmmsg
+// rejects non-sockets. Short-count-on-error policy matches the socket
+// path: the caller counts the remainder as drops.
+int32_t write_rows(int32_t fd, const uint8_t* base, uint32_t stride,
+                   const uint32_t* rows, const uint32_t* lens, uint32_t n) {
+  int32_t sent = 0;
+  for (uint32_t j = 0; j < n; j++) {
+    ssize_t rc = write(fd, base + static_cast<uint64_t>(rows[j]) * stride,
+                       lens[j]);
+    if (rc < 0) break;
+    sent++;
+  }
+  return sent;
+}
+
+// Identity row indices for batches compacted sequentially into a
+// scratch area (pio_send_batch addresses by row index).
+const uint32_t* identity_rows() {
+  static uint32_t rows[kVec];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < kVec; i++) rows[i] = i;
+    init = true;
+  }
+  return rows;
+}
+
 // Field extraction for one frame at slot i (shared by the copying and
 // in-place parse entry points). `f` points at the frame bytes, `len`
 // is the wire length, `copy` the bytes actually available (<= snap).
@@ -567,7 +594,7 @@ int32_t pio_encap_tx_batch(const int32_t* cols, const uint8_t* payload,
   const int32_t* next_hop = cols + kNextHop * kVec;
   const int32_t* dst_ip = cols + kDstIp * kVec;
   if (n > kVec) n = kVec;
-  uint32_t out_rows[kVec], out_lens[kVec], k = 0;
+  uint32_t out_lens[kVec], k = 0;
   uint8_t bcast[6];
   std::memset(bcast, 0xff, 6);
   for (uint32_t j = 0; j < n; j++) {
@@ -581,31 +608,22 @@ int32_t pio_encap_tx_batch(const int32_t* cols, const uint8_t* payload,
     if (!pio_mac_get(mac_ips, mac_macs, mac_seq, mac_cap, nh, dst_mac)) {
       std::memcpy(dst_mac, bcast, 6);
     }
-    uint32_t total = pio_encap(
+    out_lens[k] = pio_encap(
         payload + static_cast<uint64_t>(row) * snap, wire, vtep_ip, nh,
         static_cast<uint16_t>(
             49152 + (static_cast<uint32_t>(dst_ip[row]) & 0x3FFF)),
         vni, src_mac, dst_mac,
         scratch + static_cast<uint64_t>(k) * scratch_stride);
-    if (!total) continue;
-    out_rows[k] = k;
-    out_lens[k] = total;
     k++;
   }
   if (!k) return 0;
+  // encapped frames are compacted sequentially into scratch rows
   if (fd_is_sock) {
-    return pio_send_batch(fd, scratch, scratch_stride, out_rows, out_lens,
-                          k);
+    return pio_send_batch(fd, scratch, scratch_stride, identity_rows(),
+                          out_lens, k);
   }
-  int32_t sent = 0;
-  for (uint32_t j = 0; j < k; j++) {
-    ssize_t rc = write(fd, scratch + static_cast<uint64_t>(j) *
-                               scratch_stride,
-                       out_lens[j]);
-    if (rc < 0) break;
-    sent++;
-  }
-  return sent;
+  return write_rows(fd, scratch, scratch_stride, identity_rows(),
+                    out_lens, k);
 }
 
 // ---- tx dispatch: one native pass over a tx frame (the
@@ -704,17 +722,11 @@ void pio_tx_dispatch(const int32_t* cols, uint8_t* payload, uint32_t snap,
       }
     }
     if (!k) continue;
-    int32_t sent = 0;
+    int32_t sent;
     if (if_sock[s]) {
       sent = pio_send_batch(if_fds[s], payload, snap, rows, lens, k);
-    } else {
-      for (uint32_t j = 0; j < k; j++) {  // TAP: one write per frame
-        ssize_t rc = write(if_fds[s],
-                           payload + static_cast<uint64_t>(rows[j]) * snap,
-                           lens[j]);
-        if (rc < 0) break;
-        sent++;
-      }
+    } else {  // TAP char device: one write per frame
+      sent = write_rows(if_fds[s], payload, snap, rows, lens, k);
     }
     bool punt = if_indices[s] == host_if;
     counters[punt ? 2 : 0] += static_cast<uint32_t>(sent);
