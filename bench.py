@@ -946,14 +946,20 @@ def main():
 def _run():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rules", type=int, default=10240)
-    ap.add_argument("--packets", type=int, default=65536,
-                    help="packets per pipeline step (throughput run)")
+    ap.add_argument("--packets", type=int, default=None,
+                    help="packets per pipeline step (throughput run; "
+                         "default 65536, auto-shrunk on CPU fallback)")
     ap.add_argument("--backends", type=int, default=100)
-    ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--iters", type=int, default=None,
+                    help="throughput iterations (default 50, "
+                         "auto-shrunk on CPU fallback)")
     ap.add_argument("--warmup", type=int, default=5)
     ap.add_argument("--latency-frame", type=int, default=256,
                     help="frame size for the added-latency measurement")
     ap.add_argument("--cpu", action="store_true", help="force CPU (debug)")
+    ap.add_argument("--cpu-full", action="store_true", dest="cpu_full",
+                    help="run full-size workloads even on the CPU "
+                         "fallback (slow; default shrinks them)")
     ap.add_argument("--no-subbench", action="store_true",
                     help="skip the secondary BASELINE configs (#1/#3/#4)")
     # generous probe window: the axon tunnel wedges for long stretches
@@ -999,6 +1005,19 @@ def _run():
     import jax.numpy as jnp
 
     from vpp_tpu.pipeline.graph import pipeline_step, pipeline_step_mxu
+
+    # CPU fallback: a full-size step costs ~8.5 s on this host (the
+    # whole run would exceed typical driver timeouts and record
+    # NOTHING). Defaults shrink to diagnostic sizes; explicitly passed
+    # sizes are honored (None sentinels distinguish the two).
+    shrink = (jax.default_backend() == "cpu" and not args.cpu_full)
+    cpu_fallback = False
+    if args.packets is None:
+        args.packets = 8192 if shrink else 65536
+        cpu_fallback = cpu_fallback or shrink
+    if args.iters is None:
+        args.iters = 10 if shrink else 50
+        cpu_fallback = cpu_fallback or shrink
 
     dp, uplink = build_dataplane(args.rules, args.backends)
     step_fn = pipeline_step_mxu if dp._use_mxu else pipeline_step
@@ -1089,6 +1108,7 @@ def _run():
                     ),
                     "latency_frame": args.latency_frame,
                     "backend": jax.default_backend(),
+                    "cpu_fallback_reduced": cpu_fallback,
                     **subs,
                 },
             }

@@ -1,0 +1,27 @@
+"""Cluster sharding beyond the default test mesh: 16 virtual devices.
+
+The driver validates the multi-chip path at 8 devices
+(__graft_entry__.dryrun_multichip); this proves the (node, rule) mesh
+factorization, shardings and collectives also compile and execute at
+the next power of two — in a subprocess, because the device count must
+be fixed before jax initializes.
+"""
+
+import os
+import subprocess
+import sys
+
+
+def test_dryrun_multichip_16_devices():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(16); "
+         "print('OK16')"],
+        env=env, capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "OK16" in proc.stdout
