@@ -162,3 +162,54 @@ def test_show_neighbors_lists_static_and_learned():
     finally:
         control.close()
         rings.close()
+
+
+def test_connectivity_probe_reports_verdict_and_path():
+    """`test connectivity` injects a synthetic packet from the pod's
+    interface, traces it, and reports the verdict (the robot-suite
+    ping/TCP checks as a one-shot vppctl command)."""
+    import ipaddress
+
+    from vpp_tpu.cli import DebugCLI
+    from vpp_tpu.ir.rule import Action, ContivRule, Protocol
+    from vpp_tpu.pipeline.dataplane import Dataplane
+    from vpp_tpu.pipeline.tables import DataplaneConfig
+    from vpp_tpu.pipeline.vector import Disposition
+
+    dp = Dataplane(DataplaneConfig())
+    a = dp.add_pod_interface(("default", "a"))
+    b = dp.add_pod_interface(("default", "b"))
+    dp.builder.add_route("10.1.1.2/32", a, Disposition.LOCAL)
+    dp.builder.add_route("10.1.1.3/32", b, Disposition.LOCAL)
+    slot = dp.alloc_table_slot("t")
+    dp.builder.set_local_table(slot, [
+        ContivRule(action=Action.PERMIT, protocol=Protocol.TCP,
+                   dest_network=ipaddress.ip_network("10.1.1.3/32"),
+                   dest_port=80),
+        ContivRule(action=Action.DENY, protocol=Protocol.TCP),
+    ])
+    dp.assign_pod_table(("default", "a"), "t")
+    dp.swap()
+
+    cli = DebugCLI(dp)
+    ok = cli.run("test connectivity 10.1.1.2 10.1.1.3 tcp 80")
+    assert "FORWARDED" in ok and f"if {b}" in ok
+    assert "ip4-input" in ok  # the traced path is shown
+
+    denied = cli.run("test connectivity 10.1.1.2 10.1.1.3 tcp 443")
+    assert "DROPPED" in denied
+
+    unknown_src = cli.run("test connectivity 172.16.9.9 10.1.1.3 tcp 80")
+    assert "no LOCAL route" in unknown_src
+
+    # operator typos degrade to messages, not tracebacks
+    assert "bad argument" in cli.run(
+        "test connectivity pod-a 10.1.1.3 tcp 80")
+    assert "bad argument" in cli.run(
+        "test connectivity 10.1.1.2 10.1.1.3 tcp http")
+
+    # the probe is side-effect free: no reflective session was
+    # installed for the permitted flow (a debug command must not open
+    # a return-traffic hole)
+    import numpy as np
+    assert int(np.asarray(dp.tables.sess_valid).sum()) == 0

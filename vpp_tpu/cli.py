@@ -49,13 +49,16 @@ class DebugCLI:
         for sig, fn in handlers.items():
             if tuple(parts[: len(sig)]) == sig:
                 return fn()
+        if tuple(parts[:2]) == ("test", "connectivity"):
+            return self.test_connectivity(parts[2:])
         return f"unknown command: {line.strip()!r} (try 'help')"
 
     def help(self) -> str:
         return (
             "commands: show interface | show acl | show session | "
             "show nat44 | show fib | show trace | show errors | "
-            "show io | show neighbors"
+            "show io | show neighbors | "
+            "test connectivity <src> <dst> <tcp|udp|icmp> [dport]"
         )
 
     # --- commands ---
@@ -175,6 +178,74 @@ class DebugCLI:
                 f"-> if {int(b.fib_tx_if[i])} [{disp}]{extra}"
             )
         return "\n".join(sorted(lines)) or "empty FIB"
+
+    def _resolve_rx_if(self, src_ip: int):
+        """Longest-prefix FIB match for ``src_ip`` with a LOCAL
+        disposition → that pod's interface is where its traffic enters
+        the vswitch (the reference's per-pod rx interface)."""
+        b = self.dp.builder
+        plen = np.asarray(b.fib_plen)
+        best, best_len = None, -1
+        for i in np.nonzero(plen >= 0)[0]:
+            i = int(i)
+            length = int(plen[i])
+            mask = int(b.fib_mask[i])  # pre-masked by add_route
+            if (src_ip & mask) == int(b.fib_prefix[i]) and \
+                    length > best_len and \
+                    int(b.fib_disp[i]) == int(Disposition.LOCAL):
+                best, best_len = int(b.fib_tx_if[i]), length
+        return best
+
+    def test_connectivity(self, args: list) -> str:
+        """One-shot connectivity probe — the robot-suite ping/TCP checks
+        as a vppctl command: inject a synthetic packet, trace its path
+        through the pipeline, report the verdict.
+
+        usage: test connectivity <src-ip> <dst-ip> <tcp|udp|icmp> [dport]
+        """
+        from vpp_tpu.pipeline.vector import ip4, make_packet_vector
+        from vpp_tpu.trace.tracer import PacketTracer
+
+        if len(args) < 3:
+            return ("usage: test connectivity <src-ip> <dst-ip> "
+                    "<tcp|udp|icmp> [dport] [sport]")
+        src_s, dst_s, proto_s = args[0], args[1], args[2]
+        proto = {"tcp": 6, "udp": 17, "icmp": 1}.get(proto_s.lower())
+        if proto is None:
+            return f"unknown protocol {proto_s!r} (tcp|udp|icmp)"
+        try:
+            dport = int(args[3]) if len(args) > 3 else 80
+            sport = int(args[4]) if len(args) > 4 else 40000
+            src_int, _ = ip4(src_s), ip4(dst_s)
+        except (ValueError, IndexError) as e:
+            # operator typo must degrade to a message, never a
+            # traceback out of run() (every command returns a string)
+            return f"bad argument: {e}"
+        rx_if = self._resolve_rx_if(src_int)
+        if rx_if is None:
+            return (f"no LOCAL route covers src {src_s} — the probe "
+                    "must originate from a pod this node hosts")
+        probe = make_packet_vector([{
+            "src": src_s, "dst": dst_s, "proto": proto,
+            "sport": sport, "dport": dport, "rx_if": rx_if,
+        }])
+        # side-effect-free: no session install, no shared-tracer swap
+        res = self.dp.probe(probe)
+        tracer = PacketTracer()
+        tracer.add(1)
+        tracer.record(res)
+        disp = Disposition(int(np.asarray(res.disp)[0]))
+        tx_if = int(np.asarray(res.tx_if)[0])
+        verdict = {
+            Disposition.LOCAL: f"FORWARDED -> if {tx_if}",
+            Disposition.REMOTE: f"FORWARDED -> fabric (if {tx_if})",
+            Disposition.HOST: "PUNTED to host stack",
+            Disposition.DROP: "DROPPED",
+        }.get(disp, disp.name)
+        entries = tracer.entries()
+        trace = entries[0].format() if entries else "(no trace captured)"
+        return (f"{src_s} -> {dst_s} {proto_s}/{dport} via if {rx_if}\n"
+                f"{trace}\nverdict: {verdict}")
 
     def show_io(self) -> str:
         """Pump + IO-daemon counters (the `show interface rx-placement`

@@ -403,6 +403,24 @@ class Dataplane:
             tracer.record(result)
         return result
 
+    def probe(self, pkts: PacketVector, now: Optional[int] = None) -> StepResult:
+        """Side-effect-free step: classify a synthetic frame against the
+        LIVE tables without committing anything back — no reflective
+        session is installed, no tracer fires, no counters move. Debug
+        probes (`test connectivity`) must never open a return-traffic
+        hole or consume session slots."""
+        with self._lock:
+            if self.tables is None:
+                raise RuntimeError(
+                    "this Dataplane is a staging handle managed by a "
+                    "ClusterDataplane; probe via its node pipelines"
+                )
+            tables = self.tables
+            step = self._step_mxu if self._use_mxu else self._step
+            if now is None:
+                now = max(self._now, self.clock_ticks())
+        return step(tables, pkts, jnp.int32(now))
+
     def process_packed(self, flat, now: Optional[int] = None):
         """Single-transfer variant of process() for the pump's hot path:
         ``flat`` is a host [5, B] int32 bit-packed batch (see
