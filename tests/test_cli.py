@@ -207,6 +207,10 @@ def test_connectivity_probe_reports_verdict_and_path():
         "test connectivity pod-a 10.1.1.3 tcp 80")
     assert "bad argument" in cli.run(
         "test connectivity 10.1.1.2 10.1.1.3 tcp http")
+    assert "bad argument" in cli.run(
+        "test connectivity 10.1.1.2 10.1.1.300 tcp 80")  # octet > 255
+    assert "bad argument" in cli.run(
+        "test connectivity 10.1.1.2 10.1.1.3 tcp 99999999999")
 
     # the probe is side-effect free: no reflective session was
     # installed for the permitted flow (a debug command must not open

@@ -1,0 +1,99 @@
+"""Fuzz the native wire codec: arbitrary bytes from the network must
+never crash the parser, claim more captured bytes than exist, or leave
+a transmittable frame whose length lies (the trunc-flag discipline the
+tx path's no-cross-flow-leak guarantee rests on)."""
+
+from __future__ import annotations
+
+import numpy as np
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from vpp_tpu.io.rings import VEC
+from vpp_tpu.native.pktio import (
+    FLAG_NON_IP4,
+    FLAG_TRUNC,
+    FLAG_VALID,
+    PacketCodec,
+)
+
+SNAP = 256
+
+
+@st.composite
+def frame_batches(draw):
+    rng = np.random.default_rng(draw(st.integers(0, 2**32 - 1)))
+    n = draw(st.integers(1, 64))
+    frames = []
+    for _ in range(n):
+        kind = rng.integers(0, 4)
+        if kind == 0:                      # pure noise
+            length = int(rng.integers(0, 400))
+            frames.append(rng.integers(0, 256, length, np.uint8)
+                          .tobytes())
+        elif kind == 1:                    # IPv4 ethertype, noisy header
+            length = int(rng.integers(14, 400))
+            b = bytearray(rng.integers(0, 256, length, np.uint8)
+                          .tobytes())
+            b[12:14] = b"\x08\x00"
+            frames.append(bytes(b))
+        elif kind == 2:                    # valid-ish IPv4, lying length
+            import struct
+
+            claimed = int(rng.integers(0, 65535))
+            ihl = int(rng.integers(0, 16))
+            payload = rng.integers(0, 256, int(rng.integers(0, 120)),
+                                   np.uint8).tobytes()
+            hdr = struct.pack(
+                "!BBHHHBBH4s4s", 0x40 | ihl, 0, claimed, 0, 0, 64,
+                int(rng.integers(0, 255)), 0, b"\x0a\x01\x01\x02",
+                b"\x0a\x01\x01\x03")
+            frames.append(b"\x02" * 12 + b"\x08\x00" + hdr + payload)
+        else:                              # VXLAN-ish datagram
+            inner = rng.integers(0, 256, int(rng.integers(0, 80)),
+                                 np.uint8).tobytes()
+            frames.append(b"\x02" * 12 + b"\x08\x00"
+                          + bytes(rng.integers(0, 256, 28, np.uint8))
+                          + b"\x08\x00\x00\x00" + b"\x00\x00\x0a\x00"
+                          + inner)
+    return frames
+
+
+@given(frame_batches())
+@settings(max_examples=80, deadline=None)
+def test_parse_never_unsafe(frames):
+    codec = PacketCodec(snap=SNAP)
+    scratch = np.zeros((VEC, SNAP), np.uint8)
+    cols, n = codec.parse(frames, 1, scratch)
+    assert n == min(len(frames), VEC)
+    flags = cols["flags"][:n]
+    pkt_len = cols["pkt_len"][:n]
+    for i in range(n):
+        f, length = int(flags[i]), int(pkt_len[i])
+        assert f & FLAG_VALID
+        captured = min(len(frames[i]), SNAP)
+        if not f & FLAG_TRUNC:
+            # a transmittable slot's wire length must be covered by
+            # captured bytes — anything else leaks stale slot data
+            assert length + 14 <= max(captured, 14), (i, length, captured)
+        if not f & FLAG_NON_IP4:
+            assert 0 <= length <= 65535
+    # rewrite over fuzzed columns must not crash either
+    codec.rewrite(cols, scratch, n)
+
+
+@given(frame_batches())
+@settings(max_examples=40, deadline=None)
+def test_decap_batch_never_unsafe(frames):
+    codec = PacketCodec(snap=SNAP)
+    scratch = np.zeros((VEC, SNAP), np.uint8)
+    lens = np.zeros(VEC, np.uint32)
+    n = min(len(frames), VEC)
+    for i in range(n):
+        b = frames[i][:SNAP]
+        scratch[i, :len(b)] = np.frombuffer(b, np.uint8)
+        lens[i] = len(frames[i])  # true wire length (may exceed snap)
+    codec.decap_batch(scratch, lens, n, 10)
+    # decap may only shrink, never grow past the captured bytes
+    for i in range(n):
+        assert lens[i] <= max(len(frames[i]), 0)

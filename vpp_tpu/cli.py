@@ -214,9 +214,19 @@ class DebugCLI:
         if proto is None:
             return f"unknown protocol {proto_s!r} (tcp|udp|icmp)"
         try:
+            # strict validation: ip4() would silently wrap octets > 255
+            # into neighboring octets and numpy columns overflow on
+            # huge ints — a debug tool must reject typos, not probe a
+            # different address and return a confident wrong verdict
+            import ipaddress as _ipaddress
+
+            _ipaddress.IPv4Address(src_s)
+            _ipaddress.IPv4Address(dst_s)
             dport = int(args[3]) if len(args) > 3 else 80
             sport = int(args[4]) if len(args) > 4 else 40000
-            src_int, _ = ip4(src_s), ip4(dst_s)
+            if not (0 <= dport <= 65535 and 0 <= sport <= 65535):
+                raise ValueError("port out of range 0-65535")
+            src_int = ip4(src_s)
         except (ValueError, IndexError) as e:
             # operator typo must degrade to a message, never a
             # traceback out of run() (every command returns a string)
@@ -229,8 +239,11 @@ class DebugCLI:
             "src": src_s, "dst": dst_s, "proto": proto,
             "sport": sport, "dport": dport, "rx_if": rx_if,
         }])
-        # side-effect-free: no session install, no shared-tracer swap
-        res = self.dp.probe(probe)
+        try:
+            # side-effect-free: no session install, no tracer swap
+            res = self.dp.probe(probe)
+        except RuntimeError as e:  # e.g. cluster staging handle
+            return f"probe unavailable: {e}"
         tracer = PacketTracer()
         tracer.add(1)
         tracer.record(res)
