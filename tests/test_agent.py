@@ -340,3 +340,31 @@ def test_close_is_idempotent_and_stops_watches():
         m.Pod(name="late", namespace="default", labels={}, ip_address="10.0.0.9"),
     )
     assert agent.policy_cache.lookup_pod(("default", "late")) is None
+
+
+def test_cli_socket_serves_debug_commands(tmp_path):
+    """A running agent answers vppctl-style commands over its CLI
+    socket — the operator path `vpp-tpu-ctl "show interface"`."""
+    from vpp_tpu.cmd.ctl import run_line
+
+    store = KVStore()
+    cfg = AgentConfig(
+        node_name="n1", serve_http=True,
+        stats_port=0, health_port=0,
+        cni_socket=str(tmp_path / "cni.sock"),
+        cli_socket=str(tmp_path / "cli.sock"),
+    )
+    agent = ContivAgent(cfg, store=store)
+    agent.start()
+    try:
+        out = run_line(cfg.cli_socket, "show interface", timeout=10)
+        assert "uplink" in out
+        out = run_line(cfg.cli_socket, "show fib", timeout=10)
+        assert "0.0.0.0/0" in out
+        out = run_line(cfg.cli_socket, "help", timeout=10)
+        assert "test connectivity" in out
+        # unknown commands degrade to a message over the wire
+        out = run_line(cfg.cli_socket, "bogus words", timeout=10)
+        assert "unknown command" in out
+    finally:
+        agent.close()

@@ -109,6 +109,7 @@ class Node:
             "node_name": name,
             "store_url": f"tcp://127.0.0.1:{kv_port}",
             "cni_socket": self.cni_socket,
+            "cli_socket": f"{self.dir}/cli.sock",
             "stats_port": ports[1],
             "health_port": ports[0],
             "http_host": "127.0.0.1",

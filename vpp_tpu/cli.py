@@ -341,12 +341,11 @@ class DebugCLI:
 
 
 def main(argv=None) -> int:
-    """Interactive REPL against a running agent is future work (needs an
-    RPC surface); today the CLI wraps an in-process Dataplane."""
-    import sys
+    """Delegates to vpp-tpu-ctl, the vppctl analog: it speaks the
+    running agent's CLI socket (cmd/config.py cli_socket)."""
+    from vpp_tpu.cmd.ctl import main as ctl_main
 
-    print("vpp_tpu debug CLI — in-process use only; see DebugCLI.", file=sys.stderr)
-    return 1
+    return ctl_main(argv)
 
 
 if __name__ == "__main__":

@@ -165,6 +165,7 @@ class ContivAgent:
             wirer=wirer,
         )
         self.cni_transport: Optional[CNITransportServer] = None
+        self.cli_transport: Optional[CNITransportServer] = None
 
         # --- observability ---
         self.stats = StatsCollector(self.dataplane, self.container_index)
@@ -275,6 +276,55 @@ class ContivAgent:
                 c.cni_socket, self.cni_server.dispatch
             )
             self.cni_transport.start()
+            if c.cli_socket:
+                # the vppctl transport: one-shot debug commands against
+                # the RUNNING agent (vpp-tpu-ctl "show interface" ...)
+                from vpp_tpu.cli import DebugCLI
+
+                cli = DebugCLI(
+                    self.dataplane, stats=self.stats,
+                    pump=self.io_pump, io_ctl=self.io_ctl,
+                )
+
+                def _cli_dispatch(method: str, params: dict) -> dict:
+                    if method != "run":
+                        return {"result": 1,
+                                "error": f"unknown method {method!r}"}
+                    try:
+                        return {"result": 0,
+                                "output": cli.run(str(params.get("line", "")))}
+                    except Exception as e:  # noqa: BLE001 — debug path
+                        return {"result": 1,
+                                "error": f"{type(e).__name__}: {e}"}
+
+                # the transport unlinks an existing socket on bind, so
+                # a path collision would silently STEAL another live
+                # agent's CLI socket — probe first and refuse instead
+                live = False
+                try:
+                    from vpp_tpu.cni.transport import cni_call
+
+                    cni_call(c.cli_socket, "run", {"line": "help"},
+                             timeout=1.0)
+                    live = True
+                except (OSError, RuntimeError, ValueError):
+                    pass  # nothing answering: stale or absent socket
+                if live:
+                    log.warning(
+                        "cli socket %s already served by a live agent; "
+                        "not taking it over", c.cli_socket)
+                else:
+                    try:
+                        self.cli_transport = CNITransportServer(
+                            c.cli_socket, _cli_dispatch
+                        )
+                        self.cli_transport.start()
+                    except OSError as e:
+                        # a debug convenience must never take the
+                        # node's data plane down with it
+                        log.warning("cli socket %s unavailable: %s",
+                                    c.cli_socket, e)
+                        self.cli_transport = None
             self.stats_http = StatsHTTPServer(
                 self.stats.registry, port=c.stats_port, host=c.http_host
             )
@@ -360,7 +410,8 @@ class ContivAgent:
         self._closed.set()
         for cancel in self._watch_cancels:
             cancel()
-        for srv in (self.cni_transport, self.stats_http, self.health_http):
+        for srv in (self.cni_transport, self.cli_transport,
+                    self.stats_http, self.health_http):
             if srv is not None:
                 srv.close()
         self.proxy.close()

@@ -55,6 +55,8 @@ class AgentConfig:
     persist_path: Optional[str] = None       # in-process store snapshot file
     # CNI
     cni_socket: str = "/run/vpp-tpu/cni.sock"
+    # debug CLI socket (the vppctl transport; "" disables)
+    cli_socket: str = "/run/vpp-tpu/cli.sock"
     # observability / health
     stats_port: int = 9999
     health_port: int = 9191
