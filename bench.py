@@ -558,7 +558,6 @@ def hoststack_bench(args, duration_s: float = 2.5) -> dict:
     wave), server accepts are admission-checked in waves too. Also
     reports the engine's raw batched admission capacity, the device
     ceiling on CPS."""
-    import socket as socket_mod
     import threading
 
     from vpp_tpu.hoststack.session_rules import (
@@ -584,10 +583,11 @@ def hoststack_bench(args, duration_s: float = 2.5) -> dict:
         ))
     engine.apply(add=filler)
 
-    srv_sock = socket_mod.socket()
-    srv_sock.bind(("127.0.0.1", 0))
-    srv_sock.listen(256)
-    port = srv_sock.getsockname()[1]
+    server = HostStackApp(engine, appns_index=2)
+    srv = server.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(256)
+    port = srv.getsockname()[1]
 
     # specific admits over default-deny in BOTH scopes, so the connect
     # check (LOCAL) and the accept check (GLOBAL) each decide something
@@ -637,38 +637,17 @@ def hoststack_bench(args, duration_s: float = 2.5) -> dict:
             conn.close()
 
     def acceptor():
-        """Wave admission: drain pending OS accepts, one engine batch
-        per wave (VPP filters inbound sessions in its session tables;
-        waves are the batched form). Wait briefly for the FIRST
-        connection only, then drain non-blocking — a wave must never
-        stall on a timeout waiting for a member that isn't coming (that
-        stall becomes the measured CPS)."""
+        """Wave admission via FilteredSocket.accept_batch: one engine
+        batch per wave of pending connections (VPP filters inbound
+        sessions in its session tables; waves are the batched form)."""
         while not stop.is_set():
-            wave = []
             try:
-                srv_sock.settimeout(0.01)
-                wave.append(srv_sock.accept())
-                srv_sock.setblocking(False)
-                while len(wave) < 64:
-                    try:
-                        wave.append(srv_sock.accept())
-                    except (BlockingIOError, OSError):
-                        break
-            except (TimeoutError, socket_mod.timeout):
-                pass
+                wave = srv.accept_batch(max_n=64, first_timeout=0.01)
             except OSError:
-                return
-            if not wave:
-                continue
-            verdicts = engine.check_accept([
-                (6, LOOP, port, _ip_int(p[0]), p[1]) for _, p in wave
-            ])
-            for ok, (conn, _) in zip(verdicts, wave):
-                if ok:
-                    threading.Thread(target=serve_conn, args=(conn,),
-                                     daemon=True).start()
-                else:
-                    conn.close()
+                return  # listener closed: shutdown
+            for fconn, _peer in wave:
+                threading.Thread(target=serve_conn, args=(fconn.sock,),
+                                 daemon=True).start()
 
     acc = threading.Thread(target=acceptor, daemon=True)
     acc.start()
@@ -725,7 +704,7 @@ def hoststack_bench(args, duration_s: float = 2.5) -> dict:
         return out
     finally:
         stop.set()
-        srv_sock.close()
+        srv.close()
 
 
 def io_daemon_bench(args, duration_s: float = 5.0) -> dict:

@@ -164,3 +164,54 @@ def test_connect_batch_mixed_verdicts():
         if s is not None:
             s.close()
     srv.close()
+
+
+def test_accept_batch_mixed_verdicts():
+    """The server-side twin of connect_batch: one engine batch admits a
+    wave of pending inbound connections; denied peers are closed."""
+    import socket as socket_mod
+
+    from vpp_tpu.hoststack.vcl import _ip_int
+
+    engine = SessionRuleEngine(capacity=64)
+    server_app = HostStackApp(engine, appns_index=2)
+    srv = server_app.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(16)
+    port = srv.getsockname()[1]
+    # GLOBAL scope: allow only source port 39991 toward this listener,
+    # deny everything else inbound
+    engine.apply(add=[
+        SessionRule(scope=int(RuleScope.GLOBAL), appns_index=-1,
+                    transport_proto=6, lcl_net=_ip_int("127.0.0.1"),
+                    lcl_plen=32, rmt_net=0, rmt_plen=0,
+                    lcl_port=port, rmt_port=39991,
+                    action=int(RuleAction.ALLOW)),
+        SessionRule(scope=int(RuleScope.GLOBAL), appns_index=-1,
+                    transport_proto=6, lcl_net=0, lcl_plen=0,
+                    rmt_net=0, rmt_plen=0, lcl_port=0, rmt_port=0,
+                    action=int(RuleAction.DENY)),
+    ])
+
+    good = socket_mod.socket()
+    good.bind(("127.0.0.1", 39991))
+    good.connect(("127.0.0.1", port))
+    bad = socket_mod.socket()
+    bad.connect(("127.0.0.1", port))
+
+    admitted = []
+    for _ in range(50):
+        admitted += srv.accept_batch(max_n=8, first_timeout=0.05)
+        if admitted:
+            break
+    assert len(admitted) == 1
+    fconn, peer = admitted[0]
+    assert peer[1] == 39991
+    fconn.send(b"hi")
+    assert good.recv(16) == b"hi"
+    # the denied peer was closed by the wave
+    bad.settimeout(2)
+    assert bad.recv(16) == b""
+    for s in (good, bad, fconn):
+        s.close()
+    srv.close()

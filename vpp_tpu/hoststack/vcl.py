@@ -64,6 +64,54 @@ class FilteredSocket:
     def listen(self, backlog: int = 16) -> None:
         self.sock.listen(backlog)
 
+    def accept_batch(self, max_n: int = 64,
+                     first_timeout: float = 0.01) -> list:
+        """Admission-check a wave of pending inbound connections in ONE
+        engine batch — the server-side twin of
+        ``HostStackApp.connect_batch``. Waits up to ``first_timeout``
+        for the FIRST connection, then drains whatever else is already
+        queued non-blocking (a wave must never stall waiting for a
+        member that isn't coming). Denied peers are closed; returns
+        [(FilteredSocket, peer), ...] for the admitted ones."""
+        prev_timeout = self.sock.gettimeout()
+        wave = []
+        try:
+            # a closed/dead listener raises OSError out of here — the
+            # caller must be able to tell that from "no connections
+            # pending" or its accept loop busy-spins forever
+            self.sock.settimeout(first_timeout)
+            try:
+                wave.append(self.sock.accept())
+            except TimeoutError:
+                return []
+            self.sock.setblocking(False)
+            while len(wave) < max_n:
+                try:
+                    wave.append(self.sock.accept())
+                except BlockingIOError:
+                    break
+        finally:
+            try:
+                self.sock.settimeout(prev_timeout)
+            except OSError:
+                pass  # listener closed mid-wave (shutdown path)
+        # per-connection local address, same as accept(): a wildcard
+        # bind resolves to the real local IP on the accepted socket,
+        # and rules match against THAT
+        verdicts = self.app.engine.check_accept([
+            (self.proto, _ip_int(conn.getsockname()[0]),
+             conn.getsockname()[1], _ip_int(peer[0]), peer[1])
+            for conn, peer in wave
+        ])
+        out = []
+        for ok, (conn, peer) in zip(verdicts, wave):
+            if ok:
+                out.append((FilteredSocket(self.app, self.proto, conn),
+                            peer))
+            else:
+                conn.close()
+        return out
+
     def accept(self) -> Tuple["FilteredSocket", Tuple[str, int]]:
         """Accept the next ALLOWED connection; denied peers are closed
         (the VPP session layer resets filtered sessions) and the accept
