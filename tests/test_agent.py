@@ -366,5 +366,19 @@ def test_cli_socket_serves_debug_commands(tmp_path):
         # unknown commands degrade to a message over the wire
         out = run_line(cfg.cli_socket, "bogus words", timeout=10)
         assert "unknown command" in out
+        # the vppctl trace workflow: arm over the socket, traffic
+        # through the dataplane, render the captured path
+        out = run_line(cfg.cli_socket, "trace add 4", timeout=10)
+        assert "tracing the next 4" in out
+        from vpp_tpu.pipeline.vector import make_packet_vector
+
+        agent.dataplane.process(make_packet_vector([
+            {"src": "10.9.9.9", "dst": "10.9.9.10", "proto": 17,
+             "sport": 1, "dport": 2, "rx_if": agent.uplink_if}
+        ]))
+        out = run_line(cfg.cli_socket, "show trace", timeout=10)
+        # src shows post-SNAT (cluster egress rewrites to the node IP)
+        assert "10.9.9.10" in out and "ip4-input" in out
+        assert "cleared" in run_line(cfg.cli_socket, "trace clear", 10)
     finally:
         agent.close()

@@ -51,13 +51,17 @@ class DebugCLI:
                 return fn()
         if tuple(parts[:2]) == ("test", "connectivity"):
             return self.test_connectivity(parts[2:])
+        if tuple(parts[:2]) == ("trace", "add"):
+            return self.trace_add(parts[2:])
+        if tuple(parts[:2]) == ("trace", "clear"):
+            return self.trace_clear()
         return f"unknown command: {line.strip()!r} (try 'help')"
 
     def help(self) -> str:
         return (
             "commands: show interface | show acl | show session | "
             "show nat44 | show fib | show trace | show errors | "
-            "show io | show neighbors | "
+            "show io | show neighbors | trace add [n] | trace clear | "
             "test connectivity <src> <dst> <tcp|udp|icmp> [dport]"
         )
 
@@ -325,10 +329,47 @@ class DebugCLI:
             lines.append(f"{ip4_str(ip):<16} {mac_s:<18} {'S' if pin else ''}")
         return "\n".join(lines)
 
-    def show_trace(self) -> str:
-        if self.tracer is None:
+    def _live_tracer(self, create: bool = False):
+        """The tracer the DATAPLANE records into — arming anything
+        else silently captures nothing. Falls back to an explicitly
+        injected tracer (in-process test use); ``create`` attaches one
+        to the dataplane on demand."""
+        t = self.dp.tracer or self.tracer
+        if t is None and create:
+            from vpp_tpu.trace.tracer import PacketTracer
+
+            t = self.dp.tracer = PacketTracer()
+        return t
+
+    def trace_add(self, args: list) -> str:
+        """Arm the packet tracer for the next N valid packets (VPP
+        `trace add <node> N`): real traffic through the pump takes the
+        traced slow path while armed, then reverts to the fused fast
+        path."""
+        try:
+            n = int(args[0]) if args else 16
+            if n <= 0:
+                raise ValueError("count must be positive")
+        except ValueError as e:
+            return f"bad argument: {e}"
+        tracer = self._live_tracer(create=True)
+        if tracer is not self.dp.tracer:
+            self.dp.tracer = tracer  # injected tracer: make it live
+        tracer.add(n)
+        return f"tracing the next {min(n, tracer.max_entries)} packets"
+
+    def trace_clear(self) -> str:
+        tracer = self._live_tracer()
+        if tracer is None:
             return "no tracer attached"
-        return self.tracer.format_trace()
+        tracer.clear()
+        return "trace buffer cleared"
+
+    def show_trace(self) -> str:
+        tracer = self._live_tracer()
+        if tracer is None:
+            return "no tracer attached"
+        return tracer.format_trace()
 
     def show_errors(self) -> str:
         if self.stats is None:

@@ -281,6 +281,9 @@ class ContivAgent:
                 # the RUNNING agent (vpp-tpu-ctl "show interface" ...)
                 from vpp_tpu.cli import DebugCLI
 
+                # `vpp-tpu-ctl trace add N` lazily attaches the packet
+                # tracer to the dataplane; disarmed it is a zero-cost
+                # early return per frame
                 cli = DebugCLI(
                     self.dataplane, stats=self.stats,
                     pump=self.io_pump, io_ctl=self.io_ctl,
