@@ -60,6 +60,12 @@ class MxuTable(NamedTuple):
 
     coeff: np.ndarray  # [PLANES, R'] float32 in {-1, 0, 1}
     k: np.ndarray      # [R'] float32, per-rule mismatch constant
+    act: np.ndarray    # [R'] int32 action per COLUMN (-1 padding) — the
+                       # column-aligned action table. The dense glb_action
+                       # rows and the bit-plane columns shard into
+                       # *different* block boundaries when R' > R, so a
+                       # rule-sharded classify must look the deny bit up
+                       # in column space, not row space.
     ok: bool           # False => table has range rules; use dense path
 
 
@@ -77,6 +83,7 @@ def empty_bitplanes(max_rules: int) -> MxuTable:
     return MxuTable(
         coeff=np.zeros((PLANES, r_cap), np.float32),
         k=np.ones(r_cap, np.float32),
+        act=np.full(r_cap, -1, np.int32),
         ok=True,
     )
 
@@ -143,7 +150,9 @@ def compile_bitplanes(packed: dict, max_rules: int) -> MxuTable:
     # ok=False misses the rule rather than wildcarding its ports.
     coeff[:, :n] = np.where(bad_rows[None, :], 0.0, coeff[:, :n])
     k[:n] = np.where(bad_rows, 1.0, k[:n])
-    return MxuTable(coeff=coeff, k=k, ok=not bad_rows.any())
+    act = np.full(r_cap, -1, np.int32)
+    act[:n] = packed["action"]
+    return MxuTable(coeff=coeff, k=k, act=act, ok=not bad_rows.any())
 
 
 def packet_bit_planes(pkts: PacketVector) -> jnp.ndarray:
@@ -252,6 +261,22 @@ def mxu_first_match_reference(
     return jnp.min(jnp.where(mism == 0.0, col, ENC_MISS), axis=1)
 
 
+def mxu_classify_columns(tables, pkts: PacketVector) -> jnp.ndarray:
+    """First-match COLUMN index of each packet against the bit-plane
+    table (ENC_MISS = no match): packet-header bit explode + the
+    backend dispatch (Pallas kernel on TPU, jnp reference elsewhere).
+    The single entry point shared by the single-node classify below and
+    the rule-sharded cluster classify
+    (parallel/cluster.sharded_global_classify_mxu), so backend dispatch
+    can never diverge between them."""
+    bits = packet_bit_planes(pkts)
+    if jax.default_backend() == "tpu":
+        return mxu_first_match(bits, tables.glb_mxu_coeff, tables.glb_mxu_k)
+    return mxu_first_match_reference(
+        bits, tables.glb_mxu_coeff, tables.glb_mxu_k
+    )
+
+
 def acl_classify_global_mxu(tables, pkts: PacketVector) -> AclVerdict:
     """Drop-in replacement for acl_classify_global using the MXU path.
 
@@ -259,13 +284,7 @@ def acl_classify_global_mxu(tables, pkts: PacketVector) -> AclVerdict:
     DataplaneTables) and a table with no range rules (builder keeps the
     dense path otherwise).
     """
-    bits = packet_bit_planes(pkts)
-    if jax.default_backend() == "tpu":
-        enc = mxu_first_match(bits, tables.glb_mxu_coeff, tables.glb_mxu_k)
-    else:
-        enc = mxu_first_match_reference(
-            bits, tables.glb_mxu_coeff, tables.glb_mxu_k
-        )
+    enc = mxu_classify_columns(tables, pkts)
     matched = enc != ENC_MISS
     safe = jnp.where(matched, enc, 0)
     act = tables.glb_action[safe]

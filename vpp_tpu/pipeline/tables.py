@@ -87,6 +87,11 @@ class DataplaneTables(NamedTuple):
     # (vpp_tpu.ops.acl_mxu); float32 {-1,0,1} coeffs, cast to bf16 at use.
     glb_mxu_coeff: jnp.ndarray  # float32 [PLANES, R']
     glb_mxu_k: jnp.ndarray      # float32 [R']
+    glb_mxu_act: jnp.ndarray    # int32 [R'] action per bit-plane COLUMN
+                                # (-1 padding) — column space can be wider
+                                # than rule-row space (R' >= R), so the
+                                # rule-sharded MXU classify must resolve
+                                # the deny bit here, not via glb_action
 
     # --- interfaces [I] ---
     if_type: jnp.ndarray        # int32 InterfaceType
@@ -232,7 +237,7 @@ _UPLOAD_GROUPS: Dict[str, Tuple[str, ...]] = {
     "glb": ("glb_src_net", "glb_src_mask", "glb_dst_net", "glb_dst_mask",
             "glb_proto", "glb_sport_lo", "glb_sport_hi", "glb_dport_lo",
             "glb_dport_hi", "glb_action", "glb_nrules", "glb_mxu_coeff",
-            "glb_mxu_k"),
+            "glb_mxu_k", "glb_mxu_act"),
     "if": ("if_type", "if_local_table", "if_apply_global"),
     "fib": ("fib_prefix", "fib_mask", "fib_plen", "fib_tx_if", "fib_disp",
             "fib_next_hop", "fib_node_id", "fib_snat"),
@@ -255,7 +260,7 @@ class TableBuilder:
 
     def __init__(self, config: DataplaneConfig = DataplaneConfig()):
         self.config = config
-        self.mxu_enabled = True  # cleared for cluster-node builders
+        self.mxu_enabled = True  # opt-out knob for the bit-plane compile
         c = config
         z = np.zeros
         self.acl = {
@@ -317,12 +322,11 @@ class TableBuilder:
 
         self.glb = pack_rules(rules, self.config.max_global_rules)
         self.glb_nrules = len(rules)
-        # Bit-plane compilation only pays off where the MXU classify can
-        # actually run: a ClusterDataplane node always classifies via the
-        # dense rule-sharded kernel, so its builders skip the host-side
-        # compile. (The zero coeff matrix is still part of the pytree —
-        # shapes must stay epoch-invariant for jit — so the device upload
-        # itself is not avoided, only the O(PLANES·R) host work.)
+        # mxu_enabled=False skips the O(PLANES·R) host-side bit-plane
+        # compile for callers that will never take the MXU path. (The
+        # zero coeff matrix is still part of the pytree — shapes must
+        # stay epoch-invariant for jit — so the device upload itself is
+        # not avoided, only the host work.)
         if self.mxu_enabled:
             self.glb_mxu = compile_bitplanes(self.glb, self.config.max_global_rules)
         else:
@@ -508,6 +512,7 @@ class TableBuilder:
             glb_nrules=np.int32(self.glb_nrules),
             glb_mxu_coeff=self.glb_mxu.coeff,
             glb_mxu_k=self.glb_mxu.k,
+            glb_mxu_act=self.glb_mxu.act,
             if_type=self.if_type,
             if_local_table=self.if_local_table,
             if_apply_global=self.if_apply_global,
