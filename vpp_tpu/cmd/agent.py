@@ -58,14 +58,30 @@ def _ksr_key(ev_key: str) -> str:
 
 class ContivAgent:
     def __init__(self, config: Optional[AgentConfig] = None,
-                 store: Optional[KVStore] = None):
+                 store: Optional[KVStore] = None,
+                 dataplane: Optional[Dataplane] = None,
+                 mesh_node_resolver=None):
         """``store`` injection lets tests (and multi-agent simulations)
         share one in-memory store; production passes None and gets the
         configured backend — a RemoteKVStore against the cluster's
         KVServer when ``store_url`` is set (the deployed-etcd analog),
-        else a persisted local store."""
+        else a persisted local store.
+
+        ``dataplane`` injection is the mesh-mode path
+        (parallel/runtime.MeshRuntime): the agent drives a cluster NODE
+        HANDLE whose swap publishes a full multi-chip epoch, instead of
+        owning a standalone single-chip dataplane.
+
+        ``mesh_node_resolver`` maps a peer's allocator node id to its
+        mesh position (-1 = not on this mesh). With a resolver set,
+        routes toward on-mesh peers carry the mesh position as
+        ``node_id`` — the cluster step hands those packets to the
+        all_to_all ICI fabric — and off-mesh peers get edge routes
+        (node_id=-1) that leave via VXLAN, exactly the SURVEY §2.4
+        fabric/edge split."""
         self.config = config or AgentConfig()
         c = self.config
+        self.mesh_node_resolver = mesh_node_resolver
 
         # --- data store + proxy (cn-infra kvdbsync analog) ---
         if store is None:
@@ -89,7 +105,9 @@ class ContivAgent:
         self.ipam = IPAM(self.node_id, c.ipam, broker=broker)
 
         # --- data plane + renderers ---
-        self.dataplane = Dataplane(c.dataplane)
+        self.dataplane = (
+            dataplane if dataplane is not None else Dataplane(c.dataplane)
+        )
         self.uplink_if = self.dataplane.add_uplink()
         self.host_if = self.dataplane.add_host_interface()
         self.dataplane.set_vtep(int(self.ipam.vxlan_ip_address()))
@@ -310,6 +328,13 @@ class ContivAgent:
                     cni_call(c.cli_socket, "run", {"line": "help"},
                              timeout=1.0)
                     live = True
+                except TimeoutError:
+                    # connected but no answer within the window: a LIVE
+                    # but busy agent (e.g. mid jit-compile holding the
+                    # dataplane lock) — stealing its socket is exactly
+                    # what this probe exists to prevent. Refuse takeover;
+                    # only connection-refused/absent means stale.
+                    live = True
                 except (OSError, RuntimeError, ValueError):
                     pass  # nothing answering: stale or absent socket
                 if live:
@@ -491,18 +516,39 @@ class ContivAgent:
 
     def _apply_node(self, node_id: int, info: dict) -> None:
         """Install routes to another node's pod + vpp/host subnets over
-        the uplink, vxlan-encapped toward its VTEP."""
+        the uplink. Mesh mode (resolver set): on-mesh peers route into
+        the ICI fabric (node_id = mesh position, no encapsulation) and
+        only off-mesh peers get VXLAN edge routes; otherwise every peer
+        is a VXLAN peer (the reference's full-mesh,
+        node_events.go:184-250)."""
         if node_id == self.node_id or not isinstance(info, dict):
             return
         peer_vtep = int(self.ipam.vxlan_ip_address(node_id))
         if self._peer_routes.get(node_id) == peer_vtep:
             return  # already installed (IP update without vtep change)
-        with_hop = dict(
-            tx_if=self.uplink_if,
-            disposition=Disposition.REMOTE,
-            next_hop=peer_vtep,
-            node_id=node_id,
-        )
+        mesh_pos = -1
+        if self.mesh_node_resolver is not None:
+            mesh_pos = int(self.mesh_node_resolver(node_id))
+        if mesh_pos >= 0:
+            # fabric peer: the cluster step's all_to_all row IS the
+            # tunnel; next_hop=0 keeps the host VXLAN encap path (which
+            # selects on REMOTE & next_hop != 0) off these packets
+            with_hop = dict(
+                tx_if=self.uplink_if,
+                disposition=Disposition.REMOTE,
+                next_hop=0,
+                node_id=mesh_pos,
+            )
+        else:
+            with_hop = dict(
+                tx_if=self.uplink_if,
+                disposition=Disposition.REMOTE,
+                next_hop=peer_vtep,
+                # mesh mode must mark edge peers -1 (a raw allocator id
+                # would alias a fabric row); standalone mode keeps the
+                # allocator id as observability metadata
+                node_id=-1 if self.mesh_node_resolver is not None else node_id,
+            )
         with self.dataplane.commit_lock:
             self.dataplane.builder.add_route(
                 str(self.ipam.other_node_pod_network(node_id)), **with_hop
@@ -512,7 +558,11 @@ class ContivAgent:
             )
             self.dataplane.swap()
         self._peer_routes[node_id] = peer_vtep
-        log.info("node %d added: routes via vtep %s", node_id, peer_vtep)
+        log.info(
+            "node %d added: %s", node_id,
+            f"fabric row {mesh_pos}" if mesh_pos >= 0
+            else f"routes via vtep {peer_vtep}",
+        )
 
     def _remove_node(self, node_id: int) -> None:
         if self._peer_routes.pop(node_id, None) is None:

@@ -47,6 +47,17 @@ class IOConfig:
 
 
 @dataclasses.dataclass
+class MeshConfig:
+    """Multi-chip mesh mode (vpp-tpu-mesh-agent / parallel/runtime.py):
+    one process drives N vswitch nodes over a (node, rule) device mesh
+    with the all_to_all ICI fabric as the inter-node data plane."""
+
+    nodes: int = 0          # mesh rows; 0 = one node per available device
+                            # group (devices // rule_shards)
+    rule_shards: int = 1    # global-ACL rule-axis shards per node
+
+
+@dataclasses.dataclass
 class AgentConfig:
     node_name: str = "node-1"
     # data store: "" = in-process store (dev/tests); "tcp://host:port" =
@@ -75,6 +86,8 @@ class AgentConfig:
     ipam: IpamConfig = dataclasses.field(default_factory=IpamConfig)
     # packet IO
     io: IOConfig = dataclasses.field(default_factory=IOConfig)
+    # multi-chip mesh mode (ignored by the standalone vpp-tpu-agent)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
 
     @classmethod
     def from_dict(cls, d: dict) -> "AgentConfig":
@@ -99,6 +112,10 @@ class AgentConfig:
         build_section(
             "io", IOConfig,
             {f.name for f in dataclasses.fields(IOConfig)},
+        )
+        build_section(
+            "mesh", MeshConfig,
+            {f.name for f in dataclasses.fields(MeshConfig)},
         )
         known = {f.name for f in dataclasses.fields(cls)}
         unknown = set(d) - known

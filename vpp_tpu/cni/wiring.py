@@ -74,7 +74,12 @@ class VethPodWirer:
             self.io_ctl.attach(if_index, "afpacket", host_if)
             from vpp_tpu.pipeline.vector import ip4
 
-            self.io_ctl.set_mac(int(ip4(pod_ip)), pod_mac)
+            if self.io_ctl.set_mac(int(ip4(pod_ip)), pod_mac):
+                log.warning(
+                    "static MAC for pod %s displaced another pod's "
+                    "pinned neighbor entry (table pin pressure)",
+                    container_id,
+                )
             return pod_mac
         except Exception:
             log.exception("pod wire failed for %s; rolling back",

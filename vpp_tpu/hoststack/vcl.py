@@ -90,6 +90,22 @@ class FilteredSocket:
                     wave.append(self.sock.accept())
                 except BlockingIOError:
                     break
+                except ConnectionAbortedError:
+                    # a QUEUED pending connection RST before we got to
+                    # it (health checks, impatient clients) — routine,
+                    # affects only that connection: keep draining
+                    continue
+                except OSError:
+                    # genuine listener failure mid-drain (closed,
+                    # shutdown): the sockets already accepted into the
+                    # wave would leak un-admission-checked if this
+                    # propagated — close them before re-raising
+                    for conn, _peer in wave:
+                        try:
+                            conn.close()
+                        except OSError:
+                            pass
+                    raise
         finally:
             try:
                 self.sock.settimeout(prev_timeout)

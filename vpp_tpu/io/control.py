@@ -58,10 +58,13 @@ class IOControlServer:
                 removed = self.daemon.detach(int(params["if_idx"]))
                 return {"result": 0, "removed": bool(removed)}
             if method == "set_mac":
-                self.daemon.set_static_mac(
+                displaced = self.daemon.set_static_mac(
                     int(params["ip"]), bytes.fromhex(params["mac"])
                 )
-                return {"result": 0}
+                # displaced=True: installed, but another pod's pinned
+                # entry was evicted (it lost its no-flood guarantee) —
+                # the agent decides whether to re-install that pod's ARP
+                return {"result": 0, "displaced": bool(displaced)}
             if method == "stats":
                 return {"result": 0, "stats": dict(self.daemon.stats)}
             if method == "neighbors":
@@ -108,8 +111,12 @@ class IOControlClient:
     def detach(self, if_idx: int) -> bool:
         return bool(self._call("detach", {"if_idx": if_idx})["removed"])
 
-    def set_mac(self, ip: int, mac: bytes) -> None:
-        self._call("set_mac", {"ip": ip, "mac": mac.hex()})
+    def set_mac(self, ip: int, mac: bytes) -> bool:
+        """Install a static neighbor entry. True = installed but a
+        DIFFERENT pod's pinned entry was displaced (pin pressure) —
+        that pod lost its no-flood guarantee."""
+        reply = self._call("set_mac", {"ip": ip, "mac": mac.hex()})
+        return bool(reply.get("displaced"))
 
     def stats(self) -> dict:
         return self._call("stats")["stats"]

@@ -87,14 +87,25 @@ class IODaemon:
         log.info("detached if %d (%s)", if_idx, t.name)
         return True
 
-    def set_static_mac(self, ip: int, mac: bytes) -> None:
+    def set_static_mac(self, ip: int, mac: bytes) -> bool:
         """Static (ip → MAC) entry — the reference's configured static
         ARP for pod links (pod.go:375-452); rx learning keeps it fresh
-        but the first packet toward a silent pod no longer floods."""
-        if not self.mac.put(int(ip), bytes(mac)):
+        but the first packet toward a silent pod no longer floods.
+        Returns True when installing evicted ANOTHER pod's pinned entry
+        (probe run fully pinned): that pod lost its no-flood guarantee,
+        and the caller must surface the displacement, not treat the
+        install as clean."""
+        rc = self.mac.put(int(ip), bytes(mac))
+        if not rc:
             # surfaced as an RPC error through the control socket: a
             # silently missing static means permanent broadcast flood
             raise RuntimeError("neighbor table rejected static entry")
+        if rc == 2:
+            log.warning(
+                "static MAC for ip %#x displaced another pinned entry "
+                "(neighbor table pin pressure)", ip,
+            )
+        return rc == 2
 
     # --- lifecycle ---
     def start(self) -> "IODaemon":

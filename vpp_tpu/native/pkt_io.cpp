@@ -18,6 +18,7 @@
 //
 // Build: g++ -O2 -shared -fPIC -o libpktio.so pkt_io.cpp
 
+#include <array>
 #include <cstdint>
 #include <cstring>
 
@@ -114,15 +115,17 @@ int32_t write_rows(int32_t fd, const uint8_t* base, uint32_t stride,
 }
 
 // Identity row indices for batches compacted sequentially into a
-// scratch area (pio_send_batch addresses by row index).
+// scratch area (pio_send_batch addresses by row index). C++ magic
+// static: initialization is thread-safe under concurrent first calls
+// from multiple tx threads (a hand-rolled `static bool init` flag was
+// not — one thread could observe partially filled rows).
 const uint32_t* identity_rows() {
-  static uint32_t rows[kVec];
-  static bool init = false;
-  if (!init) {
-    for (uint32_t i = 0; i < kVec; i++) rows[i] = i;
-    init = true;
-  }
-  return rows;
+  static const std::array<uint32_t, kVec> rows = [] {
+    std::array<uint32_t, kVec> r{};
+    for (uint32_t i = 0; i < kVec; i++) r[i] = i;
+    return r;
+  }();
+  return rows.data();
 }
 
 // Field extraction for one frame at slot i (shared by the copying and
@@ -434,10 +437,15 @@ constexpr uint32_t kMacProbe = 16;
 static inline uint32_t mac_hash(uint32_t ip) { return ip * 0x9e3779b1u; }
 
 // Returns 1 when the entry was installed, 0 when dropped (probe run
-// fully pinned for an UNPINNED learn, or pathological CAS contention).
-// A pinned (control-plane) put never drops for pin pressure: statics
-// outrank learned entries AND each other's slots — the caller surfaces
-// a 0 as an RPC error instead of silently not installing.
+// fully pinned for an UNPINNED learn, or pathological CAS contention),
+// and 2 when installing required evicting a DIFFERENT ip's pinned
+// entry (kPinnedVictim displacement): the entry IS installed, but the
+// displaced pod lost its static-ARP guarantee — the caller must
+// surface the displacement to the control plane, not treat it as a
+// clean install. A pinned (control-plane) put never drops for pin
+// pressure: statics outrank learned entries AND each other's slots —
+// the caller surfaces a 0 as an RPC error instead of silently not
+// installing.
 int32_t pio_mac_put(uint32_t* ips, uint8_t* macs, uint32_t* seq,
                     uint8_t* pin, uint32_t cap, uint32_t ip,
                     const uint8_t* mac, uint32_t pin_flag) {
@@ -499,6 +507,12 @@ int32_t pio_mac_put(uint32_t* ips, uint8_t* macs, uint32_t* seq,
       __atomic_store_n(&seq[s], sq, __ATOMIC_RELEASE);  // release claim
       continue;  // re-probe with fresh state
     }
+    // a pinned-victim overwrite of ANOTHER ip's pinned slot displaces
+    // that static entry — report it distinctly (checked under the
+    // claim, so the displaced identity is stable)
+    bool displaced =
+        kind == kPinnedVictim && pin[s] &&
+        __atomic_load_n(&ips[s], __ATOMIC_ACQUIRE) != ip;
     __atomic_store_n(&ips[s], ip, __ATOMIC_RELEASE);
     std::memcpy(macs + static_cast<uint64_t>(s) * 6u, mac, 6);
     if (pin_flag) {
@@ -509,7 +523,7 @@ int32_t pio_mac_put(uint32_t* ips, uint8_t* macs, uint32_t* seq,
       pin[s] = 0;
     }
     __atomic_store_n(&seq[s], sq + 2, __ATOMIC_RELEASE);  // publish
-    return 1;
+    return displaced ? 2 : 1;
   }
   return 0;  // pathological contention: caller decides (learns drop)
 }

@@ -164,13 +164,15 @@ class MacTable:
         self.pin = np.zeros(capacity, np.uint8)
         self._lib = _load()
 
-    def put(self, ip: int, mac: bytes, pin: bool = True) -> bool:
+    def put(self, ip: int, mac: bytes, pin: bool = True) -> int:
         """Install an entry; ``pin`` (default, the control-plane path)
-        protects it from learning-pressure eviction. Returns False when
-        the entry could NOT be installed (unpinned put into a fully
-        pinned probe run, or pathological contention) — control-plane
-        callers must surface that, never swallow it."""
-        return bool(self._lib.pio_mac_put(
+        protects it from learning-pressure eviction. Returns 0 when the
+        entry could NOT be installed (unpinned put into a fully pinned
+        probe run, or pathological contention), 1 on a clean install,
+        and 2 when the install DISPLACED another IP's pinned entry (a
+        pinned put into a fully pinned probe run) — control-plane
+        callers must surface 0 and 2, never swallow them."""
+        return int(self._lib.pio_mac_put(
             self.ips.ctypes.data_as(ctypes.c_void_p),
             self.macs.ctypes.data_as(ctypes.c_void_p),
             self.seq.ctypes.data_as(ctypes.c_void_p),

@@ -12,4 +12,18 @@ ICI via ``all_to_all`` instead of VXLAN encapsulation.
 from vpp_tpu.parallel.mesh import cluster_mesh, table_specs
 from vpp_tpu.parallel.cluster import ClusterDataplane, cluster_step
 
-__all__ = ["cluster_mesh", "table_specs", "ClusterDataplane", "cluster_step"]
+
+def __getattr__(name):
+    # MeshRuntime imports the agent stack (cmd.*); lazy so importing the
+    # device-side cluster API never drags control-plane modules in.
+    if name == "MeshRuntime":
+        from vpp_tpu.parallel.runtime import MeshRuntime
+
+        return MeshRuntime
+    raise AttributeError(name)
+
+
+__all__ = [
+    "cluster_mesh", "table_specs", "ClusterDataplane", "cluster_step",
+    "MeshRuntime",
+]
