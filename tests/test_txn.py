@@ -134,3 +134,126 @@ def test_failed_txn_rolls_back_completely(tmp_path):
     TxnJournal(journal.path).replay(dp2.builder)
     dp2.swap()
     assert verdicts(dp2) == want
+
+
+def test_live_agent_journal_replays_to_identical_tables(tmp_path):
+    """The api-trace e2e (VERDICT r3 Next #7): a REAL agent run — base
+    config, CNI adds, a rendered NetworkPolicy, a service with
+    endpoints, node events — journals every NB commit transparently;
+    replaying the journal onto a fresh builder reproduces the exact
+    table state the live agent enforced."""
+    import numpy as np
+
+    from vpp_tpu.cmd import AgentConfig, ContivAgent
+    from vpp_tpu.cmd.ksr_main import KsrAgent
+    from vpp_tpu.cni.model import CNIRequest
+    from vpp_tpu.ksr import model as m
+    from vpp_tpu.kvstore.store import KVStore
+    from vpp_tpu.pipeline.dataplane import Dataplane
+
+    journal_path = str(tmp_path / "txn-journal.jsonl")
+    store = KVStore()
+    ksr = KsrAgent(store=store, serve_http=False)
+    ksr.start()
+    agent = ContivAgent(
+        AgentConfig(node_name="jrnl-node", serve_http=False,
+                    txn_journal_path=journal_path),
+        store=store,
+    )
+    agent.start()
+
+    def add_pod(cid, name):
+        reply = agent.cni_server.add(CNIRequest(
+            container_id=cid,
+            extra_args={"K8S_POD_NAME": name,
+                        "K8S_POD_NAMESPACE": "default"}))
+        assert reply.result == 0
+        return reply.interfaces[0].ip_addresses[0].address.split("/")[0]
+
+    ip_web = add_pod("c-web", "web")
+    ip_db = add_pod("c-db", "db")
+    for name, ip, labels in (("web", ip_web, {"app": "web"}),
+                             ("db", ip_db, {"app": "db"})):
+        ksr.sources[m.Pod.TYPE].add(
+            f"default/{name}",
+            m.Pod(name=name, namespace="default", labels=labels,
+                  ip_address=ip))
+    ksr.sources[m.Namespace.TYPE].add(
+        "default", m.Namespace(name="default", labels={}))
+    ksr.sources[m.Policy.TYPE].add("default/db-policy", m.Policy(
+        name="db-policy", namespace="default",
+        pods=m.LabelSelector(match_labels={"app": "db"}),
+        policy_type=m.POLICY_INGRESS,
+        ingress_rules=[m.PolicyRule(
+            ports=[m.PolicyPort(protocol="TCP", port=5432)],
+            peers=[m.PolicyPeer(
+                pods=m.LabelSelector(match_labels={"app": "web"}))],
+        )]))
+    ksr.sources[m.Service.TYPE].add("default/db-svc", m.Service(
+        name="db-svc", namespace="default", cluster_ip="10.96.0.77",
+        ports=[m.ServicePort(name="pg", protocol="TCP", port=5432,
+                             target_port="pg")]))
+    ksr.sources[m.Endpoints.TYPE].add("default/db-svc", m.Endpoints(
+        name="db-svc", namespace="default",
+        subsets=[m.EndpointSubset(
+            addresses=[m.EndpointAddress(ip=ip_db, node_name="jrnl-node")],
+            ports=[m.EndpointPort(name="pg", port=5432, protocol="TCP")],
+        )]))
+    # one pod deleted too: the journal must carry del ops
+    agent.cni_server.delete(CNIRequest(container_id="c-web"))
+
+    live = {k: np.copy(v)
+            for k, v in agent.dataplane.builder.host_arrays().items()}
+    n_journaled = agent.dataplane.journal.applied
+    assert n_journaled >= 5, "base + cni x3 + policy + service commits"
+    agent.close()
+
+    # Replay onto a FRESH dataplane (same sizing config, no agent).
+    from vpp_tpu.pipeline.txn import TxnJournal
+
+    fresh = Dataplane(agent.config.dataplane)
+    n = TxnJournal(journal_path).replay(fresh.builder)
+    assert n == n_journaled
+    replayed = fresh.builder.host_arrays()
+    for field, arr in live.items():
+        np.testing.assert_array_equal(
+            arr, replayed[field], err_msg=f"field {field} diverged"
+        )
+
+
+def test_cli_config_history_and_replay(tmp_path):
+    """`show config-history` tails the journal; `config replay` restores
+    a journal into a live dataplane as one transaction."""
+    from vpp_tpu.cli import DebugCLI
+    from vpp_tpu.ir.rule import Action, ContivRule
+    from vpp_tpu.pipeline.dataplane import Dataplane
+    from vpp_tpu.pipeline.tables import DataplaneConfig, InterfaceType
+
+    cfg = DataplaneConfig(max_tables=2, max_rules=8, max_global_rules=8,
+                          max_ifaces=8, fib_slots=16, sess_slots=64,
+                          nat_mappings=2, nat_backends=4)
+    path = str(tmp_path / "j.jsonl")
+    dp = Dataplane(cfg)
+    dp.enable_journal(path)
+    dp.builder.txn_label = "seed"
+    dp.builder.set_interface(1, InterfaceType.POD)
+    dp.builder.add_route("10.9.0.2/32", 1, Disposition.LOCAL)
+    dp.builder.set_global_table([ContivRule(action=Action.PERMIT)])
+    dp.swap()
+
+    cli = DebugCLI(dp)
+    out = cli.run("show config-history")
+    assert "seed" in out and "1 txns journaled" in out
+
+    dp2 = Dataplane(cfg)
+    cli2 = DebugCLI(dp2)
+    out = cli2.run(f"config replay {path}")
+    assert "replayed 1 txns" in out
+    import numpy as np
+
+    a = dp.builder.host_arrays()
+    b = dp2.builder.host_arrays()
+    for field in a:
+        np.testing.assert_array_equal(a[field], b[field], err_msg=field)
+    # a dataplane without a journal reports that cleanly
+    assert "not enabled" in cli2.run("show config-history")

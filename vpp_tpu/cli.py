@@ -49,21 +49,79 @@ class DebugCLI:
         for sig, fn in handlers.items():
             if tuple(parts[: len(sig)]) == sig:
                 return fn()
+        if tuple(parts[:2]) == ("show", "config-history"):
+            return self.show_config_history(parts[2:])
         if tuple(parts[:2]) == ("test", "connectivity"):
             return self.test_connectivity(parts[2:])
         if tuple(parts[:2]) == ("trace", "add"):
             return self.trace_add(parts[2:])
         if tuple(parts[:2]) == ("trace", "clear"):
             return self.trace_clear()
+        if tuple(parts[:2]) == ("config", "replay"):
+            return self.config_replay(parts[2:])
         return f"unknown command: {line.strip()!r} (try 'help')"
 
     def help(self) -> str:
         return (
             "commands: show interface | show acl | show session | "
             "show nat44 | show fib | show trace | show errors | "
-            "show io | show neighbors | trace add [n] | trace clear | "
+            "show io | show neighbors | show config-history [n] | "
+            "trace add [n] | trace clear | config replay <journal> | "
             "test connectivity <src> <dst> <tcp|udp|icmp> [dport]"
         )
+
+    # --- config transaction trace (api-trace analog) ---
+    def show_config_history(self, args: List[str]) -> str:
+        """Tail of the NB transaction journal the live agent recorded
+        (`api-trace` dump analog): epoch, timestamp, label, op count."""
+        journal = self.dp.journal
+        if journal is None:
+            return "config journal not enabled (set txn_journal_path)"
+        limit = int(args[0]) if args else 20
+        import json
+        import os
+        import time as _time
+
+        if not journal.path or not os.path.exists(journal.path):
+            return f"{journal.applied} txns applied (no journal file)"
+        lines = []
+        with open(journal.path) as f:
+            entries = [json.loads(x) for x in f if x.strip()]
+        for e in entries[-limit:]:
+            ts = _time.strftime("%H:%M:%S", _time.localtime(e.get("t", 0)))
+            label = e.get("label") or "-"
+            lines.append(
+                f"epoch {e.get('epoch'):>5}  {ts}  {len(e.get('ops', [])):>3} "
+                f"ops  {label}"
+            )
+        lines.append(f"{len(entries)} txns journaled, showing last "
+                     f"{min(limit, len(entries))}")
+        return "\n".join(lines)
+
+    def config_replay(self, args: List[str]) -> str:
+        """Replay a journal file against the LIVE dataplane as ONE
+        transaction (bulk restore: stage every journaled op + a single
+        epoch swap)."""
+        if not args:
+            return "usage: config replay <journal.jsonl>"
+        from vpp_tpu.pipeline.txn import TxnJournal
+
+        journal = TxnJournal(args[0])
+        txns = journal.load()
+        if not txns:
+            return f"no transactions in {args[0]}"
+        dp = self.dp
+        with dp.commit_lock:
+            snap = dp.builder.state_snapshot()
+            try:
+                for txn in txns:
+                    txn.apply_to_builder(dp.builder)
+            except Exception as e:  # noqa: BLE001 — debug path
+                dp.builder.state_restore(snap)
+                return f"replay failed (rolled back): {type(e).__name__}: {e}"
+            dp.builder.txn_label = f"config-replay {args[0]}"
+            epoch = dp.swap()
+        return f"replayed {len(txns)} txns from {args[0]} -> epoch {epoch}"
 
     # --- commands ---
     def show_interface(self) -> str:

@@ -108,6 +108,12 @@ class ContivAgent:
         self.dataplane = (
             dataplane if dataplane is not None else Dataplane(c.dataplane)
         )
+        # api-trace: enabled BEFORE any staging so the journal opens with
+        # this agent's base vswitch config and replays to identical
+        # tables (reference contiv-vswitch.conf:13-15 `api-trace { on }`)
+        if c.txn_journal_path:
+            self.dataplane.enable_journal(c.txn_journal_path)
+            self.dataplane.builder.txn_label = "base-vswitch-config"
         self.uplink_if = self.dataplane.add_uplink()
         self.host_if = self.dataplane.add_host_interface()
         self.dataplane.set_vtep(int(self.ipam.vxlan_ip_address()))
@@ -248,6 +254,11 @@ class ContivAgent:
                 self.dataplane, self.io_rings,
                 max_batch=c.io.max_batch, depth=c.io.depth,
                 workers=c.io.workers,
+                # ICMP errors (time-exceeded/unreachable) originate from
+                # the node's pod gateway address — the hop traceroute
+                # shows (reference: VPP ip4-icmp-error)
+                icmp_src_ip=(int(self.ipam.pod_gateway_ip())
+                             if c.io.icmp_errors else 0),
             )
             # warm every dispatch bucket rung before serving — a lazy
             # mid-traffic rung compile would stall the rx rings
@@ -550,6 +561,7 @@ class ContivAgent:
                 node_id=-1 if self.mesh_node_resolver is not None else node_id,
             )
         with self.dataplane.commit_lock:
+            self.dataplane.builder.txn_label = f"node-event add {node_id}"
             self.dataplane.builder.add_route(
                 str(self.ipam.other_node_pod_network(node_id)), **with_hop
             )
@@ -568,6 +580,7 @@ class ContivAgent:
         if self._peer_routes.pop(node_id, None) is None:
             return
         with self.dataplane.commit_lock:
+            self.dataplane.builder.txn_label = f"node-event del {node_id}"
             self.dataplane.builder.del_route(
                 str(self.ipam.other_node_pod_network(node_id))
             )

@@ -41,6 +41,9 @@ class IOConfig:
     uplink_dhcp: bool = False
     proxy_arp: bool = False
     vni: int = 10
+    # generate ICMP time-exceeded / net-unreachable for attributed
+    # drops (VPP ip4-icmp-error analog; traceroute shows the vswitch hop)
+    icmp_errors: bool = True
     # handshake file the agent writes once rings exist so vpp-tpu-init
     # can start the IO daemon with matching geometry ("" = don't write)
     plan_path: str = ""
@@ -68,6 +71,9 @@ class AgentConfig:
     cni_socket: str = "/run/vpp-tpu/cni.sock"
     # debug CLI socket (the vppctl transport; "" disables)
     cli_socket: str = "/run/vpp-tpu/cli.sock"
+    # config transaction trace (api-trace analog): JSONL journal of every
+    # NB commit the live agent applies; "" disables recording
+    txn_journal_path: str = ""
     # observability / health
     stats_port: int = 9999
     health_port: int = 9191

@@ -86,6 +86,7 @@ class RemoteCNIServer:
                 rewire.append(cfg)
                 n += 1
             if n:
+                self.dp.builder.txn_label = f"cni-resync {n} pods"
                 self.dp.swap()
             if self.wirer is not None:
                 # re-attach surviving veth pairs to the (possibly also
@@ -165,6 +166,7 @@ class RemoteCNIServer:
                     self.dp.builder.add_route(
                         f"{ip}/32", if_idx, Disposition.LOCAL
                     )
+                    self.dp.builder.txn_label = f"cni-add {pod_id}"
                     self.dp.swap()
                 # kernel path: veth pair + netns config + daemon attach
                 # (the reference's configurePodInterface step,
@@ -222,6 +224,9 @@ class RemoteCNIServer:
                 self.dp.builder.del_route(f"{cfg.ip}/32")
                 self.dp.del_pod_interface(pod)
                 self.ipam.release_pod_ip(f"{cfg.pod_namespace}/{cfg.pod_name}")
+                self.dp.builder.txn_label = (
+                    f"cni-del {cfg.pod_namespace}/{cfg.pod_name}"
+                )
                 self.dp.swap()
             if self.wirer is not None:
                 self.wirer.unwire(

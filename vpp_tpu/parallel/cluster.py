@@ -374,6 +374,21 @@ class ClusterDataplane:
                 self._node_sharding,
             )
             self.epoch += 1
+            # per-node api-trace: drained only AFTER the guard and the
+            # device publish succeed — draining earlier would lose the
+            # ops from the journal when the guard raises (the staged
+            # builder state survives for the next swap; a drained
+            # recording would not). Ops journal under the CLUSTER epoch
+            # so a node's replayed history lines up with the epochs the
+            # mesh actually published. Writers hold the cluster commit
+            # lock across stage+swap, so nothing new staged between the
+            # array copy above and this drain.
+            for n in self.nodes:
+                if n.journal is not None:
+                    with n._lock:
+                        txn = n.builder.drain_recording()
+                    if txn is not None:
+                        n.journal.record(txn, self.epoch)
             return self.epoch
 
     def make_frames(self, per_node_packets: Sequence[list], n: int = 256) -> PacketVector:

@@ -261,6 +261,15 @@ class TableBuilder:
     def __init__(self, config: DataplaneConfig = DataplaneConfig()):
         self.config = config
         self.mxu_enabled = True  # opt-out knob for the bit-plane compile
+        # api-trace analog (pipeline/txn.py): with recording started,
+        # every mutator appends its declarative op here and the owning
+        # Dataplane journals the batch at swap() — production writers
+        # (renderers, CNI, service, node events) get recorded without
+        # changing, exactly like VPP tracing at the binary-API boundary
+        # (reference contiv-vswitch.conf:13-15 `api-trace { on }`).
+        self._rec = None
+        # optional writer-supplied label for the NEXT journaled txn
+        self.txn_label = ""
         c = config
         z = np.zeros
         self.acl = {
@@ -306,12 +315,36 @@ class TableBuilder:
     def _mark(self, group: str) -> None:
         self._dirty.add(group)
 
+    # --- op recording (config transaction trace) ---
+    def start_recording(self) -> None:
+        from vpp_tpu.pipeline.txn import ConfigTxn
+
+        if self._rec is None:
+            self._rec = ConfigTxn()
+
+    def drain_recording(self):
+        """Ops recorded since the last drain as one ConfigTxn (None when
+        recording is off or nothing was staged). Consumes the pending
+        ``txn_label``. Called by swap() under the commit lock."""
+        from vpp_tpu.pipeline.txn import ConfigTxn
+
+        if self._rec is None or not self._rec.ops:
+            self.txn_label = ""
+            return None
+        txn = self._rec
+        txn.label = self.txn_label
+        self.txn_label = ""
+        self._rec = ConfigTxn()
+        return txn
+
     # --- ACL ---
     def set_local_table(self, slot: int, rules: Sequence[ContivRule]) -> None:
         packed = pack_rules(rules, self.config.max_rules)
         for k, v in packed.items():
             self.acl[k][slot] = v
         self.acl_nrules[slot] = len(rules)
+        if self._rec is not None:
+            self._rec.set_local_table(slot, rules)
         self._mark("acl")
 
     def clear_local_table(self, slot: int) -> None:
@@ -322,6 +355,8 @@ class TableBuilder:
 
         self.glb = pack_rules(rules, self.config.max_global_rules)
         self.glb_nrules = len(rules)
+        if self._rec is not None:
+            self._rec.set_global_table(rules)
         # mxu_enabled=False skips the O(PLANES·R) host-side bit-plane
         # compile for callers that will never take the MXU path. (The
         # zero coeff matrix is still part of the pytree — shapes must
@@ -344,6 +379,9 @@ class TableBuilder:
         self.if_type[if_index] = int(if_type)
         self.if_local_table[if_index] = local_table
         self.if_apply_global[if_index] = int(apply_global)
+        if self._rec is not None:
+            self._rec.set_interface(if_index, int(if_type), local_table,
+                                    bool(apply_global))
         self._mark("if")
 
     def set_if_local_table(self, if_index: int, slot: int) -> None:
@@ -352,6 +390,8 @@ class TableBuilder:
         set_interface — external writers must come through here so the
         'if' upload group gets marked dirty."""
         self.if_local_table[if_index] = slot
+        if self._rec is not None:
+            self._rec.set_if_local_table(if_index, slot)
         self._mark("if")
 
     # --- FIB ---
@@ -380,6 +420,10 @@ class TableBuilder:
         self.fib_next_hop[slot] = next_hop
         self.fib_node_id[slot] = node_id
         self.fib_snat[slot] = int(snat)
+        if self._rec is not None:
+            self._rec.add_route(prefix, tx_if, int(disposition),
+                                int(next_hop), int(node_id), bool(snat),
+                                slot=slot)
         self._mark("fib")
         return slot
 
@@ -393,6 +437,8 @@ class TableBuilder:
         if len(hit) == 0:
             return False
         self.fib_plen[hit[0]] = -1
+        if self._rec is not None:
+            self._rec.del_route(prefix)
         self._mark("fib")
         return True
 
@@ -424,10 +470,17 @@ class TableBuilder:
         self.nat_bcnt[slot] = len(backends)
         self.nat_total_w[slot] = cum
         self.nat_self_snat[slot] = int(self_snat)
+        if self._rec is not None:
+            self._rec.set_nat_mapping(
+                slot, int(ext_ip), int(ext_port), int(proto),
+                [(int(a), int(b), int(w)) for a, b, w in backends],
+                int(boff), bool(self_snat))
         self._mark("nat")
 
     def clear_nat(self) -> None:
         self.nat_bcnt[:] = 0
+        if self._rec is not None:
+            self._rec.clear_nat()
         self._mark("nat")
 
     def set_snat_ip(self, ip: int) -> None:
@@ -435,6 +488,8 @@ class TableBuilder:
         mutation point for ``nat_snat_ip`` — agent bootstrap and the
         service configurator both route through here."""
         self.nat_snat_ip = np.uint32(ip)
+        if self._rec is not None:
+            self._rec.set_snat_ip(int(ip))
         self._mark("nat")
 
     # staging-state array attributes (everything a mutator can touch,
@@ -462,6 +517,7 @@ class TableBuilder:
             "glb_mxu": self.glb_mxu,       # replaced wholesale, never
             "nat_snat_ip": self.nat_snat_ip,  # mutated in place
             "dirty": set(self._dirty),
+            "rec_ops": list(self._rec.ops) if self._rec is not None else None,
         }
 
     def state_restore(self, snap: dict) -> None:
@@ -480,6 +536,8 @@ class TableBuilder:
         # dirty — a redundant re-upload of identical data is harmless,
         # a stale device cache is not
         self._dirty |= set(snap["dirty"])
+        if self._rec is not None and snap.get("rec_ops") is not None:
+            self._rec.ops[:] = snap["rec_ops"]
 
     # --- device upload ---
     def host_arrays(self) -> Dict[str, np.ndarray]:

@@ -101,11 +101,17 @@ class ConfigTxn:
 
     def add_route(self, prefix: str, tx_if: int, disposition: int,
                   next_hop: int = 0, node_id: int = -1,
-                  snat: bool = False) -> "ConfigTxn":
-        return self._record("add_route", prefix=prefix, tx_if=tx_if,
-                            disposition=int(disposition),
-                            next_hop=next_hop, node_id=node_id,
-                            snat=bool(snat))
+                  snat: bool = False,
+                  slot: Optional[int] = None) -> "ConfigTxn":
+        """``slot`` pins the FIB slot (recorded from the builder's
+        resolved placement, so replay reproduces byte-identical
+        tables); None lets replay allocate."""
+        kw = dict(prefix=prefix, tx_if=tx_if,
+                  disposition=int(disposition), next_hop=next_hop,
+                  node_id=node_id, snat=bool(snat))
+        if slot is not None:
+            kw["slot"] = int(slot)
+        return self._record("add_route", **kw)
 
     def del_route(self, prefix: str) -> "ConfigTxn":
         return self._record("del_route", prefix=prefix)
@@ -223,6 +229,9 @@ def apply_txn(dataplane, txn: ConfigTxn,
             dataplane.builder.state_restore(snap)
             raise
         epoch = dataplane.swap()
-        if journal is not None:
+        # a dataplane with its own journal + recording already recorded
+        # this txn during swap(); only record here when the caller's
+        # journal is a different one (or the dataplane has none)
+        if journal is not None and journal is not dataplane.journal:
             journal.record(txn, epoch)
     return epoch

@@ -102,4 +102,7 @@ class TpuRendererTxn(RendererTxn):
         # A resync always publishes (its __init__ already mutated the
         # builder, even when nothing gets re-rendered).
         if changes or self.cache_txn.get_updated_pods() or self.resync:
+            dp.builder.txn_label = (
+                "policy-resync" if self.resync else "policy-render"
+            )
             dp.swap()

@@ -65,6 +65,7 @@ class ServiceConfigurator:
     def set_snat_ip(self, ip: str) -> None:
         with self.dataplane.commit_lock:
             self.dataplane.builder.set_snat_ip(ip4(ip))
+            self.dataplane.builder.txn_label = "service-snat-ip"
             self.dataplane.swap()
 
     def resync(self, services: List[ContivService]) -> None:
@@ -117,6 +118,7 @@ class ServiceConfigurator:
                     )
                     slot += 1
                 boff += n
+        builder.txn_label = f"service-rebuild {len(self.services)} services"
         dp.swap()
 
     def _weighted_backends(
