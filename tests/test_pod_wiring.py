@@ -92,9 +92,9 @@ def stack(tmp_path):
     daemon = IODaemon(rings, {}, uplink_if=uplink).start()
     ctl_sock = str(tmp_path / "io-ctl.sock")
     control = IOControlServer(daemon, ctl_sock).start()
-    pump = DataplanePump(dp, rings).start()
-
     ipam = IPAM(node_id=1)
+    pump = DataplanePump(dp, rings,
+                         icmp_src_ip=int(ipam.pod_gateway_ip())).start()
     wirer = VethPodWirer(IOControlClient(ctl_sock),
                          gateway_ip=str(ipam.pod_gateway_ip()))
     server = RemoteCNIServer(dp, ipam, wirer=wirer)
@@ -223,3 +223,60 @@ class TestPodWiring:
         assert stack["dp"].pod_if.get(("default", "ghost")) is None
         # and the retry path stays clean (no stale index/interface)
         assert server.index.lookup("feedfacefeedface") is None
+
+
+def _traceroute_hop(ns: str, dst: str, ttl: int, port: int = 33434):
+    """One traceroute probe from inside the pod netns: a UDP datagram
+    with the given TTL + a raw-ICMP listener; prints 'hop_ip|type' the
+    way traceroute discovers each hop."""
+    code = (
+        "import socket, time\n"
+        "icmp = socket.socket(socket.AF_INET, socket.SOCK_RAW,\n"
+        "                     socket.IPPROTO_ICMP)\n"
+        "icmp.settimeout(20)\n"
+        "s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)\n"
+        f"s.setsockopt(socket.IPPROTO_IP, socket.IP_TTL, {ttl})\n"
+        "for _ in range(20):\n"
+        f"    s.sendto(b'probe', ('{dst}', {port}))\n"
+        "    time.sleep(0.1)\n"
+        "    try:\n"
+        "        data, peer = icmp.recvfrom(4096)\n"
+        "    except socket.timeout:\n"
+        "        continue\n"
+        "    ihl = (data[0] & 0xF) * 4\n"
+        "    print(peer[0] + '|' + str(data[ihl]), flush=True)\n"
+        "    break\n"
+    )
+    return subprocess.run(
+        ["ip", "netns", "exec", ns, sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=60,
+    )
+
+
+class TestTracerouteHop:
+    def test_ttl1_probe_reports_vswitch_gateway_hop(self, stack):
+        """The traceroute semantic (VERDICT r3 Next #8): a TTL=1 UDP
+        probe from pod A toward pod B expires at the vswitch, and the
+        pod receives ICMP time-exceeded FROM THE GATEWAY IP — the hop
+        traceroute prints (reference: VPP ip4-icmp-error branch,
+        docs/VPP_PACKET_TRACING_K8S.md:28-50)."""
+        server, ipam = stack["server"], stack["ipam"]
+        _add_pod(server, CID_A, NS_A, "pod-a")
+        ip_b = _add_pod(server, CID_B, NS_B, "pod-b")
+
+        res = _traceroute_hop(NS_A, ip_b, ttl=1)
+        assert res.returncode == 0, res.stderr
+        assert res.stdout.strip(), f"no ICMP hop reply: {res.stderr}"
+        hop_ip, icmp_type = res.stdout.strip().split("|")
+        assert hop_ip == str(ipam.pod_gateway_ip()), \
+            "time-exceeded must come from the vswitch gateway hop"
+        assert int(icmp_type) == 11  # time exceeded
+
+        # with a normal TTL the probe traverses the vswitch and reaches
+        # pod B, whose kernel answers port-unreachable — the terminal
+        # hop of a traceroute. The vswitch must NOT be the responder.
+        res2 = _traceroute_hop(NS_A, ip_b, ttl=8)
+        if res2.stdout.strip():
+            hop2, t2 = res2.stdout.strip().split("|")
+            assert hop2 == ip_b and int(t2) == 3, \
+                "full-TTL probe must reach the destination pod"

@@ -62,13 +62,24 @@ class DataplanePump:
                  max_batch: int = 2048,
                  depth: int = 8,
                  workers: int = 4,
-                 lat_window: int = 4096):
+                 lat_window: int = 4096,
+                 icmp_src_ip: int = 0):
         """``max_batch``: largest coalesced device batch (packets);
         ``depth``: in-flight batches before dispatch backpressures;
-        ``workers``: concurrent result fetchers."""
+        ``workers``: concurrent result fetchers; ``icmp_src_ip``: with a
+        non-zero address (the node's pod gateway IP), TTL-expired and
+        no-route drops generate ICMP time-exceeded/net-unreachable back
+        to the sender (io/icmp.py; VPP's ip4-icmp-error node)."""
         self.dp = dataplane
         self.rings = rings
         self.poll_s = poll_s
+        self.icmp = None
+        self._icmp_scratch = None
+        if icmp_src_ip:
+            from vpp_tpu.io.icmp import IcmpErrorGen
+
+            self.icmp = IcmpErrorGen(icmp_src_ip, VEC, rings.tx.snap)
+            self._icmp_scratch = np.zeros((VEC, rings.tx.snap), np.uint8)
         self.max_batch = max(VEC, int(max_batch))
         # geometric bucket ladder VEC, 4·VEC, 16·VEC, … up to max_batch:
         # a partial backlog pads to the next bucket, not straight to
@@ -265,9 +276,9 @@ class DataplanePump:
             seq, payload, frames, non_ip, t0, slow = item
             try:
                 if slow:
-                    out_pkts, disp, tx_if, next_hop = jax.device_get(
+                    out_pkts, disp, tx_if, next_hop, cause = jax.device_get(
                         (payload.pkts, payload.disp, payload.tx_if,
-                         payload.next_hop)
+                         payload.next_hop, payload.drop_cause)
                     )
                     batch = {
                         "src_ip": np.asarray(out_pkts.src_ip),
@@ -280,6 +291,7 @@ class DataplanePump:
                         "disp": np.asarray(disp).astype(np.int32).copy(),
                         "tx_if": np.asarray(tx_if).astype(np.int32).copy(),
                         "next_hop": np.asarray(next_hop),
+                        "drop_cause": np.asarray(cause).astype(np.int32),
                     }
                 else:
                     # ONE [5, B] fetch; np.array: device_get may hand
@@ -326,6 +338,11 @@ class DataplanePump:
                            if self.dp.host_if is not None else -1)
                 batch["disp"][non_ip] = int(Disposition.HOST)
                 batch["tx_if"][non_ip] = host_if
+            # error-drop attribution is pump-consumed (ICMP error
+            # generation), not a ring column
+            drop_cause = batch.pop("drop_cause", None)
+            if self.icmp is not None and drop_cause is not None:
+                self._emit_icmp_errors(drop_cause, frames)
             batch["rx_if"] = batch.pop("tx_if")  # tx direction: egress if
             epoch = self.dp.epoch
             off = 0
@@ -357,6 +374,52 @@ class DataplanePump:
             for _ in frames:
                 self.rings.rx.release()
             self._held -= len(frames)
+
+    def _emit_icmp_errors(self, drop_cause: np.ndarray,
+                          frames: list) -> None:
+        """Generate ICMP time-exceeded / net-unreachable frames for
+        attributed drops (VERDICT r3 Next #8; VPP ip4-icmp-error). The
+        invoking packet is quoted from its rx slot payload — still
+        ring-owned here, so the original bytes are stable."""
+        from vpp_tpu.io.icmp import ICMP_TIME_EXCEEDED, ICMP_UNREACHABLE
+        from vpp_tpu.pipeline.graph import DROP_IP4, DROP_NO_ROUTE
+
+        uplink = self.dp.uplink_if
+        off = 0
+        for f in frames:
+            n = f.n
+            cause = drop_cause[off:off + n]
+            off += n
+            valid = (f.cols["flags"][:n] & 1) != 0
+            # Cross-node senders (rx on the uplink) would need the error
+            # routed back through the fabric/VXLAN path; emitting it
+            # disp=LOCAL out the uplink would inject a bare inner frame
+            # into the overlay. Until errors are re-injected through the
+            # pipeline, only locally-originated drops generate ICMP.
+            if uplink is not None:
+                valid &= f.cols["rx_if"][:n] != uplink
+            # DROP_IP4 covers TTL/len/bad-if; only a TTL of <= 1 at
+            # ingress is a time-exceeded
+            ttl_exp = (cause == DROP_IP4) & (f.cols["ttl"][:n] <= 1) & valid
+            no_rt = (cause == DROP_NO_ROUTE) & valid
+            idxs = np.nonzero(ttl_exp | no_rt)[0]
+            if not len(idxs):
+                continue
+            types = np.where(ttl_exp[idxs], ICMP_TIME_EXCEEDED,
+                             ICMP_UNREACHABLE)
+            built = self.icmp.build_frame(
+                idxs, types, f.cols, f.payload, self._icmp_scratch
+            )
+            if built is None:
+                continue
+            out_cols, k = built
+            if self.rings.tx.push(out_cols, k, payload=self._icmp_scratch,
+                                  epoch=self.dp.epoch):
+                self.stats["icmp_errors"] = (
+                    self.stats.get("icmp_errors", 0) + k
+                )
+            else:
+                self.stats["tx_ring_full"] += 1
 
     # --- observability ---
     def latency_us(self) -> dict:
