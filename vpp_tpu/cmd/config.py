@@ -30,10 +30,12 @@ class IOConfig:
     # (io/control.py; reference remote_cni_server.go:895-1250)
     control_socket: str = ""
     # pump tuning (io/pump.py): coalesced device batch cap, in-flight
-    # batches, concurrent result fetchers
+    # batches, concurrent result fetchers (None = auto: 8 on a remote
+    # device so fetch RPC round trips overlap, 1 on the CPU backend
+    # where extra blocked threads only churn the GIL)
     max_batch: int = 2048
     depth: int = 8
-    workers: int = 4
+    workers: int | None = None
     # node uplink (vpp-tpu-init bootstrap; reference contiv-init
     # vppcfg.go:74-559): kernel NIC the IO daemon binds as the uplink
     uplink_interface: str = ""

@@ -148,12 +148,21 @@ class IODaemon:
                     bfd = transport.batch_fd
                     if bfd is not None:
                         # native fast path: recvmmsg straight into the
-                        # payload scratch rows, zero bytes objects
-                        n = self.codec.recv_batch(
-                            bfd, self._scratch, self._rx_lens
-                        )
-                        if n > 0:
+                        # payload scratch rows, zero bytes objects.
+                        # Drain in a burst (bounded so one flooding
+                        # interface can't starve the rest): a single
+                        # batch per select wake caps rx at
+                        # VEC / wake-latency and drops the rest in the
+                        # kernel queue.
+                        for _ in range(16):
+                            n = self.codec.recv_batch(
+                                bfd, self._scratch, self._rx_lens
+                            )
+                            if n <= 0:
+                                break
                             self._ingest_scratch(if_idx, n)
+                            if n < VEC:
+                                break
                     else:
                         frames = transport.recv_frames(VEC)
                         if frames:

@@ -42,12 +42,9 @@ from typing import Optional
 import numpy as np
 
 from vpp_tpu.io.rings import VEC, IORingPair
-from vpp_tpu.native.pktio import FLAG_NON_IP4, FLAG_TRUNC
 from vpp_tpu.pipeline.dataplane import (
     PACKED_IN_ROWS,
-    pack_packet_columns,
     unpack_packet_input,
-    unpack_packet_result,
 )
 from vpp_tpu.pipeline.vector import Disposition, PacketVector
 
@@ -61,18 +58,28 @@ class DataplanePump:
                  poll_s: float = 0.0002,
                  max_batch: int = 2048,
                  depth: int = 8,
-                 workers: int = 4,
+                 workers: Optional[int] = None,
                  lat_window: int = 4096,
                  icmp_src_ip: int = 0):
         """``max_batch``: largest coalesced device batch (packets);
         ``depth``: in-flight batches before dispatch backpressures;
-        ``workers``: concurrent result fetchers; ``icmp_src_ip``: with a
-        non-zero address (the node's pod gateway IP), TTL-expired and
-        no-route drops generate ICMP time-exceeded/net-unreachable back
-        to the sender (io/icmp.py; VPP's ip4-icmp-error node)."""
+        ``workers``: concurrent result fetchers — None auto-picks: on a
+        REMOTE device a fetch is an RPC round trip (~100 ms on the axon
+        tunnel) and W workers overlap W round trips, so 8; on the CPU
+        backend a fetch is a local memcpy and extra blocked threads only
+        churn the GIL against the dispatch/writer threads (measured 14%
+        throughput loss at 8 workers on a single-core host), so 1.
+        ``icmp_src_ip``: with a non-zero address (the node's pod gateway
+        IP), TTL-expired and no-route drops generate ICMP
+        time-exceeded/net-unreachable back to the sender (io/icmp.py;
+        VPP's ip4-icmp-error node)."""
         self.dp = dataplane
         self.rings = rings
         self.poll_s = poll_s
+        if workers is None:
+            import jax
+
+            workers = 1 if jax.default_backend() == "cpu" else 8
         self.icmp = None
         self._icmp_scratch = None
         if icmp_src_ip:
@@ -80,6 +87,13 @@ class DataplanePump:
 
             self.icmp = IcmpErrorGen(icmp_src_ip, VEC, rings.tx.snap)
             self._icmp_scratch = np.zeros((VEC, rings.tx.snap), np.uint8)
+        # native fast-path scratch (single dispatch / single tx-writer
+        # thread each, so plain reuse is safe): per-batch frame base
+        # pointers + counts for pio_pack_batch, per-frame drop causes
+        # out of pio_unpack_to_slot
+        self._pack_bases = np.zeros(rings.rx.ring.n_slots, np.uint64)
+        self._pack_ns = np.zeros(rings.rx.ring.n_slots, np.uint32)
+        self._cause = np.zeros(VEC, np.int32)
         self.max_batch = max(VEC, int(max_batch))
         # geometric bucket ladder VEC, 4·VEC, 16·VEC, … up to max_batch:
         # a partial backlog pads to the next bucket, not straight to
@@ -98,6 +112,10 @@ class DataplanePump:
         self.stats = {
             "frames": 0, "pkts": 0, "batches": 0, "tx_ring_full": 0,
             "max_coalesce": 0, "batch_errors": 0,
+            # cumulative seconds per stage (profiling; `show io` /
+            # bench read these to attribute wire-path time)
+            "t_pack": 0.0, "t_dispatch": 0.0, "t_fetch": 0.0,
+            "t_write": 0.0,
         }
         # dispatch→tx latency of recent batches, seconds (experienced
         # added latency of the device leg; ring-wait not included — the
@@ -213,21 +231,24 @@ class DataplanePump:
         # climb the rungs instead of jumping straight to max_batch
         bucket = next(b for b in self.buckets if b >= total)
         # one [5, bucket] int32 bit-packed block: a single host→device
-        # transfer of 20 B/packet (dataplane.pack_packet_columns layout)
+        # transfer of 20 B/packet (dataplane.pack_packet_columns
+        # layout), filled by ONE native call over every frame's ring
+        # slot — the pack/mask loop releases the GIL so the daemon's rx
+        # thread keeps draining its sockets (VERDICT r3 Next #5). Bad
+        # (non-IPv4/truncated) slots are masked invalid for the
+        # pipeline; non-IP is punted after the step via `non_ip`.
+        from vpp_tpu.native.pktio import pack_batch
+
+        tp0 = time.perf_counter()
         flat = np.zeros((PACKED_IN_ROWS, bucket), np.int32)
-        fu = flat.view(np.uint32)
-        off = 0
-        for f in frames:
-            pack_packet_columns(fu, f.cols, f.n, off)
-            off += f.n
-        flags = fu[4] & 0xFF
-        non_ip = (flags & FLAG_NON_IP4) != 0
-        # non-IPv4 and truncated slots are invalid for the pipeline
-        # (bogus/partial headers); non-IP is punted after the step,
-        # truncated is dropped by the daemon via its flag. Padding slots
-        # beyond `off` stay flags=0 == invalid.
-        bad = (flags & (FLAG_NON_IP4 | FLAG_TRUNC)) != 0
-        fu[4] = np.where(bad, fu[4] & ~np.uint32(0xFF), fu[4])
+        non_ip = np.zeros(bucket, np.uint8)
+        for j, f in enumerate(frames):
+            self._pack_bases[j] = f.cols["src_ip"].ctypes.data
+            self._pack_ns[j] = f.n
+        pack_batch(self._pack_bases, self._pack_ns, len(frames), flat,
+                   non_ip)
+        non_ip = non_ip.view(bool)
+        self.stats["t_pack"] += time.perf_counter() - tp0
         tracer = self.dp.tracer
         slow = tracer is not None and getattr(tracer, "_armed", 0) > 0
         t0 = time.perf_counter()
@@ -239,6 +260,7 @@ class DataplanePump:
             )
         else:
             payload = self.dp.process_packed(flat)  # async dispatch
+        self.stats["t_dispatch"] += time.perf_counter() - t0
         item = (self._seq, payload, frames, non_ip, t0, slow)
         while True:
             # bounded put that stays responsive to stop(): the fetchers
@@ -294,11 +316,18 @@ class DataplanePump:
                         "drop_cause": np.asarray(cause).astype(np.int32),
                     }
                 else:
-                    # ONE [5, B] fetch; np.array: device_get may hand
-                    # back a read-only zero-copy view (CPU backend) and
-                    # the decode + writer mutate rows
-                    out = np.array(jax.device_get(payload))
-                    batch = unpack_packet_result(out)
+                    # ONE [5, B] fetch, kept PACKED: the tx writer
+                    # decodes it straight into ring slots natively
+                    # (rings.push_packed), no host-side column arrays.
+                    # np.array: device_get may hand back a zero-copy
+                    # view of a device buffer whose lifetime ends with
+                    # `payload` — the copy (20 B/packet) outlives it
+                    tf0 = time.perf_counter()
+                    batch = np.array(jax.device_get(payload))
+                    # concurrent fetchers: accumulate under a lock or
+                    # the += load/add/store interleaves and undercounts
+                    with self._lat_lock:
+                        self.stats["t_fetch"] += time.perf_counter() - tf0
             except Exception:
                 log.exception("pump fetch failed (batch %d)", seq)
                 batch = None
@@ -332,7 +361,32 @@ class DataplanePump:
                     self._held -= len(item[1])
 
     def _write(self, batch, frames: list, non_ip, t0: float) -> None:
-        if batch is not None:
+        if isinstance(batch, np.ndarray):
+            # fast path: ONE native call per frame decodes the packed
+            # result straight into a reserved tx slot (pass-through
+            # columns from the rx slot, non-IP punt applied in C)
+            tw0 = time.perf_counter()
+            host_if = (self.dp.host_if
+                       if self.dp.host_if is not None else -1)
+            epoch = self.dp.epoch
+            icmp_on = self.icmp is not None
+            off = 0
+            for f in frames:
+                n = f.n
+                if self.rings.tx.push_packed(batch, off, n, f, host_if,
+                                             epoch, self._cause):
+                    self.stats["frames"] += 1
+                    self.stats["pkts"] += n
+                    if icmp_on and n and self._cause[:n].any():
+                        self._emit_icmp_frame(f, self._cause)
+                else:
+                    self.stats["tx_ring_full"] += 1
+                off += n
+            self.stats["t_write"] += time.perf_counter() - tw0
+            with self._lat_lock:
+                self.batch_lat.append(time.perf_counter() - t0)
+        elif batch is not None:
+            # tracing path: full column dict from the unpacked step
             if non_ip is not None and non_ip.any():
                 host_if = (self.dp.host_if
                            if self.dp.host_if is not None else -1)
@@ -341,8 +395,6 @@ class DataplanePump:
             # error-drop attribution is pump-consumed (ICMP error
             # generation), not a ring column
             drop_cause = batch.pop("drop_cause", None)
-            if self.icmp is not None and drop_cause is not None:
-                self._emit_icmp_errors(drop_cause, frames)
             batch["rx_if"] = batch.pop("tx_if")  # tx direction: egress if
             epoch = self.dp.epoch
             off = 0
@@ -365,6 +417,14 @@ class DataplanePump:
                                       epoch=epoch):
                     self.stats["frames"] += 1
                     self.stats["pkts"] += n
+                    # ICMP only for frames that made it out: under tx
+                    # backpressure the error frames drop with the
+                    # traffic (same policy as the fast path)
+                    if self.icmp is not None and drop_cause is not None:
+                        cause = np.zeros(VEC, np.int32)
+                        cause[:n] = drop_cause[off:off + n]
+                        if cause[:n].any():
+                            self._emit_icmp_frame(f, cause)
                 else:
                     self.stats["tx_ring_full"] += 1
                 off += n
@@ -375,51 +435,48 @@ class DataplanePump:
                 self.rings.rx.release()
             self._held -= len(frames)
 
-    def _emit_icmp_errors(self, drop_cause: np.ndarray,
-                          frames: list) -> None:
-        """Generate ICMP time-exceeded / net-unreachable frames for
-        attributed drops (VERDICT r3 Next #8; VPP ip4-icmp-error). The
-        invoking packet is quoted from its rx slot payload — still
-        ring-owned here, so the original bytes are stable."""
+    def _emit_icmp_frame(self, f, cause: np.ndarray) -> None:
+        """Generate ICMP time-exceeded / net-unreachable frames for one
+        rx frame's attributed drops (VERDICT r3 Next #8; VPP
+        ip4-icmp-error). The invoking packet is quoted from its rx slot
+        payload — still ring-owned here, so the original bytes are
+        stable. ``cause`` is the per-packet DROP_* array [VEC]."""
         from vpp_tpu.io.icmp import ICMP_TIME_EXCEEDED, ICMP_UNREACHABLE
         from vpp_tpu.pipeline.graph import DROP_IP4, DROP_NO_ROUTE
 
+        n = f.n
+        c = cause[:n]
+        valid = (f.cols["flags"][:n] & 1) != 0
+        # Cross-node senders (rx on the uplink) would need the error
+        # routed back through the fabric/VXLAN path; emitting it
+        # disp=LOCAL out the uplink would inject a bare inner frame
+        # into the overlay. Until errors are re-injected through the
+        # pipeline, only locally-originated drops generate ICMP.
         uplink = self.dp.uplink_if
-        off = 0
-        for f in frames:
-            n = f.n
-            cause = drop_cause[off:off + n]
-            off += n
-            valid = (f.cols["flags"][:n] & 1) != 0
-            # Cross-node senders (rx on the uplink) would need the error
-            # routed back through the fabric/VXLAN path; emitting it
-            # disp=LOCAL out the uplink would inject a bare inner frame
-            # into the overlay. Until errors are re-injected through the
-            # pipeline, only locally-originated drops generate ICMP.
-            if uplink is not None:
-                valid &= f.cols["rx_if"][:n] != uplink
-            # DROP_IP4 covers TTL/len/bad-if; only a TTL of <= 1 at
-            # ingress is a time-exceeded
-            ttl_exp = (cause == DROP_IP4) & (f.cols["ttl"][:n] <= 1) & valid
-            no_rt = (cause == DROP_NO_ROUTE) & valid
-            idxs = np.nonzero(ttl_exp | no_rt)[0]
-            if not len(idxs):
-                continue
-            types = np.where(ttl_exp[idxs], ICMP_TIME_EXCEEDED,
-                             ICMP_UNREACHABLE)
-            built = self.icmp.build_frame(
-                idxs, types, f.cols, f.payload, self._icmp_scratch
+        if uplink is not None:
+            valid &= f.cols["rx_if"][:n] != uplink
+        # DROP_IP4 covers TTL/len/bad-if; only a TTL of <= 1 at
+        # ingress is a time-exceeded
+        ttl_exp = (c == DROP_IP4) & (f.cols["ttl"][:n] <= 1) & valid
+        no_rt = (c == DROP_NO_ROUTE) & valid
+        idxs = np.nonzero(ttl_exp | no_rt)[0]
+        if not len(idxs):
+            return
+        types = np.where(ttl_exp[idxs], ICMP_TIME_EXCEEDED,
+                         ICMP_UNREACHABLE)
+        built = self.icmp.build_frame(
+            idxs, types, f.cols, f.payload, self._icmp_scratch
+        )
+        if built is None:
+            return
+        out_cols, k = built
+        if self.rings.tx.push(out_cols, k, payload=self._icmp_scratch,
+                              epoch=self.dp.epoch):
+            self.stats["icmp_errors"] = (
+                self.stats.get("icmp_errors", 0) + k
             )
-            if built is None:
-                continue
-            out_cols, k = built
-            if self.rings.tx.push(out_cols, k, payload=self._icmp_scratch,
-                                  epoch=self.dp.epoch):
-                self.stats["icmp_errors"] = (
-                    self.stats.get("icmp_errors", 0) + k
-                )
-            else:
-                self.stats["tx_ring_full"] += 1
+        else:
+            self.stats["tx_ring_full"] += 1
 
     # --- observability ---
     def latency_us(self) -> dict:

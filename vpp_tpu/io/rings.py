@@ -79,6 +79,40 @@ class IORing:
         self.ring.commit()
         return True
 
+    def push_packed(self, packed: np.ndarray, poff: int, n: int,
+                    rx_frame: Frame, host_if: int, epoch: int,
+                    cause: np.ndarray) -> bool:
+        """Fast-path producer: decode packed device results
+        ([5, bucket] int32, columns [poff, poff+n)) STRAIGHT into the
+        reserved slot's column block in one native call (pass-through
+        columns from the rx slot, non-IPv4 re-punted to ``host_if``),
+        then copy the payload rows. Per-packet drop_cause lands in
+        ``cause`` (int32[VEC]) for the caller. False if full."""
+        from vpp_tpu.native.pktio import unpack_to_slot
+
+        ring = self.ring
+        off = ring.reserve()
+        if off < 0:
+            return False
+        hdr = np.frombuffer(ring._mv, np.uint32, count=2, offset=off)
+        hdr[0] = n
+        hdr[1] = epoch
+        base = ring._arr.ctypes.data
+        unpack_to_slot(
+            packed, poff, n,
+            rx_frame.cols["src_ip"].ctypes.data,
+            base + off + ring._slot_hdr, host_if, cause,
+        )
+        if rx_frame.payload is not None:
+            w = self.snap
+            if n:
+                w = min(self.snap,
+                        int(np.max(rx_frame.cols["pkt_len"][:n])) + 14)
+            self.payload[self._slot_index(off), :n, :w] = \
+                rx_frame.payload[:n, :w]
+        ring.commit()
+        return True
+
     # --- consumer ---
     def peek(self) -> Optional[Frame]:
         """Zero-copy views of the oldest frame (cols + payload), or None.

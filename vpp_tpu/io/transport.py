@@ -74,11 +74,33 @@ def _drain_fd_socket(sock: socket.socket, max_frames: int,
 class AfPacketTransport(Transport):
     """Raw L2 socket bound to a kernel interface (requires CAP_NET_RAW)."""
 
-    def __init__(self, ifname: str):
+    def __init__(self, ifname: str, rcvbuf: int = 64 << 20):
         self.name = ifname
         self.sock = socket.socket(
             socket.AF_PACKET, socket.SOCK_RAW, socket.htons(ETH_P_ALL)
         )
+        # deep rx queue: the daemon drains in bursts (select → recvmmsg
+        # batches) while sharing a core with the pump; the default
+        # ~200 KB socket buffer drops entire line-rate bursts between
+        # drains. RCVBUFFORCE pierces rmem_max under CAP_NET_ADMIN
+        # (which af_packet needs anyway); fall back to the clamped set.
+        SO_RCVBUFFORCE = 33
+        try:
+            self.sock.setsockopt(socket.SOL_SOCKET, SO_RCVBUFFORCE, rcvbuf)
+        except OSError:
+            self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                                 rcvbuf)
+        # Never receive our OWN transmissions: without this every frame
+        # the daemon sends on an interface is looped back into its rx
+        # path (PACKET_OUTGOING), re-enters the pipeline, and — for
+        # LOCAL-delivered traffic — re-transmits out the same interface
+        # until TTL exhausts: ~60 wasted pipeline passes per delivered
+        # packet, the dominant (hidden) cost of the r3 wire path.
+        SOL_PACKET, PACKET_IGNORE_OUTGOING = 263, 23
+        try:
+            self.sock.setsockopt(SOL_PACKET, PACKET_IGNORE_OUTGOING, 1)
+        except OSError:
+            pass  # pre-4.20 kernel: loop suppressed by TTL only
         self.sock.bind((ifname, 0))
         self.sock.setblocking(False)
         info = fcntl.ioctl(

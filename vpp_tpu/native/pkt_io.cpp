@@ -183,6 +183,104 @@ void parse_fields(const uint8_t* f, uint32_t len, uint32_t copy,
 
 extern "C" {
 
+// ---- pump fast path (io/pump.py hot loops in one GIL-releasing call
+// per batch/frame): pack rx ring slots into the [5, B] bit-packed
+// device batch, and decode the [5, B] packed result straight into a tx
+// ring slot's column block. Layouts must mirror
+// pipeline/dataplane.py's _packed_call / pack_packet_columns /
+// unpack_packet_result. ----
+
+// Pack `n_frames` rx slots (each a int32[12][kVec] column block, base
+// pointers in `slot_bases`) into the packed batch `flat` =
+// int32[5][bucket], sequentially from column 0. Non-IPv4/truncated
+// packets are masked INVALID for the device step (flags byte cleared),
+// and their non-ip bit is reported in `non_ip` (uint8[bucket], 1 =
+// punt to host after the step) — exactly the Python dispatch path.
+void pio_pack_batch(const uint64_t* slot_bases, const uint32_t* ns,
+                    uint32_t n_frames, int32_t* flat, uint32_t bucket,
+                    uint8_t* non_ip) {
+  uint32_t* f0 = reinterpret_cast<uint32_t*>(flat);
+  uint32_t* f1 = f0 + bucket;
+  uint32_t* f2 = f1 + bucket;
+  uint32_t* f3 = f2 + bucket;
+  uint32_t* f4 = f3 + bucket;
+  uint32_t off = 0;
+  for (uint32_t j = 0; j < n_frames; j++) {
+    const int32_t* slot = reinterpret_cast<const int32_t*>(slot_bases[j]);
+    uint32_t n = ns[j];
+    if (n > kVec) n = kVec;
+    if (off + n > bucket) n = bucket - off;
+    const uint32_t* src = reinterpret_cast<const uint32_t*>(slot);
+    for (uint32_t i = 0; i < n; i++) {
+      uint32_t flags = src[kFlags * kVec + i] & 0xFFu;
+      uint8_t nip = (flags & kFlagNonIp4) ? 1 : 0;
+      if (flags & (kFlagNonIp4 | kFlagTrunc)) flags = 0;
+      non_ip[off + i] = nip;
+      f0[off + i] = src[kSrcIp * kVec + i];
+      f1[off + i] = src[kDstIp * kVec + i];
+      f2[off + i] = (src[kSport * kVec + i] << 16)
+                    | (src[kDport * kVec + i] & 0xFFFFu);
+      f3[off + i] = ((src[kPktLen * kVec + i] & 0xFFFFu) << 16)
+                    | ((src[kProto * kVec + i] & 0xFFu) << 8)
+                    | (src[kTtl * kVec + i] & 0xFFu);
+      f4[off + i] = (src[kRxIf * kVec + i] << 8) | flags;
+    }
+    off += n;
+  }
+}
+
+// Decode packed result columns [off, off+n) of `packed` =
+// int32[5][bucket] into a TX ring slot column block `tx_slot`
+// (int32[12][kVec]), taking pipeline-invariant and pass-through
+// columns (proto/pkt_len/flags/meta) from the matching RX slot.
+// Non-IPv4 packets (rx flags) are re-routed to the HOST punt
+// disposition. The per-packet drop_cause nibble is written to
+// `cause` (int32[kVec], slots >= n zeroed) for the caller's ICMP
+// error generation. Columns beyond `n` are zeroed (ring consumers
+// must never see a previous lap's data).
+void pio_unpack_to_slot(const int32_t* packed, uint32_t bucket,
+                        uint32_t off, uint32_t n, const int32_t* rx_slot,
+                        int32_t* tx_slot, int32_t host_if,
+                        int32_t* cause) {
+  const uint32_t* f0 = reinterpret_cast<const uint32_t*>(packed);
+  const uint32_t* f1 = f0 + bucket;
+  const uint32_t* f2 = f1 + bucket;
+  const uint32_t* f3 = f2 + bucket;
+  const uint32_t* f4 = f3 + bucket;
+  const uint32_t* rx = reinterpret_cast<const uint32_t*>(rx_slot);
+  if (n > kVec) n = kVec;
+  for (uint32_t i = 0; i < n; i++) {
+    uint32_t r3 = f3[off + i];
+    int32_t tx_if = static_cast<int32_t>(r3 & 0xFFFFu);
+    if (tx_if == 0xFFFF) tx_if = -1;
+    int32_t disp = static_cast<int32_t>((r3 >> 24) & 0xFu);
+    cause[i] = static_cast<int32_t>(r3 >> 28);
+    uint32_t rx_flags = rx[kFlags * kVec + i];
+    if (rx_flags & kFlagNonIp4) {  // punt path: bypassed the pipeline
+      disp = 3;                    // Disposition.HOST
+      tx_if = host_if;
+    }
+    tx_slot[kSrcIp * kVec + i] = static_cast<int32_t>(f0[off + i]);
+    tx_slot[kDstIp * kVec + i] = static_cast<int32_t>(f1[off + i]);
+    tx_slot[kProto * kVec + i] = rx_slot[kProto * kVec + i];
+    tx_slot[kSport * kVec + i] = static_cast<int32_t>(f2[off + i] >> 16);
+    tx_slot[kDport * kVec + i] =
+        static_cast<int32_t>(f2[off + i] & 0xFFFFu);
+    tx_slot[kTtl * kVec + i] = static_cast<int32_t>((r3 >> 16) & 0xFFu);
+    tx_slot[kPktLen * kVec + i] = rx_slot[kPktLen * kVec + i];
+    tx_slot[kRxIf * kVec + i] = tx_if;  // tx direction: egress if
+    tx_slot[kFlags * kVec + i] = static_cast<int32_t>(rx_flags);
+    tx_slot[kDisp * kVec + i] = disp;
+    tx_slot[kNextHop * kVec + i] = static_cast<int32_t>(f4[off + i]);
+    tx_slot[kMeta * kVec + i] = rx_slot[kMeta * kVec + i];
+  }
+  for (uint32_t i = n; i < kVec; i++) {
+    cause[i] = 0;
+    for (uint32_t c = 0; c < kColumns; c++) tx_slot[c * kVec + i] = 0;
+  }
+}
+
+
 uint32_t pio_vec() { return kVec; }
 uint32_t pio_columns() { return kColumns; }
 

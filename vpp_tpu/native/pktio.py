@@ -139,6 +139,17 @@ def _load() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32,
             ctypes.c_void_p, ctypes.c_void_p,
         ]
+        lib.pio_pack_batch.restype = None
+        lib.pio_pack_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32,
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p,
+        ]
+        lib.pio_unpack_to_slot.restype = None
+        lib.pio_unpack_to_slot.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
+            ctypes.c_uint32, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int32, ctypes.c_void_p,
+        ]
         assert int(lib.pio_vec()) == VEC
         assert int(lib.pio_columns()) == N_COLUMNS
         _lib = lib
@@ -406,3 +417,37 @@ class PacketCodec:
         return int(self.lib.pio_decap_offset(
             arr.ctypes.data_as(ctypes.c_void_p), len(arr), vni & 0xFFFFFF
         ))
+
+
+# --- pump fast-path kernels (one GIL-releasing native call per batch /
+# per frame; layouts mirror pipeline/dataplane.py's packed boundary) ---
+
+def pack_batch(slot_bases: np.ndarray, ns: np.ndarray, n_frames: int,
+               flat: np.ndarray, non_ip: np.ndarray) -> None:
+    """Pack ``n_frames`` rx ring slots (column-block base addresses in
+    ``slot_bases`` uint64) sequentially into ``flat`` [5, bucket] int32,
+    masking non-IPv4/truncated packets invalid and reporting the
+    non-ip punt bit per packed column in ``non_ip`` (uint8[bucket])."""
+    _load().pio_pack_batch(
+        slot_bases.ctypes.data_as(ctypes.c_void_p),
+        ns.ctypes.data_as(ctypes.c_void_p),
+        n_frames,
+        flat.ctypes.data_as(ctypes.c_void_p),
+        flat.shape[1],
+        non_ip.ctypes.data_as(ctypes.c_void_p),
+    )
+
+
+def unpack_to_slot(packed: np.ndarray, off: int, n: int,
+                   rx_slot_base: int, tx_slot_base: int, host_if: int,
+                   cause: np.ndarray) -> None:
+    """Decode packed result columns [off, off+n) straight into a
+    reserved TX ring slot's column block (pass-through columns from the
+    rx slot, non-IPv4 re-punted to ``host_if``); per-packet drop_cause
+    lands in ``cause`` (int32[VEC])."""
+    _load().pio_unpack_to_slot(
+        packed.ctypes.data_as(ctypes.c_void_p), packed.shape[1],
+        off, n, ctypes.c_void_p(rx_slot_base),
+        ctypes.c_void_p(tx_slot_base),
+        host_if, cause.ctypes.data_as(ctypes.c_void_p),
+    )
