@@ -452,3 +452,70 @@ def test_incremental_swap_reuses_clean_device_arrays():
     import numpy as np
 
     assert int(np.asarray(t3.glb_nrules)) == 1
+
+
+def test_incremental_glb_commit_matches_full_upload():
+    """A small rule change commits as a block update into the cached
+    device arrays (VERDICT r3 Next #6); the resulting tables must be
+    bit-identical to a from-scratch full upload, including the MXU
+    bit-planes, and verdicts must track the change."""
+    import numpy as np
+
+    from vpp_tpu.ir.rule import Action, ContivRule, Protocol
+    from vpp_tpu.pipeline.dataplane import Dataplane
+    from vpp_tpu.pipeline.tables import DataplaneConfig
+    from vpp_tpu.pipeline.vector import Disposition, make_packet_vector
+
+    cfg = DataplaneConfig(max_tables=2, max_rules=8, max_global_rules=2048,
+                          max_ifaces=8, fib_slots=16, sess_slots=64,
+                          nat_mappings=2, nat_backends=4)
+
+    def rules(block_port):
+        out = [
+            ContivRule(action=Action.PERMIT, protocol=Protocol.TCP,
+                       dest_port=8000 + (i % 19))
+            for i in range(2000)
+        ]
+        out[1500] = ContivRule(action=Action.PERMIT, protocol=Protocol.TCP,
+                               dest_port=block_port)
+        out.append(ContivRule(action=Action.DENY))
+        return out
+
+    dp = Dataplane(cfg)
+    uplink = dp.add_uplink()
+    pod = dp.add_pod_interface(("ns", "p"))
+    dp.builder.add_route("10.0.0.9/32", pod, Disposition.LOCAL)
+    dp.builder.set_global_table(rules(9100))
+    dp.swap()
+    coeff_before = dp.tables.glb_mxu_coeff
+
+    # churn: one rule changes -> must NOT re-upload the full bit-planes
+    dp.builder.set_global_table(rules(9200))
+    dp.swap()
+    assert dp.tables.glb_mxu_coeff is not coeff_before
+
+    # reference: a fresh dataplane with the same final rules (full path)
+    ref = Dataplane(cfg)
+    ref.add_uplink()
+    ref_pod = ref.add_pod_interface(("ns", "p"))
+    ref.builder.add_route("10.0.0.9/32", ref_pod, Disposition.LOCAL)
+    ref.builder.set_global_table(rules(9200))
+    ref.swap()
+    for f in ("glb_src_net", "glb_dst_mask", "glb_proto", "glb_action",
+              "glb_dport_lo", "glb_dport_hi", "glb_mxu_k", "glb_mxu_act",
+              "glb_mxu_coeff"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(dp.tables, f)),
+            np.asarray(getattr(ref.tables, f)), err_msg=f,
+        )
+    # behavior tracks: the changed rule (port 9200) now permits, the
+    # old one (9100) falls to the terminal deny
+    pkts = make_packet_vector([
+        {"src": "9.9.9.9", "dst": "10.0.0.9", "proto": 6, "sport": 1,
+         "dport": 9200, "rx_if": uplink},
+        {"src": "9.9.9.9", "dst": "10.0.0.9", "proto": 6, "sport": 2,
+         "dport": 9100, "rx_if": uplink},
+    ])
+    res = dp.process(pkts)
+    assert int(res.disp[0]) == int(Disposition.LOCAL)
+    assert int(res.disp[1]) == int(Disposition.DROP)

@@ -16,6 +16,7 @@ SURVEY.md §2.3).
 from __future__ import annotations
 
 import enum
+import functools
 import ipaddress
 import logging
 from typing import Dict, NamedTuple, Optional, Sequence, Tuple
@@ -226,6 +227,70 @@ def pack_rules(rules: Sequence[ContivRule], max_rules: int) -> Dict[str, np.ndar
     return out
 
 
+# Global-table fields in ROW space [R] (diffed/updated together; the
+# bit-plane fields live in COLUMN space [R'] and diff separately).
+_GLB_ROW_FIELDS: Tuple[str, ...] = (
+    "glb_src_net", "glb_src_mask", "glb_dst_net", "glb_dst_mask",
+    "glb_proto", "glb_sport_lo", "glb_sport_hi", "glb_dport_lo",
+    "glb_dport_hi", "glb_action",
+)
+
+
+@functools.lru_cache(maxsize=16)
+def _glb_update_fn(w_r: int, w_c: int, planes: int):
+    """Jitted incremental global-table update for (row-block w_r,
+    column-block w_c): ONE packed int32 blob upload carries every
+    changed block, and one compiled program scatters the blocks into
+    the cached device arrays with dynamic_update_slice (traced start
+    offsets — no recompile per position). Blob layout:
+    [10 x w_r rows | w_c k | w_c act | planes x w_c coeff]."""
+    import jax
+
+    def update(rows, k, act, coeff, blob, lo_r, lo_c):
+        from jax import lax
+
+        out_rows = []
+        for i, dev in enumerate(rows):
+            piece = lax.bitcast_convert_type(
+                blob[i * w_r:(i + 1) * w_r], dev.dtype
+            )
+            out_rows.append(lax.dynamic_update_slice(dev, piece, (lo_r,)))
+        base = 10 * w_r
+        k_piece = lax.bitcast_convert_type(
+            blob[base:base + w_c], jnp.float32
+        )
+        new_k = lax.dynamic_update_slice(k, k_piece, (lo_c,))
+        act_piece = blob[base + w_c:base + 2 * w_c]
+        new_act = lax.dynamic_update_slice(act, act_piece, (lo_c,))
+        coeff_piece = lax.bitcast_convert_type(
+            blob[base + 2 * w_c:base + 2 * w_c + planes * w_c],
+            jnp.float32,
+        ).reshape(planes, w_c)
+        new_coeff = lax.dynamic_update_slice(
+            coeff, coeff_piece, (0, lo_c)
+        )
+        return out_rows, new_k, new_act, new_coeff
+
+    return jax.jit(update)
+
+
+def _block_of(changed: np.ndarray, total: int) -> Optional[Tuple[int, int]]:
+    """(lo, width) of the smallest padded block covering every changed
+    index, widths on a x4 ladder; None when nothing changed."""
+    idx = np.nonzero(changed)[0]
+    if len(idx) == 0:
+        return None
+    lo, hi = int(idx[0]), int(idx[-1]) + 1
+    span = hi - lo
+    w = 256
+    while w < span:
+        w *= 4
+    if w >= total:
+        return 0, total
+    lo = min(lo, total - w)
+    return lo, w
+
+
 # Upload groups: which DataplaneTables fields each builder mutation
 # invalidates. to_device() re-uploads only dirty groups; the rest reuse
 # the previous epoch's device arrays (the big win: a CNI add doesn't
@@ -311,6 +376,12 @@ class TableBuilder:
         # round trip on a remote transport (VERDICT r2 Weak #4).
         self._dirty = set(_UPLOAD_GROUPS)
         self._dev_cache: Dict[str, object] = {}
+        # host arrays as of the last device upload of the "glb" group:
+        # the diff base for incremental column/row-block commits.
+        # References, not copies — set_global_table REPLACES the glb
+        # dict and the MxuTable wholesale (never mutates in place), so
+        # a previous epoch's arrays are immutable once recorded.
+        self._glb_prev: Optional[Dict[str, np.ndarray]] = None
 
     def _mark(self, group: str) -> None:
         self._dirty.add(group)
@@ -618,9 +689,84 @@ class TableBuilder:
         host = {}
         for group, fields in _UPLOAD_GROUPS.items():
             dirty = group in self._dirty
+            if group == "glb" and dirty and self._glb_incremental(host_np):
+                # changed row/column BLOCKS were scattered into the
+                # cached device arrays with one blob upload — the
+                # multi-MB full-table re-upload (415 ms on the r3
+                # tunnel at 10k rules) is skipped (VERDICT r3 Next #6)
+                dirty = False
             for name in fields:
                 if dirty or name not in self._dev_cache:
                     self._dev_cache[name] = jnp.asarray(host_np[name])
                 host[name] = self._dev_cache[name]
         self._dirty.clear()
         return DataplaneTables(**host, **sess)
+
+    def _glb_incremental(self, host_np: Dict[str, np.ndarray]) -> bool:
+        """Try an incremental device update of the global-table group:
+        diff against the last-uploaded host arrays, and when the
+        changes confine to a block, upload ONE packed blob and scatter
+        it into the cached device arrays (see _glb_update_fn). Returns
+        True when the device cache now holds the new epoch (the caller
+        skips the full re-upload); False falls back to full upload.
+        Always refreshes the diff base."""
+        from vpp_tpu.ops.acl_mxu import PLANES
+
+        prev = self._glb_prev
+        self._glb_prev = {f: host_np[f] for f in _UPLOAD_GROUPS["glb"]}
+        if prev is None or any(
+            f not in self._dev_cache for f in _UPLOAD_GROUPS["glb"]
+        ):
+            return False
+        n_rows = host_np["glb_action"].shape[0]
+        n_cols = host_np["glb_mxu_k"].shape[0]
+        changed_r = np.zeros(n_rows, bool)
+        for f in _GLB_ROW_FIELDS:
+            changed_r |= prev[f] != host_np[f]
+        changed_c = (prev["glb_mxu_k"] != host_np["glb_mxu_k"]) \
+            | (prev["glb_mxu_act"] != host_np["glb_mxu_act"]) \
+            | np.any(prev["glb_mxu_coeff"] != host_np["glb_mxu_coeff"],
+                     axis=0)
+        blk_r = _block_of(changed_r, n_rows)
+        blk_c = _block_of(changed_c, n_cols)
+        if blk_r is None and blk_c is None:
+            # content-identical commit (e.g. rolled-back txn): only the
+            # rule-count scalar may differ
+            if int(prev["glb_nrules"]) != int(host_np["glb_nrules"]):
+                self._dev_cache["glb_nrules"] = jnp.asarray(
+                    host_np["glb_nrules"]
+                )
+            return True
+        blk_r = blk_r or (0, min(256, n_rows))
+        blk_c = blk_c or (0, min(256, n_cols))
+        lo_r, w_r = blk_r
+        lo_c, w_c = blk_c
+        if w_r >= n_rows or w_c >= n_cols:
+            return False  # change spans the table: full upload is best
+        blob = np.empty(10 * w_r + 2 * w_c + PLANES * w_c, np.int32)
+        for i, f in enumerate(_GLB_ROW_FIELDS):
+            blob[i * w_r:(i + 1) * w_r] = \
+                host_np[f][lo_r:lo_r + w_r].view(np.int32)
+        base = 10 * w_r
+        blob[base:base + w_c] = \
+            host_np["glb_mxu_k"][lo_c:lo_c + w_c].view(np.int32)
+        blob[base + w_c:base + 2 * w_c] = \
+            host_np["glb_mxu_act"][lo_c:lo_c + w_c]
+        blob[base + 2 * w_c:] = np.ascontiguousarray(
+            host_np["glb_mxu_coeff"][:, lo_c:lo_c + w_c]
+        ).reshape(-1).view(np.int32)
+        fn = _glb_update_fn(w_r, w_c, PLANES)
+        new_rows, new_k, new_act, new_coeff = fn(
+            [self._dev_cache[f] for f in _GLB_ROW_FIELDS],
+            self._dev_cache["glb_mxu_k"],
+            self._dev_cache["glb_mxu_act"],
+            self._dev_cache["glb_mxu_coeff"],
+            jnp.asarray(blob), lo_r, lo_c,
+        )
+        for f, arr in zip(_GLB_ROW_FIELDS, new_rows):
+            self._dev_cache[f] = arr
+        self._dev_cache["glb_mxu_k"] = new_k
+        self._dev_cache["glb_mxu_act"] = new_act
+        self._dev_cache["glb_mxu_coeff"] = new_coeff
+        self._dev_cache["glb_nrules"] = jnp.asarray(host_np["glb_nrules"])
+        return True
