@@ -489,9 +489,21 @@ def test_incremental_glb_commit_matches_full_upload():
     dp.swap()
     coeff_before = dp.tables.glb_mxu_coeff
 
-    # churn: one rule changes -> must NOT re-upload the full bit-planes
+    # churn: one rule changes -> must take the INCREMENTAL block path,
+    # not a full re-upload (spy pins which path ran — without it a
+    # silent regression to full uploads would keep this test green)
+    took = []
+    orig = type(dp.builder)._glb_incremental
+
+    def spy(builder, host_np):
+        r = orig(builder, host_np)
+        took.append(r)
+        return r
+
+    dp.builder._glb_incremental = spy.__get__(dp.builder)
     dp.builder.set_global_table(rules(9200))
     dp.swap()
+    assert took == [True], "churn commit must scatter a block, not re-upload"
     assert dp.tables.glb_mxu_coeff is not coeff_before
 
     # reference: a fresh dataplane with the same final rules (full path)

@@ -169,3 +169,55 @@ def test_packed_step_equals_unpacked_step():
     assert np.array_equal(dec["dport"], np.asarray(ref.pkts.dport))
     assert np.array_equal(dec["ttl"], np.asarray(ref.pkts.ttl))
     assert np.array_equal(dec["next_hop"], np.asarray(ref.next_hop))
+
+
+def test_chained_steps_equal_sequential_packed():
+    """process_packed_chain (K steps in one device program) must equal
+    K sequential process_packed dispatches: same packed outputs, same
+    session-table evolution (lax.scan threads tables identically)."""
+    import numpy as np
+
+    from vpp_tpu.pipeline.dataplane import (
+        Dataplane, packed_input_zeros, unpack_packet_result,
+    )
+    from vpp_tpu.pipeline.tables import DataplaneConfig
+    from vpp_tpu.pipeline.vector import Disposition
+
+    def build():
+        cfg = DataplaneConfig(max_tables=2, max_rules=8,
+                              max_global_rules=16, max_ifaces=8,
+                              fib_slots=16, sess_slots=64,
+                              nat_mappings=2, nat_backends=4)
+        dp = Dataplane(cfg)
+        a = dp.add_pod_interface(("d", "a"))
+        b = dp.add_pod_interface(("d", "b"))
+        dp.builder.add_route("10.0.0.3/32", b, Disposition.LOCAL)
+        dp.swap()
+        return dp, a
+
+    K, B = 4, 256
+    flats = np.zeros((K, 5, B), np.int32)
+    dp, rx = build()
+    for k in range(K):
+        fu = flats[k].view(np.uint32)
+        fu[0] = 0x0A000002
+        fu[1] = 0x0A000003
+        fu[2] = ((40000 + k) << 16) | 80
+        fu[3] = (128 << 16) | (6 << 8) | 64
+        fu[4] = (rx << 8) | 1
+
+    import jax
+
+    chained = np.array(jax.device_get(dp.process_packed_chain(flats, now=1)))
+    sess_chain = int(np.asarray(dp.tables.sess_valid).sum())
+
+    dp2, rx2 = build()
+    assert rx2 == rx
+    seq = np.stack([
+        np.array(jax.device_get(dp2.process_packed(flats[k].copy(), now=1)))
+        for k in range(K)
+    ])
+    np.testing.assert_array_equal(chained, seq)
+    assert sess_chain == int(np.asarray(dp2.tables.sess_valid).sum())
+    dec = unpack_packet_result(np.array(chained[0]))
+    assert (dec["disp"][:1] == int(Disposition.LOCAL)).all()

@@ -104,6 +104,30 @@ def _packed_call(step):
     return run
 
 
+def _chained_call(step):
+    """K packed steps in ONE device program: ``lax.scan`` over a
+    [K, 5, B] stack of packed batches, session tables threaded
+    batch-to-batch exactly as K separate dispatches would. One
+    dispatch + one sync amortizes the per-step PJRT round trip
+    (~100 µs locally, ~100 ms over the axon tunnel) across K frames —
+    the 'K-chained device steps synced once' lever of docs/LATENCY.md
+    (VERDICT r3 Next #4). Latency of the FIRST frame rises to the
+    chain's span, so this serves throughput-with-bounded-sync, not
+    single-frame latency."""
+    packed = _packed_call(step)
+
+    def run(tables, flats, now):
+        from jax import lax
+
+        def body(tbl, flat):
+            tbl2, out = packed(tbl, flat, now)
+            return tbl2, out
+
+        return lax.scan(body, tables, flats)
+
+    return run
+
+
 # packed-boundary shape: [PACKED_IN_ROWS, B] in, [PACKED_OUT_ROWS_N, B] out
 PACKED_IN_ROWS = 5
 PACKED_OUT_ROWS_N = 5
@@ -196,8 +220,22 @@ class Dataplane:
         self.commit_lock = self._lock
         self._step = jax.jit(pipeline_step)
         self._step_mxu = jax.jit(pipeline_step_mxu)
-        self._step_packed = jax.jit(_packed_call(pipeline_step))
-        self._step_packed_mxu = jax.jit(_packed_call(pipeline_step_mxu))
+        # donate the packed input: in and out are both [5, B] int32, so
+        # XLA aliases the buffers — one less device allocation + copy
+        # per batch on the hot path (the host never touches a batch
+        # after dispatch; each batch is a fresh buffer)
+        self._step_packed = jax.jit(
+            _packed_call(pipeline_step), donate_argnums=(1,)
+        )
+        self._step_packed_mxu = jax.jit(
+            _packed_call(pipeline_step_mxu), donate_argnums=(1,)
+        )
+        self._step_chain = jax.jit(
+            _chained_call(pipeline_step), donate_argnums=(1,)
+        )
+        self._step_chain_mxu = jax.jit(
+            _chained_call(pipeline_step_mxu), donate_argnums=(1,)
+        )
         self._encap = None  # jitted vxlan_encap, built on first use
         # Flipped at swap(): large exact-port global tables classify on
         # the MXU bit-plane kernel; small or range-rule tables stay dense.
@@ -471,3 +509,26 @@ class Dataplane:
             if tables is self.tables:
                 self.tables = new_tables
         return out
+
+    def process_packed_chain(self, flats, now: Optional[int] = None):
+        """K packed batches in ONE device dispatch (``_chained_call``):
+        ``flats`` is a host [K, 5, B] int32 stack; returns the DEVICE
+        [K, 5, B] packed results. One dispatch + one fetch for K
+        frames — the bounded-sync throughput lever when per-step
+        dispatch dominates (remote transports, small frames)."""
+        with self._lock:
+            if self.tables is None:
+                raise RuntimeError(
+                    "this Dataplane is a staging handle managed by a "
+                    "ClusterDataplane; process frames via cluster.step()"
+                )
+            tables = self.tables
+            step = self._step_chain_mxu if self._use_mxu else self._step_chain
+            if now is None:
+                self._now = max(self._now, self.clock_ticks())
+                now = self._now
+        new_tables, outs = step(tables, jnp.asarray(flats), jnp.int32(now))
+        with self._lock:
+            if tables is self.tables:
+                self.tables = new_tables
+        return outs

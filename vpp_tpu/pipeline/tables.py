@@ -376,11 +376,9 @@ class TableBuilder:
         # round trip on a remote transport (VERDICT r2 Weak #4).
         self._dirty = set(_UPLOAD_GROUPS)
         self._dev_cache: Dict[str, object] = {}
-        # host arrays as of the last device upload of the "glb" group:
-        # the diff base for incremental column/row-block commits.
-        # References, not copies — set_global_table REPLACES the glb
-        # dict and the MxuTable wholesale (never mutates in place), so
-        # a previous epoch's arrays are immutable once recorded.
+        # host arrays as of the last SUCCESSFUL device upload of the
+        # "glb" group: the diff base for incremental column/row-block
+        # commits (row arrays copied — see _set_glb_prev).
         self._glb_prev: Optional[Dict[str, np.ndarray]] = None
 
     def _mark(self, group: str) -> None:
@@ -687,20 +685,45 @@ class TableBuilder:
             }
         host_np = self.host_arrays()
         host = {}
+        glb_full = False
         for group, fields in _UPLOAD_GROUPS.items():
             dirty = group in self._dirty
-            if group == "glb" and dirty and self._glb_incremental(host_np):
-                # changed row/column BLOCKS were scattered into the
-                # cached device arrays with one blob upload — the
-                # multi-MB full-table re-upload (415 ms on the r3
-                # tunnel at 10k rules) is skipped (VERDICT r3 Next #6)
-                dirty = False
+            if group == "glb" and dirty:
+                if self._glb_incremental(host_np):
+                    # changed row/column BLOCKS were scattered into the
+                    # cached device arrays with one blob upload — the
+                    # multi-MB full-table re-upload (415 ms on the r3
+                    # tunnel at 10k rules) is skipped (VERDICT r3
+                    # Next #6)
+                    dirty = False
+                else:
+                    glb_full = True
             for name in fields:
                 if dirty or name not in self._dev_cache:
                     self._dev_cache[name] = jnp.asarray(host_np[name])
                 host[name] = self._dev_cache[name]
+        if glb_full:
+            # diff base refreshed only AFTER the full upload above
+            # completed — refreshing before a device call that then
+            # fails would desync the base and make a retried commit
+            # no-op while the device serves stale rules
+            self._set_glb_prev(host_np)
         self._dirty.clear()
         return DataplaneTables(**host, **sess)
+
+    def _set_glb_prev(self, host_np: Dict[str, np.ndarray]) -> None:
+        """Record the diff base for incremental glb commits. The ROW
+        arrays are COPIED: state_restore writes into the live glb
+        arrays in place, so a reference would alias the base with
+        whatever a later rollback restores and a subsequent diff would
+        see 'no change' against content the device never received. The
+        bit-plane arrays are safe references (set_global_table and
+        state_restore both replace the MxuTable wholesale)."""
+        prev = {f: host_np[f].copy() for f in _GLB_ROW_FIELDS}
+        for f in ("glb_mxu_coeff", "glb_mxu_k", "glb_mxu_act",
+                  "glb_nrules"):
+            prev[f] = host_np[f]
+        self._glb_prev = prev
 
     def _glb_incremental(self, host_np: Dict[str, np.ndarray]) -> bool:
         """Try an incremental device update of the global-table group:
@@ -713,7 +736,6 @@ class TableBuilder:
         from vpp_tpu.ops.acl_mxu import PLANES
 
         prev = self._glb_prev
-        self._glb_prev = {f: host_np[f] for f in _UPLOAD_GROUPS["glb"]}
         if prev is None or any(
             f not in self._dev_cache for f in _UPLOAD_GROUPS["glb"]
         ):
@@ -736,6 +758,7 @@ class TableBuilder:
                 self._dev_cache["glb_nrules"] = jnp.asarray(
                     host_np["glb_nrules"]
                 )
+            self._set_glb_prev(host_np)
             return True
         blk_r = blk_r or (0, min(256, n_rows))
         blk_c = blk_c or (0, min(256, n_cols))
@@ -769,4 +792,6 @@ class TableBuilder:
         self._dev_cache["glb_mxu_act"] = new_act
         self._dev_cache["glb_mxu_coeff"] = new_coeff
         self._dev_cache["glb_nrules"] = jnp.asarray(host_np["glb_nrules"])
+        # base refreshed only now — after every device call succeeded
+        self._set_glb_prev(host_np)
         return True
