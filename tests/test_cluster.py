@@ -281,3 +281,30 @@ def test_mxu_sharded_equals_dense_sharded_at_scale():
     assert int(np.asarray(res_m.stats.drop_acl).sum()) > 0
     delivered = np.asarray(res_m.delivered.disp)[1]
     assert (delivered == int(Disposition.LOCAL)).sum() > 0
+
+
+def test_wire_step_carries_payload_across_fabric():
+    """step_wire: packet BYTES ride the same all_to_all as the header
+    columns — a fabric-delivered packet's payload row at the
+    destination is the source node's original bytes."""
+    cluster, pod_ip, pod_if = build_cluster()
+    src = pod_ip["ns/pod0-0"]
+    dst = pod_ip["ns/pod2-1"]
+    frames = [[] for _ in range(4)]
+    frames[0] = [dict(src=src, dst=dst, proto=6, sport=7777, dport=80,
+                      rx_if=pod_if["ns/pod0-0"])]
+    pkts = cluster.make_frames(frames, n=8)
+    snap = 64
+    payload = np.zeros((4, 8, snap), np.uint8)
+    wire_bytes = (b"\xAB" * 14 + b"E" + b"\x00" * 29
+                  + b"fabric-payload-bytes").ljust(snap, b"\x00")
+    payload[0, 0] = np.frombuffer(wire_bytes, np.uint8)
+    res, deliv_pay = cluster.step_wire(pkts, payload, now=1)
+    d_disp = np.asarray(res.delivered.disp)
+    slots = np.nonzero(d_disp[2] == int(Disposition.LOCAL))[0]
+    assert len(slots) == 1
+    got = np.asarray(deliv_pay)[2, slots[0]]
+    assert bytes(got) == bytes(payload[0, 0]), "bytes crossed the fabric"
+    # non-fabric rows carry zeroed payload (no cross-slot leakage)
+    others = np.asarray(deliv_pay)[1]
+    assert not others.any()

@@ -202,6 +202,10 @@ class ContivAgent:
         # --- packet IO (rings + pump, created in start() when enabled) ---
         self.io_rings = None
         self.io_pump = None
+        # mesh mode: the MeshRuntime owns per-node rings and ONE
+        # ClusterPump stepping the fabric — this agent must not create
+        # its own single-node device bridge
+        self._external_io = False
 
         # peers with installed routes: node_id -> peer vtep ip
         self._peer_routes = {}
@@ -242,7 +246,7 @@ class ContivAgent:
         # NIC/TAP endpoints — VERDICT r1 Missing #1). Created before the
         # CNI resync: resync re-attaches pod veths through the daemon's
         # control socket and those packets land in these rings.
-        if c.io.enabled:
+        if c.io.enabled and not self._external_io:
             from vpp_tpu.io.pump import DataplanePump
             from vpp_tpu.io.rings import IORingPair
 
@@ -267,8 +271,11 @@ class ContivAgent:
             log.info("pump dispatch rungs %s warmed in %.1fs",
                      rungs, time.monotonic() - t0)
             self.io_pump.start()
-            if c.io.plan_path:
-                self._write_io_plan()
+        if c.io.enabled and c.io.plan_path:
+            # also in mesh mode (_external_io): vpp-tpu-init waits for
+            # this file to launch the node's vpp-tpu-io daemon, and the
+            # MeshRuntime's rings use the same config geometry/shm name
+            self._write_io_plan()
         # resync persisted pods before serving (restart path)
         n = self.cni_server.resync()
         if n:

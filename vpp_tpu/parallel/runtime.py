@@ -85,6 +85,32 @@ class MeshRuntime:
             )
             self._mesh_pos[agent.node_id] = i
             self.agents.append(agent)
+        # packet IO: per-node ring pairs + ONE ClusterPump stepping the
+        # fabric (io/cluster_pump.py). Rings exist from construction so
+        # each node's vpp-tpu-io daemon can attach before start(); the
+        # agents skip their per-node pumps (_external_io) — the cluster
+        # pump IS the device bridge in mesh mode.
+        self.ring_pairs = None
+        self.cluster_pump = None
+        if base_config.io.enabled:
+            from vpp_tpu.io.cluster_pump import ClusterPump
+            from vpp_tpu.io.rings import IORingPair
+
+            io = base_config.io
+            self.ring_pairs = [
+                IORingPair(
+                    n_slots=io.n_slots, snap=io.snap,
+                    shm_name=(f"{io.shm_name}.{i}" if io.shm_name
+                              else None),
+                    create=True,
+                )
+                for i in range(n_nodes)
+            ]
+            self.cluster_pump = ClusterPump(
+                self.cluster, self.ring_pairs, snap=io.snap
+            )
+            for agent in self.agents:
+                agent._external_io = True
 
     @property
     def n_nodes(self) -> int:
@@ -96,18 +122,32 @@ class MeshRuntime:
 
     def start(self) -> "MeshRuntime":
         for agent in self.agents:
-            if agent.config.io.enabled:
-                raise ValueError(
-                    "mesh mode drives frames through cluster.step(); "
-                    "per-node shm pumps are not wired to the fabric yet "
-                    "— disable io.enabled"
-                )
             agent.start()
+        if self.cluster_pump is not None:
+            # warm after the agents' first swap published live tables
+            self.cluster_pump.warm()
+            self.cluster_pump.start()
         return self
 
     def close(self) -> None:
+        pump_stopped = True
+        if self.cluster_pump is not None:
+            pump_stopped = self.cluster_pump.stop(join_timeout=30.0)
         for agent in reversed(self.agents):
             agent.close()
+        if self.ring_pairs is not None:
+            if pump_stopped:
+                for rings in self.ring_pairs:
+                    rings.close(
+                        unlink=bool(self.agents[0].config.io.shm_name)
+                    )
+            else:
+                # a wedged pump still holds ring pointers; freeing the
+                # buffers under it would be a use-after-free into
+                # shared memory — leak the mappings (process exit
+                # reclaims), same policy as ContivAgent.close()
+                log.error("cluster pump did not stop; leaving rings "
+                          "mapped")
 
     # --- traffic (the fabric path the agents configure) ---
     def make_frames(self, per_node_packets, n: int = 256) -> PacketVector:
@@ -132,4 +172,12 @@ def _node_config(base, i: int):
         txn_journal_path=suffix(base.txn_journal_path),
         stats_port=base.stats_port + i,
         health_port=base.health_port + i,
+        # each node talks to its OWN vpp-tpu-io daemon (control socket,
+        # shm name, IO plan are per-node endpoints)
+        io=dataclasses.replace(
+            base.io,
+            control_socket=suffix(base.io.control_socket),
+            shm_name=suffix(base.io.shm_name),
+            plan_path=suffix(base.io.plan_path),
+        ),
     )

@@ -731,8 +731,10 @@ class TableBuilder:
         changes confine to a block, upload ONE packed blob and scatter
         it into the cached device arrays (see _glb_update_fn). Returns
         True when the device cache now holds the new epoch (the caller
-        skips the full re-upload); False falls back to full upload.
-        Always refreshes the diff base."""
+        skips the full re-upload); False falls back to full upload. The
+        diff base refreshes ONLY on success — on the False path the
+        caller must refresh it after the full upload completes
+        (to_device does), so a failed device call never desyncs it."""
         from vpp_tpu.ops.acl_mxu import PLANES
 
         prev = self._glb_prev
