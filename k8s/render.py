@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Render k8s manifests from chart/vpp-tpu.yaml.tmpl + chart/values.yaml.
+
+The Helm-values analog for this repo's minimal manifests (reference
+ships a chart under k8s/contiv-vpp; SURVEY §7 scopes this build to
+minimal manifests, so parametrization is one template + one values
+file + this renderer — no external tooling):
+
+    python k8s/render.py                          # defaults -> stdout
+    python k8s/render.py --set image=reg/vpp:1.2  # overrides
+    python k8s/render.py -o k8s/vpp-tpu.yaml      # write
+
+`{{name}}` placeholders come from values.yaml (overridable with
+--set); rendering fails on unknown or leftover placeholders, so a
+template/values drift can't produce a silently broken manifest.
+`${NODE_NAME}` is NOT a template variable — it survives into the
+rendered ConfigMap and is resolved per-node at runtime.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def load_values(path: str) -> dict:
+    import yaml
+
+    with open(path) as f:
+        return yaml.safe_load(f) or {}
+
+
+def render(values: dict) -> str:
+    with open(os.path.join(_DIR, "chart", "vpp-tpu.yaml.tmpl")) as f:
+        tmpl = f.read()
+    values = dict(values)
+    # conditional mesh section: nodes > 0 turns the agent config into
+    # mesh mode (cmd/config.py MeshConfig; the init supervisor passes
+    # the same contiv.yaml to vpp-tpu-mesh-agent)
+    if int(values.get("mesh_nodes", 0)) > 0:
+        values["mesh_section"] = (
+            "    mesh:\n"
+            f"      nodes: {int(values['mesh_nodes'])}\n"
+            f"      rule_shards: {int(values.get('mesh_rule_shards', 1))}\n"
+        )
+    else:
+        values["mesh_section"] = ""
+
+    def sub(m: re.Match) -> str:
+        key = m.group(1)
+        if key not in values:
+            raise KeyError(f"template references unknown value {key!r}")
+        return str(values[key])
+
+    out = re.sub(r"\{\{(\w+)\}\}", sub, tmpl)
+    leftover = re.search(r"\{\{\w+\}\}", out)
+    if leftover:
+        raise ValueError(f"unrendered placeholder: {leftover.group(0)}")
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="render.py")
+    ap.add_argument("--values",
+                    default=os.path.join(_DIR, "chart", "values.yaml"))
+    ap.add_argument("--set", dest="sets", action="append", default=[],
+                    metavar="KEY=VALUE")
+    ap.add_argument("-o", "--output", default=None)
+    args = ap.parse_args(argv)
+    values = load_values(args.values)
+    for kv in args.sets:
+        key, eq, val = kv.partition("=")
+        if not eq:
+            raise SystemExit(f"--set {kv!r}: expected KEY=VALUE")
+        if key not in values:
+            raise SystemExit(f"--set {key}: not a known value "
+                             f"(see {args.values})")
+        values[key] = val
+    text = render(values)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text)
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,61 @@
+"""Manifest parametrization (the Helm-values analog, VERDICT r3
+Missing #5): k8s/render.py + chart/values.yaml must reproduce the
+committed manifest byte-for-byte with defaults, apply overrides, and
+fail loudly on template/values drift."""
+
+import os
+import subprocess
+import sys
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def render(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "k8s", "render.py"), *args],
+        capture_output=True, text=True, timeout=60,
+    )
+
+
+def test_default_render_matches_committed_manifest():
+    r = render()
+    assert r.returncode == 0, r.stderr
+    with open(os.path.join(REPO, "k8s", "vpp-tpu.yaml")) as f:
+        assert r.stdout == f.read(), \
+            "k8s/vpp-tpu.yaml drifted from the chart — regenerate with " \
+            "`python k8s/render.py -o k8s/vpp-tpu.yaml`"
+
+
+def test_overrides_apply_and_are_valid_yaml():
+    import yaml
+
+    r = render("--set", "image=registry.example/vpp-tpu:2.1",
+               "--set", "pod_subnet_cidr=10.9.0.0/16",
+               "--set", "mesh_nodes=4", "--set", "tpu_count=8")
+    assert r.returncode == 0, r.stderr
+    docs = list(yaml.safe_load_all(
+        r.stdout.replace("${NODE_NAME}", "node-x")
+    ))
+    assert len(docs) >= 8
+    assert "registry.example/vpp-tpu:2.1" in r.stdout
+    assert "10.9.0.0/16" in r.stdout
+    cfg = next(d for d in docs if d.get("kind") == "ConfigMap")
+    agent_yaml = yaml.safe_load(cfg["data"]["contiv.yaml"])
+    assert agent_yaml["mesh"] == {"nodes": 4, "rule_shards": 1}
+    # the rendered agent config must parse as a real AgentConfig
+    sys.path.insert(0, REPO)
+    from vpp_tpu.cmd.config import AgentConfig
+
+    parsed = AgentConfig.from_dict(agent_yaml)
+    assert parsed.mesh.nodes == 4
+    ds = next(d for d in docs if d.get("kind") == "DaemonSet")
+    limits = ds["spec"]["template"]["spec"]["containers"][0][
+        "resources"]["limits"]
+    assert limits == {"google.com/tpu": 8}
+
+
+def test_unknown_value_rejected():
+    r = render("--set", "no_such_knob=1")
+    assert r.returncode != 0
+    assert "not a known value" in (r.stderr + r.stdout)
