@@ -678,3 +678,49 @@ class TestIcmpErrors:
         while time.monotonic() < deadline:
             time.sleep(0.05)
         assert harness.pump.stats.get("icmp_errors", 0) == before
+
+    def test_remote_sender_gets_vxlan_encapped_time_exceeded(self, harness):
+        """Cross-node traceroute: a TTL=1 packet from a REMOTE pod
+        (VXLAN-decapped off the uplink) expires here; the generated
+        time-exceeded is routed back THROUGH THE PIPELINE — picking up
+        the remote route's next_hop — and leaves VXLAN-encapsulated
+        toward the peer VTEP, not as a bare frame."""
+        from vpp_tpu.native.pktio import PacketCodec
+
+        inner = make_frame(REMOTE_POD, SERVER_IP, proto=17, dport=80,
+                           ttl=1)
+        codec = PacketCodec()
+        arr = np.frombuffer(inner, np.uint8)
+        wire = codec.encap(
+            np.ascontiguousarray(arr), len(inner), ip4(VTEP_PEER),
+            ip4(VTEP_SELF), 50000, 10,
+            b"\x02\x00\x00\x00\x00\x09", b"\x02\x00\x00\x00\x00\x08",
+        )
+        harness.send("uplink", wire)
+        deadline = time.monotonic() + 8
+        while time.monotonic() < deadline:
+            try:
+                out = harness.recv("uplink", timeout=1.0)
+            except (socket.timeout, TimeoutError):
+                continue
+            # outer IPv4/UDP VXLAN toward the peer VTEP?
+            if len(out) < 50 + 34 or out[23] != 17:
+                continue
+            if out[14 + 16:14 + 20] != \
+                    ipaddress.ip_address(VTEP_PEER).packed:
+                continue
+            icmp_inner = out[50:]  # skip outer eth+ip+udp+vxlan
+            if icmp_inner[23] == 1:  # inner proto ICMP
+                break
+        else:
+            raise AssertionError("no VXLAN-encapped ICMP toward the peer")
+        assert icmp_inner[14 + 12:14 + 16] == \
+            ipaddress.ip_address(GW_IP).packed
+        assert icmp_inner[14 + 16:14 + 20] == \
+            ipaddress.ip_address(REMOTE_POD).packed
+        assert icmp_inner[34] == 11  # time exceeded
+        # RFC 792 quote: the invoking packet's header (remote pod ->
+        # server) rides inside the error
+        quoted = icmp_inner[34 + 8:]
+        assert quoted[12:16] == ipaddress.ip_address(REMOTE_POD).packed
+        assert quoted[16:20] == ipaddress.ip_address(SERVER_IP).packed

@@ -462,7 +462,11 @@ class ContivAgent:
                 srv.close()
         self.proxy.close()
         pump_stopped = True
-        if self.io_pump is not None:
+        if self.io_pump is not None and not self._external_io:
+            # mesh mode (_external_io): io_pump is the SHARED ClusterPump
+            # wired in for `show io` — its lifecycle belongs to the
+            # MeshRuntime; one agent closing must not halt fabric IO for
+            # every other node
             pump_stopped = self.io_pump.stop(join_timeout=30.0)
         if self.io_rings is not None:
             if pump_stopped:

@@ -24,6 +24,7 @@ dispatch/fetch/write split later without changing this data path.
 
 from __future__ import annotations
 
+import collections
 import logging
 import threading
 import time
@@ -48,8 +49,13 @@ class ClusterPump:
         self.rings = ring_pairs
         self.poll_s = poll_s
         self.snap = snap or min(r.rx.snap for r in ring_pairs)
+        # superset of DataplanePump's keys so the CLI's `show io`
+        # renders either pump unchanged (batches == device steps)
         self.stats = {"steps": 0, "frames": 0, "pkts": 0,
-                      "fabric_pkts": 0, "tx_ring_full": 0}
+                      "fabric_pkts": 0, "tx_ring_full": 0,
+                      "batches": 0, "max_coalesce": 0, "batch_errors": 0}
+        self._step_lat = collections.deque(maxlen=2048)
+        self._lat_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -102,6 +108,7 @@ class ClusterPump:
                     time.sleep(self.poll_s)
             except Exception:
                 log.exception("cluster pump step failed")
+                self.stats["batch_errors"] += 1
                 time.sleep(self.poll_s)
 
     def _step_once(self) -> bool:
@@ -111,6 +118,7 @@ class ClusterPump:
         frames = [r.rx.peek() for r in self.rings]
         if all(f is None for f in frames):
             return False
+        t0 = time.perf_counter()
         cols = np.zeros((n, len(_PV_FIELDS), VEC), np.int32)
         payload = np.zeros((n, VEC, self.snap), np.uint8)
         for i, f in enumerate(frames):
@@ -178,7 +186,28 @@ class ClusterPump:
                 else:
                     self.stats["tx_ring_full"] += 1
         self.stats["steps"] += 1
+        self.stats["batches"] += 1
+        self.stats["max_coalesce"] = max(
+            self.stats["max_coalesce"],
+            sum(1 for f in frames if f is not None),
+        )
+        with self._lat_lock:
+            self._step_lat.append(time.perf_counter() - t0)
         return True
+
+    def latency_us(self) -> dict:
+        """p50/p99 fabric-step latency (rx peek -> both tx streams
+        written) over the recent window — `show io` renders this."""
+        with self._lat_lock:
+            snap = list(self._step_lat)
+        if not snap:
+            return {"p50": 0.0, "p99": 0.0, "n": 0}
+        arr = np.asarray(snap) * 1e6
+        return {
+            "p50": float(np.percentile(arr, 50)),
+            "p99": float(np.percentile(arr, 99)),
+            "n": int(arr.size),
+        }
 
     @staticmethod
     def _tx_cols(res, i: int, n: Optional[int], sel=None) -> dict:

@@ -123,12 +123,17 @@ class IcmpErrorGen:
     def build_frame(
         self, idxs: np.ndarray, types: np.ndarray, cols: Dict[str, np.ndarray],
         payload: np.ndarray, scratch: np.ndarray,
+        rx_if: Optional[int] = None,
     ) -> Optional[Tuple[Dict[str, np.ndarray], int]]:
         """ICMP error frame for dropped packets ``idxs`` (positions in
         the ORIGINAL rx frame): ``cols``/``payload`` are that frame's
         ring columns + payload rows; ``scratch`` is a [VEC, snap] uint8
-        payload buffer for the new frame. Returns (ring columns, n) or
-        None when rate limiting suppressed everything."""
+        payload buffer for the new frame. ``rx_if`` is the interface
+        the error packets claim as INGRESS — self-originated traffic
+        enters via the node's host interface and the caller routes it
+        through the pipeline like any packet (VPP: ip4-icmp-error
+        feeds ip4-lookup). Returns (ring columns, n) or None when rate
+        limiting suppressed everything."""
         grant = self._take(len(idxs))
         if not grant:
             return None
@@ -151,11 +156,10 @@ class IcmpErrorGen:
             out["proto"][n] = 1
             out["ttl"][n] = 64
             out["pkt_len"][n] = pkt_len
-            # tx direction: rx_if carries the egress interface — errors
-            # leave through the interface the invoking packet came from
-            out["rx_if"][n] = cols["rx_if"][i]
+            out["rx_if"][n] = (
+                rx_if if rx_if is not None else cols["rx_if"][i]
+            )
             out["flags"][n] = 1  # FLAG_VALID
-            out["disp"][n] = 1   # Disposition.LOCAL
             out["meta"][n] = -1
             n += 1
         if not n:

@@ -44,6 +44,7 @@ import numpy as np
 from vpp_tpu.io.rings import VEC, IORingPair
 from vpp_tpu.pipeline.dataplane import (
     PACKED_IN_ROWS,
+    pack_packet_columns,
     unpack_packet_input,
 )
 from vpp_tpu.pipeline.vector import Disposition, PacketVector
@@ -51,6 +52,11 @@ from vpp_tpu.pipeline.vector import Disposition, PacketVector
 log = logging.getLogger("pump")
 
 _SENTINEL = object()
+
+# duck-typed stand-in for rings.Frame: push_packed only reads .cols
+# (contiguous column block views), .n and .payload
+_IcmpFrame = collections.namedtuple("_IcmpFrame",
+                                    ("cols", "n", "epoch", "payload"))
 
 
 class DataplanePump:
@@ -87,6 +93,10 @@ class DataplanePump:
 
             self.icmp = IcmpErrorGen(icmp_src_ip, VEC, rings.tx.snap)
             self._icmp_scratch = np.zeros((VEC, rings.tx.snap), np.uint8)
+            # built error batches queued to the error-path thread (its
+            # device round trips must not block the tx writer); bounded
+            # — overflow counts as rate-limit suppression
+            self._icmp_q: "queue.Queue" = queue.Queue(maxsize=8)
         # native fast-path scratch (single dispatch / single tx-writer
         # thread each, so plain reuse is safe): per-batch frame base
         # pointers + counts for pio_pack_batch, per-frame drop causes
@@ -94,6 +104,7 @@ class DataplanePump:
         self._pack_bases = np.zeros(rings.rx.ring.n_slots, np.uint64)
         self._pack_ns = np.zeros(rings.rx.ring.n_slots, np.uint32)
         self._cause = np.zeros(VEC, np.int32)
+        self._icmp_cause = np.zeros(VEC, np.int32)
         self.max_batch = max(VEC, int(max_batch))
         # geometric bucket ladder VEC, 4·VEC, 16·VEC, … up to max_batch:
         # a partial backlog pads to the next bucket, not straight to
@@ -163,6 +174,8 @@ class DataplanePump:
                  (self._write_loop, "dp-pump-tx")]
         names += [(self._fetch_loop, f"dp-pump-fetch{i}")
                   for i in range(self.workers)]
+        if self.icmp is not None:
+            names.append((self._icmp_loop, "dp-pump-icmp"))
         for fn, name in names:
             t = threading.Thread(target=fn, daemon=True, name=name)
             t.start()
@@ -440,21 +453,26 @@ class DataplanePump:
         rx frame's attributed drops (VERDICT r3 Next #8; VPP
         ip4-icmp-error). The invoking packet is quoted from its rx slot
         payload — still ring-owned here, so the original bytes are
-        stable. ``cause`` is the per-packet DROP_* array [VEC]."""
+        stable. ``cause`` is the per-packet DROP_* array [VEC].
+
+        The errors are ROUTED THROUGH THE PIPELINE like any ingress
+        packet (rx on the node's host interface — they originate from
+        the vswitch itself), exactly as VPP's ip4-icmp-error node feeds
+        back into ip4-lookup: errors toward local pods deliver on the
+        pod interface, errors toward REMOTE senders (the invoking
+        packet arrived on the uplink) pick up the route's next_hop and
+        leave VXLAN-encapsulated — cross-node traceroute works."""
         from vpp_tpu.io.icmp import ICMP_TIME_EXCEEDED, ICMP_UNREACHABLE
         from vpp_tpu.pipeline.graph import DROP_IP4, DROP_NO_ROUTE
 
+        ingress = self.dp.host_if
+        if ingress is None:
+            ingress = self.dp.uplink_if
+        if ingress is None:
+            return  # no self-originated ingress point configured
         n = f.n
         c = cause[:n]
         valid = (f.cols["flags"][:n] & 1) != 0
-        # Cross-node senders (rx on the uplink) would need the error
-        # routed back through the fabric/VXLAN path; emitting it
-        # disp=LOCAL out the uplink would inject a bare inner frame
-        # into the overlay. Until errors are re-injected through the
-        # pipeline, only locally-originated drops generate ICMP.
-        uplink = self.dp.uplink_if
-        if uplink is not None:
-            valid &= f.cols["rx_if"][:n] != uplink
         # DROP_IP4 covers TTL/len/bad-if; only a TTL of <= 1 at
         # ingress is a time-exceeded
         ttl_exp = (c == DROP_IP4) & (f.cols["ttl"][:n] <= 1) & valid
@@ -465,18 +483,69 @@ class DataplanePump:
         types = np.where(ttl_exp[idxs], ICMP_TIME_EXCEEDED,
                          ICMP_UNREACHABLE)
         built = self.icmp.build_frame(
-            idxs, types, f.cols, f.payload, self._icmp_scratch
+            idxs, types, f.cols, f.payload, self._icmp_scratch,
+            rx_if=int(ingress),
         )
         if built is None:
             return
         out_cols, k = built
-        if self.rings.tx.push(out_cols, k, payload=self._icmp_scratch,
-                              epoch=self.dp.epoch):
-            self.stats["icmp_errors"] = (
-                self.stats.get("icmp_errors", 0) + k
+        # hand off to the dedicated error-path thread: the classify is
+        # a blocking device round trip (~100 ms on a remote transport)
+        # and this is the IN-ORDER tx writer — blocking here would
+        # head-of-line-block all forwarded traffic and stall rx slot
+        # releases. Payload rows are copied because _icmp_scratch is
+        # reused for the next build.
+        try:
+            self._icmp_q.put_nowait(
+                (out_cols, k, self._icmp_scratch[:k].copy())
             )
-        else:
-            self.stats["tx_ring_full"] += 1
+        except queue.Full:
+            self.icmp.suppressed += k
+
+    def _icmp_loop(self) -> None:
+        """Error-path worker: routes built ICMP error frames through
+        the device pipeline (rx on the host interface — VPP's
+        ip4-icmp-error feeding ip4-lookup) and pushes the verdicts to
+        the tx ring. Its blocking round trips never touch the
+        forwarding threads."""
+        import jax
+
+        from vpp_tpu.native.pktio import flatten_cols
+        from vpp_tpu.native.ring import RING_COLUMNS
+        from vpp_tpu.pipeline.dataplane import packed_input_zeros
+
+        payload_buf = np.zeros((VEC, self.rings.tx.snap), np.uint8)
+        while not self._stop.is_set():
+            try:
+                out_cols, k, payload = self._icmp_q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            try:
+                flat = packed_input_zeros(VEC)
+                pack_packet_columns(flat.view(np.uint32), out_cols, k)
+                # the verdict assigns the real egress + next_hop
+                res = np.array(jax.device_get(self.dp.process_packed(flat)))
+                block = flatten_cols(out_cols)
+                cols_view = {
+                    name: block[j]
+                    for j, (name, _dt) in enumerate(RING_COLUMNS)
+                }
+                payload_buf[:k] = payload
+                frame = _IcmpFrame(cols=cols_view, n=k,
+                                   epoch=self.dp.epoch,
+                                   payload=payload_buf)
+                host_if = (self.dp.host_if
+                           if self.dp.host_if is not None else -1)
+                if self.rings.tx.push_packed(res, 0, k, frame, host_if,
+                                             self.dp.epoch,
+                                             self._icmp_cause):
+                    self.stats["icmp_errors"] = (
+                        self.stats.get("icmp_errors", 0) + k
+                    )
+                else:
+                    self.stats["tx_ring_full"] += 1
+            except Exception:
+                log.exception("icmp error path failed")
 
     # --- observability ---
     def latency_us(self) -> dict:

@@ -111,6 +111,8 @@ class MeshRuntime:
             )
             for agent in self.agents:
                 agent._external_io = True
+                # the shared fabric pump backs every node's `show io`
+                agent.io_pump = self.cluster_pump
 
     @property
     def n_nodes(self) -> int:
