@@ -41,6 +41,13 @@ _PV_FIELDS = ("src_ip", "dst_ip", "proto", "sport", "dport", "ttl",
               "pkt_len", "rx_if", "flags")
 
 
+# per-node rx frames coalesced into one device step (two jit buckets:
+# VEC and VEC*MAX_FRAMES packets per node, like the single-node pump's
+# ladder — a backlog quadruples the per-step payload instead of paying
+# a step per frame)
+MAX_FRAMES = 4
+
+
 class ClusterPump:
     def __init__(self, cluster, ring_pairs: List[IORingPair],
                  poll_s: float = 0.0005, snap: Optional[int] = None):
@@ -75,16 +82,19 @@ class ClusterPump:
         })
 
     def warm(self) -> None:
-        """Compile the wire step before serving traffic (same input
-        shapes/shardings as the live loop)."""
+        """Compile the wire step at BOTH coalesce buckets before
+        serving traffic (same input shapes/shardings as the live loop
+        — a mid-traffic recompile costs minutes on a small host)."""
         import jax
 
         n = self.cluster.n_nodes
-        cols = np.zeros((n, len(_PV_FIELDS), VEC), np.int32)
-        payload = np.zeros((n, VEC, self.snap), np.uint8)
-        jax.block_until_ready(
-            self.cluster.step_wire(self._pv_from(cols), payload, now=0)
-        )
+        for p in (VEC, VEC * MAX_FRAMES):
+            cols = np.zeros((n, len(_PV_FIELDS), p), np.int32)
+            payload = np.zeros((n, p, self.snap), np.uint8)
+            jax.block_until_ready(
+                self.cluster.step_wire(self._pv_from(cols), payload,
+                                       now=0)
+            )
 
     def start(self) -> "ClusterPump":
         self._thread = threading.Thread(
@@ -115,19 +125,35 @@ class ClusterPump:
         import jax
 
         n = self.cluster.n_nodes
-        frames = [r.rx.peek() for r in self.rings]
-        if all(f is None for f in frames):
+        per_node: List[list] = []
+        for r in self.rings:
+            lst = []
+            for k in range(MAX_FRAMES):
+                f = r.rx.peek_nth(k)
+                if f is None:
+                    break
+                lst.append(f)
+            per_node.append(lst)
+        if all(not lst for lst in per_node):
             return False
         t0 = time.perf_counter()
-        cols = np.zeros((n, len(_PV_FIELDS), VEC), np.int32)
-        payload = np.zeros((n, VEC, self.snap), np.uint8)
-        for i, f in enumerate(frames):
-            if f is None:
-                continue
-            for j, name in enumerate(_PV_FIELDS):
-                cols[i, j] = f.cols[name].view(np.int32)
-            w = min(self.snap, f.payload.shape[1])
-            payload[i, :f.n, :w] = f.payload[:f.n, :w]
+        depth = max(len(lst) for lst in per_node)
+        p_cap = VEC if depth <= 1 else VEC * MAX_FRAMES
+        cols = np.zeros((n, len(_PV_FIELDS), p_cap), np.int32)
+        payload = np.zeros((n, p_cap, self.snap), np.uint8)
+        offs: List[list] = []  # per node: (packet offset, frame)
+        for i, lst in enumerate(per_node):
+            off = 0
+            node_offs = []
+            for f in lst:
+                for j, name in enumerate(_PV_FIELDS):
+                    cols[i, j, off:off + f.n] = \
+                        f.cols[name][:f.n].view(np.int32)
+                w = min(self.snap, f.payload.shape[1])
+                payload[i, off:off + f.n, :w] = f.payload[:f.n, :w]
+                node_offs.append((off, f))
+                off += f.n
+            offs.append(node_offs)
         pv = self._pv_from(cols)
         result, deliv_pay = self.cluster.step_wire(pv, payload)
         res_local, res_deliv = jax.device_get(
@@ -136,31 +162,34 @@ class ClusterPump:
         deliv_pay = np.asarray(jax.device_get(deliv_pay))
 
         # pass-1 results → ingress node's tx ring (payload: own rx slot)
-        for i, f in enumerate(frames):
-            if f is None:
-                continue
-            out_cols = self._tx_cols(res_local, i, f.n)
-            # fabric-consumed packets must not ALSO leave via the
-            # ingress tx path: their disposition stays REMOTE with a
-            # node_id >= 0; the daemon would VXLAN-encap (next_hop) or
-            # uplink-send them. Mark them transmitted-by-fabric (drop
-            # here, delivered at the peer).
-            fabric = (np.asarray(res_local.node_id)[i][:f.n] >= 0) & \
-                (out_cols["disp"][:f.n] == int(Disposition.REMOTE))
-            out_cols["disp"][:f.n] = np.where(
-                fabric, int(Disposition.DROP), out_cols["disp"][:f.n]
-            )
-            out_cols["flags"] = f.cols["flags"].copy()
-            out_cols["meta"] = f.cols["meta"].copy()
-            out_cols["proto"] = f.cols["proto"].copy()
-            out_cols["pkt_len"] = f.cols["pkt_len"].copy()
-            if self.rings[i].tx.push(out_cols, f.n, payload=f.payload,
-                                     epoch=self.cluster.epoch):
-                self.stats["frames"] += 1
-                self.stats["pkts"] += f.n
-            else:
-                self.stats["tx_ring_full"] += 1
-            self.rings[i].rx.release()
+        for i, node_offs in enumerate(offs):
+            node_ids = np.asarray(res_local.node_id)[i]
+            for off, f in node_offs:
+                out_cols = self._tx_cols(res_local, i, f.n, off=off)
+                # fabric-consumed packets must not ALSO leave via the
+                # ingress tx path: their disposition stays REMOTE with
+                # a node_id >= 0; the daemon would VXLAN-encap
+                # (next_hop) or uplink-send them. Mark them
+                # transmitted-by-fabric (drop here, delivered at the
+                # peer).
+                fabric = (node_ids[off:off + f.n] >= 0) & \
+                    (out_cols["disp"][:f.n] == int(Disposition.REMOTE))
+                out_cols["disp"][:f.n] = np.where(
+                    fabric, int(Disposition.DROP), out_cols["disp"][:f.n]
+                )
+                out_cols["flags"] = f.cols["flags"].copy()
+                out_cols["meta"] = f.cols["meta"].copy()
+                out_cols["proto"] = f.cols["proto"].copy()
+                out_cols["pkt_len"] = f.cols["pkt_len"].copy()
+                if self.rings[i].tx.push(out_cols, f.n,
+                                         payload=f.payload,
+                                         epoch=self.cluster.epoch):
+                    self.stats["frames"] += 1
+                    self.stats["pkts"] += f.n
+                else:
+                    self.stats["tx_ring_full"] += 1
+            for _ in node_offs:
+                self.rings[i].rx.release()
 
         # pass-2 fabric deliveries → destination node's tx ring
         # (payload: the bytes that crossed the fabric)
@@ -189,7 +218,7 @@ class ClusterPump:
         self.stats["batches"] += 1
         self.stats["max_coalesce"] = max(
             self.stats["max_coalesce"],
-            sum(1 for f in frames if f is not None),
+            sum(len(lst) for lst in per_node),
         )
         with self._lat_lock:
             self._step_lat.append(time.perf_counter() - t0)
@@ -210,9 +239,12 @@ class ClusterPump:
         }
 
     @staticmethod
-    def _tx_cols(res, i: int, n: Optional[int], sel=None) -> dict:
+    def _tx_cols(res, i: int, n: Optional[int], sel=None,
+                 off: int = 0) -> dict:
         """TX ring columns from one node's row of a NodeTx result (tx
-        direction: the rx_if column carries the egress interface)."""
+        direction: the rx_if column carries the egress interface).
+        ``off`` slices a coalesced frame's packets out of the node
+        row; ``sel`` gathers arbitrary positions (delivered path)."""
         pk = res.pkts
         out = {}
 
@@ -222,7 +254,7 @@ class ClusterPump:
             if sel is not None:
                 col[:len(sel)] = a[sel].astype(dtype, copy=False)
             else:
-                col[:n] = a[:n].astype(dtype, copy=False)
+                col[:n] = a[off:off + n].astype(dtype, copy=False)
             return col
 
         out["src_ip"] = take(pk.src_ip, np.uint32)

@@ -145,6 +145,10 @@ class DataplanePump:
         # every pending index down, so both sides mutate under the lock.
         self._held_lock = threading.Lock()
         self._held = 0
+        # the tx frame ring is SPSC: its reserve/commit protocol
+        # permits ONE producer. The in-order writer and the ICMP
+        # error-path thread both push, so their pushes serialize here.
+        self._tx_lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: list = []
 
@@ -386,8 +390,11 @@ class DataplanePump:
             off = 0
             for f in frames:
                 n = f.n
-                if self.rings.tx.push_packed(batch, off, n, f, host_if,
-                                             epoch, self._cause):
+                with self._tx_lock:
+                    ok = self.rings.tx.push_packed(batch, off, n, f,
+                                                   host_if, epoch,
+                                                   self._cause)
+                if ok:
                     self.stats["frames"] += 1
                     self.stats["pkts"] += n
                     if icmp_on and n and self._cause[:n].any():
@@ -426,8 +433,11 @@ class DataplanePump:
                 for inv in ("proto", "pkt_len"):
                     if inv not in out_cols:
                         out_cols[inv] = f.cols[inv]
-                if self.rings.tx.push(out_cols, n, payload=f.payload,
-                                      epoch=epoch):
+                with self._tx_lock:
+                    ok = self.rings.tx.push(out_cols, n,
+                                            payload=f.payload,
+                                            epoch=epoch)
+                if ok:
                     self.stats["frames"] += 1
                     self.stats["pkts"] += n
                     # ICMP only for frames that made it out: under tx
@@ -523,8 +533,14 @@ class DataplanePump:
             try:
                 flat = packed_input_zeros(VEC)
                 pack_packet_columns(flat.view(np.uint32), out_cols, k)
-                # the verdict assigns the real egress + next_hop
-                res = np.array(jax.device_get(self.dp.process_packed(flat)))
+                # the verdict assigns the real egress + next_hop.
+                # commit=False: error classification must not install
+                # sessions NOR race the dispatch thread's table
+                # commits (two committers would drop one side's
+                # reflective-session installs)
+                res = np.array(jax.device_get(
+                    self.dp.process_packed(flat, commit=False)
+                ))
                 block = flatten_cols(out_cols)
                 cols_view = {
                     name: block[j]
@@ -536,9 +552,12 @@ class DataplanePump:
                                    payload=payload_buf)
                 host_if = (self.dp.host_if
                            if self.dp.host_if is not None else -1)
-                if self.rings.tx.push_packed(res, 0, k, frame, host_if,
-                                             self.dp.epoch,
-                                             self._icmp_cause):
+                with self._tx_lock:
+                    ok = self.rings.tx.push_packed(res, 0, k, frame,
+                                                   host_if,
+                                                   self.dp.epoch,
+                                                   self._icmp_cause)
+                if ok:
                     self.stats["icmp_errors"] = (
                         self.stats.get("icmp_errors", 0) + k
                     )

@@ -485,14 +485,21 @@ class Dataplane:
                 now = max(self._now, self.clock_ticks())
         return step(tables, pkts, jnp.int32(now))
 
-    def process_packed(self, flat, now: Optional[int] = None):
+    def process_packed(self, flat, now: Optional[int] = None,
+                       commit: bool = True):
         """Single-transfer variant of process() for the pump's hot path:
         ``flat`` is a host [5, B] int32 bit-packed batch (see
         ``_packed_call`` for the row layout; build with
         ``pack_packet_columns`` / ``packed_input_zeros``); returns the
         DEVICE [5, B] int32 packed result without forcing a host sync —
         the caller device_gets it when ready. One upload, one fetch per
-        batch, 20 bytes per packet each way."""
+        batch, 20 bytes per packet each way.
+
+        ``commit=False`` discards the resulting session-table state (a
+        probe-like classify): REQUIRED for any caller other than the
+        pump's single dispatch thread — two concurrent committers race
+        the ``tables is self.tables`` swap guard and one side's
+        reflective-session installs would be silently lost."""
         with self._lock:
             if self.tables is None:
                 raise RuntimeError(
@@ -505,9 +512,10 @@ class Dataplane:
                 self._now = max(self._now, self.clock_ticks())
                 now = self._now
         new_tables, out = step(tables, jnp.asarray(flat), jnp.int32(now))
-        with self._lock:
-            if tables is self.tables:
-                self.tables = new_tables
+        if commit:
+            with self._lock:
+                if tables is self.tables:
+                    self.tables = new_tables
         return out
 
     def process_packed_chain(self, flats, now: Optional[int] = None):
