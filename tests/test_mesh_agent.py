@@ -180,9 +180,11 @@ def test_mesh_service_nat_across_nodes():
 
 
 def test_cluster_pump_coalesces_backlog():
-    """A burst of rx frames on one node is coalesced into ONE fabric
-    step (the VEC*MAX_FRAMES bucket) instead of a step per frame —
-    and every packet still delivers at the peer with its bytes."""
+    """A pre-staged backlog of rx frames is coalesced into FEWER fabric
+    steps (the VEC*MAX_FRAMES bucket) than frames — and every packet
+    still delivers at the peer with its bytes. The pump starts only
+    AFTER the backlog is staged, so the coalesce assertion is
+    deterministic."""
     import sys
     import time as _t
 
@@ -191,9 +193,8 @@ def test_cluster_pump_coalesces_backlog():
     sys.path.insert(0, "tests")
     from wire import make_frame
 
-    from vpp_tpu.cmd.config import IOConfig
-    from vpp_tpu.cni.model import CNIRequest
-    from vpp_tpu.io.cluster_pump import MAX_FRAMES
+    from vpp_tpu.io.cluster_pump import MAX_FRAMES, ClusterPump
+    from vpp_tpu.io.rings import IORingPair
     from vpp_tpu.native.pktio import PacketCodec
 
     store = KVStore()
@@ -205,28 +206,22 @@ def test_cluster_pump_coalesces_backlog():
             max_tables=4, max_rules=16, max_global_rules=32, max_ifaces=16,
             fib_slots=64, sess_slots=256, nat_mappings=4, nat_backends=16,
         ),
-        io=IOConfig(enabled=True, n_slots=16, snap=256),
     )
     runtime = MeshRuntime(2, cfg, rule_shards=2, store=store).start()
+    rings = [IORingPair(n_slots=16, snap=256) for _ in range(2)]
+    pump = ClusterPump(runtime.cluster, rings, snap=256)
     try:
         a0, a1 = runtime.agents
-
-        def add(agent, cid, name):
-            r = agent.cni_server.add(CNIRequest(
-                container_id=cid,
-                extra_args={"K8S_POD_NAME": name,
-                            "K8S_POD_NAMESPACE": "default"}))
-            assert r.result == 0
-            return r.interfaces[0].ip_addresses[0].address.split("/")[0]
-
-        ip_a = add(a0, "c-a", "pa")
-        ip_b = add(a1, "c-b", "pb")
+        ip_a = add_pod(a0, "c-a", "pa")
+        ip_b = add_pod(a1, "c-b", "pb")
         if_a = a0.dataplane.pod_if[("default", "pa")]
 
+        pump.warm()
         codec = PacketCodec(snap=256)
         scratch = np.zeros((256, 256), np.uint8)
         lens = np.zeros(256, np.uint32)
         n_frames, per = MAX_FRAMES, 8
+        # stage the WHOLE backlog before the pump thread exists
         for j in range(n_frames):
             for i in range(per):
                 f = make_frame(ip_a, ip_b, proto=17,
@@ -234,22 +229,23 @@ def test_cluster_pump_coalesces_backlog():
                 scratch[i, :len(f)] = np.frombuffer(f, np.uint8)
                 lens[i] = len(f)
             cols, k = codec.parse_inplace(scratch, lens, per, if_a)
-            assert runtime.ring_pairs[0].rx.push(cols, k, payload=scratch)
+            assert rings[0].rx.push(cols, k, payload=scratch)
+        pump.start()
 
         deadline = _t.monotonic() + 60
         while (_t.monotonic() < deadline
-               and runtime.cluster_pump.stats["fabric_pkts"]
-               < n_frames * per):
+               and pump.stats["fabric_pkts"] < n_frames * per):
             _t.sleep(0.05)
-        assert runtime.cluster_pump.stats["fabric_pkts"] == n_frames * per
-        # the backlog crossed in FEWER steps than frames (coalesced)
-        assert runtime.cluster_pump.stats["max_coalesce"] > 1
+        assert pump.stats["fabric_pkts"] == n_frames * per
+        # the pre-staged backlog crossed in ONE coalesced step
+        assert pump.stats["max_coalesce"] == n_frames
+        assert pump.stats["steps"] == 1
 
         # drain node 1's tx ring: every packet delivered with bytes
         got = 0
         deadline = _t.monotonic() + 10
         while got < n_frames * per and _t.monotonic() < deadline:
-            fr = runtime.ring_pairs[1].tx.peek()
+            fr = rings[1].tx.peek()
             if fr is None:
                 _t.sleep(0.02)
                 continue
@@ -258,7 +254,44 @@ def test_cluster_pump_coalesces_backlog():
             got += int(live)
             # payload survived the fabric for the first packet
             assert fr.payload[0, 12:14].tobytes() == b"\x08\x00"
-            runtime.ring_pairs[1].tx.release()
+            rings[1].tx.release()
         assert got == n_frames * per
     finally:
+        pump.stop(join_timeout=30.0)
         runtime.close()
+        for r in rings:
+            r.close()
+
+
+def test_mesh_runtime_restart_keeps_identity(tmp_path):
+    """A restarted mesh runtime (persisted local store, the
+    connect_store path) reclaims the SAME allocator node ids and pod
+    addresses — pods that survived the restart keep their IPs exactly
+    like a standalone agent restart (kvstore-backed NodeIDAllocator +
+    CNI resync)."""
+    import dataclasses
+
+    persist = str(tmp_path / "mesh-store.json")
+    cfg = AgentConfig(
+        node_name="rst", serve_http=False, persist_path=persist,
+        dataplane=DataplaneConfig(
+            max_tables=4, max_rules=16, max_global_rules=32, max_ifaces=16,
+            fib_slots=64, sess_slots=256, nat_mappings=4, nat_backends=16,
+        ),
+    )
+    rt1 = MeshRuntime(2, cfg).start()
+    ids1 = [a.node_id for a in rt1.agents]
+    ip1 = add_pod(rt1.agents[0], "c-keep", "keeper")
+    rt1.agents[0].store.save()
+    rt1.close()
+
+    rt2 = MeshRuntime(2, dataclasses.replace(cfg)).start()
+    try:
+        assert [a.node_id for a in rt2.agents] == ids1, \
+            "allocator ids must survive the restart"
+        # the persisted pod resynced with its original address
+        a0 = rt2.agents[0]
+        assert ("default", "keeper") in a0.dataplane.pod_if
+        assert str(a0.ipam.get_pod_ip("default/keeper")) == ip1
+    finally:
+        rt2.close()

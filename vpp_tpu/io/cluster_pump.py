@@ -56,6 +56,18 @@ class ClusterPump:
         self.rings = ring_pairs
         self.poll_s = poll_s
         self.snap = snap or min(r.rx.snap for r in ring_pairs)
+        # preallocated staging for the two coalesce buckets: the hot
+        # loop must not allocate/zero multi-MB buffers per step. Only
+        # the flags row needs clearing between steps — a stale VALID
+        # flag would resurrect a previous step's packet, while every
+        # other stale column is inert behind flags=0 (invalid slots
+        # are masked through the whole pipeline).
+        n_nodes = cluster.n_nodes
+        self._stage = {
+            p: (np.zeros((n_nodes, len(_PV_FIELDS), p), np.int32),
+                np.zeros((n_nodes, p, self.snap), np.uint8))
+            for p in (VEC, VEC * MAX_FRAMES)
+        }
         # superset of DataplanePump's keys so the CLI's `show io`
         # renders either pump unchanged (batches == device steps)
         self.stats = {"steps": 0, "frames": 0, "pkts": 0,
@@ -139,8 +151,8 @@ class ClusterPump:
         t0 = time.perf_counter()
         depth = max(len(lst) for lst in per_node)
         p_cap = VEC if depth <= 1 else VEC * MAX_FRAMES
-        cols = np.zeros((n, len(_PV_FIELDS), p_cap), np.int32)
-        payload = np.zeros((n, p_cap, self.snap), np.uint8)
+        cols, payload = self._stage[p_cap]
+        cols[:, _PV_FIELDS.index("flags"), :] = 0
         offs: List[list] = []  # per node: (packet offset, frame)
         for i, lst in enumerate(per_node):
             off = 0

@@ -22,8 +22,8 @@ import jax.numpy as jnp
 from vpp_tpu.ops.acl import acl_classify_global, acl_classify_local
 from vpp_tpu.ops.fib import ip4_lookup
 from vpp_tpu.ops.ip4 import ip4_input
-from vpp_tpu.ops.nat44 import nat44_dnat, nat44_reverse
-from vpp_tpu.ops.session import session_lookup_reverse
+from vpp_tpu.ops.nat44 import nat44_dnat, nat44_reverse, nat44_snat
+from vpp_tpu.ops.session import session_insert, session_lookup_reverse
 from vpp_tpu.pipeline.graph import pipeline_step
 from vpp_tpu.pipeline.tables import DataplaneTables
 from vpp_tpu.pipeline.vector import PacketVector
@@ -79,6 +79,11 @@ def profile_stages(
         "acl-classify-local": (jax.jit(acl_classify_local), (tables, pkts)),
         "acl-classify-global": (jax.jit(acl_classify_global), (tables, pkts)),
         "ip4-lookup": (jax.jit(ip4_lookup), (tables, pkts.dst_ip)),
+        # r3 additions to the step (the suspects of any r2->r3 headline
+        # movement — VERDICT r3 Weak #2)
+        "nat44-snat": (jax.jit(nat44_snat), (tables, pkts, alive)),
+        "session-insert": (jax.jit(session_insert),
+                           (tables, pkts, alive, now)),
         "FUSED pipeline-step": (jax.jit(pipeline_step), (tables, pkts, now)),
     }
     out = []
