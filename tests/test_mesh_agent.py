@@ -295,3 +295,74 @@ def test_mesh_runtime_restart_keeps_identity(tmp_path):
         assert str(a0.ipam.get_pod_ip("default/keeper")) == ip1
     finally:
         rt2.close()
+
+
+def test_mesh_over_remote_kvserver():
+    """Mesh agents against a REAL served kvstore (the deployed-etcd
+    analog, in-process KVServer over TCP): node registration, KSR
+    reflection and the fabric path all work through the remote store —
+    the production store_url configuration of vpp-tpu-mesh-agent."""
+    from vpp_tpu.kvstore.server import KVServer
+
+    server = KVServer(host="127.0.0.1", port=0)
+    server.start()
+    try:
+        url = f"tcp://127.0.0.1:{server.port}"
+        from vpp_tpu.kvstore.client import connect_store
+
+        ksr = KsrAgent(store=connect_store(url), serve_http=False)
+        ksr.start()
+        cfg = AgentConfig(
+            node_name="rkv", serve_http=False, store_url=url,
+            dataplane=DataplaneConfig(
+                max_tables=4, max_rules=16, max_global_rules=32,
+                max_ifaces=16, fib_slots=64, sess_slots=256,
+                nat_mappings=4, nat_backends=16,
+            ),
+        )
+        # no injected store: MeshRuntime connects via store_url itself
+        runtime = MeshRuntime(2, cfg, rule_shards=2)
+        runtime.start()
+        try:
+            a0, a1 = runtime.agents
+            assert {runtime.mesh_position(a0.node_id),
+                    runtime.mesh_position(a1.node_id)} == {0, 1}
+            ip_a = add_pod(a0, "c-ra", "rpa")
+            ip_b = add_pod(a1, "c-rb", "rpb")
+            # policy reflected through the SERVED store cuts the flow
+            ksr.sources[m.Pod.TYPE].add("default/rpa", m.Pod(
+                name="rpa", namespace="default", labels={"app": "rpa"},
+                ip_address=ip_a))
+            ksr.sources[m.Pod.TYPE].add("default/rpb", m.Pod(
+                name="rpb", namespace="default", labels={"app": "rpb"},
+                ip_address=ip_b))
+            res = cross_node_send(runtime, 0, ("default", "rpa"),
+                                  ip_a, ip_b, 443)
+            d_disp = np.asarray(res.delivered.disp)[1]
+            assert np.any(d_disp == int(Disposition.LOCAL)), \
+                "fabric delivery through the remote-store mesh"
+            ksr.sources[m.Policy.TYPE].add("default/iso", m.Policy(
+                name="iso", namespace="default",
+                pods=m.LabelSelector(match_labels={"app": "rpb"}),
+                policy_type=m.POLICY_INGRESS, ingress_rules=[]))
+            import time as _t
+
+            deadline = _t.monotonic() + 20
+            cut = False
+            while _t.monotonic() < deadline and not cut:
+                res = cross_node_send(runtime, 0, ("default", "rpa"),
+                                      ip_a, ip_b, 443, sport=41100)
+                cut = not np.any(
+                    np.asarray(res.delivered.disp)[1]
+                    == int(Disposition.LOCAL)
+                )
+                if not cut:
+                    _t.sleep(0.2)
+            assert cut, "policy over the remote store cuts the flow"
+        finally:
+            runtime.close()
+            runtime.store.close()
+    finally:
+        ksr.close()
+        ksr.store.close()
+        server.close()

@@ -99,10 +99,8 @@ class ClusterPump:
         — a mid-traffic recompile costs minutes on a small host)."""
         import jax
 
-        n = self.cluster.n_nodes
         for p in (VEC, VEC * MAX_FRAMES):
-            cols = np.zeros((n, len(_PV_FIELDS), p), np.int32)
-            payload = np.zeros((n, p, self.snap), np.uint8)
+            cols, payload = self._stage[p]
             jax.block_until_ready(
                 self.cluster.step_wire(self._pv_from(cols), payload,
                                        now=0)
@@ -163,6 +161,11 @@ class ClusterPump:
                         f.cols[name][:f.n].view(np.int32)
                 w = min(self.snap, f.payload.shape[1])
                 payload[i, off:off + f.n, :w] = f.payload[:f.n, :w]
+                if w < self.snap:
+                    # reused staging: a narrower source ring must not
+                    # leave a previous step's bytes in the row tail —
+                    # VALID rows ride the fabric full-width
+                    payload[i, off:off + f.n, w:] = 0
                 node_offs.append((off, f))
                 off += f.n
             offs.append(node_offs)
