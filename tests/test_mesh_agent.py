@@ -366,3 +366,77 @@ def test_mesh_over_remote_kvserver():
         ksr.close()
         ksr.store.close()
         server.close()
+
+
+def test_icmp_error_returns_across_the_fabric():
+    """Traceroute hop 2, mesh edition: a TTL=2 packet from a pod on
+    node 0 survives the ingress vswitch, crosses the fabric, and
+    expires at NODE 1's pass — whose time-exceeded (src = node 1's pod
+    gateway) is re-injected through the pipeline and rides the fabric
+    BACK to the sender's node. No VXLAN, no kernel hops: the error
+    path is the same all_to_all the data path uses."""
+    import sys
+    import time as _t
+
+    sys.path.insert(0, "tests")
+    from wire import make_frame
+
+    from vpp_tpu.cmd.config import IOConfig
+    from vpp_tpu.native.pktio import PacketCodec
+
+    store = KVStore()
+    ksr = KsrAgent(store=store, serve_http=False)
+    ksr.start()
+    cfg = AgentConfig(
+        node_name="micmp", serve_http=False,
+        dataplane=DataplaneConfig(
+            max_tables=4, max_rules=16, max_global_rules=32, max_ifaces=16,
+            fib_slots=64, sess_slots=256, nat_mappings=4, nat_backends=16,
+        ),
+        io=IOConfig(enabled=True, n_slots=16, snap=256),
+    )
+    runtime = MeshRuntime(2, cfg, rule_shards=2, store=store).start()
+    try:
+        a0, a1 = runtime.agents
+        ip_a = add_pod(a0, "c-ia", "ipa")
+        ip_b = add_pod(a1, "c-ib", "ipb")
+        gw1 = str(a1.ipam.pod_gateway_ip())
+        if_a = a0.dataplane.pod_if[("default", "ipa")]
+
+        codec = PacketCodec(snap=256)
+        scratch = np.zeros((256, 256), np.uint8)
+        lens = np.zeros(256, np.uint32)
+        probe = make_frame(ip_a, ip_b, proto=17, sport=33434,
+                           dport=33434, ttl=2)
+        scratch[0, :len(probe)] = np.frombuffer(probe, np.uint8)
+        lens[0] = len(probe)
+        cols, k = codec.parse_inplace(scratch, lens, 1, if_a)
+        assert runtime.ring_pairs[0].rx.push(cols, k, payload=scratch)
+
+        from vpp_tpu.pipeline.vector import ip4
+
+        deadline = _t.monotonic() + 60
+        hop = None
+        while _t.monotonic() < deadline and hop is None:
+            fr = runtime.ring_pairs[0].tx.peek()
+            if fr is None:
+                _t.sleep(0.05)
+                continue
+            for s_ in range(fr.n):
+                if (fr.cols["proto"][s_] == 1
+                        and fr.cols["disp"][s_]
+                        == int(Disposition.LOCAL)):
+                    hop = (int(fr.cols["src_ip"][s_]),
+                           int(fr.cols["dst_ip"][s_]),
+                           bytes(fr.payload[s_, 34:36]))
+                    break
+            runtime.ring_pairs[0].tx.release()
+        assert hop is not None, "no ICMP error returned across the fabric"
+        src, dst, icmp_hdr = hop
+        assert src == int(ip4(gw1)), \
+            "time-exceeded originates from the REMOTE node's gateway"
+        assert dst == int(ip4(ip_a))
+        assert icmp_hdr[0] == 11 and icmp_hdr[1] == 0
+        assert runtime.cluster_pump.stats.get("icmp_errors", 0) >= 1
+    finally:
+        runtime.close()

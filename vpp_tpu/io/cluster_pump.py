@@ -48,14 +48,41 @@ _PV_FIELDS = ("src_ip", "dst_ip", "proto", "sport", "dport", "ttl",
 MAX_FRAMES = 4
 
 
+# a synthetic frame re-injected into the NEXT fabric step (ICMP error
+# path); shape-compatible with the staging loop's ring Frames
+_ErrFrame = collections.namedtuple("_ErrFrame", ("cols", "n", "payload"))
+
+
 class ClusterPump:
     def __init__(self, cluster, ring_pairs: List[IORingPair],
-                 poll_s: float = 0.0005, snap: Optional[int] = None):
+                 poll_s: float = 0.0005, snap: Optional[int] = None,
+                 icmp_src_ips: Optional[List[int]] = None,
+                 ingress_ifs: Optional[List[int]] = None):
+        """``icmp_src_ips``/``ingress_ifs`` (per mesh node: the pod
+        gateway address and the node's host interface) enable ICMP
+        error generation for attributed drops: errors are BUILT from
+        the step's drop_cause + the staged/fabric payload bytes and
+        RE-INJECTED as self-originated ingress into the next fabric
+        step — the pipeline verdict then delivers them to a local pod
+        or back ACROSS THE FABRIC toward a remote sender (VPP's
+        ip4-icmp-error feeding ip4-lookup, mesh edition)."""
         assert len(ring_pairs) == cluster.n_nodes
         self.cluster = cluster
         self.rings = ring_pairs
         self.poll_s = poll_s
         self.snap = snap or min(r.rx.snap for r in ring_pairs)
+        self.icmp = None
+        self._err_q: List[list] = [[] for _ in range(cluster.n_nodes)]
+        if icmp_src_ips is not None:
+            from vpp_tpu.io.icmp import IcmpErrorGen
+
+            assert ingress_ifs is not None and \
+                len(icmp_src_ips) == cluster.n_nodes
+            self.icmp = [
+                IcmpErrorGen(ip, VEC, self.snap) for ip in icmp_src_ips
+            ]
+            self.ingress_ifs = list(ingress_ifs)
+            self._icmp_scratch = np.zeros((VEC, self.snap), np.uint8)
         # preallocated staging for the two coalesce buckets: the hot
         # loop must not allocate/zero multi-MB buffers per step. Only
         # the flags row needs clearing between steps — a stale VALID
@@ -135,14 +162,22 @@ class ClusterPump:
         import jax
 
         n = self.cluster.n_nodes
-        per_node: List[list] = []
-        for r in self.rings:
+        per_node: List[list] = []   # (frame, from_ring) pairs
+        err_taken = [0] * n
+        for i, r in enumerate(self.rings):
             lst = []
-            for k in range(MAX_FRAMES):
+            # queued ICMP error frames first (self-originated ingress,
+            # produced by a PREVIOUS step's drop attribution). Gathered
+            # WITHOUT popping — like the rx peek/release split, a step
+            # that raises must retry them, not lose them
+            for ef in self._err_q[i][:MAX_FRAMES]:
+                lst.append((ef, False))
+            err_taken[i] = len(lst)
+            for k in range(MAX_FRAMES - len(lst)):
                 f = r.rx.peek_nth(k)
                 if f is None:
                     break
-                lst.append(f)
+                lst.append((f, True))
             per_node.append(lst)
         if all(not lst for lst in per_node):
             return False
@@ -151,11 +186,11 @@ class ClusterPump:
         p_cap = VEC if depth <= 1 else VEC * MAX_FRAMES
         cols, payload = self._stage[p_cap]
         cols[:, _PV_FIELDS.index("flags"), :] = 0
-        offs: List[list] = []  # per node: (packet offset, frame)
+        offs: List[list] = []  # per node: (packet offset, frame, from_ring)
         for i, lst in enumerate(per_node):
             off = 0
             node_offs = []
-            for f in lst:
+            for f, from_ring in lst:
                 for j, name in enumerate(_PV_FIELDS):
                     cols[i, j, off:off + f.n] = \
                         f.cols[name][:f.n].view(np.int32)
@@ -166,7 +201,7 @@ class ClusterPump:
                     # leave a previous step's bytes in the row tail —
                     # VALID rows ride the fabric full-width
                     payload[i, off:off + f.n, w:] = 0
-                node_offs.append((off, f))
+                node_offs.append((off, f, from_ring))
                 off += f.n
             offs.append(node_offs)
         pv = self._pv_from(cols)
@@ -179,7 +214,8 @@ class ClusterPump:
         # pass-1 results → ingress node's tx ring (payload: own rx slot)
         for i, node_offs in enumerate(offs):
             node_ids = np.asarray(res_local.node_id)[i]
-            for off, f in node_offs:
+            causes = np.asarray(res_local.drop_cause)[i]
+            for off, f, from_ring in node_offs:
                 out_cols = self._tx_cols(res_local, i, f.n, off=off)
                 # fabric-consumed packets must not ALSO leave via the
                 # ingress tx path: their disposition stays REMOTE with
@@ -203,8 +239,13 @@ class ClusterPump:
                     self.stats["pkts"] += f.n
                 else:
                     self.stats["tx_ring_full"] += 1
-            for _ in node_offs:
-                self.rings[i].rx.release()
+                if self.icmp is not None:
+                    self._queue_errors(i, f.cols, f.payload, f.n,
+                                       causes[off:off + f.n])
+            for _, _, from_ring in node_offs:
+                if from_ring:
+                    self.rings[i].rx.release()
+            del self._err_q[i][:err_taken[i]]  # consumed successfully
 
         # pass-2 fabric deliveries → destination node's tx ring
         # (payload: the bytes that crossed the fabric)
@@ -229,6 +270,28 @@ class ClusterPump:
                     self.stats["fabric_pkts"] += k
                 else:
                     self.stats["tx_ring_full"] += 1
+        # drop attribution → ICMP errors, re-injected next step. Pass-2
+        # drops matter most here: the invoking packet came from ANOTHER
+        # node, and the re-injected error's pipeline verdict sends it
+        # back ACROSS THE FABRIC to that sender.
+        if self.icmp is not None:
+            from vpp_tpu.native.ring import RING_COLUMNS
+
+            d_cause = np.asarray(res_deliv.drop_cause)
+            d_pk = res_deliv.pkts
+            width = d_cause.shape[1]
+            for i in range(n):
+                if not d_cause[i].any():
+                    continue
+                cols_like = {
+                    name: np.zeros(width, dt) for name, dt in RING_COLUMNS
+                }
+                cols_like["src_ip"] = np.asarray(d_pk.src_ip)[i]
+                cols_like["pkt_len"] = np.asarray(d_pk.pkt_len)[i]
+                cols_like["ttl"] = np.asarray(d_pk.ttl)[i]
+                cols_like["flags"] = np.asarray(d_pk.flags)[i]
+                self._queue_errors(i, cols_like, deliv_pay[i], width,
+                                   d_cause[i])
         self.stats["steps"] += 1
         self.stats["batches"] += 1
         self.stats["max_coalesce"] = max(
@@ -238,6 +301,34 @@ class ClusterPump:
         with self._lat_lock:
             self._step_lat.append(time.perf_counter() - t0)
         return True
+
+    def _queue_errors(self, node: int, cols, payload, n: int,
+                      causes: np.ndarray) -> None:
+        """Build rate-limited ICMP errors for one frame's attributed
+        drops and queue them for re-injection as the node's
+        self-originated ingress in the NEXT fabric step (single pump
+        thread produces and consumes the queue — no locking)."""
+        from vpp_tpu.io.icmp import classify_drops
+
+        gen = self.icmp[node]
+        idxs, types = classify_drops(causes, cols["flags"],
+                                     cols["ttl"], n)
+        if not len(idxs):
+            return
+        if len(self._err_q[node]) >= MAX_FRAMES:
+            gen.suppressed += len(idxs)
+            return
+        built = gen.build_frame(
+            idxs, types, cols, payload, self._icmp_scratch,
+            rx_if=int(self.ingress_ifs[node]),
+        )
+        if built is None:
+            return
+        out_cols, k = built
+        self._err_q[node].append(_ErrFrame(
+            cols=out_cols, n=k, payload=self._icmp_scratch[:k].copy()
+        ))
+        self.stats["icmp_errors"] = self.stats.get("icmp_errors", 0) + k
 
     def latency_us(self) -> dict:
         """p50/p99 fabric-step latency (rx peek -> both tx streams

@@ -64,6 +64,18 @@ def build_icmp_error(
     avail = oip.shape[0]
     if orig_len is not None:
         avail = min(avail, max(int(orig_len), 0))
+    # RFC 792/1122: never generate an ICMP error about an ICMP error
+    # (types 3/4/5/11/12) — an undeliverable error must die silently,
+    # not ping-pong more errors through the data plane. The type byte
+    # is read only within the packet's REAL length (bytes past
+    # orig_len are another flow's residue from a previous ring lap);
+    # an ICMP packet whose type byte is unreadable is conservatively
+    # not quoted at all.
+    if int(oip[9]) == 1:
+        if oihl >= avail:
+            return None
+        if int(oip[oihl]) in (3, 4, 5, 11, 12):
+            return None
     quote = min(oihl + 8, avail)
     if quote < _IP_HDR:
         return None
@@ -92,6 +104,26 @@ def build_icmp_error(
     ck = _checksum(icmp[: _ICMP_HDR + quote])
     icmp[2:4] = np.frombuffer(ck.to_bytes(2, "big"), np.uint8)
     return frame, total
+
+
+def classify_drops(causes: np.ndarray, flags: np.ndarray,
+                   ttl: np.ndarray, n: int):
+    """Which attributed drops deserve an ICMP error, and which type:
+    (idxs, types) over positions [0, n). DROP_IP4 covers TTL/len/bad-if
+    — only a TTL of <= 1 at ingress is a time-exceeded; FIB misses are
+    net-unreachable; every other cause (policy, fib-drop, NAT) stays
+    silent. Shared by the single-node and cluster pumps so the
+    cause→error mapping can never diverge between them."""
+    from vpp_tpu.pipeline.graph import DROP_IP4, DROP_NO_ROUTE
+
+    c = causes[:n]
+    valid = (np.asarray(flags[:n]).view(np.int32) & 1) != 0
+    t = np.asarray(ttl[:n]).view(np.int32)
+    ttl_exp = (c == DROP_IP4) & (t <= 1) & valid
+    no_rt = (c == DROP_NO_ROUTE) & valid
+    idxs = np.nonzero(ttl_exp | no_rt)[0]
+    types = np.where(ttl_exp[idxs], ICMP_TIME_EXCEEDED, ICMP_UNREACHABLE)
+    return idxs, types
 
 
 class IcmpErrorGen:

@@ -472,8 +472,7 @@ class DataplanePump:
         pod interface, errors toward REMOTE senders (the invoking
         packet arrived on the uplink) pick up the route's next_hop and
         leave VXLAN-encapsulated — cross-node traceroute works."""
-        from vpp_tpu.io.icmp import ICMP_TIME_EXCEEDED, ICMP_UNREACHABLE
-        from vpp_tpu.pipeline.graph import DROP_IP4, DROP_NO_ROUTE
+        from vpp_tpu.io.icmp import classify_drops
 
         ingress = self.dp.host_if
         if ingress is None:
@@ -481,17 +480,10 @@ class DataplanePump:
         if ingress is None:
             return  # no self-originated ingress point configured
         n = f.n
-        c = cause[:n]
-        valid = (f.cols["flags"][:n] & 1) != 0
-        # DROP_IP4 covers TTL/len/bad-if; only a TTL of <= 1 at
-        # ingress is a time-exceeded
-        ttl_exp = (c == DROP_IP4) & (f.cols["ttl"][:n] <= 1) & valid
-        no_rt = (c == DROP_NO_ROUTE) & valid
-        idxs = np.nonzero(ttl_exp | no_rt)[0]
+        idxs, types = classify_drops(cause, f.cols["flags"],
+                                     f.cols["ttl"], n)
         if not len(idxs):
             return
-        types = np.where(ttl_exp[idxs], ICMP_TIME_EXCEEDED,
-                         ICMP_UNREACHABLE)
         built = self.icmp.build_frame(
             idxs, types, f.cols, f.payload, self._icmp_scratch,
             rx_if=int(ingress),

@@ -69,6 +69,8 @@ class NodeTx(NamedTuple):
     tx_if: jnp.ndarray    # int32 egress interface (uplink for REMOTE, -1 dropped)
     node_id: jnp.ndarray  # int32 destination node, -1 local
     next_hop: jnp.ndarray  # uint32 VXLAN peer for EDGE traffic (0 = none)
+    drop_cause: jnp.ndarray  # int32 DROP_* attribution (graph.py) — the
+                             # host error path (ICMP generation) reads it
 
 
 class ClusterStepResult(NamedTuple):
@@ -277,9 +279,10 @@ def make_cluster_step(mesh: Mesh, budget: int = 0, mxu: bool = False,
         stats = jax.tree.map(lambda a, b: a + b, res1.stats, res2.stats)
         out = ClusterStepResult(
             local=NodeTx(res1.pkts, res1.disp, res1.tx_if, res1.node_id,
-                         res1.next_hop),
+                         res1.next_hop, res1.drop_cause),
             delivered=NodeTx(res2.pkts, res2.disp, res2.tx_if,
-                             res2.node_id, res2.next_hop),
+                             res2.node_id, res2.next_hop,
+                             res2.drop_cause),
             tables=res2.tables,
             stats=stats,
             fabric_overflow=overflow,
@@ -292,6 +295,7 @@ def make_cluster_step(mesh: Mesh, budget: int = 0, mxu: bool = False,
     tx_spec = NodeTx(
         pkts=_pv_spec(), disp=P(NODE_AXIS), tx_if=P(NODE_AXIS),
         node_id=P(NODE_AXIS), next_hop=P(NODE_AXIS),
+        drop_cause=P(NODE_AXIS),
     )
     out_specs = ClusterStepResult(
         local=tx_spec,

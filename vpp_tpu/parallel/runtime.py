@@ -107,7 +107,14 @@ class MeshRuntime:
                 for i in range(n_nodes)
             ]
             self.cluster_pump = ClusterPump(
-                self.cluster, self.ring_pairs, snap=io.snap
+                self.cluster, self.ring_pairs, snap=io.snap,
+                # ICMP errors from each node's pod gateway, re-injected
+                # as that node's self-originated ingress (host if)
+                icmp_src_ips=(
+                    [int(a.ipam.pod_gateway_ip()) for a in self.agents]
+                    if io.icmp_errors else None
+                ),
+                ingress_ifs=[a.host_if for a in self.agents],
             )
             for agent in self.agents:
                 agent._external_io = True
