@@ -2,10 +2,10 @@
 
 The mesh-mode analog of io/pump.DataplanePump: one pump drives N
 per-node ring pairs against ONE ClusterDataplane. Each step gathers up
-to one rx frame per node, stacks headers ([N, P] columns) and packet
-bytes ([N, P, snap] uint8), runs ``cluster.step_wire`` — two fused
-pipeline passes joined by all_to_all collectives carrying headers AND
-payload — and writes BOTH result streams back out:
+to MAX_FRAMES rx frames per node, stacks headers ([N, P] columns) and
+packet bytes ([N, P, snap] uint8), runs ``cluster.step_wire`` — two
+fused pipeline passes joined by all_to_all collectives carrying
+headers AND payload — and writes BOTH result streams back out:
 
   * pass-1 ``local`` results to the INGRESS node's tx ring (locally
     delivered / host-punted / VXLAN-edge traffic; payload from the
@@ -14,18 +14,31 @@ payload — and writes BOTH result streams back out:
     the packet bytes arrive from the device (they crossed the fabric),
     so cross-node traffic needs no host-side source lookup at all.
 
+PIPELINED (two stages, like the single-node pump's dispatch/writer
+split): the dispatch thread stages + dispatches fabric steps without
+waiting (session tables chain device-side; XLA queues the programs),
+and the writer thread syncs results IN ORDER, writes the tx rings and
+releases the rx slots. Frames stay ring-owned while in flight
+(peek_nth + deferred release), so staging reads stable memory. On a
+remote device this overlaps each step's ~RTT-sized sync with the next
+step's staging + compute.
+
+ICMP errors (io/icmp.py): attributed drops from either pass build
+rate-limited error frames RE-INJECTED as that node's self-originated
+ingress into a following step — the pipeline verdict returns them to
+a local pod or back ACROSS the fabric toward a remote sender.
+
 Reference analog: inter-node pod traffic through the VXLAN full-mesh
 (plugins/contiv/node_events.go:184-250, two_node_two_pods.robot); here
 the overlay is the ICI all_to_all and the per-node IO daemons only see
-plain frames. Synchronous one-frame-per-node steps (v1): mesh wire
-throughput pipelining can reuse the single-node pump's
-dispatch/fetch/write split later without changing this data path.
+plain frames.
 """
 
 from __future__ import annotations
 
 import collections
 import logging
+import queue
 import threading
 import time
 from typing import List, Optional
@@ -40,6 +53,7 @@ log = logging.getLogger("cluster-pump")
 _PV_FIELDS = ("src_ip", "dst_ip", "proto", "sport", "dport", "ttl",
               "pkt_len", "rx_if", "flags")
 
+_SENTINEL = object()
 
 # per-node rx frames coalesced into one device step (two jit buckets:
 # VEC and VEC*MAX_FRAMES packets per node, like the single-node pump's
@@ -47,32 +61,30 @@ _PV_FIELDS = ("src_ip", "dst_ip", "proto", "sport", "dport", "ttl",
 # a step per frame)
 MAX_FRAMES = 4
 
-
-# a synthetic frame re-injected into the NEXT fabric step (ICMP error
-# path); shape-compatible with the staging loop's ring Frames
+# a synthetic frame re-injected into a following fabric step (ICMP
+# error path); shape-compatible with the staging loop's ring Frames
 _ErrFrame = collections.namedtuple("_ErrFrame", ("cols", "n", "payload"))
 
 
 class ClusterPump:
     def __init__(self, cluster, ring_pairs: List[IORingPair],
                  poll_s: float = 0.0005, snap: Optional[int] = None,
+                 depth: int = 2,
                  icmp_src_ips: Optional[List[int]] = None,
                  ingress_ifs: Optional[List[int]] = None):
-        """``icmp_src_ips``/``ingress_ifs`` (per mesh node: the pod
-        gateway address and the node's host interface) enable ICMP
-        error generation for attributed drops: errors are BUILT from
-        the step's drop_cause + the staged/fabric payload bytes and
-        RE-INJECTED as self-originated ingress into the next fabric
-        step — the pipeline verdict then delivers them to a local pod
-        or back ACROSS THE FABRIC toward a remote sender (VPP's
-        ip4-icmp-error feeding ip4-lookup, mesh edition)."""
+        """``depth``: fabric steps in flight before dispatch
+        backpressures. ``icmp_src_ips``/``ingress_ifs`` (per mesh node:
+        the pod gateway address and the node's host interface) enable
+        the ICMP error path (see module doc)."""
         assert len(ring_pairs) == cluster.n_nodes
         self.cluster = cluster
         self.rings = ring_pairs
         self.poll_s = poll_s
         self.snap = snap or min(r.rx.snap for r in ring_pairs)
+        self.depth = max(1, int(depth))
         self.icmp = None
         self._err_q: List[list] = [[] for _ in range(cluster.n_nodes)]
+        self._err_lock = threading.Lock()
         if icmp_src_ips is not None:
             from vpp_tpu.io.icmp import IcmpErrorGen
 
@@ -83,18 +95,15 @@ class ClusterPump:
             ]
             self.ingress_ifs = list(ingress_ifs)
             self._icmp_scratch = np.zeros((VEC, self.snap), np.uint8)
-        # preallocated staging for the two coalesce buckets: the hot
-        # loop must not allocate/zero multi-MB buffers per step. Only
-        # the flags row needs clearing between steps — a stale VALID
-        # flag would resurrect a previous step's packet, while every
-        # other stale column is inert behind flags=0 (invalid slots
-        # are masked through the whole pipeline).
-        n_nodes = cluster.n_nodes
-        self._stage = {
-            p: (np.zeros((n_nodes, len(_PV_FIELDS), p), np.int32),
-                np.zeros((n_nodes, p, self.snap), np.uint8))
-            for p in (VEC, VEC * MAX_FRAMES)
-        }
+        # staging pool: dispatch cycles depth+2 buffer pairs per bucket
+        # (allocated lazily per bucket) — a buffer is reused only after
+        # its step completed in the writer, so a CPU-backend jnp.asarray
+        # that aliases host memory can never observe a rewrite. Only
+        # the flags row needs clearing between reuses — a stale VALID
+        # flag would resurrect an old packet, while every other stale
+        # column is inert behind flags=0.
+        self._pool_n = self.depth + 2
+        self._stage_pool: dict = {}
         # superset of DataplanePump's keys so the CLI's `show io`
         # renders either pump unchanged (batches == device steps)
         self.stats = {"steps": 0, "frames": 0, "pkts": 0,
@@ -102,12 +111,33 @@ class ClusterPump:
                       "batches": 0, "max_coalesce": 0, "batch_errors": 0}
         self._step_lat = collections.deque(maxlen=2048)
         self._lat_lock = threading.Lock()
+        # frames peeked by dispatch but not yet released by the writer,
+        # per ring (releases shift pending peek indices, so both sides
+        # mutate under the lock — the single-node pump's held protocol)
+        self._held = [0] * cluster.n_nodes
+        self._held_lock = threading.Lock()
+        self._inflight: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        self._seq = 0
         self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
+        self._threads: List[threading.Thread] = []
 
-    # --- lifecycle ---
+    # --- staging ---
+    def _stage_buffers(self, p_cap: int):
+        pool = self._stage_pool.get(p_cap)
+        if pool is None:
+            n = self.cluster.n_nodes
+            pool = [
+                (np.zeros((n, len(_PV_FIELDS), p_cap), np.int32),
+                 np.zeros((n, p_cap, self.snap), np.uint8))
+                for _ in range(self._pool_n)
+            ]
+            self._stage_pool[p_cap] = pool
+        cols, payload = pool[self._seq % self._pool_n]
+        cols[:, _PV_FIELDS.index("flags"), :] = 0
+        return cols, payload
+
     def _pv_from(self, cols: np.ndarray):
-        """[N, 9, VEC] int32 column block -> stacked PacketVector with
+        """[N, 9, P] int32 column block -> stacked PacketVector with
         EXACTLY the array construction the live path uses — warm() must
         produce the same jit signature or the first real frame pays a
         full recompile mid-traffic (minutes on a small host)."""
@@ -127,85 +157,171 @@ class ClusterPump:
         import jax
 
         for p in (VEC, VEC * MAX_FRAMES):
-            cols, payload = self._stage[p]
+            cols, payload = self._stage_buffers(p)
             jax.block_until_ready(
                 self.cluster.step_wire(self._pv_from(cols), payload,
                                        now=0)
             )
 
+    # --- lifecycle ---
     def start(self) -> "ClusterPump":
-        self._thread = threading.Thread(
-            target=self._loop, daemon=True, name="cluster-pump"
-        )
-        self._thread.start()
+        for fn, name in ((self._dispatch_loop, "cluster-pump-dispatch"),
+                         (self._write_loop, "cluster-pump-tx")):
+            t = threading.Thread(target=fn, daemon=True, name=name)
+            t.start()
+            self._threads.append(t)
         return self
 
     def stop(self, join_timeout: Optional[float] = None) -> bool:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=join_timeout)
-            return not self._thread.is_alive()
-        return True
+        try:
+            self._inflight.put_nowait(_SENTINEL)
+        except queue.Full:
+            pass  # writer drains; it checks _stop per item
+        ok = True
+        for t in self._threads:
+            t.join(timeout=join_timeout)
+            ok = ok and not t.is_alive()
+        return ok
 
-    # --- the step loop ---
-    def _loop(self) -> None:
+    # --- dispatch: rings -> device (async) ---
+    def _dispatch_loop(self) -> None:
         while not self._stop.is_set():
             try:
-                if not self._step_once():
+                if not self._dispatch_once():
                     time.sleep(self.poll_s)
             except Exception:
-                log.exception("cluster pump step failed")
+                log.exception("cluster pump dispatch failed")
                 self.stats["batch_errors"] += 1
                 time.sleep(self.poll_s)
 
-    def _step_once(self) -> bool:
-        import jax
-
+    def _dispatch_once(self) -> bool:
         n = self.cluster.n_nodes
         per_node: List[list] = []   # (frame, from_ring) pairs
-        err_taken = [0] * n
-        for i, r in enumerate(self.rings):
-            lst = []
-            # queued ICMP error frames first (self-originated ingress,
-            # produced by a PREVIOUS step's drop attribution). Gathered
-            # WITHOUT popping — like the rx peek/release split, a step
-            # that raises must retry them, not lose them
-            for ef in self._err_q[i][:MAX_FRAMES]:
-                lst.append((ef, False))
-            err_taken[i] = len(lst)
-            for k in range(MAX_FRAMES - len(lst)):
-                f = r.rx.peek_nth(k)
-                if f is None:
-                    break
-                lst.append((f, True))
-            per_node.append(lst)
+        with self._err_lock:
+            err_frames = [
+                self._err_q[i][:MAX_FRAMES] for i in range(n)
+            ]
+            for i in range(n):
+                del self._err_q[i][:len(err_frames[i])]
+        # the whole peek block holds _held_lock: a concurrent writer
+        # release shifts pending peek indices, so a stale held snapshot
+        # would skip one frame and double-take another (silent loss +
+        # duplication) — same protocol as the single-node pump
+        with self._held_lock:
+            for i, r in enumerate(self.rings):
+                lst = [(ef, False) for ef in err_frames[i]]
+                taken = 0
+                for k in range(MAX_FRAMES - len(lst)):
+                    f = r.rx.peek_nth(self._held[i] + k)
+                    if f is None:
+                        break
+                    lst.append((f, True))
+                    taken += 1
+                self._held[i] += taken
+                per_node.append(lst)
         if all(not lst for lst in per_node):
             return False
         t0 = time.perf_counter()
-        depth = max(len(lst) for lst in per_node)
-        p_cap = VEC if depth <= 1 else VEC * MAX_FRAMES
-        cols, payload = self._stage[p_cap]
-        cols[:, _PV_FIELDS.index("flags"), :] = 0
-        offs: List[list] = []  # per node: (packet offset, frame, from_ring)
-        for i, lst in enumerate(per_node):
-            off = 0
-            node_offs = []
-            for f, from_ring in lst:
-                for j, name in enumerate(_PV_FIELDS):
-                    cols[i, j, off:off + f.n] = \
-                        f.cols[name][:f.n].view(np.int32)
-                w = min(self.snap, f.payload.shape[1])
-                payload[i, off:off + f.n, :w] = f.payload[:f.n, :w]
-                if w < self.snap:
-                    # reused staging: a narrower source ring must not
-                    # leave a previous step's bytes in the row tail —
-                    # VALID rows ride the fabric full-width
-                    payload[i, off:off + f.n, w:] = 0
-                node_offs.append((off, f, from_ring))
-                off += f.n
-            offs.append(node_offs)
-        pv = self._pv_from(cols)
-        result, deliv_pay = self.cluster.step_wire(pv, payload)
+        try:
+            depth = max(len(lst) for lst in per_node)
+            p_cap = VEC if depth <= 1 else VEC * MAX_FRAMES
+            cols, payload = self._stage_buffers(p_cap)
+            offs: List[list] = []  # per node: (offset, frame, from_ring)
+            for i, lst in enumerate(per_node):
+                off = 0
+                node_offs = []
+                for f, from_ring in lst:
+                    for j, name in enumerate(_PV_FIELDS):
+                        cols[i, j, off:off + f.n] = \
+                            f.cols[name][:f.n].view(np.int32)
+                    w = min(self.snap, f.payload.shape[1])
+                    payload[i, off:off + f.n, :w] = f.payload[:f.n, :w]
+                    if w < self.snap:
+                        # a narrower source ring must not leave a
+                        # previous step's bytes in the row tail —
+                        # VALID rows ride the fabric full-width
+                        payload[i, off:off + f.n, w:] = 0
+                    node_offs.append((off, f, from_ring))
+                    off += f.n
+                offs.append(node_offs)
+            result, deliv_pay = self.cluster.step_wire(
+                self._pv_from(cols), payload
+            )
+            item = (result, deliv_pay, offs, t0)
+        except Exception:
+            # staging/dispatch failed AFTER taking frames: hand the
+            # writer a failed item so ring releases stay in order and
+            # the error frames are re-queued, not lost
+            log.exception("cluster pump staging/dispatch failed")
+            self.stats["batch_errors"] += 1
+            item = (None, None,
+                    [[(0, f, fr) for f, fr in lst]
+                     for lst in per_node], t0)
+        while True:
+            try:
+                self._inflight.put(item, timeout=0.05)
+                break
+            except queue.Full:
+                if self._stop.is_set():
+                    # shutdown with a wedged writer: the runtime tears
+                    # the rings down wholesale next — abandoning the
+                    # held frames is safe, processing them is not
+                    return True
+        self._seq += 1
+        return True
+
+    # --- writer: device -> rings, in order ---
+    def _write_loop(self) -> None:
+        while True:
+            try:
+                item = self._inflight.get(timeout=0.05)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            if item is _SENTINEL:
+                return
+            try:
+                self._write(*item)
+            except Exception:
+                log.exception("cluster pump write failed")
+                self.stats["batch_errors"] += 1
+                self._release_item(item)
+
+    def _release_frames(self, offs) -> None:
+        """Ordered ring releases + held decrements for one item (the
+        single copy of the held protocol; success and failure paths
+        both end here)."""
+        for i, node_offs in enumerate(offs):
+            with self._held_lock:
+                for _, _, from_ring in node_offs:
+                    if from_ring:
+                        self.rings[i].rx.release()
+                        self._held[i] -= 1
+
+    def _release_item(self, item) -> None:
+        """Failure path: release ring frames in order; error frames
+        (destructively taken at dispatch) are re-queued ONLY when the
+        device step never ran — a step that succeeded already injected
+        them, and re-running would deliver duplicate ICMP errors."""
+        result, _, offs, _ = item
+        if result is None:
+            for i, node_offs in enumerate(offs):
+                requeue = [f for _, f, from_ring in node_offs
+                           if not from_ring]
+                if requeue:
+                    with self._err_lock:
+                        self._err_q[i][:0] = requeue
+        self._release_frames(offs)
+
+    def _write(self, result, deliv_pay, offs, t0) -> None:
+        import jax
+
+        if result is None:  # failed dispatch: ordered cleanup only
+            self._release_item((None, None, offs, t0))
+            return
+        n = self.cluster.n_nodes
         res_local, res_deliv = jax.device_get(
             (result.local, result.delivered)
         )
@@ -242,10 +358,6 @@ class ClusterPump:
                 if self.icmp is not None:
                     self._queue_errors(i, f.cols, f.payload, f.n,
                                        causes[off:off + f.n])
-            for _, _, from_ring in node_offs:
-                if from_ring:
-                    self.rings[i].rx.release()
-            del self._err_q[i][:err_taken[i]]  # consumed successfully
 
         # pass-2 fabric deliveries → destination node's tx ring
         # (payload: the bytes that crossed the fabric)
@@ -270,10 +382,10 @@ class ClusterPump:
                     self.stats["fabric_pkts"] += k
                 else:
                     self.stats["tx_ring_full"] += 1
-        # drop attribution → ICMP errors, re-injected next step. Pass-2
-        # drops matter most here: the invoking packet came from ANOTHER
-        # node, and the re-injected error's pipeline verdict sends it
-        # back ACROSS THE FABRIC to that sender.
+        # drop attribution → ICMP errors, re-injected into a following
+        # step. Pass-2 drops matter most here: the invoking packet came
+        # from ANOTHER node, and the re-injected error's pipeline
+        # verdict sends it back ACROSS THE FABRIC to that sender.
         if self.icmp is not None:
             from vpp_tpu.native.ring import RING_COLUMNS
 
@@ -296,18 +408,21 @@ class ClusterPump:
         self.stats["batches"] += 1
         self.stats["max_coalesce"] = max(
             self.stats["max_coalesce"],
-            sum(len(lst) for lst in per_node),
+            sum(len(node_offs) for node_offs in offs),
         )
+        # ring releases LAST, after every read of the frames' memory:
+        # an exception anywhere above leaves all releases to the
+        # writer loop's _release_item (no double release possible)
+        self._release_frames(offs)
         with self._lat_lock:
             self._step_lat.append(time.perf_counter() - t0)
-        return True
 
     def _queue_errors(self, node: int, cols, payload, n: int,
                       causes: np.ndarray) -> None:
         """Build rate-limited ICMP errors for one frame's attributed
         drops and queue them for re-injection as the node's
-        self-originated ingress in the NEXT fabric step (single pump
-        thread produces and consumes the queue — no locking)."""
+        self-originated ingress in a following fabric step (produced by
+        the writer thread, consumed by dispatch — under _err_lock)."""
         from vpp_tpu.io.icmp import classify_drops
 
         gen = self.icmp[node]
@@ -315,9 +430,10 @@ class ClusterPump:
                                      cols["ttl"], n)
         if not len(idxs):
             return
-        if len(self._err_q[node]) >= MAX_FRAMES:
-            gen.suppressed += len(idxs)
-            return
+        with self._err_lock:
+            if len(self._err_q[node]) >= MAX_FRAMES:
+                gen.suppressed += len(idxs)
+                return
         built = gen.build_frame(
             idxs, types, cols, payload, self._icmp_scratch,
             rx_if=int(self.ingress_ifs[node]),
@@ -325,13 +441,14 @@ class ClusterPump:
         if built is None:
             return
         out_cols, k = built
-        self._err_q[node].append(_ErrFrame(
-            cols=out_cols, n=k, payload=self._icmp_scratch[:k].copy()
-        ))
+        with self._err_lock:
+            self._err_q[node].append(_ErrFrame(
+                cols=out_cols, n=k, payload=self._icmp_scratch[:k].copy()
+            ))
         self.stats["icmp_errors"] = self.stats.get("icmp_errors", 0) + k
 
     def latency_us(self) -> dict:
-        """p50/p99 fabric-step latency (rx peek -> both tx streams
+        """p50/p99 fabric-step latency (staged -> both tx streams
         written) over the recent window — `show io` renders this."""
         with self._lat_lock:
             snap = list(self._step_lat)
