@@ -440,3 +440,31 @@ def test_icmp_error_returns_across_the_fabric():
         assert runtime.cluster_pump.stats.get("icmp_errors", 0) >= 1
     finally:
         runtime.close()
+
+
+def test_cluster_session_aging_reclaims_slots():
+    """Mesh-mode session aging: the cluster-level expire_sessions (the
+    MeshRuntime maintenance loop's call) reclaims idle sessions across
+    the node-stacked tables in bulk."""
+    store, ksr, runtime = boot_mesh()
+    try:
+        a0, a1 = runtime.agents
+        ip_a = add_pod(a0, "c-sa", "sa")
+        ip_b = add_pod(a1, "c-sb", "sb")
+        cross_node_send(runtime, 0, ("default", "sa"), ip_a, ip_b, 443)
+        live = int(np.asarray(runtime.cluster.tables.sess_valid).sum())
+        assert live >= 1
+        # simulate idle time past the timeout, then bulk-reclaim
+        from vpp_tpu.pipeline.dataplane import Dataplane
+
+        runtime.cluster.advance_clock(
+            (runtime.cluster.config.sess_max_age + 10)
+            / Dataplane.TICKS_PER_SEC
+        )
+        expired = runtime.cluster.expire_sessions()
+        assert expired == live
+        assert int(
+            np.asarray(runtime.cluster.tables.sess_valid).sum()
+        ) == 0
+    finally:
+        runtime.close()

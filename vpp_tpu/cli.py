@@ -335,6 +335,13 @@ class DebugCLI:
                 f"), tx-ring-full {s['tx_ring_full']}, "
                 f"errors {s['batch_errors']}"
             )
+            extra = []
+            if s.get("fabric_pkts"):
+                extra.append(f"fabric {s['fabric_pkts']} pkts")
+            if s.get("icmp_errors"):
+                extra.append(f"icmp-errors {s['icmp_errors']}")
+            if extra:
+                lines.append("pump: " + ", ".join(extra))
             lines.append(
                 f"pump batch latency: p50 {lat['p50']:.0f}us "
                 f"p99 {lat['p99']:.0f}us over {lat['n']} batches"

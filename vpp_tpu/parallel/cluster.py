@@ -462,16 +462,59 @@ class ClusterDataplane:
         stacked = jax.tree.map(lambda *a: jnp.stack(a), *vecs)
         return jax.device_put(stacked, self._node_sharding)
 
+    def clock_ticks(self) -> int:
+        """Monotonic wall-clock ticks since this cluster started
+        (Dataplane.clock_ticks analog; TICKS_PER_SEC shared)."""
+        return int(
+            (_time.monotonic() - self._t0) * Dataplane.TICKS_PER_SEC
+        )
+
+    def advance_clock(self, seconds: float) -> None:
+        """Shift the time base forward (tests simulate idle periods
+        without sleeping — the Dataplane.advance_clock analog)."""
+        self._t0 -= seconds
+
+    def expire_sessions(self, max_age: Optional[int] = None) -> int:
+        """Host-driven bulk aging of the node-stacked session tables
+        (reflective + NAT), the Dataplane.expire_sessions analog: the
+        in-kernel timeout already makes expired entries invisible and
+        insert-time eviction reclaims their slots lazily — this frees
+        slots in bulk so occupancy gauges reflect reality. Returns the
+        number of sessions expired across all nodes."""
+        from vpp_tpu.ops.session import session_expire
+
+        if max_age is None:
+            max_age = self.config.sess_max_age
+        with self._lock:
+            if self.tables is None:
+                return 0
+            self._now = max(self._now, self.clock_ticks())
+            now = self._now
+            before = self.tables
+        # dispatch + the blocking count OUTSIDE the lock: this runs on
+        # the maintenance cadence against live traffic, and holding the
+        # lock across a device round trip would stall every concurrent
+        # step dispatch (periodic p99 spikes)
+        after = session_expire(before, now, max_age)
+        expired = int(
+            jnp.sum(before.sess_valid - after.sess_valid)
+            + jnp.sum(before.natsess_valid - after.natsess_valid)
+        )
+        with self._lock:
+            # publish ONLY when something expired (a no-op replacement
+            # would still invalidate the `tables is self.tables` guard
+            # of an in-flight step and discard its session inserts) and
+            # only if no step published newer tables while we computed
+            if expired and before is self.tables:
+                self.tables = after
+        return expired
+
     def step(self, pkts: PacketVector, now: Optional[int] = None) -> ClusterStepResult:
         with self._lock:
             if self.tables is None:
                 self.swap()
             if now is None:
-                ticks = int(
-                    (_time.monotonic() - self._t0)
-                    * Dataplane.TICKS_PER_SEC
-                )
-                self._now = max(self._now, ticks)
+                self._now = max(self._now, self.clock_ticks())
                 now = self._now
             tables, uplinks = self.tables, self._uplinks
             step = self._step_mxu if self._use_mxu else self._step
@@ -491,11 +534,7 @@ class ClusterDataplane:
             if self.tables is None:
                 self.swap()
             if now is None:
-                ticks = int(
-                    (_time.monotonic() - self._t0)
-                    * Dataplane.TICKS_PER_SEC
-                )
-                self._now = max(self._now, ticks)
+                self._now = max(self._now, self.clock_ticks())
                 now = self._now
             step = self._wire_steps.get(self._use_mxu)
             if step is None:

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import threading
 from typing import Dict, List, Optional, Sequence
 
 from vpp_tpu.parallel.cluster import ClusterDataplane, ClusterStepResult
@@ -136,9 +137,32 @@ class MeshRuntime:
             # warm after the agents' first swap published live tables
             self.cluster_pump.warm()
             self.cluster_pump.start()
+        # cluster-level session aging: the agents' own maintenance
+        # loops call their NODE HANDLE's expire_sessions, a no-op when
+        # the cluster owns the live tables — this loop is the mesh
+        # analog (bulk slot reclaim; in-kernel timeouts already hide
+        # expired entries from lookups either way)
+        self._maint_stop = threading.Event()
+
+        def _maint(interval: float = 5.0) -> None:
+            while not self._maint_stop.wait(interval):
+                try:
+                    self.cluster.expire_sessions()
+                except Exception:
+                    log.exception("cluster session expiry failed")
+
+        self._maint_thread = threading.Thread(
+            target=_maint, daemon=True, name="mesh-maintenance"
+        )
+        self._maint_thread.start()
         return self
 
     def close(self) -> None:
+        if getattr(self, "_maint_stop", None) is not None:
+            self._maint_stop.set()
+            # join BEFORE teardown: an expire already in flight must
+            # not race the pump stop / ring close into spurious errors
+            self._maint_thread.join(timeout=30.0)
         pump_stopped = True
         if self.cluster_pump is not None:
             pump_stopped = self.cluster_pump.stop(join_timeout=30.0)

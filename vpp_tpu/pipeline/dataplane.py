@@ -433,11 +433,17 @@ class Dataplane:
             self._now = max(self._now, self.clock_ticks())
             before = self.tables
             after = session_expire(before, self._now, max_age)
-            self.tables = after
-        expired = int(
-            jnp.sum(before.sess_valid - after.sess_valid)
-            + jnp.sum(before.natsess_valid - after.natsess_valid)
-        )
+            expired = int(
+                jnp.sum(before.sess_valid - after.sess_valid)
+                + jnp.sum(before.natsess_valid - after.natsess_valid)
+            )
+            # publish ONLY when something expired: a no-op replacement
+            # would still invalidate the `tables is self.tables` guard
+            # of a concurrently dispatched step and silently discard
+            # that batch's session inserts (the maintenance loop runs
+            # every few seconds against live traffic)
+            if expired:
+                self.tables = after
         return expired
 
     # --- traffic ---
