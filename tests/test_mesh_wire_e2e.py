@@ -215,3 +215,14 @@ class TestMeshWire:
         # destination node's global table), not before it
         assert runtime.cluster_pump.stats["steps"] > 0
         assert runtime.cluster_pump.stats["fabric_pkts"] == fabric_before
+
+    def test_cluster_pump_exported_from_exactly_one_collector(
+            self, mesh_stack):
+        """The shared ClusterPump's counters are cluster-wide: exactly
+        one agent's Prometheus collector may export them, else sum()
+        over the mesh's /stats endpoints overcounts by n_nodes."""
+        runtime = mesh_stack["runtime"]
+        exporters = [a for a in runtime.agents
+                     if a.stats.pump is not None]
+        assert len(exporters) == 1
+        assert exporters[0].stats.pump is runtime.cluster_pump

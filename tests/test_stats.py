@@ -212,3 +212,32 @@ def test_gauge_render_escaping():
     g.set(1, name='we"ird\\pod')
     lines = g.render()
     assert 'x{name="we\\"ird\\\\pod"} 1' in lines
+
+
+def test_pump_counters_exported_over_prometheus():
+    """IO pump counters (single-node or cluster pump — same stats
+    contract) reach the Prometheus text exposition via set_pump()."""
+    from vpp_tpu.pipeline.dataplane import Dataplane
+    from vpp_tpu.pipeline.tables import DataplaneConfig
+    from vpp_tpu.stats.collector import StatsCollector
+
+    class FakePump:
+        stats = {"frames": 7, "pkts": 1792, "batches": 3,
+                 "tx_ring_full": 1, "batch_errors": 0,
+                 "icmp_errors": 2, "fabric_pkts": 512}
+
+        @staticmethod
+        def latency_us():
+            return {"p50": 123.0, "p99": 456.0, "n": 3}
+
+    dp = Dataplane(DataplaneConfig(
+        max_tables=2, max_rules=8, max_global_rules=8, max_ifaces=8,
+        fib_slots=16, sess_slots=64, nat_mappings=2, nat_backends=4))
+    coll = StatsCollector(dp)
+    coll.set_pump(FakePump())
+    coll.publish()
+    text = coll.registry.render("/stats")
+    assert "vpp_tpu_pump_packets 1792" in text
+    assert "vpp_tpu_pump_fabric_packets 512" in text
+    assert "vpp_tpu_pump_icmp_errors 2" in text
+    assert "vpp_tpu_pump_batch_latency_p99_us 456" in text

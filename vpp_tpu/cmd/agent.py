@@ -276,6 +276,13 @@ class ContivAgent:
             # this file to launch the node's vpp-tpu-io daemon, and the
             # MeshRuntime's rings use the same config geometry/shm name
             self._write_io_plan()
+        if self.io_pump is not None and not self._external_io:
+            # export pump counters over Prometheus. In mesh mode
+            # (_external_io) io_pump is the SHARED ClusterPump whose
+            # counters are cluster-wide — exporting it from every
+            # agent would overcount by n_nodes, so the MeshRuntime
+            # attaches it to one designated collector instead.
+            self.stats.set_pump(self.io_pump)
         # resync persisted pods before serving (restart path)
         n = self.cni_server.resync()
         if n:

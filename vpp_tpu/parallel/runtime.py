@@ -121,6 +121,10 @@ class MeshRuntime:
                 agent._external_io = True
                 # the shared fabric pump backs every node's `show io`
                 agent.io_pump = self.cluster_pump
+            # the pump's counters are cluster-wide: export them from
+            # exactly one collector so sum() over the mesh's /stats
+            # endpoints doesn't overcount by n_nodes
+            self.agents[0].stats.set_pump(self.cluster_pump)
 
     @property
     def n_nodes(self) -> int:

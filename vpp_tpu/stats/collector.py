@@ -36,6 +36,31 @@ PER_IF_GAUGES = (
     ("vpp_tpu_if_punt_packets", "packets punted to the host stack"),
 )
 
+# pump.stats key -> (gauge name, help); one source of truth for both
+# gauge registration and the publish() copy loop
+PUMP_STAT_GAUGES = (
+    ("frames", "vpp_tpu_pump_frames", "tx frames written by the IO pump"),
+    ("pkts", "vpp_tpu_pump_packets", "packets moved by the IO pump"),
+    ("batches", "vpp_tpu_pump_batches",
+     "device batches dispatched by the pump"),
+    ("tx_ring_full", "vpp_tpu_pump_tx_ring_full",
+     "tx frames dropped: tx ring full"),
+    ("batch_errors", "vpp_tpu_pump_batch_errors", "pump batches that failed"),
+    ("icmp_errors", "vpp_tpu_pump_icmp_errors",
+     "ICMP error packets generated"),
+    ("fabric_pkts", "vpp_tpu_pump_fabric_packets",
+     "packets delivered across the mesh fabric (cluster pump)"),
+)
+
+PUMP_GAUGES = tuple(
+    (name, help_) for _, name, help_ in PUMP_STAT_GAUGES
+) + (
+    ("vpp_tpu_pump_batch_latency_p50_us",
+     "median dispatch-to-tx batch latency (recent window)"),
+    ("vpp_tpu_pump_batch_latency_p99_us",
+     "p99 dispatch-to-tx batch latency (recent window)"),
+)
+
 NODE_GAUGES = (
     ("vpp_tpu_node_rx_packets", "total valid packets processed"),
     ("vpp_tpu_node_tx_packets", "total packets forwarded"),
@@ -89,11 +114,21 @@ class StatsCollector:
             name: self.registry.register(STATS_PATH, Gauge(name, help_))
             for name, help_ in NODE_GAUGES
         }
+        self.pump = None  # set_pump(): IO pump counters -> gauges
+        self.pump_gauges = {
+            name: self.registry.register(STATS_PATH, Gauge(name, help_))
+            for name, help_ in PUMP_GAUGES
+        }
         self._known_labels: Dict[int, Dict[str, str]] = {}
         self._publish_lock = threading.Lock()
         # zero accumulators when an interface slot is freed, so a later
         # pod reusing the slot doesn't inherit the old pod's counters
         dataplane.on_if_freed.append(self.reset_interface)
+
+    def set_pump(self, pump) -> None:
+        """Attach the IO pump (DataplanePump or the mesh ClusterPump —
+        same stats contract) so publish() exports its counters."""
+        self.pump = pump
 
     def reset_interface(self, if_idx: int) -> None:
         with self._lock:
@@ -193,6 +228,16 @@ class StatsCollector:
             self.node_gauges["vpp_tpu_node_sessions_active"].set(
                 int(np.asarray(self.dp.tables.sess_valid).sum())
             )
+        pump = self.pump
+        if pump is not None:
+            ps = pump.stats
+            for stat_key, gauge_name, _ in PUMP_STAT_GAUGES:
+                self.pump_gauges[gauge_name].set(int(ps.get(stat_key, 0)))
+            lat = pump.latency_us()
+            self.pump_gauges["vpp_tpu_pump_batch_latency_p50_us"].set(
+                lat["p50"])
+            self.pump_gauges["vpp_tpu_pump_batch_latency_p99_us"].set(
+                lat["p99"])
 
 
 def register_ksr_gauges(
