@@ -218,6 +218,25 @@ class TestMacTable:
         t.put(0x0A010102, b"\x02\x00\x00\x00\x00\x09")  # refresh
         assert t.get(0x0A010102) == b"\x02\x00\x00\x00\x00\x09"
 
+    def test_unpin_releases_static_slot_to_eviction(self):
+        """Unwiring an interface unpins its static entry: the entry
+        stays resolvable (insert-only table, no tombstones) but loses
+        its eviction immunity, so later pressure can reclaim the slot —
+        it no longer counts against the pin budget forever."""
+        from vpp_tpu.native.pktio import MacTable
+
+        t = MacTable(capacity=64)
+        ip = 0x0A010155
+        t.put(ip, b"\x02\x00\x00\x00\x00\x05", pin=True)
+        assert t.unpin(ip) is True
+        assert t.unpin(0x0A010199) is False  # absent ip: not found
+        # still resolvable after the unpin...
+        assert t.get(ip) == b"\x02\x00\x00\x00\x00\x05"
+        # ...but no longer pinned: an UNPINNED put into the same probe
+        # run may now take the slot (before the unpin it could not)
+        entries = {e[0]: e[2] for e in t.entries()}
+        assert entries[ip] is False
+
     def test_pinned_static_entry_survives_learn_pressure(self):
         """A static (control-plane) entry for a silent pod must survive
         arbitrary learning churn — eviction may only take unpinned

@@ -68,6 +68,8 @@ def _cleanup():
     for cid in (CID_A, CID_B):
         subprocess.run(["ip", "link", "del", host_ifname(cid)],
                        capture_output=True)
+    subprocess.run(["ip", "link", "del", "vpptpu-host"],
+                   capture_output=True)
 
 
 @pytest.fixture()
@@ -80,6 +82,7 @@ def stack(tmp_path):
 
     dp = Dataplane(DataplaneConfig())
     uplink = dp.add_uplink()
+    host_if = dp.add_host_interface()
     # no NetworkPolicy installed yet -> default allow (the classifier
     # fails closed with an empty global table)
     dp.builder.set_global_table(
@@ -89,7 +92,7 @@ def stack(tmp_path):
     dp.process_packed(packed_input_zeros(256))  # pre-compile
 
     rings = IORingPair(n_slots=32)
-    daemon = IODaemon(rings, {}, uplink_if=uplink).start()
+    daemon = IODaemon(rings, {}, uplink_if=uplink, host_if=host_if).start()
     ctl_sock = str(tmp_path / "io-ctl.sock")
     control = IOControlServer(daemon, ctl_sock).start()
     ipam = IPAM(node_id=1)
@@ -101,7 +104,7 @@ def stack(tmp_path):
     server.set_ready()
     try:
         yield {"dp": dp, "server": server, "daemon": daemon,
-               "ipam": ipam}
+               "ipam": ipam, "ctl_sock": ctl_sock, "host_if": host_if}
     finally:
         pump.stop()
         control.close()
@@ -321,10 +324,10 @@ def _ping(ns: str, dst: str, count: int = 5, timeout: float = 2.0):
         "    time.sleep(0.1)\n"
         f"print(str({count}) + '|' + str(got), flush=True)\n"
     )
-    return subprocess.run(
-        ["ip", "netns", "exec", ns, sys.executable, "-c", code],
-        capture_output=True, text=True, timeout=90,
-    )
+    argv = [sys.executable, "-c", code]
+    if ns is not None:
+        argv = ["ip", "netns", "exec", ns] + argv
+    return subprocess.run(argv, capture_output=True, text=True, timeout=90)
 
 
 class TestPingAndTCP:
@@ -429,3 +432,59 @@ class TestPingAndTCP:
         finally:
             srv.kill()
             srv.wait(timeout=10)
+
+
+class TestHostInterconnect:
+    """Host↔pod connectivity through the VPP↔host interconnect veth
+    (reference: interconnectVethHost/interconnectVethVpp + host routes,
+    host.go:105-200 & :44-86; robot Host_To_Nginx_Ping /
+    Get_Web_Page_From_Host analogs)."""
+
+    def test_host_pings_pod_through_dataplane(self, stack):
+        from vpp_tpu.cni.wiring import HostInterconnectWirer
+        from vpp_tpu.io.control import IOControlClient
+        from vpp_tpu.pipeline.vector import Disposition
+
+        server, dp, ipam = stack["server"], stack["dp"], stack["ipam"]
+        ip_b = _add_pod(server, CID_B, NS_B, "pod-b")
+        # the agent stages this route in __init__ (routesToHost analog);
+        # this hand-built stack stages it here
+        with dp.commit_lock:
+            dp.builder.add_route(str(ipam.vpp_host_network),
+                                 stack["host_if"], Disposition.HOST)
+            dp.swap()
+
+        wirer = HostInterconnectWirer(
+            IOControlClient(stack["ctl_sock"]), ipam)
+        wirer.wire(stack["host_if"])
+        try:
+            # kernel artifacts: host end carries the IPAM address +
+            # routes for the pod and service subnets via the vswitch
+            out = subprocess.run(
+                ["ip", "-o", "addr", "show", "vpptpu-host"],
+                capture_output=True, text=True).stdout
+            assert str(ipam.veth_host_end_ip()) in out
+            routes = subprocess.run(
+                ["ip", "route", "show"],
+                capture_output=True, text=True).stdout
+            assert str(ipam.pod_subnet) in routes
+            assert str(ipam.service_network) in routes
+
+            _ping(None, ip_b, count=2)  # warm the path
+            res = _ping(None, ip_b, count=5)
+            assert res.returncode == 0, res.stderr
+            sent, got = res.stdout.strip().split("|")
+            assert (sent, got) == ("5", "5"), \
+                f"host->pod loss: {got}/{sent} ({res.stderr})"
+
+            # pod reaches the host stack back through the same path
+            res2 = _ping(NS_B, str(ipam.veth_host_end_ip()), count=3)
+            assert res2.returncode == 0, res2.stderr
+            s2, g2 = res2.stdout.strip().split("|")
+            assert (s2, g2) == ("3", "3"), \
+                f"pod->host loss: {g2}/{s2} ({res2.stderr})"
+        finally:
+            wirer.unwire(stack["host_if"])
+        assert subprocess.run(
+            ["ip", "link", "show", "vpptpu-host"],
+            capture_output=True).returncode != 0

@@ -138,6 +138,17 @@ class ContivAgent:
         self.dataplane.builder.add_route(
             str(self.ipam.vpp_host_subnet), -1, Disposition.DROP
         )
+        if c.io.host_interconnect and c.io.control_socket:
+            # this node's own host-interconnect /24 punts to the host
+            # stack (longest prefix wins over the supernet drop above)
+            # — the routesToHost analog (host.go:92-110). Gated on the
+            # interconnect actually being wired: without a host
+            # transport these flows must stay attributed FIB drops, not
+            # phantom punts that die in tx dispatch
+            self.dataplane.builder.add_route(
+                str(self.ipam.vpp_host_network), self.host_if,
+                Disposition.HOST
+            )
         self.dataplane.builder.set_snat_ip(
             ip4(str(self.ipam.node_ip_address()))
         )
@@ -182,6 +193,15 @@ class ContivAgent:
             self.io_ctl = IOControlClient(c.io.control_socket)
             wirer = VethPodWirer(
                 self.io_ctl, gateway_ip=str(self.ipam.pod_gateway_ip())
+            )
+        # VPP↔host interconnect (host.go:105-200): wired in start()
+        # once the IO daemon serves the control socket
+        self.host_interconnect = None
+        if c.io.host_interconnect and self.io_ctl is not None:
+            from vpp_tpu.cni.wiring import HostInterconnectWirer
+
+            self.host_interconnect = HostInterconnectWirer(
+                self.io_ctl, self.ipam
             )
         self.cni_server = RemoteCNIServer(
             self.dataplane, self.ipam, self.container_index,
@@ -283,6 +303,24 @@ class ContivAgent:
             # agent would overcount by n_nodes, so the MeshRuntime
             # attaches it to one designated collector instead.
             self.stats.set_pump(self.io_pump)
+        if self.host_interconnect is not None:
+            # vpp-tpu-init only STARTS the IO daemon after it sees the
+            # plan file written above, so on a cold boot the control
+            # socket appears a moment later — wait for it instead of
+            # losing the race (CNI pod wiring never hits this because
+            # Adds arrive only once the daemon is up)
+            deadline = time.monotonic() + 60.0
+            while True:
+                try:
+                    self.host_interconnect.wire(self.host_if)
+                    break
+                except (OSError, RuntimeError):
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(0.5)
+            log.info("host interconnect wired (%s <-> %s)",
+                     self.host_interconnect.host_end,
+                     self.host_interconnect.vsw_end)
         # resync persisted pods before serving (restart path)
         n = self.cni_server.resync()
         if n:
@@ -483,6 +521,11 @@ class ContivAgent:
                 # buffers under it would be a use-after-free into shared
                 # memory. Leak the mapping (process exit reclaims it).
                 log.error("pump did not stop; leaving rings mapped")
+        if self.host_interconnect is not None:
+            try:
+                self.host_interconnect.unwire(self.host_if)
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                log.warning("host interconnect unwire failed")
         if self.stn is not None:
             self.stn.revert_all()
         if self.store.persist_path:

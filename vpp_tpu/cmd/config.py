@@ -46,6 +46,10 @@ class IOConfig:
     # generate ICMP time-exceeded / net-unreachable for attributed
     # drops (VPP ip4-icmp-error analog; traceroute shows the vswitch hop)
     icmp_errors: bool = True
+    # wire the VPP↔host-stack interconnect veth on start (requires
+    # control_socket; reference host.go:105-200): the node's own Linux
+    # stack reaches pod/service IPs through the data plane
+    host_interconnect: bool = False
     # handshake file the agent writes once rings exist so vpp-tpu-init
     # can start the IO daemon with matching geometry ("" = don't write)
     plan_path: str = ""

@@ -160,6 +160,7 @@ class RemoteCNIServer:
                                 container_id=stale.container_id,
                                 netns=stale.netns,
                                 if_index=stale.if_index,
+                                pod_ip=stale.ip,
                             )
                     ip = self.ipam.next_pod_ip(pod_id)
                     if_idx = self.dp.add_pod_interface(pod)
@@ -231,7 +232,7 @@ class RemoteCNIServer:
             if self.wirer is not None:
                 self.wirer.unwire(
                     container_id=cfg.container_id, netns=cfg.netns,
-                    if_index=cfg.if_index,
+                    if_index=cfg.if_index, pod_ip=cfg.ip,
                 )
         self._notify()
         return CNIReply(result=ResultCode.OK)

@@ -13,6 +13,8 @@ binary API (plugins/contiv/remote_cni_server.go:895-1250):
                                  the reference's configured static ARPs
                                  (pod.go:375-452), replacing broadcast-
                                  flood fallback for known pods
+  del_mac  {ip}                  unpin a static entry (interface gone):
+                                 it becomes evictable like a learned one
   stats    {}                    daemon counters
   list     {}                    current interface table
   neighbors {}                   (ip → MAC) table dump (show ip arp)
@@ -65,6 +67,9 @@ class IOControlServer:
                 # entry was evicted (it lost its no-flood guarantee) —
                 # the agent decides whether to re-install that pod's ARP
                 return {"result": 0, "displaced": bool(displaced)}
+            if method == "del_mac":
+                found = self.daemon.del_static_mac(int(params["ip"]))
+                return {"result": 0, "found": bool(found)}
             if method == "stats":
                 return {"result": 0, "stats": dict(self.daemon.stats)}
             if method == "neighbors":
@@ -117,6 +122,11 @@ class IOControlClient:
         that pod lost its no-flood guarantee."""
         reply = self._call("set_mac", {"ip": ip, "mac": mac.hex()})
         return bool(reply.get("displaced"))
+
+    def del_mac(self, ip: int) -> bool:
+        """Unpin a static neighbor entry (interface unwired). True if
+        an entry for ip existed."""
+        return bool(self._call("del_mac", {"ip": ip})["found"])
 
     def stats(self) -> dict:
         return self._call("stats")["stats"]

@@ -107,6 +107,14 @@ class IODaemon:
             )
         return rc == 2
 
+    def del_static_mac(self, ip: int) -> bool:
+        """Unpin a static entry when its interface is unwired (CNI
+        Delete / interconnect teardown). The entry becomes an ordinary
+        learned entry — evictable, refreshable — instead of occupying
+        pin-limited neighbor-table space for a dead interface. True if
+        an entry existed."""
+        return self.mac.unpin(int(ip))
+
     # --- lifecycle ---
     def start(self) -> "IODaemon":
         for fn, name in ((self._rx_loop, "io-rx"), (self._tx_loop, "io-tx")):

@@ -647,6 +647,40 @@ int32_t pio_mac_get(const uint32_t* ips, const uint8_t* macs,
   return 0;
 }
 
+// Unpin a static entry when its interface is unwired. The table is
+// insert-only (probe chains rely on seq==0 terminators, no
+// tombstones), so "delete" means dropping the pin: the entry becomes
+// an ordinary learned entry — evictable under probe pressure and
+// refreshable by rx learning — instead of permanently occupying
+// pin-limited space for an interface that no longer exists. Returns 1
+// if an entry for ip was found, else 0.
+int32_t pio_mac_unpin(uint32_t* ips, uint8_t* pin, uint32_t* seq,
+                      uint32_t cap, uint32_t ip) {
+  uint32_t mask = cap - 1;
+  uint32_t h = mac_hash(ip) & mask;
+  for (uint32_t attempt = 0; attempt < 64; attempt++) {
+    for (uint32_t probe = 0; probe < kMacProbe; probe++) {
+      uint32_t s = (h + probe) & mask;
+      uint32_t sq = __atomic_load_n(&seq[s], __ATOMIC_ACQUIRE);
+      if (sq == 0) return 0;            // chain end: not present
+      if (sq & 1) goto retry;           // mid-write: restart the probe
+      if (__atomic_load_n(&ips[s], __ATOMIC_ACQUIRE) != ip) continue;
+      // claim like a writer so a concurrent put can't re-pin under us
+      if (!__atomic_compare_exchange_n(&seq[s], &sq, sq + 1, false,
+                                       __ATOMIC_ACQ_REL,
+                                       __ATOMIC_ACQUIRE)) {
+        goto retry;
+      }
+      if (__atomic_load_n(&ips[s], __ATOMIC_ACQUIRE) == ip) pin[s] = 0;
+      __atomic_store_n(&seq[s], sq + 2, __ATOMIC_RELEASE);
+      return 1;
+    }
+    return 0;                            // probed the whole run
+  retry:;
+  }
+  return 0;  // pathological contention
+}
+
 // Learn (src_ip -> source MAC) for every valid IPv4 packet of a parsed
 // frame in one pass — replaces a per-packet Python loop that capped
 // the rx path at ~1 Mpps. flags/src are the frame's column arrays.
@@ -794,9 +828,15 @@ void pio_tx_dispatch(const int32_t* cols, uint8_t* payload, uint32_t snap,
         continue;
       }
       target = uplink_if;
-    } else if (d == 3) {  // HOST punt: original Ethernet kept intact
+    } else if (d == 3) {  // HOST
+      // Raw punts (non-IPv4, bypassed the pipeline) keep the original
+      // Ethernet intact — STN semantics. Pipeline-ROUTED host traffic
+      // (a FIB route with HOST disposition: the VPP↔host interconnect,
+      // host.go:92-110) is a routed hop: it must be re-addressed to the
+      // host stack's MAC or the kernel on the interconnect veth drops
+      // the frame as not-for-me.
       target = host_if;
-      set_mac = false;
+      set_mac = !(f & kFlagNonIp4);
     } else {
       counters[1]++;
       continue;

@@ -123,6 +123,11 @@ def _load() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_uint32, ctypes.c_uint32, ctypes.c_void_p,
         ]
+        lib.pio_mac_unpin.restype = ctypes.c_int32
+        lib.pio_mac_unpin.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_uint32, ctypes.c_uint32,
+        ]
         lib.pio_mac_learn.restype = None
         lib.pio_mac_learn.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
@@ -191,6 +196,19 @@ class MacTable:
             self.capacity, ip & 0xFFFFFFFF,
             (ctypes.c_char * 6).from_buffer_copy(mac),
             1 if pin else 0,
+        ))
+
+    def unpin(self, ip: int) -> bool:
+        """Drop an entry's static pin when its interface is unwired.
+        The table is insert-only (no tombstones), so the entry stays
+        resolvable but becomes evictable/refreshable like any learned
+        entry instead of holding pin-limited space forever. True if an
+        entry for ``ip`` existed."""
+        return bool(self._lib.pio_mac_unpin(
+            self.ips.ctypes.data_as(ctypes.c_void_p),
+            self.pin.ctypes.data_as(ctypes.c_void_p),
+            self.seq.ctypes.data_as(ctypes.c_void_p),
+            self.capacity, ip & 0xFFFFFFFF,
         ))
 
     def get(self, ip: int) -> Optional[bytes]:

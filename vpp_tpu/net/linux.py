@@ -58,6 +58,18 @@ def delete_link(name: str, netns: Optional[str] = None) -> bool:
                   check=False).returncode == 0
 
 
+def disable_offload(name: str, netns: Optional[str] = None) -> None:
+    """Disable tx/rx checksum offload on a veth end. Over veth the
+    kernel leaves TCP/UDP checksums partial (CHECKSUM_PARTIAL) since no
+    physical NIC ever fills them in; a userspace data plane forwarding
+    raw frames would deliver garbage checksums that the receiving stack
+    drops. Best-effort (ethtool may be absent in minimal images)."""
+    argv = ["ethtool", "-K", name, "tx", "off", "rx", "off"]
+    if netns:
+        argv = ["ip", "netns", "exec", netns] + argv
+    subprocess.run(argv, capture_output=True, timeout=30)
+
+
 def get_mac(name: str, netns: Optional[str] = None) -> bytes:
     out = ip_cmd("-o", "link", "show", name, netns=netns).stdout
     # "N: name: ... link/ether aa:bb:cc:dd:ee:ff brd ..."
@@ -136,15 +148,7 @@ def setup_pod_interface(netns_name: str, ifname: str, new_name: str,
            "onlink", netns=netns_name)
     ip_cmd("neigh", "replace", gw_ip, "lladdr", gw_mac_s, "dev", new_name,
            "nud", "permanent", netns=netns_name)
-    # Disable checksum offload on the container side: over veth the
-    # kernel leaves TCP/UDP checksums partial (CHECKSUM_PARTIAL) since
-    # no physical NIC ever fills them in; a userspace data plane
-    # forwarding raw frames would deliver garbage checksums that the
-    # receiving pod's stack then drops. The reference's VPP negotiates
-    # offload on its TAP/af_packet interfaces instead.
-    subprocess.run(
-        ["ip", "netns", "exec", netns_name, "ethtool", "-K", new_name,
-         "tx", "off", "rx", "off"],
-        capture_output=True, timeout=30,
-    )
+    # The reference's VPP negotiates checksum offload on its TAP /
+    # af_packet interfaces instead; a userspace plane must turn it off.
+    disable_offload(new_name, netns=netns_name)
     return get_mac(new_name, netns=netns_name)
