@@ -38,24 +38,52 @@ def _emit_error(exc: BaseException) -> None:
     )
 
 
-def _subprocess_probe(timeout_s: float = 60.0) -> bool:
+def _subprocess_probe(timeout_s: float = 90.0) -> bool:
     """Probe TPU backend health in a THROWAWAY subprocess first.
 
     A wedged axon tunnel (a SIGTERM'd process mid-claim) makes backend
     init HANG rather than fail — in-process that would hang this whole
     bench and the driver would record nothing. A subprocess can be
-    killed safely (it holds no grant yet)."""
+    killed safely (it holds no grant yet).
+
+    The probe EXECUTES a matmul, not just jax.devices(): a half-wedged
+    tunnel has been observed (2026-07-31) to answer device enumeration
+    from cache and then hang the first real compile/execute RPC."""
     import subprocess
 
     try:
         proc = subprocess.run(
             [sys.executable, "-c",
-             "import jax; jax.devices(); print('ok')"],
+             "import jax, jax.numpy as jnp; jax.devices(); "
+             "(jnp.ones((64,64)) @ jnp.ones((64,64))).block_until_ready(); "
+             "print('ok-exec')"],
             capture_output=True, timeout=timeout_s, text=True,
         )
-        return proc.returncode == 0 and "ok" in proc.stdout
+        return proc.returncode == 0 and "ok-exec" in proc.stdout
     except (subprocess.TimeoutExpired, OSError):
         return False
+
+
+# --- section checkpointing -------------------------------------------
+# The tunnel wedges MID-RUN without warning (r3: a degraded tunnel
+# zeroed the io_* section; 2026-07-31: a wedge 20 min in lost the whole
+# run). Each completed section is flushed to a sidecar JSON so a
+# watchdog-killed run still yields every number it finished.
+_PROGRESS_PATH: str | None = os.environ.get("BENCH_PROGRESS_OUT") or None
+_PROGRESS_STATE: dict = {}
+
+
+def _progress(**kv) -> None:
+    if not _PROGRESS_PATH:
+        return
+    _PROGRESS_STATE.update(kv)
+    tmp = f"{_PROGRESS_PATH}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(_PROGRESS_STATE, f, indent=1, default=str)
+        os.replace(tmp, _PROGRESS_PATH)
+    except OSError:
+        pass
 
 
 def _probe_backend(retries: int, delay: float):
@@ -347,6 +375,7 @@ def sub_benches(args):
         step, dp.tables, build_pod_traffic(args.packets), args.iters, args.warmup
     )
     out["pod_to_pod_fwd_mpps"] = round(mpps, 1)
+    _progress(pod_to_pod_fwd_mpps=out["pod_to_pod_fwd_mpps"])
 
     # #3 NAT44 100-backend LB: all traffic through the VIP
     dp, uplink = build_dataplane(16, args.backends)
@@ -357,6 +386,7 @@ def sub_benches(args):
     )
     mpps, _ = measure_mpps(step, dp.tables, pkts, args.iters, args.warmup)
     out["nat44_vip_lb_mpps"] = round(mpps, 1)
+    _progress(nat44_vip_lb_mpps=out["nat44_vip_lb_mpps"])
 
     # #4 VXLAN overlay: remote-disposed traffic + encap kernel
     from vpp_tpu.ops.vxlan import vxlan_encap
@@ -396,6 +426,7 @@ def sub_benches(args):
     jax.block_until_ready(outer)
     mpps = n * args.iters / (time.perf_counter() - t0) / 1e6
     out["vxlan_overlay_encap_mpps"] = round(mpps, 1)
+    _progress(vxlan_overlay_encap_mpps=out["vxlan_overlay_encap_mpps"])
 
     # IO front-end: wire bytes -> native parse -> ring -> pipelined pump
     # (coalesced packed device batches, K in flight) -> ring -> native
@@ -1009,7 +1040,14 @@ def _run():
     ap.add_argument("--retries", type=int, default=12,
                     help="TPU backend init attempts before CPU fallback")
     ap.add_argument("--retry-delay", type=float, default=15.0)
+    ap.add_argument("--progress-out", default=None,
+                    help="sidecar JSON checkpointing each completed "
+                         "section (survives a mid-run tunnel wedge)")
     args = ap.parse_args()
+
+    global _PROGRESS_PATH
+    if args.progress_out:
+        _PROGRESS_PATH = args.progress_out
 
     if args.cpu:
         import jax
@@ -1059,6 +1097,10 @@ def _run():
         args.iters = 10 if shrink else 50
         cpu_fallback = cpu_fallback or shrink
 
+    _progress(backend=jax.default_backend(), host_cores=os.cpu_count(),
+              started_at=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+              load_at_start=os.getloadavg()[0])
+
     dp, uplink = build_dataplane(args.rules, args.backends)
     step_fn = pipeline_step_mxu if dp._use_mxu else pipeline_step
     step = jax.jit(step_fn, donate_argnums=(0,))
@@ -1078,6 +1120,8 @@ def _run():
     jax.block_until_ready(res)
     dt = time.perf_counter() - t0
     mpps = args.packets * args.iters / dt / 1e6
+    _progress(headline_mpps=round(mpps, 3), rules=args.rules,
+              packets_per_step=args.packets, iters=args.iters)
 
     # --- added latency: single small-frame step, p50/p99 ---
     frame = build_traffic(args.latency_frame, uplink, seed=11)
@@ -1093,6 +1137,8 @@ def _run():
         lat.append(time.perf_counter() - t0)
         tables = out.tables
     lat_us = np.array(lat) * 1e6
+    _progress(frame_latency_p50_us=round(float(np.percentile(lat_us, 50)), 1),
+              frame_latency_p99_us=round(float(np.percentile(lat_us, 99)), 1))
 
     # steady-state (pipelined) per-frame latency: dispatch K frames
     # back-to-back without host sync — the per-frame cost once dispatch
@@ -1104,6 +1150,7 @@ def _run():
         tables = out.tables
     jax.block_until_ready(out.disp)
     pipelined_us = (time.perf_counter() - t0) / K * 1e6
+    _progress(frame_latency_pipelined_us=round(pipelined_us, 1))
 
     # chained quantum (VERDICT r3 Next #4 lever): K packed frames run
     # inside ONE device program (lax.scan) with ONE dispatch + ONE
@@ -1134,6 +1181,7 @@ def _run():
         )
         chain_lat.append((time.perf_counter() - t0) / KC * 1e6)
     chained_us = float(np.percentile(np.array(chain_lat), 50))
+    _progress(frame_latency_chained_us=round(chained_us, 1))
 
     # per-stage `show run` snapshot (trace/cycles.py) in the official
     # output: attributes headline movements between rounds to a stage
@@ -1148,18 +1196,23 @@ def _run():
             stage_ns[t.node] = round(t.ns_per_packet, 1)
     except Exception as e:  # noqa: BLE001 — diagnostics must not kill
         stage_ns["error"] = f"{type(e).__name__}: {e}"
+    _progress(stage_ns_per_pkt=stage_ns)
 
     subs = {} if args.no_subbench else sub_benches(args)
+    _progress(**subs)
     if not args.no_subbench:
         try:
             subs.update(io_daemon_bench(args))
         except Exception as e:  # noqa: BLE001 — optional, env-dependent
             subs["io_daemon_bench_error"] = f"{type(e).__name__}: {e}"
+        _progress(**subs)
         try:
             subs.update(hoststack_bench(args))
         except Exception as e:  # noqa: BLE001 — optional, env-dependent
             subs["hoststack_bench_error"] = f"{type(e).__name__}: {e}"
+        _progress(**subs)
     subs.update(commit_bench(args))
+    _progress(**subs, completed=True)
     # the honest experienced figure: ring-to-ring wire-path latency at
     # a paced (non-saturating) offered load, NOT pipelined-throughput/N
     # (VERDICT r2 Weak #2); the wire bench fills it in when it ran

@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""TPU recovery watcher: capture a full bench when the axon tunnel is up.
+
+The tunnel comes and goes (down ~19 h on 2026-07-30; a brief window on
+2026-07-31 03:46 closed again within ~25 min, wedging a bench mid-run).
+This watcher loops forever:
+
+  1. probe the tunnel in a throwaway subprocess (tiny matmul EXECUTED,
+     not just jax.devices() — a half-wedged tunnel answers enumeration
+     and then hangs the first real RPC)
+  2. on success, export a git-archive snapshot of the repo's committed
+     HEAD (ADVICE r3 item 5: captures must be reproducible from a
+     commit, not a drifting working tree) and run bench.py there with
+     --progress-out so every finished section survives a mid-run wedge
+  3. a watchdog kills the bench if it exceeds its deadline (a wedged
+     RPC blocks forever otherwise); whatever the sidecar holds is kept
+  4. a COMPLETE run writes BENCH_r04_manual_tpu.json (+ git commit);
+     a partial run writes/refreshes BENCH_r04_partial_tpu.json iff it
+     got further than any earlier attempt
+
+Run detached:  nohup python tools/tpu_watch.py >/tmp/tpu_watch_r04.log 2>&1 &
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROBE_TIMEOUT_S = 90
+# a killed-mid-claim probe is itself the wedge trigger (the grant needs
+# ~3-10 min unpoked to recover) — probe sparsely enough to let it heal
+PROBE_INTERVAL_S = 300
+BENCH_DEADLINE_S = 2700  # 45 min; a healthy-tunnel full run fits easily
+COMPLETE_OUT = os.path.join(REPO, "BENCH_r04_manual_tpu.json")
+PARTIAL_OUT = os.path.join(REPO, "BENCH_r04_partial_tpu.json")
+
+
+def log(msg: str) -> None:
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def probe() -> bool:
+    # ONE probe definition for watcher and bench: bench.py's
+    # _subprocess_probe (matmul executed in a throwaway process)
+    sys.path.insert(0, REPO)
+    from bench import _subprocess_probe
+
+    return _subprocess_probe(PROBE_TIMEOUT_S)
+
+
+def head_commit() -> str:
+    return subprocess.run(
+        ["git", "-C", REPO, "rev-parse", "HEAD"],
+        capture_output=True, text=True, check=True,
+    ).stdout.strip()
+
+
+def snapshot_head(dst: str) -> None:
+    ar = subprocess.Popen(["git", "-C", REPO, "archive", "HEAD"],
+                          stdout=subprocess.PIPE)
+    subprocess.run(["tar", "-x", "-C", dst], stdin=ar.stdout, check=True)
+    ar.wait()
+    if ar.returncode:
+        raise RuntimeError(f"git archive rc={ar.returncode}")
+
+
+def run_capture() -> None:
+    commit = head_commit()
+    tmp = tempfile.mkdtemp(prefix="bench_snap_")
+    sidecar = os.path.join(tmp, "progress.json")
+    try:
+        snapshot_head(tmp)
+        log(f"tunnel up — benching snapshot of {commit[:10]} in {tmp}")
+        t0 = time.time()
+        try:
+            proc = subprocess.run(
+                [sys.executable, "bench.py", "--progress-out", sidecar],
+                cwd=tmp, capture_output=True, text=True,
+                timeout=BENCH_DEADLINE_S,
+            )
+            out_lines = [l for l in proc.stdout.splitlines() if l.strip()]
+            timed_out = False
+        except subprocess.TimeoutExpired as e:
+            out_lines = []
+            timed_out = True
+            log(f"bench hit {BENCH_DEADLINE_S}s deadline — killed "
+                f"(stderr tail: {str(e.stderr)[-200:] if e.stderr else ''})")
+        wall = round(time.time() - t0, 1)
+
+        result = None
+        if out_lines:
+            try:
+                result = json.loads(out_lines[-1])
+            except json.JSONDecodeError:
+                log(f"unparseable bench stdout tail: {out_lines[-1][:200]}")
+        if result and "error" not in result and \
+                result.get("details", {}).get("backend") == "tpu":
+            result["note"] = (
+                f"Full-bench TPU capture by tools/tpu_watch.py from a "
+                f"git-archive snapshot of commit {commit} (no working-tree "
+                f"drift), {time.strftime('%Y-%m-%d %H:%M UTC', time.gmtime())}, "
+                f"wall {wall}s, load_at_start in details. Re-run: git "
+                f"archive + python bench.py at that commit.")
+            with open(COMPLETE_OUT, "w") as f:
+                json.dump(result, f, indent=1)
+            subprocess.run(["git", "-C", REPO, "add", COMPLETE_OUT])
+            subprocess.run(["git", "-C", REPO, "commit", "-m",
+                            "Round-4 real-TPU bench capture (watcher, "
+                            f"snapshot of {commit[:10]})",
+                            "--", COMPLETE_OUT])
+            log(f"COMPLETE capture committed ({wall}s)")
+            return
+        # partial: keep the furthest sidecar seen so far
+        part = {}
+        if os.path.exists(sidecar):
+            try:
+                part = json.load(open(sidecar))
+            except json.JSONDecodeError:
+                part = {}
+        if result and "error" in result:
+            log(f"bench errored: {result['error'][:200]}")
+        if part.get("backend") != "tpu":
+            log(f"no TPU partial to keep (backend={part.get('backend')}, "
+                f"timed_out={timed_out})")
+            return
+        prev_keys = -1
+        if os.path.exists(PARTIAL_OUT):
+            try:
+                prev_keys = len(json.load(open(PARTIAL_OUT)))
+            except (json.JSONDecodeError, OSError):
+                pass
+        if len(part) + 2 > prev_keys:
+            part["note"] = (
+                f"PARTIAL TPU capture (tunnel wedged mid-run, watchdog "
+                f"kill at {wall}s): every key here completed on backend="
+                f"tpu before the wedge. Snapshot of commit {commit}, "
+                f"{time.strftime('%Y-%m-%d %H:%M UTC', time.gmtime())}.")
+            part["commit"] = commit
+            with open(PARTIAL_OUT, "w") as f:
+                json.dump(part, f, indent=1)
+            subprocess.run(["git", "-C", REPO, "add", PARTIAL_OUT])
+            subprocess.run(["git", "-C", REPO, "commit", "-m",
+                            "Partial TPU bench sections salvaged by the "
+                            "recovery watcher", "--", PARTIAL_OUT])
+            log(f"partial capture kept ({len(part)} keys, {wall}s)")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main() -> None:
+    log(f"watcher up (pid {os.getpid()}), repo {REPO}")
+    while True:
+        if os.path.exists(COMPLETE_OUT):
+            log("complete capture exists — watcher done")
+            return
+        if probe():
+            try:
+                run_capture()
+            except Exception as e:  # noqa: BLE001 — keep watching
+                log(f"capture attempt failed: {type(e).__name__}: {e}")
+        # sleep on EVERY iteration: a failed capture attempt (snapshot
+        # error, CPU fallback) must not spin probe->capture->probe and
+        # keep poking a grant that needs minutes unpoked to heal
+        time.sleep(PROBE_INTERVAL_S)
+
+
+if __name__ == "__main__":
+    main()

@@ -33,6 +33,7 @@ from vpp_tpu.ipam.ipam import IPAM
 from vpp_tpu.ir.rule import PodID
 from vpp_tpu.ksr import model as m
 from vpp_tpu.kvstore.proxy import KVProxy
+from vpp_tpu.net.linux import IpCmdError
 from vpp_tpu.kvstore.store import Broker, KVEvent, KVStore, Op
 from vpp_tpu.pipeline.dataplane import Dataplane
 from vpp_tpu.pipeline.vector import Disposition
@@ -314,7 +315,16 @@ class ContivAgent:
                 try:
                     self.host_interconnect.wire(self.host_if)
                     break
-                except (OSError, RuntimeError):
+                except IpCmdError:
+                    # ip(8)/daemon command failures are permanent
+                    # (missing CAP_NET_ADMIN, EEXIST, ...) — retrying
+                    # them only re-runs wire()'s create+rollback for a
+                    # minute; surface immediately
+                    raise
+                except OSError:
+                    # the boot race this wait exists for: control
+                    # socket not yet bound (FileNotFoundError /
+                    # ConnectionRefusedError)
                     if time.monotonic() >= deadline:
                         raise
                     time.sleep(0.5)
