@@ -883,34 +883,59 @@ def io_daemon_bench(args, duration_s: float = 5.0) -> dict:
             os.path.dirname(os.path.abspath(__file__))
             + os.pathsep + env.get("PYTHONPATH", "")
         )
-        sender_code = (
-            "import time\n"
-            "import numpy as np\n"
-            "from bench import wire_udp\n"
-            "from vpp_tpu.io.transport import AfPacketTransport\n"
-            "from vpp_tpu.native.pktio import PacketCodec\n"
-            "VEC = 256\n"
-            "codec = PacketCodec(snap=512)\n"
-            "t = AfPacketTransport('vppbnA1')\n"
-            "payload = np.zeros((VEC, 512), np.uint8)\n"
-            "lens = np.zeros(VEC, np.uint32)\n"
-            "for i in range(VEC):\n"
-            "    f = wire_udp(i)\n"
-            "    payload[i, :len(f)] = np.frombuffer(f, np.uint8)\n"
-            "    lens[i] = len(f)\n"
-            "rows = np.arange(VEC, dtype=np.uint32)\n"
-            # the sender times its own loop: interpreter/numpy startup
-            # and frame building must not dilute the send window
-            "t0 = time.perf_counter()\n"
-            f"deadline = t0 + {duration_s}\n"
-            "sent = 0\n"
-            "while time.perf_counter() < deadline:\n"
-            "    k = codec.send_batch(t.batch_fd, payload, rows, lens, VEC)\n"
-            "    sent += k\n"
-            "    if k < VEC:\n"
-            "        time.sleep(0.0005)\n"
-            "print(sent, time.perf_counter() - t0)\n"
-        )
+        def make_sender(pace_pps: float | None) -> str:
+            if pace_pps is None:
+                loop = (
+                    "while time.perf_counter() < deadline:\n"
+                    "    k = codec.send_batch(t.batch_fd, payload, rows, "
+                    "lens, VEC)\n"
+                    "    sent += k\n"
+                    "    if k < VEC:\n"
+                    "        time.sleep(0.0005)\n"
+                )
+            else:
+                # paced: BURST frames per interval, absolute schedule
+                # (next_t += interval) so pacing error doesn't accumulate
+                loop = (
+                    "BURST = 64\n"
+                    f"interval = BURST / {pace_pps}\n"
+                    "next_t = t0\n"
+                    "while True:\n"
+                    "    now = time.perf_counter()\n"
+                    "    if now >= deadline:\n"
+                    "        break\n"
+                    "    if now < next_t:\n"
+                    "        time.sleep(min(next_t - now, 0.001))\n"
+                    "        continue\n"
+                    "    k = codec.send_batch(t.batch_fd, payload, rows, "
+                    "lens, BURST)\n"
+                    "    sent += k\n"
+                    "    next_t += interval\n"
+                )
+            return (
+                "import time\n"
+                "import numpy as np\n"
+                "from bench import wire_udp\n"
+                "from vpp_tpu.io.transport import AfPacketTransport\n"
+                "from vpp_tpu.native.pktio import PacketCodec\n"
+                "VEC = 256\n"
+                "codec = PacketCodec(snap=512)\n"
+                "t = AfPacketTransport('vppbnA1')\n"
+                "payload = np.zeros((VEC, 512), np.uint8)\n"
+                "lens = np.zeros(VEC, np.uint32)\n"
+                "for i in range(VEC):\n"
+                "    f = wire_udp(i)\n"
+                "    payload[i, :len(f)] = np.frombuffer(f, np.uint8)\n"
+                "    lens[i] = len(f)\n"
+                "rows = np.arange(VEC, dtype=np.uint32)\n"
+                # the sender times its own loop: interpreter/numpy
+                # startup and frame building must not dilute the window
+                "t0 = time.perf_counter()\n"
+                f"deadline = t0 + {duration_s}\n"
+                "sent = 0\n"
+                + loop +
+                "print(sent, time.perf_counter() - t0)\n"
+            )
         recv_code = (
             "import socket, time\n"
             "import numpy as np\n"
@@ -940,35 +965,78 @@ def io_daemon_bench(args, duration_s: float = 5.0) -> dict:
             "        time.sleep(0.0002)\n"
             "print(got)\n"
         )
-        recv_proc = subprocess.Popen(
-            [sys.executable, "-c", recv_code], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        )
-        # wait for the receiver's socket to exist before offering load —
-        # frames forwarded to vppbnB1 before the bind are unaccountable
-        ready = recv_proc.stdout.readline()
-        if "READY" not in ready:
-            _, r_err = recv_proc.communicate(timeout=30)
-            raise RuntimeError(f"receiver failed to start: {r_err[-300:]}")
-        send_proc = subprocess.Popen(
-            [sys.executable, "-c", sender_code], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        )
-        s_out, s_err = send_proc.communicate(timeout=duration_s + 60)
-        r_out, r_err = recv_proc.communicate(timeout=duration_s + 60)
-        # a dead endpoint must surface as an ERROR, not as a plausible
-        # 0.0 Mpps datum
-        if send_proc.returncode != 0 or not s_out.strip():
-            raise RuntimeError(f"sender failed: {s_err[-300:]}")
-        if recv_proc.returncode != 0 or not r_out.strip():
-            raise RuntimeError(f"receiver failed: {r_err[-300:]}")
-        offered_s, window_s = s_out.split()
-        offered = int(offered_s)
-        send_window = float(window_s)
-        got = int(r_out.strip())
+        def run_round(pace_pps: float | None):
+            """One sender/receiver subprocess round; returns
+            (offered, got, send_window_s)."""
+            recv_proc = subprocess.Popen(
+                [sys.executable, "-c", recv_code], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+            # wait for the receiver's socket to exist before offering
+            # load — frames forwarded to vppbnB1 before the bind are
+            # unaccountable
+            ready = recv_proc.stdout.readline()
+            if "READY" not in ready:
+                _, r_err = recv_proc.communicate(timeout=30)
+                raise RuntimeError(
+                    f"receiver failed to start: {r_err[-300:]}")
+            send_proc = subprocess.Popen(
+                [sys.executable, "-c", make_sender(pace_pps)], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+            s_out, s_err = send_proc.communicate(timeout=duration_s + 60)
+            r_out, r_err = recv_proc.communicate(timeout=duration_s + 60)
+            # a dead endpoint must surface as an ERROR, not as a
+            # plausible 0.0 Mpps datum
+            if send_proc.returncode != 0 or not s_out.strip():
+                raise RuntimeError(f"sender failed: {s_err[-300:]}")
+            if recv_proc.returncode != 0 or not r_out.strip():
+                raise RuntimeError(f"receiver failed: {r_err[-300:]}")
+            offered_s, window_s = s_out.split()
+            return int(offered_s), int(r_out.strip()), float(window_s)
+
+        offered, got, send_window = run_round(None)
+
+        # paced round: offer at ~60% of the measured saturation
+        # DELIVERY rate — the deployment regime (goodput at a
+        # sustainable load), vs the saturation row where sender-side
+        # kernel drops dominate on a shared core (docs/IO_PATH.md).
+        # A fresh flow set would re-miss the session cache, so reuse.
+        paced = {}
+        sat_pps = got / send_window
+        if sat_pps > 0:
+            try:
+                # quiesce: let in-flight saturation traffic drain, but
+                # under a HARD cap — trickling background frames (e.g.
+                # kernel ND chatter) must not reset the wait forever
+                q_deadline = time.perf_counter() + 20
+                q_since, q_cnt = time.perf_counter(), pump.stats["frames"]
+                while time.perf_counter() < q_deadline:
+                    time.sleep(0.1)
+                    cnt = pump.stats["frames"]
+                    if cnt != q_cnt:
+                        q_cnt, q_since = cnt, time.perf_counter()
+                    elif time.perf_counter() - q_since > 1.5:
+                        break
+                p_off, p_got, p_win = run_round(
+                    max(sat_pps * 0.6, 5_000.0))
+                paced = {
+                    "io_daemon_paced_mpps": round(p_got / p_win / 1e6, 4),
+                    "io_daemon_paced_offered_mpps": round(
+                        p_off / p_win / 1e6, 4),
+                    "io_daemon_paced_goodput_pct": round(
+                        100.0 * p_got / p_off, 1) if p_off else 0.0,
+                }
+            except Exception as e:  # noqa: BLE001 — the paced round is
+                # additive; its failure must not discard the measured
+                # saturation numbers
+                paced = {"io_daemon_paced_error":
+                         f"{type(e).__name__}: {e}"}
+
         # rate over the offered window (the receiver's post-drain of its
         # kernel queue belongs to that window's traffic)
         return {
+            **paced,
             "io_daemon_veth_mpps": round(got / send_window / 1e6, 4),
             "io_daemon_offered_mpps": round(offered / send_window / 1e6, 4),
             # diagnosability: what the pump actually moved during the

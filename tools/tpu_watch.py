@@ -47,11 +47,18 @@ def log(msg: str) -> None:
 
 def probe() -> bool:
     # ONE probe definition for watcher and bench: bench.py's
-    # _subprocess_probe (matmul executed in a throwaway process)
-    sys.path.insert(0, REPO)
-    from bench import _subprocess_probe
+    # _subprocess_probe (matmul executed in a throwaway process).
+    # Import errors (a mid-edit working tree) count as probe-failed —
+    # a detached watcher must survive them.
+    try:
+        if REPO not in sys.path:
+            sys.path.insert(0, REPO)
+        from bench import _subprocess_probe
 
-    return _subprocess_probe(PROBE_TIMEOUT_S)
+        return _subprocess_probe(PROBE_TIMEOUT_S)
+    except Exception as e:  # noqa: BLE001 — keep watching
+        log(f"probe import/run failed: {type(e).__name__}: {e}")
+        return False
 
 
 def head_commit() -> str:
@@ -109,12 +116,20 @@ def run_capture() -> None:
                 f"archive + python bench.py at that commit.")
             with open(COMPLETE_OUT, "w") as f:
                 json.dump(result, f, indent=1)
-            subprocess.run(["git", "-C", REPO, "add", COMPLETE_OUT])
-            subprocess.run(["git", "-C", REPO, "commit", "-m",
-                            "Round-4 real-TPU bench capture (watcher, "
-                            f"snapshot of {commit[:10]})",
-                            "--", COMPLETE_OUT])
-            log(f"COMPLETE capture committed ({wall}s)")
+            rc = subprocess.run(
+                ["git", "-C", REPO, "add", COMPLETE_OUT]).returncode
+            rc |= subprocess.run(
+                ["git", "-C", REPO, "commit", "-m",
+                 "Round-4 real-TPU bench capture (watcher, "
+                 f"snapshot of {commit[:10]})",
+                 "--", COMPLETE_OUT]).returncode
+            if rc == 0:
+                log(f"COMPLETE capture committed ({wall}s)")
+            else:
+                # capture is on disk either way (the round driver
+                # commits uncommitted work); do not claim otherwise
+                log(f"COMPLETE capture WRITTEN but git commit failed "
+                    f"rc={rc} ({wall}s) — left for the round driver")
             return
         # partial: keep the furthest sidecar seen so far
         part = {}
@@ -144,11 +159,14 @@ def run_capture() -> None:
             part["commit"] = commit
             with open(PARTIAL_OUT, "w") as f:
                 json.dump(part, f, indent=1)
-            subprocess.run(["git", "-C", REPO, "add", PARTIAL_OUT])
-            subprocess.run(["git", "-C", REPO, "commit", "-m",
-                            "Partial TPU bench sections salvaged by the "
-                            "recovery watcher", "--", PARTIAL_OUT])
-            log(f"partial capture kept ({len(part)} keys, {wall}s)")
+            rc = subprocess.run(
+                ["git", "-C", REPO, "add", PARTIAL_OUT]).returncode
+            rc |= subprocess.run(
+                ["git", "-C", REPO, "commit", "-m",
+                 "Partial TPU bench sections salvaged by the recovery "
+                 "watcher", "--", PARTIAL_OUT]).returncode
+            log(f"partial capture kept ({len(part)} keys, {wall}s, "
+                f"commit rc={rc})")
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
