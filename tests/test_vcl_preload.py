@@ -1,0 +1,243 @@
+"""LD_PRELOAD session shim e2e: unmodified subprocesses get session-rule
+admission on connect()/accept().
+
+Reference analog: VPP's VCL ldpreload deployment (tests/ld_preload*,
+the iperf/nginx suites run with LD_PRELOAD=libvcl_ldpreload.so and the
+contiv-cri shim injecting that env) — app sockets are filtered by the
+session rule tables the VPPTCP renderer programs
+(plugins/policy/renderer/vpptcp/bin_api/session). Here libvclshim.so
+(native/vcl_preload.c) asks the VclAdmissionServer
+(hoststack/admission.py) for a verdict backed by the SAME
+SessionRuleEngine, and the apps under test are real python subprocesses
+that never import vpp_tpu.
+"""
+
+import socket
+import struct
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from vpp_tpu.hoststack.admission import (
+    OP_CONNECT, VclAdmissionServer, _REQ,
+)
+from vpp_tpu.hoststack.preload import shim_path, vcl_env
+from vpp_tpu.hoststack.session_rules import (
+    GLOBAL_NS, RuleAction, RuleScope, SessionRule, SessionRuleEngine,
+)
+
+
+def ipi(a: str) -> int:
+    return struct.unpack("!I", socket.inet_aton(a))[0]
+
+
+def local_rule(appns, rmt_port, action, proto=6):
+    return SessionRule(
+        scope=int(RuleScope.LOCAL), appns_index=appns,
+        transport_proto=proto, lcl_net=0, lcl_plen=0,
+        rmt_net=ipi("127.0.0.1"), rmt_plen=32,
+        lcl_port=0, rmt_port=rmt_port, action=int(action))
+
+
+def global_rule(lcl_port, action, proto=6):
+    return SessionRule(
+        scope=int(RuleScope.GLOBAL), appns_index=GLOBAL_NS,
+        transport_proto=proto, lcl_net=ipi("127.0.0.1"), lcl_plen=32,
+        rmt_net=0, rmt_plen=0,
+        lcl_port=lcl_port, rmt_port=0, action=int(action))
+
+
+CONNECT_CODE = """
+import socket, sys
+s = socket.socket()
+s.settimeout(10)
+try:
+    s.connect(("127.0.0.1", int(sys.argv[1])))
+    print("CONNECTED")
+except ConnectionRefusedError:
+    print("REFUSED")
+"""
+
+
+@pytest.fixture()
+def admission(tmp_path):
+    engine = SessionRuleEngine()
+    path = str(tmp_path / "vcl.sock")
+    srv = VclAdmissionServer(engine, path).start()
+    yield engine, path
+    srv.stop()
+
+
+@pytest.fixture()
+def listener():
+    socks = []
+
+    def make(port=0):
+        ls = socket.socket()
+        ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        ls.bind(("127.0.0.1", port))
+        ls.listen(8)
+        socks.append(ls)
+
+        def drain():
+            while True:
+                try:
+                    c, _ = ls.accept()
+                    c.close()
+                except OSError:
+                    return
+
+        threading.Thread(target=drain, daemon=True).start()
+        return ls.getsockname()[1]
+
+    yield make
+    for s in socks:
+        s.close()
+
+
+def run_under_shim(env, code, *argv, timeout=60):
+    out = subprocess.run([sys.executable, "-c", code, *map(str, argv)],
+                         env=env, capture_output=True, text=True,
+                         timeout=timeout)
+    assert out.returncode == 0, out.stderr[-500:]
+    return out.stdout.strip()
+
+
+def test_connect_deny_and_allow(admission, listener):
+    engine, sock = admission
+    port = listener()
+    engine.apply(add=[local_rule(7, port, RuleAction.DENY)])
+    env = vcl_env(sock, appns_index=7)
+    assert run_under_shim(env, CONNECT_CODE, port) == "REFUSED"
+    # an unfiltered port on the same namespace still connects
+    port2 = listener()
+    assert run_under_shim(env, CONNECT_CODE, port2) == "CONNECTED"
+
+
+def test_appns_scoping(admission, listener):
+    """LOCAL rules bind to their app namespace: ns 7 denied, ns 8 not."""
+    engine, sock = admission
+    port = listener()
+    engine.apply(add=[local_rule(7, port, RuleAction.DENY)])
+    assert run_under_shim(vcl_env(sock, appns_index=7),
+                          CONNECT_CODE, port) == "REFUSED"
+    assert run_under_shim(vcl_env(sock, appns_index=8),
+                          CONNECT_CODE, port) == "CONNECTED"
+
+
+def test_udp_connect_filtered(admission, listener):
+    engine, sock = admission
+    engine.apply(add=[local_rule(3, 5353, RuleAction.DENY, proto=17)])
+    code = """
+import socket
+s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+try:
+    s.connect(("127.0.0.1", 5353))
+    print("CONNECTED")
+except ConnectionRefusedError:
+    print("REFUSED")
+"""
+    assert run_under_shim(vcl_env(sock, appns_index=3), code) == "REFUSED"
+    # TCP rule does not catch UDP and vice versa
+    engine.flush()
+    engine.apply(add=[local_rule(3, 5353, RuleAction.DENY, proto=6)])
+    assert run_under_shim(vcl_env(sock, appns_index=3), code) == "CONNECTED"
+
+
+ECHO_SERVER_CODE = """
+import socket, sys
+ls = socket.socket()
+ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+ls.bind(("127.0.0.1", 0))
+ls.listen(8)
+print(ls.getsockname()[1], flush=True)
+while True:
+    c, _ = ls.accept()          # interposed: denied peers never surface
+    data = c.recv(64)
+    c.sendall(b"echo:" + data)
+    c.close()
+"""
+
+
+def test_accept_side_global_deny(admission):
+    """A server under the shim: denied inbound peers are closed before
+    the app sees them (the VPP session layer resets filtered sessions);
+    allowed peers get service."""
+    engine, sock = admission
+    srv = subprocess.Popen(
+        [sys.executable, "-c", ECHO_SERVER_CODE],
+        env=vcl_env(sock), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    try:
+        port = int(srv.stdout.readline())
+        engine.apply(add=[global_rule(port, RuleAction.DENY)])
+
+        c = socket.create_connection(("127.0.0.1", port), timeout=10)
+        c.sendall(b"hi")
+        # kernel completed the handshake (backlog), but the shim closes
+        # the connection before the app ever accepts it
+        c.settimeout(10)
+        try:
+            got = c.recv(64)
+        except ConnectionResetError:
+            got = b""
+        assert got == b"", got
+        c.close()
+
+        engine.apply(delete=[global_rule(port, RuleAction.DENY)])
+        c = socket.create_connection(("127.0.0.1", port), timeout=10)
+        c.sendall(b"hi")
+        assert c.recv(64) == b"echo:hi"
+        c.close()
+    finally:
+        srv.kill()
+        srv.wait(timeout=10)
+
+
+def test_fail_open_and_fail_closed(tmp_path, listener):
+    port = listener()
+    dead = str(tmp_path / "nobody.sock")
+    assert run_under_shim(vcl_env(dead), CONNECT_CODE, port) == "CONNECTED"
+    assert run_under_shim(vcl_env(dead, fail_closed=True),
+                          CONNECT_CODE, port) == "REFUSED"
+
+
+def test_no_shim_env_passthrough(listener):
+    """LD_PRELOAD loaded but VPP_TPU_VCL_SOCK unset: pure pass-through."""
+    import os
+
+    port = listener()
+    env = dict(os.environ)
+    env["LD_PRELOAD"] = shim_path()
+    env.pop("VPP_TPU_VCL_SOCK", None)
+    assert run_under_shim(env, CONNECT_CODE, port) == "CONNECTED"
+
+
+def test_agent_serves_admission(tmp_path):
+    """vcl_socket in AgentConfig brings the endpoint up on the live
+    agent, answering the shim protocol from the agent's own
+    SessionRuleEngine (the one the VPPTCP renderer programs)."""
+    from vpp_tpu.cmd import AgentConfig, ContivAgent
+    from vpp_tpu.kvstore.store import KVStore
+
+    path = str(tmp_path / "agent_vcl.sock")
+    agent = ContivAgent(
+        AgentConfig(node_name="n1", serve_http=False, vcl_socket=path),
+        store=KVStore())
+    agent.start()
+    try:
+        agent.session_engine.apply(add=[local_rule(5, 8080,
+                                                   RuleAction.DENY)])
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(path)
+        s.sendall(_REQ.pack(OP_CONNECT, 6, 0, 5, 0, ipi("127.0.0.1"),
+                            0, 8080))
+        assert s.recv(1) == b"\x00"      # denied
+        s.sendall(_REQ.pack(OP_CONNECT, 6, 0, 6, 0, ipi("127.0.0.1"),
+                            0, 8080))
+        assert s.recv(1) == b"\x01"      # other namespace: allowed
+        s.close()
+    finally:
+        agent.close()

@@ -211,6 +211,7 @@ class ContivAgent:
         )
         self.cni_transport: Optional[CNITransportServer] = None
         self.cli_transport: Optional[CNITransportServer] = None
+        self.vcl_admission = None  # VclAdmissionServer when vcl_socket set
 
         # --- observability ---
         self.stats = StatsCollector(self.dataplane, self.container_index)
@@ -362,6 +363,17 @@ class ContivAgent:
         except Exception:
             log.exception("liveness publish failed (continuing)")
         self.cni_server.set_ready()
+        if c.vcl_socket:
+            # the ldpreload endpoint: unmodified apps launched with
+            # vcl_env() get session-rule admission on every
+            # connect()/accept() against this node's session rules
+            # (reference: VCL ldpreload, tests/ld_preload*). A policy
+            # endpoint, not observability — independent of serve_http.
+            from vpp_tpu.hoststack.admission import VclAdmissionServer
+
+            self.vcl_admission = VclAdmissionServer(
+                self.session_engine, c.vcl_socket
+            ).start()
         if c.serve_http:
             self.cni_transport = CNITransportServer(
                 c.cni_socket, self.cni_server.dispatch
@@ -515,6 +527,8 @@ class ContivAgent:
                     self.stats_http, self.health_http):
             if srv is not None:
                 srv.close()
+        if self.vcl_admission is not None:
+            self.vcl_admission.stop()
         self.proxy.close()
         pump_stopped = True
         if self.io_pump is not None and not self._external_io:

@@ -77,6 +77,10 @@ class AgentConfig:
     cni_socket: str = "/run/vpp-tpu/cni.sock"
     # debug CLI socket (the vppctl transport; "" disables)
     cli_socket: str = "/run/vpp-tpu/cli.sock"
+    # VCL admission socket for the LD_PRELOAD session shim
+    # (libvclshim.so answers its connect()/accept() checks here against
+    # the node's session rules; "" disables)
+    vcl_socket: str = ""
     # config transaction trace (api-trace analog): JSONL journal of every
     # NB commit the live agent applies; "" disables recording
     txn_journal_path: str = ""

@@ -1,0 +1,124 @@
+"""VCL admission socket: the session-layer policy endpoint for the
+LD_PRELOAD shim.
+
+Reference analog: VPP's VCL connects an app worker to the session layer
+over the VCL app socket, and every session create/accept inside VPP is
+filtered by the session rule tables the VPPTCP renderer programs
+(plugins/policy/renderer/vpptcp/bin_api/session, tests/ld_preload*).
+Here the unmodified-app path is reproduced natively: libvclshim.so
+(native/vcl_preload.c) interposes connect()/accept() and asks THIS
+server for a verdict before the call proceeds; the server answers from
+the node's SessionRuleEngine — the same engine, and therefore the same
+device-resident rule tables, the VPPTCP renderer commits to.
+
+Wire protocol (one unix stream per client process, requests pipelined
+sequentially, all fields little-endian):
+
+    request  (20 B): u8 op ('C' connect | 'A' accept), u8 proto,
+                     u16 pad, u32 appns, u32 lcl_ip, u32 rmt_ip,
+                     u16 lcl_port, u16 rmt_port
+    response  (1 B): 1 allow, 0 deny
+
+IPs are host-order u32s of the network-byte-order address (ntohl on the
+C side), matching vcl.py's ``_ip_int``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import struct
+import threading
+from typing import Optional
+
+from vpp_tpu.hoststack.session_rules import SessionRuleEngine
+
+log = logging.getLogger("vpp-tpu.vcl")
+
+_REQ = struct.Struct("<BBHIIIHH")
+REQ_SIZE = _REQ.size
+OP_CONNECT = ord("C")
+OP_ACCEPT = ord("A")
+
+
+class VclAdmissionServer:
+    """Threaded unix-socket server answering shim admission queries."""
+
+    def __init__(self, engine: SessionRuleEngine, path: str):
+        self.engine = engine
+        self.path = path
+        self._sock: Optional[socket.socket] = None
+        self._threads: list = []
+        self._stop = threading.Event()
+
+    def start(self) -> "VclAdmissionServer":
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(self.path)
+        self._sock.listen(64)
+        t = threading.Thread(target=self._accept_loop,
+                             name="vcl-admission", daemon=True)
+        t.start()
+        self._threads.append(t)
+        log.info("VCL admission socket at %s", self.path)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    # --- internals ---
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # closed
+            # per-connection threads are daemons and never joined — do
+            # not retain them (a churning node would grow the list for
+            # the agent's lifetime)
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                buf = b""
+                while len(buf) < REQ_SIZE:
+                    chunk = conn.recv(REQ_SIZE - len(buf))
+                    if not chunk:
+                        return
+                    buf += chunk
+                op, proto, _pad, appns, lcl_ip, rmt_ip, lcl_port, \
+                    rmt_port = _REQ.unpack(buf)
+                if op == OP_CONNECT:
+                    ok = bool(self.engine.check_connect(
+                        [(appns, proto, lcl_ip, lcl_port,
+                          rmt_ip, rmt_port)])[0])
+                elif op == OP_ACCEPT:
+                    ok = bool(self.engine.check_accept(
+                        [(proto, lcl_ip, lcl_port, rmt_ip, rmt_port)])[0])
+                else:
+                    log.warning("unknown admission op %#x", op)
+                    ok = False
+                conn.sendall(b"\x01" if ok else b"\x00")
+        except OSError:
+            pass  # client went away
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
