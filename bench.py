@@ -782,10 +782,120 @@ def hoststack_bench(args, duration_s: float = 2.5) -> dict:
         out["session_admission_ksps"] = round(
             4096 * iters / (time.perf_counter() - t0) / 1e3, 1
         )
+
+        # --- ldpreload iperf analog (BASELINE row: pod<->pod iperf,
+        # kernel stack vs VCL/ldpreload,
+        # tests/robot/suites/one_node_two_pods_ldpreload_iperf.robot):
+        # bulk TCP between two REAL subprocesses, once bare-kernel and
+        # once under libvclshim.so admission. Session rules filter
+        # connection SETUP only, so the two should track each other —
+        # the VCL number proves policy admission costs nothing on the
+        # data path.
+        try:
+            out.update(vcl_iperf_bench(engine))
+        except Exception as e:  # noqa: BLE001 — optional, env-dependent
+            out["vcl_iperf_error"] = f"{type(e).__name__}: {e}"
         return out
     finally:
         stop.set()
         srv.close()
+
+
+def vcl_iperf_bench(engine, mb: int = 256, port: int = 15201) -> dict:
+    """Bulk-transfer Gbps over loopback: bare kernel vs under the
+    LD_PRELOAD session shim (admission served from ``engine``).
+
+    The engine arrives with hoststack_bench's deny-alls installed in
+    both scopes, so the iperf port needs explicit admits — which makes
+    the shim's verdicts load-bearing, same as the RPS section."""
+    import subprocess
+    import tempfile
+
+    from vpp_tpu.hoststack.admission import VclAdmissionServer
+    from vpp_tpu.hoststack.preload import vcl_env
+    from vpp_tpu.hoststack.session_rules import (
+        RuleAction, RuleScope, SessionRule,
+    )
+    from vpp_tpu.hoststack.vcl import _ip_int
+
+    LOOP = _ip_int("127.0.0.1")
+    engine.apply(add=[
+        SessionRule(scope=int(RuleScope.LOCAL), appns_index=1,
+                    transport_proto=6, lcl_net=0, lcl_plen=0,
+                    rmt_net=LOOP, rmt_plen=32, lcl_port=0, rmt_port=port,
+                    action=int(RuleAction.ALLOW)),
+        SessionRule(scope=int(RuleScope.GLOBAL), appns_index=-1,
+                    transport_proto=6, lcl_net=LOOP, lcl_plen=32,
+                    rmt_net=0, rmt_plen=0, lcl_port=port, rmt_port=0,
+                    action=int(RuleAction.ALLOW)),
+    ])
+
+    total = mb << 20
+    server_code = (
+        "import socket, sys\n"
+        "ls = socket.socket()\n"
+        "ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)\n"
+        f"ls.bind((\"127.0.0.1\", {port}))\n"
+        "ls.listen(1)\n"
+        "print(ls.getsockname()[1], flush=True)\n"
+        "c, _ = ls.accept()\n"
+        "buf = memoryview(bytearray(1 << 20))\n"
+        "n = 0\n"
+        "while True:\n"
+        "    r = c.recv_into(buf)\n"
+        "    if not r:\n"
+        "        break\n"
+        "    n += r\n"
+        "print(n)\n"
+    )
+    client_code = (
+        "import socket, sys, time\n"
+        f"total = {total}\n"
+        "c = socket.create_connection((\"127.0.0.1\", int(sys.argv[1])),"
+        " timeout=30)\n"
+        "chunk = b\"x\" * (1 << 20)\n"
+        "t0 = time.perf_counter()\n"
+        "sent = 0\n"
+        "while sent < total:\n"
+        "    c.sendall(chunk)\n"
+        "    sent += len(chunk)\n"
+        "c.close()\n"
+        "print(time.perf_counter() - t0)\n"
+    )
+
+    def one(env) -> float:
+        srv_p = subprocess.Popen([sys.executable, "-c", server_code],
+                                 env=env, stdout=subprocess.PIPE,
+                                 stderr=subprocess.PIPE, text=True)
+        try:
+            port = int(srv_p.stdout.readline())
+            cli = subprocess.run([sys.executable, "-c", client_code,
+                                  str(port)], env=env,
+                                 capture_output=True, text=True,
+                                 timeout=120)
+            if cli.returncode != 0:
+                raise RuntimeError(f"iperf client: {cli.stderr[-300:]}")
+            dt = float(cli.stdout.strip())
+            got = int(srv_p.stdout.readline())
+            if got != total:
+                raise RuntimeError(f"iperf short read {got}/{total}")
+            return total * 8 / dt / 1e9
+        finally:
+            srv_p.kill()
+            srv_p.wait(timeout=10)
+
+    kernel_gbps = one(dict(os.environ))
+    with tempfile.TemporaryDirectory() as td:
+        sock = os.path.join(td, "vcl.sock")
+        adm = VclAdmissionServer(engine, sock).start()
+        try:
+            vcl_gbps = one(vcl_env(sock, appns_index=1))
+        finally:
+            adm.stop()
+    return {
+        "iperf_kernel_gbps": round(kernel_gbps, 2),
+        "iperf_vcl_ldpreload_gbps": round(vcl_gbps, 2),
+    }
 
 
 def io_daemon_bench(args, duration_s: float = 5.0) -> dict:

@@ -217,3 +217,37 @@ def test_connectivity_probe_reports_verdict_and_path():
     # a return-traffic hole)
     import numpy as np
     assert int(np.asarray(dp.tables.sess_valid).sum()) == 0
+
+
+def test_show_session_rules():
+    """`show session-rules` dumps the VPPTCP renderer's filter tables
+    (the `show session rules` analog); without an engine it degrades to
+    a message."""
+    from vpp_tpu.hoststack.session_rules import (
+        RuleAction, RuleScope, SessionRule, SessionRuleEngine,
+    )
+
+    dp, a, uplink = make_env()
+    assert "no session rule engine" in DebugCLI(dp).run(
+        "show session-rules")
+
+    eng = SessionRuleEngine()
+    eng.apply(add=[
+        SessionRule(scope=int(RuleScope.LOCAL), appns_index=4,
+                    transport_proto=6, lcl_net=0, lcl_plen=0,
+                    rmt_net=ip4("10.1.1.9"), rmt_plen=32,
+                    lcl_port=0, rmt_port=443,
+                    action=int(RuleAction.DENY)),
+        SessionRule(scope=int(RuleScope.GLOBAL), appns_index=-1,
+                    transport_proto=17, lcl_net=ip4("10.1.1.2"),
+                    lcl_plen=32, rmt_net=0, rmt_plen=0,
+                    lcl_port=53, rmt_port=0,
+                    action=int(RuleAction.ALLOW)),
+    ])
+    out = DebugCLI(dp, session_engine=eng).run("show session-rules")
+    assert "2 session rules" in out
+    assert "LOCAL ns 4" in out and "10.1.1.9/32:443" in out
+    assert "deny" in out
+    assert "GLOBAL" in out and "10.1.1.2/32:53" in out and "allow" in out
+    # `show session` (the flow table) still resolves independently
+    assert "established sessions" in DebugCLI(dp).run("show session")

@@ -17,6 +17,7 @@ import struct
 import subprocess
 import sys
 import threading
+import time
 
 import pytest
 
@@ -241,3 +242,65 @@ def test_agent_serves_admission(tmp_path):
         s.close()
     finally:
         agent.close()
+
+
+NONBLOCK_SERVER_CODE = """
+import socket, sys, time
+ls = socket.socket()
+ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+ls.bind(("127.0.0.1", 0))
+ls.listen(8)
+print(ls.getsockname()[1], flush=True)
+sys.stdin.readline()        # wait for GO (both peers queued)
+ls.setblocking(False)
+deadline = time.time() + 10
+while True:
+    try:
+        c, peer = ls.accept()   # one wake must surface the ALLOWED peer
+        print(peer[1], flush=True)
+        break
+    except BlockingIOError:
+        if time.time() > deadline:
+            print("EAGAIN-TIMEOUT", flush=True)
+            break
+        time.sleep(0.05)
+c.recv(16)
+"""
+
+
+def test_nonblocking_accept_skips_denied_backlog(admission):
+    """A denied peer queued AHEAD of an allowed one must not turn the
+    wake into EAGAIN — edge-triggered pollers would never be re-notified
+    for the allowed connection. The shim drains the denied peer and
+    returns the allowed one from the same accept() call."""
+    engine, sock = admission
+    srv = subprocess.Popen(
+        [sys.executable, "-c", NONBLOCK_SERVER_CODE],
+        env=vcl_env(sock), stdin=subprocess.PIPE,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        port = int(srv.stdout.readline())
+        # deny inbound from source port 33001 specifically
+        engine.apply(add=[SessionRule(
+            scope=int(RuleScope.GLOBAL), appns_index=GLOBAL_NS,
+            transport_proto=6, lcl_net=ipi("127.0.0.1"), lcl_plen=32,
+            rmt_net=ipi("127.0.0.1"), rmt_plen=32,
+            lcl_port=port, rmt_port=33001,
+            action=int(RuleAction.DENY))])
+
+        denied = socket.socket()
+        denied.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        denied.bind(("127.0.0.1", 33001))
+        denied.connect(("127.0.0.1", port))   # queued first
+        allowed = socket.create_connection(("127.0.0.1", port),
+                                           timeout=10)
+        time.sleep(0.3)                        # both in the backlog
+        srv.stdin.write("GO\n")
+        srv.stdin.flush()
+        got = srv.stdout.readline().strip()
+        assert got == str(allowed.getsockname()[1]), got
+        denied.close()
+        allowed.close()
+    finally:
+        srv.kill()
+        srv.wait(timeout=10)

@@ -20,7 +20,7 @@ from vpp_tpu.pipeline.vector import Disposition, ip4_str
 
 class DebugCLI:
     def __init__(self, dataplane: Dataplane, tracer=None, stats=None,
-                 pump=None, io_ctl=None):
+                 pump=None, io_ctl=None, session_engine=None):
         self.dp = dataplane
         self.tracer = tracer
         self.stats = stats
@@ -28,6 +28,8 @@ class DebugCLI:
         # control-socket client into the (separate) IO daemon process
         self.pump = pump
         self.io_ctl = io_ctl
+        # optional host-stack handle (show session-rules)
+        self.session_engine = session_engine
 
     # --- dispatch ---
     def run(self, line: str) -> str:
@@ -38,6 +40,7 @@ class DebugCLI:
             ("show", "interface"): self.show_interface,
             ("show", "acl"): self.show_acl,
             ("show", "session"): self.show_session,
+            ("show", "session-rules"): self.show_session_rules,
             ("show", "nat44"): self.show_nat44,
             ("show", "fib"): self.show_fib,
             ("show", "trace"): self.show_trace,
@@ -64,6 +67,7 @@ class DebugCLI:
     def help(self) -> str:
         return (
             "commands: show interface | show acl | show session | "
+            "show session-rules | "
             "show nat44 | show fib | show trace | show errors | "
             "show io | show neighbors | show config-history [n] | "
             "trace add [n] | trace clear | config replay <journal> | "
@@ -196,6 +200,36 @@ class DebugCLI:
             )
         if len(idxs) > 64:
             lines.append(f"  ... {len(idxs) - 64} more")
+        return "\n".join(lines)
+
+    def show_session_rules(self) -> str:
+        """The `show session rules` analog: the VPPTCP renderer's
+        installed session filter tables, most-specific first per scope
+        (reference: session_rules_table dump,
+        plugins/policy/renderer/vpptcp/bin_api/session)."""
+        eng = self.session_engine
+        if eng is None:
+            return "no session rule engine attached"
+        rules = eng.dump()
+        lines = [f"{len(rules)} session rules "
+                 f"(capacity {eng.capacity})"]
+        scope_name = {1: "LOCAL", 2: "GLOBAL"}
+        act_name = {0: "deny", 1: "allow"}
+        for r in rules[:128]:
+            lcl = (f"{ip4_str(int(r.lcl_net))}/{r.lcl_plen}"
+                   if r.lcl_plen else "any")
+            rmt = (f"{ip4_str(int(r.rmt_net))}/{r.rmt_plen}"
+                   if r.rmt_plen else "any")
+            ns = "" if r.appns_index < 0 else f" ns {r.appns_index}"
+            lines.append(
+                f"  {scope_name.get(r.scope, r.scope)}{ns} "
+                f"proto {r.transport_proto} "
+                f"lcl {lcl}:{r.lcl_port or 'any'} "
+                f"rmt {rmt}:{r.rmt_port or 'any'} "
+                f"-> {act_name.get(r.action, r.action)}"
+            )
+        if len(rules) > 128:
+            lines.append(f"  ... {len(rules) - 128} more")
         return "\n".join(lines)
 
     def show_nat44(self) -> str:

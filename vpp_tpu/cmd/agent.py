@@ -390,6 +390,7 @@ class ContivAgent:
                 cli = DebugCLI(
                     self.dataplane, stats=self.stats,
                     pump=self.io_pump, io_ctl=self.io_ctl,
+                    session_engine=self.session_engine,
                 )
 
                 def _cli_dispatch(method: str, params: dict) -> dict:

@@ -65,8 +65,22 @@ class VclAdmissionServer:
                              name="vcl-admission", daemon=True)
         t.start()
         self._threads.append(t)
+        # Warm the engine's jitted check at the shim's batch shape in
+        # the background: a first-verdict jax compile (20-40 s on TPU)
+        # would outlast the shim's bounded round trip and fail-open a
+        # policy-bypass window exactly when the agent boots with deny
+        # rules already installed.
+        threading.Thread(target=self._warm, name="vcl-warm",
+                         daemon=True).start()
         log.info("VCL admission socket at %s", self.path)
         return self
+
+    def _warm(self) -> None:
+        try:
+            self.engine.check_connect([(0, 6, 0, 0, 0, 0)])
+            self.engine.check_accept([(6, 0, 0, 0, 0)])
+        except Exception:  # noqa: BLE001 — warmup is best-effort
+            log.warning("admission warmup failed", exc_info=True)
 
     def stop(self) -> None:
         self._stop.set()

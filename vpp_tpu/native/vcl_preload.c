@@ -89,8 +89,11 @@ static int chan_open_locked(void) {
   }
   /* a wedged agent (accepting but not answering) must not hang the
    * app inside connect()/accept() while holding chan_mu: bounded
-   * round trips, timeout => verdict unavailable (fail-open/-closed) */
-  struct timeval tv = {2, 0};
+   * round trips, timeout => verdict unavailable (fail-open/-closed).
+   * Worst case across query()'s one reconnect retry is ~4 s (two
+   * 1 s reads; writes only stall on a full socket buffer). Post-warmup
+   * verdicts are sub-ms, so 1 s only ever bites a wedged agent. */
+  struct timeval tv = {1, 0};
   setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
   setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
   return fd;
@@ -170,11 +173,6 @@ static int sock_proto(int fd) {
   return -1;
 }
 
-static int sock_is_blocking(int fd) {
-  int fl = fcntl(fd, F_GETFL, 0);
-  return fl >= 0 && !(fl & O_NONBLOCK);
-}
-
 /* --- interposers --------------------------------------------------- */
 
 #ifdef __cplusplus
@@ -238,9 +236,12 @@ static int admit_accepted(int lfd, int cfd) {
   return !(verdict == 0 || (verdict < 0 && fail_closed()));
 }
 
-/* denied peers are closed and the accept retried (blocking listeners) —
- * the VPP session layer resets filtered sessions and the app never sees
- * them; a non-blocking listener reports EAGAIN for that wake instead. */
+/* denied peers are closed and the accept retried — the VPP session
+ * layer resets filtered sessions and the app never sees them. The
+ * retry also covers non-blocking listeners: an ALLOWED peer queued
+ * behind a denied one must surface on this wake (edge-triggered pollers
+ * would otherwise never be re-notified for it); when the backlog is
+ * truly empty real_accept itself reports EAGAIN. */
 static int accept_common(int lfd, struct sockaddr *addr, socklen_t *alen,
                          int flags, int use4) {
   pthread_once(&resolve_once, resolve_reals);
@@ -250,10 +251,6 @@ static int accept_common(int lfd, struct sockaddr *addr, socklen_t *alen,
     if (cfd < 0 || !getenv("VPP_TPU_VCL_SOCK")) return cfd;
     if (admit_accepted(lfd, cfd)) return cfd;
     close(cfd);
-    if (!sock_is_blocking(lfd)) {
-      errno = EAGAIN;
-      return -1;
-    }
   }
 }
 
