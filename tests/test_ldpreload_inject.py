@@ -1,0 +1,165 @@
+"""vpp-tpu-ldpreload-inject: manifest rewriting for the session shim.
+
+Reference analog: the ldpreload-label-injector dev tool + the CRI
+shim's env injection (cmd/tools/ldpreload-label-injector,
+cmd/contiv-cri) — modernized as a yaml transform (SURVEY §7 excludes
+the dockershim wrapper itself).
+"""
+
+import io
+
+import yaml
+
+from vpp_tpu.cmd.ldpreload_inject import inject_documents, main
+
+DEPLOYMENT = """
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: web
+spec:
+  replicas: 2
+  template:
+    spec:
+      containers:
+      - name: nginx
+        image: nginx:1.25
+        env:
+        - name: EXISTING
+          value: keep
+      - name: sidecar
+        image: busybox
+---
+apiVersion: v1
+kind: Pod
+metadata:
+  name: one-off
+spec:
+  containers:
+  - name: app
+    image: alpine
+---
+apiVersion: v1
+kind: Service
+metadata:
+  name: web
+spec:
+  ports:
+  - port: 80
+"""
+
+
+def _envmap(container):
+    return {e["name"]: e["value"] for e in container["env"]}
+
+
+def test_inject_deployment_pod_and_skip_service():
+    docs = list(yaml.safe_load_all(DEPLOYMENT))
+    n = inject_documents(docs, "/run/vpp-tpu/vcl.sock",
+                         "/opt/vpp-tpu/lib", appns=3, fail_closed=False)
+    assert n == 2  # Deployment template + Pod; Service untouched
+
+    dep, pod, svc = docs
+    for c in dep["spec"]["template"]["spec"]["containers"]:
+        env = _envmap(c)
+        assert env["LD_PRELOAD"] == "/opt/vpp-tpu/lib/libvclshim.so"
+        assert env["VPP_TPU_VCL_SOCK"] == "/run/vpp-tpu/vcl.sock"
+        assert env["VPP_TPU_APPNS"] == "3"
+        assert "VPP_TPU_VCL_FAILCLOSED" not in env
+        mounts = {m["name"]: m for m in c["volumeMounts"]}
+        assert mounts["vpp-tpu-run"]["mountPath"] == "/run/vpp-tpu"
+        assert mounts["vpp-tpu-lib"]["readOnly"] is True
+    # existing env preserved
+    assert _envmap(dep["spec"]["template"]["spec"]["containers"][0])[
+        "EXISTING"] == "keep"
+    vols = {v["name"]: v for v in dep["spec"]["template"]["spec"]["volumes"]}
+    assert vols["vpp-tpu-run"]["hostPath"]["path"] == "/run/vpp-tpu"
+
+    assert _envmap(pod["spec"]["containers"][0])["VPP_TPU_APPNS"] == "3"
+    assert "env" not in svc["spec"].get("ports", [{}])[0]
+
+
+def test_idempotent_and_fail_closed():
+    docs = list(yaml.safe_load_all(DEPLOYMENT))
+    inject_documents(docs, "/run/vpp-tpu/vcl.sock", "/opt/vpp-tpu/lib",
+                     appns=1, fail_closed=True)
+    once = yaml.safe_dump_all(docs, sort_keys=False)
+    inject_documents(docs, "/run/vpp-tpu/vcl.sock", "/opt/vpp-tpu/lib",
+                     appns=1, fail_closed=True)
+    twice = yaml.safe_dump_all(docs, sort_keys=False)
+    assert once == twice
+    c = docs[0]["spec"]["template"]["spec"]["containers"][0]
+    assert _envmap(c)["VPP_TPU_VCL_FAILCLOSED"] == "1"
+    # exactly one copy of each mount/volume survived the re-run
+    assert [m["name"] for m in c["volumeMounts"]].count("vpp-tpu-run") == 1
+    vols = docs[0]["spec"]["template"]["spec"]["volumes"]
+    assert [v["name"] for v in vols].count("vpp-tpu-lib") == 1
+
+
+def test_cronjob_and_cli_roundtrip(tmp_path, capsys, monkeypatch):
+    cron = """
+apiVersion: batch/v1
+kind: CronJob
+spec:
+  schedule: "0 * * * *"
+  jobTemplate:
+    spec:
+      template:
+        spec:
+          containers:
+          - name: task
+            image: alpine
+"""
+    src = tmp_path / "cron.yaml"
+    src.write_text(cron)
+    out = tmp_path / "out.yaml"
+    rc = main([str(src), "-o", str(out), "--appns", "9"])
+    assert rc == 0
+    doc = yaml.safe_load(out.read_text())
+    c = doc["spec"]["jobTemplate"]["spec"]["template"]["spec"][
+        "containers"][0]
+    assert _envmap(c)["VPP_TPU_APPNS"] == "9"
+
+    # stdin/stdout mode; a manifest with no pod template exits 1
+    monkeypatch.setattr("sys.stdin",
+                        io.StringIO("apiVersion: v1\nkind: Service\n"
+                                    "spec: {ports: []}\n"))
+    rc = main(["-"])
+    assert rc == 1
+
+
+def test_init_containers_and_ld_preload_chaining():
+    """initContainers get the shim too (a wait-for-db init connect must
+    not bypass admission), and an existing LD_PRELOAD is chained after,
+    not clobbered (same contract as vcl_env)."""
+    manifest = """
+apiVersion: apps/v1
+kind: Deployment
+spec:
+  template:
+    spec:
+      initContainers:
+      - name: wait-db
+        image: busybox
+      containers:
+      - name: app
+        image: alpine
+        env:
+        - name: LD_PRELOAD
+          value: /usr/lib/libjemalloc.so
+"""
+    docs = list(yaml.safe_load_all(manifest))
+    inject_documents(docs, "/run/vpp-tpu/vcl.sock", "/opt/vpp-tpu/lib",
+                     appns=2, fail_closed=False)
+    tmpl = docs[0]["spec"]["template"]["spec"]
+    init_env = _envmap(tmpl["initContainers"][0])
+    assert init_env["LD_PRELOAD"] == "/opt/vpp-tpu/lib/libvclshim.so"
+    assert init_env["VPP_TPU_APPNS"] == "2"
+    app_env = _envmap(tmpl["containers"][0])
+    assert app_env["LD_PRELOAD"] == (
+        "/usr/lib/libjemalloc.so:/opt/vpp-tpu/lib/libvclshim.so")
+    # idempotent: no double-chaining on a second run
+    inject_documents(docs, "/run/vpp-tpu/vcl.sock", "/opt/vpp-tpu/lib",
+                     appns=2, fail_closed=False)
+    assert _envmap(tmpl["containers"][0])["LD_PRELOAD"] == (
+        "/usr/lib/libjemalloc.so:/opt/vpp-tpu/lib/libvclshim.so")

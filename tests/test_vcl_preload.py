@@ -240,6 +240,12 @@ def test_agent_serves_admission(tmp_path):
                             0, 8080))
         assert s.recv(1) == b"\x01"      # other namespace: allowed
         s.close()
+        # admission counters ride the node's Prometheus export
+        agent.stats.publish()
+        g = agent.stats.vcl_gauges
+        assert g["vpp_tpu_vcl_connect_checks"].get() == 2
+        assert g["vpp_tpu_vcl_connect_denies"].get() == 1
+        assert g["vpp_tpu_vcl_clients"].get() == 1
     finally:
         agent.close()
 
@@ -280,17 +286,17 @@ def test_nonblocking_accept_skips_denied_backlog(admission):
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
     try:
         port = int(srv.stdout.readline())
-        # deny inbound from source port 33001 specifically
+        # deny inbound from source port 23001 specifically
         engine.apply(add=[SessionRule(
             scope=int(RuleScope.GLOBAL), appns_index=GLOBAL_NS,
             transport_proto=6, lcl_net=ipi("127.0.0.1"), lcl_plen=32,
             rmt_net=ipi("127.0.0.1"), rmt_plen=32,
-            lcl_port=port, rmt_port=33001,
+            lcl_port=port, rmt_port=23001,
             action=int(RuleAction.DENY))])
 
         denied = socket.socket()
         denied.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        denied.bind(("127.0.0.1", 33001))
+        denied.bind(("127.0.0.1", 23001))
         denied.connect(("127.0.0.1", port))   # queued first
         allowed = socket.create_connection(("127.0.0.1", port),
                                            timeout=10)

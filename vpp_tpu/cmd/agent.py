@@ -374,6 +374,7 @@ class ContivAgent:
             self.vcl_admission = VclAdmissionServer(
                 self.session_engine, c.vcl_socket
             ).start()
+            self.stats.set_vcl(self.vcl_admission)
         if c.serve_http:
             self.cni_transport = CNITransportServer(
                 c.cni_socket, self.cni_server.dispatch

@@ -51,6 +51,13 @@ class VclAdmissionServer:
         self._sock: Optional[socket.socket] = None
         self._threads: list = []
         self._stop = threading.Event()
+        # admission counters (Prometheus via StatsCollector.set_vcl);
+        # plain int updates under one lock — verdicts are sequential
+        # per client but clients are concurrent
+        self._stats_lock = threading.Lock()
+        self.stats = {"connect_checks": 0, "connect_denies": 0,
+                      "accept_checks": 0, "accept_denies": 0,
+                      "clients": 0}
 
     def start(self) -> "VclAdmissionServer":
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
@@ -108,6 +115,18 @@ class VclAdmissionServer:
                              daemon=True).start()
 
     def _serve(self, conn: socket.socket) -> None:
+        # live connection count (one per app process in steady state;
+        # the shim reconnects after agent hiccups, so cumulative counts
+        # would inflate)
+        with self._stats_lock:
+            self.stats["clients"] += 1
+        try:
+            self._serve_inner(conn)
+        finally:
+            with self._stats_lock:
+                self.stats["clients"] -= 1
+
+    def _serve_inner(self, conn: socket.socket) -> None:
         try:
             while not self._stop.is_set():
                 buf = b""
@@ -122,9 +141,15 @@ class VclAdmissionServer:
                     ok = bool(self.engine.check_connect(
                         [(appns, proto, lcl_ip, lcl_port,
                           rmt_ip, rmt_port)])[0])
+                    with self._stats_lock:
+                        self.stats["connect_checks"] += 1
+                        self.stats["connect_denies"] += int(not ok)
                 elif op == OP_ACCEPT:
                     ok = bool(self.engine.check_accept(
                         [(proto, lcl_ip, lcl_port, rmt_ip, rmt_port)])[0])
+                    with self._stats_lock:
+                        self.stats["accept_checks"] += 1
+                        self.stats["accept_denies"] += int(not ok)
                 else:
                     log.warning("unknown admission op %#x", op)
                     ok = False

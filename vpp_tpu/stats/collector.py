@@ -61,6 +61,20 @@ PUMP_GAUGES = tuple(
      "p99 dispatch-to-tx batch latency (recent window)"),
 )
 
+VCL_GAUGES = (
+    ("vpp_tpu_vcl_connect_checks",
+     "ldpreload shim connect() admission checks served"),
+    ("vpp_tpu_vcl_connect_denies",
+     "ldpreload shim connect() verdicts denied by session rules"),
+    ("vpp_tpu_vcl_accept_checks",
+     "ldpreload shim accept() admission checks served"),
+    ("vpp_tpu_vcl_accept_denies",
+     "ldpreload shim accept() verdicts denied by session rules"),
+    ("vpp_tpu_vcl_clients",
+     "admission-socket connections currently open (one per live app "
+     "process in steady state)"),
+)
+
 NODE_GAUGES = (
     ("vpp_tpu_node_rx_packets", "total valid packets processed"),
     ("vpp_tpu_node_tx_packets", "total packets forwarded"),
@@ -119,6 +133,11 @@ class StatsCollector:
             name: self.registry.register(STATS_PATH, Gauge(name, help_))
             for name, help_ in PUMP_GAUGES
         }
+        self.vcl = None  # set_vcl(): admission counters -> gauges
+        self.vcl_gauges = {
+            name: self.registry.register(STATS_PATH, Gauge(name, help_))
+            for name, help_ in VCL_GAUGES
+        }
         self._known_labels: Dict[int, Dict[str, str]] = {}
         self._publish_lock = threading.Lock()
         # zero accumulators when an interface slot is freed, so a later
@@ -129,6 +148,11 @@ class StatsCollector:
         """Attach the IO pump (DataplanePump or the mesh ClusterPump —
         same stats contract) so publish() exports its counters."""
         self.pump = pump
+
+    def set_vcl(self, server) -> None:
+        """Attach the VclAdmissionServer so publish() exports its
+        admission counters."""
+        self.vcl = server
 
     def reset_interface(self, if_idx: int) -> None:
         with self._lock:
@@ -238,6 +262,13 @@ class StatsCollector:
                 lat["p50"])
             self.pump_gauges["vpp_tpu_pump_batch_latency_p99_us"].set(
                 lat["p99"])
+        vcl = self.vcl
+        if vcl is not None:
+            vs = dict(vcl.stats)
+            for key in ("connect_checks", "connect_denies",
+                        "accept_checks", "accept_denies", "clients"):
+                self.vcl_gauges[f"vpp_tpu_vcl_{key}"].set(
+                    int(vs.get(key, 0)))
 
 
 def register_ksr_gauges(
