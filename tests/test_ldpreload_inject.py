@@ -163,3 +163,27 @@ spec:
                      appns=2, fail_closed=False)
     assert _envmap(tmpl["containers"][0])["LD_PRELOAD"] == (
         "/usr/lib/libjemalloc.so:/opt/vpp-tpu/lib/libvclshim.so")
+
+
+def test_value_from_replaced():
+    """An env entry carrying valueFrom must lose it when we set a
+    literal value — value+valueFrom together is rejected by the API."""
+    manifest = """
+apiVersion: v1
+kind: Pod
+spec:
+  containers:
+  - name: app
+    image: alpine
+    env:
+    - name: VPP_TPU_APPNS
+      valueFrom:
+        fieldRef:
+          fieldPath: metadata.name
+"""
+    docs = list(yaml.safe_load_all(manifest))
+    inject_documents(docs, "/run/vpp-tpu/vcl.sock", "/opt/vpp-tpu/lib",
+                     appns=4, fail_closed=False)
+    entry = [e for e in docs[0]["spec"]["containers"][0]["env"]
+             if e["name"] == "VPP_TPU_APPNS"][0]
+    assert entry == {"name": "VPP_TPU_APPNS", "value": "4"}

@@ -239,13 +239,15 @@ def test_agent_serves_admission(tmp_path):
         s.sendall(_REQ.pack(OP_CONNECT, 6, 0, 6, 0, ipi("127.0.0.1"),
                             0, 8080))
         assert s.recv(1) == b"\x01"      # other namespace: allowed
-        s.close()
-        # admission counters ride the node's Prometheus export
+        # publish BEFORE closing: the server decrements the live-client
+        # gauge as soon as it sees our EOF, and losing that race would
+        # flake the clients==1 assertion
         agent.stats.publish()
         g = agent.stats.vcl_gauges
         assert g["vpp_tpu_vcl_connect_checks"].get() == 2
         assert g["vpp_tpu_vcl_connect_denies"].get() == 1
         assert g["vpp_tpu_vcl_clients"].get() == 1
+        s.close()
     finally:
         agent.close()
 

@@ -86,8 +86,12 @@ def run_capture() -> None:
         log(f"tunnel up — benching snapshot of {commit[:10]} in {tmp}")
         t0 = time.time()
         try:
+            # --inner: the watcher IS the supervisor here (deadline
+            # kill + sidecar salvage below); bench.py's own supervisor
+            # mode would nest a second cpu-fill run inside our window
             proc = subprocess.run(
-                [sys.executable, "bench.py", "--progress-out", sidecar],
+                [sys.executable, "bench.py", "--inner",
+                 "--progress-out", sidecar],
                 cwd=tmp, capture_output=True, text=True,
                 timeout=BENCH_DEADLINE_S,
             )

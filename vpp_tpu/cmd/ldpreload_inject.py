@@ -50,6 +50,9 @@ def _set_env(container: dict, name: str, value: str) -> None:
     env = env if isinstance(env, list) else []
     for e in env:
         if isinstance(e, dict) and e.get("name") == name:
+            # value + valueFrom together is rejected by the k8s API;
+            # our literal value replaces any valueFrom source
+            e.pop("valueFrom", None)
             if name == "LD_PRELOAD":
                 # chain after any existing preload (same contract as
                 # vcl_env: the app keeps its jemalloc/instrumentation)
