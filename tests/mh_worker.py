@@ -1,0 +1,131 @@
+"""Worker process for tests/test_multihost.py: one JAX process of a
+2-process cluster mesh (run directly, never imported by pytest).
+
+Builds its LOCAL nodes of a 4-node cluster (2 virtual CPU devices per
+process), publishes tables collectively, steps the fabric in lockstep,
+and prints one JSON verdict line the parent asserts on.
+"""
+
+import json
+import os
+import sys
+
+PROC_ID = int(sys.argv[1])
+NUM_PROCS = int(sys.argv[2])
+PORT = sys.argv[3]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from vpp_tpu.parallel.multihost import (  # noqa: E402
+    MultiHostCluster, barrier, init_multihost,
+)
+from vpp_tpu.ipam.ipam import IPAM  # noqa: E402
+from vpp_tpu.ir.rule import Action, ContivRule, Protocol  # noqa: E402
+from vpp_tpu.pipeline.tables import DataplaneConfig  # noqa: E402
+from vpp_tpu.pipeline.vector import Disposition  # noqa: E402
+import ipaddress  # noqa: E402
+
+init_multihost(f"127.0.0.1:{PORT}", NUM_PROCS, PROC_ID)
+
+N_NODES = 4
+cfg = DataplaneConfig(
+    max_tables=4, max_rules=16, max_global_rules=32, max_ifaces=8,
+    fib_slots=32, sess_slots=256, nat_mappings=4, nat_backends=16,
+)
+cluster = MultiHostCluster(N_NODES, cfg)
+assert cluster.local_nodes == ([0, 1] if PROC_ID == 0 else [2, 3]), \
+    cluster.local_nodes
+
+pod_ip = {}
+pod_if = {}
+for nid in cluster.local_nodes:
+    node = cluster.node(nid)
+    uplink = node.add_uplink()
+    ipam = IPAM(nid + 1)
+    pod = f"ns/pod{nid}"
+    ip = ipam.next_pod_ip(pod)
+    pod_ip[nid] = str(ip)
+    pod_if[nid] = node.add_pod_interface(pod)
+    node.builder.add_route(f"{ip}/32", pod_if[nid], Disposition.LOCAL)
+    for other in range(N_NODES):
+        if other != nid:
+            node.builder.add_route(
+                str(ipam.other_node_pod_network(other + 1)),
+                uplink, Disposition.REMOTE, node_id=other)
+    # node 3 additionally carries a deny-all-but-TCP/80 global table:
+    # fabric traffic enters through its uplink and must be filtered
+    if nid == 3:
+        node.builder.set_global_table([
+            ContivRule(action=Action.PERMIT, protocol=Protocol.TCP,
+                       dest_port=80),
+            ContivRule(action=Action.DENY),
+        ])
+
+barrier("staged")
+cluster.publish()
+
+# every process must know the cluster-wide pod addressing for the
+# scenario; it is deterministic from the IPAM arithmetic
+all_pod_ip = {n: str(IPAM(n + 1).next_pod_ip(f"ns/pod{n}"))
+              for n in range(N_NODES)}
+
+# lockstep step 1: pod0 (P0) -> pod2 (P1) allowed; pod1 -> pod3:80
+# allowed; pod1 -> pod3:22 denied by node 3's global table
+frames = [[] for _ in cluster.local_nodes]
+if PROC_ID == 0:
+    frames[0] = [dict(src=all_pod_ip[0], dst=all_pod_ip[2], proto=6,
+                      sport=1000, dport=8080, rx_if=pod_if[0])]
+    frames[1] = [
+        dict(src=all_pod_ip[1], dst=all_pod_ip[3], proto=6,
+             sport=1001, dport=80, rx_if=pod_if[1]),
+        dict(src=all_pod_ip[1], dst=all_pod_ip[3], proto=6,
+             sport=1002, dport=22, rx_if=pod_if[1]),
+    ]
+res = cluster.step(cluster.make_frames(frames, n=8), now=1)
+
+deliv_disp = cluster.local_rows(res.delivered.disp)
+deliv_dst = cluster.local_rows(res.delivered.pkts.dst_ip)
+deliv_txif = cluster.local_rows(res.delivered.tx_if)
+drop_acl = cluster.local_rows(res.stats.drop_acl)
+
+verdict = {"proc": PROC_ID, "local_nodes": cluster.local_nodes}
+if PROC_ID == 1:
+    # row 0 = node 2, row 1 = node 3 (host-local view)
+    n2_local = np.nonzero(deliv_disp[0] == int(Disposition.LOCAL))[0]
+    n3_local = np.nonzero(deliv_disp[1] == int(Disposition.LOCAL))[0]
+    verdict.update(
+        pod2_delivered=len(n2_local),
+        pod2_txif_ok=bool((deliv_txif[0][n2_local] == pod_if[2]).all()),
+        pod2_dst_ok=bool((deliv_dst[0][n2_local].astype(np.uint32)
+                          == int(ipaddress.ip_address(all_pod_ip[2]))
+                          ).all()),
+        pod3_delivered=len(n3_local),
+        node3_acl_drops=int(drop_acl[1]),
+    )
+else:
+    local_disp = cluster.local_rows(res.local.disp)
+    verdict.update(
+        sent_remote=int((local_disp[0][:1]
+                         == int(Disposition.REMOTE)).sum()
+                        + (local_disp[1][:2]
+                           == int(Disposition.REMOTE)).sum()))
+
+# lockstep step 2: reply path pod2 -> pod0 rides an established-flow
+# (session was installed at delivery) — proves sessions persist in the
+# global tables across collective steps
+frames2 = [[] for _ in cluster.local_nodes]
+if PROC_ID == 1:
+    frames2[0] = [dict(src=all_pod_ip[2], dst=all_pod_ip[0], proto=6,
+                       sport=8080, dport=1000, rx_if=pod_if[2])]
+res2 = cluster.step(cluster.make_frames(frames2, n=8), now=2)
+if PROC_ID == 0:
+    d = cluster.local_rows(res2.delivered.disp)
+    verdict["reply_delivered"] = int((d[0] == int(Disposition.LOCAL)).sum())
+
+barrier("done")
+print("VERDICT " + json.dumps(verdict), flush=True)
