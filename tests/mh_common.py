@@ -1,0 +1,31 @@
+"""Shared scaffolding for the multi-host worker scripts (run as
+standalone processes by tests/test_multihost.py, never collected)."""
+
+from vpp_tpu.ipam.ipam import IPAM
+from vpp_tpu.pipeline.vector import Disposition
+
+
+def stage_full_mesh(cluster):
+    """Uplink + one pod + full-mesh fabric routes on every LOCAL node;
+    returns {nid: pod_if}. Pod addressing is the deterministic IPAM
+    arithmetic, so every process can recompute any pod's IP."""
+    pod_if = {}
+    for nid in cluster.local_nodes:
+        node = cluster.node(nid)
+        uplink = node.add_uplink()
+        ipam = IPAM(nid + 1)
+        ip = ipam.next_pod_ip(f"ns/pod{nid}")
+        pod_if[nid] = node.add_pod_interface(f"ns/pod{nid}")
+        node.builder.add_route(f"{ip}/32", pod_if[nid],
+                               Disposition.LOCAL)
+        for other in range(cluster.n_nodes):
+            if other != nid:
+                node.builder.add_route(
+                    str(ipam.other_node_pod_network(other + 1)),
+                    uplink, Disposition.REMOTE, node_id=other)
+    return pod_if
+
+
+def pod_ips(n_nodes):
+    return {n: str(IPAM(n + 1).next_pod_ip(f"ns/pod{n}"))
+            for n in range(n_nodes)}

@@ -1,0 +1,95 @@
+"""Worker for test_multihost lockstep-commit scenario (run directly).
+
+Two JAX processes + one shared TCP kvstore: process 1 stages a
+policy change on ITS node mid-run and requests a commit through the
+store; the LockstepDriver's collective min-agreement makes both
+processes publish the new epoch on the same tick, and traffic that was
+flowing cross-process gets cut off cluster-wide.
+"""
+
+import json
+import os
+import sys
+
+PROC_ID = int(sys.argv[1])
+NUM_PROCS = int(sys.argv[2])
+PORT = sys.argv[3]
+KV_PORT = sys.argv[4]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from vpp_tpu.parallel.multihost import (  # noqa: E402
+    LockstepDriver, MultiHostCluster, barrier, init_multihost,
+)
+from mh_common import pod_ips, stage_full_mesh  # noqa: E402
+from vpp_tpu.ir.rule import Action, ContivRule  # noqa: E402
+from vpp_tpu.kvstore.client import connect_store  # noqa: E402
+from vpp_tpu.pipeline.tables import DataplaneConfig  # noqa: E402
+from vpp_tpu.pipeline.vector import Disposition  # noqa: E402
+
+init_multihost(f"127.0.0.1:{PORT}", NUM_PROCS, PROC_ID)
+
+N_NODES = 4
+cfg = DataplaneConfig(
+    max_tables=4, max_rules=16, max_global_rules=32, max_ifaces=8,
+    fib_slots=32, sess_slots=256, nat_mappings=4, nat_backends=16,
+)
+cluster = MultiHostCluster(N_NODES, cfg)
+store = connect_store(f"tcp://127.0.0.1:{KV_PORT}")
+driver = LockstepDriver(cluster, store)
+
+pod_if = stage_full_mesh(cluster)
+
+barrier("staged")
+cluster.publish()
+
+all_pod_ip = pod_ips(N_NODES)
+
+
+def frames_for_tick(sport):
+    """pod0 (P0) -> pod2 (P1); fresh sport each tick so no tick rides
+    the previous tick's reflective session."""
+    f = [[] for _ in cluster.local_nodes]
+    if PROC_ID == 0:
+        f[0] = [dict(src=all_pod_ip[0], dst=all_pod_ip[2], proto=6,
+                     sport=sport, dport=8080, rx_if=pod_if[0])]
+    return f
+
+
+def deliveries(res):
+    if PROC_ID != 1:
+        return -1
+    disp = cluster.local_rows(res.delivered.disp)
+    return int((disp[0] == int(Disposition.LOCAL)).sum())  # node 2 row
+
+
+verdict = {"proc": PROC_ID}
+
+res = driver.tick(frames_for_tick(1000), n=8)
+verdict["t1_delivered"] = deliveries(res)
+
+# P1 stages a deny-all on ITS node 2 and asks the fleet to commit
+if PROC_ID == 1:
+    cluster.node(2).builder.set_global_table(
+        [ContivRule(action=Action.DENY)])
+    driver.request_commit()
+barrier("change-requested")   # both processes have the request visible
+
+res = driver.tick(frames_for_tick(1001), n=8)
+verdict["t2_delivered"] = deliveries(res)
+verdict["t2_epoch"] = cluster.epoch
+if PROC_ID == 1:
+    verdict["t2_acl_drops"] = int(
+        cluster.local_rows(res.stats.drop_acl)[0])
+
+res = driver.tick(frames_for_tick(1002), n=8)
+verdict["t3_delivered"] = deliveries(res)
+verdict["applied"] = driver.applied
+
+barrier("done")
+print("VERDICT " + json.dumps(verdict), flush=True)

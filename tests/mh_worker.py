@@ -24,7 +24,7 @@ import numpy as np  # noqa: E402
 from vpp_tpu.parallel.multihost import (  # noqa: E402
     MultiHostCluster, barrier, init_multihost,
 )
-from vpp_tpu.ipam.ipam import IPAM  # noqa: E402
+from mh_common import pod_ips, stage_full_mesh  # noqa: E402
 from vpp_tpu.ir.rule import Action, ContivRule, Protocol  # noqa: E402
 from vpp_tpu.pipeline.tables import DataplaneConfig  # noqa: E402
 from vpp_tpu.pipeline.vector import Disposition  # noqa: E402
@@ -41,38 +41,20 @@ cluster = MultiHostCluster(N_NODES, cfg)
 assert cluster.local_nodes == ([0, 1] if PROC_ID == 0 else [2, 3]), \
     cluster.local_nodes
 
-pod_ip = {}
-pod_if = {}
-for nid in cluster.local_nodes:
-    node = cluster.node(nid)
-    uplink = node.add_uplink()
-    ipam = IPAM(nid + 1)
-    pod = f"ns/pod{nid}"
-    ip = ipam.next_pod_ip(pod)
-    pod_ip[nid] = str(ip)
-    pod_if[nid] = node.add_pod_interface(pod)
-    node.builder.add_route(f"{ip}/32", pod_if[nid], Disposition.LOCAL)
-    for other in range(N_NODES):
-        if other != nid:
-            node.builder.add_route(
-                str(ipam.other_node_pod_network(other + 1)),
-                uplink, Disposition.REMOTE, node_id=other)
-    # node 3 additionally carries a deny-all-but-TCP/80 global table:
-    # fabric traffic enters through its uplink and must be filtered
-    if nid == 3:
-        node.builder.set_global_table([
-            ContivRule(action=Action.PERMIT, protocol=Protocol.TCP,
-                       dest_port=80),
-            ContivRule(action=Action.DENY),
-        ])
+pod_if = stage_full_mesh(cluster)
+# node 3 additionally carries a deny-all-but-TCP/80 global table:
+# fabric traffic enters through its uplink and must be filtered
+if 3 in cluster.local_nodes:
+    cluster.node(3).builder.set_global_table([
+        ContivRule(action=Action.PERMIT, protocol=Protocol.TCP,
+                   dest_port=80),
+        ContivRule(action=Action.DENY),
+    ])
 
 barrier("staged")
 cluster.publish()
 
-# every process must know the cluster-wide pod addressing for the
-# scenario; it is deterministic from the IPAM arithmetic
-all_pod_ip = {n: str(IPAM(n + 1).next_pod_ip(f"ns/pod{n}"))
-              for n in range(N_NODES)}
+all_pod_ip = pod_ips(N_NODES)
 
 # lockstep step 1: pod0 (P0) -> pod2 (P1) allowed; pod1 -> pod3:80
 # allowed; pod1 -> pod3:22 denied by node 3's global table

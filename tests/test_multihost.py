@@ -15,6 +15,10 @@ import os
 import socket
 import subprocess
 import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
 
 def _free_port() -> int:
     s = socket.socket()
@@ -24,23 +28,30 @@ def _free_port() -> int:
     return port
 
 
-def test_two_process_fabric():
-    port = _free_port()
-    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "mh_worker.py")
+def _worker_env():
+    """Workers set their own JAX env; scrub the conftest's 8-device
+    forcing and any axon plugin so distributed init is clean."""
     env = dict(os.environ)
-    # the workers set their own JAX env; scrub the conftest's 8-device
-    # forcing and any axon plugin so distributed init is clean
     env.pop("XLA_FLAGS", None)
     env["PYTHONPATH"] = ":".join(
         p for p in env.get("PYTHONPATH", "").split(":")
         if p and "axon" not in p)
+    return env
+
+
+def _run_workers(script: str, extra_args=(), n_procs: int = 2):
+    """Spawn the worker processes and collect their VERDICT lines. A
+    failed worker must not orphan its peer inside a jax.distributed
+    collective — everyone is reaped on the way out."""
+    env = _worker_env()
+    coord_port = _free_port()
     procs = [
         subprocess.Popen(
-            [sys.executable, worker, str(pid), "2", str(port)],
+            [sys.executable, os.path.join(HERE, script), str(pid),
+             str(n_procs), str(coord_port), *map(str, extra_args)],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True)
-        for pid in range(2)
+        for pid in range(n_procs)
     ]
     outs = {}
     try:
@@ -51,12 +62,15 @@ def test_two_process_fabric():
                     if ln.startswith("VERDICT ")][-1]
             outs[pid] = json.loads(line[len("VERDICT "):])
     finally:
-        # one worker failing leaves its peer parked in a collective —
-        # never orphan it on the machine
         for p in procs:
             if p.poll() is None:
                 p.kill()
                 p.wait(timeout=30)
+    return outs
+
+
+def test_two_process_fabric():
+    outs = _run_workers("mh_worker.py")
 
     # P0 fabric-routed all three packets
     assert outs[0]["local_nodes"] == [0, 1]
@@ -70,3 +84,35 @@ def test_two_process_fabric():
     assert outs[1]["node3_acl_drops"] == 1
     # step 2: the reply crossed back P1 -> P0
     assert outs[0]["reply_delivered"] == 1
+
+
+def test_lockstep_commit_across_processes(tmp_path):
+    """Control-plane half of multi-host: process 1 stages a policy
+    change on its node and requests a commit through the shared
+    kvstore; the LockstepDriver's collective min-agreement makes BOTH
+    processes publish on the same tick — cross-process traffic that
+    flowed on tick 1 is cut off cluster-wide from tick 2."""
+    port_file = str(tmp_path / "kv.port")
+    kv = subprocess.Popen(
+        [sys.executable, "-m", "vpp_tpu.cmd.kvserver", "--host",
+         "127.0.0.1", "--port", "0", "--port-file", port_file],
+        env=_worker_env())
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not os.path.exists(port_file):
+            assert kv.poll() is None, \
+                f"kvserver died at startup (rc={kv.returncode})"
+            time.sleep(0.2)
+        kv_port = open(port_file).read().strip()
+        outs = _run_workers("mh_lockstep_worker.py", [kv_port])
+    finally:
+        kv.kill()
+        kv.wait(timeout=30)
+
+    v = outs[1]
+    assert v["t1_delivered"] == 1          # flowing before the commit
+    assert v["t2_epoch"] == 2              # both published on tick 2
+    assert v["t2_delivered"] == 0          # cut off the same tick
+    assert v["t2_acl_drops"] == 1
+    assert v["t3_delivered"] == 0
+    assert outs[0]["applied"] == 1 and outs[1]["applied"] == 1

@@ -129,20 +129,30 @@ class MultiHostCluster:
         split across processes). Sessions carry over."""
         arrs_by_node = {i: self.nodes[i].builder.host_arrays()
                         for i in self.local_nodes}
-        # the local half of ClusterDataplane.swap's misconfiguration
-        # guard: a locally-staged fabric route to a LOCAL node without
-        # an uplink would silently deliver onto reserved interface 0.
-        # Cross-process targets can't be checked here — that half of
-        # the contract is each owning process's own publish().
+        # ClusterDataplane.swap's misconfiguration guard, made
+        # COLLECTIVE: a fabric route to a node without an uplink means
+        # inbound traffic lands on reserved interface 0 and is silently
+        # dropped. Targets and uplinks live on different processes, so
+        # each contributes its local bitmap and every process checks
+        # the identical union.
+        local_targets = np.zeros(self.n_nodes, np.int32)
+        local_uplinked = np.zeros(self.n_nodes, np.int32)
         for i in self.local_nodes:
             arrs = arrs_by_node[i]
-            targets = arrs["fib_node_id"][arrs["fib_plen"] >= 0]
-            for t in np.unique(targets[targets >= 0]):
-                t = int(t)
-                if t in self.nodes and self.nodes[t].uplink_if is None:
-                    raise ValueError(
-                        f"node {i} routes to node {t}, which has no "
-                        "uplink interface (call add_uplink())")
+            t = arrs["fib_node_id"][arrs["fib_plen"] >= 0]
+            local_targets[np.unique(t[t >= 0])] = 1
+            if self.nodes[i].uplink_if is not None:
+                local_uplinked[i] = 1
+        gathered = np.asarray(multihost_utils.process_allgather(
+            np.stack([local_targets, local_uplinked])))
+        gathered = gathered.reshape(-1, 2, self.n_nodes)
+        targeted = gathered[:, 0].max(axis=0) > 0
+        uplinked = gathered[:, 1].max(axis=0) > 0
+        bad = np.nonzero(targeted & ~uplinked)[0]
+        if len(bad):
+            raise ValueError(
+                f"fabric routes target node(s) {bad.tolist()} which "
+                "have no uplink interface (call add_uplink())")
         local_stack = {}
         for k in DataplaneTables._fields:
             if k in SESSION_FIELDS:
@@ -169,6 +179,16 @@ class MultiHostCluster:
                       for i in self.local_nodes], np.int32),
             P(NODE_AXIS))
         self.epoch += 1
+        # per-node api-trace: drain AFTER the guard + assembly succeed,
+        # under the cluster epoch (same contract as
+        # ClusterDataplane.swap)
+        for i in self.local_nodes:
+            node = self.nodes[i]
+            if node.journal is not None:
+                with node._lock:
+                    txn = node.builder.drain_recording()
+                if txn is not None:
+                    node.journal.record(txn, self.epoch)
         return self.epoch
 
     def make_frames(self, per_local_node_packets: Sequence[list],
@@ -200,3 +220,61 @@ class MultiHostCluster:
         loc = multihost_utils.global_array_to_host_local_array(
             arr, self.mesh, P(NODE_AXIS))
         return np.asarray(loc)
+
+
+class LockstepDriver:
+    """Kvstore-coordinated epoch commits for a MultiHostCluster.
+
+    publish() is collective, but config changes originate on ONE host
+    (a policy event, a CNI Add). The protocol, per tick of the driver
+    loop every process runs:
+
+      1. the requesting process stages its builder mutations locally
+         (cross-host state rides the shared kvstore as usual — KSR,
+         node events) and bumps the ``commit_req`` counter (CAS);
+      2. every process reads the counter LOCALLY (no collective), then
+         the fleet agrees on ``min(process_allgather(seen))`` — a tiny
+         device collective, so the DECISION to publish is itself
+         deterministic and collective;
+      3. once every process has seen request N > applied, they all
+         publish() on the SAME tick, then step().
+
+    A process that hasn't noticed the request yet holds the whole
+    fleet's epoch back (min-agreement) but never deadlocks it — the
+    fabric keeps stepping on the old epoch until agreement lands.
+    Reference analog: renderer resync events fanning out of one ETCD
+    write to every vswitch (plugins/policy watch path); the collective
+    min replaces "eventually each node applies" with "all nodes apply
+    the same tick".
+    """
+
+    def __init__(self, cluster: MultiHostCluster, store,
+                 prefix: str = "/mesh/epoch/"):
+        self.cluster = cluster
+        self.store = store
+        self.req_key = prefix + "commit_req"
+        self.applied = 0
+        self.ticks = 0
+
+    def request_commit(self) -> int:
+        """Bump the commit counter (any process; CAS-safe)."""
+        while True:
+            cur = self.store.get(self.req_key)
+            nxt = int(cur or 0) + 1
+            if self.store.compare_and_put(self.req_key, cur, nxt):
+                return nxt
+
+    def tick(self, per_local_node_packets: Sequence[list],
+             n: int = 256) -> ClusterStepResult:
+        """COLLECTIVE: agree on pending commits, publish if the whole
+        fleet has seen one, then run one fabric step."""
+        seen = int(self.store.get(self.req_key) or 0)
+        agreed = int(multihost_utils.process_allgather(
+            np.int32(seen)).min())
+        if agreed > self.applied:
+            self.cluster.publish()
+            self.applied = agreed
+        self.ticks += 1
+        return self.cluster.step(
+            self.cluster.make_frames(per_local_node_packets, n=n),
+            now=self.ticks)
