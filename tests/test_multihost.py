@@ -10,6 +10,7 @@ and DCN between hosts (reference analog: the VXLAN full-mesh between
 DaemonSet replicas, plugins/contiv/node_events.go:184-250).
 """
 
+import contextlib
 import json
 import os
 import socket
@@ -86,12 +87,9 @@ def test_two_process_fabric():
     assert outs[0]["reply_delivered"] == 1
 
 
-def test_lockstep_commit_across_processes(tmp_path):
-    """Control-plane half of multi-host: process 1 stages a policy
-    change on its node and requests a commit through the shared
-    kvstore; the LockstepDriver's collective min-agreement makes BOTH
-    processes publish on the same tick — cross-process traffic that
-    flowed on tick 1 is cut off cluster-wide from tick 2."""
+@contextlib.contextmanager
+def _kvserver(tmp_path):
+    """Spawn a real TCP kvserver; yields its port, reaps on exit."""
     port_file = str(tmp_path / "kv.port")
     kv = subprocess.Popen(
         [sys.executable, "-m", "vpp_tpu.cmd.kvserver", "--host",
@@ -103,11 +101,21 @@ def test_lockstep_commit_across_processes(tmp_path):
             assert kv.poll() is None, \
                 f"kvserver died at startup (rc={kv.returncode})"
             time.sleep(0.2)
-        kv_port = open(port_file).read().strip()
-        outs = _run_workers("mh_lockstep_worker.py", [kv_port])
+        assert os.path.exists(port_file), "kvserver never wrote its port"
+        yield open(port_file).read().strip()
     finally:
         kv.kill()
         kv.wait(timeout=30)
+
+
+def test_lockstep_commit_across_processes(tmp_path):
+    """Control-plane half of multi-host: process 1 stages a policy
+    change on its node and requests a commit through the shared
+    kvstore; the LockstepDriver's collective min-agreement makes BOTH
+    processes publish on the same tick — cross-process traffic that
+    flowed on tick 1 is cut off cluster-wide from tick 2."""
+    with _kvserver(tmp_path) as kv_port:
+        outs = _run_workers("mh_lockstep_worker.py", [kv_port])
 
     v = outs[1]
     assert v["t1_delivered"] == 1          # flowing before the commit
@@ -116,3 +124,18 @@ def test_lockstep_commit_across_processes(tmp_path):
     assert v["t2_acl_drops"] == 1
     assert v["t3_delivered"] == 0
     assert outs[0]["applied"] == 1 and outs[1]["applied"] == 1
+
+
+def test_deployed_runtime_across_processes(tmp_path):
+    """The DEPLOYED multi-host form (vpp-tpu-mesh-agent --coordinator
+    shape): real ContivAgents on each process over a shared kvstore —
+    CNI pod adds, node events resolving peers to mesh positions across
+    the process boundary, fabric delivery, then a renderer-driven
+    policy cutoff — every commit riding LockstepDriver epochs."""
+    with _kvserver(tmp_path) as kv_port:
+        outs = _run_workers("mh_runtime_worker.py", [kv_port])
+
+    assert outs[0]["stage1_ok"] is True
+    assert outs[1]["stage1_delivered"] >= 1       # fabric worked
+    assert outs[1]["stage2_new_deliveries"] == 0  # policy cut it off
+    assert outs[1]["stage2_acl_drops"] >= 1

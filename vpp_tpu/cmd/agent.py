@@ -100,7 +100,9 @@ class ContivAgent:
         self._report_service = self.statuscheck.register("service")
 
         # --- node identity + IPAM ---
-        self.node_allocator = NodeIDAllocator(self.store, c.node_name)
+        self.node_allocator = NodeIDAllocator(
+            self.store, c.node_name,
+            liveness_ttl_s=c.node_liveness_ttl_s)
         self.node_id = self.node_allocator.get_or_allocate()
         broker = Broker(self.store, f"agent/{c.node_name}/")
         self.ipam = IPAM(self.node_id, c.ipam, broker=broker)

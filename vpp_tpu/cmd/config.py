@@ -64,6 +64,13 @@ class MeshConfig:
     nodes: int = 0          # mesh rows; 0 = one node per available device
                             # group (devices // rule_shards)
     rule_shards: int = 1    # global-ACL rule-axis shards per node
+    # multi-host (DCN): set all three to span processes/hosts —
+    # ``nodes`` then counts the WHOLE cluster's mesh rows and each
+    # process boots agents for the rows its local devices own
+    # (parallel/multihost.MultiHostRuntime). Requires store_url.
+    coordinator: str = ""   # jax.distributed coordinator host:port
+    num_processes: int = 0
+    process_id: int = -1
 
 
 @dataclasses.dataclass
@@ -84,6 +91,10 @@ class AgentConfig:
     # config transaction trace (api-trace analog): JSONL journal of every
     # NB commit the live agent applies; "" disables recording
     txn_journal_path: str = ""
+    # node liveness lease TTL (the etcd-lease analog; peers drop a
+    # node's routes when it expires). Raise where long jit compiles or
+    # heavy host contention can starve the keepalive thread.
+    node_liveness_ttl_s: float = 15.0
     # observability / health
     stats_port: int = 9999
     health_port: int = 9191

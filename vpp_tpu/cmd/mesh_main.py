@@ -40,6 +40,12 @@ def main(argv=None) -> int:
                              "all local devices / rule shards)")
     parser.add_argument("--rule-shards", type=int, default=None,
                         help="overrides mesh.rule_shards")
+    parser.add_argument("--coordinator", default=None,
+                        help="jax.distributed coordinator host:port — "
+                             "enables MULTI-HOST mode (one process per "
+                             "host; overrides mesh.coordinator)")
+    parser.add_argument("--num-processes", type=int, default=None)
+    parser.add_argument("--process-id", type=int, default=None)
     args = parser.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
@@ -51,11 +57,36 @@ def main(argv=None) -> int:
         else config.mesh.rule_shards
     )
     n_nodes = args.nodes if args.nodes is not None else config.mesh.nodes
-    if not n_nodes:
+    coordinator = (args.coordinator if args.coordinator is not None
+                   else config.mesh.coordinator)
+    if coordinator:
+        # multi-host: the SAME binary on every host, one process each;
+        # jax.distributed must come up before any backend touch, then
+        # n_nodes counts the WHOLE cluster's mesh rows
+        from vpp_tpu.parallel.multihost import (
+            MultiHostRuntime, init_multihost,
+        )
+
+        num_procs = (args.num_processes if args.num_processes is not None
+                     else config.mesh.num_processes)
+        proc_id = (args.process_id if args.process_id is not None
+                   else config.mesh.process_id)
+        if num_procs <= 0 or proc_id < 0:
+            parser.error("--coordinator requires --num-processes and "
+                         "--process-id (or the mesh.* config keys)")
+        init_multihost(coordinator, num_procs, proc_id)
         import jax
 
-        n_nodes = max(1, len(jax.devices()) // rule_shards)
-    runtime = MeshRuntime(n_nodes, config, rule_shards=rule_shards)
+        if not n_nodes:
+            n_nodes = max(1, len(jax.devices()) // rule_shards)
+        runtime = MultiHostRuntime(n_nodes, config,
+                                   rule_shards=rule_shards)
+    else:
+        if not n_nodes:
+            import jax
+
+            n_nodes = max(1, len(jax.devices()) // rule_shards)
+        runtime = MeshRuntime(n_nodes, config, rule_shards=rule_shards)
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: stop.set())

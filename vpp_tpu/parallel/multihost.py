@@ -29,7 +29,10 @@ pods the same code runs with one process per host
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -55,6 +58,8 @@ from vpp_tpu.pipeline.tables import (
     zero_sessions,
 )
 from vpp_tpu.pipeline.vector import PacketVector, make_packet_vector
+
+log = logging.getLogger("vpp_tpu.multihost")
 
 
 def init_multihost(coordinator_address: str, num_processes: int,
@@ -127,8 +132,16 @@ class MultiHostCluster:
         """COLLECTIVE: stack this process's staged node builders and
         assemble the global sharded table epoch (ClusterDataplane.swap
         split across processes). Sessions carry over."""
-        arrs_by_node = {i: self.nodes[i].builder.host_arrays()
-                        for i in self.local_nodes}
+        # copy under each node's lock: agent threads mutate builders
+        # concurrently and a torn row must never reach a global epoch
+        # (same contract as ClusterDataplane.swap)
+        arrs_by_node = {}
+        for i in self.local_nodes:
+            with self.nodes[i]._lock:
+                arrs_by_node[i] = {
+                    k: np.copy(v)
+                    for k, v in self.nodes[i].builder.host_arrays().items()
+                }
         # ClusterDataplane.swap's misconfiguration guard, made
         # COLLECTIVE: a fabric route to a node without an uplink means
         # inbound traffic lands on reserved interface 0 and is silently
@@ -140,7 +153,16 @@ class MultiHostCluster:
         for i in self.local_nodes:
             arrs = arrs_by_node[i]
             t = arrs["fib_node_id"][arrs["fib_plen"] >= 0]
-            local_targets[np.unique(t[t >= 0])] = 1
+            t = np.unique(t[t >= 0])
+            oob = t[t >= self.n_nodes]
+            if len(oob):
+                # a raw allocator id where a mesh POSITION belongs —
+                # name it instead of IndexError-ing inside a collective
+                raise ValueError(
+                    f"node {i} stages routes to node id(s) "
+                    f"{oob.tolist()} outside this {self.n_nodes}-node "
+                    "mesh (allocator id vs mesh position aliasing?)")
+            local_targets[t] = 1
             if self.nodes[i].uplink_if is not None:
                 local_uplinked[i] = 1
         gathered = np.asarray(multihost_utils.process_allgather(
@@ -253,28 +275,173 @@ class LockstepDriver:
         self.cluster = cluster
         self.store = store
         self.req_key = prefix + "commit_req"
+        self.stop_key = prefix + "stop_req"
         self.applied = 0
         self.ticks = 0
 
-    def request_commit(self) -> int:
-        """Bump the commit counter (any process; CAS-safe)."""
+    def _bump(self, key: str) -> int:
         while True:
-            cur = self.store.get(self.req_key)
+            cur = self.store.get(key)
             nxt = int(cur or 0) + 1
-            if self.store.compare_and_put(self.req_key, cur, nxt):
+            if self.store.compare_and_put(key, cur, nxt):
                 return nxt
 
+    def request_commit(self) -> int:
+        """Bump the commit counter (any process; CAS-safe)."""
+        return self._bump(self.req_key)
+
+    def request_stop(self) -> int:
+        """Ask the WHOLE fleet to stop ticking: collectives can't be
+        abandoned unilaterally (a peer blocked in one would hang), so
+        shutdown is agreed the same way commits are."""
+        return self._bump(self.stop_key)
+
     def tick(self, per_local_node_packets: Sequence[list],
-             n: int = 256) -> ClusterStepResult:
-        """COLLECTIVE: agree on pending commits, publish if the whole
-        fleet has seen one, then run one fabric step."""
-        seen = int(self.store.get(self.req_key) or 0)
-        agreed = int(multihost_utils.process_allgather(
-            np.int32(seen)).min())
-        if agreed > self.applied:
+             n: int = 256) -> Optional[ClusterStepResult]:
+        """COLLECTIVE: agree on pending commits/stop, publish if the
+        whole fleet has seen a commit, then run one fabric step.
+        Returns None once the fleet has agreed to stop — no further
+        collectives may be issued after that."""
+        seen = np.int32([int(self.store.get(self.req_key) or 0),
+                         int(self.store.get(self.stop_key) or 0)])
+        agreed = np.asarray(
+            multihost_utils.process_allgather(seen)
+        ).reshape(-1, 2).min(axis=0)
+        if int(agreed[1]) > 0:
+            return None
+        if int(agreed[0]) > self.applied:
             self.cluster.publish()
-            self.applied = agreed
+            self.applied = int(agreed[0])
         self.ticks += 1
         return self.cluster.step(
             self.cluster.make_frames(per_local_node_packets, n=n),
             now=self.ticks)
+
+
+class MultiHostRuntime:
+    """The DEPLOYABLE multi-host mesh: real ContivAgents per local
+    node over a cross-process MultiHostCluster.
+
+    One MultiHostRuntime per host (vpp-tpu-mesh-agent
+    --coordinator ...): each boots agents for the mesh rows its
+    devices own, the agents' unchanged renderer/CNI/service/node-event
+    commit paths STAGE into their node builders, and every commit is
+    routed through LockstepDriver.request_commit — the swap-delegate
+    analog of MeshRuntime, except the publish happens on the next
+    agreed tick instead of inline (the same eventual-apply the
+    reference gets from ETCD watch fan-out). A tick thread steps the
+    fabric at a fixed cadence; collectives self-synchronize, so the
+    fleet runs at the slowest host's pace.
+
+    Cross-process peer resolution rides the shared kvstore: each agent
+    publishes (allocator node id -> mesh position) and the resolver
+    reads peers' entries, so node events on ANY host produce fabric
+    routes toward the right mesh row.
+    """
+
+    POS_PREFIX = "/mesh/pos/"
+
+    def __init__(self, n_nodes: int, base_config, rule_shards: int = 1,
+                 store=None, tick_interval: float = 0.02,
+                 frame_n: int = 256,
+                 on_result: Optional[Callable] = None):
+        from vpp_tpu.cmd.agent import ContivAgent
+        from vpp_tpu.kvstore.client import connect_store
+        from vpp_tpu.parallel.runtime import _node_config
+
+        if store is None:
+            if not base_config.store_url:
+                raise ValueError(
+                    "multi-host mesh requires store_url (a kvstore "
+                    "shared by every host)")
+            store = connect_store(base_config.store_url,
+                                  persist_path=base_config.persist_path)
+        self.store = store
+        if base_config.io.enabled:
+            # a per-host cluster pump over the multi-host mesh is not
+            # built yet; silently booting agents whose IO plan spawns a
+            # daemon with no rings would blackhole real NIC traffic
+            raise ValueError(
+                "io.enabled is not supported in multi-host mesh mode "
+                "yet: packet IO reaches the fabric via inject()/host "
+                "front-ends only (disable io or use single-host "
+                "vpp-tpu-mesh-agent)")
+        self.cluster = MultiHostCluster(
+            n_nodes, base_config.dataplane, rule_shards)
+        self.n_nodes = n_nodes
+        self.driver = LockstepDriver(self.cluster, store)
+        self.tick_interval = tick_interval
+        self.frame_n = frame_n
+        self.on_result = on_result
+        self.last_result: Optional[ClusterStepResult] = None
+        for i in self.cluster.local_nodes:
+            self.cluster.node(i)._swap_delegate = \
+                self.driver.request_commit
+
+        def resolver(nid: int) -> int:
+            v = self.store.get(self.POS_PREFIX + str(int(nid)))
+            return -1 if v is None else int(v)
+
+        self.agents = []
+        for i in self.cluster.local_nodes:
+            cfg = _node_config(base_config, i)
+            agent = ContivAgent(cfg, store=store,
+                                dataplane=self.cluster.node(i),
+                                mesh_node_resolver=resolver)
+            agent._external_io = True  # no per-agent pump on node handles
+            self.store.put(self.POS_PREFIX + str(agent.node_id), i)
+            self.agents.append(agent)
+        self._frames_lock = threading.Lock()
+        self._pending: Dict[int, list] = {
+            i: [] for i in self.cluster.local_nodes}
+        self._tick_thread: Optional[threading.Thread] = None
+
+    # --- traffic injection (tests / local IO front-ends) ---
+    def inject(self, node: int, packets: Sequence[dict]) -> None:
+        with self._frames_lock:
+            self._pending[node].extend(packets)
+
+    def _drain(self) -> List[list]:
+        with self._frames_lock:
+            out = [self._pending[i][:self.frame_n]
+                   for i in self.cluster.local_nodes]
+            for i in self.cluster.local_nodes:
+                del self._pending[i][:self.frame_n]
+            return out
+
+    # --- lifecycle ---
+    def start(self) -> "MultiHostRuntime":
+        for agent in self.agents:
+            agent.start()
+        self._tick_thread = threading.Thread(
+            target=self._loop, daemon=True, name="mh-tick")
+        self._tick_thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while True:
+            try:
+                res = self.driver.tick(self._drain(), n=self.frame_n)
+            except Exception:
+                # a failed collective leaves the fleet out of step —
+                # there is no local recovery; stop ticking and surface
+                log.exception("mesh tick failed; fabric halted")
+                return
+            if res is None:
+                return  # fleet agreed to stop
+            self.last_result = res
+            if self.on_result is not None:
+                self.on_result(res)
+            time.sleep(self.tick_interval)
+
+    def close(self, join_timeout: float = 60.0) -> None:
+        if self._tick_thread is not None:
+            self.driver.request_stop()
+            self._tick_thread.join(timeout=join_timeout)
+            if self._tick_thread.is_alive():
+                # a dead peer strands our tick thread inside a
+                # collective; nothing safe to do but report (process
+                # exit reclaims it)
+                log.error("tick thread did not stop (peer host down?)")
+        for agent in reversed(self.agents):
+            agent.close()
