@@ -41,7 +41,8 @@ cfg = DataplaneConfig(
 )
 cluster = MultiHostCluster(N_NODES, cfg)
 store = connect_store(f"tcp://127.0.0.1:{KV_PORT}")
-driver = LockstepDriver(cluster, store)
+# expire_every=3: tick 3 runs the collective session aging pass too
+driver = LockstepDriver(cluster, store, expire_every=3)
 
 pod_if = stage_full_mesh(cluster)
 

@@ -236,6 +236,20 @@ class MultiHostCluster:
         self.tables = res.tables
         return res
 
+    def expire_sessions(self, now: int,
+                        max_age: Optional[int] = None) -> None:
+        """COLLECTIVE: bulk-age the global session tables (reflective +
+        NAT) — the ClusterDataplane.expire_sessions analog. In-kernel
+        timeouts already hide expired entries from lookups; this frees
+        slots in bulk. ``now`` must be the fleet-agreed tick."""
+        from vpp_tpu.ops.session import session_expire
+
+        if self.tables is None:
+            return
+        if max_age is None:
+            max_age = self.config.sess_max_age
+        self.tables = session_expire(self.tables, now, max_age)
+
     # --- host-local views of a step result ---
     def local_rows(self, arr) -> np.ndarray:
         """This process's node rows of a node-stacked global output."""
@@ -271,13 +285,18 @@ class LockstepDriver:
     """
 
     def __init__(self, cluster: MultiHostCluster, store,
-                 prefix: str = "/mesh/epoch/"):
+                 prefix: str = "/mesh/epoch/",
+                 expire_every: int = 512):
         self.cluster = cluster
         self.store = store
         self.req_key = prefix + "commit_req"
         self.stop_key = prefix + "stop_req"
         self.applied = 0
         self.ticks = 0
+        # session aging cadence (in ticks): deterministic from the
+        # shared tick count, so the collective expire runs on the same
+        # tick fleet-wide
+        self.expire_every = expire_every
 
     def _bump(self, key: str) -> int:
         while True:
@@ -313,9 +332,12 @@ class LockstepDriver:
             self.cluster.publish()
             self.applied = int(agreed[0])
         self.ticks += 1
-        return self.cluster.step(
+        res = self.cluster.step(
             self.cluster.make_frames(per_local_node_packets, n=n),
             now=self.ticks)
+        if self.expire_every and self.ticks % self.expire_every == 0:
+            self.cluster.expire_sessions(now=self.ticks)
+        return res
 
 
 class MultiHostRuntime:
