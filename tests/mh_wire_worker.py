@@ -1,0 +1,217 @@
+"""Worker for the multi-host WIRE e2e (run directly, not collected).
+
+io.enabled multi-host: real wire frames (Ethernet/IP/UDP bytes) enter
+one host's per-node rx ring, ride the fabric all_to_all — headers AND
+payload — across the process boundary, and come out the destination
+host's tx ring; then a renderer-driven deny cuts the path. The tick
+loop drives the ClusterPump's dispatch so the wire step interleaves
+deterministically with the lockstep driver's other collectives.
+"""
+
+import json
+import logging
+import os
+import sys
+
+import time
+
+PROC_ID = int(sys.argv[1])
+NUM_PROCS = int(sys.argv[2])
+COORD_PORT = sys.argv[3]
+KV_PORT = sys.argv[4]
+
+if os.environ.get("MH_DEBUG"):
+    logging.basicConfig(level=logging.INFO)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+sys.path.insert(0, HERE)  # tests/wire.py
+
+import numpy as np  # noqa: E402
+
+from vpp_tpu.parallel.multihost import (  # noqa: E402
+    MultiHostRuntime, init_multihost,
+)
+from vpp_tpu.cmd import AgentConfig  # noqa: E402
+from vpp_tpu.cmd.config import IOConfig  # noqa: E402
+from vpp_tpu.cni.model import CNIRequest  # noqa: E402
+from vpp_tpu.native.pktio import PacketCodec  # noqa: E402
+from vpp_tpu.pipeline.vector import Disposition  # noqa: E402
+from wire import make_frame  # noqa: E402
+
+init_multihost(f"127.0.0.1:{COORD_PORT}", NUM_PROCS, PROC_ID)
+
+cfg = AgentConfig(
+    node_name="mhw", serve_http=False,
+    store_url=f"tcp://127.0.0.1:{KV_PORT}",
+    node_liveness_ttl_s=120.0,
+    io=IOConfig(enabled=True, n_slots=16, snap=256),
+)
+runtime = MultiHostRuntime(4, cfg, tick_interval=0.02)
+store = runtime.store
+runtime.start()
+
+SNAP = 256
+
+
+def wait_for(pred, what, deadline_s=90.0):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(0.1)
+    raise TimeoutError(f"waiting for {what}")
+
+
+def add_pod(agent, cid, name):
+    reply = agent.cni_server.add(CNIRequest(
+        container_id=cid,
+        extra_args={"K8S_POD_NAME": name, "K8S_POD_NAMESPACE": "default"},
+    ))
+    assert reply.result == 0, reply
+    return reply.interfaces[0].ip_addresses[0].address.split("/")[0]
+
+
+verdict = {"proc": PROC_ID, "local_nodes": runtime.cluster.local_nodes}
+my_agent = runtime.agents[0]
+pod_name = f"pod{runtime.cluster.local_nodes[0]}"
+my_ip = add_pod(my_agent, f"cid-{pod_name}", pod_name)
+store.put(f"/test/{pod_name}_ip", my_ip)
+ip0 = wait_for(lambda: store.get("/test/pod0_ip"), "pod0 ip")
+ip2 = wait_for(lambda: store.get("/test/pod2_ip"), "pod2 ip")
+wait_for(lambda: runtime.driver.applied >= 1, "first epoch")
+
+codec = PacketCodec(snap=SNAP)
+
+
+def push_wire(sport):
+    """One UDP wire frame pod0 -> pod2 into node0's rx ring (P0)."""
+    scratch = np.zeros((SNAP, SNAP), np.uint8)
+    lens = np.zeros(SNAP, np.uint32)
+    f = make_frame(ip0, ip2, proto=17, sport=sport, dport=5000,
+                   payload=b"vppt" + b"x" * 28)
+    scratch[0, :len(f)] = np.frombuffer(f, np.uint8)
+    lens[0] = len(f)
+    if_a = my_agent.dataplane.pod_if[("default", "pod0")]
+    cols, k = codec.parse_inplace(scratch, lens, 1, if_a)
+    assert runtime.ring_pairs[0].rx.push(cols, k, payload=scratch)
+
+
+def drain_tx_count(ip_dst):
+    """P1: pop node 2's tx ring; count delivered frames to ip_dst with
+    intact UDP payload bytes."""
+    import ipaddress
+
+    want = int(ipaddress.ip_address(ip_dst))
+    got = 0
+    while True:
+        fr = runtime.ring_pairs[0].tx.peek()
+        if fr is None:
+            return got
+        for s_ in range(fr.n):
+            if (int(fr.cols["dst_ip"][s_]) == want
+                    and fr.cols["disp"][s_] == int(Disposition.LOCAL)
+                    and fr.cols["proto"][s_] == 17):
+                # wire.py's UDP payload body survives the fabric
+                assert bytes(fr.payload[s_, 42:46]) == b"vppt", \
+                    bytes(fr.payload[s_, 40:60])
+                got += 1
+        runtime.ring_pairs[0].tx.release()
+
+
+if PROC_ID == 0:
+    seq = iter(range(20000, 29000))
+
+    def delivered():
+        # unique sport per push: a repeated 5-tuple would install a
+        # reflective session that (correctly) outlives the later policy
+        # and pollute the stage-2 verdict
+        push_wire(next(seq))
+        time.sleep(0.1)
+        return int(store.get("/test/wire1_count") or 0) > 0
+
+    wait_for(delivered, "wire delivery", 120)
+    verdict["stage1_ok"] = True
+    # the retry loop may have queued a backlog (pushes outpace the
+    # 1-frame/ring/tick fleet-agreed drain) — let it fully flush before
+    # the peer snapshots its pre-policy counters
+    base = runtime.driver.ticks
+    wait_for(lambda: runtime.driver.ticks > base + 24, "backlog drain")
+    store.put("/test/stage1_drained", True)
+    # stage 2: serve fresh-sport waves on request until the peer is
+    # done evaluating the policy cutoff
+    sport = iter(range(30000, 60000))
+    acked = 0
+    deadline = time.monotonic() + 150
+    while time.monotonic() < deadline:
+        if store.get("/test/p1_done"):
+            break
+        req = int(store.get("/test/wave_req") or 0)
+        if req > acked:
+            base = runtime.driver.ticks
+            for _ in range(4):
+                push_wire(next(sport))
+            wait_for(lambda: runtime.driver.ticks > base + 5,
+                     "wave ticks", 60)
+            acked = req
+            store.put("/test/wave_ack", acked)
+        else:
+            time.sleep(0.1)
+else:
+    total = 0
+
+    def got_wire():
+        global total
+        total += drain_tx_count(my_ip)
+        if total:
+            store.put("/test/wire1_count", total)
+        return total
+
+    wait_for(got_wire, "wire delivery at pod2", 120)
+    verdict["wire_delivered"] = total
+    wait_for(lambda: store.get("/test/stage1_drained"),
+             "sender backlog drained", 120)
+
+    # isolate pod2 through the agent's REAL policy machinery: a KSR
+    # Pod (labels) + an ingress NetworkPolicy with no rules, written to
+    # the shared store exactly as contiv-ksr would — the agent's watch
+    # -> processor -> renderer path stages the deny and its commit
+    # rides the lockstep epoch. (A test-owned TpuRenderer would race
+    # the agent's own renderer over the global table.)
+    from vpp_tpu.cmd.ksr_main import KsrAgent
+    from vpp_tpu.ksr import model as m
+
+    ksr = KsrAgent(store=store, serve_http=False)
+    ksr.start()
+    ksr.sources[m.Pod.TYPE].add("default/pod2", m.Pod(
+        name="pod2", namespace="default",
+        labels={"app": "pod2"}, ip_address=my_ip))
+    ksr.sources[m.Policy.TYPE].add("default/iso", m.Policy(
+        name="iso", namespace="default",
+        pods=m.LabelSelector(match_labels={"app": "pod2"}),
+        policy_type=m.POLICY_INGRESS, ingress_rules=[]))
+
+    # converge: waves of fresh-sport frames from P0 until one FULL wave
+    # yields zero deliveries (policy propagation is async: watch ->
+    # commit -> agreed publish)
+    cut = False
+    deadline = time.monotonic() + 120
+    wave = 0
+    while time.monotonic() < deadline and not cut:
+        drain_tx_count(my_ip)              # discard anything in flight
+        wave += 1
+        store.put("/test/wave_req", wave)
+        wait_for(lambda: int(store.get("/test/wave_ack") or 0) >= wave,
+                 f"wave {wave} ack", 60)
+        base = runtime.driver.ticks
+        wait_for(lambda: runtime.driver.ticks > base + 6,
+                 "wave settle", 60)
+        cut = drain_tx_count(my_ip) == 0
+    verdict["stage2_cut"] = cut
+    store.put("/test/p1_done", True)
+
+runtime.close()
+print("VERDICT " + json.dumps(verdict), flush=True)

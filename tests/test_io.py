@@ -536,7 +536,10 @@ class TestCrossProcessDaemon:
         try:
             frame = make_frame(CLIENT_IP, SERVER_IP, proto=6, dport=80)
             out = None
-            deadline = time.monotonic() + 20
+            # generous: covers the daemon subprocess's interpreter boot
+            # on a loaded single-core host (observed >20 s under the
+            # race harness with a concurrent suite)
+            deadline = time.monotonic() + 60
             srv_sock = pairs["server"][1].sock
             srv_sock.setblocking(True)
             srv_sock.settimeout(1.0)

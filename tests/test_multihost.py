@@ -139,3 +139,19 @@ def test_deployed_runtime_across_processes(tmp_path):
     assert outs[1]["stage1_delivered"] >= 1       # fabric worked
     assert outs[1]["stage2_new_deliveries"] == 0  # policy cut it off
     assert outs[1]["stage2_acl_drops"] >= 1
+
+
+def test_wire_path_across_processes(tmp_path):
+    """io.enabled multi-host: real wire frames (Ethernet/IP/UDP bytes)
+    pushed into one host's per-node rx ring ride the fabric — headers
+    AND payload — across the process boundary and surface on the
+    destination host's tx ring with the UDP body intact; a
+    renderer-driven deny then cuts the wire path. The ClusterPump runs
+    tick-driven (writer thread only), so its collective wire step
+    interleaves deterministically with the lockstep driver."""
+    with _kvserver(tmp_path) as kv_port:
+        outs = _run_workers("mh_wire_worker.py", [kv_port])
+
+    assert outs[0]["stage1_ok"] is True
+    assert outs[1]["wire_delivered"] >= 1
+    assert outs[1]["stage2_cut"] is True

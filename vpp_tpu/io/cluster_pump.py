@@ -156,7 +156,9 @@ class ClusterPump:
         — a mid-traffic recompile costs minutes on a small host)."""
         import jax
 
-        for p in (VEC, VEC * MAX_FRAMES):
+        buckets = ((VEC,) if self.max_frames_per_ring <= 1
+                   else (VEC, VEC * MAX_FRAMES))
+        for p in buckets:
             cols, payload = self._stage_buffers(p)
             jax.block_until_ready(
                 self.cluster.step_wire(self._pv_from(cols), payload,
@@ -164,9 +166,25 @@ class ClusterPump:
             )
 
     # --- lifecycle ---
-    def start(self) -> "ClusterPump":
-        for fn, name in ((self._dispatch_loop, "cluster-pump-dispatch"),
-                         (self._write_loop, "cluster-pump-tx")):
+    # multi-host tick mode: the step is a COLLECTIVE, so an idle host
+    # must still dispatch (empty staging) to pair with a peer that has
+    # traffic — the tick driver, not this class, owns the cadence
+    step_when_idle = False
+    # multi-host tick mode: the coalesce bucket must be FLEET-AGREED —
+    # p_cap derived from the LOCAL backlog would make hosts stage
+    # different global shapes and issue mismatched collectives (gloo
+    # aborts). 1 pins every host to the VEC bucket deterministically.
+    max_frames_per_ring = MAX_FRAMES
+
+    def start(self, dispatch: bool = True) -> "ClusterPump":
+        """``dispatch=False``: writer thread only — an external tick
+        driver calls ``_dispatch_once()`` itself (multi-host lockstep,
+        where the fabric step must interleave deterministically with
+        the driver's other collectives)."""
+        loops = [(self._write_loop, "cluster-pump-tx")]
+        if dispatch:
+            loops.insert(0, (self._dispatch_loop, "cluster-pump-dispatch"))
+        for fn, name in loops:
             t = threading.Thread(target=fn, daemon=True, name=name)
             t.start()
             self._threads.append(t)
@@ -198,9 +216,10 @@ class ClusterPump:
     def _dispatch_once(self) -> bool:
         n = self.cluster.n_nodes
         per_node: List[list] = []   # (frame, from_ring) pairs
+        cap = self.max_frames_per_ring
         with self._err_lock:
             err_frames = [
-                self._err_q[i][:MAX_FRAMES] for i in range(n)
+                self._err_q[i][:cap] for i in range(n)
             ]
             for i in range(n):
                 del self._err_q[i][:len(err_frames[i])]
@@ -212,7 +231,7 @@ class ClusterPump:
             for i, r in enumerate(self.rings):
                 lst = [(ef, False) for ef in err_frames[i]]
                 taken = 0
-                for k in range(MAX_FRAMES - len(lst)):
+                for k in range(cap - len(lst)):
                     f = r.rx.peek_nth(self._held[i] + k)
                     if f is None:
                         break
@@ -220,7 +239,7 @@ class ClusterPump:
                     taken += 1
                 self._held[i] += taken
                 per_node.append(lst)
-        if all(not lst for lst in per_node):
+        if all(not lst for lst in per_node) and not self.step_when_idle:
             return False
         t0 = time.perf_counter()
         try:

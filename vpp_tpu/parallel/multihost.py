@@ -119,6 +119,7 @@ class MultiHostCluster:
         self.epoch = 0
         self._specs = table_specs()
         self._step = make_cluster_step(self.mesh)
+        self._wire_step = None  # built on first step_wire
 
     def node(self, i: int) -> Dataplane:
         return self.nodes[i]
@@ -236,6 +237,23 @@ class MultiHostCluster:
         self.tables = res.tables
         return res
 
+    def step_wire(self, pkts: PacketVector, payload, now: int):
+        """COLLECTIVE: wire-traffic step — headers AND payload bytes
+        ride the fabric (ClusterDataplane.step_wire analog; dense
+        classify only — the MXU selection is per-epoch cluster state
+        the multi-host publish does not track yet)."""
+        from vpp_tpu.parallel.cluster import make_cluster_step_wire
+
+        if self.tables is None:
+            raise RuntimeError("publish() first")
+        if self._wire_step is None:
+            self._wire_step = make_cluster_step_wire(self.mesh)
+        result, deliv_pay = self._wire_step(
+            self.tables, pkts, jnp.asarray(payload), jnp.int32(now),
+            self._uplinks)
+        self.tables = result.tables
+        return result, deliv_pay
+
     def expire_sessions(self, now: int,
                         max_age: Optional[int] = None) -> None:
         """COLLECTIVE: bulk-age the global session tables (reflective +
@@ -293,6 +311,17 @@ class LockstepDriver:
         self.stop_key = prefix + "stop_req"
         self.applied = 0
         self.ticks = 0
+        # stop requests are counted RELATIVE to construction: a stop
+        # agreed by a PREVIOUS deployment persists in the store and
+        # must not halt a restarted fleet on its first tick. The
+        # baseline itself is AGREED (max over an allgather of each
+        # process's read) — divergent local reads racing an old
+        # fleet's final bump would otherwise stop one process and
+        # strand the rest in their next collective. Construction is
+        # therefore collective; every process builds its driver at the
+        # same point in startup.
+        self._stop_base = int(np.asarray(multihost_utils.process_allgather(
+            np.int32(int(self.store.get(self.stop_key) or 0)))).max())
         # session aging cadence (in ticks): deterministic from the
         # shared tick count, so the collective expire runs on the same
         # tick fleet-wide
@@ -321,23 +350,73 @@ class LockstepDriver:
         whole fleet has seen a commit, then run one fabric step.
         Returns None once the fleet has agreed to stop — no further
         collectives may be issued after that."""
+        out = self.tick_fabric(lambda t: self.cluster.step(
+            self.cluster.make_frames(per_local_node_packets, n=n),
+            now=t))
+        return None if out is self._STOPPED else out
+
+    _STOPPED = object()
+
+    def tick_fabric(self, fabric_fn):
+        """COLLECTIVE tick with a caller-supplied fabric step (the wire
+        pump's ring->device->ring dispatch). Same agreement protocol as
+        tick(); returns ``LockstepDriver._STOPPED`` once the fleet
+        agreed to stop, else ``fabric_fn(tick)``'s result. fabric_fn
+        MUST issue the identical collective sequence on every process."""
         seen = np.int32([int(self.store.get(self.req_key) or 0),
                          int(self.store.get(self.stop_key) or 0)])
         agreed = np.asarray(
             multihost_utils.process_allgather(seen)
         ).reshape(-1, 2).min(axis=0)
-        if int(agreed[1]) > 0:
-            return None
+        if int(agreed[1]) > self._stop_base:
+            return self._STOPPED
         if int(agreed[0]) > self.applied:
             self.cluster.publish()
             self.applied = int(agreed[0])
         self.ticks += 1
-        res = self.cluster.step(
-            self.cluster.make_frames(per_local_node_packets, n=n),
-            now=self.ticks)
+        out = fabric_fn(self.ticks)
         if self.expire_every and self.ticks % self.expire_every == 0:
             self.cluster.expire_sessions(now=self.ticks)
-        return res
+        return out
+
+
+class _LocalWireView:
+    """Cluster-shaped LOCAL view for ClusterPump in multi-host mode.
+
+    The pump stages/reads only THIS host's mesh rows; ``step_wire``
+    lifts the local staging to global arrays, runs the COLLECTIVE wire
+    step, and hands back host-local rows so the pump's writer never
+    touches non-addressable shards. ``now`` is set per tick by the
+    runtime (the fleet-agreed tick, not wall clock)."""
+
+    def __init__(self, mh: MultiHostCluster):
+        self.mh = mh
+        self.now = 0
+
+    @property
+    def n_nodes(self) -> int:
+        return len(self.mh.local_nodes)
+
+    @property
+    def epoch(self) -> int:
+        return self.mh.epoch
+
+    def step_wire(self, pkts: PacketVector, payload, now=None):
+        import types
+
+        mh = self.mh
+        g_pkts = jax.tree.map(
+            lambda a: mh._to_global(np.asarray(a), P(NODE_AXIS)), pkts)
+        g_pay = mh._to_global(np.ascontiguousarray(payload), P(NODE_AXIS))
+        res, dpay = mh.step_wire(
+            g_pkts, g_pay, now=self.now if now is None else now)
+
+        def localize(tree):
+            return jax.tree.map(mh.local_rows, tree)
+
+        return (types.SimpleNamespace(local=localize(res.local),
+                                      delivered=localize(res.delivered)),
+                mh.local_rows(dpay))
 
 
 class MultiHostRuntime:
@@ -379,15 +458,6 @@ class MultiHostRuntime:
             store = connect_store(base_config.store_url,
                                   persist_path=base_config.persist_path)
         self.store = store
-        if base_config.io.enabled:
-            # a per-host cluster pump over the multi-host mesh is not
-            # built yet; silently booting agents whose IO plan spawns a
-            # daemon with no rings would blackhole real NIC traffic
-            raise ValueError(
-                "io.enabled is not supported in multi-host mesh mode "
-                "yet: packet IO reaches the fabric via inject()/host "
-                "front-ends only (disable io or use single-host "
-                "vpp-tpu-mesh-agent)")
         self.cluster = MultiHostCluster(
             n_nodes, base_config.dataplane, rule_shards)
         self.n_nodes = n_nodes
@@ -417,9 +487,48 @@ class MultiHostRuntime:
         self._pending: Dict[int, list] = {
             i: [] for i in self.cluster.local_nodes}
         self._tick_thread: Optional[threading.Thread] = None
+        # packet IO (io.enabled): per-LOCAL-node ring pairs + ONE
+        # tick-driven ClusterPump over the local wire view — the same
+        # ring/daemon contract as MeshRuntime, but the fabric step is
+        # issued by the tick loop so it interleaves deterministically
+        # with the driver's other collectives on every host
+        self.ring_pairs = None
+        self.cluster_pump = None
+        if base_config.io.enabled:
+            from vpp_tpu.io.cluster_pump import ClusterPump
+            from vpp_tpu.io.rings import IORingPair
+
+            io = base_config.io
+            self.ring_pairs = [
+                IORingPair(
+                    n_slots=io.n_slots, snap=io.snap,
+                    shm_name=(f"{io.shm_name}.{i}" if io.shm_name
+                              else None),
+                    create=True,
+                )
+                for i in self.cluster.local_nodes
+            ]
+            self.wire_view = _LocalWireView(self.cluster)
+            self.cluster_pump = ClusterPump(self.wire_view,
+                                            self.ring_pairs)
+            self.cluster_pump.step_when_idle = True
+            # fleet-agreed coalesce bucket: every host stages the SAME
+            # global shape every tick (see ClusterPump.max_frames_per_ring)
+            self.cluster_pump.max_frames_per_ring = 1
+            for agent in self.agents:
+                agent.io_pump = self.cluster_pump
+            # one designated exporter (MeshRuntime parity): every agent
+            # exporting the SHARED pump would overcount by n_local
+            self.agents[0].stats.set_pump(self.cluster_pump)
 
     # --- traffic injection (tests / local IO front-ends) ---
     def inject(self, node: int, packets: Sequence[dict]) -> None:
+        if self.cluster_pump is not None:
+            # the io tick loop steps the WIRE pump, not _pending —
+            # silently queueing here would blackhole forever
+            raise RuntimeError(
+                "inject() is for header-only mode; with io.enabled "
+                "push wire frames into ring_pairs[i].rx instead")
         with self._frames_lock:
             self._pending[node].extend(packets)
 
@@ -435,25 +544,43 @@ class MultiHostRuntime:
     def start(self) -> "MultiHostRuntime":
         for agent in self.agents:
             agent.start()
+        if self.cluster_pump is not None:
+            # the wire step needs live tables and both coalesce-bucket
+            # compiles BEFORE traffic; both are collectives, so every
+            # host runs them here, in the same order, pre-tick-loop
+            self.cluster.publish()
+            self.cluster_pump.warm()
+            self.cluster_pump.start(dispatch=False)  # writer only
         self._tick_thread = threading.Thread(
             target=self._loop, daemon=True, name="mh-tick")
         self._tick_thread.start()
         return self
 
     def _loop(self) -> None:
+        stopped = LockstepDriver._STOPPED
         while True:
             try:
-                res = self.driver.tick(self._drain(), n=self.frame_n)
+                if self.cluster_pump is not None:
+                    def fabric(tick):
+                        self.wire_view.now = tick
+                        self.cluster_pump._dispatch_once()
+                        return True
+
+                    res = self.driver.tick_fabric(fabric)
+                    if res is stopped:
+                        return
+                else:
+                    res = self.driver.tick(self._drain(), n=self.frame_n)
+                    if res is None:
+                        return  # fleet agreed to stop
+                    self.last_result = res
+                    if self.on_result is not None:
+                        self.on_result(res)
             except Exception:
                 # a failed collective leaves the fleet out of step —
                 # there is no local recovery; stop ticking and surface
                 log.exception("mesh tick failed; fabric halted")
                 return
-            if res is None:
-                return  # fleet agreed to stop
-            self.last_result = res
-            if self.on_result is not None:
-                self.on_result(res)
             time.sleep(self.tick_interval)
 
     def close(self, join_timeout: float = 60.0) -> None:
@@ -465,5 +592,25 @@ class MultiHostRuntime:
                 # collective; nothing safe to do but report (process
                 # exit reclaims it)
                 log.error("tick thread did not stop (peer host down?)")
+        pump_stopped = True
+        if self.cluster_pump is not None:
+            pump_stopped = self.cluster_pump.stop(join_timeout=30.0)
+            # in multi-host io mode the TICK thread is the pump's
+            # dispatcher: if it is still wedged in a collective (peer
+            # down) it can resume into the rings later — freeing them
+            # now would be a use-after-free into shared memory
+            pump_stopped = pump_stopped and not (
+                self._tick_thread is not None
+                and self._tick_thread.is_alive())
         for agent in reversed(self.agents):
             agent.close()
+        if self.ring_pairs is not None:
+            if pump_stopped:
+                for rings in self.ring_pairs:
+                    rings.close(
+                        unlink=bool(self.agents[0].config.io.shm_name))
+            else:
+                # a wedged writer still holds ring pointers (same
+                # policy as MeshRuntime/agent close)
+                log.error("cluster pump did not stop; leaving rings "
+                          "mapped")

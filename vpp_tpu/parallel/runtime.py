@@ -206,6 +206,7 @@ def _node_config(base, i: int):
         node_name=f"{base.node_name}-{i}" if base.node_name else f"node-{i}",
         cni_socket=suffix(base.cni_socket),
         cli_socket=suffix(base.cli_socket),
+        vcl_socket=suffix(base.vcl_socket),
         txn_journal_path=suffix(base.txn_journal_path),
         stats_port=base.stats_port + i,
         health_port=base.health_port + i,
