@@ -34,8 +34,10 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PROBE_TIMEOUT_S = 90
 # a killed-mid-claim probe is itself the wedge trigger (the grant needs
-# ~3-10 min unpoked to recover) — probe sparsely enough to let it heal
-PROBE_INTERVAL_S = 300
+# ~3-10 min UNPOKED to recover) — the gap between killed probes must
+# exceed the recovery window's high end, or the watcher itself keeps
+# the grant wedged forever
+PROBE_INTERVAL_S = 600
 BENCH_DEADLINE_S = 2700  # 45 min; a healthy-tunnel full run fits easily
 COMPLETE_OUT = os.path.join(REPO, "BENCH_r04_manual_tpu.json")
 PARTIAL_OUT = os.path.join(REPO, "BENCH_r04_partial_tpu.json")
