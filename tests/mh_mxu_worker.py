@@ -1,0 +1,105 @@
+"""Worker: fleet-agreed MXU selection in MultiHostCluster (run
+directly; single jax.distributed process, 2 virtual devices).
+
+At 600+ bit-plane-compatible global rules publish() must select the
+MXU classifier (ClusterDataplane.swap's rule), and its verdicts must
+be identical to a dense-forced twin cluster on the same frames.
+"""
+
+import json
+import os
+import sys
+
+COORD_PORT = sys.argv[1]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import ipaddress  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+from vpp_tpu.ir.rule import Action, ContivRule, Protocol  # noqa: E402
+from vpp_tpu.parallel.multihost import (  # noqa: E402
+    MultiHostCluster,
+)
+from vpp_tpu.pipeline.tables import DataplaneConfig  # noqa: E402
+from vpp_tpu.pipeline.vector import Disposition  # noqa: E402
+
+# no jax.distributed here: a single-process "fleet" works without it
+# (process_count==1), and the coordinator's heartbeat can die under
+# the compile storm this worker intentionally creates
+_ = COORD_PORT
+
+N_RULES = 640
+rules = []
+for i in range(N_RULES - 1):
+    net = ipaddress.ip_network(
+        f"172.{16 + (i % 1000) // 256}.{(i % 1000) % 256}.0/24")
+    rules.append(ContivRule(
+        action=Action.DENY if i % 6 == 5 else Action.PERMIT,
+        src_network=net, protocol=Protocol.TCP,
+        dest_port=8000 + i % 20))
+rules.append(ContivRule(action=Action.DENY))
+
+cfg = DataplaneConfig(
+    max_tables=4, max_rules=16, max_global_rules=N_RULES, max_ifaces=8,
+    fib_slots=32, sess_slots=256, nat_mappings=4, nat_backends=16,
+)
+
+
+def build(force_dense: bool) -> MultiHostCluster:
+    cl = MultiHostCluster(2, cfg)
+    if force_dense:
+        cl.mxu_threshold = 1 << 30
+    for nid in range(2):
+        n = cl.node(nid)
+        up = n.add_uplink()
+        pi = n.add_pod_interface(("d", f"p{nid}"))
+        n.builder.add_route(f"10.{nid + 1}.0.2/32", pi,
+                            Disposition.LOCAL)
+        other = 1 - nid
+        n.builder.add_route(f"10.{other + 1}.0.0/24", up,
+                            Disposition.REMOTE, node_id=other)
+        n.builder.set_global_table(list(rules))
+    cl.publish()
+    return cl
+
+
+def frames(cl):
+    rng = np.random.default_rng(3)
+    pkts = []
+    for k in range(32):
+        blk = int(rng.integers(0, 1000))
+        pkts.append(dict(
+            src=f"172.{16 + blk // 256}.{blk % 256}.{1 + k % 250}",
+            dst="10.2.0.2", proto=6, sport=1000 + k,
+            dport=8000 + int(rng.integers(0, 20)),
+            rx_if=cl.node(0).pod_if[("d", "p0")]))
+    return cl.make_frames([pkts, []], n=64)
+
+
+mxu = build(force_dense=False)
+dense = build(force_dense=True)
+assert mxu._use_mxu, "MXU not selected at 640 compatible rules"
+assert not dense._use_mxu
+
+r_m = mxu.step(frames(mxu), now=1)
+r_d = dense.step(frames(dense), now=1)
+
+same = (np.array_equal(np.asarray(mxu.local_rows(r_m.local.disp)),
+                       np.asarray(dense.local_rows(r_d.local.disp)))
+        and np.array_equal(
+            np.asarray(mxu.local_rows(r_m.delivered.disp)),
+            np.asarray(dense.local_rows(r_d.delivered.disp))))
+dropped = int(np.asarray(mxu.local_rows(r_m.stats.drop_acl)).sum())
+delivered = int((np.asarray(mxu.local_rows(r_m.delivered.disp))
+                 == int(Disposition.LOCAL)).sum())
+print("VERDICT " + json.dumps({
+    "mxu_selected": bool(mxu._use_mxu),
+    "verdicts_equal": bool(same),
+    "drop_acl": dropped,
+    "delivered": delivered,
+}), flush=True)

@@ -119,7 +119,10 @@ class MultiHostCluster:
         self.epoch = 0
         self._specs = table_specs()
         self._step = make_cluster_step(self.mesh)
-        self._wire_step = None  # built on first step_wire
+        self._step_mxu = None    # built on first mxu epoch
+        self._wire_steps = {}    # mxu-mode -> jitted wire step
+        self._use_mxu = False
+        self.mxu_threshold = 512
 
     def node(self, i: int) -> Dataplane:
         return self.nodes[i]
@@ -196,6 +199,21 @@ class MultiHostCluster:
                                    getattr(self._specs, f))
                 for f in SESSION_FIELDS
             }
+        # MXU classifier selection is CLUSTER state: one jitted
+        # program serves all nodes, so the choice must be identical
+        # fleet-wide — agree on it like the uplink guard (local
+        # eligibility bits, collective min/max)
+        local_ok = all(
+            self.nodes[i].builder.mxu_enabled
+            and self.nodes[i].builder.glb_mxu.ok
+            for i in self.local_nodes)
+        local_big = any(
+            self.nodes[i].builder.glb_nrules >= self.mxu_threshold
+            for i in self.local_nodes)
+        flags = np.asarray(multihost_utils.process_allgather(
+            np.int32([int(local_ok), int(local_big)]))).reshape(-1, 2)
+        self._use_mxu = bool(flags[:, 0].min()) and bool(
+            flags[:, 1].max())
         self.tables = DataplaneTables(**host_fields, **sess)
         self._uplinks = self._to_global(
             np.array([self.nodes[i].uplink_if or 0
@@ -233,22 +251,29 @@ class MultiHostCluster:
             raise RuntimeError("publish() first")
         if now is None:
             now = self.epoch  # deterministic default, NOT wall clock
-        res = self._step(self.tables, pkts, jnp.int32(now), self._uplinks)
+        step = self._step
+        if self._use_mxu:
+            if self._step_mxu is None:
+                self._step_mxu = make_cluster_step(self.mesh, mxu=True)
+            step = self._step_mxu
+        res = step(self.tables, pkts, jnp.int32(now), self._uplinks)
         self.tables = res.tables
         return res
 
     def step_wire(self, pkts: PacketVector, payload, now: int):
         """COLLECTIVE: wire-traffic step — headers AND payload bytes
-        ride the fabric (ClusterDataplane.step_wire analog; dense
-        classify only — the MXU selection is per-epoch cluster state
-        the multi-host publish does not track yet)."""
+        ride the fabric (ClusterDataplane.step_wire analog; the MXU
+        classifier engages when publish()'s fleet-agreed eligibility
+        selected it, same rule as ClusterDataplane.swap)."""
         from vpp_tpu.parallel.cluster import make_cluster_step_wire
 
         if self.tables is None:
             raise RuntimeError("publish() first")
-        if self._wire_step is None:
-            self._wire_step = make_cluster_step_wire(self.mesh)
-        result, deliv_pay = self._wire_step(
+        step = self._wire_steps.get(self._use_mxu)
+        if step is None:
+            step = make_cluster_step_wire(self.mesh, mxu=self._use_mxu)
+            self._wire_steps[self._use_mxu] = step
+        result, deliv_pay = step(
             self.tables, pkts, jnp.asarray(payload), jnp.int32(now),
             self._uplinks)
         self.tables = result.tables
