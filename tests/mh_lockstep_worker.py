@@ -32,7 +32,8 @@ from vpp_tpu.kvstore.client import connect_store  # noqa: E402
 from vpp_tpu.pipeline.tables import DataplaneConfig  # noqa: E402
 from vpp_tpu.pipeline.vector import Disposition  # noqa: E402
 
-init_multihost(f"127.0.0.1:{PORT}", NUM_PROCS, PROC_ID)
+init_multihost(f"127.0.0.1:{PORT}", NUM_PROCS, PROC_ID,
+               heartbeat_timeout_s=600)
 
 N_NODES = 4
 cfg = DataplaneConfig(

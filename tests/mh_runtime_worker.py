@@ -36,7 +36,8 @@ from vpp_tpu.cmd import AgentConfig  # noqa: E402
 from vpp_tpu.cni.model import CNIRequest  # noqa: E402
 from vpp_tpu.pipeline.vector import Disposition  # noqa: E402
 
-init_multihost(f"127.0.0.1:{COORD_PORT}", NUM_PROCS, PROC_ID)
+init_multihost(f"127.0.0.1:{COORD_PORT}", NUM_PROCS, PROC_ID,
+               heartbeat_timeout_s=600)
 
 import ipaddress  # noqa: E402
 

@@ -41,7 +41,8 @@ from vpp_tpu.native.pktio import PacketCodec  # noqa: E402
 from vpp_tpu.pipeline.vector import Disposition  # noqa: E402
 from wire import make_frame  # noqa: E402
 
-init_multihost(f"127.0.0.1:{COORD_PORT}", NUM_PROCS, PROC_ID)
+init_multihost(f"127.0.0.1:{COORD_PORT}", NUM_PROCS, PROC_ID,
+               heartbeat_timeout_s=600)
 
 cfg = AgentConfig(
     node_name="mhw", serve_http=False,

@@ -63,13 +63,17 @@ log = logging.getLogger("vpp_tpu.multihost")
 
 
 def init_multihost(coordinator_address: str, num_processes: int,
-                   process_id: int) -> None:
+                   process_id: int,
+                   heartbeat_timeout_s: int = 100) -> None:
     """``jax.distributed.initialize`` with the runtime's settings; call
-    before any other JAX API touches a backend."""
+    before any other JAX API touches a backend. Raise
+    ``heartbeat_timeout_s`` where long jit compiles can starve the
+    coordinator heartbeat (the service KILLS tasks that miss it)."""
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
+        heartbeat_timeout_seconds=heartbeat_timeout_s,
     )
 
 
