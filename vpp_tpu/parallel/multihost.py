@@ -379,31 +379,48 @@ class LockstepDriver:
         whole fleet has seen a commit, then run one fabric step.
         Returns None once the fleet has agreed to stop — no further
         collectives may be issued after that."""
-        out = self.tick_fabric(lambda t: self.cluster.step(
-            self.cluster.make_frames(per_local_node_packets, n=n),
-            now=t))
+        out = self.tick_fabric(
+            lambda t: self.cluster.step(
+                self.cluster.make_frames(per_local_node_packets, n=n),
+                now=t),
+            has_work=True)  # header-mode callers pass explicit frames
         return None if out is self._STOPPED else out
 
     _STOPPED = object()
 
-    def tick_fabric(self, fabric_fn):
+    def tick_fabric(self, fabric_fn, has_work: bool = True):
         """COLLECTIVE tick with a caller-supplied fabric step (the wire
         pump's ring->device->ring dispatch). Same agreement protocol as
         tick(); returns ``LockstepDriver._STOPPED`` once the fleet
-        agreed to stop, else ``fabric_fn(tick)``'s result. fabric_fn
-        MUST issue the identical collective sequence on every process."""
+        agreed to stop, else ``fabric_fn(tick)``'s result (None when
+        the step was skipped). fabric_fn MUST issue the identical
+        collective sequence on every process.
+
+        ``has_work``: this host's local signal (pending frames). The
+        allgather carries it, and when the WHOLE fleet is idle every
+        process skips the fabric step on the same tick — an idle
+        deployment burns one tiny allgather per tick instead of a full
+        device step."""
         seen = np.int32([int(self.store.get(self.req_key) or 0),
-                         int(self.store.get(self.stop_key) or 0)])
-        agreed = np.asarray(
-            multihost_utils.process_allgather(seen)
-        ).reshape(-1, 2).min(axis=0)
-        if int(agreed[1]) > self._stop_base:
+                         int(self.store.get(self.stop_key) or 0),
+                         int(bool(has_work))])
+        gathered = np.asarray(
+            multihost_utils.process_allgather(seen)).reshape(-1, 3)
+        agreed_req = int(gathered[:, 0].min())
+        agreed_stop = int(gathered[:, 1].min())
+        fleet_has_work = bool(gathered[:, 2].max())
+        if agreed_stop > self._stop_base:
             return self._STOPPED
-        if int(agreed[0]) > self.applied:
+        pending_commit = agreed_req > self.applied
+        if pending_commit:
             self.cluster.publish()
-            self.applied = int(agreed[0])
+            self.applied = agreed_req
         self.ticks += 1
-        out = fabric_fn(self.ticks)
+        out = None
+        # a commit tick always steps: in-flight state (sessions) must
+        # advance onto the new epoch deterministically everywhere
+        if fleet_has_work or pending_commit:
+            out = fabric_fn(self.ticks)
         if self.expire_every and self.ticks % self.expire_every == 0:
             self.cluster.expire_sessions(now=self.ticks)
         return out
@@ -569,6 +586,19 @@ class MultiHostRuntime:
                 del self._pending[i][:self.frame_n]
             return out
 
+    def _rings_have_work(self) -> bool:
+        """Local has-work signal for the idle-skip agreement: any rx
+        frame pending (peek without consuming) or queued ICMP errors."""
+        pump = self.cluster_pump
+        for i, r in enumerate(pump.rings):
+            with pump._held_lock:
+                if r.rx.peek_nth(pump._held[i]) is not None:
+                    return True
+        with pump._err_lock:
+            if any(pump._err_q):
+                return True
+        return False
+
     # --- lifecycle ---
     def start(self) -> "MultiHostRuntime":
         for agent in self.agents:
@@ -595,7 +625,8 @@ class MultiHostRuntime:
                         self.cluster_pump._dispatch_once()
                         return True
 
-                    res = self.driver.tick_fabric(fabric)
+                    res = self.driver.tick_fabric(
+                        fabric, has_work=self._rings_have_work())
                     if res is stopped:
                         return
                 else:
