@@ -141,6 +141,13 @@ if PROC_ID == 0:
     # the peer snapshots its pre-policy counters
     base = runtime.driver.ticks
     wait_for(lambda: runtime.driver.ticks > base + 24, "backlog drain")
+    # fleet-idle window (the peer is blocked waiting on stage1_drained,
+    # our backlog is flushed): ticks must advance WITHOUT device steps
+    s0 = runtime.cluster_pump.stats["steps"]
+    t0 = runtime.driver.ticks
+    wait_for(lambda: runtime.driver.ticks > t0 + 8, "idle ticks")
+    verdict["idle_steps_flat"] = \
+        runtime.cluster_pump.stats["steps"] == s0
     store.put("/test/stage1_drained", True)
     # stage 2: serve fresh-sport waves on request until the peer is
     # done evaluating the policy cutoff
@@ -185,6 +192,7 @@ else:
     from vpp_tpu.cmd.ksr_main import KsrAgent
     from vpp_tpu.ksr import model as m
 
+    steps_before_commit = runtime.cluster_pump.stats["steps"]
     ksr = KsrAgent(store=store, serve_http=False)
     ksr.start()
     ksr.sources[m.Pod.TYPE].add("default/pod2", m.Pod(
@@ -194,6 +202,18 @@ else:
         name="iso", namespace="default",
         pods=m.LabelSelector(match_labels={"app": "pod2"}),
         policy_type=m.POLICY_INGRESS, ingress_rules=[]))
+
+    # a commit tick must STEP even on an idle fleet (session state
+    # migrates onto the new epoch); observable as steps advancing while
+    # no traffic flows
+    applied0 = runtime.driver.applied
+    wait_for(lambda: runtime.driver.applied > applied0,
+             "policy epoch applied", 120)
+    # steps counts in the WRITER thread after the item lands — wait,
+    # don't snapshot-race it
+    wait_for(lambda: runtime.cluster_pump.stats["steps"]
+             > steps_before_commit, "commit-tick step", 60)
+    verdict["commit_stepped"] = True
 
     # converge: waves of fresh-sport frames from P0 until one FULL wave
     # yields zero deliveries (policy propagation is async: watch ->

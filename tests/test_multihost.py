@@ -154,7 +154,9 @@ def test_wire_path_across_processes(tmp_path):
         outs = _run_workers("mh_wire_worker.py", [kv_port])
 
     assert outs[0]["stage1_ok"] is True
+    assert outs[0]["idle_steps_flat"] is True   # fleet-idle skips steps
     assert outs[1]["wire_delivered"] >= 1
+    assert outs[1]["commit_stepped"] is True    # commit tick always steps
     assert outs[1]["stage2_cut"] is True
 
 

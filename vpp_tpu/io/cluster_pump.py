@@ -202,6 +202,17 @@ class ClusterPump:
             ok = ok and not t.is_alive()
         return ok
 
+    def has_pending(self) -> bool:
+        """Any un-dispatched rx frame (held ones excluded) or queued
+        ICMP error — the multi-host idle-skip's local has-work signal.
+        Owns the same locking the dispatch peek does."""
+        with self._held_lock:
+            for i, r in enumerate(self.rings):
+                if r.rx.peek_nth(self._held[i]) is not None:
+                    return True
+        with self._err_lock:
+            return any(self._err_q)
+
     # --- dispatch: rings -> device (async) ---
     def _dispatch_loop(self) -> None:
         while not self._stop.is_set():

@@ -586,19 +586,6 @@ class MultiHostRuntime:
                 del self._pending[i][:self.frame_n]
             return out
 
-    def _rings_have_work(self) -> bool:
-        """Local has-work signal for the idle-skip agreement: any rx
-        frame pending (peek without consuming) or queued ICMP errors."""
-        pump = self.cluster_pump
-        for i, r in enumerate(pump.rings):
-            with pump._held_lock:
-                if r.rx.peek_nth(pump._held[i]) is not None:
-                    return True
-        with pump._err_lock:
-            if any(pump._err_q):
-                return True
-        return False
-
     # --- lifecycle ---
     def start(self) -> "MultiHostRuntime":
         for agent in self.agents:
@@ -626,7 +613,7 @@ class MultiHostRuntime:
                         return True
 
                     res = self.driver.tick_fabric(
-                        fabric, has_work=self._rings_have_work())
+                        fabric, has_work=self.cluster_pump.has_pending())
                     if res is stopped:
                         return
                 else:
