@@ -1349,9 +1349,11 @@ def _merge_salvage(tpu_part: dict, cpu_res: dict | None,
             and not k.startswith("cpu_"))
     if partial_was_tpu and "headline_mpps" in tpu_part:
         headline = tpu_part["headline_mpps"]
-    elif cpu_res is not None:
-        headline = cpu_res.get("value", 0.0)
+    elif cpu_res is not None and cpu_res.get("value"):
+        headline = cpu_res["value"]
     else:
+        # an errored fill run emits value 0.0 — its sidecar may still
+        # hold the measured headline
         headline = cpu_details.get("headline_mpps", 0.0)
     merged["supervisor"] = (
         f"inner run {'stalled (tunnel wedge)' if stalled else 'failed'}; "

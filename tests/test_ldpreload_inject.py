@@ -187,3 +187,29 @@ spec:
     entry = [e for e in docs[0]["spec"]["containers"][0]["env"]
              if e["name"] == "VPP_TPU_APPNS"][0]
     assert entry == {"name": "VPP_TPU_APPNS", "value": "4"}
+
+
+def test_null_documents_dropped():
+    """A trailing '---' / comment-only section loads as None — it must
+    not re-serialize as a literal 'null' document kubectl rejects."""
+    import subprocess
+    import sys
+
+    manifest = """\
+apiVersion: v1
+kind: Pod
+spec:
+  containers:
+  - name: app
+    image: alpine
+---
+# just a comment
+---
+"""
+    proc = subprocess.run(
+        [sys.executable, "-m", "vpp_tpu.cmd.ldpreload_inject", "-"],
+        input=manifest, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "null" not in proc.stdout
+    docs = [d for d in yaml.safe_load_all(proc.stdout)]
+    assert len(docs) == 1 and docs[0]["kind"] == "Pod"

@@ -143,7 +143,9 @@ def main(argv: Optional[list] = None) -> int:
 
     text = (sys.stdin.read() if args.manifest == "-"
             else open(args.manifest).read())
-    docs = list(yaml.safe_load_all(text))
+    # a trailing '---' or comment-only section loads as None and would
+    # re-serialize as a literal 'null' document kubectl rejects
+    docs = [d for d in yaml.safe_load_all(text) if d is not None]
     n = inject_documents(docs, args.sock, args.libdir, args.appns,
                          args.fail_closed)
     out = yaml.safe_dump_all(docs, sort_keys=False)

@@ -75,17 +75,16 @@ def main(argv=None) -> int:
             parser.error("--coordinator requires --num-processes and "
                          "--process-id (or the mesh.* config keys)")
         init_multihost(coordinator, num_procs, proc_id)
+    if not n_nodes:
+        # after any distributed init: jax.devices() is then the GLOBAL
+        # device set, so the default covers the whole fleet's rows
         import jax
 
-        if not n_nodes:
-            n_nodes = max(1, len(jax.devices()) // rule_shards)
+        n_nodes = max(1, len(jax.devices()) // rule_shards)
+    if coordinator:
         runtime = MultiHostRuntime(n_nodes, config,
                                    rule_shards=rule_shards)
     else:
-        if not n_nodes:
-            import jax
-
-            n_nodes = max(1, len(jax.devices()) // rule_shards)
         runtime = MeshRuntime(n_nodes, config, rule_shards=rule_shards)
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):

@@ -11,7 +11,7 @@ server for a verdict before the call proceeds; the server answers from
 the node's SessionRuleEngine — the same engine, and therefore the same
 device-resident rule tables, the VPPTCP renderer commits to.
 
-Wire protocol (one unix stream per client process, requests pipelined
+Wire protocol (one unix stream per client THREAD, requests pipelined
 sequentially, all fields little-endian):
 
     request  (20 B): u8 op ('C' connect | 'A' accept), u8 proto,
@@ -58,6 +58,8 @@ class VclAdmissionServer:
         self.stats = {"connect_checks": 0, "connect_denies": 0,
                       "accept_checks": 0, "accept_denies": 0,
                       "clients": 0}
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
 
     def start(self) -> "VclAdmissionServer":
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
@@ -96,6 +98,17 @@ class VclAdmissionServer:
                 self._sock.close()
             except OSError:
                 pass
+        # close LIVE client channels too: _serve threads block in
+        # recv() between requests, so a stopped server would otherwise
+        # keep answering stale verdicts and the shims would never
+        # re-dial a restarted agent
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
         try:
             os.unlink(self.path)
         except OSError:
@@ -115,9 +128,11 @@ class VclAdmissionServer:
                              daemon=True).start()
 
     def _serve(self, conn: socket.socket) -> None:
-        # live connection count (one per app process in steady state;
+        # live connection count (one per app thread in steady state;
         # the shim reconnects after agent hiccups, so cumulative counts
         # would inflate)
+        with self._conns_lock:
+            self._conns.add(conn)
         with self._stats_lock:
             self.stats["clients"] += 1
         try:
@@ -125,6 +140,8 @@ class VclAdmissionServer:
         finally:
             with self._stats_lock:
                 self.stats["clients"] -= 1
+            with self._conns_lock:
+                self._conns.discard(conn)
 
     def _serve_inner(self, conn: socket.socket) -> None:
         try:

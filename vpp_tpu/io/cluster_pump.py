@@ -170,6 +170,11 @@ class ClusterPump:
     # must still dispatch (empty staging) to pair with a peer that has
     # traffic — the tick driver, not this class, owns the cadence
     step_when_idle = False
+    # multi-host tick mode: a swallowed staging/dispatch error would
+    # desync the fleet's collective sequence SILENTLY (this host skips
+    # a step its peers issued; their writers block forever). The tick
+    # driver must see the exception and halt loudly.
+    raise_on_error = False
     # multi-host tick mode: the coalesce bucket must be FLEET-AGREED —
     # p_cap derived from the LOCAL backlog would make hosts stage
     # different global shapes and issue mismatched collectives (gloo
@@ -288,6 +293,18 @@ class ClusterPump:
             item = (None, None,
                     [[(0, f, fr) for f, fr in lst]
                      for lst in per_node], t0)
+            if self.raise_on_error:
+                # ordered cleanup first, then surface: the lockstep
+                # driver has no way to resync a fleet whose collective
+                # sequences diverged
+                while True:
+                    try:
+                        self._inflight.put(item, timeout=0.05)
+                        break
+                    except queue.Full:
+                        if self._stop.is_set():
+                            break
+                raise
         while True:
             try:
                 self._inflight.put(item, timeout=0.05)

@@ -51,13 +51,15 @@ static void resolve_reals(void) {
   real_accept4 = (accept4_fn)dlsym(RTLD_NEXT, "accept4");
 }
 
-/* --- admission channel: one persistent fd per process ------------- */
+/* --- admission channel: one persistent fd PER THREAD --------------
+ * Thread-local channels remove the process-global mutex a slow/wedged
+ * agent would otherwise serialize every thread's connect()/accept()
+ * behind (~4 s worst case each, in turn). Forked children get a fresh
+ * channel via the pid check (a parent's stream would interleave
+ * verdicts across processes). */
 
-static pthread_mutex_t chan_mu = PTHREAD_MUTEX_INITIALIZER;
-static int chan_fd = -1;
-static pid_t chan_pid = 0; /* owner pid: a forked child must not share
-                              the parent's admission stream (interleaved
-                              verdicts would cross processes) */
+static __thread int chan_fd = -1;
+static __thread pid_t chan_pid = 0;
 
 #pragma pack(push, 1)
 struct vcl_req { /* must mirror hoststack/admission.py _REQ ("<BBHIIIHH") */
@@ -72,7 +74,7 @@ struct vcl_req { /* must mirror hoststack/admission.py _REQ ("<BBHIIIHH") */
 };
 #pragma pack(pop)
 
-static int chan_open_locked(void) {
+static int chan_open(void) {
   const char *path = getenv("VPP_TPU_VCL_SOCK");
   if (!path || !*path) return -1;
   int fd = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
@@ -88,8 +90,8 @@ static int chan_open_locked(void) {
     return -1;
   }
   /* a wedged agent (accepting but not answering) must not hang the
-   * app inside connect()/accept() while holding chan_mu: bounded
-   * round trips, timeout => verdict unavailable (fail-open/-closed).
+   * app inside connect()/accept(): bounded round trips, timeout =>
+   * verdict unavailable (fail-open/-closed).
    * Worst case across query()'s one reconnect retry is ~4 s (two
    * 1 s reads; writes only stall on a full socket buffer). Post-warmup
    * verdicts are sub-ms, so 1 s only ever bites a wedged agent. */
@@ -103,6 +105,9 @@ static int read_full(int fd, void *buf, size_t n) {
   size_t off = 0;
   while (off < n) {
     ssize_t r = read(fd, (char *)buf + off, n - off);
+    if (r < 0 && errno == EINTR) continue; /* signal-heavy apps
+        (profilers, SIGCHLD bursts) must not read as a dead peer —
+        that would fail-open a policy bypass */
     if (r <= 0) return -1;
     off += (size_t)r;
   }
@@ -115,17 +120,17 @@ static int write_full(int fd, const void *buf, size_t n) {
     /* MSG_NOSIGNAL: a dead agent must surface as a retry, not kill
      * the interposed app with SIGPIPE */
     ssize_t r = send(fd, (const char *)buf + off, n - off, MSG_NOSIGNAL);
+    if (r < 0 && errno == EINTR) continue;
     if (r <= 0) return -1;
     off += (size_t)r;
   }
   return 0;
 }
 
-/* one round trip; retries once on a dead channel (agent restart).
- * Returns 1 allow, 0 deny, -1 unavailable. */
+/* one round trip on THIS thread's channel; retries once on a dead
+ * channel (agent restart). Returns 1 allow, 0 deny, -1 unavailable. */
 static int query(const struct vcl_req *req) {
   int verdict = -1;
-  pthread_mutex_lock(&chan_mu);
   if (chan_fd >= 0 && chan_pid != getpid()) {
     /* inherited across fork(): the fd is the PARENT's stream; using it
      * here would interleave our requests with theirs and cross their
@@ -135,7 +140,7 @@ static int query(const struct vcl_req *req) {
   }
   for (int attempt = 0; attempt < 2 && verdict < 0; attempt++) {
     if (chan_fd < 0) {
-      chan_fd = chan_open_locked();
+      chan_fd = chan_open();
       chan_pid = getpid();
     }
     if (chan_fd < 0) break;
@@ -148,7 +153,6 @@ static int query(const struct vcl_req *req) {
       chan_fd = -1;
     }
   }
-  pthread_mutex_unlock(&chan_mu);
   return verdict;
 }
 

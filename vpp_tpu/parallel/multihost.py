@@ -158,24 +158,33 @@ class MultiHostCluster:
         # the identical union.
         local_targets = np.zeros(self.n_nodes, np.int32)
         local_uplinked = np.zeros(self.n_nodes, np.int32)
+        local_oob = np.zeros(self.n_nodes, np.int32)  # row 2 of gather
+        oob_detail = ""
         for i in self.local_nodes:
             arrs = arrs_by_node[i]
             t = arrs["fib_node_id"][arrs["fib_plen"] >= 0]
             t = np.unique(t[t >= 0])
             oob = t[t >= self.n_nodes]
             if len(oob):
-                # a raw allocator id where a mesh POSITION belongs —
-                # name it instead of IndexError-ing inside a collective
-                raise ValueError(
-                    f"node {i} stages routes to node id(s) "
-                    f"{oob.tolist()} outside this {self.n_nodes}-node "
-                    "mesh (allocator id vs mesh position aliasing?)")
-            local_targets[t] = 1
+                # a raw allocator id where a mesh POSITION belongs.
+                # Do NOT raise here: peers are already inside (or
+                # entering) the allgather and a one-sided abort would
+                # strand them — carry the flag through the gather so
+                # EVERY process raises on the same tick.
+                local_oob[0] = 1
+                oob_detail = (f"node {i} stages routes to node id(s) "
+                              f"{oob.tolist()}")
+            local_targets[t[t < self.n_nodes]] = 1
             if self.nodes[i].uplink_if is not None:
                 local_uplinked[i] = 1
         gathered = np.asarray(multihost_utils.process_allgather(
-            np.stack([local_targets, local_uplinked])))
-        gathered = gathered.reshape(-1, 2, self.n_nodes)
+            np.stack([local_targets, local_uplinked, local_oob])))
+        gathered = gathered.reshape(-1, 3, self.n_nodes)
+        if gathered[:, 2].max() > 0:
+            raise ValueError(
+                "staged fabric routes target node id(s) outside this "
+                f"{self.n_nodes}-node mesh (allocator id vs mesh "
+                f"position aliasing?) {oob_detail}".rstrip())
         targeted = gathered[:, 0].max(axis=0) > 0
         uplinked = gathered[:, 1].max(axis=0) > 0
         bad = np.nonzero(targeted & ~uplinked)[0]
@@ -558,6 +567,7 @@ class MultiHostRuntime:
             self.cluster_pump = ClusterPump(self.wire_view,
                                             self.ring_pairs)
             self.cluster_pump.step_when_idle = True
+            self.cluster_pump.raise_on_error = True
             # fleet-agreed coalesce bucket: every host stages the SAME
             # global shape every tick (see ClusterPump.max_frames_per_ring)
             self.cluster_pump.max_frames_per_ring = 1
@@ -625,8 +635,16 @@ class MultiHostRuntime:
                         self.on_result(res)
             except Exception:
                 # a failed collective leaves the fleet out of step —
-                # there is no local recovery; stop ticking and surface
+                # there is no local recovery; surface it, and
+                # best-effort ask peers to stop (helps any that have
+                # not yet entered this tick's collectives; ones already
+                # inside are unblocked by the coordination service's
+                # own timeout)
                 log.exception("mesh tick failed; fabric halted")
+                try:
+                    self.driver.request_stop()
+                except Exception:  # noqa: BLE001 — store may be gone too
+                    pass
                 return
             time.sleep(self.tick_interval)
 

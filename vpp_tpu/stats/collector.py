@@ -71,8 +71,9 @@ VCL_GAUGES = (
     ("vpp_tpu_vcl_accept_denies",
      "ldpreload shim accept() verdicts denied by session rules"),
     ("vpp_tpu_vcl_clients",
-     "admission-socket connections currently open (one per live app "
-     "process in steady state)"),
+     "admission-socket connections currently open (one per app THREAD "
+     "that has issued a filtered call — the shim keeps per-thread "
+     "channels)"),
 )
 
 NODE_GAUGES = (
