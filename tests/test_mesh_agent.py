@@ -468,3 +468,52 @@ def test_cluster_session_aging_reclaims_slots():
         ) == 0
     finally:
         runtime.close()
+
+
+def test_mesh_per_node_vcl_sockets(tmp_path):
+    """vcl_socket in mesh mode: every node agent serves ITS OWN
+    admission socket (suffixed per node, _node_config) backed by its
+    own SessionRuleEngine — a shared path would cross namespaces."""
+    import socket as pysocket
+    import struct as pystruct
+
+    from vpp_tpu.hoststack.admission import OP_CONNECT, _REQ
+    from vpp_tpu.hoststack.session_rules import (
+        RuleAction, RuleScope, SessionRule,
+    )
+
+    store = KVStore()
+    base = str(tmp_path / "vcl.sock")
+    cfg = AgentConfig(
+        node_name="mv", serve_http=False, vcl_socket=base,
+        dataplane=DataplaneConfig(
+            max_tables=4, max_rules=16, max_global_rules=32,
+            max_ifaces=16, fib_slots=64, sess_slots=256,
+            nat_mappings=4, nat_backends=16,
+        ),
+    )
+    runtime = MeshRuntime(2, cfg, rule_shards=2, store=store)
+    runtime.start()
+    try:
+        # node 1's engine denies appns 4 -> *:9100; node 0's allows
+        runtime.agents[1].session_engine.apply(add=[SessionRule(
+            scope=int(RuleScope.LOCAL), appns_index=4,
+            transport_proto=6, lcl_net=0, lcl_plen=0, rmt_net=0,
+            rmt_plen=0, lcl_port=0, rmt_port=9100,
+            action=int(RuleAction.DENY))])
+
+        def ask(node: int) -> bytes:
+            s = pysocket.socket(pysocket.AF_UNIX, pysocket.SOCK_STREAM)
+            s.connect(f"{base}.{node}")
+            s.sendall(_REQ.pack(OP_CONNECT, 6, 0, 4, 0,
+                                pystruct.unpack(
+                                    "!I", pysocket.inet_aton(
+                                        "127.0.0.1"))[0], 0, 9100))
+            out = s.recv(1)
+            s.close()
+            return out
+
+        assert ask(0) == b"\x01"   # node 0: no such rule -> allow
+        assert ask(1) == b"\x00"   # node 1: denied by ITS engine
+    finally:
+        runtime.close()

@@ -312,3 +312,44 @@ def test_nonblocking_accept_skips_denied_backlog(admission):
     finally:
         srv.kill()
         srv.wait(timeout=10)
+
+
+def test_threaded_client_concurrent_verdicts(admission, listener):
+    """Per-thread admission channels: N app threads connect
+    concurrently and every verdict lands on the right call (no
+    cross-thread verdict mixups on a shared stream)."""
+    engine, sock = admission
+    port_deny = listener()
+    port_allow = listener()
+    engine.apply(add=[local_rule(9, port_deny, RuleAction.DENY)])
+    code = """
+import socket, sys, threading
+deny_port, allow_port = int(sys.argv[1]), int(sys.argv[2])
+results = {}
+lock = threading.Lock()
+
+def probe(i):
+    port = deny_port if i % 2 == 0 else allow_port
+    s = socket.socket()
+    s.settimeout(10)
+    try:
+        s.connect(("127.0.0.1", port))
+        out = "CONNECTED"
+        s.close()
+    except ConnectionRefusedError:
+        out = "REFUSED"
+    with lock:
+        results[i] = out
+
+threads = [threading.Thread(target=probe, args=(i,)) for i in range(16)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+bad = [i for i, r in results.items()
+       if r != ("REFUSED" if i % 2 == 0 else "CONNECTED")]
+print("BAD" if bad else "ALL-OK", bad)
+"""
+    out = run_under_shim(vcl_env(sock, appns_index=9), code,
+                         port_deny, port_allow)
+    assert out.startswith("ALL-OK"), out
