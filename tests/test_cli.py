@@ -251,3 +251,28 @@ def test_show_session_rules():
     assert "GLOBAL" in out and "10.1.1.2/32:53" in out and "allow" in out
     # `show session` (the flow table) still resolves independently
     assert "established sessions" in DebugCLI(dp).run("show session")
+
+
+def test_show_mesh():
+    """`show mesh` renders runtime state (nodes, lockstep counters,
+    pump stats) from whatever runtime shape is attached; standalone
+    agents degrade to a message."""
+    import types
+
+    dp, a, uplink = make_env()
+    assert "not a mesh agent" in DebugCLI(dp).run("show mesh")
+
+    fake = types.SimpleNamespace(
+        cluster=types.SimpleNamespace(n_nodes=4, epoch=7,
+                                      local_nodes=[0, 1]),
+        driver=types.SimpleNamespace(ticks=123, applied=2,
+                                     expire_every=512),
+        agents=[types.SimpleNamespace(
+            config=types.SimpleNamespace(node_name="mh-0"), node_id=3)],
+        cluster_pump=None,
+    )
+    out = DebugCLI(dp, mesh_runtime=fake).run("show mesh")
+    assert "4 nodes, epoch 7" in out
+    assert "local mesh rows: [0, 1]" in out
+    assert "tick 123" in out and "epoch-req 2" in out
+    assert "mh-0(id 3)" in out

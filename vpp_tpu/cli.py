@@ -20,7 +20,8 @@ from vpp_tpu.pipeline.vector import Disposition, ip4_str
 
 class DebugCLI:
     def __init__(self, dataplane: Dataplane, tracer=None, stats=None,
-                 pump=None, io_ctl=None, session_engine=None):
+                 pump=None, io_ctl=None, session_engine=None,
+                 mesh_runtime=None):
         self.dp = dataplane
         self.tracer = tracer
         self.stats = stats
@@ -30,6 +31,8 @@ class DebugCLI:
         self.io_ctl = io_ctl
         # optional host-stack handle (show session-rules)
         self.session_engine = session_engine
+        # optional mesh/multi-host runtime handle (show mesh)
+        self.mesh_runtime = mesh_runtime
 
     # --- dispatch ---
     def run(self, line: str) -> str:
@@ -41,6 +44,7 @@ class DebugCLI:
             ("show", "acl"): self.show_acl,
             ("show", "session"): self.show_session,
             ("show", "session-rules"): self.show_session_rules,
+            ("show", "mesh"): self.show_mesh,
             ("show", "nat44"): self.show_nat44,
             ("show", "fib"): self.show_fib,
             ("show", "trace"): self.show_trace,
@@ -67,7 +71,7 @@ class DebugCLI:
     def help(self) -> str:
         return (
             "commands: show interface | show acl | show session | "
-            "show session-rules | "
+            "show session-rules | show mesh | "
             "show nat44 | show fib | show trace | show errors | "
             "show io | show neighbors | show config-history [n] | "
             "trace add [n] | trace clear | config replay <journal> | "
@@ -201,6 +205,44 @@ class DebugCLI:
         if len(idxs) > 64:
             lines.append(f"  ... {len(idxs) - 64} more")
         return "\n".join(lines)
+
+    def show_mesh(self) -> str:
+        """Mesh/multi-host runtime state: nodes this host drives, the
+        lockstep tick/epoch counters (multi-host), fabric pump
+        counters. The `show version`-grade operator one-pager for the
+        multi-chip plane."""
+        rt = self.mesh_runtime
+        if rt is None:
+            return "not a mesh agent (no runtime attached)"
+        lines = []
+        cluster = getattr(rt, "cluster", None)
+        if cluster is not None:
+            lines.append(
+                f"cluster: {cluster.n_nodes} nodes, epoch {cluster.epoch}")
+        local = getattr(cluster, "local_nodes", None)
+        if local is not None:
+            lines.append(f"local mesh rows: {local}")
+        driver = getattr(rt, "driver", None)
+        if driver is not None:
+            lines.append(
+                f"lockstep: tick {driver.ticks}, applied epoch-req "
+                f"{driver.applied}, session aging every "
+                f"{driver.expire_every} ticks")
+        agents = getattr(rt, "agents", None)
+        if agents:
+            lines.append("agents: " + ", ".join(
+                f"{a.config.node_name}(id {a.node_id})" for a in agents))
+        pump = getattr(rt, "cluster_pump", None)
+        if pump is not None:
+            ps = pump.stats
+            lines.append(
+                f"fabric pump: steps {ps.get('steps', 0)}, frames "
+                f"{ps.get('frames', 0)}, fabric pkts "
+                f"{ps.get('fabric_pkts', 0)}, tx-ring-full "
+                f"{ps.get('tx_ring_full', 0)}, errors "
+                f"{ps.get('batch_errors', 0)}, pending "
+                f"{pump.has_pending() if hasattr(pump, 'has_pending') else '?'}")
+        return "\n".join(lines) or "mesh runtime attached, no state"
 
     def show_session_rules(self) -> str:
         """The `show session rules` analog: the VPPTCP renderer's

@@ -214,6 +214,7 @@ class ContivAgent:
         self.cni_transport: Optional[CNITransportServer] = None
         self.cli_transport: Optional[CNITransportServer] = None
         self.vcl_admission = None  # VclAdmissionServer when vcl_socket set
+        self.mesh_runtime = None   # set by Mesh/MultiHostRuntime (show mesh)
 
         # --- observability ---
         self.stats = StatsCollector(self.dataplane, self.container_index)
@@ -394,6 +395,7 @@ class ContivAgent:
                     self.dataplane, stats=self.stats,
                     pump=self.io_pump, io_ctl=self.io_ctl,
                     session_engine=self.session_engine,
+                    mesh_runtime=self.mesh_runtime,
                 )
 
                 def _cli_dispatch(method: str, params: dict) -> dict:

@@ -536,6 +536,7 @@ class MultiHostRuntime:
                                 dataplane=self.cluster.node(i),
                                 mesh_node_resolver=resolver)
             agent._external_io = True  # no per-agent pump on node handles
+            agent.mesh_runtime = self  # `show mesh` on any node's CLI
             self.store.put(self.POS_PREFIX + str(agent.node_id), i)
             self.agents.append(agent)
         self._frames_lock = threading.Lock()

@@ -85,6 +85,7 @@ class MeshRuntime:
                 mesh_node_resolver=lambda nid: self._mesh_pos.get(nid, -1),
             )
             self._mesh_pos[agent.node_id] = i
+            agent.mesh_runtime = self  # `show mesh` on any node's CLI
             self.agents.append(agent)
         # packet IO: per-node ring pairs + ONE ClusterPump stepping the
         # fabric (io/cluster_pump.py). Rings exist from construction so
