@@ -1474,6 +1474,21 @@ def _run():
               packets_per_step=args.packets, iters=args.iters)
 
     # --- added latency: single small-frame step, p50/p99 ---
+    def pack_frame(pv, n):
+        """Latency-section staging: one packed [5, n] int32 frame from
+        a PacketVector (shared by the chained and persistent levers —
+        they must measure identical traffic)."""
+        from vpp_tpu.pipeline.dataplane import pack_packet_columns
+
+        cols = {
+            f: np.asarray(getattr(pv, f))
+            for f in ("src_ip", "dst_ip", "proto", "sport", "dport",
+                      "ttl", "pkt_len", "rx_if", "flags")
+        }
+        flat = np.zeros((5, n), np.int32)
+        pack_packet_columns(flat.view(np.uint32), cols, n)
+        return flat
+
     frame = build_traffic(args.latency_frame, uplink, seed=11)
     lat = []
     for i in range(args.warmup):
@@ -1506,20 +1521,12 @@ def _run():
     # inside ONE device program (lax.scan) with ONE dispatch + ONE
     # sync, vs K separate dispatches above. Amortizes the per-step
     # host round trip; measured per frame.
-    from vpp_tpu.pipeline.dataplane import pack_packet_columns
-
     KC = 16
     chain_dp, chain_up = build_dataplane(args.rules, args.backends)
     cframe = build_traffic(args.latency_frame, chain_up, seed=12)
-    flats = np.zeros((KC, 5, args.latency_frame), np.int32)
-    cols = {
-        f: np.asarray(getattr(cframe, f))
-        for f in ("src_ip", "dst_ip", "proto", "sport", "dport", "ttl",
-                  "pkt_len", "rx_if", "flags")
-    }
-    for k in range(KC):
-        pack_packet_columns(flats[k].view(np.uint32), cols,
-                            args.latency_frame)
+    one = pack_frame(cframe, args.latency_frame)
+    flats = np.broadcast_to(
+        one, (KC, 5, args.latency_frame)).copy()
     jax.block_until_ready(
         chain_dp.process_packed_chain(flats.copy(), now=1)
     )  # compile
@@ -1532,6 +1539,42 @@ def _run():
         chain_lat.append((time.perf_counter() - t0) / KC * 1e6)
     chained_us = float(np.percentile(np.array(chain_lat), 50))
     _progress(frame_latency_chained_us=round(chained_us, 1))
+
+    # persistent resident loop (docs/LATENCY.md lever #5): ONE program
+    # stays on-device, frames ride ordered io_callbacks — no per-frame
+    # dispatch at all. Latency-floor regime; additive and best-effort.
+    persistent_us = None
+    pump_p = None
+    try:
+        from vpp_tpu.pipeline.persistent import PersistentPump
+
+        pdp, pup = build_dataplane(args.rules, args.backends)
+        pflat = pack_frame(build_traffic(args.latency_frame, pup,
+                                         seed=13), args.latency_frame)
+        pump_p = PersistentPump(pdp.tables, batch=args.latency_frame)
+        pump_p.start()
+        pump_p.submit(pflat, now=1)          # warm (traces the loop)
+        pump_p.result(timeout=600)
+        lat_p = []
+        for i in range(50):
+            t0 = time.perf_counter()
+            pump_p.submit(pflat, now=2 + i)
+            pump_p.result(timeout=120)
+            lat_p.append(time.perf_counter() - t0)
+        persistent_us = round(
+            float(np.percentile(np.array(lat_p) * 1e6, 50)), 1)
+        _progress(frame_latency_persistent_us=persistent_us)
+    except Exception as e:  # noqa: BLE001 — prototype lever, optional
+        persistent_us = f"error: {type(e).__name__}: {e}"
+    finally:
+        # the resident program must NOT outlive this section: on a
+        # single-execution-stream device it would block everything
+        # after it (it sits in host_fetch waiting for frames)
+        if pump_p is not None:
+            try:
+                pump_p.stop()
+            except Exception:  # noqa: BLE001 — already recorded above
+                pass
 
     # per-stage `show run` snapshot (trace/cycles.py) in the official
     # output: attributes headline movements between rounds to a stage
@@ -1587,6 +1630,9 @@ def _run():
                     # dispatch+sync (lax.scan chain) — the bounded-sync
                     # quantum, per frame (docs/LATENCY.md lever #4)
                     "frame_latency_chained_us": round(chained_us, 1),
+                    # resident while_loop + io_callback refills: zero
+                    # per-frame dispatch (docs/LATENCY.md lever #5)
+                    "frame_latency_persistent_us": persistent_us,
                     "stage_ns_per_pkt": stage_ns,
                     # throughput at the DEPLOYED frame size (VPP's 256-
                     # packet frames), not the 65536-packet bench steps —

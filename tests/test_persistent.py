@@ -1,0 +1,86 @@
+"""Persistent device loop: one resident while_loop program pumps many
+frames through host io_callbacks — verdicts identical to the
+per-dispatch packed path, session state threaded frame-to-frame,
+clean stop returning the final tables."""
+
+import numpy as np
+
+from vpp_tpu.pipeline.dataplane import Dataplane, pack_packet_columns
+from vpp_tpu.pipeline.persistent import PersistentPump
+from vpp_tpu.pipeline.tables import DataplaneConfig
+from vpp_tpu.pipeline.vector import Disposition, ip4
+from vpp_tpu.ir.rule import Action, ContivRule, Protocol
+
+B = 64
+
+
+def build_dp():
+    dp = Dataplane(DataplaneConfig(
+        max_tables=2, max_rules=16, max_global_rules=32, max_ifaces=8,
+        fib_slots=32, sess_slots=256, nat_mappings=4, nat_backends=4,
+    ))
+    up = dp.add_uplink()
+    pod = dp.add_pod_interface(("d", "p"))
+    dp.builder.add_route("10.1.1.2/32", pod, Disposition.LOCAL)
+    dp.builder.set_global_table([
+        ContivRule(action=Action.DENY, protocol=Protocol.TCP,
+                   dest_port=23),
+        ContivRule(action=Action.PERMIT),
+    ])
+    dp.swap()
+    return dp, up, pod
+
+
+def packed_frame(dport, sport, up):
+    cols = {
+        "src_ip": np.full(B, ip4("10.9.0.9"), np.uint32),
+        "dst_ip": np.full(B, ip4("10.1.1.2"), np.uint32),
+        "proto": np.full(B, 6, np.uint32),
+        "sport": np.full(B, sport, np.uint32),
+        "dport": np.full(B, dport, np.uint32),
+        "ttl": np.full(B, 64, np.uint32),
+        "pkt_len": np.full(B, 64, np.uint32),
+        "rx_if": np.full(B, up, np.uint32),
+        "flags": np.ones(B, np.uint32),
+    }
+    flat = np.zeros((5, B), np.int32)
+    pack_packet_columns(flat.view(np.uint32), cols, B)
+    return flat
+
+
+def out_disp(out):
+    return (out.view(np.uint32)[3] >> 24) & 0xF
+
+
+def test_persistent_matches_dispatch_and_threads_sessions():
+    dp, up, pod = build_dp()
+    pump = PersistentPump(dp.tables, batch=B).start()
+    try:
+        # frame 1: telnet denied, frame 2: http allowed
+        pump.submit(packed_frame(23, 1000, up), now=1)
+        pump.submit(packed_frame(80, 2000, up), now=2)
+        o1 = pump.result(timeout=120)
+        o2 = pump.result(timeout=120)
+        assert (out_disp(o1) == int(Disposition.DROP)).all()
+        assert (out_disp(o2) == int(Disposition.LOCAL)).all()
+
+        # per-dispatch oracle: identical verdict rows
+        ref = dp.process_packed(packed_frame(80, 3000, up), now=3)
+        pump.submit(packed_frame(80, 3000, up), now=3)
+        o4 = pump.result(timeout=120)
+        # dp.process_packed ran on ITS copy of the tables (fresh flow)
+        assert np.array_equal(out_disp(np.asarray(ref)),
+                              out_disp(o4))
+    finally:
+        final = pump.stop()
+    # sessions installed inside the loop survive into the returned
+    # tables (frames 2-3 were permitted fresh flows)
+    assert int(np.asarray(final.sess_valid).sum()) > 0
+
+
+def test_stop_without_traffic():
+    dp, up, pod = build_dp()
+    pump = PersistentPump(dp.tables, batch=B).start()
+    final = pump.stop()
+    assert final is not None
+    assert int(np.asarray(final.sess_valid).sum()) == 0
