@@ -54,6 +54,13 @@ class FakeSpawner:
         if (self.cfg is not None and self.plan_on_agent
                 and "vpp_tpu.cmd.agent" in argv):
             write_plan(self.cfg)
+        if (self.cfg is not None and self.plan_on_agent
+                and "vpp_tpu.cmd.mesh_main" in argv):
+            # a mesh agent writes one plan per node (suffixed paths)
+            for i in range(self.cfg.mesh.nodes):
+                write_plan(self.cfg, _suffix=f".{i}",
+                           shm=f"vpp-shm.{i}",
+                           control_socket=f"/run/vpp-tpu/io-ctl.sock.{i}")
         return p
 
     def by_module(self, module):
@@ -73,7 +80,7 @@ def cfg_with_io(tmp_path, **kw):
     )
 
 
-def write_plan(cfg, **over):
+def write_plan(cfg, _suffix="", **over):
     plan = {
         "shm": "vpp-shm", "slots": 32, "snap": 1024, "uplink_if": 63,
         "host_if": 62, "uplink_interface": "eth9",
@@ -81,7 +88,7 @@ def write_plan(cfg, **over):
         "control_socket": "/run/vpp-tpu/io-ctl.sock",
     }
     plan.update(over)
-    with open(cfg.io.plan_path, "w") as f:
+    with open(cfg.io.plan_path + _suffix, "w") as f:
         json.dump(plan, f)
     return plan
 
@@ -225,3 +232,99 @@ class TestUplinkPreconfig:
             cfg, run=lambda *a, **k: (_ for _ in ()).throw(
                 AssertionError("must not shell out")))
         assert applied["interface"] == ""
+
+
+
+class TestMeshBoot:
+    def _cfg(self, tmp_path):
+        from vpp_tpu.cmd.config import MeshConfig
+
+        cfg = cfg_with_io(tmp_path)
+        cfg.mesh = MeshConfig(nodes=2, rule_shards=1)
+        return cfg
+
+    def test_mesh_agent_and_per_node_io(self, tmp_path):
+        """mesh: config -> vpp-tpu-mesh-agent is the vswitch and ONE io
+        daemon boots per node plan (suffixed shm/control endpoints)."""
+        cfg = self._cfg(tmp_path)
+        spawner = FakeSpawner(cfg)
+        sup = InitSupervisor(cfg, None, spawn=spawner, plan_timeout_s=5.0)
+        # settle window is 1.5s inside read_plans
+        sup.start()
+        assert spawner.by_module("vpp_tpu.cmd.mesh_main")
+        assert not spawner.by_module("vpp_tpu.cmd.agent")
+        ios = spawner.by_module("vpp_tpu.cmd.io_daemon")
+        assert len(ios) == 2
+        shms = sorted(a[a.index("--shm") + 1] for a in
+                      (p.argv for p in ios))
+        assert shms == ["vpp-shm.0", "vpp-shm.1"]
+
+    def test_one_io_death_respawns_only_it(self, tmp_path):
+        cfg = self._cfg(tmp_path)
+        spawner = FakeSpawner(cfg)
+        sup = InitSupervisor(cfg, None, spawn=spawner, plan_timeout_s=5.0)
+        sup.start()
+        t = threading.Thread(target=sup.supervise, daemon=True)
+        t.start()
+        try:
+            ios = spawner.by_module("vpp_tpu.cmd.io_daemon")
+            ios[0].die(rc=3)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                now = spawner.by_module("vpp_tpu.cmd.io_daemon")
+                if len(now) == 3:
+                    break
+                time.sleep(0.05)
+            assert len(spawner.by_module("vpp_tpu.cmd.io_daemon")) == 3
+            # the mesh agent was NOT restarted
+            assert len(spawner.by_module("vpp_tpu.cmd.mesh_main")) == 1
+        finally:
+            sup.stop()
+            t.join(timeout=10)
+
+    def test_mesh_agent_death_restarts_all_io(self, tmp_path):
+        cfg = self._cfg(tmp_path)
+        spawner = FakeSpawner(cfg)
+        sup = InitSupervisor(cfg, None, spawn=spawner, plan_timeout_s=5.0)
+        sup.start()
+        t = threading.Thread(target=sup.supervise, daemon=True)
+        t.start()
+        try:
+            spawner.by_module("vpp_tpu.cmd.mesh_main")[0].die(rc=2)
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                if (len(spawner.by_module("vpp_tpu.cmd.mesh_main")) >= 2
+                        and len(spawner.by_module(
+                            "vpp_tpu.cmd.io_daemon")) >= 4):
+                    break
+                time.sleep(0.05)
+            assert len(spawner.by_module("vpp_tpu.cmd.mesh_main")) == 2
+            assert len(spawner.by_module("vpp_tpu.cmd.io_daemon")) == 4
+        finally:
+            sup.stop()
+            t.join(timeout=10)
+
+
+def test_mesh_plans_straggle_past_settle_window(tmp_path):
+    """Known node count: init must wait for ALL plans even when node
+    boots straggle (a settle heuristic committed to a partial set when
+    writes were >1.5s apart — e.g. a host-interconnect wire wait
+    between agent boots)."""
+    from vpp_tpu.cmd.config import MeshConfig
+
+    cfg = cfg_with_io(tmp_path)
+    cfg.mesh = MeshConfig(nodes=2, rule_shards=1)
+    spawner = FakeSpawner(cfg, plan_on_agent=False)
+    sup = InitSupervisor(cfg, None, spawn=spawner, plan_timeout_s=15.0)
+
+    def slow_agent_boots():
+        write_plan(cfg, _suffix=".0", shm="vpp-shm.0")
+        time.sleep(3.0)   # well past the old 1.5s settle window
+        write_plan(cfg, _suffix=".1", shm="vpp-shm.1")
+
+    threading.Thread(target=slow_agent_boots, daemon=True).start()
+    sup.start()
+    ios = spawner.by_module("vpp_tpu.cmd.io_daemon")
+    assert len(ios) == 2, "partial plan set committed"
+    shms = sorted(p.argv[p.argv.index("--shm") + 1] for p in ios)
+    assert shms == ["vpp-shm.0", "vpp-shm.1"]

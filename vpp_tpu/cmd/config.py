@@ -61,6 +61,9 @@ class MeshConfig:
     one process drives N vswitch nodes over a (node, rule) device mesh
     with the all_to_all ICI fabric as the inter-node data plane."""
 
+    enabled: bool = False   # explicit mesh switch (nodes/coordinator/
+                            # rule_shards>1 also imply it — needed for
+                            # the auto-size nodes=0 form)
     nodes: int = 0          # mesh rows; 0 = one node per available device
                             # group (devices // rule_shards)
     rule_shards: int = 1    # global-ACL rule-axis shards per node

@@ -31,6 +31,7 @@ whole bootstrap is unit-testable without root or real processes.
 from __future__ import annotations
 
 import argparse
+import collections
 import json
 import logging
 import os
@@ -102,12 +103,22 @@ class InitSupervisor:
         self.spawn = spawn or (lambda argv: subprocess.Popen(argv))
         self.plan_timeout_s = plan_timeout_s
         self.procs: Dict[str, "subprocess.Popen"] = {}
-        self.restarts: Dict[str, int] = {"agent": 0, "io": 0}
+        self.restarts: Dict[str, int] = collections.defaultdict(int)
         self._stop = threading.Event()
 
     # --- child argv builders (also what the unit tests assert on) ---
+    def _is_mesh(self) -> bool:
+        m = self.config.mesh
+        return bool(m.enabled or m.nodes or m.coordinator
+                    or m.rule_shards > 1)
+
     def agent_argv(self) -> List[str]:
-        argv = [sys.executable, "-m", "vpp_tpu.cmd.agent"]
+        # a mesh: config section means the vswitch is the multi-chip
+        # (or multi-host) mesh agent — same supervision contract, one
+        # process driving every local chip
+        module = ("vpp_tpu.cmd.mesh_main" if self._is_mesh()
+                  else "vpp_tpu.cmd.agent")
+        argv = [sys.executable, "-m", module]
         if self.config_path:
             argv += ["--config", self.config_path]
         return argv
@@ -131,38 +142,105 @@ class InitSupervisor:
             argv += ["--control", plan["control_socket"]]
         return argv
 
-    def read_plan(self) -> dict:
-        """Wait for the agent's IO plan file (rings exist once written)."""
-        path = self.config.io.plan_path
+    def _plan_files(self) -> List[str]:
+        """Plan files the running agent has written: ONE at plan_path
+        for a standalone agent; plan_path.<node> per mesh node (the
+        runtimes suffix per-node endpoints, parallel/runtime.py)."""
+        import glob as _glob
+
+        base = self.config.io.plan_path
+        if not self._is_mesh():
+            return [base] if os.path.exists(base) else []
+        # ONLY digit suffixes are node plans (plan_path.<n>); anything
+        # else — the agents' atomic-write temp files especially — must
+        # not become a phantom io daemon sharing a live daemon's rings
+        return sorted(p for p in _glob.glob(base + ".*")
+                      if p[len(base) + 1:].isdigit())
+
+    def read_plans(self) -> dict:
+        """Wait for the agent's IO plan file(s); returns
+        {proc_name: (path, plan)}. With a KNOWN node count
+        (mesh.nodes > 0) we wait for exactly that many plans — a
+        settle heuristic would commit to a partial set whenever node
+        boots straggle (e.g. a host-interconnect wire wait between
+        them), leaving later nodes without io daemons. Only the
+        auto-size mode (nodes=0) falls back to waiting for the set to
+        stop growing."""
         deadline = time.monotonic() + self.plan_timeout_s
+        want = self.config.mesh.nodes if self._is_mesh() else 1
+        seen: List[str] = []
+        stable_since = 0.0
         while time.monotonic() < deadline and not self._stop.is_set():
-            if os.path.exists(path):
-                with open(path) as f:
-                    return json.load(f)
+            paths = self._plan_files()
+            if paths and not self._is_mesh():
+                with open(paths[0]) as f:
+                    return {"io": (paths[0], json.load(f))}
+            done = False
+            if paths and want > 0:
+                done = len(paths) >= want
+            elif paths:
+                if paths != seen:
+                    seen = paths
+                    stable_since = time.monotonic()
+                else:
+                    done = time.monotonic() - stable_since > 1.5
+            if done:
+                out = {}
+                for p in paths:
+                    with open(p) as f:
+                        out[f"io:{p.rsplit('.', 1)[1]}"] = (
+                            p, json.load(f))
+                return out
             time.sleep(0.2)
-        raise TimeoutError(f"agent never wrote IO plan at {path}")
+        raise TimeoutError(
+            f"agent never wrote IO plan at {self.config.io.plan_path}")
 
     def _clear_plan(self) -> None:
-        """Remove any stale plan file BEFORE (re)spawning the agent, so
-        read_plan() waits for the plan of the agent actually running —
-        a leftover from a previous boot would describe dead rings."""
-        try:
-            os.remove(self.config.io.plan_path)
-        except OSError:
-            pass
+        """Remove any stale plan file(s) BEFORE (re)spawning the agent,
+        so read_plans() waits for the plans of the agent actually
+        running — a leftover from a previous boot would describe dead
+        rings."""
+        import glob as _glob
+
+        base = self.config.io.plan_path
+        for p in [base] + _glob.glob(base + ".*"):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
 
     def _spawn_agent(self) -> None:
         self._clear_plan()
         self.procs["agent"] = self.spawn(self.agent_argv())
 
+    def _io_names(self) -> List[str]:
+        return [n for n in self.procs
+                if n == "io" or n.startswith("io:")]
+
     def _spawn_io(self) -> bool:
         try:
-            plan = self.read_plan()
+            plans = self.read_plans()
         except TimeoutError:
             log.error("io start blocked: no plan file")
             return False
-        self.procs["io"] = self.spawn(self.io_argv(plan))
+        self._io_plan_paths = {n: p for n, (p, _) in plans.items()}
+        for name, (_, plan) in plans.items():
+            self.procs[name] = self.spawn(self.io_argv(plan))
         return True
+
+    def _respawn_one_io(self, name: str) -> None:
+        """One io daemon died on its own: respawn it from ITS plan
+        (still on disk — the agent only rewrites plans on restart).
+        NEVER falls back to a full _spawn_io(): that would spawn
+        duplicates of the still-healthy daemons onto live rings."""
+        path = getattr(self, "_io_plan_paths", {}).get(name)
+        if not path or not os.path.exists(path):
+            # the supervisor loop retries with backoff; the plan
+            # reappears after the next agent (re)boot
+            log.error("no plan on disk for %s; will retry", name)
+            return
+        with open(path) as f:
+            self.procs[name] = self.spawn(self.io_argv(json.load(f)))
 
     # --- lifecycle ---
     def start(self) -> None:
@@ -198,26 +276,27 @@ class InitSupervisor:
                 if self._stop.wait(delay):
                     return
                 if name == "agent":
-                    io = self.procs.get("io")
-                    if io is not None and io.poll() is None:
-                        io.terminate()
-                        try:
-                            io.wait(timeout=10)
-                        except subprocess.TimeoutExpired:
-                            io.kill()
+                    for io_name in self._io_names():
+                        io = self.procs.get(io_name)
+                        if io is not None and io.poll() is None:
+                            io.terminate()
+                            try:
+                                io.wait(timeout=10)
+                            except subprocess.TimeoutExpired:
+                                io.kill()
                     self._spawn_agent()
                     if self.config.io.enabled and self.config.io.plan_path:
                         self._spawn_io()
                 elif self.procs.get(name) is proc:
                     # skip if the agent-restart path above already
                     # replaced this io process within this loop pass
-                    self._spawn_io()
+                    self._respawn_one_io(name)
 
     def stop(self, term_timeout: float = 15.0) -> None:
         """Reverse-order teardown: IO daemon first (drains endpoints),
         then the agent (owns the rings)."""
         self._stop.set()
-        for name in ("io", "agent"):
+        for name in self._io_names() + ["agent"]:
             proc = self.procs.get(name)
             if proc is None or proc.poll() is not None:
                 continue

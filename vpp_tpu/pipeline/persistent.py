@@ -63,11 +63,14 @@ class PersistentPump:
         self._tables0 = tables
         self._step = _packed_call(pipeline_step)
 
+        self._stop_seen = False
+
         def host_fetch(_tick):
             """Ordered callback: block until the host has a frame (or
             stop); returns (ctl, frame)."""
             item = self._in.get()
             if item is None:
+                self._stop_seen = True
                 return STOP, np.zeros(
                     (PACKED_IN_ROWS, self.batch), np.int32)
             return np.int32(item[0]), item[1]
@@ -114,6 +117,12 @@ class PersistentPump:
             try:
                 self._tables_final = jax.block_until_ready(
                     self._loop(self._tables0))
+                if not self._stop_seen:
+                    # the loop exhausted max_frames mid-stream: later
+                    # submits would hang their consumers silently
+                    self._error = RuntimeError(
+                        f"persistent loop frame budget "
+                        f"({self._max_frames}) exhausted without stop")
             except BaseException as e:  # noqa: BLE001 — re-raised to
                 # the caller from result()/stop(); a silently dead
                 # loop would leave result() blocking to timeout
