@@ -353,3 +353,35 @@ print("BAD" if bad else "ALL-OK", bad)
     out = run_under_shim(vcl_env(sock, appns_index=9), code,
                          port_deny, port_allow)
     assert out.startswith("ALL-OK"), out
+
+
+def test_thread_exit_closes_admission_fd(admission, listener):
+    """Per-thread channels must not leak fds when threads die — a
+    thread-per-connection server would otherwise grow one admission fd
+    per handled connection (TLS destructor closes them)."""
+    engine, sock = admission
+    port = listener()
+    code = """
+import os, socket, sys, threading
+port = int(sys.argv[1])
+
+def fds():
+    return len(os.listdir("/proc/self/fd"))
+
+def probe():
+    s = socket.socket()
+    s.settimeout(10)
+    s.connect(("127.0.0.1", port))
+    s.close()
+
+# one warm round so lazy init (TLS key etc.) is paid
+t = threading.Thread(target=probe); t.start(); t.join()
+base = fds()
+for _ in range(40):
+    t = threading.Thread(target=probe)
+    t.start()
+    t.join()
+print("LEAK" if fds() > base + 2 else "BOUNDED", base, fds())
+"""
+    out = run_under_shim(vcl_env(sock, appns_index=2), code, port)
+    assert out.startswith("BOUNDED"), out

@@ -61,6 +61,22 @@ static void resolve_reals(void) {
 static __thread int chan_fd = -1;
 static __thread pid_t chan_pid = 0;
 
+/* __thread alone leaks the fd when a thread exits (no destructor) — a
+ * thread-per-connection server would leak one admission fd per
+ * handled connection. A pthread key's destructor closes it; the value
+ * stores fd+1 so fd 0 is distinguishable from "unset". */
+static pthread_key_t chan_key;
+static pthread_once_t chan_key_once = PTHREAD_ONCE_INIT;
+
+static void chan_destruct(void *p) {
+  int fd = (int)(intptr_t)p - 1;
+  if (fd >= 0) close(fd);
+}
+
+static void chan_key_make(void) {
+  pthread_key_create(&chan_key, chan_destruct);
+}
+
 #pragma pack(push, 1)
 struct vcl_req { /* must mirror hoststack/admission.py _REQ ("<BBHIIIHH") */
   uint8_t op;
@@ -142,6 +158,10 @@ static int query(const struct vcl_req *req) {
     if (chan_fd < 0) {
       chan_fd = chan_open();
       chan_pid = getpid();
+      if (chan_fd >= 0) {
+        pthread_once(&chan_key_once, chan_key_make);
+        pthread_setspecific(chan_key, (void *)(intptr_t)(chan_fd + 1));
+      }
     }
     if (chan_fd < 0) break;
     uint8_t rsp;
@@ -151,6 +171,7 @@ static int query(const struct vcl_req *req) {
     } else {
       close(chan_fd); /* stale (agent restarted) — reconnect and retry */
       chan_fd = -1;
+      pthread_setspecific(chan_key, NULL);
     }
   }
   return verdict;
