@@ -1,0 +1,145 @@
+"""LockstepDriver protocol edges, in-process (single-controller JAX —
+process_allgather degenerates to identity, so the agreement logic runs
+for real without worker subprocesses)."""
+
+import numpy as np
+import pytest
+
+from vpp_tpu.ir.rule import Action, ContivRule
+from vpp_tpu.kvstore.store import KVStore
+from vpp_tpu.parallel.multihost import LockstepDriver, MultiHostCluster
+from vpp_tpu.pipeline.tables import DataplaneConfig
+from vpp_tpu.pipeline.vector import Disposition
+
+
+def build_cluster():
+    cfg = DataplaneConfig(
+        max_tables=4, max_rules=16, max_global_rules=32, max_ifaces=8,
+        fib_slots=32, sess_slots=256, nat_mappings=4, nat_backends=16,
+    )
+    cl = MultiHostCluster(2, cfg)
+    for nid in range(2):
+        n = cl.node(nid)
+        up = n.add_uplink()
+        pi = n.add_pod_interface(("d", f"p{nid}"))
+        n.builder.add_route(f"10.{nid + 1}.0.2/32", pi,
+                            Disposition.LOCAL)
+        other = 1 - nid
+        n.builder.add_route(f"10.{other + 1}.0.0/24", up,
+                            Disposition.REMOTE, node_id=other)
+    return cl
+
+
+def frames(cl, sport=1000):
+    f = [[] for _ in cl.local_nodes]
+    f[0] = [dict(src="10.1.0.2", dst="10.2.0.2", proto=6, sport=sport,
+                 dport=80, rx_if=cl.node(0).pod_if[("d", "p0")])]
+    return f
+
+
+def test_stale_stop_counter_does_not_halt_a_new_fleet():
+    """A stop agreed by a PREVIOUS deployment persists in the store;
+    the new fleet's driver must baseline it away — and a FRESH stop
+    request still stops."""
+    store = KVStore()
+    store.put("/mesh/epoch/stop_req", 5)     # old fleet's shutdown
+    cl = build_cluster()
+    driver = LockstepDriver(cl, store)
+    cl.publish()
+    res = driver.tick(frames(cl), n=8)
+    assert res is not None, "stale stop halted a restarted fleet"
+    driver.request_stop()
+    assert driver.tick(frames(cl), n=8) is None
+    # post-stop: no further collectives may be issued
+    assert driver.tick(frames(cl), n=8) is None
+
+
+def test_commit_agreement_publishes_once_per_request():
+    store = KVStore()
+    cl = build_cluster()
+    driver = LockstepDriver(cl, store)
+    cl.publish()
+    assert cl.epoch == 1
+    driver.tick(frames(cl), n=8)
+    assert cl.epoch == 1                     # no request, no publish
+
+    cl.node(1).builder.set_global_table([ContivRule(action=Action.DENY)])
+    driver.request_commit()
+    res = driver.tick(frames(cl, sport=2000), n=8)
+    assert cl.epoch == 2                     # agreed, published
+    # the SAME tick already enforces the new epoch
+    disp = np.asarray(cl.local_rows(res.delivered.disp))
+    assert not (disp[1] == int(Disposition.LOCAL)).any()
+    driver.tick(frames(cl, sport=3000), n=8)
+    assert cl.epoch == 2                     # one publish per request
+
+
+def test_idle_skip_and_commit_tick_always_steps():
+    store = KVStore()
+    cl = build_cluster()
+    driver = LockstepDriver(cl, store)
+    cl.publish()
+    calls = []
+
+    def fabric(tick):
+        calls.append(tick)
+        return "stepped"
+
+    assert driver.tick_fabric(fabric, has_work=False) is None
+    assert driver.tick_fabric(fabric, has_work=False) is None
+    assert calls == [], "idle fleet must skip the fabric step"
+    assert driver.ticks == 2, "ticks advance even when idle"
+
+    assert driver.tick_fabric(fabric, has_work=True) == "stepped"
+    assert calls == [3]
+
+    driver.request_commit()
+    assert driver.tick_fabric(fabric, has_work=False) == "stepped", \
+        "a commit tick must step even when idle"
+    assert driver.applied == 1
+
+    driver.request_stop()
+    out = driver.tick_fabric(fabric, has_work=True)
+    assert out is LockstepDriver._STOPPED
+    assert calls == [3, 4], "no step after the fleet agreed to stop"
+
+
+def test_session_aging_on_tick_cadence():
+    store = KVStore()
+    cl = build_cluster()
+    driver = LockstepDriver(cl, store, expire_every=2)
+    cl.publish()
+    driver.tick(frames(cl), n=8)             # installs a session
+    occupied = int(np.asarray(cl.tables.sess_valid).sum())
+    assert occupied > 0
+    # tick 2 triggers the collective expiry pass; with a huge max_age
+    # nothing is reclaimed (no-op correctness), with max_age tiny the
+    # slots free
+    driver.tick([[] for _ in cl.local_nodes], n=8)
+    assert int(np.asarray(cl.tables.sess_valid).sum()) == occupied
+    cl.expire_sessions(now=10_000_000, max_age=1)
+    assert int(np.asarray(cl.tables.sess_valid).sum()) == 0
+
+
+def test_publish_names_out_of_mesh_targets():
+    cl = build_cluster()
+    cl.node(0).builder.add_route("10.77.0.0/24", cl.node(0).uplink_if,
+                                 Disposition.REMOTE, node_id=7)
+    with pytest.raises(ValueError, match="outside"):
+        cl.publish()
+
+
+def test_publish_guards_missing_uplink():
+    """An in-mesh fabric target without an uplink would silently drop
+    inbound traffic on reserved interface 0 — publish refuses."""
+    cfg = DataplaneConfig(
+        max_tables=4, max_rules=16, max_global_rules=32, max_ifaces=8,
+        fib_slots=32, sess_slots=256, nat_mappings=4, nat_backends=16,
+    )
+    cl = MultiHostCluster(2, cfg)
+    up0 = cl.node(0).add_uplink()
+    cl.node(0).builder.add_route("10.2.0.0/24", up0,
+                                 Disposition.REMOTE, node_id=1)
+    # node 1: no add_uplink()
+    with pytest.raises(ValueError, match="no uplink"):
+        cl.publish()
