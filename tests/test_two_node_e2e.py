@@ -142,7 +142,7 @@ class Node:
             AgentConfig.from_dict(json.load(open(self.cfg_path))),
             self.cfg_path, plan_timeout_s=120.0,
         )
-        plan = sup.read_plan()
+        (_, plan), = sup.read_plans().values()
         self._io_log = open(f"{self.dir}/io.log", "w")
         self.io = subprocess.Popen(
             sup.io_argv(plan), env=env,
