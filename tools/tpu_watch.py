@@ -18,7 +18,8 @@ This watcher loops forever:
      a partial run writes/refreshes BENCH_r04_partial_tpu.json iff it
      got further than any earlier attempt
 
-Run detached:  nohup python tools/tpu_watch.py >/tmp/tpu_watch_r04.log 2>&1 &
+Run detached:  nohup python tools/tpu_watch.py >/tmp/tpu_watch_r05.log 2>&1 &
+(The target round defaults to 05; override with VPPT_BENCH_ROUND=rNN.)
 """
 
 from __future__ import annotations
@@ -39,8 +40,9 @@ PROBE_TIMEOUT_S = 90
 # the grant wedged forever
 PROBE_INTERVAL_S = 600
 BENCH_DEADLINE_S = 2700  # 45 min; a healthy-tunnel full run fits easily
-COMPLETE_OUT = os.path.join(REPO, "BENCH_r04_manual_tpu.json")
-PARTIAL_OUT = os.path.join(REPO, "BENCH_r04_partial_tpu.json")
+ROUND = os.environ.get("VPPT_BENCH_ROUND", "r05")
+COMPLETE_OUT = os.path.join(REPO, f"BENCH_{ROUND}_manual_tpu.json")
+PARTIAL_OUT = os.path.join(REPO, f"BENCH_{ROUND}_partial_tpu.json")
 
 
 def log(msg: str) -> None:
@@ -126,7 +128,7 @@ def run_capture() -> None:
                 ["git", "-C", REPO, "add", COMPLETE_OUT]).returncode
             rc |= subprocess.run(
                 ["git", "-C", REPO, "commit", "-m",
-                 "Round-4 real-TPU bench capture (watcher, "
+                 f"Real-TPU bench capture {ROUND} (watcher, "
                  f"snapshot of {commit[:10]})",
                  "--", COMPLETE_OUT]).returncode
             if rc == 0:
