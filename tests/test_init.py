@@ -328,3 +328,35 @@ def test_mesh_plans_straggle_past_settle_window(tmp_path):
     assert len(ios) == 2, "partial plan set committed"
     shms = sorted(p.argv[p.argv.index("--shm") + 1] for p in ios)
     assert shms == ["vpp-shm.0", "vpp-shm.1"]
+
+
+def test_multihost_waits_for_local_plans_only(tmp_path):
+    """Multi-host (mesh.coordinator set): mesh.nodes counts the WHOLE
+    cluster's rows, but this host's MultiHostRuntime writes plan files
+    only for the rows its local devices own. Waiting for the global
+    count timed out on every host and left the deployment with no io
+    daemons (ADVICE r4 #1) — the settle heuristic must apply instead."""
+    from vpp_tpu.cmd.config import MeshConfig
+
+    cfg = cfg_with_io(tmp_path)
+    cfg.mesh = MeshConfig(nodes=4, rule_shards=1,
+                          coordinator="10.0.0.1:1234",
+                          num_processes=2, process_id=0)
+    spawner = FakeSpawner(cfg, plan_on_agent=False)
+    sup = InitSupervisor(cfg, None, spawn=spawner, plan_timeout_s=8.0)
+
+    def local_rows_boot():
+        # this host owns rows 0 and 1 of the 4-row cluster
+        write_plan(cfg, _suffix=".0", shm="vpp-shm.0")
+        write_plan(cfg, _suffix=".1", shm="vpp-shm.1")
+
+    threading.Thread(target=local_rows_boot, daemon=True).start()
+    sup.start()
+    try:
+        ios = spawner.by_module("vpp_tpu.cmd.io_daemon")
+        assert len(ios) == 2, (
+            f"expected io daemons for the 2 LOCAL rows, got {len(ios)}")
+        shms = sorted(p.argv[p.argv.index("--shm") + 1] for p in ios)
+        assert shms == ["vpp-shm.0", "vpp-shm.1"]
+    finally:
+        sup.stop()

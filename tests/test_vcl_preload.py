@@ -385,3 +385,37 @@ print("LEAK" if fds() > base + 2 else "BOUNDED", base, fds())
 """
     out = run_under_shim(vcl_env(sock, appns_index=2), code, port)
     assert out.startswith("BOUNDED"), out
+
+
+def test_engine_exception_answers_deny_not_disconnect(admission):
+    """A per-request engine error (a JAX/device fault, a table bug)
+    must answer DENY and keep serving — with the shim's default
+    fail-open config, tearing down the serve loop would turn every
+    later verdict on that app into an allow (policy bypass via an
+    agent-side bug, not agent unavailability)."""
+    engine, sock = admission
+
+    boom = {"n": 1}
+    real_check = engine.check_connect
+
+    def flaky_check(batch):
+        if boom["n"]:
+            boom["n"] -= 1
+            raise RuntimeError("injected engine fault")
+        return real_check(batch)
+
+    engine.check_connect = flaky_check
+
+    c = socket.socket(socket.AF_UNIX)
+    c.settimeout(10)
+    c.connect(sock)
+    req = _REQ.pack(OP_CONNECT, 6, 0, 0,
+                    ipi("127.0.0.1"), ipi("127.0.0.1"), 0, 80)
+    # request 1: engine raises -> deny byte, connection STAYS up
+    c.sendall(req)
+    assert c.recv(1) == b"\x00"
+    # request 2 on the SAME connection: engine healthy again -> real
+    # verdict (no rules -> allow), proving the serve loop survived
+    c.sendall(req)
+    assert c.recv(1) == b"\x01"
+    c.close()

@@ -165,9 +165,21 @@ class InitSupervisor:
         boots straggle (e.g. a host-interconnect wire wait between
         them), leaving later nodes without io daemons. Only the
         auto-size mode (nodes=0) falls back to waiting for the set to
-        stop growing."""
+        stop growing. Multi-host (mesh.coordinator set) also settles:
+        mesh.nodes counts the WHOLE cluster's rows but this host's
+        MultiHostRuntime writes plan_path.<n> only for the rows its
+        local devices own — waiting for the global count would time
+        out on every host and leave the deployment with no io daemons
+        at all."""
         deadline = time.monotonic() + self.plan_timeout_s
-        want = self.config.mesh.nodes if self._is_mesh() else 1
+        mesh = self.config.mesh
+        if not self._is_mesh():
+            want = 1
+        elif mesh.coordinator:
+            want = 0  # per-host row count is decided by device
+            #           ownership at runtime, not config — settle
+        else:
+            want = mesh.nodes
         seen: List[str] = []
         stable_since = 0.0
         while time.monotonic() < deadline and not self._stop.is_set():

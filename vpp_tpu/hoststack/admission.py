@@ -154,21 +154,41 @@ class VclAdmissionServer:
                     buf += chunk
                 op, proto, _pad, appns, lcl_ip, rmt_ip, lcl_port, \
                     rmt_port = _REQ.unpack(buf)
-                if op == OP_CONNECT:
-                    ok = bool(self.engine.check_connect(
-                        [(appns, proto, lcl_ip, lcl_port,
-                          rmt_ip, rmt_port)])[0])
+                # an engine exception (a JAX/device error, a table
+                # mid-swap bug) must answer DENY, not tear down the
+                # connection: with the shim's default fail-open config
+                # a killed serve loop turns every later verdict on that
+                # app into an allow — an agent-side bug becoming a
+                # policy bypass. Deny keeps the failure visible in the
+                # deny counters while the loop keeps serving.
+                try:
+                    if op == OP_CONNECT:
+                        ok = bool(self.engine.check_connect(
+                            [(appns, proto, lcl_ip, lcl_port,
+                              rmt_ip, rmt_port)])[0])
+                        with self._stats_lock:
+                            self.stats["connect_checks"] += 1
+                            self.stats["connect_denies"] += int(not ok)
+                    elif op == OP_ACCEPT:
+                        ok = bool(self.engine.check_accept(
+                            [(proto, lcl_ip, lcl_port, rmt_ip,
+                              rmt_port)])[0])
+                        with self._stats_lock:
+                            self.stats["accept_checks"] += 1
+                            self.stats["accept_denies"] += int(not ok)
+                    else:
+                        log.warning("unknown admission op %#x", op)
+                        ok = False
+                except Exception:  # incl. OSError: no socket ops in
+                    #                this block, so it's engine-raised
+                    log.exception("admission engine error — denying")
                     with self._stats_lock:
-                        self.stats["connect_checks"] += 1
-                        self.stats["connect_denies"] += int(not ok)
-                elif op == OP_ACCEPT:
-                    ok = bool(self.engine.check_accept(
-                        [(proto, lcl_ip, lcl_port, rmt_ip, rmt_port)])[0])
-                    with self._stats_lock:
-                        self.stats["accept_checks"] += 1
-                        self.stats["accept_denies"] += int(not ok)
-                else:
-                    log.warning("unknown admission op %#x", op)
+                        side = ("connect" if op == OP_CONNECT
+                                else "accept")
+                        # count the check too: deny rates computed as
+                        # denies/checks must stay <= 1 under faults
+                        self.stats[f"{side}_checks"] += 1
+                        self.stats[f"{side}_denies"] += 1
                     ok = False
                 conn.sendall(b"\x01" if ok else b"\x00")
         except OSError:

@@ -150,9 +150,13 @@ static int query(const struct vcl_req *req) {
   if (chan_fd >= 0 && chan_pid != getpid()) {
     /* inherited across fork(): the fd is the PARENT's stream; using it
      * here would interleave our requests with theirs and cross their
-     * verdicts. Drop it (close only our dup'd reference). */
+     * verdicts. Drop it (close only our dup'd reference) — and clear
+     * the pthread key too, else if the reconnect below fails this
+     * thread's exit destructor close()s the stale fd number, which may
+     * by then be an unrelated reused descriptor. */
     close(chan_fd);
     chan_fd = -1;
+    pthread_setspecific(chan_key, NULL);
   }
   for (int attempt = 0; attempt < 2 && verdict < 0; attempt++) {
     if (chan_fd < 0) {
