@@ -34,6 +34,22 @@ def main(argv=None) -> int:
     parser.add_argument("--promote-after", type=float, default=10.0,
                         help="seconds of primary unreachability before a "
                              "follower promotes itself to primary")
+    parser.add_argument("--witness", default=None, metavar="HOST:PORT",
+                        help="QuorumWitness address (vpp-tpu-kvwitness). "
+                             "Primary role: renew authority there and "
+                             "self-demote when it can't. Follower role: "
+                             "promote only on a granted claim. This is "
+                             "what makes a both-alive partition yield "
+                             "exactly one writable store")
+    parser.add_argument("--advertise", default=None, metavar="HOST:PORT",
+                        help="this server's client-reachable address, "
+                             "recorded at the witness as the primary "
+                             "identity (default host:port, required "
+                             "explicitly when --host is a wildcard)")
+    parser.add_argument("--fence-ttl", type=float, default=6.0,
+                        help="witness lease ttl: primary renews every "
+                             "ttl/6, self-demotes after 0.7*ttl unproven; "
+                             "a standby claim is grantable after ttl")
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args(argv)
 
@@ -43,17 +59,25 @@ def main(argv=None) -> int:
     )
     server = KVServer(host=args.host, port=args.port,
                       persist_path=args.persist)
-    replicator = None
-    if args.follow:
+    advertise = args.advertise or f"{args.host}:{server.port}"
+    if args.witness and args.advertise is None and \
+            args.host in ("0.0.0.0", "::"):
+        parser.error("--witness with a wildcard --host needs --advertise "
+                     "(the witness records the client-reachable address)")
+    ha = None
+    if args.follow or args.witness:
         from vpp_tpu.agent.node_id import LIVENESS_PREFIX
-        from vpp_tpu.kvstore.replica import Replicator
+        from vpp_tpu.kvstore.replica import HaCoordinator
 
-        fhost, _, fport = args.follow.rpartition(":")
-        server.read_only = True
-        replicator = Replicator(
-            server.store, fhost, int(fport),
+        # HaCoordinator owns the role for the process lifetime:
+        # standby -> (claim granted) -> guarded primary ->
+        # (superseded) -> standby of the winner, and so on — the pair
+        # heals back to primary+standby with no operator action.
+        ha = HaCoordinator(
+            server, args.witness, advertise,
+            fence_ttl=args.fence_ttl,
             promote_after=args.promote_after,
-            on_promote=lambda: setattr(server, "read_only", False),
+            follow=args.follow,
             grace_prefixes=(LIVENESS_PREFIX,),
         ).start()
     if args.port_file:
@@ -74,8 +98,8 @@ def main(argv=None) -> int:
         signal.signal(sig, lambda *_: stop.set())
     server.start()
     stop.wait()
-    if replicator is not None:
-        replicator.stop()
+    if ha is not None:
+        ha.stop()
     server.close()
     return 0
 

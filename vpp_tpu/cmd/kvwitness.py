@@ -1,0 +1,59 @@
+"""vpp-tpu-kvwitness: the HA kvstore pair's quorum arbiter.
+
+Third voter of the 2-replicas + arbiter construction
+(kvstore/witness.py) that stands in for the raft quorum the reference
+gets from etcd (/root/reference/k8s/contiv-vpp.yaml:72-114). Holds no
+cluster data — only the fencing epoch and the current primary's lease —
+so it runs anywhere a few KB and a TCP port exist (the chart schedules
+it on a third node, k8s/chart/).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+from vpp_tpu.kvstore.witness import QuorumWitness
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="vpp-tpu kvstore quorum witness")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=12380)
+    parser.add_argument("--persist", default=None,
+                        help="epoch/primary survive restarts here "
+                             "(atomic-rename JSON)")
+    parser.add_argument("--port-file", default=None,
+                        help="write the bound port here once listening")
+    parser.add_argument("--log-level", default="INFO")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=args.log_level,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    witness = QuorumWitness(host=args.host, port=args.port,
+                            persist_path=args.persist)
+    if args.port_file:
+        import os
+
+        tmp = args.port_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(witness.port))
+        os.replace(tmp, args.port_file)
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    witness.start()
+    stop.wait()
+    witness.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

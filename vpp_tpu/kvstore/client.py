@@ -93,6 +93,11 @@ class RemoteKVStore:
         self._resync_rids: Dict[int, _Watch] = {}
         self._rotate_start = 0
         self._closed = False
+        # HA fencing (kvstore/witness.py): the epoch learned from the
+        # connected server, stamped onto every write so a superseded
+        # ex-primary can never silently accept state derived from
+        # another primary's history. None = server predates fencing.
+        self._epoch: Optional[int] = None
 
         self._events: "queue.Queue[Any]" = queue.Queue()
         self._dispatcher = threading.Thread(
@@ -144,6 +149,7 @@ class RemoteKVStore:
                 name="kv-reader",
             )
             self._reader.start()
+        self._refresh_epoch()
         self._reregister_watches()
 
     def _read_loop(self, sock: socket.socket) -> None:
@@ -206,13 +212,25 @@ class RemoteKVStore:
                 except Exception:  # noqa: BLE001 — observer must not kill us
                     log.exception("on_reconnect_failed callback failed")
 
+    def _refresh_epoch(self) -> None:
+        """Learn the connected server's fencing epoch. Every (re)connect
+        refreshes it — failing over to a freshly promoted primary means
+        a bumped epoch, and writes stamped with the old one would be
+        rejected as stale forever."""
+        try:
+            self._epoch = int(self._request("epoch"))
+        except RuntimeError:
+            self._epoch = None  # pre-fencing server
+        except (ConnectionError, TimeoutError):
+            pass  # connection already dying; reconnect will retry
+
     def _reregister_watches(self) -> None:
         with self._lock:
             watches = [w for w in self._watches.values() if w.active]
         for w in watches:
             try:
                 self._watch_request(w)
-            except ConnectionError:
+            except (ConnectionError, TimeoutError):
                 return  # next reconnect will retry
 
     def _watch_request(self, w: _Watch) -> Any:
@@ -230,12 +248,22 @@ class RemoteKVStore:
             self._resync_rids.pop(rid, None)
 
     # --- request plumbing ---
+    WRITE_OPS = frozenset(
+        {"put", "delete", "cas", "cad",
+         "lease_grant", "lease_keepalive", "lease_revoke"}
+    )
+
     def _request(self, op: str, _rid: Optional[int] = None, **kw: Any) -> Any:
         rid = next(self._ids) if _rid is None else _rid
-        msg = {"id": rid, "op": op, **kw}
-        data = json.dumps(msg, separators=(",", ":")).encode() + b"\n"
         deadline = time.monotonic() + self.request_timeout
         while True:
+            msg = {"id": rid, "op": op, **kw}
+            # stamp writes with the fencing epoch (rebuilt every
+            # attempt: a retry after an epoch refresh must carry the
+            # NEW epoch)
+            if op in self.WRITE_OPS and self._epoch is not None:
+                msg["fence"] = self._epoch
+            data = json.dumps(msg, separators=(",", ":")).encode() + b"\n"
             with self._lock:
                 sock = self._sock
                 if sock is not None:
@@ -268,7 +296,14 @@ class RemoteKVStore:
                 raise ConnectionError("connection lost during request")
             if not resp.get("ok"):
                 err = str(resp.get("error"))
-                if "not primary" in err and \
+                if "stale fencing epoch" in err and \
+                        time.monotonic() < deadline:
+                    # the server's epoch moved past ours (a promotion we
+                    # haven't heard about). The op did NOT apply; learn
+                    # the current epoch and retry with it.
+                    self._refresh_epoch()
+                    continue
+                if ("not primary" in err or "superseded" in err) and \
                         len(self.endpoints) > 1 and \
                         time.monotonic() < deadline:
                     # connected to a read-only follower (e.g. the

@@ -115,6 +115,24 @@ class _Conn(socketserver.BaseRequestHandler):
                 raise PermissionError(
                     "not primary: this kvserver is a read-only follower"
                 )
+            if op in self.WRITE_OPS and req.get("fence") is not None:
+                fence, epoch = int(req["fence"]), store.fencing_epoch
+                if fence > epoch:
+                    # the client has seen a NEWER primary than us: we
+                    # are a superseded ex-primary that hasn't heard yet.
+                    # Demote on the spot — the in-band beacon that
+                    # closes the sub-ttl window between a standby's
+                    # granted claim and our own guard noticing
+                    # (kvstore/witness.py module docs).
+                    self.server.read_only = True  # type: ignore[attr-defined]
+                    log.error("write carried fencing epoch %d > ours %d "
+                              "— superseded, demoting to read-only",
+                              fence, epoch)
+                    raise PermissionError(
+                        f"superseded: fencing epoch {fence} > {epoch}")
+                if fence < epoch:
+                    raise PermissionError(
+                        f"stale fencing epoch {fence} != {epoch}")
             if op == "get":
                 res = store.get(req["key"])
             elif op == "put":
@@ -168,6 +186,8 @@ class _Conn(socketserver.BaseRequestHandler):
                 res = store.lease_revoke(int(req["lease"]))
             elif op == "ping":
                 res = "pong"
+            elif op == "epoch":
+                res = store.fencing_epoch
             else:
                 raise ValueError(f"unknown op: {op!r}")
         except Exception as exc:  # noqa: BLE001 — protocol boundary
@@ -217,6 +237,11 @@ class KVServer:
                     log.info("lease sweep expired %d keys", n)
             except Exception:  # noqa: BLE001 — keep sweeping
                 log.exception("lease sweep failed")
+
+    @property
+    def epoch(self) -> int:
+        """The served store's HA fencing epoch (kvstore/witness.py)."""
+        return self.store.fencing_epoch
 
     @property
     def read_only(self) -> bool:

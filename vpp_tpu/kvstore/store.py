@@ -58,6 +58,11 @@ class KVStore:
         self._lease_keys: Dict[int, set] = {}
         self._lease_of: Dict[str, int] = {}
         self._next_lease = 1
+        # HA fencing epoch (kvstore/witness.py): bumped by a granted
+        # witness claim on promotion, stamped onto writes by fenced
+        # clients, persisted so a restarted ex-primary still knows the
+        # epoch it was superseded at
+        self._fence = 0
         self._persist_path = persist_path
         if persist_path and os.path.exists(persist_path):
             self.load(persist_path)
@@ -137,6 +142,20 @@ class KVStore:
     def revision(self) -> int:
         with self._lock:
             return self._rev
+
+    @property
+    def fencing_epoch(self) -> int:
+        with self._lock:
+            return self._fence
+
+    @fencing_epoch.setter
+    def fencing_epoch(self, value: int) -> None:
+        with self._lock:
+            if value < self._fence:
+                raise ValueError(
+                    f"fencing epoch may only advance ({value} < {self._fence})")
+            self._fence = int(value)
+            self._maybe_persist()
 
     # --- watch ---
     def watch(self, prefix: str, callback: WatchCallback) -> Callable[[], None]:
@@ -244,6 +263,7 @@ class KVStore:
         with self._lock:
             return {
                 "rev": self._rev,
+                "fence": self._fence,
                 "data": dict(self._data),
                 "lease_of": dict(self._lease_of),
             }
@@ -279,6 +299,7 @@ class KVStore:
         with self._lock:
             self._data = dict(snapshot["data"])
             self._rev = int(snapshot["rev"])
+            self._fence = int(snapshot.get("fence", 0))
             # leases do not survive a restart: their holders must
             # keepalive against the new process, so any persisted
             # lease-attached key (node liveness entries) starts expired
