@@ -299,6 +299,106 @@ class TestPipelinedPump:
             rings.close()
 
 
+class TestPersistentPumpMode:
+    """mode="persistent": the pump feeds ONE resident device program
+    (pipeline/persistent.py) instead of per-batch dispatches — the
+    deployed form of docs/LATENCY.md lever #2 (VERDICT r4 Next #2).
+    Same ring contract, same in-order per-frame results; config swaps
+    restart the loop without losing traffic or session state."""
+
+    def _mk(self):
+        from vpp_tpu.io.rings import IORingPair
+
+        dp = Dataplane(DataplaneConfig())
+        a = dp.add_pod_interface(("default", "a"))
+        b = dp.add_pod_interface(("default", "b"))
+        dp.builder.add_route(f"{CLIENT_IP}/32", a, Disposition.LOCAL)
+        dp.builder.add_route(f"{SERVER_IP}/32", b, Disposition.LOCAL)
+        dp.swap()
+        return dp, a, b, IORingPair(n_slots=32)
+
+    def _push(self, rings, codec, scratch, rx_if, k, per=4):
+        from vpp_tpu.native.pktio import PacketCodec  # noqa: F401
+
+        frames = [
+            make_frame(CLIENT_IP, SERVER_IP, proto=6, sport=30000 + k,
+                       dport=2000 + k * per + j)
+            for j in range(per)
+        ]
+        cols, n = codec.parse(frames, rx_if, scratch)
+        assert rings.rx.push(cols, n, payload=scratch)
+        return per
+
+    def _drain(self, rings, want, timeout=240):
+        got = []
+        deadline = time.monotonic() + timeout
+        while len(got) < want and time.monotonic() < deadline:
+            f = rings.tx.peek()
+            if f is None:
+                time.sleep(0.005)
+                continue
+            got.append((f.cols["sport"][:f.n].copy(),
+                        f.cols["rx_if"][:f.n].copy(), f.n))
+            rings.tx.release()
+        return got
+
+    def test_resident_loop_serves_frames_in_order(self):
+        from vpp_tpu.native.pktio import PacketCodec
+        from vpp_tpu.pipeline.vector import VEC
+
+        dp, a, b, rings = self._mk()
+        codec = PacketCodec()
+        scratch = np.zeros((VEC, rings.rx.snap), np.uint8)
+        pump = DataplanePump(dp, rings, mode="persistent")
+        assert pump.warm() == [VEC]  # loop resident + hot
+        pump.start()
+        try:
+            n_frames, per = 6, 4
+            for k in range(n_frames):
+                self._push(rings, codec, scratch, a, k, per)
+            got = self._drain(rings, n_frames)
+            assert len(got) == n_frames
+            for k, (sports, tx_ifs, n) in enumerate(got):
+                assert n == per
+                assert (sports == 30000 + k).all()  # submission order
+                assert (tx_ifs == b).all()
+            assert pump.stats["frames"] == n_frames
+            assert pump.stats["batches"] == n_frames  # one frame, one pass
+        finally:
+            assert pump.stop()
+            rings.close()
+        # the loop's session state was grafted back at shutdown: the
+        # permitted TCP flows live in the dataplane's tables now
+        assert int(np.asarray(dp.tables.sess_valid).sum()) > 0
+
+    def test_config_swap_restarts_loop_without_losing_traffic(self):
+        from vpp_tpu.native.pktio import PacketCodec
+        from vpp_tpu.pipeline.vector import VEC
+
+        dp, a, b, rings = self._mk()
+        codec = PacketCodec()
+        scratch = np.zeros((VEC, rings.rx.snap), np.uint8)
+        pump = DataplanePump(dp, rings, mode="persistent")
+        pump.warm()
+        pump.start()
+        try:
+            self._push(rings, codec, scratch, a, 0)
+            assert len(self._drain(rings, 1)) == 1
+            epoch0 = pump._persist_epoch
+            # live config change: a new route -> dp.swap bumps the
+            # epoch; the pump must restart the resident loop and keep
+            # serving (the reference's non-stalling renderer Commit)
+            dp.builder.add_route("10.9.9.9/32", b, Disposition.LOCAL)
+            dp.swap()
+            self._push(rings, codec, scratch, a, 1)
+            got = self._drain(rings, 1)
+            assert len(got) == 1 and (got[0][0] == 30001).all()
+            assert pump._persist_epoch > epoch0  # loop was relaunched
+        finally:
+            assert pump.stop()
+            rings.close()
+
+
 class TestCodecSafety:
     """Adversarial wire input must never leak slot memory or over-read."""
 

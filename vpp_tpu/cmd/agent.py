@@ -283,6 +283,7 @@ class ContivAgent:
                 self.dataplane, self.io_rings,
                 max_batch=c.io.max_batch, depth=c.io.depth,
                 workers=c.io.workers,
+                mode=c.io.pump_mode,
                 # ICMP errors (time-exceeded/unreachable) originate from
                 # the node's pod gateway address — the hop traceroute
                 # shows (reference: VPP ip4-icmp-error)

@@ -36,6 +36,12 @@ class IOConfig:
     max_batch: int = 2048
     depth: int = 8
     workers: int | None = None
+    # "dispatch" (pipelined ladder, peak throughput) or "persistent"
+    # (ONE resident device loop fed through io_callbacks — the
+    # latency-floor regime; docs/LATENCY.md lever #2). Persistent mode
+    # disables ICMP error generation (side programs park behind the
+    # resident loop).
+    pump_mode: str = "dispatch"
     # node uplink (vpp-tpu-init bootstrap; reference contiv-init
     # vppcfg.go:74-559): kernel NIC the IO daemon binds as the uplink
     uplink_interface: str = ""

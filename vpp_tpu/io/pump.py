@@ -28,6 +28,25 @@ no payload copy happens on the rx side at all.
 Non-IPv4 frames bypass classification and are punted to the host
 disposition (the STN punt analog for un-parseable traffic, reference
 plugins/contiv/pod.go:375-381).
+
+``mode="persistent"`` (docs/LATENCY.md lever #2; VERDICT r4 Next #2)
+replaces the dispatch/fetch legs with ONE resident device program
+(pipeline/persistent.PersistentPump): a jitted ``lax.while_loop`` stays
+on the device and exchanges frames through ordered io_callbacks, so the
+per-frame PJRT dispatch + result-fetch round trips — the dominant cost
+on an attached transport — are paid once at loop start instead of per
+batch. The VPP analog is the eternal worker dispatch loop: the graph
+scheduler never re-launches per frame (reference
+docs/VPP_PACKET_TRACING_K8S.md:28-50). Trades:
+
+  * frames process one VEC-frame at a time in submission order — the
+    latency-floor regime, not peak batch throughput (the dispatch
+    ladder owns that);
+  * the resident program occupies the device, so side programs are
+    parked behind it: the ICMP error path is disabled in this mode
+    (its round trips would never complete) and config swaps RESTART
+    the loop (sessions carried over) — detected per-frame via
+    ``dp.epoch``.
 """
 
 from __future__ import annotations
@@ -66,7 +85,8 @@ class DataplanePump:
                  depth: int = 8,
                  workers: Optional[int] = None,
                  lat_window: int = 4096,
-                 icmp_src_ip: int = 0):
+                 icmp_src_ip: int = 0,
+                 mode: str = "dispatch"):
         """``max_batch``: largest coalesced device batch (packets);
         ``depth``: in-flight batches before dispatch backpressures;
         ``workers``: concurrent result fetchers — None auto-picks: on a
@@ -78,7 +98,12 @@ class DataplanePump:
         ``icmp_src_ip``: with a non-zero address (the node's pod gateway
         IP), TTL-expired and no-route drops generate ICMP
         time-exceeded/net-unreachable back to the sender (io/icmp.py;
-        VPP's ip4-icmp-error node)."""
+        VPP's ip4-icmp-error node).
+        ``mode``: "dispatch" (default, the pipelined ladder) or
+        "persistent" (resident device loop — module docs)."""
+        if mode not in ("dispatch", "persistent"):
+            raise ValueError(f"unknown pump mode {mode!r}")
+        self.mode = mode
         self.dp = dataplane
         self.rings = rings
         self.poll_s = poll_s
@@ -88,6 +113,11 @@ class DataplanePump:
             workers = 1 if jax.default_backend() == "cpu" else 8
         self.icmp = None
         self._icmp_scratch = None
+        if icmp_src_ip and mode == "persistent":
+            log.warning("persistent pump mode: ICMP error generation "
+                        "disabled (side programs park behind the "
+                        "resident loop)")
+            icmp_src_ip = 0
         if icmp_src_ip:
             from vpp_tpu.io.icmp import IcmpErrorGen
 
@@ -151,6 +181,12 @@ class DataplanePump:
         self._tx_lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: list = []
+        # persistent mode (module docs): the resident-loop handle, the
+        # table epoch it was started against, and the FIFO tying each
+        # submitted frame to the loop's (ordered) result stream
+        self._ppump = None
+        self._persist_epoch = -1
+        self._persist_q: "queue.Queue" = queue.Queue(maxsize=depth)
 
     def bucket_sizes(self) -> list:
         """The dispatch bucket ladder — precompile ``process_packed``
@@ -161,11 +197,21 @@ class DataplanePump:
         """Compile every dispatch bucket rung (blocking). Call before
         ``start()``/before offering traffic: a rung's first jit compile
         costs 20-40 s on TPU, and paying it lazily inside the dispatch
-        thread stalls the rx rings and drops live traffic."""
+        thread stalls the rx rings and drops live traffic.
+
+        Persistent mode: launches the resident loop (its one compile)
+        and round-trips an all-invalid frame through it, so the device
+        program is resident and hot before traffic is offered."""
         import jax
 
         from vpp_tpu.pipeline.dataplane import packed_input_zeros
 
+        if self.mode == "persistent":
+            self._persist_start()
+            self._ppump.submit(packed_input_zeros(VEC),
+                               now=self.dp.clock_ticks())
+            self._ppump.result(timeout=300.0)
+            return [VEC]
         for bucket in self.buckets:
             jax.block_until_ready(
                 self.dp.process_packed(packed_input_zeros(bucket))
@@ -174,12 +220,17 @@ class DataplanePump:
 
     # --- lifecycle ---
     def start(self) -> "DataplanePump":
-        names = [(self._dispatch_loop, "dp-pump-dispatch"),
-                 (self._write_loop, "dp-pump-tx")]
-        names += [(self._fetch_loop, f"dp-pump-fetch{i}")
-                  for i in range(self.workers)]
-        if self.icmp is not None:
-            names.append((self._icmp_loop, "dp-pump-icmp"))
+        if self.mode == "persistent":
+            names = [(self._persist_dispatch_loop, "dp-pump-dispatch"),
+                     (self._persist_collect_loop, "dp-pump-collect"),
+                     (self._write_loop, "dp-pump-tx")]
+        else:
+            names = [(self._dispatch_loop, "dp-pump-dispatch"),
+                     (self._write_loop, "dp-pump-tx")]
+            names += [(self._fetch_loop, f"dp-pump-fetch{i}")
+                      for i in range(self.workers)]
+            if self.icmp is not None:
+                names.append((self._icmp_loop, "dp-pump-icmp"))
         for fn, name in names:
             t = threading.Thread(target=fn, daemon=True, name=name)
             t.start()
@@ -293,6 +344,148 @@ class DataplanePump:
         self.stats["batches"] += 1
         self.stats["max_coalesce"] = max(self.stats["max_coalesce"],
                                          len(frames))
+
+    # --- persistent mode: resident device loop (module docs) ---
+    def _persist_start(self) -> None:
+        from vpp_tpu.pipeline.persistent import PersistentPump
+
+        with self.dp._lock:
+            tables = self.dp.tables
+            epoch = self.dp.epoch
+        self._ppump = PersistentPump(tables, batch=VEC).start()
+        self._persist_epoch = epoch
+
+    def _persist_stop_merge(self) -> None:
+        """Exit the resident loop and graft its final session state
+        back into the dataplane's live tables — the loop threads
+        sessions through its carry, so by stop time they are NEWER
+        than whatever dp.tables holds (the per-dispatch path commits
+        per batch; this is the same continuity, paid at loop exit)."""
+        from vpp_tpu.pipeline.tables import SESSION_FIELDS
+
+        if self._ppump is None:
+            return
+        final = self._ppump.stop()
+        self._ppump = None
+        if final is None:
+            return
+        sess = {f: getattr(final, f) for f in SESSION_FIELDS}
+        with self.dp._lock:
+            if self.dp.tables is not None:
+                # DataplaneTables is a NamedTuple pytree, not a dataclass
+                self.dp.tables = self.dp.tables._replace(**sess)
+
+    def _persist_restart(self) -> None:
+        """Config epoch moved (dp.swap): the resident loop still holds
+        the OLD tables. Drain it (ordered results keep flowing to the
+        collector), merge sessions, relaunch against the new epoch —
+        the persistent-mode equivalent of the per-dispatch path simply
+        reading dp.tables on its next batch."""
+        log.info("persistent loop restart: table epoch %d -> %d",
+                 self._persist_epoch, self.dp.epoch)
+        self._persist_stop_merge()
+        self._persist_start()
+
+    def _persist_dispatch_loop(self) -> None:
+        from vpp_tpu.native.pktio import pack_batch
+
+        if self._ppump is None:  # warm() may have launched it already
+            self._persist_start()
+        rx = self.rings.rx
+        hold_cap = max(2, rx.ring.n_slots - 4)
+        try:
+            while not self._stop.is_set():
+                if self.dp.epoch != self._persist_epoch:
+                    self._persist_restart()
+                with self._held_lock:
+                    held = self._held
+                    f = None
+                    if rx.pending() - held > 0 and held < hold_cap:
+                        f = rx.peek_nth(held)
+                    if f is not None:
+                        self._held += 1
+                if f is None:
+                    time.sleep(self.poll_s)
+                    continue
+                tp0 = time.perf_counter()
+                flat = np.zeros((PACKED_IN_ROWS, VEC), np.int32)
+                non_ip = np.zeros(VEC, np.uint8)
+                self._pack_bases[0] = f.cols["src_ip"].ctypes.data
+                self._pack_ns[0] = f.n
+                pack_batch(self._pack_bases, self._pack_ns, 1, flat,
+                           non_ip)
+                self.stats["t_pack"] += time.perf_counter() - tp0
+                t0 = time.perf_counter()
+                try:
+                    self._ppump.submit(flat, now=self.dp.clock_ticks())
+                except RuntimeError:
+                    log.exception("resident loop died — relaunching")
+                    self.stats["batch_errors"] += 1
+                    self._ppump = None
+                    self._persist_start()
+                    self._ppump.submit(flat, now=self.dp.clock_ticks())
+                self.stats["t_dispatch"] += time.perf_counter() - t0
+                item = (self._seq, self._ppump, [f],
+                        non_ip.view(bool), t0)
+                while True:
+                    try:
+                        self._persist_q.put(item, timeout=0.05)
+                        break
+                    except queue.Full:
+                        if self._stop.is_set():
+                            return
+                self._seq += 1
+                self.stats["batches"] += 1
+                self.stats["max_coalesce"] = max(
+                    self.stats["max_coalesce"], 1)
+        finally:
+            # exit the device program on the way out — a resident loop
+            # left behind would block the device for every later user
+            try:
+                self._persist_stop_merge()
+            except Exception:  # noqa: BLE001 — shutdown path
+                log.exception("persistent loop shutdown failed")
+
+    def _persist_collect_loop(self) -> None:
+        """Pull ordered results off the resident loop and hand them to
+        the in-order tx writer. The loop preserves submission order, so
+        seq mapping is one FIFO deep — no reorder buffer needed, but
+        the writer's _done contract is kept so `stop()` semantics and
+        stats stay identical across modes."""
+        while True:
+            try:
+                seq, ppump, frames, non_ip, t0 = self._persist_q.get(
+                    timeout=0.05)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            tf0 = time.perf_counter()
+            batch = None
+            deadline = time.monotonic() + 300.0
+            # NOT gated on _stop: an already-submitted frame's result
+            # is coming (PersistentPump.stop drains every queued frame
+            # before the loop exits) — discarding it at pump shutdown
+            # would silently drop live traffic the dispatch mode
+            # delivers. Loop-death/timeout still bounds the wait.
+            while True:
+                try:
+                    batch = ppump.result(timeout=0.2)
+                    break
+                except queue.Empty:
+                    if time.monotonic() > deadline:
+                        log.error("resident loop result timed out")
+                        self.stats["batch_errors"] += 1
+                        break
+                except RuntimeError:
+                    log.exception("resident loop result failed")
+                    self.stats["batch_errors"] += 1
+                    break
+            with self._lat_lock:
+                self.stats["t_fetch"] += time.perf_counter() - tf0
+            with self._done_cv:
+                self._done[seq] = (batch, frames, non_ip, t0)
+                self._done_cv.notify_all()
 
     # --- fetch workers: concurrent device_get (RPC round trips) ---
     def _fetch_loop(self) -> None:
