@@ -216,3 +216,62 @@ class TestWallClockAging:
         expired = dp.expire_sessions()
         assert expired >= 64
         assert int(np.asarray(dp.tables.sess_valid).sum()) == 0
+
+
+class TestElectionStrategies:
+    """The claim (scatter-min) and sort (stable-argsort) slot elections
+    must be bit-identical across every collision/eviction/conflict
+    shape — the backend-dependent auto-selection (ops/session.py module
+    doc) is only sound if the strategies can never disagree."""
+
+    def test_claim_and_sort_elections_identical(self, monkeypatch):
+        import jax
+        import jax.numpy as jnp
+
+        from vpp_tpu.ops import session as sess
+
+        rng = np.random.default_rng(11)
+        for trial in range(10):
+            slots = int(rng.choice([64, 256, 1024]))
+            n = int(rng.choice([64, 256]))
+            results = {}
+            for mode in ("claim", "sort"):
+                monkeypatch.setenv("VPPT_SESS_ELECTION", mode)
+                dp = Dataplane(DataplaneConfig(
+                    max_tables=2, max_rules=8, max_global_rules=8,
+                    max_ifaces=4, fib_slots=16, sess_slots=slots,
+                    nat_mappings=2, nat_backends=2))
+                dp.add_uplink()
+                dp.swap()
+                fn = jax.jit(sess.session_insert)
+                t = dp.tables
+                masks = []
+                r2 = np.random.default_rng(trial)  # same traffic per mode
+                for step in range(4):
+                    pv = make_packet_vector(
+                        [{"src": "10.0.0.1", "dst": "10.1.1.3",
+                          "proto": 6, "sport": 1024, "dport": 80,
+                          "rx_if": 1}], n=n)
+                    n_flows = int(r2.choice([4, 16, n]))
+                    fsrc = r2.integers(1, 1 << 24, n_flows).astype(np.uint32)
+                    fsport = r2.integers(1024, 60000, n_flows).astype(np.int32)
+                    pick = r2.integers(0, n_flows, n)
+                    pv = pv._replace(
+                        src_ip=jnp.asarray(fsrc[pick]),
+                        sport=jnp.asarray(fsport[pick]),
+                        flags=jnp.asarray(
+                            r2.integers(0, 2, n).astype(np.int32)))
+                    want = jnp.asarray(
+                        r2.integers(0, 2, n).astype(bool)) & pv.valid
+                    t, ins, fail = fn(t, pv, want, jnp.int32(step + 1))
+                    masks.append((np.asarray(ins), np.asarray(fail)))
+                results[mode] = (t, masks)
+            tc, mc = results["claim"]
+            ts, ms = results["sort"]
+            for (ic, fc), (is_, fs) in zip(mc, ms):
+                assert np.array_equal(ic, is_), trial
+                assert np.array_equal(fc, fs), trial
+            for f in ("sess_valid", "sess_src", "sess_dst",
+                      "sess_ports", "sess_proto", "sess_time"):
+                assert np.array_equal(np.asarray(getattr(tc, f)),
+                                      np.asarray(getattr(ts, f))), (trial, f)

@@ -7,26 +7,57 @@ statefully without needing its own permit rule.
 Design: fixed-size power-of-two slot arrays, linear probing with a small
 static probe depth (fully unrolled under jit — no data-dependent control
 flow). Batch-parallel insert resolves same-slot collisions *within* a
-vector by a scatter-min election: the lowest packet index wins the slot,
-losers fall through to the next probe round. Aging is a host-side loop
-clearing stale ``sess_time`` entries (the reference ages sessions on a
-VPP worker interrupt, SURVEY.md §5).
+vector by an election among contenders for the same slot; the lowest
+packet index wins, losers fall through to the next probe round. Two
+equivalent election strategies (differentially tested identical,
+selected at trace time — VERDICT r4 Next #5):
+
+  * ``claim`` — scatter-min over an [n_slots] claim array. O(n_slots)
+    memset + scatter + gather per probe round: cheap linear memory work
+    on CPU at deployed table sizes, but cost SCALES with the table
+    (366 ns/pkt @4k slots → 947 @64k, one CPU core).
+  * ``sort`` — stable argsort of the candidates' slot numbers; equal
+    slots form runs in packet order, the first of each run is the
+    winner. O(B log B) in the BATCH, independent of n_slots (flat
+    ~1 µs/pkt on the same core at any table size).
+
+``auto`` picks claim on CPU-class backends at ≤16k slots, sort above
+that and on TPU (scatter serialization is the TPU risk the sort path
+avoids; ``bench.py`` measures both on the live backend —
+``sess_election_*`` keys — so the choice is evidence-backed per
+round). Override with VPPT_SESS_ELECTION=claim|sort. Aging is a
+host-side loop clearing stale ``sess_time`` entries (the reference
+ages sessions on a VPP worker interrupt, SURVEY.md §5).
 """
 
 from __future__ import annotations
 
+import os
 from typing import Tuple
 
 import jax.numpy as jnp
-
-from vpp_tpu.pipeline.tables import DataplaneTables
-from vpp_tpu.pipeline.vector import PacketVector
 
 # Plain int, not jnp: a module-level device scalar would (a) initialize
 # the JAX backend at import and (b) be captured as an embedded device
 # constant in every jitted program using it, which forces a drastically
 # slower dispatch path (~100x) through the axon TPU tunnel.
 _BIG = 0x7FFFFFFF
+
+
+def election_mode(n_slots: int) -> str:
+    """Trace-time election strategy (module doc). Env override first,
+    then backend/table-size heuristic."""
+    mode = os.environ.get("VPPT_SESS_ELECTION", "auto")
+    if mode in ("claim", "sort"):
+        return mode
+    import jax
+
+    if jax.default_backend() != "cpu":
+        return "sort"
+    return "claim" if n_slots <= (1 << 14) else "sort"
+
+from vpp_tpu.pipeline.tables import DataplaneTables
+from vpp_tpu.pipeline.vector import PacketVector
 
 # Linear-probe depth of every hash table (lookup and insert must agree).
 SESS_PROBES = 4
@@ -163,7 +194,6 @@ def hashmap_insert(
     silent skip VERDICT r1 flagged.
     """
     n_slots = valid.shape[0]
-    p_idx = jnp.arange(h.shape[0], dtype=jnp.int32)
     keys = tuple(keys)
     extras = tuple(extras)
 
@@ -203,17 +233,35 @@ def hashmap_insert(
     inserted = refresh
 
     # Pass 2: election-insert rounds. Among packets probing the same empty
-    # slot, the lowest packet index wins; after the write, any pending
-    # packet whose key now occupies the slot (the winner itself, or a
-    # same-key loser) is satisfied — this is what prevents two packets of
-    # one flow in the same vector from inserting twice.
+    # slot, the lowest packet index wins (election strategies in the
+    # module doc — semantics identical, picked at trace time); after the
+    # write, any pending packet whose key now occupies the slot (the
+    # winner itself, or a same-key loser) is satisfied — this is what
+    # prevents two packets of one flow in the same vector from
+    # inserting twice.
+    batch = h.shape[0]
+    mode = election_mode(n_slots)
+    p_idx = jnp.arange(batch, dtype=jnp.int32)
+
+    def elect(cand, idx):
+        if mode == "claim":
+            claim = jnp.full((n_slots,), _BIG, dtype=jnp.int32)
+            claim = claim.at[jnp.where(cand, idx, n_slots)].min(
+                p_idx, mode="drop")
+            return cand & (claim[idx] == p_idx)
+        slot_key = jnp.where(cand, idx, n_slots)  # non-cands sort last
+        order = jnp.argsort(slot_key)              # stable (jnp default)
+        ss = slot_key[order]
+        first_of_run = jnp.concatenate(
+            [jnp.ones((1,), bool), ss[1:] != ss[:-1]])
+        return jnp.zeros(batch, bool).at[order].set(
+            first_of_run & (ss < n_slots))
+
     for p in range(probes):
         idx = (h + p) & (n_slots - 1)
         empty = ~live_at(idx)   # free, or expired (insert-time eviction)
         cand = pending & empty
-        claim = jnp.full((n_slots,), _BIG, dtype=jnp.int32)
-        claim = claim.at[jnp.where(cand, idx, n_slots)].min(p_idx, mode="drop")
-        winner = cand & (claim[idx] == p_idx)
+        winner = elect(cand, idx)
 
         widx = jnp.where(winner, idx, n_slots)  # out-of-range = dropped
         keys = tuple(
@@ -229,8 +277,9 @@ def hashmap_insert(
         # flow in this same vector won the key (intra-batch reply-key
         # collision) — flag it so the caller fails closed.
         done_key = pending & key_at(idx)
-        done = done_key & payload_at(idx)
-        conflict = conflict | (done_key & ~payload_at(idx))
+        pay_same = payload_at(idx)
+        done = done_key & pay_same
+        conflict = conflict | (done_key & ~pay_same)
         inserted = inserted | done
         pending = pending & ~done_key
     return valid, time, keys, extras, inserted, conflict, pending
