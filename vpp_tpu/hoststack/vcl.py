@@ -157,6 +157,9 @@ class FilteredSocket:
     def send(self, data: bytes) -> int:
         return self.sock.send(data)
 
+    def sendall(self, data: bytes) -> None:
+        self.sock.sendall(data)
+
     def recv(self, n: int) -> bytes:
         return self.sock.recv(n)
 
