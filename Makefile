@@ -17,7 +17,8 @@ test:
 test-race:
 	VPP_TPU_RACE=1 $(PY) -m pytest tests/test_concurrency.py tests/test_io.py \
 		tests/test_native_ring.py tests/test_kvserver.py \
-		tests/test_vcl_preload.py tests/test_multihost_unit.py -q
+		tests/test_vcl_preload.py tests/test_multihost_unit.py \
+		tests/test_kvstore_fencing.py -q
 
 lint:
 	$(PY) tools/lint.py
