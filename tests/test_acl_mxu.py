@@ -195,3 +195,139 @@ def test_dataplane_flips_to_mxu_path():
     assert disp[0] == int(Disposition.LOCAL)
     assert disp[1] == int(Disposition.DROP)
     assert int(res.stats.drop_acl) == 1
+
+
+class TestIncrementalCompile:
+    """compile_bitplanes_update + pack_rules_incremental must be
+    bit-identical to a from-scratch compile across every churn shape:
+    in-place edits, inserts/removes (index shifts), table shrink/grow,
+    range-port (non-compilable) rules entering and leaving, and
+    builder snapshot/restore invalidation."""
+
+    @staticmethod
+    def _rand_rules(rng, n):
+        rules = []
+        for i in range(n):
+            kind = rng.integers(0, 8)
+            net = ipaddress.ip_network(
+                f"10.{int(rng.integers(0, 200))}."
+                f"{int(rng.integers(0, 200))}.0/24")
+            if kind == 0:  # any-port rule (the IR cannot express port
+                #            RANGES; the non-compilable bad-mask carry
+                #            is covered at the packed level below)
+                r = ContivRule(action=Action.DENY, dest_network=net,
+                               protocol=Protocol.TCP)
+            else:
+                r = ContivRule(
+                    action=Action.PERMIT if i % 3 else Action.DENY,
+                    dest_network=net, protocol=Protocol.TCP,
+                    dest_port=int(rng.integers(1, 60000)))
+            rules.append(r)
+        return rules
+
+    def test_matches_full_compile_across_churn(self):
+        from vpp_tpu.pipeline.tables import TableBuilder
+        from vpp_tpu.ops.acl_mxu import compile_bitplanes_full
+        from vpp_tpu.pipeline.tables import pack_rules as _pack
+
+        rng = np.random.default_rng(3)
+        cfg = DataplaneConfig(max_tables=2, max_rules=8,
+                              max_global_rules=256, max_ifaces=4,
+                              fib_slots=16, sess_slots=64,
+                              nat_mappings=2, nat_backends=2)
+        b = TableBuilder(cfg)
+        rules = self._rand_rules(rng, 64)
+        for step in range(12):
+            b.set_global_table(rules)
+            want, _ = compile_bitplanes_full(_pack(rules, 256), 256)
+            got = b.glb_mxu
+            assert np.array_equal(got.coeff, want.coeff), step
+            assert np.array_equal(got.k, want.k), step
+            assert np.array_equal(got.act, want.act), step
+            assert got.ok == want.ok, step
+            for key in b.glb:
+                assert np.array_equal(
+                    b.glb[key], _pack(rules, 256)[key]), (step, key)
+            # next churn: mix of in-place edit / insert / remove
+            rules = list(rules)
+            op = step % 4
+            if op == 0:    # in-place edits (the common policy churn)
+                for j in rng.integers(0, len(rules), 5):
+                    rules[int(j)] = ContivRule(
+                        action=Action.PERMIT, protocol=Protocol.TCP,
+                        dest_port=7000 + step)
+            elif op == 1:  # insert early: everything after shifts
+                rules.insert(3, ContivRule(action=Action.DENY,
+                                           protocol=Protocol.UDP,
+                                           dest_port=9))
+            elif op == 2:  # remove a chunk: table shrinks
+                del rules[5:15]
+            else:          # grow with fresh random rules (some bad)
+                rules.extend(self._rand_rules(rng, 7))
+
+    def test_bad_mask_carries_across_updates(self):
+        """Non-compilable (range-port) rows can only be expressed at
+        the packed level (the ContivRule IR carries exact-or-ANY ports
+        only — test_range_rules_fall_back). The incremental update
+        must CARRY the bad mask: ok stays False while an untouched
+        range row exists, and recovers only when the update recompiles
+        that row into a compilable form."""
+        from vpp_tpu.ops.acl_mxu import (
+            compile_bitplanes_full, compile_bitplanes_update,
+        )
+
+        cap = 64
+        packed = pack_rules(
+            [ContivRule(action=Action.PERMIT, protocol=Protocol.TCP,
+                        dest_port=80) for _ in range(4)], cap)
+        packed["dport_lo"][2], packed["dport_hi"][2] = 1000, 2000  # range
+        mxu, bad = compile_bitplanes_full(packed, cap)
+        assert not mxu.ok and bad[2]
+
+        # churn a DIFFERENT row: badness must carry, not reset
+        packed2 = {k: v.copy() for k, v in packed.items()}
+        packed2["dport_lo"][0] = packed2["dport_hi"][0] = 8080
+        mxu2, bad2 = compile_bitplanes_update(
+            packed2, cap, mxu, bad, np.asarray([0], np.int64))
+        want2, wbad2 = compile_bitplanes_full(packed2, cap)
+        assert not mxu2.ok and bad2[2]
+        assert np.array_equal(mxu2.coeff, want2.coeff)
+        assert np.array_equal(mxu2.k, want2.k)
+        assert np.array_equal(bad2, wbad2)
+
+        # fix the range row: ok recovers through the incremental path
+        packed3 = {k: v.copy() for k, v in packed2.items()}
+        packed3["dport_lo"][2] = packed3["dport_hi"][2] = 1500
+        mxu3, bad3 = compile_bitplanes_update(
+            packed3, cap, mxu2, bad2, np.asarray([2], np.int64))
+        want3, wbad3 = compile_bitplanes_full(packed3, cap)
+        assert mxu3.ok and not bad3.any()
+        assert np.array_equal(mxu3.coeff, want3.coeff)
+        assert np.array_equal(mxu3.k, want3.k)
+        assert np.array_equal(mxu3.act, want3.act)
+
+    def test_snapshot_restore_invalidates_cache(self):
+        from vpp_tpu.pipeline.tables import TableBuilder
+        from vpp_tpu.ops.acl_mxu import compile_bitplanes_full
+        from vpp_tpu.pipeline.tables import pack_rules as _pack
+
+        cfg = DataplaneConfig(max_tables=2, max_rules=8,
+                              max_global_rules=64, max_ifaces=4,
+                              fib_slots=16, sess_slots=64,
+                              nat_mappings=2, nat_backends=2)
+        b = TableBuilder(cfg)
+        r1 = [ContivRule(action=Action.PERMIT, protocol=Protocol.TCP,
+                         dest_port=80)]
+        r2 = [ContivRule(action=Action.DENY, protocol=Protocol.TCP,
+                         dest_port=443)]
+        b.set_global_table(r1)
+        snap = b.state_snapshot()
+        b.set_global_table(r2)
+        b.state_restore(snap)
+        # post-restore commit must not trust the pre-restore identity
+        # cache: committing r2 again must produce exactly r2's compile
+        b.set_global_table(r2)
+        want, _ = compile_bitplanes_full(_pack(r2, 64), 64)
+        assert np.array_equal(b.glb_mxu.coeff, want.coeff)
+        assert np.array_equal(b.glb_mxu.k, want.k)
+        assert np.array_equal(b.glb_mxu.act, want.act)

@@ -88,18 +88,13 @@ def empty_bitplanes(max_rules: int) -> MxuTable:
     )
 
 
-def compile_bitplanes(packed: dict, max_rules: int) -> MxuTable:
-    """Compile pack_rules() output into bit-plane coefficients.
-
-    ``packed`` holds [R] arrays: src_net/src_mask/dst_net/dst_mask/
-    proto/sport_lo/sport_hi/dport_lo/dport_hi/action (action == -1 marks
-    padding rows). Padding and non-compilable rows get k=1 so they can
-    never produce a zero mismatch count.
-    """
-    r_cap = mxu_rule_capacity(max_rules)
-    coeff = np.zeros((PLANES, r_cap), np.float32)
-    k = np.ones(r_cap, np.float32)  # default: never matches
-    n = len(packed["action"])
+def _compile_columns(packed: dict, n: int):
+    """The bit-plane math for ``n`` rule rows (any subset): returns
+    (coeff [PLANES, n], k [n], bad [n]). Live-ness comes from
+    action != -1, so padding rows compile to never-match columns
+    regardless of position."""
+    coeff = np.zeros((PLANES, n), np.float32)
+    k = np.ones(n, np.float32)  # default: never matches
     live = packed["action"] != -1
 
     def put_field(base: int, nbits: int, value, mask):
@@ -109,12 +104,12 @@ def compile_bitplanes(packed: dict, max_rules: int) -> MxuTable:
         shifts = np.arange(nbits, dtype=np.uint32)[:, None]
         m = ((mask[None, :] >> shifts) & 1).astype(np.float32)
         v = ((value[None, :] >> shifts) & 1).astype(np.float32)
-        coeff[base:base + nbits, :n] = np.where(
+        coeff[base:base + nbits, :] = np.where(
             live[None, :], m * (1.0 - 2.0 * v), 0.0
         )
-        k[:n] += np.where(live[None, :], m * v, 0.0).sum(axis=0)
+        k[:] += np.where(live[None, :], m * v, 0.0).sum(axis=0)
 
-    k[:n] = np.where(live, 0.0, 1.0)
+    k[:] = np.where(live, 0.0, 1.0)
     src_net = packed["src_net"].astype(np.uint32)
     src_mask = packed["src_mask"].astype(np.uint32)
     dst_net = packed["dst_net"].astype(np.uint32)
@@ -148,11 +143,59 @@ def compile_bitplanes(packed: dict, max_rules: int) -> MxuTable:
     # zero its coefficient column AND pin k=1 so the mismatch count is a
     # constant 1 regardless of packet bits. A caller that ignores
     # ok=False misses the rule rather than wildcarding its ports.
-    coeff[:, :n] = np.where(bad_rows[None, :], 0.0, coeff[:, :n])
-    k[:n] = np.where(bad_rows, 1.0, k[:n])
+    coeff[:, :] = np.where(bad_rows[None, :], 0.0, coeff)
+    k[:] = np.where(bad_rows, 1.0, k)
+    return coeff, k, bad_rows
+
+
+def compile_bitplanes_full(packed: dict, max_rules: int):
+    """Compile pack_rules() output into bit-plane coefficients.
+
+    ``packed`` holds [R] arrays: src_net/src_mask/dst_net/dst_mask/
+    proto/sport_lo/sport_hi/dport_lo/dport_hi/action (action == -1 marks
+    padding rows). Padding and non-compilable rows get k=1 so they can
+    never produce a zero mismatch count. Returns (MxuTable, bad [R]) —
+    ``bad`` is the per-row non-compilable mask the incremental update
+    threads forward."""
+    r_cap = mxu_rule_capacity(max_rules)
+    n = len(packed["action"])
+    cblock, kblock, bad = _compile_columns(packed, n)
+    coeff = np.zeros((PLANES, r_cap), np.float32)
+    k = np.ones(r_cap, np.float32)
+    coeff[:, :n] = cblock
+    k[:n] = kblock
     act = np.full(r_cap, -1, np.int32)
     act[:n] = packed["action"]
-    return MxuTable(coeff=coeff, k=k, act=act, ok=not bad_rows.any())
+    return MxuTable(coeff=coeff, k=k, act=act, ok=not bad.any()), bad
+
+
+def compile_bitplanes(packed: dict, max_rules: int) -> MxuTable:
+    return compile_bitplanes_full(packed, max_rules)[0]
+
+
+def compile_bitplanes_update(packed: dict, max_rules: int,
+                             prev: MxuTable, prev_bad: np.ndarray,
+                             changed: np.ndarray):
+    """Incremental recompile: only the ``changed`` rule columns are
+    recomputed; every other column is carried over from ``prev``
+    (policy churn touches ~one policy's worth of rows out of 10k —
+    recompiling the whole [PLANES, R'] matrix per commit was the
+    dominant host cost of the commit path, VERDICT r4 Next #3).
+    Returns (MxuTable, bad) exactly as compile_bitplanes_full would
+    have produced from scratch — equivalence-tested in
+    tests/test_acl_mxu.py."""
+    coeff = prev.coeff.copy()
+    k = prev.k.copy()
+    act = prev.act.copy()
+    bad = prev_bad.copy()
+    if len(changed):
+        sub = {key: arr[changed] for key, arr in packed.items()}
+        cblock, kblock, bsub = _compile_columns(sub, len(changed))
+        coeff[:, changed] = cblock
+        k[changed] = kblock
+        act[changed] = packed["action"][changed]
+        bad[changed] = bsub
+    return MxuTable(coeff=coeff, k=k, act=act, ok=not bad.any()), bad
 
 
 def packet_bit_planes(pkts: PacketVector) -> jnp.ndarray:
