@@ -118,7 +118,7 @@ def test_show_io_with_pump_and_daemon():
 
         cli = DebugCLI(dp, pump=pump, io_ctl=IOControlClient(sock))
         out = cli.run("show io")
-        assert "pump: 1 frames" in out
+        assert "pump (dispatch): 1 frames" in out
         assert "io-daemon: rx" in out
         assert "batch latency" in out
         assert "interfaces" in out
@@ -276,3 +276,44 @@ def test_show_mesh():
     assert "local mesh rows: [0, 1]" in out
     assert "tick 123" in out and "epoch-req 2" in out
     assert "mh-0(id 3)" in out
+
+
+def test_show_store_remote_and_local():
+    from vpp_tpu.kvstore.client import RemoteKVStore
+    from vpp_tpu.kvstore.server import KVServer
+    from vpp_tpu.kvstore.store import KVStore
+
+    dp, _, _ = make_env()
+    # in-process store
+    local = KVStore()
+    local.put("a", 1)
+    out = DebugCLI(dp, store=local).run("show store")
+    assert "in-process store" in out and "keys: 1" in out
+    # served store with a fencing epoch: the agent-side view
+    srv = KVServer(host="127.0.0.1", port=0).start()
+    try:
+        srv.store.fencing_epoch = 2
+        client = RemoteKVStore("127.0.0.1", srv.port, request_timeout=5.0)
+        out = DebugCLI(dp, store=client).run("show store")
+        assert f"connected: 127.0.0.1:{srv.port}" in out
+        assert "fencing epoch: 2" in out
+        assert "ping" in out and "revision" in out
+        client.close()
+    finally:
+        srv.close()
+    assert "no store handle" in DebugCLI(dp).run("show store")
+
+
+def test_kvwitness_status_cli(capsys):
+    from vpp_tpu.cmd.kvwitness import main as wmain
+    from vpp_tpu.kvstore.witness import QuorumWitness, WitnessClient
+
+    w = QuorumWitness(host="127.0.0.1").start()
+    try:
+        WitnessClient(w.address).renew("10.0.0.1:12379", 0, ttl=5.0)
+        assert wmain(["--status", w.address]) == 0
+        out = capsys.readouterr().out
+        assert "epoch 0" in out and "10.0.0.1:12379" in out
+    finally:
+        w.close()
+    assert wmain(["--status", "127.0.0.1:1"]) == 1

@@ -21,7 +21,7 @@ from vpp_tpu.pipeline.vector import Disposition, ip4_str
 class DebugCLI:
     def __init__(self, dataplane: Dataplane, tracer=None, stats=None,
                  pump=None, io_ctl=None, session_engine=None,
-                 mesh_runtime=None):
+                 mesh_runtime=None, store=None):
         self.dp = dataplane
         self.tracer = tracer
         self.stats = stats
@@ -33,6 +33,9 @@ class DebugCLI:
         self.session_engine = session_engine
         # optional mesh/multi-host runtime handle (show mesh)
         self.mesh_runtime = mesh_runtime
+        # optional cluster-store handle (show store: endpoint, fencing
+        # epoch, HA failover state as this agent experiences it)
+        self.store = store
 
     # --- dispatch ---
     def run(self, line: str) -> str:
@@ -51,6 +54,7 @@ class DebugCLI:
             ("show", "errors"): self.show_errors,
             ("show", "io"): self.show_io,
             ("show", "neighbors"): self.show_neighbors,
+            ("show", "store"): self.show_store,
             ("help",): self.help,
         }
         for sig, fn in handlers.items():
@@ -73,7 +77,8 @@ class DebugCLI:
             "commands: show interface | show acl | show session | "
             "show session-rules | show mesh | "
             "show nat44 | show fib | show trace | show errors | "
-            "show io | show neighbors | show config-history [n] | "
+            "show io | show neighbors | show store | "
+            "show config-history [n] | "
             "trace add [n] | trace clear | config replay <journal> | "
             "test connectivity <src> <dst> <tcp|udp|icmp> [dport]"
         )
@@ -398,6 +403,54 @@ class DebugCLI:
         return (f"{src_s} -> {dst_s} {proto_s}/{dport} via if {rx_if}\n"
                 f"{trace}\nverdict: {verdict}")
 
+    def show_store(self) -> str:
+        """Cluster-store health as THIS agent experiences it: which
+        endpoint it is on, the fencing epoch its writes carry, and the
+        failover candidates (the etcdctl endpoint-status analog for
+        the fenced HA pair, kvstore/witness.py)."""
+        store = self.store
+        if store is None:
+            return "no store handle attached"
+        import time as _time
+
+        lines = []
+        if hasattr(store, "endpoints"):  # RemoteKVStore
+            t0 = _time.perf_counter()
+            up = True
+            try:
+                store.ping()
+                rtt = f"{(_time.perf_counter() - t0) * 1e3:.1f} ms"
+            except Exception as e:  # noqa: BLE001 — debug path
+                up = False
+                rtt = f"UNREACHABLE ({type(e).__name__})"
+            lines.append(f"connected: {store.host}:{store.port}  "
+                         f"ping {rtt}")
+            for host, port in store.endpoints:
+                mark = " *" if (host, port) == (store.host,
+                                                store.port) else ""
+                lines.append(f"  endpoint {host}:{port}{mark}")
+            epoch = store.fencing_epoch
+            lines.append(
+                f"fencing epoch: "
+                f"{'unfenced (pre-witness server)' if epoch is None else epoch}"
+            )
+            if up:
+                try:
+                    lines.append(f"revision: {store.revision}")
+                except Exception as e:  # noqa: BLE001 — debug path
+                    lines.append(
+                        f"revision: unavailable ({type(e).__name__})")
+            else:
+                # the ping already burned its timeout; a second doomed
+                # request would double the operator's stall
+                lines.append("revision: unavailable (server down)")
+        else:  # in-process KVStore
+            lines.append("in-process store (no HA pair)")
+            lines.append(f"revision: {store.revision}, "
+                         f"fencing epoch: {store.fencing_epoch}, "
+                         f"keys: {len(store.list_keys(''))}")
+        return "\n".join(lines)
+
     def show_io(self) -> str:
         """Pump + IO-daemon counters (the `show interface rx-placement`
         / vector-rates analog for the host IO path)."""
@@ -405,8 +458,9 @@ class DebugCLI:
         if self.pump is not None:
             s = self.pump.stats
             lat = self.pump.latency_us()
+            mode = getattr(self.pump, "mode", "dispatch")
             lines.append(
-                f"pump: {s['frames']} frames, {s['pkts']} pkts, "
+                f"pump ({mode}): {s['frames']} frames, {s['pkts']} pkts, "
                 f"{s['batches']} batches (max coalesce {s['max_coalesce']}"
                 f"), tx-ring-full {s['tx_ring_full']}, "
                 f"errors {s['batch_errors']}"

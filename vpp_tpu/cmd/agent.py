@@ -397,6 +397,7 @@ class ContivAgent:
                     pump=self.io_pump, io_ctl=self.io_ctl,
                     session_engine=self.session_engine,
                     mesh_runtime=self.mesh_runtime,
+                    store=self.store,
                 )
 
                 def _cli_dispatch(method: str, params: dict) -> dict:

@@ -29,8 +29,29 @@ def main(argv=None) -> int:
                              "(atomic-rename JSON)")
     parser.add_argument("--port-file", default=None,
                         help="write the bound port here once listening")
+    parser.add_argument("--status", default=None, metavar="HOST:PORT",
+                        help="query a RUNNING witness and print its "
+                             "arbitration state (epoch, primary, lease "
+                             "remaining) — the operator one-liner for "
+                             "'who is writable right now'")
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args(argv)
+
+    if args.status:
+        from vpp_tpu.kvstore.witness import (
+            WitnessClient, WitnessUnreachable,
+        )
+
+        try:
+            st = WitnessClient(args.status).status()
+        except (WitnessUnreachable, ValueError) as exc:
+            # ValueError: malformed host:port — same operator-facing
+            # one-liner, not a traceback
+            print(f"witness {args.status} unreachable: {exc}")
+            return 1
+        print(f"epoch {st['epoch']}  primary {st['primary'] or '(none)'}"
+              f"  lease remaining {st['remaining']:.1f}s")
+        return 0
 
     logging.basicConfig(
         level=args.log_level,

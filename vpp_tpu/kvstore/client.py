@@ -355,6 +355,14 @@ class RemoteKVStore:
     def persist_path(self) -> Optional[str]:
         return None  # durability lives server-side
 
+    @property
+    def fencing_epoch(self) -> Optional[int]:
+        """The HA fencing epoch this client's writes carry (learned at
+        connect, refreshed on failover); None against a pre-fencing
+        server or while a refresh is pending. Observability surface —
+        `show store` reads it."""
+        return self._epoch
+
     def get(self, key: str) -> Any:
         return self._request("get", key=key)
 
