@@ -430,9 +430,13 @@ class DebugCLI:
                                                 store.port) else ""
                 lines.append(f"  endpoint {host}:{port}{mark}")
             epoch = store.fencing_epoch
+            # None is ambiguous by design: a pre-fencing server never
+            # answers the epoch op, AND a client mid-failover has
+            # nulled it until the new primary answers — don't let the
+            # label misdiagnose the exact window this command debugs
             lines.append(
                 f"fencing epoch: "
-                f"{'unfenced (pre-witness server)' if epoch is None else epoch}"
+                f"{'unknown (pre-fencing server, or refresh pending after failover)' if epoch is None else epoch}"
             )
             if up:
                 try:
