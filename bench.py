@@ -1425,8 +1425,7 @@ def io_daemon_bench(args, duration_s: float = 5.0) -> dict:
                 # the latency window must cover exactly this paced
                 # round — saturation-round batches in the deque would
                 # report queueing delay as paced latency
-                with pump._lat_lock:
-                    pump.batch_lat.clear()
+                pump.reset_latency()
                 p_off, p_got, p_win = run_round(
                     max(sat_pps * 0.6, 5_000.0))
                 paced = {
@@ -1459,8 +1458,7 @@ def io_daemon_bench(args, duration_s: float = 5.0) -> dict:
                 ppump.warm()
                 ppump.start()
                 wait_quiesce(ppump)
-                with ppump._lat_lock:
-                    ppump.batch_lat.clear()  # warm frames excluded
+                ppump.reset_latency()  # warm frames excluded
                 pp_off, pp_got, pp_win = run_round(
                     max(sat_pps * 0.6, 5_000.0))
                 plat = ppump.latency_us()
@@ -1469,11 +1467,14 @@ def io_daemon_bench(args, duration_s: float = 5.0) -> dict:
                         pp_got / pp_win / 1e6, 4),
                     "io_daemon_persistent_goodput_pct": round(
                         100.0 * pp_got / max(1, pp_off), 1),
-                    "io_daemon_persistent_pump_lat_p50_us": round(
-                        plat["p50"], 1),
-                    "io_daemon_persistent_pump_lat_p99_us": round(
-                        plat["p99"], 1),
                 }
+                if plat["n"]:
+                    persistent.update({
+                        "io_daemon_persistent_pump_lat_p50_us": round(
+                            plat["p50"], 1),
+                        "io_daemon_persistent_pump_lat_p99_us": round(
+                            plat["p99"], 1),
+                    })
             except Exception as e:  # noqa: BLE001 — additive round
                 persistent = {"io_daemon_persistent_error":
                               f"{type(e).__name__}: {e}"}
@@ -1483,8 +1484,11 @@ def io_daemon_bench(args, duration_s: float = 5.0) -> dict:
         return {
             **paced,
             **persistent,
-            "io_daemon_pump_lat_p50_us": round(dlat["p50"], 1),
-            "io_daemon_pump_lat_p99_us": round(dlat["p99"], 1),
+            # n == 0 means the paced round died after reset_latency():
+            # omitting beats emitting a plausible-perfect 0.0 datum
+            **({"io_daemon_pump_lat_p50_us": round(dlat["p50"], 1),
+                "io_daemon_pump_lat_p99_us": round(dlat["p99"], 1)}
+               if dlat["n"] else {}),
             "io_daemon_veth_mpps": round(got / send_window / 1e6, 4),
             "io_daemon_offered_mpps": round(offered / send_window / 1e6, 4),
             # diagnosability: what the pump actually moved during the
@@ -1916,11 +1920,13 @@ def _run():
     # faster one per backend is a pure win — this measurement is what
     # ops/session.election_mode's auto heuristic is calibrated against.
     try:
-        _progress(**session_election_bench(args))
+        sess_el = session_election_bench(args)
     except Exception as e:  # noqa: BLE001 — diagnostics must not kill
-        _progress(sess_election_error=f"{type(e).__name__}: {e}")
+        sess_el = {"sess_election_error": f"{type(e).__name__}: {e}"}
+    _progress(**sess_el)
 
     subs = {} if args.no_subbench else sub_benches(args)
+    subs.update(sess_el)  # election shoot-out into the final details
     _progress(**subs)
     if not args.no_subbench:
         try:

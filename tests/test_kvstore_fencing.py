@@ -501,3 +501,32 @@ class TestWitnessRobustness:
             assert c.renew("p:1", 0, ttl=30.0)["ok"] is True
         finally:
             w.close()
+
+    def test_nan_ttl_rejected_even_when_claim_would_be_granted(self):
+        """The deadly variant: on a FREE lease a NaN ttl would win the
+        claim and set a deadline no comparison can ever pass — the
+        arbiter wedged forever, failover impossible. It must be
+        rejected at the protocol boundary, leaving arbitration
+        fully functional."""
+        import json
+
+        w = QuorumWitness().start()  # no primary: claims are grantable
+        try:
+            for evil in ("NaN", "Infinity", -1, 0):
+                s = socket.create_connection(
+                    ("127.0.0.1", w.port), timeout=5)
+                s.sendall(json.dumps(
+                    {"op": "claim", "node": "evil", "ttl": evil}
+                ).encode() + b"\n")
+                s.settimeout(5)
+                rsp = json.loads(s.recv(65536))
+                s.close()
+                assert rsp.get("granted") is not True, (evil, rsp)
+            c = WitnessClient(w.address)
+            assert c.status()["primary"] is None
+            # a legitimate claim still wins, and expiry still works
+            assert c.claim("good:1", ttl=0.3)["granted"] is True
+            time.sleep(0.4)
+            assert c.claim("other:1", ttl=5.0)["granted"] is True
+        finally:
+            w.close()

@@ -752,6 +752,13 @@ class DataplanePump:
                 log.exception("icmp error path failed")
 
     # --- observability ---
+    def reset_latency(self) -> None:
+        """Clear the latency window so the next ``latency_us()``
+        covers only batches from here on (the bench scopes each paced
+        round this way)."""
+        with self._lat_lock:
+            self.batch_lat.clear()
+
     def latency_us(self) -> dict:
         """p50/p99 dispatch→tx batch latency over the recent window."""
         with self._lat_lock:

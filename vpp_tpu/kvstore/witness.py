@@ -144,16 +144,30 @@ class QuorumWitness:
             os.fsync(f.fileno())
         os.replace(tmp, self._persist_path)
 
+    @staticmethod
+    def _ttl_of(req: Dict[str, Any]) -> float:
+        """Validated lease ttl: a NaN/inf/non-positive ttl that won a
+        claim would set a deadline no comparison can ever pass —
+        arbitration wedged forever, no failover possible. Reject at
+        the protocol boundary."""
+        import math
+
+        ttl = float(req.get("ttl", 6.0))
+        if not math.isfinite(ttl) or ttl <= 0:
+            raise ValueError(f"invalid ttl {ttl!r}")
+        return ttl
+
     def _handle(self, req: Dict[str, Any]) -> Dict[str, Any]:
         op = req.get("op")
         now = time.monotonic()
         with self._lock:
             if op == "renew":
                 node, epoch = str(req["node"]), int(req["epoch"])
+                ttl = self._ttl_of(req)
                 if epoch == self.epoch and self.primary in (None, node):
                     changed = self.primary != node
                     self.primary = node
-                    self._ttl = float(req.get("ttl", 6.0))
+                    self._ttl = ttl
                     self._deadline = now + self._ttl
                     if changed:
                         self._persist()
@@ -164,7 +178,7 @@ class QuorumWitness:
                         "primary": self.primary}
             if op == "claim":
                 node = str(req["node"])
-                ttl = float(req.get("ttl", 6.0))
+                ttl = self._ttl_of(req)
                 if self.primary == node:
                     # current primary re-claiming (e.g. after a witness
                     # blip it demoted through): renew, no epoch bump
