@@ -4,7 +4,11 @@ The full control+data story of the reference's two_node_two_pods robot
 suite (tests/robot/suites/two_node_two_pods.robot), with real process
 boundaries everywhere the deployment has them:
 
-  * one vpp-tpu-kvstore subprocess (the etcd analog),
+  * the FENCED store trio as three subprocesses — quorum witness,
+    primary kvserver, warm-standby kvserver (the chart's three
+    Deployments; the etcd analog with its raft-quorum guarantee
+    rebuilt as 2 replicas + arbiter, kvstore/witness.py) — agents'
+    store_url lists both endpoints,
   * per node: a vpp-tpu-agent subprocess and a vpp-tpu-io subprocess
     (launched from the agent's published IO plan, exactly as
     vpp-tpu-init does),
@@ -15,8 +19,11 @@ boundaries everywhere the deployment has them:
   * netns "pods" wired by CNI Adds over each agent's unix socket.
 
 Asserts: pod on node A reaches pod on node B (UDP through both device
-pipelines + VXLAN encap/decap), and a NetworkPolicy published through
-the store (KSR key scheme) cuts that traffic off.
+pipelines + VXLAN encap/decap), a NetworkPolicy published through the
+store (KSR key scheme) cuts that traffic off — and after the primary
+store CRASHES mid-cluster, the witness-arbitrated failover promotes
+the standby, a policy delete lands on the new primary (fenced write),
+and cross-node traffic resumes with no agent restarts.
 """
 
 from __future__ import annotations
@@ -99,15 +106,17 @@ def _wait_ready(port: int, timeout: float = 120.0) -> None:
 
 
 class Node:
-    def __init__(self, name: str, fab_if: str, kv_port: int, ports):
+    def __init__(self, name: str, fab_if: str, kv_ports, ports):
         self.name = name
         self.dir = f"{RUN}/{name}"
         os.makedirs(self.dir, exist_ok=True)
         self.cni_socket = f"{self.dir}/cni.sock"
         self.health_port = ports[0]
+        store_url = "tcp://" + ",".join(
+            f"127.0.0.1:{p}" for p in kv_ports)
         cfg = {
             "node_name": name,
-            "store_url": f"tcp://127.0.0.1:{kv_port}",
+            "store_url": store_url,
             "cni_socket": self.cni_socket,
             "cli_socket": f"{self.dir}/cli.sock",
             "stats_port": ports[1],
@@ -205,32 +214,63 @@ def cluster():
                        timeout=10)
 
     env = _child_env()
+
+    def _port(path, timeout=30):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                return int(open(path).read())
+            except (OSError, ValueError):
+                time.sleep(0.2)
+        raise TimeoutError(path)
+
+    # the fenced store trio, wired exactly as the chart deploys it.
+    # fence-ttl is generous for the 1-core host: thread starvation
+    # under load must not read as a dead primary mid-test.
+    witness = subprocess.Popen(
+        [sys.executable, "-m", "vpp_tpu.cmd.kvwitness", "--host",
+         "127.0.0.1", "--port", "0", "--port-file", f"{RUN}/w.port"],
+        env=env)
+    w_port = _port(f"{RUN}/w.port")
     kv = subprocess.Popen(
         [sys.executable, "-m", "vpp_tpu.cmd.kvserver", "--host",
-         "127.0.0.1", "--port", "0", "--port-file", f"{RUN}/kv.port"],
+         "127.0.0.1", "--port", "0", "--port-file", f"{RUN}/kv.port",
+         "--witness", f"127.0.0.1:{w_port}", "--fence-ttl", "6"],
         env=env)
-    deadline = time.monotonic() + 30
-    while time.monotonic() < deadline and not os.path.exists(f"{RUN}/kv.port"):
-        time.sleep(0.2)
-    kv_port = int(open(f"{RUN}/kv.port").read())
+    kv_port = _port(f"{RUN}/kv.port")
+    standby = subprocess.Popen(
+        [sys.executable, "-m", "vpp_tpu.cmd.kvserver", "--host",
+         "127.0.0.1", "--port", "0", "--port-file", f"{RUN}/sb.port",
+         "--follow", f"127.0.0.1:{kv_port}",
+         "--witness", f"127.0.0.1:{w_port}",
+         "--fence-ttl", "6", "--promote-after", "3"],
+        env=env)
+    sb_port = _port(f"{RUN}/sb.port", timeout=60)
 
-    node_a = Node("node-a", FAB[0], kv_port, (21191, 21991)).start()
-    node_b = Node("node-b", FAB[1], kv_port, (21192, 21992)).start()
+    node_a = Node("node-a", FAB[0], (kv_port, sb_port),
+                  (21191, 21991)).start()
+    node_b = Node("node-b", FAB[1], (kv_port, sb_port),
+                  (21192, 21992)).start()
     try:
         _wait_ready(node_a.health_port)
         _wait_ready(node_b.health_port)
-        yield {"a": node_a, "b": node_b, "kv_port": kv_port}
+        yield {"a": node_a, "b": node_b, "kv_port": kv_port,
+               "sb_port": sb_port, "w_port": w_port,
+               "kv_proc": kv}
     finally:
         for n in (node_a, node_b):
             try:
                 n.stop()
             except Exception:
                 pass
-        kv.terminate()
-        try:
-            kv.wait(timeout=10)
-        except subprocess.TimeoutExpired:
-            kv.kill()
+        for p in (standby, kv, witness):
+            if p.poll() is None:
+                p.terminate()
+        for p in (standby, kv, witness):
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
         _cleanup()
 
 
@@ -346,5 +386,71 @@ class TestTwoNodeTwoPods:
                 out2, _ = recv2.communicate(timeout=30)
                 blocked = "blocked?" not in (out2 or "")
             assert blocked, "policy never cut cross-node traffic"
+        finally:
+            cli.close()
+
+    def test_store_failover_keeps_cluster_serving(self, cluster):
+        """The primary store CRASHES under the live cluster (the
+        etcd-pod-death case the reference rides Kubernetes restarts
+        for): the witness grants the standby's claim, both agents fail
+        over (watch resync, fenced writes at the bumped epoch), a
+        policy DELETE through the new primary un-blocks the cross-node
+        traffic the previous test cut — the whole control loop keeps
+        working with no agent or daemon restarts."""
+        import signal
+
+        from vpp_tpu.kvstore.witness import WitnessClient
+
+        cluster["kv_proc"].send_signal(signal.SIGKILL)
+        cluster["kv_proc"].wait(timeout=15)
+
+        # witness-arbitrated promotion: the standby is the new primary
+        wc = WitnessClient(f"127.0.0.1:{cluster['w_port']}")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            st = wc.status()
+            if st["primary"] == f"127.0.0.1:{cluster['sb_port']}" \
+                    and st["epoch"] >= 1:
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError(f"standby never promoted: {wc.status()}")
+
+        # a fenced write through the failed-over client: deleting the
+        # lock-b policy must re-open pod-b (renderer unwind on BOTH
+        # nodes, driven entirely by the new primary's watch stream)
+        cli = RemoteKVStore(
+            "127.0.0.1", cluster["kv_port"], request_timeout=20.0,
+            reconnect_timeout=30.0,
+            fallbacks=[("127.0.0.1", cluster["sb_port"])])
+        try:
+            pol_key = KSR_PREFIX + m.Policy(
+                name="lock-b", namespace="default").key()
+            assert cli.get(pol_key) is not None, \
+                "expected the previous test's policy in the store"
+            assert cli.delete(pol_key) is True
+            assert cli.fencing_epoch >= 1
+
+            # pod-b's IP from the store (survived the failover via
+            # replication)
+            pod_b = cli.get(KSR_PREFIX + m.Pod(
+                name="pod-b", namespace="default").key())
+            ip_b = pod_b["ip_address"]
+
+            deadline = time.monotonic() + 90
+            flowing = False
+            while time.monotonic() < deadline and not flowing:
+                recv3 = _udp_recv(PODS["b"], 6014, timeout_s=6)
+                time.sleep(0.3)
+                try:
+                    _udp_spray(PODS["a"], ip_b, 6014,
+                               "after-failover", times=12)
+                except subprocess.CalledProcessError:
+                    pass
+                out3, _ = recv3.communicate(timeout=30)
+                flowing = "after-failover" in (out3 or "")
+            assert flowing, (
+                "cross-node traffic never resumed after the store "
+                "failover + policy delete")
         finally:
             cli.close()
