@@ -1,0 +1,124 @@
+"""Worker for the lockstep-across-store-failover scenario (run directly).
+
+Two JAX processes lockstep-ticking against the FENCED HA store trio
+(witness + primary + standby). Mid-run the parent SIGKILLs the
+primary; the workers' clients fail over (reads keep working on the
+follower, writes resume once the witness grants the claim), and the
+control loop proves itself post-failover: P1 stages a deny-all and
+requests a commit through the NEW primary — both processes publish the
+epoch on the same tick and traffic is cut cluster-wide, exactly as
+with the original primary.
+
+argv: pid nprocs coord_port store_url
+"""
+
+import json
+import os
+import sys
+import time
+
+PROC_ID = int(sys.argv[1])
+NUM_PROCS = int(sys.argv[2])
+PORT = sys.argv[3]
+STORE_URL = sys.argv[4]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from vpp_tpu.parallel.multihost import (  # noqa: E402
+    LockstepDriver, MultiHostCluster, barrier, init_multihost,
+)
+from mh_common import pod_ips, stage_full_mesh  # noqa: E402
+from vpp_tpu.ir.rule import Action, ContivRule  # noqa: E402
+from vpp_tpu.kvstore.client import connect_store  # noqa: E402
+from vpp_tpu.pipeline.tables import DataplaneConfig  # noqa: E402
+from vpp_tpu.pipeline.vector import Disposition  # noqa: E402
+
+init_multihost(f"127.0.0.1:{PORT}", NUM_PROCS, PROC_ID,
+               heartbeat_timeout_s=600)
+
+N_NODES = 4
+cfg = DataplaneConfig(
+    max_tables=4, max_rules=16, max_global_rules=32, max_ifaces=8,
+    fib_slots=32, sess_slots=256, nat_mappings=4, nat_backends=16,
+)
+cluster = MultiHostCluster(N_NODES, cfg)
+# generous timeouts: a get/put issued INSIDE the failover window must
+# ride the endpoint rotation + witness-arbitrated promotion (~fence
+# ttl) within one call instead of surfacing a transient error
+store = connect_store(STORE_URL, request_timeout=90.0,
+                      reconnect_timeout=90.0)
+driver = LockstepDriver(cluster, store, expire_every=3)
+
+pod_if = stage_full_mesh(cluster)
+
+barrier("staged")
+cluster.publish()
+
+all_pod_ip = pod_ips(N_NODES)
+
+
+def frames_for_tick(sport):
+    f = [[] for _ in cluster.local_nodes]
+    if PROC_ID == 0:
+        f[0] = [dict(src=all_pod_ip[0], dst=all_pod_ip[2], proto=6,
+                     sport=sport, dport=8080, rx_if=pod_if[0])]
+    return f
+
+
+def deliveries(res):
+    if PROC_ID != 1:
+        return -1
+    disp = cluster.local_rows(res.delivered.disp)
+    return int((disp[0] == int(Disposition.LOCAL)).sum())
+
+
+verdict = {"proc": PROC_ID}
+
+res = driver.tick(frames_for_tick(1000), n=8)
+verdict["t1_delivered"] = deliveries(res)
+
+# signal the parent we're mid-run, then wait out the failover it
+# injects. Reads work on the follower throughout; no collectives here,
+# so the two processes may resume at different instants — the barrier
+# below resynchronizes the fleet before ticking resumes.
+store.put(f"mhf/ready/{PROC_ID}", 1)
+deadline = time.monotonic() + 180
+while time.monotonic() < deadline:
+    try:
+        if store.get("mhf/go") == 1:
+            break
+    except Exception:  # noqa: BLE001 — mid-failover transient
+        pass
+    time.sleep(0.5)
+else:
+    raise SystemExit("parent never signalled go")
+barrier("failover-done")
+
+# the cluster keeps forwarding on the failed-over store
+res = driver.tick(frames_for_tick(1001), n=8)
+verdict["t2_delivered"] = deliveries(res)
+
+# and the control loop works against the NEW primary: stage + commit
+if PROC_ID == 1:
+    cluster.node(2).builder.set_global_table(
+        [ContivRule(action=Action.DENY)])
+    driver.request_commit()
+barrier("change-requested")
+
+res = driver.tick(frames_for_tick(1002), n=8)
+verdict["t3_delivered"] = deliveries(res)
+verdict["t3_epoch"] = cluster.epoch
+verdict["applied"] = driver.applied
+
+# the client's fencing epoch refreshes lazily on its first WRITE
+# against the new primary (a stale stamp is rejected and retried with
+# the refreshed epoch) — write once so the recorded value proves this
+# worker's writes now ride the post-failover history
+store.put(f"mhf/done/{PROC_ID}", 1)
+verdict["fence_epoch"] = store.fencing_epoch
+
+barrier("done")
+print("VERDICT " + json.dumps(verdict), flush=True)

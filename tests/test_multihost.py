@@ -173,3 +173,125 @@ def test_mxu_selection_and_equivalence():
     assert v["verdicts_equal"] is True
     assert v["drop_acl"] >= 1        # some flows hit DENY rules
     assert v["delivered"] >= 1       # and some flows got through
+
+
+def test_lockstep_survives_store_failover(tmp_path):
+    """The multi-host fleet's coordination store dies mid-lockstep:
+    witness-arbitrated failover promotes the standby, the workers'
+    clients fail over (reads never stopped; writes resume at the
+    bumped fencing epoch), and a policy commit REQUESTED THROUGH THE
+    NEW PRIMARY still publishes on the same collective tick on both
+    processes — the fenced store is transparent to the SPMD control
+    loop (kvstore/witness.py + docs/MULTIHOST.md note)."""
+    import signal
+
+    from vpp_tpu.kvstore.client import RemoteKVStore
+    from vpp_tpu.kvstore.witness import WitnessClient
+
+    env = _worker_env()
+
+    reap = []  # every spawned process, in spawn order — the finally
+    #            tears down whatever managed to start, so a failed
+    #            LATER spawn can't orphan the earlier servers
+
+    def _spawn_store(name, argv):
+        pf = str(tmp_path / f"{name}.port")
+        p = subprocess.Popen(
+            [sys.executable, "-m", argv[0], *argv[1:],
+             "--port-file", pf], env=env)
+        reap.append(p)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not os.path.exists(pf):
+            assert p.poll() is None, f"{name} died at startup"
+            time.sleep(0.2)
+        assert os.path.exists(pf), f"{name} never wrote its port"
+        return p, int(open(pf).read())
+
+    cli = None
+    procs = []
+    try:
+        witness, w_port = _spawn_store("w", [
+            "vpp_tpu.cmd.kvwitness", "--host", "127.0.0.1",
+            "--port", "0"])
+        primary, kv_port = _spawn_store("kv", [
+            "vpp_tpu.cmd.kvserver", "--host", "127.0.0.1", "--port", "0",
+            "--witness", f"127.0.0.1:{w_port}", "--fence-ttl", "6"])
+        standby, sb_port = _spawn_store("sb", [
+            "vpp_tpu.cmd.kvserver", "--host", "127.0.0.1", "--port", "0",
+            "--follow", f"127.0.0.1:{kv_port}",
+            "--witness", f"127.0.0.1:{w_port}",
+            "--fence-ttl", "6", "--promote-after", "3"])
+        store_url = f"tcp://127.0.0.1:{kv_port},127.0.0.1:{sb_port}"
+
+        coord_port = _free_port()
+        procs = [
+            subprocess.Popen(
+                [sys.executable,
+                 os.path.join(HERE, "mh_lockstep_failover_worker.py"),
+                 str(pid), "2", str(coord_port), store_url],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True)
+            for pid in range(2)
+        ]
+        cli = RemoteKVStore(
+            "127.0.0.1", kv_port, request_timeout=60.0,
+            reconnect_timeout=60.0,
+            fallbacks=[("127.0.0.1", sb_port)])
+        # both workers mid-run (tick 1 done) before the kill
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            if cli.get("mhf/ready/0") == 1 and cli.get("mhf/ready/1") == 1:
+                break
+            assert all(p.poll() is None for p in procs), \
+                "a worker died before the failover"
+            time.sleep(0.5)
+        else:
+            raise AssertionError("workers never reached the ready point")
+
+        primary.send_signal(signal.SIGKILL)
+        primary.wait(timeout=15)
+        wc = WitnessClient(f"127.0.0.1:{w_port}")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            st = wc.status()
+            if st["primary"] == f"127.0.0.1:{sb_port}" and st["epoch"] >= 1:
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError(f"standby never promoted: {wc.status()}")
+        cli.put("mhf/go", 1)   # lands on the NEW primary, fenced
+
+        outs = {}
+        for pid, p in enumerate(procs):
+            out, err = p.communicate(timeout=420)
+            assert p.returncode == 0, f"worker {pid}: {err[-800:]}"
+            line = [ln for ln in out.splitlines()
+                    if ln.startswith("VERDICT ")][-1]
+            outs[pid] = json.loads(line[len("VERDICT "):])
+    finally:
+        if cli is not None:
+            cli.close()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+        for p in reversed(reap):
+            if p.poll() is None:
+                p.terminate()
+        for p in reap:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    for pid in (0, 1):
+        # exactly ONE promotion happened: the primary adopted at epoch
+        # 0 (renew, no bump), the standby's granted claim bumped to 1,
+        # and both workers' post-failover writes carry it
+        assert outs[pid]["fence_epoch"] == 1
+        assert outs[pid]["applied"] == 1          # commit applied once
+        assert outs[pid]["t3_epoch"] == 2         # same tick, both procs
+    v = outs[1]
+    assert v["t1_delivered"] == 1     # flowing before the failover
+    assert v["t2_delivered"] == 1     # still flowing right after it
+    assert v["t3_delivered"] == 0     # cut by the post-failover commit

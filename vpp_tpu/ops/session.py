@@ -13,19 +13,20 @@ equivalent election strategies (differentially tested identical,
 selected at trace time — VERDICT r4 Next #5):
 
   * ``claim`` — scatter-min over an [n_slots] claim array. O(n_slots)
-    memset + scatter + gather per probe round: cheap linear memory work
-    on CPU at deployed table sizes, but cost SCALES with the table
-    (366 ns/pkt @4k slots → 947 @64k, one CPU core).
+    memset + scatter + gather per probe round: cost SCALES with the
+    table (order-alternated medians on one CPU core: 368 ns/pkt @4k
+    slots, 509 @32k).
   * ``sort`` — stable argsort of the candidates' slot numbers; equal
     slots form runs in packet order, the first of each run is the
-    winner. O(B log B) in the BATCH, independent of n_slots (flat
-    ~1 µs/pkt on the same core at any table size).
+    winner. O(B log B) in the BATCH, independent of n_slots — and
+    measured faster at EVERY deployed table size on CPU too (338
+    ns/pkt @4k, 442 @32k, same harness).
 
-``auto`` picks claim on CPU-class backends at ≤16k slots, sort above
-that and on TPU (scatter serialization is the TPU risk the sort path
-avoids; ``bench.py`` measures both on the live backend —
-``sess_election_*`` keys — so the choice is evidence-backed per
-round). Override with VPPT_SESS_ELECTION=claim|sort. Aging is a
+``auto`` therefore picks sort everywhere; claim remains selectable
+(VPPT_SESS_ELECTION=claim) as the comparison baseline —
+``bench.py``'s ``sess_election_*`` shoot-out re-measures both on the
+live backend every round, so a backend where claim wins would show up
+in the artifact and flip this default with evidence. Aging is a
 host-side loop clearing stale ``sess_time`` entries (the reference
 ages sessions on a VPP worker interrupt, SURVEY.md §5).
 """
@@ -45,16 +46,14 @@ _BIG = 0x7FFFFFFF
 
 
 def election_mode(n_slots: int) -> str:
-    """Trace-time election strategy (module doc). Env override first,
-    then backend/table-size heuristic."""
+    """Trace-time election strategy (module doc). Env override first;
+    ``auto`` is sort — measured faster at every table size on CPU and
+    free of the table-size scaling, with the bench shoot-out
+    re-validating the choice per backend each round."""
     mode = os.environ.get("VPPT_SESS_ELECTION", "auto")
     if mode in ("claim", "sort"):
         return mode
-    import jax
-
-    if jax.default_backend() != "cpu":
-        return "sort"
-    return "claim" if n_slots <= (1 << 14) else "sort"
+    return "sort"
 
 from vpp_tpu.pipeline.tables import DataplaneTables
 from vpp_tpu.pipeline.vector import PacketVector
