@@ -29,3 +29,37 @@ def stage_full_mesh(cluster):
 def pod_ips(n_nodes):
     return {n: str(IPAM(n + 1).next_pod_ip(f"ns/pod{n}"))
             for n in range(n_nodes)}
+
+
+LOCKSTEP_N_NODES = 4
+
+
+def lockstep_config():
+    """The DataplaneConfig both lockstep workers build their 4-node
+    cluster with — one literal, so the failover variant exercises the
+    SAME cluster shape as the baseline lockstep test."""
+    from vpp_tpu.pipeline.tables import DataplaneConfig
+
+    return DataplaneConfig(
+        max_tables=4, max_rules=16, max_global_rules=32, max_ifaces=8,
+        fib_slots=32, sess_slots=256, nat_mappings=4, nat_backends=16,
+    )
+
+
+def lockstep_frames(cluster, proc_id, all_pod_ip, pod_if, sport):
+    """pod0 (P0) -> pod2 (P1); fresh sport each tick so no tick rides
+    the previous tick's reflective session."""
+    f = [[] for _ in cluster.local_nodes]
+    if proc_id == 0:
+        f[0] = [dict(src=all_pod_ip[0], dst=all_pod_ip[2], proto=6,
+                     sport=sport, dport=8080, rx_if=pod_if[0])]
+    return f
+
+
+def lockstep_deliveries(cluster, proc_id, res):
+    """Delivered count on node 2's row (P1's first local node); -1 on
+    the process that doesn't own it."""
+    if proc_id != 1:
+        return -1
+    disp = cluster.local_rows(res.delivered.disp)
+    return int((disp[0] == int(Disposition.LOCAL)).sum())

@@ -30,21 +30,18 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from vpp_tpu.parallel.multihost import (  # noqa: E402
     LockstepDriver, MultiHostCluster, barrier, init_multihost,
 )
-from mh_common import pod_ips, stage_full_mesh  # noqa: E402
+from mh_common import (  # noqa: E402
+    LOCKSTEP_N_NODES, lockstep_config, lockstep_deliveries,
+    lockstep_frames, pod_ips, stage_full_mesh,
+)
 from vpp_tpu.ir.rule import Action, ContivRule  # noqa: E402
 from vpp_tpu.kvstore.client import connect_store  # noqa: E402
-from vpp_tpu.pipeline.tables import DataplaneConfig  # noqa: E402
-from vpp_tpu.pipeline.vector import Disposition  # noqa: E402
 
 init_multihost(f"127.0.0.1:{PORT}", NUM_PROCS, PROC_ID,
                heartbeat_timeout_s=600)
 
-N_NODES = 4
-cfg = DataplaneConfig(
-    max_tables=4, max_rules=16, max_global_rules=32, max_ifaces=8,
-    fib_slots=32, sess_slots=256, nat_mappings=4, nat_backends=16,
-)
-cluster = MultiHostCluster(N_NODES, cfg)
+N_NODES = LOCKSTEP_N_NODES
+cluster = MultiHostCluster(N_NODES, lockstep_config())
 # generous timeouts: a get/put issued INSIDE the failover window must
 # ride the endpoint rotation + witness-arbitrated promotion (~fence
 # ttl) within one call instead of surfacing a transient error
@@ -61,18 +58,11 @@ all_pod_ip = pod_ips(N_NODES)
 
 
 def frames_for_tick(sport):
-    f = [[] for _ in cluster.local_nodes]
-    if PROC_ID == 0:
-        f[0] = [dict(src=all_pod_ip[0], dst=all_pod_ip[2], proto=6,
-                     sport=sport, dport=8080, rx_if=pod_if[0])]
-    return f
+    return lockstep_frames(cluster, PROC_ID, all_pod_ip, pod_if, sport)
 
 
 def deliveries(res):
-    if PROC_ID != 1:
-        return -1
-    disp = cluster.local_rows(res.delivered.disp)
-    return int((disp[0] == int(Disposition.LOCAL)).sum())
+    return lockstep_deliveries(cluster, PROC_ID, res)
 
 
 verdict = {"proc": PROC_ID}

@@ -26,21 +26,18 @@ import numpy as np  # noqa: E402
 from vpp_tpu.parallel.multihost import (  # noqa: E402
     LockstepDriver, MultiHostCluster, barrier, init_multihost,
 )
-from mh_common import pod_ips, stage_full_mesh  # noqa: E402
+from mh_common import (  # noqa: E402
+    LOCKSTEP_N_NODES, lockstep_config, lockstep_deliveries,
+    lockstep_frames, pod_ips, stage_full_mesh,
+)
 from vpp_tpu.ir.rule import Action, ContivRule  # noqa: E402
 from vpp_tpu.kvstore.client import connect_store  # noqa: E402
-from vpp_tpu.pipeline.tables import DataplaneConfig  # noqa: E402
-from vpp_tpu.pipeline.vector import Disposition  # noqa: E402
 
 init_multihost(f"127.0.0.1:{PORT}", NUM_PROCS, PROC_ID,
                heartbeat_timeout_s=600)
 
-N_NODES = 4
-cfg = DataplaneConfig(
-    max_tables=4, max_rules=16, max_global_rules=32, max_ifaces=8,
-    fib_slots=32, sess_slots=256, nat_mappings=4, nat_backends=16,
-)
-cluster = MultiHostCluster(N_NODES, cfg)
+N_NODES = LOCKSTEP_N_NODES
+cluster = MultiHostCluster(N_NODES, lockstep_config())
 store = connect_store(f"tcp://127.0.0.1:{KV_PORT}")
 # expire_every=3: tick 3 runs the collective session aging pass too
 driver = LockstepDriver(cluster, store, expire_every=3)
@@ -54,20 +51,11 @@ all_pod_ip = pod_ips(N_NODES)
 
 
 def frames_for_tick(sport):
-    """pod0 (P0) -> pod2 (P1); fresh sport each tick so no tick rides
-    the previous tick's reflective session."""
-    f = [[] for _ in cluster.local_nodes]
-    if PROC_ID == 0:
-        f[0] = [dict(src=all_pod_ip[0], dst=all_pod_ip[2], proto=6,
-                     sport=sport, dport=8080, rx_if=pod_if[0])]
-    return f
+    return lockstep_frames(cluster, PROC_ID, all_pod_ip, pod_if, sport)
 
 
 def deliveries(res):
-    if PROC_ID != 1:
-        return -1
-    disp = cluster.local_rows(res.delivered.disp)
-    return int((disp[0] == int(Disposition.LOCAL)).sum())  # node 2 row
+    return lockstep_deliveries(cluster, PROC_ID, res)
 
 
 verdict = {"proc": PROC_ID}

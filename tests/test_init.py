@@ -318,6 +318,15 @@ def test_mesh_plans_straggle_past_settle_window(tmp_path):
     sup = InitSupervisor(cfg, None, spawn=spawner, plan_timeout_s=15.0)
 
     def slow_agent_boots():
+        # deterministic ordering, not a sleep: _clear_plan runs
+        # immediately before the agent spawn, so once the spawner has
+        # the mesh agent the clear is done — a plan written before it
+        # would be (correctly) deleted as stale and this test would
+        # time out waiting for a .0 that never returns
+        deadline = time.monotonic() + 10
+        while not spawner.by_module("vpp_tpu.cmd.mesh_main") \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
         write_plan(cfg, _suffix=".0", shm="vpp-shm.0")
         time.sleep(3.0)   # well past the old 1.5s settle window
         write_plan(cfg, _suffix=".1", shm="vpp-shm.1")
@@ -346,7 +355,14 @@ def test_multihost_waits_for_local_plans_only(tmp_path):
     sup = InitSupervisor(cfg, None, spawn=spawner, plan_timeout_s=8.0)
 
     def local_rows_boot():
-        # this host owns rows 0 and 1 of the 4-row cluster
+        # after _clear_plan, deterministically (the agent spawn
+        # immediately follows the clear — same ordering discipline as
+        # the straggle test above): this host owns rows 0 and 1 of
+        # the 4-row cluster
+        deadline = time.monotonic() + 10
+        while not spawner.by_module("vpp_tpu.cmd.mesh_main") \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
         write_plan(cfg, _suffix=".0", shm="vpp-shm.0")
         write_plan(cfg, _suffix=".1", shm="vpp-shm.1")
 

@@ -40,11 +40,31 @@ def _worker_env():
     return env
 
 
+def _collect_verdicts(procs, timeout: float):
+    """communicate() every worker, assert clean exits, parse the
+    VERDICT lines; reaps everyone on the way out — a failed worker
+    must not orphan its peer inside a jax.distributed collective."""
+    outs = {}
+    try:
+        for pid, p in enumerate(procs):
+            out, err = p.communicate(timeout=timeout)
+            assert p.returncode == 0, f"worker {pid}: {err[-800:]}"
+            lines = [ln for ln in out.splitlines()
+                     if ln.startswith("VERDICT ")]
+            assert lines, (f"worker {pid} printed no VERDICT line; "
+                           f"stderr: {err[-800:]}")
+            outs[pid] = json.loads(lines[-1][len("VERDICT "):])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+    return outs
+
+
 def _run_workers(script: str, extra_args=(), n_procs: int = 2,
                  timeout: float = 240):
-    """Spawn the worker processes and collect their VERDICT lines. A
-    failed worker must not orphan its peer inside a jax.distributed
-    collective — everyone is reaped on the way out."""
+    """Spawn the worker processes and collect their VERDICT lines."""
     env = _worker_env()
     coord_port = _free_port()
     procs = [
@@ -55,20 +75,7 @@ def _run_workers(script: str, extra_args=(), n_procs: int = 2,
             text=True)
         for pid in range(n_procs)
     ]
-    outs = {}
-    try:
-        for pid, p in enumerate(procs):
-            out, err = p.communicate(timeout=timeout)
-            assert p.returncode == 0, f"worker {pid}: {err[-800:]}"
-            line = [ln for ln in out.splitlines()
-                    if ln.startswith("VERDICT ")][-1]
-            outs[pid] = json.loads(line[len("VERDICT "):])
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-                p.wait(timeout=30)
-    return outs
+    return _collect_verdicts(procs, timeout)
 
 
 def test_two_process_fabric():
@@ -261,13 +268,7 @@ def test_lockstep_survives_store_failover(tmp_path):
             raise AssertionError(f"standby never promoted: {wc.status()}")
         cli.put("mhf/go", 1)   # lands on the NEW primary, fenced
 
-        outs = {}
-        for pid, p in enumerate(procs):
-            out, err = p.communicate(timeout=420)
-            assert p.returncode == 0, f"worker {pid}: {err[-800:]}"
-            line = [ln for ln in out.splitlines()
-                    if ln.startswith("VERDICT ")][-1]
-            outs[pid] = json.loads(line[len("VERDICT "):])
+        outs = _collect_verdicts(procs, timeout=420)
     finally:
         if cli is not None:
             cli.close()
