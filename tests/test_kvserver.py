@@ -351,6 +351,34 @@ class TestReplication:
     (kvstore/replica.py; the reference leans on a single-replica etcd
     Deployment, k8s/contiv-vpp.yaml:72-114)."""
 
+    def test_refollow_never_stacks_heartbeat_threads(self):
+        """_try_refollow on a flapping primary link must not start a
+        second heartbeat loop while one is alive — the r5-era leak
+        accumulated one pinger per refollow cycle, each independently
+        able to fire _promote (ADVICE r5)."""
+        from vpp_tpu.kvstore.replica import Replicator
+
+        primary = KVServer(host="127.0.0.1", port=0).start()
+        fstore = KVStore()
+        repl = None
+        try:
+            repl = Replicator(fstore, "127.0.0.1", primary.port,
+                              promote_after=30.0).start()
+            first = repl._heartbeat_thread
+            assert first is not None and first.is_alive()
+            # a few refollow cycles against the same healthy primary:
+            # the heartbeat thread object must not churn
+            for _ in range(3):
+                assert repl._try_refollow() is True
+                assert repl._heartbeat_thread is first
+            hb_threads = [t for t in threading.enumerate()
+                          if t.name == "kv-replica-hb"]
+            assert len(hb_threads) == 1
+        finally:
+            if repl is not None:
+                repl.stop()
+            primary.close()
+
     def test_follower_replicates_and_rejects_writes(self):
         from vpp_tpu.kvstore.replica import Replicator
 

@@ -177,6 +177,51 @@ class TestWitness:
         with pytest.raises(WitnessUnreachable):
             c.status()
 
+    def test_fresh_witness_adopts_surviving_primary_epoch(self, tmp_path):
+        """Witness restart WITHOUT its persist file (node reschedule
+        on a hostPath): its epoch resets to 0. The surviving primary's
+        renew at its higher store epoch must be ADOPTED — refusing it
+        demotes the only primary as 'superseded' with rsp.primary=None
+        (nobody to re-follow) while the standby's heartbeats keep
+        succeeding against the read-only primary, wedging the pair
+        read-only forever (ADVICE r5 medium)."""
+        w = QuorumWitness(persist_path=str(tmp_path / "w1.json")).start()
+        c = WitnessClient(w.address)
+        # two failovers: the fleet's fencing epoch is now 2
+        assert c.claim("p:1", ttl=2.0)["granted"] is True
+        assert c.renew("p:1", 1, ttl=0.2)["ok"] is True
+        time.sleep(0.3)
+        assert c.claim("q:1", ttl=2.0)["epoch"] == 2
+        w.close()
+        # fresh state: no persist file carried over
+        w2 = QuorumWitness(persist_path=str(tmp_path / "w2.json")).start()
+        try:
+            c2 = WitnessClient(w2.address)
+            r = c2.renew("q:1", 2, ttl=2.0)
+            assert r["ok"] is True, \
+                "lone renewer at a higher epoch is the surviving " \
+                "authority, not an impostor"
+            assert r["epoch"] == 2  # adopted, not reset
+            # and the adopted epoch fences exactly like a persisted
+            # one: a stale pre-failover primary stays rejected
+            assert c2.renew("p:1", 1, ttl=2.0)["ok"] is False
+        finally:
+            w2.close()
+        # ordering hazard: the STALE ex-primary renews FIRST against
+        # yet another fresh witness. It wins transiently (the witness
+        # can't know better), but adoption is highest-epoch-wins, so
+        # the true primary's next renew supersedes it — p must not be
+        # able to permanently fence q out just by racing the restart.
+        w3 = QuorumWitness(persist_path=str(tmp_path / "w3.json")).start()
+        try:
+            c3 = WitnessClient(w3.address)
+            assert c3.renew("p:1", 1, ttl=2.0)["ok"] is True  # stale won
+            r = c3.renew("q:1", 2, ttl=2.0)
+            assert r["ok"] is True and r["epoch"] == 2  # superseded
+            assert c3.renew("p:1", 1, ttl=2.0)["ok"] is False
+        finally:
+            w3.close()
+
 
 # --- fencing epochs on the data path ---
 class TestFenceWire:

@@ -224,7 +224,11 @@ def test_pump_counters_exported_over_prometheus():
     class FakePump:
         stats = {"frames": 7, "pkts": 1792, "batches": 3,
                  "tx_ring_full": 1, "batch_errors": 0,
-                 "icmp_errors": 2, "fabric_pkts": 512}
+                 "icmp_errors": 2, "fabric_pkts": 512,
+                 "inflight": 5, "inflight_peak": 8,
+                 "chain_batches": 4, "chain_k_peak": 2,
+                 "t_pack": 0.25, "t_dispatch": 1.5,
+                 "t_fetch_wait": 12.75, "t_fetch": 0.5, "t_write": 2.0}
 
         @staticmethod
         def latency_us():
@@ -241,3 +245,42 @@ def test_pump_counters_exported_over_prometheus():
     assert "vpp_tpu_pump_fabric_packets 512" in text
     assert "vpp_tpu_pump_icmp_errors 2" in text
     assert "vpp_tpu_pump_batch_latency_p99_us 456" in text
+    # overlapped fetch ladder observability (ISSUE 1): the in-flight
+    # window and the adaptive chainer's activity are exported...
+    assert "vpp_tpu_pump_inflight_depth 5" in text
+    assert "vpp_tpu_pump_inflight_peak 8" in text
+    assert "vpp_tpu_pump_chained_dispatches 4" in text
+    assert "vpp_tpu_pump_chain_k_peak 2" in text
+    # ...and the per-stage cumulative seconds go out as one labelled
+    # COUNTER family (so rate() gives per-second stage occupancy)
+    assert "# TYPE vpp_tpu_pump_stage_seconds counter" in text
+    assert 'vpp_tpu_pump_stage_seconds{stage="pack"} 0.25' in text
+    assert 'vpp_tpu_pump_stage_seconds{stage="fetch_wait"} 12.75' in text
+    assert 'vpp_tpu_pump_stage_seconds{stage="fetch"} 0.5' in text
+    assert 'vpp_tpu_pump_stage_seconds{stage="write"} 2' in text
+
+
+def test_pump_stage_gauges_absent_keys_degrade_to_zero():
+    """A pump without the ladder stats (the cluster pump predates some
+    keys; a remote daemon may be an older build) must publish zeros,
+    not crash the scrape path."""
+    from vpp_tpu.pipeline.dataplane import Dataplane
+    from vpp_tpu.pipeline.tables import DataplaneConfig
+    from vpp_tpu.stats.collector import StatsCollector
+
+    class BarePump:
+        stats = {"frames": 1, "pkts": 2, "batches": 1}
+
+        @staticmethod
+        def latency_us():
+            return {"p50": 0.0, "p99": 0.0, "n": 0}
+
+    dp = Dataplane(DataplaneConfig(
+        max_tables=2, max_rules=8, max_global_rules=8, max_ifaces=8,
+        fib_slots=16, sess_slots=64, nat_mappings=2, nat_backends=4))
+    coll = StatsCollector(dp)
+    coll.set_pump(BarePump())
+    coll.publish()
+    text = coll.registry.render("/stats")
+    assert "vpp_tpu_pump_inflight_depth 0" in text
+    assert 'vpp_tpu_pump_stage_seconds{stage="dispatch"} 0' in text

@@ -476,6 +476,25 @@ class DebugCLI:
                 extra.append(f"icmp-errors {s['icmp_errors']}")
             if extra:
                 lines.append("pump: " + ", ".join(extra))
+            if "inflight_peak" in s:
+                lines.append(
+                    f"pump overlap: inflight {s.get('inflight', 0)} "
+                    f"(peak {s['inflight_peak']}), chained dispatches "
+                    f"{s.get('chain_batches', 0)} "
+                    f"(max K {s.get('chain_k_peak', 0)})"
+                )
+            if "t_pack" in s:
+                # stage seconds: fetch_wait is overlapped wait (the
+                # ladder hiding the device round trip), fetch the
+                # serial result copy
+                lines.append(
+                    "pump stages (s): "
+                    f"pack {s['t_pack']:.3f}, "
+                    f"dispatch {s['t_dispatch']:.3f}, "
+                    f"fetch-wait {s.get('t_fetch_wait', 0.0):.3f}, "
+                    f"fetch {s['t_fetch']:.3f}, "
+                    f"write {s.get('t_write', 0.0):.3f}"
+                )
             lines.append(
                 f"pump batch latency: p50 {lat['p50']:.0f}us "
                 f"p99 {lat['p99']:.0f}us over {lat['n']} batches"

@@ -283,6 +283,9 @@ class ContivAgent:
                 self.dataplane, self.io_rings,
                 max_batch=c.io.max_batch, depth=c.io.depth,
                 workers=c.io.workers,
+                max_inflight=c.io.max_inflight,
+                fetch_workers=c.io.fetch_workers,
+                chain_k=c.io.chain_k,
                 mode=c.io.pump_mode,
                 # ICMP errors (time-exceeded/unreachable) originate from
                 # the node's pod gateway address — the hop traceroute

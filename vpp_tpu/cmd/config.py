@@ -30,12 +30,23 @@ class IOConfig:
     # (io/control.py; reference remote_cni_server.go:895-1250)
     control_socket: str = ""
     # pump tuning (io/pump.py): coalesced device batch cap, in-flight
-    # batches, concurrent result fetchers (None = auto: 8 on a remote
-    # device so fetch RPC round trips overlap, 1 on the CPU backend
-    # where extra blocked threads only churn the GIL)
+    # batches before the dispatch stage backpressures, concurrent
+    # result fetchers (None = auto: 8 on a remote device so fetch RPC
+    # round trips overlap, 1 on the CPU backend where extra blocked
+    # threads only churn the GIL). ``depth``/``workers`` are the
+    # legacy aliases of ``max_inflight``/``fetch_workers`` — the new
+    # names win when both are set.
     max_batch: int = 2048
     depth: int = 8
     workers: int | None = None
+    max_inflight: int | None = None
+    fetch_workers: int | None = None
+    # adaptive chainer: backlog past one full max_batch bucket folds
+    # into ONE process_packed_chain dispatch of up to chain_k stacked
+    # buckets (one device round trip for K buckets of traffic — the
+    # bounded-sync lever for small frames / remote transports).
+    # 0 disables; values round down to a power of two.
+    chain_k: int = 4
     # "dispatch" (pipelined ladder, peak throughput) or "persistent"
     # (ONE resident device loop fed through io_callbacks — the
     # latency-floor regime; docs/LATENCY.md lever #2). Persistent mode

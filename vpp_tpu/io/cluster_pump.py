@@ -71,9 +71,11 @@ class ClusterPump:
                  poll_s: float = 0.0005, snap: Optional[int] = None,
                  depth: int = 2,
                  icmp_src_ips: Optional[List[int]] = None,
-                 ingress_ifs: Optional[List[int]] = None):
-        """``depth``: fabric steps in flight before dispatch
-        backpressures. ``icmp_src_ips``/``ingress_ifs`` (per mesh node:
+                 ingress_ifs: Optional[List[int]] = None,
+                 max_inflight: Optional[int] = None):
+        """``max_inflight`` (legacy alias ``depth``): fabric steps in
+        flight before dispatch backpressures.
+        ``icmp_src_ips``/``ingress_ifs`` (per mesh node:
         the pod gateway address and the node's host interface) enable
         the ICMP error path (see module doc)."""
         assert len(ring_pairs) == cluster.n_nodes
@@ -81,7 +83,9 @@ class ClusterPump:
         self.rings = ring_pairs
         self.poll_s = poll_s
         self.snap = snap or min(r.rx.snap for r in ring_pairs)
-        self.depth = max(1, int(depth))
+        self.depth = max(1, int(max_inflight if max_inflight is not None
+                                else depth))
+        self.max_inflight = self.depth
         self.icmp = None
         self._err_q: List[list] = [[] for _ in range(cluster.n_nodes)]
         self._err_lock = threading.Lock()
@@ -108,7 +112,14 @@ class ClusterPump:
         # renders either pump unchanged (batches == device steps)
         self.stats = {"steps": 0, "frames": 0, "pkts": 0,
                       "fabric_pkts": 0, "tx_ring_full": 0,
-                      "batches": 0, "max_coalesce": 0, "batch_errors": 0}
+                      "batches": 0, "max_coalesce": 0, "batch_errors": 0,
+                      # overlap observability, same contract as the
+                      # single-node pump: fabric steps dispatched but
+                      # not yet written, the wait for a step's results
+                      # to become ready (overlapped with the next
+                      # step's staging) vs the serial result copy
+                      "inflight": 0, "inflight_peak": 0,
+                      "t_fetch_wait": 0.0, "t_fetch": 0.0}
         self._step_lat = collections.deque(maxlen=2048)
         self._lat_lock = threading.Lock()
         # frames peeked by dispatch but not yet released by the writer,
@@ -297,14 +308,25 @@ class ClusterPump:
                 # ordered cleanup first, then surface: the lockstep
                 # driver has no way to resync a fleet whose collective
                 # sequences diverged
+                with self._lat_lock:
+                    self.stats["inflight"] += 1
                 while True:
                     try:
                         self._inflight.put(item, timeout=0.05)
                         break
                     except queue.Full:
                         if self._stop.is_set():
+                            with self._lat_lock:
+                                self.stats["inflight"] -= 1
                             break
                 raise
+        # count the step in flight BEFORE the hand-off (the writer can
+        # complete + decrement it the instant the put lands)
+        with self._lat_lock:
+            d = self.stats["inflight"] + 1
+            self.stats["inflight"] = d
+            if d > self.stats["inflight_peak"]:
+                self.stats["inflight_peak"] = d
         while True:
             try:
                 self._inflight.put(item, timeout=0.05)
@@ -314,6 +336,8 @@ class ClusterPump:
                     # shutdown with a wedged writer: the runtime tears
                     # the rings down wholesale next — abandoning the
                     # held frames is safe, processing them is not
+                    with self._lat_lock:
+                        self.stats["inflight"] -= 1
                     return True
         self._seq += 1
         return True
@@ -335,6 +359,9 @@ class ClusterPump:
                 log.exception("cluster pump write failed")
                 self.stats["batch_errors"] += 1
                 self._release_item(item)
+            finally:
+                with self._lat_lock:
+                    self.stats["inflight"] -= 1
 
     def _release_frames(self, offs) -> None:
         """Ordered ring releases + held decrements for one item (the
@@ -369,10 +396,20 @@ class ClusterPump:
             self._release_item((None, None, offs, t0))
             return
         n = self.cluster.n_nodes
+        # wait-for-ready apart from the copy: the wait overlaps the
+        # dispatch thread's staging of the NEXT step (that's the whole
+        # point of the depth), so only the copy is a serial cost
+        tw0 = time.perf_counter()
+        jax.block_until_ready((result.local, result.delivered, deliv_pay))
+        tf0 = time.perf_counter()
         res_local, res_deliv = jax.device_get(
             (result.local, result.delivered)
         )
         deliv_pay = np.asarray(jax.device_get(deliv_pay))
+        tf1 = time.perf_counter()
+        with self._lat_lock:
+            self.stats["t_fetch_wait"] += tf0 - tw0
+            self.stats["t_fetch"] += tf1 - tf0
 
         # pass-1 results → ingress node's tx ring (payload: own rx slot)
         for i, node_offs in enumerate(offs):

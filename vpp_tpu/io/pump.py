@@ -1,25 +1,38 @@
 """DataplanePump: the agent-side bridge between frame rings and the device.
 
-Pipelined, multi-stage (VERDICT r2 Next #2 — the r2 pump did one
-blocking device round trip per 256-packet frame, leaving the wire path
-five orders of magnitude below the synthetic number):
+Staged pipeline with explicit depth (VERDICT r2 Next #2, then the r6
+overlapped fetch ladder — BENCH_r05 pinned the deployed wire gap on
+result fetch: ``io_daemon_t_fetch_s=5.65`` vs ``t_dispatch=0.237``):
 
-  * the **dispatch** thread drains every pending rx frame, coalesces
-    them into one device batch (VPP's own behavior: vector size grows
-    under load), pads to a power-of-2 bucket so the jit cache stays
-    small, and dispatches the packed single-transfer step WITHOUT
-    waiting — JAX dispatch is asynchronous, and batches chain through
-    the session tables device-side;
-  * **fetch workers** (default 4) pull finished batches and device_get
-    them concurrently — on a remote device transport (the axon tunnel)
-    a result fetch is a full RPC round trip (~80-130 ms measured), and
-    round trips overlap across threads, so W workers divide the
-    experienced fetch latency out of the throughput path;
-  * the **tx writer** thread reorders completed batches back into
+  * the **dispatch** stage drains every pending rx frame, coalesces
+    them by PACKET COUNT into device batches (VPP's own behavior:
+    vector size grows under load), pads to a power-of-2 bucket so the
+    jit cache stays small, and dispatches the packed single-transfer
+    step WITHOUT waiting — JAX dispatch is asynchronous, and batches
+    chain through the session tables device-side. Up to
+    ``max_inflight`` dispatched batches ride concurrently before the
+    stage backpressures;
+  * the **adaptive chainer** engages when depth alone can't hide the
+    round trip: backlog beyond one full ``max_batch`` bucket folds
+    into a ``process_packed_chain`` K-stack — K packed batches in ONE
+    device program (lax.scan), one dispatch + one fetch for K buckets
+    of traffic. Light load never pays the chain's latency (a single
+    frame still dispatches alone at the VEC bucket);
+  * **fetch workers** (``fetch_workers``) pull finished batches and
+    device_get them concurrently — on a remote device transport (the
+    axon tunnel) a result fetch is a full RPC round trip (~80-130 ms
+    measured), and round trips overlap across threads, so W workers
+    divide the experienced fetch latency out of the throughput path.
+    The stage timer splits ``t_fetch_wait`` (waiting for the device
+    result to become ready — time hidden behind the other in-flight
+    batches) from ``t_fetch`` (the result copy itself, the only
+    serial cost);
+  * the **tx writer** thread re-sequences completed batches back into
     dispatch order, splits them into ring frames, writes the tx ring
     (rewritten headers + disposition + egress interface + peer
     next-hop) and releases the rx slots — in order, as the SPSC ring
-    requires.
+    requires. Session-state commit order is already serialized by the
+    single dispatch thread, so only delivery needs the reorder buffer.
 
 Frames stay ring-owned while in flight (fr_consume_peek_nth) — their
 slot views and payload bytes are stable until the in-order release, so
@@ -35,9 +48,15 @@ replaces the dispatch/fetch legs with ONE resident device program
 on the device and exchanges frames through ordered io_callbacks, so the
 per-frame PJRT dispatch + result-fetch round trips — the dominant cost
 on an attached transport — are paid once at loop start instead of per
-batch. The VPP analog is the eternal worker dispatch loop: the graph
-scheduler never re-launches per frame (reference
-docs/VPP_PACKET_TRACING_K8S.md:28-50). Trades:
+batch. The refill stage keeps up to ``max_inflight`` frames queued at
+the loop's host_fetch callback (the same overlap discipline as the
+dispatch ladder: the device must never idle waiting for the host to
+pack the next frame), and shutdown is race-free: the collector only
+exits once the dispatcher has signalled done AND the hand-off queue is
+drained, so a frame submitted during stop() still reaches the tx
+writer (VERDICT r5 Next #2 / ADVICE r5). The VPP analog is the eternal
+worker dispatch loop: the graph scheduler never re-launches per frame
+(reference docs/VPP_PACKET_TRACING_K8S.md:28-50). Trades:
 
   * frames process one VEC-frame at a time in submission order — the
     latency-floor regime, not peak batch throughput (the dispatch
@@ -56,7 +75,7 @@ import logging
 import queue
 import threading
 import time
-from typing import Optional
+from typing import Callable, Optional, Union
 
 import numpy as np
 
@@ -86,15 +105,32 @@ class DataplanePump:
                  workers: Optional[int] = None,
                  lat_window: int = 4096,
                  icmp_src_ip: int = 0,
-                 mode: str = "dispatch"):
+                 mode: str = "dispatch",
+                 max_inflight: Optional[int] = None,
+                 fetch_workers: Optional[int] = None,
+                 chain_k: int = 0,
+                 fetch_delay: Union[None, float, Callable] = None):
         """``max_batch``: largest coalesced device batch (packets);
-        ``depth``: in-flight batches before dispatch backpressures;
-        ``workers``: concurrent result fetchers — None auto-picks: on a
-        REMOTE device a fetch is an RPC round trip (~100 ms on the axon
-        tunnel) and W workers overlap W round trips, so 8; on the CPU
-        backend a fetch is a local memcpy and extra blocked threads only
-        churn the GIL against the dispatch/writer threads (measured 14%
-        throughput loss at 8 workers on a single-core host), so 1.
+        ``max_inflight``: in-flight batches before the dispatch stage
+        backpressures (``depth`` is the legacy alias — ``max_inflight``
+        wins when both are given);
+        ``fetch_workers``: concurrent result fetchers (legacy alias
+        ``workers``) — None auto-picks: on a REMOTE device a fetch is
+        an RPC round trip (~100 ms on the axon tunnel) and W workers
+        overlap W round trips, so 8; on the CPU backend a fetch is a
+        local memcpy and extra blocked threads only churn the GIL
+        against the dispatch/writer threads (measured 14% throughput
+        loss at 8 workers on a single-core host), so 1.
+        ``chain_k``: >= 2 arms the adaptive chainer — backlog past one
+        full ``max_batch`` bucket folds into ONE
+        ``process_packed_chain`` dispatch of K stacked buckets, K a
+        power of two up to ``chain_k`` (rounded down to a power of
+        two): the rung ladder bounds the jit cache to log2(chain_k)
+        chain shapes while a partial fold never pads more than 2× its
+        real depth. 0/1 disables chaining.
+        ``fetch_delay``: fault injection for tests/bench — seconds (or
+        ``callable(seq) -> seconds``) slept by the fetch worker before
+        touching the device result, simulating a slow result transport.
         ``icmp_src_ip``: with a non-zero address (the node's pod gateway
         IP), TTL-expired and no-route drops generate ICMP
         time-exceeded/net-unreachable back to the sender (io/icmp.py;
@@ -107,10 +143,21 @@ class DataplanePump:
         self.dp = dataplane
         self.rings = rings
         self.poll_s = poll_s
+        if fetch_workers is not None:
+            workers = fetch_workers
         if workers is None:
             import jax
 
             workers = 1 if jax.default_backend() == "cpu" else 8
+        self.max_inflight = int(max_inflight if max_inflight is not None
+                                else depth)
+        chain_k = int(chain_k)
+        # round down to a power of two: the chain rung ladder is
+        # K ∈ {2, 4, …, chain_k} and a non-pow2 cap would add a rung
+        # no fold ever uses
+        self.chain_k = (1 << (chain_k.bit_length() - 1)) \
+            if chain_k >= 2 else 0
+        self._fetch_delay = fetch_delay
         self.icmp = None
         self._icmp_scratch = None
         if icmp_src_ip and mode == "persistent":
@@ -154,19 +201,33 @@ class DataplanePump:
             "frames": 0, "pkts": 0, "batches": 0, "tx_ring_full": 0,
             "max_coalesce": 0, "batch_errors": 0,
             # cumulative seconds per stage (profiling; `show io` /
-            # bench read these to attribute wire-path time)
+            # bench read these to attribute wire-path time). t_fetch
+            # is the serial result COPY; t_fetch_wait is the wait for
+            # the device result to become ready — time overlapped with
+            # the other in-flight batches, not a serial path cost.
             "t_pack": 0.0, "t_dispatch": 0.0, "t_fetch": 0.0,
-            "t_write": 0.0,
+            "t_fetch_wait": 0.0, "t_write": 0.0,
+            # overlap occupancy: batches dispatched but not yet written
+            # (the ladder's live depth) + high-water mark, and how often
+            # the adaptive chainer folded backlog into one K-stack
+            "inflight": 0, "inflight_peak": 0,
+            "chain_batches": 0, "chain_k_peak": 0,
         }
         # dispatch→tx latency of recent batches, seconds (experienced
         # added latency of the device leg; ring-wait not included — the
         # bench measures full ring-to-ring with its own timestamps).
         # _lat_lock guards append vs snapshot: iterating a deque while
         # the tx writer appends raises RuntimeError (reachable from the
-        # CLI's `show io` → latency_us()).
+        # CLI's `show io` → latency_us()). It also guards the
+        # concurrent-writer stats (t_fetch*, inflight*): += is a
+        # load/add/store that interleaves across fetch workers.
         self.batch_lat = collections.deque(maxlen=lat_window)
         self._lat_lock = threading.Lock()
-        self._inflight: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._inflight: "queue.Queue" = queue.Queue(
+            maxsize=self.max_inflight)
+        # live fetch workers (under _lat_lock): the tx writer's
+        # shutdown rescue engages only once every fetcher has exited
+        self._fetchers_live = 0
         self._done: dict = {}               # seq -> completed batch
         self._done_cv = threading.Condition()
         self._seq = 0
@@ -182,11 +243,16 @@ class DataplanePump:
         self._stop = threading.Event()
         self._threads: list = []
         # persistent mode (module docs): the resident-loop handle, the
-        # table epoch it was started against, and the FIFO tying each
-        # submitted frame to the loop's (ordered) result stream
+        # table epoch it was started against, the FIFO tying each
+        # submitted frame to the loop's (ordered) result stream, and
+        # the dispatch-done event the collector's exit is gated on
+        # (ADVICE r5: an Empty+_stop exit can orphan a frame the
+        # dispatcher was still handing off)
         self._ppump = None
         self._persist_epoch = -1
-        self._persist_q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._persist_q: "queue.Queue" = queue.Queue(
+            maxsize=self.max_inflight)
+        self._persist_dispatch_done = threading.Event()
 
     def bucket_sizes(self) -> list:
         """The dispatch bucket ladder — precompile ``process_packed``
@@ -194,7 +260,8 @@ class DataplanePump:
         return list(self.buckets)
 
     def warm(self) -> list:
-        """Compile every dispatch bucket rung (blocking). Call before
+        """Compile every dispatch bucket rung (blocking), plus the one
+        chain shape when the adaptive chainer is armed. Call before
         ``start()``/before offering traffic: a rung's first jit compile
         costs 20-40 s on TPU, and paying it lazily inside the dispatch
         thread stalls the rx rings and drops live traffic.
@@ -216,6 +283,12 @@ class DataplanePump:
             jax.block_until_ready(
                 self.dp.process_packed(packed_input_zeros(bucket))
             )
+        k = 2
+        while k <= self.chain_k:
+            jax.block_until_ready(self.dp.process_packed_chain(
+                np.zeros((k, PACKED_IN_ROWS, self.max_batch), np.int32)
+            ))
+            k *= 2
         return list(self.buckets)
 
     # --- lifecycle ---
@@ -257,68 +330,122 @@ class DataplanePump:
             ok = ok and not t.is_alive()
         return ok
 
+    # --- overlap occupancy accounting (dispatch + writer + collector) --
+    def _inflight_inc(self) -> None:
+        with self._lat_lock:
+            d = self.stats["inflight"] + 1
+            self.stats["inflight"] = d
+            if d > self.stats["inflight_peak"]:
+                self.stats["inflight_peak"] = d
+
+    def _inflight_dec(self) -> None:
+        with self._lat_lock:
+            self.stats["inflight"] -= 1
+
     # --- dispatch: rx ring -> device (async) ---
+    def _take_groups(self, rx, hold_cap: int, chain_cap: int) -> list:
+        """Peek pending rx frames into coalesce groups by PACKET count:
+        a group closes when the next frame would overflow ``max_batch``
+        packets. One group = one packed batch; 2+ groups = the chainer
+        has a K-stack to fold. Holds _held_lock across the whole peek
+        block (a concurrent writer release shifts pending indices)."""
+        with self._held_lock:
+            held = self._held
+            budget = min(rx.pending() - held, hold_cap - held)
+            groups, cur, cur_n = [], [], 0
+            j = 0
+            while j < budget and len(groups) < chain_cap:
+                f = rx.peek_nth(held + j)
+                if f is None:
+                    break
+                if cur and cur_n + f.n > self.max_batch:
+                    groups.append(cur)
+                    cur, cur_n = [], 0
+                    continue
+                cur.append(f)
+                cur_n += f.n
+                j += 1
+            if cur and len(groups) < chain_cap:
+                groups.append(cur)
+            if len(groups) > 1:
+                # trim to the largest chain rung ≤ the fold (a power
+                # of two — the precompiled ladder); untrimmed groups
+                # stay pending for the next dispatch
+                groups = groups[:1 << (len(groups).bit_length() - 1)]
+            self._held += sum(len(g) for g in groups)
+        return groups
+
     def _dispatch_loop(self) -> None:
         rx = self.rings.rx
         # never hold every slot: the producer needs headroom to keep
         # writing while K batches are in flight
         hold_cap = max(2, rx.ring.n_slots - 4)
         while not self._stop.is_set():
-            with self._held_lock:
-                held = self._held
-                avail = rx.pending() - held
-                take = min(avail, hold_cap - held, self.max_batch // VEC)
-                frames = []
-                for j in range(take):
-                    f = rx.peek_nth(held + j)
-                    if f is None:
-                        break
-                    frames.append(f)
-                self._held += len(frames)
-            if not frames:
+            tracer = self.dp.tracer
+            slow = tracer is not None and getattr(tracer, "_armed", 0) > 0
+            # the chainer only engages past one full bucket of backlog
+            # (depth alone can't absorb it); tracing runs unchained so
+            # the tracer sees one full StepResult per dispatch
+            chain_cap = 1 if (slow or not self.chain_k) else self.chain_k
+            groups = self._take_groups(rx, hold_cap, chain_cap)
+            if not groups:
                 time.sleep(self.poll_s)
                 continue
             try:
-                self._dispatch(frames)
+                self._dispatch(groups, slow)
             except Exception:
                 log.exception("pump dispatch failed (%d frames)",
-                              len(frames))
+                              sum(len(g) for g in groups))
                 # hand the frames to the writer as a failed batch so
                 # rx slots are still released in order
+                self._inflight_inc()
                 with self._done_cv:
-                    self._done[self._seq] = (None, frames, None,
+                    self._done[self._seq] = (None, groups, None,
                                              time.perf_counter())
                     self._seq += 1
                     self._done_cv.notify_all()
 
-    def _dispatch(self, frames: list) -> None:
-        total = sum(f.n for f in frames)
-        # pad to the smallest ladder bucket that fits (a compile costs
-        # 20-40 s on TPU, so the ladder is geometric, not per-size): a
-        # single frame dispatches at VEC for latency; larger backlogs
-        # climb the rungs instead of jumping straight to max_batch
-        bucket = next(b for b in self.buckets if b >= total)
-        # one [5, bucket] int32 bit-packed block: a single host→device
-        # transfer of 20 B/packet (dataplane.pack_packet_columns
-        # layout), filled by ONE native call over every frame's ring
-        # slot — the pack/mask loop releases the GIL so the daemon's rx
-        # thread keeps draining its sockets (VERDICT r3 Next #5). Bad
-        # (non-IPv4/truncated) slots are masked invalid for the
-        # pipeline; non-IP is punted after the step via `non_ip`.
+    def _pack_group(self, frames: list, flat: np.ndarray,
+                    non_ip: np.ndarray) -> None:
+        """ONE native call packs every frame's ring slot into a [5, B]
+        int32 bit-packed block (dataplane.pack_packet_columns layout,
+        20 B/packet) — the pack/mask loop releases the GIL so the
+        daemon's rx thread keeps draining its sockets (VERDICT r3 Next
+        #5). Bad (non-IPv4/truncated) slots are masked invalid for the
+        pipeline; non-IP is punted after the step via ``non_ip``."""
         from vpp_tpu.native.pktio import pack_batch
 
-        tp0 = time.perf_counter()
-        flat = np.zeros((PACKED_IN_ROWS, bucket), np.int32)
-        non_ip = np.zeros(bucket, np.uint8)
         for j, f in enumerate(frames):
             self._pack_bases[j] = f.cols["src_ip"].ctypes.data
             self._pack_ns[j] = f.n
         pack_batch(self._pack_bases, self._pack_ns, len(frames), flat,
                    non_ip)
+
+    def _dispatch(self, groups: list, slow: bool = False) -> None:
+        K = len(groups)
+        tp0 = time.perf_counter()
+        if K == 1:
+            total = sum(f.n for f in groups[0])
+            # pad to the smallest ladder bucket that fits (a compile
+            # costs 20-40 s on TPU, so the ladder is geometric, not
+            # per-size): a single frame dispatches at VEC for latency;
+            # larger backlogs climb the rungs
+            bucket = next(b for b in self.buckets if b >= total)
+            flat = np.zeros((PACKED_IN_ROWS, bucket), np.int32)
+            non_ip = np.zeros(bucket, np.uint8)
+            self._pack_group(groups[0], flat, non_ip)
+        else:
+            # chain fold: K stacked max_batch buckets, ONE device
+            # program. K is a power of two from the precompiled rung
+            # ladder (``_take_groups`` trimmed to it), so the jit
+            # cache stays at log2(chain_k) chain shapes.
+            flat = np.zeros((K, PACKED_IN_ROWS,
+                             self.max_batch), np.int32)
+            non_ip = np.zeros((K, self.max_batch), np.uint8)
+            for k, g in enumerate(groups):
+                self._pack_group(g, flat[k], non_ip[k])
         non_ip = non_ip.view(bool)
         self.stats["t_pack"] += time.perf_counter() - tp0
-        tracer = self.dp.tracer
-        slow = tracer is not None and getattr(tracer, "_armed", 0) > 0
         t0 = time.perf_counter()
         if slow:
             # tracing: run the unpacked step so the tracer captures a
@@ -326,10 +453,19 @@ class DataplanePump:
             payload = self.dp.process(
                 PacketVector(**unpack_packet_input(flat))
             )
-        else:
+        elif K == 1:
             payload = self.dp.process_packed(flat)  # async dispatch
+        else:
+            payload = self.dp.process_packed_chain(flat)  # async, [K,5,B]
+            self.stats["chain_batches"] += 1
+            self.stats["chain_k_peak"] = max(self.stats["chain_k_peak"],
+                                             K)
         self.stats["t_dispatch"] += time.perf_counter() - t0
-        item = (self._seq, payload, frames, non_ip, t0, slow)
+        item = (self._seq, payload, groups, non_ip, t0, slow)
+        # count the batch in flight BEFORE the hand-off: a fetch worker
+        # can complete it (and the writer decrement it) the instant the
+        # put lands, so inc-after-put would transiently read -1
+        self._inflight_inc()
         while True:
             # bounded put that stays responsive to stop(): the fetchers
             # may already have exited, and a blocking put would deadlock
@@ -339,11 +475,12 @@ class DataplanePump:
                 break
             except queue.Full:
                 if self._stop.is_set():
+                    self._inflight_dec()
                     return
         self._seq += 1
         self.stats["batches"] += 1
         self.stats["max_coalesce"] = max(self.stats["max_coalesce"],
-                                         len(frames))
+                                         sum(len(g) for g in groups))
 
     # --- persistent mode: resident device loop (module docs) ---
     def _persist_start(self) -> None:
@@ -386,170 +523,235 @@ class DataplanePump:
         self._persist_stop_merge()
         self._persist_start()
 
-    def _persist_dispatch_loop(self) -> None:
-        from vpp_tpu.native.pktio import pack_batch
-
-        if self._ppump is None:  # warm() may have launched it already
+    def _persist_submit_one(self, f) -> bool:
+        """Pack + submit ONE held frame to the resident loop and hand
+        its FIFO ticket to the collector. Returns False when stop()
+        interrupted the hand-off (the frame stays held; the writer
+        teardown ignores it — the runtime frees the rings next)."""
+        tp0 = time.perf_counter()
+        flat = np.zeros((PACKED_IN_ROWS, VEC), np.int32)
+        non_ip = np.zeros(VEC, np.uint8)
+        self._pack_group([f], flat, non_ip)
+        self.stats["t_pack"] += time.perf_counter() - tp0
+        t0 = time.perf_counter()
+        try:
+            self._ppump.submit(flat, now=self.dp.clock_ticks())
+        except RuntimeError:
+            log.exception("resident loop died — relaunching")
+            self.stats["batch_errors"] += 1
+            self._ppump = None
             self._persist_start()
+            self._ppump.submit(flat, now=self.dp.clock_ticks())
+        self.stats["t_dispatch"] += time.perf_counter() - t0
+        item = (self._seq, self._ppump, [[f]], non_ip.view(bool), t0)
+        self._inflight_inc()
+        while True:
+            try:
+                self._persist_q.put(item, timeout=0.05)
+                break
+            except queue.Full:
+                if self._stop.is_set():
+                    self._inflight_dec()
+                    return False
+        self._seq += 1
+        self.stats["batches"] += 1
+        self.stats["max_coalesce"] = max(self.stats["max_coalesce"], 1)
+        return True
+
+    def _persist_dispatch_loop(self) -> None:
         rx = self.rings.rx
         hold_cap = max(2, rx.ring.n_slots - 4)
         try:
+            # INSIDE the try: a failed resident-loop launch (device
+            # unavailable, compile error) must still set the
+            # dispatch-done gate in the finally, or the collector —
+            # whose exit requires it — would spin forever and stop()'s
+            # unbounded join would hang
+            if self._ppump is None:  # warm() may have launched it
+                self._persist_start()
             while not self._stop.is_set():
                 if self.dp.epoch != self._persist_epoch:
                     self._persist_restart()
-                with self._held_lock:
-                    held = self._held
-                    f = None
-                    if rx.pending() - held > 0 and held < hold_cap:
-                        f = rx.peek_nth(held)
-                    if f is not None:
-                        self._held += 1
-                if f is None:
-                    time.sleep(self.poll_s)
-                    continue
-                tp0 = time.perf_counter()
-                flat = np.zeros((PACKED_IN_ROWS, VEC), np.int32)
-                non_ip = np.zeros(VEC, np.uint8)
-                self._pack_bases[0] = f.cols["src_ip"].ctypes.data
-                self._pack_ns[0] = f.n
-                pack_batch(self._pack_bases, self._pack_ns, 1, flat,
-                           non_ip)
-                self.stats["t_pack"] += time.perf_counter() - tp0
-                t0 = time.perf_counter()
-                try:
-                    self._ppump.submit(flat, now=self.dp.clock_ticks())
-                except RuntimeError:
-                    log.exception("resident loop died — relaunching")
-                    self.stats["batch_errors"] += 1
-                    self._ppump = None
-                    self._persist_start()
-                    self._ppump.submit(flat, now=self.dp.clock_ticks())
-                self.stats["t_dispatch"] += time.perf_counter() - t0
-                item = (self._seq, self._ppump, [f],
-                        non_ip.view(bool), t0)
-                while True:
-                    try:
-                        self._persist_q.put(item, timeout=0.05)
+                # refill burst: drain EVERY pending frame up to the
+                # in-flight cap before sleeping — the resident loop's
+                # host_fetch callback blocks the device whenever its
+                # queue runs empty, so the overlap discipline here is
+                # keeping max_inflight frames queued ahead of it, not
+                # one-frame-per-poll lockstep (the r5 goodput collapse)
+                burst = 0
+                while not self._stop.is_set():
+                    with self._held_lock:
+                        held = self._held
+                        f = None
+                        if rx.pending() - held > 0 and held < hold_cap:
+                            f = rx.peek_nth(held)
+                        if f is not None:
+                            self._held += 1
+                    if f is None:
                         break
-                    except queue.Full:
-                        if self._stop.is_set():
-                            return
-                self._seq += 1
-                self.stats["batches"] += 1
-                self.stats["max_coalesce"] = max(
-                    self.stats["max_coalesce"], 1)
+                    if not self._persist_submit_one(f):
+                        return
+                    burst += 1
+                    if burst >= self.max_inflight:
+                        break
+                if burst == 0:
+                    time.sleep(self.poll_s)
         finally:
-            # exit the device program on the way out — a resident loop
-            # left behind would block the device for every later user
+            # signal the collector FIRST: every _persist_q.put this
+            # thread will ever issue has happened, so Empty+done is a
+            # race-free exit condition (ADVICE r5 shutdown race) —
+            # then exit the device program (a resident loop left
+            # behind would block the device for every later user)
+            self._persist_dispatch_done.set()
             try:
                 self._persist_stop_merge()
             except Exception:  # noqa: BLE001 — shutdown path
                 log.exception("persistent loop shutdown failed")
+
+    def _persist_collect_one(self, item) -> None:
+        seq, ppump, groups, non_ip, t0 = item
+        tf0 = time.perf_counter()
+        batch = None
+        deadline = time.monotonic() + 300.0
+        # NOT gated on _stop: an already-submitted frame's result
+        # is coming (PersistentPump.stop drains every queued frame
+        # before the loop exits) — discarding it at pump shutdown
+        # would silently drop live traffic the dispatch mode
+        # delivers. Loop-death/timeout still bounds the wait.
+        while True:
+            try:
+                batch = ppump.result(timeout=0.2)
+                break
+            except queue.Empty:
+                if time.monotonic() > deadline:
+                    log.error("resident loop result timed out")
+                    self.stats["batch_errors"] += 1
+                    break
+            except RuntimeError:
+                log.exception("resident loop result failed")
+                self.stats["batch_errors"] += 1
+                break
+        with self._lat_lock:
+            self.stats["t_fetch"] += time.perf_counter() - tf0
+        with self._done_cv:
+            self._done[seq] = (batch, groups, non_ip, t0)
+            self._done_cv.notify_all()
 
     def _persist_collect_loop(self) -> None:
         """Pull ordered results off the resident loop and hand them to
         the in-order tx writer. The loop preserves submission order, so
         seq mapping is one FIFO deep — no reorder buffer needed, but
         the writer's _done contract is kept so `stop()` semantics and
-        stats stay identical across modes."""
+        stats stay identical across modes. Exit only once the
+        dispatcher is DONE and the hand-off queue is drained: an
+        Empty+_stop exit races a dispatcher mid-put, orphaning a seq
+        the writer would spin on forever (ADVICE r5)."""
         while True:
             try:
-                seq, ppump, frames, non_ip, t0 = self._persist_q.get(
-                    timeout=0.05)
+                item = self._persist_q.get(timeout=0.05)
             except queue.Empty:
-                if self._stop.is_set():
-                    return
+                if (self._stop.is_set()
+                        and self._persist_dispatch_done.is_set()):
+                    # final drain: the dispatcher has exited, so
+                    # anything it ever queued is already visible here
+                    while True:
+                        try:
+                            item = self._persist_q.get_nowait()
+                        except queue.Empty:
+                            return
+                        self._persist_collect_one(item)
                 continue
-            tf0 = time.perf_counter()
-            batch = None
-            deadline = time.monotonic() + 300.0
-            # NOT gated on _stop: an already-submitted frame's result
-            # is coming (PersistentPump.stop drains every queued frame
-            # before the loop exits) — discarding it at pump shutdown
-            # would silently drop live traffic the dispatch mode
-            # delivers. Loop-death/timeout still bounds the wait.
-            while True:
-                try:
-                    batch = ppump.result(timeout=0.2)
-                    break
-                except queue.Empty:
-                    if time.monotonic() > deadline:
-                        log.error("resident loop result timed out")
-                        self.stats["batch_errors"] += 1
-                        break
-                except RuntimeError:
-                    log.exception("resident loop result failed")
-                    self.stats["batch_errors"] += 1
-                    break
-            with self._lat_lock:
-                self.stats["t_fetch"] += time.perf_counter() - tf0
-            with self._done_cv:
-                self._done[seq] = (batch, frames, non_ip, t0)
-                self._done_cv.notify_all()
+            self._persist_collect_one(item)
 
     # --- fetch workers: concurrent device_get (RPC round trips) ---
     def _fetch_loop(self) -> None:
+        with self._lat_lock:
+            self._fetchers_live += 1
+        try:
+            while True:
+                try:
+                    item = self._inflight.get(timeout=0.05)
+                except queue.Empty:
+                    if self._stop.is_set():
+                        return
+                    continue
+                if item is _SENTINEL:
+                    # wake the next worker too, then exit
+                    try:
+                        self._inflight.put_nowait(_SENTINEL)
+                    except queue.Full:
+                        pass
+                    return
+                self._complete_item(item)
+        finally:
+            with self._lat_lock:
+                self._fetchers_live -= 1
+
+    def _complete_item(self, item) -> None:
+        """Fetch one dispatched batch's device result and hand it to
+        the in-order writer (the fetch-worker body; the writer's
+        shutdown rescue path reuses it for batches stranded behind the
+        stop sentinel)."""
         import jax
 
-        while True:
-            try:
-                item = self._inflight.get(timeout=0.05)
-            except queue.Empty:
-                if self._stop.is_set():
-                    return
-                continue
-            if item is _SENTINEL:
-                # wake the next worker too, then exit
-                try:
-                    self._inflight.put_nowait(_SENTINEL)
-                except queue.Full:
-                    pass
-                return
-            seq, payload, frames, non_ip, t0, slow = item
-            try:
-                if slow:
-                    out_pkts, disp, tx_if, next_hop, cause = jax.device_get(
-                        (payload.pkts, payload.disp, payload.tx_if,
-                         payload.next_hop, payload.drop_cause)
-                    )
-                    batch = {
-                        "src_ip": np.asarray(out_pkts.src_ip),
-                        "dst_ip": np.asarray(out_pkts.dst_ip),
-                        "proto": np.asarray(out_pkts.proto),
-                        "sport": np.asarray(out_pkts.sport),
-                        "dport": np.asarray(out_pkts.dport),
-                        "ttl": np.asarray(out_pkts.ttl),
-                        "pkt_len": np.asarray(out_pkts.pkt_len),
-                        "disp": np.asarray(disp).astype(np.int32).copy(),
-                        "tx_if": np.asarray(tx_if).astype(np.int32).copy(),
-                        "next_hop": np.asarray(next_hop),
-                        "drop_cause": np.asarray(cause).astype(np.int32),
-                    }
-                else:
-                    # ONE [5, B] fetch, kept PACKED: the tx writer
-                    # decodes it straight into ring slots natively
-                    # (rings.push_packed), no host-side column arrays.
-                    # np.array: device_get may hand back a zero-copy
-                    # view of a device buffer whose lifetime ends with
-                    # `payload` — the copy (20 B/packet) outlives it
-                    tf0 = time.perf_counter()
-                    batch = np.array(jax.device_get(payload))
-                    # concurrent fetchers: accumulate under a lock or
-                    # the += load/add/store interleaves and undercounts
-                    with self._lat_lock:
-                        self.stats["t_fetch"] += time.perf_counter() - tf0
-            except Exception:
-                log.exception("pump fetch failed (batch %d)", seq)
-                batch = None
-                self.stats["batch_errors"] += 1
-            with self._done_cv:
-                self._done[seq] = (batch, frames, non_ip, t0)
-                self._done_cv.notify_all()
+        seq, payload, groups, non_ip, t0, slow = item
+        delay = self._fetch_delay
+        if delay is not None:
+            time.sleep(delay(seq) if callable(delay) else delay)
+        try:
+            if slow:
+                out_pkts, disp, tx_if, next_hop, cause = jax.device_get(
+                    (payload.pkts, payload.disp, payload.tx_if,
+                     payload.next_hop, payload.drop_cause)
+                )
+                batch = {
+                    "src_ip": np.asarray(out_pkts.src_ip),
+                    "dst_ip": np.asarray(out_pkts.dst_ip),
+                    "proto": np.asarray(out_pkts.proto),
+                    "sport": np.asarray(out_pkts.sport),
+                    "dport": np.asarray(out_pkts.dport),
+                    "ttl": np.asarray(out_pkts.ttl),
+                    "pkt_len": np.asarray(out_pkts.pkt_len),
+                    "disp": np.asarray(disp).astype(np.int32).copy(),
+                    "tx_if": np.asarray(tx_if).astype(np.int32).copy(),
+                    "next_hop": np.asarray(next_hop),
+                    "drop_cause": np.asarray(cause).astype(np.int32),
+                }
+            else:
+                # ONE packed fetch ([5, B], or [K, 5, B] for a
+                # chain fold), kept PACKED: the tx writer decodes
+                # it straight into ring slots natively
+                # (rings.push_packed), no host-side column arrays.
+                # The wait (device compute / tunnel RTT) is timed
+                # apart from the copy: the wait overlaps the other
+                # in-flight batches across the fetch pool, so only
+                # the copy is a serial throughput cost.
+                # np.array: device_get may hand back a zero-copy
+                # view of a device buffer whose lifetime ends with
+                # `payload` — the copy (20 B/packet) outlives it
+                tw0 = time.perf_counter()
+                jax.block_until_ready(payload)
+                tf0 = time.perf_counter()
+                batch = np.array(jax.device_get(payload))
+                tf1 = time.perf_counter()
+                # concurrent fetchers: accumulate under a lock or
+                # the += load/add/store interleaves and undercounts
+                with self._lat_lock:
+                    self.stats["t_fetch_wait"] += tf0 - tw0
+                    self.stats["t_fetch"] += tf1 - tf0
+        except Exception:
+            log.exception("pump fetch failed (batch %d)", seq)
+            batch = None
+            self.stats["batch_errors"] += 1
+        with self._done_cv:
+            self._done[seq] = (batch, groups, non_ip, t0)
+            self._done_cv.notify_all()
 
     # --- tx writer: reorder, split, write tx ring, release rx slots ---
     def _write_loop(self) -> None:
         next_seq = 0
         while True:
+            rescue = False
             with self._done_cv:
                 while next_seq not in self._done:
                     # exit once stopped and every dispatched batch has
@@ -558,48 +760,89 @@ class DataplanePump:
                     # of the queue is NOT a usable signal here)
                     if self._stop.is_set() and next_seq >= self._seq:
                         return
+                    if self._stop.is_set() and not self._inflight.empty():
+                        with self._lat_lock:
+                            fetchers = self._fetchers_live
+                        if fetchers == 0:
+                            # stop() raced _dispatch's put: a batch
+                            # landed BEHIND the stop sentinel and every
+                            # fetch worker has already exited — without
+                            # a rescue its seq never reaches _done and
+                            # this unbounded-join loop hangs forever
+                            rescue = True
+                            break
                     self._done_cv.wait(timeout=0.05)
-                item = self._done.pop(next_seq)
+                if not rescue:
+                    item = self._done.pop(next_seq)
+            if rescue:
+                # complete stranded batches on this thread (outside
+                # _done_cv — _complete_item takes it to post results)
+                while True:
+                    try:
+                        stranded = self._inflight.get_nowait()
+                    except queue.Empty:
+                        break
+                    if stranded is not _SENTINEL:
+                        self._complete_item(stranded)
+                continue
             next_seq += 1
             try:
                 self._write(*item)
             except Exception:
                 log.exception("pump tx write failed")
                 with self._held_lock:
-                    for _ in item[1]:
-                        self.rings.rx.release()
-                    self._held -= len(item[1])
+                    for g in item[1]:
+                        for _ in g:
+                            self.rings.rx.release()
+                        self._held -= len(g)
+            self._inflight_dec()
 
-    def _write(self, batch, frames: list, non_ip, t0: float) -> None:
+    def _write_packed_group(self, batch: np.ndarray, frames: list,
+                            host_if: int, epoch: int,
+                            icmp_on: bool) -> None:
+        """Fast path for one coalesce group: ONE native call per frame
+        decodes the packed [5, B] result straight into a reserved tx
+        slot (pass-through columns from the rx slot, non-IP punt
+        applied in C)."""
+        off = 0
+        for f in frames:
+            n = f.n
+            with self._tx_lock:
+                ok = self.rings.tx.push_packed(batch, off, n, f,
+                                               host_if, epoch,
+                                               self._cause)
+            if ok:
+                self.stats["frames"] += 1
+                self.stats["pkts"] += n
+                if icmp_on and n and self._cause[:n].any():
+                    self._emit_icmp_frame(f, self._cause)
+            else:
+                self.stats["tx_ring_full"] += 1
+            off += n
+
+    def _write(self, batch, groups: list, non_ip, t0: float) -> None:
         if isinstance(batch, np.ndarray):
-            # fast path: ONE native call per frame decodes the packed
-            # result straight into a reserved tx slot (pass-through
-            # columns from the rx slot, non-IP punt applied in C)
             tw0 = time.perf_counter()
             host_if = (self.dp.host_if
                        if self.dp.host_if is not None else -1)
             epoch = self.dp.epoch
             icmp_on = self.icmp is not None
-            off = 0
-            for f in frames:
-                n = f.n
-                with self._tx_lock:
-                    ok = self.rings.tx.push_packed(batch, off, n, f,
-                                                   host_if, epoch,
-                                                   self._cause)
-                if ok:
-                    self.stats["frames"] += 1
-                    self.stats["pkts"] += n
-                    if icmp_on and n and self._cause[:n].any():
-                        self._emit_icmp_frame(f, self._cause)
-                else:
-                    self.stats["tx_ring_full"] += 1
-                off += n
+            if batch.ndim == 3:
+                # chain fold: sub-batch k carries group k's packets
+                # (padded stack rows past len(groups) hold no frames)
+                for k, frames in enumerate(groups):
+                    self._write_packed_group(batch[k], frames, host_if,
+                                             epoch, icmp_on)
+            else:
+                self._write_packed_group(batch, groups[0], host_if,
+                                         epoch, icmp_on)
             self.stats["t_write"] += time.perf_counter() - tw0
             with self._lat_lock:
                 self.batch_lat.append(time.perf_counter() - t0)
         elif batch is not None:
             # tracing path: full column dict from the unpacked step
+            # (the tracer never chains, so there is exactly one group)
+            frames = groups[0]
             if non_ip is not None and non_ip.any():
                 host_if = (self.dp.host_if
                            if self.dp.host_if is not None else -1)
@@ -647,9 +890,10 @@ class DataplanePump:
             with self._lat_lock:
                 self.batch_lat.append(time.perf_counter() - t0)
         with self._held_lock:
-            for _ in frames:
-                self.rings.rx.release()
-            self._held -= len(frames)
+            for g in groups:
+                for _ in g:
+                    self.rings.rx.release()
+                self._held -= len(g)
 
     def _emit_icmp_frame(self, f, cause: np.ndarray) -> None:
         """Generate ICMP time-exceeded / net-unreachable frames for one

@@ -329,6 +329,14 @@ class Replicator:
         return True
 
     def _start_heartbeat(self) -> None:
+        # one heartbeat loop per replica: a refollow swaps _client and
+        # the LIVE loop pings the new client on its next iteration, so
+        # starting another would accumulate a thread per refollow cycle
+        # on a flapping primary link — each independently able to fire
+        # _promote (ADVICE r5)
+        t = self._heartbeat_thread
+        if t is not None and t.is_alive():
+            return
         self._heartbeat_thread = threading.Thread(
             target=self._heartbeat_loop, daemon=True, name="kv-replica-hb"
         )

@@ -164,6 +164,25 @@ class QuorumWitness:
             if op == "renew":
                 node, epoch = str(req["node"]), int(req["epoch"])
                 ttl = self._ttl_of(req)
+                if epoch > self.epoch:
+                    # a renewer AHEAD of our recorded epoch proves OUR
+                    # state is stale — epochs only advance through
+                    # granted claims, so a higher stamp can only exist
+                    # if this witness lost its persist file (node
+                    # reschedule on a hostPath) or rolled back.
+                    # Refusing it would demote the surviving primary
+                    # as 'superseded' with no recorded successor and
+                    # wedge the HA pair read-only forever (ADVICE r5
+                    # medium). Highest-epoch-wins, not
+                    # first-renewer-wins: a stale ex-primary that
+                    # re-renewed first gets superseded the moment the
+                    # true (higher-epoch) primary shows up — the same
+                    # newer-fence-demotes rule the data path applies.
+                    self.epoch = epoch
+                    self.primary = None  # adopted below by the match
+                    self._persist()
+                    log.warning("stale witness state: adopted epoch %d "
+                                "from renewer %s", epoch, node)
                 if epoch == self.epoch and self.primary in (None, node):
                     changed = self.primary != node
                     self.primary = node

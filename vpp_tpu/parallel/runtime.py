@@ -110,6 +110,10 @@ class MeshRuntime:
             ]
             self.cluster_pump = ClusterPump(
                 self.cluster, self.ring_pairs, snap=io.snap,
+                # fabric steps in flight before dispatch backpressures
+                # (the overlap window — same knob as the single-node
+                # pump's ladder; None keeps the fabric default)
+                max_inflight=io.max_inflight,
                 # ICMP errors from each node's pod gateway, re-injected
                 # as that node's self-originated ingress (host if)
                 icmp_src_ips=(

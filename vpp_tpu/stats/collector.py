@@ -50,6 +50,29 @@ PUMP_STAT_GAUGES = (
      "ICMP error packets generated"),
     ("fabric_pkts", "vpp_tpu_pump_fabric_packets",
      "packets delivered across the mesh fabric (cluster pump)"),
+    # overlapped fetch ladder observability (io/pump.py module doc):
+    # the live in-flight window and the adaptive chainer's activity
+    ("inflight", "vpp_tpu_pump_inflight_depth",
+     "device batches currently in flight (dispatched, not yet written)"),
+    ("inflight_peak", "vpp_tpu_pump_inflight_peak",
+     "high-water mark of in-flight device batches"),
+    ("chain_batches", "vpp_tpu_pump_chained_dispatches",
+     "dispatches that folded K packed buckets into one chained "
+     "device program"),
+    ("chain_k_peak", "vpp_tpu_pump_chain_k_peak",
+     "largest chain fold depth K used"),
+)
+
+# pump.stats stage-seconds key -> `stage` label of the
+# vpp_tpu_pump_stage_seconds counter family. fetch_wait is the wait
+# for a device result to become READY (overlapped across the in-flight
+# window — not a serial path cost); fetch is the serial result copy.
+PUMP_STAGE_SECONDS = (
+    ("t_pack", "pack"),
+    ("t_dispatch", "dispatch"),
+    ("t_fetch_wait", "fetch_wait"),
+    ("t_fetch", "fetch"),
+    ("t_write", "write"),
 )
 
 PUMP_GAUGES = tuple(
@@ -134,6 +157,18 @@ class StatsCollector:
             name: self.registry.register(STATS_PATH, Gauge(name, help_))
             for name, help_ in PUMP_GAUGES
         }
+        # one labelled counter family for the per-stage cumulative
+        # seconds: stage="pack|dispatch|fetch_wait|fetch|write" — a
+        # counter so rate() yields per-second stage occupancy, which
+        # is how the overlap is OBSERVED (fetch_wait >> fetch with the
+        # ladder healthy; fetch_wait collapsing into the writer's
+        # critical path shows up as pump latency instead)
+        self.pump_stage_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_pump_stage_seconds",
+                  "cumulative seconds spent per pump pipeline stage",
+                  kind="counter"),
+        )
         self.vcl = None  # set_vcl(): admission counters -> gauges
         self.vcl_gauges = {
             name: self.registry.register(STATS_PATH, Gauge(name, help_))
@@ -258,6 +293,9 @@ class StatsCollector:
             ps = pump.stats
             for stat_key, gauge_name, _ in PUMP_STAT_GAUGES:
                 self.pump_gauges[gauge_name].set(int(ps.get(stat_key, 0)))
+            for stat_key, stage in PUMP_STAGE_SECONDS:
+                self.pump_stage_gauge.set(
+                    round(float(ps.get(stat_key, 0.0)), 6), stage=stage)
             lat = pump.latency_us()
             self.pump_gauges["vpp_tpu_pump_batch_latency_p50_us"].set(
                 lat["p50"])

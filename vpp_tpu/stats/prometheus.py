@@ -24,11 +24,20 @@ def _escape(v: str) -> str:
 
 
 class Gauge:
-    """One metric family; holds a value per label set."""
+    """One metric family; holds a value per label set.
 
-    def __init__(self, name: str, help_text: str = ""):
+    ``kind`` picks the exposition TYPE: "gauge" (default) or
+    "counter" — cumulative families (per-stage pump seconds, byte
+    totals) should advertise counter so PromQL ``rate()`` applies;
+    the set/add/get surface is identical either way."""
+
+    def __init__(self, name: str, help_text: str = "",
+                 kind: str = "gauge"):
+        if kind not in ("gauge", "counter"):
+            raise ValueError(f"unknown metric kind {kind!r}")
         self.name = name
         self.help = help_text
+        self.kind = kind
         self._values: Dict[LabelSet, float] = {}
         self._lock = threading.Lock()
 
@@ -53,7 +62,7 @@ class Gauge:
         out = []
         if self.help:
             out.append(f"# HELP {self.name} {self.help}")
-        out.append(f"# TYPE {self.name} gauge")
+        out.append(f"# TYPE {self.name} {self.kind}")
         with self._lock:
             items = sorted(self._values.items())
         for labels, value in items:
