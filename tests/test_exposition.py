@@ -1,0 +1,273 @@
+"""Prometheus text-format 0.0.4 conformance + metrics hygiene (ISSUE 2
+satellites).
+
+Scrapes every registered family over real HTTP and validates the
+exposition contract a Prometheus server relies on: one ``# TYPE`` per
+family, escaped help/labels, cumulative ``_bucket`` series whose
+``le="+Inf"`` equals ``_count``, and counters that never step
+backwards across publishes. Also invokes the in-tree metrics lint so a
+badly named/help-less/duplicate family fails tier-1.
+"""
+
+import re
+import urllib.request
+from pathlib import Path
+
+from vpp_tpu.cni import ContainerIndex, RemoteCNIServer
+from vpp_tpu.cni.model import CNIRequest
+from vpp_tpu.ipam.ipam import IPAM
+from vpp_tpu.pipeline.dataplane import Dataplane
+from vpp_tpu.pipeline.tables import DataplaneConfig
+from vpp_tpu.pipeline.vector import make_packet_vector
+from vpp_tpu.stats import Gauge, Histogram, MetricsRegistry, StatsHTTPServer
+from vpp_tpu.stats.collector import (
+    STATS_PATH,
+    StatsCollector,
+    register_control_plane_metrics,
+)
+
+SAMPLE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?P<labels>\{.*\})?'
+    r' (?P<value>[0-9eE.+-]+|NaN|[+-]Inf)$'
+)
+LABELS_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"'
+)
+
+
+def parse_exposition(body: str):
+    """text-format 0.0.4 → (types, samples); asserts line-level shape."""
+    types = {}
+    samples = []  # (family-or-series name, labels dict, float value)
+    for line in body.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            assert name not in types, f"second TYPE line for {name}"
+            assert kind in ("gauge", "counter", "histogram"), line
+            types[name] = kind
+            continue
+        if line.startswith("# HELP "):
+            # escaped help: no raw newline can survive into a HELP line
+            # by construction; the payload must round-trip the escapes
+            payload = line.split(" ", 3)[3]
+            assert "\n" not in payload
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line}"
+        match = SAMPLE_RE.match(line)
+        assert match, f"malformed sample line: {line!r}"
+        labels = {}
+        if match.group("labels"):
+            inner = match.group("labels")[1:-1]
+            labels = dict(LABELS_RE.findall(inner))
+        samples.append((match.group("name"), labels, float(match.group("value"))))
+    return types, samples
+
+
+def family_of(series_name: str, types: dict) -> str:
+    for suffix in ("_bucket", "_sum", "_count"):
+        base = series_name[: -len(suffix)] if series_name.endswith(suffix) else None
+        if base and types.get(base) == "histogram":
+            return base
+    return series_name
+
+
+def wired_collector():
+    dp = Dataplane(DataplaneConfig(sess_slots=256))
+    dp.add_uplink()
+    dp.add_host_interface()
+    ipam = IPAM(node_id=1)
+    index = ContainerIndex()
+    srv = RemoteCNIServer(dp, ipam, index)
+    srv.set_ready()
+    coll = StatsCollector(dp, index)
+    hists = register_control_plane_metrics(coll.registry)
+    dp.propagation_hist = hists["config_propagation"]
+    dp.txn_commit_hist = hists["txn_commit"]
+    srv.duration_hist = hists["cni_request"]
+    r1 = srv.add(CNIRequest(container_id="c1", extra_args={
+        "K8S_POD_NAME": "web", "K8S_POD_NAMESPACE": "prod"}))
+    r2 = srv.add(CNIRequest(container_id="c2", extra_args={
+        "K8S_POD_NAME": "db", "K8S_POD_NAMESPACE": "prod"}))
+    ip1 = r1.interfaces[0].ip_addresses[0].address.split("/")[0]
+    ip2 = r2.interfaces[0].ip_addresses[0].address.split("/")[0]
+    if1 = dp.pod_if[("prod", "web")]
+    res = dp.process(make_packet_vector(
+        [dict(src=ip1, dst=ip2, proto=6, sport=1000 + i, dport=80,
+              len=100, rx_if=if1) for i in range(4)]
+    ))
+    coll.update(res.stats)
+    # exercise the pump-latency histogram path directly (no pump here)
+    coll.pump_batch_hist.observe(0.0007)
+    coll.pump_batch_hist.observe(0.02)
+    coll.publish()
+    return dp, srv, coll, (ip1, ip2, if1)
+
+
+def scrape(port: int, path: str) -> str:
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ).read().decode()
+
+
+def validate_body(body: str):
+    types, samples = parse_exposition(body)
+    seen_series = set()
+    for name, labels, _ in samples:
+        fam = family_of(name, types)
+        assert fam in types, f"sample {name} has no TYPE line"
+        key = (name, tuple(sorted(labels.items())))
+        assert key not in seen_series, f"duplicate series {key}"
+        seen_series.add(key)
+    # histogram contract: cumulative buckets, +Inf == _count, _sum there
+    hists = [n for n, k in types.items() if k == "histogram"]
+    for fam in hists:
+        by_labelset = {}
+        for name, labels, value in samples:
+            if name != f"{fam}_bucket":
+                continue
+            key = tuple(sorted(
+                (k, v) for k, v in labels.items() if k != "le"))
+            by_labelset.setdefault(key, []).append((labels["le"], value))
+        counts = {
+            tuple(sorted(labels.items())): value
+            for name, labels, value in samples if name == f"{fam}_count"
+        }
+        sums = {
+            tuple(sorted(labels.items())): value
+            for name, labels, value in samples if name == f"{fam}_sum"
+        }
+        for key, buckets in by_labelset.items():
+            values = [v for _, v in buckets]  # exposition order
+            assert values == sorted(values), \
+                f"{fam}{key}: non-cumulative buckets {buckets}"
+            les = [le for le, _ in buckets]
+            assert les[-1] == "+Inf", f"{fam}{key}: last bucket {les[-1]}"
+            numeric = [float(le) for le in les[:-1]]
+            assert numeric == sorted(numeric)
+            assert key in counts and key in sums, f"{fam}{key} incomplete"
+            assert values[-1] == counts[key], \
+                f"{fam}{key}: +Inf {values[-1]} != _count {counts[key]}"
+    return types, samples
+
+
+def test_exposition_conformance_over_http():
+    dp, srv, coll, (ip1, ip2, if1) = wired_collector()
+    server = StatsHTTPServer(coll.registry, port=0)
+    server.start()
+    try:
+        # every path in the '/' index validates
+        index = scrape(server.port, "/").split()
+        assert STATS_PATH in index
+        bodies = {}
+        for path in index:
+            bodies[path] = validate_body(scrape(server.port, path))
+        types, samples = bodies[STATS_PATH]
+        # the new histogram families are all exposed
+        for fam in ("vpp_tpu_config_propagation_seconds",
+                    "vpp_tpu_txn_commit_seconds",
+                    "vpp_tpu_cni_request_seconds",
+                    "vpp_tpu_pump_batch_seconds"):
+            assert types.get(fam) == "histogram", fam
+        # counters monotonic across two publishes with more traffic
+        first = {
+            (n, tuple(sorted(l.items()))): v for n, l, v in samples
+            if types.get(family_of(n, types)) in ("counter", "histogram")
+        }
+        res = dp.process(make_packet_vector(
+            [dict(src=ip1, dst=ip2, proto=6, sport=4321, dport=80,
+                  len=100, rx_if=if1)]
+        ))
+        coll.update(res.stats)
+        coll.pump_batch_hist.observe(0.001)
+        dp.swap()  # txn-commit histogram moves too
+        coll.publish()
+        types2, samples2 = validate_body(scrape(server.port, STATS_PATH))
+        second = {
+            (n, tuple(sorted(l.items()))): v for n, l, v in samples2
+            if types2.get(family_of(n, types2)) in ("counter", "histogram")
+        }
+        assert second, "no counter/histogram samples scraped"
+        moved = 0
+        for key, v1 in first.items():
+            v2 = second.get(key)
+            assert v2 is not None and v2 >= v1, \
+                f"counter went backwards/vanished: {key} {v1} -> {v2}"
+            moved += v2 > v1
+        assert moved, "second publish must advance at least one counter"
+    finally:
+        server.close()
+
+
+def test_help_and_label_escaping_survive_http():
+    reg = MetricsRegistry()
+    g = Gauge("vpp_tpu_esc_gauge", 'tricky help \\ with "quotes"\nand newline')
+    g.set(1, pod='we"ird\\pod\nname')
+    reg.register("/x", g)
+    h = Histogram("vpp_tpu_esc_seconds", "hist\nhelp", buckets=(0.1, 1.0))
+    h.observe(0.5, op='a"b')
+    reg.register("/x", h)
+    server = StatsHTTPServer(reg, port=0)
+    server.start()
+    try:
+        body = scrape(server.port, "/x")
+        types, samples = validate_body(body)
+        assert types == {"vpp_tpu_esc_gauge": "gauge",
+                         "vpp_tpu_esc_seconds": "histogram"}
+        assert r"tricky help \\ with" in body and r"\nand newline" in body
+        labels = [lbl for n, lbl, _ in samples if n == "vpp_tpu_esc_gauge"]
+        # the parser keeps the on-wire (escaped) form: quote escaped,
+        # backslash doubled, newline as literal \n
+        assert labels and labels[0]["pod"] == 'we\\"ird\\\\pod\\nname'
+    finally:
+        server.close()
+
+
+def test_head_and_404_for_unknown_paths():
+    reg = MetricsRegistry()
+    reg.register("/stats", Gauge("vpp_tpu_x", "x"))
+    server = StatsHTTPServer(reg, port=0)
+    server.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/stats", method="HEAD")
+        resp = urllib.request.urlopen(req, timeout=10)
+        assert resp.status == 200
+        assert int(resp.headers["Content-Length"]) > 0
+        assert resp.read() == b""
+        for method in ("GET", "HEAD"):
+            try:
+                urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{server.port}/nope", method=method),
+                    timeout=10)
+                raise AssertionError("expected 404")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+    finally:
+        server.close()
+
+
+def test_metrics_lint_clean():
+    """The tier-1 hook for the tools/lint.py metrics pass: every family
+    the deployed processes register must satisfy the hygiene rules."""
+    import importlib.util
+
+    lint_path = Path(__file__).resolve().parent.parent / "tools" / "lint.py"
+    spec = importlib.util.spec_from_file_location("vpp_tpu_lint", lint_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.metrics_lint() == []
+
+
+def test_metrics_lint_catches_violations():
+    reg = MetricsRegistry()
+    reg.register("/a", Gauge("vpp_tpu_ok", "fine"))
+    reg.register("/a", Gauge("not_namespaced", "x"))
+    reg.register("/a", Gauge("vpp_tpu_no_help"))
+    reg.register("/b", Gauge("vpp_tpu_ok", "duplicate across paths"))
+    problems = reg.lint()
+    assert any("not_namespaced" in p for p in problems)
+    assert any("empty help" in p for p in problems)
+    assert any("duplicate" in p and "vpp_tpu_ok" in p for p in problems)

@@ -47,6 +47,38 @@ def wait_for(pred, timeout=5.0, msg="condition"):
 
 
 class TestBasicOps:
+    def test_request_histogram_observes_and_clamps_garbage_ops(
+            self, server, client):
+        """Every served request lands in the op-labelled latency
+        histogram; garbage op values (wrong type included) clamp to
+        "other" and must not tear the connection down."""
+        import json
+        import socket
+
+        client.put("h/k", 1)
+        assert client.get("h/k") == 1
+        assert server.request_hist.get_count(op="put") >= 1
+        assert server.request_hist.get_count(op="get") >= 1
+        # raw frame with an unhashable op: error reply, connection lives
+        s = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+        try:
+            s.sendall(b'{"id": 1, "op": ["get"]}\n')
+            buf = b""
+            while b"\n" not in buf:
+                buf += s.recv(4096)
+            reply = json.loads(buf.split(b"\n", 1)[0])
+            assert reply["ok"] is False
+            # same connection still answers a valid request
+            s.sendall(b'{"id": 2, "op": "ping"}\n')
+            buf = buf.split(b"\n", 1)[1]
+            while b"\n" not in buf:
+                buf += s.recv(4096)
+            reply = json.loads(buf.split(b"\n", 1)[0])
+            assert reply == {"id": 2, "ok": True, "result": "pong"}
+        finally:
+            s.close()
+        assert server.request_hist.get_count(op="other") >= 1
+
     def test_put_get_delete(self, client):
         rev = client.put("a/b", {"x": 1})
         assert rev >= 1

@@ -1,0 +1,216 @@
+"""Control-plane span tracing tests (trace/spans.py; ISSUE 2 tentpole).
+
+Unit level: tracer nesting/context/recording semantics. Agent level:
+the acceptance path — a pod/policy event driven through the full
+KSR → kvstore → agent → render → swap pipeline must observe the
+``vpp_tpu_config_propagation_seconds`` SLO and yield a `show spans`
+timeline with the stages in pipeline order.
+"""
+
+import threading
+
+from vpp_tpu.cli import DebugCLI
+from vpp_tpu.cmd import AgentConfig, ContivAgent
+from vpp_tpu.cmd.ksr_main import KsrAgent
+from vpp_tpu.cni.model import CNIRequest
+from vpp_tpu.ksr import model as m
+from vpp_tpu.kvstore.store import KVStore
+from vpp_tpu.trace import spans
+
+
+# --- tracer unit tests ---
+def test_span_nesting_and_trace_ids():
+    tr = spans.SpanTracer()
+    with tr.span("ksr", "root") as root:
+        assert spans.active()
+        assert spans.current_root() is root
+        with tr.span("kvstore", "child") as child:
+            assert child.trace_id == root.trace_id
+            assert child.parent_id == root.span_id
+            assert spans.current_span() is child
+            assert spans.current_root() is root
+    assert not spans.active()
+    with tr.span("cni", "other") as other:
+        assert other.trace_id != root.trace_id
+        assert other.parent_id is None
+    entries = tr.entries()
+    assert [s.name for s in entries] == ["child", "root", "other"]
+    assert all(s.done for s in entries)
+
+
+def test_span_recorder_is_bounded():
+    tr = spans.SpanTracer(max_spans=8)
+    for i in range(20):
+        with tr.span("agent", f"s{i}"):
+            pass
+    entries = tr.entries()
+    assert len(entries) == 8
+    assert entries[0].name == "s12" and entries[-1].name == "s19"
+
+
+def test_span_context_is_per_thread():
+    tr = spans.SpanTracer()
+    seen = {}
+
+    def worker():
+        seen["active"] = spans.active()
+        with tr.span("agent", "on-thread") as s:
+            seen["parent"] = s.parent_id
+
+    with tr.span("ksr", "main-root"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert seen["active"] is False, "trace context must not leak threads"
+    assert seen["parent"] is None
+
+
+def test_traces_grouping_sorted_by_start():
+    tr = spans.SpanTracer()
+    with tr.span("ksr", "r1"):
+        with tr.span("swap", "inner"):
+            pass
+    traces = tr.traces()
+    assert len(traces) == 1
+    (spans_,) = traces.values()
+    # sorted by start time: root first even though it ENDED last
+    assert [s.stage for s in spans_] == ["ksr", "swap"]
+
+
+def test_format_traces_empty():
+    assert "no spans" in spans.SpanTracer().format_traces()
+
+
+# --- agent-level acceptance ---
+def boot():
+    store = KVStore()
+    ksr = KsrAgent(store=store, serve_http=False)
+    ksr.start()
+    agent = ContivAgent(
+        AgentConfig(node_name="span-node", serve_http=False), store=store
+    )
+    agent.start()
+    return store, ksr, agent
+
+
+def add_pod(agent, cid, name, ns="default"):
+    reply = agent.cni_server.add(CNIRequest(
+        container_id=cid,
+        extra_args={"K8S_POD_NAME": name, "K8S_POD_NAMESPACE": ns},
+    ))
+    assert reply.result == 0
+    return reply.interfaces[0].ip_addresses[0].address.split("/")[0]
+
+
+def test_config_propagation_e2e_spans_and_slo():
+    """Drive a pod + policy event through the full pipeline: the
+    propagation histogram must observe it and `show spans` must show
+    the KSR, kvstore, render and swap stages in pipeline order."""
+    store, ksr, agent = boot()
+    ip_web = add_pod(agent, "c-web", "web")
+    ip_db = add_pod(agent, "c-db", "db")
+
+    prop = agent.cp_metrics["config_propagation"]
+    cni_count = prop.get_count(source="cni")
+    assert cni_count >= 1, "CNI adds are config events too"
+
+    spans.RECORDER.clear()
+    base = prop.get_count(source="ksr")
+    ksr.sources[m.Pod.TYPE].add("default/web", m.Pod(
+        name="web", namespace="default", labels={"app": "web"},
+        ip_address=ip_web))
+    ksr.sources[m.Pod.TYPE].add("default/db", m.Pod(
+        name="db", namespace="default", labels={"app": "db"},
+        ip_address=ip_db))
+    ksr.sources[m.Policy.TYPE].add("default/db-policy", m.Policy(
+        name="db-policy", namespace="default",
+        pods=m.LabelSelector(match_labels={"app": "db"}),
+        policy_type=m.POLICY_INGRESS,
+        ingress_rules=[m.PolicyRule(
+            ports=[m.PolicyPort(protocol="TCP", port=5432)],
+            peers=[m.PolicyPeer(
+                pods=m.LabelSelector(match_labels={"app": "web"}))],
+        )],
+    ))
+
+    # the SLO observed the KSR-sourced swaps
+    assert prop.get_count(source="ksr") > base
+    assert prop.get_sum(source="ksr") > 0.0
+
+    # a full trace exists with the acceptance stages in pipeline order
+    full = [
+        [s.stage for s in trace_spans]
+        for trace_spans in spans.RECORDER.traces().values()
+    ]
+    want = ["ksr", "kvstore", "render", "swap"]
+    ordered = [
+        [st for st in stages if st in want] for stages in full
+    ]
+    assert want in ordered, f"no trace carries {want} in order: {full}"
+
+    # `show spans` renders the same timeline for the operator
+    cli = DebugCLI(agent.dataplane, stats=agent.stats)
+    out = cli.run("show spans 50")
+    idx = [out.index(f"[{stage}") for stage in want]
+    assert idx == sorted(idx), out
+    assert "epoch" in out
+
+    # the exposition carries the histogram family end to end
+    text = agent.stats.registry.render("/stats")
+    assert "# TYPE vpp_tpu_config_propagation_seconds histogram" in text
+    assert 'vpp_tpu_config_propagation_seconds_count{source="ksr"}' in text
+    agent.close()
+
+
+def test_txn_commit_and_cni_histograms_observe():
+    store, ksr, agent = boot()
+    add_pod(agent, "c1", "p1")
+    assert agent.cp_metrics["cni_request"].get_count(op="add") == 1
+    assert agent.cp_metrics["txn_commit"].get_count() >= 1
+    agent.cni_server.delete(CNIRequest(container_id="c1"))
+    assert agent.cp_metrics["cni_request"].get_count(op="del") == 1
+    agent.close()
+
+
+def test_debug_pages_and_http_surface(tmp_path):
+    """/debug/spans + /debug/txns serve JSON, '/' indexes them, HEAD
+    answers — the agent's debug surface over the stats port."""
+    import json
+    import urllib.request
+
+    store = KVStore()
+    agent = ContivAgent(AgentConfig(
+        node_name="dbg", serve_http=True, stats_port=0, health_port=0,
+        cni_socket=str(tmp_path / "cni.sock"), cli_socket="",
+        txn_journal_path=str(tmp_path / "txn.jsonl"),
+    ), store=store)
+    agent.start()
+    try:
+        add_pod(agent, "c1", "p1")
+        port = agent.stats_http.port
+        index = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/", timeout=10).read().decode()
+        for path in ("/stats", "/debug/spans", "/debug/txns"):
+            assert path in index
+        body = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/spans", timeout=10
+        ).read().decode())
+        stages = {s["stage"] for t in body["traces"] for s in t["spans"]}
+        assert "swap" in stages and "cni" in stages
+        txns = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/txns", timeout=10
+        ).read().decode())
+        assert txns["torn_lines"] == 0
+        assert txns["shown"] == len(txns["txns"]) >= 2
+        assert any(t["label"] == "cni-add default/p1" for t in txns["txns"])
+        traced = [t for t in txns["txns"] if t["stage_seconds"]]
+        assert traced, "journal entries join their span timings by epoch"
+        assert "swap" in traced[-1]["stage_seconds"]
+        # HEAD answers on debug pages too
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/debug/txns", method="HEAD")
+        resp = urllib.request.urlopen(req, timeout=10)
+        assert resp.status == 200
+        assert int(resp.headers["Content-Length"]) > 0
+    finally:
+        agent.close()

@@ -102,6 +102,103 @@ def test_unknown_op_rejected():
         ConfigTxn()._record("format_disk")
 
 
+def test_torn_trailing_journal_line_tolerated(tmp_path):
+    """Crash mid-append (kill between write() and the page hitting
+    disk) leaves a truncated trailing JSONL line: load()/replay() must
+    tolerate it — counting it in ``torn_lines`` — instead of raising,
+    or a single unclean shutdown would brick config recovery."""
+    import json
+
+    path = str(tmp_path / "torn.jsonl")
+    dp = Dataplane(DataplaneConfig())
+    journal = TxnJournal(path)
+    apply_txn(dp, make_txn(), journal)
+    txn2 = ConfigTxn(label="second").add_route(
+        "10.3.0.0/16", 2, Disposition.REMOTE)
+    apply_txn(dp, txn2, journal)
+    # simulate the torn append: truncate the last line mid-JSON
+    with open(path) as f:
+        raw = f.read()
+    torn = raw.rstrip("\n")[:-17] + "\n"
+    with open(path, "w") as f:
+        f.write(torn)
+
+    reloaded = TxnJournal(path)
+    txns = reloaded.load()
+    assert [t.label for t in txns] == ["bootstrap"]
+    assert reloaded.torn_lines == 1
+
+    # replay still works, applying only the intact prefix
+    dp2 = Dataplane(DataplaneConfig())
+    replayer = TxnJournal(path)
+    assert replayer.replay(dp2.builder) == 1
+    assert replayer.torn_lines == 1
+    dp2.swap()
+    assert verdicts(dp2) == verdicts_of_first_txn_only(dp)
+
+    # an intact journal reports zero torn lines
+    clean = TxnJournal(path)
+    with open(path, "w") as f:
+        f.write(raw.splitlines()[0] + "\n")
+    clean.load()
+    assert clean.torn_lines == 0
+
+    # `show config-history` surfaces the tolerated torn line
+    from vpp_tpu.cli import DebugCLI
+
+    dp3 = Dataplane(DataplaneConfig())
+    with open(path, "w") as f:
+        f.write(torn)
+    dp3.journal = TxnJournal(path)
+    out = DebugCLI(dp3).run("show config-history")
+    assert "torn trailing line" in out
+    assert "bootstrap" in out
+
+    # mid-file corruption (valid entries AFTER the bad line) is NOT
+    # tolerated: that's real damage, not a crash tail
+    lines = raw.splitlines()
+    with open(path, "w") as f:
+        f.write(lines[0][:-10] + "\n" + lines[1] + "\n")
+    with pytest.raises(json.JSONDecodeError):
+        TxnJournal(path).load()
+
+
+def verdicts_of_first_txn_only(dp_reference):
+    """The expected verdict set after only the bootstrap txn: same as
+    the full journal here because txn2 only adds an unrelated route."""
+    return verdicts(dp_reference)
+
+
+def test_load_tail_entries_is_bounded_and_tolerant(tmp_path):
+    """The /debug/txns serving path: last-N entries from a bounded
+    tail read — a window-cut first line is discarded, a torn trailing
+    line tolerated, and only ``limit`` entries come back."""
+    import json
+
+    path = str(tmp_path / "big.jsonl")
+    with open(path, "w") as f:
+        for i in range(50):
+            f.write(json.dumps({"t": float(i), "epoch": i,
+                                "label": f"txn-{i}", "ops": []}) + "\n")
+    journal = TxnJournal(path)
+    tail = journal.load_tail_entries(5)
+    assert [e["epoch"] for e in tail] == [45, 46, 47, 48, 49]
+    assert journal.torn_lines == 0
+    # a max_bytes window smaller than the file drops the cut first line
+    # but still returns complete trailing entries
+    windowed = journal.load_tail_entries(100, max_bytes=200)
+    assert windowed and [e["epoch"] for e in windowed][-1] == 49
+    assert all(isinstance(e["epoch"], int) for e in windowed)
+    # torn trailing line: tolerated + counted, prefix served
+    with open(path) as f:
+        raw = f.read()
+    with open(path, "w") as f:
+        f.write(raw[:-20])
+    tail = journal.load_tail_entries(5)
+    assert journal.torn_lines == 1
+    assert [e["epoch"] for e in tail] == [44, 45, 46, 47, 48]
+
+
 def test_failed_txn_rolls_back_completely(tmp_path):
     """All-or-nothing: a failing op mid-txn must leave no trace — the
     next unrelated commit can never publish a half-applied txn."""

@@ -12,6 +12,14 @@ Checks, per file:
   * mutable default arguments (def f(x=[]) / {} / set())
 
 Exit code 1 if anything fires. Run via `make lint`.
+
+`--metrics` additionally runs the metrics lint: it builds the standard
+Prometheus registries (agent stats collector + control-plane
+histograms, KSR gauges, kvstore request histogram) and validates every
+registered family — name matches ``vpp_tpu_[a-z0-9_]+``, non-empty
+help, no duplicate family names across paths. Importing the dataplane
+pulls jax, so this pass only runs when asked for (tier-1:
+tests/test_exposition.py invokes it).
 """
 
 from __future__ import annotations
@@ -111,7 +119,36 @@ def lint_file(path: Path) -> list:
     return problems
 
 
-def main() -> int:
+def metrics_lint() -> list:
+    """Build every registry the deployed processes serve and validate
+    the registered families (MetricsRegistry.lint). Returns problems."""
+    repo = str(Path(__file__).resolve().parent.parent)
+    if repo not in sys.path:  # direct `python tools/lint.py` invocation
+        sys.path.insert(0, repo)
+    from vpp_tpu.ksr.reflector import ReflectorRegistry
+    from vpp_tpu.kvstore.server import make_request_histogram
+    from vpp_tpu.pipeline.dataplane import Dataplane
+    from vpp_tpu.pipeline.tables import DataplaneConfig
+    from vpp_tpu.stats.collector import (
+        StatsCollector,
+        register_control_plane_metrics,
+        register_ksr_gauges,
+    )
+
+    dp = Dataplane(DataplaneConfig(
+        max_tables=2, max_rules=8, max_global_rules=8, max_ifaces=8,
+        fib_slots=16, sess_slots=64, nat_mappings=2, nat_backends=4))
+    coll = StatsCollector(dp)
+    register_control_plane_metrics(coll.registry)
+    # the KSR and kvserver families live on other processes/paths; fold
+    # them into the same registry so cross-path duplicates are caught
+    register_ksr_gauges(coll.registry, ReflectorRegistry(), path="/metrics")
+    coll.registry.register("/kvstore", make_request_histogram())
+    return coll.registry.lint()
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
     repo = Path(__file__).resolve().parent.parent
     files = []
     for root in ROOTS:
@@ -125,6 +162,8 @@ def main() -> int:
         if "__pycache__" in str(f):
             continue
         all_problems.extend(lint_file(f))
+    if "--metrics" in argv:
+        all_problems.extend(metrics_lint())
     for p in all_problems:
         print(p)
     print(f"lint: {len(files)} files, {len(all_problems)} problems")

@@ -62,6 +62,8 @@ class DebugCLI:
                 return fn()
         if tuple(parts[:2]) == ("show", "config-history"):
             return self.show_config_history(parts[2:])
+        if tuple(parts[:2]) == ("show", "spans"):
+            return self.show_spans(parts[2:])
         if tuple(parts[:2]) == ("test", "connectivity"):
             return self.test_connectivity(parts[2:])
         if tuple(parts[:2]) == ("trace", "add"):
@@ -78,37 +80,67 @@ class DebugCLI:
             "show session-rules | show mesh | "
             "show nat44 | show fib | show trace | show errors | "
             "show io | show neighbors | show store | "
-            "show config-history [n] | "
+            "show config-history [n] | show spans [n] | "
             "trace add [n] | trace clear | config replay <journal> | "
             "test connectivity <src> <dst> <tcp|udp|icmp> [dport]"
         )
 
     # --- config transaction trace (api-trace analog) ---
+    def show_spans(self, args: List[str]) -> str:
+        """Control-plane span timelines (trace/spans.py): per applied
+        txn, the KSR → kvstore → agent → render → swap stage timings —
+        the `show trace` analog for the config path."""
+        from vpp_tpu.trace import spans as _spans
+
+        try:
+            limit = int(args[0]) if args else 10
+            if limit <= 0:
+                raise ValueError("count must be positive")
+        except ValueError as e:
+            return f"bad argument: {e}"
+        return _spans.RECORDER.format_traces(limit=limit)
+
     def show_config_history(self, args: List[str]) -> str:
         """Tail of the NB transaction journal the live agent recorded
-        (`api-trace` dump analog): epoch, timestamp, label, op count."""
+        (`api-trace` dump analog): epoch, timestamp, label, op count,
+        and — when the epoch's swap was traced — the per-stage config
+        path timings of the applying transaction."""
         journal = self.dp.journal
         if journal is None:
             return "config journal not enabled (set txn_journal_path)"
         limit = int(args[0]) if args else 20
-        import json
         import os
         import time as _time
 
+        from vpp_tpu.trace import spans as _spans
+
         if not journal.path or not os.path.exists(journal.path):
             return f"{journal.applied} txns applied (no journal file)"
+        entries = journal.load_entries()
+        # epoch -> per-stage seconds of the trace whose swap published it
+        by_epoch = _spans.RECORDER.epoch_timings()
         lines = []
-        with open(journal.path) as f:
-            entries = [json.loads(x) for x in f if x.strip()]
         for e in entries[-limit:]:
             ts = _time.strftime("%H:%M:%S", _time.localtime(e.get("t", 0)))
             label = e.get("label") or "-"
-            lines.append(
+            line = (
                 f"epoch {e.get('epoch'):>5}  {ts}  {len(e.get('ops', [])):>3} "
                 f"ops  {label}"
             )
+            _, stages = by_epoch.get(e.get("epoch"), (None, None))
+            if stages:
+                line += "  [" + " ".join(
+                    f"{stage} {sec * 1e3:.2f}ms"
+                    for stage, sec in sorted(stages.items())
+                ) + "]"
+            lines.append(line)
         lines.append(f"{len(entries)} txns journaled, showing last "
                      f"{min(limit, len(entries))}")
+        if journal.torn_lines:
+            lines.append(
+                f"WARNING: {journal.torn_lines} torn trailing line "
+                f"(crash mid-append) tolerated on load"
+            )
         return "\n".join(lines)
 
     def config_replay(self, args: List[str]) -> str:

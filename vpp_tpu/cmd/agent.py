@@ -41,8 +41,9 @@ from vpp_tpu.policy import PolicyCache, PolicyConfigurator, PolicyProcessor
 from vpp_tpu.renderer.tpu import TpuRenderer
 from vpp_tpu.renderer.vpptcp import VpptcpRenderer
 from vpp_tpu.service import ServiceConfigurator, ServiceProcessor
-from vpp_tpu.stats.collector import StatsCollector
+from vpp_tpu.stats.collector import StatsCollector, register_control_plane_metrics
 from vpp_tpu.stats.prometheus import StatsHTTPServer
+from vpp_tpu.trace import spans
 
 log = logging.getLogger("vpp_tpu.agent")
 
@@ -218,6 +219,12 @@ class ContivAgent:
 
         # --- observability ---
         self.stats = StatsCollector(self.dataplane, self.container_index)
+        # control-plane latency histograms: propagation SLO + txn commit
+        # observed at the epoch swap, CNI add/del at the CNI server
+        self.cp_metrics = register_control_plane_metrics(self.stats.registry)
+        self.dataplane.propagation_hist = self.cp_metrics["config_propagation"]
+        self.dataplane.txn_commit_hist = self.cp_metrics["txn_commit"]
+        self.cni_server.duration_hist = self.cp_metrics["cni_request"]
         self.stats_http: Optional[StatsHTTPServer] = None
         self.health_http: Optional[HealthHTTPServer] = None
 
@@ -452,6 +459,12 @@ class ContivAgent:
             self.stats_http = StatsHTTPServer(
                 self.stats.registry, port=c.stats_port, host=c.http_host
             )
+            # debug surface next to the scrape paths: span timelines and
+            # the txn journal with per-stage timings (both JSON; the
+            # CLI's `show spans` / `show config-history` render the
+            # same data for humans). `/` indexes everything served.
+            self.stats_http.add_page("/debug/spans", self.debug_spans_json)
+            self.stats_http.add_page("/debug/txns", self.debug_txns_json)
             self.stats_http.start()
             self.health_http = HealthHTTPServer(
                 self.statuscheck, port=c.health_port, host=c.http_host
@@ -494,6 +507,46 @@ class ContivAgent:
         with open(tmp, "w") as f:
             _json.dump(plan, f)
         _os.replace(tmp, c.io.plan_path)
+
+    # --- debug pages (served by the stats HTTP server) ---
+    @staticmethod
+    def debug_spans_json() -> str:
+        """/debug/spans: recorded span timelines grouped by trace."""
+        return spans.RECORDER.to_json()
+
+    # /debug/txns tail cap: a long-lived agent's journal grows without
+    # bound; the debug page serves the recent history, not an export
+    DEBUG_TXNS_LIMIT = 200
+
+    def debug_txns_json(self) -> str:
+        """/debug/txns: journal tail (last DEBUG_TXNS_LIMIT entries,
+        bounded tail read — never a full-file parse per scrape) joined
+        with each applied txn's span timeline (per-stage exclusive
+        seconds, keyed by swap epoch)."""
+        import json as _json
+
+        journal = self.dataplane.journal
+        entries = (journal.load_tail_entries(self.DEBUG_TXNS_LIMIT)
+                   if journal is not None else [])
+        by_epoch = spans.RECORDER.epoch_timings()
+        out = []
+        for e in entries:
+            epoch = e.get("epoch")
+            trace_id, stages = by_epoch.get(epoch, (None, None))
+            out.append({
+                "epoch": epoch,
+                "t": e.get("t"),
+                "label": e.get("label", ""),
+                "ops": len(e.get("ops", [])),
+                "trace_id": trace_id,
+                "stage_seconds": stages,
+            })
+        return _json.dumps({
+            "applied": journal.applied if journal is not None else 0,
+            "shown": len(entries),
+            "torn_lines": journal.torn_lines if journal is not None else 0,
+            "txns": out,
+        })
 
     def maintenance_tick(self) -> None:
         """One round of periodic upkeep: age sessions, publish stats,
@@ -567,16 +620,34 @@ class ContivAgent:
             self.store.save()
 
     # --- the kvdbsync watch bridge ---
+    def _traced(self, kind: str, handler):
+        """Wrap a watch handler in an "agent" dispatch span, joining the
+        active config trace (or rooting one for out-of-band events)."""
+        def dispatch(ev: KVEvent) -> None:
+            with spans.RECORDER.span(
+                "agent", f"dispatch {kind} {ev.key}", node=self.config.node_name,
+            ):
+                handler(ev)
+        return dispatch
+
     def _subscribe_watchers(self) -> None:
         sub = self.proxy.watch
+        traced = self._traced
         self._watch_cancels = [
-            sub(KSR_PREFIX + m.key_prefix(m.Pod.TYPE), self._on_pod_event),
-            sub(KSR_PREFIX + m.key_prefix(m.Policy.TYPE), self._on_policy_event),
-            sub(KSR_PREFIX + m.key_prefix(m.Namespace.TYPE), self._on_namespace_event),
-            sub(KSR_PREFIX + m.key_prefix(m.Service.TYPE), self._on_service_event),
-            sub(KSR_PREFIX + m.key_prefix(m.Endpoints.TYPE), self._on_endpoints_event),
-            sub(node_id_mod.ID_PREFIX, self._on_node_event),
-            sub(node_id_mod.LIVENESS_PREFIX, self._on_liveness_event),
+            sub(KSR_PREFIX + m.key_prefix(m.Pod.TYPE),
+                traced("pod", self._on_pod_event)),
+            sub(KSR_PREFIX + m.key_prefix(m.Policy.TYPE),
+                traced("policy", self._on_policy_event)),
+            sub(KSR_PREFIX + m.key_prefix(m.Namespace.TYPE),
+                traced("namespace", self._on_namespace_event)),
+            sub(KSR_PREFIX + m.key_prefix(m.Service.TYPE),
+                traced("service", self._on_service_event)),
+            sub(KSR_PREFIX + m.key_prefix(m.Endpoints.TYPE),
+                traced("endpoints", self._on_endpoints_event)),
+            sub(node_id_mod.ID_PREFIX,
+                traced("node", self._on_node_event)),
+            sub(node_id_mod.LIVENESS_PREFIX,
+                traced("liveness", self._on_liveness_event)),
         ]
 
     def _resync_from_store(self) -> None:

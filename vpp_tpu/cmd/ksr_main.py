@@ -67,6 +67,12 @@ class KsrAgent:
         self.registry.start_all()
         if self._serve_http:
             self.stats_http = StatsHTTPServer(self.metrics, port=self._stats_port)
+            # the KSR leg of config-path span timelines (in a separate
+            # KSR process the trace ends at the store write; in-process
+            # deployments see the full chain here too)
+            from vpp_tpu.trace import spans
+
+            self.stats_http.add_page("/debug/spans", spans.RECORDER.to_json)
             self.stats_http.start()
             self.health_http = HealthHTTPServer(
                 self.statuscheck, port=self._health_port

@@ -50,6 +50,11 @@ def main(argv=None) -> int:
                         help="witness lease ttl: primary renews every "
                              "ttl/6, self-demotes after 0.7*ttl unproven; "
                              "a standby claim is grantable after ttl")
+    parser.add_argument("--stats-port", type=int, default=None,
+                        help="serve Prometheus request-latency metrics "
+                             "(/stats: vpp_tpu_kvstore_request_seconds) "
+                             "on this port (0 = ephemeral; default: "
+                             "disabled)")
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args(argv)
 
@@ -59,6 +64,16 @@ def main(argv=None) -> int:
     )
     server = KVServer(host=args.host, port=args.port,
                       persist_path=args.persist)
+    stats_http = None
+    if args.stats_port is not None:
+        from vpp_tpu.stats.prometheus import MetricsRegistry, StatsHTTPServer
+
+        registry = MetricsRegistry()
+        registry.register("/stats", server.request_hist)
+        stats_http = StatsHTTPServer(registry, port=args.stats_port)
+        stats_http.start()
+        logging.getLogger("kvserver").info(
+            "stats http on :%d/stats", stats_http.port)
     advertise = args.advertise or f"{args.host}:{server.port}"
     if args.witness and args.advertise is None and \
             args.host in ("0.0.0.0", "::"):
@@ -100,6 +115,8 @@ def main(argv=None) -> int:
     stop.wait()
     if ha is not None:
         ha.stop()
+    if stats_http is not None:
+        stats_http.close()
     server.close()
     return 0
 

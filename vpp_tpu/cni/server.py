@@ -18,6 +18,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import threading
+import time
 from typing import Callable, Optional
 
 from vpp_tpu.cni.containeridx import ContainerConfig, ContainerIndex
@@ -32,6 +33,7 @@ from vpp_tpu.cni.model import (
 from vpp_tpu.ipam.ipam import IPAM
 from vpp_tpu.pipeline.dataplane import Dataplane
 from vpp_tpu.pipeline.vector import Disposition
+from vpp_tpu.trace import spans
 
 log = logging.getLogger("vpp_tpu.cni")
 
@@ -58,6 +60,10 @@ class RemoteCNIServer:
         # path and attaches it to the IO daemon. None = config-only mode
         # (unit tests, cluster simulations without CAP_NET_ADMIN).
         self.wirer = wirer
+        # Optional Prometheus Histogram (vpp_tpu_cni_request_seconds,
+        # labelled op="add"|"del"): Add/Delete handling duration —
+        # kubelet's sandbox-setup latency budget is this number
+        self.duration_hist = None
 
     # --- lifecycle ---
     def set_ready(self) -> None:
@@ -124,6 +130,23 @@ class RemoteCNIServer:
 
     # --- CNI protocol ---
     def add(self, req: CNIRequest) -> CNIReply:
+        """Wire a pod. Root span ("cni"): a CNI Add is an NB config
+        event, so its epoch swap observes the propagation SLO with
+        source="cni"; the duration histogram feeds kubelet's
+        sandbox-setup latency budget."""
+        t0 = time.perf_counter()
+        with spans.RECORDER.span(
+            "cni", f"cni-add {req.pod_namespace}/{req.pod_name}",
+            container=req.container_id,
+        ):
+            try:
+                return self._add(req)
+            finally:
+                if self.duration_hist is not None:
+                    self.duration_hist.observe(
+                        time.perf_counter() - t0, op="add")
+
+    def _add(self, req: CNIRequest) -> CNIReply:
         with self._lock:
             if not self._ready:
                 return CNIReply(
@@ -215,6 +238,18 @@ class RemoteCNIServer:
         return self._reply_for(cfg)
 
     def delete(self, req: CNIRequest) -> CNIReply:
+        t0 = time.perf_counter()
+        with spans.RECORDER.span(
+            "cni", f"cni-del {req.container_id}",
+        ):
+            try:
+                return self._delete(req)
+            finally:
+                if self.duration_hist is not None:
+                    self.duration_hist.observe(
+                        time.perf_counter() - t0, op="del")
+
+    def _delete(self, req: CNIRequest) -> CNIReply:
         with self._lock:
             cfg = self.index.unregister(req.container_id)
             if cfg is None:

@@ -122,6 +122,10 @@ class ClusterPump:
                       "t_fetch_wait": 0.0, "t_fetch": 0.0}
         self._step_lat = collections.deque(maxlen=2048)
         self._lat_lock = threading.Lock()
+        # optional Prometheus Histogram (stats/collector.py set_pump):
+        # same per-batch observation contract as DataplanePump, so
+        # vpp_tpu_pump_batch_seconds carries data on mesh nodes too
+        self.latency_hist = None
         # frames peeked by dispatch but not yet released by the writer,
         # per ring (releases shift pending peek indices, so both sides
         # mutate under the lock — the single-node pump's held protocol)
@@ -498,8 +502,11 @@ class ClusterPump:
         # an exception anywhere above leaves all releases to the
         # writer loop's _release_item (no double release possible)
         self._release_frames(offs)
+        lat = time.perf_counter() - t0
         with self._lat_lock:
-            self._step_lat.append(time.perf_counter() - t0)
+            self._step_lat.append(lat)
+        if self.latency_hist is not None:
+            self.latency_hist.observe(lat)
 
     def _queue_errors(self, node: int, cols, payload, n: int,
                       causes: np.ndarray) -> None:

@@ -223,6 +223,11 @@ class DataplanePump:
         # load/add/store that interleaves across fetch workers.
         self.batch_lat = collections.deque(maxlen=lat_window)
         self._lat_lock = threading.Lock()
+        # optional Prometheus Histogram (stats/collector.py set_pump):
+        # every batch latency is observed as a real distribution —
+        # histogram_quantile() aggregates across nodes where the
+        # p50/p99 window gauges cannot
+        self.latency_hist = None
         self._inflight: "queue.Queue" = queue.Queue(
             maxsize=self.max_inflight)
         # live fetch workers (under _lat_lock): the tx writer's
@@ -837,8 +842,11 @@ class DataplanePump:
                 self._write_packed_group(batch, groups[0], host_if,
                                          epoch, icmp_on)
             self.stats["t_write"] += time.perf_counter() - tw0
+            lat = time.perf_counter() - t0
             with self._lat_lock:
-                self.batch_lat.append(time.perf_counter() - t0)
+                self.batch_lat.append(lat)
+            if self.latency_hist is not None:
+                self.latency_hist.observe(lat)
         elif batch is not None:
             # tracing path: full column dict from the unpacked step
             # (the tracer never chains, so there is exactly one group)
@@ -887,8 +895,11 @@ class DataplanePump:
                 else:
                     self.stats["tx_ring_full"] += 1
                 off += n
+            lat = time.perf_counter() - t0
             with self._lat_lock:
-                self.batch_lat.append(time.perf_counter() - t0)
+                self.batch_lat.append(lat)
+            if self.latency_hist is not None:
+                self.latency_hist.observe(lat)
         with self._held_lock:
             for g in groups:
                 for _ in g:

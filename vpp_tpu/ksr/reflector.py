@@ -22,6 +22,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from vpp_tpu.ksr import model
 from vpp_tpu.kvstore.store import Broker
+from vpp_tpu.trace import spans
 
 # Retry backoff bounds for resync attempts, in seconds
 # (reference uses 100→1000 ms, ksr_reflector.go:35-38).
@@ -146,7 +147,15 @@ class Reflector:
             self.resync(max_attempts=1)
             return
         with self._lock:
-            self.broker.put(self._key_of(m), m.to_dict())
+            # root span: this reflector event's wall-clock start is the
+            # event timestamp the config-propagation SLO measures from;
+            # the store's synchronous watch fan-out parents every
+            # downstream stage (kvstore → agent → render → swap) to it
+            with spans.RECORDER.span(
+                "ksr", f"reflector add {self._key_of(m)}",
+                obj_type=self.obj_type,
+            ):
+                self.broker.put(self._key_of(m), m.to_dict())
             self.stats.adds += 1
 
     def _on_update(self, old: Any, new: Any) -> None:
@@ -166,7 +175,11 @@ class Reflector:
         with self._lock:
             prev = self.broker.get(self._key_of(m))
             if prev != m.to_dict():
-                self.broker.put(self._key_of(m), m.to_dict())
+                with spans.RECORDER.span(
+                    "ksr", f"reflector update {self._key_of(m)}",
+                    obj_type=self.obj_type,
+                ):
+                    self.broker.put(self._key_of(m), m.to_dict())
                 self.stats.updates += 1
 
     def _on_delete(self, obj: Any) -> None:
@@ -184,7 +197,11 @@ class Reflector:
             self.resync(max_attempts=1)
             return
         with self._lock:
-            self.broker.delete(self._key_of(m))
+            with spans.RECORDER.span(
+                "ksr", f"reflector delete {self._key_of(m)}",
+                obj_type=self.obj_type,
+            ):
+                self.broker.delete(self._key_of(m))
             self.stats.deletes += 1
 
     # --- resync (mark-and-sweep) ---
@@ -222,10 +239,16 @@ class Reflector:
             key = self._key_of(m)
             want = m.to_dict()
             if store_items.pop(key, None) != want:
-                self.broker.put(key, want)
+                with spans.RECORDER.span(
+                    "ksr", f"resync put {key}", obj_type=self.obj_type,
+                ):
+                    self.broker.put(key, want)
                 self.stats.updates += 1
         for key in store_items:
-            self.broker.delete(key)
+            with spans.RECORDER.span(
+                "ksr", f"resync sweep {key}", obj_type=self.obj_type,
+            ):
+                self.broker.delete(key)
             self.stats.deletes += 1
 
 

@@ -19,6 +19,8 @@ import threading
 import time as _time
 from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
 
+from vpp_tpu.trace import spans
+
 
 class Op(enum.Enum):
     PUT = "put"
@@ -192,9 +194,19 @@ class KVStore:
 
     def _notify(self, ev: KVEvent) -> None:
         # Called with the lock held; copy so callbacks may (un)subscribe.
+        # Watch delivery joins the active config trace (span stage
+        # "kvstore") so an applied txn's timeline shows the store hop;
+        # un-traced traffic pays only the active() thread-local check.
+        traced = spans.active()
         for prefix, cb in list(self._watchers):
             if ev.key.startswith(prefix):
-                cb(ev)
+                if traced:
+                    with spans.RECORDER.span(
+                        "kvstore", f"deliver {ev.key}", op=ev.op.value,
+                    ):
+                        cb(ev)
+                else:
+                    cb(ev)
 
     # --- leases (node-liveness TTL keys; etcd lease analog) ---
     def _attach_lease(self, key: str, lease: Optional[int]) -> None:

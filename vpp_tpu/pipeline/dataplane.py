@@ -31,6 +31,7 @@ from vpp_tpu.pipeline.tables import (
 )
 from vpp_tpu.ops.vxlan import vxlan_encap
 from vpp_tpu.pipeline.vector import Disposition, PacketVector
+from vpp_tpu.trace import spans
 
 
 def _packed_call(step):
@@ -270,6 +271,13 @@ class Dataplane:
         # statscollector zeroes its accumulators so a later pod reusing
         # the slot doesn't inherit counters)
         self.on_if_freed = []
+        # optional Prometheus histograms (stats/collector.py
+        # register_control_plane_metrics): txn_commit_hist observes
+        # every swap's publish duration; propagation_hist observes the
+        # config-propagation SLO (config event wall-clock → epoch-swap
+        # complete) whenever a swap publishes under an active span trace
+        self.txn_commit_hist = None
+        self.propagation_hist = None
 
     # --- interfaces ---
     def add_uplink(self) -> int:
@@ -361,27 +369,54 @@ class Dataplane:
         ClusterDataplane (set via ``_swap_delegate``), so renderers and
         the CNI server drive cluster nodes unchanged."""
         delegate = getattr(self, "_swap_delegate", None)
-        if delegate is not None:
-            return delegate()
-        with self._lock:
-            if self.tables is None:
-                raise RuntimeError(
-                    "this Dataplane has no live tables and no swap "
-                    "delegate (materialize=False without a managing "
-                    "ClusterDataplane)"
-                )
-            self.tables = self.builder.to_device(sessions=self.tables)
-            self._use_mxu = (
-                self.builder.mxu_enabled
-                and self.builder.glb_mxu.ok
-                and self.builder.glb_nrules >= self.mxu_threshold
+        span = spans.RECORDER.begin("swap", "epoch-swap")
+        try:
+            if delegate is not None:
+                # cluster-node staging handle: the owning
+                # ClusterDataplane publishes the multi-chip epoch; the
+                # span + histograms still record THIS commit's cost and
+                # propagation as the caller experienced it
+                epoch = delegate()
+                span.attrs["epoch"] = epoch
+                span.name = f"epoch {epoch} (cluster)"
+            else:
+                with self._lock:
+                    if self.tables is None:
+                        raise RuntimeError(
+                            "this Dataplane has no live tables and no "
+                            "swap delegate (materialize=False without a "
+                            "managing ClusterDataplane)"
+                        )
+                    self.tables = self.builder.to_device(
+                        sessions=self.tables)
+                    self._use_mxu = (
+                        self.builder.mxu_enabled
+                        and self.builder.glb_mxu.ok
+                        and self.builder.glb_nrules >= self.mxu_threshold
+                    )
+                    self.epoch += 1
+                    span.attrs["epoch"] = self.epoch
+                    span.name = f"epoch {self.epoch}"
+                    if self.journal is not None:
+                        txn = self.builder.drain_recording()
+                        if txn is not None:
+                            self.journal.record(txn, self.epoch)
+                    epoch = self.epoch
+        finally:
+            # the enclosing trace's root (KSR event, CNI add, ...) holds
+            # the config event timestamp; capture it before this span
+            # pops in case the swap IS the root (then there is no
+            # propagation to measure — a bare swap isn't an NB event)
+            root = spans.current_root()
+            spans.RECORDER.end(span)
+        if self.txn_commit_hist is not None and span.done:
+            self.txn_commit_hist.observe(span.duration)
+        if (self.propagation_hist is not None and root is not None
+                and root is not span):
+            self.propagation_hist.observe(
+                _time.time() - root.t_wall, source=root.stage
             )
-            self.epoch += 1
-            if self.journal is not None:
-                txn = self.builder.drain_recording()
-                if txn is not None:
-                    self.journal.record(txn, self.epoch)
-            return self.epoch
+        return epoch
 
     # --- VXLAN edge (cluster-boundary peers; TPU↔TPU rides ICI instead) ---
     def set_vtep(self, vtep_ip: int) -> None:

@@ -36,6 +36,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 from vpp_tpu.ir.rule import ANY_PORT, Action, ContivRule, Protocol
 from vpp_tpu.pipeline.vector import Disposition
+from vpp_tpu.trace import spans
 
 
 # --- rule (de)serialization ---
@@ -173,6 +174,9 @@ class TxnJournal:
         self.path = path
         self._lock = threading.Lock()
         self.applied = 0
+        # torn trailing lines tolerated by the last load() (crash
+        # mid-append); surfaced by `show config-history`
+        self.torn_lines = 0
 
     def record(self, txn: ConfigTxn, epoch: int) -> None:
         entry = {"t": time.time(), "epoch": epoch, **txn.to_dict()}
@@ -189,16 +193,72 @@ class TxnJournal:
                 f.flush()
                 os.fsync(f.fileno())
 
-    def load(self) -> List[ConfigTxn]:
+    def load_entries(self) -> List[Dict[str, Any]]:
+        """Raw journal entries (t/epoch/label/ops dicts) in file order.
+
+        A torn TRAILING line — the crash-mid-append case: record()
+        appends then fsyncs, so a kill between write() and the page
+        hitting disk can leave a truncated last line — is tolerated and
+        counted in ``torn_lines`` instead of raising. A malformed line
+        with valid entries AFTER it is real corruption and still
+        raises: silently skipping it would replay a history the live
+        dataplane never enforced."""
+        self.torn_lines = 0
         if not self.path or not os.path.exists(self.path):
             return []
-        out = []
         with open(self.path) as f:
-            for line in f:
-                line = line.strip()
-                if line:
-                    out.append(ConfigTxn.from_dict(json.loads(line)))
+            lines = [(i, ln.strip()) for i, ln in enumerate(f, 1)]
+        lines = [(i, ln) for i, ln in lines if ln]
+        out: List[Dict[str, Any]] = []
+        for pos, (lineno, line) in enumerate(lines):
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                if pos == len(lines) - 1:
+                    self.torn_lines = 1
+                    break
+                raise json.JSONDecodeError(
+                    f"corrupt journal line {lineno} (not the trailing "
+                    f"line — refusing to skip mid-history)", line, 0)
         return out
+
+    def load(self) -> List[ConfigTxn]:
+        return [ConfigTxn.from_dict(d) for d in self.load_entries()]
+
+    def load_tail_entries(self, limit: int,
+                          max_bytes: int = 1 << 20) -> List[Dict[str, Any]]:
+        """The last ``limit`` raw entries, reading at most ``max_bytes``
+        from the file END — the /debug/txns serving path must stay
+        O(limit) however large a long-lived agent's journal grows.
+        Torn-trailing-line tolerance matches load_entries(); a line cut
+        at the seek boundary is discarded (it has complete entries
+        after it, so it is a window artifact, not corruption)."""
+        self.torn_lines = 0
+        if not self.path or not os.path.exists(self.path):
+            return []
+        with open(self.path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            start = max(0, size - max_bytes)
+            f.seek(start)
+            data = f.read().decode(errors="replace")
+        lines = data.splitlines()
+        if start > 0 and lines:
+            lines = lines[1:]  # first line may start mid-entry
+        lines = [ln.strip() for ln in lines if ln.strip()]
+        out: List[Dict[str, Any]] = []
+        for pos, line in enumerate(lines):
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                if pos == len(lines) - 1:
+                    self.torn_lines = 1
+                    break
+                raise json.JSONDecodeError(
+                    "corrupt journal line in tail window (not the "
+                    "trailing line — refusing to skip mid-history)",
+                    line, 0)
+        return out[-limit:]
 
     def replay(self, builder) -> int:
         """Re-stage every journaled txn in order onto ``builder``;
@@ -220,18 +280,28 @@ def apply_txn(dataplane, txn: ConfigTxn,
     the builder back to its pre-txn snapshot, so the next unrelated
     commit can never publish a half-applied transaction. Journaling
     happens INSIDE the commit lock — entries land in epoch order, so a
-    replay reconstructs exactly the history the live dataplane enforced."""
-    with dataplane.commit_lock:
-        snap = dataplane.builder.state_snapshot()
-        try:
-            txn.apply_to_builder(dataplane.builder)
-        except Exception:
-            dataplane.builder.state_restore(snap)
-            raise
-        epoch = dataplane.swap()
-        # a dataplane with its own journal + recording already recorded
-        # this txn during swap(); only record here when the caller's
-        # journal is a different one (or the dataplane has none)
-        if journal is not None and journal is not dataplane.journal:
-            journal.record(txn, epoch)
+    replay reconstructs exactly the history the live dataplane enforced.
+
+    The whole stage+swap commit runs under a "txn" span, so an applied
+    txn's timeline attributes staging separately from the epoch swap
+    (the swap opens its own child span and feeds the
+    ``vpp_tpu_txn_commit_seconds`` histogram)."""
+    with spans.RECORDER.span(
+        "txn", f"apply-txn {txn.label or '(unlabelled)'}",
+        ops=len(txn.ops),
+    ):
+        with dataplane.commit_lock:
+            snap = dataplane.builder.state_snapshot()
+            try:
+                txn.apply_to_builder(dataplane.builder)
+            except Exception:
+                dataplane.builder.state_restore(snap)
+                raise
+            epoch = dataplane.swap()
+            # a dataplane with its own journal + recording already
+            # recorded this txn during swap(); only record here when the
+            # caller's journal is a different one (or the dataplane has
+            # none)
+            if journal is not None and journal is not dataplane.journal:
+                journal.record(txn, epoch)
     return epoch

@@ -29,6 +29,7 @@ from vpp_tpu.ir.rule import (
 from vpp_tpu.policy.cache import PolicyCache
 from vpp_tpu.policy.config import ContivPolicy, MatchType, PolicyType
 from vpp_tpu.renderer.api import PolicyRendererAPI
+from vpp_tpu.trace import spans
 
 
 def subtract_subnet(subnet: IPNetwork, excluded: IPNetwork) -> List[IPNetwork]:
@@ -82,6 +83,17 @@ class PolicyConfiguratorTxn:
         return self
 
     def commit(self) -> None:
+        # "render" span: rule expansion + every renderer commit (incl.
+        # the epoch swap the TPU renderer publishes) — the per-stage
+        # attribution of the policy path in an applied txn's timeline
+        with spans.RECORDER.span(
+            "render",
+            "policy-resync" if self.resync else "policy-render",
+            pods=len(self.config),
+        ):
+            self._commit_traced()
+
+    def _commit_traced(self) -> None:
         cfg = self.configurator
         processed: List[Tuple[List[ContivPolicy], List[ContivRule], List[ContivRule]]] = []
         renderer_txns = [r.new_txn(self.resync) for r in cfg.renderers]

@@ -26,6 +26,7 @@ from typing import Dict, List, Optional, Tuple
 from vpp_tpu.pipeline.dataplane import Dataplane
 from vpp_tpu.pipeline.vector import ip4
 from vpp_tpu.service.config import Backend, ContivService, TrafficPolicy
+from vpp_tpu.trace import spans
 
 # Local backends get twice the share of hash space (reference
 # configurator_impl.go localEndpointWeight).
@@ -74,8 +75,13 @@ class ServiceConfigurator:
 
     # --- rendering ---
     def _rebuild(self) -> None:
-        with self.dataplane.commit_lock:
-            self._rebuild_locked()
+        # "render" span: NAT table rebuild + its epoch swap, the service
+        # path's leg of an applied txn's timeline
+        with spans.RECORDER.span(
+            "render", "service-nat-rebuild", services=len(self.services),
+        ):
+            with self.dataplane.commit_lock:
+                self._rebuild_locked()
 
     def _rebuild_locked(self) -> None:
         dp = self.dataplane

@@ -23,9 +23,15 @@ import numpy as np
 from vpp_tpu.cni.containeridx import ContainerIndex
 from vpp_tpu.pipeline.dataplane import Dataplane
 from vpp_tpu.pipeline.graph import StepStats
-from vpp_tpu.stats.prometheus import Gauge, MetricsRegistry
+from vpp_tpu.stats.prometheus import Gauge, Histogram, MetricsRegistry
 
 STATS_PATH = "/stats"
+
+# pump batch latencies live in the sub-millisecond..100ms regime
+PUMP_LATENCY_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+    0.025, 0.05, 0.1, 0.25, 1.0,
+)
 
 PER_IF_GAUGES = (
     ("vpp_tpu_if_in_packets", "packets received on the interface"),
@@ -157,6 +163,18 @@ class StatsCollector:
             name: self.registry.register(STATS_PATH, Gauge(name, help_))
             for name, help_ in PUMP_GAUGES
         }
+        # the real distribution behind the p50/p99 gauges (kept for
+        # compatibility): the pump observes every batch's dispatch→tx
+        # latency directly, so histogram_quantile() aggregates across
+        # nodes where a pre-computed quantile gauge cannot
+        self.pump_batch_hist = self.registry.register(
+            STATS_PATH,
+            Histogram(
+                "vpp_tpu_pump_batch_seconds",
+                "dispatch-to-tx batch latency of the IO pump",
+                buckets=PUMP_LATENCY_BUCKETS,
+            ),
+        )
         # one labelled counter family for the per-stage cumulative
         # seconds: stage="pack|dispatch|fetch_wait|fetch|write" — a
         # counter so rate() yields per-second stage occupancy, which
@@ -182,8 +200,13 @@ class StatsCollector:
 
     def set_pump(self, pump) -> None:
         """Attach the IO pump (DataplanePump or the mesh ClusterPump —
-        same stats contract) so publish() exports its counters."""
+        same stats contract) so publish() exports its counters, and
+        point its per-batch latency observer at our histogram."""
         self.pump = pump
+        try:
+            pump.latency_hist = self.pump_batch_hist
+        except AttributeError:
+            pass  # exotic pump stand-ins (slotted fakes) keep gauges only
 
     def set_vcl(self, server) -> None:
         """Attach the VclAdmissionServer so publish() exports its
@@ -293,9 +316,12 @@ class StatsCollector:
             ps = pump.stats
             for stat_key, gauge_name, _ in PUMP_STAT_GAUGES:
                 self.pump_gauges[gauge_name].set(int(ps.get(stat_key, 0)))
+            # full precision: rounding to 6 decimals quantized rate()
+            # over short scrape windows (a 1 s window sees deltas well
+            # below 1 µs per stage at light load)
             for stat_key, stage in PUMP_STAGE_SECONDS:
                 self.pump_stage_gauge.set(
-                    round(float(ps.get(stat_key, 0.0)), 6), stage=stage)
+                    float(ps.get(stat_key, 0.0)), stage=stage)
             lat = pump.latency_us()
             self.pump_gauges["vpp_tpu_pump_batch_latency_p50_us"].set(
                 lat["p50"])
@@ -308,6 +334,43 @@ class StatsCollector:
                         "accept_checks", "accept_denies", "clients"):
                 self.vcl_gauges[f"vpp_tpu_vcl_{key}"].set(
                     int(vs.get(key, 0)))
+
+
+def register_control_plane_metrics(
+    registry: MetricsRegistry, path: str = STATS_PATH
+) -> Dict[str, Histogram]:
+    """The control-plane latency histogram families (ISSUE 2 tentpole):
+
+    * ``vpp_tpu_config_propagation_seconds`` — the config-propagation
+      SLO: K8s/CNI event wall-clock → epoch-swap complete, labelled by
+      the originating stage (``source="ksr"|"cni"|..."``). Observed by
+      ``Dataplane.swap()`` whenever a swap publishes under an active
+      span trace (trace/spans.py).
+    * ``vpp_tpu_txn_commit_seconds`` — every epoch swap's publish
+      duration (stage + device upload + journal record).
+    * ``vpp_tpu_cni_request_seconds`` — CNI Add/Delete handling,
+      labelled ``op="add"|"del"``.
+
+    Returns the histograms keyed by short name; the agent attaches them
+    to the dataplane / CNI server."""
+    hists = {
+        "config_propagation": Histogram(
+            "vpp_tpu_config_propagation_seconds",
+            "config propagation latency: NB event to epoch-swap "
+            "complete, labelled by originating stage",
+        ),
+        "txn_commit": Histogram(
+            "vpp_tpu_txn_commit_seconds",
+            "config transaction commit (epoch swap publish) duration",
+        ),
+        "cni_request": Histogram(
+            "vpp_tpu_cni_request_seconds",
+            "CNI request handling duration by op (add/del)",
+        ),
+    }
+    for h in hists.values():
+        registry.register(path, h)
+    return hists
 
 
 def register_ksr_gauges(

@@ -2,17 +2,24 @@
 
 Reference analog: cn-infra's prometheus plugin serving the
 statscollector registry at :9999 (docs/Prometheus.md:1-26). No external
-client library: gauges render to text format 0.0.4 directly.
+client library: gauges and histograms render to text format 0.0.4
+directly.
 """
 
 from __future__ import annotations
 
+import bisect
 import http.server
+import re
 import threading
 import urllib.parse
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 LabelSet = Tuple[Tuple[str, str], ...]
+
+# every exported family must carry the project prefix (tools/lint.py
+# metrics pass; the reference's contiv_* namespace discipline)
+METRIC_NAME_RE = re.compile(r"^vpp_tpu_[a-z0-9_]+$")
 
 
 def _labels_key(labels: Dict[str, str]) -> LabelSet:
@@ -21,6 +28,17 @@ def _labels_key(labels: Dict[str, str]) -> LabelSet:
 
 def _escape(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    # 0.0.4 HELP escaping: backslash and newline only (no quotes)
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt_value(value: float) -> str:
+    """Exact sample formatting: ':g' would round counters >1e6 (byte
+    counters get there in ~1000 packets)."""
+    return str(int(value)) if float(value).is_integer() else repr(float(value))
 
 
 class Gauge:
@@ -61,14 +79,12 @@ class Gauge:
     def render(self) -> List[str]:
         out = []
         if self.help:
-            out.append(f"# HELP {self.name} {self.help}")
+            out.append(f"# HELP {self.name} {_escape_help(self.help)}")
         out.append(f"# TYPE {self.name} {self.kind}")
         with self._lock:
             items = sorted(self._values.items())
         for labels, value in items:
-            # exact formatting: ':g' would round counters >1e6 (byte
-            # counters get there in ~1000 packets)
-            sval = str(int(value)) if float(value).is_integer() else repr(float(value))
+            sval = _fmt_value(value)
             if labels:
                 lbl = ",".join(f'{k}="{_escape(v)}"' for k, v in labels)
                 out.append(f"{self.name}{{{lbl}}} {sval}")
@@ -77,14 +93,98 @@ class Gauge:
         return out
 
 
-class MetricsRegistry:
-    """Named path-scoped registries (the cn-infra ':9999/<path>' model)."""
+class Histogram:
+    """One histogram family: configurable cumulative ``le`` buckets,
+    thread-safe ``observe()``, text-format 0.0.4 ``_bucket``/``_sum``/
+    ``_count`` exposition — the distribution type the p50/p99 gauges
+    could never be (PromQL histogram_quantile() aggregates these across
+    nodes; a pre-computed quantile gauge cannot be aggregated).
 
-    def __init__(self):
-        self._gauges: Dict[str, List[Gauge]] = {}
+    Bucket bounds are upper-inclusive seconds (or any unit) WITHOUT the
+    implicit ``+Inf`` bucket, which is always appended on exposition.
+    """
+
+    # latency-shaped default: 500 µs .. 10 s (config-path operations)
+    DEFAULT_BUCKETS = (
+        0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+        0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    )
+
+    def __init__(self, name: str, help_text: str = "",
+                 buckets: Optional[Tuple[float, ...]] = None):
+        self.name = name
+        self.help = help_text
+        self.kind = "histogram"
+        bounds = tuple(float(b) for b in (buckets or self.DEFAULT_BUCKETS))
+        if not bounds or any(b2 <= b1 for b1, b2 in zip(bounds, bounds[1:])):
+            raise ValueError("buckets must be strictly ascending and non-empty")
+        if any(b != b or b in (float("inf"), float("-inf")) for b in bounds):
+            raise ValueError("buckets must be finite (+Inf is implicit)")
+        self.buckets = bounds
+        # per label set: per-bucket counts (len(buckets)+1, last = +Inf
+        # overflow) + running sum
+        self._counts: Dict[LabelSet, List[int]] = {}
+        self._sums: Dict[LabelSet, float] = {}
         self._lock = threading.Lock()
 
-    def register(self, path: str, gauge: Gauge) -> Gauge:
+    def observe(self, value: float, **labels: str) -> None:
+        value = float(value)
+        idx = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            k = _labels_key(labels)
+            counts = self._counts.get(k)
+            if counts is None:
+                counts = self._counts[k] = [0] * (len(self.buckets) + 1)
+                self._sums[k] = 0.0
+            counts[idx] += 1
+            self._sums[k] += value
+
+    def get_count(self, **labels: str) -> int:
+        with self._lock:
+            return sum(self._counts.get(_labels_key(labels), ()))
+
+    def get_sum(self, **labels: str) -> float:
+        with self._lock:
+            return self._sums.get(_labels_key(labels), 0.0)
+
+    def render(self) -> List[str]:
+        out = []
+        if self.help:
+            out.append(f"# HELP {self.name} {_escape_help(self.help)}")
+        out.append(f"# TYPE {self.name} histogram")
+        with self._lock:
+            items = sorted(
+                (k, list(v), self._sums[k]) for k, v in self._counts.items()
+            )
+        for labels, counts, total_sum in items:
+            lbl = ",".join(f'{k}="{_escape(v)}"' for k, v in labels)
+            prefix = f"{lbl}," if lbl else ""
+            cum = 0
+            for bound, c in zip(self.buckets, counts):
+                cum += c
+                out.append(
+                    f'{self.name}_bucket{{{prefix}le="{_fmt_value(bound)}"}} '
+                    f"{cum}"
+                )
+            cum += counts[-1]
+            out.append(f'{self.name}_bucket{{{prefix}le="+Inf"}} {cum}')
+            series = f"{{{lbl}}}" if lbl else ""
+            out.append(f"{self.name}_sum{series} {_fmt_value(total_sum)}")
+            out.append(f"{self.name}_count{series} {cum}")
+        return out
+
+
+class MetricsRegistry:
+    """Named path-scoped registries (the cn-infra ':9999/<path>' model).
+
+    Holds any family object exposing ``name``/``help``/``render()``
+    (Gauge, Histogram)."""
+
+    def __init__(self):
+        self._gauges: Dict[str, List] = {}
+        self._lock = threading.Lock()
+
+    def register(self, path: str, gauge):
         with self._lock:
             self._gauges.setdefault(path, []).append(gauge)
         return gauge
@@ -92,6 +192,36 @@ class MetricsRegistry:
     def paths(self) -> List[str]:
         with self._lock:
             return list(self._gauges)
+
+    def families(self) -> List[Tuple[str, object]]:
+        """Every registered (path, family) pair — lint/index surface."""
+        with self._lock:
+            return [(p, g) for p, gs in self._gauges.items() for g in gs]
+
+    def lint(self) -> List[str]:
+        """Registry-level metrics lint (tools/lint.py --metrics): every
+        family name matches the project namespace, carries non-empty
+        help, and no family name is registered twice (within or across
+        paths — duplicate names scrape as conflicting series)."""
+        problems: List[str] = []
+        seen: Dict[str, str] = {}
+        for path, fam in self.families():
+            name = getattr(fam, "name", "")
+            if not METRIC_NAME_RE.match(name):
+                problems.append(
+                    f"{path}: metric name {name!r} does not match "
+                    f"{METRIC_NAME_RE.pattern}"
+                )
+            if not getattr(fam, "help", ""):
+                problems.append(f"{path}: metric {name!r} has empty help text")
+            if name in seen:
+                problems.append(
+                    f"duplicate metric family {name!r} registered at "
+                    f"{seen[name]} and {path}"
+                )
+            else:
+                seen[name] = path
+        return problems
 
     def render(self, path: str) -> Optional[str]:
         with self._lock:
@@ -105,29 +235,60 @@ class MetricsRegistry:
 
 
 class StatsHTTPServer:
-    """Serves every registry path ('/stats', '/metrics', ...) on one port."""
+    """Serves every registry path ('/stats', '/metrics', ...) on one port.
+
+    Beyond the registry paths it serves ``/`` (a text index of every
+    registered path — registry and debug pages alike, so an operator
+    can discover the surface with one curl) and any debug page added
+    via ``add_page()`` (the agent's ``/debug/txns`` / ``/debug/spans``).
+    HEAD is answered for everything GET serves (a probe that HEADs a
+    metrics endpoint must not 501/hang)."""
+
+    PROM_CTYPE = "text/plain; version=0.0.4; charset=utf-8"
 
     def __init__(self, registry: MetricsRegistry, port: int = 9999,
                  host: str = "127.0.0.1"):
         self.registry = registry
+        # path -> (content-type, zero-arg callable returning body str)
+        self._pages: Dict[str, Tuple[str, Callable[[], str]]] = {}
+        self._pages_lock = threading.Lock()
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
-            def do_GET(self):
+            def _resolve(self) -> Optional[Tuple[str, bytes]]:
                 path = urllib.parse.urlsplit(self.path).path
-                body = outer.registry.render(path)
-                if body is None:
+                return outer.resolve(path)
+
+            def _serve(self, include_body: bool) -> None:
+                try:
+                    resolved = self._resolve()
+                except Exception as e:  # noqa: BLE001 — debug pages
+                    data = f"{type(e).__name__}: {e}\n".encode()
+                    self.send_response(500)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    if include_body:
+                        self.wfile.write(data)
+                    return
+                if resolved is None:
                     self.send_response(404)
+                    self.send_header("Content-Length", "0")
                     self.end_headers()
                     return
-                data = body.encode()
+                ctype, data = resolved
                 self.send_response(200)
-                self.send_header(
-                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
-                )
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
-                self.wfile.write(data)
+                if include_body:
+                    self.wfile.write(data)
+
+            def do_GET(self):
+                self._serve(include_body=True)
+
+            def do_HEAD(self):
+                self._serve(include_body=False)
 
             def log_message(self, *args):  # quiet
                 pass
@@ -135,6 +296,34 @@ class StatsHTTPServer:
         self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+
+    def add_page(self, path: str, fn: Callable[[], str],
+                 content_type: str = "application/json") -> None:
+        """Mount a debug page: ``fn()`` is called per request and must
+        return the body as a string (e.g. the agent's /debug/txns)."""
+        with self._pages_lock:
+            self._pages[path] = (content_type, fn)
+
+    def index(self) -> str:
+        """The ``/`` body: one served path per line."""
+        with self._pages_lock:
+            pages = list(self._pages)
+        paths = sorted(set(self.registry.paths()) | set(pages))
+        return "\n".join(paths) + "\n" if paths else "(no paths registered)\n"
+
+    def resolve(self, path: str) -> Optional[Tuple[str, bytes]]:
+        """(content-type, body) for a request path; None = 404."""
+        if path == "/":
+            return "text/plain; charset=utf-8", self.index().encode()
+        body = self.registry.render(path)
+        if body is not None:
+            return self.PROM_CTYPE, body.encode()
+        with self._pages_lock:
+            page = self._pages.get(path)
+        if page is not None:
+            ctype, fn = page
+            return ctype, fn().encode()
+        return None
 
     def start(self) -> None:
         self._thread = threading.Thread(

@@ -1,17 +1,36 @@
 """Tracing & profiling.
 
 Reference analogs: the VPP packet tracer (`trace add <node> N` + `show
-trace`, docs/VPP_PACKET_TRACING_K8S.md:20-50) and per-graph-node cycle
-accounting (`show run` clocks/vector, :28-50).
+trace`, docs/VPP_PACKET_TRACING_K8S.md:20-50), per-graph-node cycle
+accounting (`show run` clocks/vector, :28-50), and — new in the
+control-plane observability layer — span tracing over the config path
+(``vpp_tpu.trace.spans``).
+
+Re-exports resolve lazily (PEP 562): the packet tracer pulls in the
+jax-backed pipeline, and light processes (kvserver, KSR) that only need
+``trace.spans`` must not pay that import.
 """
 
-from vpp_tpu.trace.tracer import PacketTracer, TraceEntry
-from vpp_tpu.trace.cycles import StageTiming, profile_stages, format_show_run
+_LAZY = {
+    "PacketTracer": ("vpp_tpu.trace.tracer", "PacketTracer"),
+    "TraceEntry": ("vpp_tpu.trace.tracer", "TraceEntry"),
+    "StageTiming": ("vpp_tpu.trace.cycles", "StageTiming"),
+    "profile_stages": ("vpp_tpu.trace.cycles", "profile_stages"),
+    "format_show_run": ("vpp_tpu.trace.cycles", "format_show_run"),
+    "Span": ("vpp_tpu.trace.spans", "Span"),
+    "SpanTracer": ("vpp_tpu.trace.spans", "SpanTracer"),
+}
 
-__all__ = [
-    "PacketTracer",
-    "StageTiming",
-    "TraceEntry",
-    "format_show_run",
-    "profile_stages",
-]
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(name) from None
+    import importlib
+
+    value = getattr(importlib.import_module(mod_name), attr)
+    globals()[name] = value  # cache for subsequent lookups
+    return value
