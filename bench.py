@@ -374,6 +374,85 @@ def commit_bench(args, iters: int = 10) -> dict:
     return out
 
 
+def fastpath_bench(args, iters: int = 12, batch: int = 2048) -> dict:
+    """Two-tier fast path (ISSUE 3 tentpole): the classify-free
+    established-flow kernel vs the full fused chain on an IDENTICAL
+    all-established batch, at the headline rule count.
+
+    Primes sessions with one full-chain pass over forward traffic,
+    builds the reply batch from the POST-NAT forwarded outputs (what
+    the wire would actually carry back), verifies the auto dispatcher
+    takes the fast kernel (StepStats.fastpath == 1), then times both
+    tiers on fixed tables/now. Reports:
+
+      * ``pipeline_fastpath_us``  — auto-dispatched (fast) step, median
+      * ``pipeline_fullpath_us``  — always-full-chain step, median
+      * ``fastpath_speedup_x``    — full/fast (acceptance: >= 3x)
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from vpp_tpu.pipeline.graph import (
+        pipeline_step as _full,
+        pipeline_step_auto as _auto,
+        pipeline_step_auto_mxu as _auto_mxu,
+        pipeline_step_mxu as _full_mxu,
+    )
+    from vpp_tpu.pipeline.vector import Disposition, FLAG_VALID, PacketVector
+
+    dp, uplink = build_dataplane(args.rules, 4)
+    # mirror the dataplane's own kernel selection so the comparison is
+    # the DEPLOYED full chain vs the deployed fast tier
+    step_full = jax.jit(_full_mxu if dp._use_mxu else _full)
+    step_auto = jax.jit(_auto_mxu if dp._use_mxu else _auto)
+
+    fwd = build_traffic(batch, uplink, seed=21)
+    r1 = step_full(dp.tables, fwd, jnp.int32(1))
+    jax.block_until_ready(r1.disp)
+    tables = r1.tables
+    # replies of every forwarded packet: swap the post-NAT endpoints,
+    # ingress on the egress interface (rx_if 0 placeholder on the
+    # non-forwarded slots, which are marked invalid)
+    fwd_ok = np.asarray(r1.disp) != int(Disposition.DROP)
+    pk = r1.pkts
+    reply = PacketVector(
+        src_ip=jnp.asarray(np.asarray(pk.dst_ip)),
+        dst_ip=jnp.asarray(np.asarray(pk.src_ip)),
+        proto=pk.proto,
+        sport=jnp.asarray(np.asarray(pk.dport)),
+        dport=jnp.asarray(np.asarray(pk.sport)),
+        ttl=jnp.full((batch,), 64, jnp.int32),
+        pkt_len=pk.pkt_len,
+        rx_if=jnp.asarray(
+            np.where(fwd_ok, np.asarray(r1.tx_if), 0).astype(np.int32)
+        ),
+        flags=jnp.asarray(
+            np.where(fwd_ok, FLAG_VALID, 0).astype(np.int32)
+        ),
+    )
+    out = {"fastpath_batch": batch, "fastpath_rules": args.rules}
+    probe = step_auto(tables, reply, jnp.int32(2))
+    jax.block_until_ready(probe.disp)
+    out["fastpath_engaged"] = bool(int(probe.stats.fastpath) == 1)
+    out["fastpath_hit_pkts"] = int(probe.stats.sess_hits)
+
+    def med_us(step):
+        jax.block_until_ready(step(tables, reply, jnp.int32(2)).disp)
+        ts = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(step(tables, reply, jnp.int32(2)).disp)
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts)) * 1e6
+
+    full_us = med_us(step_full)
+    fast_us = med_us(step_auto)
+    out["pipeline_fullpath_us"] = round(full_us, 1)
+    out["pipeline_fastpath_us"] = round(fast_us, 1)
+    out["fastpath_speedup_x"] = round(full_us / max(fast_us, 1e-9), 2)
+    return out
+
+
 def sub_benches(args):
     """BASELINE configs #1/#3/#4 as secondary metrics."""
     import jax
@@ -1864,6 +1943,13 @@ def _run():
         pri.update(commit_bench(args))
     except Exception as e:  # noqa: BLE001
         pri["commit_bench_error"] = f"{type(e).__name__}: {e}"
+    _progress(**pri)
+    try:
+        # tentpole capture: the two-tier fast path's measured win at
+        # the headline rule count (acceptance: >= 3x on all-established)
+        pri.update(fastpath_bench(args))
+    except Exception as e:  # noqa: BLE001
+        pri["fastpath_bench_error"] = f"{type(e).__name__}: {e}"
     _progress(**pri)
     if not args.no_subbench:
         try:

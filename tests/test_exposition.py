@@ -249,16 +249,27 @@ def test_head_and_404_for_unknown_paths():
         server.close()
 
 
-def test_metrics_lint_clean():
-    """The tier-1 hook for the tools/lint.py metrics pass: every family
-    the deployed processes register must satisfy the hygiene rules."""
+def _load_lint_module():
     import importlib.util
 
     lint_path = Path(__file__).resolve().parent.parent / "tools" / "lint.py"
     spec = importlib.util.spec_from_file_location("vpp_tpu_lint", lint_path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    assert mod.metrics_lint() == []
+    return mod
+
+
+def test_metrics_lint_clean():
+    """The tier-1 hook for the tools/lint.py metrics pass: every family
+    the deployed processes register must satisfy the hygiene rules."""
+    assert _load_lint_module().metrics_lint() == []
+
+
+def test_counters_lint_clean():
+    """The tier-1 hook for the tools/lint.py --counters parity pass:
+    every StepStats field maps to a registered Prometheus family, and
+    every vpp_tpu_pipeline_* family maps back to a StepStats field."""
+    assert _load_lint_module().counters_lint() == []
 
 
 def test_metrics_lint_catches_violations():

@@ -59,3 +59,30 @@ def test_unknown_value_rejected():
     r = render("--set", "no_such_knob=1")
     assert r.returncode != 0
     assert "not a known value" in (r.stderr + r.stdout)
+
+
+def test_witness_epoch_storage_is_a_pvc_not_hostpath():
+    """ADVICE r5: the witness's persisted fencing epoch IS the
+    cluster's fencing history — on a hostPath a node reschedule lost
+    it, defeating the epoch-adoption guard. The Deployment must mount
+    a PersistentVolumeClaim that follows the Pod across nodes."""
+    import yaml
+
+    r = render()
+    assert r.returncode == 0, r.stderr
+    docs = list(yaml.safe_load_all(
+        r.stdout.replace("${NODE_NAME}", "node-x")
+    ))
+    pvcs = [d for d in docs if d.get("kind") == "PersistentVolumeClaim"]
+    assert any(d["metadata"]["name"] == "vpp-tpu-kvwitness-data"
+               for d in pvcs), "witness PVC missing from the chart"
+    witness = next(
+        d for d in docs if d.get("kind") == "Deployment"
+        and d["metadata"]["name"] == "vpp-tpu-kvwitness"
+    )
+    volumes = witness["spec"]["template"]["spec"]["volumes"]
+    data = next(v for v in volumes if v["name"] == "data")
+    assert "hostPath" not in data, \
+        "witness epoch on hostPath: fencing state dies with the node"
+    assert data["persistentVolumeClaim"]["claimName"] == \
+        "vpp-tpu-kvwitness-data"

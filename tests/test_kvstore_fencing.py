@@ -256,6 +256,40 @@ class TestFenceWire:
         finally:
             srv.close()
 
+    def test_spurious_inband_demotion_heals_on_next_renewal(self):
+        """ADVICE r5: a write carrying fence > epoch demotes the primary
+        in-band (server.py) even when the witness never granted a claim
+        — e.g. a buggy or malicious client minting a future epoch. The
+        guard must re-assert writability on its NEXT successful renewal
+        at its own epoch (a successful renew proves the lease is still
+        ours, so no second history exists); without that, the spurious
+        demotion would be a permanent read-only outage."""
+        w = QuorumWitness(host="127.0.0.1").start()
+        srv = KVServer(host="127.0.0.1", port=0).start()
+        guard = None
+        try:
+            guard = PrimaryGuard(srv, w.address,
+                                 f"127.0.0.1:{srv.port}", ttl=0.6).start()
+            assert srv.read_only is False  # first renewal succeeded
+            # in-band demotion: a client that claims to have seen a
+            # NEWER primary, while the witness lease is in fact ours
+            c = RemoteKVStore("127.0.0.1", srv.port, request_timeout=2.0)
+            c._epoch = 99
+            with pytest.raises((RuntimeError, TimeoutError)):
+                c.put("k", 1)
+            # demoted on the spot, and the write never landed
+            assert srv.store.get("k") is None
+            # the guard's next renewal at our (real) epoch heals it
+            wait_for(lambda: not srv.read_only,
+                     msg="writable again after a proven renewal")
+            assert guard.superseded.is_set() is False
+            c.close()
+        finally:
+            if guard:
+                guard.stop()
+            srv.close()
+            w.close()
+
     def test_guard_start_fails_closed(self):
         """A server that has never held the witness lease must not take
         a single write: a restarted ex-primary partitioned from the

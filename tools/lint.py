@@ -20,6 +20,13 @@ registered family — name matches ``vpp_tpu_[a-z0-9_]+``, non-empty
 help, no duplicate family names across paths. Importing the dataplane
 pulls jax, so this pass only runs when asked for (tier-1:
 tests/test_exposition.py invokes it).
+
+`--counters` runs the counter-parity pass: every pipeline StepStats
+field must map (via stats/collector.py STEPSTATS_FAMILIES) to a
+registered Prometheus family, and every registered
+``vpp_tpu_pipeline_*`` family must map back to a StepStats field —
+so a counter added in the kernel without its observability twin (or
+vice versa) fails tier-1 alongside --metrics.
 """
 
 from __future__ import annotations
@@ -119,9 +126,10 @@ def lint_file(path: Path) -> list:
     return problems
 
 
-def metrics_lint() -> list:
-    """Build every registry the deployed processes serve and validate
-    the registered families (MetricsRegistry.lint). Returns problems."""
+def _build_full_registry():
+    """Every family the deployed processes serve, in ONE registry (so
+    cross-path duplicates are caught). Shared by the --metrics and
+    --counters passes."""
     repo = str(Path(__file__).resolve().parent.parent)
     if repo not in sys.path:  # direct `python tools/lint.py` invocation
         sys.path.insert(0, repo)
@@ -144,7 +152,55 @@ def metrics_lint() -> list:
     # them into the same registry so cross-path duplicates are caught
     register_ksr_gauges(coll.registry, ReflectorRegistry(), path="/metrics")
     coll.registry.register("/kvstore", make_request_histogram())
-    return coll.registry.lint()
+    return coll.registry
+
+
+def metrics_lint() -> list:
+    """Build every registry the deployed processes serve and validate
+    the registered families (MetricsRegistry.lint). Returns problems."""
+    return _build_full_registry().lint()
+
+
+def counters_lint() -> list:
+    """Counter-parity pass: every StepStats field must map to a
+    registered Prometheus family (stats/collector.py
+    STEPSTATS_FAMILIES), and every registered ``vpp_tpu_pipeline_*``
+    family must map back to a StepStats field — a pipeline counter
+    added on either side without its observability twin fails here
+    (and tier-1, via tests/test_exposition.py)."""
+    registry = _build_full_registry()
+    from vpp_tpu.pipeline.graph import StepStats
+    from vpp_tpu.stats.collector import STEPSTATS_FAMILIES
+
+    problems = []
+    fields = set(StepStats._fields)
+    mapped = set(STEPSTATS_FAMILIES)
+    for f in sorted(fields - mapped):
+        problems.append(
+            f"counters: StepStats.{f} has no Prometheus family mapping "
+            f"(stats/collector.py STEPSTATS_FAMILIES)"
+        )
+    for f in sorted(mapped - fields):
+        problems.append(
+            f"counters: STEPSTATS_FAMILIES maps {f!r} which is not a "
+            f"StepStats field (stale entry?)"
+        )
+    registered = {fam.name for _path, fam in registry.families()}
+    for f, family in sorted(STEPSTATS_FAMILIES.items()):
+        if family not in registered:
+            problems.append(
+                f"counters: StepStats.{f} maps to unregistered family "
+                f"{family!r}"
+            )
+    mapped_families = set(STEPSTATS_FAMILIES.values())
+    for name in sorted(registered):
+        if name.startswith("vpp_tpu_pipeline_") and \
+                name not in mapped_families:
+            problems.append(
+                f"counters: family {name!r} is in the pipeline "
+                f"namespace but maps to no StepStats field"
+            )
+    return problems
 
 
 def main(argv=None) -> int:
@@ -164,6 +220,8 @@ def main(argv=None) -> int:
         all_problems.extend(lint_file(f))
     if "--metrics" in argv:
         all_problems.extend(metrics_lint())
+    if "--counters" in argv:
+        all_problems.extend(counters_lint())
     for p in all_problems:
         print(p)
     print(f"lint: {len(files)} files, {len(all_problems)} problems")

@@ -52,6 +52,7 @@ class DebugCLI:
             ("show", "fib"): self.show_fib,
             ("show", "trace"): self.show_trace,
             ("show", "errors"): self.show_errors,
+            ("show", "fastpath"): self.show_fastpath,
             ("show", "io"): self.show_io,
             ("show", "neighbors"): self.show_neighbors,
             ("show", "store"): self.show_store,
@@ -79,7 +80,7 @@ class DebugCLI:
             "commands: show interface | show acl | show session | "
             "show session-rules | show mesh | "
             "show nat44 | show fib | show trace | show errors | "
-            "show io | show neighbors | show store | "
+            "show fastpath | show io | show neighbors | show store | "
             "show config-history [n] | show spans [n] | "
             "trace add [n] | trace clear | config replay <journal> | "
             "test connectivity <src> <dst> <tcp|udp|icmp> [dport]"
@@ -485,6 +486,54 @@ class DebugCLI:
             lines.append(f"revision: {store.revision}, "
                          f"fencing epoch: {store.fencing_epoch}, "
                          f"keys: {len(store.list_keys(''))}")
+        return "\n".join(lines)
+
+    def show_fastpath(self) -> str:
+        """Two-tier dispatch state (pipeline/graph.py): whether the
+        classify-free established-flow kernel is engaged, the gating
+        knobs, and how much traffic actually rides it — the `show
+        acl-plugin sessions`-grade operator view of the fast path."""
+        dp = self.dp
+        enabled = getattr(dp, "fastpath_enabled", False)
+        engaged = getattr(dp, "_use_fastpath", False)
+        min_rules = getattr(dp, "fastpath_min_rules", 0)
+        lines = [
+            "fastpath: {} (engaged: {})".format(
+                "enabled" if enabled else "disabled",
+                "yes" if engaged else
+                f"no — global rules {dp.builder.glb_nrules} < "
+                f"min-rules {min_rules}" if enabled else "no",
+            ),
+            f"  dispatch predicate: all valid packets hit a live "
+            f"reflective session, none DNAT-matches",
+            f"  global rules: {dp.builder.glb_nrules}, "
+            f"min-rules threshold: {min_rules}",
+        ]
+        t = dp.tables
+        if t is not None:
+            # live = valid AND not idle-expired — what the dispatch
+            # predicate's lookups actually see (an all-expired table
+            # must not read as thousands of live sessions here)
+            now = max(getattr(dp, "_now", 0), dp.clock_ticks())
+            valid = np.asarray(t.sess_valid) == 1
+            fresh_mask = (
+                now - np.asarray(t.sess_time) <= int(t.sess_max_age)
+            )
+            lines.append(
+                f"  sessions: {int((valid & fresh_mask).sum())} live of "
+                f"{valid.shape[0]} slots ({int(valid.sum())} valid)"
+            )
+        if self.pump is not None:
+            s = self.pump.stats
+            total = int(s.get("batches", 0))
+            fastb = int(s.get("fastpath_batches", 0))
+            alive = int(s.get("fastpath_alive", 0))
+            hits = int(s.get("fastpath_hits", 0))
+            pct = 100.0 * hits / alive if alive else 0.0
+            lines.append(
+                f"  pump: {fastb}/{total} batches on the fast path, "
+                f"session-hit {pct:.1f}% ({hits}/{alive} pkts)"
+            )
         return "\n".join(lines)
 
     def show_io(self) -> str:

@@ -127,7 +127,11 @@ class AgentConfig:
     # worker threads (reference's optional parallel renderer commit,
     # configurator_impl.go:211-233 / plugin_impl_policy.go:161)
     parallel_renderer_commits: bool = False
-    # device tables sizing
+    # device tables sizing + the two-tier fast-path knobs
+    # (``dataplane.fastpath``: enable the classify-free established-flow
+    # dispatch, default on; ``dataplane.fastpath_min_rules``: engage it
+    # only once the global ACL table holds at least this many rules —
+    # below that the classifier is cheap and the dispatch buys nothing)
     dataplane: DataplaneConfig = dataclasses.field(default_factory=DataplaneConfig)
     # IPAM subnets
     ipam: IpamConfig = dataclasses.field(default_factory=IpamConfig)

@@ -119,13 +119,28 @@ class ClusterPump:
                       # to become ready (overlapped with the next
                       # step's staging) vs the serial result copy
                       "inflight": 0, "inflight_peak": 0,
-                      "t_fetch_wait": 0.0, "t_fetch": 0.0}
+                      "t_fetch_wait": 0.0, "t_fetch": 0.0,
+                      # two-tier dispatch telemetry, same contract as
+                      # DataplanePump. The mesh step cannot take the
+                      # classify-free kernel yet — its rule-sharded
+                      # classify is a COLLECTIVE (pmin over RULE_AXIS),
+                      # and a per-node lax.cond around a collective is
+                      # not SPMD-uniform — so fastpath_batches stays 0
+                      # here, but the session-hit percentage (the regime
+                      # signal a later sharded dispatch would exploit)
+                      # is measured from the step's own StepStats.
+                      "fastpath_batches": 0, "fastpath_hits": 0,
+                      "fastpath_alive": 0}
         self._step_lat = collections.deque(maxlen=2048)
         self._lat_lock = threading.Lock()
         # optional Prometheus Histogram (stats/collector.py set_pump):
         # same per-batch observation contract as DataplanePump, so
         # vpp_tpu_pump_batch_seconds carries data on mesh nodes too
         self.latency_hist = None
+        # fast-tier histogram slot (set_pump parity): never observed
+        # here until the mesh step can dispatch classify-free (see the
+        # fastpath_batches comment above)
+        self.fastpath_hist = None
         # frames peeked by dispatch but not yet released by the writer,
         # per ring (releases shift pending peek indices, so both sides
         # mutate under the lock — the single-node pump's held protocol)
@@ -406,14 +421,19 @@ class ClusterPump:
         tw0 = time.perf_counter()
         jax.block_until_ready((result.local, result.delivered, deliv_pay))
         tf0 = time.perf_counter()
-        res_local, res_deliv = jax.device_get(
-            (result.local, result.delivered)
+        # the [N] sess_hits/rx vectors ride the same fetch group (a few
+        # bytes): the regime telemetry must not add a round trip
+        res_local, res_deliv, sess_hits, step_rx = jax.device_get(
+            (result.local, result.delivered,
+             result.stats.sess_hits, result.stats.rx)
         )
         deliv_pay = np.asarray(jax.device_get(deliv_pay))
         tf1 = time.perf_counter()
         with self._lat_lock:
             self.stats["t_fetch_wait"] += tf0 - tw0
             self.stats["t_fetch"] += tf1 - tf0
+            self.stats["fastpath_hits"] += int(np.asarray(sess_hits).sum())
+            self.stats["fastpath_alive"] += int(np.asarray(step_rx).sum())
 
         # pass-1 results → ingress node's tx ring (payload: own rx slot)
         for i, node_offs in enumerate(offs):

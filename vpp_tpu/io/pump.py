@@ -212,6 +212,14 @@ class DataplanePump:
             # the adaptive chainer folded backlog into one K-stack
             "inflight": 0, "inflight_peak": 0,
             "chain_batches": 0, "chain_k_peak": 0,
+            # two-tier dispatch (pipeline/graph.py pipeline_step_auto):
+            # dispatches fully served by the classify-free fast kernel
+            # (a chain fold counts ONCE, and only when every sub-batch
+            # went fast — comparable to "batches"), plus the raw
+            # session-hit/alive packet accumulators behind the
+            # fastpath_hit_pct gauge (hits/alive is the regime signal —
+            # WHY batches do or don't dispatch fast)
+            "fastpath_batches": 0, "fastpath_hits": 0, "fastpath_alive": 0,
         }
         # dispatch→tx latency of recent batches, seconds (experienced
         # added latency of the device leg; ring-wait not included — the
@@ -228,6 +236,11 @@ class DataplanePump:
         # histogram_quantile() aggregates across nodes where the
         # p50/p99 window gauges cannot
         self.latency_hist = None
+        # optional Histogram (vpp_tpu_fastpath_batch_seconds): the
+        # dispatch→tx latency of batches the classify-free kernel
+        # served — the measured fast-tier distribution next to the
+        # all-batches one above
+        self.fastpath_hist = None
         self._inflight: "queue.Queue" = queue.Queue(
             maxsize=self.max_inflight)
         # live fetch workers (under _lat_lock): the tx writer's
@@ -406,7 +419,7 @@ class DataplanePump:
                 self._inflight_inc()
                 with self._done_cv:
                     self._done[self._seq] = (None, groups, None,
-                                             time.perf_counter())
+                                             time.perf_counter(), False)
                     self._seq += 1
                     self._done_cv.notify_all()
 
@@ -459,9 +472,12 @@ class DataplanePump:
                 PacketVector(**unpack_packet_input(flat))
             )
         elif K == 1:
-            payload = self.dp.process_packed(flat)  # async dispatch
+            # async dispatch; (out, aux) with the fast-path summary
+            # riding the same program (measured on both tiers)
+            payload = self.dp.process_packed(flat, with_aux=True)
         else:
-            payload = self.dp.process_packed_chain(flat)  # async, [K,5,B]
+            # async, ([K,5,B], [K,3])
+            payload = self.dp.process_packed_chain(flat, with_aux=True)
             self.stats["chain_batches"] += 1
             self.stats["chain_k_peak"] = max(self.stats["chain_k_peak"],
                                              K)
@@ -494,7 +510,9 @@ class DataplanePump:
         with self.dp._lock:
             tables = self.dp.tables
             epoch = self.dp.epoch
-        self._ppump = PersistentPump(tables, batch=VEC).start()
+            fastpath = self.dp._use_fastpath
+        self._ppump = PersistentPump(tables, batch=VEC,
+                                     fastpath=fastpath).start()
         self._persist_epoch = epoch
 
     def _persist_stop_merge(self) -> None:
@@ -617,6 +635,7 @@ class DataplanePump:
         seq, ppump, groups, non_ip, t0 = item
         tf0 = time.perf_counter()
         batch = None
+        fast = False
         deadline = time.monotonic() + 300.0
         # NOT gated on _stop: an already-submitted frame's result
         # is coming (PersistentPump.stop drains every queued frame
@@ -625,7 +644,8 @@ class DataplanePump:
         # delivers. Loop-death/timeout still bounds the wait.
         while True:
             try:
-                batch = ppump.result(timeout=0.2)
+                batch, aux = ppump.result_ex(timeout=0.2)
+                fast = self._account_fastpath(aux)
                 break
             except queue.Empty:
                 if time.monotonic() > deadline:
@@ -639,7 +659,7 @@ class DataplanePump:
         with self._lat_lock:
             self.stats["t_fetch"] += time.perf_counter() - tf0
         with self._done_cv:
-            self._done[seq] = (batch, groups, non_ip, t0)
+            self._done[seq] = (batch, groups, non_ip, t0, fast)
             self._done_cv.notify_all()
 
     def _persist_collect_loop(self) -> None:
@@ -703,6 +723,7 @@ class DataplanePump:
         delay = self._fetch_delay
         if delay is not None:
             time.sleep(delay(seq) if callable(delay) else delay)
+        fast = False
         try:
             if slow:
                 out_pkts, disp, tx_if, next_hop, cause = jax.device_get(
@@ -734,23 +755,52 @@ class DataplanePump:
                 # np.array: device_get may hand back a zero-copy
                 # view of a device buffer whose lifetime ends with
                 # `payload` — the copy (20 B/packet) outlives it
+                out, aux = payload  # aux: [3] (or [K,3]) tier summary
                 tw0 = time.perf_counter()
                 jax.block_until_ready(payload)
                 tf0 = time.perf_counter()
-                batch = np.array(jax.device_get(payload))
+                # one fetch for both: the aux summary (12 B) must not
+                # cost a second round trip on a remote transport
+                out_h, aux_h = jax.device_get((out, aux))
+                batch = np.array(out_h)
                 tf1 = time.perf_counter()
                 # concurrent fetchers: accumulate under a lock or
                 # the += load/add/store interleaves and undercounts
                 with self._lat_lock:
                     self.stats["t_fetch_wait"] += tf0 - tw0
                     self.stats["t_fetch"] += tf1 - tf0
+                fast = self._account_fastpath(aux_h)
         except Exception:
             log.exception("pump fetch failed (batch %d)", seq)
             batch = None
             self.stats["batch_errors"] += 1
         with self._done_cv:
-            self._done[seq] = (batch, groups, non_ip, t0)
+            self._done[seq] = (batch, groups, non_ip, t0, fast)
             self._done_cv.notify_all()
+
+    def _account_fastpath(self, aux) -> bool:
+        """Fold one dispatch's [3] (or chain-fold [K, 3]) fast-path
+        summary into the pump counters; returns True when EVERY
+        sub-batch ran the classify-free kernel (the whole dispatch's
+        latency then belongs to the fast-tier histogram).
+
+        ``fastpath_batches`` counts at DISPATCH granularity — a chain
+        fold counts once, and only when all K sub-batches went fast —
+        so it stays directly comparable to ``stats["batches"]`` (the
+        ratio is a true fraction). Partial folds still show up in the
+        packet-level hits/alive accumulators."""
+        if aux is None:
+            return False
+        a = np.asarray(aux)
+        if a.ndim == 1:
+            a = a[None, :]
+        all_fast = bool((a[:, 0] > 0).all())
+        with self._lat_lock:
+            if all_fast:
+                self.stats["fastpath_batches"] += 1
+            self.stats["fastpath_alive"] += int(a[:, 1].sum())
+            self.stats["fastpath_hits"] += int(a[:, 2].sum())
+        return all_fast
 
     # --- tx writer: reorder, split, write tx ring, release rx slots ---
     def _write_loop(self) -> None:
@@ -825,7 +875,8 @@ class DataplanePump:
                 self.stats["tx_ring_full"] += 1
             off += n
 
-    def _write(self, batch, groups: list, non_ip, t0: float) -> None:
+    def _write(self, batch, groups: list, non_ip, t0: float,
+               fast: bool = False) -> None:
         if isinstance(batch, np.ndarray):
             tw0 = time.perf_counter()
             host_if = (self.dp.host_if
@@ -847,6 +898,8 @@ class DataplanePump:
                 self.batch_lat.append(lat)
             if self.latency_hist is not None:
                 self.latency_hist.observe(lat)
+            if fast and self.fastpath_hist is not None:
+                self.fastpath_hist.observe(lat)
         elif batch is not None:
             # tracing path: full column dict from the unpacked step
             # (the tracer never chains, so there is exactly one group)

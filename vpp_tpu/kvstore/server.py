@@ -163,8 +163,14 @@ class _Conn(socketserver.BaseRequestHandler):
                     # Demote on the spot — the in-band beacon that
                     # closes the sub-ttl window between a standby's
                     # granted claim and our own guard noticing
-                    # (kvstore/witness.py module docs).
-                    self.server.read_only = True  # type: ignore[attr-defined]
+                    # (kvstore/witness.py module docs). The generation
+                    # bump first: the PrimaryGuard clears a demotion
+                    # only when no demotion landed since its renew RPC
+                    # began, so this one is never undone by a renew
+                    # response that predates it.
+                    with self.server.demote_lock:  # type: ignore[attr-defined]
+                        self.server.demotions += 1  # type: ignore[attr-defined]
+                        self.server.read_only = True  # type: ignore[attr-defined]
                     log.error("write carried fencing epoch %d > ours %d "
                               "— superseded, demoting to read-only",
                               fence, epoch)
@@ -263,6 +269,15 @@ class KVServer:
         self._server.store = self.store  # type: ignore[attr-defined]
         self._server.live_conns = set()  # type: ignore[attr-defined]
         self._server.read_only = False  # type: ignore[attr-defined]
+        # monotone count of in-band demotions (fence > epoch writes):
+        # the PrimaryGuard snapshots it around each renew RPC so a
+        # demotion that lands mid-RPC is never cleared by the (stale)
+        # successful response. demote_lock makes increment+demote and
+        # the guard's check+clear mutually atomic — without it a
+        # demotion interleaving between the guard's generation check
+        # and its read_only=False assignment would be silently undone.
+        self._server.demotions = 0  # type: ignore[attr-defined]
+        self._server.demote_lock = threading.Lock()  # type: ignore[attr-defined]
         self._server.request_hist = self.request_hist  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
         self._sweep_stop = threading.Event()
@@ -295,6 +310,17 @@ class KVServer:
     @read_only.setter
     def read_only(self, value: bool) -> None:
         self._server.read_only = bool(value)  # type: ignore[attr-defined]
+
+    @property
+    def demotions(self) -> int:
+        """In-band demotion generation (see __init__)."""
+        return self._server.demotions  # type: ignore[attr-defined]
+
+    @property
+    def demote_lock(self):
+        """Lock making demotion increments and the PrimaryGuard's
+        generation-checked clear mutually atomic (see __init__)."""
+        return self._server.demote_lock  # type: ignore[attr-defined]
 
     @property
     def address(self) -> tuple:

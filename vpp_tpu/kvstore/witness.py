@@ -342,14 +342,50 @@ class PrimaryGuard:
         return self
 
     def _renew_once(self) -> None:
+        # Snapshot the in-band demotion generation BEFORE the RPC: a
+        # renew response only proves authority as of when the witness
+        # answered. If a demotion lands while the RPC is in flight (a
+        # standby's claim was granted right after our renew and its
+        # first fenced write beat our assignment), clearing read_only
+        # on the stale response would re-open exactly the dual-primary
+        # window the in-band beacon closes — so we only clear when the
+        # generation is unchanged; otherwise the NEXT renewal decides
+        # (it fails at the witness if a claim really happened).
+        gen0 = getattr(self.server, "demotions", 0)
         rsp = self.client.renew(self.self_addr, self.server.epoch, self.ttl)
         if rsp.get("ok"):
             self._last_ok = time.monotonic()
-            if self._unproven:
-                self._unproven = False
-                self.server.read_only = False
+            was_unproven, self._unproven = self._unproven, False
+            # Re-assert writability on EVERY successful renewal at our
+            # own epoch, not only when recovering from 'unproven': a
+            # client write carrying fence > epoch demotes the server
+            # in-band (kvstore/server.py) even when the fence was
+            # garbage and the witness never granted a claim — without
+            # this, that spurious demotion would be permanent. Safe: a
+            # successful renew at our epoch proves the witness lease
+            # was still ours when answered (ADVICE r5), and the
+            # generation check — atomic with the handler's
+            # increment+demote via demote_lock — extends that proof to
+            # the assignment itself (a demotion landing mid-RPC or
+            # mid-check is never undone; the NEXT renewal decides it).
+            lock = getattr(self.server, "demote_lock", None)
+            was_ro = bool(self.server.read_only)
+            cleared = False
+            if lock is not None:
+                with lock:
+                    if getattr(self.server, "demotions", 0) == gen0:
+                        self.server.read_only = False
+                        cleared = True
+            else:  # bare test doubles without the lock: best effort
+                if getattr(self.server, "demotions", 0) == gen0:
+                    self.server.read_only = False
+                    cleared = True
+            if cleared and was_unproven:
                 log.warning("witness back, lease still ours — writable "
                             "again (read-only blip, no fork possible)")
+            elif cleared and was_ro:
+                log.warning("renewal succeeded at our epoch — cleared "
+                            "a demotion the witness never ratified")
             return
         # epoch moved or another node holds the lease: superseded
         self.superseded.set()

@@ -39,6 +39,41 @@ def _flow_hash(pkts: PacketVector) -> jnp.ndarray:
     return h
 
 
+def _dnat_lookup(
+    tables: DataplaneTables, pkts: PacketVector
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Mapping match for DNAT: (matched [P] — before any eligibility
+    mask, m_idx [P] best mapping slot). Match key is (dst_ip, dport,
+    proto). ext_port 0 = any port (used for plain node-IP SNAT
+    passthrough mappings); an exact-port mapping always takes
+    precedence over a port-0 wildcard for the same IP/proto,
+    regardless of slot order."""
+    exact = tables.nat_ext_port[None, :] == pkts.dport[:, None]
+    wildcard = tables.nat_ext_port[None, :] == 0
+    hit = (
+        (tables.nat_ext_ip[None, :] == pkts.dst_ip[:, None])
+        & (exact | wildcard)
+        & (tables.nat_proto[None, :] == pkts.proto[:, None])
+        & (tables.nat_bcnt[None, :] > 0)
+    )
+    score = jnp.where(hit, jnp.where(exact, 2, 1), 0)
+    m_idx = jnp.argmax(score, axis=1)
+    matched = jnp.take_along_axis(score, m_idx[:, None], axis=1)[:, 0] > 0
+    return matched, m_idx
+
+
+def nat44_dnat_match(
+    tables: DataplaneTables, pkts: PacketVector, eligible: jnp.ndarray
+) -> jnp.ndarray:
+    """Would ``nat44_dnat`` translate any of these packets? Match-only
+    probe (no rewrite, no backend pick) — the fast/slow dispatch
+    predicate (pipeline/graph.py) uses it to keep DNAT state changes
+    off the classify-free fast path. O(P·M) over the dense mapping
+    table, a rounding error next to the rule classify it gates."""
+    matched, _ = _dnat_lookup(tables, pkts)
+    return matched & eligible
+
+
 def nat44_dnat(
     tables: DataplaneTables,
     pkts: PacketVector,
@@ -53,24 +88,10 @@ def nat44_dnat(
     is a separate step (``nat44_record``) run *after* the ACL verdict so
     denied packets never consume NAT session slots.
     """
-    M = tables.nat_ext_ip.shape[0]
     B = tables.natb_ip.shape[0]
 
-    # Match (dst_ip, dport, proto) against mappings. ext_port 0 = any port
-    # (used for plain node-IP SNAT passthrough mappings). An exact-port
-    # mapping always takes precedence over a port-0 wildcard for the same
-    # IP/proto, regardless of slot order.
-    exact = tables.nat_ext_port[None, :] == pkts.dport[:, None]
-    wildcard = tables.nat_ext_port[None, :] == 0
-    hit = (
-        (tables.nat_ext_ip[None, :] == pkts.dst_ip[:, None])
-        & (exact | wildcard)
-        & (tables.nat_proto[None, :] == pkts.proto[:, None])
-        & (tables.nat_bcnt[None, :] > 0)
-    )
-    score = jnp.where(hit, jnp.where(exact, 2, 1), 0)
-    m_idx = jnp.argmax(score, axis=1)
-    matched = (jnp.take_along_axis(score, m_idx[:, None], axis=1)[:, 0] > 0) & eligible
+    raw_matched, m_idx = _dnat_lookup(tables, pkts)
+    matched = raw_matched & eligible
 
     # Weighted consistent backend pick: w ∈ [0, total_w); first backend in
     # the mapping's range with cumulative weight > w wins.

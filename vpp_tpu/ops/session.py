@@ -147,6 +147,24 @@ def session_lookup_reverse_idx(
     return found, hit_idx
 
 
+def session_batch_summary(
+    tables: DataplaneTables, pkts: PacketVector, alive: jnp.ndarray, now
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Batched hit summary for the two-tier fast/slow dispatch
+    (pipeline/graph.py pipeline_step_auto): one reverse lookup yields
+    ``(hits, hit_idx, all_hit)`` where ``hits`` masks alive packets
+    admitted by a live reflective session, ``hit_idx`` their matched
+    slots (for session_touch) and ``all_hit`` the batch-level scalar
+    predicate — EVERY alive packet rides an established session, so the
+    classify-free fast kernel is bit-exact for the whole vector. A
+    batch with no alive packets is vacuously all-hit (the fast kernel
+    is a no-op on it, exactly like the full chain)."""
+    found, hit_idx = session_lookup_reverse_idx(tables, pkts, now)
+    hits = found & alive
+    all_hit = jnp.all(hits == alive)
+    return hits, hit_idx, all_hit
+
+
 def session_touch(
     tables: DataplaneTables, hit_idx: jnp.ndarray, mask: jnp.ndarray, now
 ) -> DataplaneTables:

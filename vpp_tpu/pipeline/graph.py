@@ -22,12 +22,14 @@ from vpp_tpu.ops.fib import ip4_lookup
 from vpp_tpu.ops.ip4 import ip4_input
 from vpp_tpu.ops.nat44 import (
     nat44_dnat,
+    nat44_dnat_match,
     nat44_record,
     nat44_reverse,
     nat44_snat,
     nat44_touch,
 )
 from vpp_tpu.ops.session import (
+    session_batch_summary,
     session_insert,
     session_lookup_reverse_idx,
     session_touch,
@@ -62,6 +64,12 @@ class StepStats(NamedTuple):
     if_rx_bytes: jnp.ndarray   # int32 [I]
     if_tx_bytes: jnp.ndarray   # int32 [I]
     if_drops: jnp.ndarray      # int32 [I] drops attributed to the rx if
+    sess_hits: jnp.ndarray     # int32 scalar: alive packets admitted via
+                               # a live reflective session (the fast-path
+                               # dispatch signal, two-tier pipeline)
+    fastpath: jnp.ndarray      # int32 scalar: 1 when this step ran the
+                               # classify-free established-flow kernel,
+                               # 0 for the full chain
 
 
 # Per-packet drop attribution (error-drop counter analog).
@@ -96,99 +104,47 @@ class StepResult(NamedTuple):
     snat_applied: jnp.ndarray  # bool [P] SNAT rewrote the source
 
 
-def pipeline_step(
+def _ingress(tables: DataplaneTables, pkts: PacketVector):
+    """Shared ingress prologue of every pipeline tier: ip4-input plus
+    the unconfigured-interface drop (VPP analog: unknown sw_if_index →
+    error-drop). One copy, so an ingress-semantics change lands on the
+    full chain, the fast kernel and the dispatch predicate alike.
+    Returns (pkts, drop_ip4, alive)."""
+    pkts, drop_ip4 = ip4_input(pkts)
+    bad_if = tables.if_type[pkts.rx_if] == 0
+    drop_ip4 = drop_ip4 | (bad_if & pkts.valid)
+    return pkts, drop_ip4, pkts.valid & ~drop_ip4
+
+
+def _finish_step(
     tables: DataplaneTables,
     pkts: PacketVector,
     now: jnp.ndarray,
-    acl_global_fn=acl_classify_global,
+    alive: jnp.ndarray,
+    drop_ip4: jnp.ndarray,
+    drop_acl: jnp.ndarray,
+    permit: jnp.ndarray,
+    fib,
+    forwarded: jnp.ndarray,
+    disp: jnp.ndarray,
+    tx_if: jnp.ndarray,
+    established: jnp.ndarray,
+    nat_reversed: jnp.ndarray,
+    dnat_applied: jnp.ndarray,
+    snat_applied: jnp.ndarray,
+    dropped_nat: jnp.ndarray,
+    sess_fail: jnp.ndarray,
+    natsess_fail: jnp.ndarray,
+    fastpath: jnp.ndarray,
 ) -> StepResult:
-    """Process one packet vector through the full forwarding chain.
-
-    Pure function: (tables, frame, time) → (result, new session state).
-    Jit once; call per frame. ``acl_global_fn`` lets the multi-chip
-    cluster step substitute a rule-sharded global classify
-    (vpp_tpu.parallel.cluster) without altering the chain.
-    """
+    """Shared tail of both pipeline tiers: drop attribution, counters,
+    StepStats and the StepResult assembly. The ONE copy of the
+    accounting semantics — the fast kernel calls it with its statically
+    empty NAT/insert masks (all-False vectors, which XLA folds), so an
+    edit to drop_cause/occupancy/per-interface logic lands on both
+    tiers by construction."""
     n_ifaces = tables.if_type.shape[0]
-
-    # --- ip4-input ---
-    pkts, drop_ip4 = ip4_input(pkts)
-    # Traffic from an unconfigured interface slot is invalid (VPP analog:
-    # unknown sw_if_index → error-drop).
-    bad_if = tables.if_type[pkts.rx_if] == 0
-    drop_ip4 = drop_ip4 | (bad_if & pkts.valid)
-    alive = pkts.valid & ~drop_ip4
-
-    # --- reflective session bypass (return traffic of permitted flows) ---
-    # Looked up on the raw (pre-NAT) header: forward sessions are installed
-    # post-DNAT, so a backend's reply B→C reverses to the stored C→B key.
-    # Expired entries (idle > sess_max_age ticks) don't match, and hits
-    # refresh the timestamp — active flows never expire mid-flow.
-    established, sess_hit_idx = session_lookup_reverse_idx(tables, pkts, now)
-    established = established & alive
-    tables = session_touch(tables, sess_hit_idx, established, now)
-
-    # --- NAT44: reverse-translate return traffic, then DNAT new flows ---
-    pkts, nat_reversed, nat_hit_idx = nat44_reverse(tables, pkts, alive, now)
-    tables = nat44_touch(tables, nat_hit_idx, nat_reversed, now)
-    orig_dst, orig_dport = pkts.dst_ip, pkts.dport
-    pkts, dnat_applied, dnat_self_snat = nat44_dnat(
-        tables, pkts, alive & ~nat_reversed
-    )
-
-    # --- ACL classify (local per-interface table + node-global table) ---
-    local_v = acl_classify_local(tables, pkts)
-    glob_v = acl_global_fn(tables, pkts)
-    permit = (local_v.permit & glob_v.permit) | established
-    drop_acl = alive & ~permit
-
-    # --- ip4-lookup (on possibly NAT-rewritten dst) ---
-    fib = ip4_lookup(tables, pkts.dst_ip)
     drop_no_route = alive & permit & ~fib.matched
-
-    forwarded = alive & permit & fib.matched & (fib.disp != int(Disposition.DROP))
-    disp = jnp.where(forwarded, fib.disp, int(Disposition.DROP)).astype(jnp.int32)
-    tx_if = jnp.where(forwarded, fib.tx_if, -1)
-
-    # --- SNAT for cluster-egress flows (routes marked snat) and for
-    # self-snat DNAT mappings (nodeports: the backend's reply must return
-    # through this node for un-DNAT even when the backend is remote).
-    # New outbound flows only: reply traffic (un-NAT'd above, or admitted
-    # via a reflective session) must keep its translated/original source.
-    # Reference: configurator_impl.go:258-264 SNAT pool.
-    is_l4 = (pkts.proto == 6) | (pkts.proto == 17)
-    nat_capable = is_l4 | (pkts.proto == 1)  # icmp: src-only translation
-    fresh = ~nat_reversed & ~established
-    orig_src, orig_sport = pkts.src_ip, pkts.sport
-    want_snat = forwarded & fresh & nat_capable & (fib.snat | dnat_self_snat)
-    pkts, snat_applied = nat44_snat(tables, pkts, want_snat)
-    # A protocol NAT can't translate, leaving via an SNAT route, would
-    # leak the pod's private source address — fail closed.
-    nat_unsupported = (
-        forwarded & fresh & ~nat_capable & fib.snat
-        & (tables.nat_snat_ip != 0)
-    )
-
-    # --- session install for newly permitted flows only (denied packets
-    # must not consume session slots); keys are post-NAT so replies match ---
-    want_sess = forwarded & ~established & nat_capable & ~nat_unsupported
-    tables, _, sess_fail = session_insert(tables, pkts, want_sess, now)
-    nat_kind = (
-        jnp.where(dnat_applied, 1, 0) + jnp.where(snat_applied, 2, 0)
-    ).astype(jnp.int32)
-    tables, nat_conflict, natsess_fail = nat44_record(
-        tables, pkts, orig_dst, orig_dport, orig_src, orig_sport, nat_kind,
-        (dnat_applied | snat_applied) & forwarded, now,
-    )
-    # Fail closed on reply-key collisions (two SNAT'd flows hashed onto
-    # the same external port): misdelivering replies to the wrong pod is
-    # worse than dropping the colliding flow — drops are counted.
-    dropped_nat = nat_conflict | nat_unsupported
-    forwarded = forwarded & ~dropped_nat
-    disp = jnp.where(dropped_nat, int(Disposition.DROP), disp).astype(jnp.int32)
-    tx_if = jnp.where(dropped_nat, -1, tx_if)
-
-    # --- counters ---
     fib_dropped = alive & permit & fib.matched & (
         fib.disp == int(Disposition.DROP)
     )
@@ -236,6 +192,8 @@ def pipeline_step(
             jnp.where(forwarded, pkts.pkt_len, 0), mode="drop"
         ),
         if_drops=zero_i.at[drop_if_safe].add(1, mode="drop"),
+        sess_hits=jnp.sum(established.astype(jnp.int32)),
+        fastpath=fastpath,
     )
     drop_cause = (
         jnp.where(pkts.valid & drop_ip4, DROP_IP4, 0)
@@ -259,6 +217,98 @@ def pipeline_step(
     )
 
 
+def pipeline_step(
+    tables: DataplaneTables,
+    pkts: PacketVector,
+    now: jnp.ndarray,
+    acl_global_fn=acl_classify_global,
+) -> StepResult:
+    """Process one packet vector through the full forwarding chain.
+
+    Pure function: (tables, frame, time) → (result, new session state).
+    Jit once; call per frame. ``acl_global_fn`` lets the multi-chip
+    cluster step substitute a rule-sharded global classify
+    (vpp_tpu.parallel.cluster) without altering the chain.
+    """
+    # --- ip4-input (+ unconfigured-interface drop) ---
+    pkts, drop_ip4, alive = _ingress(tables, pkts)
+
+    # --- reflective session bypass (return traffic of permitted flows) ---
+    # Looked up on the raw (pre-NAT) header: forward sessions are installed
+    # post-DNAT, so a backend's reply B→C reverses to the stored C→B key.
+    # Expired entries (idle > sess_max_age ticks) don't match, and hits
+    # refresh the timestamp — active flows never expire mid-flow.
+    established, sess_hit_idx = session_lookup_reverse_idx(tables, pkts, now)
+    established = established & alive
+    tables = session_touch(tables, sess_hit_idx, established, now)
+
+    # --- NAT44: reverse-translate return traffic, then DNAT new flows ---
+    pkts, nat_reversed, nat_hit_idx = nat44_reverse(tables, pkts, alive, now)
+    tables = nat44_touch(tables, nat_hit_idx, nat_reversed, now)
+    orig_dst, orig_dport = pkts.dst_ip, pkts.dport
+    pkts, dnat_applied, dnat_self_snat = nat44_dnat(
+        tables, pkts, alive & ~nat_reversed
+    )
+
+    # --- ACL classify (local per-interface table + node-global table) ---
+    local_v = acl_classify_local(tables, pkts)
+    glob_v = acl_global_fn(tables, pkts)
+    permit = (local_v.permit & glob_v.permit) | established
+    drop_acl = alive & ~permit
+
+    # --- ip4-lookup (on possibly NAT-rewritten dst) ---
+    fib = ip4_lookup(tables, pkts.dst_ip)
+    forwarded = alive & permit & fib.matched & (fib.disp != int(Disposition.DROP))
+    disp = jnp.where(forwarded, fib.disp, int(Disposition.DROP)).astype(jnp.int32)
+    tx_if = jnp.where(forwarded, fib.tx_if, -1)
+
+    # --- SNAT for cluster-egress flows (routes marked snat) and for
+    # self-snat DNAT mappings (nodeports: the backend's reply must return
+    # through this node for un-DNAT even when the backend is remote).
+    # New outbound flows only: reply traffic (un-NAT'd above, or admitted
+    # via a reflective session) must keep its translated/original source.
+    # Reference: configurator_impl.go:258-264 SNAT pool.
+    is_l4 = (pkts.proto == 6) | (pkts.proto == 17)
+    nat_capable = is_l4 | (pkts.proto == 1)  # icmp: src-only translation
+    fresh = ~nat_reversed & ~established
+    orig_src, orig_sport = pkts.src_ip, pkts.sport
+    want_snat = forwarded & fresh & nat_capable & (fib.snat | dnat_self_snat)
+    pkts, snat_applied = nat44_snat(tables, pkts, want_snat)
+    # A protocol NAT can't translate, leaving via an SNAT route, would
+    # leak the pod's private source address — fail closed.
+    nat_unsupported = (
+        forwarded & fresh & ~nat_capable & fib.snat
+        & (tables.nat_snat_ip != 0)
+    )
+
+    # --- session install for newly permitted flows only (denied packets
+    # must not consume session slots); keys are post-NAT so replies match ---
+    want_sess = forwarded & ~established & nat_capable & ~nat_unsupported
+    tables, _, sess_fail = session_insert(tables, pkts, want_sess, now)
+    nat_kind = (
+        jnp.where(dnat_applied, 1, 0) + jnp.where(snat_applied, 2, 0)
+    ).astype(jnp.int32)
+    tables, nat_conflict, natsess_fail = nat44_record(
+        tables, pkts, orig_dst, orig_dport, orig_src, orig_sport, nat_kind,
+        (dnat_applied | snat_applied) & forwarded, now,
+    )
+    # Fail closed on reply-key collisions (two SNAT'd flows hashed onto
+    # the same external port): misdelivering replies to the wrong pod is
+    # worse than dropping the colliding flow — drops are counted.
+    dropped_nat = nat_conflict | nat_unsupported
+    forwarded = forwarded & ~dropped_nat
+    disp = jnp.where(dropped_nat, int(Disposition.DROP), disp).astype(jnp.int32)
+    tx_if = jnp.where(dropped_nat, -1, tx_if)
+
+    # counters / attribution / result assembly: the shared tail
+    return _finish_step(
+        tables, pkts, now, alive, drop_ip4, drop_acl, permit, fib,
+        forwarded, disp, tx_if, established, nat_reversed, dnat_applied,
+        snat_applied, dropped_nat, sess_fail, natsess_fail,
+        fastpath=jnp.int32(0),
+    )
+
+
 def pipeline_step_mxu(
     tables: DataplaneTables, pkts: PacketVector, now: jnp.ndarray
 ) -> StepResult:
@@ -267,6 +317,152 @@ def pipeline_step_mxu(
     from vpp_tpu.ops.acl_mxu import acl_classify_global_mxu
 
     return pipeline_step(tables, pkts, now, acl_global_fn=acl_classify_global_mxu)
+
+
+# --- two-tier established-flow fast path ------------------------------
+#
+# BENCH_r05 put 15.4 ms of the 24.2 ms fused step in the global ACL
+# classify, yet steady-state traffic is return flows the reflective
+# session table already admits — the full chain computed `established`
+# and then ran the classifier anyway just to OR the verdicts. The split
+# below is the VPP acl-plugin flow-cache idea on a vector machine:
+# per-PACKET branching is impossible under XLA (every lane executes
+# every instruction), so the dispatch granularity is the BATCH — one
+# `lax.cond` on "every valid packet hit a live session (and none
+# touches DNAT state)" picks a classify-free kernel for the whole
+# vector, and any partial-hit batch falls through to the full chain
+# bit-for-bit unchanged.
+
+
+def _pipeline_fast_finish(
+    tables: DataplaneTables,
+    pkts: PacketVector,
+    now: jnp.ndarray,
+    alive: jnp.ndarray,
+    drop_ip4: jnp.ndarray,
+    established: jnp.ndarray,
+    sess_hit_idx: jnp.ndarray,
+    nat_reversed: jnp.ndarray,
+    nat_hit_idx: jnp.ndarray,
+) -> StepResult:
+    """Tail of the classify-free kernel, from post-reverse headers on.
+
+    Valid ONLY under the dispatch invariant (every alive packet is
+    established, none DNAT-matches): `permit` collapses to
+    `established`, SNAT/session-insert/NAT-record are statically empty
+    (they all require a fresh flow or a DNAT hit) and are elided rather
+    than computed-and-discarded — that elision IS the speedup.
+    """
+    tables = session_touch(tables, sess_hit_idx, established, now)
+    tables = nat44_touch(tables, nat_hit_idx, nat_reversed, now)
+
+    # permit == (local & glob) | established on every alive packet by
+    # the dispatch invariant, so the classify is skipped outright
+    permit = established
+    drop_acl = alive & ~permit
+
+    fib = ip4_lookup(tables, pkts.dst_ip)
+    forwarded = alive & permit & fib.matched & (
+        fib.disp != int(Disposition.DROP)
+    )
+    disp = jnp.where(forwarded, fib.disp, int(Disposition.DROP)).astype(
+        jnp.int32
+    )
+    tx_if = jnp.where(forwarded, fib.tx_if, -1)
+
+    # the elided stages are statically empty under the invariant: hand
+    # the shared tail all-False masks (XLA folds the dead reductions)
+    false_p = jnp.zeros(alive.shape, bool)
+    return _finish_step(
+        tables, pkts, now, alive, drop_ip4, drop_acl, permit, fib,
+        forwarded, disp, tx_if, established, nat_reversed,
+        dnat_applied=false_p, snat_applied=false_p, dropped_nat=false_p,
+        sess_fail=false_p, natsess_fail=false_p, fastpath=jnp.int32(1),
+    )
+
+
+def pipeline_step_fast(
+    tables: DataplaneTables, pkts: PacketVector, now: jnp.ndarray
+) -> StepResult:
+    """The classify-free established-flow kernel, standalone:
+    ip4-input → session lookup/touch → NAT reverse/touch → FIB → tx.
+
+    Bit-exact with ``pipeline_step`` ONLY when every valid packet hits
+    a live reflective session and none DNAT-matches — the invariant
+    ``pipeline_step_auto``'s dispatch predicate guarantees. Exposed on
+    its own for the differential test and the bench's speedup capture;
+    production traffic goes through the auto dispatcher.
+    """
+    pkts, drop_ip4, alive = _ingress(tables, pkts)
+    established, sess_hit_idx = session_lookup_reverse_idx(tables, pkts, now)
+    established = established & alive
+    pkts, nat_reversed, nat_hit_idx = nat44_reverse(tables, pkts, alive, now)
+    return _pipeline_fast_finish(
+        tables, pkts, now, alive, drop_ip4, established, sess_hit_idx,
+        nat_reversed, nat_hit_idx,
+    )
+
+
+def pipeline_step_auto(
+    tables: DataplaneTables,
+    pkts: PacketVector,
+    now: jnp.ndarray,
+    acl_global_fn=acl_classify_global,
+) -> StepResult:
+    """Two-tier dispatch: the fast kernel when the whole batch rides
+    established sessions, the full chain otherwise.
+
+    The predicate work (ip4-input, session summary, NAT reverse, DNAT
+    probe) is computed once up front; the fast branch reuses it via
+    closure, the full branch recomputes inside ``pipeline_step`` —
+    paying a second session/NAT lookup only on the path that is about
+    to pay the full classifier anyway. ``lax.cond`` executes exactly
+    one branch per batch, so steady-state (all-established) traffic
+    never touches the ACL tables.
+
+    The predicate additionally requires that NO packet would DNAT-match
+    after un-NAT: a reflective-session hit whose destination is also a
+    service VIP still takes the full chain, because the full chain
+    DNATs it and records NAT state the fast kernel elides.
+    """
+    from jax import lax
+
+    orig_pkts = pkts
+    pkts1, drop_ip4, alive = _ingress(tables, pkts)
+    hits, sess_hit_idx, all_hit = session_batch_summary(
+        tables, pkts1, alive, now
+    )
+    # NAT reverse runs before the DNAT probe: the un-NAT'd header is
+    # what the full chain would hand nat44_dnat
+    rpkts, nat_reversed, nat_hit_idx = nat44_reverse(
+        tables, pkts1, alive, now
+    )
+    dnat_would = nat44_dnat_match(tables, rpkts, alive & ~nat_reversed)
+    ok = all_hit & ~jnp.any(dnat_would)
+
+    def fast(_):
+        return _pipeline_fast_finish(
+            tables, rpkts, now, alive, drop_ip4, hits, sess_hit_idx,
+            nat_reversed, nat_hit_idx,
+        )
+
+    def full(_):
+        return pipeline_step(tables, orig_pkts, now, acl_global_fn)
+
+    return lax.cond(ok, fast, full, None)
+
+
+def pipeline_step_auto_mxu(
+    tables: DataplaneTables, pkts: PacketVector, now: jnp.ndarray
+) -> StepResult:
+    """pipeline_step_auto whose full-chain branch classifies the global
+    table on the MXU bit-plane kernel — the fast branch has no
+    classifier at all, so the tiers differ only on the slow side."""
+    from vpp_tpu.ops.acl_mxu import acl_classify_global_mxu
+
+    return pipeline_step_auto(
+        tables, pkts, now, acl_global_fn=acl_classify_global_mxu
+    )
 
 
 pipeline_step_jit = jax.jit(pipeline_step, donate_argnums=())

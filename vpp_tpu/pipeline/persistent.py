@@ -38,7 +38,7 @@ from vpp_tpu.pipeline.dataplane import (
     PACKED_IN_ROWS,
     _packed_call,
 )
-from vpp_tpu.pipeline.graph import pipeline_step
+from vpp_tpu.pipeline.graph import pipeline_step, pipeline_step_auto
 
 STOP = np.int32(-1)
 
@@ -50,10 +50,20 @@ class PersistentPump:
     hands a [5, B] packed frame to the loop, ``results`` yields
     [5, B] packed outputs in order. ``stop()`` makes the device loop
     exit and the driver thread return the final tables.
+
+    ``fastpath=True`` (default) runs the two-tier auto dispatcher
+    inside the resident loop: an all-established frame takes the
+    classify-free kernel — the latency-floor regime is exactly where
+    steady-state return traffic lives, so the resident loop benefits
+    the most. Each delivered frame carries its [3] fast-path summary
+    (``[fastpath, rx, sess_hits]``) through the same ordered deliver
+    callback; ``result_ex()`` exposes it, ``result()`` drops it.
     """
 
-    def __init__(self, tables, batch: int, max_frames: int = 1 << 20):
+    def __init__(self, tables, batch: int, max_frames: int = 1 << 20,
+                 fastpath: bool = True):
         self.batch = int(batch)
+        self.fastpath_enabled = bool(fastpath)
         self._in: "queue.Queue" = queue.Queue()
         self._out: "queue.Queue" = queue.Queue()
         self._tables_final = None
@@ -61,7 +71,10 @@ class PersistentPump:
         self._thread: Optional[threading.Thread] = None
         self._max_frames = max_frames
         self._tables0 = tables
-        self._step = _packed_call(pipeline_step)
+        step_fn = pipeline_step_auto if fastpath else pipeline_step
+        # aux always on: the plain chain reports fastpath=0, so the
+        # deliver callback keeps ONE shape either way
+        self._step = _packed_call(step_fn, with_aux=True)
 
         self._stop_seen = False
 
@@ -75,8 +88,8 @@ class PersistentPump:
                     (PACKED_IN_ROWS, self.batch), np.int32)
             return np.int32(item[0]), item[1]
 
-        def host_deliver(out_frame):
-            self._out.put(np.asarray(out_frame))
+        def host_deliver(out_frame, aux):
+            self._out.put((np.asarray(out_frame), np.asarray(aux)))
             return np.int32(0)
 
         fetch_shape = (
@@ -97,9 +110,9 @@ class PersistentPump:
                 stopped = ctl < 0
 
                 def run(t):
-                    t2, out = self._step(t, flat, ctl)
+                    t2, out, aux = self._step(t, flat, ctl)
                     _ = io_callback(host_deliver, deliver_shape, out,
-                                    ordered=True)
+                                    aux, ordered=True)
                     return t2
 
                 tables2 = lax.cond(stopped, lambda t: t, run, tables_)
@@ -146,6 +159,12 @@ class PersistentPump:
         self._in.put((now, np.array(flat, np.int32, copy=True)))
 
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        return self.result_ex(timeout=timeout)[0]
+
+    def result_ex(self, timeout: Optional[float] = None):
+        """Like result(), but returns ``(out, aux)`` where ``aux`` is
+        the frame's [3] int32 fast-path summary
+        ``[fastpath, rx, sess_hits]`` (the pump's regime telemetry)."""
         try:
             return self._out.get(timeout=timeout)
         except queue.Empty:

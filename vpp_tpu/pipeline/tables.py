@@ -54,6 +54,16 @@ class DataplaneConfig(NamedTuple):
     sess_max_age: int = 3000
     nat_mappings: int = 64     # DNAT static mapping slots
     nat_backends: int = 512    # total backend slots across mappings
+    # Two-tier established-flow fast path (pipeline/graph.py
+    # pipeline_step_auto): batches where every valid packet hits a live
+    # reflective session dispatch to a classify-free kernel. ``fastpath``
+    # is the master switch; ``fastpath_min_rules`` gates engagement on
+    # the global table size (below it the classifier is cheap enough
+    # that the dispatch predicate buys nothing — the mxu_threshold
+    # analog). Both kernels (and their MXU variants) are compiled and
+    # cached per epoch by the Dataplane exactly like the full chain.
+    fastpath: bool = True
+    fastpath_min_rules: int = 0
 
 
 class DataplaneTables(NamedTuple):

@@ -67,6 +67,10 @@ PUMP_STAT_GAUGES = (
      "device program"),
     ("chain_k_peak", "vpp_tpu_pump_chain_k_peak",
      "largest chain fold depth K used"),
+    # two-tier fast path (pipeline/graph.py pipeline_step_auto)
+    ("fastpath_batches", "vpp_tpu_pump_fastpath_batches",
+     "pump dispatches fully served by the classify-free "
+     "established-flow kernel (chain folds count once)"),
 )
 
 # pump.stats stage-seconds key -> `stage` label of the
@@ -88,6 +92,10 @@ PUMP_GAUGES = tuple(
      "median dispatch-to-tx batch latency (recent window)"),
     ("vpp_tpu_pump_batch_latency_p99_us",
      "p99 dispatch-to-tx batch latency (recent window)"),
+    ("vpp_tpu_pump_fastpath_hit_pct",
+     "percentage of alive packets admitted via a live reflective "
+     "session — the fast-path regime signal (100 = pure established "
+     "return traffic)"),
 )
 
 VCL_GAUGES = (
@@ -119,7 +127,47 @@ NODE_GAUGES = (
      "NAT-session inserts that found no free probe slot"),
     ("vpp_tpu_node_sess_occupancy", "live (unexpired) reflective slots"),
     ("vpp_tpu_node_natsess_occupancy", "live (unexpired) NAT-session slots"),
+    ("vpp_tpu_node_dnat_packets", "DNAT translations applied (forwarded)"),
+    ("vpp_tpu_node_snat_packets", "SNAT translations applied (forwarded)"),
+    ("vpp_tpu_node_nat_reversed_packets",
+     "reply-path un-NAT translations applied (forwarded)"),
+    # two-tier fast path: the vpp_tpu_pipeline_* namespace mirrors the
+    # StepStats fields behind the tools/lint.py --counters parity pass
+    ("vpp_tpu_pipeline_sess_hits",
+     "packets admitted via a live reflective session"),
+    ("vpp_tpu_pipeline_fastpath_steps",
+     "pipeline steps served by the classify-free established-flow "
+     "kernel"),
 )
+
+# StepStats field → the Prometheus family its value feeds. The single
+# source of truth behind the tools/lint.py ``--counters`` parity pass:
+# every StepStats field MUST appear here with a registered family, and
+# every registered ``vpp_tpu_pipeline_*`` family must map back to a
+# field — a counter added on either side without its twin fails tier-1.
+STEPSTATS_FAMILIES = {
+    "rx": "vpp_tpu_node_rx_packets",
+    "tx": "vpp_tpu_node_tx_packets",
+    "drop_ip4": "vpp_tpu_node_drop_ip4",
+    "drop_acl": "vpp_tpu_node_drop_acl",
+    "drop_no_route": "vpp_tpu_node_drop_no_route",
+    "punt": "vpp_tpu_if_punt_packets",
+    "dnat": "vpp_tpu_node_dnat_packets",
+    "snat": "vpp_tpu_node_snat_packets",
+    "nat_reversed": "vpp_tpu_node_nat_reversed_packets",
+    "drop_nat": "vpp_tpu_node_drop_nat",
+    "sess_insert_fail": "vpp_tpu_node_sess_insert_fail",
+    "natsess_insert_fail": "vpp_tpu_node_natsess_insert_fail",
+    "sess_occupancy": "vpp_tpu_node_sess_occupancy",
+    "natsess_occupancy": "vpp_tpu_node_natsess_occupancy",
+    "if_rx": "vpp_tpu_if_in_packets",
+    "if_tx": "vpp_tpu_if_out_packets",
+    "if_rx_bytes": "vpp_tpu_if_in_bytes",
+    "if_tx_bytes": "vpp_tpu_if_out_bytes",
+    "if_drops": "vpp_tpu_if_drop_packets",
+    "sess_hits": "vpp_tpu_pipeline_sess_hits",
+    "fastpath": "vpp_tpu_pipeline_fastpath_steps",
+}
 
 
 class StatsCollector:
@@ -144,7 +192,9 @@ class StatsCollector:
         self._totals: Dict[str, int] = {
             k: 0 for k in ("rx", "tx", "drop_ip4", "drop_acl",
                            "drop_no_route", "punt", "drop_nat",
-                           "sess_insert_fail", "natsess_insert_fail")
+                           "sess_insert_fail", "natsess_insert_fail",
+                           "dnat", "snat", "nat_reversed",
+                           "sess_hits", "fastpath")
         }
         # gauges, not counters: last-step snapshots
         self._last: Dict[str, int] = {
@@ -172,6 +222,18 @@ class StatsCollector:
             Histogram(
                 "vpp_tpu_pump_batch_seconds",
                 "dispatch-to-tx batch latency of the IO pump",
+                buckets=PUMP_LATENCY_BUCKETS,
+            ),
+        )
+        # the fast-tier slice of the distribution above: only batches
+        # the classify-free kernel served observe here, so the two
+        # histograms side by side ARE the measured two-tier split
+        self.fastpath_batch_hist = self.registry.register(
+            STATS_PATH,
+            Histogram(
+                "vpp_tpu_fastpath_batch_seconds",
+                "dispatch-to-tx latency of batches served by the "
+                "classify-free established-flow fast path",
                 buckets=PUMP_LATENCY_BUCKETS,
             ),
         )
@@ -205,6 +267,7 @@ class StatsCollector:
         self.pump = pump
         try:
             pump.latency_hist = self.pump_batch_hist
+            pump.fastpath_hist = self.fastpath_batch_hist
         except AttributeError:
             pass  # exotic pump stand-ins (slotted fakes) keep gauges only
 
@@ -301,6 +364,14 @@ class StatsCollector:
             totals["sess_insert_fail"])
         self.node_gauges["vpp_tpu_node_natsess_insert_fail"].set(
             totals["natsess_insert_fail"])
+        self.node_gauges["vpp_tpu_node_dnat_packets"].set(totals["dnat"])
+        self.node_gauges["vpp_tpu_node_snat_packets"].set(totals["snat"])
+        self.node_gauges["vpp_tpu_node_nat_reversed_packets"].set(
+            totals["nat_reversed"])
+        self.node_gauges["vpp_tpu_pipeline_sess_hits"].set(
+            totals["sess_hits"])
+        self.node_gauges["vpp_tpu_pipeline_fastpath_steps"].set(
+            totals["fastpath"])
         with self._lock:
             last = dict(self._last)
         self.node_gauges["vpp_tpu_node_sess_occupancy"].set(
@@ -327,6 +398,12 @@ class StatsCollector:
                 lat["p50"])
             self.pump_gauges["vpp_tpu_pump_batch_latency_p99_us"].set(
                 lat["p99"])
+            # derived, not raw: percentage of alive packets riding
+            # established sessions (0 when the pump hasn't seen traffic)
+            alive = int(ps.get("fastpath_alive", 0))
+            hits = int(ps.get("fastpath_hits", 0))
+            self.pump_gauges["vpp_tpu_pump_fastpath_hit_pct"].set(
+                100.0 * hits / alive if alive else 0.0)
         vcl = self.vcl
         if vcl is not None:
             vs = dict(vcl.stats)
