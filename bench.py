@@ -374,6 +374,70 @@ def commit_bench(args, iters: int = 10) -> dict:
     return out
 
 
+def acl_classifier_bench(args, batch: int = 2048, iters: int = 20) -> dict:
+    """Classifier shoot-out (ISSUE 4 tentpole): dense vs MXU vs BV
+    global classify in isolation at 1,024 and the headline rule count,
+    order-alternated medians like the ``sess_election_*`` pattern (a
+    fixed order biased those r4 numbers by warmup/cache state). Each
+    round re-validates the ``classifier: auto`` default with evidence:
+
+      * ``acl_classifier_selected``      — what auto picked at the
+        headline count on THIS backend
+      * ``acl_classify_{dense,mxu,bv}_ns_pkt`` (+ ``_1k`` variants)
+      * ``acl_bv_build_ms``              — commit-time structure build
+      * ``acl_classifier_speedup_bv_vs_dense`` (acceptance: >= 5x at
+        10,240 rules on the CPU harness)
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from vpp_tpu.pipeline.graph import _classifier_fns
+
+    out = {}
+    for n_rules in sorted({1024, args.rules}):
+        suffix = "" if n_rules == args.rules else "_1k"
+        dp, uplink = build_dataplane(n_rules, 4)
+        pkts = build_traffic(batch, uplink, seed=17)
+        if n_rules == args.rules:
+            out["acl_classifier_selected"] = dp.classifier_impl
+            out["acl_classifier_rules"] = n_rules
+        if dp.builder.bv_enabled:
+            out[f"acl_bv_build_ms{suffix}"] = round(
+                dp.builder.bv_build_ms, 2)
+        impls = ["dense", "bv"] if dp.builder.bv_enabled else ["dense"]
+        if dp.builder.mxu_enabled and dp.builder.glb_mxu.ok:
+            impls.insert(1, "mxu")
+        fns = {}
+        for impl in impls:
+            fns[impl] = jax.jit(_classifier_fns(impl)[0])
+            jax.block_until_ready(fns[impl](dp.tables, pkts).permit)
+        acc = {impl: [] for impl in impls}
+        for rep in range(3):
+            order = impls if rep % 2 == 0 else impls[::-1]
+            for impl in order:
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    v = fns[impl](dp.tables, pkts)
+                jax.block_until_ready(v.permit)
+                acc[impl].append(
+                    (time.perf_counter() - t0) / iters / batch * 1e9)
+        for impl, vals in acc.items():
+            out[f"acl_classify_{impl}_ns_pkt{suffix}"] = round(
+                float(np.median(vals)), 1)
+        if n_rules == args.rules:
+            # fold the probe time into the observability twin of this
+            # measurement (vpp_tpu_pump_stage_seconds{stage="classify"})
+            try:
+                dp.time_classifier(batch=min(batch, 256), iters=4)
+            except Exception:  # noqa: BLE001 — diagnostic only
+                pass
+    dense = out.get("acl_classify_dense_ns_pkt")
+    bv = out.get("acl_classify_bv_ns_pkt")
+    if dense and bv:
+        out["acl_classifier_speedup_bv_vs_dense"] = round(dense / bv, 2)
+    return out
+
+
 def fastpath_bench(args, iters: int = 12, batch: int = 2048) -> dict:
     """Two-tier fast path (ISSUE 3 tentpole): the classify-free
     established-flow kernel vs the full fused chain on an IDENTICAL
@@ -392,19 +456,16 @@ def fastpath_bench(args, iters: int = 12, batch: int = 2048) -> dict:
     import jax
     import jax.numpy as jnp
 
-    from vpp_tpu.pipeline.graph import (
-        pipeline_step as _full,
-        pipeline_step_auto as _auto,
-        pipeline_step_auto_mxu as _auto_mxu,
-        pipeline_step_mxu as _full_mxu,
-    )
+    from vpp_tpu.pipeline.graph import make_pipeline_step
     from vpp_tpu.pipeline.vector import Disposition, FLAG_VALID, PacketVector
 
     dp, uplink = build_dataplane(args.rules, 4)
-    # mirror the dataplane's own kernel selection so the comparison is
-    # the DEPLOYED full chain vs the deployed fast tier
-    step_full = jax.jit(_full_mxu if dp._use_mxu else _full)
-    step_auto = jax.jit(_auto_mxu if dp._use_mxu else _auto)
+    # mirror the dataplane's own kernel selection (classifier impl +
+    # local-skip gate) so the comparison is the DEPLOYED full chain vs
+    # the deployed fast tier
+    impl, skip = dp.classifier_impl, dp._skip_local
+    step_full = jax.jit(make_pipeline_step(impl, skip, fast=False))
+    step_auto = jax.jit(make_pipeline_step(impl, skip, fast=True))
 
     fwd = build_traffic(batch, uplink, seed=21)
     r1 = step_full(dp.tables, fwd, jnp.int32(1))
@@ -1906,7 +1967,7 @@ def _run():
     import jax
     import jax.numpy as jnp
 
-    from vpp_tpu.pipeline.graph import pipeline_step, pipeline_step_mxu
+    from vpp_tpu.pipeline.graph import make_pipeline_step
 
     # CPU fallback: a full-size step costs ~8.5 s on this host (the
     # whole run would exceed typical driver timeouts and record
@@ -1945,6 +2006,13 @@ def _run():
         pri["commit_bench_error"] = f"{type(e).__name__}: {e}"
     _progress(**pri)
     try:
+        # classifier shoot-out (ISSUE 4): dense vs MXU vs BV at 1,024
+        # and the headline rule count — re-validates the auto default
+        pri.update(acl_classifier_bench(args))
+    except Exception as e:  # noqa: BLE001
+        pri["acl_classifier_bench_error"] = f"{type(e).__name__}: {e}"
+    _progress(**pri)
+    try:
         # tentpole capture: the two-tier fast path's measured win at
         # the headline rule count (acceptance: >= 3x on all-established)
         pri.update(fastpath_bench(args))
@@ -1964,7 +2032,10 @@ def _run():
         _progress(**pri)
 
     dp, uplink = build_dataplane(args.rules, args.backends)
-    step_fn = pipeline_step_mxu if dp._use_mxu else pipeline_step
+    # headline runs whatever the deployed dataplane selected (the
+    # classifier: auto ladder — BV at the 10k regime, re-validated by
+    # the acl_classifier_* shoot-out above)
+    step_fn = make_pipeline_step(dp.classifier_impl, dp._skip_local)
     step = jax.jit(step_fn, donate_argnums=(0,))
 
     # --- throughput: K chained steps, sessions threaded through ---
@@ -2063,7 +2134,9 @@ def _run():
         pdp, pup = build_dataplane(args.rules, args.backends)
         pflat = pack_frame(build_traffic(args.latency_frame, pup,
                                          seed=13), args.latency_frame)
-        pump_p = PersistentPump(pdp.tables, batch=args.latency_frame)
+        pump_p = PersistentPump(pdp.tables, batch=args.latency_frame,
+                                classifier=pdp.classifier_impl,
+                                skip_local=pdp._skip_local)
         pump_p.start()
         pump_p.submit(pflat, now=1)          # warm (traces the loop)
         pump_p.result(timeout=600)

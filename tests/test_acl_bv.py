@@ -1,0 +1,427 @@
+"""Bit-vector (BV) interval-bitmap classify vs the dense oracle.
+
+The BV compilation (vpp_tpu.ops.acl_bv) must reproduce the dense
+kernel's verdicts AND matched rule indices exactly for every rule
+shape: prefixes (incl. /0 wildcards), exact protocols and proto=-1,
+port edge cases (lo==hi, 0, 65535 and — unlike MXU — true ranges),
+overlapping priorities and padding rows; for the global table and the
+per-interface local tables. Also covers the incremental per-dimension
+plane rebuild, the epoch-time classifier selection (auto/threshold/
+memory cap), the policy-free local-classify skip, and the
+``tools/lint.py --tables`` invariant pass (run from tier-1 here).
+"""
+
+import ipaddress
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from vpp_tpu.ir.rule import Action, ContivRule, Protocol
+from vpp_tpu.ops.acl import acl_classify_global, acl_classify_local
+from vpp_tpu.ops.acl_bv import (
+    acl_classify_global_bv,
+    acl_classify_local_bv,
+    bv_first_match,
+    bv_global_bytes,
+    compile_bv,
+)
+from vpp_tpu.pipeline.dataplane import Dataplane
+from vpp_tpu.pipeline.tables import (
+    DataplaneConfig,
+    InterfaceType,
+    TableBuilder,
+    pack_rules,
+)
+from vpp_tpu.pipeline.vector import (
+    Disposition,
+    PacketVector,
+    make_packet_vector,
+)
+
+
+def _mask(plen):
+    return ((1 << 32) - 1) ^ ((1 << (32 - plen)) - 1) if plen else 0
+
+
+def random_rules(rng, n):
+    """Seeded-random tables over every expressible shape: wildcard
+    (mask 0 / no network), proto ANY, dport edge values 0/65535,
+    overlapping priorities (duplicate prefixes at different actions)."""
+    rules = []
+    for i in range(n):
+        plen = int(rng.integers(0, 33))
+        net = ipaddress.ip_network(
+            (int(rng.integers(0, 2**32)) & _mask(plen), plen))
+        dplen = int(rng.integers(0, 33))
+        dnet = ipaddress.ip_network(
+            (int(rng.integers(0, 2**32)) & _mask(dplen), dplen))
+        proto = [Protocol.ANY, Protocol.TCP, Protocol.UDP][
+            int(rng.integers(0, 3))]
+        dport = int(rng.choice([0, 80, 443, 8080, 65535]))
+        rules.append(ContivRule(
+            action=Action.PERMIT if rng.random() < 0.5 else Action.DENY,
+            src_network=net if rng.random() < 0.7 else None,
+            dest_network=dnet if rng.random() < 0.7 else None,
+            protocol=proto,
+            dest_port=dport if proto != Protocol.ANY else 0,
+        ))
+    return rules
+
+
+def random_packets(rng, n, rules, rx_if=1, max_if=None):
+    """Half random 5-tuples, half crafted into rule prefixes; rx_if
+    scalar or per-packet choices."""
+    src = rng.integers(0, 2**32, n, dtype=np.uint32)
+    dst = rng.integers(0, 2**32, n, dtype=np.uint32)
+    for i in range(n // 2):
+        r = rules[int(rng.integers(0, len(rules)))]
+        if r.src_network is not None:
+            src[i] = int(r.src_network.network_address) + int(rng.integers(
+                0, max(1, min(r.src_network.num_addresses, 1000))))
+        if r.dest_network is not None:
+            dst[i] = int(r.dest_network.network_address) + int(rng.integers(
+                0, max(1, min(r.dest_network.num_addresses, 1000))))
+    if max_if is None:
+        rxi = np.full(n, rx_if, np.int32)
+    else:
+        rxi = rng.integers(0, max_if, n).astype(np.int32)
+    return PacketVector(
+        src_ip=jnp.asarray(src),
+        dst_ip=jnp.asarray(dst),
+        proto=jnp.asarray(rng.choice([1, 6, 17], n).astype(np.int32)),
+        sport=jnp.asarray(rng.integers(0, 65536, n).astype(np.int32)),
+        dport=jnp.asarray(
+            rng.choice([0, 80, 443, 8080, 53, 65535], n).astype(np.int32)),
+        ttl=jnp.full((n,), 64, jnp.int32),
+        pkt_len=jnp.full((n,), 100, jnp.int32),
+        rx_if=jnp.asarray(rxi),
+        flags=jnp.ones((n,), jnp.int32),
+    )
+
+
+def _cfg(**kw):
+    base = dict(max_tables=4, max_rules=32, max_global_rules=128,
+                max_ifaces=8, fib_slots=16, sess_slots=64,
+                nat_mappings=2, nat_backends=4, classifier="bv")
+    base.update(kw)
+    return DataplaneConfig(**base)
+
+
+def _tables(rules, rng=None, n_local=0):
+    """Builder-committed device tables: uplink on if 1 (global
+    applies), pods on 2.. with local tables when asked."""
+    b = TableBuilder(_cfg())
+    b.set_interface(1, InterfaceType.UPLINK, apply_global=True)
+    b.set_global_table(rules)
+    for t in range(n_local):
+        b.set_interface(2 + t, InterfaceType.POD, local_table=t)
+        b.set_local_table(t, random_rules(rng, int(rng.integers(1, 28))))
+    # one pod with NO local table: must be permitted by the local stage
+    b.set_interface(2 + n_local, InterfaceType.POD, local_table=-1)
+    return b, b.to_device()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_global_bv_matches_dense(seed):
+    rng = np.random.default_rng(seed)
+    rules = random_rules(rng, 100)
+    _, t = _tables(rules)
+    pkts = random_packets(rng, 256, rules, rx_if=1)
+    want = acl_classify_global(t, pkts)
+    got = acl_classify_global_bv(t, pkts)
+    np.testing.assert_array_equal(np.asarray(got.permit),
+                                  np.asarray(want.permit))
+    np.testing.assert_array_equal(np.asarray(got.rule_idx),
+                                  np.asarray(want.rule_idx))
+
+
+@pytest.mark.parametrize("seed", [4, 5])
+def test_local_bv_matches_dense(seed):
+    rng = np.random.default_rng(seed)
+    rules = random_rules(rng, 40)
+    _, t = _tables(rules, rng=rng, n_local=3)
+    # packets across uplink, policied pods AND the tableless pod
+    pkts = random_packets(rng, 256, rules, max_if=6)
+    want = acl_classify_local(t, pkts)
+    got = acl_classify_local_bv(t, pkts)
+    np.testing.assert_array_equal(np.asarray(got.permit),
+                                  np.asarray(want.permit))
+    np.testing.assert_array_equal(np.asarray(got.rule_idx),
+                                  np.asarray(want.rule_idx))
+
+
+def test_port_ranges_and_padding_rows():
+    """True port ranges are the BV scheme's home turf (the MXU planes
+    fall back on them): inject ranges + collapsed (lo==hi) + full-span
+    edges at the packed level and diff against the dense first-match."""
+    from vpp_tpu.ops import acl
+
+    cap = 16
+    packed = pack_rules(
+        [ContivRule(action=Action.PERMIT, protocol=Protocol.TCP,
+                    dest_port=80) for _ in range(6)], cap)
+    packed["dport_lo"][0], packed["dport_hi"][0] = 100, 200     # range
+    packed["dport_lo"][1], packed["dport_hi"][1] = 0, 0         # edge 0
+    packed["dport_lo"][2], packed["dport_hi"][2] = 65535, 65535
+    packed["dport_lo"][3], packed["dport_hi"][3] = 0, 65535     # any
+    packed["sport_lo"][4], packed["sport_hi"][4] = 1000, 1000   # lo==hi
+    bv, _, _ = compile_bv(packed, cap)
+    assert bv.ok
+    rng = np.random.default_rng(9)
+    n = 256
+    pkts = PacketVector(
+        src_ip=jnp.asarray(rng.integers(0, 2**32, n, dtype=np.uint32)),
+        dst_ip=jnp.asarray(rng.integers(0, 2**32, n, dtype=np.uint32)),
+        proto=jnp.asarray(rng.choice([6, 7, 17], n).astype(np.int32)),
+        sport=jnp.asarray(
+            rng.choice([0, 999, 1000, 1001, 65535], n).astype(np.int32)),
+        dport=jnp.asarray(
+            rng.choice([0, 1, 80, 99, 100, 150, 200, 201, 65534, 65535],
+                       n).astype(np.int32)),
+        ttl=jnp.full((n,), 64, jnp.int32),
+        pkt_len=jnp.full((n,), 100, jnp.int32),
+        rx_if=jnp.ones((n,), jnp.int32),
+        flags=jnp.ones((n,), jnp.int32),
+    )
+    matched, rule = bv_first_match(
+        bv.bnd_src, bv.bnd_dst, bv.bnd_sport, bv.bnd_dport,
+        jnp.asarray(bv.nbnd), jnp.asarray(bv.bm_src),
+        jnp.asarray(bv.bm_dst), jnp.asarray(bv.bm_sport),
+        jnp.asarray(bv.bm_dport), jnp.asarray(bv.bm_proto), pkts)
+    dense = acl._first_match(
+        pkts,
+        jnp.asarray(packed["src_net"]), jnp.asarray(packed["src_mask"]),
+        jnp.asarray(packed["dst_net"]), jnp.asarray(packed["dst_mask"]),
+        jnp.asarray(packed["proto"]),
+        jnp.asarray(packed["sport_lo"]), jnp.asarray(packed["sport_hi"]),
+        jnp.asarray(packed["dport_lo"]), jnp.asarray(packed["dport_hi"]),
+        jnp.asarray(packed["action"]), jnp.int32(6))
+    np.testing.assert_array_equal(np.asarray(rule),
+                                  np.asarray(dense.rule_idx))
+    assert bool(np.asarray(matched).any())  # the crafted ports do hit
+
+
+def test_non_prefix_mask_fails_closed():
+    """A non-contiguous address mask is not one interval: the compile
+    must flag ok=False AND exclude the rule (miss, never mismatch)."""
+    packed = pack_rules(
+        [ContivRule(action=Action.PERMIT, protocol=Protocol.TCP,
+                    dest_port=80)], 8)
+    packed["src_mask"][0] = 0xFF00FF00
+    packed["src_net"][0] = 0x0A000A00
+    bv, _, _ = compile_bv(packed, 8)
+    assert not bv.ok
+    assert not bv.bm_src.any()  # the rule contributed no interval
+
+
+class TestIncrementalRebuild:
+    """The per-dimension incremental compile must (a) rebuild ONLY the
+    planes whose intervals moved and (b) stay bit-identical to a
+    from-scratch build across add/remove churn."""
+
+    def _assert_equal(self, got, want):
+        for f in ("bnd_src", "bnd_dst", "bnd_sport", "bnd_dport",
+                  "nbnd", "bm_src", "bm_dst", "bm_sport", "bm_dport",
+                  "bm_proto"):
+            np.testing.assert_array_equal(
+                getattr(got, f), getattr(want, f), err_msg=f)
+        assert got.ok == want.ok
+
+    def test_port_only_churn_keeps_address_planes(self):
+        b = TableBuilder(_cfg())
+        rules = [
+            ContivRule(action=Action.PERMIT, protocol=Protocol.TCP,
+                       src_network=ipaddress.ip_network(f"10.{i}.0.0/16"),
+                       dest_port=8000 + i)
+            for i in range(20)
+        ]
+        b.set_global_table(rules)
+        addr_src = b.glb_bv.bm_src
+        churned = list(rules)
+        churned[3] = ContivRule(
+            action=Action.PERMIT, protocol=Protocol.TCP,
+            src_network=rules[3].src_network, dest_port=9999)
+        b.set_global_table(churned)
+        # only the dport plane moved; src/dst/sport/proto carried over
+        assert b.bv_rebuilt == ("dport",)
+        assert b.glb_bv.bm_src is addr_src  # reference-carried, not rebuilt
+        # and the carried structure still equals a from-scratch build
+        want, _, _ = compile_bv(pack_rules(churned, 128), 128)
+        self._assert_equal(b.glb_bv, want)
+
+    def test_add_remove_parity_vs_scratch(self):
+        rng = np.random.default_rng(7)
+        b = TableBuilder(_cfg())
+        rules = random_rules(rng, 30)
+        for step in range(8):
+            b.set_global_table(rules)
+            want, _, _ = compile_bv(pack_rules(rules, 128), 128)
+            self._assert_equal(b.glb_bv, want)
+            rules = list(rules)
+            op = step % 3
+            if op == 0:
+                rules.insert(2, ContivRule(action=Action.DENY,
+                                           protocol=Protocol.UDP,
+                                           dest_port=53))
+            elif op == 1:
+                del rules[4:9]
+            else:
+                rules.extend(random_rules(rng, 5))
+
+    def test_snapshot_restore_invalidates_cache(self):
+        b = TableBuilder(_cfg())
+        r1 = [ContivRule(action=Action.PERMIT, protocol=Protocol.TCP,
+                         dest_port=80)]
+        r2 = [ContivRule(action=Action.DENY, protocol=Protocol.TCP,
+                         dest_port=443)]
+        b.set_global_table(r1)
+        snap = b.state_snapshot()
+        b.set_global_table(r2)
+        b.state_restore(snap)
+        b.set_global_table(r2)
+        want, _, _ = compile_bv(pack_rules(r2, 128), 128)
+        self._assert_equal(b.glb_bv, want)
+
+
+def _mk_dp(n_rules, **cfg_kw):
+    cfg = DataplaneConfig(
+        max_tables=2, max_rules=16, max_global_rules=max(n_rules, 16),
+        max_ifaces=8, fib_slots=16, sess_slots=64, nat_mappings=2,
+        nat_backends=4, **cfg_kw)
+    dp = Dataplane(cfg)
+    up = dp.add_uplink()
+    pod = dp.add_pod_interface(("ns", "p"))
+    dp.builder.add_route("10.1.1.2/32", pod, Disposition.LOCAL)
+    rules = [
+        ContivRule(action=Action.PERMIT, protocol=Protocol.TCP,
+                   dest_port=8000 + i)
+        for i in range(n_rules - 1)
+    ] + [ContivRule(action=Action.DENY)]
+    dp.builder.set_global_table(rules)
+    dp.swap()
+    return dp, up
+
+
+def test_auto_selection_regates_at_swap():
+    """`classifier: auto` picks BV at/above the rule threshold and
+    dense below it, re-gated at each epoch swap, with the selection
+    visible in `show acl` and the Prometheus info gauge."""
+    from vpp_tpu.cli import DebugCLI
+    from vpp_tpu.stats.collector import StatsCollector
+
+    dp, _ = _mk_dp(64, classifier="auto", classifier_bv_min_rules=32)
+    dp.mxu_threshold = 1 << 30  # park MXU: this test walks bv<->dense
+    dp.swap()
+    assert dp.classifier_impl == "bv"  # threshold 32 <= 64 rules
+    assert "classifier: bv" in DebugCLI(dp).run("show acl")
+    coll = StatsCollector(dp)
+    coll.publish()
+    page = coll.registry.render("/stats")
+    assert 'vpp_tpu_acl_classifier{impl="bv"} 1' in page
+    assert 'vpp_tpu_acl_classifier{impl="dense"} 0' in page
+    # shrink below the threshold: the SAME dataplane re-gates to dense
+    dp.builder.set_global_table(
+        [ContivRule(action=Action.DENY, protocol=Protocol.TCP,
+                    dest_port=23)])
+    dp.swap()
+    assert dp.classifier_impl == "dense"
+    assert "classifier: dense" in DebugCLI(dp).run("show acl")
+
+
+def test_auto_selection_initial_epoch():
+    """__init__ evaluates the selection against the (empty) staged
+    builder — dense at 0 rules — and the first committing swap flips
+    it to BV in the same dataplane."""
+    cfg = DataplaneConfig(
+        max_tables=2, max_rules=16, max_global_rules=64, max_ifaces=8,
+        fib_slots=16, sess_slots=64, nat_mappings=2, nat_backends=4,
+        classifier="auto", classifier_bv_min_rules=8)
+    dp = Dataplane(cfg)
+    dp.mxu_threshold = 1 << 30
+    assert dp.classifier_impl == "dense"
+    dp.builder.set_global_table(
+        [ContivRule(action=Action.PERMIT, protocol=Protocol.TCP,
+                    dest_port=8000 + i) for i in range(16)])
+    dp.swap()
+    assert dp.classifier_impl == "bv"
+
+
+def test_memory_cap_disables_bv():
+    """auto honors classifier_bv_mem_mb: a cap below the structure
+    size keeps the builder off BV entirely (minimal placeholder
+    shapes) and the selection on the dense/MXU ladder."""
+    dp, _ = _mk_dp(64, classifier="auto", classifier_bv_min_rules=1,
+                   classifier_bv_mem_mb=0)
+    assert not dp.builder.bv_enabled
+    assert int(dp.tables.glb_bv_src.shape[0]) == 2  # placeholder
+    dp.swap()
+    assert dp.classifier_impl != "bv"
+    assert bv_global_bytes(64) > 0
+
+
+def test_bv_end_to_end_matches_dense_dataplane():
+    """Full pipeline differential: identical config except the
+    classifier knob must produce identical dispositions/counters."""
+    rng = np.random.default_rng(11)
+    flows = [(int(rng.integers(1024, 65000)),
+              int(rng.choice([8000, 8005, 23, 80])))
+             for _ in range(64)]
+    out = {}
+    for knob in ("dense", "bv"):
+        dp, up = _mk_dp(48, classifier=knob)
+        if knob == "bv":
+            assert dp.classifier_impl == "bv"
+        pkts = make_packet_vector(
+            [{"src": "1.2.3.4", "dst": "10.1.1.2", "proto": 6,
+              "sport": sp, "dport": dp_, "rx_if": up}
+             for sp, dp_ in flows])
+        res = dp.process(pkts)
+        out[knob] = (np.asarray(res.disp), np.asarray(res.drop_cause),
+                     int(res.stats.drop_acl))
+    np.testing.assert_array_equal(out["dense"][0], out["bv"][0])
+    np.testing.assert_array_equal(out["dense"][1], out["bv"][1])
+    assert out["dense"][2] == out["bv"][2]
+
+
+def test_skip_local_gate_regates_at_swap():
+    """Policy-free nodes compile the local stage away; assigning a
+    local table re-gates at the next swap with identical verdicts."""
+    dp, up = _mk_dp(16, classifier="dense")
+    assert dp._skip_local  # no interface points at a local table
+    pkts = make_packet_vector(
+        [{"src": "1.2.3.4", "dst": "10.1.1.2", "proto": 6,
+          "sport": 1000, "dport": 8000, "rx_if": up}])
+    permit_before = bool(np.asarray(dp.process(pkts).disp)[0]
+                         == int(Disposition.LOCAL))
+    slot = dp.alloc_table_slot("T1")
+    dp.builder.set_local_table(
+        slot, [ContivRule(action=Action.DENY)])
+    dp.builder.set_if_local_table(dp.pod_if[("ns", "p")], slot)
+    dp.swap()
+    assert not dp._skip_local
+    # the pod's local deny-all doesn't apply to uplink rx: verdict holds
+    permit_after = bool(np.asarray(dp.process(pkts).disp)[0]
+                        == int(Disposition.LOCAL))
+    assert permit_before == permit_after
+    # and unassigning flips the gate back
+    dp.builder.set_if_local_table(dp.pod_if[("ns", "p")], -1)
+    dp.swap()
+    assert dp._skip_local
+
+
+def test_tables_lint_invariants():
+    """tools/lint.py --tables, run from tier-1: boundary sort, word
+    width, padding inertness, capacity-constant consistency."""
+    import sys
+    from pathlib import Path
+
+    tools = Path(__file__).resolve().parent.parent / "tools"
+    sys.path.insert(0, str(tools))
+    try:
+        import lint as _lint
+
+        assert _lint.tables_lint() == []
+    finally:
+        sys.path.remove(str(tools))

@@ -27,6 +27,14 @@ registered Prometheus family, and every registered
 ``vpp_tpu_pipeline_*`` family must map back to a StepStats field —
 so a counter added in the kernel without its observability twin (or
 vice versa) fails tier-1 alongside --metrics.
+
+`--tables` runs the table-structure invariant pass over a
+representative BV-classifier commit (ops/acl_bv.py): interval
+boundaries strictly sorted per dimension, bitmap word width matching
+the padded rule capacity, padding provably inert (no bit of a rule
+row >= nrules set anywhere, interval rows past the live boundary
+count all-zero), and the BV/dense/MXU capacity constants consistent.
+Invoked from tier-1 (tests/test_acl_bv.py).
 """
 
 from __future__ import annotations
@@ -203,6 +211,132 @@ def counters_lint() -> list:
     return problems
 
 
+def _bv_plane_problems(name: str, bv, nrules: int, max_rules: int) -> list:
+    """Invariants of ONE compiled BvTable against its live rule count."""
+    import numpy as np
+
+    from vpp_tpu.ops.acl_bv import DIMS, bv_capacity
+
+    problems = []
+    cap_i, cap_w, cap_pr = bv_capacity(max_rules, True)
+    planes = {dim: getattr(bv, f"bm_{dim}") for dim in DIMS}
+    planes["proto"] = bv.bm_proto
+    for k, dim in enumerate(DIMS):
+        bnd = getattr(bv, f"bnd_{dim}")
+        n = int(bv.nbnd[k])
+        if len(bnd) != cap_i:
+            problems.append(
+                f"tables: {name}.{dim} boundary capacity {len(bnd)} != "
+                f"bv_capacity {cap_i}")
+        live = bnd[:n].astype(np.int64)
+        if n and not (np.diff(live) > 0).all():
+            problems.append(
+                f"tables: {name}.{dim} boundaries not strictly sorted")
+        if n and live[0] != 0:
+            problems.append(
+                f"tables: {name}.{dim} boundary[0] != 0 (value space "
+                f"must be fully covered)")
+    for pname, bm in planes.items():
+        if bm.shape[-1] != cap_w or cap_w != max(1, (max_rules + 31) // 32):
+            problems.append(
+                f"tables: {name}.{pname} word width {bm.shape[-1]} does "
+                f"not match padded rule capacity {max_rules}")
+        # padding inert, rule axis: no bit of a row >= nrules anywhere
+        for w in range(bm.shape[-1]):
+            lo_rule = w * 32
+            nbits = min(32, max(0, nrules - lo_rule))
+            allowed = np.uint32((1 << nbits) - 1)
+            if (bm[..., w] & ~allowed).any():
+                problems.append(
+                    f"tables: {name}.{pname} word {w} sets bits of "
+                    f"padding rules (nrules={nrules})")
+        # padding inert, interval axis: rows past the live boundary
+        # count must be all-zero (a clipped lookup can never land
+        # there; a stale bit would be a silent wrong-match hazard)
+        if pname != "proto":
+            n = int(bv.nbnd[list(DIMS).index(pname)])
+            if bm[n:].any():
+                problems.append(
+                    f"tables: {name}.{pname} has bits set in interval "
+                    f"rows >= nbnd ({n})")
+    return problems
+
+
+def tables_lint() -> list:
+    """Table-structure invariant pass (`--tables`): commit a
+    representative rule set through a BV-enabled TableBuilder and
+    validate the compiled structure + the cross-implementation
+    capacity constants. Returns problems."""
+    repo = str(Path(__file__).resolve().parent.parent)
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    import ipaddress
+
+    from vpp_tpu.ir.rule import Action, ContivRule, Protocol
+    from vpp_tpu.ops.acl_bv import bv_capacity, bv_global_bytes
+    from vpp_tpu.ops.acl_mxu import mxu_rule_capacity
+    from vpp_tpu.pipeline.tables import DataplaneConfig, TableBuilder
+
+    cfg = DataplaneConfig(
+        max_tables=2, max_rules=16, max_global_rules=96, max_ifaces=8,
+        fib_slots=16, sess_slots=64, nat_mappings=2, nat_backends=4,
+        classifier="bv")
+    b = TableBuilder(cfg)
+    rules = [
+        ContivRule(action=Action.PERMIT, protocol=Protocol.TCP,
+                   src_network=ipaddress.ip_network(f"10.{i}.0.0/16"),
+                   dest_port=80 + i)
+        for i in range(40)
+    ] + [
+        ContivRule(action=Action.DENY, protocol=Protocol.UDP,
+                   dest_port=0),
+        ContivRule(action=Action.PERMIT),        # wildcard everything
+        ContivRule(action=Action.DENY, protocol=Protocol.TCP,
+                   dest_port=65535),
+        ContivRule(action=Action.DENY),          # terminal deny-all
+    ]
+    b.set_global_table(rules)
+    b.set_local_table(0, rules[:7])
+    # slot 1 stays empty: its planes must be entirely inert
+
+    problems = _bv_plane_problems("glb", b.glb_bv, b.glb_nrules,
+                                  cfg.max_global_rules)
+    for slot, nrules in ((0, 7), (1, 0)):
+        from vpp_tpu.ops.acl_bv import BvTable
+
+        local = BvTable(
+            bnd_src=b.acl_bv["bnd_src"][slot],
+            bnd_dst=b.acl_bv["bnd_dst"][slot],
+            bnd_sport=b.acl_bv["bnd_sport"][slot],
+            bnd_dport=b.acl_bv["bnd_dport"][slot],
+            nbnd=b.acl_bv["nbnd"][slot],
+            bm_src=b.acl_bv["src"][slot], bm_dst=b.acl_bv["dst"][slot],
+            bm_sport=b.acl_bv["sport"][slot],
+            bm_dport=b.acl_bv["dport"][slot],
+            bm_proto=b.acl_bv["proto"][slot],
+            ok=bool(b.acl_bv_ok[slot]), build_ms=0.0,
+        )
+        problems += _bv_plane_problems(f"local[{slot}]", local, nrules,
+                                       cfg.max_rules)
+    # cross-implementation capacity constants
+    for r in (cfg.max_rules, cfg.max_global_rules, 1024, 10240):
+        ib, w, _pr = bv_capacity(r, True)
+        if ib != 2 * r + 2:
+            problems.append(
+                f"tables: bv interval capacity {ib} != 2*{r}+2")
+        if w * 32 < r:
+            problems.append(
+                f"tables: bv word capacity {w}*32 < {r} rules")
+        if mxu_rule_capacity(r) < r:
+            problems.append(
+                f"tables: mxu rule capacity {mxu_rule_capacity(r)} < {r}")
+        if bv_global_bytes(r) < ib * w * 4 * 4:
+            problems.append(
+                f"tables: bv_global_bytes({r}) smaller than its own "
+                f"bitmap matrices")
+    return problems
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     repo = Path(__file__).resolve().parent.parent
@@ -222,6 +356,8 @@ def main(argv=None) -> int:
         all_problems.extend(metrics_lint())
     if "--counters" in argv:
         all_problems.extend(counters_lint())
+    if "--tables" in argv:
+        all_problems.extend(tables_lint())
     for p in all_problems:
         print(p)
     print(f"lint: {len(files)} files, {len(all_problems)} problems")

@@ -190,7 +190,30 @@ class DebugCLI:
 
     def show_acl(self) -> str:
         dp = self.dp
-        lines = []
+        b = dp.builder
+        impl = getattr(dp, "classifier_impl", "dense")
+        knob = getattr(dp, "classifier", "auto")
+        lines = [
+            f"classifier: {impl} (knob {knob}), "
+            f"global rules {int(b.glb_nrules)}",
+        ]
+        if getattr(b, "bv_enabled", False):
+            from vpp_tpu.ops.acl_bv import bv_global_bytes
+
+            detail = (
+                f"  bv: bitmap {bv_global_bytes(dp.config.max_global_rules)}"
+                f" bytes, build {b.bv_build_ms:.2f} ms"
+            )
+            rebuilt = getattr(b, "bv_rebuilt", ())
+            if rebuilt:
+                detail += f", last rebuilt planes: {','.join(rebuilt)}"
+            if not b.bv_ok():
+                detail += " (NOT eligible: non-prefix mask rule)"
+            lines.append(detail)
+        ns = getattr(dp, "classify_ns_pkt", None)
+        if ns is not None:
+            lines.append(f"  classify probe: {ns:.1f} ns/pkt "
+                         f"(time_classifier diagnostic)")
         for table_id, slot in sorted(dp.table_slots.items()):
             n = int(dp.builder.acl_nrules[slot])
             lines.append(f"local table {table_id} (slot {slot}, {n} rules):")

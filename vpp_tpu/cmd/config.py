@@ -132,6 +132,10 @@ class AgentConfig:
     # dispatch, default on; ``dataplane.fastpath_min_rules``: engage it
     # only once the global ACL table holds at least this many rules —
     # below that the classifier is cheap and the dispatch buys nothing)
+    # + the global-classify implementation selection
+    # (``dataplane.classifier: dense|mxu|bv|auto`` with
+    # ``classifier_bv_min_rules`` / ``classifier_bv_mem_mb`` gating the
+    # auto ladder — docs/CLASSIFIER.md; re-evaluated at every epoch swap)
     dataplane: DataplaneConfig = dataclasses.field(default_factory=DataplaneConfig)
     # IPAM subnets
     ipam: IpamConfig = dataclasses.field(default_factory=IpamConfig)

@@ -511,8 +511,12 @@ class DataplanePump:
             tables = self.dp.tables
             epoch = self.dp.epoch
             fastpath = self.dp._use_fastpath
+            classifier = getattr(self.dp, "_classifier_impl", "dense")
+            skip_local = getattr(self.dp, "_skip_local", False)
         self._ppump = PersistentPump(tables, batch=VEC,
-                                     fastpath=fastpath).start()
+                                     fastpath=fastpath,
+                                     classifier=classifier,
+                                     skip_local=skip_local).start()
         self._persist_epoch = epoch
 
     def _persist_stop_merge(self) -> None:

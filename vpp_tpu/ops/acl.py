@@ -156,6 +156,20 @@ def acl_classify_local(tables: DataplaneTables, pkts: PacketVector) -> AclVerdic
     )
 
 
+def acl_local_none(tables: DataplaneTables, pkts: PacketVector) -> AclVerdict:
+    """The local-classify stage of a policy-free node: every interface's
+    ``if_local_table`` is -1, so the full gather-and-match would permit
+    everything anyway — this constant verdict lets the epoch compile
+    skip the local stage outright (Dataplane re-gates at every swap,
+    like the classifier selection). Bit-exact with acl_classify_local
+    under the all-empty invariant by construction."""
+    n = pkts.src_ip.shape[0]
+    return AclVerdict(
+        permit=jnp.ones((n,), bool),
+        rule_idx=jnp.full((n,), -1, jnp.int32),
+    )
+
+
 def assemble_global_verdict(
     tables: DataplaneTables,
     pkts: PacketVector,

@@ -338,7 +338,13 @@ class ClusterDataplane:
 
     def __init__(self, mesh: Mesh, config: Optional[DataplaneConfig] = None):
         self.mesh = mesh
-        self.config = config or DataplaneConfig()
+        # The cluster classify is rule-sharded dense/MXU (module doc of
+        # ops/acl_bv.py: interval bitmaps don't shard along the rule
+        # axis), so node builders never compile the BV structure —
+        # pinning the knob keeps the node-stacked BV pytree fields at
+        # their minimal placeholder shapes instead of ~100 MB per node.
+        self.config = (config or DataplaneConfig())._replace(
+            classifier="dense")
         self.n_nodes = mesh.shape[NODE_AXIS]
         rule_shards = mesh.shape[RULE_AXIS]
         from vpp_tpu.ops.acl_mxu import mxu_rule_capacity

@@ -28,11 +28,19 @@ RULE_AXIS = "rule"
 # Global-ACL row arrays are sharded over the rule axis as well as stacked
 # over nodes; everything else is only stacked per node. The bit-plane
 # arrays (ops/acl_mxu) shard their *rule* dimension, which for the coeff
-# matrix is axis 2 of the node-stacked array.
+# matrix is axis 2 of the node-stacked array. The BV interval-bitmap
+# arrays (ops/acl_bv) are EXCLUDED: a segment's bitmap row spans ALL
+# rules (the rule axis is packed into uint32 words, and the boundary
+# axis is data-dependent, not divisible by shard count), so the mesh
+# keeps its rule-sharded dense/MXU classify and the BV fields ride
+# node-stacked only (docs/CLASSIFIER.md — ClusterDataplane pins its
+# node configs to classifier="dense", so they are minimal placeholders).
 _RULE_SHARDED_FIELDS = frozenset(
     f
     for f in DataplaneTables._fields
-    if f.startswith("glb_") and f not in ("glb_nrules", "glb_mxu_coeff")
+    if f.startswith("glb_")
+    and not f.startswith("glb_bv_")
+    and f not in ("glb_nrules", "glb_mxu_coeff")
 )
 
 

@@ -94,7 +94,10 @@ class MultiHostCluster:
                  config: Optional[DataplaneConfig] = None,
                  rule_shards: int = 1):
         self.mesh = cluster_mesh(n_nodes, rule_shards)
-        self.config = config or DataplaneConfig()
+        # mesh classify is rule-sharded dense/MXU — pin the node
+        # builders off the BV structure (see ClusterDataplane)
+        self.config = (config or DataplaneConfig())._replace(
+            classifier="dense")
         self.n_nodes = n_nodes
         local_ids = {d.id for d in jax.local_devices()}
         self.local_nodes: List[int] = [

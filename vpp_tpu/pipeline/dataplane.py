@@ -24,10 +24,7 @@ import jax.numpy as jnp
 from vpp_tpu.ir.rule import PodID
 from vpp_tpu.pipeline.graph import (
     StepResult,
-    pipeline_step,
-    pipeline_step_auto,
-    pipeline_step_auto_mxu,
-    pipeline_step_mxu,
+    make_pipeline_step,
 )
 from vpp_tpu.pipeline.tables import (
     DataplaneConfig,
@@ -157,6 +154,31 @@ PACKED_IN_ROWS = 5
 PACKED_OUT_ROWS_N = 5
 
 
+# Jitted step variants, shared PROCESS-WIDE across Dataplane instances
+# (keyed by the selection gates + call form): make_pipeline_step is
+# memoized so the underlying function identity is stable, and sharing
+# the jit wrappers too means N dataplanes in one process (tests, the
+# bench, multi-instance agents) compile each variant once.
+_JIT_STEPS: Dict[tuple, object] = {}
+
+
+def _jitted_step(impl: str, skip_local: bool, fast: bool, form: str):
+    key = (impl, skip_local, fast, form)
+    step = _JIT_STEPS.get(key)
+    if step is None:
+        fn = make_pipeline_step(impl, skip_local, fast)
+        if form == "plain":
+            step = jax.jit(fn)
+        elif form == "packed":
+            step = jax.jit(_packed_call(fn, with_aux=True),
+                           donate_argnums=(1,))
+        else:
+            step = jax.jit(_chained_call(fn, with_aux=True),
+                           donate_argnums=(1,))
+        _JIT_STEPS[key] = step
+    return step
+
+
 def packed_input_zeros(n: int):
     """An all-invalid packed input batch (flags=0) — the pre-compile /
     warm-up argument for ``process_packed``."""
@@ -242,73 +264,59 @@ class Dataplane:
         # node events, service configurator) hold this across builder
         # mutations + swap().
         self.commit_lock = self._lock
-        self._step = jax.jit(pipeline_step)
-        self._step_mxu = jax.jit(pipeline_step_mxu)
-        # Two-tier dispatch variants (pipeline_step_auto): BOTH kernels
-        # — the classify-free fast path and the full chain — live in one
-        # jitted program behind a lax.cond, so an epoch swap caches both
+        # Step variants are built lazily through ONE factory
+        # (graph.make_pipeline_step), jit-cached PROCESS-WIDE per
+        # (classifier impl, skip-local, fast-tier, call form) — see
+        # _get_step / _jitted_step. The two-tier (fast) variants put
+        # BOTH kernels —
+        # the classify-free fast path and the full chain — behind a
+        # lax.cond in one jitted program, so an epoch swap caches both
         # compilations exactly like the plain step (jit keys on shapes,
-        # which are epoch-invariant). The MXU variant differs only in
-        # the full branch's classifier.
-        self._step_auto = jax.jit(pipeline_step_auto)
-        self._step_auto_mxu = jax.jit(pipeline_step_auto_mxu)
-        # donate the packed input: in and out are both [5, B] int32, so
-        # XLA aliases the buffers — one less device allocation + copy
-        # per batch on the hot path (the host never touches a batch
-        # after dispatch; each batch is a fresh buffer).
-        # ALL packed variants carry the aux summary — the plain chain
-        # reports fastpath=0 but still measures rx/sess_hits, so the
-        # hit-percentage regime signal exists even with the fast path
-        # disengaged (exactly when an operator is deciding whether to
-        # enable it).
-        self._step_packed = jax.jit(
-            _packed_call(pipeline_step, with_aux=True), donate_argnums=(1,)
-        )
-        self._step_packed_mxu = jax.jit(
-            _packed_call(pipeline_step_mxu, with_aux=True),
-            donate_argnums=(1,),
-        )
-        self._step_packed_auto = jax.jit(
-            _packed_call(pipeline_step_auto, with_aux=True),
-            donate_argnums=(1,),
-        )
-        self._step_packed_auto_mxu = jax.jit(
-            _packed_call(pipeline_step_auto_mxu, with_aux=True),
-            donate_argnums=(1,),
-        )
-        self._step_chain = jax.jit(
-            _chained_call(pipeline_step, with_aux=True), donate_argnums=(1,)
-        )
-        self._step_chain_mxu = jax.jit(
-            _chained_call(pipeline_step_mxu, with_aux=True),
-            donate_argnums=(1,),
-        )
-        self._step_chain_auto = jax.jit(
-            _chained_call(pipeline_step_auto, with_aux=True),
-            donate_argnums=(1,),
-        )
-        self._step_chain_auto_mxu = jax.jit(
-            _chained_call(pipeline_step_auto_mxu, with_aux=True),
-            donate_argnums=(1,),
-        )
+        # which are epoch-invariant; only the selection gates flip).
+        # Packed/chained forms donate the packed input (in and out are
+        # both [5, B] int32, so XLA aliases the buffers) and ALL carry
+        # the aux summary — the plain chain reports fastpath=0 but
+        # still measures rx/sess_hits, so the hit-percentage regime
+        # signal exists even with the fast path disengaged (exactly
+        # when an operator is deciding whether to enable it).
         self._encap = None  # jitted vxlan_encap, built on first use
-        # Flipped at swap(): large exact-port global tables classify on
-        # the MXU bit-plane kernel; small or range-rule tables stay dense.
-        self._use_mxu = False
+        # Classifier selection (re-evaluated at every swap, like the
+        # fast-path gate): the ``classifier`` knob picks
+        # dense | mxu | bv | auto; auto ladders BV above bv_min_rules
+        # (the memory cap is honored at builder allocation —
+        # ops/acl_bv.bv_enabled_for), MXU above mxu_threshold, dense
+        # below. ``_use_mxu`` is kept as the legacy boolean view of
+        # the selection (impl == "mxu").
+        self.classifier = getattr(self.config, "classifier", "auto")
         self.mxu_threshold = 512
+        self.bv_min_rules = int(
+            getattr(self.config, "classifier_bv_min_rules", 1024)
+        )
+        self._classifier_impl = "dense"
+        self._use_mxu = False
+        # Policy-free local-classify skip: when NO interface points at
+        # a local ACL table at swap time, the compiled step elides the
+        # local stage entirely (ops/acl.acl_local_none) — gathering
+        # full [P, R] rule rows against an all-(-1) if_local_table was
+        # pure waste on nodes without isolated pods.
+        self._skip_local = True
         # Established-flow fast path (two-tier dispatch). The enable +
         # min-rules threshold come from DataplaneConfig (YAML:
         # dataplane.fastpath / dataplane.fastpath_min_rules);
         # ``_use_fastpath`` is re-evaluated at every swap() against the
-        # staged global rule count, like ``_use_mxu``.
+        # staged global rule count, like the classifier selection.
         self.fastpath_enabled = bool(getattr(self.config, "fastpath", True))
         self.fastpath_min_rules = int(
             getattr(self.config, "fastpath_min_rules", 0)
         )
-        self._use_fastpath = (
-            self.fastpath_enabled
-            and self.builder.glb_nrules >= self.fastpath_min_rules
-        )
+        self._use_fastpath = False
+        self._refresh_selection()
+        # diagnostic classify-probe accumulators (time_classifier):
+        # exported as the stage="classify" row of the
+        # vpp_tpu_pump_stage_seconds family and shown by `show acl`
+        self.classify_seconds = 0.0
+        self.classify_ns_pkt: Optional[float] = None
+        self._classify_probe_cache: Dict[str, object] = {}
         # Session time base: wall-clock ticks (TICKS_PER_SEC), not frame
         # counts — aging semantics must not depend on offered load
         # (VERDICT r1 Weak #5; the reference ages on timers).
@@ -456,18 +464,12 @@ class Dataplane:
                         )
                     self.tables = self.builder.to_device(
                         sessions=self.tables)
-                    self._use_mxu = (
-                        self.builder.mxu_enabled
-                        and self.builder.glb_mxu.ok
-                        and self.builder.glb_nrules >= self.mxu_threshold
-                    )
-                    # re-gate the two-tier dispatch on the new epoch's
-                    # rule count (both kernels stay jit-cached — shapes
-                    # are epoch-invariant, only the gate flips)
-                    self._use_fastpath = (
-                        self.fastpath_enabled
-                        and self.builder.glb_nrules >= self.fastpath_min_rules
-                    )
+                    # re-gate the classifier selection, the policy-free
+                    # local skip and the two-tier dispatch on the new
+                    # epoch's staged state (the variants stay
+                    # jit-cached — shapes are epoch-invariant, only the
+                    # gates flip)
+                    self._refresh_selection()
                     self.epoch += 1
                     span.attrs["epoch"] = self.epoch
                     span.name = f"epoch {self.epoch}"
@@ -555,14 +557,120 @@ class Dataplane:
                 self.tables = after
         return expired
 
+    # --- classifier / step selection ---
+    @property
+    def classifier_impl(self) -> str:
+        """The global-classify implementation the LIVE epoch runs
+        ("dense" | "mxu" | "bv") — surfaced by `show acl` and the
+        ``vpp_tpu_acl_classifier`` info gauge."""
+        return self._classifier_impl
+
+    def _select_classifier(self) -> str:
+        """Resolve the ``classifier`` knob against the staged builder
+        state. Explicit impls are honored when compilable (an operator
+        knob beats a size heuristic); ``auto`` ladders
+        BV >= bv_min_rules > MXU >= mxu_threshold > dense, with every
+        ineligible structure (range rules for MXU, non-prefix masks or
+        a busted memory cap for BV) falling to the next rung."""
+        b = self.builder
+        n = b.glb_nrules
+        mxu_ok = b.mxu_enabled and b.glb_mxu.ok
+        bv_ok = b.bv_ok()
+        knob = self.classifier
+        if knob == "dense":
+            return "dense"
+        if knob == "mxu":
+            return "mxu" if mxu_ok else "dense"
+        if knob == "bv":
+            if bv_ok:
+                return "bv"
+            return ("mxu" if mxu_ok and n >= self.mxu_threshold
+                    else "dense")
+        if bv_ok and n >= self.bv_min_rules:
+            return "bv"
+        if mxu_ok and n >= self.mxu_threshold:
+            return "mxu"
+        return "dense"
+
+    def _refresh_selection(self) -> None:
+        """Re-gate every per-epoch compile-time choice against the
+        staged builder: classifier impl, the policy-free local-classify
+        skip, and the fast-path engagement. Called from __init__ and
+        under the lock at every swap()."""
+        b = self.builder
+        self._classifier_impl = self._select_classifier()
+        self._use_mxu = self._classifier_impl == "mxu"
+        self._skip_local = bool((b.if_local_table < 0).all())
+        self._use_fastpath = (
+            self.fastpath_enabled
+            and b.glb_nrules >= self.fastpath_min_rules
+        )
+
+    def _get_step(self, fast: bool, form: str = "plain"):
+        """The jit-cached step variant of the current selection.
+        ``form``: "plain" (PacketVector in/out), "packed" ([5, B]
+        boundary + aux) or "chain" (K packed frames under lax.scan).
+        Call under ``_lock`` (reads the selection gates).
+
+        The local-skip gate is an OPTIMIZATION, never a requirement:
+        the non-skip variant is correct for every epoch (interfaces
+        with if_local_table == -1 are permitted by the local stage
+        anyway), so when that variant is already built we keep using
+        it rather than paying a second full-chain compile for the
+        skip variant — a process oscillating between policy-free and
+        policied epochs compiles ONE program, whichever came first."""
+        skip = self._skip_local
+        if (skip
+                and (self._classifier_impl, skip, fast, form)
+                not in _JIT_STEPS
+                and (self._classifier_impl, False, fast, form)
+                in _JIT_STEPS):
+            skip = False
+        return _jitted_step(self._classifier_impl, skip, fast, form)
+
+    def time_classifier(self, batch: int = 256, iters: int = 10) -> float:
+        """Diagnostic: time the SELECTED global classifier in isolation
+        over a synthetic batch and return ns/packet. Accumulates wall
+        seconds into ``classify_seconds`` (exported as the
+        stage="classify" row of ``vpp_tpu_pump_stage_seconds``) and
+        records ``classify_ns_pkt`` for `show acl`. Not hot-path work —
+        the first call per impl pays a jit compile; bench/operator use."""
+        from vpp_tpu.pipeline.graph import _classifier_fns
+        from vpp_tpu.pipeline.vector import make_packet_vector
+
+        with self._lock:
+            if self.tables is None:
+                raise RuntimeError("no live tables to time against")
+            tables = self.tables
+            impl = self._classifier_impl
+        fn = self._classify_probe_cache.get(impl)
+        if fn is None:
+            fn = jax.jit(_classifier_fns(impl)[0])
+            self._classify_probe_cache[impl] = fn
+        uplink = self.uplink_if if self.uplink_if is not None else 0
+        pkts = make_packet_vector(
+            [{"src": "172.16.0.9", "dst": "10.1.1.2", "proto": 6,
+              "sport": 40000 + i, "dport": 8000 + (i % 20),
+              "rx_if": uplink} for i in range(min(batch, 64))],
+            n=batch,
+        )
+        jax.block_until_ready(fn(tables, pkts).permit)  # compile+warm
+        t0 = _time.perf_counter()
+        for _ in range(iters):
+            out = fn(tables, pkts)
+        jax.block_until_ready(out.permit)
+        dt = _time.perf_counter() - t0
+        self.classify_seconds += dt
+        self.classify_ns_pkt = dt / iters / batch * 1e9
+        return self.classify_ns_pkt
+
     # --- traffic ---
     def _pick_step(self):
         """The unpacked step for the current regime: the two-tier auto
         dispatcher when the fast path is engaged, else the plain chain
-        (MXU classify variant either way). Call under ``_lock``."""
-        if self._use_fastpath:
-            return self._step_auto_mxu if self._use_mxu else self._step_auto
-        return self._step_mxu if self._use_mxu else self._step
+        (classifier impl and local-skip per the epoch's selection
+        either way). Call under ``_lock``."""
+        return self._get_step(self._use_fastpath, "plain")
 
     def process(self, pkts: PacketVector, now: Optional[int] = None) -> StepResult:
         with self._lock:
@@ -603,7 +711,7 @@ class Dataplane:
                     "ClusterDataplane; probe via its node pipelines"
                 )
             tables = self.tables
-            step = self._step_mxu if self._use_mxu else self._step
+            step = self._get_step(fast=False)
             if now is None:
                 now = max(self._now, self.clock_ticks())
         return step(tables, pkts, jnp.int32(now))
@@ -637,13 +745,7 @@ class Dataplane:
                     "ClusterDataplane; process frames via cluster.step()"
                 )
             tables = self.tables
-            fast = self._use_fastpath
-            if fast:
-                step = (self._step_packed_auto_mxu if self._use_mxu
-                        else self._step_packed_auto)
-            else:
-                step = (self._step_packed_mxu if self._use_mxu
-                        else self._step_packed)
+            step = self._get_step(self._use_fastpath, "packed")
             if now is None:
                 self._now = max(self._now, self.clock_ticks())
                 now = self._now
@@ -670,13 +772,7 @@ class Dataplane:
                     "ClusterDataplane; process frames via cluster.step()"
                 )
             tables = self.tables
-            fast = self._use_fastpath
-            if fast:
-                step = (self._step_chain_auto_mxu if self._use_mxu
-                        else self._step_chain_auto)
-            else:
-                step = (self._step_chain_mxu if self._use_mxu
-                        else self._step_chain)
+            step = self._get_step(self._use_fastpath, "chain")
             if now is None:
                 self._now = max(self._now, self.clock_ticks())
                 now = self._now

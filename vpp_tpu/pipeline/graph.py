@@ -12,6 +12,7 @@ statscollector (Prometheus) equivalent.
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
@@ -222,13 +223,17 @@ def pipeline_step(
     pkts: PacketVector,
     now: jnp.ndarray,
     acl_global_fn=acl_classify_global,
+    acl_local_fn=acl_classify_local,
 ) -> StepResult:
     """Process one packet vector through the full forwarding chain.
 
     Pure function: (tables, frame, time) → (result, new session state).
     Jit once; call per frame. ``acl_global_fn`` lets the multi-chip
     cluster step substitute a rule-sharded global classify
-    (vpp_tpu.parallel.cluster) without altering the chain.
+    (vpp_tpu.parallel.cluster) without altering the chain;
+    ``acl_local_fn`` swaps the per-interface classify the same way
+    (the BV implementation, or the policy-free skip —
+    ``make_pipeline_step`` composes both).
     """
     # --- ip4-input (+ unconfigured-interface drop) ---
     pkts, drop_ip4, alive = _ingress(tables, pkts)
@@ -251,7 +256,7 @@ def pipeline_step(
     )
 
     # --- ACL classify (local per-interface table + node-global table) ---
-    local_v = acl_classify_local(tables, pkts)
+    local_v = acl_local_fn(tables, pkts)
     glob_v = acl_global_fn(tables, pkts)
     permit = (local_v.permit & glob_v.permit) | established
     drop_acl = alive & ~permit
@@ -307,16 +312,6 @@ def pipeline_step(
         snat_applied, dropped_nat, sess_fail, natsess_fail,
         fastpath=jnp.int32(0),
     )
-
-
-def pipeline_step_mxu(
-    tables: DataplaneTables, pkts: PacketVector, now: jnp.ndarray
-) -> StepResult:
-    """pipeline_step with the global ACL on the MXU bit-plane kernel
-    (vpp_tpu.ops.acl_mxu) — the fast path for large exact-port tables."""
-    from vpp_tpu.ops.acl_mxu import acl_classify_global_mxu
-
-    return pipeline_step(tables, pkts, now, acl_global_fn=acl_classify_global_mxu)
 
 
 # --- two-tier established-flow fast path ------------------------------
@@ -408,6 +403,7 @@ def pipeline_step_auto(
     pkts: PacketVector,
     now: jnp.ndarray,
     acl_global_fn=acl_classify_global,
+    acl_local_fn=acl_classify_local,
 ) -> StepResult:
     """Two-tier dispatch: the fast kernel when the whole batch rides
     established sessions, the full chain otherwise.
@@ -447,22 +443,64 @@ def pipeline_step_auto(
         )
 
     def full(_):
-        return pipeline_step(tables, orig_pkts, now, acl_global_fn)
+        return pipeline_step(tables, orig_pkts, now, acl_global_fn,
+                             acl_local_fn)
 
     return lax.cond(ok, fast, full, None)
 
 
-def pipeline_step_auto_mxu(
-    tables: DataplaneTables, pkts: PacketVector, now: jnp.ndarray
-) -> StepResult:
-    """pipeline_step_auto whose full-chain branch classifies the global
-    table on the MXU bit-plane kernel — the fast branch has no
-    classifier at all, so the tiers differ only on the slow side."""
-    from vpp_tpu.ops.acl_mxu import acl_classify_global_mxu
+def _classifier_fns(impl: str):
+    """(global, local) classify functions of one implementation name.
+    Only BV swaps the LOCAL classify too — the MXU kernel is a
+    global-table reformulation (bit-plane matmul doesn't gather
+    per-packet tables), so mxu keeps the dense local path."""
+    if impl == "mxu":
+        from vpp_tpu.ops.acl_mxu import acl_classify_global_mxu
 
-    return pipeline_step_auto(
-        tables, pkts, now, acl_global_fn=acl_classify_global_mxu
+        return acl_classify_global_mxu, acl_classify_local
+    if impl == "bv":
+        from vpp_tpu.ops.acl_bv import (
+            acl_classify_global_bv,
+            acl_classify_local_bv,
+        )
+
+        return acl_classify_global_bv, acl_classify_local_bv
+    if impl != "dense":
+        raise ValueError(f"unknown classifier impl {impl!r}")
+    return acl_classify_global, acl_classify_local
+
+
+@functools.lru_cache(maxsize=None)
+def make_pipeline_step(impl: str = "dense", skip_local: bool = False,
+                       fast: bool = False):
+    """Compose one pipeline-step callable from the epoch's gates:
+    classifier implementation (dense | mxu | bv), the policy-free
+    local-classify skip, and the two-tier fast-path dispatch. The
+    Dataplane builds (and jit-caches) its step variants exclusively
+    through here, so every (impl, skip, tier) combination shares ONE
+    chain definition — a pipeline edit can't diverge a variant.
+
+    Memoized: equal gates return the SAME function object, so jax's
+    function-identity tracing/compilation caches are shared across
+    every Dataplane (and test) in the process — exactly as the old
+    module-level step functions were. A fresh closure per caller
+    would recompile the whole chain per dataplane instance."""
+    from vpp_tpu.ops.acl import acl_local_none
+
+    acl_global_fn, acl_local_fn = _classifier_fns(impl)
+    if skip_local:
+        acl_local_fn = acl_local_none
+    base = pipeline_step_auto if fast else pipeline_step
+
+    def step(tables: DataplaneTables, pkts: PacketVector,
+             now: jnp.ndarray) -> StepResult:
+        return base(tables, pkts, now, acl_global_fn=acl_global_fn,
+                    acl_local_fn=acl_local_fn)
+
+    step.__name__ = "pipeline_step_{}{}{}".format(
+        impl, "_nolocal" if skip_local else "", "_auto" if fast else ""
     )
+    return step
 
 
 pipeline_step_jit = jax.jit(pipeline_step, donate_argnums=())

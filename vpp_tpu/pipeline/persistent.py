@@ -38,7 +38,7 @@ from vpp_tpu.pipeline.dataplane import (
     PACKED_IN_ROWS,
     _packed_call,
 )
-from vpp_tpu.pipeline.graph import pipeline_step, pipeline_step_auto
+from vpp_tpu.pipeline.graph import make_pipeline_step
 
 STOP = np.int32(-1)
 
@@ -58,10 +58,17 @@ class PersistentPump:
     the most. Each delivered frame carries its [3] fast-path summary
     (``[fastpath, rx, sess_hits]``) through the same ordered deliver
     callback; ``result_ex()`` exposes it, ``result()`` drops it.
+
+    ``classifier``/``skip_local`` mirror the owning Dataplane's epoch
+    selection (pipeline/graph.py make_pipeline_step), so the resident
+    loop's full-chain tier classifies exactly like the dispatch path
+    would — the pump re-creates the loop on every epoch swap, which is
+    when the selection can flip.
     """
 
     def __init__(self, tables, batch: int, max_frames: int = 1 << 20,
-                 fastpath: bool = True):
+                 fastpath: bool = True, classifier: str = "dense",
+                 skip_local: bool = False):
         self.batch = int(batch)
         self.fastpath_enabled = bool(fastpath)
         self._in: "queue.Queue" = queue.Queue()
@@ -71,7 +78,7 @@ class PersistentPump:
         self._thread: Optional[threading.Thread] = None
         self._max_frames = max_frames
         self._tables0 = tables
-        step_fn = pipeline_step_auto if fastpath else pipeline_step
+        step_fn = make_pipeline_step(classifier, skip_local, fast=fastpath)
         # aux always on: the plain chain reports fastpath=0, so the
         # deliver callback keeps ONE shape either way
         self._step = _packed_call(step_fn, with_aux=True)

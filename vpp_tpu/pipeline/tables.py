@@ -64,6 +64,22 @@ class DataplaneConfig(NamedTuple):
     # cached per epoch by the Dataplane exactly like the full chain.
     fastpath: bool = True
     fastpath_min_rules: int = 0
+    # Global-classify implementation (ops/acl.py dense VPU compare,
+    # ops/acl_mxu.py bit-plane matmul, ops/acl_bv.py interval-bitmap
+    # bit-vector): "dense" | "mxu" | "bv" | "auto". ``auto`` picks BV
+    # once the global table reaches ``classifier_bv_min_rules`` (and
+    # the worst-case interval-bitmap structure fits
+    # ``classifier_bv_mem_mb`` — ~5 x 2R x R/32 uint32 words, ~105 MB
+    # at 10,240 rules), the MXU kernel above Dataplane.mxu_threshold,
+    # dense below. Re-evaluated at every epoch swap against the staged
+    # rule count; the structure's SHAPES are config-static, so only
+    # the selection flips per epoch, never the compiled programs'
+    # signatures. BV also serves the per-interface local tables (MXU
+    # is global-only); the multi-chip mesh keeps its rule-sharded
+    # dense/MXU classify (docs/CLASSIFIER.md).
+    classifier: str = "auto"
+    classifier_bv_min_rules: int = 1024
+    classifier_bv_mem_mb: int = 256
 
 
 class DataplaneTables(NamedTuple):
@@ -81,6 +97,19 @@ class DataplaneTables(NamedTuple):
     acl_dport_hi: jnp.ndarray   # int32
     acl_action: jnp.ndarray     # int32: 0 deny, 1 permit, -1 padding
     acl_nrules: jnp.ndarray     # int32 [T]
+    # Interval-bitmap (BV) form of the local tables (ops/acl_bv.py);
+    # minimal placeholder shapes when the classifier knob disables BV
+    # (bv_capacity(enabled=False)) — shapes stay epoch-invariant.
+    acl_bv_bnd_src: jnp.ndarray    # uint32 [T, I]
+    acl_bv_bnd_dst: jnp.ndarray    # uint32 [T, I]
+    acl_bv_bnd_sport: jnp.ndarray  # int32 [T, I]
+    acl_bv_bnd_dport: jnp.ndarray  # int32 [T, I]
+    acl_bv_nbnd: jnp.ndarray       # int32 [T, 4] live boundary counts
+    acl_bv_src: jnp.ndarray        # uint32 [T, I, W] segment bitmaps
+    acl_bv_dst: jnp.ndarray        # uint32 [T, I, W]
+    acl_bv_sport: jnp.ndarray      # uint32 [T, I, W]
+    acl_bv_dport: jnp.ndarray      # uint32 [T, I, W]
+    acl_bv_proto: jnp.ndarray      # uint32 [T, PR, W] direct proto plane
 
     # --- global ACL table, padded [G] ---
     glb_src_net: jnp.ndarray
@@ -103,6 +132,22 @@ class DataplaneTables(NamedTuple):
                                 # than rule-row space (R' >= R), so the
                                 # rule-sharded MXU classify must resolve
                                 # the deny bit here, not via glb_action
+    # Interval-bitmap (BV) form of the global table (ops/acl_bv.py);
+    # its own upload group ("glb_bv"), re-uploaded per-dimension-plane
+    # so a port-only policy churn doesn't re-ship the address bitmaps.
+    # NOT rule-sharded in the mesh (a segment's bitmap spans ALL rules
+    # — parallel/mesh.py excludes glb_bv_*; the cluster classify stays
+    # dense/MXU, documented in docs/CLASSIFIER.md).
+    glb_bv_bnd_src: jnp.ndarray    # uint32 [I]
+    glb_bv_bnd_dst: jnp.ndarray    # uint32 [I]
+    glb_bv_bnd_sport: jnp.ndarray  # int32 [I]
+    glb_bv_bnd_dport: jnp.ndarray  # int32 [I]
+    glb_bv_nbnd: jnp.ndarray       # int32 [4]
+    glb_bv_src: jnp.ndarray        # uint32 [I, W]
+    glb_bv_dst: jnp.ndarray        # uint32 [I, W]
+    glb_bv_sport: jnp.ndarray      # uint32 [I, W]
+    glb_bv_dport: jnp.ndarray      # uint32 [I, W]
+    glb_bv_proto: jnp.ndarray      # uint32 [PR, W]
 
     # --- interfaces [I] ---
     if_type: jnp.ndarray        # int32 InterfaceType
@@ -387,11 +432,22 @@ def _block_of(changed: np.ndarray, total: int) -> Optional[Tuple[int, int]]:
 _UPLOAD_GROUPS: Dict[str, Tuple[str, ...]] = {
     "acl": ("acl_src_net", "acl_src_mask", "acl_dst_net", "acl_dst_mask",
             "acl_proto", "acl_sport_lo", "acl_sport_hi", "acl_dport_lo",
-            "acl_dport_hi", "acl_action", "acl_nrules"),
+            "acl_dport_hi", "acl_action", "acl_nrules",
+            "acl_bv_bnd_src", "acl_bv_bnd_dst", "acl_bv_bnd_sport",
+            "acl_bv_bnd_dport", "acl_bv_nbnd", "acl_bv_src",
+            "acl_bv_dst", "acl_bv_sport", "acl_bv_dport",
+            "acl_bv_proto"),
     "glb": ("glb_src_net", "glb_src_mask", "glb_dst_net", "glb_dst_mask",
             "glb_proto", "glb_sport_lo", "glb_sport_hi", "glb_dport_lo",
             "glb_dport_hi", "glb_action", "glb_nrules", "glb_mxu_coeff",
             "glb_mxu_k", "glb_mxu_act"),
+    # the BV structure uploads per-dimension-plane (see to_device): a
+    # separate group so the "glb" incremental row/column blob path can
+    # never leave stale BV planes on the device
+    "glb_bv": ("glb_bv_bnd_src", "glb_bv_bnd_dst", "glb_bv_bnd_sport",
+               "glb_bv_bnd_dport", "glb_bv_nbnd", "glb_bv_src",
+               "glb_bv_dst", "glb_bv_sport", "glb_bv_dport",
+               "glb_bv_proto"),
     "if": ("if_type", "if_local_table", "if_apply_global"),
     "fib": ("fib_prefix", "fib_mask", "fib_plen", "fib_tx_if", "fib_disp",
             "fib_next_hop", "fib_node_id", "fib_snat"),
@@ -399,6 +455,17 @@ _UPLOAD_GROUPS: Dict[str, Tuple[str, ...]] = {
             "nat_bcnt", "nat_total_w", "nat_self_snat", "natb_ip",
             "natb_port", "natb_cumw", "nat_snat_ip"),
     "config": ("sess_max_age",),
+}
+
+# BV dimension -> its global-table device fields (granular upload:
+# only the planes compile_bv actually rebuilt re-ship; the nbnd count
+# vector rides along whenever anything changed).
+_GLB_BV_DIM_FIELDS: Dict[str, Tuple[str, ...]] = {
+    "src": ("glb_bv_bnd_src", "glb_bv_src"),
+    "dst": ("glb_bv_bnd_dst", "glb_bv_dst"),
+    "sport": ("glb_bv_bnd_sport", "glb_bv_sport"),
+    "dport": ("glb_bv_bnd_dport", "glb_bv_dport"),
+    "proto": ("glb_bv_proto",),
 }
 
 
@@ -436,6 +503,42 @@ class TableBuilder:
         from vpp_tpu.ops.acl_mxu import empty_bitplanes
 
         self.glb_mxu = empty_bitplanes(c.max_global_rules)
+        # BV interval-bitmap staging (ops/acl_bv.py). Allocation is
+        # knob-gated: dense/mxu configs (and auto configs whose
+        # worst-case structure busts classifier_bv_mem_mb) carry only
+        # minimal placeholder shapes — the BV kernels are then never
+        # selected, so the placeholders are never read.
+        from vpp_tpu.ops.acl_bv import bv_capacity, bv_enabled_for, empty_bv
+
+        knob = getattr(c, "classifier", "auto")
+        if knob not in ("dense", "mxu", "bv", "auto"):
+            # loud, at config time: a typo'd knob silently falling
+            # through to the auto ladder would run a different
+            # classifier than the operator believes is deployed
+            raise ValueError(
+                f"unknown dataplane.classifier {knob!r} "
+                f"(expected dense | mxu | bv | auto)")
+        self.bv_enabled = bv_enabled_for(c)
+        self.glb_bv = empty_bv(c.max_global_rules, self.bv_enabled)
+        self._bv_cols = None        # per-dim column cache (incremental)
+        self._bv_dirty = set(_UPLOAD_GROUPS["glb_bv"])
+        self.bv_rebuilt: Tuple[str, ...] = ()  # last commit's planes
+        self.bv_build_ms = 0.0      # last commit's BV host build cost
+        local_bv = empty_bv(c.max_rules, self.bv_enabled)
+        lib, lw, lpr = bv_capacity(c.max_rules, self.bv_enabled)
+        self.acl_bv = {
+            "bnd_src": np.tile(local_bv.bnd_src, (c.max_tables, 1)),
+            "bnd_dst": np.tile(local_bv.bnd_dst, (c.max_tables, 1)),
+            "bnd_sport": np.tile(local_bv.bnd_sport, (c.max_tables, 1)),
+            "bnd_dport": np.tile(local_bv.bnd_dport, (c.max_tables, 1)),
+            "nbnd": np.tile(local_bv.nbnd, (c.max_tables, 1)),
+            "src": np.zeros((c.max_tables, lib, lw), np.uint32),
+            "dst": np.zeros((c.max_tables, lib, lw), np.uint32),
+            "sport": np.zeros((c.max_tables, lib, lw), np.uint32),
+            "dport": np.zeros((c.max_tables, lib, lw), np.uint32),
+            "proto": np.zeros((c.max_tables, lpr, lw), np.uint32),
+        }
+        self.acl_bv_ok = np.ones(c.max_tables, bool)
         self.if_type = z(c.max_ifaces, np.int32)
         self.if_local_table = np.full(c.max_ifaces, -1, np.int32)
         self.if_apply_global = z(c.max_ifaces, np.int32)
@@ -505,12 +608,32 @@ class TableBuilder:
         self._rec = ConfigTxn()
         return txn
 
+    def bv_ok(self) -> bool:
+        """Whether the BV classifier can serve THIS staged config:
+        structure allocated, and every table (global + all local
+        slots) expressible as interval bitmaps (no non-prefix masks)."""
+        return (self.bv_enabled and self.glb_bv.ok
+                and bool(self.acl_bv_ok.all()))
+
     # --- ACL ---
     def set_local_table(self, slot: int, rules: Sequence[ContivRule]) -> None:
         packed = pack_rules(rules, self.config.max_rules)
         for k, v in packed.items():
             self.acl[k][slot] = v
         self.acl_nrules[slot] = len(rules)
+        if self.bv_enabled:
+            # per-slot full rebuild: local tables are <= max_rules
+            # (128) rows, so the plane compile is microseconds — the
+            # dimension-incremental path only pays off at global scale
+            from vpp_tpu.ops.acl_bv import compile_bv
+
+            bv, _, _ = compile_bv(packed, self.config.max_rules)
+            for dim in ("src", "dst", "sport", "dport"):
+                self.acl_bv[f"bnd_{dim}"][slot] = getattr(bv, f"bnd_{dim}")
+                self.acl_bv[dim][slot] = getattr(bv, f"bm_{dim}")
+            self.acl_bv["nbnd"][slot] = bv.nbnd
+            self.acl_bv["proto"][slot] = bv.bm_proto
+            self.acl_bv_ok[slot] = bv.ok
         if self._rec is not None:
             self._rec.set_local_table(slot, rules)
         self._mark("acl")
@@ -553,10 +676,30 @@ class TableBuilder:
                 # policy churn: only the changed rule columns recompile
                 self.glb_mxu, bad = compile_bitplanes_update(
                     self.glb, cap, self.glb_mxu, self._glb_bad, changed)
+            if self.bv_enabled:
+                # dimension-incremental BV compile (ops/acl_bv.py):
+                # composes with the identity-diff pack above — only
+                # dimension planes whose per-rule intervals actually
+                # moved rebuild; a port-only churn keeps the (large)
+                # address bitmaps untouched on host AND device
+                from vpp_tpu.ops.acl_bv import compile_bv
+
+                self.glb_bv, self._bv_cols, rebuilt = compile_bv(
+                    self.glb, cap, prev=self.glb_bv,
+                    prev_cols=self._bv_cols)
+                self.bv_rebuilt = rebuilt
+                self.bv_build_ms = self.glb_bv.build_ms
+                if rebuilt:
+                    self._bv_dirty.add("glb_bv_nbnd")
+                    for dim in rebuilt:
+                        self._bv_dirty.update(_GLB_BV_DIM_FIELDS[dim])
+                    self._mark("glb_bv")
         except Exception:
             self._glb_rules_ref = None
             self._glb_rows = None
             self._glb_bad = None
+            self._bv_cols = None
+            self._bv_dirty = set(_UPLOAD_GROUPS["glb_bv"])
             raise
         self._glb_rules_ref = list(rules)
         self._glb_rows = rows
@@ -707,10 +850,13 @@ class TableBuilder:
             "arrays": {k: getattr(self, k).copy()
                        for k in self._STATE_ARRAYS},
             "acl": {k: v.copy() for k, v in self.acl.items()},
+            "acl_bv": {k: v.copy() for k, v in self.acl_bv.items()},
+            "acl_bv_ok": self.acl_bv_ok.copy(),
             "glb": {k: v.copy() for k, v in self.glb.items()},
             "glb_nrules": self.glb_nrules,
             "glb_mxu": self.glb_mxu,       # replaced wholesale, never
-            "nat_snat_ip": self.nat_snat_ip,  # mutated in place
+            "glb_bv": self.glb_bv,         # mutated in place
+            "nat_snat_ip": self.nat_snat_ip,
             "dirty": set(self._dirty),
             "rec_ops": list(self._rec.ops) if self._rec is not None else None,
         }
@@ -722,15 +868,23 @@ class TableBuilder:
             getattr(self, k)[...] = v
         for k, v in snap["acl"].items():
             self.acl[k][...] = v
+        for k, v in snap["acl_bv"].items():
+            self.acl_bv[k][...] = v
+        self.acl_bv_ok[...] = snap["acl_bv_ok"]
         for k, v in snap["glb"].items():
             self.glb[k][...] = v
         self.glb_nrules = snap["glb_nrules"]
         self.glb_mxu = snap["glb_mxu"]
+        self.glb_bv = snap["glb_bv"]
         # the identity-diff caches describe the pre-restore rule list;
-        # the next set_global_table must full-recompile
+        # the next set_global_table must full-recompile. The BV device
+        # cache may hold planes of the rolled-back commit — every BV
+        # field re-uploads conservatively.
         self._glb_rules_ref = None
         self._glb_rows = None
         self._glb_bad = None
+        self._bv_cols = None
+        self._bv_dirty = set(_UPLOAD_GROUPS["glb_bv"])
         self.nat_snat_ip = snap["nat_snat_ip"]
         # union, not replace: groups the rolled-back ops touched stay
         # dirty — a redundant re-upload of identical data is harmless,
@@ -757,6 +911,16 @@ class TableBuilder:
             acl_dport_hi=self.acl["dport_hi"],
             acl_action=self.acl["action"],
             acl_nrules=self.acl_nrules,
+            acl_bv_bnd_src=self.acl_bv["bnd_src"],
+            acl_bv_bnd_dst=self.acl_bv["bnd_dst"],
+            acl_bv_bnd_sport=self.acl_bv["bnd_sport"],
+            acl_bv_bnd_dport=self.acl_bv["bnd_dport"],
+            acl_bv_nbnd=self.acl_bv["nbnd"],
+            acl_bv_src=self.acl_bv["src"],
+            acl_bv_dst=self.acl_bv["dst"],
+            acl_bv_sport=self.acl_bv["sport"],
+            acl_bv_dport=self.acl_bv["dport"],
+            acl_bv_proto=self.acl_bv["proto"],
             glb_src_net=self.glb["src_net"],
             glb_src_mask=self.glb["src_mask"],
             glb_dst_net=self.glb["dst_net"],
@@ -771,6 +935,16 @@ class TableBuilder:
             glb_mxu_coeff=self.glb_mxu.coeff,
             glb_mxu_k=self.glb_mxu.k,
             glb_mxu_act=self.glb_mxu.act,
+            glb_bv_bnd_src=self.glb_bv.bnd_src,
+            glb_bv_bnd_dst=self.glb_bv.bnd_dst,
+            glb_bv_bnd_sport=self.glb_bv.bnd_sport,
+            glb_bv_bnd_dport=self.glb_bv.bnd_dport,
+            glb_bv_nbnd=self.glb_bv.nbnd,
+            glb_bv_src=self.glb_bv.bm_src,
+            glb_bv_dst=self.glb_bv.bm_dst,
+            glb_bv_sport=self.glb_bv.bm_sport,
+            glb_bv_dport=self.glb_bv.bm_dport,
+            glb_bv_proto=self.glb_bv.bm_proto,
             if_type=self.if_type,
             if_local_table=self.if_local_table,
             if_apply_global=self.if_apply_global,
@@ -819,6 +993,18 @@ class TableBuilder:
         glb_full = False
         for group, fields in _UPLOAD_GROUPS.items():
             dirty = group in self._dirty
+            if group == "glb_bv":
+                # per-dimension-plane upload: only planes compile_bv
+                # rebuilt since the last to_device re-ship (a port-only
+                # churn keeps the multi-MB address bitmaps cached);
+                # a field with no cache entry always uploads
+                for name in fields:
+                    if (dirty and name in self._bv_dirty) \
+                            or name not in self._dev_cache:
+                        self._dev_cache[name] = jnp.asarray(host_np[name])
+                    host[name] = self._dev_cache[name]
+                self._bv_dirty.clear()
+                continue
             if group == "glb" and dirty:
                 if self._glb_incremental(host_np):
                     # changed row/column BLOCKS were scattered into the

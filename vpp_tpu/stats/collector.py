@@ -85,6 +85,11 @@ PUMP_STAGE_SECONDS = (
     ("t_write", "write"),
 )
 
+# Global-classify implementations the vpp_tpu_acl_classifier info
+# gauge enumerates (Dataplane.classifier_impl; ops/acl.py dense,
+# ops/acl_mxu.py, ops/acl_bv.py).
+CLASSIFIER_IMPLS = ("dense", "mxu", "bv")
+
 PUMP_GAUGES = tuple(
     (name, help_) for _, name, help_ in PUMP_STAT_GAUGES
 ) + (
@@ -249,6 +254,16 @@ class StatsCollector:
                   "cumulative seconds spent per pump pipeline stage",
                   kind="counter"),
         )
+        # info-style selection gauge: 1 on the impl the live epoch
+        # classifies with (Dataplane._refresh_selection at every swap),
+        # 0 on the others — `sum by (impl)` across a fleet counts the
+        # nodes on each path
+        self.classifier_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_acl_classifier",
+                  "selected global ACL classifier implementation "
+                  "(info-style: impl label, 1 = active)"),
+        )
         self.vcl = None  # set_vcl(): admission counters -> gauges
         self.vcl_gauges = {
             name: self.registry.register(STATS_PATH, Gauge(name, help_))
@@ -382,6 +397,18 @@ class StatsCollector:
             self.node_gauges["vpp_tpu_node_sessions_active"].set(
                 int(np.asarray(self.dp.tables.sess_valid).sum())
             )
+        impl = getattr(self.dp, "classifier_impl", "dense")
+        for name in CLASSIFIER_IMPLS:
+            self.classifier_gauge.set(
+                1.0 if name == impl else 0.0, impl=name)
+        # classify-stage occupancy in the pump stage family: cumulative
+        # seconds of the isolated classify probe
+        # (Dataplane.time_classifier — the bench and operators drive
+        # it; 0 until the first probe). Dataplane-owned, so published
+        # even without a pump attached.
+        self.pump_stage_gauge.set(
+            float(getattr(self.dp, "classify_seconds", 0.0)),
+            stage="classify")
         pump = self.pump
         if pump is not None:
             ps = pump.stats

@@ -86,6 +86,18 @@ def profile_stages(
                            (tables, pkts, alive, now)),
         "FUSED pipeline-step": (jax.jit(pipeline_step), (tables, pkts, now)),
     }
+    # BV classify rows only when the epoch carries a real interval-
+    # bitmap structure (placeholder shapes mean the knob disabled BV)
+    if int(tables.glb_bv_src.shape[0]) > 2:
+        from vpp_tpu.ops.acl_bv import (
+            acl_classify_global_bv,
+            acl_classify_local_bv,
+        )
+
+        stages["acl-classify-global-bv"] = (
+            jax.jit(acl_classify_global_bv), (tables, pkts))
+        stages["acl-classify-local-bv"] = (
+            jax.jit(acl_classify_local_bv), (tables, pkts))
     out = []
     for name, (fn, args) in stages.items():
         sec = _time(fn, args, iters)
