@@ -20,8 +20,12 @@ test-race:
 		tests/test_vcl_preload.py tests/test_multihost_unit.py \
 		tests/test_kvstore_fencing.py -q
 
+# Base style pass + the pure-AST analysis passes (tools/analysis/):
+# --jax tracer/recompile hygiene, --threads lock discipline. The
+# registry passes (--metrics/--counters/--tables) import jax, so
+# tier-1 runs them from tests instead (test_exposition / test_acl_bv).
 lint:
-	$(PY) tools/lint.py
+	$(PY) tools/lint.py --jax --threads
 
 # Driver-facing headline benchmark (real TPU; one JSON line).
 bench:

@@ -99,6 +99,20 @@ def _progress(**kv) -> None:
         pass
 
 
+def _jit_compiles_now() -> int:
+    """Total pipeline-step XLA compiles so far (the runtime jit-compile
+    guard, pipeline/dataplane.py). Recorded per priority-ladder section
+    as <section>_jit_compiles so a recompile regression — the PR-4
+    fresh-closure class — shows up in the BENCH_* trajectory, not just
+    in wall-clock drift."""
+    try:
+        from vpp_tpu.pipeline.dataplane import jit_compile_totals
+
+        return sum(jit_compile_totals().values())
+    except Exception:  # noqa: BLE001 — accounting must never kill a run
+        return 0
+
+
 def _probe_backend(retries: int, delay: float):
     """Initialize the JAX backend, retrying transient axon/tunnel init
     failures (round-1 bench died on 'Unable to initialize backend axon'
@@ -1994,16 +2008,23 @@ def _run():
     # is individually guarded: a failure records its error key and the
     # run continues.
     pri = {}
+    _jc = _jit_compiles_now()
     try:
         pri.update(session_election_bench(args))
     except Exception as e:  # noqa: BLE001 — priority sections are
         # individually additive
         pri["sess_election_error"] = f"{type(e).__name__}: {e}"
+    _jc_now = _jit_compiles_now()
+    pri["sess_election_jit_compiles"] = _jc_now - _jc
+    _jc = _jc_now
     _progress(**pri)
     try:
         pri.update(commit_bench(args))
     except Exception as e:  # noqa: BLE001
         pri["commit_bench_error"] = f"{type(e).__name__}: {e}"
+    _jc_now = _jit_compiles_now()
+    pri["commit_jit_compiles"] = _jc_now - _jc
+    _jc = _jc_now
     _progress(**pri)
     try:
         # classifier shoot-out (ISSUE 4): dense vs MXU vs BV at 1,024
@@ -2011,6 +2032,9 @@ def _run():
         pri.update(acl_classifier_bench(args))
     except Exception as e:  # noqa: BLE001
         pri["acl_classifier_bench_error"] = f"{type(e).__name__}: {e}"
+    _jc_now = _jit_compiles_now()
+    pri["acl_classifier_jit_compiles"] = _jc_now - _jc
+    _jc = _jc_now
     _progress(**pri)
     try:
         # tentpole capture: the two-tier fast path's measured win at
@@ -2018,17 +2042,26 @@ def _run():
         pri.update(fastpath_bench(args))
     except Exception as e:  # noqa: BLE001
         pri["fastpath_bench_error"] = f"{type(e).__name__}: {e}"
+    _jc_now = _jit_compiles_now()
+    pri["fastpath_jit_compiles"] = _jc_now - _jc
+    _jc = _jc_now
     _progress(**pri)
     if not args.no_subbench:
         try:
             pri.update(io_ring_bench(args))
         except Exception as e:  # noqa: BLE001
             pri["io_ring_bench_error"] = f"{type(e).__name__}: {e}"
+        _jc_now = _jit_compiles_now()
+        pri["io_ring_jit_compiles"] = _jc_now - _jc
+        _jc = _jc_now
         _progress(**pri)
         try:
             pri.update(io_daemon_bench(args))
         except Exception as e:  # noqa: BLE001 — optional, env-dependent
             pri["io_daemon_bench_error"] = f"{type(e).__name__}: {e}"
+        _jc_now = _jit_compiles_now()
+        pri["io_daemon_jit_compiles"] = _jc_now - _jc
+        _jc = _jc_now
         _progress(**pri)
 
     dp, uplink = build_dataplane(args.rules, args.backends)
@@ -2054,7 +2087,9 @@ def _run():
     dt = time.perf_counter() - t0
     mpps = args.packets * args.iters / dt / 1e6
     _progress(headline_mpps=round(mpps, 3), rules=args.rules,
-              packets_per_step=args.packets, iters=args.iters)
+              packets_per_step=args.packets, iters=args.iters,
+              headline_jit_compiles=_jit_compiles_now() - _jc,
+              jit_compiles_total=_jit_compiles_now())
 
     # --- added latency: single small-frame step, p50/p99 ---
     def pack_frame(pv, n):
@@ -2229,6 +2264,11 @@ def _run():
                         pipelined_us / args.latency_frame, 3
                     ),
                     "latency_frame": args.latency_frame,
+                    # runtime jit-compile guard roll-up: per-section
+                    # *_jit_compiles deltas ride in via **subs; this is
+                    # the whole-run total (flat across rounds unless a
+                    # recompile regression landed)
+                    "jit_compiles_total": _jit_compiles_now(),
                     "backend": jax.default_backend(),
                     # wire-path numbers are host-CPU-bound too: on a
                     # 1-core host the sender/daemon/pump/receiver AND

@@ -28,3 +28,61 @@ if os.environ.get("VPP_TPU_RACE"):
     import sys
 
     sys.setswitchinterval(5e-6)
+
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "jit_budget(n): with the jit_compile_budget fixture, fail the "
+        "test if it triggers more than n pipeline-step XLA compiles "
+        "(pipeline/dataplane.py runtime jit-compile guard, ISSUE 5)",
+    )
+
+
+@pytest.fixture
+def jit_compile_budget(request):
+    """Opt-in compile-budget guard: a test that requests this fixture
+    declares (via ``@pytest.mark.jit_budget(n)``, default 0) how many
+    pipeline-step compiles it is allowed to trigger; exceeding the
+    budget fails the test. Budget 0 == "my shapes and variants are
+    already warm" — the regression fence for the PR-4 bug class."""
+    from vpp_tpu.pipeline import dataplane as _dp
+
+    marker = request.node.get_closest_marker("jit_budget")
+    budget = int(marker.args[0]) if marker and marker.args else 0
+    guard = _dp.jit_compile_budget(budget)
+    guard.__enter__()
+    yield guard
+    try:
+        guard.__exit__(None, None, None)
+    except _dp.JitBudgetExceeded as e:
+        pytest.fail(str(e))
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """The process-wide compile-once contract, verified over the WHOLE
+    tier-1 run: every pipeline-step variant compiles at most once per
+    (impl, skip, fast, form, call-shape) key per process. Consults the
+    counter only if the dataplane was imported — this hook must not
+    pull jax into a run that never used it."""
+    import sys
+
+    dp = sys.modules.get("vpp_tpu.pipeline.dataplane")
+    if dp is None:
+        return
+    recompiled = dp.jit_recompiles()
+    if recompiled:
+        lines = [
+            f"  {label} @ {n} compiles, shapes {sig!r}"
+            for (label, sig), n in sorted(recompiled.items())
+        ]
+        print(
+            "\njit-compile guard: compile-once contract BROKEN — "
+            "step variants re-traced at identical call shapes (the "
+            "PR-4 fresh-closure regression class):\n"
+            + "\n".join(lines),
+            file=sys.stderr,
+        )
+        session.exitstatus = 1

@@ -1,0 +1,38 @@
+"""In-tree static-analysis framework (the metalinter CI stage analog,
+README.md:36-40 / Dockerfile.metalinter — rebuilt as project-specific
+AST passes for the two bug classes generic linters miss here):
+
+* ``style``    — base hygiene (tools/analysis/imports.py): parse, unused
+  imports, bare except, tabs/trailing whitespace, mutable defaults,
+  ``== True/False/None`` comparisons.
+* ``jax``      — tracer/recompile hygiene (tools/analysis/jaxlint.py):
+  host syncs inside jitted code, Python control flow on tracer-derived
+  values, per-instance jit closures and lru_cache factory hazards (the
+  PR-4 fresh-closure bug class), float64 literal drift, and a
+  jit-registry manifest so every jitted entry point is enumerated.
+* ``threads``  — lock discipline (tools/analysis/threadlint.py): per
+  class, attributes written under ``with self._lock`` must be accessed
+  under it everywhere; lock-nesting order must be acyclic.
+* ``metrics`` / ``counters`` / ``tables`` — registry and table
+  invariants (tools/analysis/registries.py; import jax, so they only
+  run when asked for).
+
+``tools/lint.py`` is the CLI; tier-1 invokes the passes through
+tests/test_analysis.py + tests/test_exposition.py + tests/test_acl_bv.py.
+Suppression syntax and the rule catalog: docs/STATIC_ANALYSIS.md.
+"""
+
+from analysis.common import Finding, iter_source_files, parse_suppressions
+from analysis.imports import ImportCollector, style_problems
+from analysis.jaxlint import jax_lint
+from analysis.threadlint import threads_lint
+
+__all__ = [
+    "Finding",
+    "ImportCollector",
+    "iter_source_files",
+    "jax_lint",
+    "parse_suppressions",
+    "style_problems",
+    "threads_lint",
+]

@@ -1,0 +1,109 @@
+"""Shared infrastructure of the analysis passes: the Finding record,
+suppression-comment parsing and source-tree iteration.
+
+Suppression syntax (docs/STATIC_ANALYSIS.md):
+
+* ``# jax-ok: <reason>``   — suppress jax-pass findings on this line.
+* ``# unlocked: <reason>`` — suppress thread-pass findings on this line.
+* ``# noqa``               — the base style pass's escape (kept from the
+  original tools/lint.py).
+
+A suppression WITHOUT a reason is itself a finding (``bare-suppression``):
+the annotation is the changelog entry for the next reader, so an empty
+one defeats the point.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, Tuple
+
+SUPPRESSION_RE = re.compile(r"#\s*(jax-ok|unlocked)\b:?[ \t]*(.*)")
+
+
+def _comment_lines(src: str) -> Dict[int, str]:
+    """{lineno: comment text} via the tokenizer, so a suppression token
+    inside a STRING LITERAL (help text, log message) never registers.
+    Falls back to treating every line as scannable if tokenization
+    fails (the style pass reports the syntax error separately)."""
+    try:
+        return {
+            tok.start[0]: tok.string
+            for tok in tokenize.generate_tokens(io.StringIO(src).readline)
+            if tok.type == tokenize.COMMENT
+        }
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return {i: ln for i, ln in enumerate(src.splitlines(), 1)}
+
+
+@dataclass
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+    suppressed: bool = False
+    reason: str = ""
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class Suppressions:
+    """Per-file suppression map: kind -> {line -> reason}."""
+
+    jax: Dict[int, str] = field(default_factory=dict)
+    unlocked: Dict[int, str] = field(default_factory=dict)
+    problems: list = field(default_factory=list)
+
+
+def parse_suppressions(src: str, path: str = "<src>") -> Suppressions:
+    """A suppression applies to its own line; a suppression on a
+    comment-only line (possibly the tail of a multi-line comment
+    block) additionally covers the next CODE line — so reasons too
+    long for an inline comment go in a block right above the site."""
+    lines = src.splitlines()
+    comments = _comment_lines(src)
+    sup = Suppressions()
+    for i, line in enumerate(lines, 1):
+        m = SUPPRESSION_RE.search(comments.get(i, ""))
+        if m is None:
+            continue
+        kind, reason = m.group(1), m.group(2).strip()
+        if not reason:
+            sup.problems.append(Finding(
+                path, i, "bare-suppression",
+                f"'# {kind}:' needs a reason (the annotation IS the "
+                f"documentation)",
+            ))
+            continue
+        target = sup.jax if kind == "jax-ok" else sup.unlocked
+        target[i] = reason
+        if line.lstrip().startswith("#"):
+            j = i  # 0-based index of the line AFTER the comment
+            while j < len(lines) and lines[j].lstrip()[:1] in ("#", ""):
+                j += 1
+            if j < len(lines):
+                target[j + 1] = reason
+    return sup
+
+
+def iter_source_files(
+    repo: Path, roots: Iterable[str]
+) -> Iterator[Tuple[str, Path]]:
+    """Yield (repo-relative path, absolute path) of every .py file under
+    the given roots, sorted, __pycache__ excluded."""
+    for root in roots:
+        p = repo / root
+        if p.is_file():
+            yield root, p
+            continue
+        for f in sorted(p.rglob("*.py")):
+            if "__pycache__" in f.parts:
+                continue
+            yield str(f.relative_to(repo)), f

@@ -1,0 +1,160 @@
+"""Base style pass: the original tools/lint.py checks, refactored.
+
+Checks, per file: the file parses (SyntaxError == fail), unused imports,
+bare ``except:``, tab indentation / trailing whitespace, mutable default
+arguments, ``== True/False/None`` comparisons.
+
+ImportCollector gap fixes over the original (ISSUE 5 satellite):
+
+* names used only inside STRING annotations (``def f(x: "KVStore")`` —
+  with ``from __future__ import annotations`` every forward reference
+  is one) are now counted as uses: string constants in annotation
+  position are parsed as expressions and their names collected;
+* ``__all__`` re-exports declared as tuples, via ``__all__ += [...]``
+  augmented assignment, or through an annotated assignment
+  (``__all__: tuple = (...)``) are all honored (the original only read
+  a plain ``__all__ = [...]`` and only caught ValueError, so a tuple
+  containing a non-literal silently dropped the whole export list);
+* dotted ``import a.b.c as d`` aliases bind ``d`` (the original split
+  on "." and recorded ``a`` for the asname too).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import List
+
+
+class ImportCollector(ast.NodeVisitor):
+    def __init__(self):
+        self.imports: dict = {}   # bound name -> lineno
+        self.used: set = set()
+        self.exported: set = set()
+
+    def visit_Import(self, node):
+        for a in node.names:
+            # `import a.b` binds `a`; `import a.b as c` binds `c`
+            name = a.asname if a.asname else a.name.split(".")[0]
+            self.imports[name] = node.lineno
+
+    def visit_ImportFrom(self, node):
+        if node.module == "__future__":
+            return
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.imports[a.asname or a.name] = node.lineno
+
+    def visit_Name(self, node):
+        self.used.add(node.id)
+
+    def _collect_annotation(self, ann) -> None:
+        """Names in an annotation expression, including names inside
+        string annotations (forward references / postponed evaluation)."""
+        if ann is None:
+            return
+        for sub in ast.walk(ann):
+            if isinstance(sub, ast.Name):
+                self.used.add(sub.id)
+            elif isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                try:
+                    parsed = ast.parse(sub.value, mode="eval")
+                except SyntaxError:
+                    continue
+                for n in ast.walk(parsed):
+                    if isinstance(n, ast.Name):
+                        self.used.add(n.id)
+
+    def visit_arg(self, node):
+        self._collect_annotation(node.annotation)
+        self.generic_visit(node)
+
+    def _visit_function(self, node):
+        self._collect_annotation(node.returns)
+        self.generic_visit(node)
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    def _record_exports(self, value) -> None:
+        try:
+            names = ast.literal_eval(value)
+        except (ValueError, SyntaxError, TypeError):
+            return
+        if isinstance(names, (list, tuple, set)):
+            self.exported |= {n for n in names if isinstance(n, str)}
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id == "__all__":
+                self._record_exports(node.value)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        if isinstance(node.target, ast.Name) and node.target.id == "__all__":
+            self._record_exports(node.value)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if (isinstance(node.target, ast.Name) and node.target.id == "__all__"
+                and node.value is not None):
+            self._record_exports(node.value)
+        self._collect_annotation(node.annotation)
+        self.generic_visit(node)
+
+
+def style_problems(path: Path, src: str = None) -> List[str]:
+    """The base per-file checks; returns formatted problem strings
+    (kept string-typed — these predate Finding and feed `make lint`)."""
+    problems = []
+    if src is None:
+        src = path.read_text()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
+
+    lines = src.splitlines()
+    noqa = {i + 1 for i, ln in enumerate(lines) if "# noqa" in ln}
+
+    for i, ln in enumerate(lines, 1):
+        if ln.rstrip() != ln and ln.strip():
+            problems.append(f"{path}:{i}: trailing whitespace")
+        if ln.startswith("\t"):
+            problems.append(f"{path}:{i}: tab indentation")
+
+    col = ImportCollector()
+    col.visit(tree)
+    # exemptions: used as a Name anywhere (annotations included), re-
+    # exported via __all__, `# noqa` on the import line, or a leading-
+    # underscore alias
+    for name, lineno in col.imports.items():
+        if name in col.used or name in col.exported or lineno in noqa:
+            continue
+        if name.startswith("_"):
+            continue
+        problems.append(f"{path}:{lineno}: unused import '{name}'")
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            if node.lineno not in noqa:
+                problems.append(f"{path}:{node.lineno}: bare 'except:'")
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in node.args.defaults + node.args.kw_defaults:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    problems.append(
+                        f"{path}:{node.lineno}: mutable default argument "
+                        f"in '{node.name}'"
+                    )
+        if isinstance(node, ast.Compare):
+            for cmp_op, val in zip(node.ops, node.comparators):
+                if isinstance(cmp_op, (ast.Eq, ast.NotEq)) and \
+                        isinstance(val, ast.Constant) and \
+                        any(val.value is c for c in (True, False, None)):
+                    if node.lineno not in noqa:
+                        problems.append(
+                            f"{path}:{node.lineno}: comparison to "
+                            f"{val.value!r} — use 'is'/'is not'/truthiness"
+                        )
+    return problems

@@ -1,0 +1,575 @@
+"""The ``--jax`` tracer/recompile hygiene pass.
+
+Scope: vpp_tpu/ops, vpp_tpu/pipeline, vpp_tpu/parallel — the code that
+is traced into XLA programs. Rules (docs/STATIC_ANALYSIS.md catalog):
+
+* ``jit-unregistered``   — a ``jax.jit`` call site not enumerated in
+  tools/analysis/jit_manifest.py. Every jit is a compile-cache entry
+  with a recompile blast radius; new ones land with a manifest reason.
+* ``jit-manifest-stale`` — a manifest entry (site or traced root) that
+  no longer matches the tree.
+* ``per-instance-jit``   — ``jax.jit`` of a closure that captures
+  ``self`` inside a method: a fresh function identity per instance, so
+  every instance re-traces (the PR-4 bug class: a fresh-closure-per-
+  dataplane step factory silently recompiled per test and blew the
+  tier-1 budget 3x).
+* ``host-sync``          — ``.item()``, ``int()/float()/bool()`` of a
+  tracer-derived value, or ``np.asarray/np.array`` of a device value
+  inside traced code: forces a device round trip per call (or a
+  ConcretizationTypeError at trace time).
+* ``tracer-branch``      — Python ``if``/``while`` on a tracer-derived
+  value inside traced code: per-value recompile or trace error; use
+  ``lax.cond``/``jnp.where``.
+* ``float-literal-dtype``— float literals fed to jnp constructors with
+  no explicit dtype, and any ``float64`` reference: under x64 these
+  silently drift the whole program to f64.
+* ``lru-cache-method``   — ``lru_cache`` on a method: keys on ``self``,
+  pinning instances live and giving per-instance cache behavior.
+* ``unhashable-arg``     — list/dict/set literal passed to an
+  ``lru_cache``'d factory: TypeError at call time.
+
+Traced code = the reachability closure from the manifest's jitted entry
+points: resolvable ``jax.jit(f)`` targets, decorated defs, plus the
+manifest's TRACED_ROOTS for indirect wrappings. Host callbacks
+(``io_callback``/``pure_callback`` first argument) are excluded — they
+run on the host by construction.
+
+Suppression: ``# jax-ok: <reason>`` on the flagged line.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from analysis.common import Finding, iter_source_files, parse_suppressions
+
+JAX_ROOTS = ("vpp_tpu/ops", "vpp_tpu/pipeline", "vpp_tpu/parallel")
+
+ARRAY_MODULES = {"jnp", "lax", "jsp", "pl"}
+STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "sharding"}
+CALLBACK_FUNCS = {"io_callback", "pure_callback", "debug_callback",
+                  "callback"}
+NP_SYNC_FUNCS = {"asarray", "array", "copy", "ascontiguousarray"}
+JNP_FLOAT_CTORS = {"array", "asarray", "full", "arange"}
+LRU_NAMES = {"lru_cache", "cache"}
+
+
+class ModuleIndex:
+    """Per-module AST index: defs by qualname, lexical child-def maps,
+    and the import environment (vpp_tpu-internal bindings only)."""
+
+    def __init__(self, repo: Path, relpath: str, tree: ast.Module,
+                 sup) -> None:
+        self.relpath = relpath
+        self.tree = tree
+        self.sup = sup
+        self.defs: Dict[str, ast.AST] = {}
+        # id(scope node) -> {name: def node}; key 0 == module scope
+        self.children: Dict[int, Dict[str, ast.AST]] = {0: {}}
+        self.obj_imports: Dict[str, Tuple[str, str]] = {}
+        self.mod_imports: Dict[str, str] = {}
+        self._index(tree, prefix="", scope_key=0)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module and \
+                    node.module.startswith("vpp_tpu"):
+                base = node.module.replace(".", "/")
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    if (repo / base / (a.name + ".py")).is_file():
+                        self.mod_imports[a.asname or a.name] = \
+                            f"{base}/{a.name}.py"
+                    elif (repo / (base + ".py")).is_file():
+                        self.obj_imports[a.asname or a.name] = \
+                            (base + ".py", a.name)
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.startswith("vpp_tpu") and \
+                            (repo / (a.name.replace(".", "/") + ".py")
+                             ).is_file():
+                        bound = a.asname or a.name.split(".")[0]
+                        if a.asname:
+                            self.mod_imports[bound] = \
+                                a.name.replace(".", "/") + ".py"
+
+    def _index(self, node, prefix: str, scope_key: int) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                self.defs[qual] = child
+                self.children.setdefault(scope_key, {})[child.name] = child
+                self.children.setdefault(id(child), {})
+                self._index(child, f"{qual}.", id(child))
+            elif isinstance(child, ast.ClassDef):
+                # class bodies don't form a name-resolution scope for
+                # methods; qualnames still carry the class for display
+                self._index(child, f"{prefix}{child.name}.", scope_key)
+            else:
+                self._index(child, prefix, scope_key)
+
+
+def _base_name(expr) -> Optional[str]:
+    while isinstance(expr, ast.Attribute):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+def _is_jax_jit(expr) -> bool:
+    return (isinstance(expr, ast.Attribute) and expr.attr == "jit"
+            and _base_name(expr) == "jax") or \
+           (isinstance(expr, ast.Name) and expr.id == "jit")
+
+
+def _is_shard_map(expr) -> bool:
+    return isinstance(expr, ast.Attribute) and expr.attr == "shard_map"
+
+
+def _is_lru_decorator(dec) -> bool:
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    return (isinstance(dec, ast.Name) and dec.id in LRU_NAMES) or \
+           (isinstance(dec, ast.Attribute) and dec.attr in LRU_NAMES)
+
+
+def _jit_decorator(dec) -> bool:
+    """``@jax.jit`` / ``@jit`` / ``@functools.partial(jax.jit, ...)``."""
+    if _is_jax_jit(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        f = dec.func
+        if _is_jax_jit(f):
+            return True
+        if ((isinstance(f, ast.Attribute) and f.attr == "partial")
+                or (isinstance(f, ast.Name) and f.id == "partial")):
+            return bool(dec.args) and _is_jax_jit(dec.args[0])
+    return False
+
+
+class _Region:
+    """One traced region: a def (or lambda) plus its lexical extent."""
+
+    def __init__(self, module: ModuleIndex, node, qual: str,
+                 scope_chain: List[ast.AST]):
+        self.module = module
+        self.node = node
+        self.qual = qual
+        # innermost-first enclosing def nodes, for name resolution
+        self.scope_chain = scope_chain
+
+
+class JaxPass:
+    def __init__(self, repo: Path, roots=JAX_ROOTS, jit_sites=None,
+                 traced_roots=None):
+        if jit_sites is None or traced_roots is None:
+            from analysis import jit_manifest
+
+            jit_sites = jit_manifest.JIT_SITES if jit_sites is None \
+                else jit_sites
+            traced_roots = jit_manifest.TRACED_ROOTS if traced_roots is None \
+                else traced_roots
+        self.repo = repo
+        self.roots = roots
+        self.jit_sites = dict(jit_sites)
+        self.traced_roots = set(traced_roots)
+        self.findings: List[Finding] = []
+        self.modules: Dict[str, ModuleIndex] = {}
+
+    # --- top level ---
+    def run(self) -> List[Finding]:
+        for relpath, path in iter_source_files(self.repo, self.roots):
+            src = path.read_text()
+            try:
+                tree = ast.parse(src, filename=relpath)
+            except SyntaxError:
+                continue  # the style pass reports parse failures
+            sup = parse_suppressions(src, relpath)
+            self.findings.extend(sup.problems)
+            self.modules[relpath] = ModuleIndex(self.repo, relpath,
+                                               tree, sup)
+        seen_sites = set()
+        regions: List[_Region] = []
+        for mod in self.modules.values():
+            regions.extend(self._collect_sites(mod, seen_sites))
+        for relpath, qual in sorted(self.traced_roots):
+            mod = self.modules.get(relpath)
+            node = mod.defs.get(qual) if mod else None
+            if node is None:
+                self._emit(relpath, 1, "jit-manifest-stale",
+                           f"traced root {qual!r} not found in {relpath}",
+                           mod)
+                continue
+            regions.append(_Region(mod, node, qual,
+                                   self._scope_chain(mod, node)))
+        for key, reason in sorted(self.jit_sites.items()):
+            if key not in seen_sites:
+                self._emit(key[0], 1, "jit-manifest-stale",
+                           f"manifest site {key[1]!r} has no matching "
+                           f"jax.jit call ({reason})",
+                           self.modules.get(key[0]))
+        self._close_and_check(regions)
+        self._module_rules()
+        return self.findings
+
+    def _emit(self, relpath: str, line: int, rule: str, msg: str,
+              mod: Optional[ModuleIndex]) -> None:
+        if mod is not None and line in mod.sup.jax:
+            return
+        self.findings.append(Finding(relpath, line, rule, msg))
+
+    # --- name resolution ---
+    def _scope_chain(self, mod: ModuleIndex, node) -> List[ast.AST]:
+        """Enclosing def nodes of ``node``, innermost first."""
+        chain: List[ast.AST] = []
+
+        def descend(parent, stack):
+            for child in ast.iter_child_nodes(parent):
+                if child is node:
+                    chain.extend(reversed(stack))
+                    return True
+                nstack = stack + [child] if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ) else stack
+                if descend(child, nstack):
+                    return True
+            return False
+
+        descend(mod.tree, [])
+        return chain
+
+    def _resolve(self, mod: ModuleIndex, scope_chain, name: str):
+        """Resolve ``name`` to (module, qual, def node) or None."""
+        for scope in scope_chain:
+            hit = mod.children.get(id(scope), {}).get(name)
+            if hit is not None:
+                return mod, self._qual_of(mod, hit), hit
+        hit = mod.children[0].get(name)
+        if hit is not None:
+            return mod, self._qual_of(mod, hit), hit
+        target = mod.obj_imports.get(name)
+        if target is not None:
+            tmod = self.modules.get(target[0])
+            if tmod is not None:
+                hit = tmod.children[0].get(target[1])
+                if hit is not None:
+                    return tmod, self._qual_of(tmod, hit), hit
+        return None
+
+    def _qual_of(self, mod: ModuleIndex, node) -> str:
+        for qual, d in mod.defs.items():
+            if d is node:
+                return qual
+        return getattr(node, "name", "<lambda>")
+
+    # --- jit call sites ---
+    def _collect_sites(self, mod: ModuleIndex, seen) -> List[_Region]:
+        regions: List[_Region] = []
+        decorator_calls = set()
+        for qual, d in mod.defs.items():
+            for dec in getattr(d, "decorator_list", []):
+                if _jit_decorator(dec):
+                    decorator_calls.update(id(n) for n in ast.walk(dec))
+                    key = (mod.relpath, f"@{qual}")
+                    seen.add(key)
+                    if key not in self.jit_sites:
+                        self._emit(mod.relpath, d.lineno, "jit-unregistered",
+                                   f"jit decorator on {qual!r} is not in "
+                                   f"the jit manifest "
+                                   f"(tools/analysis/jit_manifest.py)", mod)
+                    regions.append(_Region(
+                        mod, d, qual, self._scope_chain(mod, d)))
+
+        def scan(parent, fstack):
+            for child in ast.iter_child_nodes(parent):
+                nstack = fstack
+                if isinstance(child,
+                              (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    nstack = fstack + [child]
+                if isinstance(child, ast.Call) and _is_jax_jit(child.func) \
+                        and id(child) not in decorator_calls:
+                    self._one_site(mod, child, fstack, seen, regions)
+                scan(child, nstack)
+
+        scan(mod.tree, [])
+        return regions
+
+    def _one_site(self, mod, call, fstack, seen, regions) -> None:
+        scope = self._qual_of(mod, fstack[-1]) if fstack else "<module>"
+        key = (mod.relpath, scope)
+        seen.add(key)
+        if key not in self.jit_sites:
+            self._emit(mod.relpath, call.lineno, "jit-unregistered",
+                       f"jax.jit call in {scope!r} is not in the jit "
+                       f"manifest (tools/analysis/jit_manifest.py)", mod)
+        if not call.args:
+            return
+        target = call.args[0]
+        if isinstance(target, ast.Call) and _is_shard_map(target.func) \
+                and target.args:
+            target = target.args[0]
+        chain = list(reversed(fstack))
+        if isinstance(target, ast.Lambda):
+            regions.append(_Region(mod, target, f"{scope}.<lambda>",
+                                   chain))
+            if fstack and self._mentions_self(target):
+                self._emit(mod.relpath, call.lineno, "per-instance-jit",
+                           f"jax.jit of a self-capturing lambda in "
+                           f"{scope!r}: fresh function identity per "
+                           f"instance, re-traced per instance", mod)
+            return
+        if isinstance(target, ast.Name):
+            hit = self._resolve(mod, chain, target.id)
+            if hit is not None:
+                tmod, tqual, tnode = hit
+                regions.append(_Region(tmod, tnode, tqual,
+                                       self._scope_chain(tmod, tnode)))
+                # a LOCAL def jitted inside a method that closes over
+                # self is the PR-4 recompile class
+                if fstack and tmod is mod and tnode in ast.walk(fstack[-1]) \
+                        and self._mentions_self(tnode):
+                    self._emit(
+                        mod.relpath, call.lineno, "per-instance-jit",
+                        f"jax.jit of local closure {tqual!r} capturing "
+                        f"self: fresh function identity per instance, "
+                        f"re-traced per instance", mod)
+
+    @staticmethod
+    def _mentions_self(node) -> bool:
+        return any(isinstance(n, ast.Name) and n.id == "self"
+                   for n in ast.walk(node))
+
+    # --- reachability closure + traced-region rules ---
+    def _close_and_check(self, regions: List[_Region]) -> None:
+        traced: Dict[int, _Region] = {}
+        work = list(regions)
+        while work:
+            r = work.pop()
+            if id(r.node) in traced:
+                continue
+            traced[id(r.node)] = r
+            for name, line in self._region_refs(r):
+                hit = self._resolve(r.module, [r.node] + r.scope_chain,
+                                    name)
+                if hit is not None and id(hit[2]) not in traced:
+                    tmod, tqual, tnode = hit
+                    work.append(_Region(
+                        tmod, tnode, tqual,
+                        self._scope_chain(tmod, tnode)))
+        for r in traced.values():
+            # skip regions lexically inside another traced region: the
+            # enclosing region's checker covers them exactly once
+            if any(id(s) in traced for s in r.scope_chain):
+                continue
+            _TaintChecker(self, r).run()
+
+    def _region_refs(self, r: _Region):
+        """(name, line) of every Name referenced in the region, host
+        callback functions excluded."""
+        skip = set()
+        for node in ast.walk(r.node):
+            if isinstance(node, ast.Call):
+                f = node.func
+                fname = f.attr if isinstance(f, ast.Attribute) else \
+                    (f.id if isinstance(f, ast.Name) else None)
+                if fname in CALLBACK_FUNCS and node.args:
+                    skip.update(id(n) for n in ast.walk(node.args[0]))
+        for node in ast.walk(r.node):
+            if isinstance(node, ast.Name) and id(node) not in skip:
+                yield node.id, node.lineno
+
+    # --- module-wide rules (float64 refs, lru_cache hygiene) ---
+    def _module_rules(self) -> None:
+        for mod in self.modules.values():
+            lru_defs = {}
+            for qual, d in mod.defs.items():
+                if any(_is_lru_decorator(dec)
+                       for dec in getattr(d, "decorator_list", [])):
+                    lru_defs[d.name] = qual
+                    args = d.args.posonlyargs + d.args.args
+                    if args and args[0].arg in ("self", "cls"):
+                        self._emit(
+                            mod.relpath, d.lineno, "lru-cache-method",
+                            f"lru_cache on method {qual!r}: cache keys "
+                            f"on the instance (leaks it, and behaves "
+                            f"per-instance — memoize at module scope)",
+                            mod)
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Attribute) and \
+                        node.attr == "float64":
+                    self._emit(mod.relpath, node.lineno,
+                               "float-literal-dtype",
+                               "float64 reference in traced-root code: "
+                               "x64 drift doubles every downstream "
+                               "buffer", mod)
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Name) and \
+                        node.func.id in lru_defs:
+                    for a in node.args:
+                        if isinstance(a, (ast.List, ast.Dict, ast.Set)):
+                            self._emit(
+                                mod.relpath, node.lineno, "unhashable-arg",
+                                f"unhashable literal passed to "
+                                f"lru_cache'd {lru_defs[node.func.id]!r}",
+                                mod)
+
+
+class _TaintChecker:
+    """Forward taint walk over one traced region: parameters and
+    jnp/lax-derived values are tracers; host syncs and Python control
+    flow on them are findings."""
+
+    def __init__(self, owner: JaxPass, region: _Region):
+        self.owner = owner
+        self.r = region
+        self.tainted: set = set()
+        for node in ast.walk(region.node):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                a = node.args
+                for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                            + ([a.vararg] if a.vararg else [])
+                            + ([a.kwarg] if a.kwarg else [])):
+                    self.tainted.add(arg.arg)
+
+    def _emit(self, line: int, rule: str, msg: str) -> None:
+        self.owner._emit(self.r.module.relpath, line, rule, msg,
+                         self.r.module)
+
+    def is_tainted(self, expr) -> bool:
+        if expr is None or isinstance(expr, ast.Constant):
+            return False
+        if isinstance(expr, ast.Name):
+            return expr.id in self.tainted
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in STATIC_ATTRS:
+                return False
+            return self.is_tainted(expr.value)
+        if isinstance(expr, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in expr.ops):
+            # `x is None` is resolved at TRACE time (a tracer is never
+            # None): static, whatever x is
+            return False
+        if isinstance(expr, ast.Call):
+            f = expr.func
+            if isinstance(f, ast.Name):
+                if f.id == "len":
+                    return False
+                if f.id in ("int", "float", "bool"):
+                    return False  # host value (flagged separately)
+            if isinstance(f, ast.Attribute) and \
+                    _base_name(f) in ARRAY_MODULES:
+                return True
+            return any(self.is_tainted(a) for a in expr.args) or \
+                any(self.is_tainted(kw.value) for kw in expr.keywords) or \
+                self.is_tainted(f)
+        return any(self.is_tainted(c) for c in ast.iter_child_nodes(expr))
+
+    def run(self) -> None:
+        self._walk(self.r.node)
+
+    def _walk(self, node) -> None:
+        for stmt in ast.iter_child_nodes(node):
+            self._stmt(stmt)
+
+    def _stmt(self, stmt) -> None:
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            if value is not None:
+                self._check_expr(value)
+                if self.is_tainted(value):
+                    targets = stmt.targets if isinstance(
+                        stmt, ast.Assign) else [stmt.target]
+                    for t in targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                self.tainted.add(n.id)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._check_expr(stmt.test)
+            if self.is_tainted(stmt.test):
+                kind = "if" if isinstance(stmt, ast.If) else "while"
+                self._emit(stmt.lineno, "tracer-branch",
+                           f"Python `{kind}` on a tracer-derived value: "
+                           f"recompiles per value (or fails to trace) — "
+                           f"use lax.cond/lax.while_loop/jnp.where")
+            for s in stmt.body + stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.For):
+            if self.is_tainted(stmt.iter):
+                for n in ast.walk(stmt.target):
+                    if isinstance(n, ast.Name):
+                        self.tainted.add(n.id)
+            self._check_expr(stmt.iter)
+            for s in stmt.body + stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, (ast.Return, ast.Expr)):
+            if stmt.value is not None:
+                self._check_expr(stmt.value)
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._check_expr(item.context_expr)
+            for s in stmt.body:
+                self._stmt(s)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._walk(stmt)
+            return
+        # default: check expressions, recurse into bodies (except
+        # handlers are neither stmt nor expr — recurse explicitly or
+        # their bodies would escape the host-sync/branch rules)
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._check_expr(child)
+            elif isinstance(child, (ast.stmt, ast.excepthandler)):
+                self._stmt(child)
+
+    def _check_expr(self, expr) -> None:
+        skip = set()
+        for node in ast.walk(expr):
+            if id(node) in skip or not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            fname = f.attr if isinstance(f, ast.Attribute) else \
+                (f.id if isinstance(f, ast.Name) else None)
+            if fname in CALLBACK_FUNCS and node.args:
+                skip.update(id(n) for n in ast.walk(node.args[0]))
+                continue
+            if isinstance(f, ast.Attribute) and f.attr == "item":
+                self._emit(node.lineno, "host-sync",
+                           ".item() in traced code forces a device "
+                           "round trip per call")
+            elif isinstance(f, ast.Name) and \
+                    f.id in ("int", "float", "bool") and \
+                    any(self.is_tainted(a) for a in node.args):
+                self._emit(node.lineno, "host-sync",
+                           f"{f.id}() of a tracer-derived value in "
+                           f"traced code: host sync (or trace error)")
+            elif isinstance(f, ast.Attribute) and \
+                    _base_name(f) == "np" and f.attr in NP_SYNC_FUNCS and \
+                    any(self.is_tainted(a) for a in node.args):
+                self._emit(node.lineno, "host-sync",
+                           f"np.{f.attr}() of a device value in traced "
+                           f"code: device->host copy per call")
+            elif isinstance(f, ast.Attribute) and \
+                    _base_name(f) == "jnp" and f.attr in JNP_FLOAT_CTORS:
+                has_float = any(
+                    isinstance(n, ast.Constant) and isinstance(n.value,
+                                                               float)
+                    for a in node.args for n in ast.walk(a))
+                has_dtype = any(kw.arg == "dtype" for kw in node.keywords)
+                if has_float and not has_dtype:
+                    self._emit(node.lineno, "float-literal-dtype",
+                               f"float literal into jnp.{f.attr} with no "
+                               f"dtype: promotes to float64 under x64")
+
+
+def jax_lint(repo=None, roots=JAX_ROOTS, jit_sites=None,
+             traced_roots=None) -> List[Finding]:
+    """Run the pass; returns unsuppressed findings (empty == clean)."""
+    if repo is None:
+        repo = Path(__file__).resolve().parents[2]
+    return JaxPass(Path(repo), roots, jit_sites, traced_roots).run()

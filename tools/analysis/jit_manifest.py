@@ -1,0 +1,70 @@
+"""The jit-registry manifest: every jitted entry point of the traced
+roots (vpp_tpu/ops, vpp_tpu/pipeline, vpp_tpu/parallel), ENUMERATED —
+the jax pass (tools/analysis/jaxlint.py) fails on any ``jax.jit`` call
+site not registered here, and on any entry here that no longer matches
+a call site (stale manifest). Adding a jit is a reviewed decision: it
+changes what recompiles when, so it lands with a reason string.
+
+Keys are ``(repo-relative path, enclosing scope qualname)``; the scope
+is ``<module>`` for module-level calls, ``@name`` for a decorator on
+``name``, else the dotted qualname of the enclosing function/method.
+
+``TRACED_ROOTS`` additionally names functions that are traced INTO a
+jit program but whose wrapping is indirect (the first argument of the
+``jax.jit`` call is an expression the AST pass cannot resolve — e.g.
+``jax.jit(_packed_call(fn))``). These are the roots the host-sync /
+tracer-branch rules start their reachability closure from; a root that
+names a function that no longer exists is a finding.
+"""
+
+# (relpath, scope) -> why this site exists / what caches it
+JIT_SITES = {
+    ("vpp_tpu/pipeline/dataplane.py", "_jitted_step"):
+        "THE step factory: process-wide _JIT_STEPS cache keyed "
+        "(impl, skip, fast, form); compile counting wraps fn here",
+    ("vpp_tpu/pipeline/dataplane.py", "Dataplane.encap_remote"):
+        "lazy vxlan_encap jit; module-level target fn, built once per "
+        "dataplane on first remote-disposed frame",
+    ("vpp_tpu/pipeline/dataplane.py", "Dataplane.time_classifier"):
+        "diagnostic classify probe; per-impl cache on the instance, "
+        "bench/operator path — never hot",
+    ("vpp_tpu/pipeline/graph.py", "<module>"):
+        "pipeline_step_jit: the module-level reference step (tests, "
+        "trace/cycles)",
+    ("vpp_tpu/pipeline/tables.py", "_glb_update_fn"):
+        "incremental glb-blob upload kernel; memoized per (w_r, w_c, "
+        "planes) block geometry",
+    ("vpp_tpu/pipeline/persistent.py", "PersistentPump.__init__"):
+        "the resident io_callback loop; one compile per pump instance "
+        "by design (long-lived singleton per process)",
+    ("vpp_tpu/parallel/cluster.py", "make_cluster_step"):
+        "the SPMD cluster step (shard_map over the node mesh); built "
+        "once per mesh by ClusterDataplane",
+    ("vpp_tpu/ops/acl_mxu.py", "@mxu_first_match"):
+        "pallas first-match kernel entry; static interpret flag only",
+}
+
+# (relpath, dotted def qualname) traced into jit programs indirectly
+TRACED_ROOTS = {
+    # the step factory composition: jax.jit(make_pipeline_step(...))
+    ("vpp_tpu/pipeline/graph.py", "make_pipeline_step.step"),
+    ("vpp_tpu/pipeline/graph.py", "pipeline_step"),
+    ("vpp_tpu/pipeline/graph.py", "pipeline_step_fast"),
+    ("vpp_tpu/pipeline/graph.py", "pipeline_step_auto"),
+    # the packed/chained IO boundary wrappers: jax.jit(_packed_call(fn))
+    ("vpp_tpu/pipeline/dataplane.py", "_packed_call.run"),
+    ("vpp_tpu/pipeline/dataplane.py", "_chained_call.run"),
+    # classifier implementations reach jit through _classifier_fns /
+    # time_classifier's subscripted call — enumerate them explicitly
+    ("vpp_tpu/ops/acl.py", "acl_classify_global"),
+    ("vpp_tpu/ops/acl.py", "acl_classify_local"),
+    ("vpp_tpu/ops/acl.py", "acl_local_none"),
+    ("vpp_tpu/ops/acl_mxu.py", "acl_classify_global_mxu"),
+    ("vpp_tpu/ops/acl_bv.py", "acl_classify_global_bv"),
+    ("vpp_tpu/ops/acl_bv.py", "acl_classify_local_bv"),
+    # mesh-sharded classify substitutions (parallel/cluster.py body)
+    ("vpp_tpu/parallel/cluster.py", "sharded_global_classify"),
+    ("vpp_tpu/parallel/cluster.py", "sharded_global_classify_mxu"),
+    # vxlan encap rides its own jit (Dataplane.encap_remote)
+    ("vpp_tpu/ops/vxlan.py", "vxlan_encap"),
+}

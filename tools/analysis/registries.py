@@ -1,0 +1,217 @@
+"""Registry/table invariant passes (``--metrics`` / ``--counters`` /
+``--tables``) — moved verbatim in behavior from the original
+tools/lint.py. These import the dataplane (and therefore jax), so they
+only run when asked for; tier-1 invokes them via
+tests/test_exposition.py and tests/test_acl_bv.py.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+
+def _repo_on_path() -> Path:
+    repo = Path(__file__).resolve().parents[2]
+    if str(repo) not in sys.path:
+        sys.path.insert(0, str(repo))
+    return repo
+
+
+def _build_full_registry():
+    """Every family the deployed processes serve, in ONE registry (so
+    cross-path duplicates are caught). Shared by the --metrics and
+    --counters passes."""
+    _repo_on_path()
+    from vpp_tpu.ksr.reflector import ReflectorRegistry
+    from vpp_tpu.kvstore.server import make_request_histogram
+    from vpp_tpu.pipeline.dataplane import Dataplane
+    from vpp_tpu.pipeline.tables import DataplaneConfig
+    from vpp_tpu.stats.collector import (
+        StatsCollector,
+        register_control_plane_metrics,
+        register_ksr_gauges,
+    )
+
+    dp = Dataplane(DataplaneConfig(
+        max_tables=2, max_rules=8, max_global_rules=8, max_ifaces=8,
+        fib_slots=16, sess_slots=64, nat_mappings=2, nat_backends=4))
+    coll = StatsCollector(dp)
+    register_control_plane_metrics(coll.registry)
+    # the KSR and kvserver families live on other processes/paths; fold
+    # them into the same registry so cross-path duplicates are caught
+    register_ksr_gauges(coll.registry, ReflectorRegistry(), path="/metrics")
+    coll.registry.register("/kvstore", make_request_histogram())
+    return coll.registry
+
+
+def metrics_lint() -> list:
+    """Build every registry the deployed processes serve and validate
+    the registered families (MetricsRegistry.lint). Returns problems."""
+    return _build_full_registry().lint()
+
+
+def counters_lint() -> list:
+    """Counter-parity pass: every StepStats field must map to a
+    registered Prometheus family (stats/collector.py
+    STEPSTATS_FAMILIES), and every registered ``vpp_tpu_pipeline_*``
+    family must map back to a StepStats field — a pipeline counter
+    added on either side without its observability twin fails here
+    (and tier-1, via tests/test_exposition.py)."""
+    registry = _build_full_registry()
+    from vpp_tpu.pipeline.graph import StepStats
+    from vpp_tpu.stats.collector import STEPSTATS_FAMILIES
+
+    problems = []
+    fields = set(StepStats._fields)
+    mapped = set(STEPSTATS_FAMILIES)
+    for f in sorted(fields - mapped):
+        problems.append(
+            f"counters: StepStats.{f} has no Prometheus family mapping "
+            f"(stats/collector.py STEPSTATS_FAMILIES)"
+        )
+    for f in sorted(mapped - fields):
+        problems.append(
+            f"counters: STEPSTATS_FAMILIES maps {f!r} which is not a "
+            f"StepStats field (stale entry?)"
+        )
+    registered = {fam.name for _path, fam in registry.families()}
+    for f, family in sorted(STEPSTATS_FAMILIES.items()):
+        if family not in registered:
+            problems.append(
+                f"counters: StepStats.{f} maps to unregistered family "
+                f"{family!r}"
+            )
+    mapped_families = set(STEPSTATS_FAMILIES.values())
+    for name in sorted(registered):
+        if name.startswith("vpp_tpu_pipeline_") and \
+                name not in mapped_families:
+            problems.append(
+                f"counters: family {name!r} is in the pipeline "
+                f"namespace but maps to no StepStats field"
+            )
+    return problems
+
+
+def _bv_plane_problems(name: str, bv, nrules: int, max_rules: int) -> list:
+    """Invariants of ONE compiled BvTable against its live rule count."""
+    import numpy as np
+
+    from vpp_tpu.ops.acl_bv import DIMS, bv_capacity
+
+    problems = []
+    cap_i, cap_w, cap_pr = bv_capacity(max_rules, True)
+    planes = {dim: getattr(bv, f"bm_{dim}") for dim in DIMS}
+    planes["proto"] = bv.bm_proto
+    for k, dim in enumerate(DIMS):
+        bnd = getattr(bv, f"bnd_{dim}")
+        n = int(bv.nbnd[k])
+        if len(bnd) != cap_i:
+            problems.append(
+                f"tables: {name}.{dim} boundary capacity {len(bnd)} != "
+                f"bv_capacity {cap_i}")
+        live = bnd[:n].astype(np.int64)
+        if n and not (np.diff(live) > 0).all():
+            problems.append(
+                f"tables: {name}.{dim} boundaries not strictly sorted")
+        if n and live[0] != 0:
+            problems.append(
+                f"tables: {name}.{dim} boundary[0] != 0 (value space "
+                f"must be fully covered)")
+    for pname, bm in planes.items():
+        if bm.shape[-1] != cap_w or cap_w != max(1, (max_rules + 31) // 32):
+            problems.append(
+                f"tables: {name}.{pname} word width {bm.shape[-1]} does "
+                f"not match padded rule capacity {max_rules}")
+        # padding inert, rule axis: no bit of a row >= nrules anywhere
+        for w in range(bm.shape[-1]):
+            lo_rule = w * 32
+            nbits = min(32, max(0, nrules - lo_rule))
+            allowed = np.uint32((1 << nbits) - 1)
+            if (bm[..., w] & ~allowed).any():
+                problems.append(
+                    f"tables: {name}.{pname} word {w} sets bits of "
+                    f"padding rules (nrules={nrules})")
+        # padding inert, interval axis: rows past the live boundary
+        # count must be all-zero (a clipped lookup can never land
+        # there; a stale bit would be a silent wrong-match hazard)
+        if pname != "proto":
+            n = int(bv.nbnd[list(DIMS).index(pname)])
+            if bm[n:].any():
+                problems.append(
+                    f"tables: {name}.{pname} has bits set in interval "
+                    f"rows >= nbnd ({n})")
+    return problems
+
+
+def tables_lint() -> list:
+    """Table-structure invariant pass (`--tables`): commit a
+    representative rule set through a BV-enabled TableBuilder and
+    validate the compiled structure + the cross-implementation
+    capacity constants. Returns problems."""
+    _repo_on_path()
+    import ipaddress
+
+    from vpp_tpu.ir.rule import Action, ContivRule, Protocol
+    from vpp_tpu.ops.acl_bv import bv_capacity, bv_global_bytes
+    from vpp_tpu.ops.acl_mxu import mxu_rule_capacity
+    from vpp_tpu.pipeline.tables import DataplaneConfig, TableBuilder
+
+    cfg = DataplaneConfig(
+        max_tables=2, max_rules=16, max_global_rules=96, max_ifaces=8,
+        fib_slots=16, sess_slots=64, nat_mappings=2, nat_backends=4,
+        classifier="bv")
+    b = TableBuilder(cfg)
+    rules = [
+        ContivRule(action=Action.PERMIT, protocol=Protocol.TCP,
+                   src_network=ipaddress.ip_network(f"10.{i}.0.0/16"),
+                   dest_port=80 + i)
+        for i in range(40)
+    ] + [
+        ContivRule(action=Action.DENY, protocol=Protocol.UDP,
+                   dest_port=0),
+        ContivRule(action=Action.PERMIT),        # wildcard everything
+        ContivRule(action=Action.DENY, protocol=Protocol.TCP,
+                   dest_port=65535),
+        ContivRule(action=Action.DENY),          # terminal deny-all
+    ]
+    b.set_global_table(rules)
+    b.set_local_table(0, rules[:7])
+    # slot 1 stays empty: its planes must be entirely inert
+
+    problems = _bv_plane_problems("glb", b.glb_bv, b.glb_nrules,
+                                  cfg.max_global_rules)
+    for slot, nrules in ((0, 7), (1, 0)):
+        from vpp_tpu.ops.acl_bv import BvTable
+
+        local = BvTable(
+            bnd_src=b.acl_bv["bnd_src"][slot],
+            bnd_dst=b.acl_bv["bnd_dst"][slot],
+            bnd_sport=b.acl_bv["bnd_sport"][slot],
+            bnd_dport=b.acl_bv["bnd_dport"][slot],
+            nbnd=b.acl_bv["nbnd"][slot],
+            bm_src=b.acl_bv["src"][slot], bm_dst=b.acl_bv["dst"][slot],
+            bm_sport=b.acl_bv["sport"][slot],
+            bm_dport=b.acl_bv["dport"][slot],
+            bm_proto=b.acl_bv["proto"][slot],
+            ok=bool(b.acl_bv_ok[slot]), build_ms=0.0,
+        )
+        problems += _bv_plane_problems(f"local[{slot}]", local, nrules,
+                                       cfg.max_rules)
+    # cross-implementation capacity constants
+    for r in (cfg.max_rules, cfg.max_global_rules, 1024, 10240):
+        ib, w, _pr = bv_capacity(r, True)
+        if ib != 2 * r + 2:
+            problems.append(
+                f"tables: bv interval capacity {ib} != 2*{r}+2")
+        if w * 32 < r:
+            problems.append(
+                f"tables: bv word capacity {w}*32 < {r} rules")
+        if mxu_rule_capacity(r) < r:
+            problems.append(
+                f"tables: mxu rule capacity {mxu_rule_capacity(r)} < {r}")
+        if bv_global_bytes(r) < ib * w * 4 * 4:
+            problems.append(
+                f"tables: bv_global_bytes({r}) smaller than its own "
+                f"bitmap matrices")
+    return problems

@@ -1,0 +1,231 @@
+"""The ``--threads`` lock-discipline pass.
+
+Scope: the concurrent control-plane and pump modules (io/pump.py,
+io/cluster_pump.py, kvstore/, stats/, trace/, pipeline/txn.py — the
+files where the agent's threads, the pump's fetch workers and the
+kvstore's replication threads meet shared state).
+
+Rules (docs/STATIC_ANALYSIS.md catalog):
+
+* ``unlocked-access`` — per class, the PROTECTED attribute set is
+  inferred: any ``self.X`` written under ``with self.<lock>`` in a
+  non-``__init__`` method is protected by that lock; every other
+  read/write of X in any method must then hold the same lock.
+  Exemptions: ``__init__`` (no concurrent access before publication),
+  methods whose name ends in ``_locked`` (the in-tree convention for
+  "caller holds the lock"), and sites annotated
+  ``# unlocked: <reason>``.
+* ``lock-order``      — per class, ``with self.A:`` lexically nested
+  inside ``with self.B:`` defines the acquisition edge B->A; a cycle
+  in that graph (A->B somewhere, B->A elsewhere) is a deadlock-by-
+  schedule waiting to happen.
+
+Lock attributes are discovered from ``__init__``: names assigned
+``threading.Lock()``, ``RLock()`` or ``Condition()`` (aliases via
+``self.a = self.b`` follow the aliased lock). Nested function bodies
+(worker closures handed to threads) reset the held-lock context — the
+closure runs later, not under the ``with`` that lexically encloses its
+definition.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+from analysis.common import Finding, iter_source_files, parse_suppressions
+
+THREAD_ROOTS = (
+    "vpp_tpu/io/pump.py",
+    "vpp_tpu/io/cluster_pump.py",
+    "vpp_tpu/kvstore",
+    "vpp_tpu/stats",
+    "vpp_tpu/trace",
+    "vpp_tpu/pipeline/txn.py",
+)
+
+LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+
+def _self_attr(expr) -> Optional[str]:
+    """'x' for ``self.x``, 'a.b' for ``self.a.b`` — None otherwise."""
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name) and expr.id == "self":
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_lock_ctor(expr) -> bool:
+    if not isinstance(expr, ast.Call):
+        return False
+    f = expr.func
+    name = f.attr if isinstance(f, ast.Attribute) else \
+        (f.id if isinstance(f, ast.Name) else None)
+    return name in LOCK_CTORS
+
+
+class _ClassInfo:
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        self.locks: Set[str] = set()
+        # attr -> {lock: [(method, line, is_write)]} for locked writes
+        self.locked_writes: Dict[str, Dict[str, list]] = {}
+        # every access: (attr, method, line, is_write, held_locks)
+        self.accesses: List[Tuple[str, str, int, bool, frozenset]] = []
+        # lock-nesting edges: (outer, inner) -> first line seen
+        self.edges: Dict[Tuple[str, str], int] = {}
+
+
+class ThreadPass:
+    def __init__(self, repo: Path, roots=THREAD_ROOTS):
+        self.repo = repo
+        self.roots = roots
+        self.findings: List[Finding] = []
+
+    def run(self) -> List[Finding]:
+        for relpath, path in iter_source_files(self.repo, self.roots):
+            src = path.read_text()
+            try:
+                tree = ast.parse(src, filename=relpath)
+            except SyntaxError:
+                continue  # the style pass reports parse failures
+            sup = parse_suppressions(src, relpath)
+            self.findings.extend(sup.problems)
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ClassDef):
+                    self._check_class(relpath, node, sup)
+        return self.findings
+
+    def _emit(self, relpath, line, rule, msg, sup) -> None:
+        if line in sup.unlocked:
+            return
+        self.findings.append(Finding(relpath, line, rule, msg))
+
+    # --- per-class analysis ---
+    def _check_class(self, relpath: str, cls: ast.ClassDef, sup) -> None:
+        info = _ClassInfo(cls)
+        init = next((m for m in cls.body
+                     if isinstance(m, ast.FunctionDef)
+                     and m.name == "__init__"), None)
+        if init is not None:
+            aliases: Dict[str, str] = {}
+            for stmt in ast.walk(init):
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                for t in stmt.targets:
+                    attr = _self_attr(t)
+                    if attr is None:
+                        continue
+                    if _is_lock_ctor(stmt.value):
+                        info.locks.add(attr)
+                    else:
+                        src_attr = _self_attr(stmt.value)
+                        if src_attr is not None:
+                            aliases[attr] = src_attr
+            # one alias hop is enough for the in-tree idiom
+            # (commit_lock = self._lock)
+            for dst, src_attr in aliases.items():
+                if src_attr in info.locks:
+                    info.locks.add(dst)
+        if not info.locks:
+            return
+
+        for m in cls.body:
+            if isinstance(m, ast.FunctionDef):
+                self._scan_method(info, m)
+
+        self._report(relpath, info, sup)
+
+    def _scan_method(self, info: _ClassInfo, method: ast.FunctionDef):
+        exempt = (method.name == "__init__"
+                  or method.name.endswith("_locked"))
+
+        def visit(node, held: tuple):
+            if isinstance(node, ast.With):
+                new_held = held
+                for item in node.items:
+                    attr = _self_attr(item.context_expr)
+                    # `with self._lock:` and `with self._cv:` acquire;
+                    # `self._cv.wait()` etc handled as accesses below
+                    if attr is not None and attr in info.locks:
+                        for outer in new_held:
+                            if outer != attr:
+                                info.edges.setdefault(
+                                    (outer, attr), item.context_expr.lineno)
+                        new_held = new_held + (attr,)
+                    else:
+                        visit(item.context_expr, held)
+                for s in node.body:
+                    visit(s, new_held)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not method:
+                # a nested closure runs later (worker threads): the
+                # lexically-enclosing with-blocks are NOT held
+                for child in ast.iter_child_nodes(node):
+                    visit(child, ())
+                return
+            if isinstance(node, ast.Attribute):
+                attr = _self_attr(node)
+                if attr is None:
+                    # not a plain self.a(.b) chain — e.g. the base is a
+                    # Subscript or Call (`self._buf[0].x`); recurse so
+                    # the inner self.* access is still recorded
+                    for child in ast.iter_child_nodes(node):
+                        visit(child, held)
+                    return
+                if attr not in info.locks and not exempt:
+                    is_write = isinstance(node.ctx,
+                                          (ast.Store, ast.Del))
+                    info.accesses.append(
+                        (attr, method.name, node.lineno, is_write,
+                         frozenset(held)))
+                    if is_write and held:
+                        for lk in held:
+                            info.locked_writes.setdefault(
+                                attr, {}).setdefault(lk, []).append(
+                                (method.name, node.lineno))
+                # don't recurse into the attribute chain: self.a.b
+                # was recorded as one dotted access
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        visit(method, ())
+
+    def _report(self, relpath: str, info: _ClassInfo, sup) -> None:
+        cls = info.node.name
+        for attr, by_lock in sorted(info.locked_writes.items()):
+            # the protecting lock: the one most of the locked writes
+            # hold (ties broken lexicographically for determinism)
+            lock = sorted(by_lock,
+                          key=lambda lk: (-len(by_lock[lk]), lk))[0]
+            for a_attr, meth, line, is_write, held in info.accesses:
+                if a_attr != attr or lock in held:
+                    continue
+                kind = "write" if is_write else "read"
+                self._emit(
+                    relpath, line, "unlocked-access",
+                    f"{cls}.{attr} is written under self.{lock} "
+                    f"(lock-protected) but {kind} in {meth}() without "
+                    f"it", sup)
+        # lock-order cycles: A->B and B->A both observed
+        for (a, b), line in sorted(info.edges.items()):
+            if (b, a) in info.edges and a < b:
+                self._emit(
+                    relpath, line, "lock-order",
+                    f"{cls}: self.{a} and self.{b} are acquired in "
+                    f"both nesting orders (here {a}->{b}, line "
+                    f"{info.edges[(b, a)]} {b}->{a}): deadlock by "
+                    f"schedule", sup)
+
+
+def threads_lint(repo=None, roots=THREAD_ROOTS) -> List[Finding]:
+    """Run the pass; returns unsuppressed findings (empty == clean)."""
+    if repo is None:
+        repo = Path(__file__).resolve().parents[2]
+    return ThreadPass(Path(repo), roots).run()

@@ -1,340 +1,60 @@
 #!/usr/bin/env python
-"""Minimal in-tree linter (the `go fmt`/`golint` analog of the
-reference's CI — README.md:36-40, docker/development Dockerfile.metalinter
-— rebuilt for a no-external-deps environment).
+"""In-tree analysis CLI (the metalinter CI stage analog of the
+reference — README.md:36-40, docker/development Dockerfile.metalinter —
+grown from a single-file linter into the tools/analysis/ package of
+project-specific passes; ISSUE 5 tentpole).
 
-Checks, per file:
-  * the file parses (SyntaxError == fail)
-  * unused imports (module scope; names re-exported via __all__ or
-    marked `# noqa: unused` are exempt)
-  * `except:` bare except clauses
-  * tabs in indentation and trailing whitespace
-  * mutable default arguments (def f(x=[]) / {} / set())
+Always runs the base style pass (parse, unused imports, bare except,
+tabs/trailing whitespace, mutable defaults) over ROOTS. Flags add:
 
-Exit code 1 if anything fires. Run via `make lint`.
+  --jax       tracer/recompile hygiene over vpp_tpu/{ops,pipeline,
+              parallel}: host syncs inside traced code, Python control
+              flow on tracers, per-instance jit closures (the PR-4 bug
+              class), float64 drift, and the jit-registry manifest
+              check (every jax.jit site enumerated in
+              tools/analysis/jit_manifest.py). Suppress one line with
+              `# jax-ok: <reason>`.
+  --threads   lock discipline over io/pump.py, io/cluster_pump.py,
+              kvstore/, stats/, trace/, pipeline/txn.py: attributes
+              written under `with self._lock` must be accessed under
+              it everywhere (`__init__` and `*_locked` methods
+              exempt), and lock-nesting order must be acyclic.
+              Suppress one line with `# unlocked: <reason>`.
+  --metrics   Prometheus registry hygiene (imports jax; tier-1 runs it
+              via tests/test_exposition.py).
+  --counters  StepStats <-> Prometheus family parity (imports jax).
+  --tables    BV classifier table invariants (imports jax; tier-1 runs
+              it via tests/test_acl_bv.py).
 
-`--metrics` additionally runs the metrics lint: it builds the standard
-Prometheus registries (agent stats collector + control-plane
-histograms, KSR gauges, kvstore request histogram) and validates every
-registered family — name matches ``vpp_tpu_[a-z0-9_]+``, non-empty
-help, no duplicate family names across paths. Importing the dataplane
-pulls jax, so this pass only runs when asked for (tier-1:
-tests/test_exposition.py invokes it).
-
-`--counters` runs the counter-parity pass: every pipeline StepStats
-field must map (via stats/collector.py STEPSTATS_FAMILIES) to a
-registered Prometheus family, and every registered
-``vpp_tpu_pipeline_*`` family must map back to a StepStats field —
-so a counter added in the kernel without its observability twin (or
-vice versa) fails tier-1 alongside --metrics.
-
-`--tables` runs the table-structure invariant pass over a
-representative BV-classifier commit (ops/acl_bv.py): interval
-boundaries strictly sorted per dimension, bitmap word width matching
-the padded rule capacity, padding provably inert (no bit of a rule
-row >= nrules set anywhere, interval rows past the live boundary
-count all-zero), and the BV/dense/MXU capacity constants consistent.
-Invoked from tier-1 (tests/test_acl_bv.py).
+Exit code 1 if anything fires. `make lint` runs the base + --jax +
+--threads (the pure-AST passes). Rule catalog + suppression syntax:
+docs/STATIC_ANALYSIS.md.
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
+
+_TOOLS = Path(__file__).resolve().parent
+if str(_TOOLS) not in sys.path:  # lint.py is loaded by path from tests
+    sys.path.insert(0, str(_TOOLS))
+
+from analysis.imports import style_problems  # noqa: E402
+from analysis.jaxlint import jax_lint  # noqa: E402
+from analysis.registries import (  # noqa: E402  (re-exported: tier-1
+    counters_lint,                 # loads lint.py by path and calls
+    metrics_lint,                  # these directly)
+    tables_lint,
+)
+from analysis.threadlint import threads_lint  # noqa: E402
 
 ROOTS = ("vpp_tpu", "tests", "bench.py", "__graft_entry__.py", "tools")
 
 
-class ImportCollector(ast.NodeVisitor):
-    def __init__(self):
-        self.imports: dict = {}   # name -> (lineno, stmt text)
-        self.used: set = set()
-        self.exported: set = set()
-
-    def visit_Import(self, node):
-        for a in node.names:
-            name = (a.asname or a.name).split(".")[0]
-            self.imports[name] = node.lineno
-
-    def visit_ImportFrom(self, node):
-        if node.module == "__future__":
-            return
-        for a in node.names:
-            if a.name == "*":
-                continue
-            self.imports[a.asname or a.name] = node.lineno
-
-    def visit_Name(self, node):
-        self.used.add(node.id)
-
-    def visit_Attribute(self, node):
-        self.generic_visit(node)
-
-    def visit_Assign(self, node):
-        # __all__ = [...] re-exports
-        for t in node.targets:
-            if isinstance(t, ast.Name) and t.id == "__all__":
-                try:
-                    self.exported |= set(ast.literal_eval(node.value))
-                except ValueError:
-                    pass
-        self.generic_visit(node)
-
-
 def lint_file(path: Path) -> list:
-    problems = []
-    src = path.read_text()
-    try:
-        tree = ast.parse(src, filename=str(path))
-    except SyntaxError as e:
-        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
-
-    lines = src.splitlines()
-    noqa = {i + 1 for i, ln in enumerate(lines) if "# noqa" in ln}
-
-    for i, ln in enumerate(lines, 1):
-        if ln.rstrip() != ln and ln.strip():
-            problems.append(f"{path}:{i}: trailing whitespace")
-        if ln.startswith("\t"):
-            problems.append(f"{path}:{i}: tab indentation")
-
-    col = ImportCollector()
-    col.visit(tree)
-    # exemptions: used as a Name anywhere, re-exported via __all__,
-    # `# noqa` on the import line, or a leading-underscore alias
-    for name, lineno in col.imports.items():
-        if name in col.used or name in col.exported or lineno in noqa:
-            continue
-        if name.startswith("_"):
-            continue
-        problems.append(f"{path}:{lineno}: unused import '{name}'")
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler) and node.type is None:
-            if node.lineno not in noqa:
-                problems.append(f"{path}:{node.lineno}: bare 'except:'")
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for d in node.args.defaults + node.args.kw_defaults:
-                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
-                    problems.append(
-                        f"{path}:{node.lineno}: mutable default argument "
-                        f"in '{node.name}'"
-                    )
-        if isinstance(node, ast.Compare):
-            for cmp_op, val in zip(node.ops, node.comparators):
-                if isinstance(cmp_op, (ast.Eq, ast.NotEq)) and \
-                        isinstance(val, ast.Constant) and \
-                        any(val.value is c for c in (True, False, None)):
-                    if node.lineno not in noqa:
-                        problems.append(
-                            f"{path}:{node.lineno}: comparison to "
-                            f"{val.value!r} — use 'is'/'is not'/truthiness"
-                        )
-    return problems
-
-
-def _build_full_registry():
-    """Every family the deployed processes serve, in ONE registry (so
-    cross-path duplicates are caught). Shared by the --metrics and
-    --counters passes."""
-    repo = str(Path(__file__).resolve().parent.parent)
-    if repo not in sys.path:  # direct `python tools/lint.py` invocation
-        sys.path.insert(0, repo)
-    from vpp_tpu.ksr.reflector import ReflectorRegistry
-    from vpp_tpu.kvstore.server import make_request_histogram
-    from vpp_tpu.pipeline.dataplane import Dataplane
-    from vpp_tpu.pipeline.tables import DataplaneConfig
-    from vpp_tpu.stats.collector import (
-        StatsCollector,
-        register_control_plane_metrics,
-        register_ksr_gauges,
-    )
-
-    dp = Dataplane(DataplaneConfig(
-        max_tables=2, max_rules=8, max_global_rules=8, max_ifaces=8,
-        fib_slots=16, sess_slots=64, nat_mappings=2, nat_backends=4))
-    coll = StatsCollector(dp)
-    register_control_plane_metrics(coll.registry)
-    # the KSR and kvserver families live on other processes/paths; fold
-    # them into the same registry so cross-path duplicates are caught
-    register_ksr_gauges(coll.registry, ReflectorRegistry(), path="/metrics")
-    coll.registry.register("/kvstore", make_request_histogram())
-    return coll.registry
-
-
-def metrics_lint() -> list:
-    """Build every registry the deployed processes serve and validate
-    the registered families (MetricsRegistry.lint). Returns problems."""
-    return _build_full_registry().lint()
-
-
-def counters_lint() -> list:
-    """Counter-parity pass: every StepStats field must map to a
-    registered Prometheus family (stats/collector.py
-    STEPSTATS_FAMILIES), and every registered ``vpp_tpu_pipeline_*``
-    family must map back to a StepStats field — a pipeline counter
-    added on either side without its observability twin fails here
-    (and tier-1, via tests/test_exposition.py)."""
-    registry = _build_full_registry()
-    from vpp_tpu.pipeline.graph import StepStats
-    from vpp_tpu.stats.collector import STEPSTATS_FAMILIES
-
-    problems = []
-    fields = set(StepStats._fields)
-    mapped = set(STEPSTATS_FAMILIES)
-    for f in sorted(fields - mapped):
-        problems.append(
-            f"counters: StepStats.{f} has no Prometheus family mapping "
-            f"(stats/collector.py STEPSTATS_FAMILIES)"
-        )
-    for f in sorted(mapped - fields):
-        problems.append(
-            f"counters: STEPSTATS_FAMILIES maps {f!r} which is not a "
-            f"StepStats field (stale entry?)"
-        )
-    registered = {fam.name for _path, fam in registry.families()}
-    for f, family in sorted(STEPSTATS_FAMILIES.items()):
-        if family not in registered:
-            problems.append(
-                f"counters: StepStats.{f} maps to unregistered family "
-                f"{family!r}"
-            )
-    mapped_families = set(STEPSTATS_FAMILIES.values())
-    for name in sorted(registered):
-        if name.startswith("vpp_tpu_pipeline_") and \
-                name not in mapped_families:
-            problems.append(
-                f"counters: family {name!r} is in the pipeline "
-                f"namespace but maps to no StepStats field"
-            )
-    return problems
-
-
-def _bv_plane_problems(name: str, bv, nrules: int, max_rules: int) -> list:
-    """Invariants of ONE compiled BvTable against its live rule count."""
-    import numpy as np
-
-    from vpp_tpu.ops.acl_bv import DIMS, bv_capacity
-
-    problems = []
-    cap_i, cap_w, cap_pr = bv_capacity(max_rules, True)
-    planes = {dim: getattr(bv, f"bm_{dim}") for dim in DIMS}
-    planes["proto"] = bv.bm_proto
-    for k, dim in enumerate(DIMS):
-        bnd = getattr(bv, f"bnd_{dim}")
-        n = int(bv.nbnd[k])
-        if len(bnd) != cap_i:
-            problems.append(
-                f"tables: {name}.{dim} boundary capacity {len(bnd)} != "
-                f"bv_capacity {cap_i}")
-        live = bnd[:n].astype(np.int64)
-        if n and not (np.diff(live) > 0).all():
-            problems.append(
-                f"tables: {name}.{dim} boundaries not strictly sorted")
-        if n and live[0] != 0:
-            problems.append(
-                f"tables: {name}.{dim} boundary[0] != 0 (value space "
-                f"must be fully covered)")
-    for pname, bm in planes.items():
-        if bm.shape[-1] != cap_w or cap_w != max(1, (max_rules + 31) // 32):
-            problems.append(
-                f"tables: {name}.{pname} word width {bm.shape[-1]} does "
-                f"not match padded rule capacity {max_rules}")
-        # padding inert, rule axis: no bit of a row >= nrules anywhere
-        for w in range(bm.shape[-1]):
-            lo_rule = w * 32
-            nbits = min(32, max(0, nrules - lo_rule))
-            allowed = np.uint32((1 << nbits) - 1)
-            if (bm[..., w] & ~allowed).any():
-                problems.append(
-                    f"tables: {name}.{pname} word {w} sets bits of "
-                    f"padding rules (nrules={nrules})")
-        # padding inert, interval axis: rows past the live boundary
-        # count must be all-zero (a clipped lookup can never land
-        # there; a stale bit would be a silent wrong-match hazard)
-        if pname != "proto":
-            n = int(bv.nbnd[list(DIMS).index(pname)])
-            if bm[n:].any():
-                problems.append(
-                    f"tables: {name}.{pname} has bits set in interval "
-                    f"rows >= nbnd ({n})")
-    return problems
-
-
-def tables_lint() -> list:
-    """Table-structure invariant pass (`--tables`): commit a
-    representative rule set through a BV-enabled TableBuilder and
-    validate the compiled structure + the cross-implementation
-    capacity constants. Returns problems."""
-    repo = str(Path(__file__).resolve().parent.parent)
-    if repo not in sys.path:
-        sys.path.insert(0, repo)
-    import ipaddress
-
-    from vpp_tpu.ir.rule import Action, ContivRule, Protocol
-    from vpp_tpu.ops.acl_bv import bv_capacity, bv_global_bytes
-    from vpp_tpu.ops.acl_mxu import mxu_rule_capacity
-    from vpp_tpu.pipeline.tables import DataplaneConfig, TableBuilder
-
-    cfg = DataplaneConfig(
-        max_tables=2, max_rules=16, max_global_rules=96, max_ifaces=8,
-        fib_slots=16, sess_slots=64, nat_mappings=2, nat_backends=4,
-        classifier="bv")
-    b = TableBuilder(cfg)
-    rules = [
-        ContivRule(action=Action.PERMIT, protocol=Protocol.TCP,
-                   src_network=ipaddress.ip_network(f"10.{i}.0.0/16"),
-                   dest_port=80 + i)
-        for i in range(40)
-    ] + [
-        ContivRule(action=Action.DENY, protocol=Protocol.UDP,
-                   dest_port=0),
-        ContivRule(action=Action.PERMIT),        # wildcard everything
-        ContivRule(action=Action.DENY, protocol=Protocol.TCP,
-                   dest_port=65535),
-        ContivRule(action=Action.DENY),          # terminal deny-all
-    ]
-    b.set_global_table(rules)
-    b.set_local_table(0, rules[:7])
-    # slot 1 stays empty: its planes must be entirely inert
-
-    problems = _bv_plane_problems("glb", b.glb_bv, b.glb_nrules,
-                                  cfg.max_global_rules)
-    for slot, nrules in ((0, 7), (1, 0)):
-        from vpp_tpu.ops.acl_bv import BvTable
-
-        local = BvTable(
-            bnd_src=b.acl_bv["bnd_src"][slot],
-            bnd_dst=b.acl_bv["bnd_dst"][slot],
-            bnd_sport=b.acl_bv["bnd_sport"][slot],
-            bnd_dport=b.acl_bv["bnd_dport"][slot],
-            nbnd=b.acl_bv["nbnd"][slot],
-            bm_src=b.acl_bv["src"][slot], bm_dst=b.acl_bv["dst"][slot],
-            bm_sport=b.acl_bv["sport"][slot],
-            bm_dport=b.acl_bv["dport"][slot],
-            bm_proto=b.acl_bv["proto"][slot],
-            ok=bool(b.acl_bv_ok[slot]), build_ms=0.0,
-        )
-        problems += _bv_plane_problems(f"local[{slot}]", local, nrules,
-                                       cfg.max_rules)
-    # cross-implementation capacity constants
-    for r in (cfg.max_rules, cfg.max_global_rules, 1024, 10240):
-        ib, w, _pr = bv_capacity(r, True)
-        if ib != 2 * r + 2:
-            problems.append(
-                f"tables: bv interval capacity {ib} != 2*{r}+2")
-        if w * 32 < r:
-            problems.append(
-                f"tables: bv word capacity {w}*32 < {r} rules")
-        if mxu_rule_capacity(r) < r:
-            problems.append(
-                f"tables: mxu rule capacity {mxu_rule_capacity(r)} < {r}")
-        if bv_global_bytes(r) < ib * w * 4 * 4:
-            problems.append(
-                f"tables: bv_global_bytes({r}) smaller than its own "
-                f"bitmap matrices")
-    return problems
+    """Base style pass on one file (kept as the public per-file API)."""
+    return style_problems(path)
 
 
 def main(argv=None) -> int:
@@ -352,16 +72,26 @@ def main(argv=None) -> int:
         if "__pycache__" in str(f):
             continue
         all_problems.extend(lint_file(f))
+    if "--jax" in argv:
+        all_problems.extend(str(f) for f in jax_lint(repo))
+    if "--threads" in argv:
+        all_problems.extend(str(f) for f in threads_lint(repo))
     if "--metrics" in argv:
         all_problems.extend(metrics_lint())
     if "--counters" in argv:
         all_problems.extend(counters_lint())
     if "--tables" in argv:
         all_problems.extend(tables_lint())
+    # --jax and --threads both report bare suppressions; dedupe
+    seen, unique = set(), []
     for p in all_problems:
+        if str(p) not in seen:
+            seen.add(str(p))
+            unique.append(p)
+    for p in unique:
         print(p)
-    print(f"lint: {len(files)} files, {len(all_problems)} problems")
-    return 1 if all_problems else 0
+    print(f"lint: {len(files)} files, {len(unique)} problems")
+    return 1 if unique else 0
 
 
 if __name__ == "__main__":

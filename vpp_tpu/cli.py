@@ -603,6 +603,25 @@ class DebugCLI:
                 f"pump batch latency: p50 {lat['p50']:.0f}us "
                 f"p99 {lat['p99']:.0f}us over {lat['n']} batches"
             )
+        # jit-compile guard (pipeline/dataplane.py): compile-once means
+        # each variant shows 1; a RECOMPILED marker is the PR-4
+        # regression class live — see /debug/jit for shape signatures
+        from vpp_tpu.pipeline.dataplane import (
+            jit_compile_totals,
+            jit_recompiles,
+        )
+        totals = jit_compile_totals()
+        if totals:
+            lines.append(
+                "jit compiles: "
+                + ", ".join(f"{k} {v}" for k, v in sorted(totals.items()))
+            )
+            recomp = jit_recompiles()
+            if recomp:
+                lines.append(
+                    f"jit RECOMPILED ({len(recomp)} step+shape keys "
+                    f"traced >1x — compile-once contract broken)"
+                )
         if self.io_ctl is not None:
             # the whole block is guarded: the daemon is another process
             # over a socket, so besides being down it may be a different

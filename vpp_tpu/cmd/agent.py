@@ -465,6 +465,7 @@ class ContivAgent:
             # same data for humans). `/` indexes everything served.
             self.stats_http.add_page("/debug/spans", self.debug_spans_json)
             self.stats_http.add_page("/debug/txns", self.debug_txns_json)
+            self.stats_http.add_page("/debug/jit", self.debug_jit_json)
             self.stats_http.start()
             self.health_http = HealthHTTPServer(
                 self.statuscheck, port=c.health_port, host=c.http_host
@@ -513,6 +514,32 @@ class ContivAgent:
     def debug_spans_json() -> str:
         """/debug/spans: recorded span timelines grouped by trace."""
         return spans.RECORDER.to_json()
+
+    @staticmethod
+    def debug_jit_json() -> str:
+        """/debug/jit: the runtime jit-compile guard's full state —
+        per (step variant, argument-shape signature) compile counts and
+        the recompile violations (ISSUE 5; the scrapeable twin of
+        ``vpp_tpu_jit_compiles_total`` with the shape axis kept)."""
+        import json as _json
+
+        from vpp_tpu.pipeline.dataplane import (
+            jit_compile_counts,
+            jit_compile_totals,
+            jit_recompiles,
+        )
+
+        return _json.dumps({
+            "totals": jit_compile_totals(),
+            "compiles": [
+                {"step": label, "shapes": repr(sig), "count": n}
+                for (label, sig), n in sorted(jit_compile_counts().items())
+            ],
+            "recompiled": [
+                {"step": label, "shapes": repr(sig), "count": n}
+                for (label, sig), n in sorted(jit_recompiles().items())
+            ],
+        }, indent=1)
 
     # /debug/txns tail cap: a long-lived agent's journal grows without
     # bound; the debug page serves the recent history, not an export

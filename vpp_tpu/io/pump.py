@@ -482,6 +482,8 @@ class DataplanePump:
             self.stats["chain_k_peak"] = max(self.stats["chain_k_peak"],
                                              K)
         self.stats["t_dispatch"] += time.perf_counter() - t0
+        # unlocked: the dispatch thread is _seq's only writer, so its
+        # own read needs no lock; increments publish under _done_cv
         item = (self._seq, payload, groups, non_ip, t0, slow)
         # count the batch in flight BEFORE the hand-off: a fetch worker
         # can complete it (and the writer decrement it) the instant the
@@ -498,7 +500,11 @@ class DataplanePump:
                 if self._stop.is_set():
                     self._inflight_dec()
                     return
-        self._seq += 1
+        # under _done_cv like the failed-batch path: the tx writer's
+        # shutdown gate compares next_seq against _seq under the cv, so
+        # an unlocked increment could be observed stale there
+        with self._done_cv:
+            self._seq += 1
         self.stats["batches"] += 1
         self.stats["max_coalesce"] = max(self.stats["max_coalesce"],
                                          sum(len(g) for g in groups))
@@ -570,6 +576,8 @@ class DataplanePump:
             self._persist_start()
             self._ppump.submit(flat, now=self.dp.clock_ticks())
         self.stats["t_dispatch"] += time.perf_counter() - t0
+        # unlocked: the dispatch thread is _seq's only writer, so its
+        # own read needs no lock; increments publish under _done_cv
         item = (self._seq, self._ppump, [[f]], non_ip.view(bool), t0)
         self._inflight_inc()
         while True:
@@ -580,7 +588,10 @@ class DataplanePump:
                 if self._stop.is_set():
                     self._inflight_dec()
                     return False
-        self._seq += 1
+        # under _done_cv for the same reason as the dispatch-mode bump:
+        # the writer's shutdown gate reads _seq under the cv
+        with self._done_cv:
+            self._seq += 1
         self.stats["batches"] += 1
         self.stats["max_coalesce"] = max(self.stats["max_coalesce"], 1)
         return True

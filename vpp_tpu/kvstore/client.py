@@ -122,7 +122,13 @@ class RemoteKVStore:
             # advances it (see _request) so the rotation can move off a
             # live-but-read-only follower, and lands back on index 0
             # (the preferred primary) one step later.
-            idx = (self._rotate_start + attempt) % n
+            # _rotate_start is shared with _rotate_endpoint (the request
+            # thread advances it off a read-only follower while THIS
+            # reconnect thread retries): read and write it under the
+            # lock — never held across the blocking connect — so a
+            # concurrent advance isn't overwritten and re-tried dead
+            with self._lock:
+                idx = (self._rotate_start + attempt) % n
             host, port = self.endpoints[idx]
             attempt += 1
             try:
@@ -132,7 +138,8 @@ class RemoteKVStore:
                 sock.settimeout(None)
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 self.host, self.port = host, port
-                self._rotate_start = idx
+                with self._lock:
+                    self._rotate_start = idx
                 break
             except OSError as exc:
                 if time.monotonic() >= deadline:

@@ -88,7 +88,7 @@ class KVStore:
             self._rev += 1
             ev = KVEvent(Op.PUT, key, value, prev, self._rev)
             self._notify(ev)
-            self._maybe_persist()
+            self._maybe_persist_locked()
         return ev.rev
 
     def delete(self, key: str) -> bool:
@@ -100,7 +100,7 @@ class KVStore:
             self._rev += 1
             ev = KVEvent(Op.DELETE, key, None, prev, self._rev)
             self._notify(ev)
-            self._maybe_persist()
+            self._maybe_persist_locked()
         return True
 
     def compare_and_put(self, key: str, expected: Any, value: Any) -> bool:
@@ -118,7 +118,7 @@ class KVStore:
             self._rev += 1
             ev = KVEvent(Op.PUT, key, value, prev, self._rev)
             self._notify(ev)
-            self._maybe_persist()
+            self._maybe_persist_locked()
         return True
 
     def compare_and_delete(self, key: str, expected: Any) -> bool:
@@ -129,7 +129,7 @@ class KVStore:
             self._rev += 1
             ev = KVEvent(Op.DELETE, key, None, prev, self._rev)
             self._notify(ev)
-            self._maybe_persist()
+            self._maybe_persist_locked()
         return True
 
     def list_values(self, prefix: str) -> Dict[str, Any]:
@@ -157,7 +157,7 @@ class KVStore:
                 raise ValueError(
                     f"fencing epoch may only advance ({value} < {self._fence})")
             self._fence = int(value)
-            self._maybe_persist()
+            self._maybe_persist_locked()
 
     # --- watch ---
     def watch(self, prefix: str, callback: WatchCallback) -> Callable[[], None]:
@@ -241,9 +241,9 @@ class KVStore:
     def lease_revoke(self, lease: int) -> int:
         """Drop a lease and delete its keys. Returns keys deleted."""
         with self._lock:
-            return self._expire_lease(lease)
+            return self._expire_lease_locked(lease)
 
-    def _expire_lease(self, lease: int) -> int:
+    def _expire_lease_locked(self, lease: int) -> int:
         if lease not in self._leases:
             return 0
         del self._leases[lease]
@@ -257,7 +257,7 @@ class KVStore:
                 self._notify(KVEvent(Op.DELETE, key, None, prev, self._rev))
                 n += 1
         if n:
-            self._maybe_persist()
+            self._maybe_persist_locked()
         return n
 
     def sweep_leases(self, now: Optional[float] = None) -> int:
@@ -268,7 +268,7 @@ class KVStore:
         with self._lock:
             overdue = [lid for lid, (dl, _) in self._leases.items()
                        if dl <= now]
-            return sum(self._expire_lease(lid) for lid in overdue)
+            return sum(self._expire_lease_locked(lid) for lid in overdue)
 
     # --- persistence (checkpoint/resume; reference: ETCD durability) ---
     def dump(self) -> Dict[str, Any]:
@@ -326,7 +326,7 @@ class KVStore:
     # for a synchronous checkpoint.
     AUTOSAVE_MIN_INTERVAL = 0.2  # seconds
 
-    def _maybe_persist(self) -> None:
+    def _maybe_persist_locked(self) -> None:
         if self._persist_path and (
             _time.monotonic() - self._last_save >= self.AUTOSAVE_MIN_INTERVAL
         ):

@@ -133,7 +133,7 @@ class QuorumWitness:
         self._thread: Optional[threading.Thread] = None
 
     # --- state machine ---
-    def _persist(self) -> None:
+    def _persist_locked(self) -> None:
         if not self._persist_path:
             return
         tmp = f"{self._persist_path}.{os.getpid()}.tmp"
@@ -180,7 +180,7 @@ class QuorumWitness:
                     # newer-fence-demotes rule the data path applies.
                     self.epoch = epoch
                     self.primary = None  # adopted below by the match
-                    self._persist()
+                    self._persist_locked()
                     log.warning("stale witness state: adopted epoch %d "
                                 "from renewer %s", epoch, node)
                 if epoch == self.epoch and self.primary in (None, node):
@@ -189,7 +189,7 @@ class QuorumWitness:
                     self._ttl = ttl
                     self._deadline = now + self._ttl
                     if changed:
-                        self._persist()
+                        self._persist_locked()
                         log.info("adopted primary %s @ epoch %d",
                                  node, self.epoch)
                     return {"ok": True, "epoch": self.epoch}
@@ -209,7 +209,7 @@ class QuorumWitness:
                     self.primary = node
                     self._ttl = ttl
                     self._deadline = now + ttl
-                    self._persist()
+                    self._persist_locked()
                     log.warning("claim granted: %s is primary @ epoch %d",
                                 node, self.epoch)
                     return {"granted": True, "epoch": self.epoch}
@@ -236,6 +236,8 @@ class QuorumWitness:
             target=self._server.serve_forever, daemon=True,
             name="kvwitness")
         self._thread.start()
+        # unlocked: startup log only — a claim racing serve_forever's
+        # first request can stale this line, never the state machine
         log.info("quorum witness on %s (epoch %d)", self.address, self.epoch)
         return self
 

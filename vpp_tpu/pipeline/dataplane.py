@@ -161,20 +161,135 @@ PACKED_OUT_ROWS_N = 5
 # bench, multi-instance agents) compile each variant once.
 _JIT_STEPS: Dict[tuple, object] = {}
 
+# Runtime jit-compile guard (ISSUE 5 tentpole): every TRACE of a step
+# variant is counted per (step key, argument-shape signature). A healthy
+# process compiles each (impl, skip, fast, form, call-shape) exactly
+# once; a count of 2+ IS the PR-4 regression class (a fresh-closure
+# factory silently re-tracing per instance) happening live. Exported as
+# ``vpp_tpu_jit_compiles_total{step=}`` (stats/collector.py), shown by
+# `show io` and /debug/jit, enforced by the tests/conftest.py
+# jit_compile_budget fixture and the end-of-session recompile check.
+_JIT_COMPILES: Dict[tuple, int] = {}
+_JIT_COMPILES_LOCK = threading.Lock()
+
+
+def _step_label(impl: str, skip_local: bool, fast: bool, form: str) -> str:
+    return "{}{}{}_{}".format(
+        impl, "_nolocal" if skip_local else "", "_auto" if fast else "",
+        form)
+
+
+def _shape_sig(args, kwargs) -> tuple:
+    def leaf_sig(x):
+        shape = getattr(x, "shape", None)
+        if shape is None:
+            return type(x).__name__
+        return (tuple(shape), str(getattr(x, "dtype", "?")))
+
+    leaves, _ = jax.tree_util.tree_flatten((args, kwargs))
+    return tuple(leaf_sig(x) for x in leaves)
+
+
+def _counting(label: str, fn):
+    """Wrap ``fn`` so each TRACE (the python body running under jit —
+    once per compile, never on cache hits) bumps the compile counter.
+    Must wrap the OUTERMOST callable handed to jax.jit: an inner
+    function can legitimately re-run within one compile (lax.scan
+    traces its body twice), which would double-count."""
+
+    def traced(*args, **kwargs):
+        key = (label, _shape_sig(args, kwargs))
+        with _JIT_COMPILES_LOCK:
+            _JIT_COMPILES[key] = _JIT_COMPILES.get(key, 0) + 1
+        return fn(*args, **kwargs)
+
+    traced.__name__ = getattr(fn, "__name__", label)
+    return traced
+
+
+def jit_compile_counts() -> Dict[tuple, int]:
+    """Snapshot of {(step label, shape signature): compile count}."""
+    with _JIT_COMPILES_LOCK:
+        return dict(_JIT_COMPILES)
+
+
+def jit_compile_totals() -> Dict[str, int]:
+    """Compiles per step label (the ``step=`` axis of
+    ``vpp_tpu_jit_compiles_total``)."""
+    totals: Dict[str, int] = {}
+    with _JIT_COMPILES_LOCK:
+        for (label, _sig), n in _JIT_COMPILES.items():
+            totals[label] = totals.get(label, 0) + n
+    return totals
+
+
+def jit_recompiles() -> Dict[tuple, int]:
+    """The violations: (step label, shape signature) keys traced more
+    than once in this process. Non-empty == the compile-once contract
+    is broken (tests/conftest.py fails the session on it)."""
+    with _JIT_COMPILES_LOCK:
+        return {k: n for k, n in _JIT_COMPILES.items() if n > 1}
+
+
+class JitBudgetExceeded(AssertionError):
+    """Raised by jit_compile_budget() when a scope compiles more step
+    programs than it declared."""
+
+
+class _JitBudget:
+    def __init__(self, budget: int):
+        self.budget = budget
+        self._before: Optional[Dict[tuple, int]] = None
+
+    def __enter__(self) -> "_JitBudget":
+        self._before = jit_compile_counts()
+        return self
+
+    @property
+    def spent(self) -> int:
+        before = self._before or {}
+        return (sum(jit_compile_counts().values())
+                - sum(before.values()))
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None:
+            return
+        before = self._before or {}
+        after = jit_compile_counts()
+        new = {k: n - before.get(k, 0) for k, n in after.items()
+               if n - before.get(k, 0) > 0}
+        spent = sum(new.values())
+        if spent > self.budget:
+            detail = ", ".join(
+                f"{label}@{n}x" for (label, _sig), n in sorted(new.items()))
+            raise JitBudgetExceeded(
+                f"pipeline-step jit compile budget exceeded: {spent} "
+                f"compiles > declared budget {self.budget} ({detail})")
+
+
+def jit_compile_budget(budget: int) -> _JitBudget:
+    """Context manager: fail if the enclosed scope triggers more than
+    ``budget`` pipeline-step compiles. The pytest fixture of the same
+    name (tests/conftest.py) wraps a whole test in one."""
+    return _JitBudget(budget)
+
 
 def _jitted_step(impl: str, skip_local: bool, fast: bool, form: str):
     key = (impl, skip_local, fast, form)
     step = _JIT_STEPS.get(key)
     if step is None:
         fn = make_pipeline_step(impl, skip_local, fast)
+        label = _step_label(impl, skip_local, fast, form)
         if form == "plain":
-            step = jax.jit(fn)
+            step = jax.jit(_counting(label, fn))
         elif form == "packed":
-            step = jax.jit(_packed_call(fn, with_aux=True),
-                           donate_argnums=(1,))
+            step = jax.jit(
+                _counting(label, _packed_call(fn, with_aux=True)),
+                donate_argnums=(1,))
         else:
-            step = jax.jit(_chained_call(fn, with_aux=True),
-                           donate_argnums=(1,))
+            step = jax.jit(
+                _counting(label, _chained_call(fn, with_aux=True)),
+                donate_argnums=(1,))
         _JIT_STEPS[key] = step
     return step
 

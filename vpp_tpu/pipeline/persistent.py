@@ -129,6 +129,9 @@ class PersistentPump:
                 cond, body, (tables, jnp.int32(0), jnp.bool_(False)))
             return final
 
+        # jax-ok: one resident loop per pump BY DESIGN — the loop closes
+        # over this instance's rings/queues, and a process runs one
+        # long-lived pump (the compile is the pump's startup cost)
         self._loop = jax.jit(loop)
 
     # --- lifecycle ---

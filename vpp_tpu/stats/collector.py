@@ -264,6 +264,19 @@ class StatsCollector:
                   "selected global ACL classifier implementation "
                   "(info-style: impl label, 1 = active)"),
         )
+        # runtime jit-compile guard (pipeline/dataplane.py _JIT_COMPILES,
+        # ISSUE 5): XLA traces per step variant, labelled step=. The
+        # compile-once contract makes the healthy steady state a flat 1
+        # per live label; rate() > 0 after warmup IS the PR-4 recompile
+        # regression class happening in production.
+        self.jit_compiles_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_jit_compiles_total",
+                  "pipeline-step XLA compiles per step variant "
+                  "(process-wide; >1 per variant+shape means the "
+                  "compile-once contract broke)",
+                  kind="counter"),
+        )
         self.vcl = None  # set_vcl(): admission counters -> gauges
         self.vcl_gauges = {
             name: self.registry.register(STATS_PATH, Gauge(name, help_))
@@ -401,6 +414,9 @@ class StatsCollector:
         for name in CLASSIFIER_IMPLS:
             self.classifier_gauge.set(
                 1.0 if name == impl else 0.0, impl=name)
+        from vpp_tpu.pipeline.dataplane import jit_compile_totals
+        for label, n in jit_compile_totals().items():
+            self.jit_compiles_gauge.set(float(n), step=label)
         # classify-stage occupancy in the pump stage family: cumulative
         # seconds of the isolated classify probe
         # (Dataplane.time_classifier — the bench and operators drive

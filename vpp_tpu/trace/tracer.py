@@ -70,6 +70,9 @@ class PacketTracer:
 
     @property
     def armed(self) -> int:
+        # unlocked: lock-free peek on the per-frame hot path — a stale
+        # read only starts/stops capture one frame late, and record()
+        # re-checks under the lock before touching the buffer
         return self._armed
 
     def record(self, result: StepResult) -> int:
