@@ -666,8 +666,8 @@ def session_election_bench(args, batch: int = 2048, iters: int = 30) -> dict:
                 t = dp.tables
                 t0 = time.perf_counter()
                 for i in range(iters):
-                    t2, ins, fail = fns[mode](t, pv, want,
-                                              jnp.int32(2 + i))
+                    t2, ins, fail, _ev_exp, _ev_vic = fns[mode](
+                        t, pv, want, jnp.int32(2 + i))
                 _jax.block_until_ready(t2)
                 acc[mode].append(
                     (time.perf_counter() - t0) / iters / batch * 1e9)
@@ -679,6 +679,252 @@ def session_election_bench(args, batch: int = 2048, iters: int = 30) -> dict:
             _os.environ.pop("VPPT_SESS_ELECTION", None)
         else:
             _os.environ["VPPT_SESS_ELECTION"] = saved
+    return out
+
+
+def _mem_available_bytes() -> int:
+    """Best-effort MemAvailable (0 when unreadable) — gates the
+    10M-session scale config so a small CI box downshifts instead of
+    getting OOM-killed mid-run."""
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 0
+
+
+def session_scale_bench(args, batch: int = 2048, iters: int = 24) -> dict:
+    """Set-associative session-table capture (ISSUE 6), two parts.
+
+    **Old-vs-new** at the headline table size (1<<15 slots): the W-way
+    single-election insert (ops/session.hashmap_insert) against the
+    retained linear-probe baseline (hashmap_insert_linear — the
+    pre-rework algorithm, verbatim). Methodology (docs/SESSIONS.md):
+
+      * **kernel-level, donated, scan-chained** — both inserts run
+        directly over the six session COLUMNS with donated buffers,
+        and all `calls` chained inserts execute inside ONE jitted
+        lax.scan program, exactly how the fused pipeline step runs
+        them in production (in-place updates, no per-call table copy,
+        no per-call dispatch). Whole-DataplaneTables dispatch was
+        measured at ~325 ns/pkt of pure pytree/donation overhead and
+        the per-call jit dispatch at ~700 us/call on this harness —
+        additive constants on BOTH sides that compressed the real
+        algorithmic ratio.
+      * **fresh distinct flows per call** (pre-built outside the
+        clock, stacked [calls, batch] for the scan) keep every chained
+        insert at full pressure without the refresh-hit pollution that
+        forward-threading one batch would cause; 8 calls x batch into
+        1<<15 slots tops out at 50% load, well under the eviction
+        regime.
+      * **per-mode MINIMUM over interleaved windows** — the unloaded-
+        cost estimator. This box runs concurrent load with multi-x
+        wall-clock swings; medians of long runs inherit whatever
+        landed on top of them, while tightly alternated small windows
+        give every mode the same shot at the quiet slices.
+
+    Keys: ``sess_insert_ns_pkt`` / ``sess_insert_linear_ns_pkt`` /
+    ``sess_insert_speedup_x`` (acceptance: >= 3x).
+
+    **Scale**: a 10M+-resident config (``sess_slots`` 1<<24, override
+    with VPPT_SESS_SCALE_SLOTS; downshifts automatically when
+    MemAvailable can't hold ~3x the table) is prefilled on-device to
+    ~62% live occupancy, then fresh-flow batches are admitted through
+    a tables-donating jit (in-place threading — the production-step
+    donation story lives in docs/SESSIONS.md). Keys:
+    ``sessions_resident_millions`` (live entries after admission) and
+    ``session_admission_ksps`` (inserted flows/sec at that residency),
+    plus ``sess_scale_insert_ns_pkt``. The new insert's cost is
+    O(batch), table-size independent — which is the whole point of the
+    sort-rank election — so the scale rows measure memory pressure,
+    not an algorithmic cliff.
+    """
+    import os as _os
+
+    import jax as _jax
+    import jax.numpy as jnp
+
+    from vpp_tpu.ops.session import session_insert
+    from vpp_tpu.pipeline.dataplane import Dataplane
+    from vpp_tpu.pipeline.tables import DataplaneConfig
+    from vpp_tpu.pipeline.vector import make_packet_vector
+
+    out = {}
+
+    def flow_batch(rng, n):
+        pv = make_packet_vector([{"src": "10.0.0.1", "dst": "10.1.1.3",
+                                  "proto": 6, "sport": 1024, "dport": 80,
+                                  "rx_if": 1}], n=n)
+        return pv._replace(
+            src_ip=jnp.asarray(
+                rng.integers(1, 1 << 30, n).astype(np.uint32)),
+            sport=jnp.asarray(
+                rng.integers(1024, 65000, n).astype(np.int32)),
+            flags=jnp.ones(n, np.int32))
+
+    # --- part 1: old-vs-new at the headline table size ---
+    from vpp_tpu.ops.session import (
+        _hash, _pack_ports, hashmap_insert, hashmap_insert_linear)
+
+    slots = 1 << 15
+    ways = 4
+    nb = slots // ways
+    calls = 8          # flows offered per window: 8 x batch = 50% load
+    windows = 10
+    out["sess_insert_slots"] = slots
+    out["sess_insert_ways"] = ways
+
+    rng = np.random.default_rng(1)
+    # distinct flows per call, stacked [calls, batch], built OUTSIDE
+    # the clock — the scan below consumes one row per chained insert
+    kvs = (
+        jnp.asarray(np.stack(
+            [(1 + i * batch + np.arange(batch)).astype(np.uint32)
+             for i in range(calls)])),
+        jnp.full((calls, batch), 0x0A010103, jnp.uint32),
+        _pack_ports(
+            jnp.asarray(rng.integers(
+                1024, 65000, (calls, batch)).astype(np.int32)),
+            jnp.full((calls, batch), 80, jnp.int32)),
+        jnp.full((calls, batch), 6, jnp.int32),
+    )
+    nows = jnp.arange(2, 2 + calls, dtype=jnp.int32)
+    want = jnp.ones(batch, bool)
+    max_age = jnp.int32(3000)
+
+    # both modes run their `calls` chained inserts inside ONE jitted
+    # lax.scan program: production runs the insert inside the fused
+    # step, so per-dispatch overhead (~700 us/call measured on this
+    # harness) is not kernel cost — paying it per call was an additive
+    # constant on BOTH sides that compressed the algorithmic ratio
+    def assoc_prog(valid, tme, k0, k1, k2, k3, kvs, nows):
+        def body(carry, x):
+            valid, tme, ks = carry
+            kv, now = tuple(x[:4]), x[4]
+            h = _hash(*kv, nb)
+            r = hashmap_insert(valid, tme, ks, kv, (), (), h, want,
+                               now, max_age=max_age)
+            return (r[0], r[1], r[2]), 0
+        (valid, tme, ks), _ = _jax.lax.scan(
+            body, (valid, tme, (k0, k1, k2, k3)), (*kvs, nows))
+        return valid, tme, ks
+
+    def linear_prog(valid, tme, k0, k1, k2, k3, kvs, nows):
+        def body(carry, x):
+            valid, tme, ks = carry
+            kv, now = tuple(x[:4]), x[4]
+            h = _hash(*kv, slots)
+            r = hashmap_insert_linear(valid, tme, ks, kv, h, want,
+                                      now, max_age=max_age)
+            return (r[0], r[1], r[2]), 0
+        (valid, tme, ks), _ = _jax.lax.scan(
+            body, (valid, tme, (k0, k1, k2, k3)), (*kvs, nows))
+        return valid, tme, ks
+
+    fns = {
+        "assoc": (_jax.jit(assoc_prog, donate_argnums=(0, 1, 2, 3, 4, 5)),
+                  (nb, ways)),
+        "linear": (_jax.jit(linear_prog, donate_argnums=(0, 1, 2, 3, 4, 5)),
+                   (slots,)),
+    }
+
+    def pristine(shape):
+        cols = [jnp.zeros(shape, jnp.int32), jnp.zeros(shape, jnp.int32),
+                jnp.zeros(shape, jnp.uint32), jnp.zeros(shape, jnp.uint32),
+                jnp.zeros(shape, jnp.uint32), jnp.zeros(shape, jnp.int32)]
+        _jax.block_until_ready(cols)
+        return cols
+
+    for fn, shape in fns.values():  # compile + warm outside the clock
+        _jax.block_until_ready(
+            _jax.tree.leaves(fn(*pristine(shape), kvs, nows)))
+    mins = {"assoc": float("inf"), "linear": float("inf")}
+    for rep in range(windows):
+        order = (("assoc", "linear") if rep % 2 == 0
+                 else ("linear", "assoc"))
+        for mode in order:
+            fn, shape = fns[mode]
+            cols = pristine(shape)
+            t0 = time.perf_counter()
+            res = fn(*cols, kvs, nows)
+            _jax.block_until_ready((res[0], res[1]))
+            mins[mode] = min(
+                mins[mode],
+                (time.perf_counter() - t0) / calls / batch * 1e9)
+    new_ns = mins["assoc"]
+    old_ns = mins["linear"]
+    out["sess_insert_ns_pkt"] = round(new_ns, 1)
+    out["sess_insert_linear_ns_pkt"] = round(old_ns, 1)
+    out["sess_insert_speedup_x"] = round(old_ns / max(new_ns, 1e-9), 2)
+
+    # --- part 2: 10M-resident scale config ---
+    scale_slots = int(_os.environ.get("VPPT_SESS_SCALE_SLOTS", 1 << 24))
+    # ~24 B/slot across the 6 session columns; require ~3x headroom
+    # (donation transients + the numpy-free device fill)
+    need = scale_slots * 24 * 3
+    avail = _mem_available_bytes()
+    while avail and need > avail and scale_slots > (1 << 18):
+        scale_slots >>= 1
+        need = scale_slots * 24 * 3
+    ways = 4
+    cfg = DataplaneConfig(
+        max_tables=2, max_rules=16, max_global_rules=32, max_ifaces=8,
+        fib_slots=32, sess_slots=scale_slots, sess_ways=ways,
+        natsess_slots=1 << 12, nat_mappings=4, nat_backends=4,
+    )
+    dp2 = Dataplane(cfg)
+    dp2.add_uplink()
+    dp2.swap()
+    n_buckets = scale_slots // ways
+    target = min(int(scale_slots * 0.625), scale_slots)
+    full_ways = target // n_buckets            # whole ways filled
+    part = target - full_ways * n_buckets      # buckets with one more
+    t = dp2.tables
+    valid = t.sess_valid
+    if full_ways:
+        valid = valid.at[:, :full_ways].set(1)
+    if part:
+        valid = valid.at[:part, full_ways].set(1)
+    # unique synthetic keys (bucket id / way) — residency + admission
+    # probe the live/free way machinery, not key recall
+    bid = jnp.arange(n_buckets, dtype=jnp.uint32)[:, None]
+    t = t._replace(
+        sess_valid=valid,
+        sess_time=jnp.where(valid == 1, jnp.int32(1), 0),
+        sess_src=jnp.broadcast_to(bid, valid.shape),
+        sess_dst=jnp.broadcast_to(
+            jnp.arange(ways, dtype=jnp.uint32)[None, :], valid.shape),
+    )
+    insert = _jax.jit(
+        lambda tt, p, w, n: session_insert(tt, p, w, n),
+        donate_argnums=(0,))
+    rng2 = np.random.default_rng(9)
+    # fresh-flow batches built OUTSIDE the clock (host-side numpy +
+    # packet-vector assembly would otherwise dominate the timed loop)
+    pvs = [flow_batch(rng2, batch) for _ in range(iters + 1)]
+    _jax.block_until_ready([pv.src_ip for pv in pvs])
+    t, ins, _f, _e, _v = insert(t, pvs[0], want, jnp.int32(2))  # compile
+    _jax.block_until_ready(t.sess_valid)
+    inserted = int(np.asarray(ins).sum())
+    ins_acc = jnp.int32(0)      # accumulate on-device; one sync at the end
+    t0 = time.perf_counter()
+    for i in range(iters):
+        t, ins, _f, _e, _v = insert(t, pvs[1 + i], want, jnp.int32(3 + i))
+        ins_acc = ins_acc + jnp.sum(ins, dtype=jnp.int32)
+    _jax.block_until_ready((t.sess_valid, ins_acc))
+    dt = time.perf_counter() - t0
+    inserted += int(np.asarray(ins_acc).item())
+    resident = int(np.asarray(jnp.sum(t.sess_valid)).item())
+    out["sess_scale_slots"] = scale_slots
+    out["sess_scale_ways"] = ways
+    out["sessions_resident_millions"] = round(resident / 1e6, 3)
+    out["session_admission_ksps"] = round(iters * batch / dt / 1e3, 1)
+    out["sess_scale_insert_ns_pkt"] = round(
+        dt / iters / batch * 1e9, 1)
+    out["sess_scale_insert_failed"] = iters * batch + batch - inserted
     return out
 
 
@@ -1104,7 +1350,12 @@ def hoststack_bench(args, duration_s: float = 2.5) -> dict:
         iters = 20
         for _ in range(iters):
             engine.check_connect(batch)
-        out["session_admission_ksps"] = round(
+        # hoststack policy-engine connect-check rate — renamed from
+        # "session_admission_ksps" when the session-table scale bench
+        # (session_scale_bench) claimed that key: hoststack_bench runs
+        # AFTER the priority sections merge into the final details, so
+        # the shared name silently overwrote the table's admission rate
+        out["hoststack_admission_ksps"] = round(
             4096 * iters / (time.perf_counter() - t0) / 1e3, 1
         )
 
@@ -2016,6 +2267,17 @@ def _run():
         pri["sess_election_error"] = f"{type(e).__name__}: {e}"
     _jc_now = _jit_compiles_now()
     pri["sess_election_jit_compiles"] = _jc_now - _jc
+    _jc = _jc_now
+    _progress(**pri)
+    try:
+        # set-associative session table (ISSUE 6): old-vs-new insert
+        # medians + the 10M-resident scale rows (admission ksps,
+        # resident millions) — acceptance: sess_insert_speedup_x >= 3
+        pri.update(session_scale_bench(args))
+    except Exception as e:  # noqa: BLE001
+        pri["session_scale_error"] = f"{type(e).__name__}: {e}"
+    _jc_now = _jit_compiles_now()
+    pri["session_scale_jit_compiles"] = _jc_now - _jc
     _jc = _jc_now
     _progress(**pri)
     try:

@@ -21,7 +21,15 @@ names a function that no longer exists is a finding.
 JIT_SITES = {
     ("vpp_tpu/pipeline/dataplane.py", "_jitted_step"):
         "THE step factory: process-wide _JIT_STEPS cache keyed "
-        "(impl, skip, fast, form); compile counting wraps fn here",
+        "(impl, skip, fast, form, sweep_stride); compile counting "
+        "wraps fn here",
+    ("vpp_tpu/ops/session.py", "<module>"):
+        "session_expire: the on-demand BULK session reclaim (tests, "
+        "CLI, idle-node maintenance) — one fused program instead of a "
+        "dozen eager whole-table ops at the 10M-slot regime; "
+        "now/max_age are traced scalars so values never retrace. "
+        "Steady-state aging is NOT this: session_sweep rides the "
+        "fused pipeline step (graph._finish_step)",
     ("vpp_tpu/pipeline/dataplane.py", "Dataplane.encap_remote"):
         "lazy vxlan_encap jit; module-level target fn, built once per "
         "dataplane on first remote-disposed frame",
@@ -51,6 +59,15 @@ TRACED_ROOTS = {
     ("vpp_tpu/pipeline/graph.py", "pipeline_step"),
     ("vpp_tpu/pipeline/graph.py", "pipeline_step_fast"),
     ("vpp_tpu/pipeline/graph.py", "pipeline_step_auto"),
+    # set-associative session table (ISSUE 6): the insert core and the
+    # amortized in-step sweep are traced INTO every step variant via
+    # graph.py; session_expire's impl is wrapped by the module-level
+    # jit registered above; the linear-probe baseline is traced only by
+    # bench.py's jitted old-vs-new shoot-out
+    ("vpp_tpu/ops/session.py", "hashmap_insert"),
+    ("vpp_tpu/ops/session.py", "session_sweep"),
+    ("vpp_tpu/ops/session.py", "_session_expire_impl"),
+    ("vpp_tpu/ops/session.py", "hashmap_insert_linear"),
     # the packed/chained IO boundary wrappers: jax.jit(_packed_call(fn))
     ("vpp_tpu/pipeline/dataplane.py", "_packed_call.run"),
     ("vpp_tpu/pipeline/dataplane.py", "_chained_call.run"),

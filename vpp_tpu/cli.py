@@ -45,6 +45,7 @@ class DebugCLI:
         handlers = {
             ("show", "interface"): self.show_interface,
             ("show", "acl"): self.show_acl,
+            ("show", "sessions"): self.show_sessions,
             ("show", "session"): self.show_session,
             ("show", "session-rules"): self.show_session_rules,
             ("show", "mesh"): self.show_mesh,
@@ -78,7 +79,7 @@ class DebugCLI:
     def help(self) -> str:
         return (
             "commands: show interface | show acl | show session | "
-            "show session-rules | show mesh | "
+            "show sessions | show session-rules | show mesh | "
             "show nat44 | show fib | show trace | show errors | "
             "show fastpath | show io | show neighbors | show store | "
             "show config-history [n] | show spans [n] | "
@@ -249,13 +250,15 @@ class DebugCLI:
         t = self.dp.tables
         if t is None:
             return "no live tables"
-        valid = np.asarray(t.sess_valid)
+        valid = np.asarray(t.sess_valid).reshape(-1)
         idxs = np.nonzero(valid)[0]
         lines = [f"{len(idxs)} established sessions "
                  f"({valid.shape[0]} slots)"]
-        src = np.asarray(t.sess_src); dst = np.asarray(t.sess_dst)
-        ports = np.asarray(t.sess_ports); proto = np.asarray(t.sess_proto)
-        age = np.asarray(t.sess_time)
+        src = np.asarray(t.sess_src).reshape(-1)
+        dst = np.asarray(t.sess_dst).reshape(-1)
+        ports = np.asarray(t.sess_ports).reshape(-1)
+        proto = np.asarray(t.sess_proto).reshape(-1)
+        age = np.asarray(t.sess_time).reshape(-1)
         for i in idxs[:64]:
             i = int(i)
             lines.append(
@@ -265,6 +268,57 @@ class DebugCLI:
             )
         if len(idxs) > 64:
             lines.append(f"  ... {len(idxs) - 64} more")
+        return "\n".join(lines)
+
+    def show_sessions(self) -> str:
+        """Session-TABLE health page (the per-flow dump is
+        `show session`): geometry, live occupancy / load factor and the
+        amortized sweep cursors of both set-associative tables
+        (ops/session.py; docs/SESSIONS.md)."""
+        t = self.dp.tables
+        if t is None:
+            return "no live tables"
+        now = max(self.dp._now, self.dp.clock_ticks())
+        max_age = int(np.asarray(t.sess_max_age))
+        lines = [f"session tables (max_age {max_age} ticks, "
+                 f"sweep stride {self.dp._sweep_stride} buckets/step)"]
+        import jax.numpy as jnp
+
+        for name, prefix, cursor in (
+            ("reflective", "sess", t.sess_sweep_cursor),
+            ("nat", "natsess", t.natsess_sweep_cursor),
+        ):
+            valid = getattr(t, f"{prefix}_valid")
+            tme = getattr(t, f"{prefix}_time")
+            n_buckets, ways = valid.shape
+            slots = n_buckets * ways
+            # aggregate ON DEVICE: at the 10M-slot config the valid +
+            # time columns are ~270 MB across both tables — a CLI page
+            # must pull back four scalars, not the arrays
+            occ_m = valid == 1
+            occupied = int(jnp.sum(occ_m))
+            live = int(jnp.sum(occ_m & (now - tme <= max_age)))
+            full = int(jnp.sum(jnp.sum(occ_m, axis=1) == ways))
+            lines.append(
+                f"  {name}: {slots} slots = {n_buckets} buckets x "
+                f"{ways} ways")
+            lines.append(
+                f"    live {live} ({100.0 * live / slots:.1f}% load)  "
+                f"occupied {occupied} (incl. expired)  "
+                f"full-buckets {full}")
+            lines.append(
+                f"    sweep cursor {int(np.asarray(cursor))}/{n_buckets}")
+        if self.stats is not None:
+            tot = self.stats.totals_snapshot()
+            lines.append(
+                "  insert-fail {s}/{n} (sess/nat)  evictions "
+                "expired {ee}+{ne} victim {ev}+{nv}".format(
+                    s=tot.get("sess_insert_fail", 0),
+                    n=tot.get("natsess_insert_fail", 0),
+                    ee=tot.get("sess_evict_expired", 0),
+                    ne=tot.get("natsess_evict_expired", 0),
+                    ev=tot.get("sess_evict_victim", 0),
+                    nv=tot.get("natsess_evict_victim", 0)))
         return "\n".join(lines)
 
     def show_mesh(self) -> str:
@@ -537,14 +591,17 @@ class DebugCLI:
             # live = valid AND not idle-expired — what the dispatch
             # predicate's lookups actually see (an all-expired table
             # must not read as thousands of live sessions here)
+            import jax.numpy as jnp
+
             now = max(getattr(dp, "_now", 0), dp.clock_ticks())
-            valid = np.asarray(t.sess_valid) == 1
-            fresh_mask = (
-                now - np.asarray(t.sess_time) <= int(t.sess_max_age)
-            )
+            # aggregate ON device (show_sessions rationale): the table
+            # is [n_buckets, W] — slots = size, not the bucket count
+            valid = t.sess_valid == 1
+            fresh_mask = now - t.sess_time <= t.sess_max_age
             lines.append(
-                f"  sessions: {int((valid & fresh_mask).sum())} live of "
-                f"{valid.shape[0]} slots ({int(valid.sum())} valid)"
+                f"  sessions: {int(jnp.sum(valid & fresh_mask))} live "
+                f"of {t.sess_valid.size} slots "
+                f"({int(jnp.sum(valid))} valid)"
             )
         if self.pump is not None:
             s = self.pump.stats

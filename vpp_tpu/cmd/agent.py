@@ -580,7 +580,12 @@ class ContivAgent:
         poll health probes. Called by the background loop; callable
         directly in tests."""
         try:
-            self.dataplane.expire_sessions(self.session_max_age)
+            # lazy: when the in-step amortized sweep has cycled the
+            # whole table since the last tick, the bulk pass is skipped
+            # (steady-state aging rides the fused step); idle nodes
+            # still reclaim here
+            self.dataplane.expire_sessions(self.session_max_age,
+                                           lazy=True)
         except Exception:
             log.exception("session expiry failed")
         try:

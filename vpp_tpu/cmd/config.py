@@ -136,6 +136,19 @@ class AgentConfig:
     # (``dataplane.classifier: dense|mxu|bv|auto`` with
     # ``classifier_bv_min_rules`` / ``classifier_bv_mem_mb`` gating the
     # auto ladder — docs/CLASSIFIER.md; re-evaluated at every epoch swap)
+    # + the session-table geometry (docs/SESSIONS.md):
+    #   ``dataplane.sess_slots``     total reflective-session slots
+    #                                (power of two; 1<<24 ≈ 16.7M slots
+    #                                serves 10M+ concurrent sessions)
+    #   ``dataplane.sess_ways``      ways per set-associative bucket
+    #                                (power of two, default 4)
+    #   ``dataplane.natsess_slots``  NAT-session slots (0 = sess_slots)
+    #   ``dataplane.sess_sweep_stride`` buckets aged per fused step by
+    #                                the amortized on-device sweep
+    #                                (power of two; 0 disables)
+    # All four are validated at load (powers of two, divisibility) so a
+    # bad value fails HERE with a clear message, not deep inside a jit
+    # trace.
     dataplane: DataplaneConfig = dataclasses.field(default_factory=DataplaneConfig)
     # IPAM subnets
     ipam: IpamConfig = dataclasses.field(default_factory=IpamConfig)
@@ -160,6 +173,10 @@ class AgentConfig:
             d[name] = section_cls(**section)
 
         build_section("dataplane", DataplaneConfig, set(DataplaneConfig._fields))
+        if "dataplane" in d:
+            from vpp_tpu.pipeline.tables import validate_dataplane_config
+
+            validate_dataplane_config(d["dataplane"])
         build_section(
             "ipam", IpamConfig,
             {f.name for f in dataclasses.fields(IpamConfig)},

@@ -220,6 +220,12 @@ class DataplanePump:
             # fastpath_hit_pct gauge (hits/alive is the regime signal —
             # WHY batches do or don't dispatch fast)
             "fastpath_batches": 0, "fastpath_hits": 0, "fastpath_alive": 0,
+            # session-table pressure riders (aux rows 3/4): inserts that
+            # lost the intra-batch way election (retried next packet)
+            # and ways reclaimed by eviction (expired + victim, both
+            # tables) — the set-associative table's congestion signals,
+            # delivered in the SAME fetch as the packed results
+            "sess_insert_fails": 0, "sess_evictions": 0,
         }
         # dispatch→tx latency of recent batches, seconds (experienced
         # added latency of the device leg; ring-wait not included — the
@@ -519,10 +525,12 @@ class DataplanePump:
             fastpath = self.dp._use_fastpath
             classifier = getattr(self.dp, "_classifier_impl", "dense")
             skip_local = getattr(self.dp, "_skip_local", False)
+            sweep_stride = getattr(self.dp, "_sweep_stride", None)
         self._ppump = PersistentPump(tables, batch=VEC,
                                      fastpath=fastpath,
                                      classifier=classifier,
-                                     skip_local=skip_local).start()
+                                     skip_local=skip_local,
+                                     sweep_stride=sweep_stride).start()
         self._persist_epoch = epoch
 
     def _persist_stop_merge(self) -> None:
@@ -794,16 +802,18 @@ class DataplanePump:
             self._done_cv.notify_all()
 
     def _account_fastpath(self, aux) -> bool:
-        """Fold one dispatch's [3] (or chain-fold [K, 3]) fast-path
-        summary into the pump counters; returns True when EVERY
-        sub-batch ran the classify-free kernel (the whole dispatch's
-        latency then belongs to the fast-tier histogram).
+        """Fold one dispatch's [5] (or chain-fold [K, 5]) aux summary
+        into the pump counters; returns True when EVERY sub-batch ran
+        the classify-free kernel (the whole dispatch's latency then
+        belongs to the fast-tier histogram).
 
         ``fastpath_batches`` counts at DISPATCH granularity — a chain
         fold counts once, and only when all K sub-batches went fast —
         so it stays directly comparable to ``stats["batches"]`` (the
         ratio is a true fraction). Partial folds still show up in the
-        packet-level hits/alive accumulators."""
+        packet-level hits/alive accumulators. Rows 3/4 carry the
+        session-table pressure counters (insert election losses,
+        evictions) when the program provides them."""
         if aux is None:
             return False
         a = np.asarray(aux)
@@ -815,6 +825,9 @@ class DataplanePump:
                 self.stats["fastpath_batches"] += 1
             self.stats["fastpath_alive"] += int(a[:, 1].sum())
             self.stats["fastpath_hits"] += int(a[:, 2].sum())
+            if a.shape[1] >= 5:
+                self.stats["sess_insert_fails"] += int(a[:, 3].sum())
+                self.stats["sess_evictions"] += int(a[:, 4].sum())
         return all_fast
 
     # --- tx writer: reorder, split, write tx ring, release rx slots ---

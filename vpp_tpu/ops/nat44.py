@@ -11,9 +11,9 @@ choice is a *consistent* weighted pick keyed on the flow hash, so every
 packet of a flow picks the same backend even before the NAT session is
 established (VPP relies on the session table for stickiness; hashing
 gives it stateless determinism — a TPU-friendly improvement). The NAT
-session table (same open-addressing design as the reflective ACL
-sessions) records the original (VIP, port) per flow for the reverse
-translation of backend→client traffic.
+session table (same W-way set-associative design as the reflective ACL
+sessions, ops/session.py) records the original (VIP, port) per flow for
+the reverse translation of backend→client traffic.
 """
 
 from __future__ import annotations
@@ -22,7 +22,7 @@ from typing import Tuple
 
 import jax.numpy as jnp
 
-from vpp_tpu.ops.session import SESS_PROBES, _hash, _pack_ports, hashmap_insert
+from vpp_tpu.ops.session import _hash, _pack_ports, hashmap_insert
 from vpp_tpu.pipeline.tables import DataplaneTables
 from vpp_tpu.pipeline.vector import PacketVector
 
@@ -168,12 +168,15 @@ def nat44_record(
     destination) and the ``kind`` bitmask saying which rewrites apply
     (1=DNAT, 2=SNAT — a node-port flow to a remote backend carries both).
 
-    Returns (tables, conflict, failed): ``conflict`` marks packets whose
-    reply key is already owned by a *different* flow (hash-derived SNAT
-    port collision) — the caller fails closed (drops + counts) so
-    replies are never misdelivered to the wrong pod. ``failed`` marks
-    probe-window congestion (no slot found; surfaced as a counter).
-    Expired entries are evicted in place (``tables.sess_max_age``).
+    Returns (tables, conflict, failed, evict_expired, evict_victim):
+    ``conflict`` marks packets whose reply key is already owned by a
+    *different* flow (hash-derived SNAT port collision) — the caller
+    fails closed (drops + counts) so replies are never misdelivered to
+    the wrong pod. ``failed`` marks packets that lost the intra-batch
+    way election to a different flow (retried on the flow's next
+    packet; surfaced as a counter). Expired ways are reclaimed in
+    place and a full bucket evicts its oldest entry — both counted by
+    reason (``tables.sess_max_age``; ops/session.py module doc).
     """
     key_vals = (
         pkts.dst_ip,
@@ -182,7 +185,8 @@ def nat44_record(
         pkts.proto,
     )
     h = _hash(*key_vals, tables.natsess_valid.shape[0])
-    valid, time, keys, extras, _, conflict, failed = hashmap_insert(
+    (valid, time, keys, extras, _, conflict, failed,
+     ev_exp, ev_vic) = hashmap_insert(
         tables.natsess_valid,
         tables.natsess_time,
         (tables.natsess_a, tables.natsess_b, tables.natsess_ports, tables.natsess_proto),
@@ -207,7 +211,7 @@ def nat44_record(
         natsess_src_ip=extras[2],
         natsess_sport=extras[3],
         natsess_kind=extras[4],
-    ), conflict, failed
+    ), conflict, failed, ev_exp, ev_vic
 
 
 def nat44_reverse(
@@ -229,43 +233,40 @@ def nat44_reverse(
     bit 2 (SNAT'd forward) rewrites the reply *destination* back to the
     original source (the pod IP/port behind the node's SNAT address).
     """
-    n_slots = tables.natsess_valid.shape[0]
-    probes = SESS_PROBES
+    n_buckets, ways = tables.natsess_valid.shape
     key_vals = (
         pkts.src_ip,
         pkts.dst_ip,
         _pack_ports(pkts.sport, pkts.dport),
         pkts.proto,
     )
-    h = _hash(*key_vals, n_slots)
-    # Vectorized probe window: one [P, probes] gather per array, then a
-    # first-hit argmax — replaces `probes` sequential dependent gathers.
-    idx = (h[:, None] + jnp.arange(probes, dtype=jnp.int32)[None, :]) & (
-        n_slots - 1
-    )
-    slot_ok = tables.natsess_valid[idx] == 1
+    b = _hash(*key_vals, n_buckets)
+    # Set-associative bucket fetch: ONE [P, W] row gather per column
+    # (the ways are contiguous), then a first-hit argmax across ways.
+    slot_ok = tables.natsess_valid[b] == 1
     if now is not None:
         # expired NAT state must not translate new traffic
         slot_ok = slot_ok & (
-            now - tables.natsess_time[idx] <= tables.sess_max_age
+            now - tables.natsess_time[b] <= tables.sess_max_age
         )
     for arr, val in zip(
         (tables.natsess_a, tables.natsess_b, tables.natsess_ports, tables.natsess_proto),
         key_vals,
     ):
-        slot_ok = slot_ok & (arr[idx] == val[:, None])
+        slot_ok = slot_ok & (arr[b] == val[:, None])
     found = jnp.any(slot_ok, axis=1)
     first = jnp.argmax(slot_ok, axis=1)
-    hit_idx = jnp.take_along_axis(idx, first[:, None], axis=1)[:, 0]
+    hit_idx = b * ways + first  # flat (bucket*W + way), for nat44_touch
+    hb, hw = hit_idx // ways, hit_idx % ways
     applied = found & eligible
-    kind = jnp.where(applied, tables.natsess_kind[hit_idx], 0)
+    kind = jnp.where(applied, tables.natsess_kind[hb, hw], 0)
     undo_dnat = (kind & 1) != 0
     undo_snat = (kind & 2) != 0
     out = pkts._replace(
-        src_ip=jnp.where(undo_dnat, tables.natsess_orig_ip[hit_idx], pkts.src_ip),
-        sport=jnp.where(undo_dnat, tables.natsess_orig_port[hit_idx], pkts.sport),
-        dst_ip=jnp.where(undo_snat, tables.natsess_src_ip[hit_idx], pkts.dst_ip),
-        dport=jnp.where(undo_snat, tables.natsess_sport[hit_idx], pkts.dport),
+        src_ip=jnp.where(undo_dnat, tables.natsess_orig_ip[hb, hw], pkts.src_ip),
+        sport=jnp.where(undo_dnat, tables.natsess_orig_port[hb, hw], pkts.sport),
+        dst_ip=jnp.where(undo_snat, tables.natsess_src_ip[hb, hw], pkts.dst_ip),
+        dport=jnp.where(undo_snat, tables.natsess_sport[hb, hw], pkts.dport),
     )
     return out, applied, hit_idx
 
@@ -274,9 +275,11 @@ def nat44_touch(
     tables: DataplaneTables, hit_idx: jnp.ndarray, mask: jnp.ndarray, now
 ) -> DataplaneTables:
     """Refresh natsess_time for sessions hit by reply traffic — an
-    active NAT'd flow must not expire while its replies still flow."""
-    n_slots = tables.natsess_valid.shape[0]
-    widx = jnp.where(mask, hit_idx, n_slots)
+    active NAT'd flow must not expire while its replies still flow.
+    ``hit_idx`` is flat (bucket·W + way, nat44_reverse)."""
+    n_buckets, ways = tables.natsess_valid.shape
+    widx = jnp.where(mask, hit_idx, n_buckets * ways)
     return tables._replace(
-        natsess_time=tables.natsess_time.at[widx].set(now, mode="drop")
+        natsess_time=tables.natsess_time.at[widx // ways, widx % ways].set(
+            now, mode="drop")
     )

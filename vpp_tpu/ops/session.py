@@ -1,34 +1,79 @@
-"""Reflective-flow session table: an open-addressing hash map in HBM.
+"""Reflective-flow session table: a W-way set-associative hash map in HBM.
 
 Reference analog: VPP acl-plugin's reflexive ("reflect") ACL session
 table — when a policy permits flow A→B, the reverse flow B→A is admitted
-statefully without needing its own permit rule.
+statefully without needing its own permit rule. The scale target is
+Gryphon's hyperscale-gateway connection state (PAPERS.md): 10M+
+concurrent sessions resident on the device.
 
-Design: fixed-size power-of-two slot arrays, linear probing with a small
-static probe depth (fully unrolled under jit — no data-dependent control
-flow). Batch-parallel insert resolves same-slot collisions *within* a
-vector by an election among contenders for the same slot; the lowest
-packet index wins, losers fall through to the next probe round. Two
-equivalent election strategies (differentially tested identical,
-selected at trace time — VERDICT r4 Next #5):
+Layout: every session column is a ``[n_buckets, W]`` array — the way
+count W is carried IN THE SHAPE, so the jitted kernels never need a
+config plumb and jax re-specializes per geometry automatically. A flow
+hashes to ONE bucket; all W ways of the bucket are fetched with a single
+row gather (``arr[bucket] -> [P, W]``), compared vectorized, and the
+whole insert resolves in ONE election round:
 
-  * ``claim`` — scatter-min over an [n_slots] claim array. O(n_slots)
-    memset + scatter + gather per probe round: cost SCALES with the
-    table (order-alternated medians on one CPU core: 368 ns/pkt @4k
-    slots, 509 @32k).
-  * ``sort`` — stable argsort of the candidates' slot numbers; equal
-    slots form runs in packet order, the first of each run is the
-    winner. O(B log B) in the BATCH, independent of n_slots — and
-    measured faster at EVERY deployed table size on CPU too (338
-    ns/pkt @4k, 442 @32k, same harness).
+  1. **exists pass** — one gather per column; live key matches anywhere
+     in the bucket refresh the timestamp (idempotent insert), same key
+     with different payload is a **conflict** (fail-closed, the caller
+     drops and counts — misdelivering NAT replies is worse than
+     dropping).
+  2. **single election round via bucket representatives** — each
+     bucket's first W pending packets (in packet-index order) are its
+     *reps*; every pending packet compares its FULL key against its
+     bucket's reps. The first rep with an equal key is the packet's
+     **leader** (exactly the lowest-index packet of its flow: if any
+     same-key packet made rep, the lower-index leader did too — never
+     a hash-probabilistic dedup), and the leader's **rank** is the
+     number of DISTINCT flows among the reps before its slot (a
+     pairwise dedup over the W reps — NOT the raw slot index, which
+     duplicate packets of a bursty sibling flow would inflate,
+     skipping free ways and victim-evicting live sessions for no
+     reason). A packet that IS its own leader wins and takes the
+     bucket's rank-th best way: free ways first (invalid and
+     idle-expired ways rank alike, by ascending way index — reclaiming
+     an expired way over a never-used one is immaterial, both are
+     free; insert-time eviction preserved and the expired case counted
+     ``reason=expired``), then LIVE ways oldest-``time`` first
+     (**victim eviction** — a full bucket admits new flows by evicting
+     longest-idle sessions, counted by reason).
+     Ranks are dense and unique per bucket, so distinct flows NEVER
+     collide on a way; followers inherit their leader's outcome (same
+     payload → satisfied, different → deterministic conflict, leader
+     not a rep → failed). The only intra-batch failure mode is a
+     flow's FIRST packet falling past the bucket's W-pending-packet
+     rep window in one vector (``failed_mask``; the flow retries on
+     its next packet). Winners are written with ONE scatter round. Two equivalent rep strategies (differentially
+     tested identical, selected at trace time):
 
-``auto`` therefore picks sort everywhere; claim remains selectable
-(VPPT_SESS_ELECTION=claim) as the comparison baseline —
-``bench.py``'s ``sess_election_*`` shoot-out re-measures both on the
-live backend every round, so a backend where claim wins would show up
-in the artifact and flip this default with evidence. Aging is a
-host-side loop clearing stale ``sess_time`` entries (the reference
-ages sessions on a VPP worker interrupt, SURVEY.md §5).
+       * ``claim`` — W iterations of scatter-min over an [n_buckets]
+         claim array (iteration j crowns rep j): O(W·n_buckets)
+         memset per insert, cost SCALES with the table.
+       * ``sort`` — ONE single-operand sort of a packed
+         (pending, bucket, packet-index) key; equal buckets form runs
+         in packet order and reps are the first W run members:
+         O(B log B) in the BATCH, table-size independent — mandatory
+         at the 10M+ regime and measured faster at every deployed
+         size on CPU too. (A variadic argsort is ~10x the cost of a
+         single-key sort on the CPU backend, hence the bit-packing;
+         when batch-index + bucket bits don't fit 32 together the
+         code pays the stable argsort instead — bucket bits are NEVER
+         masked below 2^30 buckets, because a masked merge would not
+         only waste rep slots: it inflates a winner's rank past its
+         own bucket's rep count, and a rank-inflated winner skips
+         free ways and victim-evicts a LIVE session it had no reason
+         to touch.)
+
+     ``auto`` therefore picks sort everywhere; claim remains selectable
+     (VPPT_SESS_ELECTION=claim) as the comparison baseline and
+     ``bench.py``'s ``sess_election_*`` shoot-out re-measures both.
+
+Aging is amortized: ``session_sweep`` clears a fixed stride of buckets
+per fused pipeline step (cursor threaded through the tables pytree), so
+idle-expiry reclamation is O(stride) per step instead of a monolithic
+full-table pass — nanoPU's bounded-per-step framing (PAPERS.md).
+``session_expire`` remains as the on-demand bulk reclaim (CLI / tests /
+idle-node maintenance).
 """
 
 from __future__ import annotations
@@ -36,6 +81,7 @@ from __future__ import annotations
 import os
 from typing import Tuple
 
+import jax
 import jax.numpy as jnp
 
 # Plain int, not jnp: a module-level device scalar would (a) initialize
@@ -47,9 +93,9 @@ _BIG = 0x7FFFFFFF
 
 def election_mode(n_slots: int) -> str:
     """Trace-time election strategy (module doc). Env override first;
-    ``auto`` is sort — measured faster at every table size on CPU and
-    free of the table-size scaling, with the bench shoot-out
-    re-validating the choice per backend each round."""
+    ``auto`` is sort — table-size independent (claim's scatter-min
+    scales with n_slots, untenable at the 10M regime), with the bench
+    shoot-out re-validating the choice per backend each round."""
     mode = os.environ.get("VPPT_SESS_ELECTION", "auto")
     if mode in ("claim", "sort"):
         return mode
@@ -58,13 +104,15 @@ def election_mode(n_slots: int) -> str:
 from vpp_tpu.pipeline.tables import DataplaneTables
 from vpp_tpu.pipeline.vector import PacketVector
 
-# Linear-probe depth of every hash table (lookup and insert must agree).
+# Legacy linear-probe depth — kept ONLY for the bench's old-vs-new
+# baseline (``hashmap_insert_linear``); the set-associative table's
+# probe window is the bucket's way count, carried in the array shape.
 SESS_PROBES = 4
 
 
 def _hash(src: jnp.ndarray, dst: jnp.ndarray, ports: jnp.ndarray, proto: jnp.ndarray,
-          n_slots: int) -> jnp.ndarray:
-    """Multiplicative xor hash of the 5-tuple into [0, n_slots)."""
+          n_buckets: int) -> jnp.ndarray:
+    """Multiplicative xor hash of the 5-tuple into [0, n_buckets)."""
     h = src * jnp.uint32(0x9E3779B1)
     h ^= dst * jnp.uint32(0x85EBCA77)
     h ^= ports * jnp.uint32(0xC2B2AE3D)
@@ -72,7 +120,7 @@ def _hash(src: jnp.ndarray, dst: jnp.ndarray, ports: jnp.ndarray, proto: jnp.nda
     h ^= h >> 15
     h = h * jnp.uint32(0x2545F491)
     h ^= h >> 13
-    return (h & jnp.uint32(n_slots - 1)).astype(jnp.int32)
+    return (h & jnp.uint32(n_buckets - 1)).astype(jnp.int32)
 
 
 def _pack_ports(sport: jnp.ndarray, dport: jnp.ndarray) -> jnp.ndarray:
@@ -86,33 +134,29 @@ def session_lookup_reverse(
 
     Looks up the reversed 5-tuple (dst→src, dport→sport) in the table.
     Returns a bool mask [P]. With ``now``, entries idle longer than
-    ``tables.sess_max_age`` are dead even before the host aging loop
-    reclaims them — timeout precision is in-kernel (VPP's session timers
-    fire per-worker; ours are evaluated per lookup).
+    ``tables.sess_max_age`` are dead even before any reclamation sweeps
+    them — timeout precision is in-kernel (VPP's session timers fire
+    per-worker; ours are evaluated per lookup).
     """
-    n_slots = tables.sess_valid.shape[0]
-    probes = SESS_PROBES
+    n_buckets = tables.sess_valid.shape[0]
     key_src = pkts.dst_ip
     key_dst = pkts.src_ip
     key_ports = _pack_ports(pkts.dport, pkts.sport)
     key_proto = pkts.proto
-    h = _hash(key_src, key_dst, key_ports, key_proto, n_slots)
-    # One [P, probes] gather per array instead of `probes` sequential
-    # gathers — no cross-probe dependency, so the TPU vectorizes the
-    # whole probe window at once.
-    idx = (h[:, None] + jnp.arange(probes, dtype=jnp.int32)[None, :]) & (
-        n_slots - 1
-    )
+    b = _hash(key_src, key_dst, key_ports, key_proto, n_buckets)
+    # ONE row gather per column fetches the whole bucket ([P, W]): the
+    # ways are contiguous, so this is the cheapest gather shape the
+    # table can offer — no probe chain, no cross-way dependency.
     slot_match = (
-        (tables.sess_valid[idx] == 1)
-        & (tables.sess_src[idx] == key_src[:, None])
-        & (tables.sess_dst[idx] == key_dst[:, None])
-        & (tables.sess_ports[idx] == key_ports[:, None])
-        & (tables.sess_proto[idx] == key_proto[:, None])
+        (tables.sess_valid[b] == 1)
+        & (tables.sess_src[b] == key_src[:, None])
+        & (tables.sess_dst[b] == key_dst[:, None])
+        & (tables.sess_ports[b] == key_ports[:, None])
+        & (tables.sess_proto[b] == key_proto[:, None])
     )
     if now is not None:
         slot_match = slot_match & (
-            now - tables.sess_time[idx] <= tables.sess_max_age
+            now - tables.sess_time[b] <= tables.sess_max_age
         )
     return jnp.any(slot_match, axis=1)
 
@@ -120,30 +164,27 @@ def session_lookup_reverse(
 def session_lookup_reverse_idx(
     tables: DataplaneTables, pkts: PacketVector, now
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Like session_lookup_reverse, but also returns the matched slot
-    index [P] (undefined where not found) so the pipeline can refresh
-    ``sess_time`` — active flows must not expire mid-flow."""
-    n_slots = tables.sess_valid.shape[0]
-    probes = SESS_PROBES
+    """Like session_lookup_reverse, but also returns the matched FLAT
+    slot index [P] (bucket·W + way; undefined where not found) so the
+    pipeline can refresh ``sess_time`` — active flows must not expire
+    mid-flow."""
+    n_buckets, ways = tables.sess_valid.shape
     key_src = pkts.dst_ip
     key_dst = pkts.src_ip
     key_ports = _pack_ports(pkts.dport, pkts.sport)
     key_proto = pkts.proto
-    h = _hash(key_src, key_dst, key_ports, key_proto, n_slots)
-    idx = (h[:, None] + jnp.arange(probes, dtype=jnp.int32)[None, :]) & (
-        n_slots - 1
-    )
+    b = _hash(key_src, key_dst, key_ports, key_proto, n_buckets)
     slot_match = (
-        (tables.sess_valid[idx] == 1)
-        & (tables.sess_src[idx] == key_src[:, None])
-        & (tables.sess_dst[idx] == key_dst[:, None])
-        & (tables.sess_ports[idx] == key_ports[:, None])
-        & (tables.sess_proto[idx] == key_proto[:, None])
-        & (now - tables.sess_time[idx] <= tables.sess_max_age)
+        (tables.sess_valid[b] == 1)
+        & (tables.sess_src[b] == key_src[:, None])
+        & (tables.sess_dst[b] == key_dst[:, None])
+        & (tables.sess_ports[b] == key_ports[:, None])
+        & (tables.sess_proto[b] == key_proto[:, None])
+        & (now - tables.sess_time[b] <= tables.sess_max_age)
     )
     found = jnp.any(slot_match, axis=1)
     first = jnp.argmax(slot_match, axis=1)
-    hit_idx = jnp.take_along_axis(idx, first[:, None], axis=1)[:, 0]
+    hit_idx = b * ways + first
     return found, hit_idx
 
 
@@ -168,12 +209,123 @@ def session_batch_summary(
 def session_touch(
     tables: DataplaneTables, hit_idx: jnp.ndarray, mask: jnp.ndarray, now
 ) -> DataplaneTables:
-    """Refresh sess_time for matched sessions (keepalive on traffic)."""
-    n_slots = tables.sess_valid.shape[0]
-    widx = jnp.where(mask, hit_idx, n_slots)
+    """Refresh sess_time for matched sessions (keepalive on traffic).
+    ``hit_idx`` is flat (bucket·W + way, session_lookup_reverse_idx)."""
+    n_buckets, ways = tables.sess_valid.shape
+    widx = jnp.where(mask, hit_idx, n_buckets * ways)
     return tables._replace(
-        sess_time=tables.sess_time.at[widx].set(now, mode="drop")
+        sess_time=tables.sess_time.at[widx // ways, widx % ways].set(
+            now, mode="drop")
     )
+
+
+def _elect(cand: jnp.ndarray, slot: jnp.ndarray, n_slots: int) -> jnp.ndarray:
+    """One election round: among candidate packets contending for the
+    same flat slot id, the lowest packet index wins. Strategy ladder in
+    the module doc (claim scatter-min vs stable sort) — semantics are
+    identical by construction, picked at trace time. Used by the
+    legacy linear-probe baseline; the set-associative insert uses the
+    ranked form (``_elect_rank``)."""
+    batch = slot.shape[0]
+    p_idx = jnp.arange(batch, dtype=jnp.int32)
+    # jax-ok: n_slots is a shape-derived Python int — election_mode is a
+    # trace-time strategy pick, not a tracer branch
+    if election_mode(n_slots) == "claim":
+        claim = jnp.full((n_slots,), _BIG, dtype=jnp.int32)
+        claim = claim.at[jnp.where(cand, slot, n_slots)].min(
+            p_idx, mode="drop")
+        return cand & (claim[slot] == p_idx)
+    slot_key = jnp.where(cand, slot, n_slots)  # non-cands sort last
+    order = jnp.argsort(slot_key)               # stable (jnp default)
+    ss = slot_key[order]
+    first_of_run = jnp.concatenate(
+        [jnp.ones((1,), bool), ss[1:] != ss[:-1]])
+    return jnp.zeros(batch, bool).at[order].set(
+        first_of_run & (ss < n_slots))
+
+
+def _bucket_reps(h: jnp.ndarray, pending: jnp.ndarray, n_buckets: int,
+                 ways: int) -> jnp.ndarray:
+    """Per packet, the packet indices of (up to) the first ``ways``
+    pending packets of its bucket in ascending packet-index order — a
+    [B, ways] matrix with sentinel B where the bucket has fewer pending
+    members. The claim/sort strategy ladder (module doc): claim's j-th
+    scatter-min iteration crowns exactly the (j+1)-lowest remaining
+    packet index per bucket, which IS the j-th member of the bucket's
+    run in the sorted order — bit-identical by construction. Sort mode
+    packs (pending, bucket, packet index) into ONE 32-bit key when the
+    bit widths fit, and otherwise falls back to a stable variadic
+    argsort; bucket ids are NEVER masked to force the packed form —
+    the module doc explains why a masked merge would inflate winner
+    ranks into spurious victim evictions of live ways."""
+    batch = pending.shape[0]
+    p_idx = jnp.arange(batch, dtype=jnp.int32)
+    # jax-ok: n_buckets/ways are shape-derived Python ints — trace-time
+    # strategy pick, not a tracer branch
+    if election_mode(n_buckets * ways) == "claim":
+        reps = []
+        remaining = pending
+        for _ in range(ways):
+            claim = jnp.full((n_buckets,), _BIG, dtype=jnp.int32)
+            claim = claim.at[
+                jnp.where(remaining, h, n_buckets)
+            ].min(p_idx, mode="drop")
+            rep_j = claim[h]      # this round's winner of MY bucket
+            remaining = remaining & ~(rep_j == p_idx)
+            reps.append(jnp.where(rep_j == _BIG, batch, rep_j))
+        return jnp.stack(reps, axis=1)
+    # sort mode: ONE single-operand 32-bit sort. Packed key layout
+    # (most → least significant): not-pending bit | bucket bits |
+    # packet index — so pending packets sort first, grouped by bucket,
+    # in packet order, and the index decodes straight back out.
+    idx_bits = max((batch - 1).bit_length(), 1)
+    bkt_bits = max((n_buckets - 1).bit_length(), 1)
+    # the packed form is only sound when the FULL bucket id fits next
+    # to the packet index: masked bucket bits merge runs across
+    # buckets, and a merged run inflates winner ranks → spurious
+    # victim eviction of live ways (module doc). Otherwise pay the
+    # stable argsort (exact up to 2^30 buckets).
+    # jax-ok: idx_bits/bkt_bits are shape-derived Python ints — the
+    # packed-vs-argsort pick is trace-time static, not a tracer branch
+    if idx_bits + bkt_bits <= 31:
+        sk = jnp.sort(
+            ((~pending).astype(jnp.uint32) << 31)
+            | (h.astype(jnp.uint32) << idx_bits)
+            | p_idx.astype(jnp.uint32)
+        )
+        order64 = None
+        runid = sk >> idx_bits
+        order = (sk & jnp.uint32((1 << idx_bits) - 1)).astype(jnp.int32)
+    else:
+        # 30 bucket bits, no room for the index — pay a stable
+        # variadic argsort (slower on CPU, fine on accelerators)
+        key31 = (((~pending).astype(jnp.uint32)) << 30) | (
+            h.astype(jnp.uint32) & jnp.uint32((1 << 30) - 1))
+        order64 = jnp.argsort(key31)  # stable (jnp default)
+        sk = key31[order64]
+        runid = sk
+        order = order64
+    pos = jnp.arange(batch, dtype=jnp.int32)
+    run_start = jnp.concatenate(
+        [jnp.ones((1,), bool), runid[1:] != runid[:-1]])
+    # forward-fill each position with its run's start (the where()
+    # plants start positions, cummax propagates them — sound because
+    # positions are strictly increasing)
+    start_pos = jax.lax.cummax(jnp.where(run_start, pos, 0))
+    # the whole rep window in ONE [B, W] gather: rows start_pos..+W-1
+    rp = start_pos[:, None] + jnp.arange(ways, dtype=jnp.int32)[None, :]
+    rp_c = jnp.minimum(rp, batch - 1)
+    if order64 is None:
+        sk_at = sk[rp_c]      # one gather: run check AND packet index
+        ok = (rp < batch) & ((sk_at >> idx_bits) == runid[:, None])
+        rep = (sk_at & jnp.uint32((1 << idx_bits) - 1)).astype(jnp.int32)
+    else:
+        ok = (rp < batch) & (runid[rp_c] == runid[:, None])
+        rep = order64[rp_c]
+    rep_s = jnp.where(ok, rep, batch)
+    # scatter the sorted-space rep rows back to packet order (order is
+    # a permutation: every position is written exactly once)
+    return jnp.zeros((batch, ways), jnp.int32).at[order].set(rep_s)
 
 
 def hashmap_insert(
@@ -186,120 +338,181 @@ def hashmap_insert(
     h: jnp.ndarray,
     want: jnp.ndarray,
     now: jnp.ndarray,
-    probes: int = SESS_PROBES,
     max_age=None,
-) -> Tuple[jnp.ndarray, jnp.ndarray, Tuple[jnp.ndarray, ...], Tuple[jnp.ndarray, ...], jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Generic batch-parallel open-addressing insert (see module doc).
+) -> tuple:
+    """Generic W-way set-associative batch insert (see module doc).
 
-    ``keys``/``extras`` are the table's slot arrays, ``key_vals``/
-    ``extra_vals`` the per-packet values to store; ``h`` the per-packet
-    home slot. Returns (valid, time, keys, extras, inserted_mask,
-    conflict_mask, failed_mask). Matching on ``keys`` makes the insert
-    idempotent (refreshes ``time``); ``extras`` are payload columns
-    written but not compared for matching — but if an existing entry has
-    the same key with *different* payload, the insert is a **conflict**
-    (e.g. two SNAT'd flows whose hash-derived ports collide on the same
-    reply 5-tuple): the entry is left untouched (no time refresh — the
-    original flow owns the slot) and the packet is flagged so the caller
-    can fail closed.
+    ``keys``/``extras`` are the table's ``[n_buckets, W]`` column
+    arrays, ``key_vals``/``extra_vals`` the per-packet values to store;
+    ``h`` the per-packet home BUCKET. Matching on ``keys`` makes the
+    insert idempotent (refreshes ``time``); ``extras`` are payload
+    columns written but not compared for matching — but if an existing
+    entry has the same key with *different* payload, the insert is a
+    **conflict** (e.g. two SNAT'd flows whose hash-derived ports
+    collide on the same reply 5-tuple): the entry is left untouched (no
+    time refresh — the original flow owns the slot) and the packet is
+    flagged so the caller can fail closed.
 
     With ``max_age``, entries idle past it count as dead: they neither
-    match nor block — the insert reclaims their slots (insert-time
-    eviction, so a full-but-stale window doesn't starve new flows).
-    ``failed_mask`` marks packets that found every live probe slot taken
-    (true congestion) — callers surface it as a counter instead of the
-    silent skip VERDICT r1 flagged.
+    match nor block — the insert reclaims their ways in-bucket
+    (insert-time eviction). A bucket whose every way is LIVE admits the
+    new flow anyway by evicting the oldest-``time`` way (victim
+    policy); both reclaim flavors are reported so the caller can count
+    ``{reason=expired|victim}``.
+
+    Returns ``(valid, time, keys, extras, inserted, conflict, failed,
+    evict_expired, evict_victim)`` — all masks [P]. ``failed`` marks
+    packets that lost the single intra-batch election to a DIFFERENT
+    flow targeting the same way (they retry on their flow's next
+    packet; sustained failures mean heavy same-bucket pressure and are
+    surfaced as a counter, never a silent skip).
     """
-    n_slots = valid.shape[0]
+    n_buckets, ways = valid.shape
+    batch = want.shape[0]
     keys = tuple(keys)
     extras = tuple(extras)
 
-    def live_at(idx):
-        live = valid[idx] == 1
-        if max_age is not None:
-            live = live & (now - time[idx] <= max_age)
-        return live
+    # --- pass 1: one bucket-row gather per column; refresh / conflict ---
+    vw = valid[h]                       # [P, W]
+    tw = time[h]
+    live = vw == 1
+    if max_age is not None:
+        live = live & (now - tw <= max_age)
+    key_match = live
+    for arr, val in zip(keys, key_vals):
+        key_match = key_match & (arr[h] == val[:, None])
+    exists = jnp.any(key_match, axis=1)
+    exist_way = jnp.argmax(key_match, axis=1)
 
-    def key_at(idx):
-        same = live_at(idx)
-        for arr, val in zip(keys, key_vals):
-            same = same & (arr[idx] == val)
-        return same
+    def at_way(arr, way):
+        """Single-element gather of each packet's (bucket, way) cell."""
+        return arr[h, way]
 
-    def payload_at(idx):
-        same = jnp.ones(idx.shape, bool)
-        for arr, val in zip(extras, extra_vals):
-            same = same & (arr[idx] == val)
-        return same
-
-    # Pass 1: existence check across the whole probe window, so a key whose
-    # entry sits at a later offset (because its home slot was taken at
-    # insert time but has since been freed) is refreshed, not duplicated.
-    exists = jnp.zeros_like(want)
-    exist_idx = jnp.zeros_like(h)
-    for p in range(probes):
-        idx = (h + p) & (n_slots - 1)
-        same = key_at(idx)
-        exist_idx = jnp.where(same & ~exists, idx, exist_idx)
-        exists = exists | same
-    same_payload = payload_at(exist_idx)
-    conflict = want & exists & ~same_payload
-    refresh = want & exists & same_payload
-    time = time.at[jnp.where(refresh, exist_idx, n_slots)].set(now, mode="drop")
+    pay_same = jnp.ones_like(exists)
+    for arr, val in zip(extras, extra_vals):
+        pay_same = pay_same & (at_way(arr, exist_way) == val)
+    conflict = want & exists & ~pay_same
+    refresh = want & exists & pay_same
+    refresh_slot = jnp.where(
+        refresh, h * ways + exist_way, n_buckets * ways)
     pending = want & ~exists
     inserted = refresh
 
-    # Pass 2: election-insert rounds. Among packets probing the same empty
-    # slot, the lowest packet index wins (election strategies in the
-    # module doc — semantics identical, picked at trace time); after the
-    # write, any pending packet whose key now occupies the slot (the
-    # winner itself, or a same-key loser) is satisfied — this is what
-    # prevents two packets of one flow in the same vector from
-    # inserting twice.
-    batch = h.shape[0]
-    mode = election_mode(n_slots)
+    shape = valid.shape
+
+    def put(arr, val, idx):
+        return arr.reshape(-1).at[idx].set(val, mode="drop").reshape(shape)
+
+    # the refresh scatter lands BEFORE the election so victim
+    # priorities see this batch's refreshes: a way refreshed in pass 1
+    # is active *now*, and electing it as the oldest-time victim off
+    # its stale pre-batch timestamp would evict the very flow that
+    # just touched it (while still reporting that flow inserted=True).
+    # One re-gathered row per packet; the chain time→scatter→gather is
+    # linear so XLA aliases the buffer in place.
+    time = put(time, jnp.broadcast_to(now, (batch,)).astype(time.dtype),
+               refresh_slot)
+    tw = time[h]
+
+    # --- pass 2: ONE rep-based election round (module doc) ---
     p_idx = jnp.arange(batch, dtype=jnp.int32)
+    reps = _bucket_reps(h, pending, n_buckets, ways)       # [B, W]
+    # leader = first rep with MY full key. Because reps are scanned in
+    # packet order and a flow's lowest-index pending packet makes rep
+    # whenever ANY of its packets does, the leader is (a) exactly the
+    # flow's first packet and (b) always its own leader — i.e. every
+    # follower's leader IS a winner, so no winner[leader] indirection
+    # is needed. No same-key rep => the flow's first packet fell past
+    # the bucket's W-packet budget this batch => failed (retry). Key
+    # columns are stacked so the whole rep comparison is ONE [B, W, K]
+    # gather — gathers are the dominant unfusable op on CPU.
+    kmat = jnp.stack([v.astype(jnp.uint32) for v in key_vals], axis=1)
+    rep_c = jnp.minimum(reps, batch - 1)
+    rk = kmat[rep_c]                                       # [B, W, K]
+    same = (reps < batch) & jnp.all(
+        rk == kmat[:, None, :], axis=2)                    # [B, W]
+    found = jnp.any(same, axis=1)
+    lead_slot = jnp.argmax(same, axis=1).astype(jnp.int32)  # first match
+    leader = jnp.take_along_axis(rep_c, lead_slot[:, None], axis=1)[:, 0]
+    winner = pending & found & (leader == p_idx)
+    follower = pending & found & (leader != p_idx)
+    # rank = DISTINCT flows among the reps before my leader's slot, NOT
+    # the raw rep slot index: duplicate packets of one flow occupy rep
+    # slots (the window is W pending packets) but must not inflate a
+    # later flow's rank — a slot-index rank skips free ways and
+    # victim-evicts a LIVE session whenever a sibling flow bursts >1
+    # packet into the same batch (TCP retransmits / first-window
+    # bursts). Dedup among W reps is one [B, W, W, K] pairwise compare;
+    # ranks stay dense and unique per bucket (first-appearance order).
+    ok_rep = reps < batch
+    rep_dup = jnp.any(
+        jnp.all(rk[:, :, None, :] == rk[:, None, :, :], axis=3)
+        & jnp.tril(jnp.ones((ways, ways), bool), k=-1)[None]
+        & ok_rep[:, :, None] & ok_rep[:, None, :], axis=2)  # [B, W]
+    rep_new = (ok_rep & ~rep_dup).astype(jnp.int32)
+    distinct_before = jnp.cumsum(rep_new, axis=1) - rep_new  # exclusive
+    rank = jnp.take_along_axis(
+        distinct_before, lead_slot[:, None], axis=1)[:, 0]
 
-    def elect(cand, idx):
-        if mode == "claim":
-            claim = jnp.full((n_slots,), _BIG, dtype=jnp.int32)
-            claim = claim.at[jnp.where(cand, idx, n_slots)].min(
-                p_idx, mode="drop")
-            return cand & (claim[idx] == p_idx)
-        slot_key = jnp.where(cand, idx, n_slots)  # non-cands sort last
-        order = jnp.argsort(slot_key)              # stable (jnp default)
-        ss = slot_key[order]
-        first_of_run = jnp.concatenate(
-            [jnp.ones((1,), bool), ss[1:] != ss[:-1]])
-        return jnp.zeros(batch, bool).at[order].set(
-            first_of_run & (ss < n_slots))
+    # Way priority per bucket: free ways first (ascending way index —
+    # the order is immaterial, only distinctness is), then live ways
+    # oldest-time first (victims). time is non-negative (clock ticks),
+    # so the free-way sentinel sorts strictly below every live key.
+    # W is tiny and static: a counting rank over the [P, W, W] pairwise
+    # compare (position of each way in priority order, ties broken by
+    # way index) resolves every rank in ~6 fused elementwise ops —
+    # measured ~35% faster end-to-end than the previous W-round
+    # argmin-and-mask loop (4W sequential reductions), bit-identical.
+    way_pri = jnp.where(live, tw,
+                        -jnp.int32(1 << 30)
+                        + jnp.arange(ways, dtype=jnp.int32)[None, :])
+    wid = jnp.arange(ways, dtype=jnp.int32)
+    ahead = (way_pri[:, :, None] > way_pri[:, None, :]) | (
+        (way_pri[:, :, None] == way_pri[:, None, :])
+        & (wid[None, :, None] > wid[None, None, :]))
+    pos = jnp.sum(ahead, axis=2).astype(jnp.int32)         # [P, W] perm
+    way = jnp.argmax(pos == rank[:, None], axis=1).astype(jnp.int32)
+    pri_way = jnp.take_along_axis(way_pri, way[:, None], axis=1)[:, 0]
 
-    for p in range(probes):
-        idx = (h + p) & (n_slots - 1)
-        empty = ~live_at(idx)   # free, or expired (insert-time eviction)
-        cand = pending & empty
-        winner = elect(cand, idx)
+    # eviction classification without extra table gathers: the way's
+    # pre-insert priority is negative exactly for FREE ways (invalid or
+    # expired — vw, already in registers, splits those) and the live
+    # time otherwise (victim)
+    was_live = pri_way >= 0
+    was_valid = jnp.take_along_axis(vw, way[:, None], axis=1)[:, 0] == 1
+    evict_expired = winner & was_valid & ~was_live
+    evict_victim = winner & was_live
 
-        widx = jnp.where(winner, idx, n_slots)  # out-of-range = dropped
-        keys = tuple(
-            arr.at[widx].set(val, mode="drop") for arr, val in zip(keys, key_vals)
-        )
-        extras = tuple(
-            arr.at[widx].set(val, mode="drop") for arr, val in zip(extras, extra_vals)
-        )
-        valid = valid.at[widx].set(1, mode="drop")
-        time = time.at[widx].set(now, mode="drop")
-        # A pending packet whose key now occupies the slot is satisfied
-        # only if the stored payload is its own; otherwise a *different*
-        # flow in this same vector won the key (intra-batch reply-key
-        # collision) — flag it so the caller fails closed.
-        done_key = pending & key_at(idx)
-        pay_same = payload_at(idx)
-        done = done_key & pay_same
-        conflict = conflict | (done_key & ~pay_same)
-        inserted = inserted | done
-        pending = pending & ~done_key
-    return valid, time, keys, extras, inserted, conflict, pending
+    # one flat scatter round (flat 1D scatters measured ~25% cheaper
+    # than the 2D advanced-index form on CPU); refresh timestamps do
+    # NOT ride this scatter — they already landed in the pre-election
+    # refresh pass, and both passes write the same `now`, so repeating
+    # the refresh half would double the index set for no effect.
+    slot = jnp.where(winner, h * ways + way, n_buckets * ways)
+    keys = tuple(put(arr, val, slot) for arr, val in zip(keys, key_vals))
+    extras = tuple(
+        put(arr, val, slot) for arr, val in zip(extras, extra_vals))
+    valid = put(valid, jnp.ones((batch,), valid.dtype), slot)
+    time = put(time, jnp.broadcast_to(now, (batch,)).astype(time.dtype),
+               slot)
+
+    # followers inherit their leader's outcome (no table recheck: the
+    # leader's write IS their key's slot). Same payload as the leader
+    # => satisfied; different => intra-batch reply-key collision
+    # (conflict, fail closed).
+    # jax-ok: extra_vals is a Python tuple — payload arity is trace-time
+    # static (reflective table has none, NAT table has five)
+    if extra_vals:
+        emat = jnp.stack(
+            [v.astype(jnp.uint32) for v in extra_vals], axis=1)
+        f_pay = jnp.all(emat[leader] == emat, axis=1)
+    else:
+        f_pay = jnp.ones_like(follower)
+    conflict = conflict | (follower & ~f_pay)
+    inserted = inserted | winner | (follower & f_pay)
+    failed = pending & ~found
+    return (valid, time, keys, extras, inserted, conflict, failed,
+            evict_expired, evict_victim)
 
 
 def session_insert(
@@ -307,26 +520,27 @@ def session_insert(
     pkts: PacketVector,
     want: jnp.ndarray,
     now: jnp.ndarray,
-) -> Tuple[DataplaneTables, jnp.ndarray, jnp.ndarray]:
+) -> tuple:
     """Insert forward 5-tuples of ``want`` packets; returns
-    (tables, inserted, failed).
+    (tables, inserted, failed, evict_expired, evict_victim).
 
     Existing identical sessions are refreshed (timestamp), not
-    duplicated; expired entries are evicted in place. ``failed`` marks
-    packets whose whole probe window was live (congestion): the flow
-    retries on its next packet, and the caller counts the event
-    (StepStats.sess_insert_fail → Prometheus) instead of degrading
-    silently.
+    duplicated; expired ways are reclaimed in place and a full bucket
+    evicts its oldest entry (both counted by reason). ``failed`` marks
+    packets that lost the intra-batch way election to a different flow:
+    the flow retries on its next packet, and the caller counts the
+    event (StepStats.sess_insert_fail → Prometheus) instead of
+    degrading silently.
     """
-    n_slots = tables.sess_valid.shape[0]
     key_vals = (
         pkts.src_ip,
         pkts.dst_ip,
         _pack_ports(pkts.sport, pkts.dport),
         pkts.proto,
     )
-    h = _hash(*key_vals, n_slots)
-    valid, time, keys, _, inserted, _, failed = hashmap_insert(
+    h = _hash(*key_vals, tables.sess_valid.shape[0])
+    (valid, time, keys, _, inserted, _, failed,
+     ev_exp, ev_vic) = hashmap_insert(
         tables.sess_valid,
         tables.sess_time,
         (tables.sess_src, tables.sess_dst, tables.sess_ports, tables.sess_proto),
@@ -346,15 +560,153 @@ def session_insert(
         sess_valid=valid,
         sess_time=time,
     )
-    return new_tables, inserted, failed
+    return new_tables, inserted, failed, ev_exp, ev_vic
 
 
-def session_expire(tables: DataplaneTables, now: int, max_age: int) -> DataplaneTables:
-    """Host-driven aging of both session tables (reflective ACL + NAT):
-    invalidate entries idle longer than ``max_age``."""
+# --- amortized aging -------------------------------------------------
+
+
+def _sweep_one(valid: jnp.ndarray, time: jnp.ndarray, cursor: jnp.ndarray,
+               now, max_age, stride: int):
+    """Age ONE stride of buckets starting at ``cursor`` (a multiple of
+    the effective stride by construction: cursors start at 0 and only
+    advance by it, and power-of-two bucket counts divide evenly).
+    Returns (valid, next_cursor)."""
+    from jax import lax
+
+    n_buckets, _ways = valid.shape
+    # jax-ok: stride is the trace-time-static sess_sweep_stride knob (a
+    # Python int baked into the step-factory key), not a device value
+    s = min(int(stride), n_buckets)
+    v = lax.dynamic_slice(valid, (cursor, jnp.int32(0)),
+                          (s, valid.shape[1]))
+    t = lax.dynamic_slice(time, (cursor, jnp.int32(0)),
+                          (s, valid.shape[1]))
+    stale = (v == 1) & (now - t > max_age)
+    valid = lax.dynamic_update_slice(
+        valid, jnp.where(stale, 0, v), (cursor, jnp.int32(0)))
+    return valid, lax.rem(cursor + s, jnp.int32(n_buckets))
+
+
+def sweep_covered(steps: int, stride: int, tables,
+                  bucket_axis: int = 0, passes: int = 1) -> bool:
+    """True when ``steps`` fused steps — each running ``passes``
+    pipeline passes, each pass sweeping ``stride`` buckets per table —
+    have cycled the WHOLE ring of both session tables. The ONE copy of
+    the lazy-expire coverage math (Dataplane / ClusterDataplane /
+    MultiHostCluster all pace their bulk-pass skip on it; the cluster
+    planes sweep twice per step and stack node axes ahead of the
+    bucket axis). Coverage is paced by the LARGER bucket count —
+    natsess_slots may exceed sess_slots."""
+    if not stride:
+        return False
+    n_buckets = max(tables.sess_valid.shape[bucket_axis],
+                    tables.natsess_valid.shape[bucket_axis])
+    return steps * passes * stride >= n_buckets
+
+
+def session_sweep(tables: DataplaneTables, now, stride: int) -> DataplaneTables:
+    """Amortized on-device aging: clear idle-expired entries in ONE
+    stride of buckets per table (reflective + NAT) and advance the
+    sweep cursors. Runs INSIDE the fused pipeline step (graph.py
+    ``_finish_step``), so reclamation cost is O(stride·W) per step —
+    never a monolithic full-table pass — and a full cycle completes
+    every ``n_buckets / stride`` steps. Entries the sweep has not
+    reached yet are already invisible to lookups (in-kernel timeout)
+    and reclaimable by insert-time eviction; the sweep only returns
+    their ways to the free pool so occupancy reflects reality.
+    ``stride`` is trace-time static (0 disables)."""
+    # jax-ok: stride is the trace-time-static sess_sweep_stride knob —
+    # 0-disables is a compile-time specialization, not a tracer branch
+    if not stride:
+        return tables
+    sess_valid, sess_cur = _sweep_one(
+        tables.sess_valid, tables.sess_time, tables.sess_sweep_cursor,
+        now, tables.sess_max_age, stride)
+    nat_valid, nat_cur = _sweep_one(
+        tables.natsess_valid, tables.natsess_time,
+        tables.natsess_sweep_cursor, now, tables.sess_max_age, stride)
+    return tables._replace(
+        sess_valid=sess_valid, sess_sweep_cursor=sess_cur,
+        natsess_valid=nat_valid, natsess_sweep_cursor=nat_cur,
+    )
+
+
+def _session_expire_impl(tables: DataplaneTables, now, max_age) -> DataplaneTables:
     stale = (tables.sess_valid == 1) & (now - tables.sess_time > max_age)
-    nat_stale = (tables.natsess_valid == 1) & (now - tables.natsess_time > max_age)
+    nat_stale = (tables.natsess_valid == 1) & (
+        now - tables.natsess_time > max_age)
     return tables._replace(
         sess_valid=jnp.where(stale, 0, tables.sess_valid),
         natsess_valid=jnp.where(nat_stale, 0, tables.natsess_valid),
     )
+
+
+# On-demand BULK reclaim of both session tables. Steady-state aging is
+# the in-step session_sweep; this remains for explicit host-driven
+# reclamation (tests, `clear sessions`-grade ops, idle nodes where no
+# steps run to carry the sweep). Jitted: at 10M+ slots the eager form
+# dispatches a dozen whole-table ops — one fused program keeps the
+# bulk pass a single device call (now/max_age are traced scalars, so
+# differing values never retrace).
+session_expire = jax.jit(_session_expire_impl)
+
+
+# --- legacy linear-probe baseline (bench comparison ONLY) ------------
+
+
+def hashmap_insert_linear(
+    valid: jnp.ndarray,
+    time: jnp.ndarray,
+    keys: Tuple[jnp.ndarray, ...],
+    key_vals: Tuple[jnp.ndarray, ...],
+    h: jnp.ndarray,
+    want: jnp.ndarray,
+    now: jnp.ndarray,
+    probes: int = SESS_PROBES,
+    max_age=None,
+) -> tuple:
+    """The pre-rework open-addressing insert (linear probing, one
+    election + full scatter round PER PROBE), kept verbatim-in-spirit
+    as the ``sess_insert_ns_pkt`` old-vs-new bench baseline
+    (bench.py session_scale_bench). FLAT [n_slots] arrays. Not used by
+    the pipeline."""
+    n_slots = valid.shape[0]
+    keys = tuple(keys)
+
+    def live_at(idx):
+        l = valid[idx] == 1
+        if max_age is not None:
+            l = l & (now - time[idx] <= max_age)
+        return l
+
+    def key_at(idx):
+        same = live_at(idx)
+        for arr, val in zip(keys, key_vals):
+            same = same & (arr[idx] == val)
+        return same
+
+    exists = jnp.zeros_like(want)
+    exist_idx = jnp.zeros_like(h)
+    for p in range(probes):
+        idx = (h + p) & (n_slots - 1)
+        same = key_at(idx)
+        exist_idx = jnp.where(same & ~exists, idx, exist_idx)
+        exists = exists | same
+    refresh = want & exists
+    time = time.at[jnp.where(refresh, exist_idx, n_slots)].set(
+        now, mode="drop")
+    pending = want & ~exists
+    for p in range(probes):
+        idx = (h + p) & (n_slots - 1)
+        cand = pending & ~live_at(idx)
+        winner = _elect(cand, idx, n_slots)
+        widx = jnp.where(winner, idx, n_slots)
+        keys = tuple(
+            arr.at[widx].set(val, mode="drop")
+            for arr, val in zip(keys, key_vals)
+        )
+        valid = valid.at[widx].set(1, mode="drop")
+        time = time.at[widx].set(now, mode="drop")
+        pending = pending & ~key_at(idx)
+    return valid, time, keys, pending

@@ -45,7 +45,11 @@ from vpp_tpu.parallel.mesh import (
     table_specs,
 )
 from vpp_tpu.pipeline.dataplane import Dataplane
-from vpp_tpu.pipeline.graph import StepStats, pipeline_step
+from vpp_tpu.pipeline.graph import (
+    SWEEP_STRIDE_DEFAULT,
+    StepStats,
+    pipeline_step,
+)
 from vpp_tpu.pipeline.tables import (
     SESSION_FIELDS,
     DataplaneConfig,
@@ -155,7 +159,8 @@ def _pv_spec() -> PacketVector:
 
 
 def make_cluster_step_wire(mesh: Mesh, budget: int = 0,
-                           mxu: bool = False):
+                           mxu: bool = False,
+                           sweep_stride: int = SWEEP_STRIDE_DEFAULT):
     """The cluster step for REAL wire traffic: headers AND payload
     bytes cross the fabric. Signature: (tables, pkts, payload, now,
     uplink_if) → (ClusterStepResult, delivered_payload), where
@@ -174,11 +179,13 @@ def make_cluster_step_wire(mesh: Mesh, budget: int = 0,
     B·snap/node/step — the deployment sizes ``snap`` to its MTU.
     """
     return make_cluster_step(mesh, budget=budget, mxu=mxu,
-                             with_payload=True)
+                             with_payload=True,
+                             sweep_stride=sweep_stride)
 
 
 def make_cluster_step(mesh: Mesh, budget: int = 0, mxu: bool = False,
-                      with_payload: bool = False):
+                      with_payload: bool = False,
+                      sweep_stride: int = SWEEP_STRIDE_DEFAULT):
     """Build the jitted cluster step for ``mesh``.
 
     Signature: (tables, pkts, now, uplink_if) → ClusterStepResult, where
@@ -211,7 +218,8 @@ def make_cluster_step(mesh: Mesh, budget: int = 0, mxu: bool = False,
         B = budget if budget > 0 else n_pkts
 
         # Pass 1: the ingress node's full pipeline.
-        res1 = pipeline_step(t, p, now, acl_global_fn=global_fn)
+        res1 = pipeline_step(t, p, now, acl_global_fn=global_fn,
+                             sweep_stride=sweep_stride)
 
         # Fabric exchange: compact packets into per-destination budgeted
         # rows, swap rows across the node axis (each row rides a distinct
@@ -273,7 +281,8 @@ def make_cluster_step(mesh: Mesh, budget: int = 0, mxu: bool = False,
 
         # Pass 2: delivery at the destination node.
         res2 = pipeline_step(
-            res1.tables, flat, now, acl_global_fn=global_fn
+            res1.tables, flat, now, acl_global_fn=global_fn,
+            sweep_stride=sweep_stride,
         )
 
         stats = jax.tree.map(lambda a, b: a + b, res1.stats, res2.stats)
@@ -374,9 +383,19 @@ class ClusterDataplane:
         # wall-clock session time base (matches Dataplane semantics)
         self._t0 = _time.monotonic()
         self._now = 0
+        # cluster steps since the last expire_sessions (each step runs
+        # the in-step session sweep twice — both pipeline passes)
+        self._steps_since_expire = 0
         self._uplinks = None
-        self._step = make_cluster_step(mesh)
-        self._step_mxu = make_cluster_step(mesh, mxu=True)
+        # the config's amortized-aging stride rides every cluster step
+        # variant (trace-time static), same as the single-node path
+        self._sweep_stride = int(
+            getattr(self.config, "sess_sweep_stride",
+                    SWEEP_STRIDE_DEFAULT))
+        self._step = make_cluster_step(
+            mesh, sweep_stride=self._sweep_stride)
+        self._step_mxu = make_cluster_step(
+            mesh, mxu=True, sweep_stride=self._sweep_stride)
         # wire-traffic steps (headers + payload bytes through the
         # fabric), built lazily per mxu mode — the jit specializes per
         # payload shape itself; see step_wire()
@@ -421,18 +440,30 @@ class ClusterDataplane:
             host = {
                 k: np.stack([arrs[k] for arrs in per_node]) for k in per_node[0]
             }
+            shardings = self._shardings._asdict()
+            # Config fields re-ship per swap; SESSION state is carried
+            # over BY REFERENCE — the arrays already live sharded on
+            # the mesh, and a device_put round trip of a multi-hundred-
+            # MB table per epoch flip is exactly the re-upload the
+            # set-associative rework eliminates (docs/SESSIONS.md).
+            dev = {
+                k: jax.device_put(v, shardings[k]) for k, v in host.items()
+            }
             if self.tables is not None:
                 sess = {f: getattr(self.tables, f) for f in SESSION_FIELDS}
             else:
-                sess = zero_sessions(self.config, leading=(self.n_nodes,))
-            tables = DataplaneTables(**host, **sess)
+                zs = zero_sessions(self.config, leading=(self.n_nodes,))
+                sess = {
+                    f: jax.device_put(v, shardings[f])
+                    for f, v in zs.items()
+                }
             self._use_mxu = all(
                 n.builder.mxu_enabled and n.builder.glb_mxu.ok
                 for n in self.nodes
             ) and any(
                 n.builder.glb_nrules >= self.mxu_threshold for n in self.nodes
             )
-            self.tables = jax.device_put(tables, self._shardings)
+            self.tables = DataplaneTables(**dev, **sess)
             self._uplinks = jax.device_put(
                 np.array(
                     [
@@ -480,13 +511,19 @@ class ClusterDataplane:
         without sleeping — the Dataplane.advance_clock analog)."""
         self._t0 -= seconds
 
-    def expire_sessions(self, max_age: Optional[int] = None) -> int:
+    def expire_sessions(self, max_age: Optional[int] = None,
+                        lazy: bool = False) -> int:
         """Host-driven bulk aging of the node-stacked session tables
         (reflective + NAT), the Dataplane.expire_sessions analog: the
         in-kernel timeout already makes expired entries invisible and
         insert-time eviction reclaims their slots lazily — this frees
         slots in bulk so occupancy gauges reflect reality. Returns the
-        number of sessions expired across all nodes."""
+        number of sessions expired across all nodes.
+
+        ``lazy=True`` (the maintenance-loop form) skips the bulk pass
+        when the in-step amortized sweep has covered the whole table
+        since the last call (each cluster step sweeps BOTH pipeline
+        passes) — same contract as Dataplane.expire_sessions."""
         from vpp_tpu.ops.session import session_expire
 
         if max_age is None:
@@ -494,6 +531,19 @@ class ClusterDataplane:
         with self._lock:
             if self.tables is None:
                 return 0
+            # lazy is sound only for the CONFIGURED timeout: the
+            # in-step sweep enforces tables.sess_max_age, so a shorter
+            # caller-supplied max_age must still run the bulk pass
+            if lazy and max_age == self.config.sess_max_age:
+                steps = self._steps_since_expire
+                self._steps_since_expire = 0
+                from vpp_tpu.ops.session import sweep_covered
+
+                # node-stacked [N, n_buckets, W]; each cluster step
+                # sweeps BOTH pipeline passes
+                if sweep_covered(steps, self._sweep_stride, self.tables,
+                                 bucket_axis=1, passes=2):
+                    return 0
             self._now = max(self._now, self.clock_ticks())
             now = self._now
             before = self.tables
@@ -524,6 +574,7 @@ class ClusterDataplane:
                 now = self._now
             tables, uplinks = self.tables, self._uplinks
             step = self._step_mxu if self._use_mxu else self._step
+            self._steps_since_expire += 1
         result = step(tables, pkts, jnp.int32(now), uplinks)
         with self._lock:
             if tables is self.tables:
@@ -544,9 +595,12 @@ class ClusterDataplane:
                 now = self._now
             step = self._wire_steps.get(self._use_mxu)
             if step is None:
-                step = make_cluster_step_wire(self.mesh, mxu=self._use_mxu)
+                step = make_cluster_step_wire(
+                    self.mesh, mxu=self._use_mxu,
+                    sweep_stride=self._sweep_stride)
                 self._wire_steps[self._use_mxu] = step
             tables, uplinks = self.tables, self._uplinks
+            self._steps_since_expire += 1
         result, deliv_pay = step(
             tables, pkts, jnp.asarray(payload), jnp.int32(now), uplinks
         )

@@ -125,7 +125,21 @@ class MultiHostCluster:
         self._uplinks = None
         self.epoch = 0
         self._specs = table_specs()
-        self._step = make_cluster_step(self.mesh)
+        # the config's amortized-aging stride rides every fleet step
+        # variant (trace-time static), same as the single-node and
+        # ClusterDataplane paths
+        from vpp_tpu.pipeline.graph import SWEEP_STRIDE_DEFAULT
+
+        self._sweep_stride = int(
+            getattr(self.config, "sess_sweep_stride",
+                    SWEEP_STRIDE_DEFAULT))
+        # collective steps since the last bulk expire (each cluster
+        # step sweeps BOTH pipeline passes) — step calls are collective
+        # and the config is fleet-identical, so this counter advances
+        # identically on every process
+        self._steps_since_expire = 0
+        self._step = make_cluster_step(
+            self.mesh, sweep_stride=self._sweep_stride)
         self._step_mxu = None    # built on first mxu epoch
         self._wire_steps = {}    # mxu-mode -> jitted wire step
         self._use_mxu = False
@@ -270,8 +284,11 @@ class MultiHostCluster:
         step = self._step
         if self._use_mxu:
             if self._step_mxu is None:
-                self._step_mxu = make_cluster_step(self.mesh, mxu=True)
+                self._step_mxu = make_cluster_step(
+                    self.mesh, mxu=True,
+                    sweep_stride=self._sweep_stride)
             step = self._step_mxu
+        self._steps_since_expire += 1
         res = step(self.tables, pkts, jnp.int32(now), self._uplinks)
         self.tables = res.tables
         return res
@@ -287,8 +304,11 @@ class MultiHostCluster:
             raise RuntimeError("publish() first")
         step = self._wire_steps.get(self._use_mxu)
         if step is None:
-            step = make_cluster_step_wire(self.mesh, mxu=self._use_mxu)
+            step = make_cluster_step_wire(
+                self.mesh, mxu=self._use_mxu,
+                sweep_stride=self._sweep_stride)
             self._wire_steps[self._use_mxu] = step
+        self._steps_since_expire += 1
         result, deliv_pay = step(
             self.tables, pkts, jnp.asarray(payload), jnp.int32(now),
             self._uplinks)
@@ -296,17 +316,40 @@ class MultiHostCluster:
         return result, deliv_pay
 
     def expire_sessions(self, now: int,
-                        max_age: Optional[int] = None) -> None:
+                        max_age: Optional[int] = None,
+                        lazy: bool = False) -> None:
         """COLLECTIVE: bulk-age the global session tables (reflective +
-        NAT) — the ClusterDataplane.expire_sessions analog. In-kernel
-        timeouts already hide expired entries from lookups; this frees
-        slots in bulk. ``now`` must be the fleet-agreed tick."""
+        NAT) — the ClusterDataplane.expire_sessions analog. Steady-state
+        aging happens INSIDE the fused cluster step (the amortized
+        session sweep, ops/session.py); this bulk pass serves idle
+        epochs and explicit reclamation. ``now`` must be the
+        fleet-agreed tick.
+
+        ``lazy=True`` skips the bulk device pass only when the in-step
+        sweep has covered the whole table since the last call (steps x
+        2 strides >= buckets — each cluster step sweeps both pipeline
+        passes). The decision derives from the collective step counter
+        and the fleet-identical config, so every process skips or runs
+        the collective identically."""
         from vpp_tpu.ops.session import session_expire
 
         if self.tables is None:
             return
         if max_age is None:
             max_age = self.config.sess_max_age
+        # lazy is sound only for the CONFIGURED timeout (the in-step
+        # sweep enforces tables.sess_max_age); the equality check is
+        # fleet-deterministic like the rest of the decision
+        if lazy and max_age == self.config.sess_max_age:
+            steps = self._steps_since_expire
+            self._steps_since_expire = 0
+            from vpp_tpu.ops.session import sweep_covered
+
+            # node-stacked [N, n_buckets, W]; each cluster step sweeps
+            # BOTH pipeline passes
+            if sweep_covered(steps, self._sweep_stride, self.tables,
+                             bucket_axis=1, passes=2):
+                return
         self.tables = session_expire(self.tables, now, max_age)
 
     # --- host-local views of a step result ---
@@ -434,7 +477,16 @@ class LockstepDriver:
         if fleet_has_work or pending_commit:
             out = fabric_fn(self.ticks)
         if self.expire_every and self.ticks % self.expire_every == 0:
-            self.cluster.expire_sessions(now=self.ticks)
+            # lazy: the bulk collective is skipped only when the
+            # in-step amortized sweep has actually covered the whole
+            # ring since the last expire (coverage math inside
+            # expire_sessions — NOT a mere "did we step" flag, which
+            # would skip forever on a busy fleet sweeping a big table
+            # far slower than the expire cadence). The decision derives
+            # from the collective step counter + fleet-identical
+            # config, so no process can diverge on whether this
+            # collective happens.
+            self.cluster.expire_sessions(now=self.ticks, lazy=True)
         return out
 
 

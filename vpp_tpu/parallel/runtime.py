@@ -149,14 +149,15 @@ class MeshRuntime:
         # cluster-level session aging: the agents' own maintenance
         # loops call their NODE HANDLE's expire_sessions, a no-op when
         # the cluster owns the live tables — this loop is the mesh
-        # analog (bulk slot reclaim; in-kernel timeouts already hide
-        # expired entries from lookups either way)
+        # analog. lazy=True: a stepping mesh ages in-program (the
+        # amortized sweep rides every fused cluster step), so the bulk
+        # device pass only runs across idle stretches.
         self._maint_stop = threading.Event()
 
         def _maint(interval: float = 5.0) -> None:
             while not self._maint_stop.wait(interval):
                 try:
-                    self.cluster.expire_sessions()
+                    self.cluster.expire_sessions(lazy=True)
                 except Exception:
                     log.exception("cluster session expiry failed")
 

@@ -33,6 +33,7 @@ from vpp_tpu.ops.session import (
     session_batch_summary,
     session_insert,
     session_lookup_reverse_idx,
+    session_sweep,
     session_touch,
 )
 from vpp_tpu.pipeline.tables import DataplaneTables
@@ -71,6 +72,15 @@ class StepStats(NamedTuple):
     fastpath: jnp.ndarray      # int32 scalar: 1 when this step ran the
                                # classify-free established-flow kernel,
                                # 0 for the full chain
+    # set-associative insert reclamation (ops/session.py): ways
+    # reclaimed by an insert, split by reason — ``expired`` is the
+    # benign idle-timeout reclaim, ``victim`` means a FULL bucket
+    # evicted its oldest live session to admit a new flow (the true
+    # table-pressure signal)
+    sess_evict_expired: jnp.ndarray     # int32 scalar
+    sess_evict_victim: jnp.ndarray      # int32 scalar
+    natsess_evict_expired: jnp.ndarray  # int32 scalar
+    natsess_evict_victim: jnp.ndarray   # int32 scalar
 
 
 # Per-packet drop attribution (error-drop counter analog).
@@ -137,13 +147,22 @@ def _finish_step(
     sess_fail: jnp.ndarray,
     natsess_fail: jnp.ndarray,
     fastpath: jnp.ndarray,
+    sess_evict_expired: jnp.ndarray,
+    sess_evict_victim: jnp.ndarray,
+    natsess_evict_expired: jnp.ndarray,
+    natsess_evict_victim: jnp.ndarray,
+    sweep_stride: int = 0,
 ) -> StepResult:
     """Shared tail of both pipeline tiers: drop attribution, counters,
     StepStats and the StepResult assembly. The ONE copy of the
     accounting semantics — the fast kernel calls it with its statically
     empty NAT/insert masks (all-False vectors, which XLA folds), so an
     edit to drop_cause/occupancy/per-interface logic lands on both
-    tiers by construction."""
+    tiers by construction. Also the ONE place the amortized session
+    sweep runs (``sweep_stride`` buckets per table per step —
+    ops/session.py session_sweep), so aging rides EVERY tier of the
+    fused program identically."""
+    tables = session_sweep(tables, now, sweep_stride)
     n_ifaces = tables.if_type.shape[0]
     drop_no_route = alive & permit & ~fib.matched
     fib_dropped = alive & permit & fib.matched & (
@@ -195,6 +214,12 @@ def _finish_step(
         if_drops=zero_i.at[drop_if_safe].add(1, mode="drop"),
         sess_hits=jnp.sum(established.astype(jnp.int32)),
         fastpath=fastpath,
+        sess_evict_expired=jnp.sum(sess_evict_expired.astype(jnp.int32)),
+        sess_evict_victim=jnp.sum(sess_evict_victim.astype(jnp.int32)),
+        natsess_evict_expired=jnp.sum(
+            natsess_evict_expired.astype(jnp.int32)),
+        natsess_evict_victim=jnp.sum(
+            natsess_evict_victim.astype(jnp.int32)),
     )
     drop_cause = (
         jnp.where(pkts.valid & drop_ip4, DROP_IP4, 0)
@@ -218,12 +243,20 @@ def _finish_step(
     )
 
 
+
+# Buckets swept per table per fused step when the caller doesn't plumb
+# the DataplaneConfig knob (the cluster step, module-level jits, tests
+# calling pipeline_step directly).
+SWEEP_STRIDE_DEFAULT = 256
+
+
 def pipeline_step(
     tables: DataplaneTables,
     pkts: PacketVector,
     now: jnp.ndarray,
     acl_global_fn=acl_classify_global,
     acl_local_fn=acl_classify_local,
+    sweep_stride: int = SWEEP_STRIDE_DEFAULT,
 ) -> StepResult:
     """Process one packet vector through the full forwarding chain.
 
@@ -233,7 +266,9 @@ def pipeline_step(
     (vpp_tpu.parallel.cluster) without altering the chain;
     ``acl_local_fn`` swaps the per-interface classify the same way
     (the BV implementation, or the policy-free skip —
-    ``make_pipeline_step`` composes both).
+    ``make_pipeline_step`` composes both). ``sweep_stride`` buckets per
+    session table are aged inside the step (trace-time static —
+    ops/session.py session_sweep).
     """
     # --- ip4-input (+ unconfigured-interface drop) ---
     pkts, drop_ip4, alive = _ingress(tables, pkts)
@@ -289,11 +324,12 @@ def pipeline_step(
     # --- session install for newly permitted flows only (denied packets
     # must not consume session slots); keys are post-NAT so replies match ---
     want_sess = forwarded & ~established & nat_capable & ~nat_unsupported
-    tables, _, sess_fail = session_insert(tables, pkts, want_sess, now)
+    tables, _, sess_fail, sess_ev_exp, sess_ev_vic = session_insert(
+        tables, pkts, want_sess, now)
     nat_kind = (
         jnp.where(dnat_applied, 1, 0) + jnp.where(snat_applied, 2, 0)
     ).astype(jnp.int32)
-    tables, nat_conflict, natsess_fail = nat44_record(
+    tables, nat_conflict, natsess_fail, nat_ev_exp, nat_ev_vic = nat44_record(
         tables, pkts, orig_dst, orig_dport, orig_src, orig_sport, nat_kind,
         (dnat_applied | snat_applied) & forwarded, now,
     )
@@ -311,6 +347,9 @@ def pipeline_step(
         forwarded, disp, tx_if, established, nat_reversed, dnat_applied,
         snat_applied, dropped_nat, sess_fail, natsess_fail,
         fastpath=jnp.int32(0),
+        sess_evict_expired=sess_ev_exp, sess_evict_victim=sess_ev_vic,
+        natsess_evict_expired=nat_ev_exp, natsess_evict_victim=nat_ev_vic,
+        sweep_stride=sweep_stride,
     )
 
 
@@ -339,6 +378,7 @@ def _pipeline_fast_finish(
     sess_hit_idx: jnp.ndarray,
     nat_reversed: jnp.ndarray,
     nat_hit_idx: jnp.ndarray,
+    sweep_stride: int = SWEEP_STRIDE_DEFAULT,
 ) -> StepResult:
     """Tail of the classify-free kernel, from post-reverse headers on.
 
@@ -373,11 +413,15 @@ def _pipeline_fast_finish(
         forwarded, disp, tx_if, established, nat_reversed,
         dnat_applied=false_p, snat_applied=false_p, dropped_nat=false_p,
         sess_fail=false_p, natsess_fail=false_p, fastpath=jnp.int32(1),
+        sess_evict_expired=false_p, sess_evict_victim=false_p,
+        natsess_evict_expired=false_p, natsess_evict_victim=false_p,
+        sweep_stride=sweep_stride,
     )
 
 
 def pipeline_step_fast(
-    tables: DataplaneTables, pkts: PacketVector, now: jnp.ndarray
+    tables: DataplaneTables, pkts: PacketVector, now: jnp.ndarray,
+    sweep_stride: int = SWEEP_STRIDE_DEFAULT,
 ) -> StepResult:
     """The classify-free established-flow kernel, standalone:
     ip4-input → session lookup/touch → NAT reverse/touch → FIB → tx.
@@ -394,7 +438,7 @@ def pipeline_step_fast(
     pkts, nat_reversed, nat_hit_idx = nat44_reverse(tables, pkts, alive, now)
     return _pipeline_fast_finish(
         tables, pkts, now, alive, drop_ip4, established, sess_hit_idx,
-        nat_reversed, nat_hit_idx,
+        nat_reversed, nat_hit_idx, sweep_stride=sweep_stride,
     )
 
 
@@ -404,6 +448,7 @@ def pipeline_step_auto(
     now: jnp.ndarray,
     acl_global_fn=acl_classify_global,
     acl_local_fn=acl_classify_local,
+    sweep_stride: int = SWEEP_STRIDE_DEFAULT,
 ) -> StepResult:
     """Two-tier dispatch: the fast kernel when the whole batch rides
     established sessions, the full chain otherwise.
@@ -439,12 +484,12 @@ def pipeline_step_auto(
     def fast(_):
         return _pipeline_fast_finish(
             tables, rpkts, now, alive, drop_ip4, hits, sess_hit_idx,
-            nat_reversed, nat_hit_idx,
+            nat_reversed, nat_hit_idx, sweep_stride=sweep_stride,
         )
 
     def full(_):
         return pipeline_step(tables, orig_pkts, now, acl_global_fn,
-                             acl_local_fn)
+                             acl_local_fn, sweep_stride=sweep_stride)
 
     return lax.cond(ok, fast, full, None)
 
@@ -472,13 +517,17 @@ def _classifier_fns(impl: str):
 
 @functools.lru_cache(maxsize=None)
 def make_pipeline_step(impl: str = "dense", skip_local: bool = False,
-                       fast: bool = False):
+                       fast: bool = False,
+                       sweep_stride: int = SWEEP_STRIDE_DEFAULT):
     """Compose one pipeline-step callable from the epoch's gates:
     classifier implementation (dense | mxu | bv), the policy-free
-    local-classify skip, and the two-tier fast-path dispatch. The
+    local-classify skip, the two-tier fast-path dispatch, and the
+    session sweep stride (trace-time static — part of the memo key, so
+    two configs with different strides never share a program). The
     Dataplane builds (and jit-caches) its step variants exclusively
-    through here, so every (impl, skip, tier) combination shares ONE
-    chain definition — a pipeline edit can't diverge a variant.
+    through here, so every (impl, skip, tier, stride) combination
+    shares ONE chain definition — a pipeline edit can't diverge a
+    variant.
 
     Memoized: equal gates return the SAME function object, so jax's
     function-identity tracing/compilation caches are shared across
@@ -495,7 +544,7 @@ def make_pipeline_step(impl: str = "dense", skip_local: bool = False,
     def step(tables: DataplaneTables, pkts: PacketVector,
              now: jnp.ndarray) -> StepResult:
         return base(tables, pkts, now, acl_global_fn=acl_global_fn,
-                    acl_local_fn=acl_local_fn)
+                    acl_local_fn=acl_local_fn, sweep_stride=sweep_stride)
 
     step.__name__ = "pipeline_step_{}{}{}".format(
         impl, "_nolocal" if skip_local else "", "_auto" if fast else ""

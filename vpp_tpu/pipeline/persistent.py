@@ -55,8 +55,9 @@ class PersistentPump:
     inside the resident loop: an all-established frame takes the
     classify-free kernel — the latency-floor regime is exactly where
     steady-state return traffic lives, so the resident loop benefits
-    the most. Each delivered frame carries its [3] fast-path summary
-    (``[fastpath, rx, sess_hits]``) through the same ordered deliver
+    the most. Each delivered frame carries its [5] aux summary
+    (``[fastpath, rx, sess_hits, insert_fails, evictions]``) through
+    the same ordered deliver
     callback; ``result_ex()`` exposes it, ``result()`` drops it.
 
     ``classifier``/``skip_local`` mirror the owning Dataplane's epoch
@@ -68,7 +69,10 @@ class PersistentPump:
 
     def __init__(self, tables, batch: int, max_frames: int = 1 << 20,
                  fastpath: bool = True, classifier: str = "dense",
-                 skip_local: bool = False):
+                 skip_local: bool = False,
+                 sweep_stride: Optional[int] = None):
+        from vpp_tpu.pipeline.graph import SWEEP_STRIDE_DEFAULT
+
         self.batch = int(batch)
         self.fastpath_enabled = bool(fastpath)
         self._in: "queue.Queue" = queue.Queue()
@@ -78,7 +82,11 @@ class PersistentPump:
         self._thread: Optional[threading.Thread] = None
         self._max_frames = max_frames
         self._tables0 = tables
-        step_fn = make_pipeline_step(classifier, skip_local, fast=fastpath)
+        if sweep_stride is None:
+            sweep_stride = SWEEP_STRIDE_DEFAULT
+        step_fn = make_pipeline_step(classifier, skip_local,
+                                     fast=fastpath,
+                                     sweep_stride=sweep_stride)
         # aux always on: the plain chain reports fastpath=0, so the
         # deliver callback keeps ONE shape either way
         self._step = _packed_call(step_fn, with_aux=True)
@@ -173,8 +181,9 @@ class PersistentPump:
 
     def result_ex(self, timeout: Optional[float] = None):
         """Like result(), but returns ``(out, aux)`` where ``aux`` is
-        the frame's [3] int32 fast-path summary
-        ``[fastpath, rx, sess_hits]`` (the pump's regime telemetry)."""
+        the frame's [5] int32 summary
+        ``[fastpath, rx, sess_hits, insert_fails, evictions]`` (the
+        pump's regime + session-pressure telemetry)."""
         try:
             return self._out.get(timeout=timeout)
         except queue.Empty:

@@ -45,7 +45,23 @@ class DataplaneConfig(NamedTuple):
     max_global_rules: int = 128
     max_ifaces: int = 64
     fib_slots: int = 128
-    sess_slots: int = 4096     # reflective-session hash slots (power of 2)
+    # Reflective-session table: total slots (power of 2), organized as
+    # sess_slots/sess_ways buckets of sess_ways ways each (W-way
+    # set-associative — ops/session.py). Memory is ~6 uint32 columns x
+    # sess_slots (24 B/slot): 1<<24 slots ≈ 402 MB serves 10M+
+    # concurrent sessions at ~0.6 load factor (docs/SESSIONS.md).
+    sess_slots: int = 4096
+    # Ways per bucket (power of 2, divides sess_slots). 4 is the VPP/
+    # CPU-cache sweet spot: one bucket row gather fetches the whole
+    # associativity set.
+    sess_ways: int = 4
+    # NAT-session table slots; 0 = same as sess_slots (shares sess_ways)
+    natsess_slots: int = 0
+    # Amortized on-device aging: every fused pipeline step sweeps this
+    # many buckets per table (idle-expired entries are invalidated and
+    # the cursor advances; a full cycle takes n_buckets/stride steps).
+    # 0 disables the in-step sweep (bulk expire_sessions only).
+    sess_sweep_stride: int = 256
     # Session/NAT idle timeout in clock ticks (Dataplane.TICKS_PER_SEC =
     # 10/s, so 3000 = 300 s — VPP's default TCP established timeout
     # order). Enforced in-kernel: lookups ignore expired entries and
@@ -166,13 +182,15 @@ class DataplaneTables(NamedTuple):
                                 # applies (reference: configurator_impl.go
                                 # :258-264 SNAT pool for external traffic)
 
-    # --- reflective sessions (open-addressing hash) [S] ---
-    sess_src: jnp.ndarray       # uint32
-    sess_dst: jnp.ndarray       # uint32
-    sess_ports: jnp.ndarray     # uint32 (sport<<16 | dport)
-    sess_proto: jnp.ndarray     # int32
-    sess_valid: jnp.ndarray     # int32 bool
-    sess_time: jnp.ndarray      # int32 last-hit tick (aging)
+    # --- reflective sessions (W-way set-associative hash) [NB, W] ---
+    # The way count W is carried IN THE SHAPE (ops/session.py): one
+    # bucket-row gather fetches a flow's whole associativity set.
+    sess_src: jnp.ndarray       # uint32 [NB, W]
+    sess_dst: jnp.ndarray       # uint32 [NB, W]
+    sess_ports: jnp.ndarray     # uint32 [NB, W] (sport<<16 | dport)
+    sess_proto: jnp.ndarray     # int32 [NB, W]
+    sess_valid: jnp.ndarray     # int32 bool [NB, W]
+    sess_time: jnp.ndarray      # int32 [NB, W] last-hit tick (aging)
     sess_max_age: jnp.ndarray   # int32 scalar: idle timeout in ticks
 
     # --- NAT44 DNAT mappings [M] + backends [B] ---
@@ -190,7 +208,7 @@ class DataplaneTables(NamedTuple):
     natb_cumw: jnp.ndarray      # int32 [B] cumulative weight within mapping
     nat_snat_ip: jnp.ndarray    # uint32 scalar: SNAT address (node IP)
 
-    # --- NAT44 session table (reverse translation state) [NS] ---
+    # --- NAT44 session table (reverse translation state) [NNB, W] ---
     # key: the flow as the *reply* will present it,
     # (reply_src_ip, reply_dst_ip, reply_sport<<16|reply_dport, proto)
     natsess_a: jnp.ndarray          # uint32
@@ -205,14 +223,22 @@ class DataplaneTables(NamedTuple):
     natsess_sport: jnp.ndarray      # int32 original src port
     natsess_kind: jnp.ndarray       # int32 bitmask: 1=DNAT'd, 2=SNAT'd
 
+    # --- amortized aging cursors (ops/session.py session_sweep) ---
+    # next bucket each in-step sweep starts from; int32 scalars that
+    # ride the session-state carry-over so a swap never resets aging
+    sess_sweep_cursor: jnp.ndarray
+    natsess_sweep_cursor: jnp.ndarray
+
 
 def _mask_of(plen: int, bits: int = 32) -> int:
     return ((1 << bits) - 1) ^ ((1 << (bits - plen)) - 1) if plen else 0
 
 
 # Session-state fields of DataplaneTables (reflective ACL + NAT session
-# tables) with their dtypes — the single source for zero-initialization
-# and for epoch-swap carry-over.
+# tables + sweep cursors) with their dtypes — the single source for
+# zero-initialization and for epoch-swap carry-over. The shape KIND of
+# each field lives in _SESSION_SHAPE: "sess"/"natsess" are [NB, W]
+# bucket grids, "scalar" is the per-table sweep cursor.
 SESSION_FIELDS: Dict[str, type] = {
     "sess_src": np.uint32, "sess_dst": np.uint32, "sess_ports": np.uint32,
     "sess_proto": np.int32, "sess_valid": np.int32, "sess_time": np.int32,
@@ -221,14 +247,90 @@ SESSION_FIELDS: Dict[str, type] = {
     "natsess_time": np.int32, "natsess_orig_ip": np.uint32,
     "natsess_orig_port": np.int32, "natsess_src_ip": np.uint32,
     "natsess_sport": np.int32, "natsess_kind": np.int32,
+    "sess_sweep_cursor": np.int32, "natsess_sweep_cursor": np.int32,
 }
+
+_SESSION_SHAPE: Dict[str, str] = {
+    k: ("scalar" if k.endswith("_sweep_cursor")
+        else "natsess" if k.startswith("natsess_") else "sess")
+    for k in SESSION_FIELDS
+}
+
+
+def natsess_slots_of(config: DataplaneConfig) -> int:
+    """Effective NAT-session slot count (the knob's 0 default means
+    'same as sess_slots')."""
+    n = int(getattr(config, "natsess_slots", 0) or 0)
+    return n if n else config.sess_slots
+
+
+def session_shapes(config: DataplaneConfig) -> Dict[str, Tuple[int, ...]]:
+    """Per-field session-state shapes (no leading axes): the bucket
+    grid [slots/ways, ways] per table, () for the sweep cursors."""
+    w = int(getattr(config, "sess_ways", 4))
+    shapes = {
+        "sess": (config.sess_slots // w, w),
+        "natsess": (natsess_slots_of(config) // w, w),
+        "scalar": (),
+    }
+    return {k: shapes[_SESSION_SHAPE[k]] for k in SESSION_FIELDS}
 
 
 def zero_sessions(config: DataplaneConfig, leading: Tuple[int, ...] = ()) -> Dict[str, np.ndarray]:
     """Fresh (empty) session-state arrays, optionally with leading axes
     (the cluster data plane stacks per-node session tables)."""
-    shape = leading + (config.sess_slots,)
-    return {k: np.zeros(shape, dt) for k, dt in SESSION_FIELDS.items()}
+    shapes = session_shapes(config)
+    return {k: np.zeros(leading + shapes[k], dt)
+            for k, dt in SESSION_FIELDS.items()}
+
+
+def zero_sessions_device(config: DataplaneConfig) -> Dict[str, jnp.ndarray]:
+    """Device-resident fresh session state: ``jnp.zeros`` fills on the
+    accelerator instead of shipping host zero buffers — at the 10M-slot
+    regime the session columns are hundreds of MB, and uploading zeros
+    over a remote transport (the axon tunnel) is pure waste."""
+    shapes = session_shapes(config)
+    return {k: jnp.zeros(shapes[k], dt)
+            for k, dt in SESSION_FIELDS.items()}
+
+
+def _is_pow2(n: int) -> bool:
+    return n > 0 and (n & (n - 1)) == 0
+
+
+def validate_dataplane_config(config: DataplaneConfig) -> None:
+    """Fail FAST (and intelligibly) on session-table misconfiguration.
+    The hash kernels mask with ``& (n_buckets - 1)`` and the sweep
+    relies on power-of-two divisibility, so a bad knob that once
+    surfaced as a shape error deep inside a jit trace is rejected at
+    config load instead. Called from TableBuilder (every dataplane) and
+    cmd/config.py (YAML load)."""
+    c = config
+    ways = int(getattr(c, "sess_ways", 4))
+    stride = int(getattr(c, "sess_sweep_stride", 256))
+    if not _is_pow2(c.sess_slots):
+        raise ValueError(
+            f"dataplane.sess_slots must be a power of two, got "
+            f"{c.sess_slots}")
+    if not _is_pow2(ways):
+        raise ValueError(
+            f"dataplane.sess_ways must be a power of two, got {ways}")
+    if ways > c.sess_slots:
+        raise ValueError(
+            f"dataplane.sess_ways ({ways}) exceeds sess_slots "
+            f"({c.sess_slots})")
+    nns = int(getattr(c, "natsess_slots", 0) or 0)
+    if nns and not _is_pow2(nns):
+        raise ValueError(
+            f"dataplane.natsess_slots must be a power of two (or 0 = "
+            f"sess_slots), got {nns}")
+    if nns and ways > nns:
+        raise ValueError(
+            f"dataplane.sess_ways ({ways}) exceeds natsess_slots ({nns})")
+    if stride < 0 or (stride and not _is_pow2(stride)):
+        raise ValueError(
+            f"dataplane.sess_sweep_stride must be 0 (disabled) or a "
+            f"power of two, got {stride}")
 
 
 def pack_rules(rules: Sequence[ContivRule], max_rules: int) -> Dict[str, np.ndarray]:
@@ -480,6 +582,7 @@ class TableBuilder:
     """
 
     def __init__(self, config: DataplaneConfig = DataplaneConfig()):
+        validate_dataplane_config(config)
         self.config = config
         self.mxu_enabled = True  # opt-out knob for the bit-plane compile
         # api-trace analog (pipeline/txn.py): with recording started,
@@ -983,11 +1086,13 @@ class TableBuilder:
         swap again afterwards: donation invalidates the cached buffers
         the next swap would reuse."""
         if sessions is not None:
+            # carry-over is BY REFERENCE: the live device arrays flow
+            # into the new epoch untouched — at 10M slots the session
+            # state is ~100s of MB and must never re-ship on a swap
             sess = {f: getattr(sessions, f) for f in SESSION_FIELDS}
         else:
-            sess = {
-                k: jnp.asarray(v) for k, v in zero_sessions(self.config).items()
-            }
+            # device-side zero fill, not a host upload of zeros
+            sess = zero_sessions_device(self.config)
         host_np = self.host_arrays()
         host = {}
         glb_full = False

@@ -71,6 +71,14 @@ PUMP_STAT_GAUGES = (
     ("fastpath_batches", "vpp_tpu_pump_fastpath_batches",
      "pump dispatches fully served by the classify-free "
      "established-flow kernel (chain folds count once)"),
+    # session-table pressure (aux rows 3/4 of the packed boundary):
+    # the set-associative table's congestion signals under packed IO
+    ("sess_insert_fails", "vpp_tpu_pump_sess_insert_fails",
+     "session inserts that lost the intra-batch way election "
+     "(reflective + NAT tables; retried on the flow's next packet)"),
+    ("sess_evictions", "vpp_tpu_pump_sess_evictions",
+     "session ways reclaimed by insert-time eviction "
+     "(expired + victim, both tables)"),
 )
 
 # pump.stats stage-seconds key -> `stage` label of the
@@ -172,6 +180,22 @@ STEPSTATS_FAMILIES = {
     "if_drops": "vpp_tpu_if_drop_packets",
     "sess_hits": "vpp_tpu_pipeline_sess_hits",
     "fastpath": "vpp_tpu_pipeline_fastpath_steps",
+    # set-associative session-table reclamation (ops/session.py): all
+    # four feed ONE labelled counter family,
+    # vpp_tpu_session_evictions_total{table=,reason=}
+    "sess_evict_expired": "vpp_tpu_session_evictions_total",
+    "sess_evict_victim": "vpp_tpu_session_evictions_total",
+    "natsess_evict_expired": "vpp_tpu_session_evictions_total",
+    "natsess_evict_victim": "vpp_tpu_session_evictions_total",
+}
+
+# StepStats eviction field → its (table, reason) label pair on the
+# vpp_tpu_session_evictions_total family.
+EVICTION_LABELS = {
+    "sess_evict_expired": ("sess", "expired"),
+    "sess_evict_victim": ("sess", "victim"),
+    "natsess_evict_expired": ("natsess", "expired"),
+    "natsess_evict_victim": ("natsess", "victim"),
 }
 
 
@@ -199,7 +223,10 @@ class StatsCollector:
                            "drop_no_route", "punt", "drop_nat",
                            "sess_insert_fail", "natsess_insert_fail",
                            "dnat", "snat", "nat_reversed",
-                           "sess_hits", "fastpath")
+                           "sess_hits", "fastpath",
+                           "sess_evict_expired", "sess_evict_victim",
+                           "natsess_evict_expired",
+                           "natsess_evict_victim")
         }
         # gauges, not counters: last-step snapshots
         self._last: Dict[str, int] = {
@@ -263,6 +290,29 @@ class StatsCollector:
             Gauge("vpp_tpu_acl_classifier",
                   "selected global ACL classifier implementation "
                   "(info-style: impl label, 1 = active)"),
+        )
+        # set-associative session-table pressure (ISSUE 6): the insert
+        # failure and eviction counters the operator watches to size
+        # sess_slots/sess_ways. ``..._insert_failed_total`` carries the
+        # true-congestion signal per table; ``..._evictions_total``
+        # splits reclamation by {table, reason=expired|victim} — a
+        # rising victim rate means live sessions are being pushed out
+        # (grow the table), a rising expired rate is benign idle churn.
+        self.sess_insert_failed_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_session_insert_failed_total",
+                  "session inserts that found no slot this batch "
+                  "(intra-batch way-election loss; the flow retries "
+                  "on its next packet), by table",
+                  kind="counter"),
+        )
+        self.sess_evictions_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_session_evictions_total",
+                  "session ways reclaimed by insert-time eviction, "
+                  "by table and reason (expired = idle timeout, "
+                  "victim = full bucket evicted its oldest entry)",
+                  kind="counter"),
         )
         # runtime jit-compile guard (pipeline/dataplane.py _JIT_COMPILES,
         # ISSUE 5): XLA traces per step variant, labelled step=. The
@@ -400,6 +450,13 @@ class StatsCollector:
             totals["sess_hits"])
         self.node_gauges["vpp_tpu_pipeline_fastpath_steps"].set(
             totals["fastpath"])
+        self.sess_insert_failed_gauge.set(
+            totals["sess_insert_fail"], table="sess")
+        self.sess_insert_failed_gauge.set(
+            totals["natsess_insert_fail"], table="natsess")
+        for field, (table, reason) in EVICTION_LABELS.items():
+            self.sess_evictions_gauge.set(
+                totals[field], table=table, reason=reason)
         with self._lock:
             last = dict(self._last)
         self.node_gauges["vpp_tpu_node_sess_occupancy"].set(
@@ -407,8 +464,13 @@ class StatsCollector:
         self.node_gauges["vpp_tpu_node_natsess_occupancy"].set(
             last["natsess_occupancy"])
         if self.dp.tables is not None:
+            import jax.numpy as jnp
+
+            # reduce ON device: sess_valid is [n_buckets, W] and ~67 MB
+            # at the 10M-slot config — a periodic scrape must fetch one
+            # scalar, not the column (cli.py show_sessions rationale)
             self.node_gauges["vpp_tpu_node_sessions_active"].set(
-                int(np.asarray(self.dp.tables.sess_valid).sum())
+                int(jnp.sum(self.dp.tables.sess_valid))
             )
         impl = getattr(self.dp, "classifier_impl", "dense")
         for name in CLASSIFIER_IMPLS:
