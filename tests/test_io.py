@@ -363,7 +363,13 @@ class TestPersistentPumpMode:
                 assert (sports == 30000 + k).all()  # submission order
                 assert (tx_ifs == b).all()
             assert pump.stats["frames"] == n_frames
-            assert pump.stats["batches"] == n_frames  # one frame, one pass
+            # the device-ring pump COMPACTS small frames into shared
+            # VEC-packet descriptor slots (ISSUE 7 header compaction),
+            # so batches counts coalesce groups, not frames — and the
+            # steady state made zero host callbacks
+            assert 1 <= pump.stats["batches"] <= n_frames
+            assert pump.stats["io_callbacks"] == 0
+            assert pump.stats["ring_windows"] >= 1
         finally:
             assert pump.stop()
             rings.close()

@@ -228,7 +228,11 @@ def test_pump_counters_exported_over_prometheus():
                  "inflight": 5, "inflight_peak": 8,
                  "chain_batches": 4, "chain_k_peak": 2,
                  "t_pack": 0.25, "t_dispatch": 1.5,
-                 "t_fetch_wait": 12.75, "t_fetch": 0.5, "t_write": 2.0}
+                 "t_fetch_wait": 12.75, "t_fetch": 0.5, "t_write": 2.0,
+                 "drops_tx_stall": 9, "drops_shutdown": 3,
+                 "drops_rx_full": 0, "drops_error": 2,
+                 "ring_windows": 6, "ring_frames": 11,
+                 "ring_inflight": 1, "ring_lag": 2, "io_callbacks": 0}
 
         @staticmethod
         def latency_us():
@@ -258,6 +262,55 @@ def test_pump_counters_exported_over_prometheus():
     assert 'vpp_tpu_pump_stage_seconds{stage="fetch_wait"} 12.75' in text
     assert 'vpp_tpu_pump_stage_seconds{stage="fetch"} 0.5' in text
     assert 'vpp_tpu_pump_stage_seconds{stage="write"} 2' in text
+    # device-ring telemetry + drop-cause attribution (ISSUE 7): the
+    # io_callback-free steady state and the r5 goodput loss split are
+    # exported, not inferred
+    assert "vpp_tpu_pump_ring_windows 6" in text
+    assert "vpp_tpu_pump_ring_frames 11" in text
+    assert "vpp_tpu_pump_ring_inflight 1" in text
+    assert "vpp_tpu_pump_ring_writeback_lag 2" in text
+    assert "vpp_tpu_pump_io_callbacks 0" in text
+    assert "# TYPE vpp_tpu_pump_drops_total counter" in text
+    assert 'vpp_tpu_pump_drops_total{reason="tx_stall"} 9' in text
+    assert 'vpp_tpu_pump_drops_total{reason="shutdown"} 3' in text
+    assert 'vpp_tpu_pump_drops_total{reason="rx_full"} 0' in text
+    assert 'vpp_tpu_pump_drops_total{reason="error"} 2' in text
+
+
+def test_pump_drops_rx_full_merges_daemon_stats():
+    """The rx_full drop cause is counted where it happens — the IO
+    daemon's rx thread — and folded into the same
+    vpp_tpu_pump_drops_total family via set_io_daemon()."""
+    from vpp_tpu.pipeline.dataplane import Dataplane
+    from vpp_tpu.pipeline.tables import DataplaneConfig
+    from vpp_tpu.stats.collector import StatsCollector
+
+    class FakePump:
+        stats = {"drops_rx_full": 0, "drops_tx_stall": 1,
+                 "drops_shutdown": 0}
+
+        @staticmethod
+        def latency_us():
+            return {"p50": 0.0, "p99": 0.0, "n": 0}
+
+    dp = Dataplane(DataplaneConfig(
+        max_tables=2, max_rules=8, max_global_rules=8, max_ifaces=8,
+        fib_slots=16, sess_slots=64, nat_mappings=2, nat_backends=4))
+    coll = StatsCollector(dp)
+    coll.set_pump(FakePump())
+    coll.set_io_daemon(lambda: {"drops_rx_full": 41})
+    coll.publish()
+    text = coll.registry.render("/stats")
+    assert 'vpp_tpu_pump_drops_total{reason="rx_full"} 41' in text
+    assert 'vpp_tpu_pump_drops_total{reason="tx_stall"} 1' in text
+    # mesh mode: set_io_daemon WITHOUT set_pump (the pump is attached
+    # to one designated collector cluster-wide) — daemon rx overflow
+    # must still export, not be fetched and discarded
+    coll2 = StatsCollector(dp, registry=None)
+    coll2.set_io_daemon(lambda: {"drops_rx_full": 7})
+    coll2.publish()
+    text2 = coll2.registry.render("/stats")
+    assert 'vpp_tpu_pump_drops_total{reason="rx_full"} 7' in text2
 
 
 def test_pump_stage_gauges_absent_keys_degrade_to_zero():

@@ -42,9 +42,6 @@ JIT_SITES = {
     ("vpp_tpu/pipeline/tables.py", "_glb_update_fn"):
         "incremental glb-blob upload kernel; memoized per (w_r, w_c, "
         "planes) block geometry",
-    ("vpp_tpu/pipeline/persistent.py", "PersistentPump.__init__"):
-        "the resident io_callback loop; one compile per pump instance "
-        "by design (long-lived singleton per process)",
     ("vpp_tpu/parallel/cluster.py", "make_cluster_step"):
         "the SPMD cluster step (shard_map over the node mesh); built "
         "once per mesh by ClusterDataplane",
@@ -71,6 +68,12 @@ TRACED_ROOTS = {
     # the packed/chained IO boundary wrappers: jax.jit(_packed_call(fn))
     ("vpp_tpu/pipeline/dataplane.py", "_packed_call.run"),
     ("vpp_tpu/pipeline/dataplane.py", "_chained_call.run"),
+    # the device-ring window program (ISSUE 7): jax.jit(_ring_call(fn,
+    # slots)) through _jitted_step — the persistent pump's steady
+    # state; the old per-instance PersistentPump.__init__ jit site is
+    # GONE (the ring form rides the process-wide step cache, so an
+    # epoch-swap pump restart recompiles nothing)
+    ("vpp_tpu/pipeline/dataplane.py", "_ring_call.run"),
     # classifier implementations reach jit through _classifier_fns /
     # time_classifier's subscripted call — enumerate them explicitly
     ("vpp_tpu/ops/acl.py", "acl_classify_global"),

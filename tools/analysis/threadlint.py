@@ -1,9 +1,11 @@
 """The ``--threads`` lock-discipline pass.
 
 Scope: the concurrent control-plane and pump modules (io/pump.py,
-io/cluster_pump.py, kvstore/, stats/, trace/, pipeline/txn.py — the
-files where the agent's threads, the pump's fetch workers and the
-kvstore's replication threads meet shared state).
+io/cluster_pump.py, io/rings.py, io/daemon.py, kvstore/, stats/,
+trace/, pipeline/txn.py, pipeline/persistent.py — the files where the
+agent's threads, the pump's fetch workers, the device-ring
+stager/fetcher pair and the kvstore's replication threads meet shared
+state).
 
 Rules (docs/STATIC_ANALYSIS.md catalog):
 
@@ -39,10 +41,16 @@ from analysis.common import Finding, iter_source_files, parse_suppressions
 THREAD_ROOTS = (
     "vpp_tpu/io/pump.py",
     "vpp_tpu/io/cluster_pump.py",
+    # ISSUE 7: the device-ring staging half (DeviceDescRing's cyclic
+    # acquire/release races the stager against the fetcher) and the
+    # IO daemon's rx/tx threads
+    "vpp_tpu/io/rings.py",
+    "vpp_tpu/io/daemon.py",
     "vpp_tpu/kvstore",
     "vpp_tpu/stats",
     "vpp_tpu/trace",
     "vpp_tpu/pipeline/txn.py",
+    "vpp_tpu/pipeline/persistent.py",
 )
 
 LOCK_CTORS = {"Lock", "RLock", "Condition"}

@@ -644,6 +644,36 @@ class DebugCLI:
                     f"{s.get('chain_batches', 0)} "
                     f"(max K {s.get('chain_k_peak', 0)})"
                 )
+            if mode == "persistent":
+                # device-resident descriptor rings (ISSUE 7): all
+                # HOST-side scalars — occupancy/lag/fill are counted
+                # where the windows are staged, so nothing crosses the
+                # device transport for this page (the PR 6 rule)
+                slots = getattr(self.pump, "ring_slots", 0)
+                windows = getattr(self.pump, "ring_windows", 0)
+                shipped = int(s.get("ring_windows", 0))
+                rframes = int(s.get("ring_frames", 0))
+                fill = (100.0 * rframes / (shipped * slots)
+                        if shipped and slots else 0.0)
+                lines.append(
+                    f"pump device-ring: {slots} slots x {windows} "
+                    f"windows, {shipped} windows shipped "
+                    f"({rframes} frames, fill {fill:.0f}%), "
+                    f"in-flight {s.get('ring_inflight', 0)}/{windows}, "
+                    f"tx-writeback lag {s.get('ring_lag', 0)}, "
+                    f"io-callbacks {s.get('io_callbacks', 0)}"
+                )
+            drops = {k: int(s.get(k, 0)) for k in
+                     ("drops_rx_full", "drops_tx_stall",
+                      "drops_shutdown", "drops_error")}
+            if any(drops.values()):
+                lines.append(
+                    "pump drops by cause (pkts): "
+                    f"rx-full {drops['drops_rx_full']}, "
+                    f"tx-stall {drops['drops_tx_stall']}, "
+                    f"shutdown {drops['drops_shutdown']}, "
+                    f"error {drops['drops_error']}"
+                )
             if "t_pack" in s:
                 # stage seconds: fetch_wait is overlapped wait (the
                 # ladder hiding the device round trip), fetch the
@@ -689,12 +719,14 @@ class DebugCLI:
                 ifs = self.io_ctl.list_interfaces()
                 lines.append(
                     "io-daemon: rx {rx_frames}f/{rx_pkts}p "
-                    "(ring-full {rx_ring_full}), tx {tx_frames}f/"
+                    "(ring-full {rx_ring_full}, rx-full drops "
+                    "{drops_rx_full}p), tx {tx_frames}f/"
                     "{tx_pkts}p, drops {tx_drops}, punts {tx_punts}, "
                     "trunc {trunc_drops}, vxlan {vxlan_encap}e/"
                     "{vxlan_decap}d".format(
                         **{k: d.get(k, "?") for k in (
                             "rx_frames", "rx_pkts", "rx_ring_full",
+                            "drops_rx_full",
                             "tx_frames", "tx_pkts", "tx_drops",
                             "tx_punts", "trunc_drops", "vxlan_encap",
                             "vxlan_decap")}

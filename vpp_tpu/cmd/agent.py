@@ -294,6 +294,8 @@ class ContivAgent:
                 fetch_workers=c.io.fetch_workers,
                 chain_k=c.io.chain_k,
                 mode=c.io.pump_mode,
+                ring_slots=c.io.io_ring_slots,
+                ring_windows=c.io.io_ring_windows,
                 # ICMP errors (time-exceeded/unreachable) originate from
                 # the node's pod gateway address — the hop traceroute
                 # shows (reference: VPP ip4-icmp-error)
@@ -319,6 +321,18 @@ class ContivAgent:
             # agent would overcount by n_nodes, so the MeshRuntime
             # attaches it to one designated collector instead.
             self.stats.set_pump(self.io_pump)
+        if self.io_ctl is not None:
+            # the rx_full drop cause is counted in the IO daemon (a
+            # separate process): feed its stats over the control
+            # socket so vpp_tpu_pump_drops_total{reason="rx_full"}
+            # reports real overflow, not a structural 0. A dedicated
+            # SHORT-timeout client: the scrape path must not inherit
+            # the control client's 10 s budget when the daemon wedges
+            # (the collector additionally caches + backs off).
+            from vpp_tpu.io.control import IOControlClient as _IoCtl
+
+            self.stats.set_io_daemon(
+                _IoCtl(c.io.control_socket, timeout=0.5).stats)
         if self.host_interconnect is not None:
             # vpp-tpu-init only STARTS the IO daemon after it sees the
             # plan file written above, so on a cold boot the control

@@ -48,11 +48,25 @@ class IOConfig:
     # 0 disables; values round down to a power of two.
     chain_k: int = 4
     # "dispatch" (pipelined ladder, peak throughput) or "persistent"
-    # (ONE resident device loop fed through io_callbacks — the
-    # latency-floor regime; docs/LATENCY.md lever #2). Persistent mode
-    # disables ICMP error generation (side programs park behind the
-    # resident loop).
+    # (device-resident descriptor rings: the host ships whole windows
+    # of compacted 20 B/pkt descriptors with one transfer each and the
+    # device while_loop drains them without any io_callback — the
+    # latency-floor regime; docs/IO_PATH.md + docs/LATENCY.md lever
+    # #2/#7). Persistent mode disables ICMP error generation (side
+    # programs would serialize behind the ring windows).
     pump_mode: str = "dispatch"
+    # Persistent-mode device-ring geometry (io/rings.py DeviceDescRing;
+    # both are CONFIG-STATIC SHAPE — part of the window program's
+    # jit-cache key like dataplane.sess_ways, validated powers of two):
+    #   io_ring_slots    frames (VEC-packet descriptor slots) per ring
+    #                    window — one host↔device exchange serves this
+    #                    many frames, so it divides the per-frame
+    #                    dispatch/fetch overhead by io_ring_slots
+    #   io_ring_windows  staging windows cycled in ring order (>= 2:
+    #                    the double buffer that overlaps window N's tx
+    #                    writeback with window N+1's rx refill)
+    io_ring_slots: int = 8
+    io_ring_windows: int = 2
     # node uplink (vpp-tpu-init bootstrap; reference contiv-init
     # vppcfg.go:74-559): kernel NIC the IO daemon binds as the uplink
     uplink_interface: str = ""
@@ -185,6 +199,15 @@ class AgentConfig:
             "io", IOConfig,
             {f.name for f in dataclasses.fields(IOConfig)},
         )
+        if "io" in d:
+            # fail at LOAD, not at the first persistent-mode pump
+            # launch (io/rings.py; the validate_dataplane_config
+            # pattern) — and diagnose the bad value even when
+            # pump_mode is "dispatch" and the rings never build
+            from vpp_tpu.io.rings import validate_ring_geometry
+
+            validate_ring_geometry(d["io"].io_ring_slots,
+                                   d["io"].io_ring_windows)
         build_section(
             "mesh", MeshConfig,
             {f.name for f in dataclasses.fields(MeshConfig)},

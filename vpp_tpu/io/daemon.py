@@ -67,6 +67,10 @@ class IODaemon:
         self.stats = {
             "rx_frames": 0, "rx_pkts": 0, "rx_ring_full": 0,
             "rx_ring_waits": 0,
+            # rx-ring overflow drops in PACKETS (rx_ring_full counts
+            # frames): the rx_full cause of the pump drop accounting
+            # (ISSUE 7 satellite — vpp_tpu_pump_drops_total{reason=})
+            "drops_rx_full": 0,
             "tx_frames": 0, "tx_pkts": 0, "tx_drops": 0, "tx_punts": 0,
             "trunc_drops": 0, "vxlan_encap": 0, "vxlan_decap": 0,
         }
@@ -211,6 +215,7 @@ class IODaemon:
                 self.stats["rx_pkts"] += n
             else:
                 self.stats["rx_ring_full"] += 1
+                self.stats["drops_rx_full"] += n
 
     def _ingest_scratch(self, if_idx: int, n: int) -> None:
         """Batch-received frames already sit in scratch rows: decap
@@ -227,6 +232,7 @@ class IODaemon:
             self.stats["rx_pkts"] += n
         else:
             self.stats["rx_ring_full"] += 1
+            self.stats["drops_rx_full"] += n
 
     def _rx_push(self, cols, n: int) -> bool:
         """Push one parsed frame, backpressuring briefly on a full

@@ -42,30 +42,40 @@ Non-IPv4 frames bypass classification and are punted to the host
 disposition (the STN punt analog for un-parseable traffic, reference
 plugins/contiv/pod.go:375-381).
 
-``mode="persistent"`` (docs/LATENCY.md lever #2; VERDICT r4 Next #2)
-replaces the dispatch/fetch legs with ONE resident device program
-(pipeline/persistent.PersistentPump): a jitted ``lax.while_loop`` stays
-on the device and exchanges frames through ordered io_callbacks, so the
-per-frame PJRT dispatch + result-fetch round trips — the dominant cost
-on an attached transport — are paid once at loop start instead of per
-batch. The refill stage keeps up to ``max_inflight`` frames queued at
-the loop's host_fetch callback (the same overlap discipline as the
-dispatch ladder: the device must never idle waiting for the host to
-pack the next frame), and shutdown is race-free: the collector only
-exits once the dispatcher has signalled done AND the hand-off queue is
-drained, so a frame submitted during stop() still reaches the tx
-writer (VERDICT r5 Next #2 / ADVICE r5). The VPP analog is the eternal
-worker dispatch loop: the graph scheduler never re-launches per frame
-(reference docs/VPP_PACKET_TRACING_K8S.md:28-50). Trades:
+``mode="persistent"`` (docs/LATENCY.md lever #2, reworked by the
+ISSUE 7 device-ring tentpole) serves the latency-floor regime through
+device-resident descriptor rings (pipeline/persistent.PersistentPump +
+io/rings.py DeviceDescRing): the dispatch loop COMPACTS pending frames
+into VEC-packet descriptor slots (several small frames share one slot
+at sequential offsets — the 20 B/pkt budget end-to-end, where the r6
+loop shipped a full VEC descriptor per 4-packet veth frame), the ring
+stager ships whole windows of slots with ONE transfer each, a device
+``lax.while_loop`` drains the window against its rx cursor, and the
+tx descriptors ride back in the window's ONE result fetch — zero
+io_callbacks in steady state, vs the r6 loop's two ordered blocking
+callbacks per frame. Double-buffered windows overlap window N's
+writeback with window N+1's refill, so the device never idles between
+windows; the refill stage keeps up to ``max_inflight`` slots queued at
+the stager. Shutdown is race-free: the collector only exits once the
+dispatcher has signalled done AND the hand-off queue is drained, so a
+frame submitted during stop() still reaches the tx writer (ADVICE
+r5); frames abandoned mid-flight by stop() are counted as
+``drops_shutdown``, tx-ring-full discards as ``drops_tx_stall``,
+batches whose device result never came back (loop death, fetch
+failure, timeout) as ``drops_error`` (daemon rx overflow is
+``drops_rx_full`` on its side) — the
+``vpp_tpu_pump_drops_total{reason=}`` attribution the r5 goodput
+number lacked. The VPP analog is the eternal worker dispatch loop:
+the graph scheduler never re-launches per frame (reference
+docs/VPP_PACKET_TRACING_K8S.md:28-50). Trades:
 
-  * frames process one VEC-frame at a time in submission order — the
-    latency-floor regime, not peak batch throughput (the dispatch
-    ladder owns that);
-  * the resident program occupies the device, so side programs are
-    parked behind it: the ICMP error path is disabled in this mode
-    (its round trips would never complete) and config swaps RESTART
-    the loop (sessions carried over) — detected per-frame via
-    ``dp.epoch``.
+  * frames process one window at a time in submission order — the
+    latency-floor regime with window-amortized overhead; peak batch
+    throughput still belongs to the dispatch ladder's deep coalesce;
+  * side programs serialize behind the ring windows, so the ICMP
+    error path stays disabled in this mode, and config swaps RESTART
+    the ring (sessions carried over, the window program re-used from
+    the process-wide jit cache) — detected per-frame via ``dp.epoch``.
 """
 
 from __future__ import annotations
@@ -109,7 +119,9 @@ class DataplanePump:
                  max_inflight: Optional[int] = None,
                  fetch_workers: Optional[int] = None,
                  chain_k: int = 0,
-                 fetch_delay: Union[None, float, Callable] = None):
+                 fetch_delay: Union[None, float, Callable] = None,
+                 ring_slots: int = 8,
+                 ring_windows: int = 2):
         """``max_batch``: largest coalesced device batch (packets);
         ``max_inflight``: in-flight batches before the dispatch stage
         backpressures (``depth`` is the legacy alias — ``max_inflight``
@@ -136,7 +148,12 @@ class DataplanePump:
         time-exceeded/net-unreachable back to the sender (io/icmp.py;
         VPP's ip4-icmp-error node).
         ``mode``: "dispatch" (default, the pipelined ladder) or
-        "persistent" (resident device loop — module docs)."""
+        "persistent" (device-resident descriptor rings — module docs).
+        ``ring_slots``/``ring_windows``: persistent-mode device-ring
+        geometry (frames per window / staging double-buffers —
+        io/rings.py DeviceDescRing; config-static shape like
+        ``sess_ways``, knobs ``io.io_ring_slots``/``io.io_ring_windows``
+        in cmd/config.py)."""
         if mode not in ("dispatch", "persistent"):
             raise ValueError(f"unknown pump mode {mode!r}")
         self.mode = mode
@@ -226,6 +243,28 @@ class DataplanePump:
             # tables) — the set-associative table's congestion signals,
             # delivered in the SAME fetch as the packed results
             "sess_insert_fails": 0, "sess_evictions": 0,
+            # drops by CAUSE (packets; ISSUE 7 satellite — the r5
+            # goodput number hid WHERE persistent-mode loss happened):
+            # tx_stall = tx-ring-full discards by the writer,
+            # shutdown = frames abandoned mid-flight by stop(),
+            # error = a dispatched batch whose result never came back
+            # (loop death, fetch failure, result timeout — counted
+            # where the writer releases the frames unwritten),
+            # rx_full = rx-ring overflow — counted by the IO daemon
+            # (io/daemon.py drops_rx_full; the pump's own key stays 0
+            # and exists so the vpp_tpu_pump_drops_total{reason=}
+            # family always exports every reason)
+            "drops_tx_stall": 0, "drops_shutdown": 0, "drops_rx_full": 0,
+            "drops_error": 0,
+            # device-ring telemetry (persistent mode; synced from the
+            # PersistentPump by the collect loop + at stop-merge):
+            # windows exchanged, frames staged, live in-flight windows,
+            # dispatched-minus-written-back windows (tx writeback lag),
+            # and host callbacks made by the device program — the ring
+            # steady state makes NONE (io_callbacks stays 0; bench.py
+            # reports io_wire_callbacks_per_window from it)
+            "ring_windows": 0, "ring_frames": 0, "ring_inflight": 0,
+            "ring_lag": 0, "io_callbacks": 0,
         }
         # dispatch→tx latency of recent batches, seconds (experienced
         # added latency of the device leg; ring-wait not included — the
@@ -277,6 +316,12 @@ class DataplanePump:
         self._persist_q: "queue.Queue" = queue.Queue(
             maxsize=self.max_inflight)
         self._persist_dispatch_done = threading.Event()
+        # device-ring geometry (persistent mode) + the accumulator the
+        # live PersistentPump counters fold into across epoch restarts
+        self.ring_slots = int(ring_slots)
+        self.ring_windows = int(ring_windows)
+        self._ring_accum = {"ring_windows": 0, "ring_frames": 0,
+                            "io_callbacks": 0}
 
     def bucket_sizes(self) -> list:
         """The dispatch bucket ladder — precompile ``process_packed``
@@ -290,9 +335,10 @@ class DataplanePump:
         costs 20-40 s on TPU, and paying it lazily inside the dispatch
         thread stalls the rx rings and drops live traffic.
 
-        Persistent mode: launches the resident loop (its one compile)
-        and round-trips an all-invalid frame through it, so the device
-        program is resident and hot before traffic is offered."""
+        Persistent mode: launches the device-ring pump (the window
+        program's one process-wide compile) and round-trips an
+        all-invalid frame through a 1-slot window, so the program is
+        compiled and hot before traffic is offered."""
         import jax
 
         from vpp_tpu.pipeline.dataplane import packed_input_zeros
@@ -367,12 +413,17 @@ class DataplanePump:
             self.stats["inflight"] -= 1
 
     # --- dispatch: rx ring -> device (async) ---
-    def _take_groups(self, rx, hold_cap: int, chain_cap: int) -> list:
+    def _take_groups(self, rx, hold_cap: int, chain_cap: int,
+                     max_pkts: Optional[int] = None) -> list:
         """Peek pending rx frames into coalesce groups by PACKET count:
-        a group closes when the next frame would overflow ``max_batch``
-        packets. One group = one packed batch; 2+ groups = the chainer
-        has a K-stack to fold. Holds _held_lock across the whole peek
-        block (a concurrent writer release shifts pending indices)."""
+        a group closes when the next frame would overflow ``max_pkts``
+        packets (default ``max_batch``; persistent mode compacts at the
+        VEC descriptor-slot width). One group = one packed batch; 2+
+        groups = the chainer has a K-stack to fold. Holds _held_lock
+        across the whole peek block (a concurrent writer release shifts
+        pending indices)."""
+        if max_pkts is None:
+            max_pkts = self.max_batch
         with self._held_lock:
             held = self._held
             budget = min(rx.pending() - held, hold_cap - held)
@@ -382,7 +433,7 @@ class DataplanePump:
                 f = rx.peek_nth(held + j)
                 if f is None:
                     break
-                if cur and cur_n + f.n > self.max_batch:
+                if cur and cur_n + f.n > max_pkts:
                     groups.append(cur)
                     cur, cur_n = [], 0
                     continue
@@ -420,6 +471,9 @@ class DataplanePump:
             except Exception:
                 log.exception("pump dispatch failed (%d frames)",
                               sum(len(g) for g in groups))
+                with self._lat_lock:
+                    self.stats["drops_error"] += sum(
+                        f.n for g in groups for f in g)
                 # hand the frames to the writer as a failed batch so
                 # rx slots are still released in order
                 self._inflight_inc()
@@ -505,6 +559,9 @@ class DataplanePump:
             except queue.Full:
                 if self._stop.is_set():
                     self._inflight_dec()
+                    with self._lat_lock:
+                        self.stats["drops_shutdown"] += sum(
+                            f.n for g in groups for f in g)
                     return
         # under _done_cv like the failed-batch path: the tx writer's
         # shutdown gate compares next_seq against _seq under the cv, so
@@ -530,7 +587,10 @@ class DataplanePump:
                                      fastpath=fastpath,
                                      classifier=classifier,
                                      skip_local=skip_local,
-                                     sweep_stride=sweep_stride).start()
+                                     sweep_stride=sweep_stride,
+                                     ring_slots=self.ring_slots,
+                                     ring_windows=self.ring_windows,
+                                     ).start()
         self._persist_epoch = epoch
 
     def _persist_stop_merge(self) -> None:
@@ -543,8 +603,17 @@ class DataplanePump:
 
         if self._ppump is None:
             return
-        final = self._ppump.stop()
-        self._ppump = None
+        pp = self._ppump
+        try:
+            final = pp.stop()
+        finally:
+            # fold the retiring ring's counters into the accumulator
+            # EVEN when stop() raises (a dead ring's exchanges still
+            # happened), so stats survive epoch restarts and failures
+            # without the exported totals jumping backwards
+            self._ring_fold(pp)
+            self._ppump = None
+            self._ring_stats_sync()
         if final is None:
             return
         sess = {f: getattr(final, f) for f in SESSION_FIELDS}
@@ -564,15 +633,18 @@ class DataplanePump:
         self._persist_stop_merge()
         self._persist_start()
 
-    def _persist_submit_one(self, f) -> bool:
-        """Pack + submit ONE held frame to the resident loop and hand
-        its FIFO ticket to the collector. Returns False when stop()
-        interrupted the hand-off (the frame stays held; the writer
-        teardown ignores it — the runtime frees the rings next)."""
+    def _persist_submit_group(self, frames: list) -> bool:
+        """Pack + submit ONE compacted coalesce group (several small
+        frames at sequential offsets of a single VEC descriptor slot —
+        the header-compaction half of the 20 B/pkt budget) to the ring
+        pump and hand its FIFO ticket to the collector. Returns False
+        when stop() interrupted the hand-off (the frames stay held and
+        are counted as shutdown drops; the runtime frees the rings
+        next)."""
         tp0 = time.perf_counter()
         flat = np.zeros((PACKED_IN_ROWS, VEC), np.int32)
         non_ip = np.zeros(VEC, np.uint8)
-        self._pack_group([f], flat, non_ip)
+        self._pack_group(frames, flat, non_ip)
         self.stats["t_pack"] += time.perf_counter() - tp0
         t0 = time.perf_counter()
         try:
@@ -580,13 +652,17 @@ class DataplanePump:
         except RuntimeError:
             log.exception("resident loop died — relaunching")
             self.stats["batch_errors"] += 1
+            # fold the dead ring's counters before replacing it, or
+            # the exported ring_windows/ring_frames totals would jump
+            # backwards (a spurious counter reset for scrapers)
+            self._ring_fold(self._ppump)
             self._ppump = None
             self._persist_start()
             self._ppump.submit(flat, now=self.dp.clock_ticks())
         self.stats["t_dispatch"] += time.perf_counter() - t0
         # unlocked: the dispatch thread is _seq's only writer, so its
         # own read needs no lock; increments publish under _done_cv
-        item = (self._seq, self._ppump, [[f]], non_ip.view(bool), t0)
+        item = (self._seq, self._ppump, [frames], non_ip.view(bool), t0)
         self._inflight_inc()
         while True:
             try:
@@ -595,13 +671,17 @@ class DataplanePump:
             except queue.Full:
                 if self._stop.is_set():
                     self._inflight_dec()
+                    with self._lat_lock:
+                        self.stats["drops_shutdown"] += sum(
+                            f.n for f in frames)
                     return False
         # under _done_cv for the same reason as the dispatch-mode bump:
         # the writer's shutdown gate reads _seq under the cv
         with self._done_cv:
             self._seq += 1
         self.stats["batches"] += 1
-        self.stats["max_coalesce"] = max(self.stats["max_coalesce"], 1)
+        self.stats["max_coalesce"] = max(self.stats["max_coalesce"],
+                                         len(frames))
         return True
 
     def _persist_dispatch_loop(self) -> None:
@@ -618,24 +698,20 @@ class DataplanePump:
             while not self._stop.is_set():
                 if self.dp.epoch != self._persist_epoch:
                     self._persist_restart()
-                # refill burst: drain EVERY pending frame up to the
-                # in-flight cap before sleeping — the resident loop's
-                # host_fetch callback blocks the device whenever its
-                # queue runs empty, so the overlap discipline here is
-                # keeping max_inflight frames queued ahead of it, not
-                # one-frame-per-poll lockstep (the r5 goodput collapse)
+                # refill burst: compact pending frames into VEC-packet
+                # descriptor slots and keep up to max_inflight slots
+                # queued at the ring stager before sleeping — whole
+                # windows then ship with one transfer each, and the
+                # device never idles between windows (the overlap
+                # discipline of the r6 ladder, now at window
+                # granularity)
                 burst = 0
                 while not self._stop.is_set():
-                    with self._held_lock:
-                        held = self._held
-                        f = None
-                        if rx.pending() - held > 0 and held < hold_cap:
-                            f = rx.peek_nth(held)
-                        if f is not None:
-                            self._held += 1
-                    if f is None:
+                    groups = self._take_groups(rx, hold_cap, 1,
+                                               max_pkts=VEC)
+                    if not groups:
                         break
-                    if not self._persist_submit_one(f):
+                    if not self._persist_submit_group(groups[0]):
                         return
                     burst += 1
                     if burst >= self.max_inflight:
@@ -653,6 +729,40 @@ class DataplanePump:
                 self._persist_stop_merge()
             except Exception:  # noqa: BLE001 — shutdown path
                 log.exception("persistent loop shutdown failed")
+
+    def _ring_fold(self, pp) -> None:
+        """Retire a PersistentPump's monotonic ring counters into the
+        accumulator EXACTLY ONCE, so restarts (epoch swaps,
+        death-relaunches) never reset the exported totals. The
+        retired flag flips under _lat_lock — the same lock
+        _ring_stats_sync holds while deciding whether to add the
+        ring's live counters — so a sync racing this fold either sees
+        the ring un-retired (adds live, accumulator without it) or
+        retired (accumulator only): never both."""
+        if pp is None:
+            return
+        snap = pp.stats_snapshot()
+        with self._lat_lock:
+            if pp.retired:
+                return
+            pp.retired = True
+            for k in self._ring_accum:
+                self._ring_accum[k] += int(snap.get(k, 0))
+
+    def _ring_stats_sync(self) -> None:
+        """Refresh the public ring telemetry keys: accumulated counts
+        from retired rings (epoch restarts) plus the live ring's
+        counters. Host scalars only — nothing crosses the device
+        transport (the PR 6 `show sessions` rule)."""
+        pp = self._ppump
+        live = pp.stats_snapshot() if pp is not None else {}
+        with self._lat_lock:
+            if pp is not None and pp.retired:
+                live = {}  # already folded into the accumulator
+            for k in self._ring_accum:
+                self.stats[k] = self._ring_accum[k] + int(live.get(k, 0))
+            self.stats["ring_inflight"] = int(live.get("ring_inflight", 0))
+            self.stats["ring_lag"] = int(live.get("ring_lag", 0))
 
     def _persist_collect_one(self, item) -> None:
         seq, ppump, groups, non_ip, t0 = item
@@ -681,6 +791,15 @@ class DataplanePump:
                 break
         with self._lat_lock:
             self.stats["t_fetch"] += time.perf_counter() - tf0
+            if batch is None:
+                # the frames will be released unwritten by the writer:
+                # attribute the loss. The ring drains every queued
+                # frame at stop(), so a missing result is a loop
+                # death / timeout — reason "error", even mid-shutdown
+                # (labeling it "shutdown" would hide a real failure)
+                self.stats["drops_error"] += sum(
+                    f.n for g in groups for f in g)
+        self._ring_stats_sync()
         with self._done_cv:
             self._done[seq] = (batch, groups, non_ip, t0, fast)
             self._done_cv.notify_all()
@@ -797,6 +916,11 @@ class DataplanePump:
             log.exception("pump fetch failed (batch %d)", seq)
             batch = None
             self.stats["batch_errors"] += 1
+            with self._lat_lock:
+                # the writer releases these frames unwritten —
+                # attribute the loss, don't just count a batch error
+                self.stats["drops_error"] += sum(
+                    f.n for g in groups for f in g)
         with self._done_cv:
             self._done[seq] = (batch, groups, non_ip, t0, fast)
             self._done_cv.notify_all()
@@ -901,6 +1025,7 @@ class DataplanePump:
                     self._emit_icmp_frame(f, self._cause)
             else:
                 self.stats["tx_ring_full"] += 1
+                self.stats["drops_tx_stall"] += n
             off += n
 
     def _write(self, batch, groups: list, non_ip, t0: float,
@@ -975,6 +1100,7 @@ class DataplanePump:
                             self._emit_icmp_frame(f, cause)
                 else:
                     self.stats["tx_ring_full"] += 1
+                    self.stats["drops_tx_stall"] += n
                 off += n
             lat = time.perf_counter() - t0
             with self._lat_lock:

@@ -12,6 +12,7 @@ two (multiprocessing.shared_memory, the production daemon split).
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, NamedTuple, Optional, Tuple
 
 import numpy as np
@@ -21,6 +22,111 @@ from vpp_tpu.native.ring import FrameRing
 VEC = 256
 DEFAULT_SNAP = 2048
 DEFAULT_SLOTS = 64
+
+# Rows of one packed descriptor slot — MUST equal
+# pipeline.dataplane.PACKED_IN_ROWS (20 B/packet bit-packed layout).
+# Duplicated here rather than imported: this module is shared with the
+# IO daemon process, which must stay jax-free (pipeline.dataplane pulls
+# in jax at import). pipeline/persistent.py asserts the two agree.
+DESC_ROWS = 5
+
+DEFAULT_RING_SLOTS = 8
+DEFAULT_RING_WINDOWS = 2
+
+
+def _is_pow2(n: int) -> bool:
+    return n >= 1 and (n & (n - 1)) == 0
+
+
+def validate_ring_geometry(slots: int, windows: int) -> None:
+    """Fail FAST on device-ring misconfiguration — called at YAML load
+    (cmd/config.py) and at DeviceDescRing construction, so a bad knob
+    is rejected with a clear message when the config is read, not at
+    the first persistent-mode pump launch (the PR 6
+    validate_dataplane_config pattern)."""
+    if not _is_pow2(int(slots)):
+        raise ValueError(
+            f"io_ring_slots must be a power of two, got {slots}")
+    if not _is_pow2(int(windows)) or int(windows) < 2:
+        raise ValueError(
+            f"io_ring_windows must be a power of two >= 2 "
+            f"(double buffer), got {windows}")
+
+
+class DeviceDescRing:
+    """Host half of the device-resident descriptor rings (ISSUE 7).
+
+    ``windows`` pinned staging buffers of ``slots`` descriptor slots
+    each ([slots, DESC_ROWS, batch] int32, ~20 B/packet — the packed
+    pipeline boundary), cycled in strict ring order: ``acquire()``
+    hands out the next window for staging, ``release()`` returns it
+    once its transfer (and the paired tx-ring fetch) completed. With
+    the default double buffer, the pump stages + dispatches window
+    N+1 while window N's results are still being fetched — the upload
+    of the next refill and the writeback of the previous window
+    overlap, which is what makes the steady state one exchange per
+    window instead of two blocking callbacks per frame.
+
+    Geometry is config-static (``io.io_ring_slots`` /
+    ``io.io_ring_windows``): ``slots`` is part of the device program's
+    jit-cache key the way ``sess_ways`` is carried in the session
+    arrays' shape, so geometry never retraces at runtime.
+
+    Thread contract: ONE stager calls acquire(), one fetcher calls
+    release() — the cyclic cursor + per-window state are guarded by a
+    condition variable, so a release landing concurrently with the
+    stager blocking in acquire() wakes it exactly once (the
+    double-buffer swap test races these on purpose).
+    """
+
+    def __init__(self, slots: int = DEFAULT_RING_SLOTS, batch: int = VEC,
+                 windows: int = DEFAULT_RING_WINDOWS):
+        validate_ring_geometry(slots, windows)
+        self.slots = int(slots)
+        self.batch = int(batch)
+        self.windows = int(windows)
+        self._desc = [np.zeros((self.slots, DESC_ROWS, self.batch),
+                               np.int32) for _ in range(self.windows)]
+        self._now = [np.zeros(self.slots, np.int32)
+                     for _ in range(self.windows)]
+        self._held = [False] * self.windows
+        self._next = 0  # cyclic acquire cursor
+        self._cv = threading.Condition(threading.Lock())
+
+    def window_bytes(self) -> int:
+        """Descriptor bytes one window ships each way (the window-math
+        numerator of docs/IO_PATH.md)."""
+        return self._desc[0].nbytes
+
+    def acquire(self, timeout: Optional[float] = None):
+        """The next staging window in cyclic order, or None on timeout
+        (every earlier window still in flight — host-side
+        backpressure). Returns ``(widx, desc, now)`` views; the caller
+        owns them until ``release(widx)``."""
+        with self._cv:
+            w = self._next
+            if not self._cv.wait_for(lambda: not self._held[w],
+                                     timeout=timeout):
+                return None
+            self._held[w] = True
+            self._next = (w + 1) % self.windows
+            return w, self._desc[w], self._now[w]
+
+    def release(self, widx: int) -> None:
+        """Window transfer complete — buffer reusable. Any-order safe
+        (the fetcher releases in dispatch order, but a shutdown path
+        may release a window it never dispatched)."""
+        with self._cv:
+            if not self._held[widx]:
+                raise RuntimeError(
+                    f"device-ring window {widx} released while free")
+            self._held[widx] = False
+            self._cv.notify_all()
+
+    def in_flight(self) -> int:
+        """Windows currently held (staged or awaiting writeback)."""
+        with self._cv:
+            return sum(self._held)
 
 
 class Frame(NamedTuple):

@@ -158,6 +158,69 @@ def _chained_call(step, with_aux: bool = False):
 # packed-boundary shape: [PACKED_IN_ROWS, B] in, [PACKED_OUT_ROWS_N, B] out
 PACKED_IN_ROWS = 5
 PACKED_OUT_ROWS_N = 5
+# rows of the per-batch aux summary _packed_call(with_aux=True) returns
+# ([fastpath, rx, sess_hits, insert_fails, evictions])
+PACKED_AUX_ROWS = 5
+
+
+def _ring_call(step, slots: int):
+    """Device-resident descriptor-ring window program (ISSUE 7): ONE
+    dispatch processes up to ``slots`` packed frames without any host
+    callback in between.
+
+    The host stages compacted [5, B] descriptors (20 B/packet, the
+    ``_packed_call`` layout) into the slots of an rx ring window
+    (io/rings.py DeviceDescRing) and ships the whole window as one
+    transfer; on-device, a ``lax.while_loop`` polls the rx cursor
+    against the shipped tail, runs the fused step per slot and appends
+    the verdict descriptors + aux summaries to the device tx ring. The
+    tx ring travels back in the window's ONE result fetch — the
+    aux-rider pattern of PR 3/PR 6 generalized to the whole wire path —
+    so the steady state of the persistent pump is io_callback-free:
+    one host↔device exchange per window replaces the two ordered
+    blocking callbacks per frame the r6 resident loop paid
+    (pipeline/persistent.py holds the host half and the latency math).
+
+    ``slots`` is config-static shape (``io.io_ring_slots``), part of
+    the jit-cache key exactly like ``sess_ways`` rides the session
+    arrays' shape. ``rx_now`` carries a per-slot timestamp so a window
+    is bit-exact with the same frames issued as individual
+    ``process_packed`` calls — the differential-test contract. The
+    frame cursor is device-resident: it rides the window-to-window
+    carry next to the session tables (the way sweep cursors ride the
+    tables pytree), so consumed-frame accounting never costs a
+    dedicated host sync.
+
+    Signature (donations in the jit wrapper, ``_jitted_step``):
+      (tables, cursor, rx_ring [S,5,B], rx_now [S], rx_tail) ->
+      (tables', cursor + consumed, tx_ring [S,5,B], aux_ring [S,5])
+    """
+    packed = _packed_call(step, with_aux=True)
+
+    def run(tables, cursor, rx_ring, rx_now, rx_tail):
+        from jax import lax
+
+        tx_ring0 = jnp.zeros_like(rx_ring)
+        aux_ring0 = jnp.zeros((slots, PACKED_AUX_ROWS), jnp.int32)
+
+        def cond(carry):
+            _tables, head, _tx, _aux = carry
+            return head < rx_tail
+
+        def body(carry):
+            tbl, head, tx, auxs = carry
+            flat = lax.dynamic_index_in_dim(rx_ring, head, 0,
+                                            keepdims=False)
+            tbl2, out, aux = packed(tbl, flat, rx_now[head])
+            tx = lax.dynamic_update_index_in_dim(tx, out, head, 0)
+            auxs = lax.dynamic_update_index_in_dim(auxs, aux, head, 0)
+            return tbl2, head + jnp.int32(1), tx, auxs
+
+        tables, head, tx_ring, aux_ring = lax.while_loop(
+            cond, body, (tables, jnp.int32(0), tx_ring0, aux_ring0))
+        return tables, cursor + head, tx_ring, aux_ring
+
+    return run
 
 
 # Jitted step variants, shared PROCESS-WIDE across Dataplane instances
@@ -180,14 +243,14 @@ _JIT_COMPILES_LOCK = threading.Lock()
 
 
 def _step_label(impl: str, skip_local: bool, fast: bool, form: str,
-                sweep_stride: int) -> str:
+                sweep_stride: int, ring_slots: int = 0) -> str:
     from vpp_tpu.pipeline.graph import SWEEP_STRIDE_DEFAULT
 
     return "{}{}{}{}_{}".format(
         impl, "_nolocal" if skip_local else "", "_auto" if fast else "",
         ("" if sweep_stride == SWEEP_STRIDE_DEFAULT
          else f"_sw{sweep_stride}"),
-        form)
+        f"{form}{ring_slots}" if form == "ring" else form)
 
 
 def _shape_sig(args, kwargs) -> tuple:
@@ -286,22 +349,40 @@ def jit_compile_budget(budget: int) -> _JitBudget:
 
 
 def _jitted_step(impl: str, skip_local: bool, fast: bool, form: str,
-                 sweep_stride: Optional[int] = None):
+                 sweep_stride: Optional[int] = None,
+                 ring_slots: int = 0):
     from vpp_tpu.pipeline.graph import SWEEP_STRIDE_DEFAULT
 
     if sweep_stride is None:
         sweep_stride = SWEEP_STRIDE_DEFAULT
-    key = (impl, skip_local, fast, form, sweep_stride)
+    key = (impl, skip_local, fast, form, sweep_stride, ring_slots)
     step = _JIT_STEPS.get(key)
     if step is None:
         fn = make_pipeline_step(impl, skip_local, fast, sweep_stride)
-        label = _step_label(impl, skip_local, fast, form, sweep_stride)
+        label = _step_label(impl, skip_local, fast, form, sweep_stride,
+                            ring_slots)
         if form == "plain":
             step = jax.jit(_counting(label, fn))
         elif form == "packed":
             step = jax.jit(
                 _counting(label, _packed_call(fn, with_aux=True)),
                 donate_argnums=(1,))
+        elif form == "ring":
+            # the device-ring window program: the WHOLE carry is
+            # donated — tables (argnum 0; at the 10M-flow config a
+            # non-donated carry would copy ~hundreds of MB of session
+            # columns per window, where donation aliases the unchanged
+            # config arrays and updates the session columns in place),
+            # the window-to-window cursor scalar (argnum 1), and the
+            # rx window (argnum 2, a fresh upload each dispatch,
+            # donated so the tx ring reuses its HBM). The caller MUST
+            # own the tables buffers it passes — PersistentPump copies
+            # the dataplane's live tables once at start precisely so
+            # the first window's donation can't invalidate buffers the
+            # collector/CLI still read.
+            step = jax.jit(
+                _counting(label, _ring_call(fn, ring_slots)),
+                donate_argnums=(0, 1, 2))
         else:
             step = jax.jit(
                 _counting(label, _chained_call(fn, with_aux=True)),

@@ -1,24 +1,43 @@
-"""Persistent device loop: ONE resident program pumps many frames.
+"""Persistent device pump over device-resident descriptor rings.
 
-The last lever of docs/LATENCY.md (VERDICT r3 Next #4): instead of one
-PJRT dispatch per frame (~100 µs locally, ~100 ms over a remote
-transport, paid per frame), a single jitted ``lax.while_loop`` stays
-RESIDENT on the device and exchanges packed frames with the host
-through ordered ``io_callback``s — the host feeds a refill queue, the
-device loop fetches/processes/delivers without ever returning to the
-dispatch path. VPP analog: the eternal graph dispatch loop of a worker
-thread, vs issuing one `vlib_main` per frame.
+ISSUE 7 tentpole. The r6 persistent mode kept ONE resident
+``lax.while_loop`` on the device but fed it through TWO ordered
+``io_callback`` host round trips per frame (fetch + deliver) — each a
+blocking device↔host synchronization, which is why BENCH_r05 measured
+the daemon persistent path at 61.7% goodput with a 52 ms pump p99
+while the same transport's transfer ceiling sat at 76.9 Mpps. nanoPU's
+reflex-plane framing (PAPERS.md) is the latency model: the NIC-to-
+compute path must not bounce through the host per frame.
 
-Per-frame cost inside the loop = host handoff + pipeline compute; the
-dispatch/trace/donation machinery is paid ONCE at loop start. The
-trade: the device is synchronously coupled to the host callbacks
-(an empty refill queue blocks the device program), so this serves the
-latency-floor regime — a node wanting minimum added latency per frame
-— not peak batch throughput, which the pipelined/chained paths own.
+This rework makes the steady state io_callback-free:
 
-Control protocol (host -> device via the fetched control word):
-  >= 0: a frame follows in the same fetch — process it
-  STOP: exit the while_loop and return the final session tables
+  * the host (stager thread) writes compacted ~20 B/packet descriptors
+    into a pinned staging window (io/rings.py DeviceDescRing) and
+    ships the WHOLE window with one transfer — the dispatch of the
+    jitted window program (pipeline/dataplane.py ``_ring_call``);
+  * on-device, a ``lax.while_loop`` polls the rx cursor against the
+    shipped tail, runs the fused step per slot, and appends verdict
+    descriptors + aux summaries to the device tx ring;
+  * the tx ring rides back in the window's ONE result fetch (fetcher
+    thread) — the aux-rider pattern generalized to the wire path — and
+    with the double-buffered windows the fetch of window N overlaps
+    the staging + dispatch of window N+1. The frame cursor and the
+    session tables thread window-to-window as a device-resident carry,
+    so per-frame accounting never costs a host sync.
+
+Per frame in steady state: 1/S of a dispatch + 1/S of a fetch (S =
+``io_ring_slots``), zero host callbacks — vs 2 blocking callbacks per
+frame before. Window fill is adaptive: a lone frame dispatches in a
+1-slot window (the latency floor is preserved), a backlog fills the
+window before dispatch (throughput). The window program compiles ONCE
+process-wide through the ``_jitted_step`` cache — an epoch-swap
+restart of the pump re-uses the compiled program, where the r6 loop
+paid a fresh per-instance jit every restart.
+
+``stats["io_callbacks"]`` counts host callback invocations made by the
+device program. The ring design makes none — the counter exists so a
+regression reintroducing a callback into the steady state is a
+measured fact (`io_wire_callbacks_per_window` in bench.py), not prose.
 """
 
 from __future__ import annotations
@@ -31,137 +50,103 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import lax
-from jax.experimental import io_callback
 
+from vpp_tpu.io.rings import DESC_ROWS, DeviceDescRing
 from vpp_tpu.pipeline.dataplane import (
     PACKED_IN_ROWS,
-    _packed_call,
+    _jitted_step,
 )
-from vpp_tpu.pipeline.graph import make_pipeline_step
 
-STOP = np.int32(-1)
+assert DESC_ROWS == PACKED_IN_ROWS, (
+    "io/rings.py DESC_ROWS must track pipeline.dataplane.PACKED_IN_ROWS"
+)
+
+STOP = np.int32(-1)  # legacy control word (kept for API compat)
+
+_SENTINEL = object()
 
 
 class PersistentPump:
-    """Host side of the resident loop: feed/collect packed frames.
+    """Host side of the device-ring persistent path.
 
-    One instance drives one device program invocation; ``submit()``
-    hands a [5, B] packed frame to the loop, ``results`` yields
-    [5, B] packed outputs in order. ``stop()`` makes the device loop
-    exit and the driver thread return the final tables.
+    API is unchanged from the r6 resident loop: ``submit()`` hands a
+    [5, B] packed frame in, ``result_ex()`` yields ``(out, aux)`` per
+    frame in submission order, ``stop()`` flushes everything in flight
+    and returns the final session tables. Internally, submitted frames
+    are staged into descriptor-ring windows and exchanged with the
+    device one window at a time (module doc).
 
-    ``fastpath=True`` (default) runs the two-tier auto dispatcher
-    inside the resident loop: an all-established frame takes the
-    classify-free kernel — the latency-floor regime is exactly where
-    steady-state return traffic lives, so the resident loop benefits
-    the most. Each delivered frame carries its [5] aux summary
-    (``[fastpath, rx, sess_hits, insert_fails, evictions]``) through
-    the same ordered deliver
-    callback; ``result_ex()`` exposes it, ``result()`` drops it.
+    ``fastpath``/``classifier``/``skip_local``/``sweep_stride`` mirror
+    the owning Dataplane's epoch selection exactly as before — the
+    window program is fetched from the process-wide ``_jitted_step``
+    cache keyed on them plus the ring geometry, so a pump restart
+    (epoch swap) never recompiles.
 
-    ``classifier``/``skip_local`` mirror the owning Dataplane's epoch
-    selection (pipeline/graph.py make_pipeline_step), so the resident
-    loop's full-chain tier classifies exactly like the dispatch path
-    would — the pump re-creates the loop on every epoch swap, which is
-    when the selection can flip.
+    ``ring_slots`` frames per window and ``ring_windows`` staging
+    buffers (>= 2: the double buffer that overlaps window N's
+    writeback with window N+1's refill) are config-static shape —
+    ``io.io_ring_slots`` / ``io.io_ring_windows``.
     """
 
     def __init__(self, tables, batch: int, max_frames: int = 1 << 20,
                  fastpath: bool = True, classifier: str = "dense",
                  skip_local: bool = False,
-                 sweep_stride: Optional[int] = None):
-        from vpp_tpu.pipeline.graph import SWEEP_STRIDE_DEFAULT
-
+                 sweep_stride: Optional[int] = None,
+                 ring_slots: int = 8, ring_windows: int = 2):
         self.batch = int(batch)
         self.fastpath_enabled = bool(fastpath)
+        self.ring = DeviceDescRing(slots=ring_slots, batch=self.batch,
+                                   windows=ring_windows)
         self._in: "queue.Queue" = queue.Queue()
+        # dispatched windows awaiting their result fetch, in dispatch
+        # order: (widx, n_frames, tx_ring, aux_ring) device futures
+        self._fetch_q: "queue.Queue" = queue.Queue()
         self._out: "queue.Queue" = queue.Queue()
-        self._tables_final = None
-        self._error: Optional[BaseException] = None
-        self._thread: Optional[threading.Thread] = None
-        self._max_frames = max_frames
         self._tables0 = tables
-        if sweep_stride is None:
-            sweep_stride = SWEEP_STRIDE_DEFAULT
-        step_fn = make_pipeline_step(classifier, skip_local,
-                                     fast=fastpath,
-                                     sweep_stride=sweep_stride)
-        # aux always on: the plain chain reports fastpath=0, so the
-        # deliver callback keeps ONE shape either way
-        self._step = _packed_call(step_fn, with_aux=True)
-
-        self._stop_seen = False
-
-        def host_fetch(_tick):
-            """Ordered callback: block until the host has a frame (or
-            stop); returns (ctl, frame)."""
-            item = self._in.get()
-            if item is None:
-                self._stop_seen = True
-                return STOP, np.zeros(
-                    (PACKED_IN_ROWS, self.batch), np.int32)
-            return np.int32(item[0]), item[1]
-
-        def host_deliver(out_frame, aux):
-            self._out.put((np.asarray(out_frame), np.asarray(aux)))
-            return np.int32(0)
-
-        fetch_shape = (
-            jax.ShapeDtypeStruct((), jnp.int32),
-            jax.ShapeDtypeStruct((PACKED_IN_ROWS, self.batch), jnp.int32),
-        )
-        deliver_shape = jax.ShapeDtypeStruct((), jnp.int32)
-
-        def loop(tables):
-            def cond(carry):
-                tables_, i, stopped = carry
-                return (~stopped) & (i < self._max_frames)
-
-            def body(carry):
-                tables_, i, _ = carry
-                ctl, flat = io_callback(host_fetch, fetch_shape, i,
-                                        ordered=True)
-                stopped = ctl < 0
-
-                def run(t):
-                    t2, out, aux = self._step(t, flat, ctl)
-                    _ = io_callback(host_deliver, deliver_shape, out,
-                                    aux, ordered=True)
-                    return t2
-
-                tables2 = lax.cond(stopped, lambda t: t, run, tables_)
-                return tables2, i + 1, stopped
-
-            final, _, _ = lax.while_loop(
-                cond, body, (tables, jnp.int32(0), jnp.bool_(False)))
-            return final
-
-        # jax-ok: one resident loop per pump BY DESIGN — the loop closes
-        # over this instance's rings/queues, and a process runs one
-        # long-lived pump (the compile is the pump's startup cost)
-        self._loop = jax.jit(loop)
+        self._tables_pending = None
+        self._tables_final = None
+        # set by the owning DataplanePump (under ITS stats lock) once
+        # this ring's counters have been folded into its accumulator —
+        # a concurrent stats sync then must not count them again
+        self.retired = False
+        self._error: Optional[BaseException] = None
+        self._threads: list = []
+        self._max_frames = max_frames  # legacy knob; windows need no budget
+        self._step = _jitted_step(classifier, skip_local, fast=fastpath,
+                                  form="ring", sweep_stride=sweep_stride,
+                                  ring_slots=self.ring.slots)
+        # device-resident frame cursor, threaded window-to-window next
+        # to the tables (the sweep-cursor pattern); fetched only by
+        # stats()/stop, never per window
+        self._cursor0 = jnp.int32(0)
+        # stager writes windows_dispatched, fetcher writes the rest —
+        # one lock serializes the counters and the snapshot
+        self._stats_lock = threading.Lock()
+        self.stats = {
+            # windows fully exchanged (dispatched AND written back)
+            "ring_windows": 0,
+            # frames staged through the ring (fill telemetry: frames
+            # vs windows*slots is the window-fill ratio `show io`
+            # derives)
+            "ring_frames": 0,
+            "windows_dispatched": 0,
+            # host callback invocations by the device program — the
+            # ring steady state makes NONE (module doc). Any future
+            # callback added to the window program MUST route its
+            # host function through a counter bump here; the lowered-
+            # program check (tests/test_device_rings.py
+            # TestCallbackFreeProgram) is what actually catches a
+            # callback sneaking in without one.
+            "io_callbacks": 0,
+        }
 
     # --- lifecycle ---
     def start(self) -> "PersistentPump":
-        def drive():
-            try:
-                self._tables_final = jax.block_until_ready(
-                    self._loop(self._tables0))
-                if not self._stop_seen:
-                    # the loop exhausted max_frames mid-stream: later
-                    # submits would hang their consumers silently
-                    self._error = RuntimeError(
-                        f"persistent loop frame budget "
-                        f"({self._max_frames}) exhausted without stop")
-            except BaseException as e:  # noqa: BLE001 — re-raised to
-                # the caller from result()/stop(); a silently dead
-                # loop would leave result() blocking to timeout
-                self._error = e
-
-        self._thread = threading.Thread(target=drive, daemon=True,
-                                        name="persistent-pump")
-        self._thread.start()
+        for fn, name in ((self._stage_loop, "persistent-stage"),
+                         (self._fetch_loop, "persistent-fetch")):
+            t = threading.Thread(target=fn, daemon=True, name=name)
+            t.start()
+            self._threads.append(t)
         return self
 
     def _check_error(self) -> None:
@@ -169,12 +154,12 @@ class PersistentPump:
             raise RuntimeError("persistent loop died") from self._error
 
     def submit(self, flat: np.ndarray, now: int) -> None:
-        """Queue one packed [5, B] frame; ``now`` rides the control
-        word (must be >= 0). The frame is COPIED — callers may reuse
-        their staging buffer immediately."""
+        """Queue one packed [5, B] frame; ``now`` is its per-slot
+        timestamp (must be >= 0). The frame is COPIED — callers may
+        reuse their staging buffer immediately."""
         assert now >= 0
         self._check_error()
-        self._in.put((now, np.array(flat, np.int32, copy=True)))
+        self._in.put((int(now), np.array(flat, np.int32, copy=True)))
 
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
         return self.result_ex(timeout=timeout)[0]
@@ -190,11 +175,116 @@ class PersistentPump:
             self._check_error()  # surface the REAL cause if the loop died
             raise
 
+    def stats_snapshot(self) -> dict:
+        """Consistent copy of the ring counters plus the live overlap
+        occupancy (in-flight windows, writeback lag). Host scalars
+        only — nothing crosses the device transport."""
+        with self._stats_lock:
+            s = dict(self.stats)
+        s["ring_inflight"] = self.ring.in_flight()
+        s["ring_lag"] = s.pop("windows_dispatched") - s["ring_windows"]
+        return s
+
     def stop(self, join_timeout: float = 60.0):
-        """Exit the device loop; returns the final session tables."""
+        """Flush every queued frame through the device and return the
+        final session tables."""
         self._in.put(None)
-        self._thread.join(timeout=join_timeout)
-        if self._thread.is_alive():
-            raise RuntimeError("persistent loop did not exit")
+        for t in self._threads:
+            t.join(timeout=join_timeout)
+            if t.is_alive():
+                raise RuntimeError("persistent loop did not exit")
         self._check_error()
+        if self._tables_pending is not None:
+            self._tables_final = jax.block_until_ready(
+                self._tables_pending)
+            self._tables_pending = None
         return self._tables_final
+
+    # --- stager: refill queue -> staged windows -> device dispatch ---
+    def _stage_loop(self) -> None:
+        # the window program donates its whole carry (tables + cursor),
+        # so the pump must OWN the buffers it threads: copy the
+        # dataplane's live tables once here — the first window's
+        # donation must not invalidate arrays the collector/CLI/
+        # expire_sessions still read off dp.tables (they see the
+        # pre-loop state until stop() grafts sessions back, exactly
+        # the r6 in-loop-carry staleness contract)
+        tables = jax.tree_util.tree_map(jnp.copy, self._tables0)
+        cursor = self._cursor0
+        try:
+            stopping = False
+            while not stopping:
+                item = self._in.get()
+                if item is None:
+                    break
+                # a free window, or None while the fetch side is wedged
+                # — poll so a fetcher death can't deadlock the stager
+                while True:
+                    got = self.ring.acquire(timeout=0.2)
+                    if got is not None:
+                        break
+                    if self._error is not None:
+                        return
+                widx, desc, nows = got
+                n = 0
+                # adaptive fill: drain whatever is already queued up to
+                # the window size, never wait for more — a lone frame
+                # ships in a 1-slot window (latency floor), a backlog
+                # fills the window (throughput)
+                while True:
+                    now, flat = item
+                    desc[n] = flat
+                    nows[n] = now
+                    n += 1
+                    if n >= self.ring.slots:
+                        break
+                    try:
+                        item = self._in.get_nowait()
+                    except queue.Empty:
+                        break
+                    if item is None:
+                        stopping = True
+                        break
+                # ONE async dispatch ships the window; the tx ring +
+                # aux ride back in the fetcher's one result fetch
+                tables, cursor, tx_ring, aux_ring = self._step(
+                    tables, cursor, desc, nows, np.int32(n))
+                with self._stats_lock:
+                    self.stats["windows_dispatched"] += 1
+                self._fetch_q.put((widx, n, tx_ring, aux_ring))
+            self._tables_pending = tables
+        except BaseException as e:  # noqa: BLE001 — re-raised to the
+            # caller from result()/stop(); a silently dead pump would
+            # leave result() blocking to timeout
+            self._error = e
+        finally:
+            if self._tables_pending is None and self._error is None:
+                self._tables_pending = tables
+            self._fetch_q.put(_SENTINEL)
+
+    # --- fetcher: one result fetch per window, per-frame hand-off ---
+    def _fetch_loop(self) -> None:
+        try:
+            while True:
+                item = self._fetch_q.get()
+                if item is _SENTINEL:
+                    return
+                widx, n, tx_ring, aux_ring = item
+                # the window's ONE device->host transfer: tx
+                # descriptors + per-slot aux summaries together
+                out_h, aux_h = jax.device_get((tx_ring, aux_ring))
+                out_h = np.asarray(out_h)
+                aux_h = np.asarray(aux_h)
+                # the staging buffer is reusable once its window's
+                # exchange fully completed
+                self.ring.release(widx)
+                for i in range(n):
+                    self._out.put((np.array(out_h[i]),
+                                   np.array(aux_h[i])))
+                with self._stats_lock:
+                    self.stats["ring_windows"] += 1
+                    self.stats["ring_frames"] += n
+        except BaseException as e:  # noqa: BLE001 — surfaced via
+            # _check_error exactly like a stager death
+            if self._error is None:
+                self._error = e

@@ -79,6 +79,37 @@ PUMP_STAT_GAUGES = (
     ("sess_evictions", "vpp_tpu_pump_sess_evictions",
      "session ways reclaimed by insert-time eviction "
      "(expired + victim, both tables)"),
+    # device-resident descriptor rings (persistent mode, ISSUE 7):
+    # host↔device window exchanges, frames staged through the ring,
+    # live in-flight windows, tx-writeback lag (windows dispatched but
+    # not yet written back) and host callbacks made by the device
+    # program — zero in the ring steady state; a nonzero rate() here
+    # IS the two-callbacks-per-frame regression coming back
+    ("ring_windows", "vpp_tpu_pump_ring_windows",
+     "device-ring windows exchanged (one transfer each way per window)"),
+    ("ring_frames", "vpp_tpu_pump_ring_frames",
+     "frames staged through the device descriptor rings"),
+    ("ring_inflight", "vpp_tpu_pump_ring_inflight",
+     "device-ring windows currently in flight (staged or awaiting "
+     "tx writeback)"),
+    ("ring_lag", "vpp_tpu_pump_ring_writeback_lag",
+     "device-ring windows dispatched but not yet written back"),
+    ("io_callbacks", "vpp_tpu_pump_io_callbacks",
+     "host callback invocations made by the persistent device "
+     "program (the ring steady state makes none)"),
+)
+
+# pump.stats drop-cause key -> `reason` label on the
+# vpp_tpu_pump_drops_total counter family (ISSUE 7 satellite: the r5
+# persistent goodput number hid WHERE loss happened). rx_full is
+# counted by the IO daemon (io/daemon.py drops_rx_full — a separate
+# process in deployment); attach its stats with set_io_daemon() and
+# publish() folds them into the same reason.
+PUMP_DROP_REASONS = (
+    ("drops_rx_full", "rx_full"),
+    ("drops_tx_stall", "tx_stall"),
+    ("drops_shutdown", "shutdown"),
+    ("drops_error", "error"),
 )
 
 # pump.stats stage-seconds key -> `stage` label of the
@@ -327,6 +358,29 @@ class StatsCollector:
                   "compile-once contract broke)",
                   kind="counter"),
         )
+        # drops by cause (packets): the pump contributes tx_stall +
+        # shutdown, the IO daemon rx_full (set_io_daemon) — together
+        # they attribute every persistent-path loss the r5 goodput
+        # number hid
+        self.pump_drops_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_pump_drops_total",
+                  "packets dropped on the IO path, by cause "
+                  "(rx_full = rx-ring overflow at the daemon, "
+                  "tx_stall = tx-ring full at the writer, "
+                  "shutdown = abandoned mid-flight by stop(), "
+                  "error = dispatched but the device result never "
+                  "came back)",
+                  kind="counter"),
+        )
+        # optional IO-daemon stats source (a callable returning the
+        # daemon's stats dict, or the IODaemon itself when it runs
+        # in-process): feeds the rx_full drop cause. The fetched value
+        # is cached with a failure backoff so a wedged daemon can't
+        # stall every Prometheus scrape for its RPC timeout.
+        self._io_daemon_stats = None
+        self._daemon_drops_cache = 0
+        self._daemon_retry_at = 0.0
         self.vcl = None  # set_vcl(): admission counters -> gauges
         self.vcl_gauges = {
             name: self.registry.register(STATS_PATH, Gauge(name, help_))
@@ -348,6 +402,16 @@ class StatsCollector:
             pump.fastpath_hist = self.fastpath_batch_hist
         except AttributeError:
             pass  # exotic pump stand-ins (slotted fakes) keep gauges only
+
+    def set_io_daemon(self, daemon_or_fn) -> None:
+        """Attach an IO-daemon stats source (the in-process IODaemon,
+        or a callable returning its stats dict — e.g. an IO-control
+        client's ``stats``) so publish() exports the daemon-side
+        rx_full drop cause on ``vpp_tpu_pump_drops_total``."""
+        if callable(daemon_or_fn):
+            self._io_daemon_stats = daemon_or_fn
+        else:
+            self._io_daemon_stats = lambda: dict(daemon_or_fn.stats)
 
     def set_vcl(self, server) -> None:
         """Attach the VclAdmissionServer so publish() exports its
@@ -488,6 +552,33 @@ class StatsCollector:
             float(getattr(self.dp, "classify_seconds", 0.0)),
             stage="classify")
         pump = self.pump
+        # the drops-by-cause family publishes whenever EITHER source
+        # exists: a mesh-mode agent attaches only the daemon stats
+        # (set_pump goes to one designated collector cluster-wide),
+        # and its rx_full overflow must still be visible
+        if pump is not None or self._io_daemon_stats is not None:
+            if self._io_daemon_stats is not None:
+                import time as _t
+
+                now = _t.monotonic()
+                if now >= self._daemon_retry_at:
+                    try:
+                        self._daemon_drops_cache = int(
+                            self._io_daemon_stats().get(
+                                "drops_rx_full", 0))
+                    except Exception:  # noqa: BLE001 — daemon may be
+                        # down or wedged: serve the cached value and
+                        # back off, so the scrape path pays the RPC
+                        # timeout once per backoff window, not per
+                        # scrape
+                        self._daemon_retry_at = now + 30.0
+            daemon_drops = self._daemon_drops_cache
+            ps = pump.stats if pump is not None else {}
+            for stat_key, reason in PUMP_DROP_REASONS:
+                n = int(ps.get(stat_key, 0))
+                if reason == "rx_full":
+                    n += daemon_drops
+                self.pump_drops_gauge.set(n, reason=reason)
         if pump is not None:
             ps = pump.stats
             for stat_key, gauge_name, _ in PUMP_STAT_GAUGES:
