@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-race lint verify bench all
+.PHONY: test test-race chaos lint verify bench all
 
 all: lint test
 
@@ -19,6 +19,15 @@ test-race:
 		tests/test_native_ring.py tests/test_kvserver.py \
 		tests/test_vcl_preload.py tests/test_multihost_unit.py \
 		tests/test_kvstore_fencing.py -q
+
+# Seeded fault-injection schedules (ISSUE 8): kvstore partitions,
+# ring fault → dispatch fallback, dispatch fetch/tx faults, torn
+# snapshots, reconnect storms — each asserting exact packet/session
+# conservation after recovery. Seeds default inside the tests
+# (override: VPPT_CHAOS_SEED=n); bounded runtime; also marked `slow`
+# so the tier-1 `-m 'not slow'` timing budget never pays for it.
+chaos:
+	$(PY) -m pytest tests/test_chaos.py -q -m chaos
 
 # Base style pass + the pure-AST analysis passes (tools/analysis/):
 # --jax tracer/recompile hygiene, --threads lock discipline. The

@@ -39,6 +39,18 @@ def pytest_configure(config):
         "test if it triggers more than n pipeline-step XLA compiles "
         "(pipeline/dataplane.py runtime jit-compile guard, ISSUE 5)",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: seeded fault-injection schedule (tests/test_chaos.py; "
+        "vpp_tpu/testing/faults.py). Bounded runtime; `make chaos` "
+        "runs the suite; also marked slow so the tier-1 `-m 'not "
+        "slow'` timing budget never pays for it",
+    )
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 `-m 'not slow'` run "
+        "(ROADMAP.md); run explicitly (e.g. `make chaos`)",
+    )
 
 
 @pytest.fixture

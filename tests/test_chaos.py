@@ -1,0 +1,481 @@
+"""Seeded chaos schedules (ISSUE 8: vpp_tpu/testing/faults.py).
+
+Every schedule runs REAL components through their REAL failure seams
+(compiled-in fault points, server kills, socket shutdowns) on a seeded,
+reproducible plan, and after every recovery asserts an EXACT
+conservation invariant — packets, sessions, or acknowledged writes —
+never a vibes-level "it seems to work again":
+
+* ``kvstore partition``     — server killed mid-write-stream + seeded
+  RPC drops: every acknowledged put survives, the client reports
+  degraded + staleness while down, and heals on restart.
+* ``ring fault → dispatch`` — the resident device ring dies repeatedly:
+  the pump falls back to the dispatch ladder, and
+  delivered + attributed drops == offered, exactly.
+* ``torn snapshot``         — a seeded schedule of torn chunks / torn
+  manifests across generations: restore always yields the last
+  PUBLISHED generation, bit-consistent, never a half-restored table.
+* ``reconnect storm``       — seeded connect-failure storms around
+  forced disconnects: watches re-register snapshot-atomically every
+  round and no acknowledged write is lost.
+
+Runtime is bounded (small tables, short timeouts). `make chaos` runs
+the suite; the tests are also ``slow``-marked, so the tier-1
+``-m 'not slow'`` run DESELECTS them — run `make chaos` explicitly
+before merging resilience changes. Override the seed base with
+VPPT_CHAOS_SEED to soak different schedules.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from wire import make_frame
+
+from vpp_tpu.io import DataplanePump, IORingPair
+from vpp_tpu.kvstore.client import RemoteKVStore
+from vpp_tpu.kvstore.server import KVServer
+from vpp_tpu.kvstore.store import KVStore
+from vpp_tpu.native.pktio import PacketCodec
+from vpp_tpu.pipeline.dataplane import Dataplane
+from vpp_tpu.pipeline.snapshot import SessionSnapshotter
+from vpp_tpu.pipeline.tables import DataplaneConfig
+from vpp_tpu.pipeline.vector import VEC, Disposition, make_packet_vector
+from vpp_tpu.testing import faults
+
+# `slow` keeps the seeded schedules out of the tier-1 `-m 'not slow'`
+# timing budget (ISSUE 8 satellite); `make chaos` selects them by the
+# chaos marker explicitly
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+
+SEED = int(os.environ.get("VPPT_CHAOS_SEED", "0"))
+
+CLIENT_IP = "10.1.1.2"
+SERVER_IP = "10.1.1.3"
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.uninstall()
+
+
+def wait_for(pred, timeout=20.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# --------------------------------------------------------------------
+# schedule 1: kvstore partition under a write stream
+# --------------------------------------------------------------------
+
+
+class TestKvstorePartition:
+    def test_partition_conserves_acknowledged_writes(self):
+        rng = np.random.default_rng(SEED + 1)
+        shared = KVStore()
+        srv = KVServer(store=shared, port=0)
+        srv.start()
+        port = srv.port
+        client = RemoteKVStore("127.0.0.1", port, request_timeout=2.0,
+                               reconnect_timeout=60.0)
+        resyncs = []
+        events = []
+        client.watch("c/", events.append,
+                     on_resync=lambda snap, rev: resyncs.append(rev))
+        wait_for(lambda: len(resyncs) >= 1, msg="initial resync")
+        try:
+            # seeded RPC drops while healthy: the request layer must
+            # absorb them transparently (retry within the deadline)
+            drop_after = int(rng.integers(2, 5))
+            plan = faults.install(faults.FaultPlan(seed=SEED + 1))
+            plan.inject("kv.send", after=drop_after, times=2,
+                        exc=OSError)
+            acked = {}
+            for i in range(8):
+                client.put(f"c/k{i}", i)
+                acked[f"c/k{i}"] = i
+            assert plan.fired("kv.send") == 2
+            faults.uninstall()
+
+            # hard partition mid-stream: kill the server. In-flight /
+            # subsequent puts may fail — ONLY acknowledged ones count.
+            srv.close()
+            wait_for(lambda: client.degraded, msg="degraded flag")
+            t0 = client.staleness_s()
+            assert t0 >= 0.0
+            failures = 0
+            for i in range(8, 12):
+                try:
+                    client.put(f"c/k{i}", i)
+                    acked[f"c/k{i}"] = i
+                except Exception:  # noqa: BLE001 — the partition
+                    failures += 1
+            assert failures > 0  # the partition was real
+            assert client.staleness_s() >= t0
+
+            # heal: same store, same port — the reconnect loop finds
+            # it, re-registers the watch snapshot-atomically, and the
+            # write path resumes
+            srv2 = KVServer(store=shared, port=port)
+            srv2.start()
+            try:
+                wait_for(lambda: not client.degraded,
+                         msg="reconnect after heal")
+                assert client.staleness_s() == 0.0
+                wait_for(lambda: len(resyncs) >= 2,
+                         msg="post-heal watch resync")
+                for i in range(8, 12):  # retry the window, idempotent
+                    client.put(f"c/k{i}", i)
+                    acked[f"c/k{i}"] = i
+                # EXACT conservation: every acknowledged write is in
+                # the store, with the acknowledged value
+                for k, v in acked.items():
+                    assert shared.get(k) == v, k
+                assert set(shared.list_keys("c/")) == set(acked)
+            finally:
+                srv2.close()
+        finally:
+            client.close()
+
+    def test_agent_serves_last_epoch_and_exports_staleness(self):
+        """The degraded-mode contract: with the store gone, already-
+        adopted state keeps serving and the collector exports the
+        kvstore degradation + staleness."""
+        from vpp_tpu.stats.collector import StatsCollector
+
+        srv = KVServer(store=KVStore(), port=0)
+        srv.start()
+        client = RemoteKVStore("127.0.0.1", srv.port,
+                               request_timeout=1.0,
+                               reconnect_timeout=5.0)
+        dp = Dataplane(DataplaneConfig(sess_slots=64,
+                                       sess_sweep_stride=0))
+        a = dp.add_pod_interface(("default", "a"))
+        b = dp.add_pod_interface(("default", "b"))
+        dp.builder.add_route(f"{CLIENT_IP}/32", a, Disposition.LOCAL)
+        dp.builder.add_route(f"{SERVER_IP}/32", b, Disposition.LOCAL)
+        dp.swap()
+        coll = StatsCollector(dp)
+        coll.set_store(client)
+        try:
+            srv.close()
+            wait_for(lambda: client.degraded, msg="degraded")
+            # the data plane keeps forwarding on its adopted epoch
+            pv = make_packet_vector(
+                [{"src": CLIENT_IP, "dst": SERVER_IP, "proto": 17,
+                  "sport": 1000, "dport": 53, "rx_if": a, "ttl": 64}],
+                n=64)
+            res = dp.process(pv, now=5)
+            assert int(np.asarray(res.disp)[0]) == int(Disposition.LOCAL)
+            coll.publish()
+            lines = []
+            for _p, fam in coll.registry.families():
+                lines.extend(fam.render())
+            text = "\n".join(lines)
+            assert 'vpp_tpu_degraded{component="kvstore"} 1' in text
+            stale = [ln for ln in text.splitlines()
+                     if ln.startswith("vpp_tpu_kvstore_staleness_seconds")]
+            assert stale and float(stale[0].split()[-1]) >= 0.0
+        finally:
+            client.close()
+
+
+# --------------------------------------------------------------------
+# schedule 2: resident-ring faults → dispatch-mode fallback
+# --------------------------------------------------------------------
+
+
+def _forwarding_dp():
+    dp = Dataplane(DataplaneConfig(sess_slots=256, sess_sweep_stride=0))
+    a = dp.add_pod_interface(("default", "a"))
+    b = dp.add_pod_interface(("default", "b"))
+    dp.builder.add_route(f"{CLIENT_IP}/32", a, Disposition.LOCAL)
+    dp.builder.add_route(f"{SERVER_IP}/32", b, Disposition.LOCAL)
+    dp.swap()
+    return dp, a, b
+
+
+def _push_frames(rings, rx_if, n_frames, per=4, tag0=20000):
+    codec = PacketCodec()
+    scratch = np.zeros((VEC, rings.rx.snap), np.uint8)
+    pkts = 0
+    for k in range(n_frames):
+        frames = [
+            make_frame(CLIENT_IP, SERVER_IP, proto=17,
+                       sport=tag0 + k, dport=1000 + k * per + j)
+            for j in range(per)
+        ]
+        cols, n = codec.parse(frames, rx_if, scratch)
+        assert rings.rx.push(cols, n, payload=scratch)
+        pkts += n
+    return pkts
+
+
+class TestRingFaultFallback:
+    def test_repeated_ring_faults_fall_back_with_exact_conservation(
+            self):
+        dp, a, b = _forwarding_dp()
+        rings = IORingPair(n_slots=32)
+        # every window dispatch dies → two deaths trip the limit
+        faults.install(faults.FaultPlan(seed=SEED + 2)).inject(
+            "ring.dispatch", times=-1)
+        pump = DataplanePump(dp, rings, mode="persistent",
+                             ring_fault_limit=2).start()
+        def accounted():
+            s = pump.stats
+            return (s["pkts"] + s["drops_error"] + s["drops_shutdown"]
+                    + s["drops_tx_stall"] + s["drops_rx_full"])
+
+        try:
+            # keep offering traffic until the fault ladder trips: a
+            # relaunched ring only dies when the NEXT frame reaches
+            # its stager, so a single up-front burst would leave a
+            # freshly relaunched (empty) ring looking healthy forever
+            offered = 0
+            deadline = time.monotonic() + 120.0
+            k = 0
+            while not pump.degraded_ring:
+                assert time.monotonic() < deadline, \
+                    "timed out waiting for ring→dispatch fallback"
+                offered += _push_frames(rings, a, 2, per=4,
+                                        tag0=20000 + 2 * k)
+                k += 1
+                time.sleep(0.3)
+            assert pump.mode == "dispatch"
+            # the degraded pump still moves traffic (the whole point);
+            # the tx ring (32 slots) holds everything, so conservation
+            # is read off the pump counters without a racing drain
+            offered += _push_frames(rings, a, 6, per=4, tag0=30000)
+            wait_for(lambda: accounted() == offered, timeout=180.0,
+                     msg="every offered packet accounted")
+            assert pump.stop(join_timeout=60.0)
+            s = pump.stats
+            # EXACT packet conservation across the mode switch: every
+            # offered packet is either delivered or attributed to a
+            # drop cause — the fallback itself loses nothing silently
+            assert accounted() == offered, dict(s)
+            assert s["pkts"] > 0  # post-fallback delivery happened
+            # the fault really drove the fallback
+            assert faults.active_plan().fired("ring.dispatch") >= 2
+            assert s["batch_errors"] >= 1
+        finally:
+            pump.stop(join_timeout=30.0)
+            rings.close()
+
+    def test_healthy_ring_unaffected_by_armed_other_points(self):
+        """Control: a plan arming only kvstore points leaves the ring
+        path untouched (fault points are independent seams)."""
+        dp, a, b = _forwarding_dp()
+        rings = IORingPair(n_slots=32)
+        faults.install(faults.FaultPlan(seed=SEED + 2)).inject(
+            "kv.send", times=-1, exc=OSError)
+        pump = DataplanePump(dp, rings, mode="persistent",
+                             ring_fault_limit=2).start()
+        try:
+            offered = _push_frames(rings, a, 4, per=4)
+            deadline = time.monotonic() + 120.0
+            delivered = 0
+            while delivered < offered and time.monotonic() < deadline:
+                f = rings.tx.peek()
+                if f is None:
+                    time.sleep(0.005)
+                    continue
+                delivered += f.n
+                rings.tx.release()
+            assert delivered == offered
+            assert not pump.degraded_ring
+            assert pump.mode == "persistent"
+        finally:
+            pump.stop(join_timeout=60.0)
+            rings.close()
+
+
+class TestDispatchPathFaults:
+    def test_fetch_and_tx_faults_attribute_drops_exactly(self):
+        """Dispatch-mode seams: seeded result-fetch failures and a
+        tx-ring stall. Loss is allowed — UNATTRIBUTED loss is not:
+        delivered + drops_error + drops_tx_stall (+ the rest) must
+        equal offered exactly, and traffic keeps flowing after."""
+        dp, a, b = _forwarding_dp()
+        rings = IORingPair(n_slots=32)
+        pump = DataplanePump(dp, rings, mode="dispatch").start()
+
+        def accounted():
+            s = pump.stats
+            return (s["pkts"] + s["drops_error"] + s["drops_shutdown"]
+                    + s["drops_tx_stall"] + s["drops_rx_full"])
+
+        try:
+            # warm the dispatch path FIRST: the initial jit compile
+            # takes tens of seconds, during which every pushed frame
+            # coalesces into one batch — the armed per-call windows
+            # below need distinct dispatches to land on
+            offered = _push_frames(rings, a, 1, per=4, tag0=20999)
+            wait_for(lambda: pump.stats["pkts"] >= 4, timeout=180.0,
+                     msg="warm dispatch")
+            plan = faults.install(faults.FaultPlan(seed=SEED + 5))
+            plan.inject("pump.fetch", after=1, times=2)
+            plan.inject("pump.tx_push", after=0, times=1)
+            for k in range(6):  # spaced → distinct dispatches, so the
+                # armed call windows land on different batches
+                offered += _push_frames(rings, a, 1, per=4,
+                                        tag0=21000 + k)
+                time.sleep(0.25)
+            wait_for(lambda: accounted() == offered, timeout=180.0,
+                     msg="every offered packet accounted")
+            assert pump.stop(join_timeout=60.0)
+            s = pump.stats
+            assert accounted() == offered, dict(s)
+            assert s["drops_error"] > 0        # the fetch faults bit
+            assert s["drops_tx_stall"] > 0     # the tx stall bit
+            assert s["pkts"] > 0               # and traffic survived
+            assert plan.fired("pump.fetch") == 2
+            assert plan.fired("pump.tx_push") == 1
+        finally:
+            pump.stop(join_timeout=30.0)
+            rings.close()
+
+
+# --------------------------------------------------------------------
+# schedule 3: torn-snapshot generations
+# --------------------------------------------------------------------
+
+
+class TestTornSnapshotSchedule:
+    def test_seeded_torn_generations_always_restore_published_state(
+            self, tmp_path):
+        """Across a seeded schedule of OK / torn-chunk / torn-manifest
+        snapshot attempts, restore must always produce exactly the
+        last PUBLISHED generation's session set — never a blend."""
+        rng = np.random.default_rng(SEED + 3)
+        cfg = DataplaneConfig(
+            max_ifaces=8, fib_slots=16, sess_slots=256, sess_ways=4,
+            sess_sweep_stride=0)
+        dp = Dataplane(cfg)
+        up = dp.add_uplink()
+        dp.builder.add_route("10.50.0.0/16", up, Disposition.LOCAL)
+        dp.swap()
+        snap = SessionSnapshotter(dp, str(tmp_path), chunk_buckets=16)
+
+        published_live = 0
+        total_flows = 0
+        schedule = ["ok"] + [
+            ["ok", "torn_chunk", "torn_manifest"][int(rng.integers(3))]
+            for _ in range(5)
+        ]
+        for step, kind in enumerate(schedule):
+            # fresh flows before every attempt (so every generation
+            # has new content to drain)
+            n = int(rng.integers(3, 9))
+            pv = make_packet_vector(
+                [{"src": f"172.20.{step}.{i + 1}",
+                  "dst": f"10.50.{step}.{i + 1}", "proto": 6,
+                  "sport": 5000 + i, "dport": 443, "rx_if": up,
+                  "ttl": 64} for i in range(n)], n=64)
+            dp.process(pv, now=10 + step)
+            total_flows += n
+            live_now = int(jnp.sum(dp.tables.sess_valid))
+            if kind == "ok":
+                assert snap.snapshot() is not None
+                published_live = live_now
+            else:
+                point = ("snapshot.chunk" if kind == "torn_chunk"
+                         else "snapshot.manifest")
+                faults.install(
+                    faults.FaultPlan(seed=SEED + 10 + step)).inject(point)
+                assert snap.snapshot() is None
+                faults.uninstall()
+                assert snap.degraded
+
+            # recovery check after EVERY attempt: a fresh process
+            # restores exactly the last published generation
+            dp2 = Dataplane(cfg)
+            dp2.add_uplink()
+            dp2.swap()
+            snap2 = SessionSnapshotter(dp2, str(tmp_path),
+                                       chunk_buckets=16)
+            assert snap2.restore_into()
+            restored = int(jnp.sum(dp2.tables.sess_valid))
+            assert restored == published_live, (step, kind, schedule)
+
+        # the schedule must actually have exercised a failure path
+        # (seeded draw over 5 steps; P(all ok) < 2%) — and a final
+        # clean snapshot heals regardless of history
+        assert snap.snapshot() is not None
+        assert not snap.degraded
+
+
+# --------------------------------------------------------------------
+# schedule 4: reconnect storm with watch re-registration
+# --------------------------------------------------------------------
+
+
+class TestReconnectStorm:
+    def test_seeded_storm_conserves_writes_and_watch_state(self):
+        import socket as _socket
+
+        rng = np.random.default_rng(SEED + 4)
+        shared = KVStore()
+        srv = KVServer(store=shared, port=0)
+        srv.start()
+        client = RemoteKVStore("127.0.0.1", srv.port,
+                               request_timeout=2.0,
+                               reconnect_timeout=30.0,
+                               reconnect_backoff=(0.02, 0.2))
+        got = []
+        got_lock = threading.Lock()
+        resyncs = []
+
+        def on_event(ev):
+            with got_lock:
+                got.append(ev.key)
+
+        client.watch("s/", on_event,
+                     on_resync=lambda snap, rev: resyncs.append(len(snap)))
+        try:
+            acked = {}
+            rounds = 4
+            for r in range(rounds):
+                # seeded connect-failure burst for the upcoming
+                # reconnect: the jittered backoff must ride through it
+                k = int(rng.integers(1, 4))
+                plan = faults.install(
+                    faults.FaultPlan(seed=SEED + 40 + r))
+                plan.inject("kv.connect", times=k, exc=OSError)
+                # force the disconnect (the storm's trigger)
+                with client._lock:
+                    sock = client._sock
+                assert sock is not None
+                sock.shutdown(_socket.SHUT_RDWR)
+                wait_for(lambda: len(resyncs) >= r + 2, timeout=30.0,
+                         msg=f"resync after storm round {r}")
+                assert plan.fired("kv.connect") == k
+                faults.uninstall()
+                key = f"s/round{r}"
+                client.put(key, r)
+                acked[key] = r
+                wait_for(lambda: key in got, timeout=10.0,
+                         msg=f"watch delivery round {r}")
+
+            # conservation: every acknowledged write present, every
+            # round's event delivered, one snapshot-atomic resync per
+            # storm round plus the initial registration
+            for k_, v in acked.items():
+                assert shared.get(k_) == v
+            assert set(shared.list_keys("s/")) == set(acked)
+            assert len(resyncs) >= rounds + 1
+        finally:
+            client.close()
+            srv.close()

@@ -47,6 +47,17 @@ JIT_SITES = {
         "once per mesh by ClusterDataplane",
     ("vpp_tpu/ops/acl_mxu.py", "@mxu_first_match"):
         "pallas first-match kernel entry; static interpret flag only",
+    ("vpp_tpu/pipeline/snapshot.py", "_fetch_fn"):
+        "bounded chunk drain for the crash-consistent session "
+        "snapshot (ISSUE 8): one [C, CB, W] stacked fetch per chunk, "
+        "lru_cache-memoized per chunk_buckets geometry; the start "
+        "offset is a traced scalar so draining the ring never "
+        "retraces",
+    ("vpp_tpu/pipeline/snapshot.py", "_digest_fn"):
+        "per-chunk content digest for incremental snapshots (ISSUE "
+        "8): one on-device O(table) pass returning [n_chunks] uint32 "
+        "— only chunks whose digest moved drain; memoized per "
+        "chunk_buckets geometry",
 }
 
 # (relpath, dotted def qualname) traced into jit programs indirectly

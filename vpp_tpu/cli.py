@@ -21,7 +21,7 @@ from vpp_tpu.pipeline.vector import Disposition, ip4_str
 class DebugCLI:
     def __init__(self, dataplane: Dataplane, tracer=None, stats=None,
                  pump=None, io_ctl=None, session_engine=None,
-                 mesh_runtime=None, store=None):
+                 mesh_runtime=None, store=None, snapshotter=None):
         self.dp = dataplane
         self.tracer = tracer
         self.stats = stats
@@ -36,6 +36,9 @@ class DebugCLI:
         # optional cluster-store handle (show store: endpoint, fencing
         # epoch, HA failover state as this agent experiences it)
         self.store = store
+        # optional SessionSnapshotter (show resilience: snapshot
+        # generation/age, degraded components, backoff state)
+        self.snapshotter = snapshotter
 
     # --- dispatch ---
     def run(self, line: str) -> str:
@@ -57,6 +60,7 @@ class DebugCLI:
             ("show", "io"): self.show_io,
             ("show", "neighbors"): self.show_neighbors,
             ("show", "store"): self.show_store,
+            ("show", "resilience"): self.show_resilience,
             ("help",): self.help,
         }
         for sig, fn in handlers.items():
@@ -82,7 +86,7 @@ class DebugCLI:
             "show sessions | show session-rules | show mesh | "
             "show nat44 | show fib | show trace | show errors | "
             "show fastpath | show io | show neighbors | show store | "
-            "show config-history [n] | show spans [n] | "
+            "show resilience | show config-history [n] | show spans [n] | "
             "trace add [n] | trace clear | config replay <journal> | "
             "test connectivity <src> <dst> <tcp|udp|icmp> [dport]"
         )
@@ -512,6 +516,70 @@ class DebugCLI:
         trace = entries[0].format() if entries else "(no trace captured)"
         return (f"{src_s} -> {dst_s} {proto_s}/{dport} via if {rx_if}\n"
                 f"{trace}\nverdict: {verdict}")
+
+    def show_resilience(self) -> str:
+        """Crash-consistency + degraded-mode one-pager (ISSUE 8): the
+        snapshot generation/age, which components are degraded, and
+        the live reconnect backoff state — the operator's first stop
+        after an incident ('did the table survive, and what are we
+        running without right now?')."""
+        lines = []
+        # degraded components (mirrors vpp_tpu_degraded{component=})
+        store = self.store
+        kv_deg = bool(getattr(store, "degraded", False))
+        ring_deg = bool(getattr(self.pump, "degraded_ring", False))
+        snap = self.snapshotter
+        snap_deg = bool(getattr(snap, "degraded", False))
+        flags = []
+        if kv_deg:
+            stale = store.staleness_s() if hasattr(store, "staleness_s") \
+                else 0.0
+            flags.append(f"kvstore (serving last-adopted epoch, "
+                         f"stale {stale:.1f}s)")
+        if ring_deg:
+            flags.append("ring (persistent pump fell back to dispatch "
+                         "mode)")
+        if snap_deg:
+            flags.append("snapshot (last attempt failed)")
+        lines.append("degraded: " + (", ".join(flags) if flags
+                                     else "none"))
+        if kv_deg and hasattr(store, "backoff_state"):
+            bo = store.backoff_state()
+            if bo:
+                lines.append(
+                    f"kvstore reconnect backoff: attempt "
+                    f"{bo.get('attempt', 0)}, last delay "
+                    f"{bo.get('last_delay_s', 0.0)}s "
+                    f"(base {bo.get('base_s', 0.0)}s, cap "
+                    f"{bo.get('cap_s', 0.0)}s)")
+        if ring_deg and self.pump is not None:
+            lines.append(
+                f"ring faults: "
+                f"{getattr(self.pump, '_ring_faults', 0)} "
+                f"(limit {getattr(self.pump, 'ring_fault_limit', 0)})")
+        if snap is None:
+            lines.append("snapshot: not configured")
+            return "\n".join(lines)
+        s = snap.stats_snapshot()
+        age = s["age_s"]
+        lines.append(
+            f"snapshot: generation {s['generation']}, "
+            f"age {'-' if age < 0 else f'{age:.1f}s'}, "
+            f"{s['snapshots']} published, "
+            f"{s['snapshot_failures']} failed")
+        lines.append(
+            f"snapshot chunks: {s['chunks_written']} written "
+            f"({s['bytes_written']} bytes, "
+            f"{s['chunk_seconds']:.3f}s), "
+            f"{s['chunks_skipped']} skipped clean")
+        restores = {k: v for k, v in s["restores"].items() if v}
+        lines.append(
+            "restores: " + (", ".join(f"{k} {v}" for k, v in
+                                      sorted(restores.items()))
+                            if restores else "none attempted"))
+        if s["last_error"]:
+            lines.append(f"last error: {s['last_error']}")
+        return "\n".join(lines)
 
     def show_store(self) -> str:
         """Cluster-store health as THIS agent experiences it: which

@@ -217,8 +217,26 @@ class ContivAgent:
         self.vcl_admission = None  # VclAdmissionServer when vcl_socket set
         self.mesh_runtime = None   # set by Mesh/MultiHostRuntime (show mesh)
 
+        # --- crash-consistent session snapshot/restore (ISSUE 8) ---
+        # only for a standalone (materialized) dataplane: a mesh node
+        # staging handle's session state belongs to the cluster epoch
+        self.snapshotter = None
+        if c.snapshot_path and self.dataplane.tables is not None:
+            from vpp_tpu.pipeline.snapshot import SessionSnapshotter
+
+            self.snapshotter = SessionSnapshotter(
+                self.dataplane, c.snapshot_path,
+                chunk_buckets=c.snapshot_chunk_buckets,
+                pace_s=c.snapshot_pace_s,
+            )
+
         # --- observability ---
         self.stats = StatsCollector(self.dataplane, self.container_index)
+        # degraded-mode surface: kvstore reachability/staleness +
+        # snapshot age/outcomes ride the same registry
+        self.stats.set_store(self.store)
+        if self.snapshotter is not None:
+            self.stats.set_snapshotter(self.snapshotter)
         # control-plane latency histograms: propagation SLO + txn commit
         # observed at the epoch swap, CNI add/del at the CNI server
         self.cp_metrics = register_control_plane_metrics(self.stats.registry)
@@ -273,6 +291,18 @@ class ContivAgent:
         # in __init__) before anything can send through those interfaces
         # — configureVswitchConnectivity's final txn in the reference
         self.dataplane.swap()
+        # warm restart (ISSUE 8): adopt the last crash-consistent
+        # session snapshot generation BEFORE any traffic, so
+        # established flows (and the fastpath hit rate) survive the
+        # restart; a refusal (torn/corrupt/geometry) cold-starts
+        # cleanly and the outcome counter says why
+        if self.snapshotter is not None:
+            try:
+                if self.snapshotter.restore_into():
+                    log.info("session table restored warm from %s",
+                             c.snapshot_path)
+            except Exception:
+                log.exception("session restore failed (cold start)")
         # packet-IO front-end: shared-memory rings + the dataplane pump
         # (the vpp-tpu-io daemon attaches to the same shm and owns the
         # NIC/TAP endpoints — VERDICT r1 Missing #1). Created before the
@@ -296,6 +326,7 @@ class ContivAgent:
                 mode=c.io.pump_mode,
                 ring_slots=c.io.io_ring_slots,
                 ring_windows=c.io.io_ring_windows,
+                ring_fault_limit=c.io.io_ring_fault_limit,
                 # ICMP errors (time-exceeded/unreachable) originate from
                 # the node's pod gateway address — the hop traceroute
                 # shows (reference: VPP ip4-icmp-error)
@@ -422,6 +453,7 @@ class ContivAgent:
                     session_engine=self.session_engine,
                     mesh_runtime=self.mesh_runtime,
                     store=self.store,
+                    snapshotter=self.snapshotter,
                 )
 
                 def _cli_dispatch(method: str, params: dict) -> dict:
@@ -603,6 +635,27 @@ class ContivAgent:
         except Exception:
             log.exception("session expiry failed")
         try:
+            # interval-paced incremental snapshot: dirty chunks drain
+            # off the hot path on this maintenance thread (failures
+            # mark the snapshotter degraded, never kill the tick —
+            # the liveness keepalive below must always run). A
+            # persistent-mode pump threads its session state privately
+            # through the resident ring: graft a consistent copy into
+            # dp.tables first, or the snapshot would capture the
+            # launch-time state against an advancing clock.
+            if self.snapshotter is not None and self.snapshotter.due(
+                    self.config.snapshot_interval_s):
+                # gated on the snapshot actually being due: the ring
+                # checkpoint is a full device copy of the session
+                # columns and must not run on every 5 s tick
+                sync = getattr(self.io_pump, "sync_sessions", None)
+                if callable(sync):
+                    sync()
+                self.snapshotter.maybe_snapshot(
+                    self.config.snapshot_interval_s)
+        except Exception:
+            log.exception("session snapshot failed")
+        try:
             self.stats.publish()
         except Exception:
             log.exception("stats publish failed")
@@ -662,6 +715,15 @@ class ContivAgent:
                 log.warning("host interconnect unwire failed")
         if self.stn is not None:
             self.stn.revert_all()
+        if self.snapshotter is not None:
+            # a clean shutdown's parting snapshot: the next start
+            # restores the freshest possible generation — the pump
+            # merged its final ring sessions into dp.tables above, and
+            # final_snapshot waits out any maintenance drain still in
+            # flight (which began from pre-merge state) before
+            # draining once more (best effort — failures land in the
+            # degraded counters)
+            self.snapshotter.final_snapshot()
         if self.store.persist_path:
             self.store.save()
 

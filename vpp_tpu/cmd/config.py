@@ -67,6 +67,12 @@ class IOConfig:
     #                    writeback with window N+1's rx refill)
     io_ring_slots: int = 8
     io_ring_windows: int = 2
+    # degraded-mode escape hatch (ISSUE 8; io/pump.py): after this many
+    # resident-ring deaths the persistent pump stops relaunching the
+    # device ring and falls back to the dispatch ladder (slower but
+    # alive; vpp_tpu_degraded{component="ring"} flips). 0 = never fall
+    # back: relaunch forever, paced by a jittered backoff.
+    io_ring_fault_limit: int = 3
     # node uplink (vpp-tpu-init bootstrap; reference contiv-init
     # vppcfg.go:74-559): kernel NIC the IO daemon binds as the uplink
     uplink_interface: str = ""
@@ -125,6 +131,20 @@ class AgentConfig:
     # config transaction trace (api-trace analog): JSONL journal of every
     # NB commit the live agent applies; "" disables recording
     txn_journal_path: str = ""
+    # crash-consistent session snapshot/restore (ISSUE 8;
+    # pipeline/snapshot.py): directory for the chunked snapshot files +
+    # manifest ("" disables). On start the agent restores the last
+    # published generation (established flows — and the fastpath hit
+    # rate — survive a restart warm); the maintenance loop then drains
+    # dirty chunks every ``snapshot_interval_s``. ``chunk_buckets``
+    # bounds one device→host transfer (power of two buckets of all
+    # session columns per chunk — the ~1.1 GB 10M-slot table never
+    # ships in one piece); ``snapshot_pace_s`` sleeps between chunk
+    # drains so a full drain never monopolizes the transport.
+    snapshot_path: str = ""
+    snapshot_interval_s: float = 30.0
+    snapshot_chunk_buckets: int = 4096
+    snapshot_pace_s: float = 0.0
     # node liveness lease TTL (the etcd-lease analog; peers drop a
     # node's routes when it expires). Raise where long jit compiles or
     # heavy host contention can starve the keepalive thread.

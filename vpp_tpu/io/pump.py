@@ -96,6 +96,7 @@ from vpp_tpu.pipeline.dataplane import (
     unpack_packet_input,
 )
 from vpp_tpu.pipeline.vector import Disposition, PacketVector
+from vpp_tpu.testing import faults
 
 log = logging.getLogger("pump")
 
@@ -121,7 +122,8 @@ class DataplanePump:
                  chain_k: int = 0,
                  fetch_delay: Union[None, float, Callable] = None,
                  ring_slots: int = 8,
-                 ring_windows: int = 2):
+                 ring_windows: int = 2,
+                 ring_fault_limit: int = 3):
         """``max_batch``: largest coalesced device batch (packets);
         ``max_inflight``: in-flight batches before the dispatch stage
         backpressures (``depth`` is the legacy alias — ``max_inflight``
@@ -153,7 +155,18 @@ class DataplanePump:
         geometry (frames per window / staging double-buffers —
         io/rings.py DeviceDescRing; config-static shape like
         ``sess_ways``, knobs ``io.io_ring_slots``/``io.io_ring_windows``
-        in cmd/config.py)."""
+        in cmd/config.py).
+        ``ring_fault_limit``: degraded-mode escape hatch (ISSUE 8;
+        knob ``io.io_ring_fault_limit``): after this many resident-ring
+        deaths over the pump's lifetime, persistent mode FALLS BACK to
+        the dispatch ladder instead of relaunching the ring forever —
+        a wedged device-ring path (driver fault, transfer errors) then
+        degrades to the slower-but-working mode and the
+        ``vpp_tpu_degraded{component="ring"}`` gauge says so. 0
+        disables the fallback entirely: the ring relaunches forever,
+        paced by a jittered backoff (note: the pre-ISSUE-8 code
+        relaunched exactly once and let a second death kill the
+        dispatch thread — 0 keeps the pump alive instead)."""
         if mode not in ("dispatch", "persistent"):
             raise ValueError(f"unknown pump mode {mode!r}")
         self.mode = mode
@@ -322,6 +335,21 @@ class DataplanePump:
         self.ring_windows = int(ring_windows)
         self._ring_accum = {"ring_windows": 0, "ring_frames": 0,
                             "io_callbacks": 0}
+        # ring→dispatch degraded fallback (ISSUE 8): resident-ring
+        # deaths counted over the pump lifetime (dispatch-thread-only,
+        # so unlocked); degraded_ring is the one-way flag the
+        # collector/CLI read (a plain bool flip — torn reads are
+        # impossible and the writer is the single dispatch thread)
+        self.ring_fault_limit = int(ring_fault_limit)
+        self._ring_faults = 0
+        self.degraded_ring = False
+        # pacing between ring relaunches (dispatch-thread-only): a
+        # ring dying instantly on every relaunch must not hot-spin
+        # fault→relaunch→fault — especially with ring_fault_limit=0
+        # (retry forever)
+        from vpp_tpu.net.backoff import Backoff
+
+        self._ring_backoff = Backoff(base=0.1, cap=5.0)
 
     def bucket_sizes(self) -> list:
         """The dispatch bucket ladder — precompile ``process_packed``
@@ -633,32 +661,57 @@ class DataplanePump:
         self._persist_stop_merge()
         self._persist_start()
 
-    def _persist_submit_group(self, frames: list) -> bool:
+    def _persist_submit_group(self, frames: list) -> str:
         """Pack + submit ONE compacted coalesce group (several small
         frames at sequential offsets of a single VEC descriptor slot —
         the header-compaction half of the 20 B/pkt budget) to the ring
-        pump and hand its FIFO ticket to the collector. Returns False
-        when stop() interrupted the hand-off (the frames stay held and
-        are counted as shutdown drops; the runtime frees the rings
-        next)."""
+        pump and hand its FIFO ticket to the collector. Returns "ok",
+        "stop" when stop() interrupted the hand-off (the frames stay
+        held and are counted as shutdown drops; the runtime frees the
+        rings next), or "fallback" when repeated ring deaths hit
+        ``ring_fault_limit`` (the frames are UN-held — they were never
+        ticketed, so the dispatch-mode loop that takes over re-peeks
+        and serves them; nothing is dropped by the mode switch
+        itself)."""
         tp0 = time.perf_counter()
         flat = np.zeros((PACKED_IN_ROWS, VEC), np.int32)
         non_ip = np.zeros(VEC, np.uint8)
         self._pack_group(frames, flat, non_ip)
         self.stats["t_pack"] += time.perf_counter() - tp0
         t0 = time.perf_counter()
-        try:
-            self._ppump.submit(flat, now=self.dp.clock_ticks())
-        except RuntimeError:
-            log.exception("resident loop died — relaunching")
-            self.stats["batch_errors"] += 1
-            # fold the dead ring's counters before replacing it, or
-            # the exported ring_windows/ring_frames totals would jump
-            # backwards (a spurious counter reset for scrapers)
-            self._ring_fold(self._ppump)
-            self._ppump = None
-            self._persist_start()
-            self._ppump.submit(flat, now=self.dp.clock_ticks())
+        while True:
+            try:
+                self._ppump.submit(flat, now=self.dp.clock_ticks())
+                if self._ring_backoff.attempt:
+                    self._ring_backoff.reset()
+                break
+            except RuntimeError:
+                self._ring_faults += 1
+                log.exception("resident loop died (ring fault %d%s)",
+                              self._ring_faults,
+                              f"/{self.ring_fault_limit}"
+                              if self.ring_fault_limit else "")
+                self.stats["batch_errors"] += 1
+                # fold the dead ring's counters before replacing it, or
+                # the exported ring_windows/ring_frames totals would
+                # jump backwards (a spurious counter reset for scrapers)
+                self._ring_fold(self._ppump)
+                self._ppump = None
+                if self.ring_fault_limit and \
+                        self._ring_faults >= self.ring_fault_limit:
+                    with self._held_lock:
+                        self._held -= len(frames)
+                    return "fallback"
+                time.sleep(self._ring_backoff.next())
+                try:
+                    self._persist_start()
+                except Exception:  # noqa: BLE001 — a relaunch that
+                    # cannot even start IS the wedged-ring case the
+                    # fallback exists for, whatever the limit says
+                    log.exception("resident loop relaunch failed")
+                    with self._held_lock:
+                        self._held -= len(frames)
+                    return "fallback"
         self.stats["t_dispatch"] += time.perf_counter() - t0
         # unlocked: the dispatch thread is _seq's only writer, so its
         # own read needs no lock; increments publish under _done_cv
@@ -674,7 +727,7 @@ class DataplanePump:
                     with self._lat_lock:
                         self.stats["drops_shutdown"] += sum(
                             f.n for f in frames)
-                    return False
+                    return "stop"
         # under _done_cv for the same reason as the dispatch-mode bump:
         # the writer's shutdown gate reads _seq under the cv
         with self._done_cv:
@@ -682,7 +735,7 @@ class DataplanePump:
         self.stats["batches"] += 1
         self.stats["max_coalesce"] = max(self.stats["max_coalesce"],
                                          len(frames))
-        return True
+        return "ok"
 
     def _persist_dispatch_loop(self) -> None:
         rx = self.rings.rx
@@ -711,12 +764,24 @@ class DataplanePump:
                                                max_pkts=VEC)
                     if not groups:
                         break
-                    if not self._persist_submit_group(groups[0]):
+                    st = self._persist_submit_group(groups[0])
+                    if st == "stop":
+                        return
+                    if st == "fallback":
+                        self._persist_fallback()
                         return
                     burst += 1
                     if burst >= self.max_inflight:
                         break
                 if burst == 0:
+                    # idle: a ring death with nothing left to submit
+                    # would otherwise never be counted (frames compact
+                    # into few submits, and the death lands AFTER the
+                    # last successful one) — poll the ring's health so
+                    # the fault ladder advances regardless
+                    if self._ring_check() == "fallback":
+                        self._persist_fallback()
+                        return
                     time.sleep(self.poll_s)
         finally:
             # signal the collector FIRST: every _persist_q.put this
@@ -729,6 +794,104 @@ class DataplanePump:
                 self._persist_stop_merge()
             except Exception:  # noqa: BLE001 — shutdown path
                 log.exception("persistent loop shutdown failed")
+
+    def _ring_check(self) -> str:
+        """Advance the ring-fault ladder off a DEAD-but-idle resident
+        ring (dispatch-thread only). Returns "fallback" once the limit
+        is hit (or a relaunch cannot even start), else "ok" with a
+        healthy — possibly freshly relaunched — ring in place."""
+        pp = self._ppump
+        if pp is None or not pp.failed:
+            return "ok"
+        self._ring_faults += 1
+        log.error("resident loop dead at idle (ring fault %d%s)",
+                  self._ring_faults,
+                  f"/{self.ring_fault_limit}"
+                  if self.ring_fault_limit else "")
+        self.stats["batch_errors"] += 1
+        self._ring_fold(pp)
+        self._ppump = None
+        if self.ring_fault_limit and \
+                self._ring_faults >= self.ring_fault_limit:
+            return "fallback"
+        time.sleep(self._ring_backoff.next())
+        try:
+            self._persist_start()
+        except Exception:  # noqa: BLE001 — same rule as the submit
+            # path: a relaunch that cannot start IS the wedged ring
+            log.exception("resident loop relaunch failed")
+            return "fallback"
+        return "ok"
+
+    def _persist_fallback(self) -> None:
+        """Degraded-mode escape hatch (ISSUE 8): the resident device
+        ring died ``ring_fault_limit`` times, so stop relaunching it
+        and serve traffic through the dispatch ladder instead — slower
+        (per-batch host round trips come back) but alive. Runs ON the
+        persist dispatch thread, which simply becomes the dispatch-mode
+        dispatch thread; the missing piece of the dispatch topology
+        (the concurrent fetch workers) is started here. Frames the
+        failed submit un-held are re-peeked by the ladder, and tickets
+        already in the collector's FIFO resolve as attributed
+        ``drops_error`` — the mode switch itself loses nothing.
+
+        One-way: the ring path stays off until the process restarts.
+        ``degraded_ring`` drives ``vpp_tpu_degraded{component="ring"}``
+        and `show resilience`; the first ladder dispatch pays its jit
+        compile inline (logged) — the degraded mode trades a one-time
+        stall for not being wedged."""
+        log.error("device ring failed %d times — falling back to "
+                  "dispatch mode (degraded; first ladder dispatch "
+                  "compiles inline)", self._ring_faults)
+        self.degraded_ring = True
+        self.mode = "dispatch"
+        # NOTE: ICMP error generation stays off — persistent mode
+        # zeroed icmp_src_ip at construction (self.icmp is None), so
+        # the dispatch topology taken over here has no error path to
+        # start; re-enabling it would need the agent to rebuild the
+        # pump
+        # no further ring tickets will ever be issued: let the
+        # collector drain what is queued and idle until stop()
+        self._persist_dispatch_done.set()
+        for i in range(self.workers):
+            t = threading.Thread(target=self._fetch_loop, daemon=True,
+                                 name=f"dp-pump-fetch{i}")
+            t.start()
+            self._threads.append(t)
+        self._dispatch_loop()
+
+    def sync_sessions(self, timeout: float = 30.0) -> bool:
+        """Persistent mode: graft a consistent device COPY of the
+        in-ring session state into dp.tables (ISSUE 8). The resident
+        ring threads its tables privately and only merges them back at
+        stop/epoch-restart — without this hook a long-lived ring
+        leaves dp.tables frozen at launch state, so the maintenance
+        consumers (the crash-consistent snapshotter above all, but
+        also occupancy gauges and bulk expiry) would serve stale
+        sessions against an advancing clock. Returns True when fresh
+        state landed; False (no ring, dead ring, timeout) means the
+        caller proceeds with whatever dp.tables already holds — never
+        worse than before the hook existed. Any thread may call it;
+        the copy itself happens on the ring's stager at a window
+        boundary (PersistentPump.checkpoint_sessions)."""
+        pp = self._ppump
+        if self.mode != "persistent" or pp is None:
+            return False
+        try:
+            sess = pp.checkpoint_sessions(timeout=timeout)
+        except RuntimeError:
+            return False
+        if sess is None:
+            return False
+        with self.dp._lock:
+            if self.dp.tables is None:
+                return False
+            self.dp.tables = self.dp.tables._replace(**sess)
+            # the grafted state carries stamps up to the ring's latest
+            # submit clock — advance the dataplane's session clock to
+            # match so a snapshot's rebase origin is consistent
+            self.dp._now = max(self.dp._now, self.dp.clock_ticks())
+        return True
 
     def _ring_fold(self, pp) -> None:
         """Retire a PersistentPump's monotonic ring counters into the
@@ -867,6 +1030,10 @@ class DataplanePump:
             time.sleep(delay(seq) if callable(delay) else delay)
         fast = False
         try:
+            # faults: "pump.fetch" = the device result fetch failing
+            # (transport error, wedged tunnel) — exercises the
+            # drops_error attribution + in-order release path
+            faults.fire("pump.fetch")
             if slow:
                 out_pkts, disp, tx_if, next_hop, cause = jax.device_get(
                     (payload.pkts, payload.disp, payload.tx_if,
@@ -1015,9 +1182,16 @@ class DataplanePump:
         for f in frames:
             n = f.n
             with self._tx_lock:
-                ok = self.rings.tx.push_packed(batch, off, n, f,
-                                               host_if, epoch,
-                                               self._cause)
+                try:
+                    # faults: "pump.tx_push" = a stalled tx ring (the
+                    # consumer stopped draining) — the frame takes the
+                    # drops_tx_stall path exactly like a full ring
+                    faults.fire("pump.tx_push")
+                    ok = self.rings.tx.push_packed(batch, off, n, f,
+                                                   host_if, epoch,
+                                                   self._cause)
+                except faults.FaultInjected:
+                    ok = False
             if ok:
                 self.stats["frames"] += 1
                 self.stats["pkts"] += n

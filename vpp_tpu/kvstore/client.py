@@ -34,6 +34,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from vpp_tpu.kvstore.server import decode_event
 from vpp_tpu.kvstore.store import WatchCallback
+from vpp_tpu.net.backoff import Backoff
+from vpp_tpu.testing import faults
 
 log = logging.getLogger("kvclient")
 
@@ -93,6 +95,14 @@ class RemoteKVStore:
         self._resync_rids: Dict[int, _Watch] = {}
         self._rotate_start = 0
         self._closed = False
+        # degraded-mode surface (ISSUE 8): when the connection is
+        # down the agent keeps serving its last-adopted config epoch;
+        # these let the collector/CLI export HOW stale that state may
+        # be. _disconnected_at is monotonic-clock, None while
+        # connected; _backoff_state snapshots the live reconnect
+        # pacer for `show resilience`. Both under _lock.
+        self._disconnected_at: Optional[float] = None
+        self._backoff_state: Dict[str, Any] = {}
         # HA fencing (kvstore/witness.py): the epoch learned from the
         # connected server, stamped onto every write so a superseded
         # ex-primary can never silently accept state derived from
@@ -109,7 +119,12 @@ class RemoteKVStore:
 
     # --- connection management ---
     def _connect(self, deadline: float) -> None:
-        backoff, cap = self.reconnect_backoff
+        base, cap = self.reconnect_backoff
+        # one shared pacing policy (vpp_tpu.net.backoff): jittered
+        # exponential instead of the old bare doubling, so a fleet of
+        # agents reconnecting to a restarted kvserver desynchronizes
+        # instead of arriving on the same beat
+        bo = Backoff(base, cap)
         attempt = 0
         n = len(self.endpoints)
         while True:
@@ -132,6 +147,7 @@ class RemoteKVStore:
             host, port = self.endpoints[idx]
             attempt += 1
             try:
+                faults.fire("kv.connect")
                 sock = socket.create_connection(
                     (host, port), timeout=self.request_timeout
                 )
@@ -140,6 +156,8 @@ class RemoteKVStore:
                 self.host, self.port = host, port
                 with self._lock:
                     self._rotate_start = idx
+                    self._disconnected_at = None
+                    self._backoff_state = {}
                 break
             except OSError as exc:
                 if time.monotonic() >= deadline:
@@ -147,8 +165,10 @@ class RemoteKVStore:
                         f"kvserver unreachable on {self.endpoints}: {exc}"
                     ) from exc
                 if attempt % n == 0:
-                    time.sleep(min(backoff, cap))
-                    backoff *= 2
+                    delay = bo.next()
+                    with self._lock:
+                        self._backoff_state = bo.state()
+                    time.sleep(delay)
         with self._lock:
             self._sock = sock
             self._reader = threading.Thread(
@@ -195,6 +215,8 @@ class RemoteKVStore:
             if self._sock is not sock:
                 return  # stale reader from a previous connection
             self._sock = None
+            if self._disconnected_at is None:
+                self._disconnected_at = time.monotonic()
             pending = list(self._pending.values())
             self._pending.clear()
         for q in pending:
@@ -263,6 +285,10 @@ class RemoteKVStore:
     def _request(self, op: str, _rid: Optional[int] = None, **kw: Any) -> Any:
         rid = next(self._ids) if _rid is None else _rid
         deadline = time.monotonic() + self.request_timeout
+        # per-request retry pacer (replaces the old fixed 50 ms sleeps):
+        # jittered so callers retrying through an outage spread out
+        retry_bo = Backoff(0.02, 0.25)
+        faults.fire("kv.request")
         while True:
             msg = {"id": rid, "op": op, **kw}
             # stamp writes with the fencing epoch (rebuilt every
@@ -279,7 +305,7 @@ class RemoteKVStore:
             if sock is None:
                 if self._closed or time.monotonic() >= deadline:
                     raise ConnectionError("kvserver not connected")
-                time.sleep(0.05)
+                time.sleep(retry_bo.next())
                 continue
             try:
                 # sendall can be split across multiple send() syscalls;
@@ -287,10 +313,11 @@ class RemoteKVStore:
                 # watch dispatcher, CNI handlers) could interleave partial
                 # writes and corrupt the newline-delimited stream.
                 with self._send_lock:
+                    faults.fire("kv.send")
                     sock.sendall(data)
             except OSError:
                 self._pending.pop(rid, None)
-                time.sleep(0.05)
+                time.sleep(retry_bo.next())
                 continue
             try:
                 resp = q.get(timeout=max(0.0, deadline - time.monotonic()))
@@ -322,7 +349,7 @@ class RemoteKVStore:
                     # preferred primary — and force the reconnect by
                     # dropping the socket.
                     self._rotate_endpoint()
-                    time.sleep(0.05)
+                    time.sleep(retry_bo.next())
                     continue
                 raise RuntimeError(f"kvserver error: {err}")
             return resp.get("result")
@@ -369,6 +396,35 @@ class RemoteKVStore:
         server or while a refresh is pending. Observability surface —
         `show store` reads it."""
         return self._epoch
+
+    # --- degraded-mode surface (ISSUE 8) ---
+    @property
+    def connected(self) -> bool:
+        with self._lock:
+            return self._sock is not None
+
+    @property
+    def degraded(self) -> bool:
+        """True while the kvstore is unreachable: the agent serves its
+        last-adopted epoch and the collector exports
+        ``vpp_tpu_degraded{component="kvstore"}``."""
+        with self._lock:
+            return self._sock is None and not self._closed
+
+    def staleness_s(self) -> float:
+        """Seconds the served config may lag the cluster store: 0 while
+        connected, else time since the connection was lost (the
+        ``vpp_tpu_kvstore_staleness_seconds`` gauge)."""
+        with self._lock:
+            if self._sock is not None or self._disconnected_at is None:
+                return 0.0
+            return time.monotonic() - self._disconnected_at
+
+    def backoff_state(self) -> Dict[str, Any]:
+        """Live reconnect pacer snapshot (`show resilience`): empty
+        while connected."""
+        with self._lock:
+            return dict(self._backoff_state)
 
     def get(self, key: str) -> Any:
         return self._request("get", key=key)

@@ -52,6 +52,7 @@ from typing import Any, Callable, Dict, Optional
 from vpp_tpu.kvstore.client import RemoteKVStore
 from vpp_tpu.kvstore.store import KVEvent, KVStore, Op
 from vpp_tpu.kvstore.witness import WitnessClient, WitnessUnreachable
+from vpp_tpu.net.backoff import Backoff
 
 log = logging.getLogger("kvreplica")
 
@@ -264,8 +265,17 @@ class Replicator:
         unreachable, witness says it's alive. Alternate between probing
         the primary (resume following the moment the partition heals)
         and re-claiming (promote the moment the witness-side lease
-        lapses — i.e. the primary really died)."""
-        interval = max(0.5, self.promote_after / 2.0)
+        lapses — i.e. the primary really died). Paced by the shared
+        jittered backoff (vpp_tpu.net.backoff) instead of the old fixed
+        half-interval: after a two-sided partition heals, N limbo
+        standbys re-claim spread out rather than storming the witness
+        on one beat. The cap stays at the OLD fixed interval
+        (promote_after/2), so the worst-case gap between claim
+        attempts — and with it the write-unavailability window after
+        a real primary death — never regresses past the pre-backoff
+        cadence; the jitter only spreads attempts below it."""
+        bo = Backoff(base=max(0.25, self.promote_after / 8.0),
+                     cap=max(0.5, self.promote_after / 2.0))
         try:
             while not (self.promoted.is_set() or self._stopped.is_set()):
                 # claim first — it answers in one witness round trip,
@@ -284,7 +294,7 @@ class Replicator:
                 # stream can't double-apply events either.
                 if self._try_refollow():
                     return
-                if self._stopped.wait(timeout=interval):
+                if self._stopped.wait(timeout=bo.next()):
                     return
         finally:
             with self._lock:

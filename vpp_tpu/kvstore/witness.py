@@ -403,13 +403,28 @@ class PrimaryGuard:
                 log.exception("on_demote callback failed")
 
     def _loop(self) -> None:
+        from vpp_tpu.net.backoff import Backoff
+
         interval = max(0.05, self.ttl * self.TICK_FRACTION)
-        while not self._stop.wait(interval):
+        # failed renewals retry on the shared jittered backoff, CAPPED
+        # at the regular tick: retrying sooner than the fixed cadence
+        # raises the odds of proving authority before the
+        # DEMOTE_FRACTION deadline (a demote-then-heal blip is a
+        # read-only outage), while the jitter keeps a fleet of guards
+        # behind one flapping witness from re-probing it in lockstep.
+        # The demote-deadline math above is untouched: it keys off
+        # wall-clock overdue time, not attempt count.
+        bo = Backoff(base=interval / 4.0, cap=interval)
+        wait = interval
+        while not self._stop.wait(wait):
             if self.superseded.is_set():
                 return
             try:
                 self._renew_once()
+                bo.reset()
+                wait = interval
             except WitnessUnreachable as exc:
+                wait = bo.next()
                 overdue = time.monotonic() - self._last_ok
                 if (not self._unproven
                         and overdue > self.DEMOTE_FRACTION * self.ttl):

@@ -1,5 +1,10 @@
-"""Host networking helpers: veth/netns plumbing and netlink-style ops."""
+"""Host networking helpers: veth/netns plumbing, netlink-style ops,
+and the shared retry pacing policy (net.backoff)."""
 
+from vpp_tpu.net.backoff import (  # noqa: F401
+    Backoff,
+    backoff_with_jitter,
+)
 from vpp_tpu.net.linux import (  # noqa: F401
     IpCmdError,
     create_veth,

@@ -714,6 +714,30 @@ class Dataplane:
             )
         return epoch
 
+    def adopt_sessions(self, sessions) -> int:
+        """Publish restored session state into the live tables (the
+        crash-consistent snapshot restore path, pipeline/snapshot.py).
+
+        ``sessions`` is a ``{field: host array}`` mapping of
+        SESSION_FIELDS; the upload routes through
+        ``TableBuilder.to_device(sessions=...)`` so it follows the same
+        carry-over contract as an epoch swap (shape validation, config
+        groups served from the device cache — nothing but the session
+        columns ships). The epoch bumps so a persistent-mode pump
+        restarts its resident ring against the restored state. Call at
+        agent start, right after the base-config swap and before
+        traffic — the builder must hold no unpublished staging (this
+        path would publish it early)."""
+        with self._lock:
+            if self.tables is None:
+                raise RuntimeError(
+                    "this Dataplane is a staging handle managed by a "
+                    "ClusterDataplane; session restore is not supported "
+                    "on cluster node handles")
+            self.tables = self.builder.to_device(sessions=sessions)
+            self.epoch += 1
+            return self.epoch
+
     # --- VXLAN edge (cluster-boundary peers; TPU↔TPU rides ICI instead) ---
     def set_vtep(self, vtep_ip: int) -> None:
         """Set this node's VXLAN tunnel endpoint address (the reference's

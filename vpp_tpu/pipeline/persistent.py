@@ -56,6 +56,7 @@ from vpp_tpu.pipeline.dataplane import (
     PACKED_IN_ROWS,
     _jitted_step,
 )
+from vpp_tpu.testing import faults
 
 assert DESC_ROWS == PACKED_IN_ROWS, (
     "io/rings.py DESC_ROWS must track pipeline.dataplane.PACKED_IN_ROWS"
@@ -153,6 +154,14 @@ class PersistentPump:
         if self._error is not None:
             raise RuntimeError("persistent loop died") from self._error
 
+    @property
+    def failed(self) -> bool:
+        """True once either ring thread has died. The owning pump's
+        dispatch loop polls this between bursts so a death with no
+        pending submit still counts toward the ring-fault fallback
+        (a wedged ring must not hide behind an idle rx queue)."""
+        return self._error is not None
+
     def submit(self, flat: np.ndarray, now: int) -> None:
         """Queue one packed [5, B] frame; ``now`` is its per-slot
         timestamp (must be >= 0). The frame is COPIED — callers may
@@ -160,6 +169,29 @@ class PersistentPump:
         assert now >= 0
         self._check_error()
         self._in.put((int(now), np.array(flat, np.int32, copy=True)))
+
+    def checkpoint_sessions(self, timeout: float = 30.0):
+        """Consistent DEVICE COPY of the in-ring session state, taken
+        by the stager BETWEEN windows (the ring threads its tables
+        privately and donates them window-to-window, so an outside
+        reader can neither see them nor safely hold a reference — a
+        copy at a window boundary is the only coherent read). The
+        crash-consistent snapshotter's freshness hook
+        (io/pump.py sync_sessions): without it, a long-lived ring
+        would leave dp.tables frozen at launch state and every
+        interval snapshot would capture stale sessions against an
+        advancing clock. Returns a {field: device array} dict of
+        SESSION_FIELDS, or None when the ring is stopping/dead or the
+        wait times out (callers skip the sync — no worse than the
+        pre-hook behavior)."""
+        if self._error is not None:
+            return None
+        ev = threading.Event()
+        box: dict = {}
+        self._in.put(("ckpt", ev, box))
+        if not ev.wait(timeout):
+            return None
+        return box.get("sessions")
 
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
         return self.result_ex(timeout=timeout)[0]
@@ -200,6 +232,23 @@ class PersistentPump:
             self._tables_pending = None
         return self._tables_final
 
+    @staticmethod
+    def _is_ckpt(item) -> bool:
+        return (isinstance(item, tuple) and len(item) == 3
+                and item[0] == "ckpt")
+
+    @staticmethod
+    def _serve_ckpt(item, tables) -> None:
+        """Fulfil one checkpoint_sessions request against the current
+        between-windows carry (its buffers are live until the next
+        dispatch donates them — the copy must land before that)."""
+        from vpp_tpu.pipeline.tables import SESSION_FIELDS
+
+        _, ev, box = item
+        box["sessions"] = {f: jnp.copy(getattr(tables, f))
+                           for f in SESSION_FIELDS}
+        ev.set()
+
     # --- stager: refill queue -> staged windows -> device dispatch ---
     def _stage_loop(self) -> None:
         # the window program donates its whole carry (tables + cursor),
@@ -215,6 +264,12 @@ class PersistentPump:
             stopping = False
             while not stopping:
                 item = self._in.get()
+                # session checkpoints at the window boundary: served
+                # against the current carry, whose buffers are valid
+                # exactly here (the next dispatch donates them)
+                while self._is_ckpt(item):
+                    self._serve_ckpt(item, tables)
+                    item = self._in.get()
                 if item is None:
                     break
                 # a free window, or None while the fetch side is wedged
@@ -227,6 +282,7 @@ class PersistentPump:
                         return
                 widx, desc, nows = got
                 n = 0
+                pending_ckpt = None
                 # adaptive fill: drain whatever is already queued up to
                 # the window size, never wait for more — a lone frame
                 # ships in a 1-slot window (latency floor), a backlog
@@ -245,13 +301,26 @@ class PersistentPump:
                     if item is None:
                         stopping = True
                         break
+                    if self._is_ckpt(item):
+                        # close the window here; the request is served
+                        # below against the POST-window carry (also a
+                        # window boundary — still a consistent copy)
+                        pending_ckpt = item
+                        break
                 # ONE async dispatch ships the window; the tx ring +
-                # aux ride back in the fetcher's one result fetch
+                # aux ride back in the fetcher's one result fetch.
+                # faults: "ring.dispatch" stands in for a device
+                # transfer error here — it kills this stager exactly
+                # like a real dispatch failure, which is what arms the
+                # pump's ring→dispatch degraded fallback
+                faults.fire("ring.dispatch")
                 tables, cursor, tx_ring, aux_ring = self._step(
                     tables, cursor, desc, nows, np.int32(n))
                 with self._stats_lock:
                     self.stats["windows_dispatched"] += 1
                 self._fetch_q.put((widx, n, tx_ring, aux_ring))
+                if pending_ckpt is not None:
+                    self._serve_ckpt(pending_ckpt, tables)
             self._tables_pending = tables
         except BaseException as e:  # noqa: BLE001 — re-raised to the
             # caller from result()/stop(); a silently dead pump would
@@ -261,6 +330,16 @@ class PersistentPump:
             if self._tables_pending is None and self._error is None:
                 self._tables_pending = tables
             self._fetch_q.put(_SENTINEL)
+            # unblock checkpoint requesters stranded behind the stop
+            # sentinel (or a stager death): their wait would otherwise
+            # run to its timeout for nothing
+            while True:
+                try:
+                    item = self._in.get_nowait()
+                except queue.Empty:
+                    break
+                if self._is_ckpt(item):
+                    item[1].set()  # no "sessions" key = declined
 
     # --- fetcher: one result fetch per window, per-frame hand-off ---
     def _fetch_loop(self) -> None:
@@ -272,6 +351,8 @@ class PersistentPump:
                 widx, n, tx_ring, aux_ring = item
                 # the window's ONE device->host transfer: tx
                 # descriptors + per-slot aux summaries together
+                # (faults: "ring.fetch" = the transfer failing)
+                faults.fire("ring.fetch")
                 out_h, aux_h = jax.device_get((tx_ring, aux_ring))
                 out_h = np.asarray(out_h)
                 aux_h = np.asarray(aux_h)

@@ -1,0 +1,601 @@
+"""Crash-consistent incremental session snapshot/restore (ISSUE 8).
+
+A production gateway holding 10M+ resident sessions (docs/SESSIONS.md)
+must survive an agent restart without dropping them: the fastpath hit
+rate — and with it the headline throughput — collapses to zero while
+every flow re-establishes. ROADMAP item 2 left "incremental
+snapshot/restore of the 10M-slot table" open; this module closes it.
+
+Design, shaped by the same constraints as the sweep/ring work:
+
+* **The ~1.1 GB table never ships in one transfer and never stalls the
+  fused step.** The table is split into fixed BUCKET-RANGE chunks
+  (``chunk_buckets`` buckets of all session columns — config-static
+  shape, like ``sess_ways``). Each drained chunk is ONE bounded
+  device→host fetch of a few MB, paced (``pace_s``) off the hot path
+  in the agent's maintenance thread. The step never blocks: the
+  snapshotter grabs ONE immutable tables reference under the dataplane
+  lock and drains from that epoch while traffic keeps publishing new
+  ones — the functional-pytree analog of a consistent point-in-time
+  snapshot, for free.
+* **Incremental via content digests, not a hot-path dirty bitmap.**
+  Each snapshot computes a per-chunk content digest ON DEVICE (one
+  O(table) elementwise pass + a [n_chunks] reduction — no transfer
+  beyond n_chunks words) and drains only chunks whose digest moved
+  since the last published manifest. An insert-time dirty-scatter was
+  considered (piggybacked on the ``session_sweep`` walk) and rejected:
+  it taxes every insert to speed up a maintenance-cadence operation,
+  and clearing dirty bits races concurrent steps — content digests
+  are computed against the immutable snapshot reference, so they
+  cannot miss or double-report a write. The digest is a 32-bit mix
+  (position-weighted sum of per-slot column folds): collision odds
+  per chunk per snapshot are ~2^-32 — a stale-chunk *non-ship* needs
+  a colliding digest in the SAME chunk slot, which is noise next to
+  the torn-write windows this module actually closes.
+* **Crash consistency by construction.** Chunk files are written and
+  fsync'd FIRST; the manifest (which alone gives chunks meaning) is
+  published LAST via write-tmp → fsync → atomic ``os.replace``. A
+  crash at any point leaves the previous manifest generation fully
+  intact: a trailing torn chunk is an unreferenced file, GC'd by the
+  next successful snapshot (the torn-journal discipline of
+  pipeline/txn.py, applied to bulk state). Every chunk carries a CRC32
+  — a referenced chunk that fails its CRC at restore (bit rot, truncation
+  under the manifest's feet) refuses the WHOLE restore cleanly: the
+  dataplane cold-starts instead of serving a half-restored table.
+* **Restore rides the epoch-swap path.** ``restore_into`` loads the
+  manifest generation, rebases timestamps to the new process's clock
+  (``time' = time - snap_now``: ages are preserved, so an entry with
+  200 s of idle age at snapshot still expires 100 s after a restart
+  with a 300 s timeout) and publishes through
+  ``TableBuilder.to_device(sessions=...)`` — the same SESSION_FIELDS
+  contract an epoch swap's carry-over uses.
+
+Fault points (vpp_tpu/testing/faults.py): ``snapshot.chunk`` fires
+inside a chunk write and leaves a torn file; ``snapshot.manifest``
+fires before the atomic rename — both simulate a crash mid-snapshot
+for the chaos schedules in tests/test_chaos.py.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import logging
+import os
+import struct
+import threading
+import time
+import zlib
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from vpp_tpu.pipeline.tables import (
+    SESSION_FIELDS,
+    _SESSION_SHAPE,
+    natsess_slots_of,
+    session_shapes,
+)
+from vpp_tpu.testing import faults
+
+log = logging.getLogger("vpp_tpu.snapshot")
+
+MANIFEST = "manifest.json"
+FORMAT_VERSION = 1
+_MAGIC = b"VPPSNAP1"
+_HDR = struct.Struct("<8sII")  # magic, crc32(payload), payload length
+
+# per-table column lists, in SESSION_FIELDS order (the single source of
+# chunk payload layout — restore relies on the same iteration order)
+TABLE_COLS: Dict[str, Tuple[str, ...]] = {
+    "sess": tuple(k for k in SESSION_FIELDS
+                  if _SESSION_SHAPE[k] == "sess"),
+    "natsess": tuple(k for k in SESSION_FIELDS
+                     if _SESSION_SHAPE[k] == "natsess"),
+}
+SCALAR_FIELDS: Tuple[str, ...] = tuple(
+    k for k in SESSION_FIELDS if _SESSION_SHAPE[k] == "scalar")
+
+# restore outcome reasons (the label axis of
+# vpp_tpu_snapshot_restore_total; stats/collector.py exports all of
+# them so an absent outcome is a visible 0, not a missing series)
+RESTORE_OUTCOMES = (
+    "restored", "no_manifest", "bad_manifest", "version", "geometry",
+    "missing_chunk", "crc_mismatch", "error",
+)
+
+
+@functools.lru_cache(maxsize=8)
+def _fetch_fn(chunk_buckets: int):
+    """Jitted bounded chunk drain for one bucket-range: stacks every
+    column's ``[chunk_buckets, W]`` slice into ONE ``[C, CB, W]`` int32
+    block, so a chunk costs exactly one device→host fetch. ``start``
+    is a traced scalar — draining the whole ring never retraces."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def fetch(cols, start):
+        rows = [
+            lax.dynamic_slice(
+                c, (start, jnp.int32(0)), (chunk_buckets, c.shape[1]))
+            for c in cols
+        ]
+        return jnp.stack(
+            [lax.bitcast_convert_type(r, jnp.int32) for r in rows])
+
+    return jax.jit(fetch)
+
+
+@functools.lru_cache(maxsize=8)
+def _digest_fn(chunk_buckets: int):
+    """Jitted per-chunk content digest: fold all columns elementwise
+    (multiplicative mix), finalize per slot, then position-weight and
+    sum within each chunk so reorderings inside a chunk change the
+    digest. Returns ``[n_chunks]`` uint32 — the only bytes that cross
+    the transport when nothing changed."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def digest(cols):
+        acc = None
+        for c in cols:
+            u = lax.bitcast_convert_type(c, jnp.uint32)
+            u = u.reshape(u.shape[0] // chunk_buckets, -1)
+            acc = u if acc is None else acc * jnp.uint32(0x9E3779B1) + u
+        e = acc ^ (acc >> 15)
+        e = e * jnp.uint32(0x2545F491)
+        e = e ^ (e >> 13)
+        m = e.shape[1]
+        pos = (jnp.arange(m, dtype=jnp.uint32) << 1) | jnp.uint32(1)
+        return jnp.sum(e * pos[None, :], axis=1, dtype=jnp.uint32)
+
+    return jax.jit(digest)
+
+
+def _chunk_name(table: str, idx: int, gen: int) -> str:
+    return f"{table}-{idx:05d}-g{gen}.chunk"
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY: file-content fsyncs alone don't make the
+    directory entries durable, and a power loss could otherwise leave
+    a published manifest pointing at chunk files whose dir entries
+    never landed (while GC already unlinked the previous generation's)
+    — exactly the no-restorable-generation hole the chunks-first/
+    manifest-last ordering exists to close. Best effort: some
+    filesystems refuse O_RDONLY-fsync on directories."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _geometry_of(config) -> Dict[str, int]:
+    ways = int(getattr(config, "sess_ways", 4))
+    return {
+        "sess_slots": int(config.sess_slots),
+        "sess_ways": ways,
+        "natsess_slots": int(natsess_slots_of(config)),
+    }
+
+
+class SessionSnapshotter:
+    """Owns one snapshot directory for one dataplane.
+
+    Thread model: ``snapshot()``/``maybe_snapshot()`` run on ONE caller
+    (the agent maintenance thread); a concurrent call returns None
+    instead of stacking drains. ``stats_snapshot()`` and the degraded
+    flag are safe from any thread (CLI/collector). The long drain works
+    entirely on locals; ``self`` state flips under ``_lock`` only at
+    the edges.
+    """
+
+    def __init__(self, dataplane, directory: str,
+                 chunk_buckets: int = 4096, pace_s: float = 0.0):
+        self.dp = dataplane
+        self.directory = directory
+        if chunk_buckets <= 0 or (chunk_buckets & (chunk_buckets - 1)):
+            raise ValueError(
+                f"snapshot_chunk_buckets must be a power of two, got "
+                f"{chunk_buckets}")
+        self.chunk_buckets = int(chunk_buckets)
+        self.pace_s = float(pace_s)
+        self._lock = threading.Lock()
+        self._snapping = False
+        # last successfully PUBLISHED manifest (dict) — the diff base
+        # for incremental drains; loaded from disk at ctor so the first
+        # snapshot after a process restart is already incremental
+        self._manifest: Optional[dict] = None
+        self.stats = {
+            "generation": 0,
+            "snapshots": 0,
+            "snapshot_failures": 0,
+            "consecutive_failures": 0,
+            "chunks_written": 0,
+            "chunks_skipped": 0,
+            "bytes_written": 0,
+            "chunk_seconds": 0.0,
+            "last_snapshot_wall": 0.0,
+            "last_error": "",
+            "restore_outcome": "",
+            "restores": {k: 0 for k in RESTORE_OUTCOMES},
+        }
+        os.makedirs(directory, exist_ok=True)
+        m = self._load_manifest()
+        if isinstance(m, dict):  # "bad" sentinel = present-but-torn:
+            # the next snapshot starts a fresh generation history
+            with self._lock:
+                self._manifest = m
+                self.stats["generation"] = int(m.get("generation", 0))
+                self.stats["last_snapshot_wall"] = float(
+                    m.get("t_wall", 0.0))
+
+    # --- observability ---
+    @property
+    def degraded(self) -> bool:
+        """True while the most recent snapshot attempt failed — the
+        ``vpp_tpu_degraded{component="snapshot"}`` signal."""
+        with self._lock:
+            return self.stats["consecutive_failures"] > 0
+
+    def due(self, interval_s: float) -> bool:
+        """Whether maybe_snapshot(interval_s) would drain now — lets
+        the agent pay pre-drain work (the persistent pump's session
+        sync, a full device copy) only when a snapshot is actually
+        coming, not on every maintenance tick."""
+        with self._lock:
+            last = self.stats["last_snapshot_wall"]
+        return not last or time.time() - last >= interval_s
+
+    def stats_snapshot(self) -> dict:
+        with self._lock:
+            s = dict(self.stats)
+            s["restores"] = dict(self.stats["restores"])
+        s["age_s"] = (time.time() - s["last_snapshot_wall"]
+                      if s["last_snapshot_wall"] else -1.0)
+        return s
+
+    # --- snapshot (writer side) ---
+    def maybe_snapshot(self, interval_s: float) -> Optional[int]:
+        """Interval-paced snapshot for the maintenance tick: drains
+        only when the last published generation is older than
+        ``interval_s``. Returns the new generation or None."""
+        if not self.due(interval_s):
+            return None
+        return self.snapshot()
+
+    def final_snapshot(self, timeout: float = 120.0) -> Optional[int]:
+        """The parting snapshot for a clean shutdown: unlike
+        ``snapshot()`` it WAITS OUT an in-flight maintenance drain
+        (which started from pre-merge state) and then drains once
+        more, so the generation on disk includes everything the pump
+        merged back at stop. Returns the generation, or None on a
+        real failure (already counted) or timeout."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            gen = self.snapshot()
+            if gen is not None:
+                return gen
+            with self._lock:
+                in_flight = self._snapping
+            if not in_flight:
+                return None  # our own attempt ran and failed
+            time.sleep(0.1)
+        return None
+
+    def snapshot(self) -> Optional[int]:
+        """Drain dirty chunks and publish a new manifest generation.
+        Returns the generation, or None when a snapshot is already in
+        flight. Failures (including injected ones) mark the
+        snapshotter degraded and re-raise nothing — a broken disk must
+        not take the maintenance loop (and with it liveness
+        keepalives) down; the error is exported instead."""
+        with self._lock:
+            if self._snapping:
+                return None
+            self._snapping = True
+            prev = self._manifest
+            gen = self.stats["generation"] + 1
+        try:
+            manifest = self._drain(gen, prev)
+            with self._lock:
+                self._manifest = manifest
+                self.stats["generation"] = gen
+                self.stats["snapshots"] += 1
+                self.stats["consecutive_failures"] = 0
+                self.stats["last_error"] = ""
+                self.stats["last_snapshot_wall"] = manifest["t_wall"]
+            self._gc(manifest)
+            return gen
+        except Exception as e:  # noqa: BLE001 — degraded, not fatal
+            log.exception("session snapshot failed (generation %d)", gen)
+            with self._lock:
+                self.stats["snapshot_failures"] += 1
+                self.stats["consecutive_failures"] += 1
+                self.stats["last_error"] = f"{type(e).__name__}: {e}"
+            return None
+        finally:
+            with self._lock:
+                self._snapping = False
+
+    def _drain(self, gen: int, prev: Optional[dict]) -> dict:
+        import jax
+        import jax.numpy as jnp
+
+        dp = self.dp
+        # ONE immutable epoch reference: every chunk of this manifest
+        # generation comes from the same tables pytree, so the
+        # snapshot is point-in-time consistent by construction even
+        # while traffic keeps publishing newer epochs
+        with dp._lock:
+            tables = dp.tables
+            if tables is None:
+                raise RuntimeError(
+                    "staging handle has no live tables to snapshot")
+            now = max(dp._now, dp.clock_ticks())
+        geometry = _geometry_of(dp.config)
+        prev_ok = (prev is not None
+                   and prev.get("version") == FORMAT_VERSION
+                   and prev.get("config") == geometry
+                   and prev.get("chunk_buckets") == self.chunk_buckets)
+        manifest = {
+            "version": FORMAT_VERSION,
+            "generation": gen,
+            "now": int(now),
+            "t_wall": time.time(),
+            "config": geometry,
+            "chunk_buckets": self.chunk_buckets,
+            "scalars": {},
+            "tables": {},
+        }
+        for f in SCALAR_FIELDS:
+            manifest["scalars"][f] = int(np.asarray(getattr(tables, f)))
+        written = skipped = wbytes = 0
+        t_chunks = 0.0
+        for table, fields in TABLE_COLS.items():
+            cols = tuple(getattr(tables, f) for f in fields)
+            n_buckets = int(cols[0].shape[0])
+            cb = min(self.chunk_buckets, n_buckets)
+            n_chunks = n_buckets // cb
+            digests = np.asarray(_digest_fn(cb)(cols))
+            valid = tables.sess_valid if table == "sess" \
+                else tables.natsess_valid
+            flagged = int(np.asarray(jnp.sum(valid)))
+            prev_chunks = (prev["tables"][table]["chunks"]
+                           if prev_ok and table in prev.get("tables", {})
+                           else None)
+            fetch = _fetch_fn(cb)
+            entries = []
+            for idx in range(n_chunks):
+                d = int(digests[idx])
+                if prev_chunks is not None and \
+                        prev_chunks[idx]["digest"] == d:
+                    # content unchanged since the published generation:
+                    # the old file keeps serving this chunk
+                    entries.append(dict(prev_chunks[idx]))
+                    skipped += 1
+                    continue
+                t0 = time.perf_counter()
+                block = np.asarray(
+                    jax.device_get(fetch(cols, np.int32(idx * cb))))
+                payload = block.tobytes()
+                name = _chunk_name(table, idx, gen)
+                crc = self._write_chunk(
+                    os.path.join(self.directory, name), payload)
+                t_chunks += time.perf_counter() - t0
+                entries.append({"file": name, "digest": d, "crc": crc,
+                                "start": idx * cb})
+                written += 1
+                wbytes += len(payload)
+                if self.pace_s:
+                    time.sleep(self.pace_s)
+            manifest["tables"][table] = {
+                "chunk_buckets": cb,
+                "n_chunks": n_chunks,
+                "flagged": flagged,
+                "chunks": entries,
+            }
+        self._publish_manifest(manifest)
+        with self._lock:
+            self.stats["chunks_written"] += written
+            self.stats["chunks_skipped"] += skipped
+            self.stats["bytes_written"] += wbytes
+            self.stats["chunk_seconds"] += t_chunks
+        return manifest
+
+    @staticmethod
+    def _write_chunk(path: str, payload: bytes) -> int:
+        """One chunk file: header (magic, crc32, length) + payload,
+        fsync'd. The ``snapshot.chunk`` fault fires mid-write and
+        leaves a TORN file behind — exactly what a crash between the
+        header and the tail produces — before aborting the snapshot;
+        the file is unreferenced (no manifest points at it yet), so
+        restore keeps working from the previous generation."""
+        crc = zlib.crc32(payload) & 0xFFFFFFFF
+        with open(path, "wb") as f:
+            f.write(_HDR.pack(_MAGIC, crc, len(payload)))
+            try:
+                faults.fire("snapshot.chunk")
+            except BaseException:
+                f.write(payload[: len(payload) // 2])
+                f.flush()
+                raise
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        return crc
+
+    def _publish_manifest(self, manifest: dict) -> None:
+        """tmp → fsync → atomic rename: the manifest flip IS the
+        commit point. The ``snapshot.manifest`` fault fires before the
+        rename (crash with every chunk durable but the generation
+        unpublished — the previous generation stays the truth)."""
+        path = os.path.join(self.directory, MANIFEST)
+        # every chunk's CONTENT is fsync'd; make their directory
+        # entries durable BEFORE the manifest can reference them
+        _fsync_dir(self.directory)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        faults.fire("snapshot.manifest")
+        os.replace(tmp, path)
+        # ...and the rename itself (the commit point) likewise
+        _fsync_dir(self.directory)
+
+    def _gc(self, manifest: dict) -> None:
+        """Delete chunk files the just-published manifest no longer
+        references (superseded generations, torn leftovers). Best
+        effort — an undeletable file costs disk, never correctness."""
+        live = {e["file"] for t in manifest["tables"].values()
+                for e in t["chunks"]}
+        try:
+            for name in os.listdir(self.directory):
+                if name.endswith(".chunk") and name not in live:
+                    try:
+                        os.unlink(os.path.join(self.directory, name))
+                    except OSError:
+                        pass
+                elif name.endswith(".tmp"):
+                    try:
+                        os.unlink(os.path.join(self.directory, name))
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+
+    # --- restore (reader side) ---
+    def _load_manifest(self) -> Optional[dict]:
+        path = os.path.join(self.directory, MANIFEST)
+        try:
+            with open(path) as f:
+                m = json.load(f)
+        except FileNotFoundError:
+            return None
+        except (OSError, json.JSONDecodeError, ValueError):
+            return "bad"  # sentinel: present but unreadable
+        return m if isinstance(m, dict) else "bad"
+
+    def _count_restore(self, outcome: str, detail: str = "") -> None:
+        with self._lock:
+            self.stats["restore_outcome"] = outcome
+            self.stats["restores"][outcome] = \
+                self.stats["restores"].get(outcome, 0) + 1
+            if detail:
+                self.stats["last_error"] = detail
+        if outcome != "restored":
+            log.warning("session restore: %s%s", outcome,
+                        f" ({detail})" if detail else "")
+
+    def restore(self) -> Tuple[Optional[Dict[str, np.ndarray]], str]:
+        """Load the last published generation into host session
+        arrays. Returns ``(sessions, outcome)`` — sessions is None on
+        any refusal, and a refusal is always CLEAN: either the whole
+        generation loads and verifies, or the caller cold-starts. A
+        half-restored table (some chunks new, some stale or zero) is
+        the one state this path must never produce — misdelivering
+        NAT replies is worse than re-establishing flows."""
+        m = self._load_manifest()
+        if m is None:
+            self._count_restore("no_manifest")
+            return None, "no_manifest"
+        if m == "bad":
+            self._count_restore("bad_manifest")
+            return None, "bad_manifest"
+        if m.get("version") != FORMAT_VERSION:
+            self._count_restore("version",
+                                f"manifest version {m.get('version')!r}")
+            return None, "version"
+        geometry = _geometry_of(self.dp.config)
+        if m.get("config") != geometry:
+            self._count_restore(
+                "geometry",
+                f"snapshot {m.get('config')} != configured {geometry}")
+            return None, "geometry"
+        snap_now = int(m.get("now", 0))
+        shapes = session_shapes(self.dp.config)
+        sessions: Dict[str, np.ndarray] = {}
+        try:
+            for table, fields in TABLE_COLS.items():
+                tinfo = m["tables"][table]
+                cb = int(tinfo["chunk_buckets"])
+                arrs = {f: np.zeros(shapes[f], SESSION_FIELDS[f])
+                        for f in fields}
+                for entry in tinfo["chunks"]:
+                    block = self._read_chunk(entry, len(fields), cb,
+                                             shapes[fields[0]][1])
+                    if block is None:
+                        self._count_restore(
+                            "crc_mismatch",
+                            f"chunk {entry['file']} failed verification")
+                        return None, "crc_mismatch"
+                    start = int(entry["start"])
+                    for i, f in enumerate(fields):
+                        arrs[f][start:start + cb] = \
+                            block[i].view(SESSION_FIELDS[f])
+                sessions.update(arrs)
+        except FileNotFoundError as e:
+            self._count_restore("missing_chunk", str(e))
+            return None, "missing_chunk"
+        except Exception as e:  # noqa: BLE001 — clean refusal, never half
+            self._count_restore("error", f"{type(e).__name__}: {e}")
+            return None, "error"
+        # rebase timestamps onto the new process's clock: ages are
+        # preserved (time' = time - snap_now is <= 0, and the new
+        # process's ticks start at 0), so idle-expiry semantics carry
+        # straight across the restart
+        for f in ("sess_time", "natsess_time"):
+            sessions[f] = (
+                sessions[f].astype(np.int64) - snap_now
+            ).astype(np.int32)
+        for f in SCALAR_FIELDS:
+            sessions[f] = np.int32(m["scalars"].get(f, 0))
+        self._count_restore("restored")
+        return sessions, "restored"
+
+    def _read_chunk(self, entry: dict, n_cols: int, cb: int,
+                    ways: int) -> Optional[np.ndarray]:
+        """Read + verify one chunk file; None on any mismatch (torn
+        header, truncated payload, CRC failure, manifest/file CRC
+        disagreement)."""
+        path = os.path.join(self.directory, entry["file"])
+        want = n_cols * cb * ways * 4
+        with open(path, "rb") as f:
+            hdr = f.read(_HDR.size)
+            if len(hdr) != _HDR.size:
+                return None
+            magic, crc, length = _HDR.unpack(hdr)
+            if magic != _MAGIC or length != want or \
+                    crc != int(entry["crc"]):
+                return None
+            payload = f.read(length + 1)
+        if len(payload) != length or \
+                (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+            return None
+        return np.frombuffer(payload, np.int32).reshape(
+            n_cols, cb, ways)
+
+    def restore_into(self, dataplane=None) -> bool:
+        """Restore the last generation into the dataplane's live
+        epoch (via ``TableBuilder.to_device(sessions=...)`` — the swap
+        carry-over contract). Returns True when the table came back
+        warm; False means a clean cold start (reason in the restore
+        outcome counter). Call right after the base-config swap and
+        before traffic is offered."""
+        dp = dataplane if dataplane is not None else self.dp
+        sessions, outcome = self.restore()
+        if sessions is None:
+            return False
+        dp.adopt_sessions(sessions)
+        log.info("session table restored warm: generation %d (%s)",
+                 self.stats["generation"], outcome)
+        return True

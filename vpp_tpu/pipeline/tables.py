@@ -1073,9 +1073,17 @@ class TableBuilder:
             nat_snat_ip=self.nat_snat_ip,
         )
 
-    def to_device(self, sessions: Optional[DataplaneTables] = None) -> DataplaneTables:
+    def to_device(self, sessions=None) -> DataplaneTables:
         """Produce the immutable device pytree. If ``sessions`` (a previous
         epoch's tables) is given, its live session arrays are carried over.
+
+        ``sessions`` may also be a ``{field: host array}`` mapping of
+        SESSION_FIELDS (the crash-consistent snapshot restore path,
+        pipeline/snapshot.py): the arrays are uploaded and a restarted
+        agent's established flows come back warm. Shapes must match the
+        config geometry — the snapshot loader already refused a
+        geometry mismatch, so a bad shape here is a programming error
+        and raises.
 
         Incremental: only fields of groups mutated since the previous
         call are re-uploaded; clean groups reuse the cached device
@@ -1085,7 +1093,22 @@ class TableBuilder:
         pytree produced here into a jit (donate_argnums) if you will
         swap again afterwards: donation invalidates the cached buffers
         the next swap would reuse."""
-        if sessions is not None:
+        if isinstance(sessions, dict):
+            missing = set(SESSION_FIELDS) - set(sessions)
+            if missing:
+                raise ValueError(
+                    f"restored session state missing fields: "
+                    f"{sorted(missing)}")
+            shapes = session_shapes(self.config)
+            for f, arr in sessions.items():
+                if tuple(np.shape(arr)) != shapes[f]:
+                    raise ValueError(
+                        f"restored session field {f!r} shape "
+                        f"{tuple(np.shape(arr))} != configured "
+                        f"{shapes[f]}")
+            sess = {f: jnp.asarray(np.asarray(sessions[f], dt))
+                    for f, dt in SESSION_FIELDS.items()}
+        elif sessions is not None:
             # carry-over is BY REFERENCE: the live device arrays flow
             # into the new epoch untouched — at 10M slots the session
             # state is ~100s of MB and must never re-ship on a swap

@@ -129,6 +129,15 @@ PUMP_STAGE_SECONDS = (
 # ops/acl_mxu.py, ops/acl_bv.py).
 CLASSIFIER_IMPLS = ("dense", "mxu", "bv")
 
+# Degraded-mode components the vpp_tpu_degraded gauge enumerates
+# (ISSUE 8): kvstore = the cluster store is unreachable (the agent
+# serves its last-adopted epoch; staleness exported next to it),
+# ring = the persistent pump fell back from the device ring to the
+# dispatch ladder, snapshot = the last snapshot attempt failed. Every
+# component always exports (0 = healthy) so an absent series is a
+# wiring bug, not good news.
+DEGRADED_COMPONENTS = ("kvstore", "ring", "snapshot")
+
 PUMP_GAUGES = tuple(
     (name, help_) for _, name, help_ in PUMP_STAT_GAUGES
 ) + (
@@ -373,6 +382,55 @@ class StatsCollector:
                   "came back)",
                   kind="counter"),
         )
+        # resilience surface (ISSUE 8): degraded components, kvstore
+        # staleness, snapshot age/progress/restore outcomes
+        self.degraded_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_degraded",
+                  "degraded-mode flags by component (1 = degraded: "
+                  "kvstore = store unreachable, serving the "
+                  "last-adopted epoch; ring = persistent pump fell "
+                  "back to dispatch mode; snapshot = last snapshot "
+                  "attempt failed)"),
+        )
+        self.kv_staleness_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_kvstore_staleness_seconds",
+                  "seconds the served config may lag the cluster "
+                  "store (0 while connected; time since disconnect "
+                  "while degraded)"),
+        )
+        self.snapshot_age_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_snapshot_age_seconds",
+                  "age of the last durable session-snapshot "
+                  "generation (-1 = none published yet)"),
+        )
+        self.snapshot_chunk_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_snapshot_chunk_seconds",
+                  "cumulative seconds spent draining + writing "
+                  "session snapshot chunks (off the hot path)",
+                  kind="counter"),
+        )
+        self.snapshot_gen_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_snapshot_generation",
+                  "last durable session-snapshot generation number"),
+        )
+        self.snapshot_restore_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_snapshot_restore_total",
+                  "session restore attempts by outcome (restored = "
+                  "warm start; every refusal reason is its own label "
+                  "and cold-starts cleanly)",
+                  kind="counter"),  # _total => counter exposition
+        )
+        # degraded-state sources: the cluster store (set_store) and
+        # the snapshotter (set_snapshotter); the pump is already
+        # attached via set_pump
+        self._store = None
+        self._snapshotter = None
         # optional IO-daemon stats source (a callable returning the
         # daemon's stats dict, or the IODaemon itself when it runs
         # in-process): feeds the rx_full drop cause. The fetched value
@@ -412,6 +470,19 @@ class StatsCollector:
             self._io_daemon_stats = daemon_or_fn
         else:
             self._io_daemon_stats = lambda: dict(daemon_or_fn.stats)
+
+    def set_store(self, store) -> None:
+        """Attach the cluster store so publish() exports its
+        reachability (``vpp_tpu_degraded{component="kvstore"}``) and
+        staleness. In-process stores have neither attribute and read
+        as always healthy."""
+        self._store = store
+
+    def set_snapshotter(self, snapshotter) -> None:
+        """Attach the SessionSnapshotter (pipeline/snapshot.py) so
+        publish() exports snapshot age, generation, chunk time and
+        restore outcomes."""
+        self._snapshotter = snapshotter
 
     def set_vcl(self, server) -> None:
         """Attach the VclAdmissionServer so publish() exports its
@@ -543,6 +614,31 @@ class StatsCollector:
         from vpp_tpu.pipeline.dataplane import jit_compile_totals
         for label, n in jit_compile_totals().items():
             self.jit_compiles_gauge.set(float(n), step=label)
+        # resilience surface (ISSUE 8): every component exports every
+        # publish (0 = healthy) so dashboards alert on value, never on
+        # series absence
+        store = self._store
+        kv_degraded = bool(getattr(store, "degraded", False))
+        self.degraded_gauge.set(
+            1.0 if kv_degraded else 0.0, component="kvstore")
+        stale_fn = getattr(store, "staleness_s", None)
+        self.kv_staleness_gauge.set(
+            float(stale_fn()) if callable(stale_fn) else 0.0)
+        self.degraded_gauge.set(
+            1.0 if getattr(self.pump, "degraded_ring", False) else 0.0,
+            component="ring")
+        snap = self._snapshotter
+        self.degraded_gauge.set(
+            1.0 if getattr(snap, "degraded", False) else 0.0,
+            component="snapshot")
+        if snap is not None:
+            s = snap.stats_snapshot()
+            self.snapshot_age_gauge.set(float(s["age_s"]))
+            self.snapshot_chunk_gauge.set(float(s["chunk_seconds"]))
+            self.snapshot_gen_gauge.set(float(s["generation"]))
+            for outcome, n in s["restores"].items():
+                self.snapshot_restore_gauge.set(
+                    float(n), outcome=outcome)
         # classify-stage occupancy in the pump stage family: cumulative
         # seconds of the isolated classify probe
         # (Dataplane.time_classifier — the bench and operators drive

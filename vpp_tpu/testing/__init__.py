@@ -1,0 +1,6 @@
+"""In-tree test/chaos machinery (fault injection).
+
+Production modules import :mod:`vpp_tpu.testing.faults` for its
+zero-cost-when-idle ``fire()`` hook; everything heavier (schedules,
+chaos harness helpers) stays inside the test suite.
+"""
