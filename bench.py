@@ -171,7 +171,7 @@ def build_rules(n_rules: int):
     return rules
 
 
-def build_dataplane(n_rules: int, n_backends: int):
+def build_dataplane(n_rules: int, n_backends: int, ml_stage: str = "off"):
     from vpp_tpu.ir.rule import Action, ContivRule
     from vpp_tpu.pipeline.dataplane import Dataplane
     from vpp_tpu.pipeline.tables import DataplaneConfig
@@ -186,6 +186,7 @@ def build_dataplane(n_rules: int, n_backends: int):
         sess_slots=1 << 15,
         nat_mappings=4,
         nat_backends=max(n_backends, 1),
+        ml_stage=ml_stage,
     )
     dp = Dataplane(config)
     uplink = dp.add_uplink()
@@ -525,6 +526,89 @@ def fastpath_bench(args, iters: int = 12, batch: int = 2048) -> dict:
     out["pipeline_fullpath_us"] = round(full_us, 1)
     out["pipeline_fastpath_us"] = round(fast_us, 1)
     out["fastpath_speedup_x"] = round(full_us / max(fast_us, 1e-9), 2)
+    return out
+
+
+def ml_stage_bench(args, iters: int = 12, batch: int = 2048) -> dict:
+    """Per-packet ML scoring stage (ISSUE 10 tentpole): the ADDED cost
+    of int8 MLP inference riding inside the fused step, at the
+    headline rule count.
+
+    Compiles the deployed chain twice — ml_mode off vs score (same
+    classifier impl/local-skip selection, same tables: the glb_ml_*
+    planes are staged either way, the off variant just never reads
+    them) — and reports the delta. The stage rides INSIDE the one
+    jitted program (no extra dispatch), so the delta IS the marginal
+    matmul cost. Keys:
+
+      * ``ml_stage_ns_pkt``           — (t_score − t_off)/batch
+      * ``ml_headline_overhead_pct``  — 100·(t_score − t_off)/t_off
+                                        (acceptance: < 10)
+      * ``ml_enforce_overhead_pct``   — enforce-mode delta (the
+                                        verdict fold's extra cost)
+      * ``ml_swap_zero_reship``       — 1 when an ACL-only epoch swap
+                                        reuses the staged model's
+                                        device arrays by identity
+                                        (acceptance: 1)
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from vpp_tpu.ml.train import train_and_pack
+    from vpp_tpu.pipeline.graph import make_pipeline_step
+
+    dp, uplink = build_dataplane(args.rules, 4, ml_stage="score")
+    model, report = train_and_pack(kind="mlp", hidden=16,
+                                   samples=2048, action="drop")
+    with dp.commit_lock:
+        dp.builder.set_ml_model(model)
+        dp.swap()
+    out = {
+        "ml_stage_batch": batch, "ml_stage_rules": args.rules,
+        "ml_stage_kind": model.kind, "ml_stage_hidden": model.hidden,
+        "ml_train_accuracy": round(report["accuracy"], 4),
+    }
+    impl, skip = dp.classifier_impl, dp._skip_local
+    steps = {
+        mode: jax.jit(make_pipeline_step(impl, skip, ml_mode=mode))
+        for mode in ("off", "score", "enforce")
+    }
+    pkts = build_traffic(batch, uplink, seed=33)
+    tables = dp.tables
+
+    def med_us(step):
+        jax.block_until_ready(step(tables, pkts, jnp.int32(2)).disp)
+        ts = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(step(tables, pkts, jnp.int32(2)).disp)
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts)) * 1e6
+
+    t_off = med_us(steps["off"])
+    t_score = med_us(steps["score"])
+    t_enforce = med_us(steps["enforce"])
+    probe = steps["score"](tables, pkts, jnp.int32(2))
+    out["ml_stage_scored"] = int(probe.stats.ml_scored)
+    out["ml_stage_flagged_pct"] = round(
+        100.0 * int(probe.stats.ml_flagged)
+        / max(int(probe.stats.ml_scored), 1), 2)
+    out["ml_fullpath_us"] = round(t_off, 1)
+    out["ml_scorepath_us"] = round(t_score, 1)
+    out["ml_stage_ns_pkt"] = round(
+        max(t_score - t_off, 0.0) / batch * 1e3, 2)
+    out["ml_headline_overhead_pct"] = round(
+        100.0 * (t_score - t_off) / max(t_off, 1e-9), 2)
+    out["ml_enforce_overhead_pct"] = round(
+        100.0 * (t_enforce - t_off) / max(t_off, 1e-9), 2)
+    # model epoch-swap plane reuse: an ACL-only churn must NOT re-ship
+    # the model group — the cached device arrays carry over by identity
+    ml_plane_before = dp.tables.glb_ml_w1
+    with dp.commit_lock:
+        dp.builder.set_global_table(build_rules(max(args.rules // 2, 2)))
+        dp.swap()
+    out["ml_swap_zero_reship"] = int(
+        dp.tables.glb_ml_w1 is ml_plane_before)
     return out
 
 
@@ -2508,6 +2592,17 @@ def _run():
         pri["fastpath_bench_error"] = f"{type(e).__name__}: {e}"
     _jc_now = _jit_compiles_now()
     pri["fastpath_jit_compiles"] = _jc_now - _jc
+    _jc = _jc_now
+    _progress(**pri)
+    try:
+        # per-packet ML stage (ISSUE 10): marginal in-step cost of the
+        # int8 MLP + the zero-re-ship model-swap check (acceptance:
+        # ml_headline_overhead_pct < 10, ml_swap_zero_reship == 1)
+        pri.update(ml_stage_bench(args))
+    except Exception as e:  # noqa: BLE001
+        pri["ml_stage_bench_error"] = f"{type(e).__name__}: {e}"
+    _jc_now = _jit_compiles_now()
+    pri["ml_stage_jit_compiles"] = _jc_now - _jc
     _jc = _jc_now
     _progress(**pri)
     if not args.no_subbench:

@@ -18,6 +18,11 @@ never a vibes-level "it seems to work again":
 * ``reconnect storm``       — seeded connect-failure storms around
   forced disconnects: watches re-register snapshot-atomically every
   round and no acknowledged write is lost.
+* ``ml model refusals``     — a seeded schedule of corrupt artifacts +
+  injected ``ml.load`` faults across model generations: every refusal
+  is a counted outcome, the previous model KEEPS SERVING (verdicts
+  unchanged, version never half-applied), degraded{component=ml}
+  flips exactly while refused, and a good artifact heals.
 
 Runtime is bounded (small tables, short timeouts). `make chaos` runs
 the suite; the tests are also ``slow``-marked, so the tier-1
@@ -479,3 +484,118 @@ class TestReconnectStorm:
         finally:
             client.close()
             srv.close()
+
+
+# --------------------------------------------------------------------
+# schedule 5: ML model load refusals across generations (ISSUE 10)
+# --------------------------------------------------------------------
+
+
+class TestMlModelRefusals:
+    def test_refusal_schedule_keeps_previous_generation_serving(
+            self, tmp_path):
+        """Seeded schedule over the REAL ``ml.load`` seam
+        (vpp_tpu/ml/loader.py): good v1 → injected load faults →
+        corrupt file → good v2. Conservation after every round: the
+        version the dataplane scores with is EXACTLY the last
+        successfully published generation (never absent, never a
+        half-applied blob — the w1 plane and the version scalar always
+        belong to the same artifact), and the refusal ledger accounts
+        for every attempt: loaded + refused == polls that found a
+        changed file."""
+        import numpy as np
+
+        from vpp_tpu.ml.loader import MlModelSource
+        from vpp_tpu.ml.model import MlModel, save_model
+        from vpp_tpu.ops.mlscore import ML_FEATURES
+
+        rng = np.random.default_rng(SEED + 60)
+
+        def gen_model(version):
+            # version-keyed weights so "which generation is serving"
+            # is readable off the staged planes, not just the scalar
+            w1 = np.zeros((ML_FEATURES, 4), np.int8)
+            w1[12, 0] = np.int8(version)
+            return MlModel(
+                kind="mlp", version=version, n_features=ML_FEATURES,
+                w1=w1, b1=np.zeros(4, np.int32), s1=0,
+                w2=np.array([1, 0, 0, 0], np.int8), b2=0,
+                flag_thresh=10, action="drop").validate()
+
+        dp = Dataplane(DataplaneConfig(
+            max_tables=2, max_rules=8, max_global_rules=8,
+            max_ifaces=8, fib_slots=16, sess_slots=64,
+            nat_mappings=2, nat_backends=4,
+            ml_stage="enforce", ml_hidden=4))
+        uplink = dp.add_uplink()
+        dp.builder.add_route("0.0.0.0/0", uplink, Disposition.REMOTE)
+        dp.swap()
+        path = tmp_path / "model.json"
+        src = MlModelSource(dp, str(path))
+
+        served = 0           # the generation that must be serving
+        changed_polls = 0    # polls that saw a changed file
+        import time as _t
+
+        def write_and_poll(content_fn, version=None):
+            nonlocal changed_polls
+            content_fn()
+            # mtime granularity: ensure the poll sees the change
+            import os as _os
+
+            _os.utime(path, (_t.time(), _t.time() + changed_polls + 1))
+            changed_polls += 1
+            return src.poll()
+
+        # round 0: good v1 publishes
+        assert write_and_poll(
+            lambda: save_model(gen_model(1), str(path))) is True
+        served = 1
+
+        def assert_serving(version):
+            assert int(dp.tables.glb_ml_version) == version
+            # the weight plane belongs to the SAME artifact (never a
+            # half-applied swap)
+            assert int(np.asarray(dp.tables.glb_ml_w1)[12, 0]) == version
+            # and the verdicts are that model's: proto 17 scores
+            # 17*version, flagged iff > 10
+            pv = make_packet_vector([dict(
+                src="198.18.0.1", dst="203.0.113.5", proto=17,
+                sport=53, dport=9000, rx_if=uplink)], n=8)
+            res = dp.process(pv)
+            want = 1 if 17 * version > 10 else 0
+            assert int(res.stats.ml_flagged) == want
+
+        assert_serving(1)
+
+        # rounds 1..N: seeded mix of injected faults and corrupt files
+        refusals = 0
+        for r in range(4):
+            mode = int(rng.integers(0, 2))
+            if mode == 0:
+                plan = faults.install(faults.FaultPlan(seed=SEED + r))
+                plan.inject("ml.load", times=1, exc=OSError)
+                ok = write_and_poll(
+                    lambda: save_model(gen_model(9), str(path)))
+                assert plan.fired("ml.load") == 1
+                faults.uninstall()
+            else:
+                ok = write_and_poll(
+                    lambda: path.write_text('{"format": "garbage'))
+            assert ok is False
+            refusals += 1
+            assert src.degraded
+            assert_serving(served)  # previous generation still serving
+
+        # heal: good v2 publishes and degraded clears
+        assert write_and_poll(
+            lambda: save_model(gen_model(2), str(path))) is True
+        served = 2
+        assert not src.degraded
+        assert_serving(2)
+
+        # ledger conservation: every changed-file poll is accounted
+        st = src.stats_snapshot()
+        assert st["outcomes"]["loaded"] == 2
+        assert sum(st["outcomes"].values()) == changed_polls == \
+            refusals + 2

@@ -171,6 +171,21 @@ def test_exposition_conformance_over_http():
                     "vpp_tpu_cni_request_seconds",
                     "vpp_tpu_pump_batch_seconds"):
             assert types.get(fam) == "histogram", fam
+        # per-packet ML stage families (ISSUE 10): the StepStats
+        # mirrors are gauges, the load ledger is a counter, and the
+        # info-style stage gauge exports every mode label
+        for fam in ("vpp_tpu_ml_scored_packets",
+                    "vpp_tpu_ml_flagged_packets",
+                    "vpp_tpu_ml_dropped_packets",
+                    "vpp_tpu_ml_stage", "vpp_tpu_ml_model_version"):
+            assert types.get(fam) == "gauge", fam
+        assert types.get("vpp_tpu_ml_load_total") == "counter"
+        ml_modes = {l.get("mode") for n, l, _ in samples
+                    if n == "vpp_tpu_ml_stage"}
+        assert ml_modes == {"off", "score", "enforce"}
+        degraded = {l.get("component") for n, l, _ in samples
+                    if n == "vpp_tpu_degraded"}
+        assert "ml" in degraded
         # counters monotonic across two publishes with more traffic
         first = {
             (n, tuple(sorted(l.items()))): v for n, l, v in samples

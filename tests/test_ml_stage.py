@@ -1,0 +1,786 @@
+"""Per-packet ML scoring stage (ISSUE 10): differential suite.
+
+The device kernel (ops/mlscore.py) is validated against an INDEPENDENT
+NumPy fixed-point oracle implemented in THIS file from the documented
+contract (docs/ML_STAGE.md) — not against vpp_tpu.ml.model's own
+reference — so a shared bug can't vouch for itself. Equality is
+bit-exactness everywhere: the whole pipeline is exact integer math.
+
+Covers: float-train → int8-pack → device-inference round trips,
+degenerate models (all-zero weights, single feature, threshold
+extremes), score/enforce pipeline differentials over mixed traffic
+(flags/lengths/session states), verdict ordering (deny beats ml-drop
+beats permit), the rate-limit flow gate, fastpath interplay (the fast
+tier still scores, bit-exactly), epoch-swap plane reuse (ACL churn
+re-ships NOTHING of the model), artifact load refusals, and the
+packed-path aux riders.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from vpp_tpu.ml.model import MlModel, MlModelError, load_model, save_model
+from vpp_tpu.ml.train import make_synth_dataset, quantize_mlp, train_mlp
+from vpp_tpu.ops.mlscore import ML_FEATURES, ml_score
+from vpp_tpu.pipeline.dataplane import Dataplane
+from vpp_tpu.pipeline.graph import DROP_ACL, DROP_ML
+from vpp_tpu.pipeline.tables import DataplaneConfig
+from vpp_tpu.pipeline.vector import Disposition, make_packet_vector
+
+POD_NET = "10.1.1.0/24"
+
+
+# --------------------------------------------------------------------
+# the independent oracle (docs/ML_STAGE.md contract, from scratch)
+# --------------------------------------------------------------------
+
+
+def oracle_features(pv, established, age):
+    """uint8 [P, 18] features straight from the documented layout."""
+    src = np.asarray(pv.src_ip, dtype=np.uint32)
+    dst = np.asarray(pv.dst_ip, dtype=np.uint32)
+    n = len(src)
+    f = np.zeros((n, ML_FEATURES), np.int64)
+    for j, sh in enumerate((24, 16, 8, 0)):
+        f[:, j] = (src >> sh) & 0xFF
+        f[:, 4 + j] = (dst >> sh) & 0xFF
+    sport = np.asarray(pv.sport, np.int64)
+    dport = np.asarray(pv.dport, np.int64)
+    f[:, 8], f[:, 9] = (sport >> 8) & 0xFF, sport & 0xFF
+    f[:, 10], f[:, 11] = (dport >> 8) & 0xFF, dport & 0xFF
+    f[:, 12] = np.asarray(pv.proto, np.int64) & 0xFF
+    f[:, 13] = np.minimum(np.asarray(pv.pkt_len, np.int64) >> 4, 255)
+    f[:, 14] = np.asarray(pv.flags, np.int64) & 0xFF
+    f[:, 15] = np.where(np.asarray(established, bool), 255, 0)
+    f[:, 16] = np.clip(np.asarray(age, np.int64), 0, 255)
+    return f
+
+
+def oracle_scores(model: MlModel, feats: np.ndarray) -> np.ndarray:
+    """Exact int64 inference from the UNFOLDED artifact fields (the
+    device computes the zero-point-folded form; integer math makes
+    them equal, which is exactly what this oracle checks)."""
+    x = feats[:, : model.n_features].astype(np.int64)
+    if model.kind == "mlp":
+        a1 = x @ model.w1.astype(np.int64) + model.b1.astype(np.int64)
+        q1 = np.clip(np.maximum(a1, 0) >> int(model.s1), 0, 255)
+        return q1 @ model.w2.astype(np.int64) + int(model.b2)
+    t, d = model.f_feat.shape
+    bits = x[:, model.f_feat.reshape(-1)] > \
+        model.f_thresh.reshape(-1)[None, :]
+    leaf = (bits.reshape(-1, t, d).astype(np.int64)
+            << np.arange(d, dtype=np.int64)[None, None, :]).sum(axis=2)
+    return model.f_leaf.astype(np.int64)[
+        np.arange(t)[None, :], leaf].sum(axis=1) + int(model.b2)
+
+
+def oracle_flow_hash(pv) -> np.ndarray:
+    """The rate-limit admission hash, re-derived (uint32 wraparound)."""
+    M = np.uint64(0xFFFFFFFF)
+
+    def mul(a, k):
+        return (a.astype(np.uint64) * np.uint64(k)) & M
+
+    src = np.asarray(pv.src_ip, np.uint32)
+    dst = np.asarray(pv.dst_ip, np.uint32)
+    ports = ((np.asarray(pv.sport, np.uint64) << np.uint64(16))
+             | np.asarray(pv.dport, np.uint64)) & M
+    proto = np.asarray(pv.proto, np.uint32)
+    h = mul(src, 0x9E3779B1)
+    h ^= mul(dst, 0x85EBCA77)
+    h ^= (ports * np.uint64(0xC2B2AE3D)) & M
+    h ^= mul(proto, 0x27D4EB2F)
+    h ^= h >> np.uint64(15)
+    return h.astype(np.uint32)
+
+
+def device_scores(tables, pv, established, age) -> np.ndarray:
+    kind = "forest" if int(tables.glb_ml_f_leaf.shape[1]) > 2 and \
+        bool(np.any(np.asarray(tables.glb_ml_f_leaf))) else "mlp"
+    return np.asarray(ml_score(
+        tables, pv, jnp.asarray(np.asarray(established, bool)),
+        jnp.asarray(np.asarray(age, np.int32)), kind=kind))
+
+
+def proto_model(flag_thresh: int = 10, action: str = "drop",
+                rl_shift: int = 0, version: int = 1) -> MlModel:
+    """Hand-crafted deterministic model: score == the packet's proto
+    byte (w1 picks feature 12 through a unit hidden path). flag_thresh
+    10 flags UDP (17) and not TCP (6) — a fully predictable policy
+    for the verdict-ordering tests."""
+    w1 = np.zeros((ML_FEATURES, 4), np.int8)
+    w1[12, 0] = 1
+    return MlModel(
+        kind="mlp", version=version, n_features=ML_FEATURES,
+        w1=w1, b1=np.zeros(4, np.int32), s1=0,
+        w2=np.array([1, 0, 0, 0], np.int8), b2=0,
+        flag_thresh=flag_thresh, action=action, rl_shift=rl_shift,
+    ).validate()
+
+
+def build_dp(ml_stage="enforce", model=None, rules=(), fastpath=True,
+             ml_hidden=16, ml_trees=4, ml_depth=3):
+    cfg = DataplaneConfig(
+        max_tables=2, max_rules=8, max_global_rules=32, max_ifaces=8,
+        fib_slots=16, sess_slots=256, nat_mappings=2, nat_backends=4,
+        ml_stage=ml_stage, ml_hidden=ml_hidden, ml_trees=ml_trees,
+        ml_depth=ml_depth, fastpath=fastpath)
+    dp = Dataplane(cfg)
+    uplink = dp.add_uplink()
+    pod_if = dp.add_pod_interface(("default", "pod"))
+    dp.builder.add_route(POD_NET, pod_if, Disposition.LOCAL)
+    dp.builder.add_route("0.0.0.0/0", uplink, Disposition.REMOTE,
+                         node_id=1)
+    if rules:
+        dp.builder.set_global_table(list(rules))
+    if model is not None:
+        dp.builder.set_ml_model(model)
+    dp.swap()
+    return dp, uplink
+
+
+def rand_traffic(n, uplink, seed=0, n_pkts=None):
+    """Seeded mixed traffic: varied addresses/ports/lengths/flags and
+    protos, some invalid slots."""
+    rng = np.random.default_rng(seed)
+    pkts = []
+    for i in range(n):
+        pkts.append(dict(
+            src=f"172.{16 + i % 4}.{rng.integers(0, 256)}."
+                f"{rng.integers(1, 255)}",
+            dst=f"10.1.1.{rng.integers(2, 250)}",
+            proto=int(rng.choice([6, 17, 1])),
+            sport=int(rng.integers(1, 65535)),
+            dport=int(rng.integers(1, 65535)),
+            len=int(rng.integers(40, 4500)),
+            rx_if=uplink,
+        ))
+    return make_packet_vector(pkts, n=n_pkts or n)
+
+
+# --------------------------------------------------------------------
+# quantization round trips + degenerate models (kernel level)
+# --------------------------------------------------------------------
+
+
+class TestQuantizationRoundTrip:
+    def test_trained_mlp_device_matches_oracle_bit_exact(self, tmp_path):
+        feats, labels = make_synth_dataset(1024, seed=3)
+        w1, b1, w2, b2 = train_mlp(feats, labels, hidden=8, epochs=60)
+        model = quantize_mlp(w1, b1, w2, b2, feats)
+        path = tmp_path / "m.json"
+        save_model(model, str(path))
+        loaded = load_model(str(path))
+        # artifact round trip is lossless
+        np.testing.assert_array_equal(model.w1, loaded.w1)
+        np.testing.assert_array_equal(model.b1, loaded.b1)
+        assert (model.s1, model.b2, model.flag_thresh) == \
+            (loaded.s1, loaded.b2, loaded.flag_thresh)
+        dp, uplink = build_dp("score", loaded, ml_hidden=8)
+        for seed in (1, 2, 3):
+            pv = rand_traffic(64, uplink, seed=seed)
+            est = np.zeros(64, bool)
+            est[::3] = True
+            age = np.where(est, (seed * 37) % 300, 0)
+            dev = device_scores(dp.tables, pv, est, age)
+            ora = oracle_scores(loaded, oracle_features(pv, est, age))
+            np.testing.assert_array_equal(dev, ora.astype(np.int64))
+
+    def test_all_zero_weights_scores_zero(self):
+        model = MlModel(
+            kind="mlp", version=1, n_features=ML_FEATURES,
+            w1=np.zeros((ML_FEATURES, 2), np.int8),
+            b1=np.zeros(2, np.int32), s1=0,
+            w2=np.zeros(2, np.int8), b2=0, flag_thresh=0,
+        ).validate()
+        dp, uplink = build_dp("score", model, ml_hidden=2)
+        pv = rand_traffic(32, uplink, seed=9)
+        dev = device_scores(dp.tables, pv, np.zeros(32, bool),
+                            np.zeros(32))
+        assert (dev == 0).all()
+        # score 0 is NOT > flag_thresh 0: nothing flags
+        res = dp.process(pv, now=1)
+        assert int(res.stats.ml_flagged) == 0
+
+    def test_single_feature_model(self):
+        """A 1-feature (packet length bucket), 1-hidden model — the
+        smallest expressible artifact — pads up to capacity and stays
+        bit-exact."""
+        model = MlModel(
+            kind="mlp", version=1, n_features=1,
+            w1=np.array([[2]], np.int8), b1=np.array([-10], np.int32),
+            s1=1, w2=np.array([3], np.int8), b2=7, flag_thresh=50,
+        ).validate()
+        dp, uplink = build_dp("score", model)
+        pv = rand_traffic(48, uplink, seed=4)
+        dev = device_scores(dp.tables, pv, np.zeros(48, bool),
+                            np.zeros(48))
+        # n_features=1 => only the src_ip MSB feature feeds the model
+        feats = oracle_features(pv, np.zeros(48, bool), np.zeros(48))
+        ora = oracle_scores(model, feats)
+        np.testing.assert_array_equal(dev, ora)
+
+    def test_threshold_extremes(self):
+        """Flag threshold at the score-space extremes: everything
+        below INT32_MIN-ish flags, nothing at INT32_MAX; forest
+        feature thresholds at 0 and 255 pin the bit boundaries."""
+        lo = proto_model(flag_thresh=-(1 << 30), action="mark")
+        hi = proto_model(flag_thresh=(1 << 30), action="mark")
+        dp, uplink = build_dp("score", lo)
+        pv = rand_traffic(32, uplink, seed=5)
+        res = dp.process(pv, now=1)
+        assert int(res.stats.ml_flagged) == int(res.stats.ml_scored) > 0
+        with dp.commit_lock:
+            dp.builder.set_ml_model(hi)
+            dp.swap()
+        res = dp.process(rand_traffic(32, uplink, seed=6), now=2)
+        assert int(res.stats.ml_flagged) == 0
+        # forest: feature threshold 255 => bit never set (values are
+        # uint8); threshold 0 => bit set iff value > 0
+        forest = MlModel(
+            kind="forest", version=1, n_features=ML_FEATURES,
+            f_feat=np.array([[12, 12]], np.int32),
+            f_thresh=np.array([[255, 0]], np.int32),
+            f_leaf=np.array([[0, 11, 22, 33]], np.int32),
+            flag_thresh=15,
+        ).validate()
+        dpf, upf = build_dp("score", forest, ml_trees=1, ml_depth=2)
+        pvf = rand_traffic(32, upf, seed=7)
+        dev = device_scores(dpf.tables, pvf, np.zeros(32, bool),
+                            np.zeros(32))
+        # proto > 255 never true -> bit0 off; proto > 0 always true ->
+        # bit1 on -> leaf 2 (value 22) for every packet
+        assert (dev == 22).all()
+        ora = oracle_scores(
+            forest, oracle_features(pvf, np.zeros(32, bool),
+                                    np.zeros(32)))
+        np.testing.assert_array_equal(dev, ora)
+
+    def test_forest_device_matches_oracle(self):
+        rng = np.random.default_rng(11)
+        forest = MlModel(
+            kind="forest", version=3, n_features=ML_FEATURES,
+            f_feat=rng.integers(0, ML_FEATURES, (4, 3)).astype(np.int32),
+            f_thresh=rng.integers(0, 256, (4, 3)).astype(np.int32),
+            f_leaf=rng.integers(-500, 500, (4, 8)).astype(np.int32),
+            b2=-17, flag_thresh=0,
+        ).validate()
+        dp, uplink = build_dp("score", forest)
+        for seed in (1, 8):
+            pv = rand_traffic(64, uplink, seed=seed)
+            est = np.zeros(64, bool)
+            est[1::4] = True
+            age = np.where(est, 123, 0)
+            dev = device_scores(dp.tables, pv, est, age)
+            ora = oracle_scores(forest,
+                                oracle_features(pv, est, age))
+            np.testing.assert_array_equal(dev, ora)
+
+
+# --------------------------------------------------------------------
+# pipeline differential: score / enforce over mixed session states
+# --------------------------------------------------------------------
+
+
+def _deny_rule(src_cidr: str):
+    import ipaddress
+
+    from vpp_tpu.ir.rule import Action, ContivRule, Protocol
+
+    return ContivRule(action=Action.DENY, protocol=Protocol.TCP,
+                      src_network=ipaddress.ip_network(src_cidr))
+
+
+def _permit_all():
+    from vpp_tpu.ir.rule import Action, ContivRule, Protocol
+
+    return ContivRule(action=Action.PERMIT, protocol=Protocol.ANY)
+
+
+class TestPipelineDifferential:
+    def _mixed_scenario(self, ml_stage: str, action: str = "drop",
+                        rl_shift: int = 0):
+        """Prime reflective sessions from pod-side traffic, then score
+        a reply batch that mixes established/new flows, TCP/UDP/ICMP,
+        and varied lengths — with the apply-global table permitting
+        everything (the ML verdict is the only drop source)."""
+        model = proto_model(action=action, rl_shift=rl_shift)
+        dp, uplink = build_dp(ml_stage, model, rules=[_permit_all()])
+        # forward (pod -> world) traffic installs reflective sessions
+        fwd = make_packet_vector([
+            dict(src=f"10.1.1.{2 + i}", dst=f"172.16.0.{10 + i}",
+                 proto=6, sport=5000 + i, dport=80, rx_if=1)
+            for i in range(8)
+        ], n=32)
+        r0 = dp.process(fwd, now=100)
+        assert int(r0.stats.tx) == 8
+        # replies: 8 established TCP + 8 fresh UDP + 8 fresh TCP
+        reply = make_packet_vector(
+            [dict(src=f"172.16.0.{10 + i}", dst=f"10.1.1.{2 + i}",
+                  proto=6, sport=80, dport=5000 + i, len=600,
+                  rx_if=uplink) for i in range(8)]
+            + [dict(src=f"198.18.0.{i}", dst=f"10.1.1.{2 + i}",
+                    proto=17, sport=53, dport=9000 + i, len=60,
+                    rx_if=uplink) for i in range(8)]
+            + [dict(src=f"198.19.0.{i}", dst=f"10.1.1.{2 + i}",
+                    proto=6, sport=443, dport=9100 + i, len=1500,
+                    rx_if=uplink) for i in range(8)],
+            n=32)
+        established = np.zeros(32, bool)
+        established[:8] = True
+        age = np.where(established, 7, 0)  # scored at now=107
+        res = dp.process(reply, now=107)
+        return dp, model, reply, established, age, res
+
+    def test_score_mode_counts_but_never_drops(self):
+        dp, model, pv, est, age, res = self._mixed_scenario("score")
+        feats = oracle_features(pv, est, age)
+        want_flag = oracle_scores(model, feats) > model.flag_thresh
+        want_flag &= np.asarray(pv.valid)
+        np.testing.assert_array_equal(
+            np.asarray(res.ml_flagged), want_flag)
+        assert int(res.stats.ml_scored) == 24
+        assert int(res.stats.ml_flagged) == int(want_flag.sum()) == 8
+        assert int(res.stats.ml_drops) == 0
+        # nothing dropped: all 24 valid packets forwarded
+        assert int(res.stats.tx) == 24
+        assert not (np.asarray(res.drop_cause) == DROP_ML).any()
+
+    def test_enforce_mode_drops_flagged_bit_exact(self):
+        dp, model, pv, est, age, res = self._mixed_scenario("enforce")
+        feats = oracle_features(pv, est, age)
+        want_drop = oracle_scores(model, feats) > model.flag_thresh
+        want_drop &= np.asarray(pv.valid)
+        got_ml = np.asarray(res.drop_cause) == DROP_ML
+        np.testing.assert_array_equal(got_ml, want_drop)
+        assert int(res.stats.ml_drops) == int(want_drop.sum()) == 8
+        assert int(res.stats.tx) == 24 - 8
+        # dropped packets have no egress
+        assert (np.asarray(res.tx_if)[want_drop] == -1).all()
+        assert (np.asarray(res.disp)[want_drop]
+                == int(Disposition.DROP)).all()
+
+    def test_enforce_established_flows_also_policed(self):
+        """An established (session-hit) flow whose score crosses the
+        threshold still drops — DDoS rides established flows too."""
+        # prime the session with a never-flagging model, THEN swap in
+        # the aggressive one (an enforce drop would otherwise have
+        # blocked the session install — by design)
+        dp, uplink = build_dp(
+            "enforce", proto_model(flag_thresh=(1 << 30)),
+            rules=[_permit_all()])
+        fwd = make_packet_vector([
+            dict(src="10.1.1.2", dst="172.16.0.9", proto=6,
+                 sport=5000, dport=80, rx_if=1)], n=8)
+        dp.process(fwd, now=10)
+        with dp.commit_lock:
+            dp.builder.set_ml_model(
+                proto_model(flag_thresh=1, action="drop"))  # flags all
+            dp.swap()
+        reply = make_packet_vector([
+            dict(src="172.16.0.9", dst="10.1.1.2", proto=6, sport=80,
+                 dport=5000, rx_if=uplink)], n=8)
+        res = dp.process(reply, now=11)
+        assert int(res.stats.sess_hits) == 1
+        assert int(res.stats.ml_drops) == 1
+        assert int(np.asarray(res.drop_cause)[0]) == DROP_ML
+
+    def test_ratelimit_admits_by_flow_hash(self):
+        dp, model, pv, est, age, res = self._mixed_scenario(
+            "enforce", action="ratelimit", rl_shift=1)
+        feats = oracle_features(pv, est, age)
+        flagged = oracle_scores(model, feats) > model.flag_thresh
+        flagged &= np.asarray(pv.valid)
+        admit = (oracle_flow_hash(pv) & np.uint32(1)) == 0
+        want_drop = flagged & ~admit
+        got_ml = np.asarray(res.drop_cause) == DROP_ML
+        np.testing.assert_array_equal(got_ml, want_drop)
+        assert int(res.stats.ml_flagged) == int(flagged.sum())
+        assert int(res.stats.ml_drops) == int(want_drop.sum())
+        # the gate is per FLOW and deterministic: a second identical
+        # batch drops exactly the same packets
+        res2 = dp.process(pv, now=108)
+        np.testing.assert_array_equal(
+            np.asarray(res2.drop_cause) == DROP_ML, want_drop)
+
+    def test_mirror_action_marks_without_dropping(self):
+        dp, model, pv, est, age, res = self._mixed_scenario(
+            "enforce", action="mirror")
+        assert int(res.stats.ml_flagged) == 8
+        assert int(res.stats.ml_drops) == 0
+        assert int(res.stats.tx) == 24
+        # the mirror mask is the flagged mask, exposed per packet
+        assert int(np.asarray(res.ml_flagged).sum()) == 8
+
+
+class TestVerdictOrdering:
+    def test_deny_beats_ml_drop_beats_permit(self):
+        """The pinned ordering: an ACL-denied packet attributes
+        DROP_ACL even when the model also flags it; an ACL-permitted
+        flagged packet attributes DROP_ML; unflagged permitted
+        traffic forwards."""
+        # model flags EVERY packet (threshold below any score)
+        model = proto_model(flag_thresh=-1, action="drop")
+        dp, uplink = build_dp(
+            "enforce", model,
+            rules=[_deny_rule("198.51.100.0/24"), _permit_all()])
+        pv = make_packet_vector([
+            # ACL-denied AND ml-flagged -> DROP_ACL wins
+            dict(src="198.51.100.7", dst="10.1.1.2", proto=6,
+                 sport=1234, dport=80, rx_if=uplink),
+            # permitted AND ml-flagged -> DROP_ML
+            dict(src="172.16.0.9", dst="10.1.1.3", proto=6,
+                 sport=1234, dport=80, rx_if=uplink),
+        ], n=8)
+        res = dp.process(pv, now=1)
+        cause = np.asarray(res.drop_cause)
+        assert int(cause[0]) == DROP_ACL
+        assert int(cause[1]) == DROP_ML
+        assert int(res.stats.drop_acl) == 1
+        assert int(res.stats.ml_drops) == 1
+        # flip to a never-flagging model: the permitted packet forwards
+        with dp.commit_lock:
+            dp.builder.set_ml_model(
+                proto_model(flag_thresh=(1 << 30), action="drop"))
+            dp.swap()
+        res2 = dp.process(pv, now=2)
+        cause2 = np.asarray(res2.drop_cause)
+        assert int(cause2[0]) == DROP_ACL
+        assert int(cause2[1]) == 0
+        assert int(res2.stats.tx) == 1
+
+    def test_ml_drop_does_not_install_session(self):
+        """An ml-dropped first packet must not open a reflective
+        return hole."""
+        model = proto_model(flag_thresh=-1, action="drop")
+        dp, uplink = build_dp("enforce", model, rules=[_permit_all()])
+        fwd = make_packet_vector([
+            dict(src="10.1.1.2", dst="172.16.0.9", proto=6,
+                 sport=5000, dport=80, rx_if=1)], n=8)
+        res = dp.process(fwd, now=1)
+        assert int(res.stats.ml_drops) == 1
+        assert int(jnp.sum(dp.tables.sess_valid)) == 0
+
+
+# --------------------------------------------------------------------
+# fastpath interplay: the fast tier still scores, bit-exactly
+# --------------------------------------------------------------------
+
+
+class TestFastpathInterplay:
+    def _established_batch(self, ml_stage, action="drop", thresh=1):
+        # sessions prime under a never-flagging model; the aggressive
+        # model swaps in afterward (enforce would drop the priming
+        # traffic and install nothing — by design)
+        dp, uplink = build_dp(
+            ml_stage, proto_model(flag_thresh=(1 << 30)),
+            rules=[_permit_all()])
+        fwd = make_packet_vector([
+            dict(src=f"10.1.1.{2 + i}", dst=f"172.16.0.{10 + i}",
+                 proto=6, sport=5000 + i, dport=80, rx_if=1)
+            for i in range(6)], n=16)
+        dp.process(fwd, now=50)
+        with dp.commit_lock:
+            dp.builder.set_ml_model(
+                proto_model(flag_thresh=thresh, action=action))
+            dp.swap()
+        reply = make_packet_vector([
+            dict(src=f"172.16.0.{10 + i}", dst=f"10.1.1.{2 + i}",
+                 proto=6, sport=80, dport=5000 + i, rx_if=uplink)
+            for i in range(6)], n=16)
+        return dp, reply
+
+    def test_fast_tier_scores_and_enforces(self):
+        """All-established batch: the auto dispatcher takes the
+        classify-free kernel (fastpath == 1) AND still runs the model
+        — counters and verdicts bit-exact vs the forced full chain."""
+        dp, reply = self._established_batch("enforce", thresh=1)
+        res_auto = dp.process(reply, now=57)
+        assert int(res_auto.stats.fastpath) == 1
+        assert int(res_auto.stats.ml_scored) == 6
+        assert int(res_auto.stats.ml_drops) == 6  # TCP proto 6 > 1
+        # forced full chain on identical input/tables: same verdicts
+        from vpp_tpu.pipeline.graph import make_pipeline_step
+
+        step_full = make_pipeline_step(
+            dp.classifier_impl, dp._skip_local, fast=False,
+            ml_mode="enforce")
+        res_full = step_full(dp.tables, reply, jnp.int32(57))
+        np.testing.assert_array_equal(
+            np.asarray(res_auto.drop_cause),
+            np.asarray(res_full.drop_cause))
+        np.testing.assert_array_equal(
+            np.asarray(res_auto.ml_flagged),
+            np.asarray(res_full.ml_flagged))
+        assert int(res_full.stats.ml_drops) == 6
+        assert int(res_full.stats.fastpath) == 0
+
+    def test_fast_tier_age_feature_matches_full_chain(self):
+        """The session-age feature is captured pre-touch on BOTH
+        tiers: a model keyed on age scores identically through the
+        fast kernel and the full chain."""
+        # score = age bucket: w1 picks feature 16
+        w1 = np.zeros((ML_FEATURES, 2), np.int8)
+        w1[16, 0] = 1
+        model = MlModel(
+            kind="mlp", version=1, n_features=ML_FEATURES, w1=w1,
+            b1=np.zeros(2, np.int32), s1=0,
+            w2=np.array([1, 0], np.int8), b2=0,
+            flag_thresh=5, action="drop").validate()
+        dp, uplink = build_dp("enforce", model, rules=[_permit_all()])
+        fwd = make_packet_vector([
+            dict(src="10.1.1.2", dst="172.16.0.9", proto=6,
+                 sport=5000, dport=80, rx_if=1)], n=8)
+        dp.process(fwd, now=10)
+        reply = make_packet_vector([
+            dict(src="172.16.0.9", dst="10.1.1.2", proto=6, sport=80,
+                 dport=5000, rx_if=uplink)], n=8)
+        # age 3 at now=13: below threshold, forwarded via fast tier
+        res = dp.process(reply, now=13)
+        assert int(res.stats.fastpath) == 1
+        assert int(res.stats.ml_drops) == 0 and int(res.stats.tx) == 1
+        # age 9 at now=22 (touch above refreshed to 13): flagged+dropped
+        res2 = dp.process(reply, now=22)
+        assert int(res2.stats.fastpath) == 1
+        assert int(res2.stats.ml_drops) == 1
+
+
+# --------------------------------------------------------------------
+# epoch-swap plane reuse + staging rollback + packed aux riders
+# --------------------------------------------------------------------
+
+
+class TestEpochSwap:
+    def test_acl_churn_reuses_model_planes_by_identity(self):
+        model = proto_model()
+        dp, uplink = build_dp("enforce", model, rules=[_permit_all()])
+        before = {f: getattr(dp.tables, f)
+                  for f in ("glb_ml_w1", "glb_ml_b1", "glb_ml_w2",
+                            "glb_ml_f_leaf", "glb_ml_thresh")}
+        with dp.commit_lock:
+            dp.builder.set_global_table(
+                [_deny_rule("203.0.113.0/24"), _permit_all()])
+            dp.swap()
+        for f, arr in before.items():
+            assert getattr(dp.tables, f) is arr, \
+                f"{f} re-shipped on an ACL-only churn"
+        # a model churn DOES replace the planes (and only then)
+        with dp.commit_lock:
+            dp.builder.set_ml_model(proto_model(version=2))
+            dp.swap()
+        assert dp.tables.glb_ml_w1 is not before["glb_ml_w1"]
+        assert int(dp.tables.glb_ml_version) == 2
+
+    def test_state_snapshot_restores_ml_staging(self):
+        dp, uplink = build_dp("enforce", proto_model(version=1))
+        snap = dp.builder.state_snapshot()
+        dp.builder.set_ml_model(proto_model(version=9))
+        assert int(dp.builder.ml["glb_ml_version"]) == 9
+        dp.builder.state_restore(snap)
+        assert int(dp.builder.ml["glb_ml_version"]) == 1
+        assert dp.builder.ml_kind == 1
+
+    def test_no_model_staged_keeps_stage_off(self):
+        """score/enforce knob with no model: the stage re-gates off —
+        no scoring, no counters moving."""
+        dp, uplink = build_dp("enforce", model=None)
+        assert dp._ml_mode == "off"
+        res = dp.process(rand_traffic(16, uplink, seed=2), now=1)
+        assert int(res.stats.ml_scored) == 0
+        # staging a model flips the gate at the swap
+        with dp.commit_lock:
+            dp.builder.set_ml_model(proto_model())
+            dp.swap()
+        assert dp._ml_mode == "enforce"
+        res = dp.process(rand_traffic(16, uplink, seed=2), now=2)
+        assert int(res.stats.ml_scored) == 16
+
+    def test_capacity_refusal_leaves_staging_intact(self):
+        dp, uplink = build_dp("enforce", proto_model(version=1),
+                              ml_hidden=4)
+        too_big = MlModel(
+            kind="mlp", version=2, n_features=ML_FEATURES,
+            w1=np.zeros((ML_FEATURES, 8), np.int8),
+            b1=np.zeros(8, np.int32), s1=0,
+            w2=np.zeros(8, np.int8), b2=0).validate()
+        with pytest.raises(MlModelError):
+            dp.builder.set_ml_model(too_big)
+        assert int(dp.builder.ml["glb_ml_version"]) == 1
+
+
+class TestPackedAux:
+    def test_packed_aux_carries_ml_verdicts(self):
+        from vpp_tpu.pipeline.dataplane import (
+            PACKED_AUX_ROWS,
+            pack_packet_columns,
+            packed_input_zeros,
+        )
+
+        model = proto_model(action="drop")
+        dp, uplink = build_dp("enforce", model, rules=[_permit_all()])
+        pv = make_packet_vector(
+            [dict(src=f"198.18.0.{i}", dst=f"10.1.1.{2 + i}",
+                  proto=17, sport=53, dport=9000 + i, rx_if=uplink)
+             for i in range(5)]
+            + [dict(src=f"198.19.0.{i}", dst=f"10.1.1.{2 + i}",
+                    proto=6, sport=443, dport=9100 + i, rx_if=uplink)
+               for i in range(3)], n=16)
+        flat = packed_input_zeros(16)
+        cols = {f: np.asarray(getattr(pv, f))
+                for f in ("src_ip", "dst_ip", "proto", "sport",
+                          "dport", "ttl", "pkt_len", "rx_if", "flags")}
+        pack_packet_columns(flat.view(np.uint32), cols, 16)
+        out, aux = dp.process_packed(flat, now=3, with_aux=True)
+        aux_h = np.asarray(aux)
+        assert aux_h.shape == (PACKED_AUX_ROWS,) == (8,)
+        assert aux_h[5] == 8          # ml_scored == rx
+        assert aux_h[6] == 5          # the UDP slice flags
+        assert aux_h[7] == 5          # drop action enforces them
+
+
+# --------------------------------------------------------------------
+# artifact + loader refusals
+# --------------------------------------------------------------------
+
+
+class TestArtifact:
+    def test_bad_magic_version_and_corrupt_json(self, tmp_path):
+        good = proto_model().to_dict()
+        bad_magic = dict(good, format="not-a-model")
+        bad_ver = dict(good, format_version=99)
+        for doc, frag in ((bad_magic, "magic"), (bad_ver, "format_version")):
+            p = tmp_path / "bad.json"
+            p.write_text(json.dumps(doc))
+            with pytest.raises(MlModelError) as ei:
+                load_model(str(p))
+            assert frag in str(ei.value)
+        p = tmp_path / "torn.json"
+        p.write_text(json.dumps(good)[: 40])  # torn mid-document
+        with pytest.raises(MlModelError):
+            load_model(str(p))
+
+    def test_shape_validation(self):
+        with pytest.raises(MlModelError):
+            MlModel(kind="mlp", n_features=4,
+                    w1=np.zeros((3, 2), np.int8),
+                    b1=np.zeros(2, np.int32),
+                    w2=np.zeros(2, np.int8)).validate()
+        with pytest.raises(MlModelError):
+            MlModel(kind="forest", n_features=4,
+                    f_feat=np.array([[9]], np.int32),  # out of range
+                    f_thresh=np.zeros((1, 1), np.int32),
+                    f_leaf=np.zeros((1, 2), np.int32)).validate()
+        with pytest.raises(MlModelError):
+            MlModel(kind="mlp", n_features=1,
+                    w1=np.zeros((1, 1), np.int8),
+                    b1=np.zeros(1, np.int32),
+                    w2=np.zeros(1, np.int8),
+                    action="explode").validate()
+
+
+class TestLoader:
+    def test_refusal_keeps_previous_model_serving(self, tmp_path):
+        from vpp_tpu.ml.loader import MlModelSource
+
+        dp, uplink = build_dp("enforce", model=None)
+        path = tmp_path / "model.json"
+        save_model(proto_model(version=1), str(path))
+        src = MlModelSource(dp, str(path))
+        assert src.poll() is True
+        assert dp._ml_mode == "enforce"
+        assert int(dp.tables.glb_ml_version) == 1
+        # corrupt overwrite: refused, counted, previous keeps serving
+        path.write_text("{ not json")
+        assert src.poll() is False
+        st = src.stats_snapshot()
+        assert st["degraded"] and st["outcomes"]["corrupt"] == 1
+        assert int(dp.tables.glb_ml_version) == 1
+        assert dp._ml_mode == "enforce"
+        # a good v2 heals
+        save_model(proto_model(version=2), str(path))
+        assert src.poll() is True
+        st = src.stats_snapshot()
+        assert not st["degraded"] and st["outcomes"]["loaded"] == 2
+        assert int(dp.tables.glb_ml_version) == 2
+        # unchanged mtime: poll is a no-op stat()
+        assert src.poll() is False
+
+
+class TestShowMl:
+    def test_show_ml_page(self, tmp_path):
+        from vpp_tpu.cli import DebugCLI
+        from vpp_tpu.ml.loader import MlModelSource
+        from vpp_tpu.stats.collector import StatsCollector
+
+        dp, uplink = build_dp(
+            "enforce", proto_model(version=4, action="ratelimit",
+                                   rl_shift=2),
+            rules=[_permit_all()])
+        coll = StatsCollector(dp)
+        res = dp.process(make_packet_vector(
+            [dict(src="198.18.0.1", dst="10.1.1.2", proto=17,
+                  sport=53, dport=9000, rx_if=uplink)], n=8))
+        coll.update(res.stats)
+        path = tmp_path / "m.json"
+        path.write_text("garbage")
+        src = MlModelSource(dp, str(path))
+        src.poll()
+        cli = DebugCLI(dp, stats=coll, ml_source=src)
+        page = cli.run("show ml")
+        assert "ml stage: enforce" in page
+        assert "model mlp" in page
+        assert "v4" in page and "ratelimit" in page
+        assert "admit 1/4" in page
+        assert "scored 1" in page and "flagged 1" in page
+        assert "DEGRADED" in page and "corrupt 1" in page
+        assert "show ml" in cli.run("help")
+
+    def test_show_ml_without_model(self):
+        from vpp_tpu.cli import DebugCLI
+
+        dp, uplink = build_dp("score", model=None)
+        page = DebugCLI(dp).run("show ml")
+        assert "ml stage: off (knob score, model none)" in page
+        assert "no model staged" in page
+
+
+class TestAgentWiring:
+    def test_yaml_config_to_scoring_epoch(self, tmp_path):
+        """ml_model_path in the agent YAML: the artifact publishes at
+        start (before traffic), the maintenance tick hot-reloads on
+        mtime change, and the collector exports the ML surface."""
+        from vpp_tpu.cmd.agent import ContivAgent
+        from vpp_tpu.cmd.config import AgentConfig
+
+        mpath = tmp_path / "model.json"
+        save_model(proto_model(version=3), str(mpath))
+        cfg = AgentConfig.from_dict({
+            "node_name": "n1",
+            "serve_http": False,
+            "ml_model_path": str(mpath),
+            "dataplane": {"sess_slots": 256, "ml_stage": "enforce",
+                          "ml_hidden": 4},
+        })
+        a = ContivAgent(cfg)
+        a.start()
+        try:
+            assert a.dataplane._ml_mode == "enforce"
+            assert int(a.dataplane.tables.glb_ml_version) == 3
+            # hot reload: v5 overwrite + a maintenance tick
+            save_model(proto_model(version=5), str(mpath))
+            import os
+
+            os.utime(str(mpath), (1, 2 << 30))  # force mtime change
+            a.maintenance_tick()
+            assert int(a.dataplane.tables.glb_ml_version) == 5
+            text = a.stats.registry.render("/stats")
+            assert 'vpp_tpu_ml_stage{mode="enforce"} 1' in text
+            assert "vpp_tpu_ml_model_version 5" in text
+            assert 'vpp_tpu_degraded{component="ml"} 0' in text
+            assert 'vpp_tpu_ml_load_total{outcome="loaded"} 2' in text
+        finally:
+            a.close()

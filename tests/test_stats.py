@@ -232,7 +232,8 @@ def test_pump_counters_exported_over_prometheus():
                  "drops_tx_stall": 9, "drops_shutdown": 3,
                  "drops_rx_full": 0, "drops_error": 2,
                  "ring_windows": 6, "ring_frames": 11,
-                 "ring_inflight": 1, "ring_lag": 2, "io_callbacks": 0}
+                 "ring_inflight": 1, "ring_lag": 2, "io_callbacks": 0,
+                 "ml_scored": 1500, "ml_flagged": 42, "ml_drops": 17}
 
         @staticmethod
         def latency_us():
@@ -275,6 +276,10 @@ def test_pump_counters_exported_over_prometheus():
     assert 'vpp_tpu_pump_drops_total{reason="shutdown"} 3' in text
     assert 'vpp_tpu_pump_drops_total{reason="rx_full"} 0' in text
     assert 'vpp_tpu_pump_drops_total{reason="error"} 2' in text
+    # ML-stage aux riders (ISSUE 10): the pump-side verdict counters
+    assert "vpp_tpu_ml_pump_scored 1500" in text
+    assert "vpp_tpu_ml_pump_flagged 42" in text
+    assert "vpp_tpu_ml_pump_drops 17" in text
 
 
 def test_pump_drops_rx_full_merges_daemon_stats():
@@ -311,6 +316,80 @@ def test_pump_drops_rx_full_merges_daemon_stats():
     coll2.publish()
     text2 = coll2.registry.render("/stats")
     assert 'vpp_tpu_pump_drops_total{reason="rx_full"} 7' in text2
+
+
+def test_ml_stage_families_exported():
+    """Per-packet ML stage (ISSUE 10): StepStats verdict counters,
+    the mode/version info gauges, the load ledger and the ml degraded
+    component all reach the exposition."""
+    import numpy as np
+
+    from vpp_tpu.ir.rule import Action, ContivRule, Protocol
+    from vpp_tpu.ml.model import MlModel
+    from vpp_tpu.ops.mlscore import ML_FEATURES
+    from vpp_tpu.pipeline.tables import DataplaneConfig
+    from vpp_tpu.pipeline.vector import Disposition
+
+    w1 = np.zeros((ML_FEATURES, 4), np.int8)
+    w1[12, 0] = 1  # score == proto byte
+    model = MlModel(
+        kind="mlp", version=7, n_features=ML_FEATURES, w1=w1,
+        b1=np.zeros(4, np.int32), s1=0,
+        w2=np.array([1, 0, 0, 0], np.int8), b2=0,
+        flag_thresh=10, action="drop").validate()
+    dp = Dataplane(DataplaneConfig(
+        max_tables=2, max_rules=8, max_global_rules=8, max_ifaces=8,
+        fib_slots=16, sess_slots=64, nat_mappings=2, nat_backends=4,
+        ml_stage="enforce", ml_hidden=4))
+    uplink = dp.add_uplink()
+    dp.builder.add_route("0.0.0.0/0", uplink, Disposition.REMOTE)
+    dp.builder.set_global_table(
+        [ContivRule(action=Action.PERMIT, protocol=Protocol.ANY)])
+    dp.builder.set_ml_model(model)
+    dp.swap()
+    coll = StatsCollector(dp)
+    res = dp.process(make_packet_vector(
+        [dict(src="198.18.0.1", dst="203.0.113.9", proto=17, sport=53,
+              dport=9000, rx_if=uplink),
+         dict(src="198.18.0.2", dst="203.0.113.9", proto=6, sport=443,
+              dport=9001, rx_if=uplink)]))
+    coll.update(res.stats)
+
+    class FailingSource:
+        degraded = True
+
+        @staticmethod
+        def stats_snapshot():
+            return {"outcomes": {"loaded": 1, "corrupt": 2},
+                    "degraded": True, "last_error": "x",
+                    "loaded_version": 7, "loaded_kind": "mlp",
+                    "path": "/m.json"}
+
+    coll.set_ml(FailingSource())
+    coll.publish()
+    text = coll.registry.render("/stats")
+    assert "vpp_tpu_ml_scored_packets 2" in text
+    assert "vpp_tpu_ml_flagged_packets 1" in text      # UDP flagged
+    assert "vpp_tpu_ml_dropped_packets 1" in text      # and dropped
+    assert 'vpp_tpu_ml_stage{mode="enforce"} 1' in text
+    assert 'vpp_tpu_ml_stage{mode="off"} 0' in text
+    assert "vpp_tpu_ml_model_version 7" in text
+    assert 'vpp_tpu_ml_load_total{outcome="corrupt"} 2' in text
+    assert 'vpp_tpu_degraded{component="ml"} 1' in text
+
+
+def test_ml_degraded_defaults_healthy_without_source():
+    """The ml degraded component always exports (0 = healthy) even
+    with no loader attached — series absence is a wiring bug."""
+    dp = Dataplane(DataplaneConfig(
+        max_tables=2, max_rules=8, max_global_rules=8, max_ifaces=8,
+        fib_slots=16, sess_slots=64, nat_mappings=2, nat_backends=4))
+    coll = StatsCollector(dp)
+    coll.publish()
+    text = coll.registry.render("/stats")
+    assert 'vpp_tpu_degraded{component="ml"} 0' in text
+    assert 'vpp_tpu_ml_stage{mode="off"} 1' in text
+    assert "vpp_tpu_ml_model_version 0" in text
 
 
 def test_pump_stage_gauges_absent_keys_degrade_to_zero():

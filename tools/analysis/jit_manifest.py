@@ -85,6 +85,14 @@ TRACED_ROOTS = {
     # GONE (the ring form rides the process-wide step cache, so an
     # epoch-swap pump restart recompiles nothing)
     ("vpp_tpu/pipeline/dataplane.py", "_ring_call.run"),
+    # the per-packet ML stage (ISSUE 10): traced into every step
+    # variant whose ml_mode gate is on via graph._ml_eval — the stage
+    # rides the SAME process-wide _jitted_step cache (no jit site of
+    # its own, so an ML-enabled step compiles once, never per epoch)
+    ("vpp_tpu/ops/mlscore.py", "ml_features"),
+    ("vpp_tpu/ops/mlscore.py", "ml_score"),
+    ("vpp_tpu/ops/mlscore.py", "ml_policy"),
+    ("vpp_tpu/ops/session.py", "session_hit_age"),
     # classifier implementations reach jit through _classifier_fns /
     # time_classifier's subscripted call — enumerate them explicitly
     ("vpp_tpu/ops/acl.py", "acl_classify_global"),

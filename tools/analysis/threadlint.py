@@ -56,6 +56,9 @@ THREAD_ROOTS = (
     # bumped from every thread that crosses an armed point
     "vpp_tpu/pipeline/snapshot.py",
     "vpp_tpu/testing/faults.py",
+    # ISSUE 10: the ML model source's load ledger is written by the
+    # maintenance thread and snapshotted by the collector/CLI
+    "vpp_tpu/ml/loader.py",
 )
 
 LOCK_CTORS = {"Lock", "RLock", "Condition"}

@@ -21,7 +21,8 @@ from vpp_tpu.pipeline.vector import Disposition, ip4_str
 class DebugCLI:
     def __init__(self, dataplane: Dataplane, tracer=None, stats=None,
                  pump=None, io_ctl=None, session_engine=None,
-                 mesh_runtime=None, store=None, snapshotter=None):
+                 mesh_runtime=None, store=None, snapshotter=None,
+                 ml_source=None):
         self.dp = dataplane
         self.tracer = tracer
         self.stats = stats
@@ -39,6 +40,8 @@ class DebugCLI:
         # optional SessionSnapshotter (show resilience: snapshot
         # generation/age, degraded components, backoff state)
         self.snapshotter = snapshotter
+        # optional MlModelSource (show ml: load ledger, degraded flag)
+        self.ml_source = ml_source
 
     # --- dispatch ---
     def run(self, line: str) -> str:
@@ -57,6 +60,7 @@ class DebugCLI:
             ("show", "trace"): self.show_trace,
             ("show", "errors"): self.show_errors,
             ("show", "fastpath"): self.show_fastpath,
+            ("show", "ml"): self.show_ml,
             ("show", "io"): self.show_io,
             ("show", "neighbors"): self.show_neighbors,
             ("show", "store"): self.show_store,
@@ -85,7 +89,8 @@ class DebugCLI:
             "commands: show interface | show acl | show session | "
             "show sessions | show session-rules | show mesh | "
             "show nat44 | show fib | show trace | show errors | "
-            "show fastpath | show io | show neighbors | show store | "
+            "show fastpath | show ml | show io | show neighbors | "
+            "show store | "
             "show resilience | show config-history [n] | show spans [n] | "
             "trace add [n] | trace clear | config replay <journal> | "
             "test connectivity <src> <dst> <tcp|udp|icmp> [dport]"
@@ -682,6 +687,72 @@ class DebugCLI:
                 f"  pump: {fastb}/{total} batches on the fast path, "
                 f"session-hit {pct:.1f}% ({hits}/{alive} pkts)"
             )
+        return "\n".join(lines)
+
+    def show_ml(self) -> str:
+        """Per-packet ML stage state (ISSUE 10; ops/mlscore.py): the
+        configured knob vs the LIVE compiled mode, the staged model's
+        geometry/thresholds/policy, the verdict counters, and the
+        loader's refusal ledger — the `show acl`-grade operator page
+        for the scoring stage."""
+        dp = self.dp
+        b = dp.builder
+        knob = getattr(dp, "ml_stage", "off")
+        mode = getattr(dp, "_ml_mode", "off")
+        kind_code = int(getattr(b, "ml_kind", 0))
+        kind = {0: "none", 1: "mlp", 2: "forest"}.get(kind_code, "?")
+        lines = [
+            f"ml stage: {mode} (knob {knob}, model {kind})",
+        ]
+        if kind_code:
+            from vpp_tpu.ops.mlscore import ML_ACTION_NAMES
+
+            ml = b.ml
+            action = ML_ACTION_NAMES.get(
+                int(ml["glb_ml_action"]), "?")
+            lines.append(
+                f"  model: v{int(ml['glb_ml_version'])}, flag-thresh "
+                f"{int(ml['glb_ml_thresh'])}, action {action}"
+                + (f" (admit 1/{1 << int(ml['glb_ml_rl_shift'])} "
+                   f"flagged flows)" if action == "ratelimit" else ""))
+            if kind_code == 1:
+                f_dim, h = ml["glb_ml_w1"].shape
+                lines.append(
+                    f"  mlp: {f_dim} features x {h} hidden, requant "
+                    f"shift {int(ml['glb_ml_s1'])}")
+            else:
+                t, d = ml["glb_ml_f_feat"].shape
+                lines.append(
+                    f"  forest: {t} trees x depth {d} "
+                    f"({ml['glb_ml_f_leaf'].shape[1]} leaves)")
+        else:
+            lines.append("  no model staged (set ml_model_path, or "
+                         "TableBuilder.set_ml_model)")
+        if self.stats is not None:
+            tot = self.stats.totals_snapshot()
+            lines.append(
+                f"  verdicts: scored {tot.get('ml_scored', 0)}, "
+                f"flagged {tot.get('ml_flagged', 0)}, "
+                f"drops {tot.get('ml_drops', 0)}")
+        if self.pump is not None:
+            s = self.pump.stats
+            lines.append(
+                f"  pump riders: scored {s.get('ml_scored', 0)}, "
+                f"flagged {s.get('ml_flagged', 0)}, "
+                f"drops {s.get('ml_drops', 0)}")
+        src = self.ml_source
+        if src is not None:
+            st = src.stats_snapshot()
+            outcomes = {k: v for k, v in st["outcomes"].items() if v}
+            lines.append(
+                f"  loader: {st['path']}, "
+                + ("DEGRADED (previous model serving), "
+                   if st["degraded"] else "")
+                + ("loads " + ", ".join(
+                    f"{k} {v}" for k, v in sorted(outcomes.items()))
+                   if outcomes else "no loads attempted"))
+            if st["last_error"]:
+                lines.append(f"  last load error: {st['last_error']}")
         return "\n".join(lines)
 
     def show_io(self) -> str:

@@ -230,6 +230,18 @@ class ContivAgent:
                 pace_s=c.snapshot_pace_s,
             )
 
+        # --- per-packet ML model source (ISSUE 10; vpp_tpu/ml/) ---
+        # only with the stage configured on AND a standalone dataplane
+        # (a mesh staging handle's tables belong to the cluster epoch)
+        self.ml_source = None
+        if (c.ml_model_path
+                and getattr(c.dataplane, "ml_stage", "off") != "off"
+                and self.dataplane.tables is not None):
+            from vpp_tpu.ml.loader import MlModelSource
+
+            self.ml_source = MlModelSource(self.dataplane,
+                                           c.ml_model_path)
+
         # --- observability ---
         self.stats = StatsCollector(self.dataplane, self.container_index)
         # degraded-mode surface: kvstore reachability/staleness +
@@ -237,6 +249,8 @@ class ContivAgent:
         self.stats.set_store(self.store)
         if self.snapshotter is not None:
             self.stats.set_snapshotter(self.snapshotter)
+        if self.ml_source is not None:
+            self.stats.set_ml(self.ml_source)
         # control-plane latency histograms: propagation SLO + txn commit
         # observed at the epoch swap, CNI add/del at the CNI server
         self.cp_metrics = register_control_plane_metrics(self.stats.registry)
@@ -303,6 +317,11 @@ class ContivAgent:
                              c.snapshot_path)
             except Exception:
                 log.exception("session restore failed (cold start)")
+        # initial ML model publish (ISSUE 10): before traffic, so the
+        # first packets already score; a refusal is a counted outcome
+        # and the stage stays compiled out until a good load lands
+        if self.ml_source is not None:
+            self.ml_source.poll()
         # packet-IO front-end: shared-memory rings + the dataplane pump
         # (the vpp-tpu-io daemon attaches to the same shm and owns the
         # NIC/TAP endpoints — VERDICT r1 Missing #1). Created before the
@@ -454,6 +473,7 @@ class ContivAgent:
                     mesh_runtime=self.mesh_runtime,
                     store=self.store,
                     snapshotter=self.snapshotter,
+                    ml_source=self.ml_source,
                 )
 
                 def _cli_dispatch(method: str, params: dict) -> dict:
@@ -655,6 +675,14 @@ class ContivAgent:
                     self.config.snapshot_interval_s)
         except Exception:
             log.exception("session snapshot failed")
+        try:
+            # ML model hot reload: mtime-gated, so the tick is one
+            # stat() in steady state; a refused artifact keeps the
+            # previous model serving (counted, degraded{component=ml})
+            if self.ml_source is not None:
+                self.ml_source.poll()
+        except Exception:
+            log.exception("ml model poll failed")
         try:
             self.stats.publish()
         except Exception:

@@ -145,6 +145,15 @@ class AgentConfig:
     snapshot_interval_s: float = 30.0
     snapshot_chunk_buckets: int = 4096
     snapshot_pace_s: float = 0.0
+    # per-packet ML scoring stage (ISSUE 10; vpp_tpu/ml/): path of the
+    # versioned model artifact (vpp_tpu.ml.train emits it). Loaded at
+    # start and re-loaded by the maintenance loop whenever the file's
+    # mtime moves; a corrupt/mis-versioned artifact is REFUSED cleanly
+    # (counted outcome, vpp_tpu_degraded{component="ml"}) and the
+    # previous model keeps serving. Requires dataplane.ml_stage to be
+    # "score" or "enforce" — with the stage "off" the path is ignored
+    # (the glb_ml_* tables carry placeholder shapes). "" disables.
+    ml_model_path: str = ""
     # node liveness lease TTL (the etcd-lease analog; peers drop a
     # node's routes when it expires). Raise where long jit compiles or
     # heavy host contention can starve the keepalive thread.
@@ -183,6 +192,13 @@ class AgentConfig:
     # All four are validated at load (powers of two, divisibility) so a
     # bad value fails HERE with a clear message, not deep inside a jit
     # trace.
+    # + the per-packet ML stage (docs/ML_STAGE.md):
+    #   ``dataplane.ml_stage``   off | score | enforce — score marks/
+    #                            counts only, enforce folds the model's
+    #                            drop/ratelimit verdicts into the
+    #                            pipeline (deny > ml-drop > permit)
+    #   ``dataplane.ml_hidden``  MLP hidden-width capacity (shape)
+    #   ``dataplane.ml_trees``/``ml_depth``  forest capacity (shape)
     dataplane: DataplaneConfig = dataclasses.field(default_factory=DataplaneConfig)
     # IPAM subnets
     ipam: IpamConfig = dataclasses.field(default_factory=IpamConfig)

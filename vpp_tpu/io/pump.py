@@ -256,6 +256,12 @@ class DataplanePump:
             # tables) — the set-associative table's congestion signals,
             # delivered in the SAME fetch as the packed results
             "sess_insert_fails": 0, "sess_evictions": 0,
+            # per-packet ML stage riders (aux rows 5..7, ISSUE 10):
+            # packets scored / flagged / dropped by the model across
+            # every dispatch form (packed, chained, device-ring) — the
+            # packed paths never fetch StepStats, so the marking
+            # signal rides the same aux fetch as the fastpath rows
+            "ml_scored": 0, "ml_flagged": 0, "ml_drops": 0,
             # drops by CAUSE (packets; ISSUE 7 satellite — the r5
             # goodput number hid WHERE persistent-mode loss happened):
             # tx_stall = tx-ring-full discards by the writer,
@@ -611,6 +617,8 @@ class DataplanePump:
             classifier = getattr(self.dp, "_classifier_impl", "dense")
             skip_local = getattr(self.dp, "_skip_local", False)
             sweep_stride = getattr(self.dp, "_sweep_stride", None)
+            ml_mode = getattr(self.dp, "_ml_mode", "off")
+            ml_kind = getattr(self.dp, "_ml_kind", "mlp")
         self._ppump = PersistentPump(tables, batch=VEC,
                                      fastpath=fastpath,
                                      classifier=classifier,
@@ -618,6 +626,8 @@ class DataplanePump:
                                      sweep_stride=sweep_stride,
                                      ring_slots=self.ring_slots,
                                      ring_windows=self.ring_windows,
+                                     ml_mode=ml_mode,
+                                     ml_kind=ml_kind,
                                      ).start()
         self._persist_epoch = epoch
 
@@ -1093,7 +1103,7 @@ class DataplanePump:
             self._done_cv.notify_all()
 
     def _account_fastpath(self, aux) -> bool:
-        """Fold one dispatch's [5] (or chain-fold [K, 5]) aux summary
+        """Fold one dispatch's [8] (or chain-fold [K, 8]) aux summary
         into the pump counters; returns True when EVERY sub-batch ran
         the classify-free kernel (the whole dispatch's latency then
         belongs to the fast-tier histogram).
@@ -1104,7 +1114,8 @@ class DataplanePump:
         ratio is a true fraction). Partial folds still show up in the
         packet-level hits/alive accumulators. Rows 3/4 carry the
         session-table pressure counters (insert election losses,
-        evictions) when the program provides them."""
+        evictions) and rows 5-7 the ML-stage verdict counters
+        (scored / flagged / dropped) when the program provides them."""
         if aux is None:
             return False
         a = np.asarray(aux)
@@ -1119,6 +1130,10 @@ class DataplanePump:
             if a.shape[1] >= 5:
                 self.stats["sess_insert_fails"] += int(a[:, 3].sum())
                 self.stats["sess_evictions"] += int(a[:, 4].sum())
+            if a.shape[1] >= 8:
+                self.stats["ml_scored"] += int(a[:, 5].sum())
+                self.stats["ml_flagged"] += int(a[:, 6].sum())
+                self.stats["ml_drops"] += int(a[:, 7].sum())
         return all_fast
 
     # --- tx writer: reorder, split, write tx ring, release rx slots ---

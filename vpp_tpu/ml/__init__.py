@@ -1,0 +1,33 @@
+"""Per-packet ML scoring: model artifact, offline trainer/packer, and
+the agent-side loader (ISSUE 10; the device kernel lives in
+vpp_tpu/ops/mlscore.py).
+
+Re-exports resolve lazily (PEP 562, the stats/__init__ pattern): the
+trainer/packer must run NumPy-only on boxes with no jax, and importing
+the package must not initialize an accelerator backend.
+"""
+
+_LAZY = {
+    "MlModel": ("vpp_tpu.ml.model", "MlModel"),
+    "MlModelError": ("vpp_tpu.ml.model", "MlModelError"),
+    "load_model": ("vpp_tpu.ml.model", "load_model"),
+    "save_model": ("vpp_tpu.ml.model", "save_model"),
+    "score_oracle": ("vpp_tpu.ml.model", "score_oracle"),
+    "packet_features": ("vpp_tpu.ml.model", "packet_features"),
+    "MlModelSource": ("vpp_tpu.ml.loader", "MlModelSource"),
+    "train_and_pack": ("vpp_tpu.ml.train", "train_and_pack"),
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(name) from None
+    import importlib
+
+    value = getattr(importlib.import_module(mod_name), attr)
+    globals()[name] = value  # cache for subsequent lookups
+    return value

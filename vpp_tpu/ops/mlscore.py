@@ -1,0 +1,213 @@
+"""Per-packet ML scoring on the MXU: int8 fixed-point inference inside
+the fused step (ISSUE 10 tentpole; ROADMAP item 4).
+
+Taurus and Inference-to-complete (PAPERS.md) both argue the data plane
+should run a small model over EVERY packet — anomaly/DDoS marking as a
+first-class pipeline stage, not an offline sampler. Here the model is a
+tiny quantized MLP (optionally an oblivious decision forest) whose
+inference is expressed as batched int8 matmuls, so on TPU it rides the
+MXU's integer systolic path (``jnp.dot(int8, int8,
+preferred_element_type=int32)`` — the integer analog of the bf16
+bit-plane classify in ops/acl_mxu.py) and fuses into the one jitted
+pipeline program. No extra device round trip, no host sync: the stage
+is ~three matmul/elementwise groups between NAT-reverse and classify.
+
+Fixed-point contract (docs/ML_STAGE.md has the full scheme; the NumPy
+oracle in tests/test_ml_stage.py mirrors it independently):
+
+* features are uint8 (0..255), centered to int8 by subtracting 128 —
+  the zero-point fold: the ``+128 * column_sum(W)`` correction lands in
+  the int32 bias AT STAGING TIME (pipeline/tables.py ``_fold_ml``), so
+  the kernel is exactly ``dot(int8, int8) + b`` per layer;
+* layer 1: ``a1 = xc @ W1 + b1`` (int32 accum), relu, then a pure
+  right-shift requantization ``q1 = clip(a1 >> s1, 0, 255)`` — shift
+  only, multiplier-free, so every step of the pipeline is exact
+  integer math the oracle reproduces bit-for-bit;
+* layer 2: ``score = (q1 - 128) @ W2 + b2`` — one int32 score/packet.
+* forest variant: feature SELECTION is a one-hot int8 matmul (still
+  the MXU), then per-level threshold compares build the oblivious
+  leaf index and one [T, 2^D] gather sums the leaf votes.
+
+All magnitudes stay far inside int32: |a1| <= F*128*127 + |b1| < 2^22,
+layer 2 <= H*128*127 + |b2| < 2^22 at the default geometry.
+
+Policy (``glb_ml_action`` — a table VALUE, so changing it never
+recompiles): ``mark`` and ``mirror`` only flag (the mirror mask rides
+StepResult.ml_flagged for the IO path); ``drop`` drops every flagged
+packet; ``ratelimit`` admits 1/2^``glb_ml_rl_shift`` of flagged FLOWS
+by a stateless flow-hash gate and drops the rest. Enforcement itself
+is gated by the trace-time-static ``DataplaneConfig.ml_stage`` knob
+(off | score | enforce) through the step factory — ``score`` counts
+and exports, only ``enforce`` folds drops into the verdict, ordered
+deny > ml-drop > permit (pipeline/graph.py).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from vpp_tpu.pipeline.tables import DataplaneTables
+from vpp_tpu.pipeline.vector import PacketVector
+
+# Fixed per-packet feature vector width (docs/ML_STAGE.md):
+#  0..3   src_ip bytes (MSB first)      8  sport >> 8    12 proto
+#  4..7   dst_ip bytes                  9  sport & 255   13 len bucket
+#                                      10  dport >> 8    14 flags
+#                                      11  dport & 255   15 hit state
+#  16 session age bucket (ticks since last hit, saturating)
+#  17 reserved (always 0)
+# Models with fewer features zero-pad at pack time; the width is part
+# of the artifact and validated at load. ONE authority — the
+# NumPy-only artifact layer — so kernel/trainer/oracle can never
+# drift (re-exported here for device-side consumers).
+from vpp_tpu.ml.model import ML_FEATURES  # noqa: F401
+
+# glb_ml_kind values (staged by TableBuilder.set_ml_model; the KERNEL
+# variant is trace-time static — Dataplane re-gates at every swap)
+ML_KIND_NONE = 0
+ML_KIND_MLP = 1
+ML_KIND_FOREST = 2
+
+# glb_ml_action values (table VALUES — flipping them is an epoch swap,
+# never a recompile)
+ML_ACTION_MARK = 0
+ML_ACTION_DROP = 1
+ML_ACTION_RATELIMIT = 2
+ML_ACTION_MIRROR = 3
+
+ML_ACTION_NAMES = {
+    ML_ACTION_MARK: "mark",
+    ML_ACTION_DROP: "drop",
+    ML_ACTION_RATELIMIT: "ratelimit",
+    ML_ACTION_MIRROR: "mirror",
+}
+
+
+def ml_features(pkts: PacketVector, established: jnp.ndarray,
+                sess_age: jnp.ndarray) -> jnp.ndarray:
+    """The [P, ML_FEATURES] uint8 feature matrix of one packet vector.
+
+    Computed on the post-NAT-reverse header (what the full chain hands
+    the classifier) plus the reflective-session hit state/age — the
+    fast tier sees the identical header at its scoring point, so both
+    tiers produce bit-identical features by construction
+    (docs/ML_STAGE.md "fastpath interplay")."""
+    u8 = jnp.uint8
+
+    def b(x, shift):
+        return ((x >> shift) & 0xFF).astype(u8)
+
+    cols = [
+        b(pkts.src_ip, 24), b(pkts.src_ip, 16),
+        b(pkts.src_ip, 8), b(pkts.src_ip, 0),
+        b(pkts.dst_ip, 24), b(pkts.dst_ip, 16),
+        b(pkts.dst_ip, 8), b(pkts.dst_ip, 0),
+        b(pkts.sport, 8), b(pkts.sport, 0),
+        b(pkts.dport, 8), b(pkts.dport, 0),
+        (pkts.proto & 0xFF).astype(u8),
+        # 16-byte length buckets, saturating at 255 (4080+ bytes)
+        jnp.minimum(pkts.pkt_len >> 4, 255).astype(u8),
+        (pkts.flags & 0xFF).astype(u8),
+        jnp.where(established, 255, 0).astype(u8),
+        jnp.clip(sess_age, 0, 255).astype(u8),
+        jnp.zeros_like(pkts.proto).astype(u8),
+    ]
+    return jnp.stack(cols, axis=1)
+
+
+def _centered(feats: jnp.ndarray) -> jnp.ndarray:
+    """uint8 features → zero-point-centered int8 (x - 128). The +128
+    correction is pre-folded into the staged int32 biases
+    (pipeline/tables.py), so downstream math is a bare int8 dot."""
+    return (feats.astype(jnp.int32) - 128).astype(jnp.int8)
+
+
+def _mlp_scores(tables: DataplaneTables, xc: jnp.ndarray) -> jnp.ndarray:
+    """Quantized two-layer MLP: int8 matmuls with int32 accumulation
+    (the MXU integer path on TPU), relu, shift-requant — one int32
+    score per packet."""
+    a1 = jnp.dot(xc, tables.glb_ml_w1,
+                 preferred_element_type=jnp.int32) + tables.glb_ml_b1[None, :]
+    r1 = jnp.maximum(a1, 0)
+    q1 = jnp.clip(jnp.right_shift(r1, tables.glb_ml_s1), 0, 255)
+    q1c = (q1 - 128).astype(jnp.int8)
+    z = jnp.dot(q1c, tables.glb_ml_w2[:, None],
+                preferred_element_type=jnp.int32)[:, 0]
+    return z + tables.glb_ml_b2
+
+
+def _forest_scores(tables: DataplaneTables, xc: jnp.ndarray) -> jnp.ndarray:
+    """Oblivious decision forest: one-hot feature selection as an int8
+    matmul, per-level threshold bits → leaf index, one leaf-table
+    gather per packet. T trees of depth D vote int32 leaf values."""
+    trees, depth = tables.glb_ml_f_feat.shape
+    feat_flat = tables.glb_ml_f_feat.reshape(-1)          # [T*D]
+    sel = (jnp.arange(xc.shape[1], dtype=jnp.int32)[:, None]
+           == feat_flat[None, :]).astype(jnp.int8)        # [F, T*D]
+    # selected features, still centered; +128 restores the uint8 value
+    x_sel = jnp.dot(xc, sel, preferred_element_type=jnp.int32) + 128
+    bits = (x_sel > tables.glb_ml_f_thresh.reshape(-1)[None, :])
+    leaf = jnp.sum(
+        bits.reshape(-1, trees, depth).astype(jnp.int32)
+        << jnp.arange(depth, dtype=jnp.int32)[None, None, :],
+        axis=2,
+    )                                                     # [P, T]
+    votes = tables.glb_ml_f_leaf[
+        jnp.arange(trees, dtype=jnp.int32)[None, :], leaf]
+    return jnp.sum(votes, axis=1) + tables.glb_ml_b2
+
+
+def ml_score(tables: DataplaneTables, pkts: PacketVector,
+             established: jnp.ndarray, sess_age: jnp.ndarray,
+             kind: str = "mlp") -> jnp.ndarray:
+    """Score one packet vector: int32 [P]. ``kind`` ("mlp" | "forest")
+    is trace-time static — part of the step-factory key, re-gated by
+    the Dataplane at every swap from the staged model's kind — so the
+    compiled program never branches on a device scalar."""
+    xc = _centered(ml_features(pkts, established, sess_age))
+    # jax-ok: kind is a trace-time-static step-factory gate (a Python
+    # string baked into the jit key), not a tracer branch
+    if kind == "forest":
+        return _forest_scores(tables, xc)
+    return _mlp_scores(tables, xc)
+
+
+def _flow_hash(pkts: PacketVector) -> jnp.ndarray:
+    """Stateless per-flow hash for the rate-limit admission gate (the
+    ops/session.py multiplicative-xor scheme, unmasked)."""
+    h = pkts.src_ip * jnp.uint32(0x9E3779B1)
+    h ^= pkts.dst_ip * jnp.uint32(0x85EBCA77)
+    h ^= ((pkts.sport.astype(jnp.uint32) << 16)
+          | pkts.dport.astype(jnp.uint32)) * jnp.uint32(0xC2B2AE3D)
+    h ^= pkts.proto.astype(jnp.uint32) * jnp.uint32(0x27D4EB2F)
+    h ^= h >> 15
+    return h
+
+
+def ml_policy(tables: DataplaneTables, pkts: PacketVector,
+              alive: jnp.ndarray, scores: jnp.ndarray,
+              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fold scores into (flagged, drop_wanted) masks [P].
+
+    ``flagged`` marks alive packets whose score exceeds the model's
+    flag threshold (exported, mirrored, histogrammed — never dropped
+    by itself). ``drop_wanted`` is the action policy's drop REQUEST:
+    everything flagged under ``drop``, the rate-limited remainder
+    under ``ratelimit`` (a flow-hash gate admits 1/2^rl_shift flagged
+    FLOWS — deterministic per flow, so one flow is either limited or
+    not, never per-packet coin-flipped), nothing under mark/mirror.
+    The pipeline applies it only in enforce mode, after ACL deny
+    (deny beats ml-drop beats permit)."""
+    flagged = alive & (scores > tables.glb_ml_thresh)
+    action = tables.glb_ml_action
+    rl_mask = jnp.left_shift(jnp.uint32(1),
+                             tables.glb_ml_rl_shift.astype(jnp.uint32)
+                             ) - jnp.uint32(1)
+    rl_admit = (_flow_hash(pkts) & rl_mask) == 0
+    drop_wanted = flagged & (
+        (action == ML_ACTION_DROP)
+        | ((action == ML_ACTION_RATELIMIT) & ~rl_admit)
+    )
+    return flagged, drop_wanted

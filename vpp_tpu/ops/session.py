@@ -206,6 +206,19 @@ def session_batch_summary(
     return hits, hit_idx, all_hit
 
 
+def session_hit_age(
+    tables: DataplaneTables, hit_idx: jnp.ndarray, mask: jnp.ndarray, now
+) -> jnp.ndarray:
+    """Ticks since the matched session's last hit, per packet (int32
+    [P]; 0 where ``mask`` is False). Read BEFORE session_touch — the
+    touch resets the timestamp to ``now``. One flat gather; feeds the
+    ML stage's session-age feature (ops/mlscore.py)."""
+    n_buckets, ways = tables.sess_valid.shape
+    safe = jnp.clip(hit_idx, 0, n_buckets * ways - 1)
+    t = tables.sess_time.reshape(-1)[safe]
+    return jnp.where(mask, now - t, 0).astype(jnp.int32)
+
+
 def session_touch(
     tables: DataplaneTables, hit_idx: jnp.ndarray, mask: jnp.ndarray, now
 ) -> DataplaneTables:

@@ -35,11 +35,16 @@ RULE_AXIS = "rule"
 # keeps its rule-sharded dense/MXU classify and the BV fields ride
 # node-stacked only (docs/CLASSIFIER.md — ClusterDataplane pins its
 # node configs to classifier="dense", so they are minimal placeholders).
+# The ML-stage model fields (glb_ml_*, ops/mlscore.py) are likewise
+# node-stacked only: their axes are feature/hidden/tree dimensions,
+# not rule rows, and cluster node configs keep ml_stage off (minimal
+# placeholder shapes — docs/ML_STAGE.md).
 _RULE_SHARDED_FIELDS = frozenset(
     f
     for f in DataplaneTables._fields
     if f.startswith("glb_")
     and not f.startswith("glb_bv_")
+    and not f.startswith("glb_ml_")
     and f not in ("glb_nrules", "glb_mxu_coeff")
 )
 

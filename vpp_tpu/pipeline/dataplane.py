@@ -41,14 +41,17 @@ def _packed_call(step, with_aux: bool = False):
     """Wrap a pipeline step with a bit-packed IO boundary: ONE [5, B]
     int32 input and ONE [5, B] int32 output.
 
-    ``with_aux=True`` additionally returns a [5] int32 summary
-    ``[fastpath, rx, sess_hits, sess_insert_fails, sess_evictions]``
-    (StepStats scalars; the last two sum the reflective + NAT tables)
+    ``with_aux=True`` additionally returns an [8] int32 summary
+    ``[fastpath, rx, sess_hits, sess_insert_fails, sess_evictions,
+    ml_scored, ml_flagged, ml_drops]``
+    (StepStats scalars; rows 3/4 sum the reflective + NAT tables, rows
+    5-7 are the per-packet ML stage's verdict counters — ISSUE 10)
     per batch — the two-tier dispatch telemetry plus the session-table
-    pressure signals. It rides the SAME device program and the same
-    result fetch as the packed output (20 bytes, not a second round
-    trip), so the pump can count fast-path batches, hit percentage and
-    table congestion without widening the 20 B/packet boundary.
+    pressure and ML-marking signals. It rides the SAME device program
+    and the same result fetch as the packed output (32 bytes, not a
+    second round trip), so the pump can count fast-path batches, hit
+    percentage, table congestion and ML verdicts without widening the
+    20 B/packet boundary.
 
     Over a remote device transport (the axon tunnel) every host↔device
     transfer is a round trip; the unpacked path costs ~13 of them per
@@ -120,6 +123,7 @@ def _packed_call(step, with_aux: bool = False):
                 s.sess_insert_fail + s.natsess_insert_fail,
                 (s.sess_evict_expired + s.sess_evict_victim
                  + s.natsess_evict_expired + s.natsess_evict_victim),
+                s.ml_scored, s.ml_flagged, s.ml_drops,
             ]).astype(jnp.int32)
             return res.tables, packed, aux
         return res.tables, packed
@@ -159,8 +163,9 @@ def _chained_call(step, with_aux: bool = False):
 PACKED_IN_ROWS = 5
 PACKED_OUT_ROWS_N = 5
 # rows of the per-batch aux summary _packed_call(with_aux=True) returns
-# ([fastpath, rx, sess_hits, insert_fails, evictions])
-PACKED_AUX_ROWS = 5
+# ([fastpath, rx, sess_hits, insert_fails, evictions,
+#   ml_scored, ml_flagged, ml_drops])
+PACKED_AUX_ROWS = 8
 
 
 def _ring_call(step, slots: int):
@@ -193,7 +198,8 @@ def _ring_call(step, slots: int):
 
     Signature (donations in the jit wrapper, ``_jitted_step``):
       (tables, cursor, rx_ring [S,5,B], rx_now [S], rx_tail) ->
-      (tables', cursor + consumed, tx_ring [S,5,B], aux_ring [S,5])
+      (tables', cursor + consumed, tx_ring [S,5,B],
+       aux_ring [S, PACKED_AUX_ROWS])
     """
     packed = _packed_call(step, with_aux=True)
 
@@ -243,11 +249,15 @@ _JIT_COMPILES_LOCK = threading.Lock()
 
 
 def _step_label(impl: str, skip_local: bool, fast: bool, form: str,
-                sweep_stride: int, ring_slots: int = 0) -> str:
+                sweep_stride: int, ring_slots: int = 0,
+                ml_mode: str = "off", ml_kind: str = "mlp") -> str:
     from vpp_tpu.pipeline.graph import SWEEP_STRIDE_DEFAULT
 
-    return "{}{}{}{}_{}".format(
+    return "{}{}{}{}{}_{}".format(
         impl, "_nolocal" if skip_local else "", "_auto" if fast else "",
+        ("" if ml_mode == "off"
+         else f"_ml{ml_mode}"
+         + ("_forest" if ml_kind == "forest" else "")),
         ("" if sweep_stride == SWEEP_STRIDE_DEFAULT
          else f"_sw{sweep_stride}"),
         f"{form}{ring_slots}" if form == "ring" else form)
@@ -350,17 +360,20 @@ def jit_compile_budget(budget: int) -> _JitBudget:
 
 def _jitted_step(impl: str, skip_local: bool, fast: bool, form: str,
                  sweep_stride: Optional[int] = None,
-                 ring_slots: int = 0):
+                 ring_slots: int = 0,
+                 ml_mode: str = "off", ml_kind: str = "mlp"):
     from vpp_tpu.pipeline.graph import SWEEP_STRIDE_DEFAULT
 
     if sweep_stride is None:
         sweep_stride = SWEEP_STRIDE_DEFAULT
-    key = (impl, skip_local, fast, form, sweep_stride, ring_slots)
+    key = (impl, skip_local, fast, form, sweep_stride, ring_slots,
+           ml_mode, ml_kind)
     step = _JIT_STEPS.get(key)
     if step is None:
-        fn = make_pipeline_step(impl, skip_local, fast, sweep_stride)
+        fn = make_pipeline_step(impl, skip_local, fast, sweep_stride,
+                                ml_mode, ml_kind)
         label = _step_label(impl, skip_local, fast, form, sweep_stride,
-                            ring_slots)
+                            ring_slots, ml_mode, ml_kind)
         if form == "plain":
             step = jax.jit(_counting(label, fn))
         elif form == "packed":
@@ -522,6 +535,15 @@ class Dataplane:
             getattr(self.config, "fastpath_min_rules", 0)
         )
         self._use_fastpath = False
+        # Per-packet ML scoring stage (ISSUE 10; ops/mlscore.py): the
+        # configured mode (off | score | enforce) engages only once a
+        # model is actually staged (builder.set_ml_model) — re-gated
+        # at every swap like the classifier/fastpath selections, so a
+        # score/enforce config with no model compiles the stage OUT
+        # and scoring starts at the first model-publishing swap.
+        self.ml_stage = getattr(self.config, "ml_stage", "off")
+        self._ml_mode = "off"
+        self._ml_kind = "mlp"
         self._refresh_selection()
         # diagnostic classify-probe accumulators (time_classifier):
         # exported as the stage="classify" row of the
@@ -870,6 +892,11 @@ class Dataplane:
             self.fastpath_enabled
             and b.glb_nrules >= self.fastpath_min_rules
         )
+        # ML stage engages only with a model staged (kind != NONE);
+        # the staged model's kind picks the compiled kernel variant
+        ml_kind = int(getattr(b, "ml_kind", 0))
+        self._ml_mode = self.ml_stage if ml_kind else "off"
+        self._ml_kind = "forest" if ml_kind == 2 else "mlp"
 
     def _get_step(self, fast: bool, form: str = "plain"):
         """The jit-cached step variant of the current selection.
@@ -886,14 +913,16 @@ class Dataplane:
         policied epochs compiles ONE program, whichever came first."""
         skip = self._skip_local
         stride = self._sweep_stride
+        ml = (self._ml_mode, self._ml_kind)
         if (skip
-                and (self._classifier_impl, skip, fast, form, stride)
-                not in _JIT_STEPS
-                and (self._classifier_impl, False, fast, form, stride)
-                in _JIT_STEPS):
+                and (self._classifier_impl, skip, fast, form, stride,
+                     0) + ml not in _JIT_STEPS
+                and (self._classifier_impl, False, fast, form, stride,
+                     0) + ml in _JIT_STEPS):
             skip = False
         return _jitted_step(self._classifier_impl, skip, fast, form,
-                            stride)
+                            stride, ml_mode=self._ml_mode,
+                            ml_kind=self._ml_kind)
 
     def time_classifier(self, batch: int = 256, iters: int = 10) -> float:
         """Diagnostic: time the SELECTED global classifier in isolation
@@ -995,8 +1024,9 @@ class Dataplane:
         batch, 20 bytes per packet each way.
 
         ``with_aux=True`` returns ``(out, aux)`` instead, where ``aux``
-        is the DEVICE [5] int32 summary
-        ``[fastpath, rx, sess_hits, insert_fails, evictions]`` from the
+        is the DEVICE [8] int32 summary
+        ``[fastpath, rx, sess_hits, insert_fails, evictions,
+        ml_scored, ml_flagged, ml_drops]`` from the
         same program. It is
         measured on BOTH tiers (fastpath is 0 on the full chain), so
         the session-hit regime signal exists even with the fast path
@@ -1035,7 +1065,7 @@ class Dataplane:
         frames — the bounded-sync throughput lever when per-step
         dispatch dominates (remote transports, small frames).
         ``with_aux=True`` returns ``(outs, auxs)`` with the stacked
-        [K, 3] fast-path summaries (measured on both tiers)."""
+        [K, 8] aux summaries (measured on both tiers)."""
         with self._lock:
             if self.tables is None:
                 raise RuntimeError(

@@ -29,8 +29,10 @@ from vpp_tpu.ops.nat44 import (
     nat44_snat,
     nat44_touch,
 )
+from vpp_tpu.ops.mlscore import ml_policy, ml_score
 from vpp_tpu.ops.session import (
     session_batch_summary,
+    session_hit_age,
     session_insert,
     session_lookup_reverse_idx,
     session_sweep,
@@ -81,6 +83,13 @@ class StepStats(NamedTuple):
     sess_evict_victim: jnp.ndarray      # int32 scalar
     natsess_evict_expired: jnp.ndarray  # int32 scalar
     natsess_evict_victim: jnp.ndarray   # int32 scalar
+    # per-packet ML scoring stage (ops/mlscore.py; all 0 with the
+    # stage compiled off): alive packets scored, packets whose score
+    # crossed the model's flag threshold, and packets the ENFORCE
+    # policy actually dropped (score mode never drops)
+    ml_scored: jnp.ndarray              # int32 scalar
+    ml_flagged: jnp.ndarray             # int32 scalar
+    ml_drops: jnp.ndarray               # int32 scalar
 
 
 # Per-packet drop attribution (error-drop counter analog).
@@ -90,6 +99,7 @@ DROP_ACL = 2        # policy deny
 DROP_NO_ROUTE = 3   # FIB miss
 DROP_FIB = 4        # matched a drop route
 DROP_NAT = 5        # NAT fail-closed (port collision / un-NATable proto)
+DROP_ML = 6         # ML-stage enforce verdict (drop / rate-limited)
 
 DROP_CAUSE_NAMES = {
     DROP_NONE: "none",
@@ -98,6 +108,7 @@ DROP_CAUSE_NAMES = {
     DROP_NO_ROUTE: "no-route",
     DROP_FIB: "fib-drop",
     DROP_NAT: "nat-drop",
+    DROP_ML: "ml-drop",
 }
 
 
@@ -113,6 +124,10 @@ class StepResult(NamedTuple):
     established: jnp.ndarray   # bool [P] admitted via reflective session
     dnat_applied: jnp.ndarray  # bool [P] DNAT rewrote the destination
     snat_applied: jnp.ndarray  # bool [P] SNAT rewrote the source
+    ml_flagged: jnp.ndarray    # bool [P] ML score crossed the flag
+                               # threshold (the mirror mask: the IO
+                               # path can copy these out; all-False
+                               # with the stage off)
 
 
 def _ingress(tables: DataplaneTables, pkts: PacketVector):
@@ -125,6 +140,36 @@ def _ingress(tables: DataplaneTables, pkts: PacketVector):
     bad_if = tables.if_type[pkts.rx_if] == 0
     drop_ip4 = drop_ip4 | (bad_if & pkts.valid)
     return pkts, drop_ip4, pkts.valid & ~drop_ip4
+
+
+def _ml_eval(tables: DataplaneTables, pkts: PacketVector,
+             alive: jnp.ndarray, established: jnp.ndarray,
+             sess_age: jnp.ndarray, ml_mode: str, ml_kind: str):
+    """The ONE copy of the ML-stage evaluation (ISSUE 10), shared by
+    the full chain and the established-flow fast tier so the two can
+    never silently diverge: scored on the post-NAT-reverse header plus
+    the reflective-session hit state/age — values both tiers hold at
+    their scoring point, bit-identically.
+
+    Returns ``(scored, flagged, drop_wanted)`` masks [P]. ``ml_mode``
+    / ``ml_kind`` are trace-time-static step-factory gates: "off"
+    returns all-False constants XLA folds away (the stage costs
+    nothing when disabled); "score" never requests drops; only
+    "enforce" passes the policy's drop verdict through — which the
+    pipeline then applies AFTER the ACL verdict (deny beats ml-drop
+    beats permit, pinned by tests/test_ml_stage.py)."""
+    # jax-ok: ml_mode/ml_kind are trace-time-static step-factory gates
+    # (Python strings baked into the jit key), not tracer branches
+    if ml_mode == "off":
+        false_p = jnp.zeros(alive.shape, bool)
+        return false_p, false_p, false_p
+    scores = ml_score(tables, pkts, established, sess_age, kind=ml_kind)
+    flagged, drop_wanted = ml_policy(tables, pkts, alive, scores)
+    # jax-ok: ml_mode is the same trace-time-static gate as above —
+    # score mode statically discards the policy's drop verdict
+    if ml_mode != "enforce":
+        drop_wanted = jnp.zeros(alive.shape, bool)
+    return alive, flagged, drop_wanted
 
 
 def _finish_step(
@@ -151,6 +196,9 @@ def _finish_step(
     sess_evict_victim: jnp.ndarray,
     natsess_evict_expired: jnp.ndarray,
     natsess_evict_victim: jnp.ndarray,
+    ml_scored: jnp.ndarray,
+    ml_flagged: jnp.ndarray,
+    ml_dropped: jnp.ndarray,
     sweep_stride: int = 0,
 ) -> StepResult:
     """Shared tail of both pipeline tiers: drop attribution, counters,
@@ -164,14 +212,18 @@ def _finish_step(
     fused program identically."""
     tables = session_sweep(tables, now, sweep_stride)
     n_ifaces = tables.if_type.shape[0]
-    drop_no_route = alive & permit & ~fib.matched
+    # ml-drop wins attribution over the FIB outcomes (the packet never
+    # reached forwarding), but LOSES to ACL deny: ml_dropped is
+    # already masked to permitted traffic by the callers
+    drop_no_route = alive & permit & ~fib.matched & ~ml_dropped
     fib_dropped = alive & permit & fib.matched & (
         fib.disp == int(Disposition.DROP)
-    )
+    ) & ~ml_dropped
     dropped = (
         (pkts.valid & (drop_ip4 | drop_acl | drop_no_route))
         | fib_dropped
         | dropped_nat
+        | ml_dropped
     )
     rx_if_safe = jnp.where(alive, pkts.rx_if, n_ifaces)
     tx_if_safe = jnp.where(forwarded, tx_if, n_ifaces)
@@ -220,6 +272,9 @@ def _finish_step(
             natsess_evict_expired.astype(jnp.int32)),
         natsess_evict_victim=jnp.sum(
             natsess_evict_victim.astype(jnp.int32)),
+        ml_scored=jnp.sum(ml_scored.astype(jnp.int32)),
+        ml_flagged=jnp.sum(ml_flagged.astype(jnp.int32)),
+        ml_drops=jnp.sum(ml_dropped.astype(jnp.int32)),
     )
     drop_cause = (
         jnp.where(pkts.valid & drop_ip4, DROP_IP4, 0)
@@ -227,6 +282,7 @@ def _finish_step(
         + jnp.where(drop_no_route, DROP_NO_ROUTE, 0)
         + jnp.where(fib_dropped, DROP_FIB, 0)
         + jnp.where(dropped_nat, DROP_NAT, 0)
+        + jnp.where(ml_dropped, DROP_ML, 0)
     ).astype(jnp.int32)
     return StepResult(
         pkts=pkts,
@@ -240,6 +296,7 @@ def _finish_step(
         established=established,
         dnat_applied=dnat_applied,
         snat_applied=snat_applied,
+        ml_flagged=ml_flagged,
     )
 
 
@@ -257,6 +314,8 @@ def pipeline_step(
     acl_global_fn=acl_classify_global,
     acl_local_fn=acl_classify_local,
     sweep_stride: int = SWEEP_STRIDE_DEFAULT,
+    ml_mode: str = "off",
+    ml_kind: str = "mlp",
 ) -> StepResult:
     """Process one packet vector through the full forwarding chain.
 
@@ -268,7 +327,8 @@ def pipeline_step(
     (the BV implementation, or the policy-free skip —
     ``make_pipeline_step`` composes both). ``sweep_stride`` buckets per
     session table are aged inside the step (trace-time static —
-    ops/session.py session_sweep).
+    ops/session.py session_sweep). ``ml_mode``/``ml_kind`` gate the
+    per-packet ML scoring stage (trace-time static — ``_ml_eval``).
     """
     # --- ip4-input (+ unconfigured-interface drop) ---
     pkts, drop_ip4, alive = _ingress(tables, pkts)
@@ -280,11 +340,21 @@ def pipeline_step(
     # refresh the timestamp — active flows never expire mid-flow.
     established, sess_hit_idx = session_lookup_reverse_idx(tables, pkts, now)
     established = established & alive
+    # pre-touch session age: an ML feature (the touch below refreshes
+    # the timestamp, so the age must be captured first — the fast tier
+    # captures it at the same pre-touch point, docs/ML_STAGE.md)
+    sess_age = session_hit_age(tables, sess_hit_idx, established, now)
     tables = session_touch(tables, sess_hit_idx, established, now)
 
     # --- NAT44: reverse-translate return traffic, then DNAT new flows ---
     pkts, nat_reversed, nat_hit_idx = nat44_reverse(tables, pkts, alive, now)
     tables = nat44_touch(tables, nat_hit_idx, nat_reversed, now)
+
+    # --- per-packet ML scoring (ISSUE 10): on the post-reverse header,
+    # the same values the fast tier scores — ONE shared evaluation
+    ml_scored, ml_flagged, ml_drop_want = _ml_eval(
+        tables, pkts, alive, established, sess_age, ml_mode, ml_kind)
+
     orig_dst, orig_dport = pkts.dst_ip, pkts.dport
     pkts, dnat_applied, dnat_self_snat = nat44_dnat(
         tables, pkts, alive & ~nat_reversed
@@ -296,9 +366,15 @@ def pipeline_step(
     permit = (local_v.permit & glob_v.permit) | established
     drop_acl = alive & ~permit
 
+    # enforce-mode ML verdict, folded AFTER the ACL verdict: an
+    # ACL-denied packet stays an ACL drop (deny beats ml-drop), an
+    # ACL-permitted flagged packet drops here (ml-drop beats permit)
+    ml_dropped = ml_drop_want & permit & alive
+
     # --- ip4-lookup (on possibly NAT-rewritten dst) ---
     fib = ip4_lookup(tables, pkts.dst_ip)
-    forwarded = alive & permit & fib.matched & (fib.disp != int(Disposition.DROP))
+    forwarded = (alive & permit & ~ml_dropped & fib.matched
+                 & (fib.disp != int(Disposition.DROP)))
     disp = jnp.where(forwarded, fib.disp, int(Disposition.DROP)).astype(jnp.int32)
     tx_if = jnp.where(forwarded, fib.tx_if, -1)
 
@@ -349,6 +425,7 @@ def pipeline_step(
         fastpath=jnp.int32(0),
         sess_evict_expired=sess_ev_exp, sess_evict_victim=sess_ev_vic,
         natsess_evict_expired=nat_ev_exp, natsess_evict_victim=nat_ev_vic,
+        ml_scored=ml_scored, ml_flagged=ml_flagged, ml_dropped=ml_dropped,
         sweep_stride=sweep_stride,
     )
 
@@ -379,6 +456,8 @@ def _pipeline_fast_finish(
     nat_reversed: jnp.ndarray,
     nat_hit_idx: jnp.ndarray,
     sweep_stride: int = SWEEP_STRIDE_DEFAULT,
+    ml_mode: str = "off",
+    ml_kind: str = "mlp",
 ) -> StepResult:
     """Tail of the classify-free kernel, from post-reverse headers on.
 
@@ -387,7 +466,17 @@ def _pipeline_fast_finish(
     `established`, SNAT/session-insert/NAT-record are statically empty
     (they all require a fresh flow or a DNAT hit) and are elided rather
     than computed-and-discarded — that elision IS the speedup.
+
+    The ML stage is NOT elided: the fast tier still scores (and in
+    enforce mode still drops) every packet — anomaly traffic rides
+    established flows too, and a fast tier that skipped the model
+    would silently diverge from the full chain exactly on the
+    steady-state traffic the model exists to police. ``_ml_eval`` is
+    the ONE shared evaluation; the age feature is captured pre-touch
+    here exactly as the full chain captures it.
     """
+    # pre-touch session age (the ML age feature — full-chain parity)
+    sess_age = session_hit_age(tables, sess_hit_idx, established, now)
     tables = session_touch(tables, sess_hit_idx, established, now)
     tables = nat44_touch(tables, nat_hit_idx, nat_reversed, now)
 
@@ -396,8 +485,12 @@ def _pipeline_fast_finish(
     permit = established
     drop_acl = alive & ~permit
 
+    ml_scored, ml_flagged, ml_drop_want = _ml_eval(
+        tables, pkts, alive, established, sess_age, ml_mode, ml_kind)
+    ml_dropped = ml_drop_want & permit & alive
+
     fib = ip4_lookup(tables, pkts.dst_ip)
-    forwarded = alive & permit & fib.matched & (
+    forwarded = alive & permit & ~ml_dropped & fib.matched & (
         fib.disp != int(Disposition.DROP)
     )
     disp = jnp.where(forwarded, fib.disp, int(Disposition.DROP)).astype(
@@ -415,6 +508,7 @@ def _pipeline_fast_finish(
         sess_fail=false_p, natsess_fail=false_p, fastpath=jnp.int32(1),
         sess_evict_expired=false_p, sess_evict_victim=false_p,
         natsess_evict_expired=false_p, natsess_evict_victim=false_p,
+        ml_scored=ml_scored, ml_flagged=ml_flagged, ml_dropped=ml_dropped,
         sweep_stride=sweep_stride,
     )
 
@@ -422,9 +516,12 @@ def _pipeline_fast_finish(
 def pipeline_step_fast(
     tables: DataplaneTables, pkts: PacketVector, now: jnp.ndarray,
     sweep_stride: int = SWEEP_STRIDE_DEFAULT,
+    ml_mode: str = "off",
+    ml_kind: str = "mlp",
 ) -> StepResult:
     """The classify-free established-flow kernel, standalone:
-    ip4-input → session lookup/touch → NAT reverse/touch → FIB → tx.
+    ip4-input → session lookup/touch → NAT reverse/touch → [ML score]
+    → FIB → tx.
 
     Bit-exact with ``pipeline_step`` ONLY when every valid packet hits
     a live reflective session and none DNAT-matches — the invariant
@@ -439,6 +536,7 @@ def pipeline_step_fast(
     return _pipeline_fast_finish(
         tables, pkts, now, alive, drop_ip4, established, sess_hit_idx,
         nat_reversed, nat_hit_idx, sweep_stride=sweep_stride,
+        ml_mode=ml_mode, ml_kind=ml_kind,
     )
 
 
@@ -449,6 +547,8 @@ def pipeline_step_auto(
     acl_global_fn=acl_classify_global,
     acl_local_fn=acl_classify_local,
     sweep_stride: int = SWEEP_STRIDE_DEFAULT,
+    ml_mode: str = "off",
+    ml_kind: str = "mlp",
 ) -> StepResult:
     """Two-tier dispatch: the fast kernel when the whole batch rides
     established sessions, the full chain otherwise.
@@ -485,11 +585,13 @@ def pipeline_step_auto(
         return _pipeline_fast_finish(
             tables, rpkts, now, alive, drop_ip4, hits, sess_hit_idx,
             nat_reversed, nat_hit_idx, sweep_stride=sweep_stride,
+            ml_mode=ml_mode, ml_kind=ml_kind,
         )
 
     def full(_):
         return pipeline_step(tables, orig_pkts, now, acl_global_fn,
-                             acl_local_fn, sweep_stride=sweep_stride)
+                             acl_local_fn, sweep_stride=sweep_stride,
+                             ml_mode=ml_mode, ml_kind=ml_kind)
 
     return lax.cond(ok, fast, full, None)
 
@@ -518,16 +620,17 @@ def _classifier_fns(impl: str):
 @functools.lru_cache(maxsize=None)
 def make_pipeline_step(impl: str = "dense", skip_local: bool = False,
                        fast: bool = False,
-                       sweep_stride: int = SWEEP_STRIDE_DEFAULT):
+                       sweep_stride: int = SWEEP_STRIDE_DEFAULT,
+                       ml_mode: str = "off", ml_kind: str = "mlp"):
     """Compose one pipeline-step callable from the epoch's gates:
     classifier implementation (dense | mxu | bv), the policy-free
-    local-classify skip, the two-tier fast-path dispatch, and the
-    session sweep stride (trace-time static — part of the memo key, so
-    two configs with different strides never share a program). The
-    Dataplane builds (and jit-caches) its step variants exclusively
-    through here, so every (impl, skip, tier, stride) combination
-    shares ONE chain definition — a pipeline edit can't diverge a
-    variant.
+    local-classify skip, the two-tier fast-path dispatch, the session
+    sweep stride, and the ML-stage mode/kernel kind (all trace-time
+    static — part of the memo key, so two configs with different gates
+    never share a program). The Dataplane builds (and jit-caches) its
+    step variants exclusively through here, so every (impl, skip,
+    tier, stride, ml) combination shares ONE chain definition — a
+    pipeline edit can't diverge a variant.
 
     Memoized: equal gates return the SAME function object, so jax's
     function-identity tracing/compilation caches are shared across
@@ -536,6 +639,10 @@ def make_pipeline_step(impl: str = "dense", skip_local: bool = False,
     would recompile the whole chain per dataplane instance."""
     from vpp_tpu.ops.acl import acl_local_none
 
+    if ml_mode not in ("off", "score", "enforce"):
+        raise ValueError(f"unknown ml_mode {ml_mode!r}")
+    if ml_kind not in ("mlp", "forest"):
+        raise ValueError(f"unknown ml_kind {ml_kind!r}")
     acl_global_fn, acl_local_fn = _classifier_fns(impl)
     if skip_local:
         acl_local_fn = acl_local_none
@@ -544,10 +651,13 @@ def make_pipeline_step(impl: str = "dense", skip_local: bool = False,
     def step(tables: DataplaneTables, pkts: PacketVector,
              now: jnp.ndarray) -> StepResult:
         return base(tables, pkts, now, acl_global_fn=acl_global_fn,
-                    acl_local_fn=acl_local_fn, sweep_stride=sweep_stride)
+                    acl_local_fn=acl_local_fn, sweep_stride=sweep_stride,
+                    ml_mode=ml_mode, ml_kind=ml_kind)
 
-    step.__name__ = "pipeline_step_{}{}{}".format(
-        impl, "_nolocal" if skip_local else "", "_auto" if fast else ""
+    step.__name__ = "pipeline_step_{}{}{}{}".format(
+        impl, "_nolocal" if skip_local else "", "_auto" if fast else "",
+        "" if ml_mode == "off" else f"_ml{ml_mode}"
+        + ("_forest" if ml_kind == "forest" else ""),
     )
     return step
 

@@ -77,7 +77,8 @@ class PersistentPump:
     are staged into descriptor-ring windows and exchanged with the
     device one window at a time (module doc).
 
-    ``fastpath``/``classifier``/``skip_local``/``sweep_stride`` mirror
+    ``fastpath``/``classifier``/``skip_local``/``sweep_stride``/
+    ``ml_mode``/``ml_kind`` mirror
     the owning Dataplane's epoch selection exactly as before — the
     window program is fetched from the process-wide ``_jitted_step``
     cache keyed on them plus the ring geometry, so a pump restart
@@ -93,7 +94,8 @@ class PersistentPump:
                  fastpath: bool = True, classifier: str = "dense",
                  skip_local: bool = False,
                  sweep_stride: Optional[int] = None,
-                 ring_slots: int = 8, ring_windows: int = 2):
+                 ring_slots: int = 8, ring_windows: int = 2,
+                 ml_mode: str = "off", ml_kind: str = "mlp"):
         self.batch = int(batch)
         self.fastpath_enabled = bool(fastpath)
         self.ring = DeviceDescRing(slots=ring_slots, batch=self.batch,
@@ -115,7 +117,8 @@ class PersistentPump:
         self._max_frames = max_frames  # legacy knob; windows need no budget
         self._step = _jitted_step(classifier, skip_local, fast=fastpath,
                                   form="ring", sweep_stride=sweep_stride,
-                                  ring_slots=self.ring.slots)
+                                  ring_slots=self.ring.slots,
+                                  ml_mode=ml_mode, ml_kind=ml_kind)
         # device-resident frame cursor, threaded window-to-window next
         # to the tables (the sweep-cursor pattern); fetched only by
         # stats()/stop, never per window
@@ -198,9 +201,10 @@ class PersistentPump:
 
     def result_ex(self, timeout: Optional[float] = None):
         """Like result(), but returns ``(out, aux)`` where ``aux`` is
-        the frame's [5] int32 summary
-        ``[fastpath, rx, sess_hits, insert_fails, evictions]`` (the
-        pump's regime + session-pressure telemetry)."""
+        the frame's [8] int32 summary
+        ``[fastpath, rx, sess_hits, insert_fails, evictions,
+        ml_scored, ml_flagged, ml_drops]`` (the pump's regime,
+        session-pressure and ML-marking telemetry)."""
         try:
             return self._out.get(timeout=timeout)
         except queue.Empty:

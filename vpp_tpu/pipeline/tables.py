@@ -96,6 +96,23 @@ class DataplaneConfig(NamedTuple):
     classifier: str = "auto"
     classifier_bv_min_rules: int = 1024
     classifier_bv_mem_mb: int = 256
+    # Per-packet ML scoring stage (ops/mlscore.py; docs/ML_STAGE.md):
+    # "off" elides the stage from the compiled step entirely (and the
+    # glb_ml_* fields carry minimal placeholder shapes, the BV
+    # allocation-gating pattern); "score" computes + counts + exports
+    # verdicts only; "enforce" additionally folds the model's
+    # drop/ratelimit decisions into the pipeline verdict (ordered
+    # deny > ml-drop > permit). The staged MODEL arrives through
+    # TableBuilder.set_ml_model (epoch-swapped like ACL rules); with
+    # no model staged the stage stays compiled-out even when the knob
+    # says score/enforce (re-gated at every swap, the fastpath
+    # pattern).
+    ml_stage: str = "off"
+    # capacity ceilings of the staged model (compile-time SHAPES; a
+    # smaller model zero-pads, a larger one is refused at staging)
+    ml_hidden: int = 16        # MLP hidden width
+    ml_trees: int = 4          # oblivious-forest tree count
+    ml_depth: int = 3          # oblivious-forest depth (leaves = 2^D)
 
 
 class DataplaneTables(NamedTuple):
@@ -164,6 +181,26 @@ class DataplaneTables(NamedTuple):
     glb_bv_sport: jnp.ndarray      # uint32 [I, W]
     glb_bv_dport: jnp.ndarray      # uint32 [I, W]
     glb_bv_proto: jnp.ndarray      # uint32 [PR, W]
+
+    # --- per-packet ML model (ops/mlscore.py; upload group "ml") ---
+    # Shipped through set_ml_model exactly like ACL rules ship through
+    # set_global_table: its OWN upload group, so policy churn never
+    # re-ships the model and a model swap never re-ships the rules.
+    # Minimal placeholder shapes when ml_stage is "off"
+    # (ml_capacity(config)); biases are zero-point FOLDED (int8
+    # features are centered x-128 — _fold_ml below).
+    glb_ml_w1: jnp.ndarray       # int8 [F, H] layer-1 weights
+    glb_ml_b1: jnp.ndarray       # int32 [H] layer-1 bias (folded)
+    glb_ml_s1: jnp.ndarray       # int32 scalar: requant right shift
+    glb_ml_w2: jnp.ndarray       # int8 [H] output weights
+    glb_ml_b2: jnp.ndarray       # int32 scalar: output bias (folded)
+    glb_ml_f_feat: jnp.ndarray   # int32 [T, D] forest feature index
+    glb_ml_f_thresh: jnp.ndarray  # int32 [T, D] forest thresholds
+    glb_ml_f_leaf: jnp.ndarray   # int32 [T, 2^D] forest leaf votes
+    glb_ml_thresh: jnp.ndarray   # int32 scalar: score > t => flagged
+    glb_ml_action: jnp.ndarray   # int32 scalar: ML_ACTION_* policy
+    glb_ml_rl_shift: jnp.ndarray  # int32 scalar: ratelimit admit shift
+    glb_ml_version: jnp.ndarray  # int32 scalar: staged model version
 
     # --- interfaces [I] ---
     if_type: jnp.ndarray        # int32 InterfaceType
@@ -331,6 +368,137 @@ def validate_dataplane_config(config: DataplaneConfig) -> None:
         raise ValueError(
             f"dataplane.sess_sweep_stride must be 0 (disabled) or a "
             f"power of two, got {stride}")
+    ml_stage = getattr(c, "ml_stage", "off")
+    if ml_stage not in ("off", "score", "enforce"):
+        raise ValueError(
+            f"dataplane.ml_stage must be off | score | enforce, got "
+            f"{ml_stage!r}")
+    if int(getattr(c, "ml_hidden", 16)) < 1:
+        raise ValueError(
+            f"dataplane.ml_hidden must be >= 1, got {c.ml_hidden}")
+    if int(getattr(c, "ml_trees", 4)) < 1:
+        raise ValueError(
+            f"dataplane.ml_trees must be >= 1, got {c.ml_trees}")
+    if not (1 <= int(getattr(c, "ml_depth", 3)) <= 8):
+        raise ValueError(
+            f"dataplane.ml_depth must be in 1..8 (leaf table is "
+            f"2^depth), got {c.ml_depth}")
+
+
+def ml_capacity(config: DataplaneConfig) -> Tuple[int, int, int, int]:
+    """(features, hidden, trees, depth) capacity of the staged ML
+    model arrays. With ml_stage "off" the fields carry minimal
+    placeholder shapes (the BV allocation-gating pattern) — the stage
+    is compiled out, so the placeholders are never read."""
+    from vpp_tpu.ops.mlscore import ML_FEATURES
+
+    if getattr(config, "ml_stage", "off") == "off":
+        return ML_FEATURES, 1, 1, 1
+    return (ML_FEATURES, int(getattr(config, "ml_hidden", 16)),
+            int(getattr(config, "ml_trees", 4)),
+            int(getattr(config, "ml_depth", 3)))
+
+
+def empty_ml(config: DataplaneConfig) -> Dict[str, np.ndarray]:
+    """Zero (no-model) ML staging arrays at the config's capacity.
+    glb_ml_thresh defaults to INT32_MAX so even a kernel compiled with
+    the stage on flags nothing until a model is staged (belt to the
+    kind==NONE re-gate's braces)."""
+    f, h, t, d = ml_capacity(config)
+    return {
+        "glb_ml_w1": np.zeros((f, h), np.int8),
+        "glb_ml_b1": np.zeros(h, np.int32),
+        "glb_ml_s1": np.int32(0),
+        "glb_ml_w2": np.zeros(h, np.int8),
+        "glb_ml_b2": np.int32(0),
+        "glb_ml_f_feat": np.zeros((t, d), np.int32),
+        "glb_ml_f_thresh": np.zeros((t, d), np.int32),
+        "glb_ml_f_leaf": np.zeros((t, 1 << d), np.int32),
+        "glb_ml_thresh": np.int32(0x7FFFFFFF),
+        "glb_ml_action": np.int32(0),
+        "glb_ml_rl_shift": np.int32(0),
+        "glb_ml_version": np.int32(0),
+    }
+
+
+def _fold_ml(model, config: DataplaneConfig) -> Tuple[Dict[str, np.ndarray], int]:
+    """Validate one MlModel against the config capacity and produce
+    the padded, zero-point-FOLDED staging arrays (+ the staged kind).
+
+    Validates COMPLETELY before returning — the builder only assigns
+    the result, so a refused model can never leave staging
+    half-mutated (the loader's keep-serving-the-previous-epoch
+    contract). The fold: device features are int8 ``x - 128``, so each
+    integer bias absorbs ``+128 * column_sum(W)``; exact in integers,
+    pinned bit-exact against the unfolded oracle by
+    tests/test_ml_stage.py."""
+    from vpp_tpu.ml.model import MlModel, MlModelError
+    from vpp_tpu.ops.mlscore import (
+        ML_ACTION_NAMES,
+        ML_KIND_FOREST,
+        ML_KIND_MLP,
+    )
+
+    if isinstance(model, dict):
+        model = MlModel.from_dict(model)
+    model.validate()
+    f, h, t, d = ml_capacity(config)
+    if model.n_features > f:
+        raise MlModelError(
+            f"model has {model.n_features} features, pipeline computes "
+            f"{f}")
+    out = empty_ml(config)
+    action_code = {name: code for code, name
+                   in ML_ACTION_NAMES.items()}[model.action]
+    if model.kind == "mlp":
+        mh = model.hidden
+        if mh > h:
+            raise MlModelError(
+                f"model hidden {mh} exceeds dataplane.ml_hidden {h}")
+        w1 = np.zeros((f, h), np.int8)
+        w1[: model.n_features, :mh] = model.w1
+        b1 = np.zeros(h, np.int32)
+        # the zero-point fold, layer 1: +128 per centered input column
+        b1[:mh] = model.b1.astype(np.int64) + 128 * model.w1.astype(
+            np.int64).sum(axis=0)
+        # padding columns keep bias 0 => relu(0) = 0 => q1 = 0; their
+        # centered form contributes -128 * w2_pad = 0 (w2 padding is 0)
+        w2 = np.zeros(h, np.int8)
+        w2[:mh] = model.w2
+        # layer-2 fold: q1c = q1 - 128 over ALL h columns (padding
+        # included — q1 of a padding column is 0, centered -128, times
+        # its zero weight = 0, so folding over mh columns is exact)
+        b2 = int(model.b2) + 128 * int(
+            model.w2.astype(np.int64).sum())
+        out.update(
+            glb_ml_w1=w1, glb_ml_b1=b1, glb_ml_s1=np.int32(model.s1),
+            glb_ml_w2=w2, glb_ml_b2=np.int32(b2))
+        kind = ML_KIND_MLP
+    else:
+        mt, md = model.trees, model.depth
+        if mt > t or md > d:
+            raise MlModelError(
+                f"forest {mt}x{md} exceeds dataplane.ml_trees/ml_depth "
+                f"{t}x{d}")
+        f_feat = np.zeros((t, d), np.int32)
+        f_thresh = np.full((t, d), 255, np.int32)  # pad bits never set
+        f_leaf = np.zeros((t, 1 << d), np.int32)
+        f_feat[:mt, :md] = model.f_feat
+        f_thresh[:mt, :md] = model.f_thresh
+        # pad levels always test feature 0 > 255 => bit 0, so a padded
+        # tree's leaf index only spans the model's 2^md prefix
+        f_leaf[:mt, : 1 << md] = model.f_leaf
+        out.update(
+            glb_ml_f_feat=f_feat, glb_ml_f_thresh=f_thresh,
+            glb_ml_f_leaf=f_leaf, glb_ml_b2=np.int32(model.b2))
+        kind = ML_KIND_FOREST
+    out.update(
+        glb_ml_thresh=np.int32(model.flag_thresh),
+        glb_ml_action=np.int32(action_code),
+        glb_ml_rl_shift=np.int32(model.rl_shift),
+        glb_ml_version=np.int32(model.version),
+    )
+    return out, kind
 
 
 def pack_rules(rules: Sequence[ContivRule], max_rules: int) -> Dict[str, np.ndarray]:
@@ -550,6 +718,15 @@ _UPLOAD_GROUPS: Dict[str, Tuple[str, ...]] = {
                "glb_bv_bnd_dport", "glb_bv_nbnd", "glb_bv_src",
                "glb_bv_dst", "glb_bv_sport", "glb_bv_dport",
                "glb_bv_proto"),
+    # the ML model blob (set_ml_model): its OWN group so an epoch swap
+    # re-ships it ONLY when the model actually changed — ACL/FIB/NAT
+    # churn reuses the cached device arrays (zero re-ship, pinned by
+    # tests/test_ml_stage.py), and a model swap ships ~a few hundred
+    # int8 weights without touching the multi-MB rule planes
+    "ml": ("glb_ml_w1", "glb_ml_b1", "glb_ml_s1", "glb_ml_w2",
+           "glb_ml_b2", "glb_ml_f_feat", "glb_ml_f_thresh",
+           "glb_ml_f_leaf", "glb_ml_thresh", "glb_ml_action",
+           "glb_ml_rl_shift", "glb_ml_version"),
     "if": ("if_type", "if_local_table", "if_apply_global"),
     "fib": ("fib_prefix", "fib_mask", "fib_plen", "fib_tx_if", "fib_disp",
             "fib_next_hop", "fib_node_id", "fib_snat"),
@@ -642,6 +819,13 @@ class TableBuilder:
             "proto": np.zeros((c.max_tables, lpr, lw), np.uint32),
         }
         self.acl_bv_ok = np.ones(c.max_tables, bool)
+        # per-packet ML model staging (ops/mlscore.py; docs/ML_STAGE.md):
+        # zero/no-model arrays at the config capacity until
+        # set_ml_model stages an artifact. ml_kind is the staged
+        # model's kernel variant (ML_KIND_*; 0 = none — the Dataplane
+        # re-gates the compiled stage off at swap while it is 0).
+        self.ml = empty_ml(c)
+        self.ml_kind = 0
         self.if_type = z(c.max_ifaces, np.int32)
         self.if_local_table = np.full(c.max_ifaces, -1, np.int32)
         self.if_apply_global = z(c.max_ifaces, np.int32)
@@ -809,6 +993,32 @@ class TableBuilder:
         self._glb_bad = bad
         self._mark("glb")
 
+    # --- per-packet ML model (ops/mlscore.py) ---
+    def set_ml_model(self, model) -> None:
+        """Stage one quantized model (an MlModel or its dict form —
+        vpp_tpu/ml/model.py) for the next epoch. Validation + padding
+        + the zero-point fold all happen in ``_fold_ml`` BEFORE any
+        staging state mutates, so a refused artifact (bad shape, bad
+        version, capacity overflow) leaves the previous model serving
+        — the loader's clean-refusal contract (vpp_tpu/ml/loader.py).
+        Marks only the "ml" upload group: rule churn and model churn
+        re-ship independently."""
+        staged, kind = _fold_ml(model, self.config)
+        self.ml = staged
+        self.ml_kind = kind
+        if self._rec is not None:
+            self._rec.set_ml_model(model)
+        self._mark("ml")
+
+    def clear_ml_model(self) -> None:
+        """Back to the no-model state (the stage re-gates off at the
+        next swap)."""
+        self.ml = empty_ml(self.config)
+        self.ml_kind = 0
+        if self._rec is not None:
+            self._rec.clear_ml_model()
+        self._mark("ml")
+
     # --- interfaces ---
     def set_interface(
         self,
@@ -959,6 +1169,8 @@ class TableBuilder:
             "glb_nrules": self.glb_nrules,
             "glb_mxu": self.glb_mxu,       # replaced wholesale, never
             "glb_bv": self.glb_bv,         # mutated in place
+            "ml": self.ml,                 # replaced wholesale too
+            "ml_kind": self.ml_kind,
             "nat_snat_ip": self.nat_snat_ip,
             "dirty": set(self._dirty),
             "rec_ops": list(self._rec.ops) if self._rec is not None else None,
@@ -979,6 +1191,8 @@ class TableBuilder:
         self.glb_nrules = snap["glb_nrules"]
         self.glb_mxu = snap["glb_mxu"]
         self.glb_bv = snap["glb_bv"]
+        self.ml = snap["ml"]
+        self.ml_kind = snap["ml_kind"]
         # the identity-diff caches describe the pre-restore rule list;
         # the next set_global_table must full-recompile. The BV device
         # cache may hold planes of the rolled-back commit — every BV
@@ -1048,6 +1262,7 @@ class TableBuilder:
             glb_bv_sport=self.glb_bv.bm_sport,
             glb_bv_dport=self.glb_bv.bm_dport,
             glb_bv_proto=self.glb_bv.bm_proto,
+            **self.ml,
             if_type=self.if_type,
             if_local_table=self.if_local_table,
             if_apply_global=self.if_apply_global,

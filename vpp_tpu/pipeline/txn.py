@@ -70,6 +70,7 @@ _OPS = (
     "set_interface", "set_if_local_table", "add_route", "del_route",
     "set_local_table", "clear_local_table", "set_global_table",
     "set_nat_mapping", "clear_nat", "set_snat_ip",
+    "set_ml_model", "clear_ml_model",
 )
 _RULE_OPS = {"set_local_table", "set_global_table"}
 
@@ -142,6 +143,17 @@ class ConfigTxn:
 
     def set_snat_ip(self, ip: int) -> "ConfigTxn":
         return self._record("set_snat_ip", ip=ip)
+
+    def set_ml_model(self, model) -> "ConfigTxn":
+        """``model`` is an MlModel or its JSON dict form; the journal
+        stores the dict (tiny — a few hundred int8 weights), so replay
+        reproduces the exact staged blob."""
+        if hasattr(model, "to_dict"):
+            model = model.to_dict()
+        return self._record("set_ml_model", model=model)
+
+    def clear_ml_model(self) -> "ConfigTxn":
+        return self._record("clear_ml_model")
 
     # --- apply / serialize ---
     def apply_to_builder(self, builder) -> None:

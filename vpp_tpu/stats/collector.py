@@ -79,6 +79,15 @@ PUMP_STAT_GAUGES = (
     ("sess_evictions", "vpp_tpu_pump_sess_evictions",
      "session ways reclaimed by insert-time eviction "
      "(expired + victim, both tables)"),
+    # per-packet ML stage riders (aux rows 5..7, ISSUE 10): the
+    # model's verdict counters as the PUMP sees them — the packed/
+    # ring paths never fetch StepStats, so these ride the aux fetch
+    ("ml_scored", "vpp_tpu_ml_pump_scored",
+     "packets scored by the ML stage across pump dispatches"),
+    ("ml_flagged", "vpp_tpu_ml_pump_flagged",
+     "packets the ML stage flagged across pump dispatches"),
+    ("ml_drops", "vpp_tpu_ml_pump_drops",
+     "packets the ML enforce policy dropped across pump dispatches"),
     # device-resident descriptor rings (persistent mode, ISSUE 7):
     # host↔device window exchanges, frames staged through the ring,
     # live in-flight windows, tx-writeback lag (windows dispatched but
@@ -133,10 +142,17 @@ CLASSIFIER_IMPLS = ("dense", "mxu", "bv")
 # (ISSUE 8): kvstore = the cluster store is unreachable (the agent
 # serves its last-adopted epoch; staleness exported next to it),
 # ring = the persistent pump fell back from the device ring to the
-# dispatch ladder, snapshot = the last snapshot attempt failed. Every
-# component always exports (0 = healthy) so an absent series is a
-# wiring bug, not good news.
-DEGRADED_COMPONENTS = ("kvstore", "ring", "snapshot")
+# dispatch ladder, snapshot = the last snapshot attempt failed,
+# ml = the last ML-model load was refused (the previous model keeps
+# serving — vpp_tpu/ml/loader.py, ISSUE 10). Every component always
+# exports (0 = healthy) so an absent series is a wiring bug, not good
+# news.
+DEGRADED_COMPONENTS = ("kvstore", "ring", "snapshot", "ml")
+
+# ML-stage modes the vpp_tpu_ml_stage info gauge enumerates (the LIVE
+# compiled mode — Dataplane._ml_mode, re-gated at every swap; "off"
+# while no model is staged even under a score/enforce knob)
+ML_STAGE_MODES = ("off", "score", "enforce")
 
 PUMP_GAUGES = tuple(
     (name, help_) for _, name, help_ in PUMP_STAT_GAUGES
@@ -191,6 +207,15 @@ NODE_GAUGES = (
     ("vpp_tpu_pipeline_fastpath_steps",
      "pipeline steps served by the classify-free established-flow "
      "kernel"),
+    # per-packet ML scoring stage (ISSUE 10; ops/mlscore.py): the
+    # StepStats verdict counters of the unpacked path — mirrors of
+    # the pump-side vpp_tpu_ml_pump_* aux riders
+    ("vpp_tpu_ml_scored_packets",
+     "packets scored by the per-packet ML stage"),
+    ("vpp_tpu_ml_flagged_packets",
+     "packets whose ML score crossed the model's flag threshold"),
+    ("vpp_tpu_ml_dropped_packets",
+     "packets dropped by the ML enforce policy (drop / rate-limit)"),
 )
 
 # StepStats field → the Prometheus family its value feeds. The single
@@ -227,6 +252,10 @@ STEPSTATS_FAMILIES = {
     "sess_evict_victim": "vpp_tpu_session_evictions_total",
     "natsess_evict_expired": "vpp_tpu_session_evictions_total",
     "natsess_evict_victim": "vpp_tpu_session_evictions_total",
+    # per-packet ML stage (ISSUE 10)
+    "ml_scored": "vpp_tpu_ml_scored_packets",
+    "ml_flagged": "vpp_tpu_ml_flagged_packets",
+    "ml_drops": "vpp_tpu_ml_dropped_packets",
 }
 
 # StepStats eviction field → its (table, reason) label pair on the
@@ -266,7 +295,8 @@ class StatsCollector:
                            "sess_hits", "fastpath",
                            "sess_evict_expired", "sess_evict_victim",
                            "natsess_evict_expired",
-                           "natsess_evict_victim")
+                           "natsess_evict_victim",
+                           "ml_scored", "ml_flagged", "ml_drops")
         }
         # gauges, not counters: last-step snapshots
         self._last: Dict[str, int] = {
@@ -426,11 +456,36 @@ class StatsCollector:
                   "and cold-starts cleanly)",
                   kind="counter"),  # _total => counter exposition
         )
-        # degraded-state sources: the cluster store (set_store) and
-        # the snapshotter (set_snapshotter); the pump is already
-        # attached via set_pump
+        # per-packet ML stage (ISSUE 10): live mode (info-style, like
+        # the classifier gauge), the staged model's version, and the
+        # loader's refusal ledger — a refused artifact is a counted
+        # outcome + the ml degraded component, never a silent keep
+        self.ml_stage_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_ml_stage",
+                  "live ML-stage mode (info-style: mode label, 1 = "
+                  "active; off while no model is staged)"),
+        )
+        self.ml_model_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_ml_model_version",
+                  "version of the ML model the live epoch scores "
+                  "with (0 = none staged)"),
+        )
+        self.ml_load_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_ml_load_total",
+                  "ML model load attempts by outcome (loaded = "
+                  "published; every refusal reason is its own label "
+                  "and keeps the previous model serving)",
+                  kind="counter"),
+        )
+        # degraded-state sources: the cluster store (set_store), the
+        # snapshotter (set_snapshotter) and the ML model source
+        # (set_ml); the pump is already attached via set_pump
         self._store = None
         self._snapshotter = None
+        self._ml_source = None
         # optional IO-daemon stats source (a callable returning the
         # daemon's stats dict, or the IODaemon itself when it runs
         # in-process): feeds the rx_full drop cause. The fetched value
@@ -483,6 +538,14 @@ class StatsCollector:
         publish() exports snapshot age, generation, chunk time and
         restore outcomes."""
         self._snapshotter = snapshotter
+
+    def set_ml(self, source) -> None:
+        """Attach the MlModelSource (vpp_tpu/ml/loader.py) so
+        publish() exports load outcomes and the ml degraded
+        component. The stage/version gauges publish from the
+        dataplane regardless — in-process model staging (tests, the
+        bench) is visible without a loader."""
+        self._ml_source = source
 
     def set_vcl(self, server) -> None:
         """Attach the VclAdmissionServer so publish() exports its
@@ -585,6 +648,12 @@ class StatsCollector:
             totals["sess_hits"])
         self.node_gauges["vpp_tpu_pipeline_fastpath_steps"].set(
             totals["fastpath"])
+        self.node_gauges["vpp_tpu_ml_scored_packets"].set(
+            totals["ml_scored"])
+        self.node_gauges["vpp_tpu_ml_flagged_packets"].set(
+            totals["ml_flagged"])
+        self.node_gauges["vpp_tpu_ml_dropped_packets"].set(
+            totals["ml_drops"])
         self.sess_insert_failed_gauge.set(
             totals["sess_insert_fail"], table="sess")
         self.sess_insert_failed_gauge.set(
@@ -611,6 +680,27 @@ class StatsCollector:
         for name in CLASSIFIER_IMPLS:
             self.classifier_gauge.set(
                 1.0 if name == impl else 0.0, impl=name)
+        # ML stage (ISSUE 10): live mode + the LIVE epoch's model
+        # version (read off the published tables ref — immutable, so
+        # no race with a load staging a model the swap hasn't
+        # published yet; the builder's staging state is NOT consulted
+        # here for exactly that reason); load ledger + degraded flag
+        # from the loader
+        ml_mode = getattr(self.dp, "_ml_mode", "off")
+        for name in ML_STAGE_MODES:
+            self.ml_stage_gauge.set(
+                1.0 if name == ml_mode else 0.0, mode=name)
+        tables = self.dp.tables
+        self.ml_model_gauge.set(
+            float(int(tables.glb_ml_version))
+            if tables is not None and ml_mode != "off" else 0.0)
+        ml_src = self._ml_source
+        self.degraded_gauge.set(
+            1.0 if getattr(ml_src, "degraded", False) else 0.0,
+            component="ml")
+        if ml_src is not None:
+            for outcome, n in ml_src.stats_snapshot()["outcomes"].items():
+                self.ml_load_gauge.set(float(n), outcome=outcome)
         from vpp_tpu.pipeline.dataplane import jit_compile_totals
         for label, n in jit_compile_totals().items():
             self.jit_compiles_gauge.set(float(n), step=label)

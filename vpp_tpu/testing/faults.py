@@ -43,6 +43,7 @@ point                 seam
 ``pump.tx_push``      io/pump.py — tx-ring write (stalled consumer)
 ``snapshot.chunk``    pipeline/snapshot.py — chunk file write (torn chunk)
 ``snapshot.manifest`` pipeline/snapshot.py — manifest publish (torn/crash)
+``ml.load``           ml/loader.py — model artifact read (corrupt/missing)
 ====================  ====================================================
 """
 
