@@ -171,7 +171,8 @@ def build_rules(n_rules: int):
     return rules
 
 
-def build_dataplane(n_rules: int, n_backends: int, ml_stage: str = "off"):
+def build_dataplane(n_rules: int, n_backends: int, ml_stage: str = "off",
+                    telemetry: str = "off"):
     from vpp_tpu.ir.rule import Action, ContivRule
     from vpp_tpu.pipeline.dataplane import Dataplane
     from vpp_tpu.pipeline.tables import DataplaneConfig
@@ -187,6 +188,7 @@ def build_dataplane(n_rules: int, n_backends: int, ml_stage: str = "off"):
         nat_mappings=4,
         nat_backends=max(n_backends, 1),
         ml_stage=ml_stage,
+        telemetry=telemetry,
     )
     dp = Dataplane(config)
     uplink = dp.add_uplink()
@@ -609,6 +611,172 @@ def ml_stage_bench(args, iters: int = 12, batch: int = 2048) -> dict:
         dp.swap()
     out["ml_swap_zero_reship"] = int(
         dp.tables.glb_ml_w1 is ml_plane_before)
+    return out
+
+
+def latency_telemetry_bench(args, iters: int = 12,
+                            batch: int = 2048) -> dict:
+    """Device telemetry plane (ISSUE 11 tentpole): the cost of the
+    in-step wire-latency histogram + flow sketch, and the dataset the
+    adaptive latency governor (ROADMAP item 3) will close its loop on.
+
+    Three captures:
+
+      * **overhead** — the fused chain compiled with telemetry off vs
+        full over the same tables/traffic; the delta IS the marginal
+        scatter-add/compare cost (``telemetry_overhead_pct``,
+        acceptance: < 5).
+      * **offered load vs on-device tail** — an open-loop sweep: each
+        packed batch is stamped with its scheduled GENERATION time and
+        paced at 50/80/95% of the measured service rate; the device
+        histograms ``dispatch − stamp``, so queueing delay shows up in
+        the on-device p99/p99.9 exactly as it would for a governor
+        (``latency_telemetry_sweep`` + the headline
+        ``wire_latency_{p50,p99,p999}_us_device`` from the top rung).
+      * **sketch fidelity** — a Zipf flow mix through a fresh sketch;
+        count-min estimates vs exact host counts
+        (``flow_sketch_error_pct`` = aggregate overcount share) and
+        the top-K candidate table's recall of the true heavy hitters
+        (``flow_topk_recall``).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from vpp_tpu.ops.telemetry import (
+        quantiles_from_bins,
+        sketch_cols,
+        tel_clock_us,
+        tel_flow_hash_np,
+    )
+    from vpp_tpu.pipeline.dataplane import (
+        pack_packet_columns,
+        packed_input_zeros,
+    )
+    from vpp_tpu.pipeline.vector import FLAG_VALID, PacketVector, ip4
+
+    out = {"latency_telemetry_batch": batch,
+           "latency_telemetry_rules": args.rules}
+
+    # --- (1) overhead: off vs full over the PACKED boundary ---
+    # Timed on process_packed, not the plain step: the wire-latency
+    # histogram update lives in the packed/chained/ring boundary
+    # wrappers (dataplane._packed_call), so a plain-step delta would
+    # structurally exclude it and only measure the sketch fold. The
+    # packed delta is the telemetry cost the pump actually pays.
+    dp_off, _up_off = build_dataplane(args.rules, 4, telemetry="off")
+    dp, uplink = build_dataplane(args.rules, 4, telemetry="full")
+    pkts = build_traffic(batch, uplink, seed=41)
+    cols = {f: np.asarray(getattr(pkts, f))
+            for f in ("src_ip", "dst_ip", "proto", "sport", "dport",
+                      "ttl", "pkt_len", "rx_if", "flags")}
+    flat = packed_input_zeros(batch)
+    pack_packet_columns(flat.view(np.uint32), cols, batch)
+
+    # interleaved windows, per-mode MINIMUM of window medians (the
+    # session-bench honest estimator: sequential medians drift with
+    # box load and can even read negative) — off-mode dataplanes
+    # ignore the stamp kwargs, so one call shape serves both sides
+    for d in (dp_off, dp):
+        jax.block_until_ready(d.process_packed(flat, now=2,
+                                               stamp_us=7, now_us=9))
+    best = {"off": float("inf"), "full": float("inf")}
+    for _w in range(max(iters // 2, 3)):
+        for mode, d in (("off", dp_off), ("full", dp)):
+            ts = []
+            for _ in range(4):
+                t0 = time.perf_counter()
+                jax.block_until_ready(d.process_packed(
+                    flat, now=3, stamp_us=7, now_us=9))
+                ts.append(time.perf_counter() - t0)
+            best[mode] = min(best[mode], float(np.median(ts)))
+    t_off = best["off"] * 1e6
+    t_full = best["full"] * 1e6
+    out["telemetry_fullpath_us"] = round(t_off, 1)
+    out["telemetry_telpath_us"] = round(t_full, 1)
+    out["telemetry_ns_pkt"] = round(
+        max(t_full - t_off, 0.0) / batch * 1e3, 2)
+    out["telemetry_overhead_pct"] = round(
+        100.0 * (t_full - t_off) / max(t_off, 1e-9), 2)
+
+    # --- (2) open-loop offered-load sweep on the packed path ---
+    service_us = max(t_full, 1.0)
+    out["telemetry_service_us"] = round(service_us, 1)
+
+    def run_rung(load_pct: int, rounds: int = 40) -> dict:
+        before = dp.telemetry_snapshot()["bins"].copy()
+        interarrival = service_us * 100.0 / load_pct
+        g = float(tel_clock_us()) + 2 * interarrival
+        for _ in range(rounds):
+            # clamp the pace wait: a tel_clock_us() 31-bit wrap
+            # mid-rung would otherwise compute a ~2^31 µs sleep and
+            # hang the bench for half an hour (the device side already
+            # discards wrap-spanning samples as negative latency)
+            wait_us = min(g - tel_clock_us(), 5 * interarrival)
+            if wait_us > 0:
+                time.sleep(wait_us / 1e6)
+            jax.block_until_ready(dp.process_packed(
+                flat, now=4, stamp_us=int(g) & 0x7FFFFFFF))
+            g += interarrival
+        bins = dp.telemetry_snapshot()["bins"] - before
+        p50, p99, p999 = quantiles_from_bins(bins)
+        return {"load_pct": load_pct, "p50_us": round(p50, 1),
+                "p99_us": round(p99, 1), "p999_us": round(p999, 1),
+                "observed": int(bins.sum())}
+
+    sweep = [run_rung(pct) for pct in (50, 80, 95)]
+    out["latency_telemetry_sweep"] = sweep
+    top = sweep[-1]
+    out["wire_latency_p50_us_device"] = top["p50_us"]
+    out["wire_latency_p99_us_device"] = top["p99_us"]
+    out["wire_latency_p999_us_device"] = top["p999_us"]
+    _progress(telemetry_overhead_pct=out["telemetry_overhead_pct"],
+              wire_latency_p99_us_device=top["p99_us"])
+
+    # --- (3) sketch fidelity on a FRESH sketch (small dataplane) ---
+    dp3, up3 = build_dataplane(64, 2, telemetry="full")
+    rng = np.random.default_rng(17)
+    n_flows, rounds, b3 = 512, 40, 512
+    ranks = np.arange(1, n_flows + 1, dtype=np.float64)
+    probs = ranks ** -1.2
+    probs /= probs.sum()
+    true = np.zeros(n_flows, np.int64)
+    base_src = ip4("198.18.0.0")
+    dst = ip4("10.1.1.9")
+    for r in range(rounds):
+        ids = rng.choice(n_flows, b3, p=probs)
+        np.add.at(true, ids, 1)
+        pv = PacketVector(
+            src_ip=jnp.asarray((base_src + ids).astype(np.uint32)),
+            dst_ip=jnp.full((b3,), dst, jnp.uint32),
+            proto=jnp.full((b3,), 6, jnp.int32),
+            sport=jnp.asarray((1024 + ids).astype(np.int32)),
+            dport=jnp.full((b3,), 8080, jnp.int32),
+            ttl=jnp.full((b3,), 64, jnp.int32),
+            pkt_len=jnp.full((b3,), 128, jnp.int32),
+            rx_if=jnp.full((b3,), up3, jnp.int32),
+            flags=jnp.full((b3,), FLAG_VALID, jnp.int32),
+        )
+        dp3.process(pv, now=2 + r)
+    snap = dp3.telemetry_snapshot()
+    sk = np.asarray(dp3.tables.tel_sketch)
+    d, w = sk.shape
+    ids = np.arange(n_flows)
+    h0 = tel_flow_hash_np(
+        (base_src + ids).astype(np.uint32),
+        np.full(n_flows, dst, np.uint32), 1024 + ids,
+        np.full(n_flows, 8080), np.full(n_flows, 6))
+    est = np.min(np.stack(
+        [sk[r_, sketch_cols(h0, r_, w)] for r_ in range(d)]), axis=0)
+    over = est.astype(np.int64) - true
+    out["flow_sketch_overcount_max"] = int(over.max())
+    out["flow_sketch_error_pct"] = round(
+        100.0 * float(over.sum()) / max(float(true.sum()), 1.0), 3)
+    k = len(snap["top_key"])
+    top_true = set(h0[np.argsort(-true)[:k]].tolist())
+    out["flow_topk_recall"] = round(
+        len(top_true & set(snap["top_key"].tolist())) / k, 3)
+    _progress(flow_sketch_error_pct=out["flow_sketch_error_pct"],
+              flow_topk_recall=out["flow_topk_recall"])
     return out
 
 
@@ -2603,6 +2771,18 @@ def _run():
         pri["ml_stage_bench_error"] = f"{type(e).__name__}: {e}"
     _jc_now = _jit_compiles_now()
     pri["ml_stage_jit_compiles"] = _jc_now - _jc
+    _jc = _jc_now
+    _progress(**pri)
+    try:
+        # device telemetry plane (ISSUE 11): in-step histogram/sketch
+        # overhead + the on-device load-vs-tail sweep + sketch
+        # fidelity (acceptance: telemetry_overhead_pct < 5,
+        # flow_topk_recall >= 0.9)
+        pri.update(latency_telemetry_bench(args))
+    except Exception as e:  # noqa: BLE001
+        pri["latency_telemetry_error"] = f"{type(e).__name__}: {e}"
+    _jc_now = _jit_compiles_now()
+    pri["latency_telemetry_jit_compiles"] = _jc_now - _jc
     _jc = _jc_now
     _progress(**pri)
     if not args.no_subbench:

@@ -107,10 +107,11 @@ class TestDeviceDescRing:
 
     def test_acquire_is_cyclic_and_backpressures(self):
         ring = DeviceDescRing(slots=2, batch=8, windows=2)
-        w0, d0, n0 = ring.acquire(timeout=1)
-        w1, d1, n1 = ring.acquire(timeout=1)
+        w0, d0, n0, s0 = ring.acquire(timeout=1)
+        w1, d1, n1, _s1 = ring.acquire(timeout=1)
         assert (w0, w1) == (0, 1)
         assert d0.shape == (2, 5, 8) and n0.shape == (2,)
+        assert s0.shape == (2,)  # the rx-enqueue stamp lane (ISSUE 11)
         assert ring.in_flight() == 2
         # every window in flight: acquire times out (host backpressure)
         assert ring.acquire(timeout=0.05) is None

@@ -183,6 +183,22 @@ def test_exposition_conformance_over_http():
         ml_modes = {l.get("mode") for n, l, _ in samples
                     if n == "vpp_tpu_ml_stage"}
         assert ml_modes == {"off", "score", "enforce"}
+        # build-info anchor (ISSUE 11 satellite): exactly one
+        # constant-1 series carrying the identity labels
+        info = [(l, v) for n, l, v in samples
+                if n == "vpp_tpu_build_info"]
+        assert len(info) == 1 and info[0][1] == 1.0
+        assert set(info[0][0]) == {"version", "jax", "backend",
+                                   "classifier"}
+        from vpp_tpu import __version__
+        assert info[0][0]["version"] == __version__
+        assert info[0][0]["classifier"] in ("dense", "mxu", "bv")
+        # the device wire-latency family registers (TYPE-only while
+        # telemetry is off) + the telemetry mode info gauge reads off
+        assert types.get("vpp_tpu_wire_latency_seconds") == "histogram"
+        tel_modes = {l.get("mode"): v for n, l, v in samples
+                     if n == "vpp_tpu_telemetry"}
+        assert tel_modes == {"off": 1.0, "latency": 0.0, "full": 0.0}
         degraded = {l.get("component") for n, l, _ in samples
                     if n == "vpp_tpu_degraded"}
         assert "ml" in degraded

@@ -616,6 +616,7 @@ class TestPackedAux:
     def test_packed_aux_carries_ml_verdicts(self):
         from vpp_tpu.pipeline.dataplane import (
             PACKED_AUX_ROWS,
+            PACKED_AUX_SCHEMA,
             pack_packet_columns,
             packed_input_zeros,
         )
@@ -636,10 +637,14 @@ class TestPackedAux:
         pack_packet_columns(flat.view(np.uint32), cols, 16)
         out, aux = dp.process_packed(flat, now=3, with_aux=True)
         aux_h = np.asarray(aux)
-        assert aux_h.shape == (PACKED_AUX_ROWS,) == (8,)
-        assert aux_h[5] == 8          # ml_scored == rx
-        assert aux_h[6] == 5          # the UDP slice flags
-        assert aux_h[7] == 5          # drop action enforces them
+        # width comes from the ONE schema constant (ISSUE 11): the
+        # rows are addressed by name, so the next widening is an edit
+        # to PACKED_AUX_SCHEMA, not to this test
+        assert aux_h.shape == (PACKED_AUX_ROWS,) \
+            == (len(PACKED_AUX_SCHEMA),)
+        assert aux_h[PACKED_AUX_SCHEMA.index("ml_scored")] == 8
+        assert aux_h[PACKED_AUX_SCHEMA.index("ml_flagged")] == 5
+        assert aux_h[PACKED_AUX_SCHEMA.index("ml_drops")] == 5
 
 
 # --------------------------------------------------------------------

@@ -77,14 +77,20 @@ TRACED_ROOTS = {
     ("vpp_tpu/ops/session.py", "_session_expire_impl"),
     ("vpp_tpu/ops/session.py", "hashmap_insert_linear"),
     # the packed/chained IO boundary wrappers: jax.jit(_packed_call(fn))
+    # — each has an off-signature and a telemetry-signature variant
+    # (ISSUE 11) sharing one _core/_loop body
+    ("vpp_tpu/pipeline/dataplane.py", "_packed_call._core"),
     ("vpp_tpu/pipeline/dataplane.py", "_packed_call.run"),
-    ("vpp_tpu/pipeline/dataplane.py", "_chained_call.run"),
+    ("vpp_tpu/pipeline/dataplane.py", "_chained_call.run_off"),
+    ("vpp_tpu/pipeline/dataplane.py", "_chained_call.run_tel"),
     # the device-ring window program (ISSUE 7): jax.jit(_ring_call(fn,
     # slots)) through _jitted_step — the persistent pump's steady
     # state; the old per-instance PersistentPump.__init__ jit site is
     # GONE (the ring form rides the process-wide step cache, so an
     # epoch-swap pump restart recompiles nothing)
     ("vpp_tpu/pipeline/dataplane.py", "_ring_call.run"),
+    ("vpp_tpu/pipeline/dataplane.py", "_ring_call.run_tel"),
+    ("vpp_tpu/pipeline/dataplane.py", "_ring_call._loop"),
     # the per-packet ML stage (ISSUE 10): traced into every step
     # variant whose ml_mode gate is on via graph._ml_eval — the stage
     # rides the SAME process-wide _jitted_step cache (no jit site of
@@ -93,6 +99,17 @@ TRACED_ROOTS = {
     ("vpp_tpu/ops/mlscore.py", "ml_score"),
     ("vpp_tpu/ops/mlscore.py", "ml_policy"),
     ("vpp_tpu/ops/session.py", "session_hit_age"),
+    # the device telemetry plane (ISSUE 11): the flow sketch rides
+    # every "full"-gated step variant via graph._finish_step, the
+    # latency histogram + rider ride the packed/chained/ring boundary
+    # wrappers via dataplane._packed_call/_ring_call — all through the
+    # SAME process-wide _jitted_step cache (no jit site of their own)
+    ("vpp_tpu/ops/telemetry.py", "tel_flow_update"),
+    ("vpp_tpu/ops/telemetry.py", "tel_flow_hash"),
+    ("vpp_tpu/ops/telemetry.py", "tel_latency_update"),
+    ("vpp_tpu/ops/telemetry.py", "lat_bucket"),
+    ("vpp_tpu/ops/telemetry.py", "sketch_cols"),
+    ("vpp_tpu/ops/telemetry.py", "pack_tel_rider"),
     # classifier implementations reach jit through _classifier_fns /
     # time_classifier's subscripted call — enumerate them explicitly
     ("vpp_tpu/ops/acl.py", "acl_classify_global"),

@@ -57,12 +57,42 @@ def counters_lint() -> list:
     STEPSTATS_FAMILIES), and every registered ``vpp_tpu_pipeline_*``
     family must map back to a StepStats field — a pipeline counter
     added on either side without its observability twin fails here
-    (and tier-1, via tests/test_exposition.py)."""
+    (and tier-1, via tests/test_exposition.py). The same discipline is
+    enforced on the packed-aux rider (ISSUE 11 satellite): every
+    PACKED_AUX_SCHEMA row past the fastpath trio must map through
+    AUX_RIDER_STATS to a pump stats key that PUMP_STAT_GAUGES exports
+    — widening the rider without its observability twin fails here."""
     registry = _build_full_registry()
+    from vpp_tpu.pipeline.dataplane import PACKED_AUX_SCHEMA
     from vpp_tpu.pipeline.graph import StepStats
-    from vpp_tpu.stats.collector import STEPSTATS_FAMILIES
+    from vpp_tpu.stats.collector import (
+        AUX_RIDER_STATS,
+        PUMP_STAT_GAUGES,
+        STEPSTATS_FAMILIES,
+    )
 
     problems = []
+    # aux-rider parity (rows 0-2 are the fastpath trio consumed
+    # positionally by io/pump.py _account_fastpath)
+    if tuple(PACKED_AUX_SCHEMA[:3]) != ("fastpath", "rx", "sess_hits"):
+        problems.append(
+            "counters: PACKED_AUX_SCHEMA rows 0-2 must stay the "
+            f"fastpath trio, got {PACKED_AUX_SCHEMA[:3]}")
+    pump_keys = {stat_key for stat_key, _name, _h in PUMP_STAT_GAUGES}
+    for row in PACKED_AUX_SCHEMA[3:]:
+        key = AUX_RIDER_STATS.get(row)
+        if key is None:
+            problems.append(
+                f"counters: aux rider row {row!r} has no pump-stats "
+                f"mapping (stats/collector.py AUX_RIDER_STATS)")
+        elif key not in pump_keys:
+            problems.append(
+                f"counters: aux rider row {row!r} maps to pump stat "
+                f"{key!r} which PUMP_STAT_GAUGES does not export")
+    for row in sorted(set(AUX_RIDER_STATS) - set(PACKED_AUX_SCHEMA)):
+        problems.append(
+            f"counters: AUX_RIDER_STATS maps {row!r} which is not a "
+            f"PACKED_AUX_SCHEMA row (stale entry?)")
     fields = set(StepStats._fields)
     mapped = set(STEPSTATS_FAMILIES)
     for f in sorted(fields - mapped):

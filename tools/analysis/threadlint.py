@@ -59,6 +59,10 @@ THREAD_ROOTS = (
     # ISSUE 10: the ML model source's load ledger is written by the
     # maintenance thread and snapshotted by the collector/CLI
     "vpp_tpu/ml/loader.py",
+    # ISSUE 11: the telemetry plane's host paths — the rider snapshot
+    # is fetcher-written and collector/CLI-read (the device kernels in
+    # the same file are thread-free, the pass just sees no classes)
+    "vpp_tpu/ops/telemetry.py",
 )
 
 LOCK_CTORS = {"Lock", "RLock", "Condition"}

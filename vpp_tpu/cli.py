@@ -61,6 +61,8 @@ class DebugCLI:
             ("show", "errors"): self.show_errors,
             ("show", "fastpath"): self.show_fastpath,
             ("show", "ml"): self.show_ml,
+            ("show", "latency"): self.show_latency,
+            ("show", "top-flows"): self.show_top_flows,
             ("show", "io"): self.show_io,
             ("show", "neighbors"): self.show_neighbors,
             ("show", "store"): self.show_store,
@@ -89,7 +91,8 @@ class DebugCLI:
             "commands: show interface | show acl | show session | "
             "show sessions | show session-rules | show mesh | "
             "show nat44 | show fib | show trace | show errors | "
-            "show fastpath | show ml | show io | show neighbors | "
+            "show fastpath | show ml | show latency | show top-flows | "
+            "show io | show neighbors | "
             "show store | "
             "show resilience | show config-history [n] | show spans [n] | "
             "trace add [n] | trace clear | config replay <journal> | "
@@ -753,6 +756,87 @@ class DebugCLI:
                    if outcomes else "no loads attempted"))
             if st["last_error"]:
                 lines.append(f"  last load error: {st['last_error']}")
+        return "\n".join(lines)
+
+    def _tel_snapshot(self):
+        """Collect-facing telemetry snapshot: the pump's ring-rider
+        copy when one exists (persistent mode — host scalars only,
+        nothing crosses the device transport at render time), else the
+        dataplane's small-plane fetch."""
+        fn = getattr(self.pump, "tel_snapshot", None)
+        snap = fn() if callable(fn) else None
+        if snap is None:
+            fn = getattr(self.dp, "telemetry_snapshot", None)
+            snap = fn() if callable(fn) else None
+        return snap
+
+    def show_latency(self) -> str:
+        """Device wire-latency page (ISSUE 11; ops/telemetry.py): the
+        on-device log2 histogram of per-packet rx-enqueue → tx-append
+        latency, with p50/p99/p99.9 derived host-side — the `show
+        latency` every reflex-plane decision (ROADMAP item 3's
+        governor) reads."""
+        mode = getattr(self.dp, "_tel_mode", "off")
+        if mode == "off":
+            return ("telemetry off (set dataplane.telemetry: "
+                    "latency | full)")
+        snap = self._tel_snapshot()
+        if snap is None:
+            return f"telemetry {mode}: no samples yet"
+        from vpp_tpu.ops.telemetry import quantiles_from_bins
+
+        bins = np.asarray(snap["bins"], np.int64)
+        total = int(bins.sum())
+        lines = [f"wire latency (telemetry {mode}): {total} packets "
+                 f"observed on device"]
+        if total:
+            p50, p99, p999 = quantiles_from_bins(bins)
+            lines.append(
+                f"  p50 {p50:.0f}us  p99 {p99:.0f}us  "
+                f"p99.9 {p999:.0f}us")
+            lines.append(f"  {'bucket':<16} {'count':>10}  share")
+            for b, n in enumerate(bins):
+                if not n:
+                    continue
+                lo = (1 << b) if b else 0
+                hi = 1 << (b + 1)
+                rng = (f"[{lo}us, {hi}us)" if b < len(bins) - 1
+                       else f">= {lo}us")
+                lines.append(
+                    f"  {rng:<16} {int(n):>10}  "
+                    f"{100.0 * int(n) / total:5.1f}%")
+        return "\n".join(lines)
+
+    def show_top_flows(self) -> str:
+        """Heavy-hitter candidates of the device count-min flow sketch
+        (ISSUE 11): the K elected flows with their estimated packet
+        counts — the page that names the flows behind a latency spike
+        or DDoS flag without ever shipping the session table."""
+        mode = getattr(self.dp, "_tel_mode", "off")
+        if mode != "full":
+            return ("flow sketch off (set dataplane.telemetry: full)")
+        snap = self._tel_snapshot()
+        if snap is None:
+            return "telemetry full: no samples yet"
+        cnt = np.asarray(snap["top_cnt"], np.int64)
+        order = np.argsort(-cnt)
+        lines = [f"top flows ({int(snap['sketched'])} packets "
+                 f"sketched; counts are count-min estimates — "
+                 f"over-counting possible, never under)"]
+        lines.append(f"  {'#':>2} {'flow':<44} {'est-pkts':>10}")
+        shown = 0
+        for k in order:
+            k = int(k)
+            if cnt[k] <= 0:
+                continue
+            ports = int(snap["top_ports"][k])
+            flow = (f"{ip4_str(int(snap['top_src'][k]))}:{ports >> 16}"
+                    f" -> {ip4_str(int(snap['top_dst'][k]))}"
+                    f":{ports & 0xFFFF}")
+            lines.append(f"  {shown:>2} {flow:<44} {int(cnt[k]):>10}")
+            shown += 1
+        if not shown:
+            lines.append("  (no candidates elected yet)")
         return "\n".join(lines)
 
     def show_io(self) -> str:

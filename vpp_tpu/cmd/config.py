@@ -199,6 +199,23 @@ class AgentConfig:
     #                            pipeline (deny > ml-drop > permit)
     #   ``dataplane.ml_hidden``  MLP hidden-width capacity (shape)
     #   ``dataplane.ml_trees``/``ml_depth``  forest capacity (shape)
+    # + the device-resident telemetry plane (docs/OBSERVABILITY.md
+    #   "device telemetry"; ops/telemetry.py):
+    #   ``dataplane.telemetry``  off | latency | full — "latency"
+    #                            histograms per-packet wire latency
+    #                            (rx-enqueue stamp → device tx-append)
+    #                            in on-device log2 bins, "full" adds
+    #                            the count-min heavy-hitter flow
+    #                            sketch + top-K table behind `show
+    #                            top-flows`; "off" compiles the plane
+    #                            out at zero cost (placeholder shapes)
+    #   ``dataplane.telemetry_lat_buckets``  log2 µs bins (4..31)
+    #   ``dataplane.telemetry_sketch_rows``/``_sketch_cols``  count-min
+    #                            depth d / width w (w a power of two;
+    #                            overestimate bound ~ e·N/w with
+    #                            failure probability e^-d)
+    #   ``dataplane.telemetry_topk``  heavy-hitter candidate slots
+    # All validated at load with the session-table knobs.
     dataplane: DataplaneConfig = dataclasses.field(default_factory=DataplaneConfig)
     # IPAM subnets
     ipam: IpamConfig = dataclasses.field(default_factory=IpamConfig)

@@ -262,6 +262,11 @@ class DataplanePump:
             # packed paths never fetch StepStats, so the marking
             # signal rides the same aux fetch as the fastpath rows
             "ml_scored": 0, "ml_flagged": 0, "ml_drops": 0,
+            # device-telemetry riders (aux rows 8/9, ISSUE 11):
+            # packets whose wire latency the device histogrammed, and
+            # packets folded into the heavy-hitter flow sketch — both
+            # 0 with dataplane.telemetry off
+            "tel_observed": 0, "tel_sketched": 0,
             # drops by CAUSE (packets; ISSUE 7 satellite — the r5
             # goodput number hid WHERE persistent-mode loss happened):
             # tx_stall = tx-ring-full discards by the writer,
@@ -536,6 +541,14 @@ class DataplanePump:
     def _dispatch(self, groups: list, slow: bool = False) -> None:
         K = len(groups)
         tp0 = time.perf_counter()
+        # rx-enqueue stamp for the device wire-latency histogram
+        # (ISSUE 11): pack start ≈ the frames' peek time in dispatch
+        # mode, so the histogram covers pack + the dispatch queue
+        stamp_us = 0
+        if getattr(self.dp, "_tel_mode", "off") != "off":
+            from vpp_tpu.ops.telemetry import tel_clock_us
+
+            stamp_us = tel_clock_us()
         if K == 1:
             total = sum(f.n for f in groups[0])
             # pad to the smallest ladder bucket that fits (a compile
@@ -568,10 +581,13 @@ class DataplanePump:
         elif K == 1:
             # async dispatch; (out, aux) with the fast-path summary
             # riding the same program (measured on both tiers)
-            payload = self.dp.process_packed(flat, with_aux=True)
+            payload = self.dp.process_packed(flat, with_aux=True,
+                                             stamp_us=stamp_us)
         else:
-            # async, ([K,5,B], [K,3])
-            payload = self.dp.process_packed_chain(flat, with_aux=True)
+            # async, ([K,5,B], [K,PACKED_AUX_ROWS])
+            payload = self.dp.process_packed_chain(
+                flat, with_aux=True,
+                stamps_us=np.full(K, stamp_us, np.int32))
             self.stats["chain_batches"] += 1
             self.stats["chain_k_peak"] = max(self.stats["chain_k_peak"],
                                              K)
@@ -619,6 +635,7 @@ class DataplanePump:
             sweep_stride = getattr(self.dp, "_sweep_stride", None)
             ml_mode = getattr(self.dp, "_ml_mode", "off")
             ml_kind = getattr(self.dp, "_ml_kind", "mlp")
+            tel_mode = getattr(self.dp, "_tel_mode", "off")
         self._ppump = PersistentPump(tables, batch=VEC,
                                      fastpath=fastpath,
                                      classifier=classifier,
@@ -628,6 +645,7 @@ class DataplanePump:
                                      ring_windows=self.ring_windows,
                                      ml_mode=ml_mode,
                                      ml_kind=ml_kind,
+                                     tel_mode=tel_mode,
                                      ).start()
         self._persist_epoch = epoch
 
@@ -637,7 +655,10 @@ class DataplanePump:
         sessions through its carry, so by stop time they are NEWER
         than whatever dp.tables holds (the per-dispatch path commits
         per batch; this is the same continuity, paid at loop exit)."""
-        from vpp_tpu.pipeline.tables import SESSION_FIELDS
+        from vpp_tpu.pipeline.tables import (
+            SESSION_FIELDS,
+            TELEMETRY_FIELDS,
+        )
 
         if self._ppump is None:
             return
@@ -654,7 +675,11 @@ class DataplanePump:
             self._ring_stats_sync()
         if final is None:
             return
-        sess = {f: getattr(final, f) for f in SESSION_FIELDS}
+        # session state AND the telemetry planes (ISSUE 11) graft
+        # back: both rode the ring's private carry, so by stop time
+        # they are newer than whatever dp.tables holds
+        sess = {f: getattr(final, f)
+                for f in (*SESSION_FIELDS, *TELEMETRY_FIELDS)}
         with self.dp._lock:
             if self.dp.tables is not None:
                 # DataplaneTables is a NamedTuple pytree, not a dataclass
@@ -684,6 +709,16 @@ class DataplanePump:
         and serves them; nothing is dropped by the mode switch
         itself)."""
         tp0 = time.perf_counter()
+        # rx-enqueue stamp (ISSUE 11): taken at pack start so the
+        # device-side wire-latency histogram covers pack + submit
+        # queueing + window fill + ring backpressure — the whole host
+        # leg up to the dispatch the governor (ROADMAP item 3) can
+        # actually influence. 0 (unstamped) with telemetry off.
+        stamp_us = 0
+        if getattr(self.dp, "_tel_mode", "off") != "off":
+            from vpp_tpu.ops.telemetry import tel_clock_us
+
+            stamp_us = tel_clock_us()
         flat = np.zeros((PACKED_IN_ROWS, VEC), np.int32)
         non_ip = np.zeros(VEC, np.uint8)
         self._pack_group(frames, flat, non_ip)
@@ -691,7 +726,8 @@ class DataplanePump:
         t0 = time.perf_counter()
         while True:
             try:
-                self._ppump.submit(flat, now=self.dp.clock_ticks())
+                self._ppump.submit(flat, now=self.dp.clock_ticks(),
+                                   stamp_us=stamp_us)
                 if self._ring_backoff.attempt:
                     self._ring_backoff.reset()
                 break
@@ -903,6 +939,33 @@ class DataplanePump:
             self.dp._now = max(self.dp._now, self.dp.clock_ticks())
         return True
 
+    def tel_snapshot(self) -> Optional[dict]:
+        """Collect-facing device-telemetry snapshot (ISSUE 11). In
+        persistent mode this unpacks the latest ring rider — the
+        telemetry planes that rode the last window's ONE result fetch
+        — so collect never touches the ring's private tables carry
+        (and never makes a device transfer at all). Other modes (and
+        a ring that hasn't written back yet) fall through to the
+        dataplane's own small-plane fetch. None when telemetry is
+        off."""
+        tel_mode = getattr(self.dp, "_tel_mode", "off")
+        if tel_mode == "off":
+            return None
+        pp = self._ppump
+        if self.mode == "persistent" and pp is not None:
+            raw = pp.tel_raw()
+            if raw is not None:
+                from vpp_tpu.ops.telemetry import unpack_tel_rider
+                from vpp_tpu.pipeline.tables import tel_capacity
+
+                nb, _d, _w, k = tel_capacity(self.dp.config)
+                snap = unpack_tel_rider(raw, nb, k)
+                snap["mode"] = tel_mode
+                snap["bins"] = np.asarray(snap["bins"], np.int64)
+                snap["top_cnt"] = np.asarray(snap["top_cnt"], np.int64)
+                return snap
+        return self.dp.telemetry_snapshot()
+
     def _ring_fold(self, pp) -> None:
         """Retire a PersistentPump's monotonic ring counters into the
         accumulator EXACTLY ONCE, so restarts (epoch swaps,
@@ -1103,10 +1166,14 @@ class DataplanePump:
             self._done_cv.notify_all()
 
     def _account_fastpath(self, aux) -> bool:
-        """Fold one dispatch's [8] (or chain-fold [K, 8]) aux summary
-        into the pump counters; returns True when EVERY sub-batch ran
-        the classify-free kernel (the whole dispatch's latency then
-        belongs to the fast-tier histogram).
+        """Fold one dispatch's ``[PACKED_AUX_ROWS]`` (or chain-fold
+        ``[K, PACKED_AUX_ROWS]``) aux summary into the pump counters;
+        returns True when EVERY sub-batch ran the classify-free kernel
+        (the whole dispatch's latency then belongs to the fast-tier
+        histogram). Row meanings come from
+        ``pipeline.dataplane.PACKED_AUX_SCHEMA`` — the width
+        authority; the ``a.shape[1] >=`` guards keep older/narrower
+        riders (mesh pumps, test fakes) accounting their prefix.
 
         ``fastpath_batches`` counts at DISPATCH granularity — a chain
         fold counts once, and only when all K sub-batches went fast —
@@ -1114,8 +1181,10 @@ class DataplanePump:
         ratio is a true fraction). Partial folds still show up in the
         packet-level hits/alive accumulators. Rows 3/4 carry the
         session-table pressure counters (insert election losses,
-        evictions) and rows 5-7 the ML-stage verdict counters
-        (scored / flagged / dropped) when the program provides them."""
+        evictions), rows 5-7 the ML-stage verdict counters (scored /
+        flagged / dropped), rows 8/9 the device-telemetry counters
+        (wire latencies histogrammed / packets sketched) when the
+        program provides them."""
         if aux is None:
             return False
         a = np.asarray(aux)
@@ -1134,6 +1203,9 @@ class DataplanePump:
                 self.stats["ml_scored"] += int(a[:, 5].sum())
                 self.stats["ml_flagged"] += int(a[:, 6].sum())
                 self.stats["ml_drops"] += int(a[:, 7].sum())
+            if a.shape[1] >= 10:
+                self.stats["tel_observed"] += int(a[:, 8].sum())
+                self.stats["tel_sketched"] += int(a[:, 9].sum())
         return all_fast
 
     # --- tx writer: reorder, split, write tx ring, release rx slots ---

@@ -89,6 +89,12 @@ class DeviceDescRing:
                                np.int32) for _ in range(self.windows)]
         self._now = [np.zeros(self.slots, np.int32)
                      for _ in range(self.windows)]
+        # the spare descriptor lane (ISSUE 11): per-slot rx-enqueue
+        # microsecond stamps the window program turns into wire-latency
+        # histogram samples (0 = unstamped; telemetry off leaves the
+        # lane zero — 4 B/slot, not worth gating the allocation)
+        self._stamp = [np.zeros(self.slots, np.int32)
+                       for _ in range(self.windows)]
         self._held = [False] * self.windows
         self._next = 0  # cyclic acquire cursor
         self._cv = threading.Condition(threading.Lock())
@@ -101,7 +107,8 @@ class DeviceDescRing:
     def acquire(self, timeout: Optional[float] = None):
         """The next staging window in cyclic order, or None on timeout
         (every earlier window still in flight — host-side
-        backpressure). Returns ``(widx, desc, now)`` views; the caller
+        backpressure). Returns ``(widx, desc, now, stamp)`` views
+        (``stamp`` is the per-slot rx-enqueue µs lane); the caller
         owns them until ``release(widx)``."""
         with self._cv:
             w = self._next
@@ -110,7 +117,7 @@ class DeviceDescRing:
                 return None
             self._held[w] = True
             self._next = (w + 1) % self.windows
-            return w, self._desc[w], self._now[w]
+            return w, self._desc[w], self._now[w], self._stamp[w]
 
     def release(self, widx: int) -> None:
         """Window transfer complete — buffer reusable. Any-order safe

@@ -174,16 +174,12 @@ def ml_score(tables: DataplaneTables, pkts: PacketVector,
     return _mlp_scores(tables, xc)
 
 
-def _flow_hash(pkts: PacketVector) -> jnp.ndarray:
-    """Stateless per-flow hash for the rate-limit admission gate (the
-    ops/session.py multiplicative-xor scheme, unmasked)."""
-    h = pkts.src_ip * jnp.uint32(0x9E3779B1)
-    h ^= pkts.dst_ip * jnp.uint32(0x85EBCA77)
-    h ^= ((pkts.sport.astype(jnp.uint32) << 16)
-          | pkts.dport.astype(jnp.uint32)) * jnp.uint32(0xC2B2AE3D)
-    h ^= pkts.proto.astype(jnp.uint32) * jnp.uint32(0x27D4EB2F)
-    h ^= h >> 15
-    return h
+# Stateless per-flow hash for the rate-limit admission gate: the ONE
+# device copy lives in ops/telemetry.py (tel_flow_hash — the
+# session-family multiplicative-xor mix), shared so the ratelimit
+# gate and the heavy-hitter sketch can never bucket the same 5-tuple
+# differently.
+from vpp_tpu.ops.telemetry import tel_flow_hash as _flow_hash  # noqa: E402
 
 
 def ml_policy(tables: DataplaneTables, pkts: PacketVector,

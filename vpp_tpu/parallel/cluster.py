@@ -52,9 +52,11 @@ from vpp_tpu.pipeline.graph import (
 )
 from vpp_tpu.pipeline.tables import (
     SESSION_FIELDS,
+    TELEMETRY_FIELDS,
     DataplaneConfig,
     DataplaneTables,
     zero_sessions,
+    zero_telemetry,
 )
 from vpp_tpu.pipeline.vector import (
     FLAG_VALID,
@@ -451,11 +453,21 @@ class ClusterDataplane:
             }
             if self.tables is not None:
                 sess = {f: getattr(self.tables, f) for f in SESSION_FIELDS}
+                tel = {f: getattr(self.tables, f)
+                       for f in TELEMETRY_FIELDS}
             else:
                 zs = zero_sessions(self.config, leading=(self.n_nodes,))
                 sess = {
                     f: jax.device_put(v, shardings[f])
                     for f, v in zs.items()
+                }
+                # telemetry planes (ops/telemetry.py): node-stacked
+                # placeholders — cluster node configs keep the knob
+                # off (the ml_stage pattern), so these are never read
+                zt = zero_telemetry(self.config, leading=(self.n_nodes,))
+                tel = {
+                    f: jax.device_put(v, shardings[f])
+                    for f, v in zt.items()
                 }
             self._use_mxu = all(
                 n.builder.mxu_enabled and n.builder.glb_mxu.ok
@@ -463,7 +475,7 @@ class ClusterDataplane:
             ) and any(
                 n.builder.glb_nrules >= self.mxu_threshold for n in self.nodes
             )
-            self.tables = DataplaneTables(**dev, **sess)
+            self.tables = DataplaneTables(**dev, **sess, **tel)
             self._uplinks = jax.device_put(
                 np.array(
                     [

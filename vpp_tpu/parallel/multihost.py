@@ -53,9 +53,11 @@ from vpp_tpu.parallel.mesh import (
 from vpp_tpu.pipeline.dataplane import Dataplane
 from vpp_tpu.pipeline.tables import (
     SESSION_FIELDS,
+    TELEMETRY_FIELDS,
     DataplaneConfig,
     DataplaneTables,
     zero_sessions,
+    zero_telemetry,
 )
 from vpp_tpu.pipeline.vector import PacketVector, make_packet_vector
 
@@ -211,7 +213,7 @@ class MultiHostCluster:
                 "have no uplink interface (call add_uplink())")
         local_stack = {}
         for k in DataplaneTables._fields:
-            if k in SESSION_FIELDS:
+            if k in SESSION_FIELDS or k in TELEMETRY_FIELDS:
                 continue
             local_stack[k] = np.stack(
                 [arrs_by_node[i][k] for i in self.local_nodes])
@@ -221,6 +223,7 @@ class MultiHostCluster:
         }
         if self.tables is not None:
             sess = {f: getattr(self.tables, f) for f in SESSION_FIELDS}
+            tel = {f: getattr(self.tables, f) for f in TELEMETRY_FIELDS}
         else:
             zero = zero_sessions(self.config,
                                  leading=(len(self.local_nodes),))
@@ -228,6 +231,15 @@ class MultiHostCluster:
                 f: self._to_global(np.asarray(zero[f]),
                                    getattr(self._specs, f))
                 for f in SESSION_FIELDS
+            }
+            # telemetry placeholders (ops/telemetry.py): multi-host
+            # node configs keep the knob off, so never read
+            zt = zero_telemetry(self.config,
+                                leading=(len(self.local_nodes),))
+            tel = {
+                f: self._to_global(np.asarray(zt[f]),
+                                   getattr(self._specs, f))
+                for f in TELEMETRY_FIELDS
             }
         # MXU classifier selection is CLUSTER state: one jitted
         # program serves all nodes, so the choice must be identical
@@ -244,7 +256,7 @@ class MultiHostCluster:
             np.int32([int(local_ok), int(local_big)]))).reshape(-1, 2)
         self._use_mxu = bool(flags[:, 0].min()) and bool(
             flags[:, 1].max())
-        self.tables = DataplaneTables(**host_fields, **sess)
+        self.tables = DataplaneTables(**host_fields, **sess, **tel)
         self._uplinks = self._to_global(
             np.array([self.nodes[i].uplink_if or 0
                       for i in self.local_nodes], np.int32),

@@ -37,21 +37,32 @@ from vpp_tpu.pipeline.vector import Disposition, PacketVector
 from vpp_tpu.trace import spans
 
 
-def _packed_call(step, with_aux: bool = False):
+def _packed_call(step, with_aux: bool = False, tel: str = "off"):
     """Wrap a pipeline step with a bit-packed IO boundary: ONE [5, B]
     int32 input and ONE [5, B] int32 output.
 
-    ``with_aux=True`` additionally returns an [8] int32 summary
-    ``[fastpath, rx, sess_hits, sess_insert_fails, sess_evictions,
-    ml_scored, ml_flagged, ml_drops]``
-    (StepStats scalars; rows 3/4 sum the reflective + NAT tables, rows
-    5-7 are the per-packet ML stage's verdict counters — ISSUE 10)
-    per batch — the two-tier dispatch telemetry plus the session-table
-    pressure and ML-marking signals. It rides the SAME device program
-    and the same result fetch as the packed output (32 bytes, not a
-    second round trip), so the pump can count fast-path batches, hit
-    percentage, table congestion and ML verdicts without widening the
-    20 B/packet boundary.
+    ``with_aux=True`` additionally returns a ``[PACKED_AUX_ROWS]``
+    int32 summary whose rows are named by ``PACKED_AUX_SCHEMA`` (the
+    ONE schema constant every dispatch form — packed, chained, ring —
+    derives its aux width from; widening the rider is an edit to that
+    tuple plus one row expression below, never three hand-edited
+    paths). Rows 3/4 sum the reflective + NAT tables, rows 5-7 are
+    the per-packet ML stage's verdict counters (ISSUE 10), rows 8/9
+    the device-telemetry counters (ISSUE 11). It rides the SAME
+    device program and the same result fetch as the packed output
+    (40 bytes, not a second round trip), so the pump can count
+    fast-path batches, hit percentage, table congestion, ML verdicts
+    and telemetry activity without widening the 20 B/packet boundary.
+
+    ``tel`` (trace-time static — the step-factory gate of
+    ops/telemetry.py) widens the call signature: below "off" the run
+    is the classic ``(tables, flat, now)``; with telemetry on it is
+    ``(tables, flat, now, rx_stamp, now_us)`` where ``rx_stamp`` is
+    the batch's rx-enqueue microsecond stamp (the spare descriptor
+    lane — 0 = unstamped, not observed) and ``now_us`` the dispatch
+    clock; the wire latency ``now_us − rx_stamp`` is bucketed into
+    the device-resident log2 histogram AFTER the step, inside the
+    same program.
 
     Over a remote device transport (the axon tunnel) every host↔device
     transfer is a round trip; the unpacked path costs ~13 of them per
@@ -81,7 +92,7 @@ def _packed_call(step, with_aux: bool = False):
     the rx ring columns for them — they don't travel back.
     """
 
-    def run(tables, flat, now):
+    def _core(tables, flat, now, rx_stamp, now_us):
         from jax import lax
 
         f = lax.bitcast_convert_type(flat, jnp.uint32)
@@ -101,6 +112,21 @@ def _packed_call(step, with_aux: bool = False):
             flags=i32(f[4] & 0xFF),
         )
         res = step(tables, pv, now)
+        out_tables = res.tables
+        tel_observed = jnp.int32(0)
+        # jax-ok: tel is a trace-time-static step-factory gate (a
+        # Python string baked into the jit key), not a tracer branch
+        if tel != "off":
+            from vpp_tpu.ops.telemetry import tel_latency_update
+
+            # a zero stamp means "not stamped" (warm-up frames, ICMP
+            # probes, chain padding); negative latency (clock wrap,
+            # bogus stamp) is equally unobserved
+            lat = now_us - rx_stamp
+            observe = res.pkts.valid & (rx_stamp > 0) & (lat >= 0)
+            out_tables, tel_observed = tel_latency_update(
+                out_tables, observe,
+                jnp.broadcast_to(lat, res.pkts.valid.shape))
 
         def u32(x):
             return x.astype(jnp.uint32)
@@ -118,20 +144,30 @@ def _packed_call(step, with_aux: bool = False):
         packed = lax.bitcast_convert_type(out, jnp.int32)
         if with_aux:
             s = res.stats
+            # row ORDER is PACKED_AUX_SCHEMA — keep the two in sync
             aux = jnp.stack([
                 s.fastpath, s.rx, s.sess_hits,
                 s.sess_insert_fail + s.natsess_insert_fail,
                 (s.sess_evict_expired + s.sess_evict_victim
                  + s.natsess_evict_expired + s.natsess_evict_victim),
                 s.ml_scored, s.ml_flagged, s.ml_drops,
+                tel_observed, s.tel_sketched,
             ]).astype(jnp.int32)
-            return res.tables, packed, aux
-        return res.tables, packed
+            return out_tables, packed, aux
+        return out_tables, packed
 
-    return run
+    if tel == "off":
+        # the pre-telemetry call signature: the off state adds no
+        # arguments and no device work (the telemetry aux rows fold
+        # to constants XLA keeps as two zero lanes of the rider)
+        def run(tables, flat, now):
+            return _core(tables, flat, now, jnp.int32(0), jnp.int32(0))
+
+        return run
+    return _core
 
 
-def _chained_call(step, with_aux: bool = False):
+def _chained_call(step, with_aux: bool = False, tel: str = "off"):
     """K packed steps in ONE device program: ``lax.scan`` over a
     [K, 5, B] stack of packed batches, session tables threaded
     batch-to-batch exactly as K separate dispatches would. One
@@ -140,11 +176,15 @@ def _chained_call(step, with_aux: bool = False):
     the 'K-chained device steps synced once' lever of docs/LATENCY.md
     (VERDICT r3 Next #4). Latency of the FIRST frame rises to the
     chain's span, so this serves throughput-with-bounded-sync, not
-    single-frame latency. ``with_aux`` stacks the per-step [5] aux
-    summaries into a [K, 5] array next to the [K, 5, B] results."""
-    packed = _packed_call(step, with_aux=with_aux)
+    single-frame latency. ``with_aux`` stacks the per-step
+    [PACKED_AUX_ROWS] aux summaries into a [K, PACKED_AUX_ROWS] array
+    next to the [K, 5, B] results. With ``tel`` on, the scan
+    additionally carries per-sub-batch rx stamps ([K] int32 µs) and
+    the dispatch clock, feeding the device latency histogram exactly
+    like K separate packed dispatches would."""
+    packed = _packed_call(step, with_aux=with_aux, tel=tel)
 
-    def run(tables, flats, now):
+    def run_off(tables, flats, now):
         from jax import lax
 
         def body(tbl, flat):
@@ -156,19 +196,44 @@ def _chained_call(step, with_aux: bool = False):
 
         return lax.scan(body, tables, flats)
 
-    return run
+    def run_tel(tables, flats, now, rx_stamps, now_us):
+        from jax import lax
+
+        def body(tbl, xs):
+            flat, stamp = xs
+            if with_aux:
+                tbl2, out, aux = packed(tbl, flat, now, stamp, now_us)
+                return tbl2, (out, aux)
+            tbl2, out = packed(tbl, flat, now, stamp, now_us)
+            return tbl2, out
+
+        return lax.scan(body, tables, (flats, rx_stamps))
+
+    return run_off if tel == "off" else run_tel
 
 
 # packed-boundary shape: [PACKED_IN_ROWS, B] in, [PACKED_OUT_ROWS_N, B] out
 PACKED_IN_ROWS = 5
 PACKED_OUT_ROWS_N = 5
-# rows of the per-batch aux summary _packed_call(with_aux=True) returns
-# ([fastpath, rx, sess_hits, insert_fails, evictions,
-#   ml_scored, ml_flagged, ml_drops])
-PACKED_AUX_ROWS = 8
+# The aux-rider schema: row names of the per-batch int32 summary
+# _packed_call(with_aux=True) returns, IN ORDER. This tuple is the ONE
+# width authority for every dispatch form — packed, chained and the
+# device-ring window program all derive their aux shape from it (and
+# tests/test_telemetry.py pins all three against it), so widening the
+# rider is an edit HERE plus the matching row expression in
+# _packed_call, never three hand-edited paths. History: [3] (fastpath
+# trio, PR 3) → [5] (+session pressure, PR 6) → [8] (+ML verdicts,
+# PR 9) → [10] (+device telemetry, PR 10 / ISSUE 11).
+PACKED_AUX_SCHEMA = (
+    "fastpath", "rx", "sess_hits",        # two-tier dispatch trio
+    "insert_fails", "evictions",          # session-table pressure
+    "ml_scored", "ml_flagged", "ml_drops",  # ML-stage verdicts
+    "tel_observed", "tel_sketched",       # device telemetry (ISSUE 11)
+)
+PACKED_AUX_ROWS = len(PACKED_AUX_SCHEMA)
 
 
-def _ring_call(step, slots: int):
+def _ring_call(step, slots: int, tel: str = "off"):
     """Device-resident descriptor-ring window program (ISSUE 7): ONE
     dispatch processes up to ``slots`` packed frames without any host
     callback in between.
@@ -200,10 +265,25 @@ def _ring_call(step, slots: int):
       (tables, cursor, rx_ring [S,5,B], rx_now [S], rx_tail) ->
       (tables', cursor + consumed, tx_ring [S,5,B],
        aux_ring [S, PACKED_AUX_ROWS])
-    """
-    packed = _packed_call(step, with_aux=True)
 
-    def run(tables, cursor, rx_ring, rx_now, rx_tail):
+    With ``tel`` on (ISSUE 11) the window additionally carries the
+    per-slot rx-enqueue stamp lane ``rx_stamp [S]`` (µs — the pump
+    stamps each frame at staging; one frame occupies one slot in
+    persistent mode, so a slot-granular stamp IS per-frame) plus the
+    dispatch clock ``now_us``; the program buckets each packet's
+    ``now_us − rx_stamp`` into the device-resident latency histogram
+    at tx-append, and the accumulated telemetry planes ride back as a
+    widened aux rider (``pack_tel_rider``) in the window's ONE
+    existing result fetch — ``io_callbacks`` stays 0 by construction:
+      (tables, cursor, rx_ring, rx_now, rx_stamp [S], now_us,
+       rx_tail) ->
+      (tables', cursor + consumed, tx_ring, aux_ring,
+       tel [tel_rider_width])
+    """
+    packed = _packed_call(step, with_aux=True, tel=tel)
+
+    def _loop(tables, cursor, rx_ring, rx_now, rx_stamp, now_us,
+              rx_tail):
         from jax import lax
 
         tx_ring0 = jnp.zeros_like(rx_ring)
@@ -217,7 +297,12 @@ def _ring_call(step, slots: int):
             tbl, head, tx, auxs = carry
             flat = lax.dynamic_index_in_dim(rx_ring, head, 0,
                                             keepdims=False)
-            tbl2, out, aux = packed(tbl, flat, rx_now[head])
+            # jax-ok: tel is a trace-time-static step-factory gate
+            if tel == "off":
+                tbl2, out, aux = packed(tbl, flat, rx_now[head])
+            else:
+                tbl2, out, aux = packed(tbl, flat, rx_now[head],
+                                        rx_stamp[head], now_us)
             tx = lax.dynamic_update_index_in_dim(tx, out, head, 0)
             auxs = lax.dynamic_update_index_in_dim(auxs, aux, head, 0)
             return tbl2, head + jnp.int32(1), tx, auxs
@@ -226,7 +311,22 @@ def _ring_call(step, slots: int):
             cond, body, (tables, jnp.int32(0), tx_ring0, aux_ring0))
         return tables, cursor + head, tx_ring, aux_ring
 
-    return run
+    if tel == "off":
+        def run(tables, cursor, rx_ring, rx_now, rx_tail):
+            return _loop(tables, cursor, rx_ring, rx_now, None,
+                         jnp.int32(0), rx_tail)
+
+        return run
+
+    def run_tel(tables, cursor, rx_ring, rx_now, rx_stamp, now_us,
+                rx_tail):
+        from vpp_tpu.ops.telemetry import pack_tel_rider
+
+        tables, cursor, tx_ring, aux_ring = _loop(
+            tables, cursor, rx_ring, rx_now, rx_stamp, now_us, rx_tail)
+        return tables, cursor, tx_ring, aux_ring, pack_tel_rider(tables)
+
+    return run_tel
 
 
 # Jitted step variants, shared PROCESS-WIDE across Dataplane instances
@@ -250,14 +350,16 @@ _JIT_COMPILES_LOCK = threading.Lock()
 
 def _step_label(impl: str, skip_local: bool, fast: bool, form: str,
                 sweep_stride: int, ring_slots: int = 0,
-                ml_mode: str = "off", ml_kind: str = "mlp") -> str:
+                ml_mode: str = "off", ml_kind: str = "mlp",
+                tel_mode: str = "off") -> str:
     from vpp_tpu.pipeline.graph import SWEEP_STRIDE_DEFAULT
 
-    return "{}{}{}{}{}_{}".format(
+    return "{}{}{}{}{}{}_{}".format(
         impl, "_nolocal" if skip_local else "", "_auto" if fast else "",
         ("" if ml_mode == "off"
          else f"_ml{ml_mode}"
          + ("_forest" if ml_kind == "forest" else "")),
+        "" if tel_mode == "off" else f"_tel{tel_mode}",
         ("" if sweep_stride == SWEEP_STRIDE_DEFAULT
          else f"_sw{sweep_stride}"),
         f"{form}{ring_slots}" if form == "ring" else form)
@@ -361,24 +463,26 @@ def jit_compile_budget(budget: int) -> _JitBudget:
 def _jitted_step(impl: str, skip_local: bool, fast: bool, form: str,
                  sweep_stride: Optional[int] = None,
                  ring_slots: int = 0,
-                 ml_mode: str = "off", ml_kind: str = "mlp"):
+                 ml_mode: str = "off", ml_kind: str = "mlp",
+                 tel_mode: str = "off"):
     from vpp_tpu.pipeline.graph import SWEEP_STRIDE_DEFAULT
 
     if sweep_stride is None:
         sweep_stride = SWEEP_STRIDE_DEFAULT
     key = (impl, skip_local, fast, form, sweep_stride, ring_slots,
-           ml_mode, ml_kind)
+           ml_mode, ml_kind, tel_mode)
     step = _JIT_STEPS.get(key)
     if step is None:
         fn = make_pipeline_step(impl, skip_local, fast, sweep_stride,
-                                ml_mode, ml_kind)
+                                ml_mode, ml_kind, tel_mode)
         label = _step_label(impl, skip_local, fast, form, sweep_stride,
-                            ring_slots, ml_mode, ml_kind)
+                            ring_slots, ml_mode, ml_kind, tel_mode)
         if form == "plain":
             step = jax.jit(_counting(label, fn))
         elif form == "packed":
             step = jax.jit(
-                _counting(label, _packed_call(fn, with_aux=True)),
+                _counting(label, _packed_call(fn, with_aux=True,
+                                              tel=tel_mode)),
                 donate_argnums=(1,))
         elif form == "ring":
             # the device-ring window program: the WHOLE carry is
@@ -394,11 +498,13 @@ def _jitted_step(impl: str, skip_local: bool, fast: bool, form: str,
             # the first window's donation can't invalidate buffers the
             # collector/CLI still read.
             step = jax.jit(
-                _counting(label, _ring_call(fn, ring_slots)),
+                _counting(label, _ring_call(fn, ring_slots,
+                                            tel=tel_mode)),
                 donate_argnums=(0, 1, 2))
         else:
             step = jax.jit(
-                _counting(label, _chained_call(fn, with_aux=True)),
+                _counting(label, _chained_call(fn, with_aux=True,
+                                               tel=tel_mode)),
                 donate_argnums=(1,))
         _JIT_STEPS[key] = step
     return step
@@ -544,6 +650,11 @@ class Dataplane:
         self.ml_stage = getattr(self.config, "ml_stage", "off")
         self._ml_mode = "off"
         self._ml_kind = "mlp"
+        # Device-resident telemetry plane (ops/telemetry.py; ISSUE 11):
+        # a pure config gate — unlike the classifier/ml selections it
+        # never re-gates at swap (there is no staged state to consult;
+        # the planes' shapes are config-static like sess_ways).
+        self._tel_mode = getattr(self.config, "telemetry", "off")
         self._refresh_selection()
         # diagnostic classify-probe accumulators (time_classifier):
         # exported as the stage="classify" row of the
@@ -913,16 +1024,17 @@ class Dataplane:
         policied epochs compiles ONE program, whichever came first."""
         skip = self._skip_local
         stride = self._sweep_stride
-        ml = (self._ml_mode, self._ml_kind)
+        gates = (self._ml_mode, self._ml_kind, self._tel_mode)
         if (skip
                 and (self._classifier_impl, skip, fast, form, stride,
-                     0) + ml not in _JIT_STEPS
+                     0) + gates not in _JIT_STEPS
                 and (self._classifier_impl, False, fast, form, stride,
-                     0) + ml in _JIT_STEPS):
+                     0) + gates in _JIT_STEPS):
             skip = False
         return _jitted_step(self._classifier_impl, skip, fast, form,
                             stride, ml_mode=self._ml_mode,
-                            ml_kind=self._ml_kind)
+                            ml_kind=self._ml_kind,
+                            tel_mode=self._tel_mode)
 
     def time_classifier(self, batch: int = 256, iters: int = 10) -> float:
         """Diagnostic: time the SELECTED global classifier in isolation
@@ -1014,7 +1126,9 @@ class Dataplane:
         return step(tables, pkts, jnp.int32(now))
 
     def process_packed(self, flat, now: Optional[int] = None,
-                       commit: bool = True, with_aux: bool = False):
+                       commit: bool = True, with_aux: bool = False,
+                       stamp_us: int = 0,
+                       now_us: Optional[int] = None):
         """Single-transfer variant of process() for the pump's hot path:
         ``flat`` is a host [5, B] int32 bit-packed batch (see
         ``_packed_call`` for the row layout; build with
@@ -1036,7 +1150,14 @@ class Dataplane:
         probe-like classify): REQUIRED for any caller other than the
         pump's single dispatch thread — two concurrent committers race
         the ``tables is self.tables`` swap guard and one side's
-        reflective-session installs would be silently lost."""
+        reflective-session installs would be silently lost.
+
+        With telemetry on (``config.telemetry`` != off), ``stamp_us``
+        is the batch's rx-enqueue microsecond stamp (ops/telemetry.py
+        tel_clock_us; 0 = unstamped, not observed) and ``now_us`` the
+        dispatch clock (None = read it here) — the device histograms
+        ``now_us − stamp_us`` for every valid packet inside the same
+        program."""
         with self._lock:
             if self.tables is None:
                 raise RuntimeError(
@@ -1050,7 +1171,17 @@ class Dataplane:
             if now is None:
                 self._now = max(self._now, self.clock_ticks())
                 now = self._now
-        new_tables, out, aux = step(tables, jnp.asarray(flat), jnp.int32(now))
+        if self._tel_mode != "off":
+            from vpp_tpu.ops.telemetry import tel_clock_us
+
+            if now_us is None:
+                now_us = tel_clock_us()
+            new_tables, out, aux = step(
+                tables, jnp.asarray(flat), jnp.int32(now),
+                jnp.int32(stamp_us), jnp.int32(now_us))
+        else:
+            new_tables, out, aux = step(tables, jnp.asarray(flat),
+                                        jnp.int32(now))
         if commit:
             with self._lock:
                 if tables is self.tables:
@@ -1058,14 +1189,19 @@ class Dataplane:
         return (out, aux) if with_aux else out
 
     def process_packed_chain(self, flats, now: Optional[int] = None,
-                             with_aux: bool = False):
+                             with_aux: bool = False,
+                             stamps_us=None,
+                             now_us: Optional[int] = None):
         """K packed batches in ONE device dispatch (``_chained_call``):
         ``flats`` is a host [K, 5, B] int32 stack; returns the DEVICE
         [K, 5, B] packed results. One dispatch + one fetch for K
         frames — the bounded-sync throughput lever when per-step
         dispatch dominates (remote transports, small frames).
         ``with_aux=True`` returns ``(outs, auxs)`` with the stacked
-        [K, 8] aux summaries (measured on both tiers)."""
+        [K, PACKED_AUX_ROWS] aux summaries (measured on both tiers).
+        ``stamps_us`` ([K] int32 µs rx-enqueue stamps) feeds the
+        device latency histogram when telemetry is on (None = all
+        unstamped)."""
         with self._lock:
             if self.tables is None:
                 raise RuntimeError(
@@ -1079,10 +1215,53 @@ class Dataplane:
             if now is None:
                 self._now = max(self._now, self.clock_ticks())
                 now = self._now
-        new_tables, (outs, auxs) = step(
-            tables, jnp.asarray(flats), jnp.int32(now)
-        )
+        if self._tel_mode != "off":
+            from vpp_tpu.ops.telemetry import tel_clock_us
+
+            if now_us is None:
+                now_us = tel_clock_us()
+            if stamps_us is None:
+                stamps_us = np.zeros(len(flats), np.int32)
+            new_tables, (outs, auxs) = step(
+                tables, jnp.asarray(flats), jnp.int32(now),
+                jnp.asarray(stamps_us, jnp.int32), jnp.int32(now_us))
+        else:
+            new_tables, (outs, auxs) = step(
+                tables, jnp.asarray(flats), jnp.int32(now)
+            )
         with self._lock:
             if tables is self.tables:
                 self.tables = new_tables
         return (outs, auxs) if with_aux else outs
+
+    # --- device telemetry (ops/telemetry.py; ISSUE 11) ---
+    def telemetry_snapshot(self) -> Optional[dict]:
+        """Host copy of the collect-facing telemetry planes: latency
+        bins, the sketched-packet scalar and the top-K candidate rows.
+        A few hundred BYTES cross the transport — the [d, w] sketch
+        matrix stays device-resident (the PR 6 `show sessions` rule:
+        collect fetches scalars, never tables). None when telemetry is
+        off or no tables are live. Persistent-mode callers prefer the
+        pump's rider snapshot (DataplanePump.tel_snapshot) — the ring
+        threads its tables privately, so dp.tables lags until
+        stop/sync."""
+        if self._tel_mode == "off":
+            return None
+        with self._lock:
+            t = self.tables
+        if t is None:
+            return None
+        bins, sketched, key, src, dst, ports, cnt = jax.device_get((
+            t.tel_lat_hist, t.tel_sketched, t.tel_top_key,
+            t.tel_top_src, t.tel_top_dst, t.tel_top_ports,
+            t.tel_top_cnt))
+        return {
+            "mode": self._tel_mode,
+            "bins": np.asarray(bins, np.int64),
+            "sketched": int(sketched),
+            "top_key": np.asarray(key, np.uint32),
+            "top_src": np.asarray(src, np.uint32),
+            "top_dst": np.asarray(dst, np.uint32),
+            "top_ports": np.asarray(ports, np.uint32),
+            "top_cnt": np.asarray(cnt, np.int64),
+        }

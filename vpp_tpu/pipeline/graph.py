@@ -90,6 +90,10 @@ class StepStats(NamedTuple):
     ml_scored: jnp.ndarray              # int32 scalar
     ml_flagged: jnp.ndarray             # int32 scalar
     ml_drops: jnp.ndarray               # int32 scalar
+    # device-resident telemetry plane (ops/telemetry.py; 0 below
+    # telemetry "full"): alive packets folded into the count-min
+    # heavy-hitter flow sketch this step
+    tel_sketched: jnp.ndarray           # int32 scalar
 
 
 # Per-packet drop attribution (error-drop counter analog).
@@ -128,6 +132,10 @@ class StepResult(NamedTuple):
                                # threshold (the mirror mask: the IO
                                # path can copy these out; all-False
                                # with the stage off)
+    ml_scores: jnp.ndarray     # int32 [P] raw per-packet ML scores
+                               # (the PacketTracer's ml-score node
+                               # reads them; all-zero with the stage
+                               # off — packed paths never fetch them)
 
 
 def _ingress(tables: DataplaneTables, pkts: PacketVector):
@@ -151,8 +159,10 @@ def _ml_eval(tables: DataplaneTables, pkts: PacketVector,
     the reflective-session hit state/age — values both tiers hold at
     their scoring point, bit-identically.
 
-    Returns ``(scored, flagged, drop_wanted)`` masks [P]. ``ml_mode``
-    / ``ml_kind`` are trace-time-static step-factory gates: "off"
+    Returns ``(scored, flagged, drop_wanted, scores)`` — three masks
+    [P] plus the raw int32 score vector (the PacketTracer's ml-score
+    node renders it; zeros with the stage off). ``ml_mode`` /
+    ``ml_kind`` are trace-time-static step-factory gates: "off"
     returns all-False constants XLA folds away (the stage costs
     nothing when disabled); "score" never requests drops; only
     "enforce" passes the policy's drop verdict through — which the
@@ -162,14 +172,15 @@ def _ml_eval(tables: DataplaneTables, pkts: PacketVector,
     # (Python strings baked into the jit key), not tracer branches
     if ml_mode == "off":
         false_p = jnp.zeros(alive.shape, bool)
-        return false_p, false_p, false_p
+        return false_p, false_p, false_p, jnp.zeros(alive.shape,
+                                                    jnp.int32)
     scores = ml_score(tables, pkts, established, sess_age, kind=ml_kind)
     flagged, drop_wanted = ml_policy(tables, pkts, alive, scores)
     # jax-ok: ml_mode is the same trace-time-static gate as above —
     # score mode statically discards the policy's drop verdict
     if ml_mode != "enforce":
         drop_wanted = jnp.zeros(alive.shape, bool)
-    return alive, flagged, drop_wanted
+    return alive, flagged, drop_wanted, scores
 
 
 def _finish_step(
@@ -199,7 +210,9 @@ def _finish_step(
     ml_scored: jnp.ndarray,
     ml_flagged: jnp.ndarray,
     ml_dropped: jnp.ndarray,
+    ml_scores: jnp.ndarray,
     sweep_stride: int = 0,
+    tel_mode: str = "off",
 ) -> StepResult:
     """Shared tail of both pipeline tiers: drop attribution, counters,
     StepStats and the StepResult assembly. The ONE copy of the
@@ -209,8 +222,18 @@ def _finish_step(
     tiers by construction. Also the ONE place the amortized session
     sweep runs (``sweep_stride`` buckets per table per step —
     ops/session.py session_sweep), so aging rides EVERY tier of the
-    fused program identically."""
+    fused program identically — and the ONE place the heavy-hitter
+    flow sketch (ops/telemetry.py; ``tel_mode`` "full", trace-time
+    static) folds the batch in, so both tiers feed the same sketch."""
     tables = session_sweep(tables, now, sweep_stride)
+    # jax-ok: tel_mode is a trace-time-static step-factory gate (a
+    # Python string baked into the jit key), not a tracer branch
+    if tel_mode == "full":
+        from vpp_tpu.ops.telemetry import tel_flow_update
+
+        tables, tel_sketched = tel_flow_update(tables, pkts, alive)
+    else:
+        tel_sketched = jnp.int32(0)
     n_ifaces = tables.if_type.shape[0]
     # ml-drop wins attribution over the FIB outcomes (the packet never
     # reached forwarding), but LOSES to ACL deny: ml_dropped is
@@ -275,6 +298,7 @@ def _finish_step(
         ml_scored=jnp.sum(ml_scored.astype(jnp.int32)),
         ml_flagged=jnp.sum(ml_flagged.astype(jnp.int32)),
         ml_drops=jnp.sum(ml_dropped.astype(jnp.int32)),
+        tel_sketched=tel_sketched,
     )
     drop_cause = (
         jnp.where(pkts.valid & drop_ip4, DROP_IP4, 0)
@@ -297,6 +321,7 @@ def _finish_step(
         dnat_applied=dnat_applied,
         snat_applied=snat_applied,
         ml_flagged=ml_flagged,
+        ml_scores=ml_scores,
     )
 
 
@@ -316,6 +341,7 @@ def pipeline_step(
     sweep_stride: int = SWEEP_STRIDE_DEFAULT,
     ml_mode: str = "off",
     ml_kind: str = "mlp",
+    tel_mode: str = "off",
 ) -> StepResult:
     """Process one packet vector through the full forwarding chain.
 
@@ -352,7 +378,7 @@ def pipeline_step(
 
     # --- per-packet ML scoring (ISSUE 10): on the post-reverse header,
     # the same values the fast tier scores — ONE shared evaluation
-    ml_scored, ml_flagged, ml_drop_want = _ml_eval(
+    ml_scored, ml_flagged, ml_drop_want, ml_scores = _ml_eval(
         tables, pkts, alive, established, sess_age, ml_mode, ml_kind)
 
     orig_dst, orig_dport = pkts.dst_ip, pkts.dport
@@ -426,7 +452,7 @@ def pipeline_step(
         sess_evict_expired=sess_ev_exp, sess_evict_victim=sess_ev_vic,
         natsess_evict_expired=nat_ev_exp, natsess_evict_victim=nat_ev_vic,
         ml_scored=ml_scored, ml_flagged=ml_flagged, ml_dropped=ml_dropped,
-        sweep_stride=sweep_stride,
+        ml_scores=ml_scores, sweep_stride=sweep_stride, tel_mode=tel_mode,
     )
 
 
@@ -458,6 +484,7 @@ def _pipeline_fast_finish(
     sweep_stride: int = SWEEP_STRIDE_DEFAULT,
     ml_mode: str = "off",
     ml_kind: str = "mlp",
+    tel_mode: str = "off",
 ) -> StepResult:
     """Tail of the classify-free kernel, from post-reverse headers on.
 
@@ -485,7 +512,7 @@ def _pipeline_fast_finish(
     permit = established
     drop_acl = alive & ~permit
 
-    ml_scored, ml_flagged, ml_drop_want = _ml_eval(
+    ml_scored, ml_flagged, ml_drop_want, ml_scores = _ml_eval(
         tables, pkts, alive, established, sess_age, ml_mode, ml_kind)
     ml_dropped = ml_drop_want & permit & alive
 
@@ -509,7 +536,7 @@ def _pipeline_fast_finish(
         sess_evict_expired=false_p, sess_evict_victim=false_p,
         natsess_evict_expired=false_p, natsess_evict_victim=false_p,
         ml_scored=ml_scored, ml_flagged=ml_flagged, ml_dropped=ml_dropped,
-        sweep_stride=sweep_stride,
+        ml_scores=ml_scores, sweep_stride=sweep_stride, tel_mode=tel_mode,
     )
 
 
@@ -518,6 +545,7 @@ def pipeline_step_fast(
     sweep_stride: int = SWEEP_STRIDE_DEFAULT,
     ml_mode: str = "off",
     ml_kind: str = "mlp",
+    tel_mode: str = "off",
 ) -> StepResult:
     """The classify-free established-flow kernel, standalone:
     ip4-input → session lookup/touch → NAT reverse/touch → [ML score]
@@ -536,7 +564,7 @@ def pipeline_step_fast(
     return _pipeline_fast_finish(
         tables, pkts, now, alive, drop_ip4, established, sess_hit_idx,
         nat_reversed, nat_hit_idx, sweep_stride=sweep_stride,
-        ml_mode=ml_mode, ml_kind=ml_kind,
+        ml_mode=ml_mode, ml_kind=ml_kind, tel_mode=tel_mode,
     )
 
 
@@ -549,6 +577,7 @@ def pipeline_step_auto(
     sweep_stride: int = SWEEP_STRIDE_DEFAULT,
     ml_mode: str = "off",
     ml_kind: str = "mlp",
+    tel_mode: str = "off",
 ) -> StepResult:
     """Two-tier dispatch: the fast kernel when the whole batch rides
     established sessions, the full chain otherwise.
@@ -585,13 +614,14 @@ def pipeline_step_auto(
         return _pipeline_fast_finish(
             tables, rpkts, now, alive, drop_ip4, hits, sess_hit_idx,
             nat_reversed, nat_hit_idx, sweep_stride=sweep_stride,
-            ml_mode=ml_mode, ml_kind=ml_kind,
+            ml_mode=ml_mode, ml_kind=ml_kind, tel_mode=tel_mode,
         )
 
     def full(_):
         return pipeline_step(tables, orig_pkts, now, acl_global_fn,
                              acl_local_fn, sweep_stride=sweep_stride,
-                             ml_mode=ml_mode, ml_kind=ml_kind)
+                             ml_mode=ml_mode, ml_kind=ml_kind,
+                             tel_mode=tel_mode)
 
     return lax.cond(ok, fast, full, None)
 
@@ -621,16 +651,18 @@ def _classifier_fns(impl: str):
 def make_pipeline_step(impl: str = "dense", skip_local: bool = False,
                        fast: bool = False,
                        sweep_stride: int = SWEEP_STRIDE_DEFAULT,
-                       ml_mode: str = "off", ml_kind: str = "mlp"):
+                       ml_mode: str = "off", ml_kind: str = "mlp",
+                       tel_mode: str = "off"):
     """Compose one pipeline-step callable from the epoch's gates:
     classifier implementation (dense | mxu | bv), the policy-free
     local-classify skip, the two-tier fast-path dispatch, the session
-    sweep stride, and the ML-stage mode/kernel kind (all trace-time
-    static — part of the memo key, so two configs with different gates
-    never share a program). The Dataplane builds (and jit-caches) its
-    step variants exclusively through here, so every (impl, skip,
-    tier, stride, ml) combination shares ONE chain definition — a
-    pipeline edit can't diverge a variant.
+    sweep stride, the ML-stage mode/kernel kind, and the telemetry
+    mode (all trace-time static — part of the memo key, so two
+    configs with different gates never share a program). The Dataplane
+    builds (and jit-caches) its step variants exclusively through
+    here, so every (impl, skip, tier, stride, ml, tel) combination
+    shares ONE chain definition — a pipeline edit can't diverge a
+    variant.
 
     Memoized: equal gates return the SAME function object, so jax's
     function-identity tracing/compilation caches are shared across
@@ -643,6 +675,8 @@ def make_pipeline_step(impl: str = "dense", skip_local: bool = False,
         raise ValueError(f"unknown ml_mode {ml_mode!r}")
     if ml_kind not in ("mlp", "forest"):
         raise ValueError(f"unknown ml_kind {ml_kind!r}")
+    if tel_mode not in ("off", "latency", "full"):
+        raise ValueError(f"unknown tel_mode {tel_mode!r}")
     acl_global_fn, acl_local_fn = _classifier_fns(impl)
     if skip_local:
         acl_local_fn = acl_local_none
@@ -652,12 +686,13 @@ def make_pipeline_step(impl: str = "dense", skip_local: bool = False,
              now: jnp.ndarray) -> StepResult:
         return base(tables, pkts, now, acl_global_fn=acl_global_fn,
                     acl_local_fn=acl_local_fn, sweep_stride=sweep_stride,
-                    ml_mode=ml_mode, ml_kind=ml_kind)
+                    ml_mode=ml_mode, ml_kind=ml_kind, tel_mode=tel_mode)
 
-    step.__name__ = "pipeline_step_{}{}{}{}".format(
+    step.__name__ = "pipeline_step_{}{}{}{}{}".format(
         impl, "_nolocal" if skip_local else "", "_auto" if fast else "",
         "" if ml_mode == "off" else f"_ml{ml_mode}"
         + ("_forest" if ml_kind == "forest" else ""),
+        "" if tel_mode == "off" else f"_tel{tel_mode}",
     )
     return step
 

@@ -95,7 +95,8 @@ class PersistentPump:
                  skip_local: bool = False,
                  sweep_stride: Optional[int] = None,
                  ring_slots: int = 8, ring_windows: int = 2,
-                 ml_mode: str = "off", ml_kind: str = "mlp"):
+                 ml_mode: str = "off", ml_kind: str = "mlp",
+                 tel_mode: str = "off"):
         self.batch = int(batch)
         self.fastpath_enabled = bool(fastpath)
         self.ring = DeviceDescRing(slots=ring_slots, batch=self.batch,
@@ -115,10 +116,16 @@ class PersistentPump:
         self._error: Optional[BaseException] = None
         self._threads: list = []
         self._max_frames = max_frames  # legacy knob; windows need no budget
+        # telemetry gate (ops/telemetry.py; ISSUE 11): with it on, the
+        # window program takes the per-slot stamp lane + dispatch
+        # clock and returns the packed telemetry rider as a 5th output
+        # riding the window's one result fetch
+        self._tel = tel_mode
         self._step = _jitted_step(classifier, skip_local, fast=fastpath,
                                   form="ring", sweep_stride=sweep_stride,
                                   ring_slots=self.ring.slots,
-                                  ml_mode=ml_mode, ml_kind=ml_kind)
+                                  ml_mode=ml_mode, ml_kind=ml_kind,
+                                  tel_mode=tel_mode)
         # device-resident frame cursor, threaded window-to-window next
         # to the tables (the sweep-cursor pattern); fetched only by
         # stats()/stop, never per window
@@ -143,6 +150,10 @@ class PersistentPump:
             # callback sneaking in without one.
             "io_callbacks": 0,
         }
+        # latest telemetry rider (fetcher-written under _stats_lock):
+        # the raw int32 vector of pack_tel_rider, cumulative — the
+        # owning pump unpacks it with the config geometry
+        self._tel_last: Optional[np.ndarray] = None
 
     # --- lifecycle ---
     def start(self) -> "PersistentPump":
@@ -165,13 +176,17 @@ class PersistentPump:
         (a wedged ring must not hide behind an idle rx queue)."""
         return self._error is not None
 
-    def submit(self, flat: np.ndarray, now: int) -> None:
+    def submit(self, flat: np.ndarray, now: int,
+               stamp_us: int = 0) -> None:
         """Queue one packed [5, B] frame; ``now`` is its per-slot
-        timestamp (must be >= 0). The frame is COPIED — callers may
-        reuse their staging buffer immediately."""
+        timestamp (must be >= 0) and ``stamp_us`` its rx-enqueue
+        microsecond stamp for the wire-latency histogram (0 =
+        unstamped; ignored with telemetry off). The frame is COPIED —
+        callers may reuse their staging buffer immediately."""
         assert now >= 0
         self._check_error()
-        self._in.put((int(now), np.array(flat, np.int32, copy=True)))
+        self._in.put((int(now), int(stamp_us),
+                      np.array(flat, np.int32, copy=True)))
 
     def checkpoint_sessions(self, timeout: float = 30.0):
         """Consistent DEVICE COPY of the in-ring session state, taken
@@ -220,6 +235,15 @@ class PersistentPump:
         s["ring_inflight"] = self.ring.in_flight()
         s["ring_lag"] = s.pop("windows_dispatched") - s["ring_windows"]
         return s
+
+    def tel_raw(self) -> Optional[np.ndarray]:
+        """Latest telemetry rider (raw ``pack_tel_rider`` int32
+        vector; cumulative) — None until the first telemetry-on window
+        wrote back. The owning pump unpacks it against the config
+        geometry (ops/telemetry.py unpack_tel_rider)."""
+        with self._stats_lock:
+            tel = self._tel_last
+        return None if tel is None else tel.copy()
 
     def stop(self, join_timeout: float = 60.0):
         """Flush every queued frame through the device and return the
@@ -284,7 +308,7 @@ class PersistentPump:
                         break
                     if self._error is not None:
                         return
-                widx, desc, nows = got
+                widx, desc, nows, stamps = got
                 n = 0
                 pending_ckpt = None
                 # adaptive fill: drain whatever is already queued up to
@@ -292,9 +316,10 @@ class PersistentPump:
                 # ships in a 1-slot window (latency floor), a backlog
                 # fills the window (throughput)
                 while True:
-                    now, flat = item
+                    now, stamp_us, flat = item
                     desc[n] = flat
                     nows[n] = now
+                    stamps[n] = stamp_us
                     n += 1
                     if n >= self.ring.slots:
                         break
@@ -318,11 +343,25 @@ class PersistentPump:
                 # like a real dispatch failure, which is what arms the
                 # pump's ring→dispatch degraded fallback
                 faults.fire("ring.dispatch")
-                tables, cursor, tx_ring, aux_ring = self._step(
-                    tables, cursor, desc, nows, np.int32(n))
+                if self._tel != "off":
+                    from vpp_tpu.ops.telemetry import tel_clock_us
+
+                    # per-packet wire latency is computed ON DEVICE at
+                    # tx-append: the window ships the per-slot stamp
+                    # lane + this dispatch clock, and the histogram
+                    # bins ride back in the ONE result fetch below —
+                    # no callback enters the program for telemetry
+                    tables, cursor, tx_ring, aux_ring, tel = \
+                        self._step(tables, cursor, desc, nows, stamps,
+                                   np.int32(tel_clock_us()),
+                                   np.int32(n))
+                else:
+                    tables, cursor, tx_ring, aux_ring = self._step(
+                        tables, cursor, desc, nows, np.int32(n))
+                    tel = None
                 with self._stats_lock:
                     self.stats["windows_dispatched"] += 1
-                self._fetch_q.put((widx, n, tx_ring, aux_ring))
+                self._fetch_q.put((widx, n, tx_ring, aux_ring, tel))
                 if pending_ckpt is not None:
                     self._serve_ckpt(pending_ckpt, tables)
             self._tables_pending = tables
@@ -352,12 +391,19 @@ class PersistentPump:
                 item = self._fetch_q.get()
                 if item is _SENTINEL:
                     return
-                widx, n, tx_ring, aux_ring = item
+                widx, n, tx_ring, aux_ring, tel = item
                 # the window's ONE device->host transfer: tx
-                # descriptors + per-slot aux summaries together
+                # descriptors + per-slot aux summaries + (telemetry
+                # on) the packed telemetry rider, together
                 # (faults: "ring.fetch" = the transfer failing)
                 faults.fire("ring.fetch")
-                out_h, aux_h = jax.device_get((tx_ring, aux_ring))
+                if tel is not None:
+                    out_h, aux_h, tel_h = jax.device_get(
+                        (tx_ring, aux_ring, tel))
+                    with self._stats_lock:
+                        self._tel_last = np.array(tel_h, np.int32)
+                else:
+                    out_h, aux_h = jax.device_get((tx_ring, aux_ring))
                 out_h = np.asarray(out_h)
                 aux_h = np.asarray(aux_h)
                 # the staging buffer is reusable once its window's

@@ -113,6 +113,19 @@ class DataplaneConfig(NamedTuple):
     ml_hidden: int = 16        # MLP hidden width
     ml_trees: int = 4          # oblivious-forest tree count
     ml_depth: int = 3          # oblivious-forest depth (leaves = 2^D)
+    # Device-resident telemetry plane (ops/telemetry.py; ISSUE 11):
+    # "off" compiles the stage out entirely and carries minimal
+    # placeholder shapes (the ml_stage pattern — the off-state programs
+    # are byte-identical to pre-telemetry); "latency" enables the
+    # in-step wire-latency log2 histogram; "full" adds the count-min
+    # heavy-hitter flow sketch + top-K candidate table. The planes ride
+    # this pytree like the sweep cursors (epoch swaps carry them by
+    # reference; the persistent ring threads them window-to-window).
+    telemetry: str = "off"
+    telemetry_lat_buckets: int = 24   # log2 µs bins (last saturates)
+    telemetry_sketch_rows: int = 2    # count-min depth d
+    telemetry_sketch_cols: int = 1024  # count-min width w (power of 2)
+    telemetry_topk: int = 8           # heavy-hitter candidate slots
 
 
 class DataplaneTables(NamedTuple):
@@ -266,6 +279,20 @@ class DataplaneTables(NamedTuple):
     sess_sweep_cursor: jnp.ndarray
     natsess_sweep_cursor: jnp.ndarray
 
+    # --- device-resident telemetry plane (ops/telemetry.py; ISSUE 11) --
+    # Carried across epoch swaps by reference like the session state
+    # (TELEMETRY_FIELDS below); minimal placeholder shapes when the
+    # ``telemetry`` knob is off (tel_capacity — the ml/BV gating
+    # pattern, the placeholders are never read by an off-state step).
+    tel_lat_hist: jnp.ndarray   # int32 [NB] log2 µs wire-latency bins
+    tel_sketch: jnp.ndarray    # int32 [d, w] count-min flow sketch
+    tel_sketched: jnp.ndarray  # int32 scalar: packets folded in
+    tel_top_key: jnp.ndarray   # uint32 [K] top-K candidate flow hash
+    tel_top_src: jnp.ndarray   # uint32 [K] candidate src ip
+    tel_top_dst: jnp.ndarray   # uint32 [K] candidate dst ip
+    tel_top_ports: jnp.ndarray  # uint32 [K] sport<<16 | dport
+    tel_top_cnt: jnp.ndarray   # int32 [K] estimated packet count
+
 
 def _mask_of(plen: int, bits: int = 32) -> int:
     return ((1 << bits) - 1) ^ ((1 << (bits - plen)) - 1) if plen else 0
@@ -331,6 +358,72 @@ def zero_sessions_device(config: DataplaneConfig) -> Dict[str, jnp.ndarray]:
             for k, dt in SESSION_FIELDS.items()}
 
 
+# Telemetry-plane fields of DataplaneTables (ops/telemetry.py; ISSUE
+# 11) with their dtypes — the single source for zero-fill, the
+# epoch-swap carry-over (to_device) and the persistent-pump stop-merge.
+# Deliberately NOT part of SESSION_FIELDS: the crash-consistent
+# snapshot format (pipeline/snapshot.py) enumerates SESSION_FIELDS, and
+# telemetry is measurement state that restarts cold by design.
+TELEMETRY_FIELDS: Dict[str, type] = {
+    "tel_lat_hist": np.int32,
+    "tel_sketch": np.int32,
+    "tel_sketched": np.int32,
+    "tel_top_key": np.uint32,
+    "tel_top_src": np.uint32,
+    "tel_top_dst": np.uint32,
+    "tel_top_ports": np.uint32,
+    "tel_top_cnt": np.int32,
+}
+
+_TELEMETRY_SHAPE: Dict[str, str] = {
+    "tel_lat_hist": "lat", "tel_sketch": "sketch",
+    "tel_sketched": "scalar", "tel_top_key": "topk",
+    "tel_top_src": "topk", "tel_top_dst": "topk",
+    "tel_top_ports": "topk", "tel_top_cnt": "topk",
+}
+
+
+def tel_capacity(config: DataplaneConfig) -> Tuple[int, int, int, int]:
+    """(lat_buckets, sketch_rows, sketch_cols, topk) of the telemetry
+    planes. "off" carries minimal placeholders (never read — the step
+    factory compiles the stage out); "latency" keeps the sketch/top-K
+    planes at placeholder size too."""
+    mode = getattr(config, "telemetry", "off")
+    if mode == "off":
+        return 1, 1, 1, 1
+    nb = int(getattr(config, "telemetry_lat_buckets", 24))
+    if mode == "latency":
+        return nb, 1, 1, 1
+    return (nb, int(getattr(config, "telemetry_sketch_rows", 2)),
+            int(getattr(config, "telemetry_sketch_cols", 1024)),
+            int(getattr(config, "telemetry_topk", 8)))
+
+
+def telemetry_shapes(config: DataplaneConfig) -> Dict[str, Tuple[int, ...]]:
+    """Per-field telemetry-plane shapes (no leading axes)."""
+    nb, d, w, k = tel_capacity(config)
+    shapes = {"lat": (nb,), "sketch": (d, w), "topk": (k,),
+              "scalar": ()}
+    return {f: shapes[_TELEMETRY_SHAPE[f]] for f in TELEMETRY_FIELDS}
+
+
+def zero_telemetry(config: DataplaneConfig,
+                   leading: Tuple[int, ...] = ()) -> Dict[str, np.ndarray]:
+    """Fresh (empty) telemetry planes, optionally node-stacked (the
+    cluster data plane's leading axis, mirroring zero_sessions)."""
+    shapes = telemetry_shapes(config)
+    return {f: np.zeros(leading + shapes[f], dt)
+            for f, dt in TELEMETRY_FIELDS.items()}
+
+
+def zero_telemetry_device(config: DataplaneConfig) -> Dict[str, jnp.ndarray]:
+    """Device-resident fresh telemetry planes (zero_sessions_device
+    twin — the planes are small, but the fill still belongs on device)."""
+    shapes = telemetry_shapes(config)
+    return {f: jnp.zeros(shapes[f], dt)
+            for f, dt in TELEMETRY_FIELDS.items()}
+
+
 def _is_pow2(n: int) -> bool:
     return n > 0 and (n & (n - 1)) == 0
 
@@ -383,6 +476,29 @@ def validate_dataplane_config(config: DataplaneConfig) -> None:
         raise ValueError(
             f"dataplane.ml_depth must be in 1..8 (leaf table is "
             f"2^depth), got {c.ml_depth}")
+    tel = getattr(c, "telemetry", "off")
+    if tel not in ("off", "latency", "full"):
+        raise ValueError(
+            f"dataplane.telemetry must be off | latency | full, got "
+            f"{tel!r}")
+    nb = int(getattr(c, "telemetry_lat_buckets", 24))
+    if not (4 <= nb <= 31):
+        raise ValueError(
+            f"dataplane.telemetry_lat_buckets must be in 4..31 "
+            f"(log2 µs bins in int32), got {nb}")
+    d = int(getattr(c, "telemetry_sketch_rows", 2))
+    if not (1 <= d <= 8):
+        raise ValueError(
+            f"dataplane.telemetry_sketch_rows must be in 1..8, got {d}")
+    w = int(getattr(c, "telemetry_sketch_cols", 1024))
+    if not _is_pow2(w):
+        raise ValueError(
+            f"dataplane.telemetry_sketch_cols must be a power of two "
+            f"(column masking), got {w}")
+    k = int(getattr(c, "telemetry_topk", 8))
+    if not (1 <= k <= 64):
+        raise ValueError(
+            f"dataplane.telemetry_topk must be in 1..64, got {k}")
 
 
 def ml_capacity(config: DataplaneConfig) -> Tuple[int, int, int, int]:
@@ -1323,14 +1439,23 @@ class TableBuilder:
                         f"{shapes[f]}")
             sess = {f: jnp.asarray(np.asarray(sessions[f], dt))
                     for f, dt in SESSION_FIELDS.items()}
+            # telemetry restarts cold on a snapshot restore by design:
+            # the snapshot format carries SESSION_FIELDS only, and
+            # measurement state from before a crash would mislabel the
+            # post-restart latency regime
+            tel = zero_telemetry_device(self.config)
         elif sessions is not None:
             # carry-over is BY REFERENCE: the live device arrays flow
             # into the new epoch untouched — at 10M slots the session
-            # state is ~100s of MB and must never re-ship on a swap
+            # state is ~100s of MB and must never re-ship on a swap.
+            # The telemetry planes (ops/telemetry.py) ride the same
+            # carry: an epoch swap must not reset the histograms.
             sess = {f: getattr(sessions, f) for f in SESSION_FIELDS}
+            tel = {f: getattr(sessions, f) for f in TELEMETRY_FIELDS}
         else:
             # device-side zero fill, not a host upload of zeros
             sess = zero_sessions_device(self.config)
+            tel = zero_telemetry_device(self.config)
         host_np = self.host_arrays()
         host = {}
         glb_full = False
@@ -1369,7 +1494,7 @@ class TableBuilder:
             # no-op while the device serves stale rules
             self._set_glb_prev(host_np)
         self._dirty.clear()
-        return DataplaneTables(**host, **sess)
+        return DataplaneTables(**host, **sess, **tel)
 
     def _set_glb_prev(self, host_np: Dict[str, np.ndarray]) -> None:
         """Record the diff base for incremental glb commits. The ROW

@@ -88,6 +88,16 @@ PUMP_STAT_GAUGES = (
      "packets the ML stage flagged across pump dispatches"),
     ("ml_drops", "vpp_tpu_ml_pump_drops",
      "packets the ML enforce policy dropped across pump dispatches"),
+    # device-telemetry riders (aux rows 8/9, ISSUE 11): wire-latency
+    # samples the device histogrammed and packets folded into the
+    # heavy-hitter flow sketch, as the pump's aux fetch saw them —
+    # both 0 with dataplane.telemetry off
+    ("tel_observed", "vpp_tpu_pump_wire_lat_observed",
+     "packets whose wire latency the device telemetry plane "
+     "histogrammed across pump dispatches"),
+    ("tel_sketched", "vpp_tpu_pump_flow_sketched",
+     "packets folded into the device heavy-hitter flow sketch "
+     "across pump dispatches"),
     # device-resident descriptor rings (persistent mode, ISSUE 7):
     # host↔device window exchanges, frames staged through the ring,
     # live in-flight windows, tx-writeback lag (windows dispatched but
@@ -216,6 +226,11 @@ NODE_GAUGES = (
      "packets whose ML score crossed the model's flag threshold"),
     ("vpp_tpu_ml_dropped_packets",
      "packets dropped by the ML enforce policy (drop / rate-limit)"),
+    # device-resident telemetry plane (ISSUE 11; ops/telemetry.py):
+    # the StepStats mirror of the in-step flow-sketch fold
+    ("vpp_tpu_flow_sketch_packets",
+     "packets folded into the device count-min heavy-hitter flow "
+     "sketch"),
 )
 
 # StepStats field → the Prometheus family its value feeds. The single
@@ -256,7 +271,30 @@ STEPSTATS_FAMILIES = {
     "ml_scored": "vpp_tpu_ml_scored_packets",
     "ml_flagged": "vpp_tpu_ml_flagged_packets",
     "ml_drops": "vpp_tpu_ml_dropped_packets",
+    # device telemetry plane (ISSUE 11)
+    "tel_sketched": "vpp_tpu_flow_sketch_packets",
 }
+
+# Packed-aux rider row (pipeline/dataplane.py PACKED_AUX_SCHEMA, rows
+# 3+) -> the pump stats key it accumulates into. Rows 0-2 are the
+# fastpath trio consumed positionally by _account_fastpath. The
+# tools/lint.py --counters pass enforces BOTH directions: every schema
+# row maps here, and every mapped key exports via PUMP_STAT_GAUGES —
+# widening the rider without its observability twin fails tier-1
+# (the STEPSTATS parity idea extended to the aux boundary, ISSUE 11).
+AUX_RIDER_STATS = {
+    "insert_fails": "sess_insert_fails",
+    "evictions": "sess_evictions",
+    "ml_scored": "ml_scored",
+    "ml_flagged": "ml_flagged",
+    "ml_drops": "ml_drops",
+    "tel_observed": "tel_observed",
+    "tel_sketched": "tel_sketched",
+}
+
+# Telemetry-plane modes the vpp_tpu_telemetry info gauge enumerates
+# (the trace-time-static DataplaneConfig.telemetry knob)
+TELEMETRY_MODES = ("off", "latency", "full")
 
 # StepStats eviction field → its (table, reason) label pair on the
 # vpp_tpu_session_evictions_total family.
@@ -296,7 +334,8 @@ class StatsCollector:
                            "sess_evict_expired", "sess_evict_victim",
                            "natsess_evict_expired",
                            "natsess_evict_victim",
-                           "ml_scored", "ml_flagged", "ml_drops")
+                           "ml_scored", "ml_flagged", "ml_drops",
+                           "tel_sketched")
         }
         # gauges, not counters: last-step snapshots
         self._last: Dict[str, int] = {
@@ -480,6 +519,70 @@ class StatsCollector:
                   "and keeps the previous model serving)",
                   kind="counter"),
         )
+        # device-resident telemetry plane (ISSUE 11; ops/telemetry.py):
+        # the wire-latency native histogram (exact log2 bucket
+        # boundaries of the device bins — the last device bin is the
+        # saturating overflow and maps to +Inf), the quantile gauges
+        # derived from the bins at collect, the heavy-hitter candidate
+        # counts, and the mode info gauge. The family registers at the
+        # CONFIG's bucket geometry even while the knob is off (a
+        # TYPE-only family until the first snapshot), so scrapers see
+        # a stable surface.
+        from vpp_tpu.ops.telemetry import bucket_bounds_seconds
+        from vpp_tpu.stats.prometheus import DeviceHistogram
+
+        nb = int(getattr(dataplane.config, "telemetry_lat_buckets", 24))
+        self._tel_nb = nb
+        self.wire_latency_hist = self.registry.register(
+            STATS_PATH,
+            DeviceHistogram(
+                "vpp_tpu_wire_latency_seconds",
+                "per-packet wire latency (rx-enqueue stamp to device "
+                "tx-append) measured INSIDE the fused step by the "
+                "device telemetry plane; exact log2 bucket boundaries "
+                "of the on-device bins",
+                bounds=bucket_bounds_seconds(nb),
+            ),
+        )
+        self.wire_latency_gauges = {
+            q: self.registry.register(
+                STATS_PATH,
+                Gauge(f"vpp_tpu_wire_latency_{q}_us",
+                      f"{q} per-packet wire latency (µs), derived "
+                      f"from the device log2 bins at collect time"))
+            for q in ("p50", "p99", "p999")
+        }
+        self.flow_top_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_flow_sketch_top_count",
+                  "estimated packet count of each heavy-hitter "
+                  "candidate slot (count-min estimate; rank label is "
+                  "the slot index, not a sorted order)"),
+        )
+        self.flow_sketched_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_flow_sketch_updates_total",
+                  "packets folded into the device count-min flow "
+                  "sketch since start (cumulative device scalar)",
+                  kind="counter"),
+        )
+        self.telemetry_mode_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_telemetry",
+                  "device-telemetry plane mode (info-style: mode "
+                  "label, 1 = active; off compiles the plane out)"),
+        )
+        # sanity anchor for every scrape-side consumer: a constant-1
+        # info gauge carrying the build/runtime identity labels
+        # (ISSUE 11 satellite). Published per collect so the
+        # classifier label tracks the live selection.
+        self.build_info_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_build_info",
+                  "build/runtime identity (info-style: constant 1 "
+                  "with version/jax/backend/classifier labels)"),
+        )
+        self._build_labels: Optional[Dict[str, str]] = None
         # degraded-state sources: the cluster store (set_store), the
         # snapshotter (set_snapshotter) and the ML model source
         # (set_ml); the pump is already attached via set_pump
@@ -654,6 +757,8 @@ class StatsCollector:
             totals["ml_flagged"])
         self.node_gauges["vpp_tpu_ml_dropped_packets"].set(
             totals["ml_drops"])
+        self.node_gauges["vpp_tpu_flow_sketch_packets"].set(
+            totals["tel_sketched"])
         self.sess_insert_failed_gauge.set(
             totals["sess_insert_fail"], table="sess")
         self.sess_insert_failed_gauge.set(
@@ -704,6 +809,56 @@ class StatsCollector:
         from vpp_tpu.pipeline.dataplane import jit_compile_totals
         for label, n in jit_compile_totals().items():
             self.jit_compiles_gauge.set(float(n), step=label)
+        # build-info anchor (ISSUE 11 satellite): constant 1, identity
+        # labels. The classifier label follows the live selection —
+        # on a change the previous label set is removed so exactly one
+        # series ever reads 1.
+        import jax as _jax
+
+        from vpp_tpu import __version__ as _version
+        build_labels = {
+            "version": _version,
+            "jax": getattr(_jax, "__version__", "?"),
+            "backend": _jax.default_backend(),
+            "classifier": impl,
+        }
+        if self._build_labels is not None \
+                and self._build_labels != build_labels:
+            self.build_info_gauge.remove(**self._build_labels)
+        self.build_info_gauge.set(1.0, **build_labels)
+        self._build_labels = build_labels
+        # device telemetry plane (ISSUE 11): mode info gauge always;
+        # bins/quantiles/top-K only once a snapshot exists. Persistent
+        # pumps serve the ring-rider snapshot (no device transfer at
+        # collect); otherwise the dataplane fetches its small planes.
+        tel_mode = getattr(self.dp, "_tel_mode", "off")
+        for name in TELEMETRY_MODES:
+            self.telemetry_mode_gauge.set(
+                1.0 if name == tel_mode else 0.0, mode=name)
+        tel = None
+        tel_fn = getattr(self.pump, "tel_snapshot", None)
+        if callable(tel_fn):
+            tel = tel_fn()
+        if tel is None:
+            tel_fn = getattr(self.dp, "telemetry_snapshot", None)
+            tel = tel_fn() if callable(tel_fn) else None
+        if tel is not None:
+            from vpp_tpu.ops.telemetry import (
+                approx_sum_us,
+                quantiles_from_bins,
+            )
+
+            bins = tel["bins"]
+            if len(bins) == self._tel_nb:
+                self.wire_latency_hist.set_bins(
+                    bins, approx_sum_us(bins) / 1e6)
+            p50, p99, p999 = quantiles_from_bins(bins)
+            self.wire_latency_gauges["p50"].set(p50)
+            self.wire_latency_gauges["p99"].set(p99)
+            self.wire_latency_gauges["p999"].set(p999)
+            self.flow_sketched_gauge.set(float(tel["sketched"]))
+            for rank, cnt in enumerate(tel["top_cnt"]):
+                self.flow_top_gauge.set(float(cnt), rank=str(rank))
         # resilience surface (ISSUE 8): every component exports every
         # publish (0 = healthy) so dashboards alert on value, never on
         # series absence

@@ -21,6 +21,10 @@ LabelSet = Tuple[Tuple[str, str], ...]
 # metrics pass; the reference's contiv_* namespace discipline)
 METRIC_NAME_RE = re.compile(r"^vpp_tpu_[a-z0-9_]+$")
 
+# on-wire label pairs (the registry lint parses rendered histogram
+# series to verify exposition completeness)
+LABELS_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
 
 def _labels_key(labels: Dict[str, str]) -> LabelSet:
     return tuple(sorted(labels.items()))
@@ -174,6 +178,81 @@ class Histogram:
         return out
 
 
+class DeviceHistogram:
+    """A histogram family whose buckets are SET wholesale from a
+    device-computed bin vector instead of observed sample-by-sample —
+    the exposition face of the device-resident telemetry plane
+    (ops/telemetry.py; ISSUE 11). The fused step scatter-adds each
+    packet into on-device log2 bins; collect fetches the small bin
+    vector and publishes it here with the exact bucket boundaries, so
+    the scrape side sees a conformant native histogram
+    (``_bucket``/``_sum``/``_count``, cumulative, ``le="+Inf"`` ==
+    ``_count``) it can ``histogram_quantile()`` across nodes.
+
+    ``bounds`` are the finite upper bounds; the LAST device bin (the
+    saturating overflow bucket) maps to the implicit ``+Inf``, so a
+    bin vector has ``len(bounds) + 1`` entries. ``_sum`` is supplied
+    by the caller (a documented lower-bound approximation — the exact
+    sum never crosses the transport) and only has to stay monotone
+    with the bins, which a cumulative device counter guarantees."""
+
+    def __init__(self, name: str, help_text: str = "",
+                 bounds: Tuple[float, ...] = ()):
+        self.name = name
+        self.help = help_text
+        self.kind = "histogram"
+        bounds = tuple(float(b) for b in bounds)
+        if not bounds or any(b2 <= b1
+                             for b1, b2 in zip(bounds, bounds[1:])):
+            raise ValueError(
+                "bounds must be strictly ascending and non-empty")
+        if any(b != b or b in (float("inf"), float("-inf"))
+               for b in bounds):
+            raise ValueError("bounds must be finite (+Inf is implicit)")
+        # the lint pass reads ``buckets`` off every histogram-kind
+        # family — keep the attribute name shared with Histogram
+        self.buckets = bounds
+        self._bins: Optional[Tuple[int, ...]] = None
+        self._sum = 0.0
+        self._lock = threading.Lock()
+
+    def set_bins(self, bins, sum_value: float) -> None:
+        """Publish one device snapshot: ``bins`` are PER-BUCKET counts
+        (len(buckets) + 1 — last is the overflow/+Inf bin)."""
+        bins = tuple(int(b) for b in bins)
+        if len(bins) != len(self.buckets) + 1:
+            raise ValueError(
+                f"{self.name}: {len(bins)} bins != {len(self.buckets)}"
+                f" bounds + overflow")
+        with self._lock:
+            self._bins = bins
+            self._sum = float(sum_value)
+
+    def get_count(self) -> int:
+        with self._lock:
+            return sum(self._bins) if self._bins is not None else 0
+
+    def render(self) -> List[str]:
+        out = []
+        if self.help:
+            out.append(f"# HELP {self.name} {_escape_help(self.help)}")
+        out.append(f"# TYPE {self.name} histogram")
+        with self._lock:
+            bins, total_sum = self._bins, self._sum
+        if bins is None:
+            return out  # no snapshot yet: TYPE-only family (legal)
+        cum = 0
+        for bound, c in zip(self.buckets, bins):
+            cum += c
+            out.append(
+                f'{self.name}_bucket{{le="{_fmt_value(bound)}"}} {cum}')
+        cum += bins[-1]
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
+        out.append(f"{self.name}_sum {_fmt_value(total_sum)}")
+        out.append(f"{self.name}_count {cum}")
+        return out
+
+
 class MetricsRegistry:
     """Named path-scoped registries (the cn-infra ':9999/<path>' model).
 
@@ -202,7 +281,13 @@ class MetricsRegistry:
         """Registry-level metrics lint (tools/lint.py --metrics): every
         family name matches the project namespace, carries non-empty
         help, and no family name is registered twice (within or across
-        paths — duplicate names scrape as conflicting series)."""
+        paths — duplicate names scrape as conflicting series). Every
+        histogram-kind family (Histogram AND DeviceHistogram — the
+        native-histogram face of the device telemetry plane)
+        additionally has strictly increasing finite bucket boundaries
+        and renders a COMPLETE ``_bucket``/``_sum``/``_count`` triple
+        per label set with cumulative buckets and ``le="+Inf"`` equal
+        to ``_count`` (ISSUE 11 satellite)."""
         problems: List[str] = []
         seen: Dict[str, str] = {}
         for path, fam in self.families():
@@ -221,6 +306,80 @@ class MetricsRegistry:
                 )
             else:
                 seen[name] = path
+            if getattr(fam, "kind", "") == "histogram":
+                problems.extend(self._lint_histogram(path, fam))
+        return problems
+
+    @staticmethod
+    def _lint_histogram(path: str, fam) -> List[str]:
+        """Boundary + exposition-completeness checks of one
+        histogram-kind family (the --metrics satellite of ISSUE 11)."""
+        problems: List[str] = []
+        name = getattr(fam, "name", "?")
+        bounds = tuple(getattr(fam, "buckets", ()))
+        if not bounds:
+            problems.append(
+                f"{path}: histogram {name!r} has no bucket boundaries")
+        elif any(b2 <= b1 for b1, b2 in zip(bounds, bounds[1:])):
+            problems.append(
+                f"{path}: histogram {name!r} bucket boundaries are "
+                f"not strictly increasing: {bounds}")
+        if any(b != b or b in (float("inf"), float("-inf"))
+               for b in bounds):
+            problems.append(
+                f"{path}: histogram {name!r} has non-finite bucket "
+                f"boundary (+Inf is implicit)")
+        # render-side completeness: for every label set that exposes a
+        # _bucket series, the cumulative contract must close — last
+        # bucket is +Inf, its value equals _count, and _sum exists
+        buckets: Dict[str, List[Tuple[str, float]]] = {}
+        counts: Dict[str, float] = {}
+        sums: Dict[str, float] = {}
+        for line in fam.render():
+            if line.startswith("#"):
+                continue
+            series, _, value = line.rpartition(" ")
+            base, brace, label_s = series.partition("{")
+            label_s = label_s[:-1] if brace else ""
+            if base == f"{name}_bucket":
+                pairs = dict(LABELS_RE.findall(label_s))
+                le = pairs.pop("le", "")
+                key = ",".join(f"{k}={v}" for k, v in sorted(pairs.items()))
+                buckets.setdefault(key, []).append((le, float(value)))
+            elif base == f"{name}_count":
+                key = ",".join(
+                    f"{k}={v}" for k, v in
+                    sorted(LABELS_RE.findall(label_s)))
+                counts[key] = float(value)
+            elif base == f"{name}_sum":
+                key = ",".join(
+                    f"{k}={v}" for k, v in
+                    sorted(LABELS_RE.findall(label_s)))
+                sums[key] = float(value)
+        for key, series in buckets.items():
+            values = [v for _le, v in series]
+            if values != sorted(values):
+                problems.append(
+                    f"{path}: histogram {name!r}{{{key}}} buckets are "
+                    f"not cumulative")
+            if not series or series[-1][0] != "+Inf":
+                problems.append(
+                    f"{path}: histogram {name!r}{{{key}}} missing the "
+                    f"+Inf bucket")
+                continue
+            if key not in counts or key not in sums:
+                problems.append(
+                    f"{path}: histogram {name!r}{{{key}}} missing "
+                    f"_sum/_count series")
+            elif series[-1][1] != counts[key]:
+                problems.append(
+                    f"{path}: histogram {name!r}{{{key}}} +Inf bucket "
+                    f"{series[-1][1]} != _count {counts[key]}")
+        for key in set(counts) | set(sums):
+            if key not in buckets:
+                problems.append(
+                    f"{path}: histogram {name!r}{{{key}}} has "
+                    f"_sum/_count but no _bucket series")
         return problems
 
     def render(self, path: str) -> Optional[str]:

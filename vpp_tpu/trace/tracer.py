@@ -95,6 +95,13 @@ class PacketTracer:
         cause = np.asarray(result.drop_cause)
         established = np.asarray(result.established)
         dnat = np.asarray(result.dnat_applied)
+        # per-packet ML stage (ISSUE 10; PR-11 satellite): when the
+        # step scored this batch, render an ml-score node with the raw
+        # score (StepResult.ml_scores — zeros with the stage off) and
+        # attribute DROP_ML verdicts to their own error-drop leaf
+        ml_on = int(np.asarray(result.stats.ml_scored)) > 0
+        ml_scores = np.asarray(result.ml_scores)
+        ml_flagged = np.asarray(result.ml_flagged)
         src = np.asarray(pkts.src_ip)
         dst = np.asarray(pkts.dst_ip)
         proto = np.asarray(pkts.proto)
@@ -116,11 +123,22 @@ class PacketTracer:
                 else:
                     if established[i]:
                         path.append("session-lookup (established)")
+                    # the ML stage evaluates on the post-NAT-reverse
+                    # header, BEFORE DNAT/classify (graph._ml_eval);
+                    # its drop verdict folds after the ACL's, so the
+                    # ml-drop leaf renders below acl-classify
+                    if ml_on:
+                        path.append(
+                            "ml-score (score {}{})".format(
+                                int(ml_scores[i]),
+                                ", flagged" if ml_flagged[i] else ""))
                     if dnat[i]:
                         path.append("nat44-dnat")
                     path.append("acl-classify")
                     if c == 2:
                         path.append("error-drop (acl-deny)")
+                    elif c == 6:  # DROP_ML (deny beat it already)
+                        path.append("error-drop (ml-drop)")
                     else:
                         path.append("ip4-lookup")
                         if c == 3:
