@@ -30,11 +30,12 @@ chaos:
 	$(PY) -m pytest tests/test_chaos.py -q -m chaos
 
 # Base style pass + the pure-AST analysis passes (tools/analysis/):
-# --jax tracer/recompile hygiene, --threads lock discipline. The
+# --jax tracer/recompile hygiene, --threads lock discipline,
+# --partitions rule completeness (pure import, no jax arrays). The
 # registry passes (--metrics/--counters/--tables) import jax, so
 # tier-1 runs them from tests instead (test_exposition / test_acl_bv).
 lint:
-	$(PY) tools/lint.py --jax --threads
+	$(PY) tools/lint.py --jax --threads --partitions
 
 # Driver-facing headline benchmark (real TPU; one JSON line).
 bench:

@@ -231,7 +231,15 @@ def test_mxu_sharded_equals_dense_sharded_at_scale():
     rules = _acl_scale_rules(n_rules)
 
     def build(force_dense):
-        cluster = ClusterDataplane(mesh, cfg)
+        # pin the knob per build: the auto ladder now tops out at the
+        # word-sharded BV kernel on the mesh (ISSUE 12), so the
+        # dense-vs-MXU comparison this test exists for names its rungs.
+        # fastpath off: fresh-flow traffic never engages it, and the
+        # two-tier dispatcher would double BOTH 10k-rule program
+        # compiles for nothing
+        cluster = ClusterDataplane(
+            mesh, cfg._replace(classifier="dense" if force_dense
+                               else "mxu", fastpath=False))
         pod_if = {}
         for nid in range(2):
             node = cluster.node(nid)

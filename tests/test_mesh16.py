@@ -11,7 +11,15 @@ import os
 import subprocess
 import sys
 
+import pytest
 
+
+# slow: the subprocess re-compiles the ENTIRE multichip dry run (mesh
+# runtime, wire steps, MXU variants and the ISSUE-12 partition
+# section) at 16 devices — the driver's own multichip check already
+# runs dryrun_multichip, so tier-1 doesn't pay for the 16-device
+# doubling; `pytest -m slow tests/test_mesh16.py` runs it on demand.
+@pytest.mark.slow
 def test_dryrun_multichip_16_devices():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"

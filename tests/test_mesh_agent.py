@@ -10,6 +10,7 @@ two_node_two_pods.robot over the node_events.go VXLAN mesh).
 """
 
 import numpy as np
+import pytest
 
 from vpp_tpu.cmd import AgentConfig
 from vpp_tpu.cmd.ksr_main import KsrAgent
@@ -179,6 +180,10 @@ def test_mesh_service_nat_across_nodes():
     runtime.close()
 
 
+@pytest.mark.slow  # ~30 s: compiles a second wire-step coalesce
+# bucket on top of the fabric program — the coalesce semantics are
+# also pinned by the single-node pump suite; tier-1 keeps the other
+# mesh-agent e2e cases
 def test_cluster_pump_coalesces_backlog():
     """A pre-staged backlog of rx frames is coalesced into FEWER fabric
     steps (the VEC*MAX_FRAMES bucket) than frames — and every packet

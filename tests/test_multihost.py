@@ -18,6 +18,17 @@ import subprocess
 import sys
 import time
 
+import pytest
+
+# slow: each case boots 2 real jax.distributed worker processes and
+# compiles the cluster program per process — minutes of wall clock
+# that the tier-1 `-m 'not slow'` budget cannot absorb now that the
+# mesh suite actually RUNS on this toolchain (ISSUE 12 un-skipped it).
+# The multi-process fabric additionally needs a CPU backend with
+# cross-process collectives (newer jaxlib); `make chaos`-style full
+# runs and TPU-pod deployments exercise these.
+pytestmark = pytest.mark.slow
+
 HERE = os.path.dirname(os.path.abspath(__file__))
 
 

@@ -37,6 +37,9 @@ def frames(cl, sport=1000):
     return f
 
 
+@pytest.mark.slow  # ~15 s of collective ticks; the baseline-agreement
+# logic it pins is byte-identical in the in-process driver the other
+# (tier-1) cases here exercise
 def test_stale_stop_counter_does_not_halt_a_new_fleet():
     """A stop agreed by a PREVIOUS deployment persists in the store;
     the new fleet's driver must baseline it away — and a FRESH stop

@@ -1,0 +1,413 @@
+"""Partition-rule layer (ISSUE 12): name-regex → PartitionSpec.
+
+Two halves:
+
+* **Rule-matching units** — ordering (first match wins), anchoring,
+  the unmatched-field error (never a silent replicate), manifest
+  completeness, stale-rule findings, the divisibility validators, and
+  the ``--partitions`` lint pass tier-1 runs from here.
+* **Mesh differentials** — 2- and 4-way rule-sharded clusters running
+  the FULL selection (word-sharded BV classify, hidden/tree-sharded
+  int8 ML enforce, bucket-sharded sessions, SPMD-uniform fastpath
+  dispatch) against a standalone Dataplane with the identical config
+  on identical seeded traffic: verdicts, stats and session STATE must
+  be bit-exact, the fastpath predicate must not diverge per shard
+  under mixed traffic, and the cluster snapshot must round-trip
+  bit-identical per-shard session state (and refuse a different mesh).
+"""
+
+import importlib.util
+import pathlib
+
+import numpy as np
+import pytest
+
+from jax.sharding import PartitionSpec as P
+
+from vpp_tpu.parallel import partition as pt
+from vpp_tpu.pipeline.tables import DataplaneConfig, DataplaneTables
+
+
+# --- rule-matching units ---------------------------------------------
+
+
+def test_first_match_wins_in_order():
+    rules = (
+        pt.PartitionRule(r"^glb_bv_bnd_", P("node"), "boundaries"),
+        pt.PartitionRule(r"^glb_bv_", P("node", None, "rule"), "planes"),
+    )
+    bnd = pt.match_partition_rules("glb_bv_bnd_src", rules)
+    plane = pt.match_partition_rules("glb_bv_src", rules)
+    assert bnd.reason == "boundaries"
+    assert plane.reason == "planes"
+    # reversed order would swallow the boundary fields into the plane
+    # rule — first match wins, so order is load-bearing
+    swapped = (rules[1], rules[0])
+    assert pt.match_partition_rules(
+        "glb_bv_bnd_src", swapped).reason == "planes"
+
+
+def test_anchoring_keeps_scalars_out_of_the_bucket_grids():
+    """The session scalar fields must resolve to their explicit rules,
+    not the [NB, W] bucket-grid rule right below them."""
+    m = pt.spec_manifest()
+    assert m["sess_max_age"].spec == P(pt.NODE_AXIS)
+    assert m["sess_sweep_cursor"].spec == P(pt.NODE_AXIS)
+    assert m["natsess_sweep_cursor"].spec == P(pt.NODE_AXIS)
+    assert m["sess_valid"].spec == P(pt.NODE_AXIS, pt.RULE_AXIS)
+    assert m["natsess_valid"].spec == P(pt.NODE_AXIS, pt.RULE_AXIS)
+
+
+def test_manifest_names_every_field():
+    m = pt.spec_manifest()
+    assert set(m) == set(DataplaneTables._fields)
+    for f, entry in m.items():
+        assert entry.field == f
+        assert entry.reason  # every placement is a documented decision
+
+
+def test_unmatched_field_is_an_error_not_a_silent_replicate():
+    # a truncated rule set that misses the session grids entirely
+    rules = (pt.PartitionRule(r"^glb_", P("node", "rule"), "glb"),)
+    with pytest.raises(pt.PartitionError, match="matches no partition"):
+        for f in DataplaneTables._fields:
+            pt.spec_for(f, rules)
+
+
+def test_spec_for_unknown_name_raises():
+    with pytest.raises(pt.PartitionError,
+                       match="no_such_field_anywhere"):
+        pt.spec_for("no_such_field_anywhere",
+                    (pt.PartitionRule(r"^glb_", P("node"), "x"),))
+
+
+def test_partition_lint_flags_stale_rules(monkeypatch):
+    stale = pt.PARTITION_RULES + (
+        pt.PartitionRule(r"^zz_never_matches_", P("node"), "stale"),
+    )
+    monkeypatch.setattr(pt, "PARTITION_RULES", stale)
+    problems = pt.partition_lint()
+    assert any("zz_never_matches_" in p for p in problems)
+
+
+def test_partitions_lint_pass_green():
+    """The tier-1 hook: the shipped rule set must resolve every field
+    and carry no stale rules (tools/lint.py --partitions)."""
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    spec = importlib.util.spec_from_file_location(
+        "vppt_lint", repo / "tools" / "lint.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.partitions_lint() == []
+
+
+def test_validate_partitioning_divisibility():
+    cfg = DataplaneConfig(sess_slots=256, sess_ways=4)  # 64 buckets
+    pt.validate_partitioning(cfg, 4)   # 64 % 4 == 0
+    with pytest.raises(ValueError, match="buckets"):
+        pt.validate_partitioning(
+            cfg._replace(sess_slots=8, sess_ways=4), 4)  # 2 buckets
+    ml = cfg._replace(ml_stage="score", ml_hidden=6)
+    with pytest.raises(ValueError, match="ml_hidden"):
+        pt.validate_partitioning(ml, 4)
+    pt.validate_partitioning(ml._replace(ml_hidden=8), 4)
+    # rule_shards == 1 divides everything
+    pt.validate_partitioning(cfg._replace(sess_slots=8), 1)
+
+
+def test_bv_mesh_ok_word_alignment():
+    cfg = DataplaneConfig(classifier="bv", max_global_rules=256)
+    assert pt.bv_mesh_ok(cfg, 4)          # 256 % 128 == 0
+    assert not pt.bv_mesh_ok(cfg._replace(max_global_rules=96), 2)
+    assert pt.bv_mesh_ok(cfg._replace(max_global_rules=96), 1)
+    assert not pt.bv_mesh_ok(cfg._replace(classifier="dense"), 1)
+
+
+# --- mesh differentials ----------------------------------------------
+
+
+def _stage(node, rules, model):
+    from vpp_tpu.pipeline.vector import Disposition
+
+    node.add_uplink()
+    pod_if = node.add_pod_interface(("part", "pod"))
+    node.builder.add_route("10.1.1.2/32", pod_if, Disposition.LOCAL)
+    node.builder.set_global_table(rules)
+    if model is not None:
+        node.builder.set_ml_model(model)
+    return pod_if
+
+
+def _build_pair(shards, ml_kind="mlp", sess_slots=512):
+    """(cluster, standalone, pod_if): a 1-node x S-shard mesh and a
+    standalone Dataplane with IDENTICAL staged config. Sweep disabled:
+    the differential compares session state cell-for-cell and the
+    cluster sweeps twice per step (two pipeline passes)."""
+    import ipaddress
+
+    from vpp_tpu.ir.rule import Action, ContivRule, Protocol
+    from vpp_tpu.ml.train import train_and_pack
+    from vpp_tpu.parallel.cluster import ClusterDataplane
+    from vpp_tpu.parallel.mesh import cluster_mesh
+    from vpp_tpu.pipeline.dataplane import Dataplane
+
+    cfg = DataplaneConfig(
+        max_tables=4, max_rules=16, max_global_rules=256, max_ifaces=8,
+        fib_slots=32, sess_slots=sess_slots, nat_mappings=2,
+        nat_backends=4, classifier="bv", fastpath=True,
+        ml_stage="enforce", ml_hidden=8, ml_trees=4, ml_depth=2,
+        sess_sweep_stride=0,
+    )
+    rules = [
+        ContivRule(action=Action.DENY, protocol=Protocol.TCP,
+                   src_network=ipaddress.ip_network(f"10.9.{i}.0/24"),
+                   dest_port=9000 + i)
+        for i in range(40)
+    ] + [ContivRule(action=Action.PERMIT)]
+    model, _ = train_and_pack(kind=ml_kind, hidden=8, trees=4, depth=2,
+                              seed=7)
+    clus = ClusterDataplane(cluster_mesh(1, shards), cfg)
+    pod_if = _stage(clus.node(0), rules, model)
+    clus.swap()
+    solo = Dataplane(cfg)
+    assert _stage(solo, rules, model) == pod_if
+    solo.swap()
+    return clus, solo, pod_if
+
+
+def _mixed_frames(pod_if, seed, n=48, reverse=False):
+    rng = np.random.default_rng(seed)
+    pk = []
+    for i in range(n):
+        sport = 20000 + i
+        dport = int(rng.integers(8990, 9080))
+        src = f"10.9.{int(rng.integers(0, 64))}.{i % 200 + 1}"
+        dst = "10.1.1.2"
+        if reverse:
+            src, dst, sport, dport = dst, src, dport, sport
+        pk.append({"src": src, "dst": dst, "proto": 6, "sport": sport,
+                   "dport": dport, "rx_if": pod_if})
+    return pk
+
+
+def _assert_step_bitexact(clus, solo, pk, now, check_fastpath=None):
+    import jax
+
+    from vpp_tpu.pipeline.vector import make_packet_vector
+
+    c_res = clus.step(clus.make_frames([pk], n=64), now=now)
+    s_res = solo.process(make_packet_vector(pk, n=64), now=now)
+    jax.block_until_ready(c_res.tables.sess_valid)
+    n = len(pk)
+    for f in ("disp", "tx_if", "drop_cause"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(c_res.local, f))[0][:n],
+            np.asarray(getattr(s_res, f))[:n], err_msg=f)
+    # cluster stats sum BOTH pipeline passes; pass 2 sees no valid
+    # packets here (no REMOTE routes), so the packet-indexed counters
+    # must match the standalone single pass exactly
+    for f in ("rx", "tx", "drop_acl", "drop_no_route", "sess_hits",
+              "ml_scored", "ml_flagged", "ml_drops",
+              "sess_insert_fail"):
+        assert int(np.asarray(getattr(c_res.stats, f)).sum()) == \
+            int(np.asarray(getattr(s_res.stats, f))), f
+    for f in ("sess_valid", "sess_src", "sess_dst", "sess_ports",
+              "sess_proto", "sess_time"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(clus.tables, f))[0],
+            np.asarray(getattr(solo.tables, f)), err_msg=f)
+    if check_fastpath is not None:
+        # pass 1 carries the real dispatch; pass 2 (all-invalid) is
+        # vacuously fast — subtract it
+        fp = int(np.asarray(c_res.stats.fastpath).sum()) - 1
+        assert fp == check_fastpath, (
+            f"pass-1 fastpath {fp} != {check_fastpath}")
+        assert int(np.asarray(s_res.stats.fastpath)) == check_fastpath
+    return c_res, s_res
+
+
+def test_mesh_2way_bv_ml_sessions_bitexact():
+    """2-way differential: sharded BV classify + hidden-sharded MLP
+    enforce + bucket-sharded session insert/lookup, three steps of
+    seeded mixed traffic including repeats (refresh path) — verdicts,
+    stats and session cells bit-exact vs the standalone dataplane."""
+    clus, solo, pod_if = _build_pair(2)
+    assert clus.classifier_impl == "bv"
+    assert clus.ml_selected == "enforce"
+    fwd = _mixed_frames(pod_if, seed=1)
+    _assert_step_bitexact(clus, solo, fwd, now=1)
+    # repeat (intra-table refresh + established hits), then new flows
+    _assert_step_bitexact(clus, solo, fwd, now=2)
+    _assert_step_bitexact(clus, solo, _mixed_frames(pod_if, seed=2),
+                          now=3)
+
+
+def test_mesh_4way_bitexact_and_fastpath_uniform():
+    """4-way differential + the SPMD-uniform fastpath dispatch: mixed
+    traffic must take the full chain on EVERY shard (no divergence —
+    the step completes and matches standalone), and an all-established
+    reply batch must engage the classify-free tier on the mesh."""
+    clus, solo, pod_if = _build_pair(4, sess_slots=512)
+    assert clus.fastpath_selected
+    fwd = [p for p in _mixed_frames(pod_if, seed=3, n=32)]
+    # step 1: fresh flows — not established, full chain everywhere
+    _assert_step_bitexact(clus, solo, fwd, now=1, check_fastpath=0)
+    # step 2: the SAME packets are forward-direction repeats of
+    # installed sessions — still not reverse hits; mixed with one new
+    # flow the predicate stays down and every shard agrees
+    _assert_step_bitexact(clus, solo, fwd + _mixed_frames(
+        pod_if, seed=4, n=8), now=2, check_fastpath=0)
+    # step 3: pure REPLY traffic of the permitted flows — every valid
+    # packet rides an established session, the all-reduced predicate
+    # goes up on every shard, and the fast tier result still matches
+    # standalone bit-for-bit. Replies are synthesized from the LIVE
+    # session table (post-NAT forward keys), reversed.
+    assert np.asarray(clus.tables.sess_valid).sum() > 0
+    reply = []
+    live_src = np.asarray(clus.tables.sess_src)[0]
+    live_dst = np.asarray(clus.tables.sess_dst)[0]
+    live_ports = np.asarray(clus.tables.sess_ports)[0]
+    live_ok = np.asarray(clus.tables.sess_valid)[0] == 1
+    for b, w in zip(*np.nonzero(live_ok)):
+        sport = int(live_ports[b, w]) >> 16
+        dport = int(live_ports[b, w]) & 0xFFFF
+        reply.append({
+            "src": ".".join(str((int(live_dst[b, w]) >> s) & 255)
+                            for s in (24, 16, 8, 0)),
+            "dst": ".".join(str((int(live_src[b, w]) >> s) & 255)
+                            for s in (24, 16, 8, 0)),
+            "proto": 6, "sport": dport, "dport": sport,
+            "rx_if": pod_if,
+        })
+        if len(reply) == 24:
+            break
+    _assert_step_bitexact(clus, solo, reply, now=3, check_fastpath=1)
+
+
+@pytest.mark.slow  # the forest gates compile their own cluster+solo
+# programs (~17 s); the MLP differential above already pins the
+# psum-reduce contract, and the MULTICHIP dry run covers selection
+def test_mesh_forest_ml_tree_sharded_bitexact():
+    """The oblivious-forest kernel with the TREE axis sharded: partial
+    vote sums psum to the standalone forest score exactly."""
+    clus, solo, pod_if = _build_pair(2, ml_kind="forest")
+    assert clus._ml_kind == "forest"
+    _assert_step_bitexact(clus, solo, _mixed_frames(pod_if, seed=5),
+                          now=1)
+
+
+def test_cluster_snapshot_roundtrip_and_mesh_refusal(tmp_path):
+    """Per-shard drains into one manifest: a same-mesh restore comes
+    back bit-identical; a different rule-shard count refuses cleanly
+    (outcome counted, nothing half-restored)."""
+    from vpp_tpu.parallel.cluster import ClusterDataplane
+    from vpp_tpu.parallel.mesh import cluster_mesh
+    from vpp_tpu.pipeline.snapshot import SessionSnapshotter
+
+    clus, _solo, pod_if = _build_pair(2)
+    clus.step(clus.make_frames(
+        [_mixed_frames(pod_if, seed=6)], n=64), now=1)
+    snap = SessionSnapshotter(clus, str(tmp_path), chunk_buckets=64)
+    assert snap.snapshot() == 1
+    # chunk files never straddle a shard boundary: every entry's
+    # bucket range maps to exactly one shard
+    m = snap._load_manifest()
+    per_shard = (clus.config.sess_slots // clus.config.sess_ways) // 2
+    for tab in m["tables"].values():
+        for e in tab["chunks"]:
+            assert e["start"] // per_shard == e["shard"] or \
+                tab["chunk_buckets"] > per_shard
+
+    clus2, _solo2, _ = _build_pair(2)
+    snap2 = SessionSnapshotter(clus2, str(tmp_path), chunk_buckets=64)
+    assert snap2.restore_into()
+    for f in ("sess_valid", "sess_src", "sess_dst", "sess_ports",
+              "sess_proto"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(clus.tables, f)),
+            np.asarray(getattr(clus2.tables, f)), err_msg=f)
+
+    from vpp_tpu.pipeline.tables import DataplaneConfig as _DC  # noqa: F401
+    clus4 = ClusterDataplane(cluster_mesh(1, 4), clus.config)
+    _stage_min(clus4.node(0))
+    clus4.swap()
+    snap4 = SessionSnapshotter(clus4, str(tmp_path), chunk_buckets=64)
+    sessions, outcome = snap4.restore()
+    assert sessions is None and outcome == "geometry"
+    assert snap4.stats["restores"]["geometry"] == 1
+
+
+def _stage_min(node):
+    from vpp_tpu.ir.rule import Action, ContivRule
+    from vpp_tpu.pipeline.vector import Disposition
+
+    node.add_uplink()
+    pod_if = node.add_pod_interface(("part", "pod"))
+    node.builder.add_route("10.1.1.2/32", pod_if, Disposition.LOCAL)
+    node.builder.set_global_table([ContivRule(action=Action.PERMIT)])
+    return pod_if
+
+
+def test_incremental_upload_groups_reship_only_rebuilt_planes():
+    """The mesh swap's per-shard upload groups: a second swap with one
+    node's global-table churn re-ships the glb group (and only the
+    REBUILT BV dimension planes); everything else reuses the cached
+    sharded device arrays."""
+    import ipaddress
+
+    from vpp_tpu.ir.rule import Action, ContivRule, Protocol
+
+    clus, _solo, _pod_if = _build_pair(2)
+    first = dict(clus.upload_stats)
+    assert first["fields_reused"] == 0
+    node = clus.node(0)
+    # port-only churn: the identity-diff pack + dimension-incremental
+    # BV compile rebuild only the dport plane
+    rules = [
+        ContivRule(action=Action.DENY, protocol=Protocol.TCP,
+                   src_network=ipaddress.ip_network(f"10.9.{i}.0/24"),
+                   dest_port=9100 + i)
+        for i in range(40)
+    ] + [ContivRule(action=Action.PERMIT)]
+    with node._lock:
+        node.builder.set_global_table(rules)
+    clus.swap()
+    second = dict(clus.upload_stats)
+    assert second["fields_reused"] > 0
+    # glb dense rows re-ship; acl/if/fib/nat/ml groups must all reuse
+    total = second["fields_shipped"] + second["fields_reused"]
+    assert second["fields_shipped"] < total // 2
+    # a no-op swap re-ships nothing at all
+    clus.swap()
+    assert clus.upload_stats["fields_shipped"] == 0
+
+
+def test_partition_observability_cli_and_gauges():
+    """`show partitions` + the vpp_tpu_partition_info /
+    vpp_tpu_shard_sessions_resident gauges (collector wired via
+    set_cluster)."""
+    import types
+
+    from vpp_tpu.cli import DebugCLI
+    from vpp_tpu.stats.collector import StatsCollector
+
+    clus, _solo, pod_if = _build_pair(2)
+    clus.step(clus.make_frames(
+        [_mixed_frames(pod_if, seed=8)], n=64), now=1)
+    cli = DebugCLI(clus.node(0),
+                   mesh_runtime=types.SimpleNamespace(cluster=clus))
+    page = cli.run("show partitions")
+    assert "rule shards" in page and "classifier=bv" in page
+    assert "per-shard sessions resident" in page
+    coll = StatsCollector(clus.node(0))
+    coll.set_cluster(clus)
+    coll.publish()
+    part = coll.partition_gauge
+    assert part.get(field="glb_bv_src", axis="rule", shards="2") == 1.0
+    assert part.get(field="sess_valid", axis="rule", shards="2") == 1.0
+    assert part.get(field="fib_prefix", axis="replicated",
+                    shards="2") == 1.0
+    res0 = coll.shard_sessions_gauge.get(shard="0")
+    res1 = coll.shard_sessions_gauge.get(shard="1")
+    assert res0 + res1 > 0
+    assert coll.shard_rule_bytes_gauge.get(shard="0") > 0

@@ -297,6 +297,15 @@ def _udp_spray(ns: str, dst: str, port: int, msg: str, times: int,
     )
 
 
+# slow: ~2 min of subprocess boots, netns traffic and failover polling
+# — the single largest tier-1 line item, and the two cases are a
+# SEQUENCE (the failover case un-blocks the policy the first case
+# cut), so they move to the slow tier together. The mesh suite now
+# RUNS on this toolchain (ISSUE 12 un-skipped ~20 tests), and the
+# `-m 'not slow'` budget can't absorb both; fenced-store failover
+# stays covered in tier-1 by test_kvstore_fencing, cross-node wire by
+# test_mesh_wire_e2e/test_proxy_chain_e2e.
+@pytest.mark.slow
 class TestTwoNodeTwoPods:
     def test_cross_node_udp_then_policy_cutoff(self, cluster):
         a, b = cluster["a"], cluster["b"]

@@ -123,6 +123,19 @@ def counters_lint() -> list:
     return problems
 
 
+def partitions_lint() -> list:
+    """Partition-rule completeness pass (``--partitions``; ISSUE 12):
+    every DataplaneTables field must resolve to an explicit rule in
+    vpp_tpu/parallel/partition.py (sharded or replicated-by-design),
+    and every rule must match at least one field (stale rules are
+    findings). Pure import — no jax arrays touched. Run from tier-1
+    via tests/test_partition.py."""
+    _repo_on_path()
+    from vpp_tpu.parallel.partition import partition_lint
+
+    return partition_lint()
+
+
 def _bv_plane_problems(name: str, bv, nrules: int, max_rules: int) -> list:
     """Invariants of ONE compiled BvTable against its live rule count."""
     import numpy as np

@@ -25,6 +25,11 @@ tabs/trailing whitespace, mutable defaults) over ROOTS. Flags add:
   --counters  StepStats <-> Prometheus family parity (imports jax).
   --tables    BV classifier table invariants (imports jax; tier-1 runs
               it via tests/test_acl_bv.py).
+  --partitions partition-rule completeness (ISSUE 12): every
+              DataplaneTables field resolves to an explicit
+              vpp_tpu/parallel/partition.py rule (sharded or
+              replicated-by-design), no stale rules. Tier-1 runs it
+              via tests/test_partition.py; `make lint` includes it.
 
 Exit code 1 if anything fires. `make lint` runs the base + --jax +
 --threads (the pure-AST passes). Rule catalog + suppression syntax:
@@ -45,6 +50,7 @@ from analysis.jaxlint import jax_lint  # noqa: E402
 from analysis.registries import (  # noqa: E402  (re-exported: tier-1
     counters_lint,                 # loads lint.py by path and calls
     metrics_lint,                  # these directly)
+    partitions_lint,
     tables_lint,
 )
 from analysis.threadlint import threads_lint  # noqa: E402
@@ -82,6 +88,8 @@ def main(argv=None) -> int:
         all_problems.extend(counters_lint())
     if "--tables" in argv:
         all_problems.extend(tables_lint())
+    if "--partitions" in argv:
+        all_problems.extend(partitions_lint())
     # --jax and --threads both report bare suppressions; dedupe
     seen, unique = set(), []
     for p in all_problems:

@@ -55,6 +55,7 @@ class DebugCLI:
             ("show", "session"): self.show_session,
             ("show", "session-rules"): self.show_session_rules,
             ("show", "mesh"): self.show_mesh,
+            ("show", "partitions"): self.show_partitions,
             ("show", "nat44"): self.show_nat44,
             ("show", "fib"): self.show_fib,
             ("show", "trace"): self.show_trace,
@@ -90,6 +91,7 @@ class DebugCLI:
         return (
             "commands: show interface | show acl | show session | "
             "show sessions | show session-rules | show mesh | "
+            "show partitions | "
             "show nat44 | show fib | show trace | show errors | "
             "show fastpath | show ml | show latency | show top-flows | "
             "show io | show neighbors | "
@@ -370,6 +372,50 @@ class DebugCLI:
                 f"{ps.get('batch_errors', 0)}, pending "
                 f"{pump.has_pending() if hasattr(pump, 'has_pending') else '?'}")
         return "\n".join(lines) or "mesh runtime attached, no state"
+
+    def show_partitions(self) -> str:
+        """The partition-rule layer's resolved placements (ISSUE 12):
+        every DataplaneTables field's spec, the rule that assigned it,
+        and — on a mesh — the live selection gates plus per-shard
+        session residency. The operator answer to "what actually
+        shards, and why"."""
+        from vpp_tpu.parallel.partition import RULE_AXIS, spec_manifest
+
+        rt = self.mesh_runtime
+        cluster = getattr(rt, "cluster", None) if rt is not None else None
+        lines = []
+        if cluster is None:
+            lines.append("standalone dataplane (no mesh attached); "
+                         "canonical placements:")
+            shards = 1
+            eff = None
+        else:
+            shards = int(getattr(cluster, "rule_shards", 1))
+            lines.append(
+                f"mesh: {cluster.n_nodes} nodes x {shards} rule "
+                f"shards, epoch {cluster.epoch}")
+            lines.append(
+                "selection: classifier="
+                f"{getattr(cluster, 'classifier_impl', '?')} "
+                f"fastpath={getattr(cluster, 'fastpath_selected', '?')} "
+                f"ml={getattr(cluster, 'ml_selected', '?')}")
+            eff = getattr(cluster, "_shardings", None)
+        by_rule = {}
+        for f, entry in spec_manifest().items():
+            spec = (getattr(eff, f).spec if eff is not None
+                    else entry.spec)
+            axes = tuple(a for a in spec if a is not None)
+            key = (RULE_AXIS if RULE_AXIS in axes else "replicated",
+                   entry.pattern, entry.reason)
+            by_rule.setdefault(key, []).append(f)
+        for (axis, pattern, reason), fields in by_rule.items():
+            lines.append(f"  [{axis:>10}] {pattern}  ({len(fields)} "
+                         f"fields) — {reason}")
+        if cluster is not None and cluster.tables is not None:
+            resident = cluster.shard_sessions_resident()
+            lines.append("per-shard sessions resident: " + ", ".join(
+                f"shard {s}: {resident[s]}" for s in range(shards)))
+        return "\n".join(lines)
 
     def show_session_rules(self) -> str:
         """The `show session rules` analog: the VPPTCP renderer's

@@ -121,14 +121,14 @@ class ClusterPump:
                       "inflight": 0, "inflight_peak": 0,
                       "t_fetch_wait": 0.0, "t_fetch": 0.0,
                       # two-tier dispatch telemetry, same contract as
-                      # DataplanePump. The mesh step cannot take the
-                      # classify-free kernel yet — its rule-sharded
-                      # classify is a COLLECTIVE (pmin over RULE_AXIS),
-                      # and a per-node lax.cond around a collective is
-                      # not SPMD-uniform — so fastpath_batches stays 0
-                      # here, but the session-hit percentage (the regime
-                      # signal a later sharded dispatch would exploit)
-                      # is measured from the step's own StepStats.
+                      # DataplanePump. Since ISSUE 12 the mesh step
+                      # CAN take the classify-free kernel: the
+                      # partition layer all-reduces the per-shard
+                      # all-established flag, so the lax.cond
+                      # predicate is SPMD-uniform and the fast tier
+                      # runs under shard_map; fastpath_batches counts
+                      # fabric steps where pass 1 dispatched fast on
+                      # every node (from the step's own StepStats).
                       "fastpath_batches": 0, "fastpath_hits": 0,
                       "fastpath_alive": 0}
         self._step_lat = collections.deque(maxlen=2048)
@@ -137,9 +137,9 @@ class ClusterPump:
         # same per-batch observation contract as DataplanePump, so
         # vpp_tpu_pump_batch_seconds carries data on mesh nodes too
         self.latency_hist = None
-        # fast-tier histogram slot (set_pump parity): never observed
-        # here until the mesh step can dispatch classify-free (see the
-        # fastpath_batches comment above)
+        # fast-tier histogram slot (set_pump parity): _write observes
+        # fabric steps whose pass 1 dispatched classify-free on every
+        # node (see the fastpath_batches comment above)
         self.fastpath_hist = None
         # frames peeked by dispatch but not yet released by the writer,
         # per ring (releases shift pending peek indices, so both sides
@@ -421,12 +421,15 @@ class ClusterPump:
         tw0 = time.perf_counter()
         jax.block_until_ready((result.local, result.delivered, deliv_pay))
         tf0 = time.perf_counter()
-        # the [N] sess_hits/rx vectors ride the same fetch group (a few
-        # bytes): the regime telemetry must not add a round trip
-        res_local, res_deliv, sess_hits, step_rx = jax.device_get(
-            (result.local, result.delivered,
-             result.stats.sess_hits, result.stats.rx)
-        )
+        # the [N] sess_hits/rx/fastpath vectors ride the same fetch
+        # group (a few bytes): the regime telemetry must not add a
+        # round trip
+        res_local, res_deliv, sess_hits, step_rx, step_fp = \
+            jax.device_get(
+                (result.local, result.delivered,
+                 result.stats.sess_hits, result.stats.rx,
+                 result.fastpath_pass1)
+            )
         deliv_pay = np.asarray(jax.device_get(deliv_pay))
         tf1 = time.perf_counter()
         with self._lat_lock:
@@ -434,6 +437,13 @@ class ClusterPump:
             self.stats["t_fetch"] += tf1 - tf0
             self.stats["fastpath_hits"] += int(np.asarray(sess_hits).sum())
             self.stats["fastpath_alive"] += int(np.asarray(step_rx).sum())
+            # "a fast fabric step" = the INGRESS pass took the
+            # classify-free tier on EVERY node (ISSUE 12: the
+            # partition layer made the predicate SPMD-uniform; pass 2
+            # is excluded — an empty fabric is vacuously fast)
+            fast_step = bool(np.asarray(step_fp).min() >= 1)
+            if fast_step:
+                self.stats["fastpath_batches"] += 1
 
         # pass-1 results → ingress node's tx ring (payload: own rx slot)
         for i, node_offs in enumerate(offs):
@@ -527,6 +537,11 @@ class ClusterPump:
             self._step_lat.append(lat)
         if self.latency_hist is not None:
             self.latency_hist.observe(lat)
+        # fast-tier slice of the distribution (DataplanePump parity):
+        # only fabric steps whose ingress pass dispatched classify-free
+        # on every node observe here
+        if fast_step and self.fastpath_hist is not None:
+            self.fastpath_hist.observe(lat)
 
     def _queue_errors(self, node: int, cols, payload, n: int,
                       causes: np.ndarray) -> None:

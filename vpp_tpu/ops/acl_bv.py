@@ -32,11 +32,13 @@ Memory: ~5 × 2R × R/32 uint32 words (~105 MB at 10,240 rules) — the
 allocating (pipeline/tables.py). The verdict fold reuses
 ``assemble_global_verdict`` / the local-verdict semantics of
 vpp_tpu.ops.acl, so deny/permit/unmatched-default stays in lockstep
-with the dense oracle by construction. The multi-chip mesh keeps its
-rule-sharded dense/MXU classify: boundary arrays don't shard along
-the rule axis (a segment's bitmap covers ALL rules), so the cluster
-step is documented dense — exactly like the fastpath dispatcher
-(docs/CLASSIFIER.md).
+with the dense oracle by construction. On the multi-chip mesh the
+structure shards along the rule-WORD axis (the boundary arrays stay
+replicated — a segment's bitmap covers ALL rules, but the row packs
+them into words, and the WORD axis divides): each shard ANDs its word
+block, first-set-bits locally, and one encoded pmin recombines —
+parallel/cluster.py ``sharded_global_classify_bv`` via the
+partition-rule layer (docs/PARTITIONING.md, docs/CLASSIFIER.md).
 """
 
 from __future__ import annotations

@@ -124,24 +124,29 @@ def _centered(feats: jnp.ndarray) -> jnp.ndarray:
     return (feats.astype(jnp.int32) - 128).astype(jnp.int8)
 
 
-def _mlp_scores(tables: DataplaneTables, xc: jnp.ndarray) -> jnp.ndarray:
-    """Quantized two-layer MLP: int8 matmuls with int32 accumulation
-    (the MXU integer path on TPU), relu, shift-requant — one int32
-    score per packet."""
+def _mlp_partial(tables: DataplaneTables, xc: jnp.ndarray) -> jnp.ndarray:
+    """Quantized two-layer MLP, WITHOUT the output bias: int8 matmuls
+    with int32 accumulation (the MXU integer path on TPU), relu,
+    shift-requant — one int32 partial score per packet. Under the mesh
+    the hidden axis is sharded (partition.py): relu/requant are
+    per-hidden-unit and stay shard-local, and the layer-2 dot over the
+    LOCAL hidden columns is a partial sum one psum finishes — integer
+    adds are associative, so the sharded score is bit-exact."""
     a1 = jnp.dot(xc, tables.glb_ml_w1,
                  preferred_element_type=jnp.int32) + tables.glb_ml_b1[None, :]
     r1 = jnp.maximum(a1, 0)
     q1 = jnp.clip(jnp.right_shift(r1, tables.glb_ml_s1), 0, 255)
     q1c = (q1 - 128).astype(jnp.int8)
-    z = jnp.dot(q1c, tables.glb_ml_w2[:, None],
-                preferred_element_type=jnp.int32)[:, 0]
-    return z + tables.glb_ml_b2
+    return jnp.dot(q1c, tables.glb_ml_w2[:, None],
+                   preferred_element_type=jnp.int32)[:, 0]
 
 
-def _forest_scores(tables: DataplaneTables, xc: jnp.ndarray) -> jnp.ndarray:
-    """Oblivious decision forest: one-hot feature selection as an int8
-    matmul, per-level threshold bits → leaf index, one leaf-table
-    gather per packet. T trees of depth D vote int32 leaf values."""
+def _forest_partial(tables: DataplaneTables, xc: jnp.ndarray) -> jnp.ndarray:
+    """Oblivious decision forest, WITHOUT the output bias: one-hot
+    feature selection as an int8 matmul, per-level threshold bits →
+    leaf index, one leaf-table gather per packet. Under the mesh the
+    TREE axis is sharded: each shard votes its local trees and one
+    psum sums the forest — bit-exact like the MLP partial."""
     trees, depth = tables.glb_ml_f_feat.shape
     feat_flat = tables.glb_ml_f_feat.reshape(-1)          # [T*D]
     sel = (jnp.arange(xc.shape[1], dtype=jnp.int32)[:, None]
@@ -156,22 +161,31 @@ def _forest_scores(tables: DataplaneTables, xc: jnp.ndarray) -> jnp.ndarray:
     )                                                     # [P, T]
     votes = tables.glb_ml_f_leaf[
         jnp.arange(trees, dtype=jnp.int32)[None, :], leaf]
-    return jnp.sum(votes, axis=1) + tables.glb_ml_b2
+    return jnp.sum(votes, axis=1)
 
 
 def ml_score(tables: DataplaneTables, pkts: PacketVector,
              established: jnp.ndarray, sess_age: jnp.ndarray,
-             kind: str = "mlp") -> jnp.ndarray:
+             kind: str = "mlp", shard=None) -> jnp.ndarray:
     """Score one packet vector: int32 [P]. ``kind`` ("mlp" | "forest")
     is trace-time static — part of the step-factory key, re-gated by
     the Dataplane at every swap from the staged model's kind — so the
-    compiled program never branches on a device scalar."""
+    compiled program never branches on a device scalar. ``shard``
+    (parallel/partition.py ShardCtx) marks the weight planes as
+    hidden/tree-axis shards: the partial scores psum and the replicated
+    output bias lands exactly once."""
+    from jax import lax
+
     xc = _centered(ml_features(pkts, established, sess_age))
     # jax-ok: kind is a trace-time-static step-factory gate (a Python
     # string baked into the jit key), not a tracer branch
     if kind == "forest":
-        return _forest_scores(tables, xc)
-    return _mlp_scores(tables, xc)
+        partial = _forest_partial(tables, xc)
+    else:
+        partial = _mlp_partial(tables, xc)
+    if shard is not None:
+        partial = lax.psum(partial, shard.axis)
+    return partial + tables.glb_ml_b2
 
 
 # Stateless per-flow hash for the rate-limit admission gate: the ONE

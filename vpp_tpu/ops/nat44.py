@@ -22,7 +22,15 @@ from typing import Tuple
 
 import jax.numpy as jnp
 
-from vpp_tpu.ops.session import _hash, _pack_ports, hashmap_insert
+from vpp_tpu.ops.session import (
+    _hash,
+    _pack_ports,
+    global_buckets,
+    hashmap_insert,
+    shard_buckets,
+    shard_combine_mask,
+    shard_combine_value,
+)
 from vpp_tpu.pipeline.tables import DataplaneTables
 from vpp_tpu.pipeline.vector import PacketVector
 
@@ -157,6 +165,7 @@ def nat44_record(
     kind: jnp.ndarray,
     want: jnp.ndarray,
     now: jnp.ndarray,
+    shard=None,
 ) -> Tuple[DataplaneTables, jnp.ndarray, jnp.ndarray]:
     """Record NAT sessions for translated-and-forwarded flows.
 
@@ -184,7 +193,13 @@ def nat44_record(
         _pack_ports(pkts.dport, pkts.sport),
         pkts.proto,
     )
-    h = _hash(*key_vals, tables.natsess_valid.shape[0])
+    # sharded (bucket-axis mesh table): the global-hash +
+    # ownership-mask + psum-recombine contract of session_insert
+    h = _hash(*key_vals,
+              global_buckets(tables.natsess_valid.shape[0], shard))
+    if shard is not None:
+        own, h = shard_buckets(h, tables.natsess_valid.shape[0], shard)
+        want = want & own
     (valid, time, keys, extras, _, conflict, failed,
      ev_exp, ev_vic) = hashmap_insert(
         tables.natsess_valid,
@@ -199,6 +214,11 @@ def nat44_record(
         now,
         max_age=tables.sess_max_age,
     )
+    if shard is not None:
+        conflict = shard_combine_mask(conflict, shard)
+        failed = shard_combine_mask(failed, shard)
+        ev_exp = shard_combine_mask(ev_exp, shard)
+        ev_vic = shard_combine_mask(ev_vic, shard)
     return tables._replace(
         natsess_a=keys[0],
         natsess_b=keys[1],
@@ -219,6 +239,7 @@ def nat44_reverse(
     pkts: PacketVector,
     eligible: jnp.ndarray,
     now=None,
+    shard=None,
 ) -> Tuple[PacketVector, jnp.ndarray, jnp.ndarray]:
     """Untranslate NAT'd return traffic.
 
@@ -232,6 +253,11 @@ def nat44_reverse(
     reply *source* back to the original destination (the service VIP);
     bit 2 (SNAT'd forward) rewrites the reply *destination* back to the
     original source (the pod IP/port behind the node's SNAT address).
+
+    Sharded, the owning shard reads the payload columns and psums
+    replicate both the masks AND the rewritten header values — every
+    shard must leave this function holding the IDENTICAL packet vector,
+    or downstream per-shard stages would diverge.
     """
     n_buckets, ways = tables.natsess_valid.shape
     key_vals = (
@@ -240,44 +266,72 @@ def nat44_reverse(
         _pack_ports(pkts.sport, pkts.dport),
         pkts.proto,
     )
-    b = _hash(*key_vals, n_buckets)
+    b = _hash(*key_vals, global_buckets(n_buckets, shard))
+    if shard is not None:
+        own, bl = shard_buckets(b, n_buckets, shard)
+    else:
+        own, bl = None, b
     # Set-associative bucket fetch: ONE [P, W] row gather per column
     # (the ways are contiguous), then a first-hit argmax across ways.
-    slot_ok = tables.natsess_valid[b] == 1
+    slot_ok = tables.natsess_valid[bl] == 1
     if now is not None:
         # expired NAT state must not translate new traffic
         slot_ok = slot_ok & (
-            now - tables.natsess_time[b] <= tables.sess_max_age
+            now - tables.natsess_time[bl] <= tables.sess_max_age
         )
     for arr, val in zip(
         (tables.natsess_a, tables.natsess_b, tables.natsess_ports, tables.natsess_proto),
         key_vals,
     ):
-        slot_ok = slot_ok & (arr[b] == val[:, None])
+        slot_ok = slot_ok & (arr[bl] == val[:, None])
+    if own is not None:
+        slot_ok = slot_ok & own[:, None]
     found = jnp.any(slot_ok, axis=1)
     first = jnp.argmax(slot_ok, axis=1)
-    hit_idx = b * ways + first  # flat (bucket*W + way), for nat44_touch
-    hb, hw = hit_idx // ways, hit_idx % ways
+    hit_idx = b * ways + first  # flat GLOBAL (bucket*W + way)
+    hb, hw = bl, first          # local row for the payload gathers
     applied = found & eligible
     kind = jnp.where(applied, tables.natsess_kind[hb, hw], 0)
+    orig_ip = tables.natsess_orig_ip[hb, hw]
+    orig_port = tables.natsess_orig_port[hb, hw]
+    src_ip = tables.natsess_src_ip[hb, hw]
+    sport = tables.natsess_sport[hb, hw]
+    if shard is not None:
+        # replicate the owner's reads: non-owners hold applied=False
+        # rows, so the psums reproduce the owning shard's values and
+        # every shard rewrites identically
+        hit_idx = shard_combine_value(hit_idx, found, shard)
+        kind = shard_combine_value(kind, applied, shard)
+        orig_ip = shard_combine_value(orig_ip, applied, shard)
+        orig_port = shard_combine_value(orig_port, applied, shard)
+        src_ip = shard_combine_value(src_ip, applied, shard)
+        sport = shard_combine_value(sport, applied, shard)
+        applied = shard_combine_mask(applied, shard)
     undo_dnat = (kind & 1) != 0
     undo_snat = (kind & 2) != 0
     out = pkts._replace(
-        src_ip=jnp.where(undo_dnat, tables.natsess_orig_ip[hb, hw], pkts.src_ip),
-        sport=jnp.where(undo_dnat, tables.natsess_orig_port[hb, hw], pkts.sport),
-        dst_ip=jnp.where(undo_snat, tables.natsess_src_ip[hb, hw], pkts.dst_ip),
-        dport=jnp.where(undo_snat, tables.natsess_sport[hb, hw], pkts.dport),
+        src_ip=jnp.where(undo_dnat, orig_ip, pkts.src_ip),
+        sport=jnp.where(undo_dnat, orig_port, pkts.sport),
+        dst_ip=jnp.where(undo_snat, src_ip, pkts.dst_ip),
+        dport=jnp.where(undo_snat, sport, pkts.dport),
     )
     return out, applied, hit_idx
 
 
 def nat44_touch(
-    tables: DataplaneTables, hit_idx: jnp.ndarray, mask: jnp.ndarray, now
+    tables: DataplaneTables, hit_idx: jnp.ndarray, mask: jnp.ndarray, now,
+    shard=None
 ) -> DataplaneTables:
     """Refresh natsess_time for sessions hit by reply traffic — an
     active NAT'd flow must not expire while its replies still flow.
-    ``hit_idx`` is flat (bucket·W + way, nat44_reverse)."""
+    ``hit_idx`` is flat (bucket·W + way, nat44_reverse — GLOBAL in
+    both modes; sharded, only the owning shard scatters)."""
+    from vpp_tpu.ops.session import _shard_flat_slot
+
     n_buckets, ways = tables.natsess_valid.shape
+    if shard is not None:
+        mask, hit_idx = _shard_flat_slot(hit_idx, mask, n_buckets, ways,
+                                         shard)
     widx = jnp.where(mask, hit_idx, n_buckets * ways)
     return tables._replace(
         natsess_time=tables.natsess_time.at[widx // ways, widx % ways].set(

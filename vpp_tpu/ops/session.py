@@ -127,6 +127,71 @@ def _pack_ports(sport: jnp.ndarray, dport: jnp.ndarray) -> jnp.ndarray:
     return (sport.astype(jnp.uint32) << 16) | dport.astype(jnp.uint32)
 
 
+# --- bucket-axis sharding (ISSUE 12; vpp_tpu/parallel/partition.py) ---
+#
+# Under the mesh, each session column is the LOCAL bucket-range shard
+# of the node's grid ([NB/S, W] inside shard_map). Bit-exactness vs the
+# standalone table comes from hashing against the GLOBAL bucket count
+# (local_buckets * shards) and masking to the shard's contiguous
+# ownership range: every flow lands in the same global bucket it would
+# standalone, exactly one shard owns it, and the per-packet outcomes
+# (hit, insert, conflict, eviction) are recombined with one psum —
+# sound because a non-owning shard contributes exactly zero. Elections
+# stay shard-local and bit-exact: packets only ever contend within one
+# bucket, and a bucket's full contender set lives on its owning shard.
+
+
+def global_buckets(n_local: int, shard) -> int:
+    """GLOBAL bucket count of a (possibly sharded) grid — the hash
+    modulus that keeps sharded bucket assignment identical to the
+    standalone table."""
+    return n_local * (shard.shards if shard is not None else 1)
+
+
+def shard_buckets(h_global: jnp.ndarray, n_local: int, shard):
+    """(own [P] bool, local_bucket [P]) of globally-hashed buckets on
+    this shard. Ownership is blocked: shard s owns global buckets
+    [s·n_local, (s+1)·n_local), so the local row is the low bits
+    (n_local is a power of two) and ownership is the high bits."""
+    from jax import lax
+
+    idx = lax.axis_index(shard.axis).astype(jnp.int32)
+    own = (h_global // n_local) == idx
+    return own, h_global & jnp.int32(n_local - 1)
+
+
+def _shard_sum(x: jnp.ndarray, shard) -> jnp.ndarray:
+    from jax import lax
+
+    return lax.psum(x, shard.axis)
+
+
+def shard_combine_mask(mask: jnp.ndarray, shard) -> jnp.ndarray:
+    """Recombine per-shard ownership-masked bool masks: exactly one
+    shard can assert a packet, so psum of the int form is 0/1."""
+    if shard is None:
+        return mask
+    return _shard_sum(mask.astype(jnp.int32), shard) > 0
+
+
+def shard_combine_value(val: jnp.ndarray, mask: jnp.ndarray, shard):
+    """Recombine per-shard values defined only on the owning shard
+    (``mask``): non-owners contribute 0, so psum reproduces the owning
+    shard's value exactly."""
+    if shard is None:
+        return val
+    return _shard_sum(jnp.where(mask, val, jnp.zeros_like(val)), shard)
+
+
+def _shard_flat_slot(hit_idx: jnp.ndarray, mask: jnp.ndarray,
+                     n_local: int, ways: int, shard):
+    """Translate a GLOBAL flat slot index (bucket·W + way) into this
+    shard's ownership mask + LOCAL flat index (for touch scatters)."""
+    bucket_g = hit_idx // ways
+    own, local_b = shard_buckets(bucket_g, n_local, shard)
+    return mask & own, local_b * ways + hit_idx % ways
+
+
 def session_lookup_reverse(
     tables: DataplaneTables, pkts: PacketVector, now=None
 ) -> jnp.ndarray:
@@ -162,34 +227,51 @@ def session_lookup_reverse(
 
 
 def session_lookup_reverse_idx(
-    tables: DataplaneTables, pkts: PacketVector, now
+    tables: DataplaneTables, pkts: PacketVector, now, shard=None
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Like session_lookup_reverse, but also returns the matched FLAT
     slot index [P] (bucket·W + way; undefined where not found) so the
     pipeline can refresh ``sess_time`` — active flows must not expire
-    mid-flow."""
+    mid-flow.
+
+    With ``shard`` (the bucket-sharded mesh table), the hash targets
+    the GLOBAL bucket space, each shard probes only buckets it owns and
+    one psum recombines: ``found``/``hit_idx`` come back replicated and
+    identical to the standalone lookup, with ``hit_idx`` staying the
+    GLOBAL flat index (the touch path re-derives local ownership)."""
     n_buckets, ways = tables.sess_valid.shape
     key_src = pkts.dst_ip
     key_dst = pkts.src_ip
     key_ports = _pack_ports(pkts.dport, pkts.sport)
     key_proto = pkts.proto
-    b = _hash(key_src, key_dst, key_ports, key_proto, n_buckets)
+    b = _hash(key_src, key_dst, key_ports, key_proto,
+              global_buckets(n_buckets, shard))
+    if shard is not None:
+        own, bl = shard_buckets(b, n_buckets, shard)
+    else:
+        own, bl = None, b
     slot_match = (
-        (tables.sess_valid[b] == 1)
-        & (tables.sess_src[b] == key_src[:, None])
-        & (tables.sess_dst[b] == key_dst[:, None])
-        & (tables.sess_ports[b] == key_ports[:, None])
-        & (tables.sess_proto[b] == key_proto[:, None])
-        & (now - tables.sess_time[b] <= tables.sess_max_age)
+        (tables.sess_valid[bl] == 1)
+        & (tables.sess_src[bl] == key_src[:, None])
+        & (tables.sess_dst[bl] == key_dst[:, None])
+        & (tables.sess_ports[bl] == key_ports[:, None])
+        & (tables.sess_proto[bl] == key_proto[:, None])
+        & (now - tables.sess_time[bl] <= tables.sess_max_age)
     )
+    if own is not None:
+        slot_match = slot_match & own[:, None]
     found = jnp.any(slot_match, axis=1)
     first = jnp.argmax(slot_match, axis=1)
-    hit_idx = b * ways + first
+    hit_idx = b * ways + first  # GLOBAL flat index in both modes
+    if shard is not None:
+        hit_idx = shard_combine_value(hit_idx, found, shard)
+        found = shard_combine_mask(found, shard)
     return found, hit_idx
 
 
 def session_batch_summary(
-    tables: DataplaneTables, pkts: PacketVector, alive: jnp.ndarray, now
+    tables: DataplaneTables, pkts: PacketVector, alive: jnp.ndarray, now,
+    shard=None
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Batched hit summary for the two-tier fast/slow dispatch
     (pipeline/graph.py pipeline_step_auto): one reverse lookup yields
@@ -199,32 +281,53 @@ def session_batch_summary(
     predicate — EVERY alive packet rides an established session, so the
     classify-free fast kernel is bit-exact for the whole vector. A
     batch with no alive packets is vacuously all-hit (the fast kernel
-    is a no-op on it, exactly like the full chain)."""
-    found, hit_idx = session_lookup_reverse_idx(tables, pkts, now)
+    is a no-op on it, exactly like the full chain).
+
+    Sharded, the psum inside the lookup already makes ``hits``
+    replicated across the rule axis, so ``all_hit`` is SPMD-uniform by
+    construction; the caller (pipeline_step_auto) additionally pmins
+    the flag so the lax.cond dispatch provably can't diverge."""
+    found, hit_idx = session_lookup_reverse_idx(tables, pkts, now,
+                                                shard=shard)
     hits = found & alive
     all_hit = jnp.all(hits == alive)
     return hits, hit_idx, all_hit
 
 
 def session_hit_age(
-    tables: DataplaneTables, hit_idx: jnp.ndarray, mask: jnp.ndarray, now
+    tables: DataplaneTables, hit_idx: jnp.ndarray, mask: jnp.ndarray, now,
+    shard=None
 ) -> jnp.ndarray:
     """Ticks since the matched session's last hit, per packet (int32
     [P]; 0 where ``mask`` is False). Read BEFORE session_touch — the
     touch resets the timestamp to ``now``. One flat gather; feeds the
-    ML stage's session-age feature (ops/mlscore.py)."""
+    ML stage's session-age feature (ops/mlscore.py). Sharded, the
+    owning shard gathers and a psum replicates the timestamp — masked
+    packets read 0 from every shard, exactly like standalone."""
     n_buckets, ways = tables.sess_valid.shape
+    if shard is not None:
+        own_mask, local = _shard_flat_slot(hit_idx, mask, n_buckets,
+                                           ways, shard)
+        safe = jnp.clip(local, 0, n_buckets * ways - 1)
+        t = shard_combine_value(
+            tables.sess_time.reshape(-1)[safe], own_mask, shard)
+        return jnp.where(mask, now - t, 0).astype(jnp.int32)
     safe = jnp.clip(hit_idx, 0, n_buckets * ways - 1)
     t = tables.sess_time.reshape(-1)[safe]
     return jnp.where(mask, now - t, 0).astype(jnp.int32)
 
 
 def session_touch(
-    tables: DataplaneTables, hit_idx: jnp.ndarray, mask: jnp.ndarray, now
+    tables: DataplaneTables, hit_idx: jnp.ndarray, mask: jnp.ndarray, now,
+    shard=None
 ) -> DataplaneTables:
     """Refresh sess_time for matched sessions (keepalive on traffic).
-    ``hit_idx`` is flat (bucket·W + way, session_lookup_reverse_idx)."""
+    ``hit_idx`` is flat (bucket·W + way, session_lookup_reverse_idx —
+    GLOBAL in both modes; sharded, only the owning shard scatters)."""
     n_buckets, ways = tables.sess_valid.shape
+    if shard is not None:
+        mask, hit_idx = _shard_flat_slot(hit_idx, mask, n_buckets, ways,
+                                         shard)
     widx = jnp.where(mask, hit_idx, n_buckets * ways)
     return tables._replace(
         sess_time=tables.sess_time.at[widx // ways, widx % ways].set(
@@ -533,6 +636,7 @@ def session_insert(
     pkts: PacketVector,
     want: jnp.ndarray,
     now: jnp.ndarray,
+    shard=None,
 ) -> tuple:
     """Insert forward 5-tuples of ``want`` packets; returns
     (tables, inserted, failed, evict_expired, evict_victim).
@@ -544,6 +648,13 @@ def session_insert(
     the flow retries on its next packet, and the caller counts the
     event (StepStats.sess_insert_fail → Prometheus) instead of
     degrading silently.
+
+    Sharded, each shard elects and scatters ONLY the packets whose
+    global bucket it owns: a bucket's full contender set lives on its
+    owning shard, so the election (reps, leaders, ranks, way
+    priorities) sees exactly the standalone contender set and the
+    per-packet outcomes are bit-identical; one psum recombines the
+    ownership-masked result masks.
     """
     key_vals = (
         pkts.src_ip,
@@ -551,7 +662,10 @@ def session_insert(
         _pack_ports(pkts.sport, pkts.dport),
         pkts.proto,
     )
-    h = _hash(*key_vals, tables.sess_valid.shape[0])
+    h = _hash(*key_vals, global_buckets(tables.sess_valid.shape[0], shard))
+    if shard is not None:
+        own, h = shard_buckets(h, tables.sess_valid.shape[0], shard)
+        want = want & own
     (valid, time, keys, _, inserted, _, failed,
      ev_exp, ev_vic) = hashmap_insert(
         tables.sess_valid,
@@ -565,6 +679,11 @@ def session_insert(
         now,
         max_age=tables.sess_max_age,
     )
+    if shard is not None:
+        inserted = shard_combine_mask(inserted, shard)
+        failed = shard_combine_mask(failed, shard)
+        ev_exp = shard_combine_mask(ev_exp, shard)
+        ev_vic = shard_combine_mask(ev_vic, shard)
     new_tables = tables._replace(
         sess_src=keys[0],
         sess_dst=keys[1],

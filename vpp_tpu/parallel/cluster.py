@@ -21,6 +21,7 @@ matching the two vswitch hops a packet crosses in the reference.
 
 from __future__ import annotations
 
+import functools
 import threading
 import time as _time
 from typing import List, NamedTuple, Optional, Sequence
@@ -38,19 +39,26 @@ from vpp_tpu.ops.acl import (
     acl_encode_shard,
     assemble_global_verdict,
 )
-from vpp_tpu.parallel.mesh import (
+from vpp_tpu.parallel.partition import (
     NODE_AXIS,
     RULE_AXIS,
-    table_shardings,
+    ShardCtx,
+    agree_ml,
+    bv_mesh_ok,
+    select_impl,
+    shard_map,
     table_specs,
+    validate_partitioning,
 )
 from vpp_tpu.pipeline.dataplane import Dataplane
 from vpp_tpu.pipeline.graph import (
     SWEEP_STRIDE_DEFAULT,
     StepStats,
     pipeline_step,
+    pipeline_step_auto,
 )
 from vpp_tpu.pipeline.tables import (
+    _UPLOAD_GROUPS,
     SESSION_FIELDS,
     TELEMETRY_FIELDS,
     DataplaneConfig,
@@ -64,6 +72,40 @@ from vpp_tpu.pipeline.vector import (
     PacketVector,
     make_packet_vector,
 )
+
+
+@functools.lru_cache(maxsize=None)
+def mesh_table_specs(bv_sharded: bool = True,
+                     ml_sharded: bool = True) -> DataplaneTables:
+    """The partition layer's spec tree, adjusted for THIS mesh's
+    degraded axes: when the BV word axis can't shard (rule capacity not
+    divisible by 32·shards — ``partition.bv_mesh_ok``) the glb_bv_*
+    planes fall back to replicated (and the selection ladder never
+    picks BV), and when the ML stage is off the placeholder-shaped
+    glb_ml_* planes replicate (the stage is compiled out, the
+    placeholders are never read). Both downgrades are observable
+    (``show partitions`` prints the effective spec), never silent
+    semantics changes — the session grids and dense/MXU rule rows have
+    hard divisibility validation instead (``validate_partitioning``)."""
+    specs = table_specs()._asdict()
+    if not bv_sharded:
+        for f in specs:
+            if f.startswith("glb_bv_"):
+                specs[f] = P(NODE_AXIS)
+    if not ml_sharded:
+        for f in specs:
+            if f.startswith("glb_ml_"):
+                specs[f] = P(NODE_AXIS)
+    return DataplaneTables(**specs)
+
+
+def mesh_table_shardings(mesh: Mesh, bv_sharded: bool = True,
+                         ml_sharded: bool = True) -> DataplaneTables:
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        mesh_table_specs(bv_sharded, ml_sharded),
+        is_leaf=lambda x: isinstance(x, P),
+    )
 
 
 class NodeTx(NamedTuple):
@@ -89,6 +131,13 @@ class ClusterStepResult(NamedTuple):
     fabric_sent: jnp.ndarray      # int32 [N]: packets actually handed to
                                   # the fabric (utilization numerator;
                                   # capacity = n_nodes * budget)
+    fastpath_pass1: jnp.ndarray   # int32 [N]: 1 when the INGRESS pass
+                                  # dispatched the classify-free fast
+                                  # tier (stats.fastpath sums both
+                                  # passes, and the empty-fabric pass 2
+                                  # is vacuously fast — the pump's
+                                  # "fast fabric step" telemetry needs
+                                  # pass 1 alone; ISSUE 12)
 
 
 def sharded_global_classify(tables: DataplaneTables, pkts: PacketVector) -> AclVerdict:
@@ -156,13 +205,71 @@ def sharded_global_classify_mxu(
     )
 
 
+def sharded_global_classify_bv(
+    tables: DataplaneTables, pkts: PacketVector
+) -> AclVerdict:
+    """Global-ACL classify on the BV interval-bitmap kernel with the
+    rule-WORD axis sharded over RULE_AXIS (ISSUE 12 — the kernel the
+    pre-partition mesh excluded wholesale).
+
+    The boundary arrays and segment indices are replicated (a
+    segment's bitmap row spans all rules, which is exactly why the
+    ROW axis never sharded); what shards is the uint32 WORD axis the
+    row packs the rules into: each chip gathers its word block, ANDs
+    the five planes, and first-set-bits LOCALLY — yielding the lowest
+    matching rule within its 32·W_shard-rule window — then one encoded
+    ``pmin`` over the rule axis picks the cluster-wide first match
+    (min by absolute rule index), exactly the dense/MXU recombination.
+    The deny bit resolves from the shard's own ``glb_action`` row
+    block: ``partition.bv_mesh_ok`` guarantees the word shard and the
+    action-row shard cover the SAME absolute rule window
+    (max_global_rules % 32·shards == 0). Must run inside shard_map
+    with the ``rule`` axis bound.
+    """
+    from vpp_tpu.ops.acl_bv import bv_first_match
+
+    shard_words = tables.glb_bv_src.shape[1]
+    base = lax.axis_index(RULE_AXIS).astype(jnp.int32) * (shard_words * 32)
+    matched, rule = bv_first_match(
+        tables.glb_bv_bnd_src, tables.glb_bv_bnd_dst,
+        tables.glb_bv_bnd_sport, tables.glb_bv_bnd_dport,
+        tables.glb_bv_nbnd,
+        tables.glb_bv_src, tables.glb_bv_dst,
+        tables.glb_bv_sport, tables.glb_bv_dport, tables.glb_bv_proto,
+        pkts,
+    )
+    # deny from the column-aligned local action rows (rule < 32·W_shard
+    # == rows per action shard, by the bv_mesh_ok alignment guarantee)
+    safe = jnp.clip(jnp.where(matched, rule, 0), 0,
+                    tables.glb_action.shape[0] - 1)
+    deny = tables.glb_action[safe] != 1
+    enc = jnp.where(
+        matched, ((base + rule) << 1) | deny, jnp.int32(ENC_NO_MATCH)
+    )
+    enc = lax.pmin(enc, RULE_AXIS)
+    matched = enc != ENC_NO_MATCH
+    return assemble_global_verdict(
+        tables, pkts, matched, (enc & 1) == 0, enc >> 1
+    )
+
+
+# impl name -> the rule-sharded global classify of the cluster step
+# (the mesh analog of graph._classifier_fns)
+_SHARDED_GLOBAL_FNS = {
+    "dense": sharded_global_classify,
+    "mxu": sharded_global_classify_mxu,
+    "bv": sharded_global_classify_bv,
+}
+
+
 def _pv_spec() -> PacketVector:
     return PacketVector(*([P(NODE_AXIS)] * len(PacketVector._fields)))
 
 
 def make_cluster_step_wire(mesh: Mesh, budget: int = 0,
                            mxu: bool = False,
-                           sweep_stride: int = SWEEP_STRIDE_DEFAULT):
+                           sweep_stride: int = SWEEP_STRIDE_DEFAULT,
+                           **gates):
     """The cluster step for REAL wire traffic: headers AND payload
     bytes cross the fabric. Signature: (tables, pkts, payload, now,
     uplink_if) → (ClusterStepResult, delivered_payload), where
@@ -182,12 +289,18 @@ def make_cluster_step_wire(mesh: Mesh, budget: int = 0,
     """
     return make_cluster_step(mesh, budget=budget, mxu=mxu,
                              with_payload=True,
-                             sweep_stride=sweep_stride)
+                             sweep_stride=sweep_stride, **gates)
 
 
+@functools.lru_cache(maxsize=None)
 def make_cluster_step(mesh: Mesh, budget: int = 0, mxu: bool = False,
                       with_payload: bool = False,
-                      sweep_stride: int = SWEEP_STRIDE_DEFAULT):
+                      sweep_stride: int = SWEEP_STRIDE_DEFAULT,
+                      impl: Optional[str] = None,
+                      fast: bool = False,
+                      ml_mode: str = "off", ml_kind: str = "mlp",
+                      bv_sharded: bool = False,
+                      ml_sharded: Optional[bool] = None):
     """Build the jitted cluster step for ``mesh``.
 
     Signature: (tables, pkts, now, uplink_if) → ClusterStepResult, where
@@ -204,12 +317,49 @@ def make_cluster_step(mesh: Mesh, budget: int = 0, mxu: bool = False,
     N·B). 0 = P (dense layout, no compaction loss; fine at small N).
     VERDICT r1 Weak #6.
 
-    ``mxu=True`` classifies the global table on the rule-sharded MXU
-    bit-plane kernel instead of the dense rule-sharded compare (both
-    recombine shard verdicts with the same encoded pmin).
-    """
+    ``impl`` picks the rule-sharded global classify ("dense" | "mxu" |
+    "bv" — the partition layer's kernels; ``mxu=True`` is the legacy
+    spelling of impl="mxu"); ``fast`` compiles the two-tier
+    established-flow dispatch (SPMD-uniform predicate —
+    pipeline_step_auto); ``ml_mode``/``ml_kind`` the per-packet ML
+    stage on hidden/tree-sharded weight planes; ``bv_sharded`` whether
+    the glb_bv_* planes ride word-sharded in_specs (partition.
+    bv_mesh_ok — False keeps them replicated and impl must not be
+    "bv"). All are trace-time static and part of the memo key: equal
+    gates share ONE jitted program process-wide (the make_pipeline_step
+    discipline — a fresh closure per ClusterDataplane instance would
+    recompile the mesh program per test)."""
     n_nodes = mesh.shape[NODE_AXIS]
-    global_fn = sharded_global_classify_mxu if mxu else sharded_global_classify
+    rule_shards = mesh.shape[RULE_AXIS]
+    if impl is None:
+        impl = "mxu" if mxu else "dense"
+    if impl == "bv" and not bv_sharded:
+        raise ValueError(
+            "impl='bv' requires word-sharded BV planes (bv_sharded)")
+    global_fn = _SHARDED_GLOBAL_FNS[impl]
+    # BV swaps the LOCAL classify too (graph._classifier_fns parity:
+    # the local tables are replicated along the rule axis, so the
+    # single-node BV local kernel runs unchanged inside shard_map)
+    if impl == "bv":
+        from vpp_tpu.ops.acl_bv import acl_classify_local_bv as local_fn
+    else:
+        from vpp_tpu.ops.acl import acl_classify_local as local_fn
+    # ml_sharded is the PLACEMENT of the glb_ml_* planes (the cluster
+    # shards them whenever its config enables the stage — even before
+    # a model is staged and the selection still gates ml_mode off), so
+    # the in_specs always match the arrays' actual sharding and no
+    # step ever pays a silent reshard. Default follows ml_mode for
+    # direct callers.
+    if ml_sharded is None:
+        ml_sharded = ml_mode != "off"
+    shard = ShardCtx(RULE_AXIS, rule_shards)
+    base_step = pipeline_step_auto if fast else pipeline_step
+
+    def node_step(t, p, now, uplink=None):
+        return base_step(t, p, now, acl_global_fn=global_fn,
+                         acl_local_fn=local_fn,
+                         sweep_stride=sweep_stride,
+                         ml_mode=ml_mode, ml_kind=ml_kind, shard=shard)
 
     def body(tables, pkts, now, uplink_if, payload=None):
         t = jax.tree.map(lambda a: a[0], tables)
@@ -220,8 +370,7 @@ def make_cluster_step(mesh: Mesh, budget: int = 0, mxu: bool = False,
         B = budget if budget > 0 else n_pkts
 
         # Pass 1: the ingress node's full pipeline.
-        res1 = pipeline_step(t, p, now, acl_global_fn=global_fn,
-                             sweep_stride=sweep_stride)
+        res1 = node_step(t, p, now)
 
         # Fabric exchange: compact packets into per-destination budgeted
         # rows, swap rows across the node axis (each row rides a distinct
@@ -282,10 +431,7 @@ def make_cluster_step(mesh: Mesh, budget: int = 0, mxu: bool = False,
         )
 
         # Pass 2: delivery at the destination node.
-        res2 = pipeline_step(
-            res1.tables, flat, now, acl_global_fn=global_fn,
-            sweep_stride=sweep_stride,
-        )
+        res2 = node_step(res1.tables, flat, now)
 
         stats = jax.tree.map(lambda a, b: a + b, res1.stats, res2.stats)
         out = ClusterStepResult(
@@ -298,6 +444,7 @@ def make_cluster_step(mesh: Mesh, budget: int = 0, mxu: bool = False,
             stats=stats,
             fabric_overflow=overflow,
             fabric_sent=sent,
+            fastpath_pass1=res1.stats.fastpath,
         )
         if pay is not None:
             return jax.tree.map(lambda a: a[None], (out, deliv_pay))
@@ -308,27 +455,29 @@ def make_cluster_step(mesh: Mesh, budget: int = 0, mxu: bool = False,
         node_id=P(NODE_AXIS), next_hop=P(NODE_AXIS),
         drop_cause=P(NODE_AXIS),
     )
+    t_specs = mesh_table_specs(bv_sharded, ml_sharded)
     out_specs = ClusterStepResult(
         local=tx_spec,
         delivered=tx_spec,
-        tables=table_specs(),
+        tables=t_specs,
         stats=StepStats(*([P(NODE_AXIS)] * len(StepStats._fields))),
         fabric_overflow=P(NODE_AXIS),
         fabric_sent=P(NODE_AXIS),
+        fastpath_pass1=P(NODE_AXIS),
     )
     if with_payload:
         def body_wire(tables, pkts, payload, now, uplink_if):
             return body(tables, pkts, now, uplink_if, payload=payload)
 
-        in_specs = (table_specs(), _pv_spec(), P(NODE_AXIS), P(),
+        in_specs = (t_specs, _pv_spec(), P(NODE_AXIS), P(),
                     P(NODE_AXIS))
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             body_wire, mesh=mesh, in_specs=in_specs,
             out_specs=(out_specs, P(NODE_AXIS)),
         ))
-    in_specs = (table_specs(), _pv_spec(), P(), P(NODE_AXIS))
+    in_specs = (t_specs, _pv_spec(), P(), P(NODE_AXIS))
     return jax.jit(
-        jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+        shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
     )
 
 
@@ -349,15 +498,16 @@ class ClusterDataplane:
 
     def __init__(self, mesh: Mesh, config: Optional[DataplaneConfig] = None):
         self.mesh = mesh
-        # The cluster classify is rule-sharded dense/MXU (module doc of
-        # ops/acl_bv.py: interval bitmaps don't shard along the rule
-        # axis), so node builders never compile the BV structure —
-        # pinning the knob keeps the node-stacked BV pytree fields at
-        # their minimal placeholder shapes instead of ~100 MB per node.
-        self.config = (config or DataplaneConfig())._replace(
-            classifier="dense")
+        # The node configs are NOT pinned dense anymore (ISSUE 12):
+        # the partition-rule layer shards the BV word planes, the ML
+        # hidden/tree planes and the session bucket grids along the
+        # rule axis, so every single-chip classifier/fastpath/ML win
+        # serves the mesh through the same selection ladder the
+        # standalone Dataplane runs (docs/PARTITIONING.md).
+        self.config = config or DataplaneConfig()
         self.n_nodes = mesh.shape[NODE_AXIS]
         rule_shards = mesh.shape[RULE_AXIS]
+        self.rule_shards = rule_shards
         from vpp_tpu.ops.acl_mxu import mxu_rule_capacity
 
         for name, dim in (
@@ -368,6 +518,22 @@ class ClusterDataplane:
                 raise ValueError(
                     f"{name} {dim} not divisible by rule shards {rule_shards}"
                 )
+        # session/NAT bucket grids and (when the stage is on) the ML
+        # hidden/tree axes must divide — fail FAST with a clear error
+        validate_partitioning(self.config, rule_shards)
+        # BV degrades instead: a rule capacity whose word axis can't
+        # shard keeps the planes replicated and the ladder off BV —
+        # unless the operator EXPLICITLY asked for bv, which deserves a
+        # loud refusal, not a silent dense fallback
+        self._bv_sharded = bv_mesh_ok(self.config, rule_shards)
+        if (getattr(self.config, "classifier", "auto") == "bv"
+                and rule_shards > 1 and not self._bv_sharded):
+            raise ValueError(
+                f"classifier=bv on a {rule_shards}-way rule-sharded mesh "
+                f"requires max_global_rules ({self.config.max_global_rules}) "
+                f"divisible by {32 * rule_shards} (32·shards) so the "
+                "bitmap word shards align with the action-row shards")
+        self._ml_sharded = getattr(self.config, "ml_stage", "off") != "off"
         self._lock = threading.RLock()
         self.nodes: List[Dataplane] = [
             Dataplane(self.config, materialize=False) for _ in range(self.n_nodes)
@@ -394,26 +560,117 @@ class ClusterDataplane:
         self._sweep_stride = int(
             getattr(self.config, "sess_sweep_stride",
                     SWEEP_STRIDE_DEFAULT))
-        self._step = make_cluster_step(
-            mesh, sweep_stride=self._sweep_stride)
-        self._step_mxu = make_cluster_step(
-            mesh, mxu=True, sweep_stride=self._sweep_stride)
-        # wire-traffic steps (headers + payload bytes through the
-        # fabric), built lazily per mxu mode — the jit specializes per
-        # payload shape itself; see step_wire()
-        self._wire_steps = {}
-        # Flipped at swap(): when every node's global table compiles to
-        # bit-planes (no range rules) and at least one is large enough
-        # to pay for the bit-plane explode, the cluster classifies on
-        # the rule-sharded MXU kernel (VERDICT r3 Missing #2). One jitted
-        # program serves all nodes, so the choice is cluster-wide.
-        self._use_mxu = False
+        # Selection state, flipped at swap() exactly like the
+        # single-node Dataplane._refresh_selection: the classifier
+        # ladder (bv >= bv_min_rules > mxu >= mxu_threshold > dense,
+        # honoring explicit knobs), the two-tier fastpath engagement
+        # and the ML stage gates. One jitted program serves all nodes,
+        # so every choice is cluster-wide; the jitted step variants
+        # come from the MEMOIZED make_cluster_step factory, so equal
+        # gates share one compile process-wide.
+        self._impl = "dense"
+        self._use_mxu = False          # legacy view (impl == "mxu")
+        self._use_fast = False
+        self._ml_mode = "off"
+        self._ml_kind = "mlp"
         self.mxu_threshold = 512
-        self._shardings = table_shardings(mesh)
+        self.bv_min_rules = int(
+            getattr(self.config, "classifier_bv_min_rules", 1024))
+        # incremental per-shard upload groups (ISSUE 12 satellite): the
+        # stacked+sharded device array of every clean upload group is
+        # reused across swaps — only fields of groups some node's
+        # builder actually dirtied (and, for glb_bv, only the planes
+        # compile_bv actually REBUILT) re-ship. Mirrors
+        # TableBuilder.to_device for the mesh.
+        self._dev_cache = {}
+        self.upload_stats = {"fields_shipped": 0, "fields_reused": 0}
+        self._shardings = mesh_table_shardings(
+            mesh, self._bv_sharded, self._ml_sharded)
         self._node_sharding = NamedSharding(mesh, P(NODE_AXIS))
 
     def node(self, i: int) -> Dataplane:
         return self.nodes[i]
+
+    @property
+    def classifier_impl(self) -> str:
+        """The rule-sharded global classify the LIVE cluster epoch runs
+        ("dense" | "mxu" | "bv") — `show partitions` / bench keys."""
+        return self._impl
+
+    @property
+    def fastpath_selected(self) -> bool:
+        return self._use_fast
+
+    @property
+    def ml_selected(self) -> str:
+        return self._ml_mode
+
+    def shard_sessions_resident(self) -> List[int]:
+        """Live reflective sessions per rule shard (summed across
+        nodes) — the ONE copy of the blocked-ownership layout math
+        (shard s owns buckets [s·NB/S, (s+1)·NB/S) of every node);
+        the collector gauge and ``show partitions`` both read this.
+        Reduced ON device: only [shards] scalars cross the transport."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            tables = self.tables
+        if tables is None:
+            return [0] * self.rule_shards
+        valid = tables.sess_valid  # [N, NB, W]
+        per = valid.shape[1] // self.rule_shards
+        resident = np.asarray(jnp.sum(
+            valid.reshape(valid.shape[0], self.rule_shards, per,
+                          valid.shape[2]),
+            axis=(0, 2, 3)))
+        return [int(v) for v in resident]
+
+    def _refresh_selection(self) -> None:
+        """Re-gate every cluster-wide compile-time choice against the
+        staged node builders (the Dataplane._refresh_selection ladder,
+        agreed across nodes because ONE jitted program serves them
+        all). Called under the lock at every swap().
+
+        * classifier: explicit knobs honored when compilable; ``auto``
+          ladders BV >= bv_min_rules > MXU >= mxu_threshold > dense.
+          BV additionally requires EVERY node's structure ok AND the
+          mesh word-shard alignment (``_bv_sharded``).
+        * fastpath: the knob and the min-rules gate against the
+          LARGEST staged global table (the node that pays the most
+          classify is the one the dispatch exists for).
+        * ML: engages only when every node staged a model of the SAME
+          kernel kind — the kind is trace-time static and
+          cluster-wide; a partially-staged fleet keeps the stage off
+          (models land per node through the "ml" upload group, so the
+          next swap after the last node stages flips it on).
+        """
+        c = self.config
+        mxu_ok = all(n.builder.mxu_enabled and n.builder.glb_mxu.ok
+                     for n in self.nodes)
+        bv_ok = self._bv_sharded and all(
+            n.builder.bv_ok() for n in self.nodes)
+        nmax = max(n.builder.glb_nrules for n in self.nodes)
+        self._impl = select_impl(
+            getattr(c, "classifier", "auto"), bv_ok, mxu_ok, nmax,
+            self.bv_min_rules, self.mxu_threshold)
+        self._use_mxu = self._impl == "mxu"
+        self._use_fast = bool(getattr(c, "fastpath", True)) and \
+            nmax >= int(getattr(c, "fastpath_min_rules", 0))
+        self._ml_mode, self._ml_kind = agree_ml(
+            getattr(c, "ml_stage", "off"),
+            {int(getattr(n.builder, "ml_kind", 0))
+             for n in self.nodes})
+
+    def _get_step(self, with_payload: bool = False):
+        """The jitted cluster step of the current selection (call
+        under ``_lock``). The factory is memoized on (mesh, gates), so
+        this is a dict hit after the first build of each variant."""
+        return make_cluster_step(
+            self.mesh, with_payload=with_payload,
+            sweep_stride=self._sweep_stride,
+            impl=self._impl, fast=self._use_fast,
+            ml_mode=self._ml_mode, ml_kind=self._ml_kind,
+            bv_sharded=self._bv_sharded, ml_sharded=self._ml_sharded)
 
     def swap(self) -> int:
         """Stack every node's staged builder into one sharded table epoch.
@@ -422,35 +679,88 @@ class ClusterDataplane:
         renderer mutations on other threads can't publish a torn epoch
         (the cluster analog of Dataplane.swap holding its lock)."""
         with self._lock:
+            # Which fields this swap will actually re-ship (union of
+            # every node's dirty upload groups + cache misses; within
+            # glb_bv only the REBUILT dimension planes): computed
+            # FIRST so the host copy below only touches those — with
+            # the mesh no longer pinned dense the clean host arrays
+            # include the ~100 MB/node BV structure, and memcpying it
+            # on a session-only churn would negate the incremental
+            # upload's host-side half.
+            dirty_groups = set()
+            bv_dirty_fields = set()
+            for n in self.nodes:
+                dirty_groups |= n.builder._dirty
+                bv_dirty_fields |= n.builder._bv_dirty
+            need = set()
+            for group, fields in _UPLOAD_GROUPS.items():
+                dirty = group in dirty_groups
+                for k in fields:
+                    if group == "glb_bv":
+                        if (dirty and k in bv_dirty_fields) \
+                                or k not in self._dev_cache:
+                            need.add(k)
+                    elif dirty or k not in self._dev_cache:
+                        need.add(k)
             per_node = []
+            guard = []
             for n in self.nodes:
                 with n._lock:
+                    arrs = n.builder.host_arrays()
                     per_node.append(
-                        {k: np.copy(v) for k, v in n.builder.host_arrays().items()}
-                    )
+                        {k: np.copy(v) for k, v in arrs.items()
+                         if k in need})
+                    # guard inputs read (not copied) under the node
+                    # lock; staging writers additionally hold the
+                    # CLUSTER commit lock we already own, so these
+                    # can't mutate before the device publish below
+                    guard.append((arrs["fib_node_id"],
+                                  arrs["fib_plen"]))
             # Misconfiguration guard: any node that fabric routes point at
             # must have an uplink, or its inbound traffic would arrive on
             # the reserved interface 0 and be silently dropped as bad-if.
-            for i, arrs in enumerate(per_node):
-                targets = arrs["fib_node_id"][arrs["fib_plen"] >= 0]
+            for i, (node_ids, plens) in enumerate(guard):
+                targets = node_ids[plens >= 0]
                 for t in np.unique(targets[targets >= 0]):
                     if self.nodes[int(t)].uplink_if is None:
                         raise ValueError(
                             f"node {i} routes to node {int(t)}, which has "
                             "no uplink interface (call add_uplink())"
                         )
-            host = {
-                k: np.stack([arrs[k] for arrs in per_node]) for k in per_node[0]
-            }
             shardings = self._shardings._asdict()
-            # Config fields re-ship per swap; SESSION state is carried
-            # over BY REFERENCE — the arrays already live sharded on
-            # the mesh, and a device_put round trip of a multi-hundred-
-            # MB table per epoch flip is exactly the re-upload the
-            # set-associative rework eliminates (docs/SESSIONS.md).
-            dev = {
-                k: jax.device_put(v, shardings[k]) for k, v in host.items()
-            }
+            # Config fields upload INCREMENTALLY by group (the
+            # TableBuilder.to_device discipline, lifted to the mesh):
+            # a group no node's builder dirtied since the last swap
+            # reuses its cached stacked+sharded device array — and
+            # within glb_bv, only the dimension planes compile_bv
+            # actually rebuilt re-ship, so a port-only policy churn
+            # ships two word-sharded planes, not the whole structure.
+            # SESSION state is carried over BY REFERENCE — the arrays
+            # already live sharded on the mesh, and a device_put round
+            # trip of a multi-hundred-MB table per epoch flip is
+            # exactly the re-upload the set-associative rework
+            # eliminates (docs/SESSIONS.md).
+            dev = {}
+            shipped = reused = 0
+            for group, fields in _UPLOAD_GROUPS.items():
+                for k in fields:
+                    if k in need:
+                        self._dev_cache[k] = jax.device_put(
+                            np.stack([arrs[k] for arrs in per_node]),
+                            shardings[k])
+                        shipped += 1
+                    else:
+                        reused += 1
+                    dev[k] = self._dev_cache[k]
+            self.upload_stats["fields_shipped"] = shipped
+            self.upload_stats["fields_reused"] = reused
+            # builders' dirt cleared only now — everything above
+            # succeeded, so the cache really holds the staged state
+            # (cluster nodes never call to_device themselves; this
+            # swap IS their upload path)
+            for n in self.nodes:
+                n.builder._dirty.clear()
+                n.builder._bv_dirty.clear()
             if self.tables is not None:
                 sess = {f: getattr(self.tables, f) for f in SESSION_FIELDS}
                 tel = {f: getattr(self.tables, f)
@@ -462,19 +772,15 @@ class ClusterDataplane:
                     for f, v in zs.items()
                 }
                 # telemetry planes (ops/telemetry.py): node-stacked
-                # placeholders — cluster node configs keep the knob
-                # off (the ml_stage pattern), so these are never read
+                # placeholders, replicated-by-design along the rule
+                # axis (partition.py) — the cluster step keeps the
+                # telemetry knob off, so these are never read
                 zt = zero_telemetry(self.config, leading=(self.n_nodes,))
                 tel = {
                     f: jax.device_put(v, shardings[f])
                     for f, v in zt.items()
                 }
-            self._use_mxu = all(
-                n.builder.mxu_enabled and n.builder.glb_mxu.ok
-                for n in self.nodes
-            ) and any(
-                n.builder.glb_nrules >= self.mxu_threshold for n in self.nodes
-            )
+            self._refresh_selection()
             self.tables = DataplaneTables(**dev, **sess, **tel)
             self._uplinks = jax.device_put(
                 np.array(
@@ -585,7 +891,7 @@ class ClusterDataplane:
                 self._now = max(self._now, self.clock_ticks())
                 now = self._now
             tables, uplinks = self.tables, self._uplinks
-            step = self._step_mxu if self._use_mxu else self._step
+            step = self._get_step()
             self._steps_since_expire += 1
         result = step(tables, pkts, jnp.int32(now), uplinks)
         with self._lock:
@@ -605,12 +911,7 @@ class ClusterDataplane:
             if now is None:
                 self._now = max(self._now, self.clock_ticks())
                 now = self._now
-            step = self._wire_steps.get(self._use_mxu)
-            if step is None:
-                step = make_cluster_step_wire(
-                    self.mesh, mxu=self._use_mxu,
-                    sweep_stride=self._sweep_stride)
-                self._wire_steps[self._use_mxu] = step
+            step = self._get_step(with_payload=True)
             tables, uplinks = self.tables, self._uplinks
             self._steps_since_expire += 1
         result, deliv_pay = step(
@@ -620,3 +921,36 @@ class ClusterDataplane:
             if tables is self.tables:
                 self.tables = result.tables
         return result, deliv_pay
+
+    def adopt_sessions(self, sessions) -> int:
+        """Publish RESTORED session state (a ``{field: node-stacked
+        host array}`` mapping of SESSION_FIELDS — the cluster
+        snapshot-restore path, pipeline/snapshot.py) as a new epoch:
+        the arrays upload onto their bucket-sharded mesh placement and
+        established flows come back warm fleet-wide. Shapes must match
+        the mesh geometry — the snapshot loader already refused a
+        mismatch, so a bad shape here raises."""
+        from vpp_tpu.pipeline.tables import session_shapes
+
+        shapes = session_shapes(self.config)
+        with self._lock:
+            if self.tables is None:
+                self.swap()
+            missing = set(SESSION_FIELDS) - set(sessions)
+            if missing:
+                raise ValueError(
+                    f"restored session state missing fields: "
+                    f"{sorted(missing)}")
+            dev = {}
+            for f, dt in SESSION_FIELDS.items():
+                want = (self.n_nodes,) + shapes[f]
+                arr = np.asarray(sessions[f], dt)
+                if arr.shape != want:
+                    raise ValueError(
+                        f"restored session field {f!r} shape "
+                        f"{arr.shape} != mesh geometry {want}")
+                dev[f] = jax.device_put(
+                    arr, getattr(self._shardings, f))
+            self.tables = self.tables._replace(**dev)
+            self.epoch += 1
+            return self.epoch

@@ -5,10 +5,12 @@ Axes:
              analog of the reference's per-node DaemonSet replica
              (k8s/contiv-vpp.yaml:150). Per-node tables are stacked on a
              leading axis and sharded here.
-  ``rule`` — shards the rows of the node-global ACL table, so a
-             cluster-scale rule set (tests/policy/perf/gen-policy.py
-             regime) classifies in parallel across chips; first-match is
-             recombined with a min-reduction (ops/acl.acl_encode_shard).
+  ``rule`` — the capacity axis: shards the global-ACL rule rows
+             (dense/MXU), the BV rule-WORD planes, the ML hidden/tree
+             planes and the session bucket grids, per the declarative
+             partition-rule layer (vpp_tpu/parallel/partition.py — the
+             ONE source of field→PartitionSpec truth; the old
+             per-field exclusion lists here are gone).
 """
 
 from __future__ import annotations
@@ -20,33 +22,17 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from vpp_tpu.parallel.partition import (
+    NODE_AXIS,
+    RULE_AXIS,
+    table_specs,
+)
 from vpp_tpu.pipeline.tables import DataplaneTables
 
-NODE_AXIS = "node"
-RULE_AXIS = "rule"
-
-# Global-ACL row arrays are sharded over the rule axis as well as stacked
-# over nodes; everything else is only stacked per node. The bit-plane
-# arrays (ops/acl_mxu) shard their *rule* dimension, which for the coeff
-# matrix is axis 2 of the node-stacked array. The BV interval-bitmap
-# arrays (ops/acl_bv) are EXCLUDED: a segment's bitmap row spans ALL
-# rules (the rule axis is packed into uint32 words, and the boundary
-# axis is data-dependent, not divisible by shard count), so the mesh
-# keeps its rule-sharded dense/MXU classify and the BV fields ride
-# node-stacked only (docs/CLASSIFIER.md — ClusterDataplane pins its
-# node configs to classifier="dense", so they are minimal placeholders).
-# The ML-stage model fields (glb_ml_*, ops/mlscore.py) are likewise
-# node-stacked only: their axes are feature/hidden/tree dimensions,
-# not rule rows, and cluster node configs keep ml_stage off (minimal
-# placeholder shapes — docs/ML_STAGE.md).
-_RULE_SHARDED_FIELDS = frozenset(
-    f
-    for f in DataplaneTables._fields
-    if f.startswith("glb_")
-    and not f.startswith("glb_bv_")
-    and not f.startswith("glb_ml_")
-    and f not in ("glb_nrules", "glb_mxu_coeff")
-)
+__all__ = [
+    "NODE_AXIS", "RULE_AXIS", "cluster_mesh", "table_specs",
+    "table_shardings",
+]
 
 
 def cluster_mesh(
@@ -61,16 +47,6 @@ def cluster_mesh(
         raise ValueError(f"need {need} devices, have {len(devices)}")
     grid = np.asarray(devices[:need]).reshape(n_nodes, rule_shards)
     return Mesh(grid, (NODE_AXIS, RULE_AXIS))
-
-
-def table_specs() -> DataplaneTables:
-    """PartitionSpec pytree for node-stacked DataplaneTables."""
-    specs = {
-        f: P(NODE_AXIS, RULE_AXIS) if f in _RULE_SHARDED_FIELDS else P(NODE_AXIS)
-        for f in DataplaneTables._fields
-    }
-    specs["glb_mxu_coeff"] = P(NODE_AXIS, None, RULE_AXIS)
-    return DataplaneTables(**specs)
 
 
 def table_shardings(mesh: Mesh) -> DataplaneTables:

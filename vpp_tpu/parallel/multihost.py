@@ -44,11 +44,17 @@ from jax.sharding import PartitionSpec as P
 from vpp_tpu.parallel.cluster import (
     ClusterStepResult,
     make_cluster_step,
+    mesh_table_specs,
 )
 from vpp_tpu.parallel.mesh import (
     NODE_AXIS,
     cluster_mesh,
-    table_specs,
+)
+from vpp_tpu.parallel.partition import (
+    agree_ml,
+    bv_mesh_ok,
+    select_impl,
+    validate_partitioning,
 )
 from vpp_tpu.pipeline.dataplane import Dataplane
 from vpp_tpu.pipeline.tables import (
@@ -70,13 +76,23 @@ def init_multihost(coordinator_address: str, num_processes: int,
     """``jax.distributed.initialize`` with the runtime's settings; call
     before any other JAX API touches a backend. Raise
     ``heartbeat_timeout_s`` where long jit compiles can starve the
-    coordinator heartbeat (the service KILLS tasks that miss it)."""
-    jax.distributed.initialize(
+    coordinator heartbeat (the service KILLS tasks that miss it) — on
+    toolchains whose initialize() predates the knob (it moved into the
+    API mid-0.4.x) the default cadence applies instead."""
+    import inspect
+
+    kwargs = dict(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
-        heartbeat_timeout_seconds=heartbeat_timeout_s,
     )
+    try:
+        params = inspect.signature(jax.distributed.initialize).parameters
+    except (TypeError, ValueError):  # C-accelerated callable: assume new
+        params = {"heartbeat_timeout_seconds": None}
+    if "heartbeat_timeout_seconds" in params:
+        kwargs["heartbeat_timeout_seconds"] = heartbeat_timeout_s
+    jax.distributed.initialize(**kwargs)
 
 
 def barrier(name: str) -> None:
@@ -96,11 +112,21 @@ class MultiHostCluster:
                  config: Optional[DataplaneConfig] = None,
                  rule_shards: int = 1):
         self.mesh = cluster_mesh(n_nodes, rule_shards)
-        # mesh classify is rule-sharded dense/MXU — pin the node
-        # builders off the BV structure (see ClusterDataplane)
-        self.config = (config or DataplaneConfig())._replace(
-            classifier="dense")
+        # node configs follow the operator's knobs (ISSUE 12): the
+        # partition layer shards BV/ML/session planes, so the fleet
+        # runs the same selection ladder as ClusterDataplane
+        self.config = config or DataplaneConfig()
         self.n_nodes = n_nodes
+        validate_partitioning(self.config, rule_shards)
+        self._bv_sharded = bv_mesh_ok(self.config, rule_shards)
+        if (getattr(self.config, "classifier", "auto") == "bv"
+                and rule_shards > 1 and not self._bv_sharded):
+            raise ValueError(
+                f"classifier=bv on a {rule_shards}-way rule-sharded "
+                f"mesh requires max_global_rules "
+                f"({self.config.max_global_rules}) divisible by "
+                f"{32 * rule_shards} (32·shards)")
+        self._ml_sharded = getattr(self.config, "ml_stage", "off") != "off"
         local_ids = {d.id for d in jax.local_devices()}
         self.local_nodes: List[int] = [
             i for i in range(n_nodes)
@@ -126,7 +152,8 @@ class MultiHostCluster:
         self.tables: Optional[DataplaneTables] = None
         self._uplinks = None
         self.epoch = 0
-        self._specs = table_specs()
+        self._specs = mesh_table_specs(self._bv_sharded,
+                                       self._ml_sharded)
         # the config's amortized-aging stride rides every fleet step
         # variant (trace-time static), same as the single-node and
         # ClusterDataplane paths
@@ -140,12 +167,19 @@ class MultiHostCluster:
         # and the config is fleet-identical, so this counter advances
         # identically on every process
         self._steps_since_expire = 0
-        self._step = make_cluster_step(
-            self.mesh, sweep_stride=self._sweep_stride)
-        self._step_mxu = None    # built on first mxu epoch
-        self._wire_steps = {}    # mxu-mode -> jitted wire step
-        self._use_mxu = False
+        # selection state, agreed COLLECTIVELY at publish() (local
+        # eligibility bits allgathered, ladder applied identically on
+        # every process — the uplink-guard pattern). Step variants come
+        # from the memoized make_cluster_step factory, like
+        # ClusterDataplane.
+        self._impl = "dense"
+        self._use_mxu = False           # legacy view (impl == "mxu")
+        self._use_fast = False
+        self._ml_mode = "off"
+        self._ml_kind = "mlp"
         self.mxu_threshold = 512
+        self.bv_min_rules = int(
+            getattr(self.config, "classifier_bv_min_rules", 1024))
 
     def node(self, i: int) -> Dataplane:
         return self.nodes[i]
@@ -241,21 +275,40 @@ class MultiHostCluster:
                                    getattr(self._specs, f))
                 for f in TELEMETRY_FIELDS
             }
-        # MXU classifier selection is CLUSTER state: one jitted
-        # program serves all nodes, so the choice must be identical
-        # fleet-wide — agree on it like the uplink guard (local
-        # eligibility bits, collective min/max)
-        local_ok = all(
+        # Classifier/fastpath/ML selection is CLUSTER state: one jitted
+        # program serves all nodes, so every choice must be identical
+        # fleet-wide — agree like the uplink guard (local eligibility
+        # bits, collective min/max, the SAME ladder
+        # ClusterDataplane._refresh_selection runs applied to the
+        # agreed bits on every process)
+        local_mxu_ok = all(
             self.nodes[i].builder.mxu_enabled
             and self.nodes[i].builder.glb_mxu.ok
             for i in self.local_nodes)
-        local_big = any(
-            self.nodes[i].builder.glb_nrules >= self.mxu_threshold
-            for i in self.local_nodes)
+        local_bv_ok = all(
+            self.nodes[i].builder.bv_ok() for i in self.local_nodes)
+        local_nmax = max(
+            self.nodes[i].builder.glb_nrules for i in self.local_nodes)
+        local_kinds = {int(getattr(self.nodes[i].builder, "ml_kind", 0))
+                       for i in self.local_nodes}
+        # ml agreement: kinds must be uniform fleet-wide; encode this
+        # host's view as (kind, conflict) — min/max detect divergence
+        local_kind = local_kinds.pop() if len(local_kinds) == 1 else -1
         flags = np.asarray(multihost_utils.process_allgather(
-            np.int32([int(local_ok), int(local_big)]))).reshape(-1, 2)
-        self._use_mxu = bool(flags[:, 0].min()) and bool(
-            flags[:, 1].max())
+            np.int32([int(local_mxu_ok), int(local_bv_ok),
+                      int(local_nmax), local_kind]))).reshape(-1, 4)
+        mxu_ok = bool(flags[:, 0].min())
+        bv_ok = self._bv_sharded and bool(flags[:, 1].min())
+        nmax = int(flags[:, 2].max())
+        c = self.config
+        self._impl = select_impl(
+            getattr(c, "classifier", "auto"), bv_ok, mxu_ok, nmax,
+            self.bv_min_rules, self.mxu_threshold)
+        self._use_mxu = self._impl == "mxu"
+        self._use_fast = bool(getattr(c, "fastpath", True)) and \
+            nmax >= int(getattr(c, "fastpath_min_rules", 0))
+        self._ml_mode, self._ml_kind = agree_ml(
+            getattr(c, "ml_stage", "off"), flags[:, 3])
         self.tables = DataplaneTables(**host_fields, **sess, **tel)
         self._uplinks = self._to_global(
             np.array([self.nodes[i].uplink_if or 0
@@ -293,33 +346,32 @@ class MultiHostCluster:
             raise RuntimeError("publish() first")
         if now is None:
             now = self.epoch  # deterministic default, NOT wall clock
-        step = self._step
-        if self._use_mxu:
-            if self._step_mxu is None:
-                self._step_mxu = make_cluster_step(
-                    self.mesh, mxu=True,
-                    sweep_stride=self._sweep_stride)
-            step = self._step_mxu
+        step = self._get_step()
         self._steps_since_expire += 1
         res = step(self.tables, pkts, jnp.int32(now), self._uplinks)
         self.tables = res.tables
         return res
 
+    def _get_step(self, with_payload: bool = False):
+        """The jitted cluster step of the fleet-agreed selection (the
+        memoized make_cluster_step factory — every process resolves
+        the SAME gates from the same collective agreement, so the
+        fleet traces identical programs)."""
+        return make_cluster_step(
+            self.mesh, with_payload=with_payload,
+            sweep_stride=self._sweep_stride,
+            impl=self._impl, fast=self._use_fast,
+            ml_mode=self._ml_mode, ml_kind=self._ml_kind,
+            bv_sharded=self._bv_sharded, ml_sharded=self._ml_sharded)
+
     def step_wire(self, pkts: PacketVector, payload, now: int):
         """COLLECTIVE: wire-traffic step — headers AND payload bytes
-        ride the fabric (ClusterDataplane.step_wire analog; the MXU
-        classifier engages when publish()'s fleet-agreed eligibility
-        selected it, same rule as ClusterDataplane.swap)."""
-        from vpp_tpu.parallel.cluster import make_cluster_step_wire
-
+        ride the fabric (ClusterDataplane.step_wire analog; the
+        classifier/fastpath/ML gates engage when publish()'s
+        fleet-agreed eligibility selected them)."""
         if self.tables is None:
             raise RuntimeError("publish() first")
-        step = self._wire_steps.get(self._use_mxu)
-        if step is None:
-            step = make_cluster_step_wire(
-                self.mesh, mxu=self._use_mxu,
-                sweep_stride=self._sweep_stride)
-            self._wire_steps[self._use_mxu] = step
+        step = self._get_step(with_payload=True)
         self._steps_since_expire += 1
         result, deliv_pay = step(
             self.tables, pkts, jnp.asarray(payload), jnp.int32(now),
@@ -537,7 +589,10 @@ class _LocalWireView:
             return jax.tree.map(mh.local_rows, tree)
 
         return (types.SimpleNamespace(local=localize(res.local),
-                                      delivered=localize(res.delivered)),
+                                      delivered=localize(res.delivered),
+                                      stats=localize(res.stats),
+                                      fastpath_pass1=mh.local_rows(
+                                          res.fastpath_pass1)),
                 mh.local_rows(dpay))
 
 

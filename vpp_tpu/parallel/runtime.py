@@ -86,6 +86,11 @@ class MeshRuntime:
             )
             self._mesh_pos[agent.node_id] = i
             agent.mesh_runtime = self  # `show mesh` on any node's CLI
+            # per-shard partition gauges (ISSUE 12): every node's
+            # collector reports the mesh placement + shard residency —
+            # these are snapshots of shared device state, not counters,
+            # so multi-node export does not overcount
+            agent.stats.set_cluster(self.cluster)
             self.agents.append(agent)
         # packet IO: per-node ring pairs + ONE ClusterPump stepping the
         # fabric (io/cluster_pump.py). Rings exist from construction so

@@ -965,30 +965,18 @@ class Dataplane:
 
     def _select_classifier(self) -> str:
         """Resolve the ``classifier`` knob against the staged builder
-        state. Explicit impls are honored when compilable (an operator
-        knob beats a size heuristic); ``auto`` ladders
-        BV >= bv_min_rules > MXU >= mxu_threshold > dense, with every
-        ineligible structure (range rules for MXU, non-prefix masks or
-        a busted memory cap for BV) falling to the next rung."""
+        state — eligibility bits (range rules for MXU, non-prefix
+        masks or a busted memory cap for BV) feed the ONE shared
+        ladder (partition.select_impl), which the cluster and
+        multi-host planes apply to their own agreed bits so the mesh
+        can never silently select a different rung."""
+        from vpp_tpu.parallel.partition import select_impl
+
         b = self.builder
-        n = b.glb_nrules
-        mxu_ok = b.mxu_enabled and b.glb_mxu.ok
-        bv_ok = b.bv_ok()
-        knob = self.classifier
-        if knob == "dense":
-            return "dense"
-        if knob == "mxu":
-            return "mxu" if mxu_ok else "dense"
-        if knob == "bv":
-            if bv_ok:
-                return "bv"
-            return ("mxu" if mxu_ok and n >= self.mxu_threshold
-                    else "dense")
-        if bv_ok and n >= self.bv_min_rules:
-            return "bv"
-        if mxu_ok and n >= self.mxu_threshold:
-            return "mxu"
-        return "dense"
+        return select_impl(self.classifier, b.bv_ok(),
+                           b.mxu_enabled and b.glb_mxu.ok,
+                           b.glb_nrules, self.bv_min_rules,
+                           self.mxu_threshold)
 
     def _refresh_selection(self) -> None:
         """Re-gate every per-epoch compile-time choice against the

@@ -152,7 +152,8 @@ def _ingress(tables: DataplaneTables, pkts: PacketVector):
 
 def _ml_eval(tables: DataplaneTables, pkts: PacketVector,
              alive: jnp.ndarray, established: jnp.ndarray,
-             sess_age: jnp.ndarray, ml_mode: str, ml_kind: str):
+             sess_age: jnp.ndarray, ml_mode: str, ml_kind: str,
+             shard=None):
     """The ONE copy of the ML-stage evaluation (ISSUE 10), shared by
     the full chain and the established-flow fast tier so the two can
     never silently diverge: scored on the post-NAT-reverse header plus
@@ -174,7 +175,8 @@ def _ml_eval(tables: DataplaneTables, pkts: PacketVector,
         false_p = jnp.zeros(alive.shape, bool)
         return false_p, false_p, false_p, jnp.zeros(alive.shape,
                                                     jnp.int32)
-    scores = ml_score(tables, pkts, established, sess_age, kind=ml_kind)
+    scores = ml_score(tables, pkts, established, sess_age, kind=ml_kind,
+                      shard=shard)
     flagged, drop_wanted = ml_policy(tables, pkts, alive, scores)
     # jax-ok: ml_mode is the same trace-time-static gate as above —
     # score mode statically discards the policy's drop verdict
@@ -213,6 +215,7 @@ def _finish_step(
     ml_scores: jnp.ndarray,
     sweep_stride: int = 0,
     tel_mode: str = "off",
+    shard=None,
 ) -> StepResult:
     """Shared tail of both pipeline tiers: drop attribution, counters,
     StepStats and the StepResult assembly. The ONE copy of the
@@ -235,6 +238,21 @@ def _finish_step(
     else:
         tel_sketched = jnp.int32(0)
     n_ifaces = tables.if_type.shape[0]
+
+    def occupancy(valid, time):
+        """Live slots (valid, not idle-expired). Sharded, the local
+        sum covers this shard's bucket range; one psum makes the
+        scalar the whole table's occupancy on every shard — StepStats
+        outputs must be replicated along the rule axis."""
+        occ = jnp.sum(((valid == 1)
+                       & (now - time <= tables.sess_max_age)
+                       ).astype(jnp.int32))
+        if shard is not None:
+            from jax import lax
+
+            occ = lax.psum(occ, shard.axis)
+        return occ
+
     # ml-drop wins attribution over the FIB outcomes (the packet never
     # reached forwarding), but LOSES to ACL deny: ml_dropped is
     # already masked to permitted traffic by the callers
@@ -268,16 +286,9 @@ def _finish_step(
         sess_insert_fail=jnp.sum(sess_fail.astype(jnp.int32)),
         natsess_insert_fail=jnp.sum(natsess_fail.astype(jnp.int32)),
         # live = valid and not idle-expired (what lookups actually see)
-        sess_occupancy=jnp.sum(
-            ((tables.sess_valid == 1)
-             & (now - tables.sess_time <= tables.sess_max_age)
-             ).astype(jnp.int32)
-        ),
-        natsess_occupancy=jnp.sum(
-            ((tables.natsess_valid == 1)
-             & (now - tables.natsess_time <= tables.sess_max_age)
-             ).astype(jnp.int32)
-        ),
+        sess_occupancy=occupancy(tables.sess_valid, tables.sess_time),
+        natsess_occupancy=occupancy(tables.natsess_valid,
+                                    tables.natsess_time),
         if_rx=zero_i.at[rx_if_safe].add(1, mode="drop"),
         if_tx=zero_i.at[tx_if_safe].add(1, mode="drop"),
         if_rx_bytes=zero_i.at[rx_if_safe].add(
@@ -342,6 +353,7 @@ def pipeline_step(
     ml_mode: str = "off",
     ml_kind: str = "mlp",
     tel_mode: str = "off",
+    shard=None,
 ) -> StepResult:
     """Process one packet vector through the full forwarding chain.
 
@@ -355,6 +367,10 @@ def pipeline_step(
     session table are aged inside the step (trace-time static —
     ops/session.py session_sweep). ``ml_mode``/``ml_kind`` gate the
     per-packet ML scoring stage (trace-time static — ``_ml_eval``).
+    ``shard`` (parallel/partition.py ShardCtx) marks the session/NAT
+    bucket grids and ML weight planes as rule-axis shards: the session
+    ops hash globally and recombine with psums, so the chain's
+    per-packet results stay bit-exact vs standalone (docs/PARTITIONING.md).
     """
     # --- ip4-input (+ unconfigured-interface drop) ---
     pkts, drop_ip4, alive = _ingress(tables, pkts)
@@ -364,22 +380,28 @@ def pipeline_step(
     # post-DNAT, so a backend's reply B→C reverses to the stored C→B key.
     # Expired entries (idle > sess_max_age ticks) don't match, and hits
     # refresh the timestamp — active flows never expire mid-flow.
-    established, sess_hit_idx = session_lookup_reverse_idx(tables, pkts, now)
+    established, sess_hit_idx = session_lookup_reverse_idx(
+        tables, pkts, now, shard=shard)
     established = established & alive
     # pre-touch session age: an ML feature (the touch below refreshes
     # the timestamp, so the age must be captured first — the fast tier
     # captures it at the same pre-touch point, docs/ML_STAGE.md)
-    sess_age = session_hit_age(tables, sess_hit_idx, established, now)
-    tables = session_touch(tables, sess_hit_idx, established, now)
+    sess_age = session_hit_age(tables, sess_hit_idx, established, now,
+                               shard=shard)
+    tables = session_touch(tables, sess_hit_idx, established, now,
+                           shard=shard)
 
     # --- NAT44: reverse-translate return traffic, then DNAT new flows ---
-    pkts, nat_reversed, nat_hit_idx = nat44_reverse(tables, pkts, alive, now)
-    tables = nat44_touch(tables, nat_hit_idx, nat_reversed, now)
+    pkts, nat_reversed, nat_hit_idx = nat44_reverse(tables, pkts, alive,
+                                                    now, shard=shard)
+    tables = nat44_touch(tables, nat_hit_idx, nat_reversed, now,
+                         shard=shard)
 
     # --- per-packet ML scoring (ISSUE 10): on the post-reverse header,
     # the same values the fast tier scores — ONE shared evaluation
     ml_scored, ml_flagged, ml_drop_want, ml_scores = _ml_eval(
-        tables, pkts, alive, established, sess_age, ml_mode, ml_kind)
+        tables, pkts, alive, established, sess_age, ml_mode, ml_kind,
+        shard=shard)
 
     orig_dst, orig_dport = pkts.dst_ip, pkts.dport
     pkts, dnat_applied, dnat_self_snat = nat44_dnat(
@@ -427,13 +449,13 @@ def pipeline_step(
     # must not consume session slots); keys are post-NAT so replies match ---
     want_sess = forwarded & ~established & nat_capable & ~nat_unsupported
     tables, _, sess_fail, sess_ev_exp, sess_ev_vic = session_insert(
-        tables, pkts, want_sess, now)
+        tables, pkts, want_sess, now, shard=shard)
     nat_kind = (
         jnp.where(dnat_applied, 1, 0) + jnp.where(snat_applied, 2, 0)
     ).astype(jnp.int32)
     tables, nat_conflict, natsess_fail, nat_ev_exp, nat_ev_vic = nat44_record(
         tables, pkts, orig_dst, orig_dport, orig_src, orig_sport, nat_kind,
-        (dnat_applied | snat_applied) & forwarded, now,
+        (dnat_applied | snat_applied) & forwarded, now, shard=shard,
     )
     # Fail closed on reply-key collisions (two SNAT'd flows hashed onto
     # the same external port): misdelivering replies to the wrong pod is
@@ -453,6 +475,7 @@ def pipeline_step(
         natsess_evict_expired=nat_ev_exp, natsess_evict_victim=nat_ev_vic,
         ml_scored=ml_scored, ml_flagged=ml_flagged, ml_dropped=ml_dropped,
         ml_scores=ml_scores, sweep_stride=sweep_stride, tel_mode=tel_mode,
+        shard=shard,
     )
 
 
@@ -485,6 +508,7 @@ def _pipeline_fast_finish(
     ml_mode: str = "off",
     ml_kind: str = "mlp",
     tel_mode: str = "off",
+    shard=None,
 ) -> StepResult:
     """Tail of the classify-free kernel, from post-reverse headers on.
 
@@ -503,9 +527,12 @@ def _pipeline_fast_finish(
     here exactly as the full chain captures it.
     """
     # pre-touch session age (the ML age feature — full-chain parity)
-    sess_age = session_hit_age(tables, sess_hit_idx, established, now)
-    tables = session_touch(tables, sess_hit_idx, established, now)
-    tables = nat44_touch(tables, nat_hit_idx, nat_reversed, now)
+    sess_age = session_hit_age(tables, sess_hit_idx, established, now,
+                               shard=shard)
+    tables = session_touch(tables, sess_hit_idx, established, now,
+                           shard=shard)
+    tables = nat44_touch(tables, nat_hit_idx, nat_reversed, now,
+                         shard=shard)
 
     # permit == (local & glob) | established on every alive packet by
     # the dispatch invariant, so the classify is skipped outright
@@ -513,7 +540,8 @@ def _pipeline_fast_finish(
     drop_acl = alive & ~permit
 
     ml_scored, ml_flagged, ml_drop_want, ml_scores = _ml_eval(
-        tables, pkts, alive, established, sess_age, ml_mode, ml_kind)
+        tables, pkts, alive, established, sess_age, ml_mode, ml_kind,
+        shard=shard)
     ml_dropped = ml_drop_want & permit & alive
 
     fib = ip4_lookup(tables, pkts.dst_ip)
@@ -537,6 +565,7 @@ def _pipeline_fast_finish(
         natsess_evict_expired=false_p, natsess_evict_victim=false_p,
         ml_scored=ml_scored, ml_flagged=ml_flagged, ml_dropped=ml_dropped,
         ml_scores=ml_scores, sweep_stride=sweep_stride, tel_mode=tel_mode,
+        shard=shard,
     )
 
 
@@ -546,6 +575,7 @@ def pipeline_step_fast(
     ml_mode: str = "off",
     ml_kind: str = "mlp",
     tel_mode: str = "off",
+    shard=None,
 ) -> StepResult:
     """The classify-free established-flow kernel, standalone:
     ip4-input → session lookup/touch → NAT reverse/touch → [ML score]
@@ -558,13 +588,15 @@ def pipeline_step_fast(
     production traffic goes through the auto dispatcher.
     """
     pkts, drop_ip4, alive = _ingress(tables, pkts)
-    established, sess_hit_idx = session_lookup_reverse_idx(tables, pkts, now)
+    established, sess_hit_idx = session_lookup_reverse_idx(
+        tables, pkts, now, shard=shard)
     established = established & alive
-    pkts, nat_reversed, nat_hit_idx = nat44_reverse(tables, pkts, alive, now)
+    pkts, nat_reversed, nat_hit_idx = nat44_reverse(tables, pkts, alive,
+                                                    now, shard=shard)
     return _pipeline_fast_finish(
         tables, pkts, now, alive, drop_ip4, established, sess_hit_idx,
         nat_reversed, nat_hit_idx, sweep_stride=sweep_stride,
-        ml_mode=ml_mode, ml_kind=ml_kind, tel_mode=tel_mode,
+        ml_mode=ml_mode, ml_kind=ml_kind, tel_mode=tel_mode, shard=shard,
     )
 
 
@@ -578,6 +610,7 @@ def pipeline_step_auto(
     ml_mode: str = "off",
     ml_kind: str = "mlp",
     tel_mode: str = "off",
+    shard=None,
 ) -> StepResult:
     """Two-tier dispatch: the fast kernel when the whole batch rides
     established sessions, the full chain otherwise.
@@ -594,34 +627,50 @@ def pipeline_step_auto(
     after un-NAT: a reflective-session hit whose destination is also a
     service VIP still takes the full chain, because the full chain
     DNATs it and records NAT state the fast kernel elides.
+
+    SPMD-uniformity under the mesh (``shard``): the sharded session
+    summary already recombines per-shard hits with a psum, and the
+    dispatch flag is additionally ALL-REDUCED (``pmin`` of each shard's
+    flag) before the ``lax.cond`` — every shard provably takes the
+    same branch, so the collectives inside both tiers line up. This is
+    what lets the fast tier finally run under shard_map (the pre-ISSUE-
+    12 cluster pump documented the predicate as not SPMD-uniform and
+    pinned the mesh to the full chain).
     """
     from jax import lax
 
     orig_pkts = pkts
     pkts1, drop_ip4, alive = _ingress(tables, pkts)
     hits, sess_hit_idx, all_hit = session_batch_summary(
-        tables, pkts1, alive, now
+        tables, pkts1, alive, now, shard=shard
     )
     # NAT reverse runs before the DNAT probe: the un-NAT'd header is
     # what the full chain would hand nat44_dnat
     rpkts, nat_reversed, nat_hit_idx = nat44_reverse(
-        tables, pkts1, alive, now
+        tables, pkts1, alive, now, shard=shard
     )
     dnat_would = nat44_dnat_match(tables, rpkts, alive & ~nat_reversed)
     ok = all_hit & ~jnp.any(dnat_would)
+    if shard is not None:
+        # the all-reduce that makes the dispatch provably uniform: the
+        # inputs are already replicated (psum'd lookups), and the pmin
+        # collapses any would-be divergence into "all take the slow
+        # tier" instead of a cross-shard collective mismatch
+        ok = lax.pmin(ok.astype(jnp.int32), shard.axis) > 0
 
     def fast(_):
         return _pipeline_fast_finish(
             tables, rpkts, now, alive, drop_ip4, hits, sess_hit_idx,
             nat_reversed, nat_hit_idx, sweep_stride=sweep_stride,
             ml_mode=ml_mode, ml_kind=ml_kind, tel_mode=tel_mode,
+            shard=shard,
         )
 
     def full(_):
         return pipeline_step(tables, orig_pkts, now, acl_global_fn,
                              acl_local_fn, sweep_stride=sweep_stride,
                              ml_mode=ml_mode, ml_kind=ml_kind,
-                             tel_mode=tel_mode)
+                             tel_mode=tel_mode, shard=shard)
 
     return lax.cond(ok, fast, full, None)
 

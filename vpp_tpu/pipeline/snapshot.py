@@ -154,8 +154,11 @@ def _digest_fn(chunk_buckets: int):
     return jax.jit(digest)
 
 
-def _chunk_name(table: str, idx: int, gen: int) -> str:
-    return f"{table}-{idx:05d}-g{gen}.chunk"
+def _chunk_name(table: str, idx: int, gen: int,
+                node: Optional[int] = None) -> str:
+    if node is None:
+        return f"{table}-{idx:05d}-g{gen}.chunk"
+    return f"{table}-n{node:03d}-{idx:05d}-g{gen}.chunk"
 
 
 def _fsync_dir(path: str) -> None:
@@ -184,6 +187,24 @@ def _geometry_of(config) -> Dict[str, int]:
         "sess_slots": int(config.sess_slots),
         "sess_ways": ways,
         "natsess_slots": int(natsess_slots_of(config)),
+    }
+
+
+def _mesh_of(dp) -> Optional[Dict[str, int]]:
+    """The mesh geometry of a CLUSTER staging handle (None for the
+    standalone Dataplane). Recorded in the manifest and REFUSED on
+    mismatch at restore: a snapshot's per-shard bucket ranges only mean
+    something on the mesh that drained them — restoring a 4-shard
+    table onto a 2-shard mesh would interleave bucket ownership wrong,
+    and misdelivering NAT replies is worse than a cold start."""
+    mesh = getattr(dp, "mesh", None)
+    if mesh is None:
+        return None
+    from vpp_tpu.parallel.partition import NODE_AXIS, RULE_AXIS
+
+    return {
+        "n_nodes": int(mesh.shape[NODE_AXIS]),
+        "rule_shards": int(mesh.shape[RULE_AXIS]),
     }
 
 
@@ -342,9 +363,11 @@ class SessionSnapshotter:
                     "staging handle has no live tables to snapshot")
             now = max(dp._now, dp.clock_ticks())
         geometry = _geometry_of(dp.config)
+        mesh = _mesh_of(dp)
         prev_ok = (prev is not None
                    and prev.get("version") == FORMAT_VERSION
                    and prev.get("config") == geometry
+                   and prev.get("mesh") == mesh
                    and prev.get("chunk_buckets") == self.chunk_buckets)
         manifest = {
             "version": FORMAT_VERSION,
@@ -352,51 +375,76 @@ class SessionSnapshotter:
             "now": int(now),
             "t_wall": time.time(),
             "config": geometry,
+            "mesh": mesh,
             "chunk_buckets": self.chunk_buckets,
             "scalars": {},
             "tables": {},
         }
         for f in SCALAR_FIELDS:
-            manifest["scalars"][f] = int(np.asarray(getattr(tables, f)))
+            v = np.asarray(getattr(tables, f))
+            # cluster handles stack the cursor scalars per node ([N])
+            manifest["scalars"][f] = (
+                [int(x) for x in v] if v.ndim else int(v))
         written = skipped = wbytes = 0
         t_chunks = 0.0
+        # node rows to drain: the standalone table is "one node" with
+        # no leading axis; the cluster table drains per (node, shard)
+        # bucket range — chunks are capped to the per-shard range so a
+        # chunk file never straddles a shard boundary and the manifest
+        # records which shard's range each chunk covers
+        nodes = (None,) if mesh is None else tuple(
+            range(mesh["n_nodes"]))
+        shards = 1 if mesh is None else mesh["rule_shards"]
         for table, fields in TABLE_COLS.items():
-            cols = tuple(getattr(tables, f) for f in fields)
-            n_buckets = int(cols[0].shape[0])
-            cb = min(self.chunk_buckets, n_buckets)
+            all_cols = tuple(getattr(tables, f) for f in fields)
+            n_buckets = int(all_cols[0].shape[-2])
+            per_shard = n_buckets // shards
+            cb = min(self.chunk_buckets, per_shard)
             n_chunks = n_buckets // cb
-            digests = np.asarray(_digest_fn(cb)(cols))
             valid = tables.sess_valid if table == "sess" \
                 else tables.natsess_valid
             flagged = int(np.asarray(jnp.sum(valid)))
-            prev_chunks = (prev["tables"][table]["chunks"]
-                           if prev_ok and table in prev.get("tables", {})
+            prev_tab = (prev["tables"][table]
+                        if prev_ok and table in prev.get("tables", {})
+                        else None)
+            prev_chunks = (prev_tab["chunks"] if prev_tab is not None
+                           and prev_tab.get("chunk_buckets") == cb
                            else None)
             fetch = _fetch_fn(cb)
             entries = []
-            for idx in range(n_chunks):
-                d = int(digests[idx])
-                if prev_chunks is not None and \
-                        prev_chunks[idx]["digest"] == d:
-                    # content unchanged since the published generation:
-                    # the old file keeps serving this chunk
-                    entries.append(dict(prev_chunks[idx]))
-                    skipped += 1
-                    continue
-                t0 = time.perf_counter()
-                block = np.asarray(
-                    jax.device_get(fetch(cols, np.int32(idx * cb))))
-                payload = block.tobytes()
-                name = _chunk_name(table, idx, gen)
-                crc = self._write_chunk(
-                    os.path.join(self.directory, name), payload)
-                t_chunks += time.perf_counter() - t0
-                entries.append({"file": name, "digest": d, "crc": crc,
-                                "start": idx * cb})
-                written += 1
-                wbytes += len(payload)
-                if self.pace_s:
-                    time.sleep(self.pace_s)
+            for node in nodes:
+                cols = (all_cols if node is None
+                        else tuple(c[node] for c in all_cols))
+                digests = np.asarray(_digest_fn(cb)(cols))
+                for idx in range(n_chunks):
+                    flat = (0 if node is None else node) * n_chunks + idx
+                    d = int(digests[idx])
+                    if prev_chunks is not None and \
+                            flat < len(prev_chunks) and \
+                            prev_chunks[flat]["digest"] == d:
+                        # content unchanged since the published
+                        # generation: the old file keeps serving it
+                        entries.append(dict(prev_chunks[flat]))
+                        skipped += 1
+                        continue
+                    t0 = time.perf_counter()
+                    block = np.asarray(
+                        jax.device_get(fetch(cols, np.int32(idx * cb))))
+                    payload = block.tobytes()
+                    name = _chunk_name(table, idx, gen, node)
+                    crc = self._write_chunk(
+                        os.path.join(self.directory, name), payload)
+                    t_chunks += time.perf_counter() - t0
+                    entry = {"file": name, "digest": d, "crc": crc,
+                             "start": idx * cb,
+                             "shard": (idx * cb) // per_shard}
+                    if node is not None:
+                        entry["node"] = node
+                    entries.append(entry)
+                    written += 1
+                    wbytes += len(payload)
+                    if self.pace_s:
+                        time.sleep(self.pace_s)
             manifest["tables"][table] = {
                 "chunk_buckets": cb,
                 "n_chunks": n_chunks,
@@ -521,14 +569,26 @@ class SessionSnapshotter:
                 "geometry",
                 f"snapshot {m.get('config')} != configured {geometry}")
             return None, "geometry"
+        mesh = _mesh_of(self.dp)
+        if m.get("mesh") != mesh:
+            # a per-shard drain only restores onto the SAME mesh shape
+            # (node count and rule-shard count): refuse cleanly —
+            # the fleet cold-starts instead of interleaving bucket
+            # ownership wrong
+            self._count_restore(
+                "geometry",
+                f"snapshot mesh {m.get('mesh')} != configured {mesh}")
+            return None, "geometry"
         snap_now = int(m.get("now", 0))
         shapes = session_shapes(self.dp.config)
+        leading = () if mesh is None else (mesh["n_nodes"],)
         sessions: Dict[str, np.ndarray] = {}
         try:
             for table, fields in TABLE_COLS.items():
                 tinfo = m["tables"][table]
                 cb = int(tinfo["chunk_buckets"])
-                arrs = {f: np.zeros(shapes[f], SESSION_FIELDS[f])
+                arrs = {f: np.zeros(leading + shapes[f],
+                                    SESSION_FIELDS[f])
                         for f in fields}
                 for entry in tinfo["chunks"]:
                     block = self._read_chunk(entry, len(fields), cb,
@@ -540,7 +600,9 @@ class SessionSnapshotter:
                         return None, "crc_mismatch"
                     start = int(entry["start"])
                     for i, f in enumerate(fields):
-                        arrs[f][start:start + cb] = \
+                        dst = (arrs[f] if mesh is None
+                               else arrs[f][int(entry["node"])])
+                        dst[start:start + cb] = \
                             block[i].view(SESSION_FIELDS[f])
                 sessions.update(arrs)
         except FileNotFoundError as e:
@@ -558,7 +620,10 @@ class SessionSnapshotter:
                 sessions[f].astype(np.int64) - snap_now
             ).astype(np.int32)
         for f in SCALAR_FIELDS:
-            sessions[f] = np.int32(m["scalars"].get(f, 0))
+            v = m["scalars"].get(f, 0)
+            sessions[f] = (np.asarray(v, np.int32) if mesh is not None
+                           else np.int32(v if not isinstance(v, list)
+                                         else v[0]))
         self._count_restore("restored")
         return sessions, "restored"
 

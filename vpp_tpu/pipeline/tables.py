@@ -181,9 +181,10 @@ class DataplaneTables(NamedTuple):
     # Interval-bitmap (BV) form of the global table (ops/acl_bv.py);
     # its own upload group ("glb_bv"), re-uploaded per-dimension-plane
     # so a port-only policy churn doesn't re-ship the address bitmaps.
-    # NOT rule-sharded in the mesh (a segment's bitmap spans ALL rules
-    # — parallel/mesh.py excludes glb_bv_*; the cluster classify stays
-    # dense/MXU, documented in docs/CLASSIFIER.md).
+    # On the mesh the bitmap planes shard along the rule-WORD axis
+    # (boundaries replicated — a segment's row spans ALL rules, but
+    # packs them into words): vpp_tpu/parallel/partition.py,
+    # docs/CLASSIFIER.md.
     glb_bv_bnd_src: jnp.ndarray    # uint32 [I]
     glb_bv_bnd_dst: jnp.ndarray    # uint32 [I]
     glb_bv_bnd_sport: jnp.ndarray  # int32 [I]

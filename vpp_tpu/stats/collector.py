@@ -583,6 +583,31 @@ class StatsCollector:
                   "with version/jax/backend/classifier labels)"),
         )
         self._build_labels: Optional[Dict[str, str]] = None
+        # partition-rule layer (ISSUE 12): the resolved placement of
+        # every DataplaneTables field (info-style; the axis label says
+        # which mesh axis shards it — "replicated" for the
+        # replicated-by-design ledger) + per-shard capacity/occupancy
+        # when a cluster handle is attached (set_cluster)
+        self.partition_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_partition_info",
+                  "partition-rule placement of each dataplane table "
+                  "field (info-style: field/axis/shards labels, "
+                  "constant 1)"),
+        )
+        self.shard_sessions_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_shard_sessions_resident",
+                  "live reflective sessions resident in each rule "
+                  "shard's bucket range (summed across nodes)"),
+        )
+        self.shard_rule_bytes_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_shard_rule_plane_bytes",
+                  "device bytes of rule-axis-sharded classifier/ML "
+                  "planes held per rule shard (summed across nodes)"),
+        )
+        self._cluster = None
         # degraded-state sources: the cluster store (set_store), the
         # snapshotter (set_snapshotter) and the ML model source
         # (set_ml); the pump is already attached via set_pump
@@ -654,6 +679,14 @@ class StatsCollector:
         """Attach the VclAdmissionServer so publish() exports its
         admission counters."""
         self.vcl = server
+
+    def set_cluster(self, cluster) -> None:
+        """Attach the ClusterDataplane (vpp_tpu/parallel/cluster.py)
+        so publish() exports the per-shard session residency and
+        rule-plane bytes of the mesh this node is part of — the
+        partition info gauge then reports the mesh's shard count
+        instead of 1."""
+        self._cluster = cluster
 
     def reset_interface(self, if_idx: int) -> None:
         with self._lock:
@@ -785,6 +818,47 @@ class StatsCollector:
         for name in CLASSIFIER_IMPLS:
             self.classifier_gauge.set(
                 1.0 if name == impl else 0.0, impl=name)
+        # partition-rule layer (ISSUE 12): field placements from the
+        # ONE manifest; per-shard residency/bytes only with a live
+        # cluster attached (scalars cross the transport, never columns)
+        from vpp_tpu.parallel.partition import (
+            RULE_AXIS,
+            spec_manifest,
+        )
+
+        cluster = self._cluster
+        shards = int(getattr(cluster, "rule_shards", 1) or 1)
+
+        def eff_spec(f, entry):
+            # the INSTANCE-effective spec when a mesh is attached: a
+            # non-divisible BV word axis / an off ML stage downgrade
+            # those planes to replicated (cluster.mesh_table_specs)
+            if cluster is not None:
+                return getattr(cluster._shardings, f).spec
+            return entry.spec
+
+        sharded_fields = []
+        for f, entry in spec_manifest().items():
+            spec = eff_spec(f, entry)
+            axes = tuple(a for a in spec if a is not None)
+            on_rule = RULE_AXIS in axes
+            if on_rule:
+                sharded_fields.append(f)
+            self.partition_gauge.set(
+                1.0, field=f,
+                axis=RULE_AXIS if on_rule else "replicated",
+                shards=str(shards))
+        if cluster is not None and cluster.tables is not None:
+            t = cluster.tables
+            resident = cluster.shard_sessions_resident()
+            plane_bytes = sum(
+                getattr(t, f).nbytes // shards
+                for f in sharded_fields if f.startswith("glb_"))
+            for s in range(shards):
+                self.shard_sessions_gauge.set(
+                    float(resident[s]), shard=str(s))
+                self.shard_rule_bytes_gauge.set(
+                    float(plane_bytes), shard=str(s))
         # ML stage (ISSUE 10): live mode + the LIVE epoch's model
         # version (read off the published tables ref — immutable, so
         # no race with a load staging a model the swap hasn't
