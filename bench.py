@@ -244,8 +244,11 @@ def build_traffic(n_pkts: int, uplink: int, seed: int = 7):
     )
 
 
-def build_fwd_dataplane():
-    """BASELINE config #1: pod-to-pod ip4-lookup only (no policy/NAT)."""
+def build_fwd_dataplane(telemetry: str = "off"):
+    """BASELINE config #1: pod-to-pod ip4-lookup only (no policy/NAT).
+    ``telemetry`` enables the device latency histogram for sections
+    that tie host-side and on-device latency from the same round
+    (the ISSUE 13 host-vs-device sanity check)."""
     from vpp_tpu.pipeline.dataplane import Dataplane
     from vpp_tpu.pipeline.tables import DataplaneConfig
     from vpp_tpu.pipeline.vector import Disposition
@@ -253,6 +256,7 @@ def build_fwd_dataplane():
     config = DataplaneConfig(
         max_tables=2, max_rules=16, max_global_rules=16, max_ifaces=64,
         fib_slots=64, sess_slots=1 << 12, nat_mappings=1, nat_backends=1,
+        telemetry=telemetry,
     )
     dp = Dataplane(config)
     for i in range(32):
@@ -777,6 +781,245 @@ def latency_telemetry_bench(args, iters: int = 12,
         len(top_true & set(snap["top_key"].tolist())) / k, 3)
     _progress(flow_sketch_error_pct=out["flow_sketch_error_pct"],
               flow_topk_recall=out["flow_topk_recall"])
+    return out
+
+
+def latency_slo_bench(args, frame_pkts: int = 16,
+                      rung_s: float = 1.2) -> dict:
+    """Reflex-plane latency governor ladder (ISSUE 13 tentpole;
+    ROADMAP item 3's bench keys). The ring-to-ring wire path under a
+    mixed load — bulk UDP frames plus a paced priority lane (dport
+    9999) — swept at 50/80/95/120% of the measured saturation rate,
+    once UNGOVERNED (the open-loop pre-13 pump) and once GOVERNED
+    (``latency_slo_us`` = 2x the lone-frame floor), plus a square-wave
+    burst scenario for tail amplification. Headline keys:
+
+      * ``latency_slo_p50/p99/p999_us`` — the governed PRIORITY lane
+        at the 95% rung (acceptance: p99 within 2x of
+        ``latency_slo_floor_us`` while
+        ``latency_slo_goodput_ratio`` >= 0.9);
+      * ``latency_slo_shed_pct`` — attributed overload shedding at
+        the 120% rung (the SLO-unattainable regime — bulk drops are
+        explicit ``drops_overload``, never silent queue growth);
+      * ``latency_slo_burst_p99_us_{governed,ungoverned}`` — the
+        priority tail under a square-wave offered load;
+      * ``latency_slo_io_callbacks`` / ``latency_slo_new_step_variants``
+        — the governor must keep the ring io_callback-free and trace
+        ZERO new jitted step variants (host-side shaping only).
+    """
+    import collections
+    import threading
+
+    from vpp_tpu.io.governor import LatencyGovernor, PriorityFilter
+    from vpp_tpu.io.pump import DataplanePump
+    from vpp_tpu.io.rings import IORingPair
+    from vpp_tpu.native.pktio import PacketCodec
+    from vpp_tpu.pipeline.dataplane import jit_compile_totals
+    from vpp_tpu.pipeline.vector import VEC
+
+    dp = build_fwd_dataplane()
+    client_if = dp.pod_if[("default", "p0")]
+    bulk_wire = [wire_udp(i) for i in range(frame_pkts)]
+    pri_wire = [wire_udp(7, dport=9999)]  # 1-pkt reflex frame
+
+    def capture(bulk_fps, pri_fps, duration, slo_us=0,
+                square=None) -> dict:
+        """One pump lifecycle: paced bulk + priority producers,
+        sequence-stamped ring-to-ring latency per frame, split by
+        lane. ``square=(hi_fps, lo_fps, half_s)`` overrides bulk
+        pacing with a square wave."""
+        rings = IORingPair(n_slots=256, snap=512)
+        codec = PacketCodec(snap=rings.rx.snap)
+        scratch = np.zeros((VEC, rings.rx.snap), np.uint8)
+        gov = None
+        if slo_us > 0:
+            gov = LatencyGovernor(slo_us, tick_s=0.01,
+                                  brownout_ticks=2, recover_ticks=3)
+        pump = DataplanePump(dp, rings, mode="persistent",
+                             governor=gov,
+                             priority=PriorityFilter(ports=(9999,)))
+        pump.warm()
+        pump.start()
+        push_log = {}   # seq -> (t_push, is_pri, n_pkts)
+        lat = collections.defaultdict(list)   # lane -> [seconds]
+        counts = {"offered_bulk": 0, "offered_pri": 0,
+                  "delivered_bulk": 0, "delivered_pri": 0,
+                  "pushed_fail": 0}
+        seq_box = [0]
+        stop = threading.Event()
+
+        def push(wire, is_pri) -> None:
+            cols, n = codec.parse(wire, client_if, scratch)
+            seq = seq_box[0]
+            cols["meta"][:n] = seq
+            t = time.perf_counter()
+            if rings.rx.push(cols, n, payload=scratch):
+                push_log[seq] = (t, is_pri, n)
+                seq_box[0] += 1
+                counts["offered_pri" if is_pri else "offered_bulk"] += n
+            else:
+                counts["pushed_fail"] += 1
+
+        def producer() -> None:
+            t0 = time.perf_counter()
+            bulk_credit = pri_credit = 0.0
+            last = t0
+            while not stop.is_set():
+                now = time.perf_counter()
+                dt, last = now - last, now
+                fps = bulk_fps
+                if square is not None:
+                    hi, lo, half = square
+                    fps = hi if int((now - t0) / half) % 2 == 0 else lo
+                bulk_credit = min(bulk_credit + fps * dt, 64.0)
+                pri_credit = min(pri_credit + pri_fps * dt, 8.0)
+                while pri_credit >= 1.0:
+                    push(pri_wire, True)
+                    pri_credit -= 1.0
+                while bulk_credit >= 1.0:
+                    push(bulk_wire, False)
+                    bulk_credit -= 1.0
+                time.sleep(0.001)
+
+        def drain_one() -> bool:
+            g = rings.tx.peek()
+            if g is None:
+                return False
+            seq = int(g.cols["meta"][0])
+            rings.tx.release()
+            rec = push_log.pop(seq, None)
+            if rec is not None:
+                t_push, is_pri, n = rec
+                lat["pri" if is_pri else "bulk"].append(
+                    time.perf_counter() - t_push)
+                counts["delivered_pri" if is_pri
+                       else "delivered_bulk"] += n
+            return True
+
+        prod = threading.Thread(target=producer, daemon=True)
+        t_start = time.perf_counter()
+        prod.start()
+        while time.perf_counter() < t_start + duration:
+            if not drain_one():
+                time.sleep(0.0002)
+        stop.set()
+        prod.join()
+        # bounded flush: shed frames never reach tx, so idle silence
+        # (not an empty push_log) ends the drain
+        idle_since = None
+        flush_deadline = time.perf_counter() + 8.0
+        while push_log and time.perf_counter() < flush_deadline:
+            if drain_one():
+                idle_since = None
+                continue
+            now = time.perf_counter()
+            if idle_since is None:
+                idle_since = now
+            elif now - idle_since > 1.0:
+                break
+            time.sleep(0.002)
+        elapsed = time.perf_counter() - t_start
+        pump.stop()
+        s = dict(pump.stats)
+        rings.close()
+
+        def pcts(xs):
+            if not xs:
+                return 0.0, 0.0, 0.0
+            a = np.asarray(xs) * 1e6
+            return (float(np.percentile(a, 50)),
+                    float(np.percentile(a, 99)),
+                    float(np.percentile(a, 99.9)))
+
+        p50a, p99a, p999a = pcts(lat["pri"] + lat["bulk"])
+        p50p, p99p, p999p = pcts(lat["pri"])
+        offered = counts["offered_bulk"] + counts["offered_pri"]
+        return {
+            "p50_us": round(p50a, 1), "p99_us": round(p99a, 1),
+            "p999_us": round(p999a, 1),
+            "pri_p50_us": round(p50p, 1), "pri_p99_us": round(p99p, 1),
+            "pri_p999_us": round(p999p, 1),
+            "bulk_goodput_fps": round(
+                len(lat["bulk"]) / max(elapsed, 1e-9), 1),
+            "bulk_delivered_pkts": counts["delivered_bulk"],
+            "offered_pkts": offered,
+            "shed_pct": round(100.0 * int(s.get("drops_overload", 0))
+                              / max(offered, 1), 2),
+            "preempts": int(s.get("priority_preempts", 0)),
+            "io_callbacks": int(s.get("io_callbacks", 0)),
+            "mode": (gov.snapshot()["mode"] if gov is not None
+                     else "off"),
+            "frames_drained": len(lat["pri"]) + len(lat["bulk"]),
+        }
+
+    out = {"latency_slo_frame_pkts": frame_pkts}
+    # (1) lone-frame floor: a paced priority-only trickle — the
+    # latency the reflex lane is entitled to
+    floor = capture(bulk_fps=0, pri_fps=50, duration=rung_s)
+    floor_us = max(floor["pri_p50_us"], 1.0)
+    out["latency_slo_floor_us"] = round(floor_us, 1)
+    # every later capture must reuse the already-compiled ring
+    # variants: the governor is host-side shaping ONLY
+    jit_labels0 = set(jit_compile_totals())
+    # (2) harness saturation rate (unpaced bulk)
+    sat = capture(bulk_fps=1e9, pri_fps=0, duration=1.5)
+    sat_fps = max(sat["bulk_goodput_fps"], 1.0)
+    out["latency_slo_sat_fps"] = round(sat_fps, 1)
+    slo_us = 2.0 * floor_us
+    out["latency_slo_us"] = round(slo_us, 1)
+    # (3) the offered-load ladder x {ungoverned, governed}
+    ladder = []
+    io_callbacks = 0
+    for pct in (50, 80, 95, 120):
+        for governed in (False, True):
+            row = capture(bulk_fps=sat_fps * pct / 100.0, pri_fps=50,
+                          duration=rung_s,
+                          slo_us=slo_us if governed else 0)
+            row["load_pct"] = pct
+            row["governed"] = int(governed)
+            io_callbacks += row.pop("io_callbacks")
+            ladder.append(row)
+    out["latency_slo_ladder"] = ladder
+
+    def _row(pct, governed):
+        return next(r for r in ladder
+                    if r["load_pct"] == pct and r["governed"] == governed)
+
+    g95, u95 = _row(95, 1), _row(95, 0)
+    # all three headline quantiles are the PRIORITY lane's (the key
+    # table's contract) — the combined distribution is bulk-dominated
+    # at this rung and lives in the ladder rows as p*_us
+    out["latency_slo_p50_us"] = g95["pri_p50_us"]
+    out["latency_slo_p99_us"] = g95["pri_p99_us"]
+    out["latency_slo_p999_us"] = g95["pri_p999_us"]
+    out["latency_slo_p99_vs_floor_x"] = round(
+        g95["pri_p99_us"] / max(floor_us, 1e-9), 2)
+    out["latency_slo_p99_vs_ungoverned_x"] = round(
+        u95["pri_p99_us"] / max(g95["pri_p99_us"], 1e-9), 2)
+    out["latency_slo_goodput_ratio"] = round(
+        g95["bulk_delivered_pkts"] / max(u95["bulk_delivered_pkts"], 1),
+        3)
+    out["latency_slo_shed_pct"] = _row(120, 1)["shed_pct"]
+    out["latency_slo_ungoverned_p99_us"] = u95["p99_us"]
+    # (4) tail amplification under burst: square-wave offered load
+    # (130% / 10% of saturation), priority lane paced through it
+    for governed in (False, True):
+        row = capture(bulk_fps=0, pri_fps=50, duration=2.4,
+                      slo_us=slo_us if governed else 0,
+                      square=(sat_fps * 1.3, sat_fps * 0.1, 0.3))
+        key = "governed" if governed else "ungoverned"
+        out[f"latency_slo_burst_p99_us_{key}"] = row["pri_p99_us"]
+        io_callbacks += row["io_callbacks"]
+    out["latency_slo_burst_amplification_x"] = round(
+        out["latency_slo_burst_p99_us_ungoverned"]
+        / max(out["latency_slo_burst_p99_us_governed"], 1e-9), 2)
+    out["latency_slo_io_callbacks"] = io_callbacks
+    out["latency_slo_new_step_variants"] = len(
+        set(jit_compile_totals()) - jit_labels0)
+    _progress(latency_slo_p99_us=out["latency_slo_p99_us"],
+              latency_slo_floor_us=out["latency_slo_floor_us"],
+              latency_slo_goodput_ratio=out["latency_slo_goodput_ratio"],
+              latency_slo_shed_pct=out["latency_slo_shed_pct"])
     return out
 
 
@@ -1339,16 +1582,17 @@ def snapshot_bench(args, batch: int = 2048, iters: int = 24) -> dict:
     return out
 
 
-def wire_udp(i: int) -> bytes:
+def wire_udp(i: int, dport: int = 80) -> bytes:
     """One test UDP frame 10.1.1.2 → 10.1.1.3 (shared by the ring bench
-    and the daemon-bench sender subprocess)."""
+    and the daemon-bench sender subprocess; ``dport`` lets the
+    latency-SLO ladder tag priority-lane traffic)."""
     import ipaddress
     import struct
 
     src = ipaddress.ip_address("10.1.1.2").packed
     dst = ipaddress.ip_address("10.1.1.3").packed
     eth = b"\x02\x00\x00\x00\x00\x02\x02\x00\x00\x00\x00\x01\x08\x00"
-    l4 = struct.pack("!HHHH", 40000 + (i % 1024), 80, 16, 0) + b"y" * 8
+    l4 = struct.pack("!HHHH", 40000 + (i % 1024), dport, 16, 0) + b"y" * 8
     hdr = struct.pack("!BBHHHBBH4s4s", 0x45, 0, 20 + len(l4), i & 0xFFFF,
                       0x4000, 64, 17, 0, src, dst)
     return eth + hdr + l4
@@ -1575,13 +1819,24 @@ def io_ring_bench(args, frame_pkts: int = 256,
         # throughput. Failures here must not void the dispatch-mode
         # numbers above.
         try:
-            ppump = DataplanePump(dp, rings, mode="persistent")
+            # a telemetry-enabled twin of the forwarding dataplane:
+            # the persistent round then histograms per-packet wire
+            # latency ON DEVICE while the harness measures the same
+            # frames host-side — the two tails are tied below (ISSUE
+            # 13 satellite) so governor acceptance can trust one
+            # source. A separate dp keeps the dispatch-mode rows
+            # above byte-comparable with earlier rounds.
+            dp_tel = build_fwd_dataplane(telemetry="latency")
+            ppump = DataplanePump(dp_tel, rings, mode="persistent")
             try:
                 ppump.warm()
                 ppump.start()
                 warm_barrier()
                 psat = run_phase(min(sat_s, 4.0))
                 pfps = psat["drained"] / psat["elapsed"]
+                tel_before = ppump.tel_snapshot()
+                bins0 = (np.asarray(tel_before["bins"], np.int64)
+                         if tel_before is not None else None)
                 ppaced = run_phase(min(paced_s, 4.0),
                                    pace_fps=max(pfps * 0.5, 1.0))
                 plat_us = (np.asarray(ppaced["lat"][5:]) * 1e6
@@ -1612,6 +1867,32 @@ def io_ring_bench(args, frame_pkts: int = 256,
                         int(ppump.stats.get("io_callbacks", 0))
                         / max(1, rwin), 4),
                 })
+                # host↔device latency tie (ISSUE 13 satellite): the
+                # host-side p99 (ring-to-ring, sequence-stamped) and
+                # the device-histogram p99 (pack → device tx-append)
+                # from the SAME paced round. The host leg is a strict
+                # superset (rx-ring wait + result fetch + tx write +
+                # drain), so a ratio far above 2 — or below 1 — means
+                # one of the two clocks is lying and neither source
+                # should anchor governor acceptance.
+                tel_after = ppump.tel_snapshot()
+                if tel_after is not None and bins0 is not None:
+                    from vpp_tpu.ops.telemetry import quantiles_from_bins
+
+                    dbins = (np.asarray(tel_after["bins"], np.int64)
+                             - bins0)
+                    if int(dbins.sum()) > 0:
+                        _d50, d99, _d999 = quantiles_from_bins(dbins)
+                        host_p99 = float(np.percentile(plat_us, 99))
+                        ratio = (host_p99 / d99) if d99 > 0 else 0.0
+                        out.update({
+                            "wire_latency_p99_us_device_wire": round(
+                                d99, 1),
+                            "wire_latency_host_vs_device_ratio": round(
+                                ratio, 3),
+                            "wire_latency_host_device_divergent": int(
+                                ratio > 2.0 or (0 < ratio < 1.0)),
+                        })
             finally:
                 ppump.stop()
         except Exception as exc:  # noqa: BLE001 — report, keep section
@@ -2792,6 +3073,20 @@ def _run():
             pri["io_ring_bench_error"] = f"{type(e).__name__}: {e}"
         _jc_now = _jit_compiles_now()
         pri["io_ring_jit_compiles"] = _jc_now - _jc
+        _jc = _jc_now
+        _progress(**pri)
+        try:
+            # reflex-plane latency governor (ISSUE 13): the priority
+            # ladder at 50/80/95/120% of sat x {ungoverned, governed}
+            # + the square-wave burst scenario (acceptance: governed
+            # priority p99 <= 2x the lone-frame floor,
+            # latency_slo_goodput_ratio >= 0.9, io_callbacks == 0,
+            # zero new step variants)
+            pri.update(latency_slo_bench(args))
+        except Exception as e:  # noqa: BLE001
+            pri["latency_slo_bench_error"] = f"{type(e).__name__}: {e}"
+        _jc_now = _jit_compiles_now()
+        pri["latency_slo_jit_compiles"] = _jc_now - _jc
         _jc = _jc_now
         _progress(**pri)
         try:

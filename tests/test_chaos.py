@@ -599,3 +599,148 @@ class TestMlModelRefusals:
         assert st["outcomes"]["loaded"] == 2
         assert sum(st["outcomes"].values()) == changed_polls == \
             refusals + 2
+
+
+# --------------------------------------------------------------------
+# schedule 6: latency-governor faults (ISSUE 13)
+# --------------------------------------------------------------------
+
+
+def _governed_pump(rings, dp, **kw):
+    from vpp_tpu.io.governor import LatencyGovernor, PriorityFilter
+
+    gov = LatencyGovernor(kw.pop("slo_us", 300), tick_s=0.005,
+                          brownout_ticks=2, recover_ticks=3)
+    pump = DataplanePump(dp, rings, mode="persistent", governor=gov,
+                         priority=PriorityFilter(ports=(9999,)), **kw)
+    return pump, gov
+
+
+def _push_mixed(rings, rx_if, n_bulk, tag0):
+    """n_bulk 4-pkt bulk frames + one 1-pkt priority frame (dport
+    9999); returns offered packets."""
+    codec = PacketCodec()
+    scratch = np.zeros((VEC, rings.rx.snap), np.uint8)
+    pkts = 0
+    for k in range(n_bulk):
+        frames = [make_frame(CLIENT_IP, SERVER_IP, proto=17,
+                             sport=tag0 + k, dport=2000 + k * 4 + j)
+                  for j in range(4)]
+        cols, n = codec.parse(frames, rx_if, scratch)
+        assert rings.rx.push(cols, n, payload=scratch)
+        pkts += n
+    frames = [make_frame(CLIENT_IP, SERVER_IP, proto=17,
+                         sport=tag0 + 999, dport=9999)]
+    cols, n = codec.parse(frames, rx_if, scratch)
+    assert rings.rx.push(cols, n, payload=scratch)
+    return pkts + n
+
+
+def _governed_accounted(pump):
+    s = pump.stats
+    return (s["pkts"] + s["drops_error"] + s["drops_shutdown"]
+            + s["drops_tx_stall"] + s["drops_rx_full"]
+            + s["drops_overload"])
+
+
+class TestGovernorChaos:
+    def test_governor_crash_mid_burst_freezes_shape_conserves(self):
+        """The ``governor.tick`` seam: the control loop crashing
+        mid-burst must WEDGE the governor at the last-known window
+        shape — one-way, degraded{component=governor} — while the
+        pump keeps forwarding with EXACT packet conservation:
+        delivered + drops_overload + drops_tx_stall + drops_shutdown
+        (+ error/rx_full) == offered."""
+        dp, a, b = _forwarding_dp()
+        rings = IORingPair(n_slots=64)
+        plan = faults.install(faults.FaultPlan(seed=SEED + 7))
+        # a few healthy ticks, then the control loop dies forever
+        plan.inject("governor.tick", after=3, times=-1)
+        pump, gov = _governed_pump(rings, dp)
+        pump.start()
+        try:
+            offered = 0
+            k = 0
+            deadline = time.monotonic() + 120.0
+            while not gov.snapshot()["wedged"]:
+                assert time.monotonic() < deadline, \
+                    "governor never wedged"
+                offered += _push_mixed(rings, a, 3, 40000 + 16 * k)
+                k += 1
+                # drain so the 64-slot tx ring never stalls the run
+                while rings.tx.peek() is not None:
+                    rings.tx.release()
+                time.sleep(0.03)
+            shape = (gov.snapshot()["fill"], gov.snapshot()["inflight"])
+            ticks_at_wedge = gov.snapshot()["ticks"]
+            # the wedged governor must freeze: keep offering traffic,
+            # the pump stays alive at the frozen shape
+            offered += _push_mixed(rings, a, 6, 48000)
+            deadline = time.monotonic() + 180.0
+            while _governed_accounted(pump) < offered \
+                    and time.monotonic() < deadline:
+                while rings.tx.peek() is not None:
+                    rings.tx.release()
+                time.sleep(0.02)
+            while rings.tx.peek() is not None:
+                rings.tx.release()
+            assert pump.stop(join_timeout=60.0)
+            s = pump.stats
+            assert _governed_accounted(pump) == offered, dict(s)
+            assert s["pkts"] > 0  # post-wedge delivery happened
+            snap = gov.snapshot()
+            assert snap["wedged"]
+            assert (snap["fill"], snap["inflight"]) == shape
+            assert snap["ticks"] == ticks_at_wedge  # frozen, no drift
+            assert plan.fired("governor.tick") >= 3
+            # degraded component flips (and ONLY for the governor)
+            from vpp_tpu.stats.collector import StatsCollector
+
+            coll = StatsCollector(dp)
+            coll.set_pump(pump)
+            coll.publish()
+            text = "\n".join(
+                line for _p, fam in coll.registry.families()
+                for line in fam.render())
+            assert 'vpp_tpu_degraded{component="governor"} 1' in text
+            assert 'vpp_tpu_degraded{component="ring"} 0' in text
+        finally:
+            pump.stop(join_timeout=30.0)
+            rings.close()
+
+    def test_priority_starvation_fault_conserves(self):
+        """The ``pump.priority_starve`` seam: flagged frames demoted
+        to bulk lose their lane (no express routing, sheddable like
+        bulk) but NEVER their conservation — every offered packet is
+        delivered or attributed after the schedule."""
+        dp, a, b = _forwarding_dp()
+        rings = IORingPair(n_slots=64)
+        plan = faults.install(faults.FaultPlan(seed=SEED + 8))
+        plan.inject("pump.priority_starve", times=-1)
+        pump, gov = _governed_pump(rings, dp)
+        pump.start()
+        try:
+            offered = 0
+            for k in range(8):
+                offered += _push_mixed(rings, a, 3, 52000 + 16 * k)
+                time.sleep(0.05)
+            deadline = time.monotonic() + 180.0
+            while _governed_accounted(pump) < offered \
+                    and time.monotonic() < deadline:
+                while rings.tx.peek() is not None:
+                    rings.tx.release()
+                time.sleep(0.02)
+            while rings.tx.peek() is not None:
+                rings.tx.release()
+            assert pump.stop(join_timeout=60.0)
+            s = pump.stats
+            assert _governed_accounted(pump) == offered, dict(s)
+            # the starve seam really demoted the lane: no frame was
+            # routed express, and the demotions were counted
+            assert plan.fired("pump.priority_starve") >= 8
+            assert s["priority_frames"] == 0
+            assert s["priority_starved"] >= 8
+            assert not gov.snapshot()["wedged"]  # only the lane faulted
+        finally:
+            pump.stop(join_timeout=30.0)
+            rings.close()

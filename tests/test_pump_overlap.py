@@ -137,7 +137,7 @@ class TestSlowFetchOverlap:
             assert pump.stats["inflight_peak"] <= cap
             assert pump.stats["inflight"] >= 1  # window actually in use
             with pump._held_lock:
-                held = pump._held
+                held = len(pump._taken) + len(pump._done_rids)
             assert held < n_frames  # backlog stayed in the ring
             # and the backlog still drains loss-free afterwards
             got = drain(rings, n_frames)

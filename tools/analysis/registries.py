@@ -120,6 +120,44 @@ def counters_lint() -> list:
                 f"counters: family {name!r} is in the pipeline "
                 f"namespace but maps to no StepStats field"
             )
+    # drop-cause parity (ISSUE 13): every pump drop-stats key must
+    # export a reason label on vpp_tpu_pump_drops_total, and vice
+    # versa — a drop cause added on either side without its twin is a
+    # silent-loss regression waiting to happen
+    from vpp_tpu.io.pump import PUMP_DROP_KEYS
+    from vpp_tpu.stats.collector import PUMP_DROP_REASONS
+
+    reason_keys = {k for k, _r in PUMP_DROP_REASONS}
+    for k in sorted(set(PUMP_DROP_KEYS) - reason_keys):
+        problems.append(
+            f"counters: pump drop key {k!r} has no reason label on "
+            f"vpp_tpu_pump_drops_total (stats/collector.py "
+            f"PUMP_DROP_REASONS)")
+    for k in sorted(reason_keys - set(PUMP_DROP_KEYS)):
+        problems.append(
+            f"counters: PUMP_DROP_REASONS maps {k!r} which is not an "
+            f"io/pump.py PUMP_DROP_KEYS drop key (stale entry?)")
+    # governor scalar parity (ISSUE 13): every control-loop snapshot
+    # scalar the governor declares must export a registered gauge,
+    # and every mapped gauge must exist in a live snapshot
+    from vpp_tpu.io.governor import LatencyGovernor
+    from vpp_tpu.stats.collector import GOVERNOR_STAT_GAUGES
+
+    snap = LatencyGovernor(1000.0, slots=8, max_inflight=8).snapshot()
+    mapped_keys = {k for k, _n, _h in GOVERNOR_STAT_GAUGES}
+    for k in sorted(set(LatencyGovernor.SNAPSHOT_SCALARS) - mapped_keys):
+        problems.append(
+            f"counters: governor scalar {k!r} has no gauge mapping "
+            f"(stats/collector.py GOVERNOR_STAT_GAUGES)")
+    for k, name, _h in GOVERNOR_STAT_GAUGES:
+        if k not in snap:
+            problems.append(
+                f"counters: GOVERNOR_STAT_GAUGES maps {k!r} which the "
+                f"governor snapshot does not carry (stale entry?)")
+        if name not in registered:
+            problems.append(
+                f"counters: governor scalar {k!r} maps to "
+                f"unregistered family {name!r}")
     return problems
 
 

@@ -46,6 +46,11 @@ THREAD_ROOTS = (
     # IO daemon's rx/tx threads
     "vpp_tpu/io/rings.py",
     "vpp_tpu/io/daemon.py",
+    # ISSUE 13: the latency governor's control state is written by
+    # the pump's dispatch-thread ticks and snapshotted by the
+    # collector/CLI; the priority filter's dynamic flow marks are
+    # written from the ML mirror path
+    "vpp_tpu/io/governor.py",
     "vpp_tpu/kvstore",
     "vpp_tpu/stats",
     "vpp_tpu/trace",

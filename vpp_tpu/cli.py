@@ -64,6 +64,7 @@ class DebugCLI:
             ("show", "ml"): self.show_ml,
             ("show", "latency"): self.show_latency,
             ("show", "top-flows"): self.show_top_flows,
+            ("show", "governor"): self.show_governor,
             ("show", "io"): self.show_io,
             ("show", "neighbors"): self.show_neighbors,
             ("show", "store"): self.show_store,
@@ -94,7 +95,7 @@ class DebugCLI:
             "show partitions | "
             "show nat44 | show fib | show trace | show errors | "
             "show fastpath | show ml | show latency | show top-flows | "
-            "show io | show neighbors | "
+            "show governor | show io | show neighbors | "
             "show store | "
             "show resilience | show config-history [n] | show spans [n] | "
             "trace add [n] | trace clear | config replay <journal> | "
@@ -885,6 +886,59 @@ class DebugCLI:
             lines.append("  (no candidates elected yet)")
         return "\n".join(lines)
 
+    def show_governor(self) -> str:
+        """Reflex-plane latency governor state (ISSUE 13;
+        io/governor.py): operating mode, the live window shape on the
+        ladder, the last control observation, the priority lane's
+        counters and the attributed overload shedding — all host
+        scalars (the PR 6 rule: nothing crosses the device
+        transport for a debug page)."""
+        pump = self.pump
+        gov = getattr(pump, "governor", None) if pump is not None \
+            else None
+        if gov is None:
+            return ("no latency governor attached "
+                    "(io.latency_slo_us = 0 — open-loop pump)")
+        s = gov.snapshot()
+        lines = [
+            f"governor: mode {s['mode']}"
+            + (" (WEDGED — window shape frozen)" if s['wedged'] else "")
+            + (", shedding bulk" if s['shedding'] else ""),
+            f"slo: {s['slo_us']:.0f}us, hysteresis band "
+            f"[{s['slo_us'] * (1 - gov.hysteresis_pct / 100.0):.0f}, "
+            f"{s['slo_us']:.0f}]us",
+            f"window shape: level {s['level']}/{s['levels'] - 1}, "
+            f"fill {s['fill']} slots, inflight {s['inflight']}",
+            f"last observation: p99 {s['last_p99_us']:.0f}us, "
+            f"queue-est {s['queue_est_us']:.0f}us "
+            f"(t_svc {s['t_svc_us']:.0f}us/frame), "
+            f"avg window fill {s['fill_avg']:.2f}",
+            f"control loop: {s['ticks']} ticks "
+            f"({s['tick_errors']} errors), steps "
+            f"{s['adjust_down']} down / {s['adjust_up']} up, "
+            f"transitions " + ", ".join(
+                f"{m} {n}" for m, n in sorted(s["transitions"].items())),
+        ]
+        ps = pump.stats
+        lines.append(
+            f"priority lane: {ps.get('priority_frames', 0)} frames / "
+            f"{ps.get('priority_pkts', 0)} pkts, "
+            f"{ps.get('priority_preempts', 0)} window preempts, "
+            f"{ps.get('priority_starved', 0)} starved (fault seam)"
+        )
+        pf = getattr(pump, "priority", None)
+        if pf is not None:
+            lines.append(
+                f"priority rules: {pf.ports.size} ports, "
+                f"{pf.prefix_count()} prefixes, {pf.protos.size} "
+                f"protos, {pf.flow_count()} marked flows"
+            )
+        lines.append(
+            f"overload shed: {ps.get('drops_overload', 0)} pkts "
+            f"(drops_total{{reason=\"overload\"}})"
+        )
+        return "\n".join(lines)
+
     def show_io(self) -> str:
         """Pump + IO-daemon counters (the `show interface rx-placement`
         / vector-rates analog for the host IO path)."""
@@ -934,14 +988,16 @@ class DebugCLI:
                 )
             drops = {k: int(s.get(k, 0)) for k in
                      ("drops_rx_full", "drops_tx_stall",
-                      "drops_shutdown", "drops_error")}
+                      "drops_shutdown", "drops_error",
+                      "drops_overload")}
             if any(drops.values()):
                 lines.append(
                     "pump drops by cause (pkts): "
                     f"rx-full {drops['drops_rx_full']}, "
                     f"tx-stall {drops['drops_tx_stall']}, "
                     f"shutdown {drops['drops_shutdown']}, "
-                    f"error {drops['drops_error']}"
+                    f"error {drops['drops_error']}, "
+                    f"overload {drops['drops_overload']}"
                 )
             if "t_pack" in s:
                 # stage seconds: fetch_wait is overlapped wait (the

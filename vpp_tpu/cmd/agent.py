@@ -335,6 +335,31 @@ class ContivAgent:
                 n_slots=c.io.n_slots, snap=c.io.snap,
                 shm_name=c.io.shm_name or None, create=True,
             )
+            # reflex-plane latency governor + priority lane (ISSUE
+            # 13; io/governor.py): built only when configured — an
+            # SLO of 0 keeps the open-loop pump, and the priority
+            # lane works with or without the governor
+            governor = None
+            if c.io.latency_slo_us > 0:
+                from vpp_tpu.io.governor import LatencyGovernor
+
+                governor = LatencyGovernor(
+                    c.io.latency_slo_us,
+                    tick_s=c.io.governor_tick_s,
+                    hysteresis_pct=c.io.governor_hysteresis_pct,
+                    brownout_ticks=c.io.governor_brownout_ticks,
+                    recover_ticks=c.io.governor_recover_ticks,
+                )
+            priority = None
+            if (c.io.priority_ports or c.io.priority_prefixes
+                    or c.io.priority_protos):
+                from vpp_tpu.io.governor import PriorityFilter
+
+                priority = PriorityFilter(
+                    ports=c.io.priority_ports,
+                    prefixes=c.io.priority_prefixes,
+                    protos=c.io.priority_protos,
+                )
             self.io_pump = DataplanePump(
                 self.dataplane, self.io_rings,
                 max_batch=c.io.max_batch, depth=c.io.depth,
@@ -346,6 +371,8 @@ class ContivAgent:
                 ring_slots=c.io.io_ring_slots,
                 ring_windows=c.io.io_ring_windows,
                 ring_fault_limit=c.io.io_ring_fault_limit,
+                governor=governor,
+                priority=priority,
                 # ICMP errors (time-exceeded/unreachable) originate from
                 # the node's pod gateway address — the hop traceroute
                 # shows (reference: VPP ip4-icmp-error)

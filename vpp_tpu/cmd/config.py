@@ -73,6 +73,36 @@ class IOConfig:
     # alive; vpp_tpu_degraded{component="ring"} flips). 0 = never fall
     # back: relaunch forever, paced by a jittered backoff.
     io_ring_fault_limit: int = 3
+    # Reflex-plane latency governor (ISSUE 13; io/governor.py): an
+    # explicit wire-latency SLO in microseconds closes the loop on the
+    # pump's window shaping — the governor adapts window fill,
+    # coalescing and in-flight depth between the 1-slot lone-frame
+    # floor and the full backlog fill, and in brownout sheds bulk
+    # admission as attributed drops_overload. 0 disables (open-loop
+    # pump, the pre-13 behavior). Host-side only: governing never
+    # traces a new step variant.
+    latency_slo_us: int = 0
+    # control-loop cadence and anti-oscillation guards (docs/LATENCY.md
+    # round 13 has the control-law math): hysteresis_pct widens the
+    # dead band below the SLO (no adjustment while p99 sits inside
+    # it); brownout_ticks = consecutive over-SLO ticks with no step
+    # left before shedding engages; recover_ticks = consecutive
+    # under-band ticks per recovery step (slow up, fast down).
+    governor_tick_s: float = 0.05
+    governor_hysteresis_pct: float = 30.0
+    governor_brownout_ticks: int = 3
+    governor_recover_ticks: int = 5
+    # Priority lane (ISSUE 13; io/governor.py PriorityFilter): flows
+    # matching any rule are reflex traffic — they form their own
+    # coalesce groups, preempt bulk ring windows, and are never shed.
+    # ports match sport OR dport; prefixes (IPv4 CIDR strings) match
+    # src OR dst; protos are IP protocol numbers. Runtime code can
+    # additionally mark (src, dst) host pairs via
+    # PriorityFilter.mark_flow — the hook an ML-mirror consumer would
+    # use (not auto-wired yet; ROADMAP item 4).
+    priority_ports: list = dataclasses.field(default_factory=list)
+    priority_prefixes: list = dataclasses.field(default_factory=list)
+    priority_protos: list = dataclasses.field(default_factory=list)
     # node uplink (vpp-tpu-init bootstrap; reference contiv-init
     # vppcfg.go:74-559): kernel NIC the IO daemon binds as the uplink
     uplink_interface: str = ""
@@ -261,6 +291,12 @@ class AgentConfig:
 
             validate_ring_geometry(d["io"].io_ring_slots,
                                    d["io"].io_ring_windows)
+            # governor/priority knobs fail at load too (ISSUE 13):
+            # bad SLO bounds or an unparsable priority CIDR is a
+            # config error, not a first-tick surprise
+            from vpp_tpu.io.governor import validate_governor_config
+
+            validate_governor_config(d["io"])
         build_section(
             "mesh", MeshConfig,
             {f.name for f in dataclasses.fields(MeshConfig)},

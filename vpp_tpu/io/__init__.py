@@ -19,9 +19,11 @@ from vpp_tpu.io.transport import (
     Transport,
 )
 from vpp_tpu.io.daemon import IODaemon
+from vpp_tpu.io.governor import LatencyGovernor, PriorityFilter
 from vpp_tpu.io.pump import DataplanePump
 
 __all__ = [
     "IORing", "IORingPair", "Transport", "AfPacketTransport",
     "TapTransport", "SocketPairTransport", "IODaemon", "DataplanePump",
+    "LatencyGovernor", "PriorityFilter",
 ]

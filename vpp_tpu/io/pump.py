@@ -102,10 +102,33 @@ log = logging.getLogger("pump")
 
 _SENTINEL = object()
 
+# Drop-cause stats keys — one per attributed loss reason. The
+# collector's vpp_tpu_pump_drops_total reason map
+# (stats/collector.py PUMP_DROP_REASONS) must stay in lockstep; the
+# tools/lint.py --counters pass enforces it (ISSUE 13 satellite), so a
+# new drop cause added on either side without its twin fails tier-1.
+PUMP_DROP_KEYS = ("drops_rx_full", "drops_tx_stall", "drops_shutdown",
+                  "drops_error", "drops_overload")
+
+# governor ticks a quiet priority lane holds its last p99 observation
+# for before reading as no-signal (io/pump.py _gov_observe lane
+# discipline — the governor then drifts back to the resting shape)
+GOV_PRI_STALE_TICKS = 20
+
 # duck-typed stand-in for rings.Frame: push_packed only reads .cols
 # (contiguous column block views), .n and .payload
 _IcmpFrame = collections.namedtuple("_IcmpFrame",
                                     ("cols", "n", "epoch", "payload"))
+
+# rings.Frame plus its stable ring-order id (rid = frames ever
+# released before it + its pending index — stable for a frame's whole
+# lifetime). The express priority lane (ISSUE 13) dispatches OUT of
+# ring order, but the SPSC rx ring can only release its oldest slot —
+# so the writer marks frames done by rid and releases the contiguous
+# done-prefix (_release_done), never a slot whose predecessors are
+# still in flight.
+_RidFrame = collections.namedtuple(
+    "_RidFrame", ("cols", "n", "epoch", "payload", "rid"))
 
 
 class DataplanePump:
@@ -123,7 +146,9 @@ class DataplanePump:
                  fetch_delay: Union[None, float, Callable] = None,
                  ring_slots: int = 8,
                  ring_windows: int = 2,
-                 ring_fault_limit: int = 3):
+                 ring_fault_limit: int = 3,
+                 governor=None,
+                 priority=None):
         """``max_batch``: largest coalesced device batch (packets);
         ``max_inflight``: in-flight batches before the dispatch stage
         backpressures (``depth`` is the legacy alias — ``max_inflight``
@@ -166,7 +191,15 @@ class DataplanePump:
         disables the fallback entirely: the ring relaunches forever,
         paced by a jittered backoff (note: the pre-ISSUE-8 code
         relaunched exactly once and let a second death kill the
-        dispatch thread — 0 keeps the pump alive instead)."""
+        dispatch thread — 0 keeps the pump alive instead).
+        ``governor``: optional io/governor.py LatencyGovernor (ISSUE
+        13) — the closed-loop SLO controller; the pump binds it to its
+        geometry, ticks it on the dispatch thread, applies its window
+        fill / in-flight / coalesce limits host-side, and sheds bulk
+        admission in brownout as attributed ``drops_overload``.
+        ``priority``: optional PriorityFilter designating reflex
+        flows: they form their own coalesce groups, preempt bulk
+        windows in the ring staging path, and are never shed."""
         if mode not in ("dispatch", "persistent"):
             raise ValueError(f"unknown pump mode {mode!r}")
         self.mode = mode
@@ -277,9 +310,19 @@ class DataplanePump:
             # rx_full = rx-ring overflow — counted by the IO daemon
             # (io/daemon.py drops_rx_full; the pump's own key stays 0
             # and exists so the vpp_tpu_pump_drops_total{reason=}
-            # family always exports every reason)
+            # family always exports every reason),
+            # overload = bulk frames the latency governor refused at
+            # admission in brownout (ISSUE 13 — shedding is explicit
+            # and attributed, never silent queue growth)
             "drops_tx_stall": 0, "drops_shutdown": 0, "drops_rx_full": 0,
-            "drops_error": 0,
+            "drops_error": 0, "drops_overload": 0,
+            # priority lane (ISSUE 13): frames/packets classified into
+            # the reflex lane by the PriorityFilter, windows the ring
+            # stager shipped early for one (synced from the
+            # PersistentPump), and priority marks the
+            # "pump.priority_starve" fault seam demoted to bulk
+            "priority_frames": 0, "priority_pkts": 0,
+            "priority_preempts": 0, "priority_starved": 0,
             # device-ring telemetry (persistent mode; synced from the
             # PersistentPump by the collect loop + at stop-merge):
             # windows exchanged, frames staged, live in-flight windows,
@@ -299,6 +342,15 @@ class DataplanePump:
         # concurrent-writer stats (t_fetch*, inflight*): += is a
         # load/add/store that interleaves across fetch workers.
         self.batch_lat = collections.deque(maxlen=lat_window)
+        # the reflex lane's own dispatch→tx latency window (ISSUE 13):
+        # the governor steers on THIS distribution when a priority
+        # filter is attached — the SLO protects reflex traffic, so
+        # bulk batching latency must not drive the control loop into
+        # brownout while the lane itself meets the SLO. _pri_total
+        # counts appends so the observer can tell fresh samples from
+        # a quiet lane.
+        self.pri_lat = collections.deque(maxlen=1024)
+        self._pri_total = 0
         self._lat_lock = threading.Lock()
         # optional Prometheus Histogram (stats/collector.py set_pump):
         # every batch latency is observed as a real distribution —
@@ -312,17 +364,35 @@ class DataplanePump:
         self.fastpath_hist = None
         self._inflight: "queue.Queue" = queue.Queue(
             maxsize=self.max_inflight)
+        # express fast path through the fetch stage (ISSUE 13): the
+        # fetch workers drain this queue FIRST, so a priority batch
+        # waits for at most the fetch already in progress — never for
+        # the whole FIFO of queued bulk fetches
+        self._inflight_pri: "queue.Queue" = queue.Queue(
+            maxsize=self.max_inflight)
         # live fetch workers (under _lat_lock): the tx writer's
         # shutdown rescue engages only once every fetcher has exited
         self._fetchers_live = 0
         self._done: dict = {}               # seq -> completed batch
         self._done_cv = threading.Condition()
         self._seq = 0
-        # guards the peek-index arithmetic: held = frames peeked by
-        # dispatch but not yet released by the tx writer. Releases shift
-        # every pending index down, so both sides mutate under the lock.
+        # guards the rid bookkeeping shared by dispatch (takes) and
+        # the tx writer (completions + in-order releases). A release
+        # shifts every pending index down, but rids are stable:
+        # rid = _consumed_base + pending index.
+        #   _taken      rids routed into a group (incl. queued express)
+        #   _done_rids  rids completed by the writer, awaiting their
+        #               turn in the ring-order release prefix
+        #   _express    priority rids awaiting express dispatch (also
+        #               in _taken so bulk takes skip them)
+        #   _scan_rid   classification frontier: every pending frame
+        #               below it has been lane-classified exactly once
         self._held_lock = threading.Lock()
-        self._held = 0
+        self._taken: set = set()
+        self._done_rids: set = set()
+        self._express: "collections.deque" = collections.deque()
+        self._consumed_base = 0
+        self._scan_rid = 0
         # the tx frame ring is SPSC: its reserve/commit protocol
         # permits ONE producer. The in-order writer and the ICMP
         # error-path thread both push, so their pushes serialize here.
@@ -345,7 +415,37 @@ class DataplanePump:
         self.ring_slots = int(ring_slots)
         self.ring_windows = int(ring_windows)
         self._ring_accum = {"ring_windows": 0, "ring_frames": 0,
-                            "io_callbacks": 0}
+                            "io_callbacks": 0, "priority_preempts": 0}
+        # reflex-plane latency governor + priority lane (ISSUE 13;
+        # io/governor.py). The governor is HOST-SIDE ONLY: it shapes
+        # window fill / in-flight depth / coalesce caps and admission
+        # — all values the device programs already take dynamically —
+        # so a governed pump traces ZERO new step variants
+        # (jit-budget-proved in tests/test_governor.py). Ticked on the
+        # dispatch thread; a crashed governor wedges itself and the
+        # pump keeps the last-known window shape.
+        self.governor = governor
+        self.priority = priority
+        if governor is not None:
+            slots = (self.ring_slots if mode == "persistent"
+                     else max(1, self.max_batch // VEC))
+            # with a priority lane attached the governor runs in
+            # EXPRESS mode: brownout keys off the physical rx queue
+            # bound, not the reflex envelope (io/governor.py bind doc)
+            governor.bind(slots, self.max_inflight,
+                          queue_cap=(rings.rx.ring.n_slots // 2
+                                     if priority is not None else None))
+        # governor observation state (dispatch-thread only): last
+        # device-histogram bins (delta quantiles per tick) and the
+        # ring's last cumulative fill snapshot (recent avg occupancy)
+        self._gov_bins = None
+        self._gov_fill_last = (0, 0)
+        self._gov_pri_seen = 0
+        # last reflex-lane p99 + how many ticks it has been stale: a
+        # quiet lane holds its observation this many ticks, then
+        # reads as no-signal (never bulk fallback — lane discipline)
+        self._gov_pri_p99: Optional[float] = None
+        self._gov_pri_stale = 0
         # ring→dispatch degraded fallback (ISSUE 8): resident-ring
         # deaths counted over the pump lifetime (dispatch-thread-only,
         # so unlocked); degraded_ring is the one-way flag the
@@ -452,33 +552,119 @@ class DataplanePump:
             self.stats["inflight"] -= 1
 
     # --- dispatch: rx ring -> device (async) ---
+    def _frame_priority(self, f) -> bool:
+        """Classify one rx frame into the reflex lane (ISSUE 13;
+        io/governor.py PriorityFilter). The "pump.priority_starve"
+        fault seam demotes a matched frame to bulk — the chaos suite
+        proves starved priority traffic is still CONSERVED (delivered
+        or attributed), just unprioritized."""
+        if self.priority is None:
+            return False
+        if not self.priority.frame_match(f):
+            return False
+        try:
+            faults.fire("pump.priority_starve")
+        except faults.FaultInjected:
+            # dispatch-thread-only counter (like stats["batches"]);
+            # re-peeked frames may re-classify, so this counts starve
+            # EVENTS, not distinct frames
+            self.stats["priority_starved"] += 1
+            return False
+        return True
+
+    def _scan_express(self, rx, hold_cap: int) -> None:
+        """Advance the lane-classification frontier over newly arrived
+        frames and route priority ones to the express queue (ISSUE
+        13). Each frame is classified exactly ONCE (the frontier is
+        monotone in rid); express rids are marked taken immediately so
+        bulk takes skip them. The frontier STALLS (resumes next round)
+        while the express queue holds ``hold_cap`` rids, so an
+        all-priority burst backpressures the producer instead of
+        marking every ring slot taken at once. Classification runs
+        OUTSIDE _held_lock — the frame cannot be released before it is
+        taken and completed, so its views are stable, and the tx
+        writer's release path must not wait out numpy matching. No-op
+        without a priority filter."""
+        if self.priority is None:
+            return
+        while True:
+            with self._held_lock:
+                if len(self._express) >= hold_cap:
+                    return
+                base = self._consumed_base
+                rid = max(self._scan_rid, base)
+                if rid >= base + rx.pending():
+                    return
+                f = rx.peek_nth(rid - base)
+                if f is None:
+                    return
+                self._scan_rid = rid + 1
+            if self._frame_priority(f):
+                with self._held_lock:
+                    self._taken.add(rid)
+                    self._express.append(rid)
+                self.stats["priority_frames"] += 1
+                self.stats["priority_pkts"] += f.n
+
+    def _take_express(self, rx):
+        """Pop the oldest express rid into a one-frame group, or None.
+        The express lane is what actually bounds reflex queueing: a
+        priority frame deep behind a bulk backlog is dispatched NOW,
+        out of ring order, while its rx slot is released later in
+        ring order by the writer's done-prefix. Never refuses a
+        queued rid: express rids are already held, so popping frees
+        ring slots (dispatch → complete → release) — refusing under
+        pressure would wedge exactly the all-priority burst the lane
+        exists for."""
+        with self._held_lock:
+            if not self._express:
+                return None
+            rid = self._express.popleft()
+            f = rx.peek_nth(rid - self._consumed_base)
+            if f is None:  # unreachable: taken rids stay pending
+                self._taken.discard(rid)
+                return None
+            return [_RidFrame(f.cols, f.n, f.epoch, f.payload, rid)]
+
     def _take_groups(self, rx, hold_cap: int, chain_cap: int,
                      max_pkts: Optional[int] = None) -> list:
-        """Peek pending rx frames into coalesce groups by PACKET count:
-        a group closes when the next frame would overflow ``max_pkts``
-        packets (default ``max_batch``; persistent mode compacts at the
-        VEC descriptor-slot width). One group = one packed batch; 2+
-        groups = the chainer has a K-stack to fold. Holds _held_lock
-        across the whole peek block (a concurrent writer release shifts
-        pending indices)."""
+        """Peek pending BULK frames (in ring order, skipping rids the
+        express lane took) into coalesce groups by PACKET count: a
+        group closes when the next frame would overflow ``max_pkts``
+        packets (default ``max_batch``; persistent mode compacts at
+        the VEC descriptor-slot width). One group = one packed batch;
+        2+ groups = the chainer has a K-stack to fold. With a
+        priority filter attached, only frames below the
+        classification frontier are takeable (scan runs first each
+        loop). Holds _held_lock across the whole peek block (a
+        concurrent writer release shifts pending indices)."""
         if max_pkts is None:
             max_pkts = self.max_batch
         with self._held_lock:
-            held = self._held
-            budget = min(rx.pending() - held, hold_cap - held)
+            base = self._consumed_base
+            pending = rx.pending()
+            end_rid = (min(self._scan_rid, base + pending)
+                       if self.priority is not None else base + pending)
+            budget = hold_cap - len(self._taken) - len(self._done_rids)
             groups, cur, cur_n = [], [], 0
-            j = 0
-            while j < budget and len(groups) < chain_cap:
-                f = rx.peek_nth(held + j)
+            rid = base
+            while rid < end_rid and budget > 0 \
+                    and len(groups) < chain_cap:
+                if rid in self._taken or rid in self._done_rids:
+                    rid += 1
+                    continue
+                f = rx.peek_nth(rid - base)
                 if f is None:
                     break
                 if cur and cur_n + f.n > max_pkts:
                     groups.append(cur)
                     cur, cur_n = [], 0
                     continue
-                cur.append(f)
+                cur.append(_RidFrame(f.cols, f.n, f.epoch, f.payload,
+                                     rid))
                 cur_n += f.n
-                j += 1
+                budget -= 1
+                rid += 1
             if cur and len(groups) < chain_cap:
                 groups.append(cur)
             if len(groups) > 1:
@@ -486,8 +672,155 @@ class DataplanePump:
                 # of two — the precompiled ladder); untrimmed groups
                 # stay pending for the next dispatch
                 groups = groups[:1 << (len(groups).bit_length() - 1)]
-            self._held += sum(len(g) for g in groups)
+            for g in groups:
+                for f in g:
+                    self._taken.add(f.rid)
         return groups
+
+    def _untake(self, frames: list, priority: bool = False) -> None:
+        """Return un-dispatched frames to the takeable pool (the
+        ring-fault fallback path): bulk rids simply become untaken
+        (the front scan re-takes them in order); express rids go back
+        to the HEAD of the express queue, still marked taken."""
+        with self._held_lock:
+            if priority:
+                self._express.extendleft(f.rid for f in reversed(frames))
+            else:
+                for f in frames:
+                    self._taken.discard(f.rid)
+
+    def _release_done(self, groups: list) -> None:
+        """Writer-side completion: mark every frame done by rid, then
+        release the CONTIGUOUS done-prefix to the rx ring — the SPSC
+        ring only frees its oldest slot, and the express lane may
+        complete rids out of order, so a done frame waits for its
+        predecessors (its slot views stay valid exactly because the
+        release is deferred)."""
+        with self._held_lock:
+            for g in groups:
+                for f in g:
+                    self._done_rids.add(f.rid)
+                    self._taken.discard(f.rid)
+            while self._consumed_base in self._done_rids:
+                self._done_rids.discard(self._consumed_base)
+                self.rings.rx.release()
+                self._consumed_base += 1
+
+    def _backlog(self) -> int:
+        """Frames pending in the rx ring that no lane has taken yet —
+        the governor's queue-depth observation."""
+        with self._held_lock:
+            return (self.rings.rx.pending() - len(self._taken)
+                    - len(self._done_rids))
+
+    def _post_batchless(self, groups: list, drop_key: str) -> None:
+        """Hand frames to the writer as a BATCHLESS done-item (no tx
+        write — the slots still complete and release in ring order)
+        with the loss attributed to ``drop_key`` at the decision
+        site. The ONE place the 6-field loss-path done-item is built:
+        the writer unpacks all six fields and the express jump
+        indexes the pri flag, so the tuple shape is load-bearing."""
+        with self._lat_lock:
+            self.stats[drop_key] += sum(f.n for g in groups for f in g)
+        self._inflight_inc()
+        with self._done_cv:
+            self._done[self._seq] = (None, groups, None,
+                                     time.perf_counter(), False, False)
+            self._seq += 1
+            self._done_cv.notify_all()
+
+    def _shed_group(self, groups: list) -> None:
+        """Overload shedding (ISSUE 13): refuse a bulk coalesce group
+        at admission while the governor is in brownout — explicit,
+        attributed shedding, never silent queue growth."""
+        self._post_batchless(groups, "drops_overload")
+
+    # --- latency governor (ISSUE 13; dispatch-thread only) ---
+    def _governor_tick(self) -> None:
+        """Run one governor control tick when due and push the window
+        fill limit to the live ring. The governor itself never raises
+        (it wedges one-way after repeated failures — module doc of
+        io/governor.py); everything here is host-side shaping, so no
+        step variant is ever retraced."""
+        gov = self.governor
+        if gov is None or not gov.tick_due():
+            return
+        p99, backlog, delivered, fill_avg = self._gov_observe()
+        gov.maybe_tick(p99, backlog, delivered, fill_avg=fill_avg)
+        pp = self._ppump
+        if pp is not None:
+            pp.set_fill_limit(gov.fill)
+
+    def _gov_observe(self) -> tuple:
+        """Observation vector for one governor tick: p99 latency (µs)
+        — the REFLEX lane's own host window when a priority filter is
+        attached and the lane has fresh samples (the SLO protects
+        reflex traffic; bulk batching latency must not drive the
+        loop), else the device wire-latency histogram's per-tick
+        DELTA quantile in persistent mode with telemetry on (the ring
+        rider, host scalars only — ISSUE 11's substrate, no device
+        transfer at tick time), else the host batch-latency window —
+        plus the un-taken rx backlog (frames), delivered-frame count
+        (the service-rate estimator's input) and the ring's recent
+        average window fill (the lone-window guard)."""
+        p99 = None
+        pp = self._ppump
+        if self.priority is not None:
+            # lane discipline: with a priority filter attached the
+            # governor NEVER steers on bulk latency — a quiet lane
+            # holds its last observation for a bounded staleness
+            # window, then reads as no-signal (the governor drifts
+            # back to the resting shape; express-mode brownout still
+            # keys off queue pressure). Falling back to the
+            # bulk-dominated histogram here would pin the ladder at
+            # the floor under pure bulk load with nothing to protect.
+            with self._lat_lock:
+                total = self._pri_total
+                snap = (list(self.pri_lat)
+                        if total > self._gov_pri_seen else None)
+            if snap:
+                self._gov_pri_seen = total
+                p99 = float(np.percentile(
+                    np.asarray(snap) * 1e6, 99))
+                self._gov_pri_p99 = p99
+                self._gov_pri_stale = 0
+            else:
+                self._gov_pri_stale += 1
+                if self._gov_pri_stale <= GOV_PRI_STALE_TICKS:
+                    p99 = self._gov_pri_p99
+        elif (pp is not None
+                and getattr(self.dp, "_tel_mode", "off") != "off"):
+            try:
+                tel = self.tel_snapshot()
+            except Exception:  # noqa: BLE001 — observation must never
+                # kill the dispatch thread; the host window serves
+                tel = None
+            if tel is not None:
+                from vpp_tpu.ops.telemetry import quantiles_from_bins
+
+                bins = np.asarray(tel["bins"], np.int64)
+                prev = self._gov_bins
+                delta = (bins - prev if prev is not None
+                         and prev.shape == bins.shape else bins)
+                self._gov_bins = bins
+                if int(delta.sum()) > 0:
+                    _p50, p99v, _p999 = quantiles_from_bins(delta)
+                    p99 = float(p99v)
+        if p99 is None and self.priority is None:
+            lat = self.latency_us()
+            if lat["n"]:
+                p99 = float(lat["p99"])
+        backlog = self._backlog()
+        delivered = int(self.stats["frames"])
+        fill_avg = None
+        if pp is not None:
+            try:
+                self._gov_fill_last, fill_avg = pp.fill_avg(
+                    self._gov_fill_last)
+            except Exception:  # noqa: BLE001 — a dying ring's stats
+                # are not worth a dispatch-thread crash
+                fill_avg = None
+        return p99, backlog, delivered, fill_avg
 
     def _dispatch_loop(self) -> None:
         rx = self.rings.rx
@@ -495,32 +828,75 @@ class DataplanePump:
         # writing while K batches are in flight
         hold_cap = max(2, rx.ring.n_slots - 4)
         while not self._stop.is_set():
+            self._governor_tick()
             tracer = self.dp.tracer
             slow = tracer is not None and getattr(tracer, "_armed", 0) > 0
             # the chainer only engages past one full bucket of backlog
             # (depth alone can't absorb it); tracing runs unchained so
             # the tracer sees one full StepResult per dispatch
             chain_cap = 1 if (slow or not self.chain_k) else self.chain_k
-            groups = self._take_groups(rx, hold_cap, chain_cap)
+            max_pkts = None
+            gov = self.governor
+            g_infl = self.max_inflight
+            if gov is not None:
+                # governed coalesce cap: window fill f maps to f·VEC
+                # packets per batch — the dispatch-mode analog of the
+                # ring's window fill limit. While shedding, groups are
+                # taken one at a time so admission decides per group.
+                g_fill, g_infl, shedding = gov.limits()
+                max_pkts = max(VEC, min(self.max_batch, g_fill * VEC))
+                if shedding:
+                    chain_cap = 1
+            # express lane first (ISSUE 13): a priority frame jumps
+            # the whole bulk queue — dispatched NOW in its own group,
+            # released later in ring order by the done-prefix
+            self._scan_express(rx, hold_cap)
+            eg = self._take_express(rx)
+            if eg is not None:
+                self._dispatch_or_fail([eg], slow, pri=True)
+                continue
+            if self._inflight.full():
+                # don't take a bulk group whose hand-off would BLOCK
+                # this thread — a blocked put can't scan for express
+                # arrivals, and the lane's bound is the scan cadence
+                time.sleep(self.poll_s)
+                continue
+            groups = self._take_groups(rx, hold_cap, chain_cap,
+                                       max_pkts)
             if not groups:
                 time.sleep(self.poll_s)
                 continue
-            try:
-                self._dispatch(groups, slow)
-            except Exception:
-                log.exception("pump dispatch failed (%d frames)",
-                              sum(len(g) for g in groups))
-                with self._lat_lock:
-                    self.stats["drops_error"] += sum(
-                        f.n for g in groups for f in g)
-                # hand the frames to the writer as a failed batch so
-                # rx slots are still released in order
-                self._inflight_inc()
-                with self._done_cv:
-                    self._done[self._seq] = (None, groups, None,
-                                             time.perf_counter(), False)
-                    self._seq += 1
-                    self._done_cv.notify_all()
+            if gov is not None:
+                if not gov.admit(False, self._backlog()):
+                    # shedding forces chain_cap=1, so refusal covers
+                    # the whole take (exactly one group); the shed
+                    # state only flips on THIS thread's ticks, so it
+                    # cannot change between limits() and here
+                    self._shed_group(groups)
+                    continue
+                if self.stats["inflight"] >= g_infl:
+                    # governed in-flight depth (tighter than the
+                    # construction-time queue bound): UNTAKE and
+                    # retry instead of sleeping with frames held — a
+                    # blocked wait here couldn't scan for express
+                    # arrivals, exactly like the full-queue gate above
+                    self._untake([f for g in groups for f in g])
+                    time.sleep(self.poll_s)
+                    continue
+            self._dispatch_or_fail(groups, slow)
+
+    def _dispatch_or_fail(self, groups: list, slow: bool,
+                          pri: bool = False) -> None:
+        """Dispatch with the failed-batch contract: on any dispatch
+        error the frames go to the writer as a batchless item so rx
+        slots still complete (and release in ring order), with the
+        loss attributed to drops_error."""
+        try:
+            self._dispatch(groups, slow, pri=pri)
+        except Exception:
+            log.exception("pump dispatch failed (%d frames)",
+                          sum(len(g) for g in groups))
+            self._post_batchless(groups, "drops_error")
 
     def _pack_group(self, frames: list, flat: np.ndarray,
                     non_ip: np.ndarray) -> None:
@@ -538,7 +914,8 @@ class DataplanePump:
         pack_batch(self._pack_bases, self._pack_ns, len(frames), flat,
                    non_ip)
 
-    def _dispatch(self, groups: list, slow: bool = False) -> None:
+    def _dispatch(self, groups: list, slow: bool = False,
+                  pri: bool = False) -> None:
         K = len(groups)
         tp0 = time.perf_counter()
         # rx-enqueue stamp for the device wire-latency histogram
@@ -594,17 +971,18 @@ class DataplanePump:
         self.stats["t_dispatch"] += time.perf_counter() - t0
         # unlocked: the dispatch thread is _seq's only writer, so its
         # own read needs no lock; increments publish under _done_cv
-        item = (self._seq, payload, groups, non_ip, t0, slow)
+        item = (self._seq, payload, groups, non_ip, t0, slow, pri)
         # count the batch in flight BEFORE the hand-off: a fetch worker
         # can complete it (and the writer decrement it) the instant the
         # put lands, so inc-after-put would transiently read -1
         self._inflight_inc()
+        target_q = self._inflight_pri if pri else self._inflight
         while True:
             # bounded put that stays responsive to stop(): the fetchers
             # may already have exited, and a blocking put would deadlock
             # the join
             try:
-                self._inflight.put(item, timeout=0.05)
+                target_q.put(item, timeout=0.05)
                 break
             except queue.Full:
                 if self._stop.is_set():
@@ -647,6 +1025,11 @@ class DataplanePump:
                                      ml_kind=ml_kind,
                                      tel_mode=tel_mode,
                                      ).start()
+        if self.governor is not None:
+            # a relaunched/restarted ring must resume at the
+            # governor's CURRENT window shape, not the full-fill
+            # default (the wedged-governor freeze contract included)
+            self._ppump.set_fill_limit(self.governor.fill)
         self._persist_epoch = epoch
 
     def _persist_stop_merge(self) -> None:
@@ -696,11 +1079,15 @@ class DataplanePump:
         self._persist_stop_merge()
         self._persist_start()
 
-    def _persist_submit_group(self, frames: list) -> str:
+    def _persist_submit_group(self, frames: list,
+                              priority: bool = False) -> str:
         """Pack + submit ONE compacted coalesce group (several small
         frames at sequential offsets of a single VEC descriptor slot —
         the header-compaction half of the 20 B/pkt budget) to the ring
-        pump and hand its FIFO ticket to the collector. Returns "ok",
+        pump and hand its FIFO ticket to the collector. ``priority``
+        marks a reflex-lane group: the ring stager ships its window
+        immediately instead of draining backlog into it (ISSUE 13).
+        Returns "ok",
         "stop" when stop() interrupted the hand-off (the frames stay
         held and are counted as shutdown drops; the runtime frees the
         rings next), or "fallback" when repeated ring deaths hit
@@ -727,7 +1114,8 @@ class DataplanePump:
         while True:
             try:
                 self._ppump.submit(flat, now=self.dp.clock_ticks(),
-                                   stamp_us=stamp_us)
+                                   stamp_us=stamp_us,
+                                   priority=priority)
                 if self._ring_backoff.attempt:
                     self._ring_backoff.reset()
                 break
@@ -745,8 +1133,7 @@ class DataplanePump:
                 self._ppump = None
                 if self.ring_fault_limit and \
                         self._ring_faults >= self.ring_fault_limit:
-                    with self._held_lock:
-                        self._held -= len(frames)
+                    self._untake(frames, priority)
                     return "fallback"
                 time.sleep(self._ring_backoff.next())
                 try:
@@ -755,13 +1142,13 @@ class DataplanePump:
                     # cannot even start IS the wedged-ring case the
                     # fallback exists for, whatever the limit says
                     log.exception("resident loop relaunch failed")
-                    with self._held_lock:
-                        self._held -= len(frames)
+                    self._untake(frames, priority)
                     return "fallback"
         self.stats["t_dispatch"] += time.perf_counter() - t0
         # unlocked: the dispatch thread is _seq's only writer, so its
         # own read needs no lock; increments publish under _done_cv
-        item = (self._seq, self._ppump, [frames], non_ip.view(bool), t0)
+        item = (self._seq, self._ppump, [frames], non_ip.view(bool), t0,
+                priority)
         self._inflight_inc()
         while True:
             try:
@@ -797,19 +1184,51 @@ class DataplanePump:
             while not self._stop.is_set():
                 if self.dp.epoch != self._persist_epoch:
                     self._persist_restart()
+                self._governor_tick()
                 # refill burst: compact pending frames into VEC-packet
                 # descriptor slots and keep up to max_inflight slots
-                # queued at the ring stager before sleeping — whole
-                # windows then ship with one transfer each, and the
-                # device never idles between windows (the overlap
-                # discipline of the r6 ladder, now at window
-                # granularity)
+                # (or the governor's tighter in-flight depth) queued
+                # at the ring stager before sleeping — whole windows
+                # then ship with one transfer each, and the device
+                # never idles between windows (the overlap discipline
+                # of the r6 ladder, now at window granularity)
+                gov = self.governor
+                g_infl = self.max_inflight
+                if gov is not None:
+                    _f, g_infl, _shed = gov.limits()
+                    g_infl = min(self.max_inflight, g_infl)
                 burst = 0
                 while not self._stop.is_set():
+                    # express lane first (ISSUE 13): priority frames
+                    # jump the bulk queue entirely — a lone-slot
+                    # submit whose window the stager ships at once
+                    self._scan_express(rx, hold_cap)
+                    eg = self._take_express(rx)
+                    if eg is not None:
+                        st = self._persist_submit_group(eg,
+                                                        priority=True)
+                        if st == "stop":
+                            return
+                        if st == "fallback":
+                            self._persist_fallback()
+                            return
+                        burst += 1
+                        continue
+                    with self._lat_lock:
+                        infl = self.stats["inflight"]
+                    if infl >= g_infl:
+                        break  # governed depth: outer loop re-ticks
                     groups = self._take_groups(rx, hold_cap, 1,
                                                max_pkts=VEC)
                     if not groups:
                         break
+                    if gov is not None and \
+                            not gov.admit(False, self._backlog()):
+                        # brownout: bulk beyond the SLO's queue budget
+                        # is dropped at admission, attributed — a shed
+                        # costs no device trip
+                        self._shed_group(groups)
+                        continue
                     st = self._persist_submit_group(groups[0])
                     if st == "stop":
                         return
@@ -817,7 +1236,7 @@ class DataplanePump:
                         self._persist_fallback()
                         return
                     burst += 1
-                    if burst >= self.max_inflight:
+                    if burst >= g_infl:
                         break
                 if burst == 0:
                     # idle: a ring death with nothing left to submit
@@ -1001,7 +1420,7 @@ class DataplanePump:
             self.stats["ring_lag"] = int(live.get("ring_lag", 0))
 
     def _persist_collect_one(self, item) -> None:
-        seq, ppump, groups, non_ip, t0 = item
+        seq, ppump, groups, non_ip, t0, pri = item
         tf0 = time.perf_counter()
         batch = None
         fast = False
@@ -1037,7 +1456,7 @@ class DataplanePump:
                     f.n for g in groups for f in g)
         self._ring_stats_sync()
         with self._done_cv:
-            self._done[seq] = (batch, groups, non_ip, t0, fast)
+            self._done[seq] = (batch, groups, non_ip, t0, fast, pri)
             self._done_cv.notify_all()
 
     def _persist_collect_loop(self) -> None:
@@ -1072,12 +1491,18 @@ class DataplanePump:
             self._fetchers_live += 1
         try:
             while True:
+                # express first (ISSUE 13): a priority batch's fetch
+                # waits only for the fetch in progress, never behind
+                # the queued bulk FIFO
                 try:
-                    item = self._inflight.get(timeout=0.05)
+                    item = self._inflight_pri.get_nowait()
                 except queue.Empty:
-                    if self._stop.is_set():
-                        return
-                    continue
+                    try:
+                        item = self._inflight.get(timeout=0.05)
+                    except queue.Empty:
+                        if self._stop.is_set():
+                            return
+                        continue
                 if item is _SENTINEL:
                     # wake the next worker too, then exit
                     try:
@@ -1097,7 +1522,7 @@ class DataplanePump:
         stop sentinel)."""
         import jax
 
-        seq, payload, groups, non_ip, t0, slow = item
+        seq, payload, groups, non_ip, t0, slow, pri = item
         delay = self._fetch_delay
         if delay is not None:
             time.sleep(delay(seq) if callable(delay) else delay)
@@ -1162,7 +1587,7 @@ class DataplanePump:
                 self.stats["drops_error"] += sum(
                     f.n for g in groups for f in g)
         with self._done_cv:
-            self._done[seq] = (batch, groups, non_ip, t0, fast)
+            self._done[seq] = (batch, groups, non_ip, t0, fast, pri)
             self._done_cv.notify_all()
 
     def _account_fastpath(self, aux) -> bool:
@@ -1211,17 +1636,42 @@ class DataplanePump:
     # --- tx writer: reorder, split, write tx ring, release rx slots ---
     def _write_loop(self) -> None:
         next_seq = 0
+        # seqs already written OUT of dispatch order by the express
+        # jump below — consumed (skipped) when next_seq reaches them
+        skipped: set = set()
         while True:
             rescue = False
+            item = None
             with self._done_cv:
-                while next_seq not in self._done:
+                while True:
+                    while next_seq in skipped:
+                        skipped.discard(next_seq)
+                        next_seq += 1
+                    if next_seq in self._done:
+                        item = self._done.pop(next_seq)
+                        next_seq += 1
+                        break
+                    # express jump (ISSUE 13): a completed PRIORITY
+                    # item is written immediately, ahead of earlier
+                    # bulk seqs still fetching — legal because rx
+                    # release order is rid-based (_release_done), so
+                    # only the tx write order changes, and reflex
+                    # frames must not wait out the bulk pipeline
+                    ex = min((s for s, it in self._done.items()
+                              if it[5]), default=None)
+                    if ex is not None:
+                        item = self._done.pop(ex)
+                        skipped.add(ex)
+                        break
                     # exit once stopped and every dispatched batch has
                     # been written (_seq is the dispatch count; the
                     # sentinel may still sit in _inflight, so emptiness
                     # of the queue is NOT a usable signal here)
                     if self._stop.is_set() and next_seq >= self._seq:
                         return
-                    if self._stop.is_set() and not self._inflight.empty():
+                    if self._stop.is_set() and \
+                            not (self._inflight.empty()
+                                 and self._inflight_pri.empty()):
                         with self._lat_lock:
                             fetchers = self._fetchers_live
                         if fetchers == 0:
@@ -1233,29 +1683,23 @@ class DataplanePump:
                             rescue = True
                             break
                     self._done_cv.wait(timeout=0.05)
-                if not rescue:
-                    item = self._done.pop(next_seq)
             if rescue:
                 # complete stranded batches on this thread (outside
                 # _done_cv — _complete_item takes it to post results)
-                while True:
-                    try:
-                        stranded = self._inflight.get_nowait()
-                    except queue.Empty:
-                        break
-                    if stranded is not _SENTINEL:
-                        self._complete_item(stranded)
+                for q in (self._inflight_pri, self._inflight):
+                    while True:
+                        try:
+                            stranded = q.get_nowait()
+                        except queue.Empty:
+                            break
+                        if stranded is not _SENTINEL:
+                            self._complete_item(stranded)
                 continue
-            next_seq += 1
             try:
                 self._write(*item)
             except Exception:
                 log.exception("pump tx write failed")
-                with self._held_lock:
-                    for g in item[1]:
-                        for _ in g:
-                            self.rings.rx.release()
-                        self._held -= len(g)
+                self._release_done(item[1])
             self._inflight_dec()
 
     def _write_packed_group(self, batch: np.ndarray, frames: list,
@@ -1290,7 +1734,7 @@ class DataplanePump:
             off += n
 
     def _write(self, batch, groups: list, non_ip, t0: float,
-               fast: bool = False) -> None:
+               fast: bool = False, pri: bool = False) -> None:
         if isinstance(batch, np.ndarray):
             tw0 = time.perf_counter()
             host_if = (self.dp.host_if
@@ -1310,6 +1754,9 @@ class DataplanePump:
             lat = time.perf_counter() - t0
             with self._lat_lock:
                 self.batch_lat.append(lat)
+                if pri:
+                    self.pri_lat.append(lat)
+                    self._pri_total += 1
             if self.latency_hist is not None:
                 self.latency_hist.observe(lat)
             if fast and self.fastpath_hist is not None:
@@ -1366,13 +1813,12 @@ class DataplanePump:
             lat = time.perf_counter() - t0
             with self._lat_lock:
                 self.batch_lat.append(lat)
+                if pri:
+                    self.pri_lat.append(lat)
+                    self._pri_total += 1
             if self.latency_hist is not None:
                 self.latency_hist.observe(lat)
-        with self._held_lock:
-            for g in groups:
-                for _ in g:
-                    self.rings.rx.release()
-                self._held -= len(g)
+        self._release_done(groups)
 
     def _emit_icmp_frame(self, f, cause: np.ndarray) -> None:
         """Generate ICMP time-exceeded / net-unreachable frames for one
@@ -1481,6 +1927,7 @@ class DataplanePump:
         round this way)."""
         with self._lat_lock:
             self.batch_lat.clear()
+            self.pri_lat.clear()
 
     def latency_us(self) -> dict:
         """p50/p99 dispatch→tx batch latency over the recent window."""

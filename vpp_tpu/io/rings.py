@@ -98,6 +98,27 @@ class DeviceDescRing:
         self._held = [False] * self.windows
         self._next = 0  # cyclic acquire cursor
         self._cv = threading.Condition(threading.Lock())
+        # per-window fill occupancy (ISSUE 13): how many slots each
+        # shipped window actually carried — the latency governor's
+        # occupancy input (lone windows mean shrinking the fill cap
+        # cannot lower p99 any further) and the `show governor` /
+        # `show io` fill telemetry. note_fill() is called by the
+        # stager at dispatch; readers take consistent (windows, slots)
+        # pairs via fill_snapshot().
+        self._fill_windows = 0
+        self._fill_slots = 0
+
+    def note_fill(self, n_slots: int) -> None:
+        """Record one shipped window's slot occupancy."""
+        with self._cv:
+            self._fill_windows += 1
+            self._fill_slots += int(n_slots)
+
+    def fill_snapshot(self) -> Tuple[int, int]:
+        """``(windows_shipped, slots_filled)`` cumulative — callers
+        delta between reads for a recent-window average fill."""
+        with self._cv:
+            return self._fill_windows, self._fill_slots
 
     def window_bytes(self) -> int:
         """Descriptor bytes one window ships each way (the window-math

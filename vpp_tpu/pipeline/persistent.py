@@ -101,6 +101,15 @@ class PersistentPump:
         self.fastpath_enabled = bool(fastpath)
         self.ring = DeviceDescRing(slots=ring_slots, batch=self.batch,
                                    windows=ring_windows)
+        # latency-governor actuator (ISSUE 13; io/governor.py): the
+        # stager closes a window once it holds this many slots, even
+        # with more backlog queued — the host-side window-shaping
+        # lever between the 1-slot lone-frame floor and the S-slot
+        # backlog fill. Written by the owning pump's dispatch thread,
+        # read by the stager: a plain int (GIL-atomic), no lock —
+        # and NOT part of the window program's inputs beyond the
+        # already-dynamic slot count `n`, so governing never retraces.
+        self._fill_limit = self.ring.slots
         self._in: "queue.Queue" = queue.Queue()
         # dispatched windows awaiting their result fetch, in dispatch
         # order: (widx, n_frames, tx_ring, aux_ring) device futures
@@ -141,6 +150,12 @@ class PersistentPump:
             # derives)
             "ring_frames": 0,
             "windows_dispatched": 0,
+            # priority-lane preemptions (ISSUE 13): windows the stager
+            # shipped EARLY because a priority slot landed (the lane's
+            # bounded-queueing mechanism — a reflex frame never waits
+            # for the backlog to drain into its window). Folded into
+            # the owning pump's ring accumulator across restarts.
+            "priority_preempts": 0,
             # host callback invocations by the device program — the
             # ring steady state makes NONE (module doc). Any future
             # callback added to the window program MUST route its
@@ -177,16 +192,37 @@ class PersistentPump:
         return self._error is not None
 
     def submit(self, flat: np.ndarray, now: int,
-               stamp_us: int = 0) -> None:
+               stamp_us: int = 0, priority: bool = False) -> None:
         """Queue one packed [5, B] frame; ``now`` is its per-slot
         timestamp (must be >= 0) and ``stamp_us`` its rx-enqueue
         microsecond stamp for the wire-latency histogram (0 =
-        unstamped; ignored with telemetry off). The frame is COPIED —
-        callers may reuse their staging buffer immediately."""
+        unstamped; ignored with telemetry off). ``priority`` marks a
+        reflex-lane frame (ISSUE 13): the stager ships its window the
+        moment the slot lands instead of draining the backlog into it.
+        The frame is COPIED — callers may reuse their staging buffer
+        immediately."""
         assert now >= 0
         self._check_error()
         self._in.put((int(now), int(stamp_us),
-                      np.array(flat, np.int32, copy=True)))
+                      np.array(flat, np.int32, copy=True),
+                      bool(priority)))
+
+    def set_fill_limit(self, n_slots: int) -> None:
+        """Governor actuator: cap the stager's window fill at
+        ``n_slots`` (clamped to [1, ring slots]). Host-side only —
+        the window program's slot count is already a dynamic input,
+        so no jit variant is touched."""
+        self._fill_limit = max(1, min(int(n_slots), self.ring.slots))
+
+    def fill_avg(self, last: Optional[tuple] = None):
+        """``(snapshot, avg_fill)`` where ``snapshot`` is the ring's
+        cumulative ``(windows, slots)`` pair and ``avg_fill`` the
+        average slots per window SINCE ``last`` (None until a window
+        shipped in the delta) — the governor's occupancy input."""
+        snap = self.ring.fill_snapshot()
+        w0, s0 = last if last is not None else (0, 0)
+        dw, ds = snap[0] - w0, snap[1] - s0
+        return snap, (ds / dw if dw > 0 else None)
 
     def checkpoint_sessions(self, timeout: float = 30.0):
         """Consistent DEVICE COPY of the in-ring session state, taken
@@ -311,17 +347,30 @@ class PersistentPump:
                 widx, desc, nows, stamps = got
                 n = 0
                 pending_ckpt = None
+                preempted = False
                 # adaptive fill: drain whatever is already queued up to
-                # the window size, never wait for more — a lone frame
-                # ships in a 1-slot window (latency floor), a backlog
-                # fills the window (throughput)
+                # the window size (capped by the governor's fill
+                # limit), never wait for more — a lone frame ships in
+                # a 1-slot window (latency floor), a backlog fills the
+                # window (throughput). A PRIORITY slot ships the
+                # window immediately (ISSUE 13): the reflex lane's
+                # bounded queueing comes from never draining backlog
+                # into a window a priority frame is already in.
+                limit = min(self.ring.slots, self._fill_limit)
                 while True:
-                    now, stamp_us, flat = item
+                    now, stamp_us, flat, pri = item
                     desc[n] = flat
                     nows[n] = now
                     stamps[n] = stamp_us
                     n += 1
-                    if n >= self.ring.slots:
+                    if pri:
+                        # a preempt is a window shipped early ONLY
+                        # when backlog was actually waiting to fill it
+                        # — a lone priority frame on an idle queue
+                        # ships the same 1-slot window either way
+                        preempted = self._in.qsize() > 0
+                        break
+                    if n >= limit:
                         break
                     try:
                         item = self._in.get_nowait()
@@ -359,8 +408,11 @@ class PersistentPump:
                     tables, cursor, tx_ring, aux_ring = self._step(
                         tables, cursor, desc, nows, np.int32(n))
                     tel = None
+                self.ring.note_fill(n)
                 with self._stats_lock:
                     self.stats["windows_dispatched"] += 1
+                    if preempted:
+                        self.stats["priority_preempts"] += 1
                 self._fetch_q.put((widx, n, tx_ring, aux_ring, tel))
                 if pending_ckpt is not None:
                     self._serve_ckpt(pending_ckpt, tables)

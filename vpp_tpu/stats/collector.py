@@ -21,6 +21,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from vpp_tpu.cni.containeridx import ContainerIndex
+from vpp_tpu.io.governor import GOVERNOR_MODES
 from vpp_tpu.pipeline.dataplane import Dataplane
 from vpp_tpu.pipeline.graph import StepStats
 from vpp_tpu.stats.prometheus import Gauge, Histogram, MetricsRegistry
@@ -116,6 +117,21 @@ PUMP_STAT_GAUGES = (
     ("io_callbacks", "vpp_tpu_pump_io_callbacks",
      "host callback invocations made by the persistent device "
      "program (the ring steady state makes none)"),
+    # priority lane (ISSUE 13; io/governor.py PriorityFilter): reflex
+    # frames/packets classified into the lane, ring windows the
+    # stager shipped early for one, and priority marks the
+    # pump.priority_starve fault seam demoted to bulk
+    ("priority_frames", "vpp_tpu_pump_priority_frames",
+     "rx frames classified into the reflex priority lane"),
+    ("priority_pkts", "vpp_tpu_pump_priority_packets",
+     "packets classified into the reflex priority lane"),
+    ("priority_preempts", "vpp_tpu_pump_priority_preempts",
+     "device-ring windows shipped early because a priority slot "
+     "landed (the lane's bounded-queueing mechanism)"),
+    ("priority_starved", "vpp_tpu_pump_priority_starved",
+     "priority classifications demoted to bulk by the "
+     "pump.priority_starve fault seam (chaos testing; 0 in "
+     "production)"),
 )
 
 # pump.stats drop-cause key -> `reason` label on the
@@ -129,6 +145,11 @@ PUMP_DROP_REASONS = (
     ("drops_tx_stall", "tx_stall"),
     ("drops_shutdown", "shutdown"),
     ("drops_error", "error"),
+    # overload = bulk admission the latency governor refused in
+    # brownout (ISSUE 13) — explicit shedding, attributed, never
+    # silent queue growth. Must stay in lockstep with
+    # io/pump.py PUMP_DROP_KEYS (counters lint).
+    ("drops_overload", "overload"),
 )
 
 # pump.stats stage-seconds key -> `stage` label of the
@@ -154,10 +175,48 @@ CLASSIFIER_IMPLS = ("dense", "mxu", "bv")
 # ring = the persistent pump fell back from the device ring to the
 # dispatch ladder, snapshot = the last snapshot attempt failed,
 # ml = the last ML-model load was refused (the previous model keeps
-# serving — vpp_tpu/ml/loader.py, ISSUE 10). Every component always
-# exports (0 = healthy) so an absent series is a wiring bug, not good
-# news.
-DEGRADED_COMPONENTS = ("kvstore", "ring", "snapshot", "ml")
+# serving — vpp_tpu/ml/loader.py, ISSUE 10), governor = the latency
+# governor's control loop is WEDGED (repeated tick failures; the pump
+# keeps forwarding at the last-known window shape — ISSUE 13; note
+# brownout is NOT degraded, it is the governor working). Every
+# component always exports (0 = healthy) so an absent series is a
+# wiring bug, not good news.
+DEGRADED_COMPONENTS = ("kvstore", "ring", "snapshot", "ml", "governor")
+
+# Latency-governor surface (ISSUE 13; io/governor.py). The mode info
+# gauge enumerates "off" (no governor attached) plus the state
+# machine's modes; GOVERNOR_STAT_GAUGES maps the governor's numeric
+# snapshot scalars (LatencyGovernor.SNAPSHOT_SCALARS) to one gauge
+# each — the tools/lint.py --counters pass keeps the two in lockstep,
+# so a control-loop scalar added without its observability twin fails
+# tier-1.
+GOVERNOR_MODE_LABELS = ("off",) + GOVERNOR_MODES
+
+GOVERNOR_STAT_GAUGES = (
+    ("slo_us", "vpp_tpu_governor_slo_us",
+     "configured wire-latency SLO the governor closes its loop on"),
+    ("level", "vpp_tpu_governor_level",
+     "current rung on the window-shape ladder (0 = lone-frame "
+     "floor)"),
+    ("fill", "vpp_tpu_governor_fill_slots",
+     "current window-fill cap the stager is held to (slots)"),
+    ("inflight", "vpp_tpu_governor_inflight_limit",
+     "current in-flight depth cap applied to the pump"),
+    ("last_p99_us", "vpp_tpu_governor_latency_p99_us",
+     "p99 wire latency the last control tick observed (device "
+     "histogram delta, or the host batch window)"),
+    ("queue_est_us", "vpp_tpu_governor_queue_est_us",
+     "estimated queueing delay of the rx backlog at the EWMA "
+     "service rate (the SLO-envelope term)"),
+    ("fill_avg", "vpp_tpu_governor_fill_avg",
+     "recent average slots per shipped ring window (the lone-window "
+     "guard's occupancy input)"),
+    ("ticks", "vpp_tpu_governor_ticks_total",
+     "control-loop ticks executed"),
+    ("tick_errors", "vpp_tpu_governor_tick_errors_total",
+     "control-loop ticks that failed (WEDGE_LIMIT consecutive "
+     "failures freeze the governor one-way)"),
+)
 
 # ML-stage modes the vpp_tpu_ml_stage info gauge enumerates (the LIVE
 # compiled mode — Dataplane._ml_mode, re-gated at every swap; "off"
@@ -517,6 +576,41 @@ class StatsCollector:
                   "ML model load attempts by outcome (loaded = "
                   "published; every refusal reason is its own label "
                   "and keeps the previous model serving)",
+                  kind="counter"),
+        )
+        # reflex-plane latency governor (ISSUE 13; io/governor.py):
+        # one gauge per control-loop scalar (the GOVERNOR_STAT_GAUGES
+        # map — counters lint keeps it in lockstep with the
+        # governor's snapshot), the mode info gauge (off with no
+        # governor attached), and the labelled adjustment/transition
+        # counters. The wedged flag rides vpp_tpu_degraded.
+        self.governor_gauges = {
+            name: self.registry.register(
+                STATS_PATH,
+                Gauge(name, help_,
+                      kind=("counter" if name.endswith("_total")
+                            else "gauge")))
+            for _key, name, help_ in GOVERNOR_STAT_GAUGES
+        }
+        self.governor_mode_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_governor_mode",
+                  "latency-governor operating mode (info-style: mode "
+                  "label, 1 = active; off = no governor attached; "
+                  "brownout = shedding bulk admission)"),
+        )
+        self.governor_adjust_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_governor_adjustments_total",
+                  "window-shape ladder steps taken by the governor, "
+                  "by direction (down = toward the lone-frame floor)",
+                  kind="counter"),
+        )
+        self.governor_transitions_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_governor_transitions_total",
+                  "governor state-machine transitions, by mode "
+                  "entered (normal/brownout/recovery)",
                   kind="counter"),
         )
         # device-resident telemetry plane (ISSUE 11; ops/telemetry.py):
@@ -946,6 +1040,30 @@ class StatsCollector:
         self.degraded_gauge.set(
             1.0 if getattr(self.pump, "degraded_ring", False) else 0.0,
             component="ring")
+        # latency governor (ISSUE 13): mode info gauge always (off
+        # with no governor attached); scalars + labelled counters
+        # when one is. Degraded ONLY when the control loop is wedged
+        # — brownout is the governor WORKING, not failing.
+        gov = getattr(self.pump, "governor", None)
+        gov_mode = "off"
+        gov_wedged = False
+        if gov is not None:
+            gs = gov.snapshot()
+            gov_mode = gs["mode"]
+            gov_wedged = bool(gs["wedged"])
+            for key, name, _h in GOVERNOR_STAT_GAUGES:
+                self.governor_gauges[name].set(float(gs[key]))
+            for direction in ("up", "down"):
+                self.governor_adjust_gauge.set(
+                    float(gs[f"adjust_{direction}"]),
+                    direction=direction)
+            for m, n in gs["transitions"].items():
+                self.governor_transitions_gauge.set(float(n), mode=m)
+        for name in GOVERNOR_MODE_LABELS:
+            self.governor_mode_gauge.set(
+                1.0 if name == gov_mode else 0.0, mode=name)
+        self.degraded_gauge.set(1.0 if gov_wedged else 0.0,
+                                component="governor")
         snap = self._snapshotter
         self.degraded_gauge.set(
             1.0 if getattr(snap, "degraded", False) else 0.0,

@@ -41,6 +41,12 @@ point                 seam
 ``ring.fetch``        pipeline/persistent.py — window result fetch
 ``pump.fetch``        io/pump.py — dispatch-mode device result fetch
 ``pump.tx_push``      io/pump.py — tx-ring write (stalled consumer)
+``pump.priority_starve``  io/pump.py — priority classification demoted
+                      to bulk (the lane starves; conservation must
+                      hold — ISSUE 13)
+``governor.tick``     io/governor.py — latency-governor control tick
+                      (repeated failures wedge the governor one-way;
+                      the pump keeps the last-known window shape)
 ``snapshot.chunk``    pipeline/snapshot.py — chunk file write (torn chunk)
 ``snapshot.manifest`` pipeline/snapshot.py — manifest publish (torn/crash)
 ``ml.load``           ml/loader.py — model artifact read (corrupt/missing)
