@@ -1023,6 +1023,309 @@ def latency_slo_bench(args, frame_pkts: int = 16,
     return out
 
 
+def tenant_isolation_bench(args, frame_pkts: int = 16,
+                           phase_s: float = 1.0) -> dict:
+    """Multi-tenant isolation scenario (ISSUE 14 acceptance;
+    docs/TENANCY.md). Four tenants on the persistent wire path —
+    device token buckets + capacity attribution + the pump's
+    weighted-fair dequeue — with tenant 4 misbehaving at 4x its quota
+    through a square-wave burst while tenants 1..3 stay inside
+    theirs. Proof keys:
+
+      * ``tenant_isolation_goodput_ratio_min`` — the worst
+        well-behaved tenant's overload-phase goodput vs its SOLO run
+        (acceptance: >= 0.9; one hog must not tax the rest);
+      * ``tenant_isolation_p99_ratio_max`` — the worst well-behaved
+        p99 amplification vs solo (acceptance: <= 2x);
+      * ``tenant_isolation_attributed_pct`` — the misbehaving
+        tenant's overage accounted as
+        ``drops_total{reason="tenant_quota"}`` (device bucket) +
+        per-tenant brownout sheds (``reason="overload"``) — nothing
+        silent;
+      * ``tenant_isolation_conserved`` — EXACT packet conservation
+        over the whole overload phase:
+        offered == goodput + tenant_quota + shed + shutdown/error.
+    """
+    import collections
+    import threading
+
+    from vpp_tpu.io.governor import LatencyGovernor
+    from vpp_tpu.io.pump import DataplanePump
+    from vpp_tpu.io.rings import IORingPair
+    from vpp_tpu.native.pktio import PacketCodec
+    from vpp_tpu.pipeline.dataplane import Dataplane
+    from vpp_tpu.pipeline.tables import DataplaneConfig
+    from vpp_tpu.pipeline.vector import VEC, Disposition
+    from vpp_tpu.tenancy.sched import (
+        TenantClassifier,
+        tenant_entries_from_config,
+    )
+
+    N, MIS = 4, 4  # tenants 1..N, tenant MIS misbehaves
+    config = DataplaneConfig(
+        max_tables=2, max_rules=16, max_global_rules=16, max_ifaces=64,
+        fib_slots=64, sess_slots=1 << 12, nat_mappings=1,
+        nat_backends=1, tenancy="on", tenancy_tenants=N + 1,
+    )
+    dp = Dataplane(config)
+    for i in range(32):
+        idx = dp.add_pod_interface(("default", f"p{i}"))
+        dp.builder.add_route(f"10.1.1.{i + 2}/32", idx,
+                             Disposition.LOCAL)
+    t_net = {t: f"10.{50 + t}.0.0/16" for t in range(1, N + 1)}
+    t_src = {t: f"10.{50 + t}.0.9" for t in range(1, N + 1)}
+    # WFQ weights: the well-behaved class outweighs the (eventual)
+    # hog 4:1 — the gold-vs-bronze shape real gateways run; quotas
+    # are staged after the sat capture (rate 0 = unlimited for now)
+    t_weight = {t: (1 if t == MIS else 4) for t in range(1, N + 1)}
+    for t in range(1, N + 1):
+        dp.builder.set_tenant(t, prefixes=[t_net[t]],
+                              weight=t_weight[t])
+    dp.swap()
+    client_if = dp.pod_if[("default", "p0")]
+    wires = {t: [wire_udp(i, src=t_src[t]) for i in range(frame_pkts)]
+             for t in range(1, N + 1)}
+    classifier = TenantClassifier(tenant_entries_from_config(
+        [{"id": t, "prefixes": [t_net[t]], "weight": t_weight[t]}
+         for t in range(1, N + 1)]))
+
+    def capture(offered_fps, duration, slo_us=0, square_t=None,
+                square=None) -> dict:
+        """One pump lifecycle: per-tenant paced producers
+        (``offered_fps``: tenant -> frames/s; ``square`` overrides
+        tenant ``square_t``'s pacing with (hi, lo, half_s)),
+        sequence-stamped wire latency split per tenant, device
+        tenant-plane DELTAS (the state planes persist across pump
+        lifecycles) and the pump's per-tenant lane ledger."""
+        snap0 = dp.tenant_snapshot()
+        rings = IORingPair(n_slots=256, snap=512)
+        codec = PacketCodec(snap=rings.rx.snap)
+        scratch = np.zeros((VEC, rings.rx.snap), np.uint8)
+        gov = (LatencyGovernor(slo_us, tick_s=0.01, brownout_ticks=2,
+                               recover_ticks=3) if slo_us > 0 else None)
+        # latency-lean geometry for the whole scenario: 1-slot ring
+        # windows + a one-frame (frame_pkts=16) WFQ service quantum
+        # bound every frame's wait behind OTHER tenants' bulk in the
+        # shared window pipeline (the WFQ delay bound scales with the
+        # quantum), so the isolation comparison measures the bucket +
+        # the lanes, not ring batching depth
+        pump = DataplanePump(dp, rings, mode="persistent",
+                             governor=gov, tenants=classifier,
+                             ring_slots=1,
+                             tenant_quantum=frame_pkts)
+        pump.warm()
+        pump.start()
+        push_log = {}
+        lat = collections.defaultdict(list)
+        offered = {t: 0 for t in offered_fps}
+        seq_box = [0]
+        stop = threading.Event()
+
+        def push(t) -> None:
+            cols, n = codec.parse(wires[t], client_if, scratch)
+            seq = seq_box[0]
+            cols["meta"][:n] = seq
+            tm = time.perf_counter()
+            if rings.rx.push(cols, n, payload=scratch):
+                push_log[seq] = (tm, t)
+                seq_box[0] += 1
+                offered[t] += n
+
+        def producer() -> None:
+            t0 = time.perf_counter()
+            # staggered initial credits de-synchronize same-rate
+            # producers: without the offsets every tenant's frame
+            # lands in the same pacing tick and the WFQ tie-break
+            # (by tenant id) turns into a fixed service-order bias
+            credit = {t: i / max(1, len(offered_fps))
+                      for i, t in enumerate(offered_fps)}
+            last = t0
+            while not stop.is_set():
+                now = time.perf_counter()
+                dt, last = now - last, now
+                for t, fps in offered_fps.items():
+                    if square is not None and t == square_t:
+                        hi, lo, half = square
+                        fps = (hi if int((now - t0) / half) % 2 == 0
+                               else lo)
+                    credit[t] = min(credit[t] + fps * dt, 32.0)
+                    while credit[t] >= 1.0:
+                        push(t)
+                        credit[t] -= 1.0
+                time.sleep(0.001)
+
+        def drain_one() -> bool:
+            g = rings.tx.peek()
+            if g is None:
+                return False
+            seq = int(g.cols["meta"][0])
+            rings.tx.release()
+            rec = push_log.pop(seq, None)
+            if rec is not None:
+                lat[rec[1]].append(time.perf_counter() - rec[0])
+            return True
+
+        prod = threading.Thread(target=producer, daemon=True)
+        t_start = time.perf_counter()
+        prod.start()
+        while time.perf_counter() < t_start + duration:
+            if not drain_one():
+                time.sleep(0.0002)
+        stop.set()
+        prod.join()
+        idle_since = None
+        flush_deadline = time.perf_counter() + 8.0
+        while push_log and time.perf_counter() < flush_deadline:
+            if drain_one():
+                idle_since = None
+                continue
+            now = time.perf_counter()
+            if idle_since is None:
+                idle_since = now
+            elif now - idle_since > 1.0:
+                break
+            time.sleep(0.002)
+        elapsed = time.perf_counter() - t_start
+        pump.stop()  # grafts the ring-carried tenant planes back
+        s = dict(pump.stats)
+        tsnap = pump.tenant_io_snapshot()
+        tio = tsnap["io"]
+        # WFQ-lane residue: frames still queued when the flush
+        # deadline expired are neither goodput nor an attributed drop
+        # (stop() abandons only DISPATCHED frames as drops_shutdown;
+        # the scheduler queues are simply left) — the conservation
+        # identity must count them or a slow flush reads as a
+        # (nonexistent) conservation bug
+        queued_residual = sum(q.get("pkts", 0)
+                              for q in tsnap["queued"].values())
+        # frames the stalled scan frontier never classified sit in the
+        # rx ring at the deadline: offered minus scan-classified
+        # (io["pkts"] counts at classification) — without this term a
+        # slow flush on the 1-core harness reads as a conservation
+        # violation
+        unclassified = max(0, sum(offered.values())
+                           - sum(v.get("pkts", 0)
+                                 for v in tio.values()))
+        rings.close()
+        snap1 = dp.tenant_snapshot()
+
+        def delta(key, t):
+            d0 = int(snap0[key][t]) if snap0 is not None else 0
+            return int(snap1[key][t]) - d0
+
+        rows = {}
+        for t in offered_fps:
+            xs = np.asarray(lat[t]) * 1e6 if lat[t] else None
+            rows[t] = {
+                "offered_pkts": offered[t],
+                "goodput_pkts": delta("tx", t),
+                "goodput_fps": round(len(lat[t]) / max(elapsed, 1e-9),
+                                     1),
+                "quota_drop_pkts": delta("rl_drops", t),
+                "dev_rx_pkts": delta("rx", t),
+                "shed_pkts": int(tio.get(t, {}).get("shed_pkts", 0)),
+                "p50_us": (round(float(np.percentile(xs, 50)), 1)
+                           if xs is not None else 0.0),
+                "p99_us": (round(float(np.percentile(xs, 99)), 1)
+                           if xs is not None else 0.0),
+            }
+        return {
+            "tenants": rows,
+            "drops_shutdown": int(s.get("drops_shutdown", 0)),
+            "drops_error": int(s.get("drops_error", 0)),
+            "queued_residual": int(queued_residual) + int(unclassified),
+            "io_callbacks": int(s.get("io_callbacks", 0)),
+        }
+
+    out = {"tenant_isolation_tenants": N,
+           "tenant_isolation_frame_pkts": frame_pkts}
+    # (1) floor + harness saturation (tenant 1, unlimited quota)
+    floor = capture({1: 40}, duration=0.8)["tenants"][1]
+    floor_us = max(floor["p50_us"], 1.0)
+    sat = capture({1: 1e9}, duration=1.2)["tenants"][1]
+    sat_fps = max(sat["goodput_fps"], 4.0)
+    out["tenant_isolation_floor_us"] = round(floor_us, 1)
+    out["tenant_isolation_sat_fps"] = round(sat_fps, 1)
+    # (2) quotas: each tenant gets 5% of sat so even the hog's 4x
+    # overage keeps TOTAL offered well under saturation (~32% avg,
+    # 42% burst-high) — on this CPU harness a quota-dropped packet
+    # costs the same device time as a forwarded one (the LATENCY.md
+    # round-13 caveat), so the comparison must isolate the BUCKET and
+    # the WFQ lanes, not queueing collapse; well-behaved tenants
+    # offer 80% of quota, the hog 4x quota through a square wave
+    quota_fps = max(1.0, 0.10 * sat_fps)
+    quota_pps = quota_fps * frame_pkts
+    rate = max(1, int(round(quota_pps / Dataplane.TICKS_PER_SEC)))
+    with dp.commit_lock:
+        for t in range(1, N + 1):
+            dp.builder.set_tenant(t, prefixes=[t_net[t]],
+                                  weight=t_weight[t],
+                                  rate=rate, burst=4 * rate)
+        dp.swap()
+    out["tenant_isolation_quota_pps"] = round(quota_pps, 1)
+    well_fps = 0.8 * quota_fps
+    # (3) solo baselines for the well-behaved tenants
+    solo = {}
+    for t in range(1, N):
+        solo[t] = capture({t: well_fps},
+                          duration=3.0 * phase_s)["tenants"][t]
+    out["tenant_isolation_solo"] = {
+        str(t): {"goodput_fps": solo[t]["goodput_fps"],
+                 "p99_us": solo[t]["p99_us"]} for t in solo}
+    # (4) the overload phase: tenant MIS at 4x quota (square wave
+    # 6x/2x), everyone else unchanged. The device token bucket
+    # absorbs the overage (attributed tenant_quota) and WFQ keeps the
+    # well-behaved tenants' queues empty; the shallow ring windows
+    # above keep their in-flight depth solo-like
+    over = capture(
+        {**{t: well_fps for t in range(1, N)}, MIS: 4 * quota_fps},
+        duration=5.0, square_t=MIS,
+        square=(6 * quota_fps, 2 * quota_fps, 0.25))
+    rows = over["tenants"]
+    out["tenant_isolation_overload"] = {
+        str(t): dict(rows[t]) for t in rows}
+    ratios_g, ratios_p = [], []
+    # the well-behaved tenants are configured IDENTICALLY (same rate/
+    # burst/weight/offered), so the median of their solo p99s is one
+    # shared baseline: a single tenant's ~75-sample solo p99 swings
+    # 2x run-to-run on this 1-core harness (the dominant ratio noise),
+    # the median-of-3 does not — per-tenant overload p99s still
+    # compare individually against it
+    solo_p99_med = max(float(np.median([s["p99_us"]
+                                        for s in solo.values()])), 1e-9)
+    for t in range(1, N):
+        ratios_g.append(rows[t]["goodput_fps"]
+                        / max(solo[t]["goodput_fps"], 1e-9))
+        ratios_p.append(rows[t]["p99_us"] / solo_p99_med)
+    out["tenant_isolation_goodput_ratio_min"] = round(min(ratios_g), 3)
+    out["tenant_isolation_p99_ratio_max"] = round(max(ratios_p), 2)
+    # (5) attribution + EXACT conservation over the overload phase
+    mis = rows[MIS]
+    overage = max(1, mis["offered_pkts"] - mis["goodput_pkts"])
+    out["tenant_isolation_mis_quota_drop_pkts"] = mis["quota_drop_pkts"]
+    out["tenant_isolation_mis_shed_pkts"] = mis["shed_pkts"]
+    out["tenant_isolation_attributed_pct"] = round(
+        100.0 * (mis["quota_drop_pkts"] + mis["shed_pkts"]) / overage,
+        2)
+    offered_total = sum(r["offered_pkts"] for r in rows.values())
+    accounted = (sum(r["goodput_pkts"] + r["quota_drop_pkts"]
+                     + r["shed_pkts"] for r in rows.values())
+                 + over["drops_shutdown"] + over["drops_error"]
+                 + over["queued_residual"])
+    out["tenant_isolation_conserved"] = int(offered_total == accounted)
+    out["tenant_isolation_residual_pkts"] = over["queued_residual"]
+    out["tenant_isolation_io_callbacks"] = over["io_callbacks"]
+    _progress(
+        tenant_isolation_goodput_ratio_min=out[
+            "tenant_isolation_goodput_ratio_min"],
+        tenant_isolation_p99_ratio_max=out[
+            "tenant_isolation_p99_ratio_max"],
+        tenant_isolation_attributed_pct=out[
+            "tenant_isolation_attributed_pct"],
+        tenant_isolation_conserved=out["tenant_isolation_conserved"])
+    return out
+
+
 def sub_benches(args):
     """BASELINE configs #1/#3/#4 as secondary metrics."""
     import jax
@@ -1582,14 +1885,15 @@ def snapshot_bench(args, batch: int = 2048, iters: int = 24) -> dict:
     return out
 
 
-def wire_udp(i: int, dport: int = 80) -> bytes:
-    """One test UDP frame 10.1.1.2 → 10.1.1.3 (shared by the ring bench
+def wire_udp(i: int, dport: int = 80, src: str = "10.1.1.2") -> bytes:
+    """One test UDP frame ``src`` → 10.1.1.3 (shared by the ring bench
     and the daemon-bench sender subprocess; ``dport`` lets the
-    latency-SLO ladder tag priority-lane traffic)."""
+    latency-SLO ladder tag priority-lane traffic, ``src`` lets the
+    tenant-isolation scenario derive per-tenant flows)."""
     import ipaddress
     import struct
 
-    src = ipaddress.ip_address("10.1.1.2").packed
+    src = ipaddress.ip_address(src).packed
     dst = ipaddress.ip_address("10.1.1.3").packed
     eth = b"\x02\x00\x00\x00\x00\x02\x02\x00\x00\x00\x00\x01\x08\x00"
     l4 = struct.pack("!HHHH", 40000 + (i % 1024), dport, 16, 0) + b"y" * 8
@@ -3087,6 +3391,20 @@ def _run():
             pri["latency_slo_bench_error"] = f"{type(e).__name__}: {e}"
         _jc_now = _jit_compiles_now()
         pri["latency_slo_jit_compiles"] = _jc_now - _jc
+        _jc = _jc_now
+        _progress(**pri)
+        try:
+            # multi-tenant isolation (ISSUE 14): 4 tenants on the
+            # wire path, tenant 4 at 4x quota with a square-wave
+            # burst (acceptance: well-behaved goodput >= 0.9x solo,
+            # p99 <= 2x solo, overage fully attributed
+            # tenant_quota/overload, conservation exact)
+            pri.update(tenant_isolation_bench(args))
+        except Exception as e:  # noqa: BLE001
+            pri["tenant_isolation_bench_error"] = \
+                f"{type(e).__name__}: {e}"
+        _jc_now = _jit_compiles_now()
+        pri["tenant_isolation_jit_compiles"] = _jc_now - _jc
         _jc = _jc_now
         _progress(**pri)
         try:

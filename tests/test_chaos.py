@@ -744,3 +744,149 @@ class TestGovernorChaos:
         finally:
             pump.stop(join_timeout=30.0)
             rings.close()
+
+
+class _ShedGovStub:
+    """Deterministic governor stand-in for the weighted-shed schedule:
+    refuses the first ``refusals`` bulk admissions (each refusal sheds
+    exactly one group from the scheduler's hog), then admits
+    everything. Implements only the surface the dispatch loop touches
+    (tick_due/limits/admit); no control thread, no timing."""
+
+    def __init__(self, refusals):
+        self.refusals = refusals
+        self.fill = 8
+
+    def bind(self, slots, inflight, queue_cap=None):
+        pass
+
+    def tick_due(self):
+        return False
+
+    def limits(self):
+        return (8, 4, self.refusals > 0)
+
+    def admit(self, is_priority, backlog):
+        if is_priority:
+            return True
+        if self.refusals > 0:
+            self.refusals -= 1
+            return False
+        return True
+
+
+def _tenant_cls():
+    from vpp_tpu.tenancy.sched import (
+        TenantClassifier,
+        tenant_entries_from_config,
+    )
+
+    return TenantClassifier(tenant_entries_from_config([
+        {"id": 1, "prefixes": ["10.50.0.0/16"], "weight": 1},
+        {"id": 2, "prefixes": ["10.60.0.0/16"], "weight": 8},
+    ]))
+
+
+def _push_tenant(rings, rx_if, src, n_frames, per, tag0):
+    codec = PacketCodec()
+    scratch = np.zeros((VEC, rings.rx.snap), np.uint8)
+    pkts = 0
+    for k in range(n_frames):
+        frames = [make_frame(src, SERVER_IP, proto=17,
+                             sport=tag0 + k, dport=1000 + k * per + j)
+                  for j in range(per)]
+        cols, n = codec.parse(frames, rx_if, scratch)
+        assert rings.rx.push(cols, n, payload=scratch)
+        pkts += n
+    return pkts
+
+
+class TestTenantChaos:
+    def test_tenant_starve_fault_conserves(self):
+        """The ``pump.tenant_starve`` seam (ISSUE 14): tenant
+        classification demoted to the default tenant loses the
+        weighted lane but NEVER conservation — every offered packet
+        is delivered or attributed, the demotions are counted, and
+        all lane accounting lands under tenant 0."""
+        dp, a, b = _forwarding_dp()
+        rings = IORingPair(n_slots=64)
+        plan = faults.install(faults.FaultPlan(seed=SEED + 9))
+        plan.inject("pump.tenant_starve", times=-1)
+        pump = DataplanePump(dp, rings, mode="dispatch",
+                             max_batch=VEC, tenants=_tenant_cls())
+        pump.start()
+        try:
+            offered = 0
+            for k in range(6):
+                offered += _push_tenant(rings, a, "10.50.1.1", 1, 4,
+                                        30000 + k)
+                offered += _push_tenant(rings, a, "10.60.1.1", 1, 4,
+                                        31000 + k)
+                time.sleep(0.02)
+            deadline = time.monotonic() + 120.0
+            while pump.stats["pkts"] < offered \
+                    and time.monotonic() < deadline:
+                while rings.tx.peek() is not None:
+                    rings.tx.release()
+                time.sleep(0.01)
+            while rings.tx.peek() is not None:
+                rings.tx.release()
+            assert pump.stop(join_timeout=60.0)
+            s = pump.stats
+            assert s["pkts"] == offered  # EXACT conservation
+            assert plan.fired("pump.tenant_starve") == 12
+            assert s["tenant_starved"] == 12
+            tio = pump.tenant_io_snapshot()
+            # every frame was demoted: only the default lane exists
+            assert set(tio["io"]) == {0}
+            assert tio["io"][0]["pkts"] == offered
+        finally:
+            pump.stop(join_timeout=30.0)
+            rings.close()
+
+    def test_brownout_sheds_per_tenant_weighted_not_fifo(self):
+        """The ISSUE 14 fairness-under-overload contract: with tenant
+        lanes, brownout shedding picks the tenant with the most
+        backlog PER UNIT WEIGHT — not arrival order. Tenant 2 (weight
+        8, small backlog) pushes FIRST, so FIFO shedding would eat its
+        frames; the hog (tenant 1: weight 1, deep backlog) arrives
+        after and must absorb EVERY shed, attributed drops_overload
+        with exact conservation."""
+        dp, a, b = _forwarding_dp()
+        rings = IORingPair(n_slots=64)
+        gov = _ShedGovStub(refusals=1)
+        pump = DataplanePump(dp, rings, mode="dispatch",
+                             max_batch=VEC, governor=gov,
+                             tenants=_tenant_cls())
+        # the whole backlog is queued BEFORE the pump starts, oldest
+        # frames belonging to the light tenant; the hog's 192-pkt
+        # backlog fits one shed group (< max_batch=VEC), so ONE
+        # refusal sheds exactly the hog's queue and nothing else
+        offered = _push_tenant(rings, a, "10.60.1.1", 6, 4, 41000)
+        offered += _push_tenant(rings, a, "10.50.1.1", 12, 16, 40000)
+        pump.start()
+        try:
+            deadline = time.monotonic() + 120.0
+            while pump.stats["pkts"] + pump.stats["drops_overload"] \
+                    < offered and time.monotonic() < deadline:
+                while rings.tx.peek() is not None:
+                    rings.tx.release()
+                time.sleep(0.01)
+            while rings.tx.peek() is not None:
+                rings.tx.release()
+            assert pump.stop(join_timeout=60.0)
+            s = pump.stats
+            assert s["pkts"] + s["drops_overload"] == offered
+            assert s["drops_overload"] == 192
+            tio = pump.tenant_io_snapshot()
+            # weighted, not FIFO: the oldest frames (tenant 2) were
+            # never shed; the hog absorbed every drop — and the light
+            # tenant's packets were all DELIVERED
+            assert tio["io"][1]["shed_pkts"] == 192
+            assert tio["io"][2]["shed_pkts"] == 0
+            assert tio["io"][2]["pkts"] == 24
+            assert s["pkts"] == 24
+            assert gov.refusals == 0
+        finally:
+            pump.stop(join_timeout=30.0)
+            rings.close()

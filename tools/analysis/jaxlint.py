@@ -45,7 +45,8 @@ from typing import Dict, List, Optional, Tuple
 
 from analysis.common import Finding, iter_source_files, parse_suppressions
 
-JAX_ROOTS = ("vpp_tpu/ops", "vpp_tpu/pipeline", "vpp_tpu/parallel")
+JAX_ROOTS = ("vpp_tpu/ops", "vpp_tpu/pipeline", "vpp_tpu/parallel",
+             "vpp_tpu/tenancy")
 
 ARRAY_MODULES = {"jnp", "lax", "jsp", "pl"}
 STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "sharding"}

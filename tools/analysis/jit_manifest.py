@@ -58,6 +58,11 @@ JIT_SITES = {
         "8): one on-device O(table) pass returning [n_chunks] uint32 "
         "— only chunks whose digest moved drain; memoized per "
         "chunk_buckets geometry",
+    ("vpp_tpu/tenancy/derive.py", "<module>"):
+        "tenant_occupancy: per-tenant live-session slice counts for "
+        "`show tenants` / vpp_tpu_tenant_sess_occupancy (ISSUE 14) — "
+        "one on-device prefix sum returning [T] ints, compiled once "
+        "per table geometry; an observability path, never hot",
 }
 
 # (relpath, dotted def qualname) traced into jit programs indirectly
@@ -123,4 +128,16 @@ TRACED_ROOTS = {
     ("vpp_tpu/parallel/cluster.py", "sharded_global_classify_mxu"),
     # vxlan encap rides its own jit (Dataplane.encap_remote)
     ("vpp_tpu/ops/vxlan.py", "vxlan_encap"),
+    # the tenant stage (ISSUE 14): derivation + token bucket +
+    # accounting are traced into every tenancy-gated step variant via
+    # graph._tenant_eval/_finish_step, and the tenant-sliced bucket
+    # computation into the session/NAT ops — all through the SAME
+    # process-wide _jitted_step cache (exactly one new step form)
+    ("vpp_tpu/tenancy/derive.py", "addr_tenant"),
+    ("vpp_tpu/tenancy/derive.py", "key_tenant"),
+    ("vpp_tpu/tenancy/derive.py", "tenant_ids"),
+    ("vpp_tpu/tenancy/derive.py", "tenant_limit"),
+    ("vpp_tpu/tenancy/derive.py", "tnt_account"),
+    ("vpp_tpu/tenancy/derive.py", "_tenant_occupancy_impl"),
+    ("vpp_tpu/ops/session.py", "tenant_bucket"),
 }

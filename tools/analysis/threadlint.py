@@ -56,6 +56,9 @@ THREAD_ROOTS = (
     "vpp_tpu/trace",
     "vpp_tpu/pipeline/txn.py",
     "vpp_tpu/pipeline/persistent.py",
+    # tenancy host side (ISSUE 14): the WFQ scheduler/classifier the
+    # pump drives under its _held_lock/_lat_lock
+    "vpp_tpu/tenancy/sched.py",
     # ISSUE 8: the snapshotter's stats flip under its lock around the
     # long unlocked drain, and the fault plan's spec/counter state is
     # bumped from every thread that crosses an armed point

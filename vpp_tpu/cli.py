@@ -65,6 +65,7 @@ class DebugCLI:
             ("show", "latency"): self.show_latency,
             ("show", "top-flows"): self.show_top_flows,
             ("show", "governor"): self.show_governor,
+            ("show", "tenants"): self.show_tenants,
             ("show", "io"): self.show_io,
             ("show", "neighbors"): self.show_neighbors,
             ("show", "store"): self.show_store,
@@ -95,7 +96,7 @@ class DebugCLI:
             "show partitions | "
             "show nat44 | show fib | show trace | show errors | "
             "show fastpath | show ml | show latency | show top-flows | "
-            "show governor | show io | show neighbors | "
+            "show governor | show tenants | show io | show neighbors | "
             "show store | "
             "show resilience | show config-history [n] | show spans [n] | "
             "trace add [n] | trace clear | config replay <journal> | "
@@ -937,6 +938,79 @@ class DebugCLI:
             f"overload shed: {ps.get('drops_overload', 0)} pkts "
             f"(drops_total{{reason=\"overload\"}})"
         )
+        return "\n".join(lines)
+
+    def show_tenants(self) -> str:
+        """Multi-tenant gateway page (ISSUE 14; vpp_tpu/tenancy/):
+        per-tenant config (prefixes, token bucket, capacity slice,
+        WFQ weight), live device counters (rx/goodput/rate-limit
+        drops/slice failures, bucket fill, slice occupancy) and the
+        pump's lane state. Host scalars only — the [T] planes cross
+        the transport, never table columns."""
+        snap_fn = getattr(self.dp, "tenant_snapshot", None)
+        snap = snap_fn() if callable(snap_fn) else None
+        if snap is None:
+            return "tenancy: off (dataplane.tenancy)"
+        lines = ["Multi-tenant gateway (dataplane.tenancy: on)"]
+        tio = None
+        if self.pump is not None and hasattr(self.pump,
+                                             "tenant_io_snapshot"):
+            tio = self.pump.tenant_io_snapshot()
+        reg = snap["tenants"]
+        # tenant 0 always renders: it is the implicit default sink for
+        # unmatched traffic, whose counters matter MOST once real
+        # tenants are registered
+        tids = sorted(set(reg) | {0})
+        for tid in tids:
+            e = reg.get(tid, {})
+            name = e.get("name", f"tenant-{tid}")
+            lines.append(f"tenant {tid} ({name}):")
+            if e.get("prefixes"):
+                lines.append(f"  prefixes     {', '.join(e['prefixes'])}")
+            if e.get("vni") is not None:
+                lines.append(f"  vni          {e['vni']}")
+            rate = int(snap["rate"][tid])
+            if rate:
+                lines.append(
+                    f"  bucket       rate {rate}/tick  burst "
+                    f"{int(snap['burst'][tid])}  fill "
+                    f"{int(snap['tokens'][tid])}")
+            else:
+                lines.append("  bucket       unlimited (rate 0)")
+            lines.append(
+                f"  sessions     {int(snap['occupancy'][tid])} live / "
+                f"{int(snap['sess_quota_slots'][tid])} slice slots"
+                + ("" if e.get("sess_buckets") else " (unsliced)"))
+            lines.append(
+                f"  counters     rx {int(snap['rx'][tid])}  goodput "
+                f"{int(snap['tx'][tid])}  rl-drops "
+                f"{int(snap['rl_drops'][tid])}  slice-fails "
+                f"{int(snap['quota_fails'][tid])}")
+            if e.get("ml_mode", "inherit") != "inherit" \
+                    or e.get("ml_thresh") is not None:
+                lines.append(
+                    f"  ml           mode {e.get('ml_mode', 'inherit')}"
+                    + (f"  thresh {e['ml_thresh']}"
+                       if e.get("ml_thresh") is not None else ""))
+            if tio is not None:
+                io = tio["io"].get(tid)
+                q = tio["queued"].get(tid)
+                w = tio["weights"].get(tid, 1)
+                parts = [f"weight {w}"]
+                if io:
+                    parts.append(
+                        f"frames {io['frames']}  pkts {io['pkts']}  "
+                        f"shed {io['shed_pkts']}")
+                if q:
+                    parts.append(f"queued {q['frames']}f/{q['pkts']}p")
+                lines.append("  pump         " + "  ".join(parts))
+        if self.pump is not None:
+            s = self.pump.stats
+            lines.append(
+                f"totals: quota-drops "
+                f"{s.get('drops_tenant_quota', 0)}  slice-fails "
+                f"{s.get('tenant_sess_quota_fails', 0)}  starved "
+                f"{s.get('tenant_starved', 0)}")
         return "\n".join(lines)
 
     def show_io(self) -> str:

@@ -301,6 +301,14 @@ class ContivAgent:
                 netlink_backend, persist_path=c.stn_persist_path
             )
             self.stn.steal(c.stn_interface)
+        # multi-tenant gateway mode (ISSUE 14; vpp_tpu/tenancy/):
+        # stage the configured tenants BEFORE the base swap so the
+        # first epoch already derives/slices/limits per tenant —
+        # entries were validated at config load
+        if c.tenants:
+            for e in c.tenants:
+                kw = {k: v for k, v in e.items() if k != "id"}
+                self.dataplane.builder.set_tenant(e["id"], **kw)
         # publish the base vswitch config (uplink/host interfaces staged
         # in __init__) before anything can send through those interfaces
         # — configureVswitchConnectivity's final txn in the reference
@@ -360,6 +368,14 @@ class ContivAgent:
                     prefixes=c.io.priority_prefixes,
                     protos=c.io.priority_protos,
                 )
+            # tenant lanes (ISSUE 14): the pump's weighted-fair
+            # classifier mirrors the staged tenant registry (same
+            # prefixes/weights/VNIs the device derivation uses)
+            tenant_cls = None
+            if c.tenants:
+                from vpp_tpu.tenancy.sched import TenantClassifier
+
+                tenant_cls = TenantClassifier(c.tenants)
             self.io_pump = DataplanePump(
                 self.dataplane, self.io_rings,
                 max_batch=c.io.max_batch, depth=c.io.depth,
@@ -373,6 +389,8 @@ class ContivAgent:
                 ring_fault_limit=c.io.io_ring_fault_limit,
                 governor=governor,
                 priority=priority,
+                tenants=tenant_cls,
+                tenant_quantum=c.io.io_tenant_quantum,
                 # ICMP errors (time-exceeded/unreachable) originate from
                 # the node's pod gateway address — the hop traceroute
                 # shows (reference: VPP ip4-icmp-error)

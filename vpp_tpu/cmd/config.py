@@ -67,6 +67,15 @@ class IOConfig:
     #                    writeback with window N+1's rx refill)
     io_ring_slots: int = 8
     io_ring_windows: int = 2
+    # Tenant WFQ service quantum (ISSUE 14; io/pump.py): cap in
+    # PACKETS on one tenant's weighted-fair take. 0 = a full
+    # slot/batch (the throughput shape). A WFQ delay bound scales
+    # with quantum x active lanes, so a small quantum bounds how long
+    # a light tenant's frame sits behind another tenant's bulk in the
+    # shared window pipeline — more window exchanges per packet in
+    # trade (the tenant_isolation_bench dial). Only meaningful with
+    # tenants configured.
+    io_tenant_quantum: int = 0
     # degraded-mode escape hatch (ISSUE 8; io/pump.py): after this many
     # resident-ring deaths the persistent pump stops relaunching the
     # device ring and falls back to the dispatch ladder (slower but
@@ -247,6 +256,28 @@ class AgentConfig:
     #   ``dataplane.telemetry_topk``  heavy-hitter candidate slots
     # All validated at load with the session-table knobs.
     dataplane: DataplaneConfig = dataclasses.field(default_factory=DataplaneConfig)
+    # multi-tenant gateway mode (ISSUE 14; vpp_tpu/tenancy/,
+    # docs/TENANCY.md): with ``dataplane.tenancy: on``, each entry
+    # registers one tenant —
+    #   id            tenant id (0 = the default tenant; required)
+    #   name          display name
+    #   prefixes      IPv4 CIDRs owned by the tenant (the device
+    #                 derivation map; disjoint across tenants —
+    #                 overlap is refused at load)
+    #   vni           VXLAN VNI → tenant for encapsulated ingress
+    #   rate/burst    token bucket: rate tokens per clock tick
+    #                 (0 = unlimited), burst = bucket capacity;
+    #                 overage drops attributed
+    #                 drops_total{reason="tenant_quota"}
+    #   sess_buckets/nat_buckets  power-of-2 session/NAT capacity
+    #                 slice (bucket counts; 0 = unsliced) — a full
+    #                 slice fails/evicts only within its tenant
+    #   weight        weighted-fair dequeue weight in the IO pump
+    #   ml_mode/ml_thresh  per-tenant ML override
+    #                 (inherit|off|score|enforce + flag threshold)
+    # Validated at load (vpp_tpu/tenancy/sched.py): bad prefixes,
+    # out-of-range ids/rates and oversubscribed slices fail HERE.
+    tenants: list = dataclasses.field(default_factory=list)
     # IPAM subnets
     ipam: IpamConfig = dataclasses.field(default_factory=IpamConfig)
     # packet IO
@@ -274,6 +305,18 @@ class AgentConfig:
             from vpp_tpu.pipeline.tables import validate_dataplane_config
 
             validate_dataplane_config(d["dataplane"])
+        if d.get("tenants"):
+            # tenant entries validate against the dataplane geometry
+            # at LOAD (vpp_tpu/tenancy/sched.py — jax-free): a bad
+            # prefix or an oversubscribed slice is a config error,
+            # not a first-commit surprise
+            from vpp_tpu.tenancy.sched import validate_tenancy_config
+
+            dp_cfg = d.get("dataplane", DataplaneConfig())
+            if getattr(dp_cfg, "tenancy", "off") == "off":
+                raise ValueError(
+                    "tenants: configured but dataplane.tenancy is off")
+            d["tenants"] = validate_tenancy_config(dp_cfg, d["tenants"])
         build_section(
             "ipam", IpamConfig,
             {f.name for f in dataclasses.fields(IpamConfig)},
@@ -297,6 +340,10 @@ class AgentConfig:
             from vpp_tpu.io.governor import validate_governor_config
 
             validate_governor_config(d["io"])
+            if int(d["io"].io_tenant_quantum) < 0:
+                raise ValueError(
+                    "io.io_tenant_quantum must be >= 0 (packets; "
+                    "0 = a full slot/batch)")
         build_section(
             "mesh", MeshConfig,
             {f.name for f in dataclasses.fields(MeshConfig)},

@@ -108,7 +108,11 @@ _SENTINEL = object()
 # tools/lint.py --counters pass enforces it (ISSUE 13 satellite), so a
 # new drop cause added on either side without its twin fails tier-1.
 PUMP_DROP_KEYS = ("drops_rx_full", "drops_tx_stall", "drops_shutdown",
-                  "drops_error", "drops_overload")
+                  "drops_error", "drops_overload",
+                  # tenant token-bucket overage dropped ON DEVICE
+                  # (DROP_TENANT verdicts, counted off the aux rider —
+                  # ISSUE 14); the reason label is "tenant_quota"
+                  "drops_tenant_quota")
 
 # governor ticks a quiet priority lane holds its last p99 observation
 # for before reading as no-signal (io/pump.py _gov_observe lane
@@ -148,7 +152,9 @@ class DataplanePump:
                  ring_windows: int = 2,
                  ring_fault_limit: int = 3,
                  governor=None,
-                 priority=None):
+                 priority=None,
+                 tenants=None,
+                 tenant_quantum: int = 0):
         """``max_batch``: largest coalesced device batch (packets);
         ``max_inflight``: in-flight batches before the dispatch stage
         backpressures (``depth`` is the legacy alias — ``max_inflight``
@@ -199,7 +205,26 @@ class DataplanePump:
         admission in brownout as attributed ``drops_overload``.
         ``priority``: optional PriorityFilter designating reflex
         flows: they form their own coalesce groups, preempt bulk
-        windows in the ring staging path, and are never shed."""
+        windows in the ring staging path, and are never shed.
+        ``tenants``: optional tenancy/sched.py TenantClassifier
+        (ISSUE 14) — bulk frames are lane-classified per tenant at
+        the scan frontier and dequeued WEIGHTED-FAIR (virtual-time
+        WFQ over per-tenant queues), so one tenant's backlog cannot
+        starve the rest; in governor brownout the pump sheds from the
+        tenant with the most backlog per unit weight (the hog)
+        instead of FIFO order, attributed ``drops_overload`` with
+        per-tenant accounting. The priority lane still outranks every
+        tenant queue (reflexes first), and tenant groups are
+        single-tenant so shedding/attribution stay clean (the chain
+        folder stays disengaged under tenant scheduling).
+        ``tenant_quantum``: cap (packets) on one tenant's WFQ service
+        take (0 = a full slot/batch, the throughput shape). A WFQ
+        delay bound scales with the service quantum x active lanes,
+        so a smaller quantum bounds how long a light tenant's frame
+        sits behind another tenant's bulk inside the shared window
+        pipeline — at the cost of more window exchanges per delivered
+        packet (the same latency/throughput dial as the ring fill;
+        ``io.io_tenant_quantum``)."""
         if mode not in ("dispatch", "persistent"):
             raise ValueError(f"unknown pump mode {mode!r}")
         self.mode = mode
@@ -316,6 +341,12 @@ class DataplanePump:
             # and attributed, never silent queue growth)
             "drops_tx_stall": 0, "drops_shutdown": 0, "drops_rx_full": 0,
             "drops_error": 0, "drops_overload": 0,
+            # tenancy (ISSUE 14): device token-bucket drops + slice
+            # insert failures off aux rows 10/11, and tenant
+            # classifications the pump.tenant_starve fault seam
+            # demoted to the default tenant (chaos testing)
+            "drops_tenant_quota": 0, "tenant_sess_quota_fails": 0,
+            "tenant_starved": 0,
             # priority lane (ISSUE 13): frames/packets classified into
             # the reflex lane by the PriorityFilter, windows the ring
             # stager shipped early for one (synced from the
@@ -323,6 +354,10 @@ class DataplanePump:
             # "pump.priority_starve" fault seam demoted to bulk
             "priority_frames": 0, "priority_pkts": 0,
             "priority_preempts": 0, "priority_starved": 0,
+            # express-vs-bulk service order under tenant lanes
+            # (ISSUE 14): WFQ bulk-frame admissions at the most recent
+            # express take — diagnostics, not exported
+            "priority_admit_bulk_seq": 0,
             # device-ring telemetry (persistent mode; synced from the
             # PersistentPump by the collect loop + at stop-merge):
             # windows exchanged, frames staged, live in-flight windows,
@@ -426,6 +461,21 @@ class DataplanePump:
         # pump keeps the last-known window shape.
         self.governor = governor
         self.priority = priority
+        # tenancy lanes (ISSUE 14; vpp_tpu/tenancy/sched.py): the
+        # classifier routes bulk frames into per-tenant WFQ queues at
+        # the scan frontier; per-tenant host counters live under
+        # _lat_lock, the scheduler itself under _held_lock (it extends
+        # the rid bookkeeping).
+        self.tenants = tenants
+        self._tnt_sched = None
+        if tenants is not None:
+            from vpp_tpu.tenancy.sched import TenantScheduler
+
+            self._tnt_sched = TenantScheduler(tenants.weights)
+        self.tenant_quantum = int(tenant_quantum) if tenant_quantum \
+            else 0
+        self.tenant_io: dict = {}
+        self._tnt_admit_frames = 0  # global WFQ admission seq (_lat_lock)
         if governor is not None:
             slots = (self.ring_slots if mode == "persistent"
                      else max(1, self.max_batch // VEC))
@@ -572,24 +622,55 @@ class DataplanePump:
             return False
         return True
 
+    def _frame_tenant(self, f) -> int:
+        """Classify one bulk frame into its tenant lane (ISSUE 14).
+        The "pump.tenant_starve" fault seam demotes a frame to the
+        DEFAULT tenant — it loses its weighted lane (schedulable and
+        sheddable as tenant 0) but is still CONSERVED, which the chaos
+        schedule proves."""
+        try:
+            faults.fire("pump.tenant_starve")
+        except faults.FaultInjected:
+            # dispatch-thread-only counter (like priority_starved)
+            self.stats["tenant_starved"] += 1
+            return 0
+        return self.tenants.frame_tenant(f)
+
     def _scan_express(self, rx, hold_cap: int) -> None:
         """Advance the lane-classification frontier over newly arrived
-        frames and route priority ones to the express queue (ISSUE
-        13). Each frame is classified exactly ONCE (the frontier is
-        monotone in rid); express rids are marked taken immediately so
-        bulk takes skip them. The frontier STALLS (resumes next round)
-        while the express queue holds ``hold_cap`` rids, so an
-        all-priority burst backpressures the producer instead of
-        marking every ring slot taken at once. Classification runs
-        OUTSIDE _held_lock — the frame cannot be released before it is
-        taken and completed, so its views are stable, and the tx
-        writer's release path must not wait out numpy matching. No-op
-        without a priority filter."""
-        if self.priority is None:
+        frames: priority ones to the express queue (ISSUE 13), and —
+        with a TenantClassifier attached (ISSUE 14) — every other
+        frame into its tenant's WFQ queue. Each frame is classified
+        exactly ONCE (the frontier is monotone in rid); lane-routed
+        rids are marked taken immediately so bulk takes skip them.
+        The frontier STALLS (resumes next round) while the lanes hold
+        ``hold_cap`` rids, so a burst backpressures the producer
+        instead of marking every ring slot taken at once.
+        Classification runs OUTSIDE _held_lock — the frame cannot be
+        released before it is taken and completed, so its views are
+        stable, and the tx writer's release path must not wait out
+        numpy matching. No-op without a priority filter or tenant
+        classifier."""
+        if self.priority is None and self.tenants is None:
             return
         while True:
             with self._held_lock:
-                if len(self._express) >= hold_cap:
+                # the taken+done bound matters only for PURE tenant
+                # lanes, where the scan marks EVERY frame taken as it
+                # routes it: without it a burst would claim the whole
+                # ring at once. It must NOT gate any config with a
+                # priority filter — the express lane's contract is to
+                # classify and jump the bulk queue precisely while
+                # bulk holds the ring at its cap (ISSUE 13), and the
+                # frontier is monotone, so stalling it on bulk
+                # occupancy would make reflex CLASSIFICATION
+                # bulk-service-bound. With both lanes attached the
+                # WFQ queues stay bounded by the rx ring itself.
+                if (len(self._express) >= hold_cap
+                        or (self.tenants is not None
+                            and self.priority is None
+                            and len(self._taken) + len(self._done_rids)
+                            >= hold_cap)):
                     return
                 base = self._consumed_base
                 rid = max(self._scan_rid, base)
@@ -599,12 +680,24 @@ class DataplanePump:
                 if f is None:
                     return
                 self._scan_rid = rid + 1
-            if self._frame_priority(f):
+            if self.priority is not None and self._frame_priority(f):
                 with self._held_lock:
                     self._taken.add(rid)
                     self._express.append(rid)
                 self.stats["priority_frames"] += 1
                 self.stats["priority_pkts"] += f.n
+                continue
+            if self.tenants is not None:
+                tid = self._frame_tenant(f)
+                with self._held_lock:
+                    self._taken.add(rid)
+                    self._tnt_sched.push(tid, rid, f.n)
+                with self._lat_lock:
+                    io = self.tenant_io.setdefault(
+                        tid, {"frames": 0, "pkts": 0, "shed_pkts": 0,
+                              "admitted_pkts": 0})
+                    io["frames"] += 1
+                    io["pkts"] += f.n
 
     def _take_express(self, rx):
         """Pop the oldest express rid into a one-frame group, or None.
@@ -624,7 +717,116 @@ class DataplanePump:
             if f is None:  # unreachable: taken rids stay pending
                 self._taken.discard(rid)
                 return None
-            return [_RidFrame(f.cols, f.n, f.epoch, f.payload, rid)]
+        if self._tnt_sched is not None:
+            with self._lat_lock:
+                # express-vs-bulk service ORDER signal (the tenant
+                # last_admit_seq analog): how many bulk frames the WFQ
+                # lanes had admitted when this reflex frame took
+                # service — bounded regardless of bulk backlog depth
+                # is the ISSUE 13 contract, now observable poll-free
+                self.stats["priority_admit_bulk_seq"] = \
+                    self._tnt_admit_frames
+        return [_RidFrame(f.cols, f.n, f.epoch, f.payload, rid)]
+
+    def _take_tenant_group(self, rx, max_pkts: Optional[int] = None):
+        """Weighted-fair bulk take (ISSUE 14): serve the tenant with
+        the least virtual time one single-tenant coalesce group (its
+        queued frames in arrival order, up to ``max_pkts`` packets).
+        Returns ``(tid, [group])`` or None. Single-tenant groups keep
+        shedding and accounting attributable — the chain folder stays
+        disengaged under tenant scheduling. ``tenant_quantum`` caps
+        the take (the WFQ delay-bound dial — ctor doc)."""
+        if max_pkts is None:
+            max_pkts = self.max_batch
+        if self.tenant_quantum:
+            max_pkts = min(max_pkts, self.tenant_quantum)
+        with self._held_lock:
+            tid = self._tnt_sched.pick()
+            if tid is None:
+                return None
+            group = self._pop_tenant_group_locked(rx, tid, max_pkts)
+        if not group:
+            return None
+        with self._lat_lock:
+            io = self.tenant_io.setdefault(
+                tid, {"frames": 0, "pkts": 0, "shed_pkts": 0,
+                      "admitted_pkts": 0})
+            io["admitted_pkts"] += sum(f.n for f in group)
+            # monotone frame-admission sequence across ALL tenants,
+            # stamped per tenant at its most recent WFQ take: a
+            # poll-free service-ORDER signal (tenant A's last_admit_seq
+            # minus its own admitted frames = frames other tenants got
+            # before A finished — how the fairness test proves WFQ vs
+            # FIFO without racing a snapshot against the drain).
+            # Untakes (ring-fault requeue) do not rewind it: it orders
+            # admissions, it does not conserve them.
+            self._tnt_admit_frames += len(group)
+            io["last_admit_seq"] = self._tnt_admit_frames
+        return tid, [group]
+
+    def _pop_tenant_group_locked(self, rx, tid: int,
+                                 max_pkts: int) -> list:
+        """Dequeue up to ``max_pkts`` packets of ``tid`` from its WFQ
+        queue into a ``_RidFrame`` group (the shared body of the take
+        and shed paths — caller holds ``_held_lock``)."""
+        frames = self._tnt_sched.pop(tid, max_pkts)
+        base = self._consumed_base
+        group = []
+        for rid, _n in frames:
+            f = rx.peek_nth(rid - base)
+            if f is None:  # unreachable: taken rids stay pending
+                self._taken.discard(rid)
+                continue
+            group.append(_RidFrame(f.cols, f.n, f.epoch, f.payload,
+                                   rid))
+        return group
+
+    def _untake_tenant(self, tid: int, frames: list) -> None:
+        """Return un-dispatched tenant frames to the HEAD of their WFQ
+        queue (the ring-fault fallback path): the scan frontier is
+        monotone, so a plain untake would orphan them below it."""
+        with self._held_lock:
+            self._tnt_sched.requeue_front(
+                tid, [(f.rid, f.n) for f in frames])
+        with self._lat_lock:
+            io = self.tenant_io.get(tid)
+            if io is not None:
+                io["admitted_pkts"] -= sum(f.n for f in frames)
+
+    def _shed_tenant(self, rx) -> bool:
+        """Brownout shedding under tenant lanes (ISSUE 14): refuse one
+        group from the tenant with the MOST backlog per unit weight —
+        per-tenant-weighted shedding, never FIFO — attributed
+        ``drops_overload`` plus the per-tenant ledger. Returns False
+        with nothing queued (the caller falls through to take/idle)."""
+        with self._held_lock:
+            tid = self._tnt_sched.shed_pick()
+            if tid is None:
+                return False
+            group = self._pop_tenant_group_locked(rx, tid, self.max_batch)
+        if not group:
+            return False
+        with self._lat_lock:
+            io = self.tenant_io.setdefault(
+                tid, {"frames": 0, "pkts": 0, "shed_pkts": 0,
+                      "admitted_pkts": 0})
+            io["shed_pkts"] += sum(f.n for f in group)
+        self._post_batchless([group], "drops_overload")
+        return True
+
+    def tenant_io_snapshot(self) -> dict:
+        """Per-tenant IO-side counters + live queue state + weights
+        (host scalars; the collector/CLI read)."""
+        with self._lat_lock:
+            io = {t: dict(v) for t, v in self.tenant_io.items()}
+        queued = {}
+        if self._tnt_sched is not None:
+            with self._held_lock:
+                queued = self._tnt_sched.snapshot()
+        weights = dict(self.tenants.weights) if self.tenants else {}
+        names = dict(self.tenants.names) if self.tenants else {}
+        return {"io": io, "queued": queued, "weights": weights,
+                "names": names}
 
     def _take_groups(self, rx, hold_cap: int, chain_cap: int,
                      max_pkts: Optional[int] = None) -> list:
@@ -677,6 +879,17 @@ class DataplanePump:
                     self._taken.add(f.rid)
         return groups
 
+    def _untake_any(self, frames: list, priority: bool,
+                    tenant) -> None:
+        """Route an un-dispatch to the right lane's untake: express
+        rids back to the express head, tenant rids back to their WFQ
+        queue head (a plain untake would orphan them below the
+        monotone scan frontier), plain bulk rids simply untaken."""
+        if tenant is not None:
+            self._untake_tenant(tenant, frames)
+        else:
+            self._untake(frames, priority)
+
     def _untake(self, frames: list, priority: bool = False) -> None:
         """Return un-dispatched frames to the takeable pool (the
         ring-fault fallback path): bulk rids simply become untaken
@@ -707,11 +920,15 @@ class DataplanePump:
                 self._consumed_base += 1
 
     def _backlog(self) -> int:
-        """Frames pending in the rx ring that no lane has taken yet —
-        the governor's queue-depth observation."""
+        """Frames pending in the rx ring that no lane has DISPATCHED
+        yet — the governor's queue-depth observation. Tenant-queued
+        frames are marked taken at the scan frontier but still wait
+        for service, so they count back in."""
         with self._held_lock:
+            queued = (self._tnt_sched.total_frames
+                      if self._tnt_sched is not None else 0)
             return (self.rings.rx.pending() - len(self._taken)
-                    - len(self._done_rids))
+                    - len(self._done_rids) + queued)
 
     def _post_batchless(self, groups: list, drop_key: str) -> None:
         """Hand frames to the writer as a BATCHLESS done-item (no tx
@@ -860,6 +1077,24 @@ class DataplanePump:
                 # this thread — a blocked put can't scan for express
                 # arrivals, and the lane's bound is the scan cadence
                 time.sleep(self.poll_s)
+                continue
+            if self.tenants is not None:
+                # tenant lanes (ISSUE 14): brownout sheds from the
+                # hog (backlog/weight max) BEFORE taking, so the
+                # weighted-fair take below only ever serves admitted
+                # load; the take itself is WFQ — least virtual time
+                if gov is not None:
+                    if not gov.admit(False, self._backlog()):
+                        if self._shed_tenant(rx):
+                            continue
+                    if self.stats["inflight"] >= g_infl:
+                        time.sleep(self.poll_s)
+                        continue
+                taken = self._take_tenant_group(rx, max_pkts)
+                if taken is None:
+                    time.sleep(self.poll_s)
+                    continue
+                self._dispatch_or_fail(taken[1], slow)
                 continue
             groups = self._take_groups(rx, hold_cap, chain_cap,
                                        max_pkts)
@@ -1014,6 +1249,7 @@ class DataplanePump:
             ml_mode = getattr(self.dp, "_ml_mode", "off")
             ml_kind = getattr(self.dp, "_ml_kind", "mlp")
             tel_mode = getattr(self.dp, "_tel_mode", "off")
+            tnt_mode = getattr(self.dp, "_tnt_mode", "off")
         self._ppump = PersistentPump(tables, batch=VEC,
                                      fastpath=fastpath,
                                      classifier=classifier,
@@ -1024,6 +1260,7 @@ class DataplanePump:
                                      ml_mode=ml_mode,
                                      ml_kind=ml_kind,
                                      tel_mode=tel_mode,
+                                     tnt_mode=tnt_mode,
                                      ).start()
         if self.governor is not None:
             # a relaunched/restarted ring must resume at the
@@ -1041,6 +1278,7 @@ class DataplanePump:
         from vpp_tpu.pipeline.tables import (
             SESSION_FIELDS,
             TELEMETRY_FIELDS,
+            TENANCY_STATE_FIELDS,
         )
 
         if self._ppump is None:
@@ -1058,11 +1296,13 @@ class DataplanePump:
             self._ring_stats_sync()
         if final is None:
             return
-        # session state AND the telemetry planes (ISSUE 11) graft
-        # back: both rode the ring's private carry, so by stop time
-        # they are newer than whatever dp.tables holds
+        # session state, the telemetry planes (ISSUE 11) AND the
+        # tenancy state (token buckets + per-tenant counters, ISSUE
+        # 14) graft back: all rode the ring's private carry, so by
+        # stop time they are newer than whatever dp.tables holds
         sess = {f: getattr(final, f)
-                for f in (*SESSION_FIELDS, *TELEMETRY_FIELDS)}
+                for f in (*SESSION_FIELDS, *TELEMETRY_FIELDS,
+                          *TENANCY_STATE_FIELDS)}
         with self.dp._lock:
             if self.dp.tables is not None:
                 # DataplaneTables is a NamedTuple pytree, not a dataclass
@@ -1080,7 +1320,8 @@ class DataplanePump:
         self._persist_start()
 
     def _persist_submit_group(self, frames: list,
-                              priority: bool = False) -> str:
+                              priority: bool = False,
+                              tenant=None) -> str:
         """Pack + submit ONE compacted coalesce group (several small
         frames at sequential offsets of a single VEC descriptor slot —
         the header-compaction half of the 20 B/pkt budget) to the ring
@@ -1133,7 +1374,7 @@ class DataplanePump:
                 self._ppump = None
                 if self.ring_fault_limit and \
                         self._ring_faults >= self.ring_fault_limit:
-                    self._untake(frames, priority)
+                    self._untake_any(frames, priority, tenant)
                     return "fallback"
                 time.sleep(self._ring_backoff.next())
                 try:
@@ -1142,7 +1383,7 @@ class DataplanePump:
                     # cannot even start IS the wedged-ring case the
                     # fallback exists for, whatever the limit says
                     log.exception("resident loop relaunch failed")
-                    self._untake(frames, priority)
+                    self._untake_any(frames, priority, tenant)
                     return "fallback"
         self.stats["t_dispatch"] += time.perf_counter() - t0
         # unlocked: the dispatch thread is _seq's only writer, so its
@@ -1218,18 +1459,35 @@ class DataplanePump:
                         infl = self.stats["inflight"]
                     if infl >= g_infl:
                         break  # governed depth: outer loop re-ticks
-                    groups = self._take_groups(rx, hold_cap, 1,
-                                               max_pkts=VEC)
-                    if not groups:
-                        break
-                    if gov is not None and \
-                            not gov.admit(False, self._backlog()):
-                        # brownout: bulk beyond the SLO's queue budget
-                        # is dropped at admission, attributed — a shed
-                        # costs no device trip
-                        self._shed_group(groups)
-                        continue
-                    st = self._persist_submit_group(groups[0])
+                    tenant = None
+                    if self.tenants is not None:
+                        # tenant lanes (ISSUE 14): shed from the hog
+                        # before serving, then WFQ-take one
+                        # single-tenant VEC-compacted group
+                        if gov is not None and \
+                                not gov.admit(False, self._backlog()):
+                            if self._shed_tenant(rx):
+                                continue
+                        taken = self._take_tenant_group(rx,
+                                                        max_pkts=VEC)
+                        if taken is None:
+                            break
+                        tenant, tg = taken
+                        groups = tg
+                    else:
+                        groups = self._take_groups(rx, hold_cap, 1,
+                                                   max_pkts=VEC)
+                        if not groups:
+                            break
+                        if gov is not None and \
+                                not gov.admit(False, self._backlog()):
+                            # brownout: bulk beyond the SLO's queue
+                            # budget is dropped at admission,
+                            # attributed — a shed costs no device trip
+                            self._shed_group(groups)
+                            continue
+                    st = self._persist_submit_group(groups[0],
+                                                    tenant=tenant)
                     if st == "stop":
                         return
                     if st == "fallback":
@@ -1631,6 +1889,14 @@ class DataplanePump:
             if a.shape[1] >= 10:
                 self.stats["tel_observed"] += int(a[:, 8].sum())
                 self.stats["tel_sketched"] += int(a[:, 9].sum())
+            if a.shape[1] >= 12:
+                # tenancy rows (ISSUE 14): device token-bucket drops
+                # feed the tenant_quota reason of
+                # vpp_tpu_pump_drops_total; slice insert failures are
+                # the per-tenant congestion counter
+                self.stats["drops_tenant_quota"] += int(a[:, 10].sum())
+                self.stats["tenant_sess_quota_fails"] += \
+                    int(a[:, 11].sum())
         return all_fast
 
     # --- tx writer: reorder, split, write tx ring, release rx slots ---

@@ -198,6 +198,7 @@ from vpp_tpu.ops.telemetry import tel_flow_hash as _flow_hash  # noqa: E402
 
 def ml_policy(tables: DataplaneTables, pkts: PacketVector,
               alive: jnp.ndarray, scores: jnp.ndarray,
+              tid=None,
               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Fold scores into (flagged, drop_wanted) masks [P].
 
@@ -209,14 +210,41 @@ def ml_policy(tables: DataplaneTables, pkts: PacketVector,
     FLOWS — deterministic per flow, so one flow is either limited or
     not, never per-packet coin-flipped), nothing under mark/mirror.
     The pipeline applies it only in enforce mode, after ACL deny
-    (deny beats ml-drop beats permit)."""
-    flagged = alive & (scores > tables.glb_ml_thresh)
+    (deny beats ml-drop beats permit).
+
+    ``tid`` ([P] int32 tenant ids — tenancy on, ISSUE 14) keys the
+    per-tenant policy vectors (``glb_ml_tnt_mode``/``_thresh``, table
+    VALUES in the "tenant" upload group — tenants flip modes and
+    thresholds against ONE staged model, zero weight re-ship): mode 0
+    inherits the global threshold + compiled stage; 1 turns the stage
+    off for the tenant (nothing flagged); 2 scores/flags with the
+    tenant threshold but never drops; 3 enforces with it. The
+    compiled ``ml_stage`` knob stays the CEILING — a tenant cannot
+    enforce under a score-compiled step (graph._ml_eval discards
+    drops there)."""
     action = tables.glb_ml_action
+    # jax-ok: tid None vs array is a trace-time-static step-factory
+    # gate (the tenancy variant), not a tracer branch
+    if tid is None:
+        thresh = tables.glb_ml_thresh
+        flagged = alive & (scores > thresh)
+        drop_ok = True
+    else:
+        from vpp_tpu.pipeline.tables import ML_TNT_THRESH_INHERIT
+
+        mode = tables.glb_ml_tnt_mode[tid]        # [P]
+        t_thr = tables.glb_ml_tnt_thresh[tid]     # [P]
+        thresh = jnp.where(t_thr != ML_TNT_THRESH_INHERIT, t_thr,
+                           tables.glb_ml_thresh)
+        flagged = alive & (scores > thresh) & (mode != 1)
+        # drops allowed under inherit (the global stage decides) or an
+        # explicit per-tenant enforce; a score-mode tenant never drops
+        drop_ok = (mode == 0) | (mode == 3)
     rl_mask = jnp.left_shift(jnp.uint32(1),
                              tables.glb_ml_rl_shift.astype(jnp.uint32)
                              ) - jnp.uint32(1)
     rl_admit = (_flow_hash(pkts) & rl_mask) == 0
-    drop_wanted = flagged & (
+    drop_wanted = flagged & drop_ok & (
         (action == ML_ACTION_DROP)
         | ((action == ML_ACTION_RATELIMIT) & ~rl_admit)
     )

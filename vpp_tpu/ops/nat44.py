@@ -24,12 +24,14 @@ import jax.numpy as jnp
 
 from vpp_tpu.ops.session import (
     _hash,
+    _hash_mix,
     _pack_ports,
     global_buckets,
     hashmap_insert,
     shard_buckets,
     shard_combine_mask,
     shard_combine_value,
+    tenant_bucket,
 )
 from vpp_tpu.pipeline.tables import DataplaneTables
 from vpp_tpu.pipeline.vector import PacketVector
@@ -166,6 +168,7 @@ def nat44_record(
     want: jnp.ndarray,
     now: jnp.ndarray,
     shard=None,
+    tnt: bool = False,
 ) -> Tuple[DataplaneTables, jnp.ndarray, jnp.ndarray]:
     """Record NAT sessions for translated-and-forwarded flows.
 
@@ -194,9 +197,19 @@ def nat44_record(
         pkts.proto,
     )
     # sharded (bucket-axis mesh table): the global-hash +
-    # ownership-mask + psum-recombine contract of session_insert
-    h = _hash(*key_vals,
-              global_buckets(tables.natsess_valid.shape[0], shard))
+    # ownership-mask + psum-recombine contract of session_insert.
+    # jax-ok: tnt is a trace-time-static step-factory gate (a Python
+    # bool baked into the jit key), not a tracer branch — the record
+    # key is the REPLY presentation, and its address pair is the same
+    # unordered pair the reply's nat44_reverse lookup hashes, so the
+    # symmetric key_tenant lands both in the same tenant slice.
+    if tnt:
+        h = tenant_bucket(tables, key_vals[0], key_vals[1],
+                          _hash_mix(*key_vals),
+                          tables.tnt_nat_base, tables.tnt_nat_mask)
+    else:
+        h = _hash(*key_vals,
+                  global_buckets(tables.natsess_valid.shape[0], shard))
     if shard is not None:
         own, h = shard_buckets(h, tables.natsess_valid.shape[0], shard)
         want = want & own
@@ -240,6 +253,7 @@ def nat44_reverse(
     eligible: jnp.ndarray,
     now=None,
     shard=None,
+    tnt: bool = False,
 ) -> Tuple[PacketVector, jnp.ndarray, jnp.ndarray]:
     """Untranslate NAT'd return traffic.
 
@@ -266,7 +280,14 @@ def nat44_reverse(
         _pack_ports(pkts.sport, pkts.dport),
         pkts.proto,
     )
-    b = _hash(*key_vals, global_buckets(n_buckets, shard))
+    # jax-ok: tnt is a trace-time-static step-factory gate (a Python
+    # bool baked into the jit key), not a tracer branch
+    if tnt:
+        b = tenant_bucket(tables, key_vals[0], key_vals[1],
+                          _hash_mix(*key_vals),
+                          tables.tnt_nat_base, tables.tnt_nat_mask)
+    else:
+        b = _hash(*key_vals, global_buckets(n_buckets, shard))
     if shard is not None:
         own, bl = shard_buckets(b, n_buckets, shard)
     else:

@@ -110,9 +110,11 @@ from vpp_tpu.pipeline.vector import PacketVector
 SESS_PROBES = 4
 
 
-def _hash(src: jnp.ndarray, dst: jnp.ndarray, ports: jnp.ndarray, proto: jnp.ndarray,
-          n_buckets: int) -> jnp.ndarray:
-    """Multiplicative xor hash of the 5-tuple into [0, n_buckets)."""
+def _hash_mix(src: jnp.ndarray, dst: jnp.ndarray, ports: jnp.ndarray,
+              proto: jnp.ndarray) -> jnp.ndarray:
+    """Full 32-bit multiplicative xor mix of the 5-tuple (uint32).
+    Callers mask it to a bucket — the whole table, or a tenant's
+    slice (``tenant_bucket``)."""
     h = src * jnp.uint32(0x9E3779B1)
     h ^= dst * jnp.uint32(0x85EBCA77)
     h ^= ports * jnp.uint32(0xC2B2AE3D)
@@ -120,7 +122,33 @@ def _hash(src: jnp.ndarray, dst: jnp.ndarray, ports: jnp.ndarray, proto: jnp.nda
     h ^= h >> 15
     h = h * jnp.uint32(0x2545F491)
     h ^= h >> 13
-    return (h & jnp.uint32(n_buckets - 1)).astype(jnp.int32)
+    return h
+
+
+def _hash(src: jnp.ndarray, dst: jnp.ndarray, ports: jnp.ndarray, proto: jnp.ndarray,
+          n_buckets: int) -> jnp.ndarray:
+    """Multiplicative xor hash of the 5-tuple into [0, n_buckets)."""
+    mix = _hash_mix(src, dst, ports, proto)
+    return (mix & jnp.uint32(n_buckets - 1)).astype(jnp.int32)
+
+
+def tenant_bucket(tables: DataplaneTables, key_a: jnp.ndarray,
+                  key_b: jnp.ndarray, mix: jnp.ndarray,
+                  base: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Tenant-sliced bucket of a hashed key (ISSUE 14): the key's
+    tenant — ``key_tenant`` on the key's ADDRESS PAIR, symmetric under
+    src/dst swap so forward insert and reply lookup agree — selects a
+    contiguous bucket range ``[base[t], base[t] + mask[t] + 1)`` in
+    GLOBAL bucket units, and the hash lands inside it. A full slice
+    can only contend/evict WITHIN its owning tenant's range (never
+    cross-tenant eviction — structural, not policed). With the default
+    single-tenant staging (base 0, full-table mask) the result is
+    bit-identical to the unsliced ``_hash``."""
+    from vpp_tpu.tenancy.derive import key_tenant
+
+    kt = key_tenant(tables, key_a, key_b)
+    return (base[kt]
+            + (mix & mask[kt].astype(jnp.uint32)).astype(jnp.int32))
 
 
 def _pack_ports(sport: jnp.ndarray, dport: jnp.ndarray) -> jnp.ndarray:
@@ -193,7 +221,8 @@ def _shard_flat_slot(hit_idx: jnp.ndarray, mask: jnp.ndarray,
 
 
 def session_lookup_reverse(
-    tables: DataplaneTables, pkts: PacketVector, now=None
+    tables: DataplaneTables, pkts: PacketVector, now=None,
+    tnt: bool = False
 ) -> jnp.ndarray:
     """Is each packet the *return* traffic of an established session?
 
@@ -208,7 +237,15 @@ def session_lookup_reverse(
     key_dst = pkts.src_ip
     key_ports = _pack_ports(pkts.dport, pkts.sport)
     key_proto = pkts.proto
-    b = _hash(key_src, key_dst, key_ports, key_proto, n_buckets)
+    # jax-ok: tnt is a trace-time-static step-factory gate (a Python
+    # bool baked into the jit key), not a tracer branch
+    if tnt:
+        b = tenant_bucket(tables, key_src, key_dst,
+                          _hash_mix(key_src, key_dst, key_ports,
+                                    key_proto),
+                          tables.tnt_sess_base, tables.tnt_sess_mask)
+    else:
+        b = _hash(key_src, key_dst, key_ports, key_proto, n_buckets)
     # ONE row gather per column fetches the whole bucket ([P, W]): the
     # ways are contiguous, so this is the cheapest gather shape the
     # table can offer — no probe chain, no cross-way dependency.
@@ -227,7 +264,8 @@ def session_lookup_reverse(
 
 
 def session_lookup_reverse_idx(
-    tables: DataplaneTables, pkts: PacketVector, now, shard=None
+    tables: DataplaneTables, pkts: PacketVector, now, shard=None,
+    tnt: bool = False
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Like session_lookup_reverse, but also returns the matched FLAT
     slot index [P] (bucket·W + way; undefined where not found) so the
@@ -244,8 +282,18 @@ def session_lookup_reverse_idx(
     key_dst = pkts.src_ip
     key_ports = _pack_ports(pkts.dport, pkts.sport)
     key_proto = pkts.proto
-    b = _hash(key_src, key_dst, key_ports, key_proto,
-              global_buckets(n_buckets, shard))
+    # jax-ok: tnt is a trace-time-static step-factory gate (a Python
+    # bool baked into the jit key), not a tracer branch. The tenant
+    # slice addresses GLOBAL bucket units, so the shard ownership
+    # split below composes unchanged (docs/TENANCY.md).
+    if tnt:
+        b = tenant_bucket(tables, key_src, key_dst,
+                          _hash_mix(key_src, key_dst, key_ports,
+                                    key_proto),
+                          tables.tnt_sess_base, tables.tnt_sess_mask)
+    else:
+        b = _hash(key_src, key_dst, key_ports, key_proto,
+                  global_buckets(n_buckets, shard))
     if shard is not None:
         own, bl = shard_buckets(b, n_buckets, shard)
     else:
@@ -271,7 +319,7 @@ def session_lookup_reverse_idx(
 
 def session_batch_summary(
     tables: DataplaneTables, pkts: PacketVector, alive: jnp.ndarray, now,
-    shard=None
+    shard=None, tnt: bool = False
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Batched hit summary for the two-tier fast/slow dispatch
     (pipeline/graph.py pipeline_step_auto): one reverse lookup yields
@@ -288,7 +336,7 @@ def session_batch_summary(
     construction; the caller (pipeline_step_auto) additionally pmins
     the flag so the lax.cond dispatch provably can't diverge."""
     found, hit_idx = session_lookup_reverse_idx(tables, pkts, now,
-                                                shard=shard)
+                                                shard=shard, tnt=tnt)
     hits = found & alive
     all_hit = jnp.all(hits == alive)
     return hits, hit_idx, all_hit
@@ -637,6 +685,7 @@ def session_insert(
     want: jnp.ndarray,
     now: jnp.ndarray,
     shard=None,
+    tnt: bool = False,
 ) -> tuple:
     """Insert forward 5-tuples of ``want`` packets; returns
     (tables, inserted, failed, evict_expired, evict_victim).
@@ -662,7 +711,15 @@ def session_insert(
         _pack_ports(pkts.sport, pkts.dport),
         pkts.proto,
     )
-    h = _hash(*key_vals, global_buckets(tables.sess_valid.shape[0], shard))
+    # jax-ok: tnt is a trace-time-static step-factory gate (a Python
+    # bool baked into the jit key), not a tracer branch
+    if tnt:
+        h = tenant_bucket(tables, key_vals[0], key_vals[1],
+                          _hash_mix(*key_vals),
+                          tables.tnt_sess_base, tables.tnt_sess_mask)
+    else:
+        h = _hash(*key_vals,
+                  global_buckets(tables.sess_valid.shape[0], shard))
     if shard is not None:
         own, h = shard_buckets(h, tables.sess_valid.shape[0], shard)
         want = want & own

@@ -61,10 +61,12 @@ from vpp_tpu.pipeline.tables import (
     _UPLOAD_GROUPS,
     SESSION_FIELDS,
     TELEMETRY_FIELDS,
+    TENANCY_STATE_FIELDS,
     DataplaneConfig,
     DataplaneTables,
     zero_sessions,
     zero_telemetry,
+    zero_tenancy_state,
 )
 from vpp_tpu.pipeline.vector import (
     FLAG_VALID,
@@ -521,6 +523,19 @@ class ClusterDataplane:
         # session/NAT bucket grids and (when the stage is on) the ML
         # hidden/tree axes must divide — fail FAST with a clear error
         validate_partitioning(self.config, rule_shards)
+        # multi-tenant gateway mode (ISSUE 14) is not wired into the
+        # cluster step yet: the mesh ops shard the tenant-sliced
+        # BUCKET math bit-exactly (tests/test_tenancy.py 2-way
+        # differential), but make_cluster_step compiles the in-step
+        # token-bucket/accounting stage out. An isolation/enforcement
+        # feature must never degrade silently (the explicit-bv-refusal
+        # convention) — refuse loudly instead.
+        if getattr(self.config, "tenancy", "off") != "off":
+            raise ValueError(
+                "dataplane.tenancy=on is not supported on the mesh "
+                "yet: the cluster step would silently skip per-tenant "
+                "rate limits and accounting — run tenancy on "
+                "standalone dataplanes (docs/TENANCY.md)")
         # BV degrades instead: a rule capacity whose word axis can't
         # shard keeps the planes replicated and the ladder off BV —
         # unless the operator EXPLICITLY asked for bv, which deserves a
@@ -765,6 +780,8 @@ class ClusterDataplane:
                 sess = {f: getattr(self.tables, f) for f in SESSION_FIELDS}
                 tel = {f: getattr(self.tables, f)
                        for f in TELEMETRY_FIELDS}
+                tnt = {f: getattr(self.tables, f)
+                       for f in TENANCY_STATE_FIELDS}
             else:
                 zs = zero_sessions(self.config, leading=(self.n_nodes,))
                 sess = {
@@ -780,8 +797,17 @@ class ClusterDataplane:
                     f: jax.device_put(v, shardings[f])
                     for f, v in zt.items()
                 }
+                # tenancy state planes (vpp_tpu/tenancy/): cluster
+                # node configs keep the tenancy knob off too —
+                # placeholder shapes, replicated-by-design, never read
+                ztn = zero_tenancy_state(self.config,
+                                         leading=(self.n_nodes,))
+                tnt = {
+                    f: jax.device_put(v, shardings[f])
+                    for f, v in ztn.items()
+                }
             self._refresh_selection()
-            self.tables = DataplaneTables(**dev, **sess, **tel)
+            self.tables = DataplaneTables(**dev, **sess, **tel, **tnt)
             self._uplinks = jax.device_put(
                 np.array(
                     [

@@ -60,10 +60,12 @@ from vpp_tpu.pipeline.dataplane import Dataplane
 from vpp_tpu.pipeline.tables import (
     SESSION_FIELDS,
     TELEMETRY_FIELDS,
+    TENANCY_STATE_FIELDS,
     DataplaneConfig,
     DataplaneTables,
     zero_sessions,
     zero_telemetry,
+    zero_tenancy_state,
 )
 from vpp_tpu.pipeline.vector import PacketVector, make_packet_vector
 
@@ -118,6 +120,14 @@ class MultiHostCluster:
         self.config = config or DataplaneConfig()
         self.n_nodes = n_nodes
         validate_partitioning(self.config, rule_shards)
+        # tenancy is not wired into the mesh step (the ClusterDataplane
+        # refusal, ISSUE 14): never silently skip an enforcement stage
+        if getattr(self.config, "tenancy", "off") != "off":
+            raise ValueError(
+                "dataplane.tenancy=on is not supported on the mesh "
+                "yet: the cluster step would silently skip per-tenant "
+                "rate limits and accounting — run tenancy on "
+                "standalone dataplanes (docs/TENANCY.md)")
         self._bv_sharded = bv_mesh_ok(self.config, rule_shards)
         if (getattr(self.config, "classifier", "auto") == "bv"
                 and rule_shards > 1 and not self._bv_sharded):
@@ -247,7 +257,8 @@ class MultiHostCluster:
                 "have no uplink interface (call add_uplink())")
         local_stack = {}
         for k in DataplaneTables._fields:
-            if k in SESSION_FIELDS or k in TELEMETRY_FIELDS:
+            if k in SESSION_FIELDS or k in TELEMETRY_FIELDS \
+                    or k in TENANCY_STATE_FIELDS:
                 continue
             local_stack[k] = np.stack(
                 [arrs_by_node[i][k] for i in self.local_nodes])
@@ -258,6 +269,8 @@ class MultiHostCluster:
         if self.tables is not None:
             sess = {f: getattr(self.tables, f) for f in SESSION_FIELDS}
             tel = {f: getattr(self.tables, f) for f in TELEMETRY_FIELDS}
+            tnt = {f: getattr(self.tables, f)
+                   for f in TENANCY_STATE_FIELDS}
         else:
             zero = zero_sessions(self.config,
                                  leading=(len(self.local_nodes),))
@@ -274,6 +287,15 @@ class MultiHostCluster:
                 f: self._to_global(np.asarray(zt[f]),
                                    getattr(self._specs, f))
                 for f in TELEMETRY_FIELDS
+            }
+            # tenancy-state placeholders (vpp_tpu/tenancy/): multi-host
+            # node configs keep the tenancy knob off too — never read
+            ztn = zero_tenancy_state(self.config,
+                                     leading=(len(self.local_nodes),))
+            tnt = {
+                f: self._to_global(np.asarray(ztn[f]),
+                                   getattr(self._specs, f))
+                for f in TENANCY_STATE_FIELDS
             }
         # Classifier/fastpath/ML selection is CLUSTER state: one jitted
         # program serves all nodes, so every choice must be identical
@@ -309,7 +331,8 @@ class MultiHostCluster:
             nmax >= int(getattr(c, "fastpath_min_rules", 0))
         self._ml_mode, self._ml_kind = agree_ml(
             getattr(c, "ml_stage", "off"), flags[:, 3])
-        self.tables = DataplaneTables(**host_fields, **sess, **tel)
+        self.tables = DataplaneTables(**host_fields, **sess, **tel,
+                                      **tnt)
         self._uplinks = self._to_global(
             np.array([self.nodes[i].uplink_if or 0
                       for i in self.local_nodes], np.int32),

@@ -135,6 +135,19 @@ PARTITION_RULES: Tuple[PartitionRule, ...] = (
                   "[NB, W] bucket grids: bucket axis sharded (global "
                   "flow hash, contiguous bucket-range ownership; "
                   "lookup/insert/sweep/aging shard-local)"),
+    # --- multi-tenant gateway planes (vpp_tpu/tenancy/; ISSUE 14) --
+    # Everything tenant-scoped is a [T]/[S] per-tenant vector and MUST
+    # replicate along the rule axis: the slice base/mask vectors
+    # address GLOBAL session-bucket indices, so the bucket-axis shards
+    # above compose with tenant slicing unchanged (a sliced bucket is
+    # still owned by exactly one shard) — partition_lint() hard-errors
+    # a tnt_ field that ever resolves rule-sharded.
+    PartitionRule(r"^tnt_", P(NODE_AXIS),
+                  "per-tenant vectors (prefix map, token buckets, "
+                  "slice base/mask in GLOBAL bucket units, accounting "
+                  "planes): replicated along the rule axis so tenant "
+                  "slices compose with the bucket-axis session shards "
+                  "bit-exactly"),
     # --- replicated-by-design ledger -------------------------------
     PartitionRule(r"^acl_", P(NODE_AXIS),
                   "per-interface local tables are small (max_rules "
@@ -240,6 +253,23 @@ def partition_lint() -> List[str]:
             problems.append(
                 f"partitions: rule {rule.pattern!r} matches no "
                 "DataplaneTables field (stale rule?)")
+    # tenancy hard errors (ISSUE 14): every tenant plane (the tnt_*
+    # slice/bucket/accounting vectors and the per-tenant ML policy
+    # vectors) must resolve REPLICATED along the rule axis — a
+    # rule-sharded [T] vector would hand each shard a different slice
+    # base and silently break the global-bucket math the bucket-axis
+    # session shards rely on.
+    for f in DataplaneTables._fields:
+        if not (f.startswith("tnt_") or f.startswith("glb_ml_tnt_")):
+            continue
+        rule = match_partition_rules(f)
+        if rule is None:
+            continue  # already reported as unmatched above
+        if any(ax == RULE_AXIS for ax in rule.spec if ax is not None):
+            problems.append(
+                f"partitions: tenant plane {f!r} resolves rule-sharded "
+                f"({rule.pattern!r}) — tenant vectors must replicate "
+                "along the rule axis (docs/TENANCY.md)")
     if not problems:
         entries = spec_manifest()
         for ax in (NODE_AXIS, RULE_AXIS):

@@ -152,6 +152,7 @@ def _packed_call(step, with_aux: bool = False, tel: str = "off"):
                  + s.natsess_evict_expired + s.natsess_evict_victim),
                 s.ml_scored, s.ml_flagged, s.ml_drops,
                 tel_observed, s.tel_sketched,
+                s.tnt_limited, s.tnt_qfail,
             ]).astype(jnp.int32)
             return out_tables, packed, aux
         return out_tables, packed
@@ -223,12 +224,16 @@ PACKED_OUT_ROWS_N = 5
 # rider is an edit HERE plus the matching row expression in
 # _packed_call, never three hand-edited paths. History: [3] (fastpath
 # trio, PR 3) → [5] (+session pressure, PR 6) → [8] (+ML verdicts,
-# PR 9) → [10] (+device telemetry, PR 10 / ISSUE 11).
+# PR 9) → [10] (+device telemetry, PR 10 / ISSUE 11) → [12]
+# (+tenancy counters, ISSUE 14).
 PACKED_AUX_SCHEMA = (
     "fastpath", "rx", "sess_hits",        # two-tier dispatch trio
     "insert_fails", "evictions",          # session-table pressure
     "ml_scored", "ml_flagged", "ml_drops",  # ML-stage verdicts
     "tel_observed", "tel_sketched",       # device telemetry (ISSUE 11)
+    "tnt_limited", "tnt_qfail",           # tenancy (ISSUE 14): rate-
+                                          # limit drops + slice quota
+                                          # insert failures
 )
 PACKED_AUX_ROWS = len(PACKED_AUX_SCHEMA)
 
@@ -351,15 +356,16 @@ _JIT_COMPILES_LOCK = threading.Lock()
 def _step_label(impl: str, skip_local: bool, fast: bool, form: str,
                 sweep_stride: int, ring_slots: int = 0,
                 ml_mode: str = "off", ml_kind: str = "mlp",
-                tel_mode: str = "off") -> str:
+                tel_mode: str = "off", tnt_mode: str = "off") -> str:
     from vpp_tpu.pipeline.graph import SWEEP_STRIDE_DEFAULT
 
-    return "{}{}{}{}{}{}_{}".format(
+    return "{}{}{}{}{}{}{}_{}".format(
         impl, "_nolocal" if skip_local else "", "_auto" if fast else "",
         ("" if ml_mode == "off"
          else f"_ml{ml_mode}"
          + ("_forest" if ml_kind == "forest" else "")),
         "" if tel_mode == "off" else f"_tel{tel_mode}",
+        "" if tnt_mode == "off" else "_tenancy",
         ("" if sweep_stride == SWEEP_STRIDE_DEFAULT
          else f"_sw{sweep_stride}"),
         f"{form}{ring_slots}" if form == "ring" else form)
@@ -464,19 +470,20 @@ def _jitted_step(impl: str, skip_local: bool, fast: bool, form: str,
                  sweep_stride: Optional[int] = None,
                  ring_slots: int = 0,
                  ml_mode: str = "off", ml_kind: str = "mlp",
-                 tel_mode: str = "off"):
+                 tel_mode: str = "off", tnt_mode: str = "off"):
     from vpp_tpu.pipeline.graph import SWEEP_STRIDE_DEFAULT
 
     if sweep_stride is None:
         sweep_stride = SWEEP_STRIDE_DEFAULT
     key = (impl, skip_local, fast, form, sweep_stride, ring_slots,
-           ml_mode, ml_kind, tel_mode)
+           ml_mode, ml_kind, tel_mode, tnt_mode)
     step = _JIT_STEPS.get(key)
     if step is None:
         fn = make_pipeline_step(impl, skip_local, fast, sweep_stride,
-                                ml_mode, ml_kind, tel_mode)
+                                ml_mode, ml_kind, tel_mode, tnt_mode)
         label = _step_label(impl, skip_local, fast, form, sweep_stride,
-                            ring_slots, ml_mode, ml_kind, tel_mode)
+                            ring_slots, ml_mode, ml_kind, tel_mode,
+                            tnt_mode)
         if form == "plain":
             step = jax.jit(_counting(label, fn))
         elif form == "packed":
@@ -655,6 +662,12 @@ class Dataplane:
         # never re-gates at swap (there is no staged state to consult;
         # the planes' shapes are config-static like sess_ways).
         self._tel_mode = getattr(self.config, "telemetry", "off")
+        # Multi-tenant gateway mode (vpp_tpu/tenancy/; ISSUE 14): a
+        # pure config gate like telemetry — the tenant planes' shapes
+        # are config-static, and an unconfigured tenancy-on dataplane
+        # behaves exactly like off (single default tenant, unsliced,
+        # unlimited), so there is no staged state to re-gate on.
+        self._tnt_mode = getattr(self.config, "tenancy", "off")
         self._refresh_selection()
         # diagnostic classify-probe accumulators (time_classifier):
         # exported as the stage="classify" row of the
@@ -1012,7 +1025,8 @@ class Dataplane:
         policied epochs compiles ONE program, whichever came first."""
         skip = self._skip_local
         stride = self._sweep_stride
-        gates = (self._ml_mode, self._ml_kind, self._tel_mode)
+        gates = (self._ml_mode, self._ml_kind, self._tel_mode,
+                 self._tnt_mode)
         if (skip
                 and (self._classifier_impl, skip, fast, form, stride,
                      0) + gates not in _JIT_STEPS
@@ -1022,7 +1036,8 @@ class Dataplane:
         return _jitted_step(self._classifier_impl, skip, fast, form,
                             stride, ml_mode=self._ml_mode,
                             ml_kind=self._ml_kind,
-                            tel_mode=self._tel_mode)
+                            tel_mode=self._tel_mode,
+                            tnt_mode=self._tnt_mode)
 
     def time_classifier(self, batch: int = 256, iters: int = 10) -> float:
         """Diagnostic: time the SELECTED global classifier in isolation
@@ -1252,4 +1267,48 @@ class Dataplane:
             "top_dst": np.asarray(dst, np.uint32),
             "top_ports": np.asarray(ports, np.uint32),
             "top_cnt": np.asarray(cnt, np.int64),
+        }
+
+    # --- multi-tenant gateway mode (vpp_tpu/tenancy/; ISSUE 14) ---
+    def tenant_snapshot(self) -> Optional[dict]:
+        """Host copy of the per-tenant planes `show tenants` and the
+        ``vpp_tpu_tenant_*`` families read: token-bucket levels,
+        rx/goodput/drop/quota-fail counters, and per-tenant live
+        session occupancy (one on-device prefix sum —
+        tenancy/derive.py tenant_occupancy; [T] ints cross the
+        transport, never columns). None when tenancy is off or no
+        tables are live. In persistent pump mode the planes ride the
+        ring's private carry, so this view refreshes at
+        sync_sessions/stop — the `show sessions` staleness contract.
+        """
+        if self._tnt_mode == "off":
+            return None
+        with self._lock:
+            t = self.tables
+            now = max(self._now, self.clock_ticks())
+            registry = {tid: dict(e)
+                        for tid, e in self.builder.tenants.items()}
+        if t is None:
+            return None
+        from vpp_tpu.tenancy.derive import tenant_occupancy
+
+        occ = tenant_occupancy(t.sess_valid, t.sess_time,
+                               jnp.int32(now), t.sess_max_age,
+                               t.tnt_sess_base, t.tnt_sess_mask + 1)
+        tokens, rx, tx, rl, qf, occ_h, rate, burst, smask = \
+            jax.device_get((t.tnt_tokens, t.tnt_rx_c, t.tnt_tx_c,
+                            t.tnt_rl_c, t.tnt_qf_c, occ, t.tnt_rate,
+                            t.tnt_burst, t.tnt_sess_mask))
+        return {
+            "tenants": registry,
+            "tokens": np.asarray(tokens, np.int64),
+            "rx": np.asarray(rx, np.int64),
+            "tx": np.asarray(tx, np.int64),
+            "rl_drops": np.asarray(rl, np.int64),
+            "quota_fails": np.asarray(qf, np.int64),
+            "occupancy": np.asarray(occ_h, np.int64),
+            "rate": np.asarray(rate, np.int64),
+            "burst": np.asarray(burst, np.int64),
+            "sess_quota_slots": (np.asarray(smask, np.int64) + 1)
+            * int(getattr(self.config, "sess_ways", 4)),
         }

@@ -94,6 +94,14 @@ class StepStats(NamedTuple):
     # telemetry "full"): alive packets folded into the count-min
     # heavy-hitter flow sketch this step
     tel_sketched: jnp.ndarray           # int32 scalar
+    # multi-tenant gateway mode (vpp_tpu/tenancy/; both 0 with the
+    # tenancy stage compiled off): packets dropped by a tenant's
+    # token-bucket rate limit this step (attributed DROP_TENANT →
+    # drops_total{reason="tenant_quota"}), and session/NAT inserts
+    # that failed inside a tenant's capacity slice (the per-tenant
+    # congestion signal — a full slice contends only with itself)
+    tnt_limited: jnp.ndarray            # int32 scalar
+    tnt_qfail: jnp.ndarray              # int32 scalar
 
 
 # Per-packet drop attribution (error-drop counter analog).
@@ -104,6 +112,7 @@ DROP_NO_ROUTE = 3   # FIB miss
 DROP_FIB = 4        # matched a drop route
 DROP_NAT = 5        # NAT fail-closed (port collision / un-NATable proto)
 DROP_ML = 6         # ML-stage enforce verdict (drop / rate-limited)
+DROP_TENANT = 7     # tenant token-bucket quota exceeded (ISSUE 14)
 
 DROP_CAUSE_NAMES = {
     DROP_NONE: "none",
@@ -113,6 +122,7 @@ DROP_CAUSE_NAMES = {
     DROP_FIB: "fib-drop",
     DROP_NAT: "nat-drop",
     DROP_ML: "ml-drop",
+    DROP_TENANT: "tenant-quota",
 }
 
 
@@ -150,10 +160,33 @@ def _ingress(tables: DataplaneTables, pkts: PacketVector):
     return pkts, drop_ip4, pkts.valid & ~drop_ip4
 
 
+def _tenant_eval(tables: DataplaneTables, pkts: PacketVector,
+                 alive: jnp.ndarray, now, tnt_mode: str):
+    """The ONE copy of the tenant stage's stateful half (ISSUE 14),
+    run EXACTLY ONCE per fused step (both pipeline tiers, and the
+    two-tier dispatcher runs it ahead of the branch and hands the
+    result to whichever tier wins — tokens are consumed once either
+    way): derive each packet's tenant id on the ingress header
+    (tenancy/derive.py — symmetric max of the src/dst prefix matches)
+    and run the per-tenant token bucket. Returns ``(tid, dropped,
+    tables')`` — ``tid`` is None with the stage compiled off (every
+    consumer then takes its pre-tenancy path, and the zero ``dropped``
+    constant folds away)."""
+    # jax-ok: tnt_mode is a trace-time-static step-factory gate (a
+    # Python string baked into the jit key), not a tracer branch
+    if tnt_mode == "off":
+        return None, jnp.zeros(alive.shape, bool), tables
+    from vpp_tpu.tenancy.derive import tenant_ids, tenant_limit
+
+    tid = tenant_ids(tables, pkts)
+    tables, dropped = tenant_limit(tables, tid, alive, now)
+    return tid, dropped, tables
+
+
 def _ml_eval(tables: DataplaneTables, pkts: PacketVector,
              alive: jnp.ndarray, established: jnp.ndarray,
              sess_age: jnp.ndarray, ml_mode: str, ml_kind: str,
-             shard=None):
+             shard=None, tid=None):
     """The ONE copy of the ML-stage evaluation (ISSUE 10), shared by
     the full chain and the established-flow fast tier so the two can
     never silently diverge: scored on the post-NAT-reverse header plus
@@ -177,7 +210,8 @@ def _ml_eval(tables: DataplaneTables, pkts: PacketVector,
                                                     jnp.int32)
     scores = ml_score(tables, pkts, established, sess_age, kind=ml_kind,
                       shard=shard)
-    flagged, drop_wanted = ml_policy(tables, pkts, alive, scores)
+    flagged, drop_wanted = ml_policy(tables, pkts, alive, scores,
+                                     tid=tid)
     # jax-ok: ml_mode is the same trace-time-static gate as above —
     # score mode statically discards the policy's drop verdict
     if ml_mode != "enforce":
@@ -216,6 +250,10 @@ def _finish_step(
     sweep_stride: int = 0,
     tel_mode: str = "off",
     shard=None,
+    tnt_mode: str = "off",
+    tid=None,
+    tnt_dropped=None,
+    tnt_qfail=None,
 ) -> StepResult:
     """Shared tail of both pipeline tiers: drop attribution, counters,
     StepStats and the StepResult assembly. The ONE copy of the
@@ -237,6 +275,22 @@ def _finish_step(
         tables, tel_sketched = tel_flow_update(tables, pkts, alive)
     else:
         tel_sketched = jnp.int32(0)
+    # tenancy masks (ISSUE 14): ``alive`` at this point EXCLUDES
+    # rate-limited packets (both tiers mask right after the tenant
+    # stage); alive_all restores them for the rx/per-interface counts
+    # — they were real received traffic, dropped with attribution
+    if tnt_dropped is None:
+        tnt_dropped = jnp.zeros(alive.shape, bool)
+    if tnt_qfail is None:
+        tnt_qfail = jnp.zeros(alive.shape, bool)
+    alive_all = alive | tnt_dropped
+    # jax-ok: tnt_mode is a trace-time-static step-factory gate (a
+    # Python string baked into the jit key), not a tracer branch
+    if tnt_mode != "off":
+        from vpp_tpu.tenancy.derive import tnt_account
+
+        tables = tnt_account(tables, tid, alive_all, forwarded,
+                             tnt_dropped, tnt_qfail)
     n_ifaces = tables.if_type.shape[0]
 
     def occupancy(valid, time):
@@ -265,13 +319,14 @@ def _finish_step(
         | fib_dropped
         | dropped_nat
         | ml_dropped
+        | tnt_dropped
     )
-    rx_if_safe = jnp.where(alive, pkts.rx_if, n_ifaces)
+    rx_if_safe = jnp.where(alive_all, pkts.rx_if, n_ifaces)
     tx_if_safe = jnp.where(forwarded, tx_if, n_ifaces)
     drop_if_safe = jnp.where(dropped, pkts.rx_if, n_ifaces)
     zero_i = jnp.zeros((n_ifaces,), jnp.int32)
     stats = StepStats(
-        rx=jnp.sum(alive.astype(jnp.int32)),
+        rx=jnp.sum(alive_all.astype(jnp.int32)),
         tx=jnp.sum(forwarded.astype(jnp.int32)),
         drop_ip4=jnp.sum(drop_ip4.astype(jnp.int32)),
         drop_acl=jnp.sum(drop_acl.astype(jnp.int32)),
@@ -292,7 +347,7 @@ def _finish_step(
         if_rx=zero_i.at[rx_if_safe].add(1, mode="drop"),
         if_tx=zero_i.at[tx_if_safe].add(1, mode="drop"),
         if_rx_bytes=zero_i.at[rx_if_safe].add(
-            jnp.where(alive, pkts.pkt_len, 0), mode="drop"
+            jnp.where(alive_all, pkts.pkt_len, 0), mode="drop"
         ),
         if_tx_bytes=zero_i.at[tx_if_safe].add(
             jnp.where(forwarded, pkts.pkt_len, 0), mode="drop"
@@ -310,7 +365,12 @@ def _finish_step(
         ml_flagged=jnp.sum(ml_flagged.astype(jnp.int32)),
         ml_drops=jnp.sum(ml_dropped.astype(jnp.int32)),
         tel_sketched=tel_sketched,
+        tnt_limited=jnp.sum(tnt_dropped.astype(jnp.int32)),
+        tnt_qfail=jnp.sum(tnt_qfail.astype(jnp.int32)),
     )
+    # attribution stays exclusive: tnt_dropped packets left ``alive``
+    # right after the tenant stage, so every other cause mask (all
+    # derived from alive/permit/forwarded) excludes them
     drop_cause = (
         jnp.where(pkts.valid & drop_ip4, DROP_IP4, 0)
         + jnp.where(drop_acl, DROP_ACL, 0)
@@ -318,6 +378,7 @@ def _finish_step(
         + jnp.where(fib_dropped, DROP_FIB, 0)
         + jnp.where(dropped_nat, DROP_NAT, 0)
         + jnp.where(ml_dropped, DROP_ML, 0)
+        + jnp.where(tnt_dropped, DROP_TENANT, 0)
     ).astype(jnp.int32)
     return StepResult(
         pkts=pkts,
@@ -353,7 +414,9 @@ def pipeline_step(
     ml_mode: str = "off",
     ml_kind: str = "mlp",
     tel_mode: str = "off",
+    tnt_mode: str = "off",
     shard=None,
+    _tnt_pre=None,
 ) -> StepResult:
     """Process one packet vector through the full forwarding chain.
 
@@ -375,13 +438,29 @@ def pipeline_step(
     # --- ip4-input (+ unconfigured-interface drop) ---
     pkts, drop_ip4, alive = _ingress(tables, pkts)
 
+    # --- tenant stage (ISSUE 14): derive + token-bucket ONCE per step.
+    # ``_tnt_pre`` is the two-tier dispatcher's pre-consumed trio (it
+    # runs _tenant_eval ahead of the lax.cond so neither branch
+    # double-consumes tokens); rate-limited packets leave ``alive``
+    # here — no session touch, no NAT state, no forwarding, attributed
+    # DROP_TENANT in the shared tail.
+    # jax-ok: _tnt_pre None-ness is trace-time static (the dispatcher
+    # always passes it under tenancy), not a tracer branch
+    if _tnt_pre is not None:
+        tid, tnt_dropped, tables = _tnt_pre
+    else:
+        tid, tnt_dropped, tables = _tenant_eval(tables, pkts, alive,
+                                                now, tnt_mode)
+    alive = alive & ~tnt_dropped
+    tnt = tnt_mode != "off"
+
     # --- reflective session bypass (return traffic of permitted flows) ---
     # Looked up on the raw (pre-NAT) header: forward sessions are installed
     # post-DNAT, so a backend's reply B→C reverses to the stored C→B key.
     # Expired entries (idle > sess_max_age ticks) don't match, and hits
     # refresh the timestamp — active flows never expire mid-flow.
     established, sess_hit_idx = session_lookup_reverse_idx(
-        tables, pkts, now, shard=shard)
+        tables, pkts, now, shard=shard, tnt=tnt)
     established = established & alive
     # pre-touch session age: an ML feature (the touch below refreshes
     # the timestamp, so the age must be captured first — the fast tier
@@ -393,7 +472,8 @@ def pipeline_step(
 
     # --- NAT44: reverse-translate return traffic, then DNAT new flows ---
     pkts, nat_reversed, nat_hit_idx = nat44_reverse(tables, pkts, alive,
-                                                    now, shard=shard)
+                                                    now, shard=shard,
+                                                    tnt=tnt)
     tables = nat44_touch(tables, nat_hit_idx, nat_reversed, now,
                          shard=shard)
 
@@ -401,7 +481,7 @@ def pipeline_step(
     # the same values the fast tier scores — ONE shared evaluation
     ml_scored, ml_flagged, ml_drop_want, ml_scores = _ml_eval(
         tables, pkts, alive, established, sess_age, ml_mode, ml_kind,
-        shard=shard)
+        shard=shard, tid=tid)
 
     orig_dst, orig_dport = pkts.dst_ip, pkts.dport
     pkts, dnat_applied, dnat_self_snat = nat44_dnat(
@@ -449,13 +529,14 @@ def pipeline_step(
     # must not consume session slots); keys are post-NAT so replies match ---
     want_sess = forwarded & ~established & nat_capable & ~nat_unsupported
     tables, _, sess_fail, sess_ev_exp, sess_ev_vic = session_insert(
-        tables, pkts, want_sess, now, shard=shard)
+        tables, pkts, want_sess, now, shard=shard, tnt=tnt)
     nat_kind = (
         jnp.where(dnat_applied, 1, 0) + jnp.where(snat_applied, 2, 0)
     ).astype(jnp.int32)
     tables, nat_conflict, natsess_fail, nat_ev_exp, nat_ev_vic = nat44_record(
         tables, pkts, orig_dst, orig_dport, orig_src, orig_sport, nat_kind,
         (dnat_applied | snat_applied) & forwarded, now, shard=shard,
+        tnt=tnt,
     )
     # Fail closed on reply-key collisions (two SNAT'd flows hashed onto
     # the same external port): misdelivering replies to the wrong pod is
@@ -475,7 +556,10 @@ def pipeline_step(
         natsess_evict_expired=nat_ev_exp, natsess_evict_victim=nat_ev_vic,
         ml_scored=ml_scored, ml_flagged=ml_flagged, ml_dropped=ml_dropped,
         ml_scores=ml_scores, sweep_stride=sweep_stride, tel_mode=tel_mode,
-        shard=shard,
+        shard=shard, tnt_mode=tnt_mode, tid=tid, tnt_dropped=tnt_dropped,
+        # only meaningful with the stage on (the per-tenant congestion
+        # signal); the off-state constant keeps the counter at 0
+        tnt_qfail=(sess_fail | natsess_fail) if tnt else None,
     )
 
 
@@ -508,7 +592,10 @@ def _pipeline_fast_finish(
     ml_mode: str = "off",
     ml_kind: str = "mlp",
     tel_mode: str = "off",
+    tnt_mode: str = "off",
     shard=None,
+    tid=None,
+    tnt_dropped=None,
 ) -> StepResult:
     """Tail of the classify-free kernel, from post-reverse headers on.
 
@@ -526,6 +613,12 @@ def _pipeline_fast_finish(
     the ONE shared evaluation; the age feature is captured pre-touch
     here exactly as the full chain captures it.
     """
+    # tenancy (ISSUE 14): ``alive``/``established`` arrive POST-limit
+    # from the callers (the tenant stage ran before the lookups, the
+    # full-chain order); tid/tnt_dropped ride through to the shared
+    # tail for attribution + per-tenant accounting
+    if tnt_dropped is None:
+        tnt_dropped = jnp.zeros(alive.shape, bool)
     # pre-touch session age (the ML age feature — full-chain parity)
     sess_age = session_hit_age(tables, sess_hit_idx, established, now,
                                shard=shard)
@@ -541,7 +634,7 @@ def _pipeline_fast_finish(
 
     ml_scored, ml_flagged, ml_drop_want, ml_scores = _ml_eval(
         tables, pkts, alive, established, sess_age, ml_mode, ml_kind,
-        shard=shard)
+        shard=shard, tid=tid)
     ml_dropped = ml_drop_want & permit & alive
 
     fib = ip4_lookup(tables, pkts.dst_ip)
@@ -565,7 +658,10 @@ def _pipeline_fast_finish(
         natsess_evict_expired=false_p, natsess_evict_victim=false_p,
         ml_scored=ml_scored, ml_flagged=ml_flagged, ml_dropped=ml_dropped,
         ml_scores=ml_scores, sweep_stride=sweep_stride, tel_mode=tel_mode,
-        shard=shard,
+        shard=shard, tnt_mode=tnt_mode, tid=tid, tnt_dropped=tnt_dropped,
+        # the fast tier inserts nothing, so slice quota failures are
+        # statically empty here (the all-False constant XLA folds)
+        tnt_qfail=None,
     )
 
 
@@ -575,6 +671,7 @@ def pipeline_step_fast(
     ml_mode: str = "off",
     ml_kind: str = "mlp",
     tel_mode: str = "off",
+    tnt_mode: str = "off",
     shard=None,
 ) -> StepResult:
     """The classify-free established-flow kernel, standalone:
@@ -588,15 +685,24 @@ def pipeline_step_fast(
     production traffic goes through the auto dispatcher.
     """
     pkts, drop_ip4, alive = _ingress(tables, pkts)
+    # tenant stage first — the full-chain order, so the two tiers stay
+    # bit-exact under the dispatch invariant with tenancy on too
+    tid, tnt_dropped, tables = _tenant_eval(tables, pkts, alive, now,
+                                            tnt_mode)
+    alive = alive & ~tnt_dropped
+    tnt = tnt_mode != "off"
     established, sess_hit_idx = session_lookup_reverse_idx(
-        tables, pkts, now, shard=shard)
+        tables, pkts, now, shard=shard, tnt=tnt)
     established = established & alive
     pkts, nat_reversed, nat_hit_idx = nat44_reverse(tables, pkts, alive,
-                                                    now, shard=shard)
+                                                    now, shard=shard,
+                                                    tnt=tnt)
     return _pipeline_fast_finish(
         tables, pkts, now, alive, drop_ip4, established, sess_hit_idx,
         nat_reversed, nat_hit_idx, sweep_stride=sweep_stride,
-        ml_mode=ml_mode, ml_kind=ml_kind, tel_mode=tel_mode, shard=shard,
+        ml_mode=ml_mode, ml_kind=ml_kind, tel_mode=tel_mode,
+        tnt_mode=tnt_mode, shard=shard, tid=tid,
+        tnt_dropped=tnt_dropped,
     )
 
 
@@ -610,10 +716,19 @@ def pipeline_step_auto(
     ml_mode: str = "off",
     ml_kind: str = "mlp",
     tel_mode: str = "off",
+    tnt_mode: str = "off",
     shard=None,
 ) -> StepResult:
     """Two-tier dispatch: the fast kernel when the whole batch rides
     established sessions, the full chain otherwise.
+
+    With tenancy on (ISSUE 14) the tenant stage runs HERE, ahead of
+    the branch: token consumption is stateful and must happen exactly
+    once per step, so the dispatcher consumes and hands the trio to
+    whichever tier wins (the full branch takes it via ``_tnt_pre``
+    instead of re-running ``_tenant_eval``). The dispatch predicate
+    evaluates on the post-limit alive set — a rate-limited packet
+    skips every downstream stage identically in both tiers.
 
     The predicate work (ip4-input, session summary, NAT reverse, DNAT
     probe) is computed once up front; the fast branch reuses it via
@@ -641,15 +756,21 @@ def pipeline_step_auto(
 
     orig_pkts = pkts
     pkts1, drop_ip4, alive = _ingress(tables, pkts)
+    # tenant stage ONCE, ahead of the branch (docstring); tbl carries
+    # the consumed token buckets into whichever tier wins
+    tid, tnt_dropped, tbl = _tenant_eval(tables, pkts1, alive, now,
+                                         tnt_mode)
+    alive = alive & ~tnt_dropped
+    tnt = tnt_mode != "off"
     hits, sess_hit_idx, all_hit = session_batch_summary(
-        tables, pkts1, alive, now, shard=shard
+        tbl, pkts1, alive, now, shard=shard, tnt=tnt
     )
     # NAT reverse runs before the DNAT probe: the un-NAT'd header is
     # what the full chain would hand nat44_dnat
     rpkts, nat_reversed, nat_hit_idx = nat44_reverse(
-        tables, pkts1, alive, now, shard=shard
+        tbl, pkts1, alive, now, shard=shard, tnt=tnt
     )
-    dnat_would = nat44_dnat_match(tables, rpkts, alive & ~nat_reversed)
+    dnat_would = nat44_dnat_match(tbl, rpkts, alive & ~nat_reversed)
     ok = all_hit & ~jnp.any(dnat_would)
     if shard is not None:
         # the all-reduce that makes the dispatch provably uniform: the
@@ -660,17 +781,24 @@ def pipeline_step_auto(
 
     def fast(_):
         return _pipeline_fast_finish(
-            tables, rpkts, now, alive, drop_ip4, hits, sess_hit_idx,
+            tbl, rpkts, now, alive, drop_ip4, hits, sess_hit_idx,
             nat_reversed, nat_hit_idx, sweep_stride=sweep_stride,
             ml_mode=ml_mode, ml_kind=ml_kind, tel_mode=tel_mode,
-            shard=shard,
+            tnt_mode=tnt_mode, shard=shard, tid=tid,
+            tnt_dropped=tnt_dropped,
         )
 
     def full(_):
+        # the full chain re-derives its own ingress masks from
+        # orig_pkts (identical by construction) but takes the
+        # ALREADY-CONSUMED tenant trio — tokens are never spent twice
         return pipeline_step(tables, orig_pkts, now, acl_global_fn,
                              acl_local_fn, sweep_stride=sweep_stride,
                              ml_mode=ml_mode, ml_kind=ml_kind,
-                             tel_mode=tel_mode, shard=shard)
+                             tel_mode=tel_mode, tnt_mode=tnt_mode,
+                             shard=shard,
+                             _tnt_pre=((tid, tnt_dropped, tbl)
+                                       if tnt else None))
 
     return lax.cond(ok, fast, full, None)
 
@@ -701,7 +829,7 @@ def make_pipeline_step(impl: str = "dense", skip_local: bool = False,
                        fast: bool = False,
                        sweep_stride: int = SWEEP_STRIDE_DEFAULT,
                        ml_mode: str = "off", ml_kind: str = "mlp",
-                       tel_mode: str = "off"):
+                       tel_mode: str = "off", tnt_mode: str = "off"):
     """Compose one pipeline-step callable from the epoch's gates:
     classifier implementation (dense | mxu | bv), the policy-free
     local-classify skip, the two-tier fast-path dispatch, the session
@@ -726,6 +854,8 @@ def make_pipeline_step(impl: str = "dense", skip_local: bool = False,
         raise ValueError(f"unknown ml_kind {ml_kind!r}")
     if tel_mode not in ("off", "latency", "full"):
         raise ValueError(f"unknown tel_mode {tel_mode!r}")
+    if tnt_mode not in ("off", "on"):
+        raise ValueError(f"unknown tnt_mode {tnt_mode!r}")
     acl_global_fn, acl_local_fn = _classifier_fns(impl)
     if skip_local:
         acl_local_fn = acl_local_none
@@ -735,13 +865,15 @@ def make_pipeline_step(impl: str = "dense", skip_local: bool = False,
              now: jnp.ndarray) -> StepResult:
         return base(tables, pkts, now, acl_global_fn=acl_global_fn,
                     acl_local_fn=acl_local_fn, sweep_stride=sweep_stride,
-                    ml_mode=ml_mode, ml_kind=ml_kind, tel_mode=tel_mode)
+                    ml_mode=ml_mode, ml_kind=ml_kind, tel_mode=tel_mode,
+                    tnt_mode=tnt_mode)
 
-    step.__name__ = "pipeline_step_{}{}{}{}{}".format(
+    step.__name__ = "pipeline_step_{}{}{}{}{}{}".format(
         impl, "_nolocal" if skip_local else "", "_auto" if fast else "",
         "" if ml_mode == "off" else f"_ml{ml_mode}"
         + ("_forest" if ml_kind == "forest" else ""),
         "" if tel_mode == "off" else f"_tel{tel_mode}",
+        "" if tnt_mode == "off" else "_tenancy",
     )
     return step
 

@@ -126,6 +126,20 @@ class DataplaneConfig(NamedTuple):
     telemetry_sketch_rows: int = 2    # count-min depth d
     telemetry_sketch_cols: int = 1024  # count-min width w (power of 2)
     telemetry_topk: int = 8           # heavy-hitter candidate slots
+    # Multi-tenant gateway mode (ISSUE 14; vpp_tpu/tenancy/,
+    # docs/TENANCY.md): "off" compiles the tenant stage out entirely
+    # and the tnt_* fields carry minimal placeholder shapes (the
+    # telemetry/ml gating pattern); "on" derives a per-packet tenant
+    # id at ip4-input from the src/dst prefix map (its own "tenant"
+    # upload group), runs the per-tenant token-bucket rate limit
+    # inside the fused step (overage → DROP_TENANT, attributed
+    # drops_total{reason="tenant_quota"}), slices session/NAT bucket
+    # capacity per tenant (TableBuilder.set_tenant sess_buckets — a
+    # full slice fails/evicts only WITHIN the owning tenant, never
+    # across), and keys the ML flag threshold/mode by tenant.
+    tenancy: str = "off"
+    tenancy_tenants: int = 8          # tenant-id capacity (1..64)
+    tenancy_prefixes: int = 64        # prefix-map slots
 
 
 class DataplaneTables(NamedTuple):
@@ -294,6 +308,45 @@ class DataplaneTables(NamedTuple):
     tel_top_ports: jnp.ndarray  # uint32 [K] sport<<16 | dport
     tel_top_cnt: jnp.ndarray   # int32 [K] estimated packet count
 
+    # --- multi-tenant gateway mode (ISSUE 14; vpp_tpu/tenancy/) -----
+    # Config half ("tenant" upload group — ships independently of
+    # rules/model, so tenant churn re-ships a few hundred bytes and
+    # rule/model churn re-ships zero tenant state). Placeholder [1]
+    # shapes when the ``tenancy`` knob is off (tnt_capacity).
+    tnt_pfx_net: jnp.ndarray    # uint32 [S] pre-masked prefix network
+    tnt_pfx_mask: jnp.ndarray   # uint32 [S]
+    tnt_pfx_id: jnp.ndarray     # int32 [S] tenant id, -1 = empty slot
+    tnt_rate: jnp.ndarray       # int32 [T] bucket tokens/tick (0 = no
+                                # limit; bounded 2^16 — int32 refill)
+    tnt_burst: jnp.ndarray      # int32 [T] bucket capacity
+    tnt_sess_base: jnp.ndarray  # int32 [T] first session bucket of the
+                                # tenant's slice (GLOBAL bucket units)
+    tnt_sess_mask: jnp.ndarray  # int32 [T] slice bucket mask (nbk-1;
+                                # unsliced tenants carry the full-table
+                                # mask — base 0)
+    tnt_nat_base: jnp.ndarray   # int32 [T] NAT-session slice base
+    tnt_nat_mask: jnp.ndarray   # int32 [T] NAT-session slice mask
+    # per-tenant ML policy vectors (tenancy/sched.py ML_MODE_CODES:
+    # 0 inherit | 1 off | 2 score | 3 enforce; thresh INT32_MIN =
+    # inherit the model's global flag threshold). Deliberately in the
+    # "tenant" group, NOT "ml": flipping a tenant's threshold/mode
+    # never re-ships the weight planes (ISSUE 14 satellite).
+    glb_ml_tnt_mode: jnp.ndarray    # int32 [T]
+    glb_ml_tnt_thresh: jnp.ndarray  # int32 [T]
+    # State half (TENANCY_STATE_FIELDS — carried by reference across
+    # swaps like the sweep cursors; the persistent ring threads them
+    # window-to-window): token-bucket level + last-refill tick, and
+    # the per-tenant accounting planes `show tenants` /
+    # vpp_tpu_tenant_* read as host scalars.
+    tnt_tokens: jnp.ndarray     # int32 [T] current bucket level
+    tnt_tok_time: jnp.ndarray   # int32 [T] last refill tick
+    tnt_rx_c: jnp.ndarray       # int32 [T] packets received
+    tnt_tx_c: jnp.ndarray       # int32 [T] packets forwarded (goodput)
+    tnt_rl_c: jnp.ndarray       # int32 [T] rate-limit (tenant_quota)
+                                # drops
+    tnt_qf_c: jnp.ndarray       # int32 [T] session-slice insert
+                                # failures attributed to the tenant
+
 
 def _mask_of(plen: int, bits: int = 32) -> int:
     return ((1 << bits) - 1) ^ ((1 << (bits - plen)) - 1) if plen else 0
@@ -425,6 +478,57 @@ def zero_telemetry_device(config: DataplaneConfig) -> Dict[str, jnp.ndarray]:
             for f, dt in TELEMETRY_FIELDS.items()}
 
 
+# --- multi-tenant gateway mode (ISSUE 14; vpp_tpu/tenancy/) ----------
+
+# glb_ml_tnt_thresh sentinel: "inherit the model's global flag
+# threshold" (a real threshold of -2^31 would flag every packet — not
+# a usable configuration, so the sentinel costs nothing).
+ML_TNT_THRESH_INHERIT = -(1 << 31)
+
+# Tenancy STATE fields of DataplaneTables (token buckets + accounting
+# planes) — carried by reference across epoch swaps and grafted back
+# from the persistent ring at stop/sync, exactly like TELEMETRY_FIELDS.
+# Deliberately NOT in SESSION_FIELDS: the crash-consistent snapshot
+# format enumerates SESSION_FIELDS, and bucket levels/counters are
+# measurement state that restarts cold by design.
+TENANCY_STATE_FIELDS: Dict[str, type] = {
+    "tnt_tokens": np.int32,
+    "tnt_tok_time": np.int32,
+    "tnt_rx_c": np.int32,
+    "tnt_tx_c": np.int32,
+    "tnt_rl_c": np.int32,
+    "tnt_qf_c": np.int32,
+}
+
+
+def tnt_capacity(config: DataplaneConfig) -> Tuple[int, int]:
+    """(tenants T, prefix slots S) of the tenant planes. "off" carries
+    minimal placeholders (never read — the step factory compiles the
+    tenant stage out, the ml/telemetry gating pattern)."""
+    if getattr(config, "tenancy", "off") == "off":
+        return 1, 1
+    return (int(getattr(config, "tenancy_tenants", 8)),
+            int(getattr(config, "tenancy_prefixes", 64)))
+
+
+def tenancy_state_shapes(config: DataplaneConfig) -> Dict[str, Tuple[int, ...]]:
+    t, _s = tnt_capacity(config)
+    return {f: (t,) for f in TENANCY_STATE_FIELDS}
+
+
+def zero_tenancy_state(config: DataplaneConfig,
+                       leading: Tuple[int, ...] = ()) -> Dict[str, np.ndarray]:
+    shapes = tenancy_state_shapes(config)
+    return {f: np.zeros(leading + shapes[f], dt)
+            for f, dt in TENANCY_STATE_FIELDS.items()}
+
+
+def zero_tenancy_state_device(config: DataplaneConfig) -> Dict[str, jnp.ndarray]:
+    shapes = tenancy_state_shapes(config)
+    return {f: jnp.zeros(shapes[f], dt)
+            for f, dt in TENANCY_STATE_FIELDS.items()}
+
+
 def _is_pow2(n: int) -> bool:
     return n > 0 and (n & (n - 1)) == 0
 
@@ -500,6 +604,18 @@ def validate_dataplane_config(config: DataplaneConfig) -> None:
     if not (1 <= k <= 64):
         raise ValueError(
             f"dataplane.telemetry_topk must be in 1..64, got {k}")
+    tnt = getattr(c, "tenancy", "off")
+    if tnt not in ("off", "on"):
+        raise ValueError(
+            f"dataplane.tenancy must be off | on, got {tnt!r}")
+    t = int(getattr(c, "tenancy_tenants", 8))
+    if not (1 <= t <= 64):
+        raise ValueError(
+            f"dataplane.tenancy_tenants must be in 1..64, got {t}")
+    s = int(getattr(c, "tenancy_prefixes", 64))
+    if not (1 <= s <= 1024):
+        raise ValueError(
+            f"dataplane.tenancy_prefixes must be in 1..1024, got {s}")
 
 
 def ml_capacity(config: DataplaneConfig) -> Tuple[int, int, int, int]:
@@ -851,6 +967,16 @@ _UPLOAD_GROUPS: Dict[str, Tuple[str, ...]] = {
             "nat_bcnt", "nat_total_w", "nat_self_snat", "natb_ip",
             "natb_port", "natb_cumw", "nat_snat_ip"),
     "config": ("sess_max_age",),
+    # tenancy config half (ISSUE 14): its OWN group, so tenant churn
+    # (a new prefix, a rate change, a per-tenant ML threshold flip)
+    # ships a few hundred bytes and never re-ships rules or weights —
+    # and vice versa. The tnt_* STATE planes are not here: they ride
+    # the carry-over like the sweep cursors.
+    "tenant": ("tnt_pfx_net", "tnt_pfx_mask", "tnt_pfx_id",
+               "tnt_rate", "tnt_burst",
+               "tnt_sess_base", "tnt_sess_mask",
+               "tnt_nat_base", "tnt_nat_mask",
+               "glb_ml_tnt_mode", "glb_ml_tnt_thresh"),
 }
 
 # BV dimension -> its global-table device fields (granular upload:
@@ -943,6 +1069,15 @@ class TableBuilder:
         # re-gates the compiled stage off at swap while it is 0).
         self.ml = empty_ml(c)
         self.ml_kind = 0
+        # multi-tenant gateway staging (ISSUE 14; vpp_tpu/tenancy/):
+        # a normalized tenant-entry registry (set_tenant) compiled
+        # into the "tenant" upload-group arrays by _restage_tenants.
+        # The VNI → tenant map and the WFQ weights live in the
+        # registry only — they are HOST-side knobs (the IO pump's
+        # TenantClassifier), not device state.
+        self.tenants: Dict[int, dict] = {}
+        self.tnt: Dict[str, np.ndarray] = {}
+        self._restage_tenants()
         self.if_type = z(c.max_ifaces, np.int32)
         self.if_local_table = np.full(c.max_ifaces, -1, np.int32)
         self.if_apply_global = z(c.max_ifaces, np.int32)
@@ -1136,6 +1271,147 @@ class TableBuilder:
             self._rec.clear_ml_model()
         self._mark("ml")
 
+    # --- multi-tenant gateway (ISSUE 14; vpp_tpu/tenancy/) ---
+    def _restage_tenants(self) -> None:
+        """Compile the tenant registry into the "tenant" upload-group
+        arrays. Session/NAT bucket slices are allocated contiguously
+        in ascending tenant-id order from the TOP of the table
+        downward (GLOBAL bucket units — the mesh's bucket-axis shards
+        split any global index, so slices compose with the partition
+        layer unchanged); unsliced tenants (including the implicit
+        default tenant 0) share the residual BOTTOM region, masked to
+        the largest power of two that fits — disjoint from every
+        slice, so unsliced traffic can never hash into (let alone
+        evict from) a sliced tenant's range. With nothing sliced the
+        residual is the whole table: bit-identical to the unsliced
+        ``_hash``. Deterministic: the same registry always compiles
+        byte-identical arrays."""
+        c = self.config
+        T, S = tnt_capacity(c)
+        ways = int(getattr(c, "sess_ways", 4))
+        sess_nb = c.sess_slots // ways
+        nat_nb = natsess_slots_of(c) // ways
+        net = np.zeros(S, np.uint32)
+        mask = np.zeros(S, np.uint32)
+        pid = np.full(S, -1, np.int32)
+        rate = np.zeros(T, np.int32)
+        burst = np.zeros(T, np.int32)
+        sb = np.zeros(T, np.int32)
+        sm = np.zeros(T, np.int32)
+        nb_ = np.zeros(T, np.int32)
+        nm = np.zeros(T, np.int32)
+        mlm = np.zeros(T, np.int32)
+        mlt = np.full(T, ML_TNT_THRESH_INHERIT, np.int32)
+        slot = 0
+        cursor = {"sess": sess_nb, "nat": nat_nb}
+        sliced_tids = {"sess": set(), "nat": set()}
+        from vpp_tpu.tenancy.sched import ML_MODE_CODES  # jax-free
+
+        for tid in sorted(self.tenants):
+            e = self.tenants[tid]
+            for p in e["prefixes"]:
+                if slot >= S:
+                    raise ValueError(
+                        f"tenant prefix map full ({S} slots — raise "
+                        f"dataplane.tenancy_prefixes)")
+                pnet = ipaddress.ip_network(p, strict=False)
+                m = _mask_of(pnet.prefixlen)
+                net[slot] = int(pnet.network_address) & m
+                mask[slot] = m
+                pid[slot] = tid
+                slot += 1
+            rate[tid] = e["rate"]
+            burst[tid] = e["burst"]
+            for kind, basearr, maskarr in (
+                    ("sess", sb, sm), ("nat", nb_, nm)):
+                nbk = e[f"{kind}_buckets"]
+                if nbk:
+                    cursor[kind] -= nbk
+                    basearr[tid] = cursor[kind]
+                    maskarr[tid] = nbk - 1
+                    sliced_tids[kind].add(tid)
+            mlm[tid] = ML_MODE_CODES[e.get("ml_mode", "inherit")]
+            if e.get("ml_thresh") is not None:
+                mlt[tid] = int(e["ml_thresh"])
+        # unsliced tenants (every tid not sliced above, tenant 0
+        # included unless it registered a slice): base 0, masked to
+        # the largest power of two inside the residual [0, cursor) so
+        # they can never land in a slice. validate_tenancy_config
+        # guarantees cursor > 0 whenever an unsliced tenant exists.
+        for kind, maskarr in (("sess", sm), ("nat", nm)):
+            free = cursor[kind]
+            um = (1 << (free.bit_length() - 1)) - 1 if free > 0 else 0
+            for tid in range(T):
+                if tid not in sliced_tids[kind]:
+                    maskarr[tid] = um
+        self.tnt = {
+            "tnt_pfx_net": net, "tnt_pfx_mask": mask, "tnt_pfx_id": pid,
+            "tnt_rate": rate, "tnt_burst": burst,
+            "tnt_sess_base": sb, "tnt_sess_mask": sm,
+            "tnt_nat_base": nb_, "tnt_nat_mask": nm,
+            "glb_ml_tnt_mode": mlm, "glb_ml_tnt_thresh": mlt,
+        }
+
+    def set_tenant(self, tid: int, **kw) -> None:
+        """Register (or replace) one tenant: prefixes, VNI, token
+        bucket (``rate`` tokens/tick, ``burst`` capacity), session/NAT
+        capacity slices (``sess_buckets``/``nat_buckets`` — power-of-2
+        bucket counts; 0 = unsliced), the pump's WFQ ``weight``, and
+        the per-tenant ML override (``ml_mode``/``ml_thresh``).
+        Validated as a whole (vpp_tpu/tenancy/sched.py) so an
+        oversubscribed slice or a bad prefix is refused BEFORE any
+        staging mutates."""
+        if getattr(self.config, "tenancy", "off") == "off":
+            raise ValueError(
+                "dataplane.tenancy is off — set_tenant requires "
+                "tenancy: on (the tnt_* planes carry placeholder "
+                "shapes otherwise)")
+        from vpp_tpu.tenancy.sched import validate_tenancy_config
+
+        merged = {t: dict(e) for t, e in self.tenants.items()}
+        merged[int(tid)] = {"id": int(tid), **kw}
+        entries = validate_tenancy_config(self.config,
+                                          list(merged.values()))
+        self.tenants = {e["id"]: e for e in entries}
+        self._restage_tenants()
+        if self._rec is not None:
+            self._rec.set_tenant(int(tid), **kw)
+        self._mark("tenant")
+
+    def clear_tenants(self) -> None:
+        """Back to the single default tenant (everything tenant 0,
+        unsliced, unlimited)."""
+        self.tenants = {}
+        self._restage_tenants()
+        if self._rec is not None:
+            self._rec.clear_tenants()
+        self._mark("tenant")
+
+    def set_tenant_ml(self, tid: int, ml_mode: str = "inherit",
+                      ml_thresh: Optional[int] = None) -> None:
+        """Flip ONE tenant's ML mode/threshold without touching its
+        other staging — marks only the "tenant" group, so the model's
+        weight planes re-ship NOTHING (the ISSUE 14 satellite: tenants
+        run different off|score|enforce modes against one staged
+        model)."""
+        if int(tid) not in self.tenants:
+            raise ValueError(
+                f"tenant {tid} not registered (set_tenant first)")
+        e = dict(self.tenants[int(tid)])
+        e["ml_mode"] = ml_mode
+        e["ml_thresh"] = ml_thresh
+        from vpp_tpu.tenancy.sched import validate_tenancy_config
+
+        merged = {t: dict(x) for t, x in self.tenants.items()}
+        merged[int(tid)] = e
+        entries = validate_tenancy_config(self.config,
+                                          list(merged.values()))
+        self.tenants = {x["id"]: x for x in entries}
+        self._restage_tenants()
+        if self._rec is not None:
+            self._rec.set_tenant_ml(int(tid), ml_mode, ml_thresh)
+        self._mark("tenant")
+
     # --- interfaces ---
     def set_interface(
         self,
@@ -1288,6 +1564,8 @@ class TableBuilder:
             "glb_bv": self.glb_bv,         # mutated in place
             "ml": self.ml,                 # replaced wholesale too
             "ml_kind": self.ml_kind,
+            "tnt": self.tnt,               # replaced wholesale
+            "tenants": {t: dict(e) for t, e in self.tenants.items()},
             "nat_snat_ip": self.nat_snat_ip,
             "dirty": set(self._dirty),
             "rec_ops": list(self._rec.ops) if self._rec is not None else None,
@@ -1310,6 +1588,8 @@ class TableBuilder:
         self.glb_bv = snap["glb_bv"]
         self.ml = snap["ml"]
         self.ml_kind = snap["ml_kind"]
+        self.tnt = snap["tnt"]
+        self.tenants = {t: dict(e) for t, e in snap["tenants"].items()}
         # the identity-diff caches describe the pre-restore rule list;
         # the next set_global_table must full-recompile. The BV device
         # cache may hold planes of the rolled-back commit — every BV
@@ -1380,6 +1660,7 @@ class TableBuilder:
             glb_bv_dport=self.glb_bv.bm_dport,
             glb_bv_proto=self.glb_bv.bm_proto,
             **self.ml,
+            **self.tnt,
             if_type=self.if_type,
             if_local_table=self.if_local_table,
             if_apply_global=self.if_apply_global,
@@ -1440,23 +1721,29 @@ class TableBuilder:
                         f"{shapes[f]}")
             sess = {f: jnp.asarray(np.asarray(sessions[f], dt))
                     for f, dt in SESSION_FIELDS.items()}
-            # telemetry restarts cold on a snapshot restore by design:
-            # the snapshot format carries SESSION_FIELDS only, and
-            # measurement state from before a crash would mislabel the
-            # post-restart latency regime
+            # telemetry + tenancy state restart cold on a snapshot
+            # restore by design: the snapshot format carries
+            # SESSION_FIELDS only, and measurement state from before a
+            # crash would mislabel the post-restart regime (the token
+            # buckets refill within one step)
             tel = zero_telemetry_device(self.config)
+            tnt_st = zero_tenancy_state_device(self.config)
         elif sessions is not None:
             # carry-over is BY REFERENCE: the live device arrays flow
             # into the new epoch untouched — at 10M slots the session
             # state is ~100s of MB and must never re-ship on a swap.
-            # The telemetry planes (ops/telemetry.py) ride the same
-            # carry: an epoch swap must not reset the histograms.
+            # The telemetry planes (ops/telemetry.py) and the tenancy
+            # state (token buckets + accounting planes, ISSUE 14) ride
+            # the same carry: an epoch swap must not reset them.
             sess = {f: getattr(sessions, f) for f in SESSION_FIELDS}
             tel = {f: getattr(sessions, f) for f in TELEMETRY_FIELDS}
+            tnt_st = {f: getattr(sessions, f)
+                      for f in TENANCY_STATE_FIELDS}
         else:
             # device-side zero fill, not a host upload of zeros
             sess = zero_sessions_device(self.config)
             tel = zero_telemetry_device(self.config)
+            tnt_st = zero_tenancy_state_device(self.config)
         host_np = self.host_arrays()
         host = {}
         glb_full = False
@@ -1495,7 +1782,7 @@ class TableBuilder:
             # no-op while the device serves stale rules
             self._set_glb_prev(host_np)
         self._dirty.clear()
-        return DataplaneTables(**host, **sess, **tel)
+        return DataplaneTables(**host, **sess, **tel, **tnt_st)
 
     def _set_glb_prev(self, host_np: Dict[str, np.ndarray]) -> None:
         """Record the diff base for incremental glb commits. The ROW

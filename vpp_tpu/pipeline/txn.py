@@ -71,6 +71,7 @@ _OPS = (
     "set_local_table", "clear_local_table", "set_global_table",
     "set_nat_mapping", "clear_nat", "set_snat_ip",
     "set_ml_model", "clear_ml_model",
+    "set_tenant", "clear_tenants", "set_tenant_ml",
 )
 _RULE_OPS = {"set_local_table", "set_global_table"}
 
@@ -154,6 +155,21 @@ class ConfigTxn:
 
     def clear_ml_model(self) -> "ConfigTxn":
         return self._record("clear_ml_model")
+
+    # --- multi-tenant gateway mode (ISSUE 14) ---
+    def set_tenant(self, tid: int, **kw: Any) -> "ConfigTxn":
+        """``kw`` is the tenant entry as TableBuilder.set_tenant takes
+        it (prefixes/vni/rate/burst/slices/weight/ml_*) — plain JSON
+        data, so the journal replays the exact staged tenant."""
+        return self._record("set_tenant", tid=int(tid), **kw)
+
+    def clear_tenants(self) -> "ConfigTxn":
+        return self._record("clear_tenants")
+
+    def set_tenant_ml(self, tid: int, ml_mode: str = "inherit",
+                      ml_thresh: Optional[int] = None) -> "ConfigTxn":
+        return self._record("set_tenant_ml", tid=int(tid),
+                            ml_mode=ml_mode, ml_thresh=ml_thresh)
 
     # --- apply / serialize ---
     def apply_to_builder(self, builder) -> None:

@@ -132,6 +132,21 @@ PUMP_STAT_GAUGES = (
      "priority classifications demoted to bulk by the "
      "pump.priority_starve fault seam (chaos testing; 0 in "
      "production)"),
+    # tenancy (ISSUE 14; vpp_tpu/tenancy/): the aux-rider totals —
+    # device token-bucket drops (also exported with the tenant_quota
+    # reason on vpp_tpu_pump_drops_total), session-slice insert
+    # failures, and tenant classifications the pump.tenant_starve
+    # fault seam demoted to the default tenant
+    ("drops_tenant_quota", "vpp_tpu_tenant_quota_drop_packets",
+     "packets dropped by per-tenant token-bucket rate limits "
+     "(device DROP_TENANT verdicts, summed across tenants)"),
+    ("tenant_sess_quota_fails", "vpp_tpu_tenant_sess_quota_fails",
+     "session/NAT inserts that failed inside a tenant's capacity "
+     "slice (summed across tenants)"),
+    ("tenant_starved", "vpp_tpu_tenant_starved",
+     "tenant classifications demoted to the default tenant by the "
+     "pump.tenant_starve fault seam (chaos testing; 0 in "
+     "production)"),
 )
 
 # pump.stats drop-cause key -> `reason` label on the
@@ -150,6 +165,11 @@ PUMP_DROP_REASONS = (
     # silent queue growth. Must stay in lockstep with
     # io/pump.py PUMP_DROP_KEYS (counters lint).
     ("drops_overload", "overload"),
+    # tenant_quota = per-tenant token-bucket overage dropped ON
+    # DEVICE (ISSUE 14; DROP_TENANT verdicts counted off the aux
+    # rider) — a misbehaving tenant's overage is fully attributed
+    # here, never absorbed silently or billed to other tenants
+    ("drops_tenant_quota", "tenant_quota"),
 )
 
 # pump.stats stage-seconds key -> `stage` label of the
@@ -290,7 +310,55 @@ NODE_GAUGES = (
     ("vpp_tpu_flow_sketch_packets",
      "packets folded into the device count-min heavy-hitter flow "
      "sketch"),
+    # multi-tenant gateway mode (ISSUE 14; vpp_tpu/tenancy/): the
+    # StepStats mirrors of the unpacked path — per-tenant detail
+    # lives on the labelled TENANT_GAUGES families
+    ("vpp_tpu_node_tenant_limited_packets",
+     "packets dropped by per-tenant token-bucket rate limits "
+     "(DROP_TENANT, all tenants)"),
+    ("vpp_tpu_node_tenant_quota_fail_packets",
+     "session/NAT inserts that failed inside a tenant's capacity "
+     "slice (all tenants)"),
 )
+
+# Per-tenant labelled families (ISSUE 14), split by their feed — the
+# publish loop SETS and stale-labelset-REMOVES each group by iterating
+# these same tuples, so a family added here is automatically covered
+# by both (no hand-maintained twin list to forget). All labelled
+# ``tenant=<id>``.
+# Device accounting planes + occupancy/quota: Dataplane.tenant_snapshot()
+TENANT_PLANE_GAUGES = (
+    ("vpp_tpu_tenant_rx_packets",
+     "packets received per tenant (device accounting plane)"),
+    ("vpp_tpu_tenant_goodput_packets",
+     "packets forwarded per tenant (the isolation bench's goodput "
+     "axis)"),
+    ("vpp_tpu_tenant_rl_dropped_packets",
+     "per-tenant token-bucket rate-limit drops (tenant_quota)"),
+    ("vpp_tpu_tenant_quota_fail_packets",
+     "per-tenant session-slice insert failures"),
+    ("vpp_tpu_tenant_bucket_tokens",
+     "current token-bucket fill level per tenant"),
+    ("vpp_tpu_tenant_sess_occupancy",
+     "live sessions resident in the tenant's capacity slice"),
+    ("vpp_tpu_tenant_sess_quota_slots",
+     "session-slot capacity of the tenant's slice (unsliced tenants "
+     "report the whole table)"),
+    ("vpp_tpu_tenant_weight",
+     "weighted-fair dequeue weight of the tenant in the IO pump"),
+)
+# IO-side scheduling counters: DataplanePump.tenant_io_snapshot()
+TENANT_IO_GAUGES = (
+    ("vpp_tpu_tenant_io_frames",
+     "rx frames the pump classified into the tenant's lane"),
+    ("vpp_tpu_tenant_io_packets",
+     "packets the pump classified into the tenant's lane"),
+    ("vpp_tpu_tenant_shed_packets",
+     "packets shed from the tenant's lane in governor brownout "
+     "(per-tenant-weighted shedding; also attributed "
+     "reason=overload)"),
+)
+TENANT_GAUGES = TENANT_PLANE_GAUGES + TENANT_IO_GAUGES
 
 # StepStats field → the Prometheus family its value feeds. The single
 # source of truth behind the tools/lint.py ``--counters`` parity pass:
@@ -332,6 +400,9 @@ STEPSTATS_FAMILIES = {
     "ml_drops": "vpp_tpu_ml_dropped_packets",
     # device telemetry plane (ISSUE 11)
     "tel_sketched": "vpp_tpu_flow_sketch_packets",
+    # multi-tenant gateway mode (ISSUE 14)
+    "tnt_limited": "vpp_tpu_node_tenant_limited_packets",
+    "tnt_qfail": "vpp_tpu_node_tenant_quota_fail_packets",
 }
 
 # Packed-aux rider row (pipeline/dataplane.py PACKED_AUX_SCHEMA, rows
@@ -349,6 +420,10 @@ AUX_RIDER_STATS = {
     "ml_drops": "ml_drops",
     "tel_observed": "tel_observed",
     "tel_sketched": "tel_sketched",
+    # tenancy rows (ISSUE 14): the rate-limit row doubles as the
+    # tenant_quota reason on vpp_tpu_pump_drops_total
+    "tnt_limited": "drops_tenant_quota",
+    "tnt_qfail": "tenant_sess_quota_fails",
 }
 
 # Telemetry-plane modes the vpp_tpu_telemetry info gauge enumerates
@@ -394,7 +469,7 @@ class StatsCollector:
                            "natsess_evict_expired",
                            "natsess_evict_victim",
                            "ml_scored", "ml_flagged", "ml_drops",
-                           "tel_sketched")
+                           "tel_sketched", "tnt_limited", "tnt_qfail")
         }
         # gauges, not counters: last-step snapshots
         self._last: Dict[str, int] = {
@@ -413,6 +488,20 @@ class StatsCollector:
             name: self.registry.register(STATS_PATH, Gauge(name, help_))
             for name, help_ in PUMP_GAUGES
         }
+        # multi-tenant gateway families (ISSUE 14): per-tenant
+        # labelled gauges fed by Dataplane.tenant_snapshot() (device
+        # planes, [T] ints) + DataplanePump.tenant_io_snapshot()
+        # (host-side lane counters)
+        self.tenant_gauges = {
+            name: self.registry.register(STATS_PATH, Gauge(name, help_))
+            for name, help_ in TENANT_GAUGES
+        }
+        # labelsets exported on the previous publish, per family group:
+        # a deleted tenant's series must be REMOVED (the build_info
+        # stale-labelset discipline), or dashboards show a ghost
+        # tenant frozen at its last values forever
+        self._tenant_pub_tids: set = set()
+        self._tenant_io_pub_tids: set = set()
         # the real distribution behind the p50/p99 gauges (kept for
         # compatibility): the pump observes every batch's dispatch→tx
         # latency directly, so histogram_quantile() aggregates across
@@ -886,6 +975,10 @@ class StatsCollector:
             totals["ml_drops"])
         self.node_gauges["vpp_tpu_flow_sketch_packets"].set(
             totals["tel_sketched"])
+        self.node_gauges["vpp_tpu_node_tenant_limited_packets"].set(
+            totals["tnt_limited"])
+        self.node_gauges["vpp_tpu_node_tenant_quota_fail_packets"].set(
+            totals["tnt_qfail"])
         self.sess_insert_failed_gauge.set(
             totals["sess_insert_fail"], table="sess")
         self.sess_insert_failed_gauge.set(
@@ -1027,6 +1120,60 @@ class StatsCollector:
             self.flow_sketched_gauge.set(float(tel["sketched"]))
             for rank, cnt in enumerate(tel["top_cnt"]):
                 self.flow_top_gauge.set(float(cnt), rank=str(rank))
+        # multi-tenant gateway mode (ISSUE 14): per-tenant device
+        # planes (accounting, bucket fill, slice occupancy/quota) +
+        # the pump's lane counters — only tenants the registry names
+        # export, so the label space stays bounded
+        tnt_fn = getattr(self.dp, "tenant_snapshot", None)
+        tnt = tnt_fn() if callable(tnt_fn) else None
+        if tnt is not None:
+            g = self.tenant_gauges
+            # tenant 0 always exports: the implicit default sink for
+            # unmatched traffic — often the dominant share — must not
+            # vanish from dashboards the moment real tenants register
+            for tid in sorted(set(tnt["tenants"]) | {0}):
+                lbl = {"tenant": str(tid)}
+                g["vpp_tpu_tenant_rx_packets"].set(
+                    float(tnt["rx"][tid]), **lbl)
+                g["vpp_tpu_tenant_goodput_packets"].set(
+                    float(tnt["tx"][tid]), **lbl)
+                g["vpp_tpu_tenant_rl_dropped_packets"].set(
+                    float(tnt["rl_drops"][tid]), **lbl)
+                g["vpp_tpu_tenant_quota_fail_packets"].set(
+                    float(tnt["quota_fails"][tid]), **lbl)
+                g["vpp_tpu_tenant_bucket_tokens"].set(
+                    float(tnt["tokens"][tid]), **lbl)
+                g["vpp_tpu_tenant_sess_occupancy"].set(
+                    float(tnt["occupancy"][tid]), **lbl)
+                g["vpp_tpu_tenant_sess_quota_slots"].set(
+                    float(tnt["sess_quota_slots"][tid]), **lbl)
+                g["vpp_tpu_tenant_weight"].set(
+                    float(tnt["tenants"].get(tid, {}).get("weight", 1)),
+                    **lbl)
+            cur = set(tnt["tenants"]) | {0}
+            for tid in self._tenant_pub_tids - cur:
+                lbl = {"tenant": str(tid)}
+                for name, _h in TENANT_PLANE_GAUGES:
+                    g[name].remove(**lbl)
+            self._tenant_pub_tids = cur
+        io_fn = getattr(self.pump, "tenant_io_snapshot", None)
+        if callable(io_fn):
+            tio = io_fn()
+            g = self.tenant_gauges
+            for tid, io in sorted(tio["io"].items()):
+                lbl = {"tenant": str(tid)}
+                g["vpp_tpu_tenant_io_frames"].set(
+                    float(io["frames"]), **lbl)
+                g["vpp_tpu_tenant_io_packets"].set(
+                    float(io["pkts"]), **lbl)
+                g["vpp_tpu_tenant_shed_packets"].set(
+                    float(io["shed_pkts"]), **lbl)
+            cur = set(tio["io"])
+            for tid in self._tenant_io_pub_tids - cur:
+                lbl = {"tenant": str(tid)}
+                for name, _h in TENANT_IO_GAUGES:
+                    g[name].remove(**lbl)
+            self._tenant_io_pub_tids = cur
         # resilience surface (ISSUE 8): every component exports every
         # publish (0 = healthy) so dashboards alert on value, never on
         # series absence

@@ -1,0 +1,37 @@
+"""Multi-tenant gateway mode (ISSUE 14): tenant-id derivation,
+tenant-sliced table capacity, per-tenant token-bucket rate limiting and
+weighted-fair IO scheduling.
+
+Lazily re-exporting (PEP 562, the stats/ and ml/ package pattern): the
+host-side scheduler (``sched``) is jax-free and must import in light
+processes (the IO daemon, the CLI client); the device ops (``derive``)
+pull in jax and load only when a data plane actually uses them.
+"""
+
+from __future__ import annotations
+
+_EXPORTS = {
+    # host side (jax-free)
+    "TenantClassifier": "vpp_tpu.tenancy.sched",
+    "TenantScheduler": "vpp_tpu.tenancy.sched",
+    "validate_tenancy_config": "vpp_tpu.tenancy.sched",
+    "tenant_entries_from_config": "vpp_tpu.tenancy.sched",
+    # device side (jax)
+    "addr_tenant": "vpp_tpu.tenancy.derive",
+    "key_tenant": "vpp_tpu.tenancy.derive",
+    "tenant_ids": "vpp_tpu.tenancy.derive",
+    "tenant_limit": "vpp_tpu.tenancy.derive",
+    "tnt_account": "vpp_tpu.tenancy.derive",
+    "tenant_occupancy": "vpp_tpu.tenancy.derive",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)

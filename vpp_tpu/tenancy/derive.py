@@ -1,0 +1,187 @@
+"""Device-side tenancy ops: tenant-id derivation + per-tenant token
+buckets + per-tenant accounting planes (ISSUE 14 tentpole).
+
+Gryphon (PAPERS.md) organizes a hyperscale gateway around hierarchical
+multi-tenancy; the analog here is a first-class tenant axis derived at
+ip4-input and threaded through the fused step:
+
+* **Derivation** is a small masked-compare prefix map shipped in its
+  own ``"tenant"`` upload group (pipeline/tables.py): per address the
+  FIRST matching slot's tenant id wins (prefixes are validated
+  DISJOINT across tenants at config load, so slot order never decides
+  between tenants and first-match equals the host classifier's max;
+  same-tenant nesting is harmless), and a packet's tenant is
+  ``max(tenant(src), tenant(dst))`` — deliberately SYMMETRIC under src/dst swap, so both
+  directions of a flow derive the same tenant and the tenant-sliced
+  session buckets (ops/session.py) are consistent between the forward
+  insert and the reply's reverse lookup. Cross-tenant (east-west)
+  flows attribute to the higher tenant id by this rule; unmatched
+  addresses are tenant 0, the default tenant. The VXLAN VNI → tenant
+  map is host-side config (tenancy/sched.py ``TenantClassifier`` /
+  TableBuilder's registry): VNIs terminate on interfaces before the
+  packet vector exists, so the device map keys on addresses.
+
+* **Rate limiting** is a per-tenant token bucket evaluated INSIDE the
+  fused step: bucket state (``tnt_tokens``/``tnt_tok_time``, [T]
+  int32) rides the tables pytree by reference exactly like the sweep
+  cursors — epoch swaps carry it, the persistent ring threads it
+  window-to-window, zero io_callbacks. Refill is ``rate`` tokens per
+  clock tick up to ``burst``; within one batch, packets of a tenant
+  consume in packet order (an exclusive per-tenant prefix count), so
+  admission is deterministic and the NumPy oracle in
+  tests/test_tenancy.py reproduces it bit-for-bit. ``rate == 0``
+  means unlimited. Overage drops are attributed ``DROP_TENANT``
+  (graph.py) → ``drops_total{reason="tenant_quota"}``.
+
+* **Accounting** scatter-adds per-tenant rx/goodput/drop counters into
+  device-resident [T] planes (the telemetry-plane pattern) — `show
+  tenants` and the ``vpp_tpu_tenant_*`` families read host copies of
+  a few dozen bytes, never columns.
+
+All magnitudes stay inside int32: refill clamps the idle gap at 2^14
+ticks and validate_dataplane_config bounds ``rate`` at 2^16, so
+``rate * dt <= 2^30`` — and the refill caps the INCREMENT at the
+bucket's remaining headroom before adding, so the sum never leaves
+int32 either (``tokens + rate*dt`` alone reaches 2^31 at the
+inclusive bounds).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from vpp_tpu.pipeline.tables import DataplaneTables
+from vpp_tpu.pipeline.vector import PacketVector
+
+# refill clamp: bounds rate * dt inside int32 with rate <= 2^16
+# (validate_dataplane_config); a bucket idle longer than 2^14 ticks
+# (~27 min at 10 ticks/s) refills to burst anyway
+_DT_CLAMP = 1 << 14
+
+
+def addr_tenant(tables: DataplaneTables, addr: jnp.ndarray) -> jnp.ndarray:
+    """Tenant id of each address ([P] uint32 → [P] int32): the FIRST
+    prefix-map slot whose masked network matches wins (cross-tenant
+    prefixes are validated disjoint, so slot order never picks
+    between tenants); no match = tenant 0 (the default tenant)."""
+    hit = (
+        ((addr[:, None] & tables.tnt_pfx_mask[None, :])
+         == tables.tnt_pfx_net[None, :])
+        & (tables.tnt_pfx_id[None, :] >= 0)
+    )
+    any_hit = jnp.any(hit, axis=1)
+    first = jnp.argmax(hit, axis=1)
+    return jnp.where(any_hit, tables.tnt_pfx_id[first], 0).astype(jnp.int32)
+
+
+def key_tenant(tables: DataplaneTables, a: jnp.ndarray,
+               b: jnp.ndarray) -> jnp.ndarray:
+    """Tenant of an ADDRESS PAIR: ``max(tenant(a), tenant(b))`` —
+    symmetric by construction, which is what makes tenant-sliced
+    session/NAT buckets consistent between a forward flow's insert key
+    and the reply's lookup key (both present the same unordered
+    address pair, whatever NAT did to the header in between)."""
+    return jnp.maximum(addr_tenant(tables, a), addr_tenant(tables, b))
+
+
+def tenant_ids(tables: DataplaneTables, pkts: PacketVector) -> jnp.ndarray:
+    """Per-packet tenant id [P] int32 — ``key_tenant`` on the ingress
+    header. Pure (no state touched): the two-tier dispatcher may call
+    it ahead of the branch without consuming tokens.
+
+    Billing semantics: this is the PRE-NAT header — the wire-cost
+    model (bill the bytes as received). A DNAT flow whose backend
+    lives in another tenant's prefix therefore bills its two
+    directions to different tenants' buckets; the session SLICE key
+    is immune (it derives from the post-NAT canonical pair). See
+    docs/TENANCY.md "Billing is ingress-header-based"."""
+    return key_tenant(tables, pkts.src_ip, pkts.dst_ip)
+
+
+def tenant_limit(
+    tables: DataplaneTables, tid: jnp.ndarray, alive: jnp.ndarray, now
+) -> Tuple[DataplaneTables, jnp.ndarray]:
+    """One token-bucket round for the batch: refill every tenant's
+    bucket by ``rate * ticks_since_last`` (clamped, capped at
+    ``burst``), admit each alive packet whose per-tenant arrival rank
+    still fits the refilled level, and drop the rest. Returns
+    ``(tables', dropped [P])``; call EXACTLY ONCE per fused step (both
+    pipeline tiers route through ``graph._tenant_eval``)."""
+    T = tables.tnt_rate.shape[0]
+    rate = tables.tnt_rate
+    burst = tables.tnt_burst
+    dt = jnp.clip(now - tables.tnt_tok_time, 0, _DT_CLAMP)
+    # overflow-free refill: cap the INCREMENT at the bucket headroom
+    # before adding (tokens + rate*dt can reach exactly 2^31 at the
+    # validator's inclusive bounds rate=2^16, dt=2^14, burst=tokens=
+    # 2^30 — both operands fit int32, their sum does not). A restage
+    # that shrank burst below the carried level self-corrects here:
+    # negative headroom pulls tok back down to burst.
+    tok = tables.tnt_tokens + jnp.minimum(rate * dt,
+                                          burst - tables.tnt_tokens)
+    limited = rate > 0
+    onehot = ((tid[:, None] == jnp.arange(T, dtype=jnp.int32)[None, :])
+              & alive[:, None])
+    oh = onehot.astype(jnp.int32)
+    # exclusive per-tenant prefix count = each packet's arrival rank
+    # within its tenant this batch (deterministic in packet order)
+    rank = jnp.cumsum(oh, axis=0) - oh
+    my_rank = jnp.sum(jnp.where(onehot, rank, 0), axis=1)
+    dropped = alive & limited[tid] & (my_rank >= tok[tid])
+    admitted = jnp.sum(oh * (~dropped).astype(jnp.int32)[:, None], axis=0)
+    tok_after = jnp.where(limited, jnp.clip(tok - admitted, 0, burst),
+                          burst)
+    return tables._replace(
+        tnt_tokens=tok_after.astype(jnp.int32),
+        tnt_tok_time=jnp.broadcast_to(
+            jnp.asarray(now, jnp.int32), tables.tnt_tok_time.shape),
+    ), dropped
+
+
+def tnt_account(
+    tables: DataplaneTables,
+    tid: jnp.ndarray,
+    rx: jnp.ndarray,
+    forwarded: jnp.ndarray,
+    rl_dropped: jnp.ndarray,
+    quota_fail: jnp.ndarray,
+) -> DataplaneTables:
+    """Scatter-add the batch into the per-tenant accounting planes
+    (device-resident [T] int32, carried by reference across swaps):
+    packets received / forwarded (goodput) / rate-limit-dropped /
+    session-slice insert failures, per tenant."""
+    T = tables.tnt_rx_c.shape[0]
+
+    def bump(plane, mask):
+        return plane.at[jnp.where(mask, tid, T)].add(1, mode="drop")
+
+    return tables._replace(
+        tnt_rx_c=bump(tables.tnt_rx_c, rx),
+        tnt_tx_c=bump(tables.tnt_tx_c, forwarded),
+        tnt_rl_c=bump(tables.tnt_rl_c, rl_dropped),
+        tnt_qf_c=bump(tables.tnt_qf_c, quota_fail),
+    )
+
+
+def _tenant_occupancy_impl(valid, time, now, max_age, base, nbk):
+    """Live sessions per tenant bucket slice: one prefix sum over the
+    per-bucket live counts, then a range difference per tenant — O(NB)
+    on device, [T] scalars back to the host."""
+    live = (valid == 1) & (now - time <= max_age)
+    per_bucket = jnp.sum(live.astype(jnp.int32), axis=1)
+    n = per_bucket.shape[0]
+    cum = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(per_bucket)])
+    lo = jnp.clip(base, 0, n)
+    hi = jnp.clip(base + nbk, 0, n)
+    return cum[hi] - cum[lo]
+
+
+# Module-level jit (registered in tools/analysis/jit_manifest.py): the
+# occupancy probe is an on-demand observability path (`show tenants`,
+# the collector) — one compiled program per table geometry, [T] ints
+# crossing the transport, never the session columns.
+tenant_occupancy = jax.jit(_tenant_occupancy_impl)

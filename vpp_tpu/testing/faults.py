@@ -44,6 +44,9 @@ point                 seam
 ``pump.priority_starve``  io/pump.py — priority classification demoted
                       to bulk (the lane starves; conservation must
                       hold — ISSUE 13)
+``pump.tenant_starve``  io/pump.py — tenant classification demoted to
+                      the default tenant (the weighted lane starves;
+                      conservation must hold — ISSUE 14)
 ``governor.tick``     io/governor.py — latency-governor control tick
                       (repeated failures wedge the governor one-way;
                       the pump keeps the last-known window shape)

@@ -120,6 +120,12 @@ class PacketTracer:
                 d = int(disp[i])
                 if c == 1:  # DROP_IP4
                     path.append("error-drop (ip4-input)")
+                elif c == 7:  # DROP_TENANT (ISSUE 14): the per-tenant
+                    # token bucket drops right after ip4-input, BEFORE
+                    # session lookup / ML / NAT / ACL — no later stage
+                    # ever saw the packet
+                    path.append("tenant-limit")
+                    path.append("error-drop (tenant-quota)")
                 else:
                     if established[i]:
                         path.append("session-lookup (established)")
