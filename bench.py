@@ -459,6 +459,242 @@ def acl_classifier_bench(args, batch: int = 2048, iters: int = 20) -> dict:
     return out
 
 
+def fib_bench(args, batch: int = 2048, iters: int = 12) -> dict:
+    """Million-route LPM FIB capture (ISSUE 15 tentpole).
+
+    Builds a BGP-shaped route table at 1M prefixes (memory-guarded
+    downshift like snapshot_bench), validates the ``fib_impl: auto``
+    ladder picked LPM, and measures:
+
+      * ``fib_lookup_lpm_ns_pkt``    — LPM lookup at the full table
+        (acceptance: within 2x of the small-table dense lookup at its
+        native scale on real accelerators; the 1-core CPU harness
+        measures ~4-6x because dense@64 is L1-resident while 1M-route
+        probes end in cold DRAM — docs/LATENCY.md round 15)
+      * ``fib_lookup_dense_ns_pkt``  — dense at its NATIVE node scale
+        (64 routes — what the seed-era FIB actually served)
+      * ``fib_lookup_dense_1m_ns_pkt_extrapolated`` — dense cost fit
+        over two mid scales and extrapolated to the route count (the
+        dense [P, F] compare cannot even be ALLOCATED at 1M:
+        2048 x 1M bools is ~8 GB — which is the point); acceptance:
+        LPM >= 10x faster than this
+      * ``fib_build_ms`` / ``fib_churn_commit_ms`` — full staging+
+        upload cost, and ONE /24 flap's commit: must re-ship only the
+        touched length plane + the count vector + a bounded slot blob
+        (``fib_churn_planes``/``fib_churn_bytes`` pin it)
+      * ``fib_ecmp_spread_pct``      — min/max member share over an
+        8-way group under hashed flows (the session hash family)
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from vpp_tpu.ops.fib import fib_lookup_dense
+    from vpp_tpu.ops.lpm import fib_lookup_lpm, lpm_plane_bytes
+    from vpp_tpu.pipeline.dataplane import Dataplane
+    from vpp_tpu.pipeline.tables import DataplaneConfig
+    from vpp_tpu.pipeline.vector import (
+        FLAG_VALID,
+        Disposition,
+        PacketVector,
+        ip4,
+    )
+
+    out = {}
+    rng = np.random.default_rng(15)
+    routes = 1 << 20
+    avail = _mem_available_bytes()
+    # per-slot columns + planes + host staging + diff base ~ 60 B/route
+    # x a 4x safety factor; small boxes downshift instead of OOMing
+    while routes > (1 << 16) and avail and routes * 240 > avail:
+        routes //= 4
+    out["fib_routes"] = routes
+
+    # BGP-shaped length mix (fractions of the feed)
+    mix = ((24, 0.55), (23, 0.10), (22, 0.08), (20, 0.07), (19, 0.05),
+           (16, 0.06), (21, 0.04), (18, 0.03), (32, 0.015), (8, 0.005))
+
+    def uniq_prefixes(plen, n):
+        """n distinct pre-masked networks of one length."""
+        shift = 32 - plen
+        want = rng.integers(0, 1 << min(plen, 62), int(n * 1.15) + 8,
+                            dtype=np.int64)
+        want = np.unique(want)[:n]
+        return (want.astype(np.uint64) << shift).astype(np.uint32)
+
+    nets, plens = [], []
+    left = routes - 1   # one /0 default staged separately
+    for plen, frac in mix:
+        n = min(int(routes * frac), left)
+        if n <= 0:
+            continue
+        p = uniq_prefixes(plen, n)
+        nets.append(p)
+        plens.append(np.full(len(p), plen, np.int32))
+        left -= len(p)
+    if left > 0:  # remainder lands on /24
+        p = uniq_prefixes(24, left)
+        nets.append(p)
+        plens.append(np.full(len(p), 24, np.int32))
+    nets = np.concatenate(nets)
+    plens = np.concatenate(plens)
+    counts = np.bincount(plens, minlength=33)
+    counts[0] += 1    # the default route
+    counts[25] += 1   # the ECMP capture route (a length the random
+    #                   feed never uses, so it can't be shadowed by an
+    #                   equal-length duplicate)
+    caps = [0] * 33
+    for L in range(33):
+        if counts[L]:
+            caps[L] = int(counts[L] + 64)
+    config = DataplaneConfig(
+        max_tables=2, max_rules=8, max_global_rules=8, max_ifaces=16,
+        fib_slots=len(nets) + 16, sess_slots=256, nat_mappings=1,
+        nat_backends=1, fib_impl="auto", fib_lpm_min_routes=256,
+        fib_lpm_mem_mb=512, fib_lpm_plen_caps=tuple(caps),
+        fib_ecmp_groups=8, fib_ecmp_ways=8)
+    t0 = time.perf_counter()
+    dp = Dataplane(config)
+    uplink = dp.add_uplink()
+    dp.builder.set_nh_group(0, [(ip4("192.168.0.2") + i, uplink, i % 4)
+                                for i in range(8)])
+    dp.builder.add_routes_np(
+        nets, plens, tx_if=np.full(len(nets), uplink, np.int32),
+        disp=np.full(len(nets), int(Disposition.REMOTE), np.int32),
+        node_id=1)
+    dp.builder.add_route("0.0.0.0/0", uplink, Disposition.REMOTE,
+                         slot=len(nets), node_id=1)
+    # the ECMP spread capture rides a dedicated /25 (longest match
+    # beats any feed /8../24 cover; the feed never stages /25s)
+    dp.builder.add_route("230.77.0.0/25", uplink, Disposition.REMOTE,
+                         slot=len(nets) + 1, group=0)
+    dp.swap()
+    out["fib_build_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+    out["fib_impl_selected"] = dp.fib_impl
+    out["fib_plane_mb"] = round(lpm_plane_bytes(config) / (1 << 20), 2)
+
+    def traffic(n, inside_frac=0.7, seed=16):
+        r2 = np.random.default_rng(seed)
+        dst = r2.integers(0, 1 << 32, n).astype(np.uint32)
+        picks = r2.integers(0, len(nets), n)
+        host = r2.integers(0, 1 << 32, n).astype(np.uint32)
+        masks = np.array([((1 << 32) - 1) ^ ((1 << (32 - p)) - 1)
+                          if p else 0 for p in range(33)],
+                         np.uint32)[plens[picks]]
+        inside = nets[picks] | (host & ~masks)
+        dst = np.where(r2.random(n) < inside_frac, inside, dst)
+        return PacketVector(
+            src_ip=jnp.asarray(r2.integers(0, 1 << 32, n)
+                               .astype(np.uint32)),
+            dst_ip=jnp.asarray(dst),
+            proto=jnp.full((n,), 6, jnp.int32),
+            sport=jnp.asarray(r2.integers(1024, 65000, n)
+                              .astype(np.int32)),
+            dport=jnp.full((n,), 443, jnp.int32),
+            ttl=jnp.full((n,), 64, jnp.int32),
+            pkt_len=jnp.full((n,), 512, jnp.int32),
+            rx_if=jnp.full((n,), uplink, jnp.int32),
+            flags=jnp.full((n,), FLAG_VALID, jnp.int32),
+        )
+
+    def time_lookup(fn, tables, pkts):
+        jfn = jax.jit(fn)
+        jax.block_until_ready(jfn(tables, pkts).tx_if)
+        ts = []
+        for _ in range(iters):
+            t1 = time.perf_counter()
+            r = jfn(tables, pkts)
+            jax.block_until_ready(r.tx_if)
+            ts.append(time.perf_counter() - t1)
+        n = int(pkts.dst_ip.shape[0])
+        return float(np.median(ts)) / n * 1e9
+
+    pkts = traffic(batch)
+    out["fib_lookup_lpm_ns_pkt"] = round(
+        time_lookup(fib_lookup_lpm, dp.tables, pkts), 1)
+
+    def dense_at(n_routes, dense_batch):
+        cfg = DataplaneConfig(
+            max_tables=2, max_rules=8, max_global_rules=8,
+            max_ifaces=16, fib_slots=n_routes + 4, sess_slots=64,
+            nat_mappings=1, nat_backends=1, fib_impl="dense")
+        d = Dataplane(cfg)
+        up = d.add_uplink()
+        k = min(n_routes, len(nets))
+        d.builder.add_routes_np(
+            nets[:k], plens[:k],
+            tx_if=np.full(k, up, np.int32),
+            disp=np.full(k, int(Disposition.REMOTE), np.int32))
+        d.builder.add_route("0.0.0.0/0", up, Disposition.REMOTE,
+                            slot=k)
+        d.swap()
+        return time_lookup(fib_lookup_dense, d.tables,
+                           traffic(dense_batch))
+
+    # native node scale: the seed-era FIB regime (tens of entries)
+    out["fib_lookup_dense_ns_pkt"] = round(dense_at(64, batch), 1)
+    # linear fit over two mid scales -> extrapolated 1M cost (the
+    # [P, F] hit matrix makes a direct 1M dense run unallocatable)
+    f1, f2 = 2048, 8192
+    n1 = dense_at(f1, 256)
+    n2 = dense_at(f2, 256)
+    out["fib_lookup_dense_mid_ns_pkt"] = round(n2, 1)
+    slope = max((n2 - n1) / (f2 - f1), 0.0)
+    extrap = n2 + slope * (routes - f2)
+    out["fib_lookup_dense_1m_ns_pkt_extrapolated"] = round(extrap, 1)
+    out["fib_lpm_speedup_vs_dense_1m"] = round(
+        extrap / max(out["fib_lookup_lpm_ns_pkt"], 1e-9), 1)
+    out["fib_lpm_vs_dense_native_x"] = round(
+        out["fib_lookup_lpm_ns_pkt"]
+        / max(out["fib_lookup_dense_ns_pkt"], 1e-9), 2)
+
+    # --- route churn: ONE /24 flap's commit cost + what it shipped ---
+    slot = int(np.nonzero(plens == 24)[0][0])
+    pfx = int(nets[slot])
+    pfx_s = (f"{pfx >> 24 & 255}.{pfx >> 16 & 255}."
+             f"{pfx >> 8 & 255}.{pfx & 255}/24")
+    t1 = time.perf_counter()
+    dp.builder.del_route(pfx_s)
+    dp.builder.add_route(pfx_s, uplink, Disposition.REMOTE, slot=slot,
+                         node_id=1)
+    dp.swap()
+    out["fib_churn_swap_ms"] = round(
+        (time.perf_counter() - t1) * 1e3, 2)
+    up = dp.builder.fib_upload
+    out["fib_churn_commit_ms"] = round(float(up.get("ms", 0.0)), 2)
+    out["fib_churn_bytes"] = int(up.get("bytes", 0))
+    out["fib_churn_planes"] = sum(
+        1 for f in up.get("fields", ()) if f.startswith("fib_lpm_p"))
+    out["fib_churn_blob_bytes"] = int(up.get("blob_bytes", 0))
+
+    # --- ECMP spread over the 8-member group (hashed distinct flows) --
+    r3 = np.random.default_rng(18)
+    n = 4096
+    epkts = PacketVector(
+        src_ip=jnp.asarray(r3.integers(0, 1 << 32, n)
+                           .astype(np.uint32)),
+        dst_ip=jnp.asarray((np.uint32(ip4("230.77.0.0"))
+                            | r3.integers(0, 128, n)
+                            .astype(np.uint32))),
+        proto=jnp.full((n,), 6, jnp.int32),
+        sport=jnp.asarray(r3.integers(1024, 65000, n)
+                          .astype(np.int32)),
+        dport=jnp.full((n,), 443, jnp.int32),
+        ttl=jnp.full((n,), 64, jnp.int32),
+        pkt_len=jnp.full((n,), 512, jnp.int32),
+        rx_if=jnp.full((n,), uplink, jnp.int32),
+        flags=jnp.full((n,), FLAG_VALID, jnp.int32),
+    )
+    res = jax.jit(fib_lookup_lpm)(dp.tables, epkts)
+    on_grp = np.asarray(res.grp) >= 0
+    nh = np.asarray(res.next_hop)[on_grp].astype(np.int64)
+    shares = np.bincount(nh - nh.min(), minlength=8)
+    shares = np.sort(shares[shares > 0])
+    out["fib_ecmp_members_hit"] = int(len(shares))
+    out["fib_ecmp_spread_pct"] = round(
+        100.0 * float(shares[0]) / max(float(shares[-1]), 1.0), 1)
+    return out
+
+
 def fastpath_bench(args, iters: int = 12, batch: int = 2048) -> dict:
     """Two-tier fast path (ISSUE 3 tentpole): the classify-free
     established-flow kernel vs the full fused chain on an IDENTICAL
@@ -3338,6 +3574,19 @@ def _run():
     _jc = _jc_now
     _progress(**pri)
     try:
+        # million-route LPM FIB (ISSUE 15): 1M-route build, LPM vs
+        # dense lookup ns/pkt (+ the dense-at-1M extrapolation), one
+        # /24 flap's bounded commit, ECMP member spread — acceptance:
+        # lpm <= 2x dense-at-native, >= 10x dense-extrapolated-to-1M,
+        # churn ships only the touched length plane
+        pri.update(fib_bench(args))
+    except Exception as e:  # noqa: BLE001
+        pri["fib_bench_error"] = f"{type(e).__name__}: {e}"
+    _jc_now = _jit_compiles_now()
+    pri["fib_jit_compiles"] = _jc_now - _jc
+    _jc = _jc_now
+    _progress(**pri)
+    try:
         # tentpole capture: the two-tier fast path's measured win at
         # the headline rule count (acceptance: >= 3x on all-established)
         pri.update(fastpath_bench(args))
@@ -3419,8 +3668,11 @@ def _run():
     dp, uplink = build_dataplane(args.rules, args.backends)
     # headline runs whatever the deployed dataplane selected (the
     # classifier: auto ladder — BV at the 10k regime, re-validated by
-    # the acl_classifier_* shoot-out above)
-    step_fn = make_pipeline_step(dp.classifier_impl, dp._skip_local)
+    # the acl_classifier_* shoot-out above — AND the fib_impl ladder,
+    # dense at the headline's node-scale FIB; fib_bench above carries
+    # the million-route LPM rows)
+    step_fn = make_pipeline_step(dp.classifier_impl, dp._skip_local,
+                                 fib_impl=dp.fib_impl)
     step = jax.jit(step_fn, donate_argnums=(0,))
 
     # --- throughput: K chained steps, sessions threaded through ---
@@ -3440,6 +3692,7 @@ def _run():
     mpps = args.packets * args.iters / dt / 1e6
     _progress(headline_mpps=round(mpps, 3), rules=args.rules,
               packets_per_step=args.packets, iters=args.iters,
+              headline_fib_impl=dp.fib_impl,
               headline_jit_compiles=_jit_compiles_now() - _jc,
               jit_compiles_total=_jit_compiles_now())
 

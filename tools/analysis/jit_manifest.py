@@ -42,6 +42,10 @@ JIT_SITES = {
     ("vpp_tpu/pipeline/tables.py", "_glb_update_fn"):
         "incremental glb-blob upload kernel; memoized per (w_r, w_c, "
         "planes) block geometry",
+    ("vpp_tpu/pipeline/tables.py", "_fib_update_fn"):
+        "incremental per-slot FIB blob scatter (ISSUE 15): a route "
+        "flap at the 1M-route regime ships a few-KB blob instead of "
+        "9 full columns; memoized per block width",
     ("vpp_tpu/parallel/cluster.py", "make_cluster_step"):
         "the SPMD cluster step (shard_map over the node mesh); built "
         "once per mesh by ClusterDataplane",
@@ -115,6 +119,14 @@ TRACED_ROOTS = {
     ("vpp_tpu/ops/telemetry.py", "lat_bucket"),
     ("vpp_tpu/ops/telemetry.py", "sketch_cols"),
     ("vpp_tpu/ops/telemetry.py", "pack_tel_rider"),
+    # the LPM FIB + shared resolver (ISSUE 15): reached through the
+    # step factory's _fib_fn indirection (the _classifier_fns twin),
+    # so the reachability closure needs them named explicitly
+    ("vpp_tpu/ops/lpm.py", "fib_lookup_lpm"),
+    ("vpp_tpu/ops/fib.py", "fib_lookup_dense"),
+    ("vpp_tpu/ops/fib.py", "resolve_fib_slot"),
+    ("vpp_tpu/ops/fib.py", "fib_flow_mix"),
+    ("vpp_tpu/ops/fib.py", "ip4_lookup"),
     # classifier implementations reach jit through _classifier_fns /
     # time_classifier's subscripted call — enumerate them explicitly
     ("vpp_tpu/ops/acl.py", "acl_classify_global"),

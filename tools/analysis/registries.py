@@ -225,6 +225,118 @@ def _bv_plane_problems(name: str, bv, nrules: int, max_rules: int) -> list:
     return problems
 
 
+def _lpm_plane_problems() -> list:
+    """LPM/ECMP structure invariants (ISSUE 15): stage a
+    representative FIB (duplicate prefixes, /0 + /32 edges, an ECMP
+    group) and validate the compiled per-length planes — strict sort
+    within each plane's live prefix, pad inertness past the live
+    count, count/cap consistency, and group membership (every way of
+    a live group carries one of its registered members; unregistered
+    rows are fully zeroed)."""
+    _repo_on_path()
+    import numpy as np
+
+    from vpp_tpu.ops.lpm import LPM_LENGTHS, LPM_PAD, lpm_field
+    from vpp_tpu.pipeline.tables import DataplaneConfig, TableBuilder
+    from vpp_tpu.pipeline.vector import Disposition
+
+    problems = []
+    b = TableBuilder(DataplaneConfig(
+        max_tables=2, max_rules=8, max_global_rules=8, max_ifaces=8,
+        fib_slots=64, sess_slots=64, nat_mappings=2, nat_backends=4,
+        fib_impl="lpm", fib_ecmp_groups=4, fib_ecmp_ways=4))
+    b.add_route("0.0.0.0/0", 1, Disposition.REMOTE, node_id=1)
+    b.add_route("255.255.255.255/32", 2, Disposition.LOCAL)
+    b.add_route("10.1.1.0/24", 3, Disposition.LOCAL)
+    b.add_route("10.1.2.0/24", 3, Disposition.LOCAL)
+    b.add_route("10.1.1.0/24", 4, Disposition.LOCAL)   # duplicate
+    b.set_nh_group(1, [(101, 5, -1), (102, 6, 2)])
+    b.add_route("10.9.0.0/16", 5, Disposition.REMOTE, group=1)
+    b.del_route("10.1.2.0/24")
+    b._restage_lpm()
+    if not b.lpm_ok():
+        problems.append("tables: representative LPM table not lpm_ok")
+    for length in range(LPM_LENGTHS):
+        plane = b.lpm_planes[lpm_field(length)]
+        n = int(b.lpm_cnt[length])
+        cap = plane.shape[1]
+        if n > cap:
+            problems.append(
+                f"tables: lpm /{length} count {n} exceeds cap {cap}")
+            continue
+        live = plane[0, :n].astype(np.int64)
+        if n > 1 and not (np.diff(live) > 0).all():
+            problems.append(
+                f"tables: lpm /{length} prefixes not STRICTLY sorted "
+                "(duplicates must dedupe to the lowest slot)")
+        if (plane[0, n:] != LPM_PAD).any() or (plane[1, n:] != 0).any():
+            problems.append(
+                f"tables: lpm /{length} pad rows past count {n} are "
+                "not inert")
+        slots = plane[1, :n].astype(np.int64)
+        if n and ((slots < 0) | (slots >= b.config.fib_slots)).any():
+            problems.append(
+                f"tables: lpm /{length} slot row out of range")
+        elif n and (b.fib_plen[slots] != length).any():
+            problems.append(
+                f"tables: lpm /{length} slot row points at a route of "
+                "another length")
+    # stride hint tables: per length, monotone non-decreasing rows
+    # bracketing [0, count] (a misordered hint silently corrupts the
+    # bounded bisection)
+    from vpp_tpu.ops.lpm import lpm_hint_layout
+
+    layout, hint_rows = lpm_hint_layout(b.lpm_caps)
+    if len(b.lpm_hint) != hint_rows:
+        problems.append(
+            f"tables: lpm hint rows {len(b.lpm_hint)} != layout "
+            f"{hint_rows}")
+    else:
+        for length in range(LPM_LENGTHS):
+            bb, off, _steps = layout[length]
+            if off < 0:
+                continue
+            h = b.lpm_hint[off:off + (1 << bb) + 1]
+            if (np.diff(h) < 0).any() or h[0] != 0 \
+                    or h[-1] != int(b.lpm_cnt[length]):
+                problems.append(
+                    f"tables: lpm /{length} hint rows not a monotone "
+                    "[0, count] bracket")
+    # the duplicate 10.1.1.0/24 must resolve to the LOWER slot (the
+    # dense argmax tie-break)
+    p24 = b.lpm_planes[lpm_field(24)]
+    n24 = int(b.lpm_cnt[24])
+    dup = p24[1, :n24][p24[0, :n24] == (10 << 24 | 1 << 16 | 1 << 8)]
+    if len(dup) != 1 or int(b.fib_tx_if[int(dup[0])]) != 3:
+        problems.append(
+            "tables: lpm duplicate-prefix dedupe does not keep the "
+            "lowest slot")
+    # group membership
+    registered = set(b.nh_groups)
+    grp_vals = set(int(g) for g in np.unique(b.fib_grp) if g >= 0)
+    if not grp_vals <= registered:
+        problems.append(
+            f"tables: routes reference unregistered ECMP group(s) "
+            f"{sorted(grp_vals - registered)}")
+    for gid in range(b.fib_grp_nh.shape[0]):
+        if gid in registered:
+            members = set(tuple(m) for m in b.nh_groups[gid]["members"])
+            rows = set(zip(b.fib_grp_nh[gid].tolist(),
+                           b.fib_grp_tx_if[gid].tolist(),
+                           b.fib_grp_node[gid].tolist()))
+            if not rows <= members:
+                problems.append(
+                    f"tables: ecmp group {gid} ways carry non-member "
+                    "entries")
+            if int(b.fib_grp_n[gid]) != len(members):
+                problems.append(
+                    f"tables: ecmp group {gid} member count desynced")
+        elif (int(b.fib_grp_n[gid]) != 0 or b.fib_grp_nh[gid].any()):
+            problems.append(
+                f"tables: unregistered ecmp group {gid} row not zeroed")
+    return problems
+
+
 def tables_lint() -> list:
     """Table-structure invariant pass (`--tables`): commit a
     representative rule set through a BV-enabled TableBuilder and
@@ -279,6 +391,7 @@ def tables_lint() -> list:
         )
         problems += _bv_plane_problems(f"local[{slot}]", local, nrules,
                                        cfg.max_rules)
+    problems += _lpm_plane_problems()
     # cross-implementation capacity constants
     for r in (cfg.max_rules, cfg.max_global_rules, 1024, 10240):
         ib, w, _pr = bv_capacity(r, True)

@@ -57,7 +57,6 @@ class DebugCLI:
             ("show", "mesh"): self.show_mesh,
             ("show", "partitions"): self.show_partitions,
             ("show", "nat44"): self.show_nat44,
-            ("show", "fib"): self.show_fib,
             ("show", "trace"): self.show_trace,
             ("show", "errors"): self.show_errors,
             ("show", "fastpath"): self.show_fastpath,
@@ -75,6 +74,8 @@ class DebugCLI:
         for sig, fn in handlers.items():
             if tuple(parts[: len(sig)]) == sig:
                 return fn()
+        if tuple(parts[:2]) == ("show", "fib"):
+            return self.show_fib(parts[2:])
         if tuple(parts[:2]) == ("show", "config-history"):
             return self.show_config_history(parts[2:])
         if tuple(parts[:2]) == ("show", "spans"):
@@ -474,40 +475,114 @@ class DebugCLI:
             lines.append(f"nat sessions: {n}")
         return "\n".join(lines)
 
-    def show_fib(self) -> str:
-        b = self.dp.builder
-        plen = np.asarray(b.fib_plen)
-        lines = []
-        for i in np.nonzero(plen >= 0)[0]:
-            i = int(i)
-            disp = Disposition(int(b.fib_disp[i])).name.lower()
-            extra = ""
-            if int(b.fib_node_id[i]) >= 0:
-                extra = f" node {int(b.fib_node_id[i])}"
-            if int(b.fib_next_hop[i]):
-                extra += f" via {ip4_str(int(b.fib_next_hop[i]))}"
+    # route rows rendered without a prefix filter before the page
+    # demands one — a 1M-route FIB must never be formatted slot by
+    # slot in Python (the ISSUE 15 satellite; `show fib <prefix>`
+    # narrows)
+    FIB_LIST_MAX = 256
+
+    def show_fib(self, args: Optional[List[str]] = None) -> str:
+        """Summary-first FIB page (ISSUE 15): impl/ladder state, route
+        histogram by prefix length, ECMP groups with per-member
+        forwarded packets, plane bytes and the last churn upload —
+        host scalars, no per-slot Python loop. Full route rows render
+        only for small tables or under a prefix filter
+        (``show fib <prefix[/len]>``: routes covering or covered by
+        it), matched with one vectorized NumPy pass."""
+        dp = self.dp
+        b = dp.builder
+        snap = dp.fib_snapshot()
+        by_len = " ".join(f"/{L}:{n}"
+                          for L, n in sorted(snap["by_length"].items()))
+        lines = [
+            "FIB: impl {} (knob {}{}), routes {}, plane bytes {}".format(
+                snap["impl"], snap["knob"],
+                "" if snap["lpm_ok"] else ", lpm ineligible",
+                snap["routes"], snap["plane_bytes"]),
+            f"routes by length: {by_len or '(none)'}",
+        ]
+        up = snap.get("upload") or {}
+        if up:
             lines.append(
-                f"  {ip4_str(int(b.fib_prefix[i]))}/{int(plen[i])} "
-                f"-> if {int(b.fib_tx_if[i])} [{disp}]{extra}"
-            )
-        return "\n".join(sorted(lines)) or "empty FIB"
+                "last churn: {:.2f} ms, {} B ({} fields + {} B "
+                "slot blob)".format(
+                    float(up.get("ms", 0.0)), int(up.get("bytes", 0)),
+                    len(up.get("fields", ())),
+                    int(up.get("blob_bytes", 0))))
+        for gid, members in sorted(snap["ecmp_groups"].items()):
+            lines.append(f"ecmp group {gid}: {len(members)} members")
+            for m in members:
+                lines.append(
+                    f"  via {ip4_str(m['nh'])} if {m['tx_if']} "
+                    f"node {m['node']} ways {len(m['ways'])} "
+                    f"pkts {m['pkts']}")
+        plen = np.asarray(b.fib_plen)
+        live = plen >= 0
+        want = None
+        if args:
+            try:
+                import ipaddress as _ipaddress
+
+                net = _ipaddress.ip_network(args[0], strict=False)
+            except ValueError as e:
+                return f"bad prefix filter: {e}"
+            qlen = net.prefixlen
+            qmask = np.uint32(
+                ((1 << 32) - 1) ^ ((1 << (32 - qlen)) - 1) if qlen else 0)
+            qnet = np.uint32(int(net.network_address)) & qmask
+            pfx = np.asarray(b.fib_prefix)
+            msk = np.asarray(b.fib_mask)
+            # route covers the query, or the query covers the route —
+            # one vectorized pass, never a per-slot Python loop
+            covers = (qnet & msk) == pfx
+            inside = (pfx & qmask) == qnet
+            want = live & (covers | inside)
+        elif int(live.sum()) <= self.FIB_LIST_MAX:
+            want = live
+        else:
+            lines.append(
+                f"({int(live.sum())} routes — pass a prefix filter: "
+                f"show fib <prefix[/len]>)")
+        if want is not None:
+            rows = []
+            idx = np.nonzero(want)[0]
+            shown = idx[:self.FIB_LIST_MAX]
+            for i in shown:
+                i = int(i)
+                disp = Disposition(int(b.fib_disp[i])).name.lower()
+                extra = ""
+                if int(b.fib_grp[i]) >= 0:
+                    extra = f" ecmp-group {int(b.fib_grp[i])}"
+                if int(b.fib_node_id[i]) >= 0:
+                    extra += f" node {int(b.fib_node_id[i])}"
+                if int(b.fib_next_hop[i]):
+                    extra += f" via {ip4_str(int(b.fib_next_hop[i]))}"
+                rows.append(
+                    f"  {ip4_str(int(b.fib_prefix[i]))}/{int(plen[i])} "
+                    f"-> if {int(b.fib_tx_if[i])} [{disp}]{extra}"
+                )
+            lines.extend(sorted(rows))
+            if len(idx) > len(shown):
+                lines.append(f"  ... {len(idx) - len(shown)} more "
+                             f"(narrow the filter)")
+        return "\n".join(lines)
 
     def _resolve_rx_if(self, src_ip: int):
         """Longest-prefix FIB match for ``src_ip`` with a LOCAL
         disposition → that pod's interface is where its traffic enters
-        the vswitch (the reference's per-pod rx interface)."""
+        the vswitch (the reference's per-pod rx interface). One
+        vectorized NumPy pass — the old per-slot Python loop walked
+        every slot, unusable at the 1M-route regime (ISSUE 15)."""
         b = self.dp.builder
         plen = np.asarray(b.fib_plen)
-        best, best_len = None, -1
-        for i in np.nonzero(plen >= 0)[0]:
-            i = int(i)
-            length = int(plen[i])
-            mask = int(b.fib_mask[i])  # pre-masked by add_route
-            if (src_ip & mask) == int(b.fib_prefix[i]) and \
-                    length > best_len and \
-                    int(b.fib_disp[i]) == int(Disposition.LOCAL):
-                best, best_len = int(b.fib_tx_if[i]), length
-        return best
+        hit = ((np.uint32(src_ip) & np.asarray(b.fib_mask))
+               == np.asarray(b.fib_prefix))
+        cand = (plen >= 0) & hit & \
+            (np.asarray(b.fib_disp) == int(Disposition.LOCAL))
+        if not cand.any():
+            return None
+        best = int(np.argmax(np.where(cand, plen, -1)))
+        return int(b.fib_tx_if[best])
 
     def test_connectivity(self, args: list) -> str:
         """One-shot connectivity probe — the robot-suite ping/TCP checks

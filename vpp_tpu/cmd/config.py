@@ -254,6 +254,20 @@ class AgentConfig:
     #                            overestimate bound ~ e·N/w with
     #                            failure probability e^-d)
     #   ``dataplane.telemetry_topk``  heavy-hitter candidate slots
+    # + the FIB lookup implementation (docs/ROUTING.md; ISSUE 15):
+    #   ``dataplane.fib_impl``   dense | lpm | auto — auto engages the
+    #                            per-length LPM planes at
+    #                            ``fib_lpm_min_routes`` staged routes
+    #                            (re-gated at every swap; an
+    #                            ineligible table falls back to dense)
+    #   ``dataplane.fib_lpm_plen_caps``  per-length plane capacities
+    #                            (index = prefix length; empty = every
+    #                            length sized to fib_slots — set the
+    #                            feed's length histogram at BGP scale)
+    #   ``dataplane.fib_lpm_mem_mb``     auto-allocation memory gate
+    #   ``dataplane.fib_ecmp_groups``/``fib_ecmp_ways``  ECMP next-hop
+    #                            group slots / member ways per group
+    #                            (power of two — flow-hash member pick)
     # All validated at load with the session-table knobs.
     dataplane: DataplaneConfig = dataclasses.field(default_factory=DataplaneConfig)
     # multi-tenant gateway mode (ISSUE 14; vpp_tpu/tenancy/,

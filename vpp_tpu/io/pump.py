@@ -1276,6 +1276,7 @@ class DataplanePump:
         than whatever dp.tables holds (the per-dispatch path commits
         per batch; this is the same continuity, paid at loop exit)."""
         from vpp_tpu.pipeline.tables import (
+            FIB_STATE_FIELDS,
             SESSION_FIELDS,
             TELEMETRY_FIELDS,
             TENANCY_STATE_FIELDS,
@@ -1296,13 +1297,13 @@ class DataplanePump:
             self._ring_stats_sync()
         if final is None:
             return
-        # session state, the telemetry planes (ISSUE 11) AND the
-        # tenancy state (token buckets + per-tenant counters, ISSUE
-        # 14) graft back: all rode the ring's private carry, so by
-        # stop time they are newer than whatever dp.tables holds
+        # session state, the telemetry planes (ISSUE 11), the tenancy
+        # state (ISSUE 14) AND the ECMP accounting plane (ISSUE 15)
+        # graft back: all rode the ring's private carry, so by stop
+        # time they are newer than whatever dp.tables holds
         sess = {f: getattr(final, f)
                 for f in (*SESSION_FIELDS, *TELEMETRY_FIELDS,
-                          *TENANCY_STATE_FIELDS)}
+                          *TENANCY_STATE_FIELDS, *FIB_STATE_FIELDS)}
         with self.dp._lock:
             if self.dp.tables is not None:
                 # DataplaneTables is a NamedTuple pytree, not a dataclass

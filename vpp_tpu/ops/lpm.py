@@ -1,0 +1,267 @@
+"""Million-route LPM: binary-search-over-prefix-lengths ip4-lookup.
+
+The routing analogue of the BV classifier (ops/acl_bv.py; ISSUE 15):
+instead of VPP's pointer-chasing mtrie — which a TPU cannot win on —
+the FIB compiles into PER-PREFIX-LENGTH SORTED PREFIX PLANES and the
+device lookup is one binary search per populated length:
+
+    for L in populated lengths, longest first:
+        m   = dst & mask(L)                       # constant mask
+        i   = searchsorted(plane_L.prefixes, m)   # log2(N_L) compares
+        hit = plane_L.prefixes[i] == m            # exact-match gather
+        first hit wins (lengths walk longest -> shortest)
+
+— the Waldvogel binary-search-on-prefix-lengths family, flattened for
+a vector machine: every packet of the batch walks every populated
+length (SPMD — no data-dependent early exit), so the cost is
+O(P * lengths * log N) against the dense compare's O(P * F). At a
+1M-route BGP feed with ~20 populated lengths that is ~400 fused
+compare/gather lanes per packet versus 1,000,000 — and the dense
+[P, F] hit matrix (8 GB at a 2048 batch) never materializes.
+
+Shapes are CONFIG-static (the jit contract): each length's plane
+capacity comes from ``dataplane.fib_lpm_plen_caps`` (default: every
+length sized to ``fib_slots``), and a length whose cap is 0 gets a
+zero-width plane the step factory SKIPS AT TRACE TIME — the
+"config-static populated-length tuple" of ISSUE 15. Route churn never
+retraces: only device VALUES (plane contents, counts) move per epoch.
+A staged table that does not fit its planes (a length over its cap)
+makes ``TableBuilder.lpm_ok()`` false and the selection ladder falls
+back to dense — the BV ``ok=False`` degradation pattern, loudly
+observable via ``show fib`` / ``vpp_tpu_fib_impl``.
+
+Each plane is one ``[2, N_L]`` uint32 field of DataplaneTables
+(``fib_lpm_p{L}``): row 0 the sorted masked prefixes (pad 0xFFFFFFFF
+— sorts at/after every real value), row 1 the owning FIB slot. Route
+DATA stays in the per-slot columns: both implementations resolve
+through the ONE shared ``ops.fib.resolve_fib_slot`` (ECMP groups
+included), so dense and LPM are bit-exact by construction. Keeping
+planes per-length — separate pytree fields, not one [33, N] matrix —
+is what makes route churn cheap: a BGP flap re-ships ONLY the touched
+length's plane (+ the count vector and a small per-slot scatter blob),
+every other plane keeps its device-array identity
+(pipeline/tables.py ``_fib_dirty`` / ``_fib_incremental``).
+
+Memory: sum over lengths of ``2 * cap_L * 4`` bytes (+ 132 B of
+counts). The default per-length cap of ``fib_slots`` costs
+``33 * 8 * fib_slots`` bytes — fine at node scale (33 KB at 128
+slots), deliberately gated by ``fib_lpm_mem_mb`` at internet scale,
+where the operator sets ``fib_lpm_plen_caps`` to the feed's real
+length distribution (docs/ROUTING.md has the formula and a worked
+1M-route example).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+# IPv4 prefix lengths /0 .. /32 — one plane each.
+LPM_LENGTHS = 33
+
+# pre-masked network masks per length (Python ints, trace-time consts)
+_ADDR_MAX = (1 << 32) - 1
+LPM_MASKS: Tuple[int, ...] = tuple(
+    (_ADDR_MAX ^ ((1 << (32 - L)) - 1)) if L else 0
+    for L in range(LPM_LENGTHS)
+)
+
+# plane pad value: sorts at/after every real prefix (a REAL 0xFFFFFFFF
+# /32 entry still resolves — searchsorted-left lands on the live copy
+# first, and the count guard rejects pure-pad hits)
+LPM_PAD = _ADDR_MAX
+
+# Stride-table accelerator (the ROADMAP item-5 "per-/8 stride tables",
+# generalized per length): each populated length gets a direct hint
+# table indexed by the query's top ``b = min(L, LPM_HINT_BITS,
+# bit_length(cap))`` bits, bounding the binary search to ONE bucket.
+# The bucket size is STRUCTURAL — at most 2^(L-b) distinct prefixes of
+# length L share b top bits (the staging dedupe guarantees distinct) —
+# so the per-length step count is config-static and never depends on
+# staged routes. A module constant, not a knob: the layout must be
+# recoverable from the table SHAPES alone (the kernel sees only the
+# tables pytree), and the memory cost is bounded by the caps it is
+# derived from (~4 bytes per hint row; ~2.3 MB at the 1M-route bench
+# shape, nothing at the default 128-slot FIB).
+LPM_HINT_BITS = 16
+
+# Planes below this capacity skip the hint layer entirely and search
+# with one fused ``searchsorted``: at small N the flat binary search
+# is already a handful of cache-resident probes, while the unrolled
+# bounded bisection costs ~50 HLO ops per length at COMPILE time —
+# a default config populates all 33 lengths, and fattening every step
+# variant's program for planes the hint cannot speed up measurably
+# slowed the whole test tier (compile-time, not run-time).
+LPM_HINT_MIN = 8192
+
+
+def lpm_hint_layout(caps) -> Tuple[Tuple[Tuple[int, int, int], ...], int]:
+    """((b_bits, hint_offset, search_steps) per length, total hint
+    rows). Offset -1 = no hint (length unpopulated, or /0 — a single
+    possible prefix needs no search at all). Pure function of the
+    capacity vector, so builder staging and the device kernel derive
+    the SAME layout from config and shapes respectively."""
+    rows = []
+    off = 0
+    for length in range(LPM_LENGTHS):
+        cap = caps[length]
+        # jax-ok: caps are Python ints (config knob values or array
+        # SHAPES) — the layout is trace-time static by construction
+        if cap < LPM_HINT_MIN or length == 0:
+            rows.append((0, -1, 0))
+            continue
+        b = min(length, LPM_HINT_BITS, max(1, (cap - 1).bit_length()))
+        bucket = min(cap, 1 << (length - b))
+        rows.append((b, off, (bucket - 1).bit_length()))
+        off += (1 << b) + 1
+    return tuple(rows), off
+
+
+def lpm_field(length: int) -> str:
+    """DataplaneTables field name of one length's prefix plane."""
+    return f"fib_lpm_p{length}"
+
+
+LPM_FIELDS: Tuple[str, ...] = tuple(lpm_field(L) for L in range(LPM_LENGTHS))
+
+
+def lpm_len_caps(config) -> Tuple[int, ...]:
+    """Per-length plane capacities [33] of one config. Disabled
+    configs (knob dense, or the worst-case structure busts
+    ``fib_lpm_mem_mb``) carry all-zero caps — every plane is a
+    zero-width placeholder and the LPM kernels compile to an
+    unconditional miss (never selected; the BV placeholder pattern)."""
+    if not lpm_enabled_for(config):
+        return (0,) * LPM_LENGTHS
+    return _raw_len_caps(config)
+
+
+def _raw_len_caps(config) -> Tuple[int, ...]:
+    """The knob's capacity vector before the enable gate: explicit
+    ``fib_lpm_plen_caps`` entries (index = prefix length, missing
+    tail = 0), or every length sized to ``fib_slots``."""
+    caps = tuple(getattr(config, "fib_lpm_plen_caps", ()) or ())
+    if caps:
+        caps = tuple(int(c) for c in caps)[:LPM_LENGTHS]
+        return caps + (0,) * (LPM_LENGTHS - len(caps))
+    return (int(config.fib_slots),) * LPM_LENGTHS
+
+
+def lpm_plane_bytes(config) -> int:
+    """Device bytes of the full LPM structure under this config's
+    capacity vector (the ``fib_lpm_mem_mb`` gate's input and the
+    ``vpp_tpu_fib_plane_bytes`` gauge): 2 uint32 rows per slot per
+    plane + the stride hint tables + the count vector."""
+    caps = _raw_len_caps(config)
+    _rows, hint = lpm_hint_layout(caps)
+    return sum(2 * 4 * c for c in caps) + 4 * hint + 4 * LPM_LENGTHS
+
+
+def lpm_enabled_for(config) -> bool:
+    """Whether this config allocates (and commit-time builds) the LPM
+    planes: explicit ``fib_impl: lpm`` always; ``auto`` only when the
+    worst-case structure fits ``fib_lpm_mem_mb`` (the
+    ``bv_enabled_for`` discipline)."""
+    knob = getattr(config, "fib_impl", "auto")
+    if knob == "lpm":
+        return True
+    if knob != "auto":
+        return False
+    cap_mb = int(getattr(config, "fib_lpm_mem_mb", 256))
+    return lpm_plane_bytes(config) <= cap_mb * (1 << 20)
+
+
+def populated_lengths(config) -> Tuple[int, ...]:
+    """The config-static populated-length tuple, longest first — the
+    lengths the compiled LPM kernel searches. Derived from capacities
+    (cap 0 = plane absent), NEVER from staged routes: churn moves
+    device values only, so the step program never retraces."""
+    caps = lpm_len_caps(config)
+    return tuple(L for L in range(LPM_LENGTHS - 1, -1, -1) if caps[L] > 0)
+
+
+def ecmp_capacity(config) -> Tuple[int, int]:
+    """(groups G, ways W) of the ECMP member tables. Groups 0 (the
+    default) carries [1, 1] placeholders — no route can reference a
+    group (TableBuilder refuses set_nh_group), the resolver's group
+    branch stays compiled but dead."""
+    g = int(getattr(config, "fib_ecmp_groups", 0))
+    if g <= 0:
+        return 1, 1
+    return g, int(getattr(config, "fib_ecmp_ways", 8))
+
+
+# --- device kernel -----------------------------------------------------
+
+
+def fib_lookup_lpm(tables, pkts):
+    """The LPM ip4-lookup (the ``fib_fn`` composed for
+    ``fib_impl: lpm`` — pipeline/graph.py), returning the same
+    ``FibResult`` as the dense path through the same shared resolver.
+
+    The Python loop below is TRACE-TIME: it unrolls over the
+    config-static populated lengths (zero-width planes skipped by
+    shape — no tracer branching), longest first so the first hit IS
+    the longest match. Ties inside a length are impossible (one masked
+    prefix per length after staging dedupe), and duplicate staged
+    prefixes keep the lowest slot — the dense argmax semantics.
+
+    Each per-length search goes through the stride hint table
+    (``fib_lpm_hint``; layout recovered from the plane SHAPES): two
+    hint gathers bound the bisection to one top-bits bucket, so the
+    unrolled step count per length is the STRUCTURAL bucket bound
+    (config-static), not log2 of the whole plane — at a BGP-shaped 1M
+    table that is ~4x fewer probe gathers than a flat searchsorted
+    per length. A hint field whose shape disagrees with the derived
+    layout (hand-built tables) falls back to the flat search."""
+    from vpp_tpu.ops.fib import fib_flow_mix, resolve_fib_slot
+
+    dst = pkts.dst_ip
+    slot = jnp.zeros(dst.shape, jnp.int32)
+    found = jnp.zeros(dst.shape, bool)
+    cnt = tables.fib_lpm_cnt
+    caps = tuple(getattr(tables, lpm_field(L)).shape[1]
+                 for L in range(LPM_LENGTHS))
+    layout, hint_rows = lpm_hint_layout(caps)
+    hint = tables.fib_lpm_hint
+    # jax-ok: shape compare — trace-time static, not a tracer branch
+    use_hint = hint.shape[0] == hint_rows and hint_rows > 0
+    for L in range(LPM_LENGTHS - 1, -1, -1):
+        plane = getattr(tables, lpm_field(L))
+        # jax-ok: plane width is a trace-time-static SHAPE (the
+        # config-static populated-length tuple), not a tracer branch
+        if plane.shape[1] == 0:
+            continue
+        pfx = plane[0]
+        top = plane.shape[1] - 1
+        if L == 0:
+            # one possible prefix (0/0): a populated plane matches all
+            hit = jnp.broadcast_to(cnt[0] > 0, dst.shape)
+            take = hit & ~found
+            slot = jnp.where(take, plane[1][0].astype(jnp.int32), slot)
+            found = found | hit
+            continue
+        m = dst & jnp.uint32(LPM_MASKS[L])
+        b, off, steps = layout[L]
+        # jax-ok: layout is derived from shapes — trace-time static
+        if use_hint and off >= 0:
+            t = (m >> (32 - b)).astype(jnp.int32)
+            lo = hint[off + t]
+            hi = hint[off + t + 1]
+            for _ in range(steps):
+                mid = (lo + hi) >> 1
+                p = pfx[jnp.clip(mid, 0, top)]
+                less = p < m
+                active = lo < hi
+                lo = jnp.where(active & less, mid + 1, lo)
+                hi = jnp.where(active & ~less, mid, hi)
+            i = lo
+        else:
+            i = jnp.searchsorted(pfx, m, side="left").astype(jnp.int32)
+        ic = jnp.clip(i, 0, top)
+        hit = (pfx[ic] == m) & (i < cnt[L])
+        take = hit & ~found
+        slot = jnp.where(take, plane[1][ic].astype(jnp.int32), slot)
+        found = found | hit
+    return resolve_fib_slot(tables, slot, found, fib_flow_mix(pkts))

@@ -59,11 +59,13 @@ from vpp_tpu.pipeline.graph import (
 )
 from vpp_tpu.pipeline.tables import (
     _UPLOAD_GROUPS,
+    FIB_STATE_FIELDS,
     SESSION_FIELDS,
     TELEMETRY_FIELDS,
     TENANCY_STATE_FIELDS,
     DataplaneConfig,
     DataplaneTables,
+    zero_fib_state,
     zero_sessions,
     zero_telemetry,
     zero_tenancy_state,
@@ -704,15 +706,28 @@ class ClusterDataplane:
             # upload's host-side half.
             dirty_groups = set()
             bv_dirty_fields = set()
+            fib_dirty_fields = set()
             for n in self.nodes:
+                # settle lazy LPM staging BEFORE reading dirt: the
+                # restage is what names the rebuilt length planes
+                n.builder._restage_lpm()
                 dirty_groups |= n.builder._dirty
                 bv_dirty_fields |= n.builder._bv_dirty
+                fib_dirty_fields |= n.builder._fib_dirty
             need = set()
             for group, fields in _UPLOAD_GROUPS.items():
                 dirty = group in dirty_groups
                 for k in fields:
                     if group == "glb_bv":
                         if (dirty and k in bv_dirty_fields) \
+                                or k not in self._dev_cache:
+                            need.add(k)
+                    elif group == "fib":
+                        # per-field granularity (the glb_bv pattern):
+                        # a route flap on one node re-ships its touched
+                        # length plane + the per-slot rows, never all
+                        # 33 planes (ISSUE 15)
+                        if (dirty and k in fib_dirty_fields) \
                                 or k not in self._dev_cache:
                             need.add(k)
                     elif dirty or k not in self._dev_cache:
@@ -776,12 +791,15 @@ class ClusterDataplane:
             for n in self.nodes:
                 n.builder._dirty.clear()
                 n.builder._bv_dirty.clear()
+                n.builder._fib_dirty.clear()
             if self.tables is not None:
                 sess = {f: getattr(self.tables, f) for f in SESSION_FIELDS}
                 tel = {f: getattr(self.tables, f)
                        for f in TELEMETRY_FIELDS}
                 tnt = {f: getattr(self.tables, f)
                        for f in TENANCY_STATE_FIELDS}
+                fib_st = {f: getattr(self.tables, f)
+                          for f in FIB_STATE_FIELDS}
             else:
                 zs = zero_sessions(self.config, leading=(self.n_nodes,))
                 sess = {
@@ -806,8 +824,17 @@ class ClusterDataplane:
                     f: jax.device_put(v, shardings[f])
                     for f, v in ztn.items()
                 }
+                # per-member ECMP accounting plane (ISSUE 15):
+                # node-stacked zeros, replicated along the rule axis
+                zf = zero_fib_state(self.config,
+                                    leading=(self.n_nodes,))
+                fib_st = {
+                    f: jax.device_put(v, shardings[f])
+                    for f, v in zf.items()
+                }
             self._refresh_selection()
-            self.tables = DataplaneTables(**dev, **sess, **tel, **tnt)
+            self.tables = DataplaneTables(**dev, **sess, **tel, **tnt,
+                                          **fib_st)
             self._uplinks = jax.device_put(
                 np.array(
                     [

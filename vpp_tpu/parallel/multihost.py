@@ -60,9 +60,11 @@ from vpp_tpu.pipeline.dataplane import Dataplane
 from vpp_tpu.pipeline.tables import (
     SESSION_FIELDS,
     TELEMETRY_FIELDS,
+    FIB_STATE_FIELDS,
     TENANCY_STATE_FIELDS,
     DataplaneConfig,
     DataplaneTables,
+    zero_fib_state,
     zero_sessions,
     zero_telemetry,
     zero_tenancy_state,
@@ -258,7 +260,8 @@ class MultiHostCluster:
         local_stack = {}
         for k in DataplaneTables._fields:
             if k in SESSION_FIELDS or k in TELEMETRY_FIELDS \
-                    or k in TENANCY_STATE_FIELDS:
+                    or k in TENANCY_STATE_FIELDS \
+                    or k in FIB_STATE_FIELDS:
                 continue
             local_stack[k] = np.stack(
                 [arrs_by_node[i][k] for i in self.local_nodes])
@@ -271,6 +274,8 @@ class MultiHostCluster:
             tel = {f: getattr(self.tables, f) for f in TELEMETRY_FIELDS}
             tnt = {f: getattr(self.tables, f)
                    for f in TENANCY_STATE_FIELDS}
+            fib_st = {f: getattr(self.tables, f)
+                      for f in FIB_STATE_FIELDS}
         else:
             zero = zero_sessions(self.config,
                                  leading=(len(self.local_nodes),))
@@ -296,6 +301,15 @@ class MultiHostCluster:
                 f: self._to_global(np.asarray(ztn[f]),
                                    getattr(self._specs, f))
                 for f in TENANCY_STATE_FIELDS
+            }
+            # per-member ECMP accounting plane (ISSUE 15): replicated
+            # along the rule axis, zeros at mesh start
+            zf = zero_fib_state(self.config,
+                                leading=(len(self.local_nodes),))
+            fib_st = {
+                f: self._to_global(np.asarray(zf[f]),
+                                   getattr(self._specs, f))
+                for f in FIB_STATE_FIELDS
             }
         # Classifier/fastpath/ML selection is CLUSTER state: one jitted
         # program serves all nodes, so every choice must be identical
@@ -332,7 +346,7 @@ class MultiHostCluster:
         self._ml_mode, self._ml_kind = agree_ml(
             getattr(c, "ml_stage", "off"), flags[:, 3])
         self.tables = DataplaneTables(**host_fields, **sess, **tel,
-                                      **tnt)
+                                      **tnt, **fib_st)
         self._uplinks = self._to_global(
             np.array([self.nodes[i].uplink_if or 0
                       for i in self.local_nodes], np.int32),

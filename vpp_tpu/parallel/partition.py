@@ -155,9 +155,21 @@ PARTITION_RULES: Tuple[PartitionRule, ...] = (
     PartitionRule(r"^if_", P(NODE_AXIS),
                   "interface attributes: per-node config, "
                   "replicated-by-design"),
+    # LPM per-length prefix planes + ECMP group tables + per-member
+    # accounting (ISSUE 15; ops/lpm.py, ops/fib.py): registered from
+    # day one so the mesh upload path serves million-route FIBs
+    # unchanged. Replicated along the rule axis by design — every
+    # shard needs the WHOLE route table (a packet's longest match can
+    # live anywhere), and the planes are read-only gathers, so
+    # replication costs memory only, never a collective.
+    PartitionRule(r"^fib_(lpm_|grp|ecmp_c)", P(NODE_AXIS),
+                  "LPM length planes / ECMP member tables / per-member "
+                  "accounting: per-node routing state, replicated "
+                  "along the rule axis (lookups are pure gathers — "
+                  "every shard holds the whole FIB)"),
     PartitionRule(r"^fib_", P(NODE_AXIS),
                   "FIB slots: per-node routing config, "
-                  "replicated-by-design (ROADMAP item 5 owns LPM scale)"),
+                  "replicated-by-design"),
     PartitionRule(r"^(nat_|natb_)", P(NODE_AXIS),
                   "NAT mappings/backends: per-node service config, "
                   "replicated-by-design"),
@@ -308,6 +320,20 @@ def select_impl(knob: str, bv_ok: bool, mxu_ok: bool, nrules: int,
     if mxu_ok and nrules >= mxu_threshold:
         return "mxu"
     return "dense"
+
+
+def select_fib_impl(knob: str, lpm_ok: bool, n_routes: int,
+                    min_routes: int) -> str:
+    """The ONE FIB-implementation ladder (ISSUE 15), the
+    ``select_impl`` twin: explicit knobs are honored when compilable
+    (``lpm`` with an ineligible table — planes disabled or a length
+    over its cap — falls back to dense rather than serving wrong
+    routes); ``auto`` engages LPM at ``min_routes`` staged routes."""
+    if knob == "dense":
+        return "dense"
+    if knob == "lpm":
+        return "lpm" if lpm_ok else "dense"
+    return "lpm" if (lpm_ok and n_routes >= min_routes) else "dense"
 
 
 def agree_ml(ml_stage: str, kinds) -> Tuple[str, str]:

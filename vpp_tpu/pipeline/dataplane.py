@@ -356,16 +356,18 @@ _JIT_COMPILES_LOCK = threading.Lock()
 def _step_label(impl: str, skip_local: bool, fast: bool, form: str,
                 sweep_stride: int, ring_slots: int = 0,
                 ml_mode: str = "off", ml_kind: str = "mlp",
-                tel_mode: str = "off", tnt_mode: str = "off") -> str:
+                tel_mode: str = "off", tnt_mode: str = "off",
+                fib_impl: str = "dense") -> str:
     from vpp_tpu.pipeline.graph import SWEEP_STRIDE_DEFAULT
 
-    return "{}{}{}{}{}{}{}_{}".format(
+    return "{}{}{}{}{}{}{}{}_{}".format(
         impl, "_nolocal" if skip_local else "", "_auto" if fast else "",
         ("" if ml_mode == "off"
          else f"_ml{ml_mode}"
          + ("_forest" if ml_kind == "forest" else "")),
         "" if tel_mode == "off" else f"_tel{tel_mode}",
         "" if tnt_mode == "off" else "_tenancy",
+        "" if fib_impl == "dense" else f"_fib{fib_impl}",
         ("" if sweep_stride == SWEEP_STRIDE_DEFAULT
          else f"_sw{sweep_stride}"),
         f"{form}{ring_slots}" if form == "ring" else form)
@@ -470,20 +472,22 @@ def _jitted_step(impl: str, skip_local: bool, fast: bool, form: str,
                  sweep_stride: Optional[int] = None,
                  ring_slots: int = 0,
                  ml_mode: str = "off", ml_kind: str = "mlp",
-                 tel_mode: str = "off", tnt_mode: str = "off"):
+                 tel_mode: str = "off", tnt_mode: str = "off",
+                 fib_impl: str = "dense"):
     from vpp_tpu.pipeline.graph import SWEEP_STRIDE_DEFAULT
 
     if sweep_stride is None:
         sweep_stride = SWEEP_STRIDE_DEFAULT
     key = (impl, skip_local, fast, form, sweep_stride, ring_slots,
-           ml_mode, ml_kind, tel_mode, tnt_mode)
+           ml_mode, ml_kind, tel_mode, tnt_mode, fib_impl)
     step = _JIT_STEPS.get(key)
     if step is None:
         fn = make_pipeline_step(impl, skip_local, fast, sweep_stride,
-                                ml_mode, ml_kind, tel_mode, tnt_mode)
+                                ml_mode, ml_kind, tel_mode, tnt_mode,
+                                fib_impl)
         label = _step_label(impl, skip_local, fast, form, sweep_stride,
                             ring_slots, ml_mode, ml_kind, tel_mode,
-                            tnt_mode)
+                            tnt_mode, fib_impl)
         if form == "plain":
             step = jax.jit(_counting(label, fn))
         elif form == "packed":
@@ -668,6 +672,19 @@ class Dataplane:
         # behaves exactly like off (single default tenant, unsliced,
         # unlimited), so there is no staged state to re-gate on.
         self._tnt_mode = getattr(self.config, "tenancy", "off")
+        # FIB lookup implementation (ISSUE 15; ops/fib.py dense,
+        # ops/lpm.py per-length binary search): the classifier-ladder
+        # twin — ``fib_impl: auto`` engages LPM once the staged route
+        # count reaches fib_lpm_min_routes (and the staged table fits
+        # its planes — builder.lpm_ok()), re-gated at every swap.
+        self.fib_impl_knob = getattr(self.config, "fib_impl", "auto")
+        self.fib_lpm_min_routes = int(
+            getattr(self.config, "fib_lpm_min_routes", 256))
+        self._fib_impl = "dense"
+        # optional Prometheus histogram (stats/collector.py): observes
+        # the fib-group upload cost of every swap that actually
+        # re-shipped FIB state (vpp_tpu_fib_churn_commit_seconds)
+        self.fib_churn_hist = None
         self._refresh_selection()
         # diagnostic classify-probe accumulators (time_classifier):
         # exported as the stage="classify" row of the
@@ -836,6 +853,13 @@ class Dataplane:
                     # jit-cached — shapes are epoch-invariant, only the
                     # gates flip)
                     self._refresh_selection()
+                    if (self.fib_churn_hist is not None
+                            and self.builder.fib_last_shipped):
+                        # route-churn commit cost (ISSUE 15): only
+                        # swaps that actually re-shipped FIB state
+                        self.fib_churn_hist.observe(
+                            float(self.builder.fib_upload.get(
+                                "ms", 0.0)) / 1e3)
                     self.epoch += 1
                     span.attrs["epoch"] = self.epoch
                     span.name = f"epoch {self.epoch}"
@@ -976,6 +1000,65 @@ class Dataplane:
         ``vpp_tpu_acl_classifier`` info gauge."""
         return self._classifier_impl
 
+    @property
+    def fib_impl(self) -> str:
+        """The ip4-lookup implementation the LIVE epoch runs ("dense" |
+        "lpm") — surfaced by `show fib` and the ``vpp_tpu_fib_impl``
+        info gauge (ISSUE 15)."""
+        return self._fib_impl
+
+    def fib_snapshot(self) -> Optional[dict]:
+        """Host scalars behind `show fib` / the ``vpp_tpu_fib_*``
+        families: live route count, per-length histogram, ECMP group
+        registry + the per-member forwarded-packet plane ([G, W] ints
+        cross the transport, never route columns), plane bytes and the
+        last churn upload. In persistent pump mode the ECMP plane
+        rides the ring's private carry, so its view refreshes at
+        sync_sessions/stop — the `show sessions` staleness contract."""
+        from vpp_tpu.ops.lpm import lpm_plane_bytes
+
+        with self._lock:
+            t = self.tables
+            b = self.builder
+            # histogram straight off the per-slot arrays: correct for
+            # dense-only configs too (the LPM staging counters only
+            # move while planes are allocated)
+            live = b.fib_plen[b.fib_plen >= 0]
+            cnts = np.bincount(live, minlength=33) if len(live) else []
+            by_len = {int(L): int(n) for L, n in enumerate(cnts) if n}
+            # per-member rows aggregated ONCE here — `show fib` and
+            # the vpp_tpu_fib_ecmp_packets family both consume these,
+            # so the two views can never diverge
+            groups = {}
+            for g, e in b.nh_groups.items():
+                groups[g] = [
+                    {"nh": int(m[0]), "tx_if": int(m[1]),
+                     "node": int(m[2]),
+                     "ways": [w for w, a in enumerate(e["assign"])
+                              if a == m],
+                     "pkts": 0}
+                    for m in e["members"]
+                ]
+            snap = {
+                "impl": self._fib_impl,
+                "knob": self.fib_impl_knob,
+                "routes": int(len(live)),
+                "by_length": by_len,
+                "lpm_ok": b.lpm_ok(),
+                "lpm_build_ms": float(b.lpm_build_ms),
+                "ecmp_groups": groups,
+                "plane_bytes": lpm_plane_bytes(self.config),
+                "upload": dict(b.fib_upload),
+            }
+        if t is not None:
+            ecmp_c = np.asarray(jax.device_get(t.fib_ecmp_c), np.int64)
+            snap["ecmp_c"] = ecmp_c
+            for g, members in groups.items():
+                for m in members:
+                    if m["ways"]:
+                        m["pkts"] = int(ecmp_c[g, m["ways"]].sum())
+        return snap
+
     def _select_classifier(self) -> str:
         """Resolve the ``classifier`` knob against the staged builder
         state — eligibility bits (range rules for MXU, non-prefix
@@ -1009,6 +1092,14 @@ class Dataplane:
         ml_kind = int(getattr(b, "ml_kind", 0))
         self._ml_mode = self.ml_stage if ml_kind else "off"
         self._ml_kind = "forest" if ml_kind == 2 else "mlp"
+        # FIB ladder (ISSUE 15): lpm when eligible and big enough —
+        # the ONE shared rung mapping (partition.select_fib_impl), so
+        # a mesh plane adopting the ladder can never diverge
+        from vpp_tpu.parallel.partition import select_fib_impl
+
+        self._fib_impl = select_fib_impl(
+            self.fib_impl_knob, b.lpm_ok(), b.fib_route_count(),
+            self.fib_lpm_min_routes)
 
     def _get_step(self, fast: bool, form: str = "plain"):
         """The jit-cached step variant of the current selection.
@@ -1026,7 +1117,7 @@ class Dataplane:
         skip = self._skip_local
         stride = self._sweep_stride
         gates = (self._ml_mode, self._ml_kind, self._tel_mode,
-                 self._tnt_mode)
+                 self._tnt_mode, self._fib_impl)
         if (skip
                 and (self._classifier_impl, skip, fast, form, stride,
                      0) + gates not in _JIT_STEPS
@@ -1037,7 +1128,8 @@ class Dataplane:
                             stride, ml_mode=self._ml_mode,
                             ml_kind=self._ml_kind,
                             tel_mode=self._tel_mode,
-                            tnt_mode=self._tnt_mode)
+                            tnt_mode=self._tnt_mode,
+                            fib_impl=self._fib_impl)
 
     def time_classifier(self, batch: int = 256, iters: int = 10) -> float:
         """Diagnostic: time the SELECTED global classifier in isolation

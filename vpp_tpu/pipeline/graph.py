@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from vpp_tpu.ops.acl import acl_classify_global, acl_classify_local
-from vpp_tpu.ops.fib import ip4_lookup
+from vpp_tpu.ops.fib import fib_lookup_dense
 from vpp_tpu.ops.ip4 import ip4_input
 from vpp_tpu.ops.nat44 import (
     nat44_dnat,
@@ -267,6 +267,16 @@ def _finish_step(
     flow sketch (ops/telemetry.py; ``tel_mode`` "full", trace-time
     static) folds the batch in, so both tiers feed the same sketch."""
     tables = session_sweep(tables, now, sweep_stride)
+    # per-member ECMP accounting (ISSUE 15; ops/fib.py resolve): one
+    # flat scatter-add of forwarded group-routed packets into the
+    # carried [G, W] plane — both tiers feed it here, the one place.
+    # Non-ECMP packets (grp -1) target the out-of-range index and drop.
+    n_grp, n_way = tables.fib_ecmp_c.shape
+    gw = jnp.where(forwarded & (fib.grp >= 0),
+                   fib.grp * n_way + fib.way, n_grp * n_way)
+    tables = tables._replace(
+        fib_ecmp_c=tables.fib_ecmp_c.reshape(-1).at[gw].add(
+            1, mode="drop").reshape(n_grp, n_way))
     # jax-ok: tel_mode is a trace-time-static step-factory gate (a
     # Python string baked into the jit key), not a tracer branch
     if tel_mode == "full":
@@ -415,6 +425,7 @@ def pipeline_step(
     ml_kind: str = "mlp",
     tel_mode: str = "off",
     tnt_mode: str = "off",
+    fib_fn=fib_lookup_dense,
     shard=None,
     _tnt_pre=None,
 ) -> StepResult:
@@ -499,8 +510,9 @@ def pipeline_step(
     # ACL-permitted flagged packet drops here (ml-drop beats permit)
     ml_dropped = ml_drop_want & permit & alive
 
-    # --- ip4-lookup (on possibly NAT-rewritten dst) ---
-    fib = ip4_lookup(tables, pkts.dst_ip)
+    # --- ip4-lookup (on possibly NAT-rewritten dst; dense or LPM per
+    # the fib_impl ladder — both resolve through ops.fib) ---
+    fib = fib_fn(tables, pkts)
     forwarded = (alive & permit & ~ml_dropped & fib.matched
                  & (fib.disp != int(Disposition.DROP)))
     disp = jnp.where(forwarded, fib.disp, int(Disposition.DROP)).astype(jnp.int32)
@@ -593,6 +605,7 @@ def _pipeline_fast_finish(
     ml_kind: str = "mlp",
     tel_mode: str = "off",
     tnt_mode: str = "off",
+    fib_fn=fib_lookup_dense,
     shard=None,
     tid=None,
     tnt_dropped=None,
@@ -637,7 +650,7 @@ def _pipeline_fast_finish(
         shard=shard, tid=tid)
     ml_dropped = ml_drop_want & permit & alive
 
-    fib = ip4_lookup(tables, pkts.dst_ip)
+    fib = fib_fn(tables, pkts)
     forwarded = alive & permit & ~ml_dropped & fib.matched & (
         fib.disp != int(Disposition.DROP)
     )
@@ -672,6 +685,7 @@ def pipeline_step_fast(
     ml_kind: str = "mlp",
     tel_mode: str = "off",
     tnt_mode: str = "off",
+    fib_fn=fib_lookup_dense,
     shard=None,
 ) -> StepResult:
     """The classify-free established-flow kernel, standalone:
@@ -701,7 +715,7 @@ def pipeline_step_fast(
         tables, pkts, now, alive, drop_ip4, established, sess_hit_idx,
         nat_reversed, nat_hit_idx, sweep_stride=sweep_stride,
         ml_mode=ml_mode, ml_kind=ml_kind, tel_mode=tel_mode,
-        tnt_mode=tnt_mode, shard=shard, tid=tid,
+        tnt_mode=tnt_mode, fib_fn=fib_fn, shard=shard, tid=tid,
         tnt_dropped=tnt_dropped,
     )
 
@@ -717,6 +731,7 @@ def pipeline_step_auto(
     ml_kind: str = "mlp",
     tel_mode: str = "off",
     tnt_mode: str = "off",
+    fib_fn=fib_lookup_dense,
     shard=None,
 ) -> StepResult:
     """Two-tier dispatch: the fast kernel when the whole batch rides
@@ -784,7 +799,7 @@ def pipeline_step_auto(
             tbl, rpkts, now, alive, drop_ip4, hits, sess_hit_idx,
             nat_reversed, nat_hit_idx, sweep_stride=sweep_stride,
             ml_mode=ml_mode, ml_kind=ml_kind, tel_mode=tel_mode,
-            tnt_mode=tnt_mode, shard=shard, tid=tid,
+            tnt_mode=tnt_mode, fib_fn=fib_fn, shard=shard, tid=tid,
             tnt_dropped=tnt_dropped,
         )
 
@@ -796,7 +811,7 @@ def pipeline_step_auto(
                              acl_local_fn, sweep_stride=sweep_stride,
                              ml_mode=ml_mode, ml_kind=ml_kind,
                              tel_mode=tel_mode, tnt_mode=tnt_mode,
-                             shard=shard,
+                             fib_fn=fib_fn, shard=shard,
                              _tnt_pre=((tid, tnt_dropped, tbl)
                                        if tnt else None))
 
@@ -824,12 +839,26 @@ def _classifier_fns(impl: str):
     return acl_classify_global, acl_classify_local
 
 
+def _fib_fn(fib_impl: str):
+    """The ip4-lookup implementation of one ladder rung (the
+    _classifier_fns twin — ops/fib.py dense masked-compare or
+    ops/lpm.py binary-search-over-prefix-lengths; docs/ROUTING.md)."""
+    if fib_impl == "lpm":
+        from vpp_tpu.ops.lpm import fib_lookup_lpm
+
+        return fib_lookup_lpm
+    if fib_impl != "dense":
+        raise ValueError(f"unknown fib impl {fib_impl!r}")
+    return fib_lookup_dense
+
+
 @functools.lru_cache(maxsize=None)
 def make_pipeline_step(impl: str = "dense", skip_local: bool = False,
                        fast: bool = False,
                        sweep_stride: int = SWEEP_STRIDE_DEFAULT,
                        ml_mode: str = "off", ml_kind: str = "mlp",
-                       tel_mode: str = "off", tnt_mode: str = "off"):
+                       tel_mode: str = "off", tnt_mode: str = "off",
+                       fib_impl: str = "dense"):
     """Compose one pipeline-step callable from the epoch's gates:
     classifier implementation (dense | mxu | bv), the policy-free
     local-classify skip, the two-tier fast-path dispatch, the session
@@ -857,6 +886,7 @@ def make_pipeline_step(impl: str = "dense", skip_local: bool = False,
     if tnt_mode not in ("off", "on"):
         raise ValueError(f"unknown tnt_mode {tnt_mode!r}")
     acl_global_fn, acl_local_fn = _classifier_fns(impl)
+    fib_fn = _fib_fn(fib_impl)
     if skip_local:
         acl_local_fn = acl_local_none
     base = pipeline_step_auto if fast else pipeline_step
@@ -866,14 +896,15 @@ def make_pipeline_step(impl: str = "dense", skip_local: bool = False,
         return base(tables, pkts, now, acl_global_fn=acl_global_fn,
                     acl_local_fn=acl_local_fn, sweep_stride=sweep_stride,
                     ml_mode=ml_mode, ml_kind=ml_kind, tel_mode=tel_mode,
-                    tnt_mode=tnt_mode)
+                    tnt_mode=tnt_mode, fib_fn=fib_fn)
 
-    step.__name__ = "pipeline_step_{}{}{}{}{}{}".format(
+    step.__name__ = "pipeline_step_{}{}{}{}{}{}{}".format(
         impl, "_nolocal" if skip_local else "", "_auto" if fast else "",
         "" if ml_mode == "off" else f"_ml{ml_mode}"
         + ("_forest" if ml_kind == "forest" else ""),
         "" if tel_mode == "off" else f"_tel{tel_mode}",
         "" if tnt_mode == "off" else "_tenancy",
+        "" if fib_impl == "dense" else f"_fib{fib_impl}",
     )
     return step
 

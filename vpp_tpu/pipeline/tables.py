@@ -45,6 +45,31 @@ class DataplaneConfig(NamedTuple):
     max_global_rules: int = 128
     max_ifaces: int = 64
     fib_slots: int = 128
+    # FIB lookup implementation (ops/fib.py dense masked-compare,
+    # ops/lpm.py binary-search-over-prefix-lengths): "dense" | "lpm" |
+    # "auto". ``auto`` picks LPM once the staged route count reaches
+    # ``fib_lpm_min_routes`` (and the per-length planes fit
+    # ``fib_lpm_mem_mb``, and every staged route fits its length's
+    # plane — the BV ok-gate pattern). Re-evaluated at every epoch
+    # swap; plane SHAPES are config-static, so only the selection
+    # flips per epoch, never the compiled programs' signatures
+    # (docs/ROUTING.md).
+    fib_impl: str = "auto"
+    fib_lpm_min_routes: int = 256
+    fib_lpm_mem_mb: int = 256
+    # Per-length plane capacities, index = prefix length /0../32
+    # (missing tail entries = 0 = length unpopulated, SKIPPED at trace
+    # time). Empty (the default) sizes every length to ``fib_slots`` —
+    # correct for any route mix; internet-scale configs set the feed's
+    # real length distribution to keep plane memory at ~8 bytes/route
+    # (ops/lpm.py has the formula).
+    fib_lpm_plen_caps: tuple = ()
+    # ECMP next-hop groups (ops/fib.py resolve_fib_slot): group slots
+    # and member ways per group (power of two — the flow-hash member
+    # pick masks with W-1). 0 groups (the default) carries [1, 1]
+    # placeholders and set_nh_group is refused.
+    fib_ecmp_groups: int = 0
+    fib_ecmp_ways: int = 8
     # Reflective-session table: total slots (power of 2), organized as
     # sess_slots/sess_ways buckets of sess_ways ways each (W-way
     # set-associative — ops/session.py). Memory is ~6 uint32 columns x
@@ -246,6 +271,71 @@ class DataplaneTables(NamedTuple):
     fib_snat: jnp.ndarray       # int32 bool: cluster-egress route — SNAT
                                 # applies (reference: configurator_impl.go
                                 # :258-264 SNAT pool for external traffic)
+    fib_grp: jnp.ndarray        # int32 [F] ECMP next-hop group of the
+                                # route, -1 = unicast (the scalar
+                                # next_hop/tx_if/node_id columns above)
+
+    # --- LPM per-length prefix planes (ops/lpm.py; ISSUE 15) --------
+    # One [2, N_L] uint32 plane per prefix length: row 0 the sorted
+    # masked prefixes (pad 0xFFFFFFFF), row 1 the owning FIB slot.
+    # SEPARATE fields deliberately — a BGP flap re-ships only the
+    # touched length's plane; the others keep device-array identity
+    # (the glb_bv per-dimension-plane discipline). Capacities are
+    # config-static (fib_lpm_plen_caps; 0 = zero-width plane, skipped
+    # at trace time). Replicated along the mesh rule axis
+    # (parallel/partition.py).
+    fib_lpm_p0: jnp.ndarray
+    fib_lpm_p1: jnp.ndarray
+    fib_lpm_p2: jnp.ndarray
+    fib_lpm_p3: jnp.ndarray
+    fib_lpm_p4: jnp.ndarray
+    fib_lpm_p5: jnp.ndarray
+    fib_lpm_p6: jnp.ndarray
+    fib_lpm_p7: jnp.ndarray
+    fib_lpm_p8: jnp.ndarray
+    fib_lpm_p9: jnp.ndarray
+    fib_lpm_p10: jnp.ndarray
+    fib_lpm_p11: jnp.ndarray
+    fib_lpm_p12: jnp.ndarray
+    fib_lpm_p13: jnp.ndarray
+    fib_lpm_p14: jnp.ndarray
+    fib_lpm_p15: jnp.ndarray
+    fib_lpm_p16: jnp.ndarray
+    fib_lpm_p17: jnp.ndarray
+    fib_lpm_p18: jnp.ndarray
+    fib_lpm_p19: jnp.ndarray
+    fib_lpm_p20: jnp.ndarray
+    fib_lpm_p21: jnp.ndarray
+    fib_lpm_p22: jnp.ndarray
+    fib_lpm_p23: jnp.ndarray
+    fib_lpm_p24: jnp.ndarray
+    fib_lpm_p25: jnp.ndarray
+    fib_lpm_p26: jnp.ndarray
+    fib_lpm_p27: jnp.ndarray
+    fib_lpm_p28: jnp.ndarray
+    fib_lpm_p29: jnp.ndarray
+    fib_lpm_p30: jnp.ndarray
+    fib_lpm_p31: jnp.ndarray
+    fib_lpm_p32: jnp.ndarray
+    fib_lpm_cnt: jnp.ndarray    # int32 [33] live (deduped) entries per
+                                # length plane, clipped to each cap
+    fib_lpm_hint: jnp.ndarray   # int32 [H] concatenated per-length
+                                # stride hint tables (ops/lpm.py
+                                # lpm_hint_layout — offsets are
+                                # config-static, derived from the caps)
+
+    # --- ECMP next-hop group tables (ops/fib.py; ISSUE 15) ----------
+    # [G, W] member tables, member picked by the session flow hash
+    # (way = mix & (W-1)); fib_grp_n counts DISTINCT members (0 =
+    # unconfigured group — routes referencing it fail closed).
+    fib_grp_nh: jnp.ndarray     # uint32 [G, W] member next-hop IP
+    fib_grp_tx_if: jnp.ndarray  # int32 [G, W]
+    fib_grp_node: jnp.ndarray   # int32 [G, W]
+    fib_grp_n: jnp.ndarray      # int32 [G] distinct member count
+    # per-member forwarded-packet accounting (graph._finish_step
+    # scatter-add; the vpp_tpu_fib_ecmp_packets family) — STATE,
+    # carried by reference across swaps like the telemetry planes
+    fib_ecmp_c: jnp.ndarray     # int32 [G, W]
 
     # --- reflective sessions (W-way set-associative hash) [NB, W] ---
     # The way count W is carried IN THE SHAPE (ops/session.py): one
@@ -529,6 +619,35 @@ def zero_tenancy_state_device(config: DataplaneConfig) -> Dict[str, jnp.ndarray]
             for f, dt in TENANCY_STATE_FIELDS.items()}
 
 
+# FIB STATE fields of DataplaneTables (the per-member ECMP accounting
+# plane — ISSUE 15), carried by reference across epoch swaps exactly
+# like TELEMETRY_FIELDS, cold on snapshot restore by design (the
+# crash-consistent snapshot format enumerates SESSION_FIELDS only).
+FIB_STATE_FIELDS: Dict[str, type] = {
+    "fib_ecmp_c": np.int32,
+}
+
+
+def fib_state_shapes(config: DataplaneConfig) -> Dict[str, Tuple[int, ...]]:
+    from vpp_tpu.ops.lpm import ecmp_capacity
+
+    g, w = ecmp_capacity(config)
+    return {f: (g, w) for f in FIB_STATE_FIELDS}
+
+
+def zero_fib_state(config: DataplaneConfig,
+                   leading: Tuple[int, ...] = ()) -> Dict[str, np.ndarray]:
+    shapes = fib_state_shapes(config)
+    return {f: np.zeros(leading + shapes[f], dt)
+            for f, dt in FIB_STATE_FIELDS.items()}
+
+
+def zero_fib_state_device(config: DataplaneConfig) -> Dict[str, jnp.ndarray]:
+    shapes = fib_state_shapes(config)
+    return {f: jnp.zeros(shapes[f], dt)
+            for f, dt in FIB_STATE_FIELDS.items()}
+
+
 def _is_pow2(n: int) -> bool:
     return n > 0 and (n & (n - 1)) == 0
 
@@ -566,6 +685,34 @@ def validate_dataplane_config(config: DataplaneConfig) -> None:
         raise ValueError(
             f"dataplane.sess_sweep_stride must be 0 (disabled) or a "
             f"power of two, got {stride}")
+    fib_impl = getattr(c, "fib_impl", "auto")
+    if fib_impl not in ("dense", "lpm", "auto"):
+        raise ValueError(
+            f"dataplane.fib_impl must be dense | lpm | auto, got "
+            f"{fib_impl!r}")
+    if int(getattr(c, "fib_lpm_min_routes", 256)) < 0:
+        raise ValueError(
+            f"dataplane.fib_lpm_min_routes must be >= 0, got "
+            f"{c.fib_lpm_min_routes}")
+    caps = tuple(getattr(c, "fib_lpm_plen_caps", ()) or ())
+    if len(caps) > 33:
+        raise ValueError(
+            f"dataplane.fib_lpm_plen_caps has {len(caps)} entries "
+            f"(index = prefix length, max 33: /0../32)")
+    for L, cap in enumerate(caps):
+        if int(cap) < 0:
+            raise ValueError(
+                f"dataplane.fib_lpm_plen_caps[/{L}] must be >= 0, "
+                f"got {cap}")
+    eg = int(getattr(c, "fib_ecmp_groups", 0))
+    if not (0 <= eg <= 4096):
+        raise ValueError(
+            f"dataplane.fib_ecmp_groups must be in 0..4096, got {eg}")
+    ew = int(getattr(c, "fib_ecmp_ways", 8))
+    if eg and (not _is_pow2(ew) or ew > 256):
+        raise ValueError(
+            f"dataplane.fib_ecmp_ways must be a power of two <= 256 "
+            f"(the flow-hash member pick masks with W-1), got {ew}")
     ml_stage = getattr(c, "ml_stage", "off")
     if ml_stage not in ("off", "score", "enforce"):
         raise ValueError(
@@ -961,8 +1108,24 @@ _UPLOAD_GROUPS: Dict[str, Tuple[str, ...]] = {
            "glb_ml_f_leaf", "glb_ml_thresh", "glb_ml_action",
            "glb_ml_rl_shift", "glb_ml_version"),
     "if": ("if_type", "if_local_table", "if_apply_global"),
+    # the FIB group uploads with per-field granularity (see to_device):
+    # per-slot row arrays go through the incremental scatter-blob path
+    # (_fib_incremental — a route flap ships a few-KB blob, not 9 x 4 MB
+    # columns at the 1M-route regime), and the per-length LPM planes +
+    # ECMP tables re-ship only when _fib_dirty names them (a flap =
+    # ONE touched length plane + the count vector)
     "fib": ("fib_prefix", "fib_mask", "fib_plen", "fib_tx_if", "fib_disp",
-            "fib_next_hop", "fib_node_id", "fib_snat"),
+            "fib_next_hop", "fib_node_id", "fib_snat", "fib_grp",
+            "fib_lpm_p0", "fib_lpm_p1", "fib_lpm_p2", "fib_lpm_p3",
+            "fib_lpm_p4", "fib_lpm_p5", "fib_lpm_p6", "fib_lpm_p7",
+            "fib_lpm_p8", "fib_lpm_p9", "fib_lpm_p10", "fib_lpm_p11",
+            "fib_lpm_p12", "fib_lpm_p13", "fib_lpm_p14", "fib_lpm_p15",
+            "fib_lpm_p16", "fib_lpm_p17", "fib_lpm_p18", "fib_lpm_p19",
+            "fib_lpm_p20", "fib_lpm_p21", "fib_lpm_p22", "fib_lpm_p23",
+            "fib_lpm_p24", "fib_lpm_p25", "fib_lpm_p26", "fib_lpm_p27",
+            "fib_lpm_p28", "fib_lpm_p29", "fib_lpm_p30", "fib_lpm_p31",
+            "fib_lpm_p32", "fib_lpm_cnt", "fib_lpm_hint",
+            "fib_grp_nh", "fib_grp_tx_if", "fib_grp_node", "fib_grp_n"),
     "nat": ("nat_ext_ip", "nat_ext_port", "nat_proto", "nat_boff",
             "nat_bcnt", "nat_total_w", "nat_self_snat", "natb_ip",
             "natb_port", "natb_cumw", "nat_snat_ip"),
@@ -978,6 +1141,40 @@ _UPLOAD_GROUPS: Dict[str, Tuple[str, ...]] = {
                "tnt_nat_base", "tnt_nat_mask",
                "glb_ml_tnt_mode", "glb_ml_tnt_thresh"),
 }
+
+# Per-slot FIB row arrays (the dense kernel's columns + the shared
+# resolver's route data): diffed together against _fib_prev and
+# scatter-updated on device as ONE packed blob when a commit's changes
+# confine to a block (_fib_incremental — the _glb_incremental scheme
+# without the bit-plane column space).
+_FIB_SLOT_FIELDS: Tuple[str, ...] = (
+    "fib_prefix", "fib_mask", "fib_plen", "fib_tx_if", "fib_disp",
+    "fib_next_hop", "fib_node_id", "fib_snat", "fib_grp",
+)
+
+
+@functools.lru_cache(maxsize=8)
+def _fib_update_fn(w: int):
+    """Jitted incremental per-slot FIB update for row-block width
+    ``w``: one packed int32 blob carries every per-slot array's
+    changed block, one compiled program scatters the blocks into the
+    cached device arrays with dynamic_update_slice (traced start
+    offset — no recompile per position). Blob layout: [9 x w rows]."""
+    import jax
+
+    def update(rows, blob, lo):
+        from jax import lax
+
+        out = []
+        for i, dev in enumerate(rows):
+            piece = lax.bitcast_convert_type(
+                blob[i * w:(i + 1) * w], dev.dtype
+            )
+            out.append(lax.dynamic_update_slice(dev, piece, (lo,)))
+        return out
+
+    return jax.jit(update)
+
 
 # BV dimension -> its global-table device fields (granular upload:
 # only the planes compile_bv actually rebuilt re-ship; the nbnd count
@@ -1089,6 +1286,63 @@ class TableBuilder:
         self.fib_next_hop = z(c.fib_slots, np.uint32)
         self.fib_node_id = np.full(c.fib_slots, -1, np.int32)
         self.fib_snat = z(c.fib_slots, np.int32)
+        self.fib_grp = np.full(c.fib_slots, -1, np.int32)
+        # LPM per-length prefix planes (ops/lpm.py; ISSUE 15).
+        # Allocation is knob-gated like BV: dense configs (and auto
+        # configs whose worst-case planes bust fib_lpm_mem_mb) carry
+        # zero-width placeholders — the LPM kernel is then never
+        # selected. Staging is LAZY: mutators only mark the touched
+        # LENGTH dirty; _restage_lpm() recompiles dirty planes at
+        # host_arrays()/lpm_ok() time (one vectorized pass per dirty
+        # length — a 1M-route bulk load pays 33 passes total, not one
+        # per route).
+        from vpp_tpu.ops.lpm import (
+            LPM_LENGTHS,
+            LPM_PAD,
+            ecmp_capacity,
+            lpm_enabled_for,
+            lpm_field,
+            lpm_hint_layout,
+            lpm_len_caps,
+        )
+
+        self.lpm_enabled = lpm_enabled_for(c)
+        self.lpm_caps = lpm_len_caps(c)
+        self._lpm_layout, hint_rows = lpm_hint_layout(self.lpm_caps)
+        self.lpm_hint = z(hint_rows, np.int32)
+        self.lpm_planes = {}
+        for length in range(LPM_LENGTHS):
+            plane = np.zeros((2, self.lpm_caps[length]), np.uint32)
+            plane[0, :] = LPM_PAD
+            self.lpm_planes[lpm_field(length)] = plane
+        self.lpm_cnt = z(LPM_LENGTHS, np.int32)
+        # full per-length route counts (deduped, NOT clipped to caps —
+        # the lpm_ok() overflow signal and the `show fib` histogram)
+        self.lpm_counts = z(LPM_LENGTHS, np.int64)
+        self._lpm_dirty_lens = set(range(LPM_LENGTHS))
+        self.lpm_build_ms = 0.0   # host cost of the LAST plane restage
+        # ECMP next-hop groups: registry {gid: {"members": [(nh,
+        # tx_if, node), ...], "assign": [member per way]}} compiled
+        # into the [G, W] member tables with STICKY way assignment
+        # (set_nh_group) — member churn only reassigns the ways it
+        # must, so flows hashed to surviving ways keep their member.
+        gcap, ways = ecmp_capacity(c)
+        self.nh_groups: Dict[int, dict] = {}
+        self.fib_grp_nh = z((gcap, ways), np.uint32)
+        self.fib_grp_tx_if = np.full((gcap, ways), -1, np.int32)
+        self.fib_grp_node = np.full((gcap, ways), -1, np.int32)
+        self.fib_grp_n = z(gcap, np.int32)
+        # per-field dirty set of the "fib" upload group (the _bv_dirty
+        # pattern): to_device re-ships only named fields; per-slot row
+        # arrays additionally try the incremental scatter-blob path
+        self._fib_dirty = set(_UPLOAD_GROUPS["fib"])
+        # per-slot arrays as of the last full device upload (the
+        # incremental diff base; None = next commit uploads full)
+        self._fib_prev: Optional[Dict[str, np.ndarray]] = None
+        # last fib-group upload, for `show fib` / fib_bench: fields
+        # re-shipped, bytes, host ms ("blob" = the per-slot scatter)
+        self.fib_upload: Dict[str, object] = {}
+        self.fib_last_shipped = False
         self.nat_ext_ip = z(c.nat_mappings, np.uint32)
         self.nat_ext_port = z(c.nat_mappings, np.int32)
         self.nat_proto = z(c.nat_mappings, np.int32)
@@ -1439,6 +1693,17 @@ class TableBuilder:
         self._mark("if")
 
     # --- FIB ---
+    def _mark_fib_slots(self, *plens: int) -> None:
+        """One route mutation: the per-slot row arrays changed (they
+        ship via the incremental blob or, fallback, in full) and the
+        named prefix LENGTHS need their LPM plane restaged."""
+        self._fib_dirty.update(_FIB_SLOT_FIELDS)
+        if self.lpm_enabled:
+            for plen in plens:
+                if 0 <= plen <= 32:
+                    self._lpm_dirty_lens.add(int(plen))
+        self._mark("fib")
+
     def add_route(
         self,
         prefix: str,
@@ -1448,13 +1713,29 @@ class TableBuilder:
         node_id: int = -1,
         slot: Optional[int] = None,
         snat: bool = False,
+        group: Optional[int] = None,
     ) -> int:
+        """Install one route. ``group`` names an ECMP next-hop group
+        (set_nh_group) the route resolves through instead of the
+        scalar next_hop/tx_if/node_id columns — which are still staged
+        as given (the trace/debug fallback and the group's fail-closed
+        documentation of intent)."""
         net = ipaddress.ip_network(prefix)
+        if group is not None:
+            gcap = self.fib_grp_nh.shape[0]
+            if int(getattr(self.config, "fib_ecmp_groups", 0)) <= 0:
+                raise ValueError(
+                    "route names an ECMP group but "
+                    "dataplane.fib_ecmp_groups is 0")
+            if not (0 <= int(group) < gcap):
+                raise ValueError(
+                    f"ECMP group {group} out of range 0..{gcap - 1}")
         if slot is None:
             free = np.nonzero(self.fib_plen < 0)[0]
             if len(free) == 0:
                 raise ValueError("FIB full")
             slot = int(free[0])
+        old_plen = int(self.fib_plen[slot])
         mask = _mask_of(net.prefixlen)
         self.fib_prefix[slot] = int(net.network_address) & mask
         self.fib_mask[slot] = mask
@@ -1464,12 +1745,61 @@ class TableBuilder:
         self.fib_next_hop[slot] = next_hop
         self.fib_node_id[slot] = node_id
         self.fib_snat[slot] = int(snat)
+        self.fib_grp[slot] = -1 if group is None else int(group)
         if self._rec is not None:
             self._rec.add_route(prefix, tx_if, int(disposition),
                                 int(next_hop), int(node_id), bool(snat),
-                                slot=slot)
-        self._mark("fib")
+                                slot=slot, group=group)
+        self._mark_fib_slots(old_plen, net.prefixlen)
         return slot
+
+    def add_routes_np(self, nets: np.ndarray, plens: np.ndarray,
+                      tx_if: np.ndarray, disp: np.ndarray,
+                      next_hop=0, node_id=-1, snat=0, group=-1,
+                      base_slot: int = 0) -> int:
+        """Bulk route loader (the BGP full-feed path; ISSUE 15):
+        vectorized writes of N routes into slots [base_slot,
+        base_slot + N). Scalars broadcast; ``nets`` must already be
+        masked networks. NOT journaled — a 1M-entry feed is adjacency
+        state, not NB config (replay rebuilds it from the feed, the
+        way VPP reloads its RIB). Returns the count staged."""
+        n = len(nets)
+        if base_slot + n > self.config.fib_slots:
+            raise ValueError(
+                f"{n} routes at base {base_slot} exceed fib_slots "
+                f"{self.config.fib_slots}")
+        grp = np.asarray(group, np.int32)
+        if (grp >= 0).any():
+            # the add_route group validation, vectorized: an
+            # out-of-range id would be CLIPPED on-device onto a real
+            # group and silently forward via its members
+            gcap = self.fib_grp_nh.shape[0]
+            if int(getattr(self.config, "fib_ecmp_groups", 0)) <= 0:
+                raise ValueError(
+                    "routes name ECMP groups but "
+                    "dataplane.fib_ecmp_groups is 0")
+            if int(grp.max()) >= gcap or int(grp.min()) < -1:
+                raise ValueError(
+                    f"ECMP group ids must be -1 (none) or in "
+                    f"0..{gcap - 1}")
+        plens = np.asarray(plens, np.int32)
+        sl = slice(base_slot, base_slot + n)
+        masks = np.array([_mask_of(int(p)) for p in range(33)],
+                         np.uint32)[plens]
+        old = self.fib_plen[sl]
+        self.fib_prefix[sl] = np.asarray(nets, np.uint32) & masks
+        self.fib_mask[sl] = masks
+        self.fib_plen[sl] = plens
+        self.fib_tx_if[sl] = np.asarray(tx_if, np.int32)
+        self.fib_disp[sl] = np.asarray(disp, np.int32)
+        self.fib_next_hop[sl] = np.asarray(next_hop, np.uint32)
+        self.fib_node_id[sl] = np.asarray(node_id, np.int32)
+        self.fib_snat[sl] = np.asarray(snat, np.int32)
+        self.fib_grp[sl] = np.asarray(group, np.int32)
+        touched = set(np.unique(plens).tolist())
+        touched |= set(np.unique(old[old >= 0]).tolist())
+        self._mark_fib_slots(*touched)
+        return n
 
     def del_route(self, prefix: str) -> bool:
         net = ipaddress.ip_network(prefix)
@@ -1483,8 +1813,161 @@ class TableBuilder:
         self.fib_plen[hit[0]] = -1
         if self._rec is not None:
             self._rec.del_route(prefix)
+        self._mark_fib_slots(net.prefixlen)
+        return True
+
+    # --- ECMP next-hop groups (ops/fib.py resolve_fib_slot) ---
+    def set_nh_group(self, gid: int, members) -> None:
+        """Stage one ECMP group: ``members`` is a sequence of
+        ``(next_hop_ip, tx_if, node_id)`` tuples. Way assignment is
+        STICKY: surviving members keep the ways they already own (up
+        to their rebalanced share), so member churn only remaps the
+        flows it must — the stickiness contract tests pin
+        (docs/ROUTING.md)."""
+        c = self.config
+        if int(getattr(c, "fib_ecmp_groups", 0)) <= 0:
+            raise ValueError(
+                "dataplane.fib_ecmp_groups is 0 — ECMP group tables "
+                "carry placeholder shapes (raise the knob)")
+        gcap, ways = self.fib_grp_nh.shape
+        if not (0 <= int(gid) < gcap):
+            raise ValueError(f"ECMP group {gid} out of range "
+                             f"0..{gcap - 1}")
+        gid = int(gid)
+        mset = []
+        for m in members:
+            nh, tx, node = int(m[0]), int(m[1]), int(m[2])
+            if (nh, tx, node) not in mset:
+                mset.append((nh, tx, node))
+        if not mset:
+            raise ValueError(
+                "ECMP group needs at least one member "
+                "(del_nh_group removes a group)")
+        if len(mset) > ways:
+            raise ValueError(
+                f"{len(mset)} distinct members exceed fib_ecmp_ways "
+                f"{ways}")
+        prev = self.nh_groups.get(gid)
+        prev_assign = list(prev["assign"]) if prev else [None] * ways
+        n = len(mset)
+        target = [ways // n + (1 if i < ways % n else 0)
+                  for i in range(n)]
+        counts = [0] * n
+        assign_i = [None] * ways
+        # pass 1: surviving members keep their ways up to their share
+        for w in range(ways):
+            m = prev_assign[w]
+            if m in mset:
+                i = mset.index(m)
+                if counts[i] < target[i]:
+                    assign_i[w] = i
+                    counts[i] += 1
+        # pass 2: freed/new ways go to the most under-share member
+        # (deterministic: ties by member order)
+        for w in range(ways):
+            if assign_i[w] is None:
+                i = min(range(n), key=lambda j: (counts[j] - target[j], j))
+                assign_i[w] = i
+                counts[i] += 1
+        assign = [mset[i] for i in assign_i]
+        self.nh_groups[gid] = {"members": mset, "assign": assign}
+        self.fib_grp_nh[gid] = np.array([m[0] for m in assign], np.uint32)
+        self.fib_grp_tx_if[gid] = np.array([m[1] for m in assign], np.int32)
+        self.fib_grp_node[gid] = np.array([m[2] for m in assign], np.int32)
+        self.fib_grp_n[gid] = n
+        if self._rec is not None:
+            self._rec.set_nh_group(gid, [list(m) for m in mset])
+        self._fib_dirty.update(("fib_grp_nh", "fib_grp_tx_if",
+                                "fib_grp_node", "fib_grp_n"))
+        self._mark("fib")
+
+    def del_nh_group(self, gid: int) -> bool:
+        """Remove one ECMP group. Routes still referencing it FAIL
+        CLOSED on the device (fib_grp_n == 0 resolves as a no-route
+        miss) until they are repointed — dropping beats forwarding to
+        a withdrawn next-hop."""
+        if int(gid) not in self.nh_groups:
+            return False
+        gid = int(gid)
+        del self.nh_groups[gid]
+        self.fib_grp_nh[gid] = 0
+        self.fib_grp_tx_if[gid] = -1
+        self.fib_grp_node[gid] = -1
+        self.fib_grp_n[gid] = 0
+        if self._rec is not None:
+            self._rec.del_nh_group(gid)
+        self._fib_dirty.update(("fib_grp_nh", "fib_grp_tx_if",
+                                "fib_grp_node", "fib_grp_n"))
         self._mark("fib")
         return True
+
+    # --- LPM plane staging (ops/lpm.py; ISSUE 15) ---
+    def _restage_lpm(self) -> None:
+        """Recompile the dirty per-length LPM planes from the per-slot
+        FIB arrays: one vectorized pass per dirty length — select that
+        length's slots, sort by (prefix, slot), keep the LOWEST slot
+        per duplicate prefix (the dense argmax tie-break, so the two
+        implementations stay bit-exact). Planes are strictly sorted
+        after dedupe (`tools/lint.py --tables` pins it). Called lazily
+        from host_arrays()/lpm_ok(); a no-op with nothing dirty."""
+        if not self._lpm_dirty_lens or not self.lpm_enabled:
+            self._lpm_dirty_lens.clear()
+            return
+        import time as _t
+
+        from vpp_tpu.ops.lpm import LPM_PAD, lpm_field
+
+        t0 = _t.perf_counter()
+        for length in sorted(self._lpm_dirty_lens):
+            cap = self.lpm_caps[length]
+            slots = np.nonzero(self.fib_plen == length)[0]
+            pfx = self.fib_prefix[slots]
+            order = np.argsort(pfx, kind="stable")
+            pfx, slots = pfx[order], slots[order]
+            if len(pfx):
+                keep = np.ones(len(pfx), bool)
+                keep[1:] = pfx[1:] != pfx[:-1]
+                pfx, slots = pfx[keep], slots[keep]
+            n = len(pfx)
+            self.lpm_counts[length] = n
+            field = lpm_field(length)
+            plane = np.zeros((2, cap), np.uint32)
+            plane[0, :] = LPM_PAD
+            nc = min(n, cap)   # overflow => lpm_ok() false, never read
+            plane[0, :nc] = pfx[:nc]
+            plane[1, :nc] = slots[:nc]
+            self.lpm_planes[field] = plane
+            self.lpm_cnt[length] = nc
+            self._fib_dirty.add(field)
+            # stride hint rows of this length (ops/lpm.py): the
+            # insertion point of every top-bits bucket boundary, so
+            # the device bisection starts inside ONE bucket
+            b, off, _steps = self._lpm_layout[length]
+            if off >= 0:
+                bounds = (np.arange((1 << b) + 1, dtype=np.uint64)
+                          << (32 - b))
+                self.lpm_hint[off:off + (1 << b) + 1] = np.searchsorted(
+                    pfx[:nc], bounds).astype(np.int32)
+                self._fib_dirty.add("fib_lpm_hint")
+        self._fib_dirty.add("fib_lpm_cnt")
+        self._lpm_dirty_lens.clear()
+        self.lpm_build_ms = (_t.perf_counter() - t0) * 1e3
+
+    def lpm_ok(self) -> bool:
+        """Whether the LPM implementation can serve THIS staged FIB:
+        planes allocated, and every populated length fits its
+        configured capacity (cap 0 = length not served). False falls
+        the selection ladder back to dense — the BV ok=False pattern."""
+        if not self.lpm_enabled:
+            return False
+        self._restage_lpm()
+        caps = np.asarray(self.lpm_caps, np.int64)
+        return bool((self.lpm_counts <= caps).all())
+
+    def fib_route_count(self) -> int:
+        """Live FIB routes staged (the fib_lpm_min_routes ladder input
+        and the vpp_tpu_fib_routes gauge)."""
+        return int(np.count_nonzero(self.fib_plen >= 0))
 
     # --- NAT ---
     def set_nat_mapping(
@@ -1542,7 +2025,9 @@ class TableBuilder:
     _STATE_ARRAYS = (
         "acl_nrules", "if_type", "if_local_table", "if_apply_global",
         "fib_prefix", "fib_mask", "fib_plen", "fib_tx_if", "fib_disp",
-        "fib_next_hop", "fib_node_id", "fib_snat",
+        "fib_next_hop", "fib_node_id", "fib_snat", "fib_grp",
+        "fib_grp_nh", "fib_grp_tx_if", "fib_grp_node", "fib_grp_n",
+        "lpm_cnt", "lpm_counts", "lpm_hint",
         "nat_ext_ip", "nat_ext_port", "nat_proto", "nat_boff", "nat_bcnt",
         "nat_total_w", "nat_self_snat", "natb_ip", "natb_port",
         "natb_cumw",
@@ -1552,6 +2037,10 @@ class TableBuilder:
         """Copy of the whole staged (host) configuration — cheap numpy
         copies, no device state. Pair with state_restore for
         transactional rollback (pipeline/txn.py)."""
+        # settle lazy LPM staging first so the snapshot's planes are
+        # consistent with its per-slot arrays (restore clears the
+        # dirty-length set on that assumption)
+        self._restage_lpm()
         return {
             "arrays": {k: getattr(self, k).copy()
                        for k in self._STATE_ARRAYS},
@@ -1566,6 +2055,11 @@ class TableBuilder:
             "ml_kind": self.ml_kind,
             "tnt": self.tnt,               # replaced wholesale
             "tenants": {t: dict(e) for t, e in self.tenants.items()},
+            "lpm_planes": {k: v.copy()
+                           for k, v in self.lpm_planes.items()},
+            "nh_groups": {g: {"members": list(e["members"]),
+                              "assign": list(e["assign"])}
+                          for g, e in self.nh_groups.items()},
             "nat_snat_ip": self.nat_snat_ip,
             "dirty": set(self._dirty),
             "rec_ops": list(self._rec.ops) if self._rec is not None else None,
@@ -1590,6 +2084,18 @@ class TableBuilder:
         self.ml_kind = snap["ml_kind"]
         self.tnt = snap["tnt"]
         self.tenants = {t: dict(e) for t, e in snap["tenants"].items()}
+        for k, v in snap["lpm_planes"].items():
+            self.lpm_planes[k][...] = v
+        self.nh_groups = {g: {"members": list(e["members"]),
+                              "assign": list(e["assign"])}
+                          for g, e in snap["nh_groups"].items()}
+        # restored planes are content-consistent with the restored
+        # per-slot arrays (both came from one snapshot), but the device
+        # cache may hold the rolled-back commit — re-ship every fib
+        # field conservatively, and force a full per-slot upload
+        self._lpm_dirty_lens = set()
+        self._fib_dirty = set(_UPLOAD_GROUPS["fib"])
+        self._fib_prev = None
         # the identity-diff caches describe the pre-restore rule list;
         # the next set_global_table must full-recompile. The BV device
         # cache may hold planes of the rolled-back commit — every BV
@@ -1612,7 +2118,9 @@ class TableBuilder:
         """The staged configuration as numpy arrays keyed by
         DataplaneTables field name (everything except session state).
         Used directly by to_device() and, node-stacked, by the cluster
-        data plane (vpp_tpu.parallel.cluster)."""
+        data plane (vpp_tpu.parallel.cluster). Settles the lazy LPM
+        plane staging first (dirty lengths recompile here, once)."""
+        self._restage_lpm()
         return dict(
             acl_src_net=self.acl["src_net"],
             acl_src_mask=self.acl["src_mask"],
@@ -1672,6 +2180,14 @@ class TableBuilder:
             fib_next_hop=self.fib_next_hop,
             fib_node_id=self.fib_node_id,
             fib_snat=self.fib_snat,
+            fib_grp=self.fib_grp,
+            **self.lpm_planes,
+            fib_lpm_cnt=self.lpm_cnt,
+            fib_lpm_hint=self.lpm_hint,
+            fib_grp_nh=self.fib_grp_nh,
+            fib_grp_tx_if=self.fib_grp_tx_if,
+            fib_grp_node=self.fib_grp_node,
+            fib_grp_n=self.fib_grp_n,
             sess_max_age=np.int32(self.config.sess_max_age),
             nat_ext_ip=self.nat_ext_ip,
             nat_ext_port=self.nat_ext_port,
@@ -1728,6 +2244,7 @@ class TableBuilder:
             # buckets refill within one step)
             tel = zero_telemetry_device(self.config)
             tnt_st = zero_tenancy_state_device(self.config)
+            fib_st = zero_fib_state_device(self.config)
         elif sessions is not None:
             # carry-over is BY REFERENCE: the live device arrays flow
             # into the new epoch untouched — at 10M slots the session
@@ -1739,16 +2256,22 @@ class TableBuilder:
             tel = {f: getattr(sessions, f) for f in TELEMETRY_FIELDS}
             tnt_st = {f: getattr(sessions, f)
                       for f in TENANCY_STATE_FIELDS}
+            fib_st = {f: getattr(sessions, f) for f in FIB_STATE_FIELDS}
         else:
             # device-side zero fill, not a host upload of zeros
             sess = zero_sessions_device(self.config)
             tel = zero_telemetry_device(self.config)
             tnt_st = zero_tenancy_state_device(self.config)
+            fib_st = zero_fib_state_device(self.config)
         host_np = self.host_arrays()
         host = {}
         glb_full = False
+        self.fib_last_shipped = False
         for group, fields in _UPLOAD_GROUPS.items():
             dirty = group in self._dirty
+            if group == "fib":
+                self._upload_fib(host, host_np, fields, dirty)
+                continue
             if group == "glb_bv":
                 # per-dimension-plane upload: only planes compile_bv
                 # rebuilt since the last to_device re-ship (a port-only
@@ -1782,7 +2305,8 @@ class TableBuilder:
             # no-op while the device serves stale rules
             self._set_glb_prev(host_np)
         self._dirty.clear()
-        return DataplaneTables(**host, **sess, **tel, **tnt_st)
+        return DataplaneTables(**host, **sess, **tel, **tnt_st,
+                               **fib_st)
 
     def _set_glb_prev(self, host_np: Dict[str, np.ndarray]) -> None:
         """Record the diff base for incremental glb commits. The ROW
@@ -1870,3 +2394,89 @@ class TableBuilder:
         # base refreshed only now — after every device call succeeded
         self._set_glb_prev(host_np)
         return True
+
+    # --- FIB upload (per-length planes + incremental slot blob) ---
+    def _upload_fib(self, host: Dict[str, object],
+                    host_np: Dict[str, np.ndarray],
+                    fields: Tuple[str, ...], dirty: bool) -> None:
+        """The "fib" group's to_device body (ISSUE 15): per-slot row
+        arrays go through the incremental scatter-blob path when the
+        commit's changes confine to a block (a route flap ships a
+        few-KB blob, not 9 full columns); the per-length LPM planes
+        and ECMP tables re-ship only when ``_fib_dirty`` names them —
+        every other plane keeps its cached device-array identity.
+        Records ``fib_upload`` for `show fib` / fib_bench."""
+        import time as _t
+
+        t0 = _t.perf_counter()
+        shipped = []
+        blob_bytes = 0
+        slot_inc = False
+        if dirty:
+            blob_bytes = self._fib_incremental(host_np)
+            slot_inc = blob_bytes is not None
+        for name in fields:
+            if name in _FIB_SLOT_FIELDS and slot_inc:
+                # the blob already scattered this field's block into
+                # the cached device array
+                host[name] = self._dev_cache[name]
+                continue
+            if (dirty and name in self._fib_dirty) \
+                    or name not in self._dev_cache:
+                self._dev_cache[name] = jnp.asarray(host_np[name])
+                shipped.append(name)
+            host[name] = self._dev_cache[name]
+        if dirty and not slot_inc:
+            # full per-slot upload above: refresh the diff base only
+            # after every device transfer succeeded (the glb rule)
+            self._set_fib_prev(host_np)
+        if dirty:
+            self.fib_last_shipped = True
+            self.fib_upload = {
+                "fields": tuple(shipped),
+                "blob_bytes": int(blob_bytes or 0),
+                "bytes": int(sum(host_np[f].nbytes for f in shipped)
+                             + (blob_bytes or 0)),
+                "ms": (_t.perf_counter() - t0) * 1e3,
+            }
+            self._fib_dirty.clear()
+
+    def _set_fib_prev(self, host_np: Dict[str, np.ndarray]) -> None:
+        """Record the per-slot diff base (COPIES — state_restore
+        writes the live arrays in place, the _set_glb_prev rationale)."""
+        self._fib_prev = {f: host_np[f].copy() for f in _FIB_SLOT_FIELDS}
+
+    def _fib_incremental(self, host_np: Dict[str, np.ndarray]):
+        """Try an incremental device update of the per-slot FIB rows:
+        diff against the last-uploaded arrays; when the changes
+        confine to a block, upload ONE packed blob and scatter it into
+        the cached device arrays (_fib_update_fn). Returns the blob's
+        byte count on success (0 = content-identical commit), None to
+        fall back to a full upload. The diff base refreshes only on
+        success — a failed device call never desyncs it."""
+        prev = self._fib_prev
+        if prev is None or any(
+            f not in self._dev_cache for f in _FIB_SLOT_FIELDS
+        ):
+            return None
+        n = host_np["fib_plen"].shape[0]
+        changed = np.zeros(n, bool)
+        for f in _FIB_SLOT_FIELDS:
+            changed |= prev[f] != host_np[f]
+        blk = _block_of(changed, n)
+        if blk is None:
+            return 0   # content-identical commit: nothing to ship
+        lo, w = blk
+        if w >= n:
+            return None  # change spans the table: full upload is best
+        nf = len(_FIB_SLOT_FIELDS)
+        blob = np.empty(nf * w, np.int32)
+        for i, f in enumerate(_FIB_SLOT_FIELDS):
+            blob[i * w:(i + 1) * w] = host_np[f][lo:lo + w].view(np.int32)
+        fn = _fib_update_fn(w)
+        new_rows = fn([self._dev_cache[f] for f in _FIB_SLOT_FIELDS],
+                      jnp.asarray(blob), lo)
+        for f, arr in zip(_FIB_SLOT_FIELDS, new_rows):
+            self._dev_cache[f] = arr
+        self._set_fib_prev(host_np)
+        return blob.nbytes

@@ -68,6 +68,7 @@ def rule_from_dict(d: Dict[str, Any]) -> ContivRule:
 # skin over the builder, so the set of legal ops IS the builder API
 _OPS = (
     "set_interface", "set_if_local_table", "add_route", "del_route",
+    "set_nh_group", "del_nh_group",
     "set_local_table", "clear_local_table", "set_global_table",
     "set_nat_mapping", "clear_nat", "set_snat_ip",
     "set_ml_model", "clear_ml_model",
@@ -105,19 +106,36 @@ class ConfigTxn:
     def add_route(self, prefix: str, tx_if: int, disposition: int,
                   next_hop: int = 0, node_id: int = -1,
                   snat: bool = False,
-                  slot: Optional[int] = None) -> "ConfigTxn":
+                  slot: Optional[int] = None,
+                  group: Optional[int] = None) -> "ConfigTxn":
         """``slot`` pins the FIB slot (recorded from the builder's
         resolved placement, so replay reproduces byte-identical
-        tables); None lets replay allocate."""
+        tables); None lets replay allocate. ``group`` names an ECMP
+        next-hop group (ISSUE 15)."""
         kw = dict(prefix=prefix, tx_if=tx_if,
                   disposition=int(disposition), next_hop=next_hop,
                   node_id=node_id, snat=bool(snat))
         if slot is not None:
             kw["slot"] = int(slot)
+        if group is not None:
+            kw["group"] = int(group)
         return self._record("add_route", **kw)
 
     def del_route(self, prefix: str) -> "ConfigTxn":
         return self._record("del_route", prefix=prefix)
+
+    # --- ECMP next-hop groups (ISSUE 15) ---
+    def set_nh_group(self, gid: int, members) -> "ConfigTxn":
+        """``members`` is the distinct member list as
+        TableBuilder.set_nh_group normalizes it — plain JSON rows
+        ``[next_hop, tx_if, node_id]``. Replay reruns the sticky way
+        fill deterministically (the same registry always compiles the
+        same assignment)."""
+        return self._record("set_nh_group", gid=int(gid),
+                            members=[list(m) for m in members])
+
+    def del_nh_group(self, gid: int) -> "ConfigTxn":
+        return self._record("del_nh_group", gid=int(gid))
 
     def set_local_table(self, slot: int,
                         rules: Sequence[ContivRule]) -> "ConfigTxn":

@@ -243,6 +243,11 @@ GOVERNOR_STAT_GAUGES = (
 # while no model is staged even under a score/enforce knob)
 ML_STAGE_MODES = ("off", "score", "enforce")
 
+# FIB lookup implementations the vpp_tpu_fib_impl info gauge
+# enumerates (Dataplane.fib_impl; ops/fib.py dense, ops/lpm.py —
+# ISSUE 15).
+FIB_IMPLS = ("dense", "lpm")
+
 PUMP_GAUGES = tuple(
     (name, help_) for _, name, help_ in PUMP_STAT_GAUGES
 ) + (
@@ -755,6 +760,61 @@ class StatsCollector:
                   "device-telemetry plane mode (info-style: mode "
                   "label, 1 = active; off compiles the plane out)"),
         )
+        # FIB routing surface (ISSUE 15; ops/lpm.py, ops/fib.py): the
+        # impl info gauge (the classifier-gauge twin), route/scale
+        # gauges, the route-churn commit-cost histogram (observed by
+        # Dataplane.swap whenever a swap actually re-shipped FIB
+        # state) and the per-member ECMP accounting family
+        # (group=/member= labels; a deleted group's labelsets are
+        # removed on the next publish — the tenant discipline).
+        self.fib_impl_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_fib_impl",
+                  "selected ip4-lookup implementation (info-style: "
+                  "impl label, 1 = active; lpm = per-length "
+                  "binary-search planes)"),
+        )
+        self.fib_routes_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_fib_routes",
+                  "live routes staged in the FIB"),
+        )
+        self.fib_lengths_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_fib_populated_lengths",
+                  "prefix lengths with at least one live route (the "
+                  "LPM lookup walks populated lengths only)"),
+        )
+        self.fib_groups_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_fib_ecmp_groups",
+                  "ECMP next-hop groups staged"),
+        )
+        self.fib_plane_bytes_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_fib_plane_bytes",
+                  "device bytes allocated to the LPM per-length "
+                  "prefix planes"),
+        )
+        self.fib_ecmp_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_fib_ecmp_packets",
+                  "packets forwarded per ECMP group member (device "
+                  "accounting plane, by group and member next-hop)",
+                  kind="counter"),
+        )
+        self.fib_churn_hist = self.registry.register(
+            STATS_PATH,
+            Histogram(
+                "vpp_tpu_fib_churn_commit_seconds",
+                "host+upload cost of FIB-group commits that re-shipped "
+                "route state (a flap should ship one length plane + a "
+                "slot blob, bounded ms)",
+                buckets=(0.0005, 0.002, 0.01, 0.05, 0.2, 1.0, 5.0),
+            ),
+        )
+        dataplane.fib_churn_hist = self.fib_churn_hist
+        self._fib_pub_members: set = set()
         # sanity anchor for every scrape-side consumer: a constant-1
         # info gauge carrying the build/runtime identity labels
         # (ISSUE 11 satellite). Published per collect so the
@@ -1005,6 +1065,38 @@ class StatsCollector:
         for name in CLASSIFIER_IMPLS:
             self.classifier_gauge.set(
                 1.0 if name == impl else 0.0, impl=name)
+        # FIB routing surface (ISSUE 15): selection, scale, per-member
+        # ECMP accounting — host scalars + one small [G, W] fetch
+        fib_fn = getattr(self.dp, "fib_snapshot", None)
+        fib = fib_fn() if callable(fib_fn) else None
+        if fib is not None:
+            from vpp_tpu.pipeline.vector import ip4_str
+
+            for name in FIB_IMPLS:
+                self.fib_impl_gauge.set(
+                    1.0 if name == fib["impl"] else 0.0, impl=name)
+            self.fib_routes_gauge.set(float(fib["routes"]))
+            self.fib_lengths_gauge.set(float(len(fib["by_length"])))
+            self.fib_groups_gauge.set(float(len(fib["ecmp_groups"])))
+            self.fib_plane_bytes_gauge.set(float(fib["plane_bytes"]))
+            pub = set()
+            for gid, members in fib["ecmp_groups"].items():
+                for m in members:
+                    # the FULL member identity labels the series —
+                    # two members sharing (ip, if) but not node must
+                    # not collapse into one labelset
+                    labels = (str(gid),
+                              f"{ip4_str(m['nh'])}:if{m['tx_if']}"
+                              f":n{m['node']}")
+                    pub.add(labels)
+                    self.fib_ecmp_gauge.set(
+                        float(m["pkts"]),
+                        group=labels[0], member=labels[1])
+            # a withdrawn group/member's series must disappear, not
+            # freeze at its last count (the tenant/build_info rule)
+            for group, member in self._fib_pub_members - pub:
+                self.fib_ecmp_gauge.remove(group=group, member=member)
+            self._fib_pub_members = pub
         # partition-rule layer (ISSUE 12): field placements from the
         # ONE manifest; per-shard residency/bytes only with a live
         # cluster attached (scalars cross the transport, never columns)
