@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-race chaos lint verify bench all
+.PHONY: test test-race chaos lint verify bench autotune autotune-check all
 
 all: lint test
 
@@ -40,3 +40,13 @@ lint:
 # Driver-facing headline benchmark (real TPU; one JSON line).
 bench:
 	$(PY) bench.py
+
+# Config-knob autotuner (ISSUE 16; tools/autotune.py): sweep the
+# backend-dependent geometry knobs and write tuned/<backend>.json.
+autotune:
+	$(PY) tools/autotune.py
+
+# Validate the committed CPU profile round-trips through the SAME
+# config loader the agent boots with (knobs land, floor clamps).
+autotune-check:
+	$(PY) tools/autotune.py --check tuned/cpu.json

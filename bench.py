@@ -1716,6 +1716,106 @@ def session_election_bench(args, batch: int = 2048, iters: int = 30) -> dict:
     return out
 
 
+def pallas_kernel_bench(args, batch: int = 2048, iters: int = 20) -> dict:
+    """Pallas kernel shoot-out (ISSUE 16): time the fused rungs of the
+    three gather-bound hot ops against their jnp reference rungs on
+    this backend, and record whether the pair is bit-exact. On a TPU
+    the kernels compile natively (the perf claim); elsewhere they run
+    in INTERPRET mode at a reduced batch — an emulator priced per
+    lowered op, so those ns/pkt rows validate semantics cost, not
+    speed (``pallas_interpret`` = 1 marks the regime). Keys:
+    pallas_{bv,lpm,sess}_ns_pkt + *_ref_ns_pkt + *_bitexact."""
+    import functools as _ft
+
+    import jax as _jax
+    import jax.numpy as jnp
+
+    from vpp_tpu.ops._pallas import pallas_available, use_pallas
+    from vpp_tpu.ops.acl_bv import bv_first_match, bv_first_match_fused
+    from vpp_tpu.ops.lpm import _fib_lookup_lpm_pallas, fib_lookup_lpm
+    from vpp_tpu.ops.session import _probe_ways_reference, sess_probe_ways
+    from vpp_tpu.pipeline.dataplane import Dataplane
+    from vpp_tpu.pipeline.tables import DataplaneConfig
+    from vpp_tpu.pipeline.vector import Disposition
+
+    on_tpu = use_pallas()
+    interpret = not on_tpu
+    if interpret:
+        batch, iters = 256, 3
+    out = {"pallas_backend": _jax.default_backend(),
+           "pallas_available": int(pallas_available()),
+           "pallas_interpret": int(interpret)}
+    if not pallas_available():
+        return out
+
+    dp = Dataplane(DataplaneConfig(
+        max_tables=2, max_rules=16, max_global_rules=64, max_ifaces=8,
+        fib_slots=256, sess_slots=1 << 12, nat_mappings=4,
+        nat_backends=4, classifier="bv", fib_impl="lpm"))
+    uplink = dp.add_uplink()
+    rules = build_rules(48)
+    dp.builder.set_global_table(rules)
+    rng = np.random.default_rng(5)
+    for i in range(60):
+        plen = int(rng.choice([8, 16, 24, 24, 32]))
+        net = int(rng.integers(0, 1 << 32)) & (0xFFFFFFFF << (32 - plen))
+        dp.builder.add_route(
+            f"{net >> 24 & 255}.{net >> 16 & 255}."
+            f"{net >> 8 & 255}.{net & 255}/{plen}",
+            1, Disposition.LOCAL)
+    dp.swap()
+    tables = dp.tables
+    pkts = build_traffic(batch, uplink, seed=21)
+
+    def ns_pkt(fn, *a):
+        r = fn(*a)
+        _jax.block_until_ready(r)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = fn(*a)
+        _jax.block_until_ready(r)
+        return round((time.perf_counter() - t0) / iters / batch * 1e9,
+                     1), r
+
+    bv_args = (
+        tables.glb_bv_bnd_src, tables.glb_bv_bnd_dst,
+        tables.glb_bv_bnd_sport, tables.glb_bv_bnd_dport,
+        tables.glb_bv_nbnd, tables.glb_bv_src, tables.glb_bv_dst,
+        tables.glb_bv_sport, tables.glb_bv_dport, tables.glb_bv_proto,
+        pkts)
+    out["pallas_bv_ns_pkt"], got = ns_pkt(
+        _jax.jit(_ft.partial(bv_first_match_fused, interpret=interpret)),
+        *bv_args)
+    out["pallas_bv_ref_ns_pkt"], ref = ns_pkt(_jax.jit(bv_first_match),
+                                              *bv_args)
+    out["pallas_bv_bitexact"] = int(
+        bool(jnp.all(got[0] == ref[0]) & jnp.all(got[1] == ref[1])))
+
+    out["pallas_lpm_ns_pkt"], got = ns_pkt(
+        _jax.jit(_ft.partial(_fib_lookup_lpm_pallas, interpret=interpret)),
+        tables, pkts)
+    out["pallas_lpm_ref_ns_pkt"], ref = ns_pkt(_jax.jit(fib_lookup_lpm),
+                                               tables, pkts)
+    out["pallas_lpm_bitexact"] = int(all(
+        bool(jnp.all(g == r)) for g, r in zip(got, ref)))
+
+    nb, ways = tables.sess_valid.shape
+    b = jnp.asarray(rng.integers(0, nb, batch).astype(np.int32))
+    keys = [jnp.asarray(rng.integers(0, 1 << 32, batch, dtype=np.uint64)
+                        .astype(np.uint32)) for _ in range(4)]
+    sess_args = (b, *keys, tables.sess_valid, tables.sess_src,
+                 tables.sess_dst, tables.sess_ports, tables.sess_proto,
+                 tables.sess_time, jnp.int32(0), jnp.int32(1 << 30))
+    out["pallas_sess_ns_pkt"], got = ns_pkt(
+        _ft.partial(sess_probe_ways, interpret=interpret), *sess_args)
+    out["pallas_sess_ref_ns_pkt"], ref = ns_pkt(
+        _jax.jit(_probe_ways_reference), *sess_args)
+    out["pallas_sess_bitexact"] = int(
+        bool(jnp.all(got[0] == ref[0]) & jnp.all(got[1] == ref[1])))
+    out["pallas_sess_ways"] = int(ways)
+    return out
+
+
 def _mem_available_bytes() -> int:
     """Best-effort MemAvailable (0 when unreadable) — gates the
     10M-session scale config so a small CI box downshifts instead of
@@ -3296,6 +3396,26 @@ SUPERVISE_STALL_S = 480.0
 SUPERVISE_TOTAL_S = 2700.0
 
 
+def _autotune_profile():
+    """The committed tuned/<backend>.json knobs, if the repo carries a
+    profile for this backend (None otherwise) — so a bench round and
+    the config a deployment would boot with land in one JSON line."""
+    try:
+        import jax as _jax
+
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "tuned", f"{_jax.default_backend()}.json")
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            prof = json.load(f)
+        return {"path": os.path.relpath(path, os.getcwd()),
+                "knobs": prof.get("knobs"),
+                "floor_us": prof.get("floor_us")}
+    except Exception as e:  # noqa: BLE001 — additive, never fatal
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _read_json(path: str) -> dict:
     try:
         with open(path) as f:
@@ -3584,6 +3704,17 @@ def _run():
         pri["fib_bench_error"] = f"{type(e).__name__}: {e}"
     _jc_now = _jit_compiles_now()
     pri["fib_jit_compiles"] = _jc_now - _jc
+    _jc = _jc_now
+    _progress(**pri)
+    try:
+        # pallas kernel rungs (ISSUE 16): fused vs reference ns/pkt +
+        # bit-exactness for the three gather-bound hot ops — native on
+        # TPU, interpret-mode semantics pricing elsewhere
+        pri.update(pallas_kernel_bench(args))
+    except Exception as e:  # noqa: BLE001
+        pri["pallas_kernel_bench_error"] = f"{type(e).__name__}: {e}"
+    _jc_now = _jit_compiles_now()
+    pri["pallas_kernel_jit_compiles"] = _jc_now - _jc
     _jc = _jc_now
     _progress(**pri)
     try:
@@ -3876,6 +4007,11 @@ def _run():
                     # the whole-run total (flat across rounds unless a
                     # recompile regression landed)
                     "jit_compiles_total": _jit_compiles_now(),
+                    # committed autotuner profile for this backend
+                    # (tools/autotune.py; ISSUE 16) — the knobs a
+                    # deployment loading tuned/<backend>.json would
+                    # run with, alongside the numbers measured here
+                    "autotune_profile": _autotune_profile(),
                     "backend": jax.default_backend(),
                     # wire-path numbers are host-CPU-bound too: on a
                     # 1-core host the sender/daemon/pump/receiver AND

@@ -51,6 +51,18 @@ JIT_SITES = {
         "once per mesh by ClusterDataplane",
     ("vpp_tpu/ops/acl_mxu.py", "@mxu_first_match"):
         "pallas first-match kernel entry; static interpret flag only",
+    ("vpp_tpu/ops/acl_bv.py", "@bv_first_set"):
+        "pallas BV word-AND + first-set-bit kernel entry (ISSUE 16); "
+        "static interpret flag only — the fused rung gathers segment "
+        "rows on-device and reduces them in VMEM tiles",
+    ("vpp_tpu/ops/lpm.py", "@lpm_fused_lookup"):
+        "pallas LPM binary-search kernel entry (ISSUE 16): one grid "
+        "fused over the populated length planes, longest-first "
+        "first-hit-wins accumulation; static interpret flag only",
+    ("vpp_tpu/ops/session.py", "@sess_probe_ways"):
+        "pallas session bucket-probe kernel entry (ISSUE 16): whole "
+        "key columns staged to VMEM, per-packet way election in-core; "
+        "static interpret flag only",
     ("vpp_tpu/pipeline/snapshot.py", "_fetch_fn"):
         "bounded chunk drain for the crash-consistent session "
         "snapshot (ISSUE 8): one [C, CB, W] stacked fetch per chunk, "
@@ -123,6 +135,7 @@ TRACED_ROOTS = {
     # step factory's _fib_fn indirection (the _classifier_fns twin),
     # so the reachability closure needs them named explicitly
     ("vpp_tpu/ops/lpm.py", "fib_lookup_lpm"),
+    ("vpp_tpu/ops/lpm.py", "fib_lookup_lpm_fused"),
     ("vpp_tpu/ops/fib.py", "fib_lookup_dense"),
     ("vpp_tpu/ops/fib.py", "resolve_fib_slot"),
     ("vpp_tpu/ops/fib.py", "fib_flow_mix"),
@@ -135,6 +148,8 @@ TRACED_ROOTS = {
     ("vpp_tpu/ops/acl_mxu.py", "acl_classify_global_mxu"),
     ("vpp_tpu/ops/acl_bv.py", "acl_classify_global_bv"),
     ("vpp_tpu/ops/acl_bv.py", "acl_classify_local_bv"),
+    ("vpp_tpu/ops/acl_bv.py", "acl_classify_global_pallas"),
+    ("vpp_tpu/ops/acl_bv.py", "acl_classify_local_pallas"),
     # mesh-sharded classify substitutions (parallel/cluster.py body)
     ("vpp_tpu/parallel/cluster.py", "sharded_global_classify"),
     ("vpp_tpu/parallel/cluster.py", "sharded_global_classify_mxu"),
@@ -152,4 +167,51 @@ TRACED_ROOTS = {
     ("vpp_tpu/tenancy/derive.py", "tnt_account"),
     ("vpp_tpu/tenancy/derive.py", "_tenant_occupancy_impl"),
     ("vpp_tpu/ops/session.py", "tenant_bucket"),
+}
+
+# --- the Pallas kernel registry (ISSUE 16) ---------------------------
+# Every ``pl.pallas_call`` entry point in the tree, ENUMERATED with the
+# DataplaneTables fields its operands are built from and the ladder
+# knob that selects it. The --partitions lint
+# (tools/analysis/registries.py) walks this: each entry must import,
+# each named field must resolve in the partition spec, and the knob
+# must be REJECTED by validate_partitioning on a rule-sharded mesh
+# until a PARTITION_RULES spec covers the fused kernel — a pallas rung
+# must never fail inside pallas_call at trace time.
+#
+# (relpath, jit-entry scope) -> {"fn": dispatch-root qualname,
+#                                "knob": config knob that selects it,
+#                                "fields": DataplaneTables operands}
+PALLAS_KERNELS = {
+    ("vpp_tpu/ops/acl_mxu.py", "@mxu_first_match"): {
+        "fn": "acl_classify_global_mxu",
+        "knob": "classifier",
+        "fields": ("glb_mxu_coeff", "glb_mxu_k", "glb_mxu_act"),
+    },
+    ("vpp_tpu/ops/acl_bv.py", "@bv_first_set"): {
+        "fn": "acl_classify_global_pallas",
+        "knob": "classifier",
+        "fields": (
+            "glb_bv_bnd_src", "glb_bv_bnd_dst", "glb_bv_bnd_sport",
+            "glb_bv_bnd_dport", "glb_bv_nbnd", "glb_bv_src",
+            "glb_bv_dst", "glb_bv_sport", "glb_bv_dport",
+            "glb_bv_proto",
+            "acl_bv_bnd_src", "acl_bv_bnd_dst", "acl_bv_bnd_sport",
+            "acl_bv_bnd_dport", "acl_bv_nbnd", "acl_bv_src",
+            "acl_bv_dst", "acl_bv_sport", "acl_bv_dport",
+            "acl_bv_proto",
+        ),
+    },
+    ("vpp_tpu/ops/lpm.py", "@lpm_fused_lookup"): {
+        "fn": "fib_lookup_lpm_fused",
+        "knob": "fib_impl",
+        "fields": tuple(f"fib_lpm_p{i}" for i in range(33))
+        + ("fib_lpm_cnt",),
+    },
+    ("vpp_tpu/ops/session.py", "@sess_probe_ways"): {
+        "fn": "_sess_probe_dispatch",
+        "knob": "session_impl",
+        "fields": ("sess_valid", "sess_src", "sess_dst", "sess_ports",
+                   "sess_proto", "sess_time"),
+    },
 }

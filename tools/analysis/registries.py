@@ -167,11 +167,101 @@ def partitions_lint() -> list:
     vpp_tpu/parallel/partition.py (sharded or replicated-by-design),
     and every rule must match at least one field (stale rules are
     findings). Pure import — no jax arrays touched. Run from tier-1
-    via tests/test_partition.py."""
+    via tests/test_partition.py. ISSUE 16 folds the Pallas-kernel pass
+    in: every PALLAS_KERNELS entry must import, its table operands
+    must resolve in the partition spec, and its knob must be REJECTED
+    at config time on a rule-sharded mesh (never fail inside
+    pallas_call)."""
     _repo_on_path()
     from vpp_tpu.parallel.partition import partition_lint
 
-    return partition_lint()
+    return partition_lint() + _pallas_kernel_problems()
+
+
+def _pallas_kernel_problems() -> list:
+    """The Pallas side of the --partitions pass (ISSUE 16): walk
+    tools/analysis/jit_manifest.py PALLAS_KERNELS and verify, per
+    kernel, that (a) its jit entry and dispatch root import from the
+    named module, (b) every DataplaneTables field its operands are
+    built from resolves to an explicit partition rule, and (c) an
+    explicit pallas knob on a rule-sharded mesh is rejected by
+    validate_partitioning with an error naming PARTITION_RULES — a
+    kernel whose operands would arrive sharded must be turned away at
+    config time, not crash at trace time inside pallas_call."""
+    _repo_on_path()
+    import importlib
+
+    from analysis.jit_manifest import JIT_SITES, PALLAS_KERNELS
+    from vpp_tpu.parallel.partition import (
+        PartitionError,
+        spec_for,
+        validate_partitioning,
+    )
+    from vpp_tpu.pipeline.tables import DataplaneConfig, DataplaneTables
+
+    problems = []
+    for (relpath, scope), entry in sorted(PALLAS_KERNELS.items()):
+        name = f"{relpath}:{scope}"
+        if (relpath, scope) not in JIT_SITES:
+            problems.append(
+                f"partitions: pallas kernel {name} is not a registered "
+                "JIT_SITES entry (jit manifest desynced)")
+        modname = relpath[:-3].replace("/", ".")
+        try:
+            mod = importlib.import_module(modname)
+        except Exception as e:  # noqa: BLE001 - lint reports, not raises
+            problems.append(
+                f"partitions: pallas kernel {name} module import "
+                f"failed: {e}")
+            continue
+        for attr in (scope.lstrip("@"), entry["fn"]):
+            if not callable(getattr(mod, attr, None)):
+                problems.append(
+                    f"partitions: pallas kernel {name} names "
+                    f"{attr!r} which {modname} does not define")
+        for f in entry["fields"]:
+            if f not in DataplaneTables._fields:
+                problems.append(
+                    f"partitions: pallas kernel {name} operand {f!r} "
+                    "is not a DataplaneTables field (stale entry?)")
+                continue
+            try:
+                spec_for(f)
+            except PartitionError as e:
+                problems.append(
+                    f"partitions: pallas kernel {name} operand {f!r} "
+                    f"has no partition rule: {e}")
+    # mesh rejection: every pallas-selecting knob, on a 2-way
+    # rule-sharded mesh, must raise at config time with an error that
+    # points the operator at PARTITION_RULES
+    base = dict(max_tables=2, max_rules=8, max_global_rules=8,
+                max_ifaces=8, fib_slots=16, sess_slots=64,
+                nat_mappings=2, nat_backends=4)
+    knobs = sorted({e["knob"] for e in PALLAS_KERNELS.values()})
+    for knob in knobs:
+        cfg = DataplaneConfig(**base, **{knob: "pallas"})
+        try:
+            validate_partitioning(cfg, rule_shards=2)
+        except ValueError as e:
+            if "PARTITION_RULES" not in str(e):
+                problems.append(
+                    f"partitions: mesh rejection of {knob}='pallas' "
+                    "does not name PARTITION_RULES (operator has no "
+                    f"pointer to the fix): {e}")
+        else:
+            problems.append(
+                f"partitions: {knob}='pallas' on a rule-sharded mesh "
+                "was NOT rejected by validate_partitioning — the step "
+                "would fail inside pallas_call at trace time")
+        # the same knob on an unsharded mesh must pass (standalone
+        # pallas is the supported deployment)
+        try:
+            validate_partitioning(cfg, rule_shards=1)
+        except ValueError as e:
+            problems.append(
+                f"partitions: {knob}='pallas' rejected even without "
+                f"rule sharding: {e}")
+    return problems
 
 
 def _bv_plane_problems(name: str, bv, nrules: int, max_rules: int) -> list:

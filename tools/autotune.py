@@ -1,0 +1,411 @@
+#!/usr/bin/env python
+"""Config-knob autotuner (ISSUE 16): sweep the geometry knobs whose
+best value is a property of the BACKEND, not of the policy — measure
+each candidate with the real fused step / lookup / ring machinery and
+emit a per-backend profile the agent loads as per-key DEFAULTS
+(``tuned_profile:`` in the YAML; cmd/config.py apply_tuned_profile —
+any knob the YAML sets explicitly wins).
+
+Swept knobs:
+
+  dataplane.sess_ways            {2, 4, 8}        fused-step ns/pkt
+  dataplane.telemetry_sketch_*   (rows, cols) grid; "full"-telemetry
+                                 step ns/pkt (the count-min geometry
+                                 trades accuracy for VMEM bandwidth)
+  env.VPPT_LPM_HINT_MIN          {1024, 8192, 65536}  LPM lookup
+                                 ns/pkt (the stride-hint engage
+                                 threshold: ops/lpm.py lpm_hint_min)
+  io.io_ring_slots/_windows      {(8,2), (16,2), (16,4)}  persistent
+                                 single-window exchange µs
+
+The profile's ``floor_us`` is the measured p50 single-frame step
+latency at the tuned knobs — the governor's achievable-latency floor:
+``io.latency_slo_us`` below it is clamped UP at config load (an SLO
+the hardware cannot meet pins the governor at the 1-slot floor
+forever, shedding for nothing).
+
+Profile shape (tuned/<backend>.json)::
+
+    {"backend": "...", "generated_by": "tools/autotune.py",
+     "knobs": {"dataplane": {...}, "io": {...}, "env": {...}},
+     "measured": {...per-candidate numbers...}, "floor_us": ...}
+
+``--check <path>`` validates a committed profile round-trips through
+AgentConfig.from_dict (every knob lands on the built config; shape
+and section errors are refused) — ``make autotune-check`` runs it
+against the committed tuned/cpu.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+import numpy as np  # noqa: E402
+
+
+# ---------------------------------------------------------------- sweep
+
+def _build_dp(**overrides):
+    from vpp_tpu.pipeline.dataplane import Dataplane
+    from vpp_tpu.pipeline.tables import DataplaneConfig
+    from vpp_tpu.pipeline.vector import Disposition
+
+    cfg = DataplaneConfig(
+        max_tables=2, max_rules=16, max_global_rules=64, max_ifaces=8,
+        fib_slots=256, sess_slots=1 << 12, nat_mappings=4,
+        nat_backends=4, **overrides)
+    dp = Dataplane(cfg)
+    uplink = dp.add_uplink()
+    dp.builder.add_route("10.1.1.0/24", 1, Disposition.LOCAL)
+    dp.builder.add_route("0.0.0.0/0", uplink, Disposition.REMOTE,
+                         node_id=1)
+    dp.swap()
+    return dp, uplink
+
+
+def _traffic(n, uplink, seed=7):
+    import jax.numpy as jnp
+
+    from vpp_tpu.pipeline.vector import FLAG_VALID, PacketVector, ip4
+
+    rng = np.random.default_rng(seed)
+    return PacketVector(
+        src_ip=jnp.asarray(rng.integers(1, 1 << 30, n).astype(np.uint32)),
+        dst_ip=jnp.asarray((ip4("10.1.1.0")
+                            + rng.integers(2, 250, n)).astype(np.uint32)),
+        proto=jnp.full((n,), 6, jnp.int32),
+        sport=jnp.asarray(rng.integers(1024, 65000, n).astype(np.int32)),
+        dport=jnp.full((n,), 80, jnp.int32),
+        ttl=jnp.full((n,), 64, jnp.int32),
+        pkt_len=jnp.full((n,), 512, jnp.int32),
+        rx_if=jnp.full((n,), uplink, jnp.int32),
+        flags=jnp.full((n,), FLAG_VALID, jnp.int32),
+    )
+
+
+def _step_ns_pkt(dp, pkts, batch, iters, warmup=2):
+    import jax
+
+    for i in range(warmup):
+        r = dp.process(pkts, now=1 + i)
+    jax.block_until_ready(r.disp)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        r = dp.process(pkts, now=10 + i)
+    jax.block_until_ready(r.disp)
+    return (time.perf_counter() - t0) / iters / batch * 1e9
+
+
+def sweep_sess_ways(batch, iters, log):
+    """Set-associativity of the session table: more ways = fewer
+    collision misses but a wider probe/election per packet."""
+    measured = {}
+    for ways in (2, 4, 8):
+        dp, uplink = _build_dp(sess_ways=ways)
+        pkts = _traffic(batch, uplink)
+        measured[str(ways)] = round(_step_ns_pkt(dp, pkts, batch, iters), 1)
+        log(f"  sess_ways={ways}: {measured[str(ways)]} ns/pkt")
+    best = min(measured, key=lambda k: measured[k])
+    return int(best), measured
+
+
+def sweep_sketch(batch, iters, log):
+    """Count-min sketch geometry under "full" telemetry: depth buys
+    collision confidence, width buys per-row accuracy — both cost
+    VMEM bandwidth in the fused step."""
+    measured = {}
+    for rows, cols in ((2, 2048), (4, 4096), (4, 8192)):
+        dp, uplink = _build_dp(telemetry="full",
+                               telemetry_sketch_rows=rows,
+                               telemetry_sketch_cols=cols)
+        pkts = _traffic(batch, uplink)
+        measured[f"{rows}x{cols}"] = round(
+            _step_ns_pkt(dp, pkts, batch, iters), 1)
+        log(f"  sketch {rows}x{cols}: {measured[f'{rows}x{cols}']} ns/pkt")
+    best = min(measured, key=lambda k: measured[k])
+    r, c = (int(x) for x in best.split("x"))
+    return (r, c), measured
+
+
+def sweep_lpm_hint(batch, iters, log):
+    """Stride-hint engage threshold (ops/lpm.py lpm_hint_min): hints
+    shrink the per-length bisection at the cost of one extra gather —
+    below some plane size the full bisection is already cheaper."""
+    import jax
+
+    from vpp_tpu.ops.lpm import fib_lookup_lpm
+    from vpp_tpu.pipeline.vector import Disposition
+
+    measured = {}
+    saved = os.environ.get("VPPT_LPM_HINT_MIN")
+    try:
+        for hint_min in (1024, 8192, 65536):
+            os.environ["VPPT_LPM_HINT_MIN"] = str(hint_min)
+            dp, uplink = _build_dp(fib_impl="lpm")
+            rng = np.random.default_rng(5)
+            for _ in range(60):
+                plen = int(rng.choice([8, 16, 24, 24, 32]))
+                net = (int(rng.integers(0, 1 << 32))
+                       & (0xFFFFFFFF << (32 - plen)))
+                dp.builder.add_route(
+                    f"{net >> 24 & 255}.{net >> 16 & 255}."
+                    f"{net >> 8 & 255}.{net & 255}/{plen}",
+                    1, Disposition.LOCAL)
+            dp.swap()
+            pkts = _traffic(batch, uplink, seed=9)
+            fn = jax.jit(fib_lookup_lpm)
+            jax.block_until_ready(fn(dp.tables, pkts))
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                r = fn(dp.tables, pkts)
+            jax.block_until_ready(r)
+            measured[str(hint_min)] = round(
+                (time.perf_counter() - t0) / iters / batch * 1e9, 1)
+            log(f"  lpm hint_min={hint_min}: {measured[str(hint_min)]} "
+                "ns/pkt")
+    finally:
+        if saved is None:
+            os.environ.pop("VPPT_LPM_HINT_MIN", None)
+        else:
+            os.environ["VPPT_LPM_HINT_MIN"] = saved
+    best = min(measured, key=lambda k: measured[k])
+    return int(best), measured
+
+
+def sweep_ring(iters, log):
+    """Persistent device-ring geometry: slots amortize the per-window
+    exchange, windows deepen the refill overlap — measured as the
+    single-window ping-pong µs (the latency-floor quantum)."""
+    from vpp_tpu.pipeline.dataplane import pack_packet_columns
+    from vpp_tpu.pipeline.persistent import PersistentPump
+
+    frame = 64
+    measured = {}
+    for slots, windows in ((8, 2), (16, 2), (16, 4)):
+        pump = None
+        try:
+            dp, uplink = _build_dp()
+            pv = _traffic(frame, uplink, seed=13)
+            cols = {f: np.asarray(getattr(pv, f))
+                    for f in ("src_ip", "dst_ip", "proto", "sport",
+                              "dport", "ttl", "pkt_len", "rx_if",
+                              "flags")}
+            flat = np.zeros((5, frame), np.int32)
+            pack_packet_columns(flat.view(np.uint32), cols, frame)
+            pump = PersistentPump(dp.tables, batch=frame,
+                                  classifier=dp.classifier_impl,
+                                  skip_local=dp._skip_local,
+                                  ring_slots=slots,
+                                  ring_windows=windows)
+            pump.start()
+            pump.submit(flat, now=1)
+            pump.result(timeout=600)
+            lat = []
+            for i in range(iters):
+                t0 = time.perf_counter()
+                pump.submit(flat, now=2 + i)
+                pump.result(timeout=120)
+                lat.append(time.perf_counter() - t0)
+            measured[f"{slots}x{windows}"] = round(
+                float(np.percentile(np.array(lat) * 1e6, 50)), 1)
+            log(f"  ring {slots}x{windows}: "
+                f"{measured[f'{slots}x{windows}']} us/window")
+        except Exception as e:  # noqa: BLE001 — best-effort lever
+            measured[f"{slots}x{windows}"] = f"error: {type(e).__name__}"
+            log(f"  ring {slots}x{windows}: FAILED ({type(e).__name__})")
+        finally:
+            if pump is not None:
+                try:
+                    pump.stop()
+                except Exception:  # noqa: BLE001 — already recorded
+                    pass
+    ok = {k: v for k, v in measured.items() if isinstance(v, float)}
+    if not ok:
+        return None, measured
+    best = min(ok, key=lambda k: ok[k])
+    s, w = (int(x) for x in best.split("x"))
+    return (s, w), measured
+
+
+def measure_floor(knobs, log):
+    """p50 single-frame step latency at the TUNED dataplane knobs —
+    the governor's achievable floor on this backend."""
+    import jax
+
+    frame = 64
+    dp, uplink = _build_dp(**knobs)
+    pkts = _traffic(frame, uplink, seed=11)
+    lat = []
+    for i in range(3):
+        r = dp.process(pkts, now=1 + i)
+    jax.block_until_ready(r.disp)
+    for i in range(30):
+        t0 = time.perf_counter()
+        r = dp.process(pkts, now=10 + i)
+        jax.block_until_ready(r.disp)
+        lat.append(time.perf_counter() - t0)
+    floor = round(float(np.percentile(np.array(lat) * 1e6, 50)), 1)
+    log(f"  floor: {floor} us (p50, {frame}-pkt frame)")
+    return floor
+
+
+def run_sweep(args, log) -> dict:
+    import jax
+
+    backend = jax.default_backend()
+    log(f"autotune: backend={backend} batch={args.batch} "
+        f"iters={args.iters}")
+    knobs_dp, knobs_io, knobs_env, measured = {}, {}, {}, {}
+
+    log("sweep: dataplane.sess_ways")
+    ways, m = sweep_sess_ways(args.batch, args.iters, log)
+    knobs_dp["sess_ways"] = ways
+    measured["sess_ways_ns_pkt"] = m
+
+    log("sweep: dataplane.telemetry_sketch_{rows,cols}")
+    (rows, cols), m = sweep_sketch(args.batch, args.iters, log)
+    knobs_dp["telemetry_sketch_rows"] = rows
+    knobs_dp["telemetry_sketch_cols"] = cols
+    measured["sketch_ns_pkt"] = m
+
+    log("sweep: VPPT_LPM_HINT_MIN")
+    hint, m = sweep_lpm_hint(args.batch, args.iters, log)
+    knobs_env["VPPT_LPM_HINT_MIN"] = str(hint)
+    measured["lpm_hint_ns_pkt"] = m
+
+    if args.skip_ring:
+        log("sweep: io ring geometry SKIPPED (--skip-ring)")
+        measured["ring_us_window"] = "skipped"
+    else:
+        log("sweep: io.io_ring_{slots,windows}")
+        geo, m = sweep_ring(max(4, args.iters), log)
+        measured["ring_us_window"] = m
+        if geo is not None:
+            knobs_io["io_ring_slots"], knobs_io["io_ring_windows"] = geo
+
+    log("measure: governor floor at tuned knobs")
+    floor = measure_floor({"sess_ways": knobs_dp["sess_ways"]}, log)
+
+    knobs = {"dataplane": knobs_dp}
+    if knobs_io:
+        knobs["io"] = knobs_io
+    if knobs_env:
+        knobs["env"] = knobs_env
+    return {
+        "backend": backend,
+        "generated_by": "tools/autotune.py",
+        "knobs": knobs,
+        "measured": measured,
+        "floor_us": floor,
+    }
+
+
+# ---------------------------------------------------------------- check
+
+def check_profile(path: str) -> list:
+    """Round-trip a committed profile through the SAME loader the
+    agent boots with: every knob must land on the built AgentConfig
+    (or, for env knobs, be applied to the environment). Returns
+    problems — ``make autotune-check`` fails on any."""
+    from vpp_tpu.cmd.config import AgentConfig, load_tuned_profile
+
+    problems = []
+    try:
+        prof = load_tuned_profile(path)
+    except ValueError as e:
+        return [f"autotune-check: {e}"]
+    if prof is None:
+        return [f"autotune-check: {path}: empty path"]
+    for key in ("backend", "knobs", "floor_us"):
+        if key not in prof:
+            problems.append(f"autotune-check: {path}: missing {key!r}")
+    if not isinstance(prof.get("floor_us"), (int, float)):
+        problems.append(
+            f"autotune-check: {path}: floor_us not numeric "
+            f"({prof.get('floor_us')!r})")
+    saved_env = dict(os.environ)
+    try:
+        cfg = AgentConfig.from_dict({"tuned_profile": path})
+    except Exception as e:  # noqa: BLE001 — report, not raise
+        return problems + [
+            f"autotune-check: {path}: AgentConfig.from_dict refused "
+            f"the profile: {type(e).__name__}: {e}"]
+    for section, obj in (("dataplane", cfg.dataplane), ("io", cfg.io)):
+        for k, v in (prof.get("knobs") or {}).get(section, {}).items():
+            got = getattr(obj, k, None)
+            if got != v:
+                problems.append(
+                    f"autotune-check: {path}: knobs.{section}.{k}={v!r} "
+                    f"did not land on the built config (got {got!r})")
+    for k, v in (prof.get("knobs") or {}).get("env", {}).items():
+        if os.environ.get(k) != str(v):
+            problems.append(
+                f"autotune-check: {path}: knobs.env.{k}={v!r} was not "
+                f"applied to the environment")
+        if saved_env.get(k) is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = saved_env[k]
+    # the floor must clamp an under-floor SLO UP through the loader
+    floor = prof.get("floor_us")
+    if isinstance(floor, (int, float)) and floor > 1:
+        cfg2 = AgentConfig.from_dict({
+            "tuned_profile": path, "io": {"latency_slo_us": 1}})
+        if cfg2.io.latency_slo_us < floor:
+            problems.append(
+                f"autotune-check: {path}: io.latency_slo_us=1 was not "
+                f"clamped up to floor_us={floor} "
+                f"(got {cfg2.io.latency_slo_us})")
+    return problems
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--out", default=None,
+                    help="profile path (default tuned/<backend>.json)")
+    ap.add_argument("--check", default=None, metavar="PATH",
+                    help="validate a committed profile instead of "
+                    "sweeping (make autotune-check)")
+    ap.add_argument("--batch", type=int, default=2048)
+    ap.add_argument("--iters", type=int, default=8)
+    ap.add_argument("--skip-ring", action="store_true",
+                    help="skip the persistent-ring geometry sweep "
+                    "(slow on CPU fallback)")
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args()
+
+    def log(msg):
+        if not args.quiet:
+            print(msg, file=sys.stderr)
+
+    if args.check:
+        problems = check_profile(args.check)
+        for p in problems:
+            print(p, file=sys.stderr)
+        if not problems:
+            log(f"autotune-check: {args.check}: OK")
+        return 1 if problems else 0
+
+    profile = run_sweep(args, log)
+    out = args.out or str(REPO / "tuned" / f"{profile['backend']}.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(profile, f, indent=2, sort_keys=True)
+        f.write("\n")
+    log(f"wrote {out}")
+    print(json.dumps({"profile": out, "floor_us": profile["floor_us"],
+                      "knobs": profile["knobs"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,6 +60,7 @@ class DebugCLI:
             ("show", "trace"): self.show_trace,
             ("show", "errors"): self.show_errors,
             ("show", "fastpath"): self.show_fastpath,
+            ("show", "kernels"): self.show_kernels,
             ("show", "ml"): self.show_ml,
             ("show", "latency"): self.show_latency,
             ("show", "top-flows"): self.show_top_flows,
@@ -96,7 +97,8 @@ class DebugCLI:
             "show sessions | show session-rules | show mesh | "
             "show partitions | "
             "show nat44 | show fib | show trace | show errors | "
-            "show fastpath | show ml | show latency | show top-flows | "
+            "show fastpath | show kernels | show ml | show latency | "
+            "show top-flows | "
             "show governor | show tenants | show io | show neighbors | "
             "show store | "
             "show resilience | show config-history [n] | show spans [n] | "
@@ -762,6 +764,29 @@ class DebugCLI:
             lines.append(f"revision: {store.revision}, "
                          f"fencing epoch: {store.fencing_epoch}, "
                          f"keys: {len(store.list_keys(''))}")
+        return "\n".join(lines)
+
+    def show_kernels(self) -> str:
+        """Per-op kernel rung selection (ISSUE 16): for each
+        gather-bound hot op — classifier, fib, session — the knob the
+        operator set, the rung the ladder selected, and WHY (backend
+        gate, structure gate, explicit knob). The operator view of
+        Dataplane.kernel_snapshot(), twinned with the
+        vpp_tpu_kernel_impl info gauge family."""
+        snap_fn = getattr(self.dp, "kernel_snapshot", None)
+        if not callable(snap_fn):
+            return "kernels: no dataplane kernel snapshot available"
+        snap = snap_fn()
+        lines = [
+            "kernel implementation ladders "
+            f"(backend: {snap['backend']}, pallas "
+            f"{'available' if snap['pallas_available'] else 'unavailable'}):",
+            f"  {'op':<12} {'knob':<8} {'selected':<9} why",
+        ]
+        for op in ("classifier", "fib", "session"):
+            s = snap[op]
+            lines.append(
+                f"  {op:<12} {s['knob']:<8} {s['impl']:<9} {s['why']}")
         return "\n".join(lines)
 
     def show_fastpath(self) -> str:

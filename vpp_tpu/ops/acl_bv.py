@@ -43,6 +43,7 @@ partition-rule layer (docs/PARTITIONING.md, docs/CLASSIFIER.md).
 
 from __future__ import annotations
 
+import functools
 import time
 from typing import Dict, NamedTuple, Optional, Tuple
 
@@ -97,10 +98,11 @@ def bv_global_bytes(max_rules: int) -> int:
 
 def bv_enabled_for(config) -> bool:
     """Whether this config allocates (and commit-time builds) the BV
-    structure: explicit ``classifier: bv`` always; ``auto`` only when
-    the worst-case structure fits the ``classifier_bv_mem_mb`` cap."""
+    structure: explicit ``classifier: bv`` always (``pallas`` rides
+    the SAME planes — ISSUE 16); ``auto`` only when the worst-case
+    structure fits the ``classifier_bv_mem_mb`` cap."""
     knob = getattr(config, "classifier", "auto")
-    if knob == "bv":
+    if knob in ("bv", "pallas"):
         return True
     if knob != "auto":
         return False
@@ -379,6 +381,194 @@ def acl_classify_local_bv(tables, pkts: PacketVector) -> AclVerdict:
              & tables.acl_bv_proto[t, pr])
     matched, rule = _first_set_bit(words)
     safe = jnp.where(matched, rule, 0)
+    act = tables.acl_action[t, safe]
+    permit = jnp.where(
+        matched, act == 1, acl_unmatched_default(pkts, tables.acl_nrules[t])
+    )
+    return AclVerdict(
+        permit=jnp.where(has_table, permit, True),
+        rule_idx=jnp.where(has_table & matched, rule, -1),
+    )
+
+
+# --- pallas rung (ISSUE 16) -------------------------------------------
+#
+# The classifier ladder's "pallas" rung keeps the BV *structure* (the
+# interval bitmaps are the right data layout) and replaces the hot
+# reduction — today 5 row gathers land [P, W] word vectors in HBM,
+# then 4 word-ANDs and the argmax/popcount priority encode each
+# re-stream them — with ONE fused kernel: the five gathered rows tile
+# into VMEM once and the AND + first-set-bit min-reduction never
+# materializes the combined word matrix. The 4 binary searches and the
+# row gathers stay XLA (log(I) scalar work per packet; the gather is
+# the one op XLA already lowers well). Dispatch follows the acl_mxu.py
+# precedent via ops/_pallas.py: compiled kernel on a TPU backend, the
+# jnp rung (bv_first_match) everywhere else — bit-exact, and interpret
+# mode keeps the differential suite runnable under JAX_PLATFORMS=cpu.
+
+# Encoded "no rule matched" sentinel of the fused kernel (any valid
+# rule index is < 32 * W <= 2**20 at the supported table sizes).
+BV_ENC_MISS = np.int32(0x7FFFFFF)
+
+# Packet-tile and word-tile sizes (the acl_mxu _PT/_RT analog).
+_BV_PT = 256
+_BV_WT = 512
+
+
+def _bv_first_set_kernel(src_ref, dst_ref, sp_ref, dp_ref, pr_ref,
+                         enc_ref):
+    """One (packet-tile, word-tile) step: AND the five bitmap-row
+    tiles, isolate each word's lowest set bit, and fold the running
+    first-match min (grid iterates the word axis innermost, so the
+    enc block accumulates across word tiles exactly like the MXU
+    kernel's rule tiles)."""
+    from vpp_tpu.ops._pallas import get_pallas
+
+    pl, _pltpu = get_pallas("bv_first_set")
+    j = pl.program_id(1)
+    w = (src_ref[...] & dst_ref[...] & sp_ref[...] & dp_ref[...]
+         & pr_ref[...])
+    low = w & (~w + jnp.uint32(1))
+    bit = lax.population_count(low - jnp.uint32(1)).astype(jnp.int32)
+    wt = w.shape[1]
+    col = jax.lax.broadcasted_iota(jnp.int32, w.shape, 1) + j * wt
+    cand = jnp.where(w != jnp.uint32(0), col * 32 + bit, BV_ENC_MISS)
+    tile_min = jnp.min(cand, axis=1, keepdims=True)  # [PT, 1]
+
+    @pl.when(j == 0)
+    def _():
+        enc_ref[...] = tile_min
+
+    @pl.when(j > 0)
+    def _():
+        enc_ref[...] = jnp.minimum(enc_ref[...], tile_min)
+
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def bv_first_set(rows_src: jnp.ndarray, rows_dst: jnp.ndarray,
+                 rows_sport: jnp.ndarray, rows_dport: jnp.ndarray,
+                 rows_proto: jnp.ndarray,
+                 interpret: bool = False) -> jnp.ndarray:
+    """Fused word-AND + first-set-bit over five gathered bitmap rows.
+
+    rows_* [P, W] uint32 → enc [P] int32: first (lowest-index) rule
+    whose bit survives the AND, BV_ENC_MISS when none does. Bit-exact
+    with ``_first_set_bit(rows AND-combined)`` — the differential
+    suite (tests/test_pallas_kernels.py) holds the two together.
+    P and W are padded to tile multiples here; zero pad words can
+    never produce a candidate."""
+    from vpp_tpu.ops._pallas import get_pallas
+
+    pl, pltpu = get_pallas("bv_first_set")
+    p, wn = rows_src.shape
+    pt = min(_BV_PT, max(8, p))
+    p_pad = ((p + pt - 1) // pt) * pt
+    wt = min(_BV_WT, max(1, wn))
+    w_pad = ((wn + wt - 1) // wt) * wt
+    rows = [rows_src, rows_dst, rows_sport, rows_dport, rows_proto]
+    if p_pad != p or w_pad != wn:
+        rows = [jnp.pad(r, ((0, p_pad - p), (0, w_pad - wn)))
+                for r in rows]
+
+    spec = pl.BlockSpec((pt, wt), lambda i, j: (i, j),
+                        memory_space=pltpu.VMEM)
+    enc = pl.pallas_call(
+        _bv_first_set_kernel,
+        grid=(p_pad // pt, w_pad // wt),
+        in_specs=[spec] * 5,
+        out_specs=pl.BlockSpec((pt, 1), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((p_pad, 1), jnp.int32),
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=9 * p_pad * w_pad,
+            bytes_accessed=5 * p_pad * w_pad * 4 + p_pad * 4,
+            transcendentals=0,
+        ),
+    )(*rows)
+    return enc[:p, 0]
+
+
+def bv_first_match_fused(
+    bnd_src, bnd_dst, bnd_sport, bnd_dport, nbnd,
+    bm_src, bm_dst, bm_sport, bm_dport, bm_proto,
+    pkts: PacketVector, interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``bv_first_match`` with the word-AND + priority encode running
+    in the fused Pallas kernel (same signature + return contract:
+    matched [P] bool, rule [P] int32 with -1 = miss)."""
+    si = _segment_of(bnd_src, pkts.src_ip, nbnd[0])
+    di = _segment_of(bnd_dst, pkts.dst_ip, nbnd[1])
+    pi = _segment_of(bnd_sport, pkts.sport, nbnd[2])
+    qi = _segment_of(bnd_dport, pkts.dport, nbnd[3])
+    pr = jnp.clip(pkts.proto, 0, bm_proto.shape[0] - 1)
+    enc = bv_first_set(bm_src[si], bm_dst[di], bm_sport[pi],
+                       bm_dport[qi], bm_proto[pr], interpret=interpret)
+    matched = enc != BV_ENC_MISS
+    return matched, jnp.where(matched, enc, -1)
+
+
+def _bv_global_first_match(tables, pkts: PacketVector, fused: bool):
+    args = (
+        tables.glb_bv_bnd_src, tables.glb_bv_bnd_dst,
+        tables.glb_bv_bnd_sport, tables.glb_bv_bnd_dport,
+        tables.glb_bv_nbnd,
+        tables.glb_bv_src, tables.glb_bv_dst,
+        tables.glb_bv_sport, tables.glb_bv_dport, tables.glb_bv_proto,
+        pkts,
+    )
+    return bv_first_match_fused(*args) if fused else bv_first_match(*args)
+
+
+def acl_classify_global_pallas(tables, pkts: PacketVector) -> AclVerdict:
+    """The classifier ladder's "pallas" rung, global table: BV planes
+    with the fused first-set kernel on a TPU backend, the jnp BV rung
+    everywhere else (the mxu_classify_columns dispatch pattern — the
+    CPU/fallback path is bit-exact by construction because it IS
+    acl_classify_global_bv's math)."""
+    from vpp_tpu.ops._pallas import use_pallas
+
+    matched, rule = _bv_global_first_match(tables, pkts,
+                                           fused=use_pallas())
+    safe = jnp.where(matched, rule, 0)
+    act = tables.glb_action[safe]
+    return assemble_global_verdict(tables, pkts, matched, act == 1, rule)
+
+
+def acl_classify_local_pallas(tables, pkts: PacketVector) -> AclVerdict:
+    """The "pallas" rung's local classify: the per-interface plane
+    gathers stay XLA (they are [P]-indexed slices of the [T, ...] BV
+    planes), the word-AND + priority encode runs in the SAME fused
+    kernel as the global path. Falls back to acl_classify_local_bv
+    off-TPU — bit-exact (identical gathered rows, identical encode)."""
+    from vpp_tpu.ops._pallas import use_pallas
+
+    if not use_pallas():
+        return acl_classify_local_bv(tables, pkts)
+    tid = tables.if_local_table[pkts.rx_if]
+    has_table = tid >= 0
+    t = jnp.maximum(tid, 0)
+    nb = tables.acl_bv_nbnd[t]  # [P, 4]
+
+    def seg(bnd_rows, vals, n):
+        i = jax.vmap(
+            lambda b, v: jnp.searchsorted(b, v, side="right")
+        )(bnd_rows, vals).astype(jnp.int32) - 1
+        return jnp.clip(i, 0, n - 1)
+
+    si = seg(tables.acl_bv_bnd_src[t], pkts.src_ip, nb[:, 0])
+    di = seg(tables.acl_bv_bnd_dst[t], pkts.dst_ip, nb[:, 1])
+    pi = seg(tables.acl_bv_bnd_sport[t], pkts.sport, nb[:, 2])
+    qi = seg(tables.acl_bv_bnd_dport[t], pkts.dport, nb[:, 3])
+    pr = jnp.clip(pkts.proto, 0, tables.acl_bv_proto.shape[1] - 1)
+    enc = bv_first_set(
+        tables.acl_bv_src[t, si], tables.acl_bv_dst[t, di],
+        tables.acl_bv_sport[t, pi], tables.acl_bv_dport[t, qi],
+        tables.acl_bv_proto[t, pr])
+    matched = enc != BV_ENC_MISS
+    rule = jnp.where(matched, enc, -1)
+    safe = jnp.where(matched, enc, 0)
     act = tables.acl_action[t, safe]
     permit = jnp.where(
         matched, act == 1, acl_unmatched_default(pkts, tables.acl_nrules[t])

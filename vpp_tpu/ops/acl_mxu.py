@@ -36,9 +36,8 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
+from vpp_tpu.ops._pallas import get_pallas
 from vpp_tpu.ops.acl import AclVerdict, assemble_global_verdict
 from vpp_tpu.pipeline.vector import PacketVector
 
@@ -223,6 +222,7 @@ def _classify_kernel(bits_ref, coeff_ref, k_ref, enc_ref):
     packet tile, so rule tiles revisit it sequentially and accumulate
     the running min (TPU grids iterate the last axis innermost).
     """
+    pl, _pltpu = get_pallas("mxu_first_match")
     j = pl.program_id(1)
     mism = jnp.dot(
         bits_ref[:], coeff_ref[:], preferred_element_type=jnp.float32
@@ -255,6 +255,10 @@ def mxu_first_match(
     enc [P] int32: matched rule index, ENC_MISS when nothing matched.
     P and R are padded to tile multiples here; callers pass any size.
     """
+    # lazy import (ISSUE 16 satellite): the Pallas modules load only
+    # when this kernel actually traces — never on a CPU run that
+    # serves the reference rung
+    pl, pltpu = get_pallas("mxu_first_match")
     p = bits.shape[0]
     r = coeff.shape[1]
     pt = min(_PT, max(8, p))
@@ -312,8 +316,10 @@ def mxu_classify_columns(tables, pkts: PacketVector) -> jnp.ndarray:
     the rule-sharded cluster classify
     (parallel/cluster.sharded_global_classify_mxu), so backend dispatch
     can never diverge between them."""
+    from vpp_tpu.ops._pallas import use_pallas
+
     bits = packet_bit_planes(pkts)
-    if jax.default_backend() == "tpu":
+    if use_pallas():
         return mxu_first_match(bits, tables.glb_mxu_coeff, tables.glb_mxu_k)
     return mxu_first_match_reference(
         bits, tables.glb_mxu_coeff, tables.glb_mxu_k

@@ -53,9 +53,13 @@ length distribution (docs/ROUTING.md has the formula and a worked
 
 from __future__ import annotations
 
+import functools
+import os
 from typing import Tuple
 
+import jax
 import jax.numpy as jnp
+from jax import lax
 
 # IPv4 prefix lengths /0 .. /32 — one plane each.
 LPM_LENGTHS = 33
@@ -96,19 +100,39 @@ LPM_HINT_BITS = 16
 LPM_HINT_MIN = 8192
 
 
-def lpm_hint_layout(caps) -> Tuple[Tuple[Tuple[int, int, int], ...], int]:
+def lpm_hint_min() -> int:
+    """The hint-engage threshold: planes at/above this capacity get a
+    stride hint table. ``VPPT_LPM_HINT_MIN`` overrides the default —
+    the autotuner's knob (tools/autotune.py sweeps it against the
+    measured hint-vs-flat crossover per backend). An env var, not a
+    config field, because the layout must be recoverable from table
+    SHAPES alone and must agree between builder staging and the
+    device kernel within one process — the VPPT_SESS_ELECTION
+    pattern."""
+    try:
+        return int(os.environ.get("VPPT_LPM_HINT_MIN", LPM_HINT_MIN))
+    except ValueError:
+        return LPM_HINT_MIN
+
+
+def lpm_hint_layout(
+    caps, hint_min: int | None = None,
+) -> Tuple[Tuple[Tuple[int, int, int], ...], int]:
     """((b_bits, hint_offset, search_steps) per length, total hint
     rows). Offset -1 = no hint (length unpopulated, or /0 — a single
     possible prefix needs no search at all). Pure function of the
-    capacity vector, so builder staging and the device kernel derive
-    the SAME layout from config and shapes respectively."""
+    capacity vector (and the process-wide engage threshold — see
+    ``lpm_hint_min``), so builder staging and the device kernel
+    derive the SAME layout from config and shapes respectively."""
+    if hint_min is None:
+        hint_min = lpm_hint_min()
     rows = []
     off = 0
     for length in range(LPM_LENGTHS):
         cap = caps[length]
         # jax-ok: caps are Python ints (config knob values or array
         # SHAPES) — the layout is trace-time static by construction
-        if cap < LPM_HINT_MIN or length == 0:
+        if cap < hint_min or length == 0:
             rows.append((0, -1, 0))
             continue
         b = min(length, LPM_HINT_BITS, max(1, (cap - 1).bit_length()))
@@ -160,11 +184,12 @@ def lpm_plane_bytes(config) -> int:
 
 def lpm_enabled_for(config) -> bool:
     """Whether this config allocates (and commit-time builds) the LPM
-    planes: explicit ``fib_impl: lpm`` always; ``auto`` only when the
-    worst-case structure fits ``fib_lpm_mem_mb`` (the
-    ``bv_enabled_for`` discipline)."""
+    planes: explicit ``fib_impl: lpm`` always (``pallas`` rides the
+    SAME planes — ISSUE 16); ``auto`` only when the worst-case
+    structure fits ``fib_lpm_mem_mb`` (the ``bv_enabled_for``
+    discipline)."""
     knob = getattr(config, "fib_impl", "auto")
-    if knob == "lpm":
+    if knob in ("lpm", "pallas"):
         return True
     if knob != "auto":
         return False
@@ -265,3 +290,194 @@ def fib_lookup_lpm(tables, pkts):
         slot = jnp.where(take, plane[1][ic].astype(jnp.int32), slot)
         found = found | hit
     return resolve_fib_slot(tables, slot, found, fib_flow_mix(pkts))
+
+
+# --- pallas rung (ISSUE 16) -------------------------------------------
+#
+# The fib_impl ladder's "pallas" rung: the per-length searches above
+# unroll into 33 separate searchsorted/gather chains — each one streams
+# the query vector and its plane through HBM independently, and XLA
+# cannot fuse across them because every chain ends in a gather. The
+# fused kernel stacks the populated planes into ONE [L, Npad] VMEM-
+# resident matrix and walks all lengths for a packet tile in a single
+# pallas_call: the queries load once, the bisection runs on registers,
+# and the longest-first first-hit fold happens in VMEM instead of L
+# round trips through ``jnp.where``. Same dispatch discipline as the
+# other kernels (ops/_pallas.py): compiled on a real TPU backend, the
+# trace-time-unrolled rung above everywhere else, interpret mode for
+# the differential suite.
+
+# packet-tile rows per grid step
+_LPM_PT = 256
+# plane pad columns round to the TPU lane width
+_LPM_LANES = 128
+
+
+def _lpm_bias(x: jnp.ndarray) -> jnp.ndarray:
+    """uint32 -> order-preserving int32 (flip the sign bit): Pallas
+    TPU compares are happiest in int32, and LPM_PAD (0xFFFFFFFF)
+    biases to int32 max — still sorting at/after every real prefix."""
+    return lax.bitcast_convert_type(
+        x ^ jnp.uint32(0x80000000), jnp.int32)
+
+
+def _lpm_search_kernel(m_ref, cnt_ref, pfx_ref, slot_ref,
+                       found_ref, out_ref, *, steps: int):
+    """One (packet-tile, length) grid step: bisect this length's
+    sorted plane for the tile's masked queries and fold the hit into
+    the running longest-first winner (grid iterates the length axis
+    innermost, so the out blocks accumulate across lengths — the
+    acl_mxu rule-tile pattern)."""
+    from vpp_tpu.ops._pallas import get_pallas
+
+    pl, _pltpu = get_pallas("lpm_fused_lookup")
+    l = pl.program_id(1)
+    m = m_ref[...][:, 0]          # [pt] biased masked queries
+    pfx = pfx_ref[...][0]         # [Npad] biased sorted prefixes
+    slots = slot_ref[...][0]      # [Npad] owning FIB slots
+    n = cnt_ref[0, 0]             # live entries of this length
+    top = pfx.shape[0] - 1
+    # bisect_left over the live region [0, n): identical insertion
+    # index to the flat searchsorted over the padded plane (pads sort
+    # at/after every real value; the i < n guard below rejects the
+    # pad region exactly like the ``i < cnt[L]`` guard in
+    # fib_lookup_lpm), with the step count static from the SHAPE.
+    lo = jnp.zeros(m.shape, jnp.int32)
+    hi = jnp.broadcast_to(n, m.shape).astype(jnp.int32)
+    for _ in range(steps):
+        mid = (lo + hi) >> 1
+        p = pfx[jnp.clip(mid, 0, top)]
+        less = p < m
+        active = lo < hi
+        lo = jnp.where(active & less, mid + 1, lo)
+        hi = jnp.where(active & ~less, mid, hi)
+    ic = jnp.clip(lo, 0, top)
+    hit = (pfx[ic] == m) & (lo < n)
+    s = jnp.where(hit, slots[ic], 0)
+
+    @pl.when(l == 0)
+    def _():
+        found_ref[...] = hit[:, None].astype(jnp.int32)
+        out_ref[...] = s[:, None]
+
+    @pl.when(l > 0)
+    def _():
+        prev = found_ref[...][:, 0] != 0
+        take = hit & ~prev
+        out_ref[...] = jnp.where(take, s, out_ref[...][:, 0])[:, None]
+        found_ref[...] = (prev | hit)[:, None].astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def lpm_fused_lookup(m_cols: jnp.ndarray, cnt_stack: jnp.ndarray,
+                     pfx_stack: jnp.ndarray, slot_stack: jnp.ndarray,
+                     interpret: bool = False):
+    """Fused all-lengths LPM search.
+
+    m_cols [P, L] int32: per-length masked queries, already biased
+    (``_lpm_bias``), length axis LONGEST FIRST — the first hit along
+    it is the longest match. cnt_stack [L, 1] int32 live counts,
+    pfx_stack [L, Npad] int32 biased sorted prefixes (pad int32 max),
+    slot_stack [L, Npad] int32 owning slots. Returns (found [P] bool,
+    slot [P] int32, 0 when miss) — bit-exact with the trace-time-
+    unrolled walk in ``fib_lookup_lpm`` over the same planes
+    (tests/test_pallas_kernels.py holds them together)."""
+    p, nl = m_cols.shape
+    npad = pfx_stack.shape[1]
+    pt = min(_LPM_PT, max(8, p))
+    p_pad = ((p + pt - 1) // pt) * pt
+    if p_pad != p:
+        m_cols = jnp.pad(m_cols, ((0, p_pad - p), (0, 0)))
+    steps = max(1, npad).bit_length()
+    kernel = functools.partial(_lpm_search_kernel, steps=steps)
+
+    from vpp_tpu.ops._pallas import get_pallas
+
+    pl, pltpu = get_pallas("lpm_fused_lookup")
+    found, slot = pl.pallas_call(
+        kernel,
+        grid=(p_pad // pt, nl),
+        in_specs=[
+            pl.BlockSpec((pt, 1), lambda i, l: (i, l),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i, l: (l, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, npad), lambda i, l: (l, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, npad), lambda i, l: (l, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((pt, 1), lambda i, l: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((pt, 1), lambda i, l: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((p_pad, 1), jnp.int32),
+            jax.ShapeDtypeStruct((p_pad, 1), jnp.int32),
+        ],
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=6 * p_pad * nl * steps,
+            bytes_accessed=(p_pad * nl * 4 + nl * (2 * npad + 1) * 4
+                            + 2 * p_pad * 4),
+            transcendentals=0,
+        ),
+    )(m_cols, cnt_stack, pfx_stack, slot_stack)
+    return found[:p, 0] != 0, slot[:p, 0]
+
+
+def _fib_lookup_lpm_pallas(tables, pkts, interpret: bool = False):
+    """``fib_lookup_lpm`` with the per-length searches running in the
+    fused kernel. The plane stacking below is TRACE-TIME bookkeeping
+    (concat of already-device-resident rows): the populated-length
+    tuple stays config-static, zero-width planes never enter the
+    stack, and the shared ``resolve_fib_slot`` tail keeps dense, LPM
+    and pallas rungs bit-exact through the same route data."""
+    from vpp_tpu.ops.fib import fib_flow_mix, resolve_fib_slot
+
+    dst = pkts.dst_ip
+    caps = tuple(getattr(tables, lpm_field(L)).shape[1]
+                 for L in range(LPM_LENGTHS))
+    # jax-ok: shapes — the config-static populated-length tuple
+    lens = tuple(L for L in range(LPM_LENGTHS - 1, -1, -1)
+                 if caps[L] > 0)
+    if not lens:
+        slot = jnp.zeros(dst.shape, jnp.int32)
+        found = jnp.zeros(dst.shape, bool)
+        return resolve_fib_slot(tables, slot, found, fib_flow_mix(pkts))
+    npad = max(caps[L] for L in lens)
+    npad = ((npad + _LPM_LANES - 1) // _LPM_LANES) * _LPM_LANES
+    pad_val = jnp.int32(0x7FFFFFFF)  # _lpm_bias(LPM_PAD)
+    pfx_rows, slot_rows = [], []
+    for L in lens:
+        plane = getattr(tables, lpm_field(L))
+        w = plane.shape[1]
+        pfx_rows.append(jnp.pad(_lpm_bias(plane[0]), (0, npad - w),
+                                constant_values=pad_val))
+        slot_rows.append(jnp.pad(plane[1].astype(jnp.int32),
+                                 (0, npad - w)))
+    masks = jnp.asarray([LPM_MASKS[L] for L in lens], jnp.uint32)
+    m_cols = _lpm_bias(dst[:, None] & masks[None, :])
+    found, slot = lpm_fused_lookup(
+        m_cols,
+        tables.fib_lpm_cnt[jnp.asarray(lens, jnp.int32)][:, None]
+        .astype(jnp.int32),
+        jnp.stack(pfx_rows),
+        jnp.stack(slot_rows),
+        interpret=interpret,
+    )
+    return resolve_fib_slot(tables, slot, found, fib_flow_mix(pkts))
+
+
+def fib_lookup_lpm_fused(tables, pkts):
+    """The fib_impl ladder's "pallas" rung (the ``fib_fn`` composed
+    for ``fib_impl: pallas`` — pipeline/graph.py): fused kernel on a
+    TPU backend, the unrolled LPM walk everywhere else. Bit-exact
+    either way — same planes, same first-hit rule, same resolver."""
+    from vpp_tpu.ops._pallas import use_pallas
+
+    if not use_pallas():
+        return fib_lookup_lpm(tables, pkts)
+    return _fib_lookup_lpm_pallas(tables, pkts)

@@ -78,6 +78,7 @@ idle-node maintenance).
 
 from __future__ import annotations
 
+import functools
 import os
 from typing import Tuple
 
@@ -222,7 +223,7 @@ def _shard_flat_slot(hit_idx: jnp.ndarray, mask: jnp.ndarray,
 
 def session_lookup_reverse(
     tables: DataplaneTables, pkts: PacketVector, now=None,
-    tnt: bool = False
+    tnt: bool = False, impl: str = "gather"
 ) -> jnp.ndarray:
     """Is each packet the *return* traffic of an established session?
 
@@ -230,8 +231,10 @@ def session_lookup_reverse(
     Returns a bool mask [P]. With ``now``, entries idle longer than
     ``tables.sess_max_age`` are dead even before any reclamation sweeps
     them — timeout precision is in-kernel (VPP's session timers fire
-    per-worker; ours are evaluated per lookup).
-    """
+    per-worker; ours are evaluated per lookup). ``impl`` is the
+    session_impl ladder rung (trace-time static, step-factory gate):
+    ``pallas`` probes through the fused kernel (gather rung off-TPU —
+    bit-exact either way)."""
     n_buckets = tables.sess_valid.shape[0]
     key_src = pkts.dst_ip
     key_dst = pkts.src_ip
@@ -246,6 +249,15 @@ def session_lookup_reverse(
                           tables.tnt_sess_base, tables.tnt_sess_mask)
     else:
         b = _hash(key_src, key_dst, key_ports, key_proto, n_buckets)
+    # jax-ok: impl is a trace-time-static ladder rung, not a tracer
+    # branch. No-age lookups pass (0, _BIG) — vacuously true on a
+    # non-negative tick clock (see _sess_probe_dispatch).
+    if impl == "pallas":
+        found, _first = _sess_probe_dispatch(
+            tables, b, key_src, key_dst, key_ports, key_proto,
+            now if now is not None else 0,
+            tables.sess_max_age if now is not None else _BIG)
+        return found
     # ONE row gather per column fetches the whole bucket ([P, W]): the
     # ways are contiguous, so this is the cheapest gather shape the
     # table can offer — no probe chain, no cross-way dependency.
@@ -265,7 +277,7 @@ def session_lookup_reverse(
 
 def session_lookup_reverse_idx(
     tables: DataplaneTables, pkts: PacketVector, now, shard=None,
-    tnt: bool = False
+    tnt: bool = False, impl: str = "gather"
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Like session_lookup_reverse, but also returns the matched FLAT
     slot index [P] (bucket·W + way; undefined where not found) so the
@@ -298,6 +310,16 @@ def session_lookup_reverse_idx(
         own, bl = shard_buckets(b, n_buckets, shard)
     else:
         own, bl = None, b
+    # jax-ok: impl is a trace-time-static ladder rung. The fused probe
+    # serves the STANDALONE table only — sharded lookups keep the
+    # gather rung (the psum recombination lives outside the kernel and
+    # the ladder never selects pallas on a mesh; partition.py rejects
+    # the knob at config time).
+    if impl == "pallas" and shard is None:
+        found, first = _sess_probe_dispatch(
+            tables, b, key_src, key_dst, key_ports, key_proto,
+            now, tables.sess_max_age)
+        return found, b * ways + first
     slot_match = (
         (tables.sess_valid[bl] == 1)
         & (tables.sess_src[bl] == key_src[:, None])
@@ -319,7 +341,7 @@ def session_lookup_reverse_idx(
 
 def session_batch_summary(
     tables: DataplaneTables, pkts: PacketVector, alive: jnp.ndarray, now,
-    shard=None, tnt: bool = False
+    shard=None, tnt: bool = False, impl: str = "gather"
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Batched hit summary for the two-tier fast/slow dispatch
     (pipeline/graph.py pipeline_step_auto): one reverse lookup yields
@@ -336,7 +358,8 @@ def session_batch_summary(
     construction; the caller (pipeline_step_auto) additionally pmins
     the flag so the lax.cond dispatch provably can't diverge."""
     found, hit_idx = session_lookup_reverse_idx(tables, pkts, now,
-                                                shard=shard, tnt=tnt)
+                                                shard=shard, tnt=tnt,
+                                                impl=impl)
     hits = found & alive
     all_hit = jnp.all(hits == alive)
     return hits, hit_idx, all_hit
@@ -899,3 +922,178 @@ def hashmap_insert_linear(
         time = time.at[widx].set(now, mode="drop")
         pending = pending & ~key_at(idx)
     return valid, time, keys, pending
+
+
+# --- pallas rung (ISSUE 16) -------------------------------------------
+#
+# The session_impl ladder's "pallas" rung: the reverse lookup above
+# spends its time in SIX independent bucket-row gathers (one per
+# column) whose [P, W] results stream through HBM five more times for
+# the compares and the election. The fused kernel holds the session
+# columns VMEM-resident (gated by ``session_pallas_fits`` — the MXU
+# VMEM-budget discipline) and does gather + key-compare + age check +
+# first-match election in one pass per packet tile. Sharded lookups
+# keep the gather rung: the psum recombination happens OUTSIDE the
+# kernel and the per-shard table slice already fits the gather path
+# fine. Dispatch discipline as everywhere (ops/_pallas.py): compiled
+# on a real TPU backend, the gather rung elsewhere, interpret mode for
+# the differential suite.
+# packet-tile rows per grid step
+_SESS_PT = 256
+
+# VMEM budget for the resident columns: 6 columns x 4 bytes per slot
+# must fit comfortably under a TPU core's ~16 MB VMEM next to the
+# packet tiles — the structural eligibility gate the selection ladder
+# consumes (partition.py select_session_impl via dataplane).
+SESS_PALLAS_VMEM_BUDGET = 8 << 20
+
+
+def session_pallas_fits(config) -> bool:
+    """Whether the whole session table (6 uint32-wide columns of
+    ``sess_slots`` cells) fits the pallas rung's VMEM budget. A table
+    past the budget keeps the gather rung — HBM-resident columns are
+    exactly what the gather path is for."""
+    slots = int(getattr(config, "sess_slots", 0))
+    return slots > 0 and 6 * 4 * slots <= SESS_PALLAS_VMEM_BUDGET
+
+
+def _sess_probe_kernel(b_ref, kmat_ref, cols_ref, valid_ref, time_ref,
+                       scal_ref, found_ref, first_ref):
+    """One packet-tile step: gather each packet's bucket row from the
+    VMEM-resident columns, compare the full reversed key + liveness +
+    age, and elect the first matching way (min way index ==
+    argmax-of-first-True — the gather rung's election)."""
+    from vpp_tpu.ops._pallas import get_pallas
+
+    _pl, _pltpu = get_pallas("sess_probe_ways")
+    b = b_ref[...][:, 0]            # [pt] home buckets
+    keys = kmat_ref[...]            # [pt, 4] uint32 reversed 5-tuple
+    cols = cols_ref[...]            # [4, NB, W] uint32 key columns
+    v = valid_ref[...][b]           # [pt, W]
+    tm = time_ref[...][b]           # [pt, W]
+    now = scal_ref[0, 0]
+    max_age = scal_ref[0, 1]
+    match = v == 1
+    for k in range(4):
+        match = match & (cols[k][b] == keys[:, k][:, None])
+    match = match & (now - tm <= max_age)
+    way = jax.lax.broadcasted_iota(jnp.int32, match.shape, 1)
+    enc = jnp.min(jnp.where(match, way, _BIG), axis=1)
+    found = enc != _BIG
+    found_ref[...] = found[:, None].astype(jnp.int32)
+    first_ref[...] = jnp.where(found, enc, 0)[:, None]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def sess_probe_ways(b: jnp.ndarray, key_src: jnp.ndarray,
+                    key_dst: jnp.ndarray, key_ports: jnp.ndarray,
+                    key_proto: jnp.ndarray, valid: jnp.ndarray,
+                    src: jnp.ndarray, dst: jnp.ndarray,
+                    ports: jnp.ndarray, proto: jnp.ndarray,
+                    time: jnp.ndarray, now, max_age,
+                    interpret: bool = False):
+    """Fused bucket probe + election over the session columns.
+
+    ``b`` [P] home buckets; ``key_*`` [P] the (already reversed)
+    5-tuple; ``valid``/``src``/``dst``/``ports``/``proto``/``time``
+    the [NB, W] table columns; ``now``/``max_age`` scalars. Returns
+    (found [P] bool, first [P] int32 — the matched way, 0 when no
+    match, exactly the gather rung's ``argmax`` convention). Bit-exact
+    with ``_probe_ways_reference`` (tests/test_pallas_kernels.py)."""
+    from vpp_tpu.ops._pallas import get_pallas
+
+    pl, pltpu = get_pallas("sess_probe_ways")
+    p = b.shape[0]
+    nb, w = valid.shape
+    pt = min(_SESS_PT, max(8, p))
+    p_pad = ((p + pt - 1) // pt) * pt
+    bp = jnp.pad(b, (0, p_pad - p)) if p_pad != p else b
+    kmat = jnp.stack([key_src.astype(jnp.uint32),
+                      key_dst.astype(jnp.uint32),
+                      key_ports.astype(jnp.uint32),
+                      key_proto.astype(jnp.uint32)], axis=1)
+    if p_pad != p:
+        kmat = jnp.pad(kmat, ((0, p_pad - p), (0, 0)))
+    cols = jnp.stack([src.astype(jnp.uint32), dst.astype(jnp.uint32),
+                      ports.astype(jnp.uint32),
+                      proto.astype(jnp.uint32)])
+    scal = jnp.stack([jnp.asarray(now, jnp.int32).reshape(()),
+                      jnp.asarray(max_age, jnp.int32).reshape(())]
+                     )[None, :]
+    found, first = pl.pallas_call(
+        _sess_probe_kernel,
+        grid=(p_pad // pt,),
+        in_specs=[
+            pl.BlockSpec((pt, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((pt, 4), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((4, nb, w), lambda i: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((nb, w), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((nb, w), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 2), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((pt, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((pt, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((p_pad, 1), jnp.int32),
+            jax.ShapeDtypeStruct((p_pad, 1), jnp.int32),
+        ],
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=12 * p_pad * w,
+            bytes_accessed=(6 * nb * w * 4 + p_pad * (4 + 16)
+                            + 2 * p_pad * 4),
+            transcendentals=0,
+        ),
+    )(bp[:, None], kmat, cols, valid.astype(jnp.int32),
+      time.astype(jnp.int32), scal)
+    return found[:p, 0] != 0, first[:p, 0]
+
+
+def _probe_ways_reference(b, key_src, key_dst, key_ports, key_proto,
+                          valid, src, dst, ports, proto, time, now,
+                          max_age):
+    """The jnp twin of ``sess_probe_ways`` — the gather rung's exact
+    math on the kernel's signature, so the differential suite can hold
+    kernel and reference together without staging a full pipeline."""
+    match = (
+        (valid[b] == 1)
+        & (src[b] == key_src[:, None])
+        & (dst[b] == key_dst[:, None])
+        & (ports[b] == key_ports[:, None])
+        & (proto[b] == key_proto[:, None])
+        & (now - time[b] <= max_age)
+    )
+    found = jnp.any(match, axis=1)
+    return found, jnp.argmax(match, axis=1).astype(jnp.int32)
+
+
+def _sess_probe_dispatch(tables, b, key_src, key_dst, key_ports,
+                         key_proto, now, max_age):
+    """(found, first-way) via the fused kernel on a TPU backend, the
+    gather rung elsewhere — the mxu_classify_columns dispatch shape.
+    Callers pass ``now=0, max_age=_BIG`` to express "no age check"
+    (time is a non-negative tick counter, so the condition is
+    vacuous)."""
+    from vpp_tpu.ops._pallas import use_pallas
+
+    if use_pallas():
+        return sess_probe_ways(
+            b, key_src, key_dst, key_ports, key_proto,
+            tables.sess_valid, tables.sess_src, tables.sess_dst,
+            tables.sess_ports, tables.sess_proto, tables.sess_time,
+            now, max_age)
+    return _probe_ways_reference(
+        b, key_src, key_dst, key_ports, key_proto,
+        tables.sess_valid, tables.sess_src, tables.sess_dst,
+        tables.sess_ports, tables.sess_proto, tables.sess_time,
+        now, max_age)

@@ -296,7 +296,8 @@ def partition_lint() -> List[str]:
 
 
 def select_impl(knob: str, bv_ok: bool, mxu_ok: bool, nrules: int,
-                bv_min_rules: int, mxu_threshold: int) -> str:
+                bv_min_rules: int, mxu_threshold: int,
+                pallas_ok: bool = False) -> str:
     """The ONE classifier-selection ladder, shared by the standalone
     Dataplane, ClusterDataplane and MultiHostCluster (each resolves
     its own eligibility bits — builder state, all-nodes agreement, or
@@ -304,36 +305,61 @@ def select_impl(knob: str, bv_ok: bool, mxu_ok: bool, nrules: int,
     mesh can never silently select a different rung than standalone).
 
     Explicit knobs are honored when compilable (an operator knob beats
-    a size heuristic); ``auto`` ladders BV >= bv_min_rules > MXU >=
+    a size heuristic); ``auto`` ladders pallas (when eligible — a real
+    TPU backend, ISSUE 16) >= BV >= bv_min_rules > MXU >=
     mxu_threshold > dense, every ineligible structure falling to the
-    next rung."""
+    next rung. The pallas rung rides the BV planes, so its structural
+    eligibility IS ``bv_ok`` — ``pallas_ok`` carries only the backend
+    bit (default False keeps mesh callers on the proven rungs until
+    they resolve it themselves)."""
     if knob == "dense":
         return "dense"
     if knob == "mxu":
         return "mxu" if mxu_ok else "dense"
-    if knob == "bv":
+    if knob in ("pallas", "bv"):
         if bv_ok:
-            return "bv"
+            return "pallas" if (knob == "pallas" and pallas_ok) else "bv"
         return "mxu" if mxu_ok and nrules >= mxu_threshold else "dense"
     if bv_ok and nrules >= bv_min_rules:
-        return "bv"
+        return "pallas" if pallas_ok else "bv"
     if mxu_ok and nrules >= mxu_threshold:
         return "mxu"
     return "dense"
 
 
 def select_fib_impl(knob: str, lpm_ok: bool, n_routes: int,
-                    min_routes: int) -> str:
+                    min_routes: int, pallas_ok: bool = False) -> str:
     """The ONE FIB-implementation ladder (ISSUE 15), the
     ``select_impl`` twin: explicit knobs are honored when compilable
     (``lpm`` with an ineligible table — planes disabled or a length
     over its cap — falls back to dense rather than serving wrong
-    routes); ``auto`` engages LPM at ``min_routes`` staged routes."""
+    routes); ``auto`` engages LPM at ``min_routes`` staged routes,
+    upgrading to the fused pallas rung (ISSUE 16) when the backend
+    carries it — the rung rides the SAME planes, so eligibility is
+    ``lpm_ok`` plus the backend bit."""
     if knob == "dense":
+        return "dense"
+    if knob == "pallas":
+        if lpm_ok:
+            return "pallas" if pallas_ok else "lpm"
         return "dense"
     if knob == "lpm":
         return "lpm" if lpm_ok else "dense"
-    return "lpm" if (lpm_ok and n_routes >= min_routes) else "dense"
+    if lpm_ok and n_routes >= min_routes:
+        return "pallas" if pallas_ok else "lpm"
+    return "dense"
+
+
+def select_session_impl(knob: str, pallas_ok: bool) -> str:
+    """The session-probe ladder (ISSUE 16): ``gather`` is the proven
+    row-gather rung (always compilable — the session columns ARE the
+    structure); ``pallas``/``auto`` take the fused probe kernel when
+    the backend and the VMEM budget carry it
+    (ops/session.session_pallas_fits — callers fold it into
+    ``pallas_ok``), falling back to gather otherwise."""
+    if knob == "gather":
+        return "gather"
+    return "pallas" if pallas_ok else "gather"
 
 
 def agree_ml(ml_stage: str, kinds) -> Tuple[str, str]:
@@ -367,6 +393,23 @@ def validate_partitioning(config, rule_shards: int) -> None:
     refusing the whole mesh."""
     if rule_shards <= 1:
         return
+    # Pallas rungs are standalone-only for now (ISSUE 16): the fused
+    # kernels probe whole VMEM-resident structures and none of them
+    # shard via PARTITION_RULES yet. An explicit pallas knob on a mesh
+    # is rejected HERE, at config time, with a recoverable message —
+    # never deep inside a pallas_call trace. (``auto`` stays legal:
+    # mesh selection ladders resolve pallas_ok=False and keep the
+    # proven sharded rungs.)
+    for knob_name, sharded_rung in (("classifier", "bv"),
+                                    ("fib_impl", "lpm"),
+                                    ("session_impl", "gather")):
+        if getattr(config, knob_name, None) == "pallas":
+            raise ValueError(
+                f"dataplane.{knob_name}: the pallas rung does not "
+                f"shard across {rule_shards} rule shards — no "
+                "PARTITION_RULES spec covers the fused kernels yet. "
+                f"Use '{sharded_rung}' or 'auto' on a mesh (auto "
+                "selects the sharded rungs)")
     ways = int(getattr(config, "sess_ways", 4))
     for name, slots in (("sess_slots", config.sess_slots),
                         ("natsess_slots", natsess_slots_of(config))):

@@ -357,10 +357,11 @@ def _step_label(impl: str, skip_local: bool, fast: bool, form: str,
                 sweep_stride: int, ring_slots: int = 0,
                 ml_mode: str = "off", ml_kind: str = "mlp",
                 tel_mode: str = "off", tnt_mode: str = "off",
-                fib_impl: str = "dense") -> str:
+                fib_impl: str = "dense",
+                sess_impl: str = "gather") -> str:
     from vpp_tpu.pipeline.graph import SWEEP_STRIDE_DEFAULT
 
-    return "{}{}{}{}{}{}{}{}_{}".format(
+    return "{}{}{}{}{}{}{}{}{}_{}".format(
         impl, "_nolocal" if skip_local else "", "_auto" if fast else "",
         ("" if ml_mode == "off"
          else f"_ml{ml_mode}"
@@ -368,6 +369,7 @@ def _step_label(impl: str, skip_local: bool, fast: bool, form: str,
         "" if tel_mode == "off" else f"_tel{tel_mode}",
         "" if tnt_mode == "off" else "_tenancy",
         "" if fib_impl == "dense" else f"_fib{fib_impl}",
+        "" if sess_impl == "gather" else f"_sess{sess_impl}",
         ("" if sweep_stride == SWEEP_STRIDE_DEFAULT
          else f"_sw{sweep_stride}"),
         f"{form}{ring_slots}" if form == "ring" else form)
@@ -473,21 +475,21 @@ def _jitted_step(impl: str, skip_local: bool, fast: bool, form: str,
                  ring_slots: int = 0,
                  ml_mode: str = "off", ml_kind: str = "mlp",
                  tel_mode: str = "off", tnt_mode: str = "off",
-                 fib_impl: str = "dense"):
+                 fib_impl: str = "dense", sess_impl: str = "gather"):
     from vpp_tpu.pipeline.graph import SWEEP_STRIDE_DEFAULT
 
     if sweep_stride is None:
         sweep_stride = SWEEP_STRIDE_DEFAULT
     key = (impl, skip_local, fast, form, sweep_stride, ring_slots,
-           ml_mode, ml_kind, tel_mode, tnt_mode, fib_impl)
+           ml_mode, ml_kind, tel_mode, tnt_mode, fib_impl, sess_impl)
     step = _JIT_STEPS.get(key)
     if step is None:
         fn = make_pipeline_step(impl, skip_local, fast, sweep_stride,
                                 ml_mode, ml_kind, tel_mode, tnt_mode,
-                                fib_impl)
+                                fib_impl, sess_impl)
         label = _step_label(impl, skip_local, fast, form, sweep_stride,
                             ring_slots, ml_mode, ml_kind, tel_mode,
-                            tnt_mode, fib_impl)
+                            tnt_mode, fib_impl, sess_impl)
         if form == "plain":
             step = jax.jit(_counting(label, fn))
         elif form == "packed":
@@ -681,6 +683,14 @@ class Dataplane:
         self.fib_lpm_min_routes = int(
             getattr(self.config, "fib_lpm_min_routes", 256))
         self._fib_impl = "dense"
+        # Session-probe implementation (ISSUE 16; ops/session.py
+        # gather rung vs the fused pallas probe): eligibility is pure
+        # backend + VMEM-budget — no staged state — but the selection
+        # is re-derived with the rest so `show kernels` reads one
+        # coherent snapshot.
+        self.session_impl_knob = getattr(self.config, "session_impl",
+                                         "auto")
+        self._session_impl = "gather"
         # optional Prometheus histogram (stats/collector.py): observes
         # the fib-group upload cost of every swap that actually
         # re-shipped FIB state (vpp_tpu_fib_churn_commit_seconds)
@@ -1003,9 +1013,67 @@ class Dataplane:
     @property
     def fib_impl(self) -> str:
         """The ip4-lookup implementation the LIVE epoch runs ("dense" |
-        "lpm") — surfaced by `show fib` and the ``vpp_tpu_fib_impl``
-        info gauge (ISSUE 15)."""
+        "lpm" | "pallas") — surfaced by `show fib` and the
+        ``vpp_tpu_fib_impl`` info gauge (ISSUE 15/16)."""
         return self._fib_impl
+
+    @property
+    def session_impl(self) -> str:
+        """The session-probe implementation the LIVE epoch runs
+        ("gather" | "pallas") — surfaced by `show kernels` and the
+        ``vpp_tpu_kernel_impl`` info gauge (ISSUE 16)."""
+        return self._session_impl
+
+    def kernel_snapshot(self) -> dict:
+        """Per-op kernel-rung resolution behind `show kernels` and the
+        ``vpp_tpu_kernel_impl`` info-gauge family: which rung each hot
+        op's ladder selected, the operator's knob, and WHY (the
+        eligibility bit that decided). One coherent read under the
+        lock — the StepStats ↔ Prometheus parity discipline."""
+        from vpp_tpu.ops._pallas import pallas_available, use_pallas
+        from vpp_tpu.ops.session import session_pallas_fits
+
+        with self._lock:
+            b = self.builder
+            p_ok = use_pallas()
+
+            def why(impl, knob, eligible, reason_ineligible):
+                if impl == "pallas":
+                    return "tpu backend + structure eligible"
+                if knob == impl:
+                    return "explicit knob"
+                if not p_ok:
+                    return "no tpu backend (pallas rung needs one)"
+                if not eligible:
+                    return reason_ineligible
+                return "ladder heuristic"
+
+            return {
+                "backend": jax.default_backend(),
+                "pallas_available": pallas_available(),
+                "classifier": {
+                    "impl": self._classifier_impl,
+                    "knob": self.classifier,
+                    "why": why(self._classifier_impl, self.classifier,
+                               b.bv_ok(),
+                               "bv structure ineligible"),
+                },
+                "fib": {
+                    "impl": self._fib_impl,
+                    "knob": self.fib_impl_knob,
+                    "why": why(self._fib_impl, self.fib_impl_knob,
+                               b.lpm_ok(),
+                               "lpm planes ineligible"),
+                },
+                "session": {
+                    "impl": self._session_impl,
+                    "knob": self.session_impl_knob,
+                    "why": why(self._session_impl,
+                               self.session_impl_knob,
+                               session_pallas_fits(self.config),
+                               "table exceeds VMEM budget"),
+                },
+            }
 
     def fib_snapshot(self) -> Optional[dict]:
         """Host scalars behind `show fib` / the ``vpp_tpu_fib_*``
@@ -1066,13 +1134,14 @@ class Dataplane:
         ladder (partition.select_impl), which the cluster and
         multi-host planes apply to their own agreed bits so the mesh
         can never silently select a different rung."""
+        from vpp_tpu.ops._pallas import use_pallas
         from vpp_tpu.parallel.partition import select_impl
 
         b = self.builder
         return select_impl(self.classifier, b.bv_ok(),
                            b.mxu_enabled and b.glb_mxu.ok,
                            b.glb_nrules, self.bv_min_rules,
-                           self.mxu_threshold)
+                           self.mxu_threshold, pallas_ok=use_pallas())
 
     def _refresh_selection(self) -> None:
         """Re-gate every per-epoch compile-time choice against the
@@ -1095,11 +1164,20 @@ class Dataplane:
         # FIB ladder (ISSUE 15): lpm when eligible and big enough —
         # the ONE shared rung mapping (partition.select_fib_impl), so
         # a mesh plane adopting the ladder can never diverge
-        from vpp_tpu.parallel.partition import select_fib_impl
+        from vpp_tpu.ops._pallas import use_pallas
+        from vpp_tpu.ops.session import session_pallas_fits
+        from vpp_tpu.parallel.partition import (
+            select_fib_impl,
+            select_session_impl,
+        )
 
+        p_ok = use_pallas()
         self._fib_impl = select_fib_impl(
             self.fib_impl_knob, b.lpm_ok(), b.fib_route_count(),
-            self.fib_lpm_min_routes)
+            self.fib_lpm_min_routes, pallas_ok=p_ok)
+        self._session_impl = select_session_impl(
+            self.session_impl_knob,
+            p_ok and session_pallas_fits(self.config))
 
     def _get_step(self, fast: bool, form: str = "plain"):
         """The jit-cached step variant of the current selection.
@@ -1117,7 +1195,7 @@ class Dataplane:
         skip = self._skip_local
         stride = self._sweep_stride
         gates = (self._ml_mode, self._ml_kind, self._tel_mode,
-                 self._tnt_mode, self._fib_impl)
+                 self._tnt_mode, self._fib_impl, self._session_impl)
         if (skip
                 and (self._classifier_impl, skip, fast, form, stride,
                      0) + gates not in _JIT_STEPS
@@ -1129,7 +1207,8 @@ class Dataplane:
                             ml_kind=self._ml_kind,
                             tel_mode=self._tel_mode,
                             tnt_mode=self._tnt_mode,
-                            fib_impl=self._fib_impl)
+                            fib_impl=self._fib_impl,
+                            sess_impl=self._session_impl)
 
     def time_classifier(self, batch: int = 256, iters: int = 10) -> float:
         """Diagnostic: time the SELECTED global classifier in isolation

@@ -426,6 +426,7 @@ def pipeline_step(
     tel_mode: str = "off",
     tnt_mode: str = "off",
     fib_fn=fib_lookup_dense,
+    sess_impl: str = "gather",
     shard=None,
     _tnt_pre=None,
 ) -> StepResult:
@@ -471,7 +472,7 @@ def pipeline_step(
     # Expired entries (idle > sess_max_age ticks) don't match, and hits
     # refresh the timestamp — active flows never expire mid-flow.
     established, sess_hit_idx = session_lookup_reverse_idx(
-        tables, pkts, now, shard=shard, tnt=tnt)
+        tables, pkts, now, shard=shard, tnt=tnt, impl=sess_impl)
     established = established & alive
     # pre-touch session age: an ML feature (the touch below refreshes
     # the timestamp, so the age must be captured first — the fast tier
@@ -686,6 +687,7 @@ def pipeline_step_fast(
     tel_mode: str = "off",
     tnt_mode: str = "off",
     fib_fn=fib_lookup_dense,
+    sess_impl: str = "gather",
     shard=None,
 ) -> StepResult:
     """The classify-free established-flow kernel, standalone:
@@ -706,7 +708,7 @@ def pipeline_step_fast(
     alive = alive & ~tnt_dropped
     tnt = tnt_mode != "off"
     established, sess_hit_idx = session_lookup_reverse_idx(
-        tables, pkts, now, shard=shard, tnt=tnt)
+        tables, pkts, now, shard=shard, tnt=tnt, impl=sess_impl)
     established = established & alive
     pkts, nat_reversed, nat_hit_idx = nat44_reverse(tables, pkts, alive,
                                                     now, shard=shard,
@@ -732,6 +734,7 @@ def pipeline_step_auto(
     tel_mode: str = "off",
     tnt_mode: str = "off",
     fib_fn=fib_lookup_dense,
+    sess_impl: str = "gather",
     shard=None,
 ) -> StepResult:
     """Two-tier dispatch: the fast kernel when the whole batch rides
@@ -778,7 +781,7 @@ def pipeline_step_auto(
     alive = alive & ~tnt_dropped
     tnt = tnt_mode != "off"
     hits, sess_hit_idx, all_hit = session_batch_summary(
-        tbl, pkts1, alive, now, shard=shard, tnt=tnt
+        tbl, pkts1, alive, now, shard=shard, tnt=tnt, impl=sess_impl
     )
     # NAT reverse runs before the DNAT probe: the un-NAT'd header is
     # what the full chain would hand nat44_dnat
@@ -811,7 +814,8 @@ def pipeline_step_auto(
                              acl_local_fn, sweep_stride=sweep_stride,
                              ml_mode=ml_mode, ml_kind=ml_kind,
                              tel_mode=tel_mode, tnt_mode=tnt_mode,
-                             fib_fn=fib_fn, shard=shard,
+                             fib_fn=fib_fn, sess_impl=sess_impl,
+                             shard=shard,
                              _tnt_pre=((tid, tnt_dropped, tbl)
                                        if tnt else None))
 
@@ -834,6 +838,17 @@ def _classifier_fns(impl: str):
         )
 
         return acl_classify_global_bv, acl_classify_local_bv
+    if impl == "pallas":
+        # ISSUE 16: the fused BV word-AND + first-set kernel rung.
+        # The functions dispatch internally (ops/_pallas.use_pallas):
+        # off-TPU they ARE the bv rung, so a pallas-knobbed config
+        # stays bit-exact on the CPU harness.
+        from vpp_tpu.ops.acl_bv import (
+            acl_classify_global_pallas,
+            acl_classify_local_pallas,
+        )
+
+        return acl_classify_global_pallas, acl_classify_local_pallas
     if impl != "dense":
         raise ValueError(f"unknown classifier impl {impl!r}")
     return acl_classify_global, acl_classify_local
@@ -841,12 +856,17 @@ def _classifier_fns(impl: str):
 
 def _fib_fn(fib_impl: str):
     """The ip4-lookup implementation of one ladder rung (the
-    _classifier_fns twin — ops/fib.py dense masked-compare or
-    ops/lpm.py binary-search-over-prefix-lengths; docs/ROUTING.md)."""
+    _classifier_fns twin — ops/fib.py dense masked-compare,
+    ops/lpm.py binary-search-over-prefix-lengths, or its fused pallas
+    form; docs/ROUTING.md, docs/KERNELS.md)."""
     if fib_impl == "lpm":
         from vpp_tpu.ops.lpm import fib_lookup_lpm
 
         return fib_lookup_lpm
+    if fib_impl == "pallas":
+        from vpp_tpu.ops.lpm import fib_lookup_lpm_fused
+
+        return fib_lookup_lpm_fused
     if fib_impl != "dense":
         raise ValueError(f"unknown fib impl {fib_impl!r}")
     return fib_lookup_dense
@@ -858,7 +878,8 @@ def make_pipeline_step(impl: str = "dense", skip_local: bool = False,
                        sweep_stride: int = SWEEP_STRIDE_DEFAULT,
                        ml_mode: str = "off", ml_kind: str = "mlp",
                        tel_mode: str = "off", tnt_mode: str = "off",
-                       fib_impl: str = "dense"):
+                       fib_impl: str = "dense",
+                       sess_impl: str = "gather"):
     """Compose one pipeline-step callable from the epoch's gates:
     classifier implementation (dense | mxu | bv), the policy-free
     local-classify skip, the two-tier fast-path dispatch, the session
@@ -885,6 +906,8 @@ def make_pipeline_step(impl: str = "dense", skip_local: bool = False,
         raise ValueError(f"unknown tel_mode {tel_mode!r}")
     if tnt_mode not in ("off", "on"):
         raise ValueError(f"unknown tnt_mode {tnt_mode!r}")
+    if sess_impl not in ("gather", "pallas"):
+        raise ValueError(f"unknown sess_impl {sess_impl!r}")
     acl_global_fn, acl_local_fn = _classifier_fns(impl)
     fib_fn = _fib_fn(fib_impl)
     if skip_local:
@@ -896,15 +919,17 @@ def make_pipeline_step(impl: str = "dense", skip_local: bool = False,
         return base(tables, pkts, now, acl_global_fn=acl_global_fn,
                     acl_local_fn=acl_local_fn, sweep_stride=sweep_stride,
                     ml_mode=ml_mode, ml_kind=ml_kind, tel_mode=tel_mode,
-                    tnt_mode=tnt_mode, fib_fn=fib_fn)
+                    tnt_mode=tnt_mode, fib_fn=fib_fn,
+                    sess_impl=sess_impl)
 
-    step.__name__ = "pipeline_step_{}{}{}{}{}{}{}".format(
+    step.__name__ = "pipeline_step_{}{}{}{}{}{}{}{}".format(
         impl, "_nolocal" if skip_local else "", "_auto" if fast else "",
         "" if ml_mode == "off" else f"_ml{ml_mode}"
         + ("_forest" if ml_kind == "forest" else ""),
         "" if tel_mode == "off" else f"_tel{tel_mode}",
         "" if tnt_mode == "off" else "_tenancy",
         "" if fib_impl == "dense" else f"_fib{fib_impl}",
+        "" if sess_impl == "gather" else f"_sess{sess_impl}",
     )
     return step
 

@@ -80,6 +80,14 @@ class DataplaneConfig(NamedTuple):
     # CPU-cache sweet spot: one bucket row gather fetches the whole
     # associativity set.
     sess_ways: int = 4
+    # Session probe implementation: "gather" (the proven row-gather
+    # rung), "pallas" (the fused probe kernel, ISSUE 16 — requires a
+    # TPU backend and the table to fit the kernel's VMEM budget,
+    # ops/session.session_pallas_fits; falls back to gather when
+    # ineligible), or "auto" (pallas when eligible). Standalone only:
+    # a mesh with an explicit pallas knob is rejected at config time
+    # (parallel/partition.py validate_partitioning).
+    session_impl: str = "auto"
     # NAT-session table slots; 0 = same as sess_slots (shares sess_ways)
     natsess_slots: int = 0
     # Amortized on-device aging: every fused pipeline step sweeps this
@@ -686,10 +694,15 @@ def validate_dataplane_config(config: DataplaneConfig) -> None:
             f"dataplane.sess_sweep_stride must be 0 (disabled) or a "
             f"power of two, got {stride}")
     fib_impl = getattr(c, "fib_impl", "auto")
-    if fib_impl not in ("dense", "lpm", "auto"):
+    if fib_impl not in ("dense", "lpm", "pallas", "auto"):
         raise ValueError(
-            f"dataplane.fib_impl must be dense | lpm | auto, got "
-            f"{fib_impl!r}")
+            f"dataplane.fib_impl must be dense | lpm | pallas | auto, "
+            f"got {fib_impl!r}")
+    session_impl = getattr(c, "session_impl", "auto")
+    if session_impl not in ("gather", "pallas", "auto"):
+        raise ValueError(
+            f"dataplane.session_impl must be gather | pallas | auto, "
+            f"got {session_impl!r}")
     if int(getattr(c, "fib_lpm_min_routes", 256)) < 0:
         raise ValueError(
             f"dataplane.fib_lpm_min_routes must be >= 0, got "
@@ -1231,13 +1244,13 @@ class TableBuilder:
         from vpp_tpu.ops.acl_bv import bv_capacity, bv_enabled_for, empty_bv
 
         knob = getattr(c, "classifier", "auto")
-        if knob not in ("dense", "mxu", "bv", "auto"):
+        if knob not in ("dense", "mxu", "bv", "pallas", "auto"):
             # loud, at config time: a typo'd knob silently falling
             # through to the auto ladder would run a different
             # classifier than the operator believes is deployed
             raise ValueError(
                 f"unknown dataplane.classifier {knob!r} "
-                f"(expected dense | mxu | bv | auto)")
+                f"(expected dense | mxu | bv | pallas | auto)")
         self.bv_enabled = bv_enabled_for(c)
         self.glb_bv = empty_bv(c.max_global_rules, self.bv_enabled)
         self._bv_cols = None        # per-dim column cache (incremental)

@@ -186,8 +186,8 @@ PUMP_STAGE_SECONDS = (
 
 # Global-classify implementations the vpp_tpu_acl_classifier info
 # gauge enumerates (Dataplane.classifier_impl; ops/acl.py dense,
-# ops/acl_mxu.py, ops/acl_bv.py).
-CLASSIFIER_IMPLS = ("dense", "mxu", "bv")
+# ops/acl_mxu.py, ops/acl_bv.py, the fused Pallas BV rung — ISSUE 16).
+CLASSIFIER_IMPLS = ("dense", "mxu", "bv", "pallas")
 
 # Degraded-mode components the vpp_tpu_degraded gauge enumerates
 # (ISSUE 8): kvstore = the cluster store is unreachable (the agent
@@ -245,8 +245,22 @@ ML_STAGE_MODES = ("off", "score", "enforce")
 
 # FIB lookup implementations the vpp_tpu_fib_impl info gauge
 # enumerates (Dataplane.fib_impl; ops/fib.py dense, ops/lpm.py —
-# ISSUE 15).
-FIB_IMPLS = ("dense", "lpm")
+# ISSUE 15 — and the fused Pallas length-plane kernel — ISSUE 16).
+FIB_IMPLS = ("dense", "lpm", "pallas")
+
+# Session-probe implementations (Dataplane.session_impl; ops/session.py
+# gather rung vs the fused Pallas bucket probe — ISSUE 16).
+SESSION_IMPLS = ("gather", "pallas")
+
+# The vpp_tpu_kernel_impl info-gauge family (ISSUE 16): per hot op,
+# the candidate implementation rungs its ladder can select — published
+# from Dataplane.kernel_snapshot(), 1 on the live rung, 0 elsewhere.
+# `sum by (op, impl)` across a fleet counts nodes per kernel path.
+KERNEL_IMPL_OPS = {
+    "classifier": CLASSIFIER_IMPLS,
+    "fib": FIB_IMPLS,
+    "session": SESSION_IMPLS,
+}
 
 PUMP_GAUGES = tuple(
     (name, help_) for _, name, help_ in PUMP_STAT_GAUGES
@@ -774,6 +788,17 @@ class StatsCollector:
                   "impl label, 1 = active; lpm = per-length "
                   "binary-search planes)"),
         )
+        # per-op kernel rung selection (ISSUE 16): one info family for
+        # all three gather-bound hot ops, labelled op=/impl= — the
+        # pallas rows flip to 1 only on a TPU backend whose structure
+        # gates pass (Dataplane.kernel_snapshot)
+        self.kernel_impl_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_kernel_impl",
+                  "selected kernel implementation per hot op "
+                  "(info-style: op and impl labels, 1 = active; "
+                  "pallas = the fused TPU kernel rung)"),
+        )
         self.fib_routes_gauge = self.registry.register(
             STATS_PATH,
             Gauge("vpp_tpu_fib_routes",
@@ -1065,6 +1090,16 @@ class StatsCollector:
         for name in CLASSIFIER_IMPLS:
             self.classifier_gauge.set(
                 1.0 if name == impl else 0.0, impl=name)
+        # per-op kernel rung selection (ISSUE 16): host scalars from
+        # the selection ladder state, no device sync
+        kern_fn = getattr(self.dp, "kernel_snapshot", None)
+        kern = kern_fn() if callable(kern_fn) else None
+        if kern is not None:
+            for op, impls in KERNEL_IMPL_OPS.items():
+                live = (kern.get(op) or {}).get("impl")
+                for name in impls:
+                    self.kernel_impl_gauge.set(
+                        1.0 if name == live else 0.0, op=op, impl=name)
         # FIB routing surface (ISSUE 15): selection, scale, per-member
         # ECMP accounting — host scalars + one small [G, W] fetch
         fib_fn = getattr(self.dp, "fib_snapshot", None)
