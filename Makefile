@@ -34,7 +34,9 @@ chaos:
 # --partitions rule completeness (pure import, no jax arrays). The
 # registry passes (--metrics/--counters/--tables) import jax, so
 # tier-1 runs them from tests instead (test_exposition / test_acl_bv).
-lint:
+# autotune-check rides along: a drifted tuned/cpu.json is a lint-class
+# failure (the committed profile must round-trip the config loader).
+lint: autotune-check
 	$(PY) tools/lint.py --jax --threads --partitions
 
 # Driver-facing headline benchmark (real TPU; one JSON line).

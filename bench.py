@@ -3366,6 +3366,301 @@ def io_daemon_bench(args, duration_s: float = 5.0) -> dict:
             sh("link", "del", leg)
 
 
+def fleet_bench(args, frame_pkts: int = 1024, iters: int = 8) -> dict:
+    """Gateway fleet: elastic scale-out + live rebalance (ISSUE 18).
+
+    Scale-out ladder — N in {1, 2, 4} identical sym-hash instances
+    behind one FleetSteering tier, the SAME offered load per rung.
+    The deployment model is one instance per host, so each instance's
+    packed-step throughput is measured SEQUENTIALLY (they never share
+    this harness's cores inside a sample) and the rung aggregates as
+    parallel capacity: ``offered / (steer + max(per-instance))``. The
+    steering tier's partition cost is charged as a serial prefix — the
+    rung only scales if steering stays cheap relative to the step.
+    Acceptance: fleet_scaleout_ratio (per-doubling geometric mean)
+    >= 1.8. CPU-harness caveat: the sequential-measure/sum framing is
+    what makes the rung meaningful on one host; on a real multi-host
+    deployment the same keys measure true aggregate.
+
+    Live rebalance — a 2-instance fleet takes a 3rd member under
+    continuous FleetPump load; the newcomer's rendezvous-won ranges
+    migrate live (fence → drain → adopt → commit → release). Keys
+    prove the tentpole bar: EXACT conservation (zero unattributed
+    loss), bounded dispatch p99 across the move, and fastpath
+    hit-rate >= 0.9 on the migrated flows within a bounded number of
+    post-move windows.
+    """
+    import threading
+
+    import jax as _jax
+
+    from vpp_tpu.fleet.hashring import assign_ranges
+    from vpp_tpu.fleet.membership import FleetMembership
+    from vpp_tpu.fleet.steering import FleetSteering
+    from vpp_tpu.io.fleet import FleetPump
+    from vpp_tpu.ir.rule import Action, ContivRule, Protocol
+    from vpp_tpu.kvstore.store import KVStore
+    from vpp_tpu.pipeline.dataplane import (
+        Dataplane,
+        pack_packet_columns,
+    )
+    from vpp_tpu.pipeline.tables import DataplaneConfig
+    from vpp_tpu.pipeline.vector import Disposition
+
+    shrink = _jax.default_backend() == "cpu" and not args.cpu_full
+    if shrink:
+        frame_pkts, iters = 512, 4
+    n_frames = 32 if shrink else 64
+    sess_slots = (1 << 16) if shrink else (1 << 18)
+    # many ranges per instance smooth the rendezvous spread — a
+    # 4-member rung owns ~16 ranges each, so per-host load imbalance
+    # stays small and the ladder measures steering + step cost, not
+    # assignment variance
+    n_ranges = 64
+
+    def mk_dp():
+        cfg = DataplaneConfig(
+            max_tables=2, max_rules=16, max_global_rules=16,
+            max_ifaces=8, fib_slots=16, sess_slots=sess_slots,
+            sess_ways=4, nat_mappings=2, nat_backends=2,
+            sess_sweep_stride=0, sess_hash="sym")
+        dp = Dataplane(cfg)
+        dp.add_uplink()
+        dp.add_pod_interface(("default", "web"))
+        dp.builder.add_route("10.1.1.0/24", 2, Disposition.LOCAL)
+        dp.builder.set_global_table([
+            ContivRule(action=Action.PERMIT, protocol=Protocol.TCP),
+            ContivRule(action=Action.DENY)])
+        dp.swap()
+        return dp
+
+    pod_ip = np.uint32((10 << 24) | (1 << 16) | (1 << 8) | 2)
+
+    def mk_frames(n_fr, width, reply=False):
+        """Packed [5, width] frames of distinct TCP flows; ``reply``
+        reverses direction (same canonical buckets under sym hash)."""
+        out = []
+        for f in range(n_fr):
+            flow = f * width + np.arange(width)
+            src = (np.uint32((10 << 24) | (9 << 16))
+                   + (flow % 65536).astype(np.uint32))
+            sport = (1024 + flow % 40000).astype(np.int32)
+            n = width
+            cols = {
+                "src_ip": np.full(n, pod_ip) if reply else src,
+                "dst_ip": src if reply else np.full(n, pod_ip),
+                "proto": np.full(n, 6, np.int32),
+                "sport": np.full(n, 80, np.int32) if reply else sport,
+                "dport": sport if reply else np.full(n, 80, np.int32),
+                "ttl": np.full(n, 64, np.int32),
+                "pkt_len": np.full(n, 64, np.int32),
+                "rx_if": np.full(n, 2 if reply else 1, np.int32),
+                "flags": np.ones(n, np.int32),
+            }
+            flat = np.zeros((5, n), np.int32)
+            pack_packet_columns(flat.view(np.uint32), cols, n)
+            out.append(flat)
+        return out
+
+    out: dict = {}
+    fr = mk_frames(n_frames, frame_pkts)
+    offered = n_frames * frame_pkts
+    out["fleet_scaleout_pkts"] = offered
+    rungs = (1, 2, 4)
+    fleets = {}
+    try:
+        for n_inst in rungs:
+            names = [f"gw{i}" for i in range(n_inst)]
+            dps = {nm: mk_dp() for nm in names}
+            st = FleetSteering(dps, n_ranges=n_ranges)
+            # warm/compile once (instances share one geometry → one
+            # cached packed step) before any timed sample
+            for dp in dps.values():
+                _jax.block_until_ready(
+                    dp.process_packed(fr[0], commit=False))
+            parts = [st.partition(f)[0] for f in fr]
+            plan = []
+            for nm in names:
+                share = [np.ascontiguousarray(f[:, idx])
+                         for f, groups in zip(fr, parts)
+                         for idx in (groups.get(nm),)
+                         if idx is not None and idx.size]
+                cols = np.concatenate(share, axis=1)
+                npk = cols.shape[1]
+                pad = (-npk) % frame_pkts
+                if pad:
+                    cols = np.concatenate(
+                        [cols, np.zeros((5, pad), np.int32)],
+                        axis=1)
+                inst_frames = [cols[:, i:i + frame_pkts]
+                               for i in range(0, cols.shape[1],
+                                              frame_pkts)]
+                # equal-DURATION samples: scale iterations so every
+                # sample moves the same packet total regardless of
+                # share size (a quarter-share loop is otherwise so
+                # short it fits inside one host-scheduler throttling
+                # window and reads 30-40% slow)
+                it = max(1, round(offered * iters / npk))
+                plan.append((dps[nm], nm, npk, it, inst_frames))
+            fleets[n_inst] = (st, plan)
+
+        # INTERLEAVED best-of-3 over all rungs: the harness's
+        # sustained rate drifts on ~minute timescales (burst credits,
+        # frequency scaling), so measuring rung 1 minutes before rung
+        # 4 folds host drift straight into the scaling ratio; a
+        # round-robin pass hits every rung inside each drift window
+        # and best-of picks each instance's sustained floor
+        steer_best = {n: float("inf") for n in rungs}
+        proc_best: dict = {}
+        for _ in range(3):
+            for n_inst in rungs:
+                st, plan = fleets[n_inst]
+                t0 = time.perf_counter()
+                for f in fr:
+                    st.partition(f)
+                steer_best[n_inst] = min(
+                    steer_best[n_inst], time.perf_counter() - t0)
+                for dp, nm, npk, it, inst_frames in plan:
+                    t0 = time.perf_counter()
+                    res = None
+                    for _ in range(it):
+                        for flat in inst_frames:
+                            res = dp.process_packed(flat,
+                                                    commit=True)
+                    _jax.block_until_ready(res)
+                    _jax.block_until_ready(dp.tables.sess_valid)
+                    dt = time.perf_counter() - t0
+                    key = (n_inst, nm)
+                    proc_best[key] = min(
+                        proc_best.get(key, float("inf")), dt)
+
+        mpps = {}
+        for n_inst in rungs:
+            st, plan = fleets[n_inst]
+            # padded tail slots are processed but not credited — the
+            # per-host rate only counts real packets; hosts run in
+            # parallel (one instance per host) so their rates SUM,
+            # and the dispatch tier's serial partition rate caps the
+            # aggregate — the rung only scales while steering stays
+            # off the critical path
+            tput = [npk * it / proc_best[(n_inst, nm)]
+                    for _, nm, npk, it, _f in plan]
+            steer_rate = offered / steer_best[n_inst]
+            mpps[n_inst] = min(sum(tput), steer_rate) / 1e6
+            out[f"fleet_scaleout_mpps_{n_inst}"] = round(
+                mpps[n_inst], 3)
+        out["fleet_steer_ns_pkt"] = round(
+            steer_best[4] / offered * 1e9, 1)
+    finally:
+        for st, _plan in fleets.values():
+            st.close()
+    out["fleet_scaleout_ratio"] = round(
+        (mpps[4] / mpps[1]) ** 0.5, 2)
+
+    # --- live rebalance under load -----------------------------------
+    width = 256
+    n_flows = 2048 if shrink else 8192
+    fwd = mk_frames(n_flows // width, width)
+    rev = mk_frames(n_flows // width, width, reply=True)
+    names = ["gw0", "gw1", "gw2"]
+    dps = {nm: mk_dp() for nm in names}
+    st = FleetSteering(
+        dps, membership=FleetMembership(KVStore(), name="bench"),
+        n_ranges=n_ranges)
+    pump = FleetPump(st, frame_width=width, queue_slots=256)
+
+    def drain(timeout=60.0):
+        pump.flush()
+        t0 = time.perf_counter()
+        while pump.pending() and time.perf_counter() - t0 < timeout:
+            time.sleep(0.001)
+
+    seen = {"hits": 0, "deliv": 0}
+
+    def window(frames_list):
+        lats = []
+        for f in frames_list:
+            t0 = time.perf_counter()
+            pump.submit(f)
+            lats.append(time.perf_counter() - t0)
+        drain()
+        snap = pump.stats_snapshot()
+        hits = sum(a.get("sess_hits", 0)
+                   for a in snap["aux"].values())
+        deliv = sum(snap["delivered"].values())
+        dh = hits - seen["hits"]
+        dd = deliv - seen["deliv"]
+        seen["hits"], seen["deliv"] = hits, deliv
+        return lats, (dh / dd if dd else 0.0)
+
+    try:
+        # shrink the fleet to two members, then establish every flow
+        st.rebalance(target=assign_ranges(["gw0", "gw1"], n_ranges))
+        pump.start()
+        for f in fwd:
+            pump.submit(f)
+        drain()
+        # prime the per-window delta baseline PAST the establishment
+        # phase (inserts, not hits) so window hit rates measure only
+        # reply traffic
+        snap0 = pump.stats_snapshot()
+        seen["hits"] = sum(a.get("sess_hits", 0)
+                           for a in snap0["aux"].values())
+        seen["deliv"] = sum(snap0["delivered"].values())
+        base_lats, base_hit = window(rev)
+        out["fleet_rebalance_hit_rate_base"] = round(base_hit, 3)
+
+        # the newcomer joins: default target re-runs rendezvous over
+        # all three instances; its won ranges migrate live while
+        # reply windows keep flowing through the pump
+        ss0 = st.stats_snapshot()
+        mover = threading.Thread(target=st.rebalance, daemon=True)
+        move_lats: list = []
+        mover.start()
+        while mover.is_alive():
+            lats, _ = window(rev)
+            move_lats.extend(lats)
+        mover.join()
+
+        recovery = -1
+        max_w = 10
+        for w in range(1, max_w + 1):
+            _, hit = window(rev)
+            if hit >= 0.9:
+                recovery = w
+                break
+        out["fleet_rebalance_hit_rate_final"] = round(hit, 3)
+        out["fleet_rebalance_recovery_windows"] = recovery
+        pump.stop()
+        cons = pump.conservation()
+        attributed = (cons["delivered"] + cons["fenced_drops"]
+                      + cons["no_owner_drops"] + cons["queue_drops"]
+                      + cons["pending"])
+        out["fleet_rebalance_offered"] = cons["offered"]
+        out["fleet_rebalance_delivered"] = cons["delivered"]
+        out["fleet_rebalance_fenced_drops"] = cons["fenced_drops"]
+        out["fleet_rebalance_queue_drops"] = cons["queue_drops"]
+        out["fleet_rebalance_conservation_exact"] = int(
+            cons["offered"] == attributed and cons["pending"] == 0)
+        ss = st.stats_snapshot()
+        out["fleet_rebalance_ranges_moved"] = (
+            ss["migrated_ranges"] - ss0["migrated_ranges"])
+        out["fleet_rebalance_sessions_moved"] = (
+            ss["migrated_sessions"] - ss0["migrated_sessions"])
+        out["fleet_rebalance_p99_ms_base"] = round(
+            float(np.percentile(np.array(base_lats) * 1e3, 99)), 3)
+        if move_lats:
+            out["fleet_rebalance_p99_ms_move"] = round(
+                float(np.percentile(np.array(move_lats) * 1e3, 99)), 3)
+    finally:
+        try:
+            pump.stop()
+        except Exception:  # noqa: BLE001 — already stopped
+            pass
+        st.close()
+    return out
+
+
 def main():
     try:
         # Supervisor by default: the axon tunnel wedges MID-RUN without
@@ -3748,6 +4043,18 @@ def _run():
         pri["latency_telemetry_error"] = f"{type(e).__name__}: {e}"
     _jc_now = _jit_compiles_now()
     pri["latency_telemetry_jit_compiles"] = _jc_now - _jc
+    _jc = _jc_now
+    _progress(**pri)
+    try:
+        # gateway fleet (ISSUE 18): the scale-out ladder (1→2→4
+        # instances, acceptance fleet_scaleout_ratio >= 1.8 per
+        # doubling) + live rebalance under pump load (acceptance:
+        # conservation EXACT, hit-rate recovery >= 0.9)
+        pri.update(fleet_bench(args))
+    except Exception as e:  # noqa: BLE001
+        pri["fleet_bench_error"] = f"{type(e).__name__}: {e}"
+    _jc_now = _jit_compiles_now()
+    pri["fleet_jit_compiles"] = _jc_now - _jc
     _jc = _jc_now
     _progress(**pri)
     if not args.no_subbench:

@@ -1,0 +1,152 @@
+"""Shared jittered backoff (vpp_tpu.net.backoff) + the reconnect
+storm it exists to break up (ISSUE 18 satellite).
+
+The jitter pact every retry loop in the tree leans on: delay for
+attempt ``a`` is ``min(cap, base * 2**a)`` scaled by a [0.5, 1.0)
+draw — exponential growth, a hard cap, a floor that guarantees
+forward progress, and per-loop decorrelation. The storm test drives
+the real surface: a fleet of RemoteKVStore clients holding the
+FleetMembership prefix watch all lose the server at once, reconnect
+on their own jittered schedules, re-register the watch, and resync
+the member churn they missed — event-gated, no wall-clock sleeps.
+"""
+
+from __future__ import annotations
+
+import queue
+import random
+
+from vpp_tpu.fleet.membership import FleetMembership
+from vpp_tpu.kvstore.client import RemoteKVStore
+from vpp_tpu.kvstore.server import KVServer
+from vpp_tpu.kvstore.store import KVStore
+from vpp_tpu.net.backoff import Backoff, backoff_with_jitter
+
+
+def _envelope(attempt, base, cap):
+    return min(cap, base * 2.0 ** attempt)
+
+
+class TestJitterBounds:
+    def test_delay_stays_inside_the_jitter_band(self):
+        """Every draw lands in [env/2, env): the 0.5 floor is what
+        stops a reconnect loop from busy-spinning on a ~0 draw, the
+        open top keeps callers under the exponential envelope."""
+        rng = random.Random(1)
+        base, cap = 0.1, 2.0
+        for attempt in range(14):
+            env = _envelope(attempt, base, cap)
+            for _ in range(200):
+                d = backoff_with_jitter(attempt, base, cap, rng=rng)
+                assert 0.5 * env <= d < env
+
+    def test_cap_bounds_late_attempts(self):
+        rng = random.Random(2)
+        for attempt in (6, 20, 63, 1000):
+            d = backoff_with_jitter(attempt, 0.1, 2.0, rng=rng)
+            assert d < 2.0  # 2**attempt must not outrun the cap
+
+    def test_negative_attempt_clamps_to_base(self):
+        rng = random.Random(3)
+        d = backoff_with_jitter(-5, 0.1, 2.0, rng=rng)
+        assert 0.05 <= d < 0.1
+
+    def test_seeded_schedule_is_reproducible(self):
+        """Determinism for tests is the rng parameter's whole job:
+        same seed, same schedule — different seeds decorrelate."""
+        sched = [Backoff(0.1, 2.0, rng=random.Random(7)).next()
+                 for _ in range(1)]
+        a = Backoff(0.1, 2.0, rng=random.Random(7))
+        b = Backoff(0.1, 2.0, rng=random.Random(7))
+        sa = [a.next() for _ in range(10)]
+        sb = [b.next() for _ in range(10)]
+        assert sa == sb
+        assert sa[0] == sched[0]
+        c = Backoff(0.1, 2.0, rng=random.Random(8))
+        assert [c.next() for _ in range(10)] != sa
+
+    def test_herd_desynchronizes(self):
+        """16 pacers with distinct seeds: no two share a schedule —
+        the property that spreads a thundering herd."""
+        scheds = []
+        for seed in range(16):
+            bo = Backoff(0.1, 2.0, rng=random.Random(seed))
+            scheds.append(tuple(bo.next() for _ in range(6)))
+        assert len(set(scheds)) == 16
+
+    def test_reset_returns_to_the_base_envelope(self):
+        bo = Backoff(0.1, 2.0, rng=random.Random(9))
+        for _ in range(8):
+            bo.next()
+        assert bo.attempt == 8
+        bo.reset()
+        assert bo.attempt == 0 and bo.last_delay == 0.0
+        assert bo.next() < 0.1  # first-attempt envelope again
+        st = bo.state()
+        assert st["base_s"] == 0.1 and st["attempt"] == 1
+
+
+class TestReconnectStorm:
+    def test_membership_watchers_survive_a_server_restart(self):
+        """The storm: every steering tier in a fleet holds the
+        FleetMembership prefix watch through ONE kvserver. The server
+        dies and restarts; each client reconnects on its own jittered
+        schedule, re-registers the watch, and the resync snapshot
+        hands it the member churn it missed — no watcher is left
+        gapped, no watcher needs a manual re-subscribe. Seeded rng,
+        event-gated throughout (queue timeouts, not sleeps)."""
+        random.seed(0xB0FF)  # module-rng draws inside the clients
+        store = KVStore()
+        gw = {n: FleetMembership(store, name=n, ttl_s=600.0)
+              for n in ("gw0", "gw1", "gw2")}
+        gw["gw0"].join()
+        srv = KVServer(store=store, host="127.0.0.1", port=0).start()
+        port = srv.port
+        clients, queues, cancels = [], [], []
+        try:
+            for i in range(5):
+                c = RemoteKVStore("127.0.0.1", port,
+                                  reconnect_backoff=(0.05, 0.2),
+                                  reconnect_timeout=10.0)
+                clients.append(c)
+                q = queue.Queue()
+                queues.append(q)
+                initial, cancel = FleetMembership(
+                    c, name=f"steer{i}").watch_members(q.put)
+                cancels.append(cancel)
+                assert initial == ["gw0"]
+
+            gw["gw1"].join()
+            for q in queues:
+                assert "gw1" in q.get(timeout=5)
+
+            # the storm: one server death under every watcher at once;
+            # churn happens while the fleet is away
+            srv.close()
+            gw["gw1"].leave()
+            gw["gw2"].join()
+            srv = KVServer(store=store, host="127.0.0.1",
+                           port=port).start()
+
+            # every client must converge on the post-outage truth via
+            # its re-registered watch (resync or the next event)
+            want = ["gw0", "gw2"]
+            for i, (c, q) in enumerate(zip(clients, queues)):
+                seen = None
+                while seen != want:
+                    seen = q.get(timeout=15)
+                assert sorted(FleetMembership(
+                    c, name=f"steer{i}").members()) == want
+
+            # and the stream is LIVE again, not just resynced
+            gw["gw1"].join()
+            for q in queues:
+                names = q.get(timeout=5)
+                while "gw1" not in names:
+                    names = q.get(timeout=5)
+        finally:
+            for cancel in cancels:
+                cancel()
+            for c in clients:
+                c.close()
+            srv.close()

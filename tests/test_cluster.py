@@ -7,6 +7,7 @@ and the rule-sharded global table recombination.
 """
 
 import numpy as np
+import pytest
 
 from vpp_tpu.ipam import IPAM
 import ipaddress
@@ -289,6 +290,90 @@ def test_mxu_sharded_equals_dense_sharded_at_scale():
     assert int(np.asarray(res_m.stats.drop_acl).sum()) > 0
     delivered = np.asarray(res_m.delivered.disp)[1]
     assert (delivered == int(Disposition.LOCAL)).sum() > 0
+
+
+@pytest.mark.slow  # ~90 s: three cluster builds, two stepped (one
+# shard_map compile per FIB rung). The tier-1 pin for the mesh flip is
+# test_multihost_unit.py::test_publish_agrees_fib_rung_fleet_wide —
+# same select_fib_impl agreement + lpm step, one in-process mesh.
+def test_fib_lpm_sharded_equals_dense_sharded():
+    """The auto FIB ladder reaches the LPM rung on the mesh (the
+    ROUTING.md "mechanical when a mesh gateway needs it" flip): a
+    cluster staging >= fib_lpm_min_routes eligible routes selects lpm,
+    and its verdicts — including nested-prefix longest-match decisions
+    — are bit-identical to the dense cluster's."""
+    mesh = cluster_mesh(2, 2)
+    base = DataplaneConfig(
+        max_tables=2, max_rules=8, max_global_rules=8, max_ifaces=8,
+        fib_slots=64, sess_slots=256, nat_mappings=2, nat_backends=4,
+        fib_lpm_min_routes=8,
+    )
+
+    def build(cfg):
+        cluster = ClusterDataplane(mesh, cfg)
+        pod_if = {}
+        for nid in range(2):
+            node = cluster.node(nid)
+            uplink = node.add_uplink()
+            idx = node.add_pod_interface(("ns", f"p{nid}"))
+            pod_if[nid] = idx
+            node.builder.add_route(f"10.1.{nid}.2/32", idx, Disposition.LOCAL)
+            other = 1 - nid
+            node.builder.add_route(
+                f"10.1.{other}.0/24", uplink, Disposition.REMOTE,
+                node_id=other)
+            # Nested prefixes: the /16 covers every 10.2.x dst, the
+            # /24s override a slice of it back to a LOCAL pod — the
+            # longest-match decision is where dense and lpm could
+            # diverge, so the spread pins it.
+            node.builder.add_route(
+                "10.2.0.0/16", uplink, Disposition.REMOTE, node_id=other)
+            for i in range(6):
+                node.builder.add_route(
+                    f"10.2.{2 * i}.0/24", idx, Disposition.LOCAL)
+            node.builder.set_global_table(
+                [ContivRule(action=Action.PERMIT)])
+        cluster.swap()
+        return cluster, pod_if
+
+    def frames(cluster, rx_if):
+        pkts = []
+        for i in range(24):
+            # alternate between /24-covered (LOCAL at this node) and
+            # /16-only (REMOTE via fabric) dsts, plus a no-route miss
+            dst = (f"10.2.{i % 14}.7" if i % 3 else "10.9.0.1")
+            pkts.append(dict(src="10.1.0.2", dst=dst, proto=6,
+                             sport=20000 + i, dport=80, rx_if=rx_if))
+        pkts.append(dict(src="10.1.0.2", dst="10.1.1.2", proto=6,
+                         sport=40000, dport=80, rx_if=rx_if))
+        return cluster.make_frames([pkts, []], n=32)
+
+    dense, pod_if_d = build(base._replace(fib_impl="dense"))
+    assert dense.fib_impl == "dense"
+    res_d = dense.step(frames(dense, pod_if_d[0]), now=1)
+
+    lpm, pod_if_l = build(base)  # auto + 9 routes/node >= 8 -> lpm
+    assert pod_if_l == pod_if_d
+    assert lpm.fib_impl == "lpm"
+    res_l = lpm.step(frames(lpm, pod_if_l[0]), now=1)
+
+    for res in (res_d, res_l):
+        disp = np.asarray(res.local.disp)[0]
+        assert (disp == int(Disposition.LOCAL)).sum() > 0
+        assert (disp == int(Disposition.REMOTE)).sum() > 0
+    for field in ("disp", "tx_if", "node_id"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(res_d.local, field)),
+            np.asarray(getattr(res_l.local, field)))
+    for field in ("disp", "tx_if"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(res_d.delivered, field)),
+            np.asarray(getattr(res_l.delivered, field)))
+
+    # below the ladder's min_routes floor the same staged FIB stays
+    # dense — the standalone Dataplane discipline, verbatim
+    small, _ = build(base._replace(fib_lpm_min_routes=256))
+    assert small.fib_impl == "dense"
 
 
 def test_wire_step_carries_payload_across_fabric():

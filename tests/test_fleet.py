@@ -1,0 +1,607 @@
+"""Gateway fleet: consistent-hash steering, live migration, elastic
+scale-out (ISSUE 18 tentpole).
+
+What must hold:
+
+* the NumPy steering hash is BIT-IDENTICAL to the device ``sym``
+  session hash (differential over random tuples, hairpins included) —
+  the whole design rests on the steering tier and the instances
+  agreeing on every packet's bucket;
+* rendezvous assignment is deterministic and disruption-bounded:
+  adding a member moves only ranges the newcomer wins, removing one
+  moves only its own ranges;
+* steering conservation is EXACT: offered == steered + attributed
+  drops at every instant, including mid-rebalance and after a crashed
+  migration;
+* live migration preserves sessions: reply-direction traffic after a
+  range moves hits the fastpath on the NEW owner (hit rate >= 0.9,
+  the warm-restart bar), and the source's released range serves
+  nothing;
+* fencing is absolute: from the fence CAS to the commit, NO steering
+  tier (including a second tier sharing the store) routes the range
+  anywhere — a crashed migration leaves attributed drops, never
+  misdelivery, and ``recover()`` completes the move;
+* per-tenant placement composes with tnt_sess_base/mask: a sliced
+  tenant's bucket window projects onto multiple ranges and therefore
+  multiple instances.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from vpp_tpu.fleet.hashring import (
+    assign_ranges,
+    buckets_of_packed,
+    canon_mix_np,
+    moved_ranges,
+    range_span,
+    tenant_ranges,
+    tenant_spread,
+)
+from vpp_tpu.fleet.membership import FENCED, FleetMembership
+from vpp_tpu.fleet.steering import FleetSteering
+from vpp_tpu.io.fleet import FleetPump
+from vpp_tpu.ir.rule import Action, ContivRule, Protocol
+from vpp_tpu.kvstore.store import KVStore
+from vpp_tpu.pipeline.dataplane import Dataplane, pack_packet_columns
+from vpp_tpu.pipeline.tables import DataplaneConfig
+from vpp_tpu.pipeline.vector import Disposition, make_packet_vector
+from vpp_tpu.testing import faults
+
+
+def build_dp(**over):
+    base = dict(
+        max_tables=2, max_rules=16, max_global_rules=16, max_ifaces=8,
+        fib_slots=16, sess_slots=1024, sess_ways=4, nat_mappings=2,
+        nat_backends=2, sess_sweep_stride=0, sess_hash="sym",
+    )
+    base.update(over)
+    dp = Dataplane(DataplaneConfig(**base))
+    up = dp.add_uplink()
+    pod = dp.add_pod_interface(("default", "web"))
+    dp.builder.add_route("10.1.1.0/24", pod, Disposition.LOCAL)
+    dp.builder.add_route("0.0.0.0/0", up, Disposition.REMOTE,
+                         node_id=1)
+    dp.builder.set_global_table([
+        ContivRule(action=Action.PERMIT, protocol=Protocol.TCP),
+        ContivRule(action=Action.DENY),
+    ])
+    dp.swap()
+    return dp
+
+
+def forward_pkts(n, base=0, rx_if=1):
+    return make_packet_vector(
+        [{"src": f"10.9.{(base + i) // 200}.{(base + i) % 200 + 1}",
+          "dst": "10.1.1.2", "proto": 6,
+          "sport": 1000 + (base + i) % 50000,
+          "dport": 80, "rx_if": rx_if, "ttl": 64}
+         for i in range(n)], n=n)
+
+
+def reply_pkts(n, base=0, rx_if=2):
+    return make_packet_vector(
+        [{"src": "10.1.1.2",
+          "dst": f"10.9.{(base + i) // 200}.{(base + i) % 200 + 1}",
+          "proto": 6, "sport": 80,
+          "dport": 1000 + (base + i) % 50000, "rx_if": rx_if,
+          "ttl": 64}
+         for i in range(n)], n=n)
+
+
+def pack_pv(pv) -> np.ndarray:
+    cols = {k: np.asarray(getattr(pv, k))
+            for k in ("src_ip", "dst_ip", "proto", "sport", "dport",
+                      "ttl", "pkt_len", "rx_if", "flags")}
+    n = cols["src_ip"].shape[0]
+    flat = np.zeros((5, n), np.int32)
+    pack_packet_columns(flat.view(np.uint32), cols, n)
+    return flat
+
+
+def live_count(dp) -> int:
+    return int(jnp.sum(dp.tables.sess_valid))
+
+
+def build_fleet(names, n_ranges=8, store=None, **over):
+    dps = {n: build_dp(**over) for n in names}
+    membership = None
+    if store is not None:
+        membership = FleetMembership(store, name="steering")
+    st = FleetSteering(dps, membership=membership, n_ranges=n_ranges)
+    return dps, st
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    yield
+    faults.uninstall()
+
+
+# --- the hash pact ---------------------------------------------------
+
+
+class TestHashTwin:
+    def test_numpy_twin_is_bit_identical_to_device_sym_hash(self):
+        from vpp_tpu.ops.session import canon_mix
+
+        rng = np.random.default_rng(7)
+        n = 8192
+        src = rng.integers(0, 2**32, n, dtype=np.uint32)
+        dst = rng.integers(0, 2**32, n, dtype=np.uint32)
+        sp = rng.integers(0, 2**16, n, dtype=np.uint32)
+        dp = rng.integers(0, 2**16, n, dtype=np.uint32)
+        pr = rng.integers(0, 256, n, dtype=np.uint32)
+        # force hairpins (src == dst) into the sample: the port
+        # tie-break is exactly the case address ordering can't cover
+        dst[: n // 8] = src[: n // 8]
+        host = canon_mix_np(src, dst, sp, dp, pr)
+        dev = np.asarray(canon_mix(
+            jnp.asarray(src), jnp.asarray(dst), jnp.asarray(sp),
+            jnp.asarray(dp), jnp.asarray(pr.astype(np.int32))))
+        assert np.array_equal(host, dev.astype(np.uint32))
+
+    def test_direction_invariance_including_hairpins(self):
+        rng = np.random.default_rng(11)
+        n = 4096
+        src = rng.integers(0, 2**32, n, dtype=np.uint32)
+        dst = rng.integers(0, 2**32, n, dtype=np.uint32)
+        sp = rng.integers(0, 2**16, n, dtype=np.uint32)
+        dp = rng.integers(0, 2**16, n, dtype=np.uint32)
+        pr = rng.integers(0, 256, n, dtype=np.uint32)
+        dst[: n // 8] = src[: n // 8]
+        fwd = canon_mix_np(src, dst, sp, dp, pr)
+        rev = canon_mix_np(dst, src, dp, sp, pr)
+        assert np.array_equal(fwd, rev)
+
+    def test_packed_frame_buckets_match_column_hash(self):
+        pv = forward_pkts(100)
+        flat = pack_pv(pv)
+        got = buckets_of_packed(flat, 64)
+        mix = canon_mix_np(np.asarray(pv.src_ip),
+                           np.asarray(pv.dst_ip),
+                           np.asarray(pv.sport),
+                           np.asarray(pv.dport),
+                           np.asarray(pv.proto))
+        assert np.array_equal(got, (mix & np.uint32(63)).astype(np.int64))
+
+    def test_sym_dataplane_buckets_replies_with_forward_flows(self):
+        """The semantic the twin test can't see: on a sym instance the
+        reply's bucket equals the forward insert's bucket, so a
+        steering tier hashing the packet AS SEEN delivers both
+        directions of a flow to one instance."""
+        dp = build_dp()
+        dp.process(forward_pkts(60, rx_if=1), now=10)
+        before = live_count(dp)
+        res = dp.process(reply_pkts(60, rx_if=2), now=11)
+        hits = int(res.stats.sess_hits)
+        assert before >= 54  # a few way-conflicts are table physics
+        assert hits >= 54
+
+
+# --- rendezvous ------------------------------------------------------
+
+
+class TestRendezvous:
+    def test_deterministic_and_total(self):
+        a = assign_ranges(["gw0", "gw1", "gw2"], 64)
+        b = assign_ranges(["gw2", "gw0", "gw1"], 64)
+        assert a == b
+        assert sorted(a) == list(range(64))
+        assert set(a.values()) <= {"gw0", "gw1", "gw2"}
+
+    def test_add_moves_only_ranges_the_newcomer_wins(self):
+        old = assign_ranges(["gw0", "gw1", "gw2"], 128)
+        new = assign_ranges(["gw0", "gw1", "gw2", "gw3"], 128)
+        moved = moved_ranges(old, new)
+        assert moved, "a 4th member must win some ranges"
+        assert all(new[r] == "gw3" for r in moved)
+        # bounded: roughly 1/N of ranges, never a reshuffle
+        assert len(moved) < 128 // 2
+
+    def test_remove_moves_only_the_departed_members_ranges(self):
+        old = assign_ranges(["gw0", "gw1", "gw2"], 128)
+        new = assign_ranges(["gw0", "gw1"], 128)
+        moved = moved_ranges(old, new)
+        assert moved
+        assert all(old[r] == "gw2" for r in moved)
+
+    def test_every_member_owns_something_at_scale(self):
+        owners = assign_ranges([f"gw{i}" for i in range(4)], 256)
+        counts = {m: 0 for m in (f"gw{i}" for i in range(4))}
+        for m in owners.values():
+            counts[m] += 1
+        assert all(v > 0 for v in counts.values()), counts
+
+    def test_range_span_covers_table_exactly_once(self):
+        spans = [range_span(r, 64, 8) for r in range(8)]
+        covered = sorted(b for s, n in spans for b in range(s, s + n))
+        assert covered == list(range(64))
+
+
+# --- tenant placement ------------------------------------------------
+
+
+class TestTenantPlacement:
+    def test_slice_projects_onto_its_ranges(self):
+        # 64 buckets, 8 ranges of 8: a slice [16, 48) spans rids 2..5
+        assert tenant_ranges(16, 31, 64, 8) == [2, 3, 4, 5]
+        # a narrow slice inside one range stays on one range
+        assert tenant_ranges(8, 7, 64, 8) == [1]
+
+    def test_hot_tenant_spreads_across_instances(self):
+        owners = assign_ranges(["gw0", "gw1", "gw2", "gw3"], 8)
+        spread = tenant_spread(0, 63, 64, 8, owners)  # whole table
+        assert len(spread) > 1
+        narrow = tenant_spread(8, 7, 64, 8, owners)
+        assert len(narrow) == 1
+
+    def test_sliced_buckets_of_packed(self):
+        pv = forward_pkts(50)
+        flat = pack_pv(pv)
+        base = np.array([0, 32], np.int64)
+        mask = np.array([31, 31], np.uint32)
+        tids = np.ones(50, np.int64)
+        b = buckets_of_packed(flat, 64, tenant_ids=tids,
+                              tnt_base=base, tnt_mask=mask)
+        assert (b >= 32).all() and (b < 64).all()
+
+
+# --- membership + epochs ---------------------------------------------
+
+
+class TestMembership:
+    def test_join_heartbeat_leave(self):
+        store = KVStore()
+        m1 = FleetMembership(store, "gw0", ttl_s=30.0)
+        m2 = FleetMembership(store, "gw1", ttl_s=30.0)
+        m1.join(), m2.join()
+        assert m1.members() == ["gw0", "gw1"]
+        assert m1.heartbeat()
+        m2.leave()
+        assert m1.members() == ["gw0"]
+        assert not m2.heartbeat()  # revoked lease cannot keepalive
+
+    def test_lease_expiry_removes_member(self):
+        store = KVStore()
+        m = FleetMembership(store, "gw0", ttl_s=0.001)
+        m.join()
+        store.sweep_leases(now=1e18)  # explicit clock, no sleeping
+        assert m.members() == []
+        assert not m.heartbeat()
+
+    def test_watch_members_fires_on_change(self):
+        store = KVStore()
+        viewer = FleetMembership(store, "viewer")
+        seen = []
+        initial, cancel = viewer.watch_members(seen.append)
+        assert initial == []
+        m = FleetMembership(store, "gw0", ttl_s=30.0)
+        m.join()
+        assert seen[-1] == ["gw0"]
+        m.leave()
+        assert seen[-1] == []
+        cancel()
+
+    def test_epochs_fence_commit_and_only_advance(self):
+        store = KVStore()
+        m = FleetMembership(store, "steering")
+        e1 = m.claim_range(3, "gw0")
+        assert e1 == 1 and m.is_current(3, 1)
+        e2 = m.fence_range(3, "gw1")
+        assert e2 == 2
+        assert not m.is_current(3, 1), "old epoch must die at fence"
+        assert not m.is_current(3, 2), "fenced is not serving"
+        assert m.fenced_ranges() == {
+            3: {"epoch": 2, "state": FENCED, "owner": "gw0",
+                "to": "gw1"}}
+        assert m.commit_range(3, 2, "gw1")
+        assert m.is_current(3, 2)
+        assert m.range_state(3)["owner"] == "gw1"
+
+    def test_stale_commit_is_refused(self):
+        store = KVStore()
+        m = FleetMembership(store, "steering")
+        m.claim_range(0, "gw0")
+        e = m.fence_range(0, "gw1")
+        e2 = m.fence_range(0, "gw2")  # a second migrator supersedes
+        assert e2 > e
+        assert not m.commit_range(0, e, "gw1"), \
+            "superseded fence must not commit"
+        assert m.commit_range(0, e2, "gw2")
+
+
+# --- steering --------------------------------------------------------
+
+
+class TestSteering:
+    def test_requires_sym_hash_and_uniform_geometry(self):
+        fwd = build_dp(sess_hash="fwd")
+        sym = build_dp()
+        with pytest.raises(ValueError, match="sym"):
+            FleetSteering({"a": fwd, "b": sym})
+        other = build_dp(sess_slots=512)
+        with pytest.raises(ValueError, match="geometry"):
+            FleetSteering({"a": sym, "b": other})
+
+    def test_partition_conserves_exactly(self):
+        _dps, st = build_fleet(["gw0", "gw1"])
+        flat = pack_pv(forward_pkts(200))
+        groups, drops = st.partition(flat)
+        routed = sum(idx.size for idx in groups.values())
+        assert routed + drops["fenced"] + drops["no_owner"] == 200
+        offered, accounted = st.conservation()
+        assert offered == accounted == 200
+
+    def test_steered_sessions_land_on_their_owner_only(self):
+        dps, st = build_fleet(["gw0", "gw1"])
+        pump = FleetPump(st, frame_width=64, queue_slots=32)
+        pump.start()
+        pump.submit(pack_pv(forward_pkts(200)))
+        pump.stop()
+        c = pump.conservation()
+        assert c["offered"] == 200 and c["pending"] == 0
+        assert (c["delivered"] + c["fenced_drops"] + c["no_owner_drops"]
+                + c["queue_drops"]) == 200
+        # each instance holds sessions ONLY in buckets of ranges it
+        # owns — the single-writer-per-range law, checked on-device
+        owners = st.owners()
+        for name, dp in dps.items():
+            valid = np.asarray(jnp.sum(dp.tables.sess_valid, axis=1))
+            for rid in range(st.n_ranges):
+                start, n = range_span(rid, st.n_buckets, st.n_ranges)
+                in_range = int(valid[start:start + n].sum())
+                if owners[rid] != name:
+                    assert in_range == 0, (name, rid)
+
+    def test_fenced_range_drops_attributed(self):
+        _dps, st = build_fleet(["gw0", "gw1"])
+        epoch = st.membership.fence_range(0, "gw1")
+        # the epoch watch applied the fence to the route table
+        flat = pack_pv(forward_pkts(300))
+        groups, drops = st.partition(flat)
+        b = buckets_of_packed(flat, st.n_buckets)
+        expect = int((b // st._per == 0).sum())
+        assert expect > 0, "sample must cover range 0"
+        assert drops["fenced"] == expect
+        offered, accounted = st.conservation()
+        assert offered == accounted
+        # and a second tier on the SAME store is fenced too
+        st2 = FleetSteering(_dps, membership=st.membership,
+                            n_ranges=st.n_ranges)
+        _g2, d2 = st2.partition(flat)
+        assert d2["fenced"] == expect
+        assert st.membership.commit_range(0, epoch, "gw1")
+        _g3, d3 = st.partition(flat)
+        assert d3["fenced"] == 0
+
+
+# --- live migration --------------------------------------------------
+
+
+def _drive(st, flat, frame_width=64):
+    pump = FleetPump(st, frame_width=frame_width, queue_slots=64)
+    pump.start()
+    pump.submit(flat)
+    pump.stop()
+    return pump
+
+
+class TestMigration:
+    def test_moved_range_serves_replies_on_new_owner(self):
+        dps, st = build_fleet(["gw0", "gw1"])
+        _drive(st, pack_pv(forward_pkts(240)))
+        total_before = sum(live_count(d) for d in dps.values())
+
+        # force EVERY range onto gw1, migrating gw0's live state
+        target = {r: "gw1" for r in range(st.n_ranges)}
+        before_owned_by_gw0 = [r for r, o in st.owners().items()
+                               if o == "gw0"]
+        moved = st.rebalance(target)
+        assert moved == len(before_owned_by_gw0) > 0
+        assert sum(live_count(d) for d in dps.values()) == total_before
+        assert live_count(dps["gw0"]) == 0, "released ranges serve " \
+            "nothing on the source"
+
+        pump = _drive(st, pack_pv(reply_pkts(240)))
+        aux = pump.stats_snapshot()["aux"]
+        assert set(aux) == {"gw1"}, "all replies steered to new owner"
+        rx = aux["gw1"]["rx"]
+        hits = aux["gw1"]["sess_hits"]
+        assert rx == 240
+        assert hits / rx >= 0.9, (hits, rx)
+
+    def test_migration_rebases_session_ages(self):
+        """A session idle on the source stays the SAME age on the
+        destination even when the two instances' tick clocks differ —
+        the restore rebase law, applied live."""
+        dps, st = build_fleet(["gw0", "gw1"])
+        # skew the destination clock far ahead of the source
+        dps["gw1"].advance_clock(1000.0)
+        _drive(st, pack_pv(forward_pkts(240)))
+        before = sum(live_count(d) for d in dps.values())
+        st.rebalance({r: "gw1" for r in range(st.n_ranges)})
+        assert sum(live_count(d) for d in dps.values()) == before
+        # expire with the destination's clock: rebased entries are
+        # YOUNG there (age preserved), so none expire within timeout
+        dps["gw1"].advance_clock(1.0)
+        dps["gw1"].expire_sessions()
+        assert live_count(dps["gw1"]) == before
+
+    def test_scale_out_migrates_only_moved_ranges(self):
+        dps, st = build_fleet(["gw0", "gw1"])
+        _drive(st, pack_pv(forward_pkts(240)))
+        old = st.owners()
+        dp2 = build_dp()
+        st2 = FleetSteering({**dps, "gw2": dp2},
+                            membership=st.membership,
+                            n_ranges=st.n_ranges)
+        target = st2.target_assignment(["gw0", "gw1", "gw2"])
+        expected_moves = moved_ranges(old, target)
+        assert all(target[r] == "gw2" for r in expected_moves), \
+            "rendezvous: scale-out moves ranges only to the newcomer"
+        moved = st2.rebalance(target)
+        assert moved == len(expected_moves)
+        s = st2.stats_snapshot()
+        assert s["migrated_ranges"] == len(expected_moves)
+
+
+# --- chaos: crashed migration, fencing, recovery ---------------------
+
+
+class TestMigrationChaos:
+    def _fleet_with_traffic(self):
+        dps, st = build_fleet(["gw0", "gw1"])
+        _drive(st, pack_pv(forward_pkts(240)))
+        return dps, st
+
+    @pytest.mark.parametrize("after", [0, 1])
+    def test_crash_mid_drain_leaves_range_fenced_conserving(self,
+                                                            after):
+        dps, st = self._fleet_with_traffic()
+        total = sum(live_count(d) for d in dps.values())
+        plan = faults.FaultPlan(seed=18)
+        plan.inject("fleet.migrate", action="error", after=after,
+                    times=1)
+        faults.install(plan)
+        target = {r: "gw1" for r in range(st.n_ranges)}
+        with pytest.raises(Exception) as ei:
+            st.rebalance(target)
+        assert isinstance(ei.value, faults.FaultInjected)
+        faults.uninstall()
+
+        fenced = st.membership.fenced_ranges()
+        assert len(fenced) == 1, "crash fenced exactly the in-flight " \
+            "range"
+        (rid, st_rec), = fenced.items()
+        assert st_rec["to"] == "gw1"
+        # no session was lost: source still holds everything un-moved
+        # (commit-before-release means pre-commit crashes never zero
+        # the source)
+        assert sum(live_count(d) for d in dps.values()) >= total
+
+        # steering NEVER serves the fenced epoch: traffic for the
+        # fenced range drops, attributed — conservation stays exact
+        pump = _drive(st, pack_pv(forward_pkts(240)))
+        c = pump.conservation()
+        assert c["offered"] == (c["delivered"] + c["fenced_drops"]
+                                + c["no_owner_drops"]
+                                + c["queue_drops"] + c["pending"])
+        assert c["fenced_drops"] > 0
+
+        # recovery completes the move against the SAME epoch
+        assert st.recover() == 1
+        assert st.membership.fenced_ranges() == {}
+        assert st.owners()[rid] == "gw1"
+        assert sum(live_count(d) for d in dps.values()) == total
+
+        # and the migrated flows serve replies on the new owner
+        pump2 = _drive(st, pack_pv(reply_pkts(240)))
+        aux = pump2.stats_snapshot()["aux"]
+        rx = sum(a["rx"] for a in aux.values())
+        hits = sum(a["sess_hits"] for a in aux.values())
+        assert hits / rx >= 0.9, (hits, rx)
+
+    def test_crash_before_commit_recovers_idempotently(self):
+        dps, st = self._fleet_with_traffic()
+        total = sum(live_count(d) for d in dps.values())
+        plan = faults.FaultPlan(seed=7)
+        # drain_bucket_range fires per chunk; the PRE-COMMIT seam is
+        # the last fire of one migration — sessions adopted on the
+        # destination but the epoch not flipped
+        n_chunk_fires = (st.n_buckets // st.n_ranges) // 256 + 1
+        plan.inject("fleet.migrate", action="error",
+                    after=n_chunk_fires, times=1)
+        faults.install(plan)
+        with pytest.raises(Exception):
+            st.rebalance({r: "gw1" for r in range(st.n_ranges)})
+        faults.uninstall()
+        assert len(st.membership.fenced_ranges()) == 1
+        assert st.recover() == 1
+        # re-drain + re-adopt overwrote, never duplicated
+        assert sum(live_count(d) for d in dps.values()) == total
+
+    def test_steer_fault_surfaces_not_swallowed(self):
+        _dps, st = self._fleet_with_traffic()
+        plan = faults.FaultPlan(seed=3)
+        plan.inject("fleet.steer", action="error", times=1)
+        faults.install(plan)
+        with pytest.raises(Exception) as ei:
+            st.partition(pack_pv(forward_pkts(10)))
+        assert isinstance(ei.value, faults.FaultInjected)
+
+
+# --- the pump tier ---------------------------------------------------
+
+
+class TestFleetPump:
+    def test_queue_overflow_drops_attributed(self):
+        _dps, st = build_fleet(["gw0"])
+        pump = FleetPump(st, frame_width=32, queue_slots=2)
+        # workers NOT started: the queue fills, overflow must be
+        # counted, never silent
+        for _ in range(8):
+            pump.submit(pack_pv(forward_pkts(32)))
+        pump.flush()
+        c = pump.conservation()
+        assert c["queue_drops"] > 0
+        assert c["offered"] == (c["delivered"] + c["fenced_drops"]
+                                + c["no_owner_drops"]
+                                + c["queue_drops"] + c["pending"])
+        # drain what's queued so stop() doesn't wait on it
+        pump.start()
+        pump.stop()
+        c = pump.conservation()
+        assert c["pending"] == 0
+        assert c["offered"] == (c["delivered"] + c["fenced_drops"]
+                                + c["no_owner_drops"]
+                                + c["queue_drops"])
+
+    def test_partial_frames_pad_with_invalid_slots(self):
+        dps, st = build_fleet(["gw0"])
+        pump = FleetPump(st, frame_width=64, queue_slots=8)
+        pump.start()
+        pump.submit(pack_pv(forward_pkts(10)))  # far below one frame
+        pump.stop()
+        snap = pump.stats_snapshot()
+        assert snap["delivered"]["gw0"] == 10
+        # rx counts VALID packets only — pads are invisible
+        assert snap["aux"]["gw0"]["rx"] == 10
+
+
+# --- observability ---------------------------------------------------
+
+
+class TestFleetObservability:
+    def test_collector_exports_fleet_families(self):
+        from vpp_tpu.stats.collector import STATS_PATH, StatsCollector
+
+        dps, st = build_fleet(["gw0", "gw1"])
+        pump = _drive(st, pack_pv(forward_pkts(100)))
+        coll = StatsCollector(next(iter(dps.values())))
+        coll.set_fleet(st, pump)
+        coll.publish()
+        text = coll.registry.render(STATS_PATH)
+        assert 'vpp_tpu_fleet_instances 2' in text
+        assert 'vpp_tpu_fleet_steered_total{instance="gw0"}' in text
+        assert 'vpp_tpu_fleet_drops_total{cause="fenced"}' in text
+        assert 'vpp_tpu_fleet_drops_total{cause="queue"}' in text
+
+    def test_show_fleet(self):
+        from vpp_tpu.cli import DebugCLI
+
+        dps, st = build_fleet(["gw0", "gw1"])
+        pump = _drive(st, pack_pv(forward_pkts(100)))
+        cli = DebugCLI(next(iter(dps.values())), fleet=st,
+                       fleet_pump=pump)
+        out = cli.run("show fleet")
+        assert "2 instances" in out
+        assert "EXACT" in out
+        assert "gw0" in out and "gw1" in out
+        # unconfigured path stays useful
+        cli2 = DebugCLI(next(iter(dps.values())))
+        assert "not configured" in cli2.run("show fleet")

@@ -375,9 +375,13 @@ class TestPartitions:
 
             relay.cut()
             # the standby notices within promote_after and tries to
-            # claim; the witness must deny. Observe >= several claim
-            # attempts worth of time:
-            time.sleep(self.PROMOTE_AFTER + 3 * self.TTL)
+            # claim; the witness must deny. Gate on the denial counter
+            # (3 observed claim attempts) instead of a wall-clock
+            # sleep sized to worst-case retry pacing — the flake was
+            # the sleep electing a loaded host's schedule:
+            wait_for(lambda: sha.replicator.claim_denials >= 3,
+                     timeout=self.PROMOTE_AFTER + 6 * self.TTL,
+                     msg="three denied claims")
             assert not sha.replicator.promoted.is_set(), \
                 "standby promoted despite a live primary (FORK)"
             assert ssrv.read_only is True

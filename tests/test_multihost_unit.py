@@ -124,6 +124,41 @@ def test_session_aging_on_tick_cadence():
     assert int(np.asarray(cl.tables.sess_valid).sum()) == 0
 
 
+def test_publish_agrees_fib_rung_fleet_wide():
+    """The widened 6-column selection allgather: publish folds every
+    process's lpm eligibility (min) and staged route count (max) into
+    one fleet-agreed FIB rung, and the next tick runs it."""
+    cfg = DataplaneConfig(
+        max_tables=4, max_rules=16, max_global_rules=32, max_ifaces=8,
+        fib_slots=32, sess_slots=256, nat_mappings=4, nat_backends=16,
+        fib_lpm_min_routes=4,
+    )
+    cl = MultiHostCluster(2, cfg)
+    for nid in range(2):
+        n = cl.node(nid)
+        up = n.add_uplink()
+        pi = n.add_pod_interface(("d", f"p{nid}"))
+        n.builder.add_route(f"10.{nid + 1}.0.2/32", pi, Disposition.LOCAL)
+        other = 1 - nid
+        n.builder.add_route(f"10.{other + 1}.0.0/24", up,
+                            Disposition.REMOTE, node_id=other)
+        n.builder.add_route("10.8.0.0/16", up, Disposition.REMOTE,
+                            node_id=other)
+        n.builder.add_route(f"10.8.{nid}.0/24", pi, Disposition.LOCAL)
+    assert cl.fib_impl == "dense"            # pre-publish default
+    cl.publish()
+    assert cl.fib_impl == "lpm"              # 4 routes >= the floor
+    driver = LockstepDriver(cl, KVStore())
+    res = driver.tick(frames(cl), n=8)
+    disp = np.asarray(cl.local_rows(res.delivered.disp))
+    assert (disp[1] == int(Disposition.LOCAL)).sum() == 1
+
+    # below the floor the fleet stays dense (the standalone ladder)
+    cl2 = build_cluster()                    # 2 routes/node, floor 256
+    cl2.publish()
+    assert cl2.fib_impl == "dense"
+
+
 def test_publish_names_out_of_mesh_targets():
     cl = build_cluster()
     cl.node(0).builder.add_route("10.77.0.0/24", cl.node(0).uplink_if,

@@ -158,6 +158,39 @@ def counters_lint() -> list:
             problems.append(
                 f"counters: governor scalar {k!r} maps to "
                 f"unregistered family {name!r}")
+    # fleet parity (ISSUE 18): the collector's drop-cause axis must be
+    # exactly the causes the steering tier + fleet pump attribute (a
+    # cause added on either side without its observability twin breaks
+    # the conservation identity's visibility), and every
+    # vpp_tpu_fleet_* family must come from the ONE declaration
+    from vpp_tpu.fleet.steering import STEER_DROP_CAUSES
+    from vpp_tpu.io.fleet import QUEUE_DROP_CAUSES
+    from vpp_tpu.stats.collector import (
+        FLEET_DROP_CAUSES,
+        FLEET_GAUGE_FAMILIES,
+    )
+
+    attributed = tuple(STEER_DROP_CAUSES) + tuple(QUEUE_DROP_CAUSES)
+    for c in sorted(set(attributed) - set(FLEET_DROP_CAUSES)):
+        problems.append(
+            f"counters: fleet drop cause {c!r} is attributed but has "
+            f"no cause label on vpp_tpu_fleet_drops_total "
+            f"(stats/collector.py FLEET_DROP_CAUSES)")
+    for c in sorted(set(FLEET_DROP_CAUSES) - set(attributed)):
+        problems.append(
+            f"counters: FLEET_DROP_CAUSES lists {c!r} which neither "
+            f"the steering tier nor the fleet pump attributes "
+            f"(stale entry?)")
+    declared = {name for name, _h, _k in FLEET_GAUGE_FAMILIES}
+    for name in sorted(registered):
+        if name.startswith("vpp_tpu_fleet_") and name not in declared:
+            problems.append(
+                f"counters: family {name!r} is in the fleet namespace "
+                f"but not declared in FLEET_GAUGE_FAMILIES")
+    for name in sorted(declared - registered):
+        problems.append(
+            f"counters: FLEET_GAUGE_FAMILIES declares {name!r} which "
+            f"is not registered")
     return problems
 
 

@@ -71,6 +71,13 @@ THREAD_ROOTS = (
     # is fetcher-written and collector/CLI-read (the device kernels in
     # the same file are thread-free, the pass just sees no classes)
     "vpp_tpu/ops/telemetry.py",
+    # ISSUE 18: the fleet tier — steering's route table flips under
+    # _lock against lock-free partition() reads, membership wraps
+    # kvstore CAS from any thread, and the pump's dispatch/worker
+    # threads share the conservation counters
+    "vpp_tpu/fleet/steering.py",
+    "vpp_tpu/fleet/membership.py",
+    "vpp_tpu/io/fleet.py",
 )
 
 LOCK_CTORS = {"Lock", "RLock", "Condition"}

@@ -22,7 +22,7 @@ class DebugCLI:
     def __init__(self, dataplane: Dataplane, tracer=None, stats=None,
                  pump=None, io_ctl=None, session_engine=None,
                  mesh_runtime=None, store=None, snapshotter=None,
-                 ml_source=None):
+                 ml_source=None, fleet=None, fleet_pump=None):
         self.dp = dataplane
         self.tracer = tracer
         self.stats = stats
@@ -42,6 +42,10 @@ class DebugCLI:
         self.snapshotter = snapshotter
         # optional MlModelSource (show ml: load ledger, degraded flag)
         self.ml_source = ml_source
+        # optional gateway-fleet handles (show fleet: ownership map,
+        # epochs, migration/conservation counters — ISSUE 18)
+        self.fleet = fleet
+        self.fleet_pump = fleet_pump
 
     # --- dispatch ---
     def run(self, line: str) -> str:
@@ -70,6 +74,7 @@ class DebugCLI:
             ("show", "neighbors"): self.show_neighbors,
             ("show", "store"): self.show_store,
             ("show", "resilience"): self.show_resilience,
+            ("show", "fleet"): self.show_fleet,
             ("help",): self.help,
         }
         for sig, fn in handlers.items():
@@ -101,7 +106,8 @@ class DebugCLI:
             "show top-flows | "
             "show governor | show tenants | show io | show neighbors | "
             "show store | "
-            "show resilience | show config-history [n] | show spans [n] | "
+            "show resilience | show fleet | "
+            "show config-history [n] | show spans [n] | "
             "trace add [n] | trace clear | config replay <journal> | "
             "test connectivity <src> <dst> <tcp|udp|icmp> [dport]"
         )
@@ -649,6 +655,58 @@ class DebugCLI:
         trace = entries[0].format() if entries else "(no trace captured)"
         return (f"{src_s} -> {dst_s} {proto_s}/{dport} via if {rx_if}\n"
                 f"{trace}\nverdict: {verdict}")
+
+    def show_fleet(self) -> str:
+        """Gateway-fleet one-pager (ISSUE 18): instances, range
+        ownership (with fenced ranges called out — those DROP until
+        recovered), epoch high-water, migration totals and the
+        conservation ledger the steering tier guarantees exactly."""
+        fleet = self.fleet
+        if fleet is None:
+            return "fleet: not configured (single-instance gateway)"
+        fs = fleet.stats_snapshot()
+        lines = [
+            f"fleet: {fs['instances']} instances, {fs['ranges']} "
+            f"hash ranges, epoch high-water {fs['epoch_max']}",
+        ]
+        by_inst: dict = {}
+        for rid, owner in sorted(fs["owners"].items()):
+            by_inst.setdefault(owner, []).append(rid)
+        for inst in sorted(by_inst):
+            rids = by_inst[inst]
+            lines.append(
+                f"  {inst}: {len(rids)} ranges "
+                f"({', '.join(str(r) for r in rids[:12])}"
+                f"{', ...' if len(rids) > 12 else ''}), "
+                f"steered {fs['steered'].get(inst, 0)}")
+        if fs["fenced_ranges"]:
+            lines.append(
+                f"  FENCED: {fs['fenced_ranges']} ranges mid-migration "
+                f"(traffic drops attributed; run recover)")
+        lines.append(
+            f"migrations: {fs['migrated_ranges']} ranges / "
+            f"{fs['migrated_sessions']} sessions across "
+            f"{fs['rebalances']} rebalances "
+            f"({fs['recovered_ranges']} crash-recovered)")
+        offered, accounted = fleet.conservation()
+        lines.append(
+            f"conservation: offered {offered} == steered "
+            f"{sum(fs['steered'].values())} + fenced "
+            f"{fs['fenced_drops']} + no-owner {fs['no_owner_drops']}"
+            f" -> {'EXACT' if offered == accounted else 'VIOLATED'}")
+        if self.fleet_pump is not None:
+            ps = self.fleet_pump.stats_snapshot()
+            lines.append(
+                f"pump: delivered {sum(ps['delivered'].values())}, "
+                f"queue drops {sum(ps['queue_drops'].values())}, "
+                f"pending {self.fleet_pump.pending()}")
+            for inst, aux in sorted(ps["aux"].items()):
+                rx = aux.get("rx", 0)
+                hits = aux.get("sess_hits", 0)
+                lines.append(
+                    f"  {inst}: rx {rx}, session hits {hits} "
+                    f"({100.0 * hits / rx if rx else 0.0:.1f}%)")
+        return "\n".join(lines)
 
     def show_resilience(self) -> str:
         """Crash-consistency + degraded-mode one-pager (ISSUE 8): the

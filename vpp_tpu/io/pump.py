@@ -1250,6 +1250,7 @@ class DataplanePump:
             ml_kind = getattr(self.dp, "_ml_kind", "mlp")
             tel_mode = getattr(self.dp, "_tel_mode", "off")
             tnt_mode = getattr(self.dp, "_tnt_mode", "off")
+            sess_hash = getattr(self.dp, "_sess_hash", "fwd")
         self._ppump = PersistentPump(tables, batch=VEC,
                                      fastpath=fastpath,
                                      classifier=classifier,
@@ -1261,6 +1262,7 @@ class DataplanePump:
                                      ml_kind=ml_kind,
                                      tel_mode=tel_mode,
                                      tnt_mode=tnt_mode,
+                                     sess_hash=sess_hash,
                                      ).start()
         if self.governor is not None:
             # a relaunched/restarted ring must resume at the

@@ -494,10 +494,16 @@ class RemoteKVStore:
         return cancel
 
     def watch_with_snapshot(
-        self, prefix: str, callback: WatchCallback
+        self, prefix: str, callback: WatchCallback,
+        on_resync: Optional[ResyncCallback] = None
     ) -> Tuple[Dict[str, Any], int, Callable[[], None]]:
+        """The initial snapshot is the synchronous return value;
+        ``on_resync(snapshot, rev)`` fires only on reconnect
+        re-registrations — the outage-time churn a live event stream
+        cannot replay (the watch() resync contract, minus the initial
+        delivery the return value already covers)."""
         wid = next(self._wids)
-        w = _Watch(wid, prefix, callback, None)
+        w = _Watch(wid, prefix, callback, on_resync)
         with self._lock:
             self._watches[wid] = w
         res = self._request("watch", prefix=prefix, watch_id=wid)

@@ -104,6 +104,9 @@ class Replicator:
         self._stopped = threading.Event()    # operator stop ≠ promotion
         self._lock = threading.Lock()
         self._retrying = False
+        # denied-claim count (witness reachable, lease alive) — the
+        # event tests gate on instead of wall-clock sleeps
+        self.claim_denials = 0
 
     # --- lifecycle ---
     def start(self) -> "Replicator":
@@ -245,6 +248,8 @@ class Replicator:
             return False, None
         if rsp.get("granted"):
             return True, int(rsp["epoch"])
+        with self._lock:
+            self.claim_denials += 1
         log.warning(
             "claim denied: %s still holds the lease (%.1fs left) — "
             "primary is alive on the other side of a partition, "

@@ -174,7 +174,8 @@ class KVStore:
         return cancel
 
     def watch_with_snapshot(
-        self, prefix: str, callback: WatchCallback
+        self, prefix: str, callback: WatchCallback,
+        on_resync=None,
     ) -> Tuple[Dict[str, Any], int, Callable[[], None]]:
         """Atomically snapshot ``prefix`` and subscribe to later changes.
 
@@ -182,7 +183,9 @@ class KVStore:
         returned rev will be delivered, and every change after it will —
         the list+watch handoff the reference gets from etcd's revisioned
         Watch (plugins/ksr/ksr_reflector.go:185-232 relies on the same
-        contract for mark-and-sweep resync).
+        contract for mark-and-sweep resync). ``on_resync`` exists for
+        RemoteKVStore signature parity (reconnect re-registration);
+        an in-process store never disconnects, so it never fires.
         """
         with self._lock:
             snapshot = {

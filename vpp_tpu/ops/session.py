@@ -156,6 +156,30 @@ def _pack_ports(sport: jnp.ndarray, dport: jnp.ndarray) -> jnp.ndarray:
     return (sport.astype(jnp.uint32) << 16) | dport.astype(jnp.uint32)
 
 
+def canon_mix(src: jnp.ndarray, dst: jnp.ndarray, sport: jnp.ndarray,
+              dport: jnp.ndarray, proto: jnp.ndarray) -> jnp.ndarray:
+    """Direction-invariant (symmetric) 5-tuple mix: the tuple is
+    canonicalized — endpoints ordered by address, ports following their
+    endpoints (hairpin src==dst orders by port) — before the same
+    ``_hash_mix``, so a flow's forward packet and its reply produce the
+    SAME mix without knowing which direction they are.
+
+    This is the ``sess_hash: "sym"`` bucket family (docs/FLEET.md): a
+    stateless host tier in front of N dataplanes can compute a packet's
+    session BUCKET without knowing flow direction, which is what makes
+    bucket-range flow steering (and range-scoped session migration)
+    possible. Only the BUCKET changes vs "fwd" — stored keys and key
+    comparison stay the forward tuple, so hit/insert semantics are
+    untouched. vpp_tpu/fleet/hashring.py carries the bit-identical
+    NumPy twin for the steering tier; keep the two in sync."""
+    swap = (src > dst) | ((src == dst) & (sport > dport))
+    a = jnp.where(swap, dst, src)
+    b = jnp.where(swap, src, dst)
+    ports = jnp.where(swap, _pack_ports(dport, sport),
+                      _pack_ports(sport, dport))
+    return _hash_mix(a, b, ports, proto)
+
+
 # --- bucket-axis sharding (ISSUE 12; vpp_tpu/parallel/partition.py) ---
 #
 # Under the mesh, each session column is the LOCAL bucket-range shard
@@ -223,7 +247,7 @@ def _shard_flat_slot(hit_idx: jnp.ndarray, mask: jnp.ndarray,
 
 def session_lookup_reverse(
     tables: DataplaneTables, pkts: PacketVector, now=None,
-    tnt: bool = False, impl: str = "gather"
+    tnt: bool = False, impl: str = "gather", sym: bool = False
 ) -> jnp.ndarray:
     """Is each packet the *return* traffic of an established session?
 
@@ -240,15 +264,21 @@ def session_lookup_reverse(
     key_dst = pkts.src_ip
     key_ports = _pack_ports(pkts.dport, pkts.sport)
     key_proto = pkts.proto
-    # jax-ok: tnt is a trace-time-static step-factory gate (a Python
-    # bool baked into the jit key), not a tracer branch
+    # jax-ok: tnt/sym are trace-time-static step-factory gates (Python
+    # bools baked into the jit key), not tracer branches. In sym mode
+    # the mix is computed on the packet AS SEEN (canonicalization makes
+    # it direction-invariant — identical to the forward key's canon
+    # mix); key comparison below stays the reconstructed forward tuple.
+    if sym:
+        mix = canon_mix(pkts.src_ip, pkts.dst_ip, pkts.sport,
+                        pkts.dport, pkts.proto)
+    else:
+        mix = _hash_mix(key_src, key_dst, key_ports, key_proto)
     if tnt:
-        b = tenant_bucket(tables, key_src, key_dst,
-                          _hash_mix(key_src, key_dst, key_ports,
-                                    key_proto),
+        b = tenant_bucket(tables, key_src, key_dst, mix,
                           tables.tnt_sess_base, tables.tnt_sess_mask)
     else:
-        b = _hash(key_src, key_dst, key_ports, key_proto, n_buckets)
+        b = (mix & jnp.uint32(n_buckets - 1)).astype(jnp.int32)
     # jax-ok: impl is a trace-time-static ladder rung, not a tracer
     # branch. No-age lookups pass (0, _BIG) — vacuously true on a
     # non-negative tick clock (see _sess_probe_dispatch).
@@ -277,7 +307,7 @@ def session_lookup_reverse(
 
 def session_lookup_reverse_idx(
     tables: DataplaneTables, pkts: PacketVector, now, shard=None,
-    tnt: bool = False, impl: str = "gather"
+    tnt: bool = False, impl: str = "gather", sym: bool = False
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Like session_lookup_reverse, but also returns the matched FLAT
     slot index [P] (bucket·W + way; undefined where not found) so the
@@ -294,18 +324,23 @@ def session_lookup_reverse_idx(
     key_dst = pkts.src_ip
     key_ports = _pack_ports(pkts.dport, pkts.sport)
     key_proto = pkts.proto
-    # jax-ok: tnt is a trace-time-static step-factory gate (a Python
-    # bool baked into the jit key), not a tracer branch. The tenant
+    # jax-ok: tnt/sym are trace-time-static step-factory gates (Python
+    # bools baked into the jit key), not tracer branches. The tenant
     # slice addresses GLOBAL bucket units, so the shard ownership
-    # split below composes unchanged (docs/TENANCY.md).
-    if tnt:
-        b = tenant_bucket(tables, key_src, key_dst,
-                          _hash_mix(key_src, key_dst, key_ports,
-                                    key_proto),
+    # split below composes unchanged (docs/TENANCY.md). sym swaps ONLY
+    # the bucket mix for the direction-invariant canon form (canon_mix
+    # doc) — stored-key comparison stays the forward tuple.
+    if sym:
+        mix = canon_mix(pkts.src_ip, pkts.dst_ip, pkts.sport,
+                        pkts.dport, pkts.proto)
+    else:
+        mix = _hash_mix(key_src, key_dst, key_ports, key_proto)
+    if tnt:  # jax-ok: trace-time-static gate (the block comment above)
+        b = tenant_bucket(tables, key_src, key_dst, mix,
                           tables.tnt_sess_base, tables.tnt_sess_mask)
     else:
-        b = _hash(key_src, key_dst, key_ports, key_proto,
-                  global_buckets(n_buckets, shard))
+        b = (mix & jnp.uint32(
+            global_buckets(n_buckets, shard) - 1)).astype(jnp.int32)
     if shard is not None:
         own, bl = shard_buckets(b, n_buckets, shard)
     else:
@@ -341,7 +376,8 @@ def session_lookup_reverse_idx(
 
 def session_batch_summary(
     tables: DataplaneTables, pkts: PacketVector, alive: jnp.ndarray, now,
-    shard=None, tnt: bool = False, impl: str = "gather"
+    shard=None, tnt: bool = False, impl: str = "gather",
+    sym: bool = False
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Batched hit summary for the two-tier fast/slow dispatch
     (pipeline/graph.py pipeline_step_auto): one reverse lookup yields
@@ -359,7 +395,7 @@ def session_batch_summary(
     the flag so the lax.cond dispatch provably can't diverge."""
     found, hit_idx = session_lookup_reverse_idx(tables, pkts, now,
                                                 shard=shard, tnt=tnt,
-                                                impl=impl)
+                                                impl=impl, sym=sym)
     hits = found & alive
     all_hit = jnp.all(hits == alive)
     return hits, hit_idx, all_hit
@@ -709,6 +745,7 @@ def session_insert(
     now: jnp.ndarray,
     shard=None,
     tnt: bool = False,
+    sym: bool = False,
 ) -> tuple:
     """Insert forward 5-tuples of ``want`` packets; returns
     (tables, inserted, failed, evict_expired, evict_victim).
@@ -734,15 +771,22 @@ def session_insert(
         _pack_ports(pkts.sport, pkts.dport),
         pkts.proto,
     )
-    # jax-ok: tnt is a trace-time-static step-factory gate (a Python
-    # bool baked into the jit key), not a tracer branch
-    if tnt:
-        h = tenant_bucket(tables, key_vals[0], key_vals[1],
-                          _hash_mix(*key_vals),
+    # jax-ok: tnt/sym are trace-time-static step-factory gates (Python
+    # bools baked into the jit key), not tracer branches. At insert
+    # the packet IS the forward tuple, so sym's canon mix equals the
+    # reply lookup's canon mix by construction (canon_mix doc).
+    if sym:
+        mix = canon_mix(pkts.src_ip, pkts.dst_ip, pkts.sport,
+                        pkts.dport, pkts.proto)
+    else:
+        mix = _hash_mix(*key_vals)
+    if tnt:  # jax-ok: trace-time-static gate (the block comment above)
+        h = tenant_bucket(tables, key_vals[0], key_vals[1], mix,
                           tables.tnt_sess_base, tables.tnt_sess_mask)
     else:
-        h = _hash(*key_vals,
-                  global_buckets(tables.sess_valid.shape[0], shard))
+        h = (mix & jnp.uint32(
+            global_buckets(tables.sess_valid.shape[0], shard) - 1)
+             ).astype(jnp.int32)
     if shard is not None:
         own, h = shard_buckets(h, tables.sess_valid.shape[0], shard)
         want = want & own

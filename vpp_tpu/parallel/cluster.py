@@ -45,6 +45,7 @@ from vpp_tpu.parallel.partition import (
     ShardCtx,
     agree_ml,
     bv_mesh_ok,
+    select_fib_impl,
     select_impl,
     shard_map,
     table_specs,
@@ -54,6 +55,7 @@ from vpp_tpu.pipeline.dataplane import Dataplane
 from vpp_tpu.pipeline.graph import (
     SWEEP_STRIDE_DEFAULT,
     StepStats,
+    _fib_fn,
     pipeline_step,
     pipeline_step_auto,
 )
@@ -304,7 +306,8 @@ def make_cluster_step(mesh: Mesh, budget: int = 0, mxu: bool = False,
                       fast: bool = False,
                       ml_mode: str = "off", ml_kind: str = "mlp",
                       bv_sharded: bool = False,
-                      ml_sharded: Optional[bool] = None):
+                      ml_sharded: Optional[bool] = None,
+                      fib: str = "dense"):
     """Build the jitted cluster step for ``mesh``.
 
     Signature: (tables, pkts, now, uplink_if) → ClusterStepResult, where
@@ -358,12 +361,20 @@ def make_cluster_step(mesh: Mesh, budget: int = 0, mxu: bool = False,
         ml_sharded = ml_mode != "off"
     shard = ShardCtx(RULE_AXIS, rule_shards)
     base_step = pipeline_step_auto if fast else pipeline_step
+    # FIB rung (ISSUE 15 → the mesh flip): every fib_lpm_* plane is
+    # registered REPLICATED along the rule axis in PARTITION_RULES and
+    # the lookup is a pure gather, so the single-node LPM kernel runs
+    # unchanged inside shard_map — same planes, same program on every
+    # shard. The pallas rung stays standalone-only
+    # (validate_partitioning rejects the explicit knob on a mesh).
+    fib_fn = _fib_fn(fib)
 
     def node_step(t, p, now, uplink=None):
         return base_step(t, p, now, acl_global_fn=global_fn,
                          acl_local_fn=local_fn,
                          sweep_stride=sweep_stride,
-                         ml_mode=ml_mode, ml_kind=ml_kind, shard=shard)
+                         ml_mode=ml_mode, ml_kind=ml_kind,
+                         fib_fn=fib_fn, shard=shard)
 
     def body(tables, pkts, now, uplink_if, payload=None):
         t = jax.tree.map(lambda a: a[0], tables)
@@ -590,9 +601,12 @@ class ClusterDataplane:
         self._use_fast = False
         self._ml_mode = "off"
         self._ml_kind = "mlp"
+        self._fib_impl = "dense"
         self.mxu_threshold = 512
         self.bv_min_rules = int(
             getattr(self.config, "classifier_bv_min_rules", 1024))
+        self.fib_lpm_min_routes = int(
+            getattr(self.config, "fib_lpm_min_routes", 256))
         # incremental per-shard upload groups (ISSUE 12 satellite): the
         # stacked+sharded device array of every clean upload group is
         # reused across swaps — only fields of groups some node's
@@ -617,6 +631,12 @@ class ClusterDataplane:
     @property
     def fastpath_selected(self) -> bool:
         return self._use_fast
+
+    @property
+    def fib_impl(self) -> str:
+        """The FIB rung the LIVE cluster epoch runs ("dense" | "lpm")
+        — the single-node ``Dataplane.fib_impl`` twin."""
+        return self._fib_impl
 
     @property
     def ml_selected(self) -> str:
@@ -677,6 +697,16 @@ class ClusterDataplane:
             getattr(c, "ml_stage", "off"),
             {int(getattr(n.builder, "ml_kind", 0))
              for n in self.nodes})
+        # FIB ladder: lpm when EVERY node's staged table is eligible
+        # and the largest node reaches the knee — the one shared rung
+        # mapping (partition.select_fib_impl), applied to collective
+        # bits exactly like the classifier. pallas_ok stays False on a
+        # mesh (the fused rung doesn't shard — validate_partitioning).
+        self._fib_impl = select_fib_impl(
+            getattr(c, "fib_impl", "auto"),
+            all(n.builder.lpm_ok() for n in self.nodes),
+            max(n.builder.fib_route_count() for n in self.nodes),
+            self.fib_lpm_min_routes, pallas_ok=False)
 
     def _get_step(self, with_payload: bool = False):
         """The jitted cluster step of the current selection (call
@@ -687,7 +717,8 @@ class ClusterDataplane:
             sweep_stride=self._sweep_stride,
             impl=self._impl, fast=self._use_fast,
             ml_mode=self._ml_mode, ml_kind=self._ml_kind,
-            bv_sharded=self._bv_sharded, ml_sharded=self._ml_sharded)
+            bv_sharded=self._bv_sharded, ml_sharded=self._ml_sharded,
+            fib=self._fib_impl)
 
     def swap(self) -> int:
         """Stack every node's staged builder into one sharded table epoch.

@@ -53,6 +53,7 @@ from vpp_tpu.parallel.mesh import (
 from vpp_tpu.parallel.partition import (
     agree_ml,
     bv_mesh_ok,
+    select_fib_impl,
     select_impl,
     validate_partitioning,
 )
@@ -189,12 +190,22 @@ class MultiHostCluster:
         self._use_fast = False
         self._ml_mode = "off"
         self._ml_kind = "mlp"
+        self._fib_impl = "dense"
         self.mxu_threshold = 512
         self.bv_min_rules = int(
             getattr(self.config, "classifier_bv_min_rules", 1024))
+        self.fib_lpm_min_routes = int(
+            getattr(self.config, "fib_lpm_min_routes", 256))
 
     def node(self, i: int) -> Dataplane:
         return self.nodes[i]
+
+    @property
+    def fib_impl(self) -> str:
+        """The FIB rung the LIVE fleet epoch runs ("dense" | "lpm"),
+        agreed across processes at publish — the ClusterDataplane
+        ``fib_impl`` twin."""
+        return self._fib_impl
 
     # --- collective operations ---
     def _to_global(self, local_chunk, spec):
@@ -330,9 +341,15 @@ class MultiHostCluster:
         # ml agreement: kinds must be uniform fleet-wide; encode this
         # host's view as (kind, conflict) — min/max detect divergence
         local_kind = local_kinds.pop() if len(local_kinds) == 1 else -1
+        local_lpm_ok = all(self.nodes[i].builder.lpm_ok()
+                           for i in self.local_nodes)
+        local_nroutes = max(self.nodes[i].builder.fib_route_count()
+                            for i in self.local_nodes)
         flags = np.asarray(multihost_utils.process_allgather(
             np.int32([int(local_mxu_ok), int(local_bv_ok),
-                      int(local_nmax), local_kind]))).reshape(-1, 4)
+                      int(local_nmax), local_kind,
+                      int(local_lpm_ok),
+                      int(local_nroutes)]))).reshape(-1, 6)
         mxu_ok = bool(flags[:, 0].min())
         bv_ok = self._bv_sharded and bool(flags[:, 1].min())
         nmax = int(flags[:, 2].max())
@@ -345,6 +362,16 @@ class MultiHostCluster:
             nmax >= int(getattr(c, "fastpath_min_rules", 0))
         self._ml_mode, self._ml_kind = agree_ml(
             getattr(c, "ml_stage", "off"), flags[:, 3])
+        # FIB ladder, fleet-agreed like the classifier: lpm only when
+        # EVERY process's nodes stage eligible tables (min), at the
+        # LARGEST staged route count (max) — the shared rung mapping
+        # keeps mesh and standalone selection identical by
+        # construction (partition.select_fib_impl; pallas never
+        # shards — validate_partitioning)
+        self._fib_impl = select_fib_impl(
+            getattr(c, "fib_impl", "auto"),
+            bool(flags[:, 4].min()), int(flags[:, 5].max()),
+            self.fib_lpm_min_routes, pallas_ok=False)
         self.tables = DataplaneTables(**host_fields, **sess, **tel,
                                       **tnt, **fib_st)
         self._uplinks = self._to_global(
@@ -399,7 +426,8 @@ class MultiHostCluster:
             sweep_stride=self._sweep_stride,
             impl=self._impl, fast=self._use_fast,
             ml_mode=self._ml_mode, ml_kind=self._ml_kind,
-            bv_sharded=self._bv_sharded, ml_sharded=self._ml_sharded)
+            bv_sharded=self._bv_sharded, ml_sharded=self._ml_sharded,
+            fib=self._fib_impl)
 
     def step_wire(self, pkts: PacketVector, payload, now: int):
         """COLLECTIVE: wire-traffic step — headers AND payload bytes

@@ -358,10 +358,11 @@ def _step_label(impl: str, skip_local: bool, fast: bool, form: str,
                 ml_mode: str = "off", ml_kind: str = "mlp",
                 tel_mode: str = "off", tnt_mode: str = "off",
                 fib_impl: str = "dense",
-                sess_impl: str = "gather") -> str:
+                sess_impl: str = "gather",
+                sess_hash: str = "fwd") -> str:
     from vpp_tpu.pipeline.graph import SWEEP_STRIDE_DEFAULT
 
-    return "{}{}{}{}{}{}{}{}{}_{}".format(
+    return "{}{}{}{}{}{}{}{}{}{}_{}".format(
         impl, "_nolocal" if skip_local else "", "_auto" if fast else "",
         ("" if ml_mode == "off"
          else f"_ml{ml_mode}"
@@ -370,6 +371,7 @@ def _step_label(impl: str, skip_local: bool, fast: bool, form: str,
         "" if tnt_mode == "off" else "_tenancy",
         "" if fib_impl == "dense" else f"_fib{fib_impl}",
         "" if sess_impl == "gather" else f"_sess{sess_impl}",
+        "" if sess_hash == "fwd" else f"_h{sess_hash}",
         ("" if sweep_stride == SWEEP_STRIDE_DEFAULT
          else f"_sw{sweep_stride}"),
         f"{form}{ring_slots}" if form == "ring" else form)
@@ -475,21 +477,23 @@ def _jitted_step(impl: str, skip_local: bool, fast: bool, form: str,
                  ring_slots: int = 0,
                  ml_mode: str = "off", ml_kind: str = "mlp",
                  tel_mode: str = "off", tnt_mode: str = "off",
-                 fib_impl: str = "dense", sess_impl: str = "gather"):
+                 fib_impl: str = "dense", sess_impl: str = "gather",
+                 sess_hash: str = "fwd"):
     from vpp_tpu.pipeline.graph import SWEEP_STRIDE_DEFAULT
 
     if sweep_stride is None:
         sweep_stride = SWEEP_STRIDE_DEFAULT
     key = (impl, skip_local, fast, form, sweep_stride, ring_slots,
-           ml_mode, ml_kind, tel_mode, tnt_mode, fib_impl, sess_impl)
+           ml_mode, ml_kind, tel_mode, tnt_mode, fib_impl, sess_impl,
+           sess_hash)
     step = _JIT_STEPS.get(key)
     if step is None:
         fn = make_pipeline_step(impl, skip_local, fast, sweep_stride,
                                 ml_mode, ml_kind, tel_mode, tnt_mode,
-                                fib_impl, sess_impl)
+                                fib_impl, sess_impl, sess_hash)
         label = _step_label(impl, skip_local, fast, form, sweep_stride,
                             ring_slots, ml_mode, ml_kind, tel_mode,
-                            tnt_mode, fib_impl, sess_impl)
+                            tnt_mode, fib_impl, sess_impl, sess_hash)
         if form == "plain":
             step = jax.jit(_counting(label, fn))
         elif form == "packed":
@@ -691,6 +695,11 @@ class Dataplane:
         self.session_impl_knob = getattr(self.config, "session_impl",
                                          "auto")
         self._session_impl = "gather"
+        # Session bucket hash family (tables.py sess_hash; ISSUE 18):
+        # a pure config gate like telemetry — "sym" buckets flows
+        # direction-invariantly so the fleet steering tier can map
+        # packets to bucket ranges from outside the dataplane.
+        self._sess_hash = getattr(self.config, "sess_hash", "fwd")
         # optional Prometheus histogram (stats/collector.py): observes
         # the fib-group upload cost of every swap that actually
         # re-shipped FIB state (vpp_tpu_fib_churn_commit_seconds)
@@ -1195,7 +1204,8 @@ class Dataplane:
         skip = self._skip_local
         stride = self._sweep_stride
         gates = (self._ml_mode, self._ml_kind, self._tel_mode,
-                 self._tnt_mode, self._fib_impl, self._session_impl)
+                 self._tnt_mode, self._fib_impl, self._session_impl,
+                 self._sess_hash)
         if (skip
                 and (self._classifier_impl, skip, fast, form, stride,
                      0) + gates not in _JIT_STEPS
@@ -1208,7 +1218,8 @@ class Dataplane:
                             tel_mode=self._tel_mode,
                             tnt_mode=self._tnt_mode,
                             fib_impl=self._fib_impl,
-                            sess_impl=self._session_impl)
+                            sess_impl=self._session_impl,
+                            sess_hash=self._sess_hash)
 
     def time_classifier(self, batch: int = 256, iters: int = 10) -> float:
         """Diagnostic: time the SELECTED global classifier in isolation

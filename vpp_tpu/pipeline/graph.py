@@ -427,6 +427,7 @@ def pipeline_step(
     tnt_mode: str = "off",
     fib_fn=fib_lookup_dense,
     sess_impl: str = "gather",
+    sess_hash: str = "fwd",
     shard=None,
     _tnt_pre=None,
 ) -> StepResult:
@@ -472,7 +473,8 @@ def pipeline_step(
     # Expired entries (idle > sess_max_age ticks) don't match, and hits
     # refresh the timestamp — active flows never expire mid-flow.
     established, sess_hit_idx = session_lookup_reverse_idx(
-        tables, pkts, now, shard=shard, tnt=tnt, impl=sess_impl)
+        tables, pkts, now, shard=shard, tnt=tnt, impl=sess_impl,
+        sym=sess_hash == "sym")
     established = established & alive
     # pre-touch session age: an ML feature (the touch below refreshes
     # the timestamp, so the age must be captured first — the fast tier
@@ -542,7 +544,8 @@ def pipeline_step(
     # must not consume session slots); keys are post-NAT so replies match ---
     want_sess = forwarded & ~established & nat_capable & ~nat_unsupported
     tables, _, sess_fail, sess_ev_exp, sess_ev_vic = session_insert(
-        tables, pkts, want_sess, now, shard=shard, tnt=tnt)
+        tables, pkts, want_sess, now, shard=shard, tnt=tnt,
+        sym=sess_hash == "sym")
     nat_kind = (
         jnp.where(dnat_applied, 1, 0) + jnp.where(snat_applied, 2, 0)
     ).astype(jnp.int32)
@@ -688,6 +691,7 @@ def pipeline_step_fast(
     tnt_mode: str = "off",
     fib_fn=fib_lookup_dense,
     sess_impl: str = "gather",
+    sess_hash: str = "fwd",
     shard=None,
 ) -> StepResult:
     """The classify-free established-flow kernel, standalone:
@@ -708,7 +712,8 @@ def pipeline_step_fast(
     alive = alive & ~tnt_dropped
     tnt = tnt_mode != "off"
     established, sess_hit_idx = session_lookup_reverse_idx(
-        tables, pkts, now, shard=shard, tnt=tnt, impl=sess_impl)
+        tables, pkts, now, shard=shard, tnt=tnt, impl=sess_impl,
+        sym=sess_hash == "sym")
     established = established & alive
     pkts, nat_reversed, nat_hit_idx = nat44_reverse(tables, pkts, alive,
                                                     now, shard=shard,
@@ -735,6 +740,7 @@ def pipeline_step_auto(
     tnt_mode: str = "off",
     fib_fn=fib_lookup_dense,
     sess_impl: str = "gather",
+    sess_hash: str = "fwd",
     shard=None,
 ) -> StepResult:
     """Two-tier dispatch: the fast kernel when the whole batch rides
@@ -781,7 +787,8 @@ def pipeline_step_auto(
     alive = alive & ~tnt_dropped
     tnt = tnt_mode != "off"
     hits, sess_hit_idx, all_hit = session_batch_summary(
-        tbl, pkts1, alive, now, shard=shard, tnt=tnt, impl=sess_impl
+        tbl, pkts1, alive, now, shard=shard, tnt=tnt, impl=sess_impl,
+        sym=sess_hash == "sym"
     )
     # NAT reverse runs before the DNAT probe: the un-NAT'd header is
     # what the full chain would hand nat44_dnat
@@ -815,7 +822,7 @@ def pipeline_step_auto(
                              ml_mode=ml_mode, ml_kind=ml_kind,
                              tel_mode=tel_mode, tnt_mode=tnt_mode,
                              fib_fn=fib_fn, sess_impl=sess_impl,
-                             shard=shard,
+                             sess_hash=sess_hash, shard=shard,
                              _tnt_pre=((tid, tnt_dropped, tbl)
                                        if tnt else None))
 
@@ -879,7 +886,8 @@ def make_pipeline_step(impl: str = "dense", skip_local: bool = False,
                        ml_mode: str = "off", ml_kind: str = "mlp",
                        tel_mode: str = "off", tnt_mode: str = "off",
                        fib_impl: str = "dense",
-                       sess_impl: str = "gather"):
+                       sess_impl: str = "gather",
+                       sess_hash: str = "fwd"):
     """Compose one pipeline-step callable from the epoch's gates:
     classifier implementation (dense | mxu | bv), the policy-free
     local-classify skip, the two-tier fast-path dispatch, the session
@@ -908,6 +916,8 @@ def make_pipeline_step(impl: str = "dense", skip_local: bool = False,
         raise ValueError(f"unknown tnt_mode {tnt_mode!r}")
     if sess_impl not in ("gather", "pallas"):
         raise ValueError(f"unknown sess_impl {sess_impl!r}")
+    if sess_hash not in ("fwd", "sym"):
+        raise ValueError(f"unknown sess_hash {sess_hash!r}")
     acl_global_fn, acl_local_fn = _classifier_fns(impl)
     fib_fn = _fib_fn(fib_impl)
     if skip_local:
@@ -920,9 +930,9 @@ def make_pipeline_step(impl: str = "dense", skip_local: bool = False,
                     acl_local_fn=acl_local_fn, sweep_stride=sweep_stride,
                     ml_mode=ml_mode, ml_kind=ml_kind, tel_mode=tel_mode,
                     tnt_mode=tnt_mode, fib_fn=fib_fn,
-                    sess_impl=sess_impl)
+                    sess_impl=sess_impl, sess_hash=sess_hash)
 
-    step.__name__ = "pipeline_step_{}{}{}{}{}{}{}{}".format(
+    step.__name__ = "pipeline_step_{}{}{}{}{}{}{}{}{}".format(
         impl, "_nolocal" if skip_local else "", "_auto" if fast else "",
         "" if ml_mode == "off" else f"_ml{ml_mode}"
         + ("_forest" if ml_kind == "forest" else ""),
@@ -930,6 +940,7 @@ def make_pipeline_step(impl: str = "dense", skip_local: bool = False,
         "" if tnt_mode == "off" else "_tenancy",
         "" if fib_impl == "dense" else f"_fib{fib_impl}",
         "" if sess_impl == "gather" else f"_sess{sess_impl}",
+        "" if sess_hash == "fwd" else f"_h{sess_hash}",
     )
     return step
 

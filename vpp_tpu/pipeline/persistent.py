@@ -96,7 +96,8 @@ class PersistentPump:
                  sweep_stride: Optional[int] = None,
                  ring_slots: int = 8, ring_windows: int = 2,
                  ml_mode: str = "off", ml_kind: str = "mlp",
-                 tel_mode: str = "off", tnt_mode: str = "off"):
+                 tel_mode: str = "off", tnt_mode: str = "off",
+                 sess_hash: str = "fwd"):
         self.batch = int(batch)
         self.fastpath_enabled = bool(fastpath)
         self.ring = DeviceDescRing(slots=ring_slots, batch=self.batch,
@@ -134,7 +135,8 @@ class PersistentPump:
                                   form="ring", sweep_stride=sweep_stride,
                                   ring_slots=self.ring.slots,
                                   ml_mode=ml_mode, ml_kind=ml_kind,
-                                  tel_mode=tel_mode, tnt_mode=tnt_mode)
+                                  tel_mode=tel_mode, tnt_mode=tnt_mode,
+                                  sess_hash=sess_hash)
         # device-resident frame cursor, threaded window-to-window next
         # to the tables (the sweep-cursor pattern); fetched only by
         # stats()/stop, never per window

@@ -664,3 +664,125 @@ class SessionSnapshotter:
         log.info("session table restored warm: generation %d (%s)",
                  self.stats["generation"], outcome)
         return True
+
+
+# --- range-scoped drain/adopt (fleet live migration; ISSUE 18) -------
+#
+# The fleet steering tier (vpp_tpu/fleet/) moves session ownership
+# between Dataplane instances in units of contiguous BUCKET RANGES —
+# the same ranges its consistent hash steers flows by. A migration
+# ships exactly the buckets whose hash range moved, nothing else:
+# drain_bucket_range fetches them off the source (reusing the jitted
+# chunk-drain program, so draining costs the same bounded device→host
+# fetches a snapshot chunk does), adopt_bucket_range splices them into
+# the destination's live columns with the snapshot-restore age rebase
+# (time' = time − now_src + now_dst: idle AGES are preserved across
+# instances whose tick clocks started at different walltimes), and
+# release_bucket_range invalidates them on the source once ownership
+# has flipped. Only the reflective "sess" table migrates: NAT sessions
+# key on the post-NAT reply tuple, which the steering tier cannot hash
+# direction-invariantly, so they cold-start on the new owner
+# (docs/FLEET.md records the limitation).
+
+
+def drain_bucket_range(dp, start: int, n_buckets: int,
+                       table: str = "sess",
+                       chunk_buckets: int = 256):
+    """Fetch rows ``[start, start+n_buckets)`` of one session table as
+    ``({field: host array [n, W]}, now_src)``. Reads ONE immutable
+    epoch reference under the lock (the _drain consistency contract);
+    the fetch itself runs outside it."""
+    import jax
+
+    fields = TABLE_COLS[table]
+    with dp._lock:
+        tables = dp.tables
+        if tables is None:
+            raise RuntimeError(
+                "staging handle has no live tables to drain")
+        now = max(dp._now, dp.clock_ticks())
+    cols = tuple(getattr(tables, f) for f in fields)
+    total = int(cols[0].shape[0])
+    if not (0 <= start and n_buckets > 0
+            and start + n_buckets <= total):
+        raise ValueError(
+            f"bucket range [{start}, {start + n_buckets}) outside "
+            f"table of {total} buckets")
+    cb = min(chunk_buckets, n_buckets)
+    out = {f: [] for f in fields}
+    fetch = _fetch_fn(cb)
+    for off in range(start, start + n_buckets, cb):
+        faults.fire("fleet.migrate")
+        step = min(cb, start + n_buckets - off)
+        block = np.asarray(jax.device_get(fetch(cols, np.int32(off))))
+        for i, f in enumerate(fields):
+            out[f].append(block[i, :step].view(SESSION_FIELDS[f]))
+    return ({f: np.concatenate(v, axis=0) for f, v in out.items()},
+            int(now))
+
+
+def adopt_bucket_range(dp, cols: Dict[str, np.ndarray], start: int,
+                       now_src: int, table: str = "sess") -> int:
+    """Splice migrated rows into the destination's live table at
+    ``[start, start+n)``, age-rebased to the destination's clock, and
+    publish (``adopt_sessions`` — the restore carry-over contract; the
+    epoch bumps). Returns the count of live sessions adopted."""
+    import jax
+
+    fields = TABLE_COLS[table]
+    n = int(next(iter(cols.values())).shape[0])
+    with dp._lock:
+        tables = dp.tables
+        if tables is None:
+            raise RuntimeError(
+                "staging handle cannot adopt migrated sessions")
+        now_dst = max(dp._now, dp.clock_ticks())
+    sessions = {f: np.array(jax.device_get(getattr(tables, f)))
+                for f in SESSION_FIELDS}
+    total = int(sessions[fields[0]].shape[0])
+    if not (0 <= start and n > 0 and start + n <= total):
+        raise ValueError(
+            f"bucket range [{start}, {start + n}) outside table of "
+            f"{total} buckets")
+    adopted = 0
+    for f in fields:
+        arr = np.asarray(cols[f], SESSION_FIELDS[f])
+        if f.endswith("_time"):
+            # the live-migration form of the restore rebase: ages are
+            # preserved, so an entry idle-expired on the source stays
+            # expired on the destination
+            arr = (arr.astype(np.int64) - now_src
+                   + now_dst).astype(np.int32)
+        sessions[f][start:start + n] = arr
+        if f.endswith("_valid"):
+            adopted = int(arr.sum())
+    dp.adopt_sessions(sessions)
+    return adopted
+
+
+def release_bucket_range(dp, start: int, n_buckets: int,
+                         table: str = "sess") -> int:
+    """Invalidate rows ``[start, start+n)`` on the SOURCE after its
+    hash range moved away: the new owner serves them now, and a stale
+    copy answering here would fork session state. Returns the count of
+    live sessions released."""
+    import jax
+
+    valid_field = "sess_valid" if table == "sess" else "natsess_valid"
+    with dp._lock:
+        tables = dp.tables
+        if tables is None:
+            raise RuntimeError(
+                "staging handle cannot release migrated sessions")
+    sessions = {f: np.array(jax.device_get(getattr(tables, f)))
+                for f in SESSION_FIELDS}
+    total = int(sessions[valid_field].shape[0])
+    if not (0 <= start and n_buckets > 0
+            and start + n_buckets <= total):
+        raise ValueError(
+            f"bucket range [{start}, {start + n_buckets}) outside "
+            f"table of {total} buckets")
+    released = int(sessions[valid_field][start:start + n_buckets].sum())
+    sessions[valid_field][start:start + n_buckets] = 0
+    dp.adopt_sessions(sessions)
+    return released

@@ -88,6 +88,16 @@ class DataplaneConfig(NamedTuple):
     # a mesh with an explicit pallas knob is rejected at config time
     # (parallel/partition.py validate_partitioning).
     session_impl: str = "auto"
+    # Session bucket hash family (ops/session.py): "fwd" hashes the
+    # forward 5-tuple (the classic single-instance layout); "sym"
+    # canonicalizes the tuple (address-pair ordered) so BOTH directions
+    # of a flow land in the same bucket without knowing direction —
+    # required by the fleet steering tier (vpp_tpu/fleet/,
+    # docs/FLEET.md), which maps packets to instances by session
+    # bucket range from OUTSIDE the dataplane. Only bucket placement
+    # changes; stored keys, key comparison and hit semantics are
+    # identical. Trace-time static (part of the step-factory key).
+    sess_hash: str = "fwd"
     # NAT-session table slots; 0 = same as sess_slots (shares sess_ways)
     natsess_slots: int = 0
     # Amortized on-device aging: every fused pipeline step sweeps this
@@ -703,6 +713,10 @@ def validate_dataplane_config(config: DataplaneConfig) -> None:
         raise ValueError(
             f"dataplane.session_impl must be gather | pallas | auto, "
             f"got {session_impl!r}")
+    sess_hash = getattr(c, "sess_hash", "fwd")
+    if sess_hash not in ("fwd", "sym"):
+        raise ValueError(
+            f"dataplane.sess_hash must be fwd | sym, got {sess_hash!r}")
     if int(getattr(c, "fib_lpm_min_routes", 256)) < 0:
         raise ValueError(
             f"dataplane.fib_lpm_min_routes must be >= 0, got "

@@ -203,6 +203,48 @@ CLASSIFIER_IMPLS = ("dense", "mxu", "bv", "pallas")
 # wiring bug, not good news.
 DEGRADED_COMPONENTS = ("kvstore", "ring", "snapshot", "ml", "governor")
 
+# Gateway-fleet surface (ISSUE 18; vpp_tpu/fleet/). One declaration
+# drives BOTH registration (__init__, unconditional — the registries.py
+# full-registry build lints these without a fleet attached) and the
+# --counters parity pass: every vpp_tpu_fleet_* family must appear
+# here, and the drop-cause axis must equal the causes the steering
+# tier (STEER_DROP_CAUSES) and the fleet pump (QUEUE_DROP_CAUSES)
+# actually attribute — a cause added on either side without its
+# observability twin fails lint, the PUMP_DROP_REASONS discipline.
+FLEET_GAUGE_FAMILIES = (
+    ("vpp_tpu_fleet_instances",
+     "dataplane instances behind the fleet steering tier", "gauge"),
+    ("vpp_tpu_fleet_ranges",
+     "consistent-hash bucket ranges (the ownership/migration "
+     "quantum)", "gauge"),
+    ("vpp_tpu_fleet_fenced_ranges",
+     "ranges currently fenced mid-migration (steered traffic for "
+     "them drops, attributed cause=fenced)", "gauge"),
+    ("vpp_tpu_fleet_epoch_max",
+     "highest per-range ownership epoch observed (the fencing-token "
+     "high-water mark; only advances)", "gauge"),
+    ("vpp_tpu_fleet_rebalances_total",
+     "completed rebalance waves (each migrates every moved range)",
+     "counter"),
+    ("vpp_tpu_fleet_migrated_ranges_total",
+     "bucket ranges live-migrated between instances (including "
+     "crash recoveries)", "counter"),
+    ("vpp_tpu_fleet_migrated_sessions_total",
+     "live sessions shipped by range migrations (drained, "
+     "age-rebased, adopted)", "counter"),
+    ("vpp_tpu_fleet_steered_total",
+     "packets steered to each instance (by instance label)",
+     "counter"),
+    ("vpp_tpu_fleet_drops_total",
+     "packets the fleet tier dropped, by attributed cause "
+     "(fenced/no_owner/queue — offered == steered + these, exactly)",
+     "counter"),
+    ("vpp_tpu_fleet_queue_depth",
+     "packets buffered or queued toward each instance (by instance "
+     "label)", "gauge"),
+)
+FLEET_DROP_CAUSES = ("fenced", "no_owner", "queue")
+
 # Latency-governor surface (ISSUE 13; io/governor.py). The mode info
 # gauge enumerates "off" (no governor attached) plus the state
 # machine's modes; GOVERNOR_STAT_GAUGES maps the governor's numeric
@@ -895,6 +937,16 @@ class StatsCollector:
             name: self.registry.register(STATS_PATH, Gauge(name, help_))
             for name, help_ in VCL_GAUGES
         }
+        # gateway fleet (ISSUE 18): registered unconditionally from
+        # the ONE declaration the --counters parity pass checks
+        self.fleet_gauges = {
+            name: self.registry.register(
+                STATS_PATH, Gauge(name, help_, kind=kind))
+            for name, help_, kind in FLEET_GAUGE_FAMILIES
+        }
+        self._fleet = None
+        self._fleet_pump = None
+        self._fleet_pub_insts: set = set()
         self._known_labels: Dict[int, Dict[str, str]] = {}
         self._publish_lock = threading.Lock()
         # zero accumulators when an interface slot is freed, so a later
@@ -955,6 +1007,16 @@ class StatsCollector:
         partition info gauge then reports the mesh's shard count
         instead of 1."""
         self._cluster = cluster
+
+    def set_fleet(self, steering, pump=None) -> None:
+        """Attach the fleet steering tier (vpp_tpu/fleet/steering.py)
+        and optionally its FleetPump so publish() exports the
+        steering/migration surface: instance and range counts, fenced
+        ranges, the epoch high-water, migration counters, per-instance
+        steered packets and queue depth, and the attributed drop-cause
+        family the conservation identity rests on."""
+        self._fleet = steering
+        self._fleet_pump = pump
 
     def reset_interface(self, if_idx: int) -> None:
         with self._lock:
@@ -1414,6 +1476,51 @@ class StatsCollector:
                         "accept_checks", "accept_denies", "clients"):
                 self.vcl_gauges[f"vpp_tpu_vcl_{key}"].set(
                     int(vs.get(key, 0)))
+        # gateway fleet (ISSUE 18): steering/migration surface from
+        # the attached tier's host counters — no device traffic
+        fleet = self._fleet
+        if fleet is not None:
+            fs = fleet.stats_snapshot()
+            g = self.fleet_gauges
+            g["vpp_tpu_fleet_instances"].set(float(fs["instances"]))
+            g["vpp_tpu_fleet_ranges"].set(float(fs["ranges"]))
+            g["vpp_tpu_fleet_fenced_ranges"].set(
+                float(fs["fenced_ranges"]))
+            g["vpp_tpu_fleet_epoch_max"].set(float(fs["epoch_max"]))
+            g["vpp_tpu_fleet_rebalances_total"].set(
+                float(fs["rebalances"]))
+            g["vpp_tpu_fleet_migrated_ranges_total"].set(
+                float(fs["migrated_ranges"]))
+            g["vpp_tpu_fleet_migrated_sessions_total"].set(
+                float(fs["migrated_sessions"]))
+            fpump = self._fleet_pump
+            psnap = (fpump.stats_snapshot()
+                     if fpump is not None else None)
+            queue_drops = (sum(psnap["queue_drops"].values())
+                           if psnap is not None else 0)
+            pub = set()
+            for inst, n in fs["steered"].items():
+                pub.add(inst)
+                g["vpp_tpu_fleet_steered_total"].set(
+                    float(n), instance=inst)
+                depth = 0
+                if psnap is not None:
+                    depth = (psnap["submitted"].get(inst, 0)
+                             - psnap["delivered"].get(inst, 0)
+                             + psnap["buffered"].get(inst, 0))
+                g["vpp_tpu_fleet_queue_depth"].set(
+                    float(depth), instance=inst)
+            # a departed instance's series must disappear, not freeze
+            # at its last count (the tenant/ECMP rule)
+            for inst in self._fleet_pub_insts - pub:
+                g["vpp_tpu_fleet_steered_total"].remove(instance=inst)
+                g["vpp_tpu_fleet_queue_depth"].remove(instance=inst)
+            self._fleet_pub_insts = pub
+            for cause, n in (("fenced", fs["fenced_drops"]),
+                             ("no_owner", fs["no_owner_drops"]),
+                             ("queue", queue_drops)):
+                g["vpp_tpu_fleet_drops_total"].set(float(n),
+                                                   cause=cause)
 
 
 def register_control_plane_metrics(

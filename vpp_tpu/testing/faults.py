@@ -53,6 +53,14 @@ point                 seam
 ``snapshot.chunk``    pipeline/snapshot.py — chunk file write (torn chunk)
 ``snapshot.manifest`` pipeline/snapshot.py — manifest publish (torn/crash)
 ``ml.load``           ml/loader.py — model artifact read (corrupt/missing)
+``fleet.steer``       fleet/steering.py — per-frame partition (the
+                      steering tier dying mid-stream; conservation
+                      must hold — ISSUE 18)
+``fleet.migrate``     pipeline/snapshot.py drain_bucket_range (per
+                      migrated chunk) + fleet/steering.py pre-commit —
+                      a migration crashing at either seam leaves the
+                      range FENCED: steered traffic drops attributed
+                      and ``recover()`` completes the move
 ====================  ====================================================
 """
 
