@@ -3661,6 +3661,335 @@ def fleet_bench(args, frame_pkts: int = 1024, iters: int = 8) -> dict:
     return out
 
 
+def overlay_bench(args, iters: int = 12, batch: int = 2048) -> dict:
+    """Device-resident VXLAN overlay + svc NAT44 planes (ISSUE 19
+    tentpole): three captures.
+
+      * **encap overhead** — the deployed chain compiled overlay off
+        vs vxlan over IDENTICAL east-west traffic at the headline rule
+        count; the vxlan variant additionally runs the decap
+        validator, the per-packet outer-header math and the outer-FIB
+        walk INSIDE the one jitted program, so the delta IS the
+        always-paid overlay cost (``overlay_encap_overhead_pct``,
+        acceptance: <= 15).
+      * **east-west round** — pod-to-pod across a 2-instance gateway
+        fleet: VXLAN frames addressed to the anycast VTEP are spread
+        by the steering tier (outer entropy sport — exactly how
+        underlay ECMP spreads them), decapped on whichever instance
+        owns the flow, delivered locally or re-encapped toward the
+        destination node. Per-tenant VNI isolation: an unknown VNI
+        fails CLOSED (drop_overlay) on every instance, conservation
+        exact.
+      * **backend churn** — a rolling service-backend replacement at
+        svc scale ships ONLY the svc group's few-KB scatter blob
+        (``svc_churn_bytes``; every non-svc device array carries over
+        by identity) and keeps surviving backends' hash ways
+        (``svc_sticky_kept_pct`` — only the replaced backend's flows
+        move, with zero unattributed loss).
+
+    CPU-harness caveat: overhead pct compares two compilations of the
+    same chain on the same backend, so the RATIO is meaningful even
+    when the absolute step cost is CPU-bound (the fleet_bench
+    framing); on TPU the same keys price the real deployment.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from vpp_tpu.fleet.steering import FleetSteering
+    from vpp_tpu.ir.rule import Action, ContivRule, Protocol
+    from vpp_tpu.ops.vxlan import OUTER_TTL, VXLAN_PORT, ENCAP_OVERHEAD
+    from vpp_tpu.pipeline.dataplane import Dataplane, pack_packet_columns
+    from vpp_tpu.pipeline.graph import make_pipeline_step
+    from vpp_tpu.pipeline.tables import DataplaneConfig
+    from vpp_tpu.pipeline.vector import (
+        Disposition,
+        FLAG_VALID,
+        PacketVector,
+        ip4,
+    )
+
+    shrink = jax.default_backend() == "cpu" and not args.cpu_full
+    if shrink:
+        iters = max(iters // 2, 4)
+    out: dict = {"overlay_batch": batch, "overlay_rules": args.rules}
+
+    # --- the overlay + svc gateway under test (parts 1 and 3) ---
+    config = DataplaneConfig(
+        max_tables=2, max_rules=16, max_global_rules=args.rules + 1,
+        max_ifaces=16, fib_slots=64, sess_slots=1 << 14,
+        nat_mappings=4, nat_backends=4, overlay="vxlan",
+        svc_vips=64, svc_backend_ways=8,
+    )
+    dp = Dataplane(config)
+    uplink = dp.add_uplink()
+    pod_if = dp.add_pod_interface(("default", "server"))
+    dp.set_vtep(ip4("192.168.16.1"))
+    dp.builder.add_route("10.1.1.0/24", pod_if, Disposition.LOCAL)
+    # svc backends live behind the pod interface
+    dp.builder.add_route("10.200.0.0/16", pod_if, Disposition.LOCAL)
+    # 16 remote pod /24s, each behind a peer VTEP (inner FIB), plus the
+    # VTEP underlay /24 the OUTER header resolves through — the second
+    # FIB walk the vxlan variant pays every step
+    for x in range(16):
+        dp.builder.add_route(
+            f"10.2.{x}.0/24", uplink, Disposition.REMOTE,
+            next_hop=ip4(f"192.168.16.{2 + x % 8}"), node_id=2 + x)
+    dp.builder.add_route("192.168.16.0/24", uplink, Disposition.REMOTE)
+    rules = build_rules(args.rules)
+    # VIP traffic (dport 80) rides the same table as the east-west mix
+    rules.insert(0, ContivRule(action=Action.PERMIT,
+                               protocol=Protocol.TCP, dest_port=80))
+    dp.builder.set_global_table(rules)
+    # 48 service VIPs x 4 backends: the svc planes at deployment scale
+    # (64-row capacity), so the churn round exercises the incremental
+    # blob path (the w-ladder needs blocks smaller than the VIP axis)
+    vips = {}
+    for v in range(48):
+        key = (ip4(f"10.96.{v // 250}.{2 + v % 250}"), 80, 6)
+        backends = [(ip4(f"10.200.{v}.10") + j, 80, 1) for j in range(4)]
+        dp.builder.set_service(*key, backends)
+        vips[v] = (key, backends)
+    dp.swap()
+    out["svc_full_upload_bytes"] = int(dp.builder.svc_upload["bytes"])
+
+    # --- part 1: the always-paid overlay stage cost -------------------
+    # East-west transit shaped on the rule grid (src block <-> dport
+    # pairing of build_rules) so the batch actually forwards: permitted
+    # frames take a REMOTE next_hop route and the vxlan variant
+    # re-encaps them toward the peer VTEP on-device.
+    rng = np.random.default_rng(19)
+    ridx = rng.integers(0, max(args.rules - 1, 1), batch)
+    ridx = ridx + (ridx % 6 == 5)  # step off the interleaved DENY rows
+    block = ridx % 1000
+    src = ((172 << 24) | ((16 + block // 256) << 16)
+           | ((block % 256) << 8)
+           | rng.integers(1, 255, batch)).astype(np.uint32)
+    dst = ((10 << 24) | (2 << 16) | ((ridx % 16) << 8)
+           | rng.integers(2, 250, batch)).astype(np.uint32)
+    pkts = PacketVector(
+        src_ip=jnp.asarray(src),
+        dst_ip=jnp.asarray(dst),
+        proto=jnp.full((batch,), 6, jnp.int32),
+        sport=jnp.asarray(
+            rng.integers(1024, 65535, batch).astype(np.int32)),
+        dport=jnp.asarray(
+            (8000 + (ridx // 1000) % 20).astype(np.int32)),
+        ttl=jnp.full((batch,), 64, jnp.int32),
+        pkt_len=jnp.full((batch,), 512, jnp.int32),
+        rx_if=jnp.full((batch,), uplink, jnp.int32),
+        flags=jnp.full((batch,), FLAG_VALID, jnp.int32),
+    )
+    impl, skip = dp.classifier_impl, dp._skip_local
+    step_off = jax.jit(make_pipeline_step(impl, skip,
+                                          fib_impl=dp.fib_impl))
+    step_ovl = jax.jit(make_pipeline_step(impl, skip,
+                                          fib_impl=dp.fib_impl,
+                                          overlay="vxlan"))
+    tables = dp.tables
+    no_frames = jnp.full((batch,), -1, jnp.int32)  # plain-IP sidecar
+
+    def med_us(step, *extra):
+        jax.block_until_ready(step(tables, pkts, jnp.int32(2),
+                                   *extra).disp)
+        ts = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(step(tables, pkts, jnp.int32(2),
+                                       *extra).disp)
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts)) * 1e6
+
+    t_off = med_us(step_off)
+    t_ovl = med_us(step_ovl, pkts, no_frames)
+    probe = step_ovl(tables, pkts, jnp.int32(2), pkts, no_frames)
+    out["overlay_encap_pkts"] = int(probe.stats.ovl_encap)
+    out["overlay_off_us"] = round(t_off, 1)
+    out["overlay_on_us"] = round(t_ovl, 1)
+    out["overlay_stage_ns_pkt"] = round(
+        max(t_ovl - t_off, 0.0) / batch * 1e3, 2)
+    out["overlay_encap_overhead_pct"] = round(
+        100.0 * (t_ovl - t_off) / max(t_off, 1e-9), 2)
+
+    # --- part 3: rolling backend replacement (zero-reship churn) ------
+    # Flow fan toward one VIP; probe() observes the hash-way pick
+    # without committing sessions, so stickiness below is the svc
+    # plane's sticky fill — not session pinning.
+    n_flows = 512
+    vkey, vbackends = vips[7]
+    frng = np.random.default_rng(23)
+    vip_pkts = PacketVector(
+        src_ip=jnp.asarray(
+            (ip4("172.16.0.0")
+             + frng.integers(1, 255, n_flows)).astype(np.uint32)),
+        dst_ip=jnp.full((n_flows,), vkey[0], jnp.uint32),
+        proto=jnp.full((n_flows,), 6, jnp.int32),
+        sport=jnp.asarray(
+            (1024 + np.arange(n_flows) * 13 % 50000).astype(np.int32)),
+        dport=jnp.full((n_flows,), 80, jnp.int32),
+        ttl=jnp.full((n_flows,), 64, jnp.int32),
+        pkt_len=jnp.full((n_flows,), 128, jnp.int32),
+        rx_if=jnp.full((n_flows,), uplink, jnp.int32),
+        flags=jnp.full((n_flows,), FLAG_VALID, jnp.int32),
+    )
+    r0 = dp.probe(vip_pkts, now=3)
+    picks0 = np.asarray(r0.pkts.dst_ip)
+    ok0 = np.asarray(r0.disp) != int(Disposition.DROP)
+    pins = (dp.tables.glb_src_net, dp.tables.acl_src_net,
+            dp.tables.fib_prefix, dp.tables.tnt_vni)
+    # roll ONE backend of ONE vip — the Deployment rolling-update beat
+    replaced = vbackends[3]
+    new_bk = (ip4("10.200.99.99"), 80, 1)
+    with dp.commit_lock:
+        dp.builder.set_service(*vkey, vbackends[:3] + [new_bk])
+        dp.swap()
+    up = dp.builder.svc_upload
+    out["svc_churn_bytes"] = int(up["bytes"])
+    out["svc_churn_blob_bytes"] = int(up["blob_bytes"])
+    out["svc_churn_fields"] = len(up["fields"])
+    out["svc_churn_ms"] = round(float(up["ms"]), 3)
+    out["svc_churn_zero_reship"] = int(all(
+        a is b for a, b in zip(pins, (
+            dp.tables.glb_src_net, dp.tables.acl_src_net,
+            dp.tables.fib_prefix, dp.tables.tnt_vni))))
+    r1 = dp.probe(vip_pkts, now=4)
+    picks1 = np.asarray(r1.pkts.dst_ip)
+    ok1 = np.asarray(r1.disp) != int(Disposition.DROP)
+    survivor = ok0 & (picks0 != np.uint32(replaced[0]))
+    moved = ok0 & (picks0 == np.uint32(replaced[0]))
+    out["svc_churn_flows"] = int(ok0.sum())
+    out["svc_churn_loss"] = int(ok0.sum() - ok1.sum())
+    out["svc_sticky_kept_pct"] = round(
+        100.0 * float((picks1[survivor] == picks0[survivor]).mean())
+        if survivor.any() else 100.0, 2)
+    out["svc_moved_flows"] = int(moved.sum())
+    out["svc_moved_to_new_pct"] = round(
+        100.0 * float((picks1[moved] == np.uint32(new_bk[0])).mean())
+        if moved.any() else 100.0, 2)
+
+    # --- part 2: pod-to-pod across the fleet, per-tenant VNIs ---------
+    def mk_gw():
+        cfg = DataplaneConfig(
+            max_tables=2, max_rules=16, max_global_rules=8,
+            max_ifaces=8, fib_slots=32, sess_slots=1 << 12,
+            sess_ways=4, sess_hash="sym", nat_mappings=1,
+            nat_backends=1, tenancy="on", tenancy_tenants=4,
+            overlay="vxlan")
+        gw = Dataplane(cfg)
+        gup = gw.add_uplink()
+        gpod = gw.add_pod_interface(("default", "east"))
+        gw.set_vtep(ip4("192.168.32.1"))  # anycast gateway VTEP
+        gw.builder.set_tenant(1, prefixes=["10.61.0.0/16"], vni=100)
+        gw.builder.set_tenant(2, prefixes=["10.62.0.0/16"], vni=200)
+        for t in (61, 62):
+            gw.builder.add_route(f"10.{t}.1.0/24", gpod,
+                                 Disposition.LOCAL)
+            gw.builder.add_route(
+                f"10.{t}.2.0/24", gup, Disposition.REMOTE,
+                next_hop=ip4("192.168.32.9"), node_id=3)
+        gw.builder.add_route("192.168.32.0/24", gup,
+                             Disposition.REMOTE)
+        gw.builder.set_global_table([
+            ContivRule(action=Action.PERMIT, protocol=Protocol.TCP),
+            ContivRule(action=Action.DENY)])
+        gw.swap()
+        return gw, gup
+
+    n2 = 512
+    lanes = np.arange(n2)
+    tnt = 1 + (lanes % 2)
+    bad = (lanes % 8) == 7
+    to_local = (lanes // 2) % 2 == 0
+    inner_src = ((10 << 24) | ((60 + tnt) << 16) | (9 << 8)
+                 | (1 + lanes % 250)).astype(np.uint32)
+    inner_dst = ((10 << 24) | ((60 + tnt) << 16)
+                 | (np.where(to_local, 1, 2) << 8)
+                 | (2 + lanes % 250)).astype(np.uint32)
+    vni = np.where(bad, 999, np.where(tnt == 1, 100, 200)).astype(
+        np.int32)
+    outer_cols = {
+        "src_ip": np.full(n2, ip4("192.168.32.50"), np.uint32),
+        "dst_ip": np.full(n2, ip4("192.168.32.1"), np.uint32),
+        "proto": np.full(n2, 17, np.int32),
+        "sport": (49152 + lanes % 16384).astype(np.int32),
+        "dport": np.full(n2, VXLAN_PORT, np.int32),
+        "ttl": np.full(n2, OUTER_TTL, np.int32),
+        "pkt_len": np.full(n2, 128 + ENCAP_OVERHEAD, np.int32),
+        "rx_if": np.ones(n2, np.int32),
+        "flags": np.full(n2, FLAG_VALID, np.int32),
+    }
+    flat = np.zeros((5, n2), np.int32)
+    pack_packet_columns(flat.view(np.uint32), outer_cols, n2)
+
+    gws = {"gw-a": mk_gw(), "gw-b": mk_gw()}
+    st = FleetSteering({nm: g for nm, (g, _) in gws.items()})
+    try:
+        groups, sdrops = st.partition(flat)
+        delivered = reencapped = decapped = bad_dropped = 0
+        bad_offered = int(bad.sum())
+        spread = {}
+        for nm, idx in groups.items():
+            gw, gup = gws[nm]
+            k = idx.size
+            spread[nm] = k
+            sel = np.concatenate(
+                [idx, np.zeros(n2 - k, np.int64)]).astype(np.int64)
+            live = np.arange(n2) < k
+            outer_pv = PacketVector(
+                src_ip=jnp.asarray(outer_cols["src_ip"][sel]),
+                dst_ip=jnp.asarray(outer_cols["dst_ip"][sel]),
+                proto=jnp.asarray(outer_cols["proto"][sel]),
+                sport=jnp.asarray(outer_cols["sport"][sel]),
+                dport=jnp.asarray(outer_cols["dport"][sel]),
+                ttl=jnp.asarray(outer_cols["ttl"][sel]),
+                pkt_len=jnp.asarray(outer_cols["pkt_len"][sel]),
+                rx_if=jnp.full((n2,), 1, jnp.int32),
+                flags=jnp.asarray(
+                    np.where(live, FLAG_VALID, 0).astype(np.int32)),
+            )
+            inner_pv = PacketVector(
+                src_ip=jnp.asarray(inner_src[sel]),
+                dst_ip=jnp.asarray(inner_dst[sel]),
+                proto=jnp.full((n2,), 6, jnp.int32),
+                sport=jnp.asarray(
+                    (1024 + sel % 40000).astype(np.int32)),
+                dport=jnp.full((n2,), 80, jnp.int32),
+                ttl=jnp.full((n2,), 64, jnp.int32),
+                pkt_len=jnp.full((n2,), 128, jnp.int32),
+                rx_if=jnp.full((n2,), 1, jnp.int32),
+                flags=jnp.asarray(
+                    np.where(live, FLAG_VALID, 0).astype(np.int32)),
+            )
+            vni_pv = np.where(live, vni[sel], -1).astype(np.int32)
+            r = gw.process(outer_pv, now=5, ovl_inner=inner_pv,
+                           ovl_vni=vni_pv)
+            disp = np.asarray(r.disp)[:k]
+            delivered += int((disp == int(Disposition.LOCAL)).sum())
+            reencapped += int(r.stats.ovl_encap)
+            decapped += int(r.stats.ovl_decap)
+            bad_dropped += int(r.stats.drop_overlay)
+        n_good = n2 - bad_offered - sdrops["fenced"] - \
+            sdrops["no_owner"]
+        out["overlay_eastwest_frames"] = n2
+        out["overlay_eastwest_instances"] = len(gws)
+        out["overlay_eastwest_spread_min_pct"] = round(
+            100.0 * min(spread.values(), default=0) / n2, 1)
+        out["overlay_eastwest_decapped"] = decapped
+        out["overlay_eastwest_delivered"] = delivered
+        out["overlay_eastwest_reencapped"] = reencapped
+        out["overlay_eastwest_delivered_pct"] = round(
+            100.0 * (delivered + reencapped) / max(n_good, 1), 1)
+        out["overlay_eastwest_bad_vni"] = bad_offered
+        out["overlay_eastwest_bad_dropped"] = bad_dropped
+        out["overlay_eastwest_isolated"] = int(
+            bad_dropped == bad_offered)
+        out["overlay_eastwest_conservation_exact"] = int(
+            delivered + reencapped + bad_dropped
+            + sdrops["fenced"] + sdrops["no_owner"] == n2)
+    finally:
+        st.close()
+    return out
+
+
 def main():
     try:
         # Supervisor by default: the axon tunnel wedges MID-RUN without
@@ -4055,6 +4384,21 @@ def _run():
         pri["fleet_bench_error"] = f"{type(e).__name__}: {e}"
     _jc_now = _jit_compiles_now()
     pri["fleet_jit_compiles"] = _jc_now - _jc
+    _jc = _jc_now
+    _progress(**pri)
+    try:
+        # device-resident VXLAN overlay + svc NAT44 planes (ISSUE 19):
+        # the always-paid overlay stage cost at the headline rule
+        # count (acceptance: overlay_encap_overhead_pct <= 15), the
+        # pod-to-pod cross-instance round over the steering tier with
+        # per-tenant VNI isolation, and the rolling backend
+        # replacement's svc-only blob (svc_churn_bytes — a few KB,
+        # every non-svc plane identity-pinned)
+        pri.update(overlay_bench(args))
+    except Exception as e:  # noqa: BLE001
+        pri["overlay_bench_error"] = f"{type(e).__name__}: {e}"
+    _jc_now = _jit_compiles_now()
+    pri["overlay_jit_compiles"] = _jc_now - _jc
     _jc = _jc_now
     _progress(**pri)
     if not args.no_subbench:

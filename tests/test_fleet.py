@@ -605,3 +605,80 @@ class TestFleetObservability:
         # unconfigured path stays useful
         cli2 = DebugCLI(next(iter(dps.values())))
         assert "not configured" in cli2.run("show fleet")
+
+
+# --- NAT coldstarts across migration (ISSUE 19) ----------------------
+
+
+VIP = "10.96.0.10"
+
+
+def nat_pkts(n, base=0, rx_if=1):
+    """Forward flows to the service VIP: DNAT'd on the owner, so each
+    distinct flow leaves a live NAT session behind."""
+    return make_packet_vector(
+        [{"src": f"10.9.{(base + i) // 200}.{(base + i) % 200 + 1}",
+          "dst": VIP, "proto": 6,
+          "sport": 1000 + (base + i) % 50000, "dport": 80,
+          "rx_if": rx_if, "ttl": 64}
+         for i in range(n)], n=n)
+
+
+def natsess_live(dp) -> int:
+    return int(jnp.sum(dp.tables.natsess_valid))
+
+
+class TestNatColdstarts:
+    """Range migration moves the reflective session table but NOT the
+    NAT table (NAT state keys on the post-NAT pair): the flows left
+    behind are COUNTED exactly (``nat_coldstarts``), and the new owner
+    re-establishes them from the mapping tables within one window."""
+
+    def _fleet_with_nat(self):
+        from vpp_tpu.pipeline.vector import ip4
+
+        dps, st = build_fleet(["gw0", "gw1"])
+        for dp in dps.values():
+            with dp.commit_lock:
+                dp.builder.set_nat_mapping(
+                    0, ip4(VIP), 80, 6,
+                    [(ip4("10.1.1.2"), 80, 1)], boff=0)
+                dp.swap()
+        return dps, st
+
+    def test_migration_counts_and_conserves_nat_coldstarts(self):
+        dps, st = self._fleet_with_nat()
+        _drive(st, pack_pv(nat_pkts(240)))
+        per = {n: natsess_live(d) for n, d in dps.items()}
+        assert per["gw0"] > 0 and per["gw1"] > 0, per
+        assert st.stats_snapshot()["nat_coldstarts"] == 0
+
+        st.rebalance({r: "gw1" for r in range(st.n_ranges)})
+        # exact conservation: the counter is precisely the live NAT
+        # sessions the source held in moved ranges — no more, no less
+        assert st.stats_snapshot()["nat_coldstarts"] == per["gw0"]
+
+        # re-established within one steering window: the SAME flows
+        # re-driven all steer to the new owner, DNAT again from the
+        # mapping tables, and nothing goes unattributed
+        pump = _drive(st, pack_pv(nat_pkts(240)))
+        snap = pump.stats_snapshot()
+        assert snap["delivered"].get("gw1", 0) == 240
+        assert snap["aux"]["gw1"]["rx"] == 240
+        assert natsess_live(dps["gw1"]) >= per["gw0"]
+
+    def test_coldstart_counter_exported(self):
+        from vpp_tpu.stats.collector import STATS_PATH, StatsCollector
+
+        dps, st = self._fleet_with_nat()
+        pump = _drive(st, pack_pv(nat_pkts(120)))
+        st.rebalance({r: "gw0" for r in range(st.n_ranges)})
+        cold = st.stats_snapshot()["nat_coldstarts"]
+        assert cold > 0
+        coll = StatsCollector(dps["gw0"])
+        coll.set_fleet(st, pump)
+        coll.publish()
+        text = coll.registry.render(STATS_PATH)
+        line = [l for l in text.splitlines()
+                if l.startswith("vpp_tpu_fleet_nat_coldstarts_total")]
+        assert line and float(line[0].split()[-1]) == float(cold)

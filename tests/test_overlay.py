@@ -1,0 +1,339 @@
+"""Fused-step VXLAN overlay (ISSUE 19): decap at ip4-input, encap at
+tx, outer FIB, per-tenant VNI admission — differential against the
+host-side RFC 7348 byte oracle (``encode_frame``/``decode_frame``).
+
+The pact under test: the overlay rides INSIDE the one jitted step
+(knob-gated ``overlay: off|vxlan``, exactly one new step-form
+dimension, zero io_callbacks), an overlay-ADDRESSED frame that cannot
+be admitted fails CLOSED (DROP_OVERLAY), and the on-device outer
+header is bit-exact with what the byte codec would put on the wire.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vpp_tpu.ops.vxlan import (
+    DEFAULT_VNI,
+    ENCAP_OVERHEAD,
+    OUTER_TTL,
+    VXLAN_PORT,
+    decode_frame,
+    encode_frame,
+)
+from vpp_tpu.pipeline.dataplane import Dataplane
+from vpp_tpu.pipeline.graph import DROP_OVERLAY
+from vpp_tpu.pipeline.tables import DataplaneConfig
+from vpp_tpu.pipeline.vector import (
+    Disposition,
+    FLAG_VALID,
+    PacketVector,
+    ip4,
+    make_packet_vector,
+)
+
+VTEP_A = ip4("192.168.16.1")   # this node
+VTEP_B = ip4("192.168.16.2")   # remote peer
+
+
+def mk_dp(**over):
+    base = dict(
+        max_tables=2, max_rules=8, max_global_rules=8, max_ifaces=8,
+        fib_slots=32, sess_slots=512, nat_mappings=2, nat_backends=4,
+        overlay="vxlan",
+    )
+    base.update(over)
+    dp = Dataplane(DataplaneConfig(**base))
+    up = dp.add_uplink()
+    pod = dp.add_pod_interface(("default", "a"))
+    dp.set_vtep(VTEP_A)
+    dp.builder.add_route("10.1.1.0/24", pod, Disposition.LOCAL)
+    # remote pod subnet behind peer VTEP (inner FIB) + the VTEP
+    # underlay route the OUTER header resolves through
+    dp.builder.add_route("10.2.0.0/16", up, Disposition.REMOTE,
+                         next_hop=VTEP_B, node_id=2)
+    dp.builder.add_route("192.168.16.0/24", up, Disposition.REMOTE)
+    dp.swap()
+    return dp, up, pod
+
+
+def vxlan_lanes(up, specs):
+    """Outer + inner + vni sidecar vectors from per-lane specs:
+    (inner_src, inner_dst, sport, vni) or None for a plain lane
+    filled by the caller."""
+    n = len(specs)
+    outer = make_packet_vector(
+        [{"src": "192.168.16.2", "dst": "192.168.16.1", "proto": 17,
+          "sport": 49152 + i, "dport": VXLAN_PORT, "ttl": OUTER_TTL,
+          "len": 128 + ENCAP_OVERHEAD, "rx_if": up}
+         for i in range(n)], n=n)
+    inner = make_packet_vector(
+        [{"src": s[0], "dst": s[1], "proto": 6, "sport": s[2],
+          "dport": 80, "ttl": 64, "len": 128, "rx_if": up}
+         for s in specs], n=n)
+    vni = jnp.asarray(np.array([s[3] for s in specs], np.int32))
+    return outer, inner, vni
+
+
+class TestStepOverlay:
+    def test_decap_forward_reencap_roundtrip(self):
+        """A VXLAN frame for a remote pod transits: decap at
+        ip4-input, inner FIB to the peer, re-encap at tx with the
+        outer resolved through the OUTER FIB walk."""
+        dp, up, pod = mk_dp()
+        outer, inner, vni = vxlan_lanes(up, [
+            ("10.9.0.2", "10.1.1.5", 40000, DEFAULT_VNI),  # deliver
+            ("10.9.0.3", "10.2.1.5", 40001, DEFAULT_VNI),  # transit
+            ("10.9.0.4", "10.1.1.5", 40002, 999),          # bad VNI
+        ])
+        r = dp.process(outer, now=1, ovl_inner=inner, ovl_vni=vni)
+        s = r.stats
+        assert int(s.ovl_decap) == 2
+        assert int(s.drop_overlay) == 1
+        disp = np.asarray(r.disp)
+        assert disp[0] == int(Disposition.LOCAL)
+        assert disp[1] == int(Disposition.REMOTE)
+        assert disp[2] == int(Disposition.DROP)
+        assert int(np.asarray(r.drop_cause)[2]) == DROP_OVERLAY
+        # decapped inner rides the step in place: post-step headers
+        # are the INNER tuple
+        assert int(r.pkts.dst_ip[0]) == ip4("10.1.1.5")
+        assert int(r.pkts.dst_ip[1]) == ip4("10.2.1.5")
+        # transit lane re-encapped toward the peer VTEP
+        assert bool(np.asarray(r.ovl_encap)[1])
+        assert int(r.ovl_outer.dst_ip[1]) == VTEP_B
+        assert int(r.ovl_outer.src_ip[1]) == VTEP_A
+        assert int(r.ovl_vni[1]) == DEFAULT_VNI
+        assert int(r.ovl_vni[0]) == -1 and int(r.ovl_vni[2]) == -1
+
+    def test_encap_bit_exact_vs_byte_oracle(self):
+        """Device-built outer headers survive the host byte codec
+        round trip bit-exact: encode_frame(device outer, device inner)
+        → decode_frame → every field equals what the device holds."""
+        dp, up, pod = mk_dp()
+        pkts = make_packet_vector(
+            [{"src": f"10.1.1.{2 + i}", "dst": f"10.2.3.{2 + i}",
+              "proto": 6, "sport": 41000 + 977 * i, "dport": 80,
+              "ttl": 64, "len": 200, "rx_if": pod}
+             for i in range(8)], n=8)
+        r = dp.process(pkts, now=1)
+        enc = np.asarray(r.ovl_encap)
+        assert enc[:8].all()
+        for i in range(8):
+            outer = {
+                "src": int(r.ovl_outer.src_ip[i]),
+                "dst": int(r.ovl_outer.dst_ip[i]),
+                "sport": int(r.ovl_outer.sport[i]),
+                "ttl": int(r.ovl_outer.ttl[i]),
+            }
+            inner = {
+                "src": int(r.pkts.src_ip[i]),
+                "dst": int(r.pkts.dst_ip[i]),
+                "proto": int(r.pkts.proto[i]),
+                "ttl": int(r.pkts.ttl[i]),
+                "sport": int(r.pkts.sport[i]),
+                "dport": int(r.pkts.dport[i]),
+            }
+            wire = encode_frame(outer, inner, vni=int(r.ovl_vni[i]))
+            o, in_, vni, _ = decode_frame(wire)
+            assert o["src"] == VTEP_A and o["dst"] == VTEP_B
+            assert o["sport"] == outer["sport"]
+            assert o["dport"] == VXLAN_PORT
+            assert o["ttl"] == OUTER_TTL
+            assert vni == int(r.ovl_vni[i]) == DEFAULT_VNI
+            for k in ("src", "dst", "proto", "ttl", "sport", "dport"):
+                assert in_[k] == inner[k], (i, k)
+
+    def test_decap_differential_vs_oracle_mask(self):
+        """Random lane mix (framed good/bad-VNI/wrong-port/not-ours +
+        plain remote): the device admission mask equals the NumPy
+        oracle applying the RFC 7348 checks the byte codec enforces."""
+        rng = np.random.default_rng(19)
+        dp, up, pod = mk_dp()
+        n = 64
+        kind = rng.integers(0, 5, n)  # 0 good 1 badvni 2 badport
+        #                               3 not-ours 4 plain
+        o_dst = np.where(kind == 3, ip4("192.168.16.7"),
+                         VTEP_A).astype(np.uint32)
+        o_dport = np.where(kind == 2, 5789, VXLAN_PORT)
+        o_proto = np.where(kind == 4, 6, 17)
+        vni = np.where(kind == 1, 999, DEFAULT_VNI).astype(np.int32)
+        outer = PacketVector(
+            src_ip=jnp.full((n,), VTEP_B, jnp.uint32),
+            dst_ip=jnp.asarray(o_dst),
+            proto=jnp.asarray(o_proto.astype(np.int32)),
+            sport=jnp.asarray(
+                (49152 + rng.integers(0, 16384, n)).astype(np.int32)),
+            dport=jnp.asarray(o_dport.astype(np.int32)),
+            ttl=jnp.full((n,), OUTER_TTL, jnp.int32),
+            pkt_len=jnp.full((n,), 178, jnp.int32),
+            rx_if=jnp.full((n,), up, jnp.int32),
+            flags=jnp.full((n,), FLAG_VALID, jnp.int32),
+        )
+        inner = PacketVector(
+            src_ip=jnp.asarray(
+                (ip4("10.9.0.0")
+                 + rng.integers(2, 250, n)).astype(np.uint32)),
+            dst_ip=jnp.asarray(
+                (ip4("10.2.1.0")
+                 + rng.integers(2, 250, n)).astype(np.uint32)),
+            proto=jnp.full((n,), 6, jnp.int32),
+            sport=jnp.asarray(
+                (1024 + rng.integers(0, 50000, n)).astype(np.int32)),
+            dport=jnp.full((n,), 80, jnp.int32),
+            ttl=jnp.full((n,), 64, jnp.int32),
+            pkt_len=jnp.full((n,), 128, jnp.int32),
+            rx_if=jnp.full((n,), up, jnp.int32),
+            flags=jnp.full((n,), FLAG_VALID, jnp.int32),
+        )
+        r = dp.process(outer, now=1, ovl_inner=inner,
+                       ovl_vni=jnp.asarray(vni))
+        # oracle: addressed iff UDP/4789 to OUR vtep; admitted iff the
+        # VNI names a tenant (single-tenant map: DEFAULT_VNI only)
+        addressed = (o_proto == 17) & (o_dport == VXLAN_PORT) \
+            & (o_dst == VTEP_A)
+        admit = addressed & (vni == DEFAULT_VNI)
+        fail_closed = addressed & ~admit
+        assert int(r.stats.ovl_decap) == int(admit.sum())
+        assert int(r.stats.drop_overlay) == int(fail_closed.sum())
+        disp = np.asarray(r.disp)
+        assert (disp[fail_closed] == int(Disposition.DROP)).all()
+        assert (np.asarray(r.drop_cause)[fail_closed]
+                == DROP_OVERLAY).all()
+        # admitted lanes carry the INNER tuple through the step
+        got_dst = np.asarray(r.pkts.dst_ip)
+        assert (got_dst[admit] == np.asarray(inner.dst_ip)[admit]).all()
+        # unaddressed lanes are untouched plain traffic
+        plain = ~addressed
+        assert (got_dst[plain] == o_dst[plain]).all()
+
+    def test_unparseable_framing_fails_closed_like_the_oracle(self):
+        """The bad-UDP edge: a frame TO the VTEP the host codec cannot
+        parse arrives with the no-framing sidecar (vni -1) — the codec
+        raises, the device drops it OVERLAY-attributed. Both reject."""
+        wire = bytearray(encode_frame(
+            {"src": VTEP_B, "dst": VTEP_A},
+            {"src": ip4("10.9.0.2"), "dst": ip4("10.1.1.5"),
+             "proto": 6, "sport": 40000, "dport": 80}))
+        wire[22] = 0x01  # corrupt the UDP dst port bytes
+        wire[23] = 0x02
+        with pytest.raises(ValueError):
+            decode_frame(bytes(wire))
+        dp, up, pod = mk_dp()
+        outer = make_packet_vector(
+            [{"src": "192.168.16.2", "dst": "192.168.16.1",
+              "proto": 17, "sport": 50000, "dport": VXLAN_PORT,
+              "ttl": OUTER_TTL, "len": 178, "rx_if": up}])
+        r = dp.process(outer, now=1)  # default sidecar: vni -1
+        assert int(r.stats.drop_overlay) == 1
+        assert int(np.asarray(r.drop_cause)[0]) == DROP_OVERLAY
+        # probe() synthesizes the same fail-closed sidecar
+        rp = dp.probe(outer, now=2)
+        assert int(rp.stats.drop_overlay) == 1
+
+    def test_overlay_off_identity(self):
+        """overlay=off IS the baseline: bit-exact verdicts vs a
+        dataplane that never heard of the knob, no overlay sidecar in
+        the result, overlay counters pinned at zero."""
+        dp_off, up, pod = mk_dp(overlay="off")
+        base = Dataplane(DataplaneConfig(
+            max_tables=2, max_rules=8, max_global_rules=8,
+            max_ifaces=8, fib_slots=32, sess_slots=512,
+            nat_mappings=2, nat_backends=4))
+        base.add_uplink()
+        bpod = base.add_pod_interface(("default", "a"))
+        base.builder.add_route("10.1.1.0/24", bpod, Disposition.LOCAL)
+        base.builder.add_route("10.2.0.0/16", up, Disposition.REMOTE,
+                               next_hop=VTEP_B, node_id=2)
+        base.builder.add_route("192.168.16.0/24", up,
+                               Disposition.REMOTE)
+        base.swap()
+        pkts = make_packet_vector(
+            [{"src": f"10.1.1.{5 + i}", "dst": f"10.2.3.{4 + i}",
+              "proto": 6, "sport": 40000 + i, "dport": 80,
+              "rx_if": pod} for i in range(8)], n=8)
+        r = dp_off.process(pkts, now=1)
+        rb = base.process(pkts, now=1)
+        assert r.ovl_outer is None
+        assert r.ovl_encap is None and r.ovl_vni is None
+        assert int(r.stats.ovl_decap) == 0
+        assert int(r.stats.ovl_encap) == 0
+        assert int(r.stats.drop_overlay) == 0
+        np.testing.assert_array_equal(np.asarray(r.disp),
+                                      np.asarray(rb.disp))
+        np.testing.assert_array_equal(np.asarray(r.tx_if),
+                                      np.asarray(rb.tx_if))
+        np.testing.assert_array_equal(np.asarray(r.pkts.dst_ip),
+                                      np.asarray(rb.pkts.dst_ip))
+        assert int(r.disp[0]) == int(Disposition.REMOTE)
+
+    def test_overlay_rejects_packed_forms(self):
+        """The overlay stage pair is the plain step's: the packed wire
+        forms refuse the knob loudly rather than silently skipping
+        decap (the sidecar has no packed lane yet)."""
+        dp, up, pod = mk_dp()
+        flat = np.zeros((5, 8), np.int32)
+        with pytest.raises(ValueError):
+            dp.process_packed(flat)
+
+
+class TestVniTenantMap:
+    def mk_tenant_dp(self):
+        dp, up, pod = mk_dp(tenancy="on", tenancy_tenants=4,
+                            sess_slots=1024)
+        dp.builder.set_tenant(1, prefixes=["10.61.0.0/16"], vni=100)
+        dp.builder.set_tenant(2, prefixes=["10.62.0.0/16"], vni=200)
+        dp.builder.add_route("10.61.1.0/24", pod, Disposition.LOCAL)
+        dp.builder.add_route("10.62.1.0/24", pod, Disposition.LOCAL)
+        dp.swap()
+        return dp, up, pod
+
+    def test_vni_names_the_tenant_on_device(self):
+        from vpp_tpu.tenancy.derive import vni_tenant
+
+        dp, up, pod = self.mk_tenant_dp()
+        vni = jnp.asarray(np.array([100, 200, 999, -1], np.int32))
+        tid, known = vni_tenant(dp.tables, vni)
+        assert np.asarray(tid)[:2].tolist() == [1, 2]
+        assert np.asarray(known).tolist() == [True, True, False,
+                                              False]
+
+    def test_wire_vni_overrides_address_derivation(self):
+        """Tenant isolation pact: the VNI that CARRIED the frame names
+        the tenant — a frame on tenant 2's VNI whose inner src sits in
+        tenant 1's prefix is admitted as tenant 2 (the wire is
+        authoritative; addresses can be spoofed)."""
+        dp, up, pod = self.mk_tenant_dp()
+        outer, inner, _ = vxlan_lanes(up, [
+            ("10.61.0.9", "10.61.1.5", 40000, 0),
+        ])
+        rx0 = dp.tenant_snapshot()["rx"].copy()
+        r = dp.process(outer, now=1, ovl_inner=inner,
+                       ovl_vni=jnp.asarray(np.array([200], np.int32)))
+        assert int(r.stats.ovl_decap) == 1
+        d = dp.tenant_snapshot()["rx"] - rx0
+        assert d[2] == 1, d
+        assert d[1] == 0, d
+
+    def test_unregistered_vni_fails_closed_per_tenant(self):
+        dp, up, pod = self.mk_tenant_dp()
+        outer, inner, _ = vxlan_lanes(up, [
+            ("10.61.0.9", "10.61.1.5", 40000, 0),
+            ("10.62.0.9", "10.62.1.5", 40001, 0),
+            ("10.61.0.9", "10.61.1.6", 40002, 0),
+        ])
+        vni = jnp.asarray(np.array([100, 200, 300], np.int32))
+        r = dp.process(outer, now=1, ovl_inner=inner, ovl_vni=vni)
+        assert int(r.stats.ovl_decap) == 2
+        assert int(r.stats.drop_overlay) == 1
+        disp = np.asarray(r.disp)
+        assert disp[0] == int(Disposition.LOCAL)
+        assert disp[1] == int(Disposition.LOCAL)
+        assert disp[2] == int(Disposition.DROP)
+        # default tenant 0 has no VNI under tenancy: DEFAULT_VNI is
+        # only auto-admitted in the tenancy-off single-tenant posture
+        r2 = dp.process(outer, now=2, ovl_inner=inner,
+                        ovl_vni=jnp.asarray(
+                            np.array([DEFAULT_VNI] * 3, np.int32)))
+        assert int(r2.stats.ovl_decap) == 0
+        assert int(r2.stats.drop_overlay) == 3

@@ -1,0 +1,332 @@
+"""Service NAT44 backend churn (ISSUE 19): sticky way fill, DNAT
+backend-pick stickiness across a rolling replacement, the
+``service.churn`` chaos point (a half-applied backend set never
+serves), and the incremental "svc" upload group (a one-row churn
+ships a few-KB blob, never the full planes).
+"""
+
+import numpy as np
+import pytest
+
+from vpp_tpu.ksr import model as m
+from vpp_tpu.pipeline.dataplane import Dataplane
+from vpp_tpu.pipeline.tables import DataplaneConfig, svc_capacity
+from vpp_tpu.pipeline.vector import (
+    Disposition,
+    ip4,
+    ip4_str,
+    make_packet_vector,
+)
+from vpp_tpu.service import ServiceConfigurator, ServiceProcessor
+from vpp_tpu.testing import faults
+
+VIP = "10.96.0.10"
+KEY = (ip4(VIP), 80, 6)
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    yield
+    faults.uninstall()
+
+
+def mk_svc_dp(**over):
+    base = dict(
+        max_tables=2, max_rules=8, max_global_rules=8, max_ifaces=8,
+        fib_slots=32, sess_slots=512, nat_mappings=2, nat_backends=4,
+        svc_vips=16, svc_backend_ways=8,
+    )
+    base.update(over)
+    dp = Dataplane(DataplaneConfig(**base))
+    up = dp.add_uplink()
+    pod = dp.add_pod_interface(("default", "web"))
+    dp.builder.add_route("10.1.1.0/24", pod, Disposition.LOCAL)
+    dp.builder.add_route("10.200.0.0/16", pod, Disposition.LOCAL)
+    dp.builder.add_route("0.0.0.0/0", up, Disposition.REMOTE)
+    dp.swap()
+    return dp, up, pod
+
+
+def backends(n, base=10):
+    return [(ip4(f"10.200.0.{base + j}"), 8080, 1) for j in range(n)]
+
+
+def vip_flows(n, rx_if, vip=VIP, seed=0):
+    return make_packet_vector(
+        [{"src": f"10.9.{(seed + i) // 200}.{(seed + i) % 200 + 1}",
+          "dst": vip, "proto": 6,
+          "sport": 1024 + (37 * (seed + i)) % 50000, "dport": 80,
+          "rx_if": rx_if, "ttl": 64}
+         for i in range(n)], n=n)
+
+
+class TestStickyFill:
+    def test_survivors_keep_their_ways_on_replacement(self):
+        """Roll one backend out of four: the six ways the survivors
+        own stay EXACTLY where they were; only the rolled backend's
+        two ways move, and both land on the replacement."""
+        dp, up, pod = mk_svc_dp()
+        bks = backends(4)
+        with dp.commit_lock:
+            dp.builder.set_service(*KEY, bks)
+        a0 = list(dp.builder.services[KEY]["assign"])
+        rolled = bks[3]
+        new = (ip4("10.200.0.99"), 8080, 1)
+        with dp.commit_lock:
+            dp.builder.set_service(*KEY, bks[:3] + [new])
+        a1 = list(dp.builder.services[KEY]["assign"])
+        survivors = {(b[0], b[1]) for b in bks[:3]}
+        moved = 0
+        for w in range(len(a0)):
+            if (a0[w][0], a0[w][1]) in survivors:
+                assert a1[w] == a0[w], (w, a0[w], a1[w])
+            else:
+                assert (a0[w][0], a0[w][1]) == (rolled[0], rolled[1])
+                assert (a1[w][0], a1[w][1]) == (new[0], new[1])
+                moved += 1
+        assert moved == 2  # 8 ways / 4 equal-weight backends
+
+    def test_weight_change_alone_never_evicts_by_endpoint(self):
+        """Re-staging the same endpoints with shifted weights reuses
+        every way a backend keeps under its new share — matched by
+        endpoint, so no way churns to a DIFFERENT survivor."""
+        dp, up, pod = mk_svc_dp()
+        bks = backends(2)
+        with dp.commit_lock:
+            dp.builder.set_service(*KEY, bks)          # 4 + 4 ways
+        a0 = list(dp.builder.services[KEY]["assign"])
+        heavier = [(bks[0][0], bks[0][1], 3), bks[1]]  # 6 + 2 ways
+        with dp.commit_lock:
+            dp.builder.set_service(*KEY, heavier)
+        a1 = list(dp.builder.services[KEY]["assign"])
+        for w in range(len(a0)):
+            if (a1[w][0], a1[w][1]) == (bks[1][0], bks[1][1]):
+                # every way backend 1 still owns is one it owned before
+                assert (a0[w][0], a0[w][1]) == (bks[1][0], bks[1][1])
+        # idempotent re-stage: byte-identical assignment
+        with dp.commit_lock:
+            dp.builder.set_service(*KEY, heavier)
+        assert list(dp.builder.services[KEY]["assign"]) == a1
+
+    def test_half_applied_rows_never_match(self):
+        """The padding-row guard: a VIP row only matches once its
+        whole backend set is staged (svc_bk_n > 0), and a refused
+        set leaves the previous one serving."""
+        dp, up, pod = mk_svc_dp()
+        with dp.commit_lock:
+            dp.builder.set_service(*KEY, backends(2))
+            with pytest.raises(ValueError, match="weight"):
+                dp.builder.set_service(*KEY, [
+                    (ip4("10.200.0.50"), 8080, 0)])
+            dp.swap()
+        r = dp.probe(vip_flows(16, up), now=1)
+        dsts = {ip4_str(d) for d in np.asarray(r.pkts.dst_ip)}
+        assert dsts <= {"10.200.0.10", "10.200.0.11"}
+        # padding rows (bk_n == 0) are inert on-device
+        assert int(np.asarray(dp.tables.svc_bk_n)[1:].sum()) == 0
+
+
+class TestDnatStickiness:
+    def test_flow_picks_sticky_across_backend_roll(self):
+        """256 flows through a 4-backend VIP, then one backend rolls:
+        every flow that picked a survivor keeps its EXACT backend,
+        every moved flow lands on the replacement, zero loss."""
+        dp, up, pod = mk_svc_dp()
+        bks = backends(4)
+        with dp.commit_lock:
+            dp.builder.set_service(*KEY, bks)
+            dp.swap()
+        flows = vip_flows(256, up)
+        r0 = dp.probe(flows, now=1)
+        picks0 = np.asarray(r0.pkts.dst_ip)
+        assert (np.asarray(r0.disp)
+                == int(Disposition.LOCAL)).all(), "zero loss before"
+        assert (picks0 != ip4(VIP)).all(), "every flow DNAT'd"
+        rolled_ip = bks[3][0]
+        new_ip = ip4("10.200.0.99")
+        with dp.commit_lock:
+            dp.builder.set_service(*KEY, bks[:3]
+                                   + [(new_ip, 8080, 1)])
+            dp.swap()
+        r1 = dp.probe(flows, now=2)
+        picks1 = np.asarray(r1.pkts.dst_ip)
+        assert (np.asarray(r1.disp)
+                == int(Disposition.LOCAL)).all(), "zero loss after"
+        on_survivor = picks0 != rolled_ip
+        np.testing.assert_array_equal(picks1[on_survivor],
+                                      picks0[on_survivor])
+        moved = ~on_survivor
+        assert moved.any(), "sample must cover the rolled backend"
+        assert (picks1[moved] == new_ip).all()
+
+    def test_add_backend_moves_only_freed_share(self):
+        """Scale-out churn: adding a backend moves only the ways the
+        rebalanced shares free up — surviving flows overwhelmingly
+        keep their pick (>= 1 - 1/n of them exactly sticky)."""
+        dp, up, pod = mk_svc_dp()
+        bks = backends(3)
+        with dp.commit_lock:
+            dp.builder.set_service(*KEY, bks)
+            dp.swap()
+        flows = vip_flows(256, up, seed=1000)
+        picks0 = np.asarray(dp.probe(flows, now=1).pkts.dst_ip)
+        with dp.commit_lock:
+            dp.builder.set_service(
+                *KEY, bks + [(ip4("10.200.0.40"), 8080, 1)])
+            dp.swap()
+        picks1 = np.asarray(dp.probe(flows, now=2).pkts.dst_ip)
+        kept = (picks0 == picks1).mean()
+        assert kept >= 0.6, kept  # 6 of 8 ways stay put
+        assert (picks1[picks0 != picks1]
+                == ip4("10.200.0.40")).all()
+
+
+class TestChurnChaos:
+    """The ``service.churn`` fault point through the REAL configurator
+    path: a crash mid-churn rolls the builder back, publishes nothing,
+    and the pre-churn backend set keeps serving every offered flow."""
+
+    def make_env(self):
+        dp, up, pod = mk_svc_dp()
+        cfg = ServiceConfigurator(dp, node_ips=[])
+        proc = ServiceProcessor(cfg, node_name="node-a")
+        return dp, up, cfg, proc
+
+    def web_service(self):
+        return m.Service(
+            name="web", namespace="default", cluster_ip=VIP,
+            external_traffic_policy="Cluster",
+            ports=[m.ServicePort(name="http", protocol="TCP",
+                                 port=80, target_port="http",
+                                 node_port=0)],
+        )
+
+    def web_endpoints(self, ips):
+        return m.Endpoints(
+            name="web", namespace="default",
+            subsets=[m.EndpointSubset(
+                addresses=[m.EndpointAddress(ip=i, node_name="node-b")
+                           for i in ips],
+                ports=[m.EndpointPort(name="http", port=8080,
+                                      protocol="TCP")],
+            )],
+        )
+
+    def test_crash_mid_churn_rolls_back_and_old_set_serves(self):
+        dp, up, cfg, proc = self.make_env()
+        proc.update_service(self.web_service())
+        proc.update_endpoints(self.web_endpoints(
+            ["10.200.0.10", "10.200.0.11"]))
+        flows = vip_flows(64, up, seed=500)
+        before = np.asarray(dp.probe(flows, now=1).pkts.dst_ip)
+        old_set = {ip4("10.200.0.10"), ip4("10.200.0.11")}
+        assert set(before.tolist()) <= old_set
+
+        t0 = dp.tables
+        reg0 = {k: list(e["members"])
+                for k, e in dp.builder.services.items()}
+        plan = faults.install(faults.FaultPlan(seed=19))
+        plan.inject("service.churn", after=0, times=1)
+        with pytest.raises(faults.FaultInjected):
+            proc.update_endpoints(self.web_endpoints(
+                ["10.200.0.10", "10.200.0.77"]))
+        # nothing published: same device epoch, registry rolled back
+        assert dp.tables is t0
+        assert {k: list(e["members"])
+                for k, e in dp.builder.services.items()} == reg0
+        # conservation + the half-applied guard: every offered flow
+        # still DNATs to the OLD set; the new backend never serves
+        during = dp.probe(flows, now=2)
+        picks = np.asarray(during.pkts.dst_ip)
+        assert (np.asarray(during.disp)
+                == int(Disposition.LOCAL)).all()
+        np.testing.assert_array_equal(picks, before)
+        assert ip4("10.200.0.77") not in set(picks.tolist())
+
+        # recovery: the SAME churn re-driven with the fault cleared
+        # converges, and only then does the replacement serve
+        faults.uninstall()
+        proc.update_endpoints(self.web_endpoints(
+            ["10.200.0.10", "10.200.0.77"]))
+        assert dp.tables is not t0
+        after = np.asarray(dp.probe(flows, now=3).pkts.dst_ip)
+        new_set = {ip4("10.200.0.10"), ip4("10.200.0.77")}
+        assert set(after.tolist()) <= new_set
+        assert ip4("10.200.0.11") not in set(after.tolist())
+        # sticky through the crash-and-retry: survivors keep flows
+        on_kept = before == ip4("10.200.0.10")
+        np.testing.assert_array_equal(after[on_kept], before[on_kept])
+
+    def test_delete_service_mid_churn_rolls_back_too(self):
+        dp, up, cfg, proc = self.make_env()
+        proc.update_service(self.web_service())
+        proc.update_endpoints(self.web_endpoints(["10.200.0.10"]))
+        t0 = dp.tables
+        plan = faults.install(faults.FaultPlan(seed=20))
+        plan.inject("service.churn", after=0, times=1)
+        with pytest.raises(faults.FaultInjected):
+            proc.delete_service("default", "web")
+        assert dp.tables is t0
+        assert KEY in dp.builder.services
+        r = dp.probe(vip_flows(8, up), now=1)
+        assert (np.asarray(r.pkts.dst_ip)
+                == ip4("10.200.0.10")).all(), "VIP still serves"
+        faults.uninstall()
+        cfg.resync(list(cfg.services.values()))
+        assert KEY not in dp.builder.services
+
+
+class TestIncrementalUpload:
+    def test_one_row_churn_ships_blob_only(self):
+        """The zero-reship pact at plane level: after a full 48-VIP
+        stage, rolling ONE backend ships a few-KB scatter blob —
+        zero full svc fields, zero ACL/ML/FIB/tenant bytes (device
+        arrays identity-carried) — and the on-device planes equal
+        the builder's host staging bit-exact."""
+        dp, up, pod = mk_svc_dp(svc_vips=64, fib_slots=64)
+        V, B = svc_capacity(dp.config)
+        assert V == 64 and B == 8
+        vips = [(ip4(f"10.96.{v // 250}.{2 + v % 250}"), 80, 6)
+                for v in range(48)]
+        with dp.commit_lock:
+            for v, key in enumerate(vips):
+                dp.builder.set_service(
+                    *key, [(ip4(f"10.200.{v}.10") + j, 8080, 1)
+                           for j in range(4)])
+            dp.swap()
+        full = dp.builder.svc_upload
+        assert full["blob_bytes"] == 0 and len(full["fields"]) == 7
+        pinned = (dp.tables.glb_src_net, dp.tables.acl_src_net,
+                  dp.tables.fib_prefix, dp.tables.tnt_vni)
+        with dp.commit_lock:
+            v = 7
+            dp.builder.set_service(
+                *vips[v], [(ip4(f"10.200.{v}.10") + j, 8080, 1)
+                           for j in range(3)]
+                + [(ip4("10.200.99.99"), 8080, 1)])
+            dp.swap()
+        up_rec = dp.builder.svc_upload
+        assert up_rec["fields"] == ()
+        assert 0 < up_rec["blob_bytes"] < 8192, up_rec
+        assert up_rec["blob_bytes"] < full["bytes"] / 4
+        now = (dp.tables.glb_src_net, dp.tables.acl_src_net,
+               dp.tables.fib_prefix, dp.tables.tnt_vni)
+        for a, b in zip(pinned, now):
+            assert a is b, "churn re-shipped a foreign plane"
+        # the scatter blob applied EXACTLY the host staging
+        for f, host in dp.builder.svc.items():
+            np.testing.assert_array_equal(
+                np.asarray(getattr(dp.tables, f)), host, err_msg=f)
+
+    def test_unchanged_restage_ships_nothing(self):
+        """Idempotent churn: re-staging an identical registry compiles
+        byte-identical rows, so the svc group ships NOTHING."""
+        dp, up, pod = mk_svc_dp()
+        with dp.commit_lock:
+            dp.builder.set_service(*KEY, backends(3))
+            dp.swap()
+        with dp.commit_lock:
+            dp.builder.set_service(*KEY, backends(3))
+            dp.swap()
+        up_rec = dp.builder.svc_upload
+        assert up_rec["fields"] == () and up_rec["blob_bytes"] == 0

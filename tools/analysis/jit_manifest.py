@@ -46,6 +46,11 @@ JIT_SITES = {
         "incremental per-slot FIB blob scatter (ISSUE 15): a route "
         "flap at the 1M-route regime ships a few-KB blob instead of "
         "9 full columns; memoized per block width",
+    ("vpp_tpu/pipeline/tables.py", "_svc_update_fn"):
+        "incremental svc-plane blob scatter (ISSUE 19): a rolling "
+        "backend replacement ships the changed VIP rows as one "
+        "few-KB packed blob — zero ACL/ML/FIB bytes; memoized per "
+        "(block width, backend ways)",
     ("vpp_tpu/parallel/cluster.py", "make_cluster_step"):
         "the SPMD cluster step (shard_map over the node mesh); built "
         "once per mesh by ClusterDataplane",
@@ -153,8 +158,17 @@ TRACED_ROOTS = {
     # mesh-sharded classify substitutions (parallel/cluster.py body)
     ("vpp_tpu/parallel/cluster.py", "sharded_global_classify"),
     ("vpp_tpu/parallel/cluster.py", "sharded_global_classify_mxu"),
-    # vxlan encap rides its own jit (Dataplane.encap_remote)
+    # vxlan encap rides its own jit (Dataplane.encap_remote) AND the
+    # overlay-gated step forms (ISSUE 19: graph._finish_step builds
+    # the outer header in-step); decap + the VNI→tenant map are traced
+    # into the same overlay step forms via the decap stage ahead of
+    # ip4-input — all through the ONE _jitted_step cache dimension
     ("vpp_tpu/ops/vxlan.py", "vxlan_encap"),
+    ("vpp_tpu/ops/vxlan.py", "vxlan_decap_step"),
+    ("vpp_tpu/tenancy/derive.py", "vni_tenant"),
+    # the svc DNAT consult (ISSUE 19) rides every step variant via
+    # ops/nat44.nat44_dnat (inert one-row gather when svc_vips == 0)
+    ("vpp_tpu/ops/nat44.py", "_svc_lookup"),
     # the tenant stage (ISSUE 14): derivation + token bucket +
     # accounting are traced into every tenancy-gated step variant via
     # graph._tenant_eval/_finish_step, and the tenant-sliced bucket

@@ -460,6 +460,94 @@ def _lpm_plane_problems() -> list:
     return problems
 
 
+def _svc_plane_problems() -> list:
+    """Service-LB / overlay plane invariants (ISSUE 19): stage a
+    representative service registry and validate the compiled svc_*
+    arrays — VIP rows sorted by (ip, port, proto), padding rows inert
+    (bk_n 0 and all-zero: a row must never serve before its whole
+    backend set is staged), every way of a live row carrying a
+    registered backend with way counts matching the weighted
+    largest-remainder targets — then roll one backend and require the
+    sticky fill to keep every surviving backend's ways. Also pins the
+    tenancy-off VNI→tenant plane shape the overlay decap admission
+    depends on (slot 0 = DEFAULT_VNI, everything else -1)."""
+    _repo_on_path()
+    import numpy as np
+
+    from vpp_tpu.ops.vxlan import DEFAULT_VNI
+    from vpp_tpu.pipeline.tables import DataplaneConfig, TableBuilder
+
+    problems = []
+    b = TableBuilder(DataplaneConfig(
+        max_tables=2, max_rules=8, max_global_rules=8, max_ifaces=8,
+        fib_slots=16, sess_slots=64, nat_mappings=2, nat_backends=4,
+        svc_vips=4, svc_backend_ways=8))
+    vip1, vip2 = 0x0A600001, 0x0A600002
+    bk = [0x0A000001, 0x0A000002, 0x0A000003, 0x0A000004, 0x0A000005]
+    b.set_service(vip2, 80, 6, [(bk[0], 8080, 1), (bk[1], 8080, 1)])
+    b.set_service(vip1, 443, 6,
+                  [(bk[2], 8443, 2), (bk[3], 8443, 1),
+                   (bk[4], 8443, 1)], self_snat=True)
+    svc = b.svc
+    n = len(b.services)
+    keys = list(zip(svc["svc_vip_ip"][:n].astype(np.int64).tolist(),
+                    svc["svc_vip_port"][:n].tolist(),
+                    svc["svc_vip_proto"][:n].tolist()))
+    if keys != sorted(keys):
+        problems.append(
+            "tables: svc VIP rows not sorted by (ip, port, proto)")
+    if (svc["svc_bk_n"][n:].any() or svc["svc_vip_ip"][n:].any()
+            or svc["svc_bk_ip"][n:].any()
+            or svc["svc_bk_port"][n:].any()):
+        problems.append(
+            "tables: svc padding rows past the live count are not "
+            "inert (a padding row could serve)")
+    order = sorted(b.services)
+    for r, key in enumerate(order):
+        e = b.services[key]
+        members = set((m[0], m[1]) for m in e["members"])
+        ways = set(zip(svc["svc_bk_ip"][r].astype(np.int64).tolist(),
+                       svc["svc_bk_port"][r].tolist()))
+        if not ways <= members:
+            problems.append(
+                f"tables: svc row {r} ways carry non-member backends")
+        if int(svc["svc_bk_n"][r]) != len(e["members"]):
+            problems.append(f"tables: svc row {r} bk_n desynced")
+    # weighted largest-remainder fill: vip1's weight-2 backend owns
+    # exactly half the ways (targets [4, 2, 2] over 8)
+    r1 = order.index((vip1, 443, 6))
+    row = svc["svc_bk_ip"][r1].astype(np.int64)
+    if int((row == bk[2]).sum()) != 4:
+        problems.append(
+            "tables: svc weighted fill wrong — weight-2 backend "
+            f"owns {int((row == bk[2]).sum())}/8 ways, expected 4")
+    # sticky replacement: roll vip2's second backend; the survivor
+    # must keep every way it owned (flows it serves never remap)
+    r2 = order.index((vip2, 80, 6))
+    before = svc["svc_bk_ip"][r2].astype(np.int64).copy()
+    b.set_service(vip2, 80, 6, [(bk[0], 8080, 1), (0x0A000009, 8080, 1)])
+    after = b.svc["svc_bk_ip"][r2].astype(np.int64)
+    survivor = before == bk[0]
+    if not (after[survivor] == bk[0]).all():
+        problems.append(
+            "tables: svc sticky fill moved a surviving backend's ways")
+    if not (after[~survivor] == 0x0A000009).all():
+        problems.append(
+            "tables: svc replaced backend's ways not handed to the "
+            "replacement")
+    # overlay admission plane (tenancy off): exactly DEFAULT_VNI maps
+    # (to tenant 0); any other VNI must fail closed at decap
+    if int(b.tnt["tnt_vni"][0]) != DEFAULT_VNI:
+        problems.append(
+            "tables: tenancy-off tnt_vni[0] is not DEFAULT_VNI — the "
+            "single-tenant overlay would admit nothing")
+    if (b.tnt["tnt_vni"][1:] != -1).any():
+        problems.append(
+            "tables: unconfigured tnt_vni slots are not -1 (stray "
+            "VNIs would be admitted)")
+    return problems
+
+
 def tables_lint() -> list:
     """Table-structure invariant pass (`--tables`): commit a
     representative rule set through a BV-enabled TableBuilder and
@@ -515,6 +603,7 @@ def tables_lint() -> list:
         problems += _bv_plane_problems(f"local[{slot}]", local, nrules,
                                        cfg.max_rules)
     problems += _lpm_plane_problems()
+    problems += _svc_plane_problems()
     # cross-implementation capacity constants
     for r in (cfg.max_rules, cfg.max_global_rules, 1024, 10240):
         ib, w, _pr = bv_capacity(r, True)

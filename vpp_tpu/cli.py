@@ -61,6 +61,8 @@ class DebugCLI:
             ("show", "mesh"): self.show_mesh,
             ("show", "partitions"): self.show_partitions,
             ("show", "nat44"): self.show_nat44,
+            ("show", "services"): self.show_services,
+            ("show", "overlay"): self.show_overlay,
             ("show", "trace"): self.show_trace,
             ("show", "errors"): self.show_errors,
             ("show", "fastpath"): self.show_fastpath,
@@ -101,7 +103,8 @@ class DebugCLI:
             "commands: show interface | show acl | show session | "
             "show sessions | show session-rules | show mesh | "
             "show partitions | "
-            "show nat44 | show fib | show trace | show errors | "
+            "show nat44 | show services | show overlay | "
+            "show fib | show trace | show errors | "
             "show fastpath | show kernels | show ml | show latency | "
             "show top-flows | "
             "show governor | show tenants | show io | show neighbors | "
@@ -481,6 +484,67 @@ class DebugCLI:
         if t is not None:
             n = int(np.asarray(t.natsess_valid).sum())
             lines.append(f"nat sessions: {n}")
+        return "\n".join(lines)
+
+    def show_services(self) -> str:
+        """The svc-plane registry (ISSUE 19): per-VIP backend sets
+        with their sticky hash-way spread, plus the last svc-group
+        upload record — the churn blob `overlay_bench` prices as
+        svc_churn_bytes."""
+        from vpp_tpu.pipeline.tables import svc_capacity
+
+        b = self.dp.builder
+        V, B = svc_capacity(b.config)
+        if V <= 0:
+            return "svc planes off (dataplane.svc_vips is 0)"
+        lines = [f"services: {len(b.services)}/{V} VIPs, "
+                 f"{B} backend ways each"]
+        for key in sorted(b.services):
+            e = b.services[key]
+            ways: dict = {}
+            for m in e["assign"]:
+                ways[(m[0], m[1])] = ways.get((m[0], m[1]), 0) + 1
+            snat = " self-snat" if e["self_snat"] else ""
+            lines.append(
+                f"  {ip4_str(key[0])}:{key[1]} proto {key[2]} -> "
+                f"{len(e['members'])} backends{snat}:")
+            for bip, bport, w in e["members"]:
+                lines.append(
+                    f"    {ip4_str(bip)}:{bport} weight {w} "
+                    f"ways {ways.get((bip, bport), 0)}/{B}")
+        up = b.svc_upload
+        if up:
+            lines.append(
+                "last churn: {:.2f} ms, {} B ({} fields + {} B "
+                "scatter blob)".format(
+                    float(up.get("ms", 0.0)), int(up.get("bytes", 0)),
+                    len(up.get("fields", ())),
+                    int(up.get("blob_bytes", 0))))
+        return "\n".join(lines)
+
+    def show_overlay(self) -> str:
+        """Overlay state (ISSUE 19): the step-form knob, this node's
+        VTEP, the on-device VNI -> tenant admission map, and the
+        overlay stage counters when a collector is attached."""
+        dp = self.dp
+        knob = getattr(dp.config, "overlay", "off")
+        lines = [f"overlay: {knob}"]
+        vtep = getattr(dp, "_vtep", None)
+        lines.append("vtep: " + (ip4_str(int(vtep)) if vtep is not None
+                                 else "(unset)"))
+        vni = np.asarray(dp.builder.tnt["tnt_vni"])
+        rows = [(int(t), int(v)) for t, v in enumerate(vni) if v >= 0]
+        if rows:
+            lines.append("vni -> tenant admission map:")
+            for t, v in rows:
+                lines.append(f"  vni {v} -> tenant {t}")
+        else:
+            lines.append("vni admission map: empty (all decap "
+                         "fails closed)")
+        if self.stats is not None:
+            totals = self.stats.totals_snapshot()
+            for k in ("ovl_decap", "ovl_encap", "drop_overlay"):
+                lines.append(f"{k:<14} {totals.get(k, 0):>12}")
         return "\n".join(lines)
 
     # route rows rendered without a prefix filter before the page

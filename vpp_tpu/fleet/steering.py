@@ -38,6 +38,18 @@ Fault points: ``fleet.steer`` fires per partition call;
 and once more before the commit — the chaos schedule in
 tests/test_fleet.py kills a migration at both seams and proves
 conservation + fencing hold through recovery.
+
+**NAT cold starts (ISSUE 19).** Only the reflective table migrates;
+NAT sessions key on the post-NAT pair and stay behind (the PR-18
+limitation, docs/FLEET.md). Every migration now COUNTS the flows
+that limitation touches: the NAT session extras carry the full
+pre-NAT tuple, so :meth:`_nat_coldstarts_in_range` reconstructs each
+live NAT session's steering bucket exactly and tallies the ones in
+the moved range into ``stats["nat_coldstarts"]`` →
+``vpp_tpu_fleet_nat_coldstarts_total``. Those flows keep flowing —
+the destination re-establishes their NAT state from the mapping
+tables within its first windows (tests/test_fleet_coldstart.py
+bounds the re-establishment and proves conservation through it).
 """
 
 from __future__ import annotations
@@ -52,6 +64,7 @@ from vpp_tpu.fleet.hashring import (
     assign_ranges,
     buckets_of_packed,
     buckets_per_range,
+    canon_mix_np,
     moved_ranges,
     range_span,
 )
@@ -127,7 +140,7 @@ class FleetSteering:
             "offered": 0, "fenced_drops": 0, "no_owner_drops": 0,
             "rebalances": 0, "migrated_ranges": 0,
             "migrated_sessions": 0, "recovered_ranges": 0,
-            "epoch_max": 0,
+            "nat_coldstarts": 0, "epoch_max": 0,
             "steered": {n: 0 for n in self._names},
         }
 
@@ -264,6 +277,49 @@ class FleetSteering:
                 self.stats["rebalances"] += 1
             return len(moved)
 
+    def _nat_coldstarts_in_range(self, dp, start: int,
+                                 n_buckets: int) -> int:
+        """Count the source's live NAT sessions whose flow steers into
+        bucket range ``[start, start+n)`` — exactly the flows the new
+        owner will have to NAT-re-establish (the migration moves only
+        the reflective table). The NAT extras columns carry the full
+        PRE-NAT tuple (orig src/dst/ports), so each session's steering
+        bucket is recomputed host-side with the same sym canonical mix
+        ``buckets_of_packed`` uses; a control-plane-rate full-column
+        fetch, never on the packet path. Tenant-sliced steering
+        (partition with tenant_ids) re-bases buckets per tenant;
+        this count uses the unsliced mix and is exact for the
+        un-sliced fleets the bench and tests run."""
+        import jax
+
+        with dp._lock:
+            tables = dp.tables
+            if tables is None:
+                return 0
+            now = max(dp._now, dp.clock_ticks())
+        cols = jax.device_get((tables.natsess_valid,
+                               tables.natsess_time,
+                               tables.natsess_src_ip,
+                               tables.natsess_sport,
+                               tables.natsess_orig_ip,
+                               tables.natsess_orig_port,
+                               tables.natsess_proto,
+                               tables.sess_max_age))
+        valid, t, src_ip, sport, dst_ip, dport, proto, max_age = (
+            np.asarray(c) for c in cols)
+        live = (valid.ravel() == 1) & (now - t.ravel() <= int(max_age))
+        if not live.any():
+            return 0
+        mix = canon_mix_np(
+            src_ip.ravel().astype(np.uint32),
+            dst_ip.ravel().astype(np.uint32),
+            sport.ravel().astype(np.uint32) & np.uint32(0xFFFF),
+            dport.ravel().astype(np.uint32) & np.uint32(0xFFFF),
+            proto.ravel().astype(np.uint32) & np.uint32(0xFF))
+        b = (mix & np.uint32(self.n_buckets - 1)).astype(np.int64)
+        return int((live & (b >= start)
+                    & (b < start + n_buckets)).sum())
+
     def _migrate(self, rid: int, src: str, dst: str) -> None:
         """One range's move: fence → drain → adopt → commit → release.
         Raises through on injected/real faults, leaving the range
@@ -286,6 +342,8 @@ class FleetSteering:
                                            start, n)
         adopted = adopt_bucket_range(self.instances[dst], cols, start,
                                      now_src)
+        coldstarts = self._nat_coldstarts_in_range(
+            self.instances[src], start, n)
         faults.fire("fleet.migrate")
         if not self.membership.commit_range(rid, epoch, dst):
             raise RuntimeError(
@@ -297,8 +355,10 @@ class FleetSteering:
         with self._lock:
             self.stats["migrated_ranges"] += 1
             self.stats["migrated_sessions"] += int(adopted)
-        log.info("range %d migrated %s -> %s (%d sessions, epoch %d)",
-                 rid, src, dst, adopted, epoch)
+            self.stats["nat_coldstarts"] += coldstarts
+        log.info("range %d migrated %s -> %s (%d sessions, epoch %d, "
+                 "%d nat coldstarts)",
+                 rid, src, dst, adopted, epoch, coldstarts)
 
     def recover(self) -> int:
         """Complete migrations that died mid-move: every FENCED range
@@ -325,11 +385,14 @@ class FleetSteering:
                 start, n = range_span(rid, self.n_buckets,
                                       self.n_ranges)
                 adopted = 0
+                coldstarts = 0
                 if src in self.instances:
                     cols, now_src = drain_bucket_range(
                         self.instances[src], start, n)
                     adopted = adopt_bucket_range(
                         self.instances[dst], cols, start, now_src)
+                    coldstarts = self._nat_coldstarts_in_range(
+                        self.instances[src], start, n)
                 if not self.membership.commit_range(rid, epoch, dst):
                     log.warning("range %d recovery commit superseded "
                                 "(epoch %d)", rid, epoch)
@@ -343,6 +406,7 @@ class FleetSteering:
                 with self._lock:
                     self.stats["migrated_ranges"] += 1
                     self.stats["migrated_sessions"] += int(adopted)
+                    self.stats["nat_coldstarts"] += coldstarts
                     self.stats["recovered_ranges"] += 1
                 done += 1
                 log.info("range %d recovered %s -> %s "

@@ -72,6 +72,29 @@ def _dnat_lookup(
     return matched, m_idx
 
 
+def _svc_lookup(
+    tables: DataplaneTables, pkts: PacketVector
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Service-VIP match against the ``svc_*`` planes (ISSUE 19):
+    (matched [P], v_idx [P] VIP row). Match key is the exact
+    (dst_ip, dport, proto) triple — service rows are always
+    port-exact — and a row with ``svc_bk_n == 0`` NEVER matches:
+    that is both the padding-row guard and the half-applied-set
+    guard (TableBuilder stages bk_n last, so a torn view either
+    serves the old set or nothing, never a mix). VIP rows are
+    staged sorted and duplicate-free (one row per VIP triple), so
+    first-hit argmax is exact."""
+    hit = (
+        (tables.svc_vip_ip[None, :] == pkts.dst_ip[:, None])
+        & (tables.svc_vip_port[None, :] == pkts.dport[:, None])
+        & (tables.svc_vip_proto[None, :] == pkts.proto[:, None])
+        & (tables.svc_bk_n[None, :] > 0)
+    )
+    matched = jnp.any(hit, axis=1)
+    v_idx = jnp.argmax(hit, axis=1)
+    return matched, v_idx
+
+
 def nat44_dnat_match(
     tables: DataplaneTables, pkts: PacketVector, eligible: jnp.ndarray
 ) -> jnp.ndarray:
@@ -79,9 +102,12 @@ def nat44_dnat_match(
     probe (no rewrite, no backend pick) — the fast/slow dispatch
     predicate (pipeline/graph.py) uses it to keep DNAT state changes
     off the classify-free fast path. O(P·M) over the dense mapping
-    table, a rounding error next to the rule classify it gates."""
+    table (plus O(P·V) over the service-VIP rows — same ISSUE-19
+    planes ``nat44_dnat`` consults), a rounding error next to the
+    rule classify it gates."""
     matched, _ = _dnat_lookup(tables, pkts)
-    return matched & eligible
+    svc_matched, _ = _svc_lookup(tables, pkts)
+    return (matched | svc_matched) & eligible
 
 
 def nat44_dnat(
@@ -119,9 +145,30 @@ def nat44_dnat(
 
     new_dst = jnp.where(matched, tables.natb_ip[b_idx], pkts.dst_ip)
     new_dport = jnp.where(matched, tables.natb_port[b_idx], pkts.dport)
-    out = pkts._replace(dst_ip=new_dst, dport=new_dport)
     self_snat = matched & (tables.nat_self_snat[m_idx] == 1)
-    return out, matched, self_snat
+
+    # Service backend sets (ISSUE 19): the sticky-filled [V, WAYS]
+    # columns. The pick is ONE gather at flow_hash & (WAYS-1) — the
+    # way assignment (not the hash) carries the weights, and the
+    # PR-15-style sticky fill means a backend replacement moves only
+    # the ways it must, so in-flight flows keep their surviving
+    # backend with no session-table dependence. A svc row WINS over a
+    # legacy dense mapping for the same VIP (the svc planes are the
+    # churn-optimized representation; configs stage a VIP in one or
+    # the other, never both — service/configurator.py).
+    svc_raw, v_idx = _svc_lookup(tables, pkts)
+    svc_matched = svc_raw & eligible
+    ways = tables.svc_bk_ip.shape[1]  # power of two (validated)
+    way = (_flow_hash(pkts) & jnp.uint32(ways - 1)).astype(jnp.int32)
+    new_dst = jnp.where(svc_matched, tables.svc_bk_ip[v_idx, way],
+                        new_dst)
+    new_dport = jnp.where(svc_matched, tables.svc_bk_port[v_idx, way],
+                          new_dport)
+    self_snat = jnp.where(svc_matched,
+                          tables.svc_vip_snat[v_idx] == 1, self_snat)
+
+    out = pkts._replace(dst_ip=new_dst, dport=new_dport)
+    return out, matched | svc_matched, self_snat
 
 
 def nat44_snat(

@@ -119,6 +119,58 @@ def vxlan_decap(
     )
 
 
+def vxlan_decap_step(tables, pkts: PacketVector, inner: PacketVector,
+                     vni: jnp.ndarray):
+    """Fused-step decap stage (ISSUE 19): the ip4-input half of the
+    overlay stage pair, run INSIDE the jitted pipeline step (graph.py
+    routes every tier through it when ``overlay: vxlan``).
+
+    ``pkts`` is the outer vector as received; ``inner``/``vni`` are the
+    per-packet inner-header sidecar the host IO edge parsed off the
+    wire (``decode_frame`` framing; ``vni`` -1 = no VXLAN framing
+    found). A frame is overlay-ADDRESSED when the outer header is
+    UDP to the VXLAN port at this node's VTEP address
+    (``tables.ovl_vtep_ip``; 0 = unconfigured wildcard, the
+    single-NIC dev posture). Addressed frames re-admit their inner
+    vector in place when the VNI names a configured tenant
+    (tenancy/derive.py ``vni_tenant`` — the on-device VNI → tenant
+    map) and the inner sidecar is valid; anything else addressed
+    fails CLOSED (``bad`` — graph.py attributes DROP_OVERLAY). The
+    re-admitted inner keeps the outer's rx interface, like a decapped
+    packet re-entering the graph on the tunnel interface.
+
+    Returns ``(pkts', bad [P], decapped [P], tid [P] int32)`` —
+    ``tid`` is the VNI-named tenant where decapped, 0 elsewhere
+    (graph._tenant_eval overrides the address derivation with it).
+    """
+    # lazy: tenancy.derive imports tables (no cycle at module load)
+    from vpp_tpu.tenancy.derive import vni_tenant
+
+    vtep = tables.ovl_vtep_ip
+    addressed = (
+        pkts.valid
+        & (pkts.proto == 17)
+        & (pkts.dport == VXLAN_PORT)
+        & ((pkts.dst_ip == vtep) | (vtep == jnp.uint32(0)))
+    )
+    tid, known = vni_tenant(tables, vni)
+    ok = addressed & known & inner.valid
+    bad = addressed & ~ok
+    out = PacketVector(
+        src_ip=jnp.where(ok, inner.src_ip, pkts.src_ip).astype(jnp.uint32),
+        dst_ip=jnp.where(ok, inner.dst_ip, pkts.dst_ip).astype(jnp.uint32),
+        proto=jnp.where(ok, inner.proto, pkts.proto).astype(jnp.int32),
+        sport=jnp.where(ok, inner.sport, pkts.sport).astype(jnp.int32),
+        dport=jnp.where(ok, inner.dport, pkts.dport).astype(jnp.int32),
+        ttl=jnp.where(ok, inner.ttl, pkts.ttl).astype(jnp.int32),
+        pkt_len=jnp.where(ok, inner.pkt_len,
+                          pkts.pkt_len).astype(jnp.int32),
+        rx_if=pkts.rx_if,
+        flags=pkts.flags,
+    )
+    return out, bad, ok, jnp.where(ok, tid, 0).astype(jnp.int32)
+
+
 # --- byte-level wire codec (host side, for the NIC/native-ring edge) ---
 # RFC 7348 framing: outer IPv4 | outer UDP | VXLAN | inner Ethernet |
 # inner IPv4 | inner L4. The inner Ethernet header is mandatory on the

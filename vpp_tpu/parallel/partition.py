@@ -173,6 +173,19 @@ PARTITION_RULES: Tuple[PartitionRule, ...] = (
     PartitionRule(r"^(nat_|natb_)", P(NODE_AXIS),
                   "NAT mappings/backends: per-node service config, "
                   "replicated-by-design"),
+    # service LB planes + overlay config (ISSUE 19): [V]/[V, B] VIP
+    # rows and the VTEP scalar are per-node service/tunnel config.
+    # Replicated along the rule axis BY DESIGN — the flow-hash backend
+    # pick needs every row's whole way table on every shard (the
+    # nat_/natb_ rationale); partition_lint() hard-errors a svc_ field
+    # that ever resolves rule-sharded.
+    PartitionRule(r"^svc_", P(NODE_AXIS),
+                  "service VIP rows + backend way tables: per-node "
+                  "service config, replicated-by-design along the "
+                  "rule axis (the backend pick gathers whole rows)"),
+    PartitionRule(r"^ovl_", P(NODE_AXIS),
+                  "overlay config scalars (local VTEP): per-node "
+                  "tunnel config, replicated-by-design"),
     PartitionRule(r"^tel_", P(NODE_AXIS),
                   "telemetry planes: cluster node configs keep the "
                   "knob off (placeholder shapes), replicated-by-design"),
@@ -282,6 +295,21 @@ def partition_lint() -> List[str]:
                 f"partitions: tenant plane {f!r} resolves rule-sharded "
                 f"({rule.pattern!r}) — tenant vectors must replicate "
                 "along the rule axis (docs/TENANCY.md)")
+    # service-plane hard errors (ISSUE 19): the flow-hash backend pick
+    # gathers a VIP row's WHOLE way table — a rule-sharded svc plane
+    # would hand each shard a different backend subset and silently
+    # split one flow's pick across members.
+    for f in DataplaneTables._fields:
+        if not f.startswith("svc_"):
+            continue
+        rule = match_partition_rules(f)
+        if rule is None:
+            continue  # already reported as unmatched above
+        if any(ax == RULE_AXIS for ax in rule.spec if ax is not None):
+            problems.append(
+                f"partitions: service plane {f!r} resolves rule-sharded "
+                f"({rule.pattern!r}) — svc planes must replicate along "
+                "the rule axis (docs/OVERLAY.md)")
     if not problems:
         entries = spec_manifest()
         for ax in (NODE_AXIS, RULE_AXIS):

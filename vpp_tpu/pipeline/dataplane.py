@@ -359,10 +359,11 @@ def _step_label(impl: str, skip_local: bool, fast: bool, form: str,
                 tel_mode: str = "off", tnt_mode: str = "off",
                 fib_impl: str = "dense",
                 sess_impl: str = "gather",
-                sess_hash: str = "fwd") -> str:
+                sess_hash: str = "fwd",
+                overlay: str = "off") -> str:
     from vpp_tpu.pipeline.graph import SWEEP_STRIDE_DEFAULT
 
-    return "{}{}{}{}{}{}{}{}{}{}_{}".format(
+    return "{}{}{}{}{}{}{}{}{}{}{}_{}".format(
         impl, "_nolocal" if skip_local else "", "_auto" if fast else "",
         ("" if ml_mode == "off"
          else f"_ml{ml_mode}"
@@ -372,6 +373,7 @@ def _step_label(impl: str, skip_local: bool, fast: bool, form: str,
         "" if fib_impl == "dense" else f"_fib{fib_impl}",
         "" if sess_impl == "gather" else f"_sess{sess_impl}",
         "" if sess_hash == "fwd" else f"_h{sess_hash}",
+        "" if overlay == "off" else f"_o{overlay}",
         ("" if sweep_stride == SWEEP_STRIDE_DEFAULT
          else f"_sw{sweep_stride}"),
         f"{form}{ring_slots}" if form == "ring" else form)
@@ -478,22 +480,33 @@ def _jitted_step(impl: str, skip_local: bool, fast: bool, form: str,
                  ml_mode: str = "off", ml_kind: str = "mlp",
                  tel_mode: str = "off", tnt_mode: str = "off",
                  fib_impl: str = "dense", sess_impl: str = "gather",
-                 sess_hash: str = "fwd"):
+                 sess_hash: str = "fwd", overlay: str = "off"):
     from vpp_tpu.pipeline.graph import SWEEP_STRIDE_DEFAULT
 
     if sweep_stride is None:
         sweep_stride = SWEEP_STRIDE_DEFAULT
+    if overlay != "off" and form != "plain":
+        # The packed [5, B]/ring/chain boundaries carry no lane for the
+        # overlay's inner-vector sidecar (or the outer result pair);
+        # the overlay rides the plain step form only — the documented
+        # CPU-harness caveat (docs/OVERLAY.md). Widening the packed
+        # layout is future work, not a silent misdecode.
+        raise ValueError(
+            f"overlay={overlay!r} supports only the plain step form "
+            f"(the packed/ring boundaries carry no inner-header "
+            f"sidecar); got form {form!r}")
     key = (impl, skip_local, fast, form, sweep_stride, ring_slots,
            ml_mode, ml_kind, tel_mode, tnt_mode, fib_impl, sess_impl,
-           sess_hash)
+           sess_hash, overlay)
     step = _JIT_STEPS.get(key)
     if step is None:
         fn = make_pipeline_step(impl, skip_local, fast, sweep_stride,
                                 ml_mode, ml_kind, tel_mode, tnt_mode,
-                                fib_impl, sess_impl, sess_hash)
+                                fib_impl, sess_impl, sess_hash, overlay)
         label = _step_label(impl, skip_local, fast, form, sweep_stride,
                             ring_slots, ml_mode, ml_kind, tel_mode,
-                            tnt_mode, fib_impl, sess_impl, sess_hash)
+                            tnt_mode, fib_impl, sess_impl, sess_hash,
+                            overlay)
         if form == "plain":
             step = jax.jit(_counting(label, fn))
         elif form == "packed":
@@ -700,6 +713,13 @@ class Dataplane:
         # direction-invariantly so the fleet steering tier can map
         # packets to bucket ranges from outside the dataplane.
         self._sess_hash = getattr(self.config, "sess_hash", "fwd")
+        # Device-resident VXLAN overlay stage pair (ISSUE 19): a pure
+        # config gate like telemetry — the svc/overlay planes are
+        # config-static shapes, and an overlay-on dataplane with no
+        # VTEP/VNI staged only fail-closes overlay-ADDRESSED frames
+        # (UDP:4789), so there is no staged state to re-gate on. ONE
+        # extra step-form dimension, plain form only (_jitted_step).
+        self._overlay = getattr(self.config, "overlay", "off")
         # optional Prometheus histogram (stats/collector.py): observes
         # the fib-group upload cost of every swap that actually
         # re-shipped FIB state (vpp_tpu_fib_churn_commit_seconds)
@@ -930,9 +950,13 @@ class Dataplane:
     # --- VXLAN edge (cluster-boundary peers; TPU↔TPU rides ICI instead) ---
     def set_vtep(self, vtep_ip: int) -> None:
         """Set this node's VXLAN tunnel endpoint address (the reference's
-        per-node vxlanCIDR IP, plugins/contiv/ipam computeVxlanIPAddress)."""
+        per-node vxlanCIDR IP, plugins/contiv/ipam computeVxlanIPAddress).
+        Also stages the device-resident copy (``ovl_vtep_ip``) the fused
+        overlay stage pair reads (ISSUE 19) — published at the next
+        swap(), like every staged mutation."""
         with self._lock:
             self._vtep = jnp.uint32(vtep_ip)
+            self.builder.set_vtep_ip(vtep_ip)
 
     def encap_remote(self, result: StepResult) -> PacketVector:
         """Outer-header vector for REMOTE-disposed packets of a step —
@@ -1205,7 +1229,7 @@ class Dataplane:
         stride = self._sweep_stride
         gates = (self._ml_mode, self._ml_kind, self._tel_mode,
                  self._tnt_mode, self._fib_impl, self._session_impl,
-                 self._sess_hash)
+                 self._sess_hash, self._overlay)
         if (skip
                 and (self._classifier_impl, skip, fast, form, stride,
                      0) + gates not in _JIT_STEPS
@@ -1219,7 +1243,8 @@ class Dataplane:
                             tnt_mode=self._tnt_mode,
                             fib_impl=self._fib_impl,
                             sess_impl=self._session_impl,
-                            sess_hash=self._sess_hash)
+                            sess_hash=self._sess_hash,
+                            overlay=self._overlay)
 
     def time_classifier(self, batch: int = 256, iters: int = 10) -> float:
         """Diagnostic: time the SELECTED global classifier in isolation
@@ -1265,7 +1290,17 @@ class Dataplane:
         either way). Call under ``_lock``."""
         return self._get_step(self._use_fastpath, "plain")
 
-    def process(self, pkts: PacketVector, now: Optional[int] = None) -> StepResult:
+    def process(self, pkts: PacketVector, now: Optional[int] = None,
+                ovl_inner: Optional[PacketVector] = None,
+                ovl_vni=None) -> StepResult:
+        """Run one packet vector through the fused step. With the
+        overlay on (``config.overlay: vxlan``), ``ovl_inner``/
+        ``ovl_vni`` are the host-IO-parsed inner-header sidecar for
+        VXLAN-framed ingress ([P] inner PacketVector + [P] int32 VNI,
+        -1 = no VXLAN framing on that lane); None synthesizes the
+        all-unframed sidecar, under which any overlay-ADDRESSED frame
+        fails closed (DROP_OVERLAY) — exactly what an unparseable
+        VXLAN frame must do."""
         with self._lock:
             if self.tables is None:
                 raise RuntimeError(
@@ -1280,7 +1315,15 @@ class Dataplane:
                 # explicitly-supplied test timestamps from going backward)
                 self._now = max(self._now, self.clock_ticks())
                 now = self._now
-        result = step(tables, pkts, jnp.int32(now))
+        if self._overlay != "off":
+            if ovl_vni is None:
+                ovl_vni = jnp.full(pkts.valid.shape, -1, jnp.int32)
+            if ovl_inner is None:
+                ovl_inner = pkts
+            result = step(tables, pkts, jnp.int32(now), ovl_inner,
+                          jnp.asarray(ovl_vni, jnp.int32))
+        else:
+            result = step(tables, pkts, jnp.int32(now))
         # Session-table mutations flow back into the live epoch (config
         # arrays are identical between result.tables and the staged ones
         # unless a swap happens, which re-grafts the session arrays).
@@ -1308,6 +1351,9 @@ class Dataplane:
             step = self._get_step(fast=False)
             if now is None:
                 now = max(self._now, self.clock_ticks())
+        if self._overlay != "off":
+            return step(tables, pkts, jnp.int32(now), pkts,
+                        jnp.full(pkts.valid.shape, -1, jnp.int32))
         return step(tables, pkts, jnp.int32(now))
 
     def process_packed(self, flat, now: Optional[int] = None,

@@ -13,7 +13,7 @@ statscollector (Prometheus) equivalent.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -102,9 +102,21 @@ class StepStats(NamedTuple):
     # congestion signal — a full slice contends only with itself)
     tnt_limited: jnp.ndarray            # int32 scalar
     tnt_qfail: jnp.ndarray              # int32 scalar
+    # device-resident VXLAN overlay stage pair (ISSUE 19; all 0 with
+    # ``overlay: off`` — the stage compiles out): frames decapped at
+    # ip4-input (inner vector re-admitted in place), frames encapped
+    # at tx (outer header built on-device, resolved by the second FIB
+    # walk), and overlay-ADDRESSED frames that failed validation
+    # (unknown/absent VNI, invalid inner framing — fail closed,
+    # attributed DROP_OVERLAY)
+    ovl_decap: jnp.ndarray              # int32 scalar
+    ovl_encap: jnp.ndarray              # int32 scalar
+    drop_overlay: jnp.ndarray           # int32 scalar
 
 
-# Per-packet drop attribution (error-drop counter analog).
+# Per-packet drop attribution (error-drop counter analog). Values must
+# stay < 16: the packed IO boundary carries the cause in a nibble
+# (pipeline/dataplane.py _packed_call output row 3).
 DROP_NONE = 0
 DROP_IP4 = 1        # ip4-input: TTL/length/bad interface
 DROP_ACL = 2        # policy deny
@@ -113,6 +125,8 @@ DROP_FIB = 4        # matched a drop route
 DROP_NAT = 5        # NAT fail-closed (port collision / un-NATable proto)
 DROP_ML = 6         # ML-stage enforce verdict (drop / rate-limited)
 DROP_TENANT = 7     # tenant token-bucket quota exceeded (ISSUE 14)
+DROP_OVERLAY = 8    # overlay fail-closed: VXLAN-addressed frame with a
+                    # bad/unknown VNI or invalid inner framing (ISSUE 19)
 
 DROP_CAUSE_NAMES = {
     DROP_NONE: "none",
@@ -123,6 +137,7 @@ DROP_CAUSE_NAMES = {
     DROP_NAT: "nat-drop",
     DROP_ML: "ml-drop",
     DROP_TENANT: "tenant-quota",
+    DROP_OVERLAY: "overlay-drop",
 }
 
 
@@ -146,6 +161,16 @@ class StepResult(NamedTuple):
                                # (the PacketTracer's ml-score node
                                # reads them; all-zero with the stage
                                # off — packed paths never fetch them)
+    # overlay stage pair outputs (ISSUE 19) — None with ``overlay:
+    # off`` (the gate is trace-time static, so both lax.cond tiers of
+    # the auto dispatcher agree on the pytree structure). ``ovl_outer``
+    # is the on-device-built outer header vector (valid exactly where
+    # ``ovl_encap``); the host IO edge serializes (outer, inner, vni)
+    # via ops/vxlan.encode_frame — no io_callback on the wire path.
+    ovl_outer: Optional[PacketVector] = None
+    ovl_encap: Optional[jnp.ndarray] = None   # bool [P] encapped at tx
+    ovl_vni: Optional[jnp.ndarray] = None     # int32 [P] wire VNI
+                                              # (-1 where not encapped)
 
 
 def _ingress(tables: DataplaneTables, pkts: PacketVector):
@@ -161,7 +186,8 @@ def _ingress(tables: DataplaneTables, pkts: PacketVector):
 
 
 def _tenant_eval(tables: DataplaneTables, pkts: PacketVector,
-                 alive: jnp.ndarray, now, tnt_mode: str):
+                 alive: jnp.ndarray, now, tnt_mode: str,
+                 ovl_tid=None, ovl_decapped=None):
     """The ONE copy of the tenant stage's stateful half (ISSUE 14),
     run EXACTLY ONCE per fused step (both pipeline tiers, and the
     two-tier dispatcher runs it ahead of the branch and hands the
@@ -171,7 +197,14 @@ def _tenant_eval(tables: DataplaneTables, pkts: PacketVector,
     and run the per-tenant token bucket. Returns ``(tid, dropped,
     tables')`` — ``tid`` is None with the stage compiled off (every
     consumer then takes its pre-tenancy path, and the zero ``dropped``
-    constant folds away)."""
+    constant folds away).
+
+    With the overlay stage on (ISSUE 19), ``ovl_tid``/``ovl_decapped``
+    carry the decap stage's VNI-named tenant: a decapped packet's
+    tenant IS its VNI's tenant (the on-device VNI ↔ tenant pact,
+    docs/OVERLAY.md) and the address derivation is overridden for
+    exactly those lanes — underlay addresses say nothing about the
+    inner flow's tenant."""
     # jax-ok: tnt_mode is a trace-time-static step-factory gate (a
     # Python string baked into the jit key), not a tracer branch
     if tnt_mode == "off":
@@ -179,6 +212,10 @@ def _tenant_eval(tables: DataplaneTables, pkts: PacketVector,
     from vpp_tpu.tenancy.derive import tenant_ids, tenant_limit
 
     tid = tenant_ids(tables, pkts)
+    # jax-ok: ovl_tid None-ness is trace-time static (the overlay gate
+    # decides it at step-factory time), not a tracer branch
+    if ovl_tid is not None:
+        tid = jnp.where(ovl_decapped, ovl_tid, tid)
     tables, dropped = tenant_limit(tables, tid, alive, now)
     return tid, dropped, tables
 
@@ -254,6 +291,10 @@ def _finish_step(
     tid=None,
     tnt_dropped=None,
     tnt_qfail=None,
+    overlay: str = "off",
+    fib_fn=fib_lookup_dense,
+    ovl_dropped=None,
+    ovl_decapped=None,
 ) -> StepResult:
     """Shared tail of both pipeline tiers: drop attribution, counters,
     StepStats and the StepResult assembly. The ONE copy of the
@@ -265,7 +306,60 @@ def _finish_step(
     ops/session.py session_sweep), so aging rides EVERY tier of the
     fused program identically — and the ONE place the heavy-hitter
     flow sketch (ops/telemetry.py; ``tel_mode`` "full", trace-time
-    static) folds the batch in, so both tiers feed the same sketch."""
+    static) folds the batch in, so both tiers feed the same sketch.
+    With ``overlay: vxlan`` (ISSUE 19) it is also the ONE place the
+    encap half of the overlay stage pair runs — both tiers build the
+    outer header and resolve it through the second FIB walk here."""
+    if ovl_dropped is None:
+        ovl_dropped = jnp.zeros(alive.shape, bool)
+    if ovl_decapped is None:
+        ovl_decapped = jnp.zeros(alive.shape, bool)
+    # --- overlay encap at tx (ISSUE 19): REMOTE-disposed packets with
+    # a tunnel next_hop get an on-device outer header (entropy sport
+    # from the inner 5-tuple — ops/vxlan.vxlan_encap) resolved by a
+    # SECOND walk over the SAME fib planes: the inner walk's ECMP
+    # group already spread tunnel endpoints on the flow hash
+    # (next_hop IS the chosen VTEP), the outer walk routes TO that
+    # endpoint. An unroutable endpoint folds into drop_no_route, fail
+    # closed. The outer walk is deliberately NOT fed into the
+    # per-member ECMP accounting below — the inner walk already
+    # attributed this packet to its group member; counting the
+    # outer-route group too would double-bill the plane.
+    # jax-ok: overlay is a trace-time-static step-factory gate (a
+    # Python string baked into the jit key), not a tracer branch
+    if overlay != "off":
+        from vpp_tpu.ops.vxlan import DEFAULT_VNI, vxlan_encap
+
+        ovl_need = (forwarded & (disp == int(Disposition.REMOTE))
+                    & (fib.next_hop != 0))
+        ovl_outer = vxlan_encap(pkts, ovl_need, tables.ovl_vtep_ip,
+                                fib.next_hop)
+        ofib = fib_fn(tables, ovl_outer)
+        ofib_ok = ofib.matched & (ofib.disp != int(Disposition.DROP))
+        ovl_miss = ovl_need & ~ofib_ok
+        forwarded = forwarded & ~ovl_miss
+        disp = jnp.where(ovl_miss, int(Disposition.DROP),
+                         disp).astype(jnp.int32)
+        ovl_encap = ovl_need & ofib_ok
+        tx_if = jnp.where(ovl_encap, ofib.tx_if,
+                          jnp.where(ovl_miss, -1, tx_if))
+        ovl_outer = ovl_outer._replace(
+            flags=jnp.where(ovl_encap, ovl_outer.flags, 0))
+        # per-tenant VNI on the wire: the tenant's configured VNI
+        # (tnt_vni — tenancy off keeps slot 0 at DEFAULT_VNI), with
+        # DEFAULT_VNI covering tenants that configured none
+        # jax-ok: tid None-ness is the trace-time-static tnt gate
+        if tid is not None:
+            vni_raw = tables.tnt_vni[tid]
+        else:
+            vni_raw = jnp.broadcast_to(tables.tnt_vni[0], alive.shape)
+        vni = jnp.where(vni_raw >= 0, vni_raw, DEFAULT_VNI)
+        ovl_vni_out = jnp.where(ovl_encap, vni, -1).astype(jnp.int32)
+    else:
+        ovl_miss = jnp.zeros(alive.shape, bool)
+        ovl_encap = jnp.zeros(alive.shape, bool)
+        ovl_outer = None
+        ovl_vni_out = None
     tables = session_sweep(tables, now, sweep_stride)
     # per-member ECMP accounting (ISSUE 15; ops/fib.py resolve): one
     # flat scatter-add of forwarded group-routed packets into the
@@ -293,7 +387,11 @@ def _finish_step(
         tnt_dropped = jnp.zeros(alive.shape, bool)
     if tnt_qfail is None:
         tnt_qfail = jnp.zeros(alive.shape, bool)
-    alive_all = alive | tnt_dropped
+    # overlay fail-closed lanes left ``alive`` right after ip4-input
+    # (the decap stage's bad mask) but were real received traffic —
+    # alive_all restores them for rx/per-interface counts exactly
+    # like the rate-limited lanes
+    alive_all = alive | tnt_dropped | ovl_dropped
     # jax-ok: tnt_mode is a trace-time-static step-factory gate (a
     # Python string baked into the jit key), not a tracer branch
     if tnt_mode != "off":
@@ -320,7 +418,8 @@ def _finish_step(
     # ml-drop wins attribution over the FIB outcomes (the packet never
     # reached forwarding), but LOSES to ACL deny: ml_dropped is
     # already masked to permitted traffic by the callers
-    drop_no_route = alive & permit & ~fib.matched & ~ml_dropped
+    drop_no_route = (alive & permit & ~fib.matched & ~ml_dropped
+                     | ovl_miss)
     fib_dropped = alive & permit & fib.matched & (
         fib.disp == int(Disposition.DROP)
     ) & ~ml_dropped
@@ -330,6 +429,7 @@ def _finish_step(
         | dropped_nat
         | ml_dropped
         | tnt_dropped
+        | ovl_dropped
     )
     rx_if_safe = jnp.where(alive_all, pkts.rx_if, n_ifaces)
     tx_if_safe = jnp.where(forwarded, tx_if, n_ifaces)
@@ -377,10 +477,15 @@ def _finish_step(
         tel_sketched=tel_sketched,
         tnt_limited=jnp.sum(tnt_dropped.astype(jnp.int32)),
         tnt_qfail=jnp.sum(tnt_qfail.astype(jnp.int32)),
+        ovl_decap=jnp.sum(ovl_decapped.astype(jnp.int32)),
+        ovl_encap=jnp.sum(ovl_encap.astype(jnp.int32)),
+        drop_overlay=jnp.sum(ovl_dropped.astype(jnp.int32)),
     )
     # attribution stays exclusive: tnt_dropped packets left ``alive``
     # right after the tenant stage, so every other cause mask (all
-    # derived from alive/permit/forwarded) excludes them
+    # derived from alive/permit/forwarded) excludes them; ovl_dropped
+    # lanes likewise left right after ip4-input (and exclude the
+    # drop_ip4 lanes — the decap stage masks them out)
     drop_cause = (
         jnp.where(pkts.valid & drop_ip4, DROP_IP4, 0)
         + jnp.where(drop_acl, DROP_ACL, 0)
@@ -389,6 +494,7 @@ def _finish_step(
         + jnp.where(dropped_nat, DROP_NAT, 0)
         + jnp.where(ml_dropped, DROP_ML, 0)
         + jnp.where(tnt_dropped, DROP_TENANT, 0)
+        + jnp.where(ovl_dropped, DROP_OVERLAY, 0)
     ).astype(jnp.int32)
     return StepResult(
         pkts=pkts,
@@ -404,6 +510,9 @@ def _finish_step(
         snat_applied=snat_applied,
         ml_flagged=ml_flagged,
         ml_scores=ml_scores,
+        ovl_outer=ovl_outer,
+        ovl_encap=ovl_encap if overlay != "off" else None,
+        ovl_vni=ovl_vni_out,
     )
 
 
@@ -429,6 +538,9 @@ def pipeline_step(
     sess_impl: str = "gather",
     sess_hash: str = "fwd",
     shard=None,
+    overlay: str = "off",
+    ovl_inner=None,
+    ovl_vni=None,
     _tnt_pre=None,
 ) -> StepResult:
     """Process one packet vector through the full forwarding chain.
@@ -447,9 +559,37 @@ def pipeline_step(
     bucket grids and ML weight planes as rule-axis shards: the session
     ops hash globally and recombine with psums, so the chain's
     per-packet results stay bit-exact vs standalone (docs/PARTITIONING.md).
+
+    ``overlay: vxlan`` (ISSUE 19) engages the fused overlay stage
+    pair: decap runs HERE, ahead of ip4-input (the outer header plus
+    the host-parsed ``ovl_inner``/``ovl_vni`` sidecar — the inner
+    vector is re-admitted in place, fail-closed lanes leave ``alive``
+    attributed DROP_OVERLAY), and encap runs at tx inside the shared
+    tail. Trace-time static like every other gate — ONE step-form
+    dimension in the jit cache, zero io_callbacks.
     """
+    # --- overlay decap at ip4-input (ISSUE 19) ---
+    # jax-ok: overlay is a trace-time-static step-factory gate (a
+    # Python string baked into the jit key), not a tracer branch
+    if overlay != "off":
+        from vpp_tpu.ops.vxlan import vxlan_decap_step
+
+        pkts, ovl_bad, ovl_decapped, ovl_tid = vxlan_decap_step(
+            tables, pkts, ovl_inner, ovl_vni)
+    else:
+        ovl_bad = ovl_decapped = ovl_tid = None
+
     # --- ip4-input (+ unconfigured-interface drop) ---
     pkts, drop_ip4, alive = _ingress(tables, pkts)
+    # jax-ok: same trace-time-static overlay gate as above
+    if overlay != "off":
+        # fail-closed overlay lanes leave here; ip4-input keeps
+        # attribution priority on lanes it already dropped (the outer
+        # header must parse before the decap verdict means anything)
+        ovl_dropped = ovl_bad & ~drop_ip4
+        alive = alive & ~ovl_dropped
+    else:
+        ovl_dropped = None
 
     # --- tenant stage (ISSUE 14): derive + token-bucket ONCE per step.
     # ``_tnt_pre`` is the two-tier dispatcher's pre-consumed trio (it
@@ -463,7 +603,9 @@ def pipeline_step(
         tid, tnt_dropped, tables = _tnt_pre
     else:
         tid, tnt_dropped, tables = _tenant_eval(tables, pkts, alive,
-                                                now, tnt_mode)
+                                                now, tnt_mode,
+                                                ovl_tid=ovl_tid,
+                                                ovl_decapped=ovl_decapped)
     alive = alive & ~tnt_dropped
     tnt = tnt_mode != "off"
 
@@ -576,6 +718,8 @@ def pipeline_step(
         # only meaningful with the stage on (the per-tenant congestion
         # signal); the off-state constant keeps the counter at 0
         tnt_qfail=(sess_fail | natsess_fail) if tnt else None,
+        overlay=overlay, fib_fn=fib_fn, ovl_dropped=ovl_dropped,
+        ovl_decapped=ovl_decapped,
     )
 
 
@@ -613,6 +757,9 @@ def _pipeline_fast_finish(
     shard=None,
     tid=None,
     tnt_dropped=None,
+    overlay: str = "off",
+    ovl_dropped=None,
+    ovl_decapped=None,
 ) -> StepResult:
     """Tail of the classify-free kernel, from post-reverse headers on.
 
@@ -679,6 +826,8 @@ def _pipeline_fast_finish(
         # the fast tier inserts nothing, so slice quota failures are
         # statically empty here (the all-False constant XLA folds)
         tnt_qfail=None,
+        overlay=overlay, fib_fn=fib_fn, ovl_dropped=ovl_dropped,
+        ovl_decapped=ovl_decapped,
     )
 
 
@@ -693,10 +842,13 @@ def pipeline_step_fast(
     sess_impl: str = "gather",
     sess_hash: str = "fwd",
     shard=None,
+    overlay: str = "off",
+    ovl_inner=None,
+    ovl_vni=None,
 ) -> StepResult:
     """The classify-free established-flow kernel, standalone:
-    ip4-input → session lookup/touch → NAT reverse/touch → [ML score]
-    → FIB → tx.
+    [overlay decap] → ip4-input → session lookup/touch → NAT
+    reverse/touch → [ML score] → FIB → tx [→ overlay encap].
 
     Bit-exact with ``pipeline_step`` ONLY when every valid packet hits
     a live reflective session and none DNAT-matches — the invariant
@@ -704,11 +856,27 @@ def pipeline_step_fast(
     its own for the differential test and the bench's speedup capture;
     production traffic goes through the auto dispatcher.
     """
+    # jax-ok: overlay is a trace-time-static step-factory gate (a
+    # Python string baked into the jit key), not a tracer branch
+    if overlay != "off":
+        from vpp_tpu.ops.vxlan import vxlan_decap_step
+
+        pkts, ovl_bad, ovl_decapped, ovl_tid = vxlan_decap_step(
+            tables, pkts, ovl_inner, ovl_vni)
+    else:
+        ovl_bad = ovl_decapped = ovl_tid = None
     pkts, drop_ip4, alive = _ingress(tables, pkts)
+    # jax-ok: same trace-time-static overlay gate as above
+    if overlay != "off":
+        ovl_dropped = ovl_bad & ~drop_ip4
+        alive = alive & ~ovl_dropped
+    else:
+        ovl_dropped = None
     # tenant stage first — the full-chain order, so the two tiers stay
     # bit-exact under the dispatch invariant with tenancy on too
     tid, tnt_dropped, tables = _tenant_eval(tables, pkts, alive, now,
-                                            tnt_mode)
+                                            tnt_mode, ovl_tid=ovl_tid,
+                                            ovl_decapped=ovl_decapped)
     alive = alive & ~tnt_dropped
     tnt = tnt_mode != "off"
     established, sess_hit_idx = session_lookup_reverse_idx(
@@ -723,7 +891,8 @@ def pipeline_step_fast(
         nat_reversed, nat_hit_idx, sweep_stride=sweep_stride,
         ml_mode=ml_mode, ml_kind=ml_kind, tel_mode=tel_mode,
         tnt_mode=tnt_mode, fib_fn=fib_fn, shard=shard, tid=tid,
-        tnt_dropped=tnt_dropped,
+        tnt_dropped=tnt_dropped, overlay=overlay,
+        ovl_dropped=ovl_dropped, ovl_decapped=ovl_decapped,
     )
 
 
@@ -742,9 +911,18 @@ def pipeline_step_auto(
     sess_impl: str = "gather",
     sess_hash: str = "fwd",
     shard=None,
+    overlay: str = "off",
+    ovl_inner=None,
+    ovl_vni=None,
 ) -> StepResult:
     """Two-tier dispatch: the fast kernel when the whole batch rides
     established sessions, the full chain otherwise.
+
+    With the overlay on (ISSUE 19) the decap stage runs ahead of the
+    predicate — established INNER flows ride the fast tier even when
+    they arrive encapped, which is exactly the east-west steady state
+    the tier exists for. The full branch re-derives from the pre-decap
+    vector (identical by construction, like the ingress masks).
 
     With tenancy on (ISSUE 14) the tenant stage runs HERE, ahead of
     the branch: token consumption is stateful and must happen exactly
@@ -779,11 +957,27 @@ def pipeline_step_auto(
     from jax import lax
 
     orig_pkts = pkts
+    # jax-ok: overlay is a trace-time-static step-factory gate (a
+    # Python string baked into the jit key), not a tracer branch
+    if overlay != "off":
+        from vpp_tpu.ops.vxlan import vxlan_decap_step
+
+        pkts, ovl_bad, ovl_decapped, ovl_tid = vxlan_decap_step(
+            tables, pkts, ovl_inner, ovl_vni)
+    else:
+        ovl_bad = ovl_decapped = ovl_tid = None
     pkts1, drop_ip4, alive = _ingress(tables, pkts)
+    # jax-ok: same trace-time-static overlay gate as above
+    if overlay != "off":
+        ovl_dropped = ovl_bad & ~drop_ip4
+        alive = alive & ~ovl_dropped
+    else:
+        ovl_dropped = None
     # tenant stage ONCE, ahead of the branch (docstring); tbl carries
     # the consumed token buckets into whichever tier wins
     tid, tnt_dropped, tbl = _tenant_eval(tables, pkts1, alive, now,
-                                         tnt_mode)
+                                         tnt_mode, ovl_tid=ovl_tid,
+                                         ovl_decapped=ovl_decapped)
     alive = alive & ~tnt_dropped
     tnt = tnt_mode != "off"
     hits, sess_hit_idx, all_hit = session_batch_summary(
@@ -810,19 +1004,23 @@ def pipeline_step_auto(
             nat_reversed, nat_hit_idx, sweep_stride=sweep_stride,
             ml_mode=ml_mode, ml_kind=ml_kind, tel_mode=tel_mode,
             tnt_mode=tnt_mode, fib_fn=fib_fn, shard=shard, tid=tid,
-            tnt_dropped=tnt_dropped,
+            tnt_dropped=tnt_dropped, overlay=overlay,
+            ovl_dropped=ovl_dropped, ovl_decapped=ovl_decapped,
         )
 
     def full(_):
-        # the full chain re-derives its own ingress masks from
-        # orig_pkts (identical by construction) but takes the
-        # ALREADY-CONSUMED tenant trio — tokens are never spent twice
+        # the full chain re-derives its own ingress masks (and the
+        # overlay decap) from orig_pkts (identical by construction)
+        # but takes the ALREADY-CONSUMED tenant trio — tokens are
+        # never spent twice
         return pipeline_step(tables, orig_pkts, now, acl_global_fn,
                              acl_local_fn, sweep_stride=sweep_stride,
                              ml_mode=ml_mode, ml_kind=ml_kind,
                              tel_mode=tel_mode, tnt_mode=tnt_mode,
                              fib_fn=fib_fn, sess_impl=sess_impl,
                              sess_hash=sess_hash, shard=shard,
+                             overlay=overlay, ovl_inner=ovl_inner,
+                             ovl_vni=ovl_vni,
                              _tnt_pre=((tid, tnt_dropped, tbl)
                                        if tnt else None))
 
@@ -887,7 +1085,8 @@ def make_pipeline_step(impl: str = "dense", skip_local: bool = False,
                        tel_mode: str = "off", tnt_mode: str = "off",
                        fib_impl: str = "dense",
                        sess_impl: str = "gather",
-                       sess_hash: str = "fwd"):
+                       sess_hash: str = "fwd",
+                       overlay: str = "off"):
     """Compose one pipeline-step callable from the epoch's gates:
     classifier implementation (dense | mxu | bv), the policy-free
     local-classify skip, the two-tier fast-path dispatch, the session
@@ -918,21 +1117,42 @@ def make_pipeline_step(impl: str = "dense", skip_local: bool = False,
         raise ValueError(f"unknown sess_impl {sess_impl!r}")
     if sess_hash not in ("fwd", "sym"):
         raise ValueError(f"unknown sess_hash {sess_hash!r}")
+    if overlay not in ("off", "vxlan"):
+        raise ValueError(f"unknown overlay {overlay!r}")
     acl_global_fn, acl_local_fn = _classifier_fns(impl)
     fib_fn = _fib_fn(fib_impl)
     if skip_local:
         acl_local_fn = acl_local_none
     base = pipeline_step_auto if fast else pipeline_step
 
-    def step(tables: DataplaneTables, pkts: PacketVector,
-             now: jnp.ndarray) -> StepResult:
-        return base(tables, pkts, now, acl_global_fn=acl_global_fn,
-                    acl_local_fn=acl_local_fn, sweep_stride=sweep_stride,
-                    ml_mode=ml_mode, ml_kind=ml_kind, tel_mode=tel_mode,
-                    tnt_mode=tnt_mode, fib_fn=fib_fn,
-                    sess_impl=sess_impl, sess_hash=sess_hash)
+    # jax-ok: overlay is trace-time static — it picks the step's CALL
+    # SIGNATURE (the overlay form takes the host-parsed inner/vni
+    # sidecar as explicit jit arguments), not a tracer branch
+    if overlay == "off":
+        def step(tables: DataplaneTables, pkts: PacketVector,
+                 now: jnp.ndarray) -> StepResult:
+            return base(tables, pkts, now, acl_global_fn=acl_global_fn,
+                        acl_local_fn=acl_local_fn,
+                        sweep_stride=sweep_stride,
+                        ml_mode=ml_mode, ml_kind=ml_kind,
+                        tel_mode=tel_mode,
+                        tnt_mode=tnt_mode, fib_fn=fib_fn,
+                        sess_impl=sess_impl, sess_hash=sess_hash)
+    else:
+        def step(tables: DataplaneTables, pkts: PacketVector,
+                 now: jnp.ndarray, ovl_inner: PacketVector,
+                 ovl_vni: jnp.ndarray) -> StepResult:
+            return base(tables, pkts, now, acl_global_fn=acl_global_fn,
+                        acl_local_fn=acl_local_fn,
+                        sweep_stride=sweep_stride,
+                        ml_mode=ml_mode, ml_kind=ml_kind,
+                        tel_mode=tel_mode,
+                        tnt_mode=tnt_mode, fib_fn=fib_fn,
+                        sess_impl=sess_impl, sess_hash=sess_hash,
+                        overlay=overlay, ovl_inner=ovl_inner,
+                        ovl_vni=ovl_vni)
 
-    step.__name__ = "pipeline_step_{}{}{}{}{}{}{}{}{}".format(
+    step.__name__ = "pipeline_step_{}{}{}{}{}{}{}{}{}{}".format(
         impl, "_nolocal" if skip_local else "", "_auto" if fast else "",
         "" if ml_mode == "off" else f"_ml{ml_mode}"
         + ("_forest" if ml_kind == "forest" else ""),
@@ -941,6 +1161,7 @@ def make_pipeline_step(impl: str = "dense", skip_local: bool = False,
         "" if fib_impl == "dense" else f"_fib{fib_impl}",
         "" if sess_impl == "gather" else f"_sess{sess_impl}",
         "" if sess_hash == "fwd" else f"_h{sess_hash}",
+        "" if overlay == "off" else f"_o{overlay}",
     )
     return step
 

@@ -183,6 +183,31 @@ class DataplaneConfig(NamedTuple):
     tenancy: str = "off"
     tenancy_tenants: int = 8          # tenant-id capacity (1..64)
     tenancy_prefixes: int = 64        # prefix-map slots
+    # Device-resident VXLAN overlay (ops/vxlan.py; ISSUE 19;
+    # docs/OVERLAY.md): "off" compiles the stage pair out entirely —
+    # the step programs are byte-identical to pre-overlay; "vxlan"
+    # decaps VTEP-addressed UDP/4789 frames at ip4-input (outer header
+    # + VNI validated on-device, the inner vector re-admitted in
+    # place, VNI → tenant handed to the tenancy derivation) and
+    # builds the per-destination-node outer header at tx (entropy
+    # sport from the inner 5-tuple, outer endpoint resolved by a
+    # SECOND walk over the same FIB planes — LPM/ECMP carry over
+    # unchanged). ONE new step-form dimension in the process-wide jit
+    # cache; zero io_callbacks on the wire path.
+    overlay: str = "off"
+    # Service NAT44 LB planes (ops/nat44.py svc path; ISSUE 19): VIP
+    # row capacity of the svc_* tables. 0 (default) carries [1, B]
+    # placeholders with bk_n 0 — rows that can never serve — and
+    # set_service is refused; the svc consult then costs one gather
+    # against a 1-row table. The planes ride their OWN "svc" upload
+    # group, so rolling backend churn ships a few-KB blob and ZERO
+    # ACL/ML/FIB bytes.
+    svc_vips: int = 0
+    # Backend ways per VIP row (power of two — the flow-hash backend
+    # pick masks with B-1). Way assignment is STICKY across backend
+    # churn (the set_nh_group fill), so a rolling replacement only
+    # remaps the ways it must.
+    svc_backend_ways: int = 8
 
 
 class DataplaneTables(NamedTuple):
@@ -441,6 +466,14 @@ class DataplaneTables(NamedTuple):
     # never re-ships the weight planes (ISSUE 14 satellite).
     glb_ml_tnt_mode: jnp.ndarray    # int32 [T]
     glb_ml_tnt_thresh: jnp.ndarray  # int32 [T]
+    # Direct VNI → tenant map (ISSUE 19 satellite: the overlay decap
+    # stage derives the tenant from the VALIDATED VNI on-device, so
+    # tunneled traffic no longer depends on inner-address prefixes).
+    # tnt_vni[t] is tenant t's VNI (-1 = none); a decapped VNI that
+    # maps to no tenant FAILS CLOSED (DROP_OVERLAY). Tenancy-off
+    # placeholder [1] carries DEFAULT_VNI so the single-tenant overlay
+    # admits VNI 10 and nothing else.
+    tnt_vni: jnp.ndarray        # int32 [T]
     # State half (TENANCY_STATE_FIELDS — carried by reference across
     # swaps like the sweep cursors; the persistent ring threads them
     # window-to-window): token-bucket level + last-refill tick, and
@@ -454,6 +487,34 @@ class DataplaneTables(NamedTuple):
                                 # drops
     tnt_qf_c: jnp.ndarray       # int32 [T] session-slice insert
                                 # failures attributed to the tenant
+
+    # --- VXLAN overlay config (ops/vxlan.py; ISSUE 19) --------------
+    # The node's local VTEP address; rides the tiny "config" upload
+    # group (one scalar — a VTEP move ships bytes, not planes). 0 =
+    # unset: decap then admits any VTEP-addressed UDP/4789 frame (the
+    # single-node test harness), encap still stamps it as outer src.
+    ovl_vtep_ip: jnp.ndarray    # uint32 scalar
+
+    # --- service NAT44 LB planes (ops/nat44.py svc path; ISSUE 19) --
+    # VIP rows sorted by (ip, port, proto) — the --tables invariant —
+    # with padding rows inert via svc_bk_n == 0 (a row with no staged
+    # backend set must NEVER serve: the half-applied-churn guard).
+    # Backend columns are WAY tables, member picked by the session
+    # flow hash (way = mix & (B-1)) with sticky weighted fill
+    # (set_service — the set_nh_group discipline), so backend churn
+    # only remaps the ways it must. Their OWN "svc" upload group: a
+    # rolling backend replacement ships a few-KB scatter blob and
+    # zero ACL/ML/FIB bytes (_upload_svc).
+    svc_vip_ip: jnp.ndarray     # uint32 [V] service VIP
+    svc_vip_port: jnp.ndarray   # int32 [V] service port (exact match)
+    svc_vip_proto: jnp.ndarray  # int32 [V] IANA proto
+    svc_vip_snat: jnp.ndarray   # int32 bool [V]: nodeport-style —
+                                # DNAT'd flows also SNAT (reply must
+                                # return via this node)
+    svc_bk_n: jnp.ndarray       # int32 [V] distinct backends (0 =
+                                # empty/padding row, never serves)
+    svc_bk_ip: jnp.ndarray      # uint32 [V, B] per-way backend IP
+    svc_bk_port: jnp.ndarray    # int32 [V, B] per-way backend port
 
 
 def _mask_of(plen: int, bits: int = 32) -> int:
@@ -666,6 +727,16 @@ def zero_fib_state_device(config: DataplaneConfig) -> Dict[str, jnp.ndarray]:
             for f, dt in FIB_STATE_FIELDS.items()}
 
 
+def svc_capacity(config: DataplaneConfig) -> Tuple[int, int]:
+    """(VIP rows V, backend ways B) of the service LB planes (ISSUE
+    19). svc_vips 0 carries a [1, B] placeholder whose single row has
+    bk_n 0 — it can never match, so the always-compiled svc consult is
+    one inert gather (no step-form dimension for the svc path)."""
+    b = int(getattr(config, "svc_backend_ways", 8))
+    v = int(getattr(config, "svc_vips", 0))
+    return (v if v > 0 else 1), b
+
+
 def _is_pow2(n: int) -> bool:
     return n > 0 and (n & (n - 1)) == 0
 
@@ -790,6 +861,19 @@ def validate_dataplane_config(config: DataplaneConfig) -> None:
     if not (1 <= s <= 1024):
         raise ValueError(
             f"dataplane.tenancy_prefixes must be in 1..1024, got {s}")
+    ovl = getattr(c, "overlay", "off")
+    if ovl not in ("off", "vxlan"):
+        raise ValueError(
+            f"dataplane.overlay must be off | vxlan, got {ovl!r}")
+    v = int(getattr(c, "svc_vips", 0))
+    if not (0 <= v <= 4096):
+        raise ValueError(
+            f"dataplane.svc_vips must be in 0..4096, got {v}")
+    b = int(getattr(c, "svc_backend_ways", 8))
+    if not _is_pow2(b) or b > 256:
+        raise ValueError(
+            f"dataplane.svc_backend_ways must be a power of two <= 256 "
+            f"(the flow-hash backend pick masks with B-1), got {b}")
 
 
 def ml_capacity(config: DataplaneConfig) -> Tuple[int, int, int, int]:
@@ -1156,7 +1240,7 @@ _UPLOAD_GROUPS: Dict[str, Tuple[str, ...]] = {
     "nat": ("nat_ext_ip", "nat_ext_port", "nat_proto", "nat_boff",
             "nat_bcnt", "nat_total_w", "nat_self_snat", "natb_ip",
             "natb_port", "natb_cumw", "nat_snat_ip"),
-    "config": ("sess_max_age",),
+    "config": ("sess_max_age", "ovl_vtep_ip"),
     # tenancy config half (ISSUE 14): its OWN group, so tenant churn
     # (a new prefix, a rate change, a per-tenant ML threshold flip)
     # ships a few hundred bytes and never re-ships rules or weights —
@@ -1166,7 +1250,15 @@ _UPLOAD_GROUPS: Dict[str, Tuple[str, ...]] = {
                "tnt_rate", "tnt_burst",
                "tnt_sess_base", "tnt_sess_mask",
                "tnt_nat_base", "tnt_nat_mask",
-               "glb_ml_tnt_mode", "glb_ml_tnt_thresh"),
+               "glb_ml_tnt_mode", "glb_ml_tnt_thresh", "tnt_vni"),
+    # service NAT44 LB planes (ISSUE 19): their OWN group so a rolling
+    # backend replacement ships ONLY svc bytes — every other group
+    # keeps its cached device-array identity (the zero-reship
+    # acceptance bench pins). Additionally rides the incremental
+    # scatter-blob path (_upload_svc): changed VIP rows confine to a
+    # block and ship as one few-KB blob.
+    "svc": ("svc_vip_ip", "svc_vip_port", "svc_vip_proto",
+            "svc_vip_snat", "svc_bk_n", "svc_bk_ip", "svc_bk_port"),
 }
 
 # Per-slot FIB row arrays (the dense kernel's columns + the shared
@@ -1199,6 +1291,49 @@ def _fib_update_fn(w: int):
             )
             out.append(lax.dynamic_update_slice(dev, piece, (lo,)))
         return out
+
+    return jax.jit(update)
+
+
+# Service-LB planes in VIP-row space (ISSUE 19): diffed together
+# against _svc_prev and scatter-updated on device as ONE packed blob
+# when a churn's changes confine to a row block (the _fib_incremental
+# scheme; the [V, B] way tables flatten into the blob row-major).
+_SVC_1D_FIELDS: Tuple[str, ...] = (
+    "svc_vip_ip", "svc_vip_port", "svc_vip_proto", "svc_vip_snat",
+    "svc_bk_n",
+)
+_SVC_2D_FIELDS: Tuple[str, ...] = ("svc_bk_ip", "svc_bk_port")
+
+
+@functools.lru_cache(maxsize=8)
+def _svc_update_fn(w: int, ways: int):
+    """Jitted incremental service-plane update for VIP-row-block width
+    ``w``: one packed int32 blob carries every svc array's changed row
+    block, one compiled program scatters the blocks into the cached
+    device arrays (traced start offset — no recompile per position).
+    Blob layout: [5 x w rows | 2 x w x B way rows]."""
+    import jax
+
+    def update(rows, grids, blob, lo):
+        from jax import lax
+
+        out_rows = []
+        for i, dev in enumerate(rows):
+            piece = lax.bitcast_convert_type(
+                blob[i * w:(i + 1) * w], dev.dtype
+            )
+            out_rows.append(lax.dynamic_update_slice(dev, piece, (lo,)))
+        base = len(rows) * w
+        out_grids = []
+        for i, dev in enumerate(grids):
+            piece = lax.bitcast_convert_type(
+                blob[base + i * w * ways:base + (i + 1) * w * ways],
+                dev.dtype,
+            ).reshape(w, ways)
+            out_grids.append(
+                lax.dynamic_update_slice(dev, piece, (lo, 0)))
+        return out_rows, out_grids
 
     return jax.jit(update)
 
@@ -1381,6 +1516,25 @@ class TableBuilder:
         self.natb_port = z(c.nat_backends, np.int32)
         self.natb_cumw = z(c.nat_backends, np.int32)
         self.nat_snat_ip = np.uint32(0)
+        # VXLAN overlay config (ISSUE 19): the node's local VTEP
+        # address, staged into the tiny "config" group.
+        self.ovl_vtep_ip = np.uint32(0)
+        # Service NAT44 LB staging (ISSUE 19): a normalized service
+        # registry (set_service) compiled into the "svc" upload-group
+        # arrays by _restage_svc — the tenant-registry pattern. Each
+        # entry keeps its sticky way ASSIGNMENT, keyed by the service
+        # key, so VIP-row moves from churn elsewhere never reshuffle a
+        # surviving service's backend picks.
+        self.services: Dict[Tuple[int, int, int], dict] = {}
+        self.svc: Dict[str, np.ndarray] = {}
+        self._restage_svc()
+        # svc incremental-upload state (the _fib_prev discipline):
+        # diff base of the last full device upload (None = next commit
+        # uploads full) + last-upload record for `show services` /
+        # overlay_bench's svc_churn_bytes.
+        self._svc_prev: Optional[Dict[str, np.ndarray]] = None
+        self.svc_upload: Dict[str, object] = {}
+        self.svc_last_shipped = False
         # Upload groups touched since the last to_device(): every field
         # of a clean group reuses the previous epoch's DEVICE array, so
         # a CNI add (fib+if dirty) doesn't re-upload the 10k-rule
@@ -1583,6 +1737,17 @@ class TableBuilder:
         nm = np.zeros(T, np.int32)
         mlm = np.zeros(T, np.int32)
         mlt = np.full(T, ML_TNT_THRESH_INHERIT, np.int32)
+        # VNI → tenant plane (ISSUE 19): tenant t's registered VNI or
+        # -1. Tenancy-off placeholder admits DEFAULT_VNI as tenant 0 so
+        # the single-tenant overlay works out of the box; every other
+        # VNI fails closed at decap.
+        from vpp_tpu.ops.vxlan import DEFAULT_VNI  # local: keeps the
+        # tables module importable without pulling the overlay ops in
+        # at module load (the sched-import discipline)
+
+        vni = np.full(T, -1, np.int32)
+        if getattr(c, "tenancy", "off") == "off":
+            vni[0] = DEFAULT_VNI
         slot = 0
         cursor = {"sess": sess_nb, "nat": nat_nb}
         sliced_tids = {"sess": set(), "nat": set()}
@@ -1614,6 +1779,8 @@ class TableBuilder:
             mlm[tid] = ML_MODE_CODES[e.get("ml_mode", "inherit")]
             if e.get("ml_thresh") is not None:
                 mlt[tid] = int(e["ml_thresh"])
+            if e.get("vni") is not None:
+                vni[tid] = int(e["vni"])
         # unsliced tenants (every tid not sliced above, tenant 0
         # included unless it registered a slice): base 0, masked to
         # the largest power of two inside the residual [0, cursor) so
@@ -1631,6 +1798,7 @@ class TableBuilder:
             "tnt_sess_base": sb, "tnt_sess_mask": sm,
             "tnt_nat_base": nb_, "tnt_nat_mask": nm,
             "glb_ml_tnt_mode": mlm, "glb_ml_tnt_thresh": mlt,
+            "tnt_vni": vni,
         }
 
     def set_tenant(self, tid: int, **kw) -> None:
@@ -2046,6 +2214,157 @@ class TableBuilder:
             self._rec.set_snat_ip(int(ip))
         self._mark("nat")
 
+    # --- VXLAN overlay + service LB (ISSUE 19; docs/OVERLAY.md) ---
+    def set_vtep_ip(self, ip: int) -> None:
+        """Set the node's local VTEP address (the overlay stage's
+        decap admission filter and encap outer source). Rides the tiny
+        "config" upload group — a VTEP move ships bytes, not planes."""
+        self.ovl_vtep_ip = np.uint32(ip)
+        if self._rec is not None:
+            self._rec.set_vtep_ip(int(ip))
+        self._mark("config")
+
+    def _restage_svc(self) -> None:
+        """Compile the service registry into the "svc" upload-group
+        arrays. VIP rows are sorted by (ip, port, proto) — the
+        --tables invariant — and padding rows stay all-zero with
+        bk_n 0, so they can never serve (the half-applied guard: a
+        row only matches once its whole backend set is staged).
+        Deterministic: the same registry always compiles
+        byte-identical arrays (the _restage_tenants discipline)."""
+        V, B = svc_capacity(self.config)
+        z = np.zeros
+        vip_ip = z(V, np.uint32)
+        vip_port = z(V, np.int32)
+        vip_proto = z(V, np.int32)
+        vip_snat = z(V, np.int32)
+        bk_n = z(V, np.int32)
+        bk_ip = z((V, B), np.uint32)
+        bk_port = z((V, B), np.int32)
+        for r, key in enumerate(sorted(self.services)):
+            e = self.services[key]
+            ip, port, proto = key
+            vip_ip[r] = ip
+            vip_port[r] = port
+            vip_proto[r] = proto
+            vip_snat[r] = int(e["self_snat"])
+            bk_n[r] = len(e["members"])
+            bk_ip[r] = np.array([m[0] for m in e["assign"]], np.uint32)
+            bk_port[r] = np.array([m[1] for m in e["assign"]], np.int32)
+        self.svc = {
+            "svc_vip_ip": vip_ip, "svc_vip_port": vip_port,
+            "svc_vip_proto": vip_proto, "svc_vip_snat": vip_snat,
+            "svc_bk_n": bk_n, "svc_bk_ip": bk_ip,
+            "svc_bk_port": bk_port,
+        }
+
+    def set_service(self, vip_ip: int, port: int, proto: int,
+                    backends: Sequence[Tuple[int, int, int]],
+                    self_snat: bool = False) -> None:
+        """Stage (or replace) one service VIP's backend set:
+        ``backends`` is a sequence of ``(ip, port, weight)`` tuples.
+        Way assignment is STICKY per service (the set_nh_group fill,
+        weighted by largest remainder): surviving backends keep the
+        ways they own up to their rebalanced share, so a rolling
+        replacement only remaps the flows it must. Validates
+        COMPLETELY before any staging mutates — a refused backend set
+        leaves the previous one serving, and a half-applied set can
+        never reach the device (the _fold_ml clean-refusal
+        contract)."""
+        c = self.config
+        if int(getattr(c, "svc_vips", 0)) <= 0:
+            raise ValueError(
+                "dataplane.svc_vips is 0 — the svc planes carry "
+                "placeholder shapes (raise the knob)")
+        V, B = svc_capacity(c)
+        if not (1 <= int(port) <= 65535):
+            raise ValueError(
+                f"service port must be in 1..65535 (exact match), "
+                f"got {port}")
+        key = (int(vip_ip) & 0xFFFFFFFF, int(port), int(proto))
+        mset = []
+        seen = set()
+        for m in backends:
+            bip, bport, w = int(m[0]), int(m[1]), int(m[2])
+            if w <= 0:
+                raise ValueError(
+                    f"backend weight must be > 0, got {w}")
+            if (bip, bport) not in seen:
+                seen.add((bip, bport))
+                mset.append((bip, bport, w))
+        if not mset:
+            raise ValueError(
+                "service needs at least one backend "
+                "(del_service removes a VIP)")
+        if len(mset) > B:
+            raise ValueError(
+                f"{len(mset)} distinct backends exceed "
+                f"svc_backend_ways {B}")
+        if key not in self.services and len(self.services) >= V:
+            raise ValueError(
+                f"service table full ({V} VIP rows — raise "
+                f"dataplane.svc_vips)")
+        prev = self.services.get(key)
+        prev_assign = list(prev["assign"]) if prev else [None] * B
+        # weighted way targets by largest remainder (deterministic:
+        # remainder ties break by member order)
+        total_w = sum(m[2] for m in mset)
+        raw = [B * m[2] / total_w for m in mset]
+        target = [int(r) for r in raw]
+        rest = B - sum(target)
+        order = sorted(range(len(mset)),
+                       key=lambda i: (-(raw[i] - target[i]), i))
+        for i in order[:rest]:
+            target[i] += 1
+        counts = [0] * len(mset)
+        assign_i: list = [None] * B
+        by_ep = {(m[0], m[1]): i for i, m in enumerate(mset)}
+        # pass 1: surviving backends keep their ways up to their share
+        # (matched by endpoint, so a weight change alone never evicts)
+        for w in range(B):
+            pm = prev_assign[w]
+            i = by_ep.get((pm[0], pm[1])) if pm is not None else None
+            if i is not None and counts[i] < target[i]:
+                assign_i[w] = i
+                counts[i] += 1
+        # pass 2: freed/new ways go to the most under-share backend
+        for w in range(B):
+            if assign_i[w] is None:
+                i = min(range(len(mset)),
+                        key=lambda j: (counts[j] - target[j], j))
+                assign_i[w] = i
+                counts[i] += 1
+        assign = [mset[i] for i in assign_i]
+        self.services[key] = {"members": mset, "assign": assign,
+                              "self_snat": bool(self_snat)}
+        self._restage_svc()
+        if self._rec is not None:
+            self._rec.set_service(key[0], key[1], key[2],
+                                  [list(m) for m in mset],
+                                  bool(self_snat))
+        self._mark("svc")
+
+    def del_service(self, vip_ip: int, port: int, proto: int) -> bool:
+        """Remove one service VIP. Flows established to its backends
+        keep translating through the NAT-session table until they
+        age out; NEW flows to the VIP stop matching immediately."""
+        key = (int(vip_ip) & 0xFFFFFFFF, int(port), int(proto))
+        if key not in self.services:
+            return False
+        del self.services[key]
+        self._restage_svc()
+        if self._rec is not None:
+            self._rec.del_service(key[0], key[1], key[2])
+        self._mark("svc")
+        return True
+
+    def clear_services(self) -> None:
+        self.services = {}
+        self._restage_svc()
+        if self._rec is not None:
+            self._rec.clear_services()
+        self._mark("svc")
+
     # staging-state array attributes (everything a mutator can touch,
     # besides the dict-of-arrays acl/glb and the scalars handled
     # explicitly in state_snapshot/state_restore)
@@ -2088,6 +2407,12 @@ class TableBuilder:
                               "assign": list(e["assign"])}
                           for g, e in self.nh_groups.items()},
             "nat_snat_ip": self.nat_snat_ip,
+            "ovl_vtep_ip": self.ovl_vtep_ip,
+            "svc": self.svc,               # replaced wholesale
+            "services": {k: {"members": list(e["members"]),
+                             "assign": list(e["assign"]),
+                             "self_snat": e["self_snat"]}
+                         for k, e in self.services.items()},
             "dirty": set(self._dirty),
             "rec_ops": list(self._rec.ops) if self._rec is not None else None,
         }
@@ -2133,6 +2458,15 @@ class TableBuilder:
         self._bv_cols = None
         self._bv_dirty = set(_UPLOAD_GROUPS["glb_bv"])
         self.nat_snat_ip = snap["nat_snat_ip"]
+        self.ovl_vtep_ip = snap["ovl_vtep_ip"]
+        self.svc = snap["svc"]
+        self.services = {k: {"members": list(e["members"]),
+                             "assign": list(e["assign"]),
+                             "self_snat": e["self_snat"]}
+                         for k, e in snap["services"].items()}
+        # the device cache may hold the rolled-back svc commit — force
+        # the next upload full (the _fib_prev conservatism)
+        self._svc_prev = None
         # union, not replace: groups the rolled-back ops touched stay
         # dirty — a redundant re-upload of identical data is harmless,
         # a stale device cache is not
@@ -2227,6 +2561,8 @@ class TableBuilder:
             natb_port=self.natb_port,
             natb_cumw=self.natb_cumw,
             nat_snat_ip=self.nat_snat_ip,
+            ovl_vtep_ip=self.ovl_vtep_ip,
+            **self.svc,
         )
 
     def to_device(self, sessions=None) -> DataplaneTables:
@@ -2298,6 +2634,9 @@ class TableBuilder:
             dirty = group in self._dirty
             if group == "fib":
                 self._upload_fib(host, host_np, fields, dirty)
+                continue
+            if group == "svc":
+                self._upload_svc(host, host_np, fields, dirty)
                 continue
             if group == "glb_bv":
                 # per-dimension-plane upload: only planes compile_bv
@@ -2506,4 +2845,105 @@ class TableBuilder:
         for f, arr in zip(_FIB_SLOT_FIELDS, new_rows):
             self._dev_cache[f] = arr
         self._set_fib_prev(host_np)
+        return blob.nbytes
+
+    # --- service-plane upload (incremental VIP-row blob; ISSUE 19) --
+    def _upload_svc(self, host: Dict[str, object],
+                    host_np: Dict[str, np.ndarray],
+                    fields: Tuple[str, ...], dirty: bool) -> None:
+        """The "svc" group's to_device body (the _upload_fib twin):
+        changed VIP rows go through the incremental scatter-blob path
+        when they confine to a block — a rolling backend replacement
+        ships a few-KB blob, never the full planes, and NEVER any
+        other group's bytes. Records ``svc_upload`` for
+        `show services` / overlay_bench's svc_churn_bytes."""
+        import time as _t
+
+        t0 = _t.perf_counter()
+        shipped = []
+        blob_bytes = 0
+        inc = False
+        if dirty:
+            blob_bytes = self._svc_incremental(host_np)
+            inc = blob_bytes is not None
+        for name in fields:
+            if inc:
+                host[name] = self._dev_cache[name]
+                continue
+            if dirty or name not in self._dev_cache:
+                self._dev_cache[name] = jnp.asarray(host_np[name])
+                shipped.append(name)
+            host[name] = self._dev_cache[name]
+        if dirty and not inc:
+            # full upload above: refresh the diff base only after
+            # every device transfer succeeded (the glb/fib rule)
+            self._set_svc_prev(host_np)
+        if dirty:
+            self.svc_last_shipped = True
+            self.svc_upload = {
+                "fields": tuple(shipped),
+                "blob_bytes": int(blob_bytes or 0),
+                "bytes": int(sum(host_np[f].nbytes for f in shipped)
+                             + (blob_bytes or 0)),
+                "ms": (_t.perf_counter() - t0) * 1e3,
+            }
+
+    def _set_svc_prev(self, host_np: Dict[str, np.ndarray]) -> None:
+        """Record the svc diff base (safe references — _restage_svc
+        replaces the staging arrays wholesale, never in place)."""
+        self._svc_prev = {f: host_np[f]
+                          for f in _SVC_1D_FIELDS + _SVC_2D_FIELDS}
+
+    def _svc_incremental(self, host_np: Dict[str, np.ndarray]):
+        """Try an incremental device update of the service planes:
+        diff VIP rows against the last-uploaded arrays; when the
+        changes confine to a row block, upload ONE packed blob and
+        scatter it into the cached device arrays (_svc_update_fn).
+        Returns the blob's byte count on success (0 =
+        content-identical commit), None to fall back to a full
+        upload."""
+        prev = self._svc_prev
+        all_fields = _SVC_1D_FIELDS + _SVC_2D_FIELDS
+        if prev is None or any(
+            f not in self._dev_cache for f in all_fields
+        ):
+            return None
+        V, B = host_np["svc_bk_ip"].shape
+        changed = np.zeros(V, bool)
+        for f in _SVC_1D_FIELDS:
+            changed |= prev[f] != host_np[f]
+        for f in _SVC_2D_FIELDS:
+            changed |= np.any(prev[f] != host_np[f], axis=1)
+        idx = np.nonzero(changed)[0]
+        if len(idx) == 0:
+            return 0   # content-identical commit: nothing to ship
+        # _block_of's 256-row floor suits rule/FIB tables; VIP tables
+        # are small, so the blob ladder starts at 8 rows (x4 steps)
+        lo, hi = int(idx[0]), int(idx[-1]) + 1
+        w = 8
+        while w < hi - lo:
+            w *= 4
+        if w >= V:
+            return None  # change spans the table: full upload is best
+        lo = min(lo, V - w)
+        n1 = len(_SVC_1D_FIELDS)
+        blob = np.empty(n1 * w + len(_SVC_2D_FIELDS) * w * B, np.int32)
+        for i, f in enumerate(_SVC_1D_FIELDS):
+            blob[i * w:(i + 1) * w] = host_np[f][lo:lo + w].view(np.int32)
+        base = n1 * w
+        for i, f in enumerate(_SVC_2D_FIELDS):
+            blob[base + i * w * B:base + (i + 1) * w * B] = \
+                np.ascontiguousarray(
+                    host_np[f][lo:lo + w]).reshape(-1).view(np.int32)
+        fn = _svc_update_fn(w, B)
+        new_rows, new_grids = fn(
+            [self._dev_cache[f] for f in _SVC_1D_FIELDS],
+            [self._dev_cache[f] for f in _SVC_2D_FIELDS],
+            jnp.asarray(blob), lo,
+        )
+        for f, arr in zip(_SVC_1D_FIELDS, new_rows):
+            self._dev_cache[f] = arr
+        for f, arr in zip(_SVC_2D_FIELDS, new_grids):
+            self._dev_cache[f] = arr
+        self._set_svc_prev(host_np)
         return blob.nbytes

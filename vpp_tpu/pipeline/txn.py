@@ -73,6 +73,7 @@ _OPS = (
     "set_nat_mapping", "clear_nat", "set_snat_ip",
     "set_ml_model", "clear_ml_model",
     "set_tenant", "clear_tenants", "set_tenant_ml",
+    "set_service", "del_service", "clear_services", "set_vtep_ip",
 )
 _RULE_OPS = {"set_local_table", "set_global_table"}
 
@@ -163,6 +164,30 @@ class ConfigTxn:
     def set_snat_ip(self, ip: int) -> "ConfigTxn":
         return self._record("set_snat_ip", ip=ip)
 
+    # --- VXLAN overlay + service LB (ISSUE 19) ---
+    def set_vtep_ip(self, ip: int) -> "ConfigTxn":
+        return self._record("set_vtep_ip", ip=ip)
+
+    def set_service(self, vip_ip: int, port: int, proto: int,
+                    backends: Sequence[tuple],
+                    self_snat: bool = False) -> "ConfigTxn":
+        """``backends`` is the distinct backend list as
+        TableBuilder.set_service normalizes it — plain JSON rows
+        ``[ip, port, weight]``. Replay reruns the sticky way fill
+        deterministically (the set_nh_group journaling rationale)."""
+        return self._record("set_service", vip_ip=int(vip_ip),
+                            port=int(port), proto=int(proto),
+                            backends=[list(b) for b in backends],
+                            self_snat=bool(self_snat))
+
+    def del_service(self, vip_ip: int, port: int,
+                    proto: int) -> "ConfigTxn":
+        return self._record("del_service", vip_ip=int(vip_ip),
+                            port=int(port), proto=int(proto))
+
+    def clear_services(self) -> "ConfigTxn":
+        return self._record("clear_services")
+
     def set_ml_model(self, model) -> "ConfigTxn":
         """``model`` is an MlModel or its JSON dict form; the journal
         stores the dict (tiny — a few hundred int8 weights), so replay
@@ -198,7 +223,7 @@ class ConfigTxn:
             kw = {k: v for k, v in entry.items() if k != "op"}
             if op in _RULE_OPS:
                 kw["rules"] = [rule_from_dict(d) for d in kw["rules"]]
-            if op == "set_nat_mapping":
+            if op in ("set_nat_mapping", "set_service"):
                 kw["backends"] = [tuple(b) for b in kw["backends"]]
             if op == "add_route":
                 kw["disposition"] = Disposition(kw["disposition"])

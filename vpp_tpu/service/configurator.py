@@ -12,10 +12,23 @@ reference (plugins/service/configurator/configurator_impl.go):
 - "Local" external traffic policy keeps only node-local backends;
 - SNAT address for traffic leaving the cluster (:258-264).
 
-The full NAT table is rebuilt from the service map on every change:
-services are few, the rebuild is O(total backends), and it keeps the
-device arrays dense and fragmentation-free (the TPU analog of the
-reference's full-resync path against DumpNat44DNat, :213-296).
+Two rendering paths, picked by the ``svc_vips`` capacity knob:
+
+* **Legacy (svc_vips == 0)**: the full NAT table is rebuilt from the
+  service map on every change — services are few, the rebuild is
+  O(total backends), and it keeps the device arrays dense and
+  fragmentation-free (the TPU analog of the reference's full-resync
+  path against DumpNat44DNat, :213-296).
+* **svc planes (svc_vips > 0, ISSUE 19)**: each VIP renders through
+  the builder's KEYED service registry (set_service/del_service) into
+  the ``svc_*`` planes, which ride their OWN "svc" upload group — a
+  rolling backend replacement ships a few-KB scatter blob and ZERO
+  ACL/ML/FIB bytes (docs/OVERLAY.md "zero-reship backend churn").
+  Way assignment is sticky per VIP, so surviving backends keep their
+  flows. The staging loop carries the ``service.churn`` fault point
+  (testing/faults.py): a failure mid-churn rolls the builder back to
+  the pre-churn snapshot, so a half-applied backend set never reaches
+  a swap — the device either serves the OLD set or the NEW one.
 """
 
 from __future__ import annotations
@@ -26,6 +39,7 @@ from typing import Dict, List, Optional, Tuple
 from vpp_tpu.pipeline.dataplane import Dataplane
 from vpp_tpu.pipeline.vector import ip4
 from vpp_tpu.service.config import Backend, ContivService, TrafficPolicy
+from vpp_tpu.testing import faults
 from vpp_tpu.trace import spans
 
 # Local backends get twice the share of hash space (reference
@@ -81,7 +95,67 @@ class ServiceConfigurator:
             "render", "service-nat-rebuild", services=len(self.services),
         ):
             with self.dataplane.commit_lock:
-                self._rebuild_locked()
+                if int(getattr(self.dataplane.config, "svc_vips", 0)) > 0:
+                    self._render_svc_locked()
+                else:
+                    self._rebuild_locked()
+
+    def _frontends(self, svc: ContivService,
+                   spec) -> List[Tuple[int, int, bool]]:
+        # (frontend ip, frontend port, self_snat): nodeport
+        # frontends are marked self-snat so flows DNAT'd to a
+        # remote backend also get source-NAT'd — the backend's
+        # reply must return through this node for un-DNAT
+        # (reference nodeport/TwoNodeNAT semantics).
+        frontends: List[Tuple[int, int, bool]] = []
+        if svc.cluster_ip:
+            frontends.append((ip4(svc.cluster_ip), spec.port, False))
+        for ext in svc.external_ips:
+            frontends.append((ip4(ext), spec.port, False))
+        if spec.node_port:
+            for nip in self.node_ips:
+                frontends.append((ip4(nip), spec.node_port, True))
+        return frontends
+
+    def _render_svc_locked(self) -> None:
+        """svc-plane path (ISSUE 19): diff the desired VIP set against
+        the builder's keyed registry and stage only the delta — removed
+        VIPs first (frees rows), then set_service per surviving VIP
+        (idempotent: an unchanged set compiles byte-identical rows, so
+        the incremental "svc" upload ships nothing for it). The
+        ``service.churn`` fault point fires after every staged
+        mutation; any failure mid-churn restores the pre-churn builder
+        snapshot — the swap below only ever publishes a COMPLETE set."""
+        dp = self.dataplane
+        builder = dp.builder
+        desired: Dict[Tuple[int, int, int],
+                      Tuple[List[Tuple[int, int, int]], bool]] = {}
+        for svc in self.services.values():
+            for pname, spec in svc.ports.items():
+                weighted = self._weighted_backends(
+                    svc, svc.backends.get(pname, []))
+                if not weighted:
+                    continue
+                proto = _PROTO_NUM.get(spec.protocol.upper(), 6)
+                for ext_ip, ext_port, self_snat in self._frontends(
+                        svc, spec):
+                    desired[(ext_ip, ext_port, proto)] = (
+                        weighted, self_snat)
+        snap = builder.state_snapshot()
+        try:
+            for key in sorted(set(builder.services) - set(desired)):
+                builder.del_service(*key)
+                faults.fire("service.churn")
+            for key in sorted(desired):
+                backends, self_snat = desired[key]
+                builder.set_service(key[0], key[1], key[2], backends,
+                                    self_snat=self_snat)
+                faults.fire("service.churn")
+        except Exception:
+            builder.state_restore(snap)
+            raise
+        builder.txn_label = f"service-svc {len(desired)} vips"
+        dp.swap()
 
     def _rebuild_locked(self) -> None:
         dp = self.dataplane
@@ -96,20 +170,7 @@ class ServiceConfigurator:
                 weighted = self._weighted_backends(svc, backends)
                 if not weighted:
                     continue
-                # (frontend ip, frontend port, self_snat): nodeport
-                # frontends are marked self-snat so flows DNAT'd to a
-                # remote backend also get source-NAT'd — the backend's
-                # reply must return through this node for un-DNAT
-                # (reference nodeport/TwoNodeNAT semantics).
-                frontends: List[Tuple[int, int, bool]] = []
-                if svc.cluster_ip:
-                    frontends.append((ip4(svc.cluster_ip), spec.port, False))
-                for ext in svc.external_ips:
-                    frontends.append((ip4(ext), spec.port, False))
-                if spec.node_port:
-                    for nip in self.node_ips:
-                        frontends.append((ip4(nip), spec.node_port, True))
-
+                frontends = self._frontends(svc, spec)
                 proto = _PROTO_NUM.get(spec.protocol.upper(), 6)
                 # All frontends of this service port share one backend range.
                 n = len(weighted)

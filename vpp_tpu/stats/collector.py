@@ -232,6 +232,11 @@ FLEET_GAUGE_FAMILIES = (
     ("vpp_tpu_fleet_migrated_sessions_total",
      "live sessions shipped by range migrations (drained, "
      "age-rebased, adopted)", "counter"),
+    ("vpp_tpu_fleet_nat_coldstarts_total",
+     "live NAT sessions left behind by range migrations (NAT state "
+     "keys on the post-NAT pair and cannot migrate — ISSUE 19; the "
+     "new owner re-establishes these flows from the mapping tables)",
+     "counter"),
     ("vpp_tpu_fleet_steered_total",
      "packets steered to each instance (by instance label)",
      "counter"),
@@ -380,6 +385,18 @@ NODE_GAUGES = (
     ("vpp_tpu_node_tenant_quota_fail_packets",
      "session/NAT inserts that failed inside a tenant's capacity "
      "slice (all tenants)"),
+    # device-resident VXLAN overlay (ISSUE 19; ops/vxlan.py): the
+    # StepStats mirrors of the fused decap/encap stage pair
+    ("vpp_tpu_node_overlay_decap_packets",
+     "VXLAN frames decapsulated in-step (VNI validated, inner vector "
+     "re-admitted at ip4-input)"),
+    ("vpp_tpu_node_overlay_encap_packets",
+     "forwarded packets VXLAN-encapsulated in-step (outer header "
+     "resolved through the outer-FIB walk)"),
+    ("vpp_tpu_node_drop_overlay",
+     "overlay fail-closed drops: VXLAN-addressed frames with an "
+     "unknown VNI, a bad outer header, or an unresolvable outer "
+     "route (DROP_OVERLAY)"),
 )
 
 # Per-tenant labelled families (ISSUE 14), split by their feed — the
@@ -464,6 +481,10 @@ STEPSTATS_FAMILIES = {
     # multi-tenant gateway mode (ISSUE 14)
     "tnt_limited": "vpp_tpu_node_tenant_limited_packets",
     "tnt_qfail": "vpp_tpu_node_tenant_quota_fail_packets",
+    # device-resident VXLAN overlay (ISSUE 19)
+    "ovl_decap": "vpp_tpu_node_overlay_decap_packets",
+    "ovl_encap": "vpp_tpu_node_overlay_encap_packets",
+    "drop_overlay": "vpp_tpu_node_drop_overlay",
 }
 
 # Packed-aux rider row (pipeline/dataplane.py PACKED_AUX_SCHEMA, rows
@@ -530,7 +551,8 @@ class StatsCollector:
                            "natsess_evict_expired",
                            "natsess_evict_victim",
                            "ml_scored", "ml_flagged", "ml_drops",
-                           "tel_sketched", "tnt_limited", "tnt_qfail")
+                           "tel_sketched", "tnt_limited", "tnt_qfail",
+                           "ovl_decap", "ovl_encap", "drop_overlay")
         }
         # gauges, not counters: last-step snapshots
         self._last: Dict[str, int] = {
@@ -1126,6 +1148,12 @@ class StatsCollector:
             totals["tnt_limited"])
         self.node_gauges["vpp_tpu_node_tenant_quota_fail_packets"].set(
             totals["tnt_qfail"])
+        self.node_gauges["vpp_tpu_node_overlay_decap_packets"].set(
+            totals["ovl_decap"])
+        self.node_gauges["vpp_tpu_node_overlay_encap_packets"].set(
+            totals["ovl_encap"])
+        self.node_gauges["vpp_tpu_node_drop_overlay"].set(
+            totals["drop_overlay"])
         self.sess_insert_failed_gauge.set(
             totals["sess_insert_fail"], table="sess")
         self.sess_insert_failed_gauge.set(
@@ -1493,6 +1521,8 @@ class StatsCollector:
                 float(fs["migrated_ranges"]))
             g["vpp_tpu_fleet_migrated_sessions_total"].set(
                 float(fs["migrated_sessions"]))
+            g["vpp_tpu_fleet_nat_coldstarts_total"].set(
+                float(fs["nat_coldstarts"]))
             fpump = self._fleet_pump
             psnap = (fpump.stats_snapshot()
                      if fpump is not None else None)

@@ -17,9 +17,12 @@ ip4-input and threaded through the fused step:
   insert and the reply's reverse lookup. Cross-tenant (east-west)
   flows attribute to the higher tenant id by this rule; unmatched
   addresses are tenant 0, the default tenant. The VXLAN VNI → tenant
-  map is host-side config (tenancy/sched.py ``TenantClassifier`` /
-  TableBuilder's registry): VNIs terminate on interfaces before the
-  packet vector exists, so the device map keys on addresses.
+  map rides on-device too (the ``tnt_vni`` plane + ``vni_tenant``
+  below, ISSUE 19): when the overlay stage decaps a frame INSIDE the
+  fused step (ops/vxlan.py vxlan_decap_step), the outer header's VNI
+  names the tenant directly and overrides the address-derived id for
+  that packet — docs/OVERLAY.md "VNI ↔ tenant pact". Non-overlay
+  traffic keeps deriving on addresses.
 
 * **Rate limiting** is a per-tenant token bucket evaluated INSIDE the
   fused step: bucket state (``tnt_tokens``/``tnt_tok_time``, [T]
@@ -85,6 +88,23 @@ def key_tenant(tables: DataplaneTables, a: jnp.ndarray,
     and the reply's lookup key (both present the same unordered
     address pair, whatever NAT did to the header in between)."""
     return jnp.maximum(addr_tenant(tables, a), addr_tenant(tables, b))
+
+
+def vni_tenant(tables: DataplaneTables,
+               vni: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Tenant of each VXLAN VNI ([P] int32 → (tid [P] int32, known [P]
+    bool)): the ``tnt_vni`` plane maps tenant id → configured VNI
+    (-1 = none); a decapped frame's VNI names its tenant DIRECTLY
+    (ISSUE 19 — no address derivation on overlay traffic). Unknown or
+    negative VNIs come back ``known=False`` and the overlay stage
+    fails closed (DROP_OVERLAY) — a VNI that names no tenant must
+    never be admitted as tenant 0 traffic."""
+    plane = tables.tnt_vni
+    hit = ((vni[:, None] == plane[None, :])
+           & (plane[None, :] >= 0) & (vni[:, None] >= 0))
+    known = jnp.any(hit, axis=1)
+    tid = jnp.where(known, jnp.argmax(hit, axis=1), 0).astype(jnp.int32)
+    return tid, known
 
 
 def tenant_ids(tables: DataplaneTables, pkts: PacketVector) -> jnp.ndarray:

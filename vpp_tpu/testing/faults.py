@@ -61,6 +61,11 @@ point                 seam
                       a migration crashing at either seam leaves the
                       range FENCED: steered traffic drops attributed
                       and ``recover()`` completes the move
+``service.churn``     service/configurator.py — per staged svc-plane
+                      mutation during a backend replacement; a crash
+                      mid-churn rolls the builder back so a
+                      HALF-APPLIED backend set never serves
+                      (conservation must hold — ISSUE 19)
 ====================  ====================================================
 """
 
