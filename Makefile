@@ -31,13 +31,16 @@ chaos:
 
 # Base style pass + the pure-AST analysis passes (tools/analysis/):
 # --jax tracer/recompile hygiene, --threads lock discipline,
-# --partitions rule completeness (pure import, no jax arrays). The
+# --partitions rule completeness (pure import, no jax arrays), and the
+# ISSUE 20 device-boundary dataflow passes: --uploads group-staleness,
+# --transfers host-fetch allowlisting, --donate use-after-donate. The
 # registry passes (--metrics/--counters/--tables) import jax, so
 # tier-1 runs them from tests instead (test_exposition / test_acl_bv).
 # autotune-check rides along: a drifted tuned/cpu.json is a lint-class
 # failure (the committed profile must round-trip the config loader).
 lint: autotune-check
-	$(PY) tools/lint.py --jax --threads --partitions
+	$(PY) tools/lint.py --jax --threads --partitions --uploads \
+		--transfers --donate
 
 # Driver-facing headline benchmark (real TPU; one JSON line).
 bench:

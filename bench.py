@@ -113,6 +113,20 @@ def _jit_compiles_now() -> int:
         return 0
 
 
+def _transfer_bytes_now() -> int:
+    """Total device->host bytes through the counted fetch sites so far
+    (the runtime device-transfer guard, pipeline/dataplane.py).
+    Recorded per priority-ladder section as <section>_transfer_bytes so
+    a table-column fetch creeping onto a measured path — the PR-6/8/12
+    "aggregate on host" class — shows up in the BENCH_* trajectory."""
+    try:
+        from vpp_tpu.pipeline.dataplane import device_transfer_totals
+
+        return sum(device_transfer_totals().values())
+    except Exception:  # noqa: BLE001 — accounting must never kill a run
+        return 0
+
+
 def _probe_backend(retries: int, delay: float):
     """Initialize the JAX backend, retrying transient axon/tunnel init
     failures (round-1 bench died on 'Unable to initialize backend axon'
@@ -4268,6 +4282,7 @@ def _run():
     # run continues.
     pri = {}
     _jc = _jit_compiles_now()
+    _tb = _transfer_bytes_now()
     try:
         pri.update(session_election_bench(args))
     except Exception as e:  # noqa: BLE001 — priority sections are
@@ -4276,6 +4291,9 @@ def _run():
     _jc_now = _jit_compiles_now()
     pri["sess_election_jit_compiles"] = _jc_now - _jc
     _jc = _jc_now
+    _tb_now = _transfer_bytes_now()
+    pri["sess_election_transfer_bytes"] = _tb_now - _tb
+    _tb = _tb_now
     _progress(**pri)
     try:
         # set-associative session table (ISSUE 6): old-vs-new insert
@@ -4287,6 +4305,9 @@ def _run():
     _jc_now = _jit_compiles_now()
     pri["session_scale_jit_compiles"] = _jc_now - _jc
     _jc = _jc_now
+    _tb_now = _transfer_bytes_now()
+    pri["session_scale_transfer_bytes"] = _tb_now - _tb
+    _tb = _tb_now
     _progress(**pri)
     try:
         # crash-consistent snapshot at the scale config (ISSUE 8):
@@ -4298,6 +4319,9 @@ def _run():
     _jc_now = _jit_compiles_now()
     pri["snapshot_jit_compiles"] = _jc_now - _jc
     _jc = _jc_now
+    _tb_now = _transfer_bytes_now()
+    pri["snapshot_transfer_bytes"] = _tb_now - _tb
+    _tb = _tb_now
     _progress(**pri)
     try:
         pri.update(commit_bench(args))
@@ -4306,6 +4330,9 @@ def _run():
     _jc_now = _jit_compiles_now()
     pri["commit_jit_compiles"] = _jc_now - _jc
     _jc = _jc_now
+    _tb_now = _transfer_bytes_now()
+    pri["commit_transfer_bytes"] = _tb_now - _tb
+    _tb = _tb_now
     _progress(**pri)
     try:
         # classifier shoot-out (ISSUE 4): dense vs MXU vs BV at 1,024
@@ -4316,6 +4343,9 @@ def _run():
     _jc_now = _jit_compiles_now()
     pri["acl_classifier_jit_compiles"] = _jc_now - _jc
     _jc = _jc_now
+    _tb_now = _transfer_bytes_now()
+    pri["acl_classifier_transfer_bytes"] = _tb_now - _tb
+    _tb = _tb_now
     _progress(**pri)
     try:
         # million-route LPM FIB (ISSUE 15): 1M-route build, LPM vs
@@ -4329,6 +4359,9 @@ def _run():
     _jc_now = _jit_compiles_now()
     pri["fib_jit_compiles"] = _jc_now - _jc
     _jc = _jc_now
+    _tb_now = _transfer_bytes_now()
+    pri["fib_transfer_bytes"] = _tb_now - _tb
+    _tb = _tb_now
     _progress(**pri)
     try:
         # pallas kernel rungs (ISSUE 16): fused vs reference ns/pkt +
@@ -4340,6 +4373,9 @@ def _run():
     _jc_now = _jit_compiles_now()
     pri["pallas_kernel_jit_compiles"] = _jc_now - _jc
     _jc = _jc_now
+    _tb_now = _transfer_bytes_now()
+    pri["pallas_kernel_transfer_bytes"] = _tb_now - _tb
+    _tb = _tb_now
     _progress(**pri)
     try:
         # tentpole capture: the two-tier fast path's measured win at
@@ -4350,6 +4386,9 @@ def _run():
     _jc_now = _jit_compiles_now()
     pri["fastpath_jit_compiles"] = _jc_now - _jc
     _jc = _jc_now
+    _tb_now = _transfer_bytes_now()
+    pri["fastpath_transfer_bytes"] = _tb_now - _tb
+    _tb = _tb_now
     _progress(**pri)
     try:
         # per-packet ML stage (ISSUE 10): marginal in-step cost of the
@@ -4361,6 +4400,9 @@ def _run():
     _jc_now = _jit_compiles_now()
     pri["ml_stage_jit_compiles"] = _jc_now - _jc
     _jc = _jc_now
+    _tb_now = _transfer_bytes_now()
+    pri["ml_stage_transfer_bytes"] = _tb_now - _tb
+    _tb = _tb_now
     _progress(**pri)
     try:
         # device telemetry plane (ISSUE 11): in-step histogram/sketch
@@ -4373,6 +4415,9 @@ def _run():
     _jc_now = _jit_compiles_now()
     pri["latency_telemetry_jit_compiles"] = _jc_now - _jc
     _jc = _jc_now
+    _tb_now = _transfer_bytes_now()
+    pri["latency_telemetry_transfer_bytes"] = _tb_now - _tb
+    _tb = _tb_now
     _progress(**pri)
     try:
         # gateway fleet (ISSUE 18): the scale-out ladder (1→2→4
@@ -4385,6 +4430,9 @@ def _run():
     _jc_now = _jit_compiles_now()
     pri["fleet_jit_compiles"] = _jc_now - _jc
     _jc = _jc_now
+    _tb_now = _transfer_bytes_now()
+    pri["fleet_transfer_bytes"] = _tb_now - _tb
+    _tb = _tb_now
     _progress(**pri)
     try:
         # device-resident VXLAN overlay + svc NAT44 planes (ISSUE 19):
@@ -4400,6 +4448,9 @@ def _run():
     _jc_now = _jit_compiles_now()
     pri["overlay_jit_compiles"] = _jc_now - _jc
     _jc = _jc_now
+    _tb_now = _transfer_bytes_now()
+    pri["overlay_transfer_bytes"] = _tb_now - _tb
+    _tb = _tb_now
     _progress(**pri)
     if not args.no_subbench:
         try:
@@ -4409,6 +4460,9 @@ def _run():
         _jc_now = _jit_compiles_now()
         pri["io_ring_jit_compiles"] = _jc_now - _jc
         _jc = _jc_now
+        _tb_now = _transfer_bytes_now()
+        pri["io_ring_transfer_bytes"] = _tb_now - _tb
+        _tb = _tb_now
         _progress(**pri)
         try:
             # reflex-plane latency governor (ISSUE 13): the priority
@@ -4423,6 +4477,9 @@ def _run():
         _jc_now = _jit_compiles_now()
         pri["latency_slo_jit_compiles"] = _jc_now - _jc
         _jc = _jc_now
+        _tb_now = _transfer_bytes_now()
+        pri["latency_slo_transfer_bytes"] = _tb_now - _tb
+        _tb = _tb_now
         _progress(**pri)
         try:
             # multi-tenant isolation (ISSUE 14): 4 tenants on the
@@ -4437,6 +4494,9 @@ def _run():
         _jc_now = _jit_compiles_now()
         pri["tenant_isolation_jit_compiles"] = _jc_now - _jc
         _jc = _jc_now
+        _tb_now = _transfer_bytes_now()
+        pri["tenant_isolation_transfer_bytes"] = _tb_now - _tb
+        _tb = _tb_now
         _progress(**pri)
         try:
             pri.update(io_daemon_bench(args))
@@ -4445,6 +4505,9 @@ def _run():
         _jc_now = _jit_compiles_now()
         pri["io_daemon_jit_compiles"] = _jc_now - _jc
         _jc = _jc_now
+        _tb_now = _transfer_bytes_now()
+        pri["io_daemon_transfer_bytes"] = _tb_now - _tb
+        _tb = _tb_now
         _progress(**pri)
 
     dp, uplink = build_dataplane(args.rules, args.backends)
@@ -4658,6 +4721,7 @@ def _run():
                     # the whole-run total (flat across rounds unless a
                     # recompile regression landed)
                     "jit_compiles_total": _jit_compiles_now(),
+                    "device_transfer_bytes_total": _transfer_bytes_now(),
                     # committed autotuner profile for this backend
                     # (tools/autotune.py; ISSUE 16) — the knobs a
                     # deployment loading tuned/<backend>.json would

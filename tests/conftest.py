@@ -41,6 +41,13 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "transfer_budget(n): with the transfer_budget fixture, fail "
+        "the test if the counted fetch sites move more than n "
+        "device->host bytes (pipeline/dataplane.py runtime "
+        "device-transfer guard, ISSUE 20)",
+    )
+    config.addinivalue_line(
+        "markers",
         "chaos: seeded fault-injection schedule (tests/test_chaos.py; "
         "vpp_tpu/testing/faults.py). Bounded runtime; `make chaos` "
         "runs the suite; also marked slow so the tier-1 `-m 'not "
@@ -70,6 +77,27 @@ def jit_compile_budget(request):
     try:
         guard.__exit__(None, None, None)
     except _dp.JitBudgetExceeded as e:
+        pytest.fail(str(e))
+
+
+@pytest.fixture
+def transfer_budget(request):
+    """Opt-in device-transfer budget guard: a test that requests this
+    fixture declares (via ``@pytest.mark.transfer_budget(n)``, default
+    0) how many device->host bytes its counted fetch sites may move;
+    exceeding the budget fails the test. The runtime face of the
+    static ``--transfers`` pass: the manifest pins WHERE fetches
+    happen, this pins HOW MUCH they move."""
+    from vpp_tpu.pipeline import dataplane as _dp
+
+    marker = request.node.get_closest_marker("transfer_budget")
+    budget = int(marker.args[0]) if marker and marker.args else 0
+    guard = _dp.transfer_budget(budget)
+    guard.__enter__()
+    yield guard
+    try:
+        guard.__exit__(None, None, None)
+    except _dp.TransferBudgetExceeded as e:
         pytest.fail(str(e))
 
 

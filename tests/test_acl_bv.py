@@ -385,6 +385,7 @@ def test_bv_end_to_end_matches_dense_dataplane():
     assert out["dense"][2] == out["bv"][2]
 
 
+@pytest.mark.slow  # ~11 s: gate/regate compile pair; the regate-at-swap bug class stays fast via test_lpm auto-regate
 def test_skip_local_gate_regates_at_swap():
     """Policy-free nodes compile the local stage away; assigning a
     local table re-gates at the next swap with identical verdicts."""

@@ -52,6 +52,7 @@ def build_cluster(n_nodes=4, rule_shards=2, global_rules=()):
     return cluster, pod_ip, pod_if
 
 
+@pytest.mark.slow  # ~18 s: full renderer orchestration; node bring-up is covered by test_cross_node_forwarding fast
 def test_renderer_drives_cluster_nodes():
     """The policy pipeline (renderer API) works unchanged against a
     cluster node: commits publish cluster epochs via swap delegation,
@@ -218,6 +219,7 @@ def _acl_scale_rules(n_rules):
     return rules
 
 
+@pytest.mark.slow  # ~35 s: at-scale shard geometry; the small-geometry mxu-vs-dense differential stays fast
 def test_mxu_sharded_equals_dense_sharded_at_scale():
     """The rule-sharded MXU bit-plane classify and the rule-sharded dense
     classify produce identical cluster verdicts at 10k+ rules (VERDICT r3
@@ -376,6 +378,7 @@ def test_fib_lpm_sharded_equals_dense_sharded():
     assert small.fib_impl == "dense"
 
 
+@pytest.mark.slow  # ~19 s: payload-bearing wire variant compile; cross-node forwarding keeps the fabric anchor fast
 def test_wire_step_carries_payload_across_fabric():
     """step_wire: packet BYTES ride the same all_to_all as the header
     columns — a fabric-delivered packet's payload row at the

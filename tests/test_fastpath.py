@@ -177,6 +177,7 @@ class TestDifferential:
         assert np.array_equal(np.asarray(raw.disp), np.asarray(ref.disp))
         assert int(raw.stats.fastpath) == 1
 
+    @pytest.mark.slow  # ~27 s: partial-hit compile of both chain forms; mixed-traffic full-chain bit-exact stays the fast differential anchor
     def test_partial_hit_batch_falls_through(self, steps):
         """One fresh flow mixed into established replies: the batch
         dispatch predicate must reject and the full chain must install
@@ -254,6 +255,7 @@ class TestDifferential:
 
 
 class TestPackedAux:
+    @pytest.mark.slow  # ~16 s: packed-aux variant compile; aux schema width parity stays fast in test_telemetry
     def test_packed_aux_reports_fast_dispatch(self):
         """The pump-facing telemetry: process_packed(with_aux=True)
         returns [fastpath, rx, sess_hits] from the same program, and

@@ -397,6 +397,7 @@ def _accounted(pump):
 
 
 class TestPriorityLaneOrdering:
+    @pytest.mark.slow  # ~14 s: saturating-load soak; brownout shed/conservation stays the fast governor anchor
     def test_priority_bounded_queueing_under_saturating_bulk(self):
         """The ISSUE 13 ordering contract: under a saturating bulk
         burst, flagged frames observe bounded queueing — p99 within

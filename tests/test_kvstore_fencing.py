@@ -403,6 +403,7 @@ class TestPartitions:
             psrv.close()
             w.close()
 
+    @pytest.mark.slow  # ~35 s and timing-sensitive under load (30 s promote wait); standby-side fencing stays fast below, full matrix in make test-race
     def test_isolated_primary_demotes_before_standby_claims(self):
         """P loses BOTH links (to W and to S) but stays alive: it must
         stop accepting writes strictly before S's claim can be granted.

@@ -373,6 +373,7 @@ def test_mesh_over_remote_kvserver():
         server.close()
 
 
+@pytest.mark.slow  # ~44 s: ICMP error path compiles its own wire-step variants; fabric path + policy stays fast below
 def test_icmp_error_returns_across_the_fabric():
     """Traceroute hop 2, mesh edition: a TTL=2 packet from a pod on
     node 0 survives the ingress vswitch, crosses the fabric, and

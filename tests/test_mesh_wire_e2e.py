@@ -118,6 +118,7 @@ def _add_pod(agent, cid, ns, name):
     return reply.interfaces[0].ip_addresses[0].address.split("/")[0]
 
 
+@pytest.mark.slow  # ~60 s total: real netns + veth e2e per test (function-scoped mesh_stack); the same wire path is covered fast by test_cluster/test_mesh_agent unit analogs
 class TestMeshWire:
     def test_udp_crosses_the_fabric_between_netns_pods(self, mesh_stack):
         runtime = mesh_stack["runtime"]

@@ -494,6 +494,7 @@ class TestFastpathInterplay:
             for i in range(6)], n=16)
         return dp, reply
 
+    @pytest.mark.slow  # ~14 s: fastpath x ML cross-layer compile; ML scoring/quantization correctness stays fast in this file
     def test_fast_tier_scores_and_enforces(self):
         """All-established batch: the auto dispatcher takes the
         classify-free kernel (fastpath == 1) AND still runs the model

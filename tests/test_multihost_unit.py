@@ -57,6 +57,7 @@ def test_stale_stop_counter_does_not_halt_a_new_fleet():
     assert driver.tick(frames(cl), n=8) is None
 
 
+@pytest.mark.slow  # ~15 s: multi-round agreement loop; single-commit agreement is covered by the fast tests in this file
 def test_commit_agreement_publishes_once_per_request():
     store = KVStore()
     cl = build_cluster()
@@ -124,6 +125,7 @@ def test_session_aging_on_tick_cadence():
     assert int(np.asarray(cl.tables.sess_valid).sum()) == 0
 
 
+@pytest.mark.slow  # ~46 s: full fleet-wide rung agreement; commit/publish correctness stays fast via the smaller agreement tests below
 def test_publish_agrees_fib_rung_fleet_wide():
     """The widened 6-column selection allgather: publish folds every
     process's lpm eligibility (min) and staged route count (max) into

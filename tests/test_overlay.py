@@ -207,6 +207,7 @@ class TestStepOverlay:
         plain = ~addressed
         assert (got_dst[plain] == o_dst[plain]).all()
 
+    @pytest.mark.slow  # ~10 s: malformed-framing sweep; fail-closed stays fast via the VNI fails-closed test, decap differential stays fast
     def test_unparseable_framing_fails_closed_like_the_oracle(self):
         """The bad-UDP edge: a frame TO the VTEP the host codec cannot
         parse arrives with the no-framing sidecar (vni -1) — the codec

@@ -242,6 +242,7 @@ def test_mesh_2way_bv_ml_sessions_bitexact():
                           now=3)
 
 
+@pytest.mark.slow  # ~22 s: 4-way mesh compile; the 2-way bitexact differential stays the fast anchor
 def test_mesh_4way_bitexact_and_fastpath_uniform():
     """4-way differential + the SPMD-uniform fastpath dispatch: mixed
     traffic must take the full chain on EVERY shard (no divergence —

@@ -216,6 +216,7 @@ class TestAdaptiveChain:
             assert (da == db).all()
             assert (ia == ib).all()
 
+    @pytest.mark.slow  # ~15 s: adaptive-threshold behavior under light load; the chain==overlap bit-exact identity stays the fast anchor
     def test_light_load_never_pays_the_chain(self):
         """A single pending frame dispatches alone at the VEC bucket —
         the chainer only folds BACKLOG (its latency cost must not leak
@@ -272,6 +273,7 @@ class TestPersistentShutdown:
         finally:
             rings.close()
 
+    @pytest.mark.slow  # ~13 s: shutdown with resident frames; orderly persistent-pump shutdown is covered fast in test_io
     def test_stop_with_frames_resident_in_device_rings(self):
         """stop() while whole windows are still in flight on the
         device rings (ISSUE 7): every thread joins, the steady state

@@ -603,6 +603,7 @@ class TestFastpathUnderChurn:
         assert int(np.asarray(dp.tables.sess_sweep_cursor)) == (
             steps * 2) % 64
 
+    @pytest.mark.slow  # ~12 s: churn soak; sweep reclaim correctness is covered fast by the other churn tests
     def test_sweep_reclaims_expired_without_bulk_pass(self):
         """After flows idle past max_age, continuing to process
         (denied) traffic lets the IN-STEP sweep return their ways to

@@ -26,6 +26,8 @@ from __future__ import annotations
 
 import urllib.request
 
+import pytest
+
 import numpy as np
 
 import jax.numpy as jnp
@@ -183,6 +185,7 @@ class TestHistogramDifferential:
                               expect)
         assert int(snap["bins"].sum()) == expect_count
 
+    @pytest.mark.slow  # ~12 s: mode-interaction variant compile; histogram and sketch differentials each stay fast on their own
     def test_latency_mode_skips_sketch(self):
         dp, up = build_dp("latency")
         dp.process_packed(packed_frame(8, up, sport=1000), now=1,

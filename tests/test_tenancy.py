@@ -554,6 +554,7 @@ class TestUploadGroups:
 
 
 class TestShardComposition:
+    @pytest.mark.slow  # ~17 s: mesh-sliced tenant lookup compile; per-tenant correctness stays fast, mesh slicing anchored by test_partition 2-way
     def test_sliced_lookup_2way_mesh_bitexact(self):
         """The PARTITION_RULES contract (ISSUE 14): tenant slices
         address GLOBAL bucket units, so the mesh's blocked bucket
@@ -1014,6 +1015,7 @@ class TestPumpWfq:
             pump.stop(join_timeout=30.0)
             rings.close()
 
+    @pytest.mark.slow  # ~10 s: pump + WFQ bring-up; quota-drop conservation stays fast in TestQuotaDrops
     def test_device_quota_drops_surface_in_pump_stats(self):
         """Dispatch pump over a tenancy-on dataplane with a
         rate-limited tenant: the aux rider's DROP_TENANT count lands

@@ -3,10 +3,13 @@ suppression-comment parsing and source-tree iteration.
 
 Suppression syntax (docs/STATIC_ANALYSIS.md):
 
-* ``# jax-ok: <reason>``   — suppress jax-pass findings on this line.
-* ``# unlocked: <reason>`` — suppress thread-pass findings on this line.
-* ``# noqa``               — the base style pass's escape (kept from the
-  original tools/lint.py).
+* ``# jax-ok: <reason>``      — suppress jax-pass findings on this line.
+* ``# unlocked: <reason>``    — suppress thread-pass findings on this line.
+* ``# upload-ok: <reason>``   — suppress upload-pass findings (ISSUE 20).
+* ``# transfer-ok: <reason>`` — suppress transfer-pass findings.
+* ``# donate-ok: <reason>``   — suppress donate-pass findings.
+* ``# noqa``                  — the base style pass's escape (kept from
+  the original tools/lint.py).
 
 A suppression WITHOUT a reason is itself a finding (``bare-suppression``):
 the annotation is the changelog entry for the next reader, so an empty
@@ -22,7 +25,8 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Dict, Iterable, Iterator, Tuple
 
-SUPPRESSION_RE = re.compile(r"#\s*(jax-ok|unlocked)\b:?[ \t]*(.*)")
+SUPPRESSION_RE = re.compile(
+    r"#\s*(jax-ok|unlocked|upload-ok|transfer-ok|donate-ok)\b:?[ \t]*(.*)")
 
 
 def _comment_lines(src: str) -> Dict[int, str]:
@@ -59,6 +63,9 @@ class Suppressions:
 
     jax: Dict[int, str] = field(default_factory=dict)
     unlocked: Dict[int, str] = field(default_factory=dict)
+    upload: Dict[int, str] = field(default_factory=dict)
+    transfer: Dict[int, str] = field(default_factory=dict)
+    donate: Dict[int, str] = field(default_factory=dict)
     problems: list = field(default_factory=list)
 
 
@@ -82,7 +89,9 @@ def parse_suppressions(src: str, path: str = "<src>") -> Suppressions:
                 f"documentation)",
             ))
             continue
-        target = sup.jax if kind == "jax-ok" else sup.unlocked
+        target = {"jax-ok": sup.jax, "unlocked": sup.unlocked,
+                  "upload-ok": sup.upload, "transfer-ok": sup.transfer,
+                  "donate-ok": sup.donate}[kind]
         target[i] = reason
         if line.lstrip().startswith("#"):
             j = i  # 0-based index of the line AFTER the comment

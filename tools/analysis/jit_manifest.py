@@ -229,3 +229,74 @@ PALLAS_KERNELS = {
                    "sess_proto", "sess_time"),
     },
 }
+
+
+# --- donation registry (ISSUE 20, the --donate pass) ----------------------
+#
+# Every jax.jit call with a NON-EMPTY donate_argnums must be registered
+# here by (relpath, enclosing scope): donation is an ownership transfer,
+# and an unregistered donating jit is a use-after-donate bug waiting for
+# a reader (the PR-8 checkpoint_sessions hazard).  The reason documents
+# who owns the buffers and why donating is safe.
+DONATED_JIT_SITES = {
+    ("vpp_tpu/pipeline/dataplane.py", "_jitted_step"): (
+        "the packed/ring/chain step factories: packed+chain donate only "
+        "the flat input column block (a fresh jnp.asarray temp at every "
+        "call site); ring donates the tables carry + cursor + rx window, "
+        "owned by the persistent pump which threads the returned carry"),
+    ("bench.py", "sub_benches"): (
+        "throughput loop donates its private dataplane's tables; the "
+        "carry is rebound from StepResult every iteration"),
+    ("bench.py", "session_scale_bench"): (
+        "hashmap shoot-out donates the pristine() column sets (rebuilt "
+        "per window) and the 10M-resident insert carry (rebound from "
+        "the result tuple)"),
+    ("bench.py", "_run"): (
+        "headline loop donates its private dataplane's tables; carry "
+        "rebound from StepResult; commit_bench runs on its OWN "
+        "dataplane for exactly this reason (its docstring)"),
+}
+
+# Donating CALL sites the use-after-donate dataflow checks:
+# (relpath, enclosing scope, callee expression) -> (argnums, reason).
+# The pass finds every matching call in that scope, tracks the donated
+# name arguments, and flags any read that can observe the invalidated
+# buffer (straight-line reads after the call, and loop-carried reads
+# with no rebind in between).  Donated values may only be re-exposed
+# via the sanctioned copy points (checkpoint_sessions / _serve_ckpt
+# jnp.copy, the stager hand-off) — those live in OTHER scopes and get
+# a fresh reference, never the donated one.
+DONATING_CALLS = {
+    ("vpp_tpu/pipeline/persistent.py", "PersistentPump._stage_loop",
+     "self._step"): (
+        (0, 1, 2),
+        "ring window program: donates tables carry + cursor + rx "
+        "window; _stage_loop rebinds all three from the result tuple "
+        "in the same statement"),
+    ("vpp_tpu/pipeline/dataplane.py", "Dataplane.process_packed",
+     "step"): (
+        (1,),
+        "packed column block: the donated arg is a fresh "
+        "jnp.asarray(flat) temp, never a named value"),
+    ("vpp_tpu/pipeline/dataplane.py",
+     "Dataplane.process_packed_chain", "step"): (
+        (1,),
+        "chained packed block: same fresh-temp discipline as "
+        "process_packed"),
+    ("bench.py", "measure_mpps", "step"): (
+        (0,),
+        "tables carry donated and rebound from res.tables each "
+        "iteration"),
+    ("bench.py", "session_scale_bench", "fn"): (
+        (0, 1, 2, 3, 4, 5),
+        "the six hashmap columns are rebuilt by pristine() before "
+        "every donating call"),
+    ("bench.py", "session_scale_bench", "insert"): (
+        (0,),
+        "10M-resident carry: rebound from the result tuple in the "
+        "same statement"),
+    ("bench.py", "_run", "step"): (
+        (0,),
+        "headline tables carry: rebound from res.tables each "
+        "iteration"),
+}

@@ -1,0 +1,291 @@
+"""Placement manifest for DataplaneTables fields and TableBuilder staging.
+
+Companion to the ``--uploads`` pass (`tools/analysis/uploadlint.py`), in the
+same contract style as `jit_manifest.py`: the dict literals below are the
+reviewed source of truth, and the pass diffs them against what the AST of
+``vpp_tpu/pipeline/tables.py`` actually says.  Adding a `DataplaneTables`
+field without deciding how it ships (which `_UPLOAD_GROUPS` entry re-uploads
+it, or which carried-by-reference ledger exempts it) is a finding -- the
+failure mode is otherwise silent: either a stale device plane (field staged
+but never re-shipped) or a full-table re-upload on every swap.
+
+Three tables:
+
+- ``FIELD_PLACEMENTS``: every `DataplaneTables` field -> exactly one
+  placement, ``group:<name>`` (member of that `_UPLOAD_GROUPS` entry; the
+  builder re-stages + re-uploads it when the group is dirty) or
+  ``ledger:<NAME>`` (carried by reference across swaps -- session state,
+  telemetry counters, sweep cursors -- never re-staged from host).
+- ``STAGED_ATTRS``: TableBuilder staging attribute -> upload group.  A
+  mutator that writes one of these must mark that group dirty on every
+  non-raising path, or the next `to_device()` ships a stale plane.
+- ``EXEMPT_METHODS``: TableBuilder methods excluded from the mark-dataflow
+  check, each with the invariant that makes the exemption sound.
+"""
+
+from typing import Dict
+
+# --- field -> placement (generated from tables.py, then reviewed) ---------
+# Keep in DataplaneTables declaration order so diffs stay readable.
+FIELD_PLACEMENTS: Dict[str, str] = {
+    "acl_src_net": "group:acl",
+    "acl_src_mask": "group:acl",
+    "acl_dst_net": "group:acl",
+    "acl_dst_mask": "group:acl",
+    "acl_proto": "group:acl",
+    "acl_sport_lo": "group:acl",
+    "acl_sport_hi": "group:acl",
+    "acl_dport_lo": "group:acl",
+    "acl_dport_hi": "group:acl",
+    "acl_action": "group:acl",
+    "acl_nrules": "group:acl",
+    "acl_bv_bnd_src": "group:acl",
+    "acl_bv_bnd_dst": "group:acl",
+    "acl_bv_bnd_sport": "group:acl",
+    "acl_bv_bnd_dport": "group:acl",
+    "acl_bv_nbnd": "group:acl",
+    "acl_bv_src": "group:acl",
+    "acl_bv_dst": "group:acl",
+    "acl_bv_sport": "group:acl",
+    "acl_bv_dport": "group:acl",
+    "acl_bv_proto": "group:acl",
+    "glb_src_net": "group:glb",
+    "glb_src_mask": "group:glb",
+    "glb_dst_net": "group:glb",
+    "glb_dst_mask": "group:glb",
+    "glb_proto": "group:glb",
+    "glb_sport_lo": "group:glb",
+    "glb_sport_hi": "group:glb",
+    "glb_dport_lo": "group:glb",
+    "glb_dport_hi": "group:glb",
+    "glb_action": "group:glb",
+    "glb_nrules": "group:glb",
+    "glb_mxu_coeff": "group:glb",
+    "glb_mxu_k": "group:glb",
+    "glb_mxu_act": "group:glb",
+    "glb_bv_bnd_src": "group:glb_bv",
+    "glb_bv_bnd_dst": "group:glb_bv",
+    "glb_bv_bnd_sport": "group:glb_bv",
+    "glb_bv_bnd_dport": "group:glb_bv",
+    "glb_bv_nbnd": "group:glb_bv",
+    "glb_bv_src": "group:glb_bv",
+    "glb_bv_dst": "group:glb_bv",
+    "glb_bv_sport": "group:glb_bv",
+    "glb_bv_dport": "group:glb_bv",
+    "glb_bv_proto": "group:glb_bv",
+    "glb_ml_w1": "group:ml",
+    "glb_ml_b1": "group:ml",
+    "glb_ml_s1": "group:ml",
+    "glb_ml_w2": "group:ml",
+    "glb_ml_b2": "group:ml",
+    "glb_ml_f_feat": "group:ml",
+    "glb_ml_f_thresh": "group:ml",
+    "glb_ml_f_leaf": "group:ml",
+    "glb_ml_thresh": "group:ml",
+    "glb_ml_action": "group:ml",
+    "glb_ml_rl_shift": "group:ml",
+    "glb_ml_version": "group:ml",
+    "if_type": "group:if",
+    "if_local_table": "group:if",
+    "if_apply_global": "group:if",
+    "fib_prefix": "group:fib",
+    "fib_mask": "group:fib",
+    "fib_plen": "group:fib",
+    "fib_tx_if": "group:fib",
+    "fib_disp": "group:fib",
+    "fib_next_hop": "group:fib",
+    "fib_node_id": "group:fib",
+    "fib_snat": "group:fib",
+    "fib_grp": "group:fib",
+    "fib_lpm_p0": "group:fib",
+    "fib_lpm_p1": "group:fib",
+    "fib_lpm_p2": "group:fib",
+    "fib_lpm_p3": "group:fib",
+    "fib_lpm_p4": "group:fib",
+    "fib_lpm_p5": "group:fib",
+    "fib_lpm_p6": "group:fib",
+    "fib_lpm_p7": "group:fib",
+    "fib_lpm_p8": "group:fib",
+    "fib_lpm_p9": "group:fib",
+    "fib_lpm_p10": "group:fib",
+    "fib_lpm_p11": "group:fib",
+    "fib_lpm_p12": "group:fib",
+    "fib_lpm_p13": "group:fib",
+    "fib_lpm_p14": "group:fib",
+    "fib_lpm_p15": "group:fib",
+    "fib_lpm_p16": "group:fib",
+    "fib_lpm_p17": "group:fib",
+    "fib_lpm_p18": "group:fib",
+    "fib_lpm_p19": "group:fib",
+    "fib_lpm_p20": "group:fib",
+    "fib_lpm_p21": "group:fib",
+    "fib_lpm_p22": "group:fib",
+    "fib_lpm_p23": "group:fib",
+    "fib_lpm_p24": "group:fib",
+    "fib_lpm_p25": "group:fib",
+    "fib_lpm_p26": "group:fib",
+    "fib_lpm_p27": "group:fib",
+    "fib_lpm_p28": "group:fib",
+    "fib_lpm_p29": "group:fib",
+    "fib_lpm_p30": "group:fib",
+    "fib_lpm_p31": "group:fib",
+    "fib_lpm_p32": "group:fib",
+    "fib_lpm_cnt": "group:fib",
+    "fib_lpm_hint": "group:fib",
+    "fib_grp_nh": "group:fib",
+    "fib_grp_tx_if": "group:fib",
+    "fib_grp_node": "group:fib",
+    "fib_grp_n": "group:fib",
+    "fib_ecmp_c": "ledger:FIB_STATE_FIELDS",
+    "sess_src": "ledger:SESSION_FIELDS",
+    "sess_dst": "ledger:SESSION_FIELDS",
+    "sess_ports": "ledger:SESSION_FIELDS",
+    "sess_proto": "ledger:SESSION_FIELDS",
+    "sess_valid": "ledger:SESSION_FIELDS",
+    "sess_time": "ledger:SESSION_FIELDS",
+    "sess_max_age": "group:config",
+    "nat_ext_ip": "group:nat",
+    "nat_ext_port": "group:nat",
+    "nat_proto": "group:nat",
+    "nat_boff": "group:nat",
+    "nat_bcnt": "group:nat",
+    "nat_total_w": "group:nat",
+    "nat_self_snat": "group:nat",
+    "natb_ip": "group:nat",
+    "natb_port": "group:nat",
+    "natb_cumw": "group:nat",
+    "nat_snat_ip": "group:nat",
+    "natsess_a": "ledger:SESSION_FIELDS",
+    "natsess_b": "ledger:SESSION_FIELDS",
+    "natsess_ports": "ledger:SESSION_FIELDS",
+    "natsess_proto": "ledger:SESSION_FIELDS",
+    "natsess_valid": "ledger:SESSION_FIELDS",
+    "natsess_time": "ledger:SESSION_FIELDS",
+    "natsess_orig_ip": "ledger:SESSION_FIELDS",
+    "natsess_orig_port": "ledger:SESSION_FIELDS",
+    "natsess_src_ip": "ledger:SESSION_FIELDS",
+    "natsess_sport": "ledger:SESSION_FIELDS",
+    "natsess_kind": "ledger:SESSION_FIELDS",
+    "sess_sweep_cursor": "ledger:SESSION_FIELDS",
+    "natsess_sweep_cursor": "ledger:SESSION_FIELDS",
+    "tel_lat_hist": "ledger:TELEMETRY_FIELDS",
+    "tel_sketch": "ledger:TELEMETRY_FIELDS",
+    "tel_sketched": "ledger:TELEMETRY_FIELDS",
+    "tel_top_key": "ledger:TELEMETRY_FIELDS",
+    "tel_top_src": "ledger:TELEMETRY_FIELDS",
+    "tel_top_dst": "ledger:TELEMETRY_FIELDS",
+    "tel_top_ports": "ledger:TELEMETRY_FIELDS",
+    "tel_top_cnt": "ledger:TELEMETRY_FIELDS",
+    "tnt_pfx_net": "group:tenant",
+    "tnt_pfx_mask": "group:tenant",
+    "tnt_pfx_id": "group:tenant",
+    "tnt_rate": "group:tenant",
+    "tnt_burst": "group:tenant",
+    "tnt_sess_base": "group:tenant",
+    "tnt_sess_mask": "group:tenant",
+    "tnt_nat_base": "group:tenant",
+    "tnt_nat_mask": "group:tenant",
+    "glb_ml_tnt_mode": "group:tenant",
+    "glb_ml_tnt_thresh": "group:tenant",
+    "tnt_vni": "group:tenant",
+    "tnt_tokens": "ledger:TENANCY_STATE_FIELDS",
+    "tnt_tok_time": "ledger:TENANCY_STATE_FIELDS",
+    "tnt_rx_c": "ledger:TENANCY_STATE_FIELDS",
+    "tnt_tx_c": "ledger:TENANCY_STATE_FIELDS",
+    "tnt_rl_c": "ledger:TENANCY_STATE_FIELDS",
+    "tnt_qf_c": "ledger:TENANCY_STATE_FIELDS",
+    "ovl_vtep_ip": "group:config",
+    "svc_vip_ip": "group:svc",
+    "svc_vip_port": "group:svc",
+    "svc_vip_proto": "group:svc",
+    "svc_vip_snat": "group:svc",
+    "svc_bk_n": "group:svc",
+    "svc_bk_ip": "group:svc",
+    "svc_bk_port": "group:svc",
+}
+
+# --- TableBuilder staging attribute -> upload group -----------------------
+# Writes to these (attribute assign, subscript store, or in-place update)
+# inside a TableBuilder method must be followed, on every non-raising path,
+# by a mark of the mapped group (self._mark(g) / self._dirty.add(g) /
+# self._dirty.update(..)).  Host-only metadata attrs (caches, prev-refs,
+# timing) are deliberately absent: writing them cannot stale a device plane.
+STAGED_ATTRS: Dict[str, str] = {
+    # acl: per-table rule columns + compiled per-table bit-planes
+    "acl": "acl",
+    "acl_nrules": "acl",
+    "acl_bv": "acl",
+    # glb: packed global rule columns (+ MXU operand re-pack)
+    "glb": "glb",
+    "glb_nrules": "glb",
+    "glb_mxu": "glb",
+    # glb_bv: compiled global bit-vector planes
+    "glb_bv": "glb_bv",
+    # ml: model weights/config staging dict + kind tag
+    "ml": "ml",
+    "ml_kind": "ml",
+    # tenant: tenancy table + its restaged column dict
+    "tenants": "tenant",
+    "tnt": "tenant",
+    # if: interface typing / table binding rows
+    "if_type": "if",
+    "if_local_table": "if",
+    "if_apply_global": "if",
+    # fib: route slots, nh-groups, and the LPM restage products
+    "fib_prefix": "fib",
+    "fib_plen": "fib",
+    "fib_mask": "fib",
+    "fib_next_hop": "fib",
+    "fib_tx_if": "fib",
+    "fib_node_id": "fib",
+    "fib_disp": "fib",
+    "fib_snat": "fib",
+    "fib_grp": "fib",
+    "nh_groups": "fib",
+    "fib_grp_nh": "fib",
+    "fib_grp_tx_if": "fib",
+    "fib_grp_node": "fib",
+    "fib_grp_n": "fib",
+    "lpm_planes": "fib",
+    "lpm_cnt": "fib",
+    "lpm_counts": "fib",
+    "lpm_hint": "fib",
+    # nat: static mapping rows + backend pools + SNAT ip
+    "nat_proto": "nat",
+    "nat_ext_ip": "nat",
+    "nat_ext_port": "nat",
+    "nat_boff": "nat",
+    "nat_bcnt": "nat",
+    "nat_total_w": "nat",
+    "nat_self_snat": "nat",
+    "natb_ip": "nat",
+    "natb_port": "nat",
+    "natb_cumw": "nat",
+    "nat_snat_ip": "nat",
+    # config: scalar knobs shipped with the config group
+    "ovl_vtep_ip": "config",
+    # svc: service LB staging + its restaged column dict
+    "services": "svc",
+    "svc": "svc",
+}
+
+# --- methods exempt from the mark-dataflow check --------------------------
+EXEMPT_METHODS: Dict[str, str] = {
+    "state_snapshot": (
+        "read-only apart from the _restage_lpm refresh; snapshots staging, "
+        "never stales it"),
+    "_restage_lpm": (
+        "lazy LPM restage: only reachable with 'fib' already dirty "
+        "(_mark_fib_slots adds the plen to _lpm_dirty_lens AND marks 'fib' "
+        "atomically; state_restore resets the set), so the group mark "
+        "already happened at the add_route/del_route site"),
+    "state_restore": (
+        "rollback path, audited ISSUE 20: every snapshot->restore span "
+        "(txn.apply_txn, cli config-replay, configurator "
+        "_render_svc_locked) runs under the dataplane commit lock with "
+        "the restore BEFORE the aborted swap, so no to_device() can "
+        "intervene and _dirty only grew since the snapshot; the "
+        "`_dirty |= snap['dirty']` union plus the explicit full "
+        "fib/bv re-dirty and _svc_prev/_fib_prev resets covers every "
+        "group whose staging can diverge from the device cache"),
+}

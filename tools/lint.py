@@ -30,10 +30,26 @@ tabs/trailing whitespace, mutable defaults) over ROOTS. Flags add:
               vpp_tpu/parallel/partition.py rule (sharded or
               replicated-by-design), no stale rules. Tier-1 runs it
               via tests/test_partition.py; `make lint` includes it.
+  --uploads   upload-group consistency over pipeline/tables.py and
+              its callers (ISSUE 20): every DataplaneTables field
+              placed in exactly one _UPLOAD_GROUPS entry or state
+              ledger (manifest: tools/analysis/upload_manifest.py),
+              and every TableBuilder staged-attr write marks its
+              group dirty on every path. Suppress one line with
+              `# upload-ok: <reason>`.
+  --transfers host materialization of table-scale device values
+              (np.asarray / jax.device_get / .item() / int() on
+              DataplaneTables-reachable values) outside the approved
+              fetch sites (tools/analysis/transfer_manifest.py).
+              Suppress with `# transfer-ok: <reason>`.
+  --donate    use-after-donate over the registered donating jit call
+              sites (jit_manifest.DONATING_CALLS), plus unregistered
+              non-empty donate_argnums detection. Suppress with
+              `# donate-ok: <reason>`.
 
 Exit code 1 if anything fires. `make lint` runs the base + --jax +
---threads (the pure-AST passes). Rule catalog + suppression syntax:
-docs/STATIC_ANALYSIS.md.
+--threads + --uploads + --transfers + --donate (the pure-AST passes).
+Rule catalog + suppression syntax: docs/STATIC_ANALYSIS.md.
 """
 
 from __future__ import annotations
@@ -54,6 +70,9 @@ from analysis.registries import (  # noqa: E402  (re-exported: tier-1
     tables_lint,
 )
 from analysis.threadlint import threads_lint  # noqa: E402
+from analysis.uploadlint import uploads_lint  # noqa: E402
+from analysis.transferlint import transfers_lint  # noqa: E402
+from analysis.donatelint import donate_lint  # noqa: E402
 
 ROOTS = ("vpp_tpu", "tests", "bench.py", "__graft_entry__.py", "tools")
 
@@ -82,6 +101,12 @@ def main(argv=None) -> int:
         all_problems.extend(str(f) for f in jax_lint(repo))
     if "--threads" in argv:
         all_problems.extend(str(f) for f in threads_lint(repo))
+    if "--uploads" in argv:
+        all_problems.extend(str(f) for f in uploads_lint(repo))
+    if "--transfers" in argv:
+        all_problems.extend(str(f) for f in transfers_lint(repo))
+    if "--donate" in argv:
+        all_problems.extend(str(f) for f in donate_lint(repo))
     if "--metrics" in argv:
         all_problems.extend(metrics_lint())
     if "--counters" in argv:

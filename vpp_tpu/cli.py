@@ -944,10 +944,12 @@ class DebugCLI:
             # is [n_buckets, W] — slots = size, not the bucket count
             valid = t.sess_valid == 1
             fresh_mask = now - t.sess_time <= t.sess_max_age
+            live = int(jnp.sum(valid & fresh_mask))  # transfer-ok: scalar
+            nvalid = int(jnp.sum(valid))  # transfer-ok: scalar
             lines.append(
-                f"  sessions: {int(jnp.sum(valid & fresh_mask))} live "
+                f"  sessions: {live} live "
                 f"of {t.sess_valid.size} slots "
-                f"({int(jnp.sum(valid))} valid)"
+                f"({nvalid} valid)"
             )
         if self.pump is not None:
             s = self.pump.stats
@@ -1315,6 +1317,7 @@ class DebugCLI:
         # each variant shows 1; a RECOMPILED marker is the PR-4
         # regression class live — see /debug/jit for shape signatures
         from vpp_tpu.pipeline.dataplane import (
+            device_transfer_totals,
             jit_compile_totals,
             jit_recompiles,
         )
@@ -1330,6 +1333,14 @@ class DebugCLI:
                     f"jit RECOMPILED ({len(recomp)} step+shape keys "
                     f"traced >1x — compile-once contract broken)"
                 )
+        # device-transfer guard (ISSUE 20): bytes fetched per approved
+        # site — the serving-path sites must stay rider/descriptor-sized
+        xfer = device_transfer_totals()
+        if xfer:
+            lines.append(
+                "device transfer bytes: "
+                + ", ".join(f"{k} {v}" for k, v in sorted(xfer.items()))
+            )
         if self.io_ctl is not None:
             # the whole block is guarded: the daemon is another process
             # over a socket, so besides being down it may be a different

@@ -64,7 +64,6 @@ from vpp_tpu.fleet.hashring import (
     assign_ranges,
     buckets_of_packed,
     buckets_per_range,
-    canon_mix_np,
     moved_ranges,
     range_span,
 )
@@ -284,41 +283,38 @@ class FleetSteering:
         owner will have to NAT-re-establish (the migration moves only
         the reflective table). The NAT extras columns carry the full
         PRE-NAT tuple (orig src/dst/ports), so each session's steering
-        bucket is recomputed host-side with the same sym canonical mix
-        ``buckets_of_packed`` uses; a control-plane-rate full-column
-        fetch, never on the packet path. Tenant-sliced steering
-        (partition with tenant_ids) re-bases buckets per tenant;
-        this count uses the unsliced mix and is exact for the
-        un-sliced fleets the bench and tests run."""
-        import jax
+        bucket is recomputed with the same sym canonical mix
+        ``buckets_of_packed`` uses — ON DEVICE (``ops.session.
+        canon_mix``), reducing to one count; only a scalar crosses the
+        transport, vs the seven full natsess columns the first cut
+        fetched host-side (caught by ``lint.py --transfers``).
+        Tenant-sliced steering (partition with tenant_ids) re-bases
+        buckets per tenant; this count uses the unsliced mix and is
+        exact for the un-sliced fleets the bench and tests run."""
+        import jax.numpy as jnp
+
+        from vpp_tpu.ops.session import canon_mix
 
         with dp._lock:
             tables = dp.tables
             if tables is None:
                 return 0
             now = max(dp._now, dp.clock_ticks())
-        cols = jax.device_get((tables.natsess_valid,
-                               tables.natsess_time,
-                               tables.natsess_src_ip,
-                               tables.natsess_sport,
-                               tables.natsess_orig_ip,
-                               tables.natsess_orig_port,
-                               tables.natsess_proto,
-                               tables.sess_max_age))
-        valid, t, src_ip, sport, dst_ip, dport, proto, max_age = (
-            np.asarray(c) for c in cols)
-        live = (valid.ravel() == 1) & (now - t.ravel() <= int(max_age))
-        if not live.any():
-            return 0
-        mix = canon_mix_np(
-            src_ip.ravel().astype(np.uint32),
-            dst_ip.ravel().astype(np.uint32),
-            sport.ravel().astype(np.uint32) & np.uint32(0xFFFF),
-            dport.ravel().astype(np.uint32) & np.uint32(0xFFFF),
-            proto.ravel().astype(np.uint32) & np.uint32(0xFF))
-        b = (mix & np.uint32(self.n_buckets - 1)).astype(np.int64)
-        return int((live & (b >= start)
-                    & (b < start + n_buckets)).sum())
+        live = ((tables.natsess_valid.ravel() == 1)
+                & (now - tables.natsess_time.ravel()
+                   <= tables.sess_max_age))
+        mix = canon_mix(
+            tables.natsess_src_ip.ravel().astype(jnp.uint32),
+            tables.natsess_orig_ip.ravel().astype(jnp.uint32),
+            tables.natsess_sport.ravel().astype(jnp.uint32)
+            & jnp.uint32(0xFFFF),
+            tables.natsess_orig_port.ravel().astype(jnp.uint32)
+            & jnp.uint32(0xFFFF),
+            tables.natsess_proto.ravel().astype(jnp.uint32)
+            & jnp.uint32(0xFF))
+        b = (mix & jnp.uint32(self.n_buckets - 1)).astype(jnp.int32)
+        # transfer-ok: device-reduced scalar — 4 bytes cross, not columns
+        return int(jnp.sum(live & (b >= start) & (b < start + n_buckets)))
 
     def _migrate(self, rid: int, src: str, dst: str) -> None:
         """One range's move: fence → drain → adopt → commit → release.

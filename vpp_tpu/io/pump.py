@@ -92,6 +92,7 @@ import numpy as np
 from vpp_tpu.io.rings import VEC, IORingPair
 from vpp_tpu.pipeline.dataplane import (
     PACKED_IN_ROWS,
+    count_device_transfer,
     pack_packet_columns,
     unpack_packet_input,
 )
@@ -1798,6 +1799,9 @@ class DataplanePump:
                     (payload.pkts, payload.disp, payload.tx_if,
                      payload.next_hop, payload.drop_cause)
                 )
+                count_device_transfer(
+                    "pump.fetch.columns",
+                    (out_pkts, disp, tx_if, next_hop, cause))
                 batch = {
                     "src_ip": np.asarray(out_pkts.src_ip),
                     "dst_ip": np.asarray(out_pkts.dst_ip),
@@ -1830,6 +1834,7 @@ class DataplanePump:
                 # one fetch for both: the aux summary (12 B) must not
                 # cost a second round trip on a remote transport
                 out_h, aux_h = jax.device_get((out, aux))
+                count_device_transfer("pump.fetch.packed", (out_h, aux_h))
                 batch = np.array(out_h)
                 tf1 = time.perf_counter()
                 # concurrent fetchers: accumulate under a lock or

@@ -656,6 +656,8 @@ class ClusterDataplane:
             return [0] * self.rule_shards
         valid = tables.sess_valid  # [N, NB, W]
         per = valid.shape[1] // self.rule_shards
+        # transfer-ok: device-reduced [rule_shards] counts — shards*8
+        # bytes cross, the [N, NB, W] table never leaves the device
         resident = np.asarray(jnp.sum(
             valid.reshape(valid.shape[0], self.rule_shards, per,
                           valid.shape[2]),
@@ -954,6 +956,7 @@ class ClusterDataplane:
         # lock across a device round trip would stall every concurrent
         # step dispatch (periodic p99 spikes)
         after = session_expire(before, now, max_age)
+        # transfer-ok: device-reduced scalar (expired-slot count)
         expired = int(
             jnp.sum(before.sess_valid - after.sess_valid)
             + jnp.sum(before.natsess_valid - after.natsess_valid)

@@ -474,6 +474,86 @@ def jit_compile_budget(budget: int) -> _JitBudget:
     return _JitBudget(budget)
 
 
+# Runtime device-transfer guard (ISSUE 20): the static ``--transfers``
+# pass pins WHERE device->host fetches may happen (the manifest in
+# tools/analysis/transfer_manifest.py); this counter proves HOW MUCH
+# each approved site actually moves at run time. Every sanctioned fetch
+# point funnels its device_get result through count_device_transfer(),
+# keyed by site. Exported as
+# ``vpp_tpu_device_transfer_bytes_total{site=}`` (stats/collector.py),
+# shown by `show io`, enforced per-test by the opt-in transfer_budget
+# fixture (tests/conftest.py), and recorded per bench section — the
+# wire/persistent sections must fetch rider/descriptor bytes per
+# window, never table columns ("~270 MB crosses the transport" was the
+# PR-6/8/12 regression class).
+_TRANSFER_BYTES: Dict[str, int] = {}
+_TRANSFER_LOCK = threading.Lock()
+
+
+def count_device_transfer(site: str, fetched) -> None:
+    """Charge ``fetched``'s array bytes (any pytree of host/device
+    arrays; scalars count their itemsize) to ``site``. Call it on the
+    device_get RESULT at every approved fetch point — the charge is
+    the bytes that actually crossed the transport."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(fetched):
+        nb = getattr(leaf, "nbytes", None)
+        total += int(nb) if nb is not None else 8
+    with _TRANSFER_LOCK:
+        _TRANSFER_BYTES[site] = _TRANSFER_BYTES.get(site, 0) + total
+
+
+def device_transfer_totals() -> Dict[str, int]:
+    """Snapshot of {site: device->host bytes fetched} this process
+    (the ``site=`` axis of ``vpp_tpu_device_transfer_bytes_total``)."""
+    with _TRANSFER_LOCK:
+        return dict(_TRANSFER_BYTES)
+
+
+class TransferBudgetExceeded(AssertionError):
+    """Raised by transfer_budget() when a scope fetches more
+    device->host bytes than it declared."""
+
+
+class _TransferBudget:
+    def __init__(self, budget_bytes: int):
+        self.budget = budget_bytes
+        self._before: Optional[Dict[str, int]] = None
+
+    def __enter__(self) -> "_TransferBudget":
+        self._before = device_transfer_totals()
+        return self
+
+    @property
+    def spent(self) -> int:
+        before = self._before or {}
+        return (sum(device_transfer_totals().values())
+                - sum(before.values()))
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None:
+            return
+        before = self._before or {}
+        after = device_transfer_totals()
+        new = {k: n - before.get(k, 0) for k, n in after.items()
+               if n - before.get(k, 0) > 0}
+        spent = sum(new.values())
+        if spent > self.budget:
+            detail = ", ".join(
+                f"{site}={n}B" for site, n in sorted(new.items()))
+            raise TransferBudgetExceeded(
+                f"device->host transfer budget exceeded: {spent} bytes "
+                f"> declared budget {self.budget} ({detail})")
+
+
+def transfer_budget(budget_bytes: int) -> _TransferBudget:
+    """Context manager: fail if the enclosed scope fetches more than
+    ``budget_bytes`` device->host bytes through the counted sites. The
+    opt-in pytest fixture of the same name (tests/conftest.py) wraps a
+    test declaring ``@pytest.mark.transfer_budget(n)``."""
+    return _TransferBudget(budget_bytes)
+
+
 def _jitted_step(impl: str, skip_local: bool, fast: bool, form: str,
                  sweep_stride: Optional[int] = None,
                  ring_slots: int = 0,
@@ -1022,6 +1102,7 @@ class Dataplane:
             self._now = max(self._now, self.clock_ticks())
             before = self.tables
             after = session_expire(before, self._now, max_age)
+            # transfer-ok: device-reduced scalar (expired-slot count)
             expired = int(
                 jnp.sum(before.sess_valid - after.sess_valid)
                 + jnp.sum(before.natsess_valid - after.natsess_valid)
@@ -1153,6 +1234,7 @@ class Dataplane:
             }
         if t is not None:
             ecmp_c = np.asarray(jax.device_get(t.fib_ecmp_c), np.int64)
+            count_device_transfer("fib.snapshot", ecmp_c)
             snap["ecmp_c"] = ecmp_c
             for g, members in groups.items():
                 for m in members:
@@ -1486,6 +1568,9 @@ class Dataplane:
             t.tel_lat_hist, t.tel_sketched, t.tel_top_key,
             t.tel_top_src, t.tel_top_dst, t.tel_top_ports,
             t.tel_top_cnt))
+        count_device_transfer(
+            "telemetry.snapshot",
+            (bins, sketched, key, src, dst, ports, cnt))
         return {
             "mode": self._tel_mode,
             "bins": np.asarray(bins, np.int64),
@@ -1527,6 +1612,9 @@ class Dataplane:
             jax.device_get((t.tnt_tokens, t.tnt_rx_c, t.tnt_tx_c,
                             t.tnt_rl_c, t.tnt_qf_c, occ, t.tnt_rate,
                             t.tnt_burst, t.tnt_sess_mask))
+        count_device_transfer(
+            "tenant.snapshot",
+            (tokens, rx, tx, rl, qf, occ_h, rate, burst, smask))
         return {
             "tenants": registry,
             "tokens": np.asarray(tokens, np.int64),

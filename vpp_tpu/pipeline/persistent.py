@@ -55,6 +55,7 @@ from vpp_tpu.io.rings import DESC_ROWS, DeviceDescRing
 from vpp_tpu.pipeline.dataplane import (
     PACKED_IN_ROWS,
     _jitted_step,
+    count_device_transfer,
 )
 from vpp_tpu.testing import faults
 
@@ -454,10 +455,13 @@ class PersistentPump:
                 if tel is not None:
                     out_h, aux_h, tel_h = jax.device_get(
                         (tx_ring, aux_ring, tel))
+                    count_device_transfer("ring.window",
+                                          (out_h, aux_h, tel_h))
                     with self._stats_lock:
                         self._tel_last = np.array(tel_h, np.int32)
                 else:
                     out_h, aux_h = jax.device_get((tx_ring, aux_ring))
+                    count_device_transfer("ring.window", (out_h, aux_h))
                 out_h = np.asarray(out_h)
                 aux_h = np.asarray(aux_h)
                 # the staging buffer is reusable once its window's

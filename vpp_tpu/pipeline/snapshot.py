@@ -70,6 +70,7 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from vpp_tpu.pipeline.dataplane import count_device_transfer
 from vpp_tpu.pipeline.tables import (
     SESSION_FIELDS,
     _SESSION_SHAPE,
@@ -430,6 +431,7 @@ class SessionSnapshotter:
                     t0 = time.perf_counter()
                     block = np.asarray(
                         jax.device_get(fetch(cols, np.int32(idx * cb))))
+                    count_device_transfer("snapshot.drain", block)
                     payload = block.tobytes()
                     name = _chunk_name(table, idx, gen, node)
                     crc = self._write_chunk(
@@ -715,6 +717,7 @@ def drain_bucket_range(dp, start: int, n_buckets: int,
         faults.fire("fleet.migrate")
         step = min(cb, start + n_buckets - off)
         block = np.asarray(jax.device_get(fetch(cols, np.int32(off))))
+        count_device_transfer("migrate.drain", block)
         for i, f in enumerate(fields):
             out[f].append(block[i, :step].view(SESSION_FIELDS[f]))
     return ({f: np.concatenate(v, axis=0) for f, v in out.items()},
@@ -739,6 +742,7 @@ def adopt_bucket_range(dp, cols: Dict[str, np.ndarray], start: int,
         now_dst = max(dp._now, dp.clock_ticks())
     sessions = {f: np.array(jax.device_get(getattr(tables, f)))
                 for f in SESSION_FIELDS}
+    count_device_transfer("migrate.adopt", sessions)
     total = int(sessions[fields[0]].shape[0])
     if not (0 <= start and n > 0 and start + n <= total):
         raise ValueError(
@@ -776,6 +780,7 @@ def release_bucket_range(dp, start: int, n_buckets: int,
                 "staging handle cannot release migrated sessions")
     sessions = {f: np.array(jax.device_get(getattr(tables, f)))
                 for f in SESSION_FIELDS}
+    count_device_transfer("migrate.release", sessions)
     total = int(sessions[valid_field].shape[0])
     if not (0 <= start and n_buckets > 0
             and start + n_buckets <= total):

@@ -1658,6 +1658,12 @@ class TableBuilder:
                 # address bitmaps untouched on host AND device
                 from vpp_tpu.ops.acl_bv import compile_bv
 
+                # upload-ok: compile_bv reuses the prev planes for
+                # every dimension it did not rebuild, so when
+                # `rebuilt` is empty the device copies are still
+                # content-identical and skipping the glb_bv mark is
+                # the zero-reship design, not a staleness gap; any
+                # rebuilt dimension marks the group two lines down
                 self.glb_bv, self._bv_cols, rebuilt = compile_bv(
                     self.glb, cap, prev=self.glb_bv,
                     prev_cols=self._bv_cols)

@@ -667,6 +667,20 @@ class StatsCollector:
                   "compile-once contract broke)",
                   kind="counter"),
         )
+        # runtime device-transfer guard (pipeline/dataplane.py
+        # _TRANSFER_BYTES, ISSUE 20): device->host bytes fetched per
+        # approved site, labelled site=. The serving-path sites
+        # (pump.fetch.*, ring.window) must grow rider/descriptor-sized
+        # per window; a table-column-scale rate() here is the PR-6/8/12
+        # "aggregate on host" regression class happening live.
+        self.transfer_bytes_gauge = self.registry.register(
+            STATS_PATH,
+            Gauge("vpp_tpu_device_transfer_bytes_total",
+                  "device->host bytes fetched per approved transfer "
+                  "site (process-wide; the static --transfers pass "
+                  "pins WHERE, this counts HOW MUCH)",
+                  kind="counter"),
+        )
         # drops by cause (packets): the pump contributes tx_stall +
         # shutdown, the IO daemon rx_full (set_io_daemon) — together
         # they attribute every persistent-path loss the r5 goodput
@@ -1174,6 +1188,7 @@ class StatsCollector:
             # at the 10M-slot config — a periodic scrape must fetch one
             # scalar, not the column (cli.py show_sessions rationale)
             self.node_gauges["vpp_tpu_node_sessions_active"].set(
+                # transfer-ok: device-reduced scalar (see above)
                 int(jnp.sum(self.dp.tables.sess_valid))
             )
         impl = getattr(self.dp, "classifier_impl", "dense")
@@ -1275,6 +1290,7 @@ class StatsCollector:
                 1.0 if name == ml_mode else 0.0, mode=name)
         tables = self.dp.tables
         self.ml_model_gauge.set(
+            # transfer-ok: glb_ml_version is a device SCALAR, not a column
             float(int(tables.glb_ml_version))
             if tables is not None and ml_mode != "off" else 0.0)
         ml_src = self._ml_source
@@ -1284,9 +1300,14 @@ class StatsCollector:
         if ml_src is not None:
             for outcome, n in ml_src.stats_snapshot()["outcomes"].items():
                 self.ml_load_gauge.set(float(n), outcome=outcome)
-        from vpp_tpu.pipeline.dataplane import jit_compile_totals
+        from vpp_tpu.pipeline.dataplane import (
+            device_transfer_totals,
+            jit_compile_totals,
+        )
         for label, n in jit_compile_totals().items():
             self.jit_compiles_gauge.set(float(n), step=label)
+        for site, n in device_transfer_totals().items():
+            self.transfer_bytes_gauge.set(float(n), site=site)
         # build-info anchor (ISSUE 11 satellite): constant 1, identity
         # labels. The classifier label follows the live selection —
         # on a change the previous label set is removed so exactly one
